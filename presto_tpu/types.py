@@ -1,0 +1,255 @@
+"""SQL type system for the TPU engine.
+
+Reference surface: presto-common/src/main/java/com/facebook/presto/common/type/
+(~80 files: BigintType, DoubleType, VarcharType, DecimalType, ArrayType, ...)
+and the type-signature parser the native worker keeps in
+presto-native-execution/presto_cpp/main/types/TypeParser.cpp.
+
+TPU mapping decisions (deliberately different from the JVM/Velox layouts):
+
+* Integral SQL types map to the narrowest JAX integer dtype; arithmetic is
+  exact on-device.
+* DECIMAL(p, s) with p <= 18 maps to a scaled int64 ("short decimal") --
+  exact fixed-point arithmetic on the VPU. p > 18 (LongDecimalType's
+  int128) is represented as a (hi64, lo64) pair; round 1 supports
+  short decimals only in compute.
+* VARCHAR/CHAR map to fixed-width padded uint8 matrices + a length vector
+  (TPU has no pointers; offsets+bytes heaps don't vectorize). Dictionary
+  encoding is the preferred representation for wide/low-cardinality
+  string columns.
+* DATE is days-since-epoch int32; TIMESTAMP is micros-since-epoch int64
+  (reference stores millis; micros match TPU-friendly 64-bit math and
+  modern Presto semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "Type",
+    "BOOLEAN", "TINYINT", "SMALLINT", "INTEGER", "BIGINT",
+    "REAL", "DOUBLE", "DATE", "TIMESTAMP", "UNKNOWN",
+    "varchar", "char", "decimal", "array_of", "map_of", "row_of",
+    "parse_type",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Type:
+    """A SQL type. `base` is the lowercase base name ("bigint", "varchar",
+    "decimal", "array", ...); `parameters` hold numeric or nested-type
+    parameters exactly as in a Presto TypeSignature."""
+
+    base: str
+    parameters: Tuple[object, ...] = ()
+
+    # ---- classification -------------------------------------------------
+    @property
+    def is_integral(self) -> bool:
+        return self.base in ("tinyint", "smallint", "integer", "bigint")
+
+    @property
+    def is_floating(self) -> bool:
+        return self.base in ("real", "double")
+
+    @property
+    def is_decimal(self) -> bool:
+        return self.base == "decimal"
+
+    @property
+    def is_string(self) -> bool:
+        return self.base in ("varchar", "char")
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.is_integral or self.is_floating or self.is_decimal
+
+    @property
+    def is_fixed_width(self) -> bool:
+        return not (self.is_string or self.base in ("array", "map", "row"))
+
+    # ---- decimal helpers ------------------------------------------------
+    @property
+    def precision(self) -> int:
+        assert self.is_decimal
+        return int(self.parameters[0])
+
+    @property
+    def scale(self) -> int:
+        assert self.is_decimal
+        return int(self.parameters[1])
+
+    @property
+    def is_short_decimal(self) -> bool:
+        return self.is_decimal and self.precision <= 18
+
+    # ---- string helpers -------------------------------------------------
+    @property
+    def max_length(self) -> int:
+        """Declared length for varchar(n)/char(n); UNBOUNDED_LENGTH if none."""
+        if self.parameters:
+            return int(self.parameters[0])
+        return UNBOUNDED_LENGTH
+
+    # ---- container helpers ----------------------------------------------
+    @property
+    def element_type(self) -> "Type":
+        assert self.base == "array"
+        return self.parameters[0]
+
+    @property
+    def key_type(self) -> "Type":
+        assert self.base == "map"
+        return self.parameters[0]
+
+    @property
+    def value_type(self) -> "Type":
+        assert self.base == "map"
+        return self.parameters[1]
+
+    @property
+    def field_types(self) -> Tuple["Type", ...]:
+        assert self.base == "row"
+        return tuple(p[1] if isinstance(p, tuple) else p for p in self.parameters)
+
+    # ---- dtype mapping --------------------------------------------------
+    def to_dtype(self) -> np.dtype:
+        """numpy/JAX dtype of the on-device value array for this type."""
+        d = _DTYPES.get(self.base)
+        if d is not None:
+            return np.dtype(d)
+        if self.is_decimal:
+            if self.is_short_decimal:
+                return np.dtype(np.int64)
+            raise NotImplementedError("long decimal (p>18) compute is not yet supported")
+        if self.is_string:
+            return np.dtype(np.uint8)
+        raise ValueError(f"no device dtype for type {self}")
+
+    # ---- display --------------------------------------------------------
+    def __str__(self) -> str:
+        if not self.parameters:
+            return self.base
+        if self.base == "varchar" and self.parameters[0] == UNBOUNDED_LENGTH:
+            return "varchar"
+        parts = []
+        for p in self.parameters:
+            if isinstance(p, tuple):  # row field (name, type)
+                parts.append(f"{p[0]} {p[1]}")
+            else:
+                parts.append(str(p))
+        return f"{self.base}({', '.join(parts)})"
+
+    def __repr__(self) -> str:
+        return f"Type[{self}]"
+
+
+UNBOUNDED_LENGTH = 2**31 - 1
+
+_DTYPES = {
+    "boolean": np.bool_,
+    "tinyint": np.int8,
+    "smallint": np.int16,
+    "integer": np.int32,
+    "bigint": np.int64,
+    "real": np.float32,
+    "double": np.float64,
+    "date": np.int32,
+    "timestamp": np.int64,
+    "unknown": np.bool_,
+}
+
+BOOLEAN = Type("boolean")
+TINYINT = Type("tinyint")
+SMALLINT = Type("smallint")
+INTEGER = Type("integer")
+BIGINT = Type("bigint")
+REAL = Type("real")
+DOUBLE = Type("double")
+DATE = Type("date")
+TIMESTAMP = Type("timestamp")
+UNKNOWN = Type("unknown")  # the NULL literal's type
+
+
+def varchar(length: int = UNBOUNDED_LENGTH) -> Type:
+    return Type("varchar", (length,))
+
+
+def char(length: int) -> Type:
+    return Type("char", (length,))
+
+
+def decimal(precision: int, scale: int) -> Type:
+    return Type("decimal", (precision, scale))
+
+
+def array_of(elem: Type) -> Type:
+    return Type("array", (elem,))
+
+
+def map_of(key: Type, value: Type) -> Type:
+    return Type("map", (key, value))
+
+
+def row_of(*fields) -> Type:
+    """row_of(T1, T2) or row_of(("name", T1), ...)."""
+    return Type("row", tuple(fields))
+
+
+# --------------------------------------------------------------------------
+# Type-signature parsing (TypeParser.cpp / TypeSignature.parse analog).
+# Grammar: base ( "(" param ("," param)* ")" )?  where param is an integer,
+# a nested signature, or `name type` for row fields.
+# --------------------------------------------------------------------------
+
+_TOKEN = re.compile(r"\s*([(),]|[^\s(),]+)")
+
+
+def parse_type(signature: str) -> Type:
+    tokens = _TOKEN.findall(signature)
+    ty, rest = _parse(tokens)
+    if rest:
+        raise ValueError(f"trailing tokens in type signature {signature!r}: {rest}")
+    return ty
+
+
+def _parse(tokens):
+    if not tokens:
+        raise ValueError("empty type signature")
+    base = tokens[0].lower()
+    tokens = tokens[1:]
+    if not tokens or tokens[0] != "(":
+        return _finish(base, ()), tokens
+    tokens = tokens[1:]  # consume "("
+    params = []
+    while True:
+        if tokens and tokens[0] == ")":
+            tokens = tokens[1:]
+            break
+        if tokens and tokens[0].isdigit():
+            # could be `123` param or a quoted field name; integers only here
+            params.append(int(tokens[0]))
+            tokens = tokens[1:]
+        else:
+            # row field may be `name type`; detect by lookahead
+            if base == "row" and len(tokens) >= 2 and tokens[1] not in ("(", ")", ","):
+                name = tokens[0]
+                ty, tokens = _parse(tokens[1:])
+                params.append((name, ty))
+            else:
+                ty, tokens = _parse(tokens)
+                params.append(ty)
+        if tokens and tokens[0] == ",":
+            tokens = tokens[1:]
+    return _finish(base, tuple(params)), tokens
+
+
+def _finish(base: str, params: tuple) -> Type:
+    if base == "varchar" and not params:
+        return varchar()
+    return Type(base, params)

@@ -1,0 +1,63 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.block import (Batch, Column, DictionaryColumn, StringColumn,
+                              batch_from_numpy, concat_batches, from_numpy,
+                              to_numpy)
+
+
+def test_fixed_width_roundtrip():
+    vals = np.array([1, 2, 3, 4], dtype=np.int64)
+    nulls = np.array([False, True, False, False])
+    col = from_numpy(T.BIGINT, vals, nulls, capacity=8)
+    assert col.capacity == 8
+    v, n = to_numpy(col)
+    np.testing.assert_array_equal(v[:4], vals)
+    np.testing.assert_array_equal(n[:4], nulls)
+    assert n[4:].all()  # padding rows are null
+
+
+def test_string_roundtrip():
+    vals = np.array(["hello", "", "presto-tpu"], dtype=object)
+    col = from_numpy(T.varchar(20), vals, capacity=4)
+    assert isinstance(col, StringColumn)
+    v, n = to_numpy(col)
+    assert list(v[:3]) == ["hello", "", "presto-tpu"]
+
+
+def test_dictionary_decode():
+    dict_col = from_numpy(T.varchar(5), np.array(["A", "B", "C"], dtype=object))
+    idx = jnp.array([2, 0, 1, 1])
+    dc = DictionaryColumn(idx, dict_col, jnp.zeros(4, dtype=bool), T.varchar(5))
+    v, _ = to_numpy(dc)
+    assert list(v) == ["C", "A", "B", "B"]
+
+
+def test_batch_pytree():
+    b = batch_from_numpy([T.BIGINT, T.DOUBLE],
+                         [np.arange(5, dtype=np.int64),
+                          np.linspace(0, 1, 5)], capacity=8)
+    assert int(b.count()) == 5
+    leaves = jax.tree_util.tree_leaves(b)
+    assert all(hasattr(l, "shape") for l in leaves)
+
+    @jax.jit
+    def double_it(batch: Batch) -> Batch:
+        c0 = batch.column(0)
+        return batch.with_columns(
+            [Column(c0.values * 2, c0.nulls, c0.type), batch.column(1)])
+
+    out = double_it(b)
+    v, _ = to_numpy(out.column(0))
+    np.testing.assert_array_equal(v[:5], np.arange(5) * 2)
+    assert int(out.count()) == 5
+
+
+def test_concat_batches():
+    b1 = batch_from_numpy([T.BIGINT], [np.arange(3, dtype=np.int64)], capacity=4)
+    b2 = batch_from_numpy([T.BIGINT], [np.arange(10, 12, dtype=np.int64)], capacity=4)
+    cat = concat_batches([b1, b2])
+    assert cat.capacity == 8
+    assert int(cat.count()) == 5

@@ -1,0 +1,90 @@
+import numpy as np
+
+from presto_tpu.connectors import tpch
+
+
+def test_row_counts():
+    assert tpch.table_row_count("lineitem", 1) == 6_000_000
+    assert tpch.table_row_count("orders", 0.01) == 15_000
+    assert tpch.table_row_count("nation", 100) == 25
+
+
+def test_determinism_and_split_addressability():
+    # generating rows [1000, 1100) directly must equal the slice of a
+    # bigger generation -- the property scans rely on for parallel splits
+    a = tpch.generate_columns("lineitem", 0.01,
+                              ["orderkey", "quantity", "shipdate", "returnflag"],
+                              start=1000, count=100)
+    b = tpch.generate_columns("lineitem", 0.01,
+                              ["orderkey", "quantity", "shipdate", "returnflag"],
+                              start=0, count=2000)
+    for c in a:
+        np.testing.assert_array_equal(a[c], b[c][1000:1100])
+
+
+def test_value_domains():
+    cols = tpch.generate_columns("lineitem", 0.01,
+                                 ["quantity", "discount", "tax", "returnflag",
+                                  "linestatus", "shipdate", "orderkey"],
+                                 count=5000)
+    q = cols["quantity"]
+    assert q.min() >= 100 and q.max() <= 5000  # 1..50 in cents scale
+    assert cols["discount"].min() >= 0 and cols["discount"].max() <= 10
+    assert set(np.unique(cols["returnflag"])) <= {"R", "A", "N"}
+    assert set(np.unique(cols["linestatus"])) <= {"O", "F"}
+    # every order has exactly 4 lines
+    ok = cols["orderkey"]
+    _, counts = np.unique(ok, return_counts=True)
+    assert (counts == 4).all()
+
+
+def test_fk_validity():
+    orders = tpch.generate_columns("orders", 0.01, ["custkey"], count=5000)
+    n_cust = tpch.table_row_count("customer", 0.01)
+    assert orders["custkey"].min() >= 1
+    assert orders["custkey"].max() <= n_cust
+
+
+def test_generate_batch():
+    b = tpch.generate_batch("lineitem", 0.01, ["quantity", "returnflag"],
+                            start=0, count=100, capacity=128)
+    assert b.capacity == 128
+    assert int(b.count()) == 100
+
+
+def test_spec_consistency_invariants():
+    # acctbal spans negative..positive (regression: uint64 overflow on lo<0)
+    c = tpch.generate_columns("customer", 0.01,
+                              ["acctbal", "phone", "nationkey"], count=1500)
+    assert c["acctbal"].min() < 0 < c["acctbal"].max()
+    # phone country code == nationkey + 10 (customer and supplier)
+    for tbl, cols in (("customer", c),
+                      ("supplier", tpch.generate_columns(
+                          "supplier", 0.01, ["phone", "nationkey"], count=100))):
+        cc = np.array([int(p.split("-")[0]) for p in cols["phone"]])
+        np.testing.assert_array_equal(cc, cols["nationkey"] + 10)
+    # orderdate spans the full spec range ending 1998-08-02
+    od = tpch.generate_columns("orders", 0.01, ["orderdate"], count=15000)["orderdate"]
+    assert np.datetime64("1970-01-01") + od.max() == np.datetime64("1998-08-02")
+    # strings never exceed their declared varchar width
+    pc = tpch.generate_columns("part", 0.01, ["comment"], count=2000)["comment"]
+    assert max(len(x) for x in pc) <= tpch.column_type("part", "comment").max_length
+    # extendedprice == quantity * part.retailprice (join consistency)
+    li = tpch.generate_columns("lineitem", 0.01,
+                               ["quantity", "partkey", "extendedprice"], count=1000)
+    rp = tpch.generate_columns("part", 0.01, ["retailprice"])["retailprice"]
+    np.testing.assert_array_equal(li["extendedprice"],
+                                  (li["quantity"] // 100) * rp[li["partkey"] - 1])
+
+
+def test_q1_q6_selectivity_sane():
+    cols = tpch.generate_columns("lineitem", 0.01,
+                                 ["shipdate", "discount", "quantity"], count=10000)
+    epoch = np.datetime64("1970-01-01")
+    d94 = int((np.datetime64("1994-01-01") - epoch).astype(int))
+    d95 = int((np.datetime64("1995-01-01") - epoch).astype(int))
+    q6 = ((cols["shipdate"] >= d94) & (cols["shipdate"] < d95)
+          & (cols["discount"] >= 5) & (cols["discount"] <= 7)
+          & (cols["quantity"] < 2400))
+    frac = q6.mean()
+    assert 0.005 < frac < 0.06  # spec selectivity ~2%

@@ -1,0 +1,45 @@
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+
+
+def test_basic_signatures():
+    assert T.parse_type("bigint") == T.BIGINT
+    assert T.parse_type("BOOLEAN") == T.BOOLEAN
+    assert T.parse_type("double") == T.DOUBLE
+    assert str(T.parse_type("varchar")) == "varchar"
+
+
+def test_parameterized():
+    v = T.parse_type("varchar(25)")
+    assert v.base == "varchar" and v.max_length == 25
+    d = T.parse_type("decimal(12, 2)")
+    assert d.precision == 12 and d.scale == 2 and d.is_short_decimal
+    assert str(d) == "decimal(12, 2)"
+
+
+def test_nested():
+    a = T.parse_type("array(bigint)")
+    assert a.element_type == T.BIGINT
+    m = T.parse_type("map(varchar(5), double)")
+    assert m.key_type.base == "varchar" and m.value_type == T.DOUBLE
+    r = T.parse_type("row(x bigint, y array(double))")
+    assert r.field_types[0] == T.BIGINT
+    assert r.field_types[1].element_type == T.DOUBLE
+
+
+def test_dtypes():
+    assert T.BIGINT.to_dtype() == np.int64
+    assert T.INTEGER.to_dtype() == np.int32
+    assert T.DATE.to_dtype() == np.int32
+    assert T.decimal(12, 2).to_dtype() == np.int64
+    assert T.BOOLEAN.to_dtype() == np.bool_
+    with pytest.raises(NotImplementedError):
+        T.decimal(38, 2).to_dtype()
+
+
+def test_roundtrip_str():
+    for s in ["bigint", "varchar(10)", "decimal(15, 2)", "array(bigint)",
+              "map(bigint, double)"]:
+        assert str(T.parse_type(str(T.parse_type(s)))) == str(T.parse_type(s))
