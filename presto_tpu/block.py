@@ -203,7 +203,13 @@ def from_numpy(ty: T.Type, values: np.ndarray, nulls: Optional[np.ndarray] = Non
                             jnp.asarray(_pad(lengths, capacity)),
                             jnp.asarray(nulls), ty)
     if ty.is_string:
-        lengths = (values != 0).sum(axis=1).astype(np.int32)
+        # length = position after the last nonzero byte (strings may
+        # contain interior NULs; trailing zeros are padding by invariant)
+        nonzero = values != 0
+        any_nz = nonzero.any(axis=1)
+        lengths = np.where(any_nz,
+                           values.shape[1] - np.argmax(nonzero[:, ::-1], axis=1),
+                           0).astype(np.int32)
         return StringColumn(jnp.asarray(_pad(values, capacity)),
                             jnp.asarray(_pad(lengths, capacity)),
                             jnp.asarray(nulls), ty)

@@ -376,7 +376,6 @@ def _gen_partsupp(column: str, idx: np.ndarray, sf: float) -> np.ndarray:
     if column == "suppkey":
         pk = idx // 4
         s = idx % 4
-        n_part = table_row_count("part", sf)
         return ((pk + s * (n_supp // 4 + pk % max(n_supp // 4, 1))) % n_supp + 1).astype(np.int64)
     if column == "availqty":
         return _uniform("partsupp", "availqty", idx, 1, 9999).astype(np.int32)

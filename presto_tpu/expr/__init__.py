@@ -1,0 +1,7 @@
+from .ir import (RowExpression, InputReference, Constant, Call, SpecialForm,
+                 input_ref, const, call, special)
+from .compile import compile_expression, compile_filter, compile_projections
+
+__all__ = ["RowExpression", "InputReference", "Constant", "Call", "SpecialForm",
+           "input_ref", "const", "call", "special",
+           "compile_expression", "compile_filter", "compile_projections"]

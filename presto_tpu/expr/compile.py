@@ -1,0 +1,359 @@
+"""RowExpression -> JAX compiler: the TPU ExpressionCompiler.
+
+Reference surface: presto-main-base/.../sql/gen/ExpressionCompiler.java:144
+(compilePageProcessor -> PageFunctionCompiler emitting JVM bytecode) and
+presto-native-execution/.../types/PrestoToVeloxExpr.cpp. Here the
+"compilation" is tracing: an expression tree becomes a pure function
+over a Batch; XLA does the actual codegen and fusion that
+PageFunctionCompiler/common-subexpression machinery does by hand on the
+JVM (CommonSubExpressionRewriter is subsumed by XLA CSE).
+
+Null semantics are Presto's three-valued logic:
+  * scalar calls: NULL if any argument is NULL (functions may override)
+  * AND/OR: Kleene
+  * IF/SWITCH/COALESCE: lazy *selection* -- all branches are computed
+    (no branches in SIMD), selection picks lanes; branch kernels must be
+    total (no side effects, finite under any input), which the function
+    registry guarantees.
+
+Compile-time-constant interception: LIKE patterns, date_add units, and
+IN lists are specialized during tracing -- the analog of the reference
+constant-folding these in LocalExecutionPlanner/bytecode gen.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, List, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..block import Batch, Column, DictionaryColumn, StringColumn
+from . import functions as F
+from .ir import Call, Constant, InputReference, RowExpression, SpecialForm
+
+Block = Union[Column, StringColumn]
+
+__all__ = ["compile_expression", "compile_filter", "compile_projections",
+           "evaluate"]
+
+
+# ---------------------------------------------------------------------------
+# constants -> broadcast blocks
+# ---------------------------------------------------------------------------
+
+def _constant_block(c: Constant, capacity: int) -> Block:
+    ty = c.type
+    if c.value is None:
+        if ty.is_string:
+            return StringColumn(jnp.zeros((capacity, 1), dtype=jnp.uint8),
+                                jnp.zeros(capacity, dtype=jnp.int32),
+                                jnp.ones(capacity, dtype=bool), ty)
+        dt = ty.to_dtype() if ty != T.UNKNOWN else np.bool_
+        return Column(jnp.zeros(capacity, dtype=dt),
+                      jnp.ones(capacity, dtype=bool), ty)
+    if ty.is_string:
+        b = str(c.value).encode("utf-8")
+        w = max(len(b), 1)
+        chars = jnp.tile(jnp.asarray(bytearray(b.ljust(w, b"\x00")),
+                                     dtype=jnp.uint8)[None, :], (capacity, 1))
+        return StringColumn(chars,
+                            jnp.full(capacity, len(b), dtype=jnp.int32),
+                            jnp.zeros(capacity, dtype=bool), ty)
+    v = c.value
+    if ty.base == "date" and isinstance(v, str):
+        v = int((np.datetime64(v) - np.datetime64("1970-01-01")).astype(int))
+    return Column(jnp.full(capacity, v, dtype=ty.to_dtype()),
+                  jnp.zeros(capacity, dtype=bool), ty)
+
+
+# ---------------------------------------------------------------------------
+# LIKE pattern compilation
+# ---------------------------------------------------------------------------
+
+def _like(a: StringColumn, pattern: str) -> jnp.ndarray:
+    """Full LIKE matcher for patterns of %/_ wildcards, vectorized:
+    segments between % marks are located left-to-right greedily (each
+    segment's first feasible window), with '_' matching any single char.
+    Greedy works because segments are matched earliest-first, which never
+    eliminates a later feasible assignment (classic glob argument)."""
+    pat = pattern.encode("utf-8")
+    anchored_left = not pat.startswith(b"%")
+    anchored_right = not pat.endswith(b"%")
+    segments = [s for s in pat.split(b"%") if s != b""]
+    n, w = a.chars.shape
+    lengths = a.lengths
+
+    if not segments:
+        # pattern is only % signs (or empty)
+        if pat == b"":
+            return lengths == 0
+        return jnp.ones(n, dtype=bool)
+
+    def seg_match_windows(seg: bytes):
+        """(N, windows) bool: seg matches at window start i ('_' = any)."""
+        L = len(seg)
+        windows = w - L + 1
+        if windows <= 0:
+            return None
+        idx = (jnp.arange(windows, dtype=jnp.int32)[:, None]
+               + jnp.arange(L, dtype=jnp.int32)[None, :])
+        g = a.chars[:, idx]  # (N, windows, L)
+        sarr = jnp.asarray(bytearray(seg), dtype=jnp.uint8)
+        wild = sarr == ord("_")
+        m = jnp.all((g == sarr[None, None, :]) | wild[None, None, :], axis=2)
+        ends_ok = (jnp.arange(windows, dtype=jnp.int32)[None, :] + L) <= lengths[:, None]
+        return m & ends_ok
+
+    ok = jnp.ones(n, dtype=bool)
+    earliest = jnp.zeros(n, dtype=jnp.int32)
+
+    # all segments except (if right-anchored) the last: greedy earliest match
+    loop_segments = segments[:-1] if anchored_right else segments
+    for si, seg in enumerate(loop_segments):
+        m = seg_match_windows(seg)
+        if m is None:
+            return jnp.zeros(n, dtype=bool)
+        windows = m.shape[1]
+        pos = jnp.arange(windows, dtype=jnp.int32)[None, :]
+        feasible = m & (pos >= earliest[:, None])
+        if si == 0 and anchored_left:
+            feasible = feasible & (pos == 0)
+        found = jnp.any(feasible, axis=1)
+        first = jnp.argmax(feasible, axis=1).astype(jnp.int32)
+        ok = ok & found
+        earliest = first + len(seg)
+
+    if anchored_right:
+        last = segments[-1]
+        m = seg_match_windows(last)
+        if m is None:
+            return jnp.zeros(n, dtype=bool)
+        # the last segment must match ending exactly at the string end,
+        # starting no earlier than where the previous segments finished
+        end_pos = lengths - len(last)
+        at_end = jnp.take_along_axis(
+            m, jnp.clip(end_pos, 0, m.shape[1] - 1)[:, None], axis=1)[:, 0]
+        ok = ok & at_end & (end_pos >= earliest)
+        if anchored_left and len(segments) == 1:
+            ok = ok & (lengths == len(last))  # no % at all: exact-width match
+    return ok
+
+
+# ---------------------------------------------------------------------------
+# evaluator
+# ---------------------------------------------------------------------------
+
+def evaluate(expr: RowExpression, batch: Batch) -> Block:
+    cap = batch.capacity
+
+    if isinstance(expr, InputReference):
+        b = batch.column(expr.channel)
+        if isinstance(b, DictionaryColumn):
+            b = b.decode()
+        return b
+
+    if isinstance(expr, Constant):
+        return _constant_block(expr, cap)
+
+    if isinstance(expr, SpecialForm):
+        return _eval_special(expr, batch)
+
+    if isinstance(expr, Call):
+        name = expr.name.lower()
+        # compile-time interceptions
+        if name == "like":
+            a = evaluate(expr.arguments[0], batch)
+            pat = expr.arguments[1]
+            assert isinstance(pat, Constant), "LIKE pattern must be constant"
+            v = _like(a, str(pat.value))
+            return Column(v, a.nulls, expr.type)
+        if name == "date_add":
+            unit = expr.arguments[0]
+            assert isinstance(unit, Constant)
+            n = evaluate(expr.arguments[1], batch)
+            d = evaluate(expr.arguments[2], batch)
+            step = {"day": 1, "week": 7}.get(str(unit.value))
+            if step is not None:
+                vals = d.values + (n.values * step).astype(d.values.dtype)
+            elif str(unit.value) in ("month", "year"):
+                y, m, day = F._civil(d.values)
+                months = n.values * 12 if str(unit.value) == "year" else n.values
+                tot = (y * 12 + (m - 1)) + months
+                ny, nm = tot // 12, tot % 12 + 1
+                # clamp day to last day of target month
+                ld = F._days_from_civil(ny + (nm == 12), jnp.where(nm == 12, 1, nm + 1),
+                                        jnp.ones_like(ny)) - 1
+                _, _, last_day = F._civil(ld)
+                nd = jnp.minimum(day, last_day)
+                vals = F._days_from_civil(ny, nm, nd).astype(d.values.dtype)
+            else:
+                raise NotImplementedError(f"date_add unit {unit.value!r}")
+            return Column(vals, F._default_nulls(n, d), expr.type)
+
+        args = [evaluate(a, batch) for a in expr.arguments]
+        sf = F.lookup(name)
+        out = sf.fn(expr.type, *args)
+        if sf.null_fn is not None:
+            nulls = sf.null_fn(expr.type, *args)
+            if isinstance(out, StringColumn):
+                out = StringColumn(out.chars, out.lengths, nulls, out.type)
+            else:
+                out = Column(out.values, nulls, out.type)
+        return out
+
+    raise TypeError(f"cannot evaluate {type(expr)}")
+
+
+def _bool(b: Block):
+    """(value, null) for a boolean block; value lanes under null are False."""
+    return b.values & ~b.nulls, b.nulls
+
+
+def _eval_special(expr: SpecialForm, batch: Batch) -> Block:
+    form = expr.form
+    args = expr.arguments
+
+    if form == "AND":
+        # Kleene: FALSE if any FALSE; else NULL if any NULL; else TRUE
+        any_false, any_null = None, None
+        for a in args:
+            bv, bn = _bool(evaluate(a, batch))
+            f = ~bv & ~bn
+            any_false = f if any_false is None else (any_false | f)
+            any_null = bn if any_null is None else (any_null | bn)
+        nulls = ~any_false & any_null
+        return Column(~any_false & ~nulls, nulls, expr.type)
+
+    if form == "OR":
+        # Kleene: TRUE if any TRUE; else NULL if any NULL; else FALSE
+        any_true, any_null = None, None
+        for a in args:
+            bv, bn = _bool(evaluate(a, batch))
+            any_true = bv if any_true is None else (any_true | bv)
+            any_null = bn if any_null is None else (any_null | bn)
+        nulls = ~any_true & any_null
+        return Column(any_true, nulls, expr.type)
+
+    if form == "IS_NULL":
+        a = evaluate(args[0], batch)
+        return Column(a.nulls, jnp.zeros(len(a), dtype=bool), expr.type)
+
+    if form == "IF":
+        cv, cn = _bool(evaluate(args[0], batch))
+        t = evaluate(args[1], batch)
+        f = evaluate(args[2], batch) if len(args) > 2 else \
+            _constant_block(Constant(expr.type, None), batch.capacity)
+        take_t = cv & ~cn
+        return _select(take_t, t, f, expr.type)
+
+    if form == "NULL_IF":
+        a = evaluate(args[0], batch)
+        b = evaluate(args[1], batch)
+        eq = F._binary_cmp("eq")(T.BOOLEAN, a, b)
+        ev, en = _bool(eq)
+        nulls = a.nulls | (ev & ~en)
+        if isinstance(a, StringColumn):
+            return StringColumn(a.chars, a.lengths, nulls, expr.type)
+        return Column(a.values, nulls, expr.type)
+
+    if form == "COALESCE":
+        out = evaluate(args[0], batch)
+        for a in args[1:]:
+            nxt = evaluate(a, batch)
+            out = _select(~out.nulls, out, nxt, expr.type)
+        return out
+
+    if form == "IN":
+        x = evaluate(args[0], batch)
+        any_match = None
+        any_null = x.nulls
+        for a in args[1:]:
+            b = evaluate(a, batch)
+            eq = F._binary_cmp("eq")(T.BOOLEAN, x, b)
+            ev, en = _bool(eq)
+            any_match = ev if any_match is None else (any_match | ev)
+            any_null = any_null | b.nulls
+        # match -> TRUE; no match but saw null -> NULL; else FALSE
+        nulls = ~any_match & any_null
+        return Column(any_match & ~nulls, nulls, expr.type)
+
+    if form == "BETWEEN":
+        x = evaluate(args[0], batch)
+        lo = evaluate(args[1], batch)
+        hi = evaluate(args[2], batch)
+        ge = F._binary_cmp("ge")(T.BOOLEAN, x, lo)
+        le = F._binary_cmp("le")(T.BOOLEAN, x, hi)
+        v = ge.values & le.values
+        n = x.nulls | lo.nulls | hi.nulls
+        return Column(v & ~n, n, expr.type)
+
+    if form == "SWITCH":
+        # args: operand, WHEN(value, result)..., [else]
+        operand = args[0]
+        whens = [a for a in args[1:] if isinstance(a, SpecialForm) and a.form == "WHEN"]
+        els = [a for a in args[1:] if not (isinstance(a, SpecialForm) and a.form == "WHEN")]
+        out = evaluate(els[0], batch) if els else \
+            _constant_block(Constant(expr.type, None), batch.capacity)
+        is_searched = isinstance(operand, Constant) and operand.value is True
+        op_block = None if is_searched else evaluate(operand, batch)
+        for wh in reversed(whens):
+            cond_expr, res_expr = wh.arguments
+            if is_searched:
+                cv, cn = _bool(evaluate(cond_expr, batch))
+            else:
+                c = evaluate(cond_expr, batch)
+                eq = F._binary_cmp("eq")(T.BOOLEAN, op_block, c)
+                cv, cn = _bool(eq)
+            res = evaluate(res_expr, batch)
+            out = _select(cv & ~cn, res, out, expr.type)
+        return out
+
+    raise NotImplementedError(f"special form {form}")
+
+
+def _select(take_a, a: Block, b: Block, ty: T.Type) -> Block:
+    """Lane-select between two blocks of the same logical type."""
+    if isinstance(a, StringColumn) or isinstance(b, StringColumn):
+        w = max(a.max_len, b.max_len)
+        ca = jnp.pad(a.chars, ((0, 0), (0, w - a.max_len)))
+        cb = jnp.pad(b.chars, ((0, 0), (0, w - b.max_len)))
+        return StringColumn(jnp.where(take_a[:, None], ca, cb),
+                            jnp.where(take_a, a.lengths, b.lengths),
+                            jnp.where(take_a, a.nulls, b.nulls), ty)
+    av, bv = a.values, b.values
+    if av.dtype != bv.dtype:
+        dt = jnp.promote_types(av.dtype, bv.dtype)
+        av, bv = av.astype(dt), bv.astype(dt)
+    return Column(jnp.where(take_a, av, bv),
+                  jnp.where(take_a, a.nulls, b.nulls), ty)
+
+
+# ---------------------------------------------------------------------------
+# public compiled entry points (PageFilter / PageProjection analogs)
+# ---------------------------------------------------------------------------
+
+def compile_expression(expr: RowExpression) -> Callable[[Batch], Block]:
+    return functools.partial(evaluate, expr)
+
+
+def compile_filter(expr: RowExpression) -> Callable[[Batch], Batch]:
+    """PageFilter analog: returns the input batch with rows failing the
+    predicate (FALSE or NULL) deactivated -- selection stays a mask, no
+    compaction (see block.py module docs)."""
+    def run(batch: Batch) -> Batch:
+        out = evaluate(expr, batch)
+        keep = out.values & ~out.nulls
+        return batch.with_active(batch.active & keep)
+    return run
+
+
+def compile_projections(exprs: Sequence[RowExpression]) -> Callable[[Batch], Batch]:
+    """PageProjection analog: evaluates each expression into an output
+    column; the active mask rides along unchanged."""
+    def run(batch: Batch) -> Batch:
+        cols = tuple(evaluate(e, batch) for e in exprs)
+        return Batch(cols, batch.active)
+    return run

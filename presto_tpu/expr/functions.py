@@ -1,0 +1,665 @@
+"""Built-in scalar function registry and their JAX implementations.
+
+Reference surface: presto-main-base/.../operator/scalar/ (164 files) and
+the annotation-driven registration machinery (operator/annotations/,
+FunctionAndTypeManager). Here a function is a name plus a JAX
+value-implementation; overload resolution happens inside the
+implementation by inspecting argument Block types (the coordinator has
+already type-checked the expression tree).
+
+Null semantics: the compiler computes the default null mask (OR of
+argument nulls, RETURNS NULL ON NULL INPUT) for every call; functions
+only compute value lanes and must keep lanes finite/in-domain under
+nulls so masked garbage never poisons downstream reductions. Functions
+with non-default null behavior set `null_fn`.
+
+Decimal arithmetic follows Presto's short-decimal rules with results
+held in int64: add/subtract rescale to max scale, multiply adds scales,
+divide rescales the dividend (round-half-up like the reference).
+Precisions that exceed 18 keep int64 device representation in round 1
+(documented overflow risk; int128 lanes are a planned Pallas kernel).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..block import Column, StringColumn
+
+Block = Union[Column, StringColumn]
+
+__all__ = ["ScalarFunction", "REGISTRY", "register", "lookup",
+           "rescale_decimal", "hash64_block", "combine_hash"]
+
+
+@dataclasses.dataclass
+class ScalarFunction:
+    name: str
+    fn: Callable            # (ret_type, *blocks) -> Block
+    null_fn: Optional[Callable] = None  # (ret_type, *blocks) -> nulls | None=default
+
+
+REGISTRY: Dict[str, ScalarFunction] = {}
+
+
+def register(name: str, null_fn=None):
+    def deco(fn):
+        REGISTRY[name] = ScalarFunction(name, fn, null_fn)
+        return fn
+    return deco
+
+
+def lookup(name: str) -> ScalarFunction:
+    try:
+        return REGISTRY[name]
+    except KeyError:
+        raise NotImplementedError(f"scalar function {name!r} is not registered") from None
+
+
+def _default_nulls(*blocks: Block):
+    nulls = None
+    for b in blocks:
+        nulls = b.nulls if nulls is None else (nulls | b.nulls)
+    return nulls
+
+
+def _col(ret_type: T.Type, values, *args: Block) -> Column:
+    return Column(values, _default_nulls(*args), ret_type)
+
+
+# ---------------------------------------------------------------------------
+# numeric helpers
+# ---------------------------------------------------------------------------
+
+_POW10 = [10**i for i in range(19)]
+
+
+def rescale_decimal(values, from_scale: int, to_scale: int):
+    """Exact int64 rescale with round-half-away-from-zero on downscale."""
+    if to_scale == from_scale:
+        return values
+    if to_scale > from_scale:
+        return values * _POW10[to_scale - from_scale]
+    f = _POW10[from_scale - to_scale]
+    half = f // 2
+    return jnp.where(values >= 0, (values + half) // f, -((-values + half) // f))
+
+
+def _scale_of(ty: T.Type) -> int:
+    return ty.scale if ty.is_decimal else 0
+
+
+def _promote(ret_type: T.Type, *blocks: Column):
+    """Bring numeric args to the ret_type's representation: decimals to
+    ret scale, everything to ret dtype family."""
+    out = []
+    rd = jnp.dtype(ret_type.to_dtype())
+    for b in blocks:
+        v = b.values
+        if ret_type.is_decimal:
+            if b.type.is_decimal or b.type.is_integral:
+                v = rescale_decimal(v.astype(jnp.int64), _scale_of(b.type),
+                                    ret_type.scale)
+            else:
+                raise NotImplementedError("float->decimal arithmetic")
+        elif ret_type.is_floating:
+            if b.type.is_decimal:
+                v = v.astype(rd) / _POW10[b.type.scale]
+            else:
+                v = v.astype(rd)
+        else:
+            if b.type.is_decimal:
+                v = rescale_decimal(v.astype(jnp.int64), b.type.scale, 0)
+            v = v.astype(rd)
+        out.append(v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# arithmetic
+# ---------------------------------------------------------------------------
+
+@register("add")
+def _add(ret, a, b):
+    x, y = _promote(ret, a, b)
+    return _col(ret, x + y, a, b)
+
+
+@register("subtract")
+def _subtract(ret, a, b):
+    x, y = _promote(ret, a, b)
+    return _col(ret, x - y, a, b)
+
+
+@register("multiply")
+def _multiply(ret, a, b):
+    if ret.is_decimal:
+        # multiply: scale_out = s1 + s2; operate on raw scaled ints
+        assert _scale_of(a.type) + _scale_of(b.type) == ret.scale, \
+            (a.type, b.type, ret)
+        return _col(ret, a.values.astype(jnp.int64) * b.values.astype(jnp.int64), a, b)
+    x, y = _promote(ret, a, b)
+    return _col(ret, x * y, a, b)
+
+
+def _div_nulls(ret, a, b):
+    zero = (b.values == 0) & ~b.nulls
+    return _default_nulls(a, b) | zero
+
+
+@register("divide", null_fn=_div_nulls)
+def _divide(ret, a, b):
+    """Division by zero yields NULL (the reference raises DIVISION_BY_ZERO;
+    a jit'd kernel cannot throw -- task-level checking arrives with the
+    error-channel in exec)."""
+    nulls = _div_nulls(ret, a, b)
+    if ret.is_decimal:
+        sa, sb = _scale_of(a.type), _scale_of(b.type)
+        # presto: rescale dividend by 10^(s_out + s_b - s_a), round half away
+        num = a.values.astype(jnp.int64) * _POW10[ret.scale + sb - sa]
+        den = jnp.where(b.values == 0, 1, b.values.astype(jnp.int64))
+        neg = (num < 0) != (den < 0)
+        an, ad = jnp.abs(num), jnp.abs(den)
+        q = (2 * an + ad) // (2 * ad)
+        return Column(jnp.where(neg, -q, q), nulls, ret)
+    if ret.is_integral:
+        x = a.values.astype(jnp.int64)
+        y = jnp.where(b.values == 0, 1, b.values).astype(jnp.int64)
+        neg = (x < 0) != (y < 0)
+        q = jnp.abs(x) // jnp.abs(y)  # SQL integer division truncates toward zero
+        return Column(jnp.where(neg, -q, q).astype(ret.to_dtype()), nulls, ret)
+    x, y = _promote(ret, a, b)
+    y = jnp.where(y == 0, 1.0, y)
+    return Column(x / y, nulls, ret)
+
+
+@register("modulus", null_fn=_div_nulls)
+def _modulus(ret, a, b):
+    x, y = _promote(ret, a, b)
+    y = jnp.where(y == 0, 1, y)
+    r = jnp.sign(x) * (jnp.abs(x) % jnp.abs(y))  # truncated mod (SQL semantics)
+    return Column(r.astype(ret.to_dtype()), _div_nulls(ret, a, b), ret)
+
+
+@register("negate")
+def _negate(ret, a):
+    return _col(ret, -a.values, a)
+
+
+@register("abs")
+def _abs(ret, a):
+    return _col(ret, jnp.abs(a.values), a)
+
+
+# ---------------------------------------------------------------------------
+# comparisons (work for numeric and string blocks)
+# ---------------------------------------------------------------------------
+
+def _cmp_values(a: Block, b: Block):
+    """Return comparison key arrays for =, <, etc."""
+    if isinstance(a, StringColumn) or isinstance(b, StringColumn):
+        return None  # handled by string paths
+    sa, sb = _scale_of(a.type), _scale_of(b.type)
+    if (a.type.is_decimal or b.type.is_decimal) and not (a.type.is_floating or b.type.is_floating):
+        s = max(sa, sb)
+        return (rescale_decimal(a.values.astype(jnp.int64), sa, s),
+                rescale_decimal(b.values.astype(jnp.int64), sb, s))
+    if a.type.is_floating or b.type.is_floating:
+        va = a.values.astype(jnp.float64)
+        vb = b.values.astype(jnp.float64)
+        if a.type.is_decimal:
+            va = va / _POW10[sa]
+        if b.type.is_decimal:
+            vb = vb / _POW10[sb]
+        return va, vb
+    return a.values, b.values
+
+
+def _str_eq(a: StringColumn, b: StringColumn):
+    w = max(a.max_len, b.max_len)
+    ca = jnp.pad(a.chars, ((0, 0), (0, w - a.max_len)))
+    cb = jnp.pad(b.chars, ((0, 0), (0, w - b.max_len)))
+    return jnp.all(ca == cb, axis=1) & (a.lengths == b.lengths)
+
+
+def _str_cmp(a: StringColumn, b: StringColumn):
+    """Lexicographic compare: returns (-1, 0, 1) per row."""
+    w = max(a.max_len, b.max_len)
+    ca = jnp.pad(a.chars, ((0, 0), (0, w - a.max_len))).astype(jnp.int32)
+    cb = jnp.pad(b.chars, ((0, 0), (0, w - b.max_len))).astype(jnp.int32)
+    diff = jnp.sign(ca - cb)  # (N, w)
+    first = jnp.argmax(jnp.abs(diff), axis=1)
+    d = jnp.take_along_axis(diff, first[:, None], axis=1)[:, 0]
+    # zero-padded chars make shorter strings compare smaller automatically
+    return d
+
+
+def _binary_cmp(op):
+    def fn(ret, a, b):
+        if isinstance(a, StringColumn) and isinstance(b, StringColumn):
+            if op == "eq":
+                v = _str_eq(a, b)
+            elif op == "ne":
+                v = ~_str_eq(a, b)
+            else:
+                d = _str_cmp(a, b)
+                v = {"lt": d < 0, "le": d <= 0, "gt": d > 0, "ge": d >= 0}[op]
+            return _col(ret, v, a, b)
+        x, y = _cmp_values(a, b)
+        v = {"eq": x == y, "ne": x != y, "lt": x < y,
+             "le": x <= y, "gt": x > y, "ge": x >= y}[op]
+        return _col(ret, v, a, b)
+    return fn
+
+
+for _opname, _presto in [("eq", "$operator$equal"), ("ne", "$operator$not_equal"),
+                         ("lt", "$operator$less_than"),
+                         ("le", "$operator$less_than_or_equal"),
+                         ("gt", "$operator$greater_than"),
+                         ("ge", "$operator$greater_than_or_equal")]:
+    _f = _binary_cmp(_opname)
+    REGISTRY[_opname] = ScalarFunction(_opname, _f)
+    REGISTRY[_presto] = ScalarFunction(_presto, _f)
+
+
+@register("not")
+def _not(ret, a):
+    return _col(ret, ~a.values, a)
+
+
+# ---------------------------------------------------------------------------
+# math
+# ---------------------------------------------------------------------------
+
+@register("sqrt")
+def _sqrt(ret, a):
+    (x,) = _promote(ret, a)
+    return _col(ret, jnp.sqrt(jnp.maximum(x, 0.0)), a)
+
+
+@register("floor")
+def _floor(ret, a):
+    if a.type.is_decimal:
+        f = _POW10[a.type.scale]
+        v = jnp.where(a.values >= 0, a.values // f, -((-a.values + f - 1) // f))
+        return _col(ret, rescale_decimal(v, 0, _scale_of(ret)), a)
+    return _col(ret, jnp.floor(a.values.astype(jnp.float64)).astype(ret.to_dtype()), a)
+
+
+@register("ceil")
+@register("ceiling")
+def _ceil(ret, a):
+    if a.type.is_decimal:
+        f = _POW10[a.type.scale]
+        v = jnp.where(a.values >= 0, (a.values + f - 1) // f, -((-a.values) // f))
+        return _col(ret, rescale_decimal(v, 0, _scale_of(ret)), a)
+    return _col(ret, jnp.ceil(a.values.astype(jnp.float64)).astype(ret.to_dtype()), a)
+
+
+@register("round")
+def _round(ret, a, *rest):
+    if a.type.is_decimal:
+        s = a.type.scale
+        if not rest:
+            v = rescale_decimal(a.values, s, 0)
+            return _col(ret, rescale_decimal(v, 0, _scale_of(ret)), a)
+        # round(decimal, d): zero out digits below 10^-d, keep the scale.
+        # d must be a compile-time-constant column to stay static; clamp to
+        # the useful range and select per-row among the <= s+1 candidates.
+        d = rest[0].values.astype(jnp.int32)
+        candidates = [rescale_decimal(rescale_decimal(a.values, s, k), k,
+                                      _scale_of(ret))
+                      for k in range(0, s + 1)]
+        v = candidates[-1]
+        for k in range(s - 1, -1, -1):
+            v = jnp.where(d <= k, candidates[k], v)
+        return _col(ret, v, a, rest[0])
+    x = a.values.astype(jnp.float64)
+    if rest:
+        d = rest[0].values.astype(jnp.float64)
+        p = jnp.power(10.0, d)
+        return _col(ret, jnp.round(x * p) / p, a, rest[0])
+    return _col(ret, jnp.round(x).astype(ret.to_dtype()), a)
+
+
+@register("power")
+@register("pow")
+def _power(ret, a, b):
+    x, y = _promote(ret, a, b)
+    return _col(ret, jnp.power(x, y), a, b)
+
+
+@register("exp")
+def _exp(ret, a):
+    (x,) = _promote(ret, a)
+    return _col(ret, jnp.exp(x), a)
+
+
+@register("ln")
+def _ln(ret, a):
+    (x,) = _promote(ret, a)
+    return _col(ret, jnp.log(jnp.maximum(x, 1e-300)), a)
+
+
+@register("log10")
+def _log10(ret, a):
+    (x,) = _promote(ret, a)
+    return _col(ret, jnp.log10(jnp.maximum(x, 1e-300)), a)
+
+
+@register("greatest")
+def _greatest(ret, *args):
+    xs = _promote(ret, *args)
+    v = xs[0]
+    for x in xs[1:]:
+        v = jnp.maximum(v, x)
+    return _col(ret, v, *args)
+
+
+@register("least")
+def _least(ret, *args):
+    xs = _promote(ret, *args)
+    v = xs[0]
+    for x in xs[1:]:
+        v = jnp.minimum(v, x)
+    return _col(ret, v, *args)
+
+
+# ---------------------------------------------------------------------------
+# date/time (DATE = days since epoch int32, TIMESTAMP = micros int64)
+# civil-from-days per Howard Hinnant's algorithms, vectorized
+# ---------------------------------------------------------------------------
+
+def _civil(days):
+    z = days.astype(jnp.int64) + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y, m, d
+
+
+def _days_from_civil(y, m, d):
+    y = y - (m <= 2)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _as_days(a: Column):
+    if a.type.base == "timestamp":
+        return a.values // 86_400_000_000
+    return a.values
+
+
+@register("year")
+def _year(ret, a):
+    y, m, d = _civil(_as_days(a))
+    return _col(ret, y.astype(ret.to_dtype()), a)
+
+
+@register("month")
+def _month(ret, a):
+    y, m, d = _civil(_as_days(a))
+    return _col(ret, m.astype(ret.to_dtype()), a)
+
+
+@register("day")
+@register("day_of_month")
+def _day(ret, a):
+    y, m, d = _civil(_as_days(a))
+    return _col(ret, d.astype(ret.to_dtype()), a)
+
+
+@register("quarter")
+def _quarter(ret, a):
+    y, m, d = _civil(_as_days(a))
+    return _col(ret, ((m - 1) // 3 + 1).astype(ret.to_dtype()), a)
+
+
+@register("day_of_week")
+@register("dow")
+def _dow(ret, a):
+    days = _as_days(a).astype(jnp.int64)
+    # 1970-01-01 was Thursday; ISO dow Mon=1..Sun=7
+    v = (days + 3) % 7 + 1
+    return _col(ret, v.astype(ret.to_dtype()), a)
+
+
+@register("day_of_year")
+@register("doy")
+def _doy(ret, a):
+    days = _as_days(a).astype(jnp.int64)
+    y, m, d = _civil(days)
+    jan1 = _days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+    return _col(ret, (days - jan1 + 1).astype(ret.to_dtype()), a)
+
+
+# ---------------------------------------------------------------------------
+# strings
+# ---------------------------------------------------------------------------
+
+@register("length")
+def _length(ret, a: StringColumn):
+    return _col(ret, a.lengths.astype(ret.to_dtype()), a)
+
+
+@register("upper")
+def _upper(ret, a: StringColumn):
+    c = a.chars
+    up = jnp.where((c >= 97) & (c <= 122), c - 32, c)
+    return StringColumn(up, a.lengths, a.nulls, ret)
+
+
+@register("lower")
+def _lower(ret, a: StringColumn):
+    c = a.chars
+    lo = jnp.where((c >= 65) & (c <= 90), c + 32, c)
+    return StringColumn(lo, a.lengths, a.nulls, ret)
+
+
+@register("substr")
+def _substr(ret, a: StringColumn, start: Column, *rest):
+    """substr(s, start[, length]); 1-based start, negative counts from end."""
+    n, w = a.chars.shape
+    st0 = start.values.astype(jnp.int32)
+    # Presto: start==0 or |negative start| > length -> empty result
+    valid = (st0 != 0) & (jnp.where(st0 < 0, -st0, st0) <= a.lengths)
+    st = jnp.where(st0 < 0, a.lengths + st0, st0 - 1)  # -> 0-based
+    st = jnp.clip(st, 0, a.lengths)
+    if rest:
+        ln = jnp.clip(rest[0].values.astype(jnp.int32), 0, w)
+    else:
+        ln = a.lengths - st
+    ln = jnp.clip(jnp.minimum(ln, a.lengths - st), 0, w)
+    ln = jnp.where(valid, ln, 0)
+    idx = st[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
+    gathered = jnp.take_along_axis(a.chars, jnp.clip(idx, 0, w - 1), axis=1)
+    keep = jnp.arange(w, dtype=jnp.int32)[None, :] < ln[:, None]
+    out = jnp.where(keep, gathered, 0).astype(jnp.uint8)
+    extra = [rest[0]] if rest else []
+    return StringColumn(out, ln, _default_nulls(a, start, *extra), ret)
+
+
+@register("concat")
+def _concat(ret, *args: StringColumn):
+    out = args[0]
+    for b in args[1:]:
+        w = out.max_len + b.max_len
+        n = out.chars.shape[0]
+        pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+        l1 = out.lengths[:, None]
+        from_first = pos < l1
+        ia = jnp.clip(pos, 0, out.max_len - 1)
+        ib = jnp.clip(pos - l1, 0, b.max_len - 1)
+        ca = jnp.take_along_axis(out.chars, ia, axis=1)
+        cb = jnp.take_along_axis(b.chars, ib, axis=1)
+        lens = out.lengths + b.lengths
+        chars = jnp.where(from_first, ca, jnp.where(pos < lens[:, None], cb, 0))
+        out = StringColumn(chars.astype(jnp.uint8), lens,
+                           _default_nulls(out, b), ret)
+    return out
+
+
+@register("trim")
+def _trim(ret, a: StringColumn):
+    c = a.chars
+    n, w = c.shape
+    pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+    is_sp = (c == 32) | (pos >= a.lengths[:, None])
+    first = jnp.argmin(is_sp, axis=1).astype(jnp.int32)  # first non-space
+    all_sp = jnp.all(is_sp, axis=1)
+    last = (w - 1 - jnp.argmin(is_sp[:, ::-1], axis=1)).astype(jnp.int32)
+    st = jnp.where(all_sp, 0, first)
+    ln = jnp.where(all_sp, 0, last - first + 1)
+    idx = st[:, None] + pos
+    g = jnp.take_along_axis(c, jnp.clip(idx, 0, w - 1), axis=1)
+    out = jnp.where(pos < ln[:, None], g, 0).astype(jnp.uint8)
+    return StringColumn(out, ln, a.nulls, ret)
+
+
+def contains_pattern(a: StringColumn, needle: bytes):
+    """Vectorized substring search (LIKE '%needle%')."""
+    L = max(len(needle), 1)
+    n, w = a.chars.shape
+    if L > w:
+        return jnp.zeros(n, dtype=bool)
+    pat = jnp.asarray(bytearray(needle), dtype=jnp.uint8)
+    windows = w - L + 1
+    idx = (jnp.arange(windows, dtype=jnp.int32)[:, None]
+           + jnp.arange(L, dtype=jnp.int32)[None, :])  # (windows, L)
+    g = a.chars[:, idx]  # (N, windows, L)
+    match = jnp.all(g == pat[None, None, :], axis=2)  # (N, windows)
+    # window must end within the string
+    ok = (jnp.arange(windows, dtype=jnp.int32)[None, :] + L) <= a.lengths[:, None]
+    return jnp.any(match & ok, axis=1)
+
+
+@register("starts_with")
+def _starts_with(ret, a: StringColumn, b: StringColumn):
+    # compare b against a's head; pad a if the needle is wider
+    wa = a.chars[:, :b.max_len] if b.max_len <= a.max_len else \
+        jnp.pad(a.chars, ((0, 0), (0, b.max_len - a.max_len)))
+    pos = jnp.arange(b.max_len, dtype=jnp.int32)[None, :]
+    cmp = (wa == b.chars) | (pos >= b.lengths[:, None])
+    v = jnp.all(cmp, axis=1) & (b.lengths <= a.lengths)
+    return _col(ret, v, a, b)
+
+
+@register("strpos")
+def _strpos(ret, a: StringColumn, b: StringColumn):
+    """1-based position of first occurrence of b in a, 0 if absent.
+    Requires b to be row-constant in practice; implemented generally via
+    windows compare."""
+    n, w = a.chars.shape
+    L = b.max_len
+    if L == 0 or L > w:
+        return _col(ret, jnp.zeros(n, dtype=ret.to_dtype()), a, b)
+    windows = w - L + 1
+    idx = (jnp.arange(windows, dtype=jnp.int32)[:, None]
+           + jnp.arange(L, dtype=jnp.int32)[None, :])
+    g = a.chars[:, idx]  # (N, windows, L)
+    pos = jnp.arange(L, dtype=jnp.int32)[None, None, :]
+    match = jnp.all((g == b.chars[:, None, :]) | (pos >= b.lengths[:, None, None]),
+                    axis=2)
+    ok = (jnp.arange(windows, dtype=jnp.int32)[None, :] + b.lengths[:, None]) <= a.lengths[:, None]
+    m = match & ok
+    found = jnp.any(m, axis=1)
+    first = jnp.argmax(m, axis=1).astype(jnp.int64)
+    return _col(ret, jnp.where(found, first + 1, 0).astype(ret.to_dtype()), a, b)
+
+
+# ---------------------------------------------------------------------------
+# casts (one registry entry; dispatch on (from, to))
+# ---------------------------------------------------------------------------
+
+@register("cast")
+def _cast(ret, a):
+    ft = a.type
+    if isinstance(a, StringColumn) and ret.is_string:
+        return StringColumn(a.chars, a.lengths, a.nulls, ret)
+    if ft.is_decimal and ret.is_floating:
+        return _col(ret, a.values.astype(ret.to_dtype()) / _POW10[ft.scale], a)
+    if ft.is_decimal and ret.is_decimal:
+        return _col(ret, rescale_decimal(a.values, ft.scale, ret.scale), a)
+    if ft.is_decimal and ret.is_integral:
+        return _col(ret, rescale_decimal(a.values, ft.scale, 0).astype(ret.to_dtype()), a)
+    if ft.is_integral and ret.is_decimal:
+        return _col(ret, a.values.astype(jnp.int64) * _POW10[ret.scale], a)
+    if ft.is_floating and ret.is_decimal:
+        return _col(ret, jnp.round(a.values * _POW10[ret.scale]).astype(jnp.int64), a)
+    if ft.is_floating and ret.is_integral:
+        return _col(ret, jnp.round(a.values).astype(ret.to_dtype()), a)
+    if ft.base == "boolean" and ret.is_numeric:
+        return _col(ret, a.values.astype(ret.to_dtype()), a)
+    if ft.base == "date" and ret.base == "timestamp":
+        return _col(ret, a.values.astype(jnp.int64) * 86_400_000_000, a)
+    if ft.base == "timestamp" and ret.base == "date":
+        return _col(ret, (a.values // 86_400_000_000).astype(jnp.int32), a)
+    # plain numeric widening/narrowing
+    return _col(ret, a.values.astype(ret.to_dtype()), a)
+
+
+# ---------------------------------------------------------------------------
+# hashing (for partitioned exchange / group-by; splitmix64 on device)
+# ---------------------------------------------------------------------------
+
+# np (not jnp) constants: importing this module must not initialize a
+# device backend -- coordinator-side code builds IR without any chip.
+_GOLD = np.uint64(0x9E3779B97F4A7C15)
+_H1 = np.uint64(0xBF58476D1CE4E5B9)
+_H2 = np.uint64(0x94D049BB133111EB)
+
+
+def _mix64(z):
+    z = (z + _GOLD).astype(jnp.uint64)
+    z = (z ^ (z >> np.uint64(30))) * _H1
+    z = (z ^ (z >> np.uint64(27))) * _H2
+    return z ^ (z >> np.uint64(31))
+
+
+def hash64_block(b: Block):
+    """Per-row 64-bit hash of a block (nulls hash to a fixed value),
+    the analog of the $hashValue channels HashGenerationOptimizer adds."""
+    if isinstance(b, StringColumn):
+        h = jnp.zeros(b.chars.shape[0], dtype=jnp.uint64)
+        # mix 8 chars at a time as a little-endian word
+        w = b.chars.shape[1]
+        padded = jnp.pad(b.chars, ((0, 0), (0, (-w) % 8)))
+        words = padded.reshape(padded.shape[0], -1, 8).astype(jnp.uint64)
+        shifts = (jnp.arange(8, dtype=jnp.uint64) * 8)[None, None, :]
+        packed = jnp.sum(words << shifts, axis=2)
+        for i in range(packed.shape[1]):
+            h = _mix64(h ^ packed[:, i])
+        h = _mix64(h ^ b.lengths.astype(jnp.uint64))
+    else:
+        v = b.values
+        if v.dtype == jnp.bool_:
+            v = v.astype(jnp.uint64)
+        elif v.dtype in (jnp.float32, jnp.float64):
+            f = v.astype(jnp.float64)
+            f = jnp.where(f == 0.0, 0.0, f)        # -0.0 hashes like 0.0
+            f = jnp.where(jnp.isnan(f), jnp.nan, f)  # canonical NaN bits
+            v = jax.lax.bitcast_convert_type(f, jnp.uint64)
+        else:
+            v = v.astype(jnp.int64).astype(jnp.uint64)  # two's-complement wrap
+        h = _mix64(v)
+    return jnp.where(b.nulls, jnp.uint64(0x9E3779B97F4A7C15), h)
+
+
+def combine_hash(h1, h2):
+    return _mix64(h1 ^ (h2 + _GOLD + (h1 << jnp.uint64(6)) + (h1 >> jnp.uint64(2))))

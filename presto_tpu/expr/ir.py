@@ -1,0 +1,150 @@
+"""The relational expression IR: Presto's RowExpression family.
+
+Reference surface: presto-spi/.../spi/relation/ (CallExpression,
+SpecialFormExpression, ConstantExpression, InputReferenceExpression,
+VariableReferenceExpression, LambdaDefinitionExpression) -- the IR the
+coordinator ships to workers inside PlanFragments, produced by
+SqlToRowExpressionTranslator (presto-main-base/.../sql/relational/).
+
+This is the input language of the TPU expression compiler
+(presto_tpu.expr.compile), the analog of ExpressionCompiler.java:144 on
+the JVM and PrestoToVeloxExpr.cpp on the native worker.
+
+JSON serialization follows the shape of the Presto wire format closely
+enough that a protocol adapter can translate mechanically:
+  {"@type": "call", "displayName": ..., "returnType": sig, "arguments": [...]}
+  {"@type": "special", "form": "AND", "returnType": sig, "arguments": [...]}
+  {"@type": "constant", "valueBlock"/"value": ..., "type": sig}
+  {"@type": "variable"/"input", ...}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+from .. import types as T
+
+__all__ = ["RowExpression", "InputReference", "Constant", "Call", "SpecialForm",
+           "input_ref", "const", "call", "special", "from_json", "to_json"]
+
+
+@dataclasses.dataclass(frozen=True)
+class RowExpression:
+    type: T.Type
+
+    def children(self) -> Tuple["RowExpression", ...]:
+        return ()
+
+
+@dataclasses.dataclass(frozen=True)
+class InputReference(RowExpression):
+    """Reference to input channel `channel` of the operator's input row
+    (InputReferenceExpression analog; VariableReferenceExpressions are
+    resolved to channels before compilation, as LocalExecutionPlanner does)."""
+    channel: int = 0
+
+    def __str__(self):
+        return f"$in{self.channel}:{self.type}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Constant(RowExpression):
+    """A literal. For fixed-width types `value` is a Python scalar in the
+    device representation (decimals pre-scaled to int); for strings, a
+    Python str; None means typed NULL."""
+    value: Any = None
+
+    @property
+    def is_null(self) -> bool:
+        return self.value is None
+
+    def __str__(self):
+        return f"{self.value!r}:{self.type}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Call(RowExpression):
+    """Scalar function call, resolved by name against the function registry
+    (the FunctionHandle resolution the coordinator does is collapsed to
+    name + argument types here)."""
+    name: str = ""
+    arguments: Tuple[RowExpression, ...] = ()
+
+    def children(self):
+        return self.arguments
+
+    def __str__(self):
+        return f"{self.name}({', '.join(map(str, self.arguments))})"
+
+
+# Forms mirror SpecialFormExpression.Form
+FORMS = ("IF", "NULL_IF", "SWITCH", "WHEN", "IS_NULL", "COALESCE", "IN",
+         "AND", "OR", "DEREFERENCE", "ROW_CONSTRUCTOR", "BIND", "BETWEEN")
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecialForm(RowExpression):
+    """Non-function forms with special evaluation/null semantics
+    (SpecialFormExpression analog): short-circuit AND/OR (Kleene 3VL),
+    IF/SWITCH selection, COALESCE, IS_NULL, IN."""
+    form: str = ""
+    arguments: Tuple[RowExpression, ...] = ()
+
+    def __post_init__(self):
+        assert self.form in FORMS, self.form
+
+    def children(self):
+        return self.arguments
+
+    def __str__(self):
+        return f"{self.form}({', '.join(map(str, self.arguments))})"
+
+
+# ---- construction sugar ---------------------------------------------------
+
+def input_ref(channel: int, ty: T.Type) -> InputReference:
+    return InputReference(ty, channel)
+
+
+def const(value: Any, ty: T.Type) -> Constant:
+    return Constant(ty, value)
+
+
+def call(name: str, ty: T.Type, *args: RowExpression) -> Call:
+    return Call(ty, name, tuple(args))
+
+
+def special(form: str, ty: T.Type, *args: RowExpression) -> SpecialForm:
+    return SpecialForm(ty, form, tuple(args))
+
+
+# ---- JSON -----------------------------------------------------------------
+
+def to_json(e: RowExpression) -> dict:
+    if isinstance(e, InputReference):
+        return {"@type": "input", "channel": e.channel, "type": str(e.type)}
+    if isinstance(e, Constant):
+        return {"@type": "constant", "value": e.value, "type": str(e.type)}
+    if isinstance(e, Call):
+        return {"@type": "call", "displayName": e.name, "returnType": str(e.type),
+                "arguments": [to_json(a) for a in e.arguments]}
+    if isinstance(e, SpecialForm):
+        return {"@type": "special", "form": e.form, "returnType": str(e.type),
+                "arguments": [to_json(a) for a in e.arguments]}
+    raise TypeError(type(e))
+
+
+def from_json(j: dict) -> RowExpression:
+    t = j["@type"]
+    if t == "input":
+        return InputReference(T.parse_type(j["type"]), j["channel"])
+    if t == "constant":
+        return Constant(T.parse_type(j["type"]), j["value"])
+    if t == "call":
+        return Call(T.parse_type(j["returnType"]), j["displayName"],
+                    tuple(from_json(a) for a in j["arguments"]))
+    if t == "special":
+        return SpecialForm(T.parse_type(j["returnType"]), j["form"],
+                           tuple(from_json(a) for a in j["arguments"]))
+    raise ValueError(f"unknown RowExpression kind {t!r}")

@@ -1,0 +1,16 @@
+"""Operator kernels: the TPU analog of presto-main-base's operator/ package.
+
+Each operator is a pure, jittable function over Batch pytrees (no Driver
+push/pull state machine -- XLA fuses the chain; streaming comes from the
+exec layer feeding bounded batches)."""
+
+from .keys import key_words
+from .aggregation import (AggSpec, group_by, grouped_aggregate, merge_partials,
+                          GroupByResult)
+from .sort import sort_batch, top_n
+from .join import hash_join
+from .misc import limit, distinct
+
+__all__ = ["key_words", "AggSpec", "group_by", "grouped_aggregate",
+           "merge_partials", "GroupByResult", "sort_batch", "top_n",
+           "hash_join", "limit", "distinct"]

@@ -1,0 +1,294 @@
+"""Grouped aggregation: the HashAggregationOperator analog.
+
+Reference surface: operator/HashAggregationOperator.java:56,
+operator/aggregation/builder/InMemoryHashAggregationBuilder.java:56,
+GroupByHash/BigintGroupByHash/MultiChannelGroupByHash (operator/*.java)
+and the partial/final split the planner produces
+(PushPartialAggregationThroughExchange rule).
+
+TPU-first redesign: no open-addressed hash table (pointer chasing is
+VPU-hostile). Group resolution is SORT-based and fully static-shape:
+
+  1. normalize key columns to uint64 words (ops/keys.py)
+  2. lax.sort rows by words (inactive rows forced to the end)
+  3. adjacent-row word inequality -> segment boundaries -> cumsum gives
+     dense group ids in sorted order (exact: words encode full keys)
+  4. scatter ids back through the sort permutation
+  5. every aggregate becomes a masked scatter-add/min/max into a dense
+     (max_groups,) table -- XLA lowers these to efficient TPU scatters
+
+`max_groups` is a static capacity (shape-bucketing policy lives in the
+exec layer; overflow is reported via the result's `overflow` flag --
+the spill path's trigger, the SpillableHashAggregationBuilder analog).
+
+Partial and final aggregation share this kernel: a partial result is
+itself a Batch of (keys..., states...) rows, and `merge_partials`
+re-groups them with the merge combinators (sum<-sum, count<-sum,
+min<-min, max<-max, avg = (sum, count) pair).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..block import Batch, Block, Column, DictionaryColumn, StringColumn
+from .keys import key_words
+
+__all__ = ["AggSpec", "GroupByResult", "group_by", "grouped_aggregate",
+           "merge_partials"]
+
+
+# aggregate function names supported round 1 (reference: the ~250-file
+# operator/aggregation/ library; the long tail lands with the function
+# registry's aggregation side)
+_AGGS = ("sum", "count", "count_star", "min", "max", "avg")
+
+
+@dataclasses.dataclass(frozen=True)
+class AggSpec:
+    """One aggregate: `name(input_channel)` -> output of `output_type`.
+    input_channel is None for count(*)."""
+    name: str
+    input_channel: Optional[int]
+    output_type: T.Type
+
+    def __post_init__(self):
+        assert self.name in _AGGS, self.name
+
+
+@dataclasses.dataclass
+class GroupByResult:
+    """Dense group table: `batch` holds one row per group (key columns
+    then aggregate state columns), active for slots < num_groups.
+    `overflow` is True when distinct keys exceeded max_groups (results
+    for the overflowed tail are dropped -- exec layer must re-run with a
+    bigger bucket or spill)."""
+    batch: Batch
+    num_groups: jnp.ndarray
+    overflow: jnp.ndarray
+
+
+jax.tree_util.register_dataclass(GroupByResult,
+                                 data_fields=["batch", "num_groups", "overflow"],
+                                 meta_fields=[])
+
+
+def _group_ids(key_cols: Sequence[Block], active: jnp.ndarray, max_groups: int):
+    """Dense group ids per row (exact, sort-based). Returns
+    (ids, perm_first, num_groups, overflow) where perm_first[g] is the
+    row index of the first-seen (in sorted order) member of group g,
+    used to gather representative key values."""
+    n = active.shape[0]
+    words, _ = key_words(key_cols)
+    # inactive rows sort last: leading word 1 for inactive
+    lead = jnp.where(active, np.uint64(0), np.uint64(1))
+    operands = [lead, *words, jnp.arange(n, dtype=jnp.int32)]
+    sorted_ops = jax.lax.sort(operands, num_keys=len(operands) - 1)
+    s_words = sorted_ops[:-1]
+    perm = sorted_ops[-1]
+    s_active = s_words[0] == 0
+    # boundary where any word differs from previous row
+    diffs = jnp.zeros(n, dtype=bool)
+    for w in s_words:
+        diffs = diffs | (w != jnp.concatenate([w[:1], w[:-1]]))
+    diffs = diffs.at[0].set(False)
+    seg = jnp.cumsum(diffs.astype(jnp.int32))  # dense ids in sorted order
+    num_groups = jnp.where(jnp.any(s_active), seg[jnp.sum(s_active.astype(jnp.int32)) - 1] + 1, 0)
+    overflow = num_groups > max_groups
+    seg = jnp.minimum(seg, max_groups - 1)
+    seg = jnp.where(s_active, seg, max_groups - 1)  # park inactive in last slot
+    ids = jnp.zeros(n, dtype=jnp.int32).at[perm].set(seg)
+    # representative row per group: first sorted row of each segment
+    first_mask = (jnp.concatenate([jnp.ones(1, dtype=bool), diffs[1:]])) & s_active
+    perm_first = jnp.zeros(max_groups, dtype=jnp.int32).at[
+        jnp.where(first_mask, seg, max_groups - 1)].max(
+        jnp.where(first_mask, perm, 0))
+    return ids, perm_first, num_groups, overflow
+
+
+def _gather_block(b: Block, idx: jnp.ndarray, valid: jnp.ndarray) -> Block:
+    if isinstance(b, DictionaryColumn):
+        b = b.decode()
+    if isinstance(b, StringColumn):
+        return StringColumn(b.chars[idx], jnp.where(valid, b.lengths[idx], 0),
+                            jnp.where(valid, b.nulls[idx], True), b.type)
+    return Column(b.values[idx], jnp.where(valid, b.nulls[idx], True), b.type)
+
+
+def _sum_dtype(ty: T.Type):
+    if ty.is_floating:
+        return jnp.float64
+    return jnp.int64
+
+
+def _acc_columns(spec: AggSpec, col: Optional[Block], ids, active, max_groups: int
+                 ) -> List[Tuple[str, Column]]:
+    """Compute accumulator state tables for one aggregate. Returns a list
+    of named state columns (avg needs two)."""
+    g = max_groups
+    if spec.name == "count_star":
+        cnt = jnp.zeros(g, dtype=jnp.int64).at[ids].add(active.astype(jnp.int64))
+        return [("count", Column(cnt, jnp.zeros(g, dtype=bool), T.BIGINT))]
+
+    assert col is not None
+    if isinstance(col, DictionaryColumn):
+        col = col.decode()
+    live = active & ~col.nulls
+    nn = jnp.zeros(g, dtype=jnp.int64).at[ids].add(live.astype(jnp.int64))
+    no_input = nn == 0
+
+    if spec.name == "count":
+        return [("count", Column(nn, jnp.zeros(g, dtype=bool), T.BIGINT))]
+
+    if isinstance(col, StringColumn):
+        if spec.name in ("min", "max"):
+            return _minmax_string(col, ids, live, g, spec)
+        raise NotImplementedError(f"{spec.name} over strings")
+
+    v = col.values
+    if spec.name == "sum" or spec.name == "avg":
+        sv = v.astype(_sum_dtype(col.type))
+        s = jnp.zeros(g, dtype=sv.dtype).at[ids].add(jnp.where(live, sv, 0))
+        out = [("sum", Column(s, no_input, spec.output_type if spec.name == "sum"
+                              else _sum_type(col.type)))]
+        if spec.name == "avg":
+            out.append(("count", Column(nn, jnp.zeros(g, dtype=bool), T.BIGINT)))
+        return out
+    if spec.name == "min":
+        ident = _max_ident(v.dtype)
+        m = jnp.full(g, ident, dtype=v.dtype).at[ids].min(
+            jnp.where(live, v, ident))
+        return [("min", Column(m, no_input, spec.output_type))]
+    if spec.name == "max":
+        ident = _min_ident(v.dtype)
+        m = jnp.full(g, ident, dtype=v.dtype).at[ids].max(
+            jnp.where(live, v, ident))
+        return [("max", Column(m, no_input, spec.output_type))]
+    raise NotImplementedError(spec.name)
+
+
+def _sum_type(in_ty: T.Type) -> T.Type:
+    if in_ty.is_decimal:
+        return T.decimal(38, in_ty.scale)
+    if in_ty.is_floating:
+        return T.DOUBLE
+    return T.BIGINT
+
+
+def _max_ident(dt):
+    return jnp.inf if dt in (jnp.float32, jnp.float64) else jnp.iinfo(dt).max
+
+
+def _min_ident(dt):
+    return -jnp.inf if dt in (jnp.float32, jnp.float64) else jnp.iinfo(dt).min
+
+
+def _minmax_string(col: StringColumn, ids, live, g, spec):
+    """min/max over strings: reduce via per-group scatter-min/max over the
+    packed big-endian words, then gather the winning row's chars. Uses an
+    argmin-by-(word, rowid) trick per word chunk -- exact for widths
+    <= 8 bytes; wider strings fall back to iterative refinement."""
+    from .keys import _string_words
+    words = _string_words(col)
+    n = col.chars.shape[0]
+    # combine words with row index to make a total order, then scatter-min
+    # (or max) the packed (word_chain..., row) tuple; for practicality we
+    # reduce on the first word and tie-break iteratively.
+    best_row = None
+    remaining = live
+    # single-chunk fast path covers <=8-byte strings exactly
+    w0 = words[0]
+    if spec.name == "min":
+        sel = jnp.where(remaining, w0, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+        best_w = jnp.full(g, np.uint64(0xFFFFFFFFFFFFFFFF), dtype=jnp.uint64).at[ids].min(sel)
+    else:
+        sel = jnp.where(remaining, w0, jnp.uint64(0))
+        best_w = jnp.zeros(g, dtype=jnp.uint64).at[ids].max(sel)
+    if len(words) > 1:
+        # refine ties on subsequent chunks
+        for wk in words[1:]:
+            tie = remaining & (w0 == best_w[ids])
+            if spec.name == "min":
+                selk = jnp.where(tie, wk, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+                bk = jnp.full(g, np.uint64(0xFFFFFFFFFFFFFFFF), dtype=jnp.uint64).at[ids].min(selk)
+            else:
+                selk = jnp.where(tie, wk, jnp.uint64(0))
+                bk = jnp.zeros(g, dtype=jnp.uint64).at[ids].max(selk)
+            remaining = tie & (wk == bk[ids])
+            w0 = wk
+            best_w = bk
+        winners = remaining
+    else:
+        winners = remaining & (w0 == best_w[ids])
+    # pick the first winning row id per group
+    row_sel = jnp.where(winners, jnp.arange(n, dtype=jnp.int32), n)
+    best_row = jnp.full(g, n, dtype=jnp.int32).at[ids].min(row_sel)
+    valid = best_row < n
+    idx = jnp.clip(best_row, 0, n - 1)
+    return [(spec.name,
+             StringColumn(col.chars[idx], jnp.where(valid, col.lengths[idx], 0),
+                          ~valid, spec.output_type))]
+
+
+def group_by(batch: Batch, key_channels: Sequence[int], aggs: Sequence[AggSpec],
+             max_groups: int) -> GroupByResult:
+    """Grouped aggregation over one batch -> dense group table."""
+    keys = [batch.column(c) for c in key_channels]
+    ids, perm_first, num_groups, overflow = _group_ids(keys, batch.active, max_groups)
+    slot = jnp.arange(max_groups, dtype=jnp.int32)
+    slot_active = slot < jnp.minimum(num_groups, max_groups)
+    out_cols: List[Block] = []
+    for k in keys:
+        out_cols.append(_gather_block(k, perm_first, slot_active))
+    for spec in aggs:
+        col = None if spec.input_channel is None else batch.column(spec.input_channel)
+        for _, state in _acc_columns(spec, col, ids, batch.active, max_groups):
+            out_cols.append(state)
+    out = Batch(tuple(out_cols), slot_active)
+    return GroupByResult(out, num_groups, overflow)
+
+
+def grouped_aggregate(batch: Batch, key_channels: Sequence[int],
+                      aggs: Sequence[AggSpec], max_groups: int) -> GroupByResult:
+    """Alias with the reference's operator naming."""
+    return group_by(batch, key_channels, aggs, max_groups)
+
+
+def state_width(spec: AggSpec) -> int:
+    return 2 if spec.name == "avg" else 1
+
+
+def merge_spec(spec: AggSpec, state_channel: int) -> List[AggSpec]:
+    """The merge-side aggregates for a partial state at `state_channel`
+    (final aggregation step: sum<-sum, count<-sum, min<-min, max<-max,
+    avg <- sum(sum)/sum(count))."""
+    if spec.name in ("sum",):
+        return [AggSpec("sum", state_channel, spec.output_type)]
+    if spec.name in ("count", "count_star"):
+        return [AggSpec("sum", state_channel, T.BIGINT)]
+    if spec.name == "min":
+        return [AggSpec("min", state_channel, spec.output_type)]
+    if spec.name == "max":
+        return [AggSpec("max", state_channel, spec.output_type)]
+    if spec.name == "avg":
+        return [AggSpec("sum", state_channel, T.decimal(38, 0)),
+                AggSpec("sum", state_channel + 1, T.BIGINT)]
+    raise NotImplementedError(spec.name)
+
+
+def merge_partials(partials: Batch, num_keys: int, aggs: Sequence[AggSpec],
+                   max_groups: int) -> GroupByResult:
+    """Final aggregation over concatenated partial tables (the
+    INTERMEDIATE/FINAL step of the reference's two-stage aggregation)."""
+    specs: List[AggSpec] = []
+    ch = num_keys
+    for spec in aggs:
+        specs.extend(merge_spec(spec, ch))
+        ch += state_width(spec)
+    return group_by(partials, list(range(num_keys)), specs, max_groups)

@@ -1,0 +1,225 @@
+"""Joins: the HashBuilderOperator / LookupJoinOperator analog.
+
+Reference surface: operator/HashBuilderOperator.java:55 (build side ->
+LookupSource), operator/LookupJoinOperator.java:52 (probe loop),
+JoinCompiler's generated hash strategies, and the join plan nodes
+(JoinNode INNER/LEFT/RIGHT/FULL, SemiJoinNode).
+
+TPU-first redesign: no pointer-chasing hash table. The build side is
+SORTED by key words once (MXU-friendly O(n log n) on device); probes
+binary-search via jnp.searchsorted (vectorized, log n gathers). 1:N
+matches expand through a static-capacity prefix-sum expansion:
+
+  start[i] = searchsorted_left(build, probe_i)
+  cnt[i]   = searchsorted_right - start  (0 for null/missing keys)
+  off      = exclusive_cumsum(cnt)
+  out row k maps back to probe row via searchsorted(off, k), and to
+  build row start[row] + (k - off[row])
+
+Everything is a fixed-shape gather -- the dynamic result size only
+shows up in the output's active mask and an `overflow` flag when the
+out_capacity bucket is too small (exec layer re-runs bigger, the
+LookupJoinOperator yield/rebatch analog).
+
+Sort order on multiple words: lexicographic. searchsorted works on a
+single key, so the word tuple is reduced to a single total-order rank:
+build rows get rank = their sorted position; probes find their rank by
+stacked binary search over each word level. For the common 1-2 word
+case (bigint keys) this is one searchsorted call.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..block import Batch, Block, Column, DictionaryColumn, StringColumn
+from .keys import key_words
+
+__all__ = ["hash_join", "JoinResult", "semi_join_mask"]
+
+
+@dataclasses.dataclass
+class JoinResult:
+    batch: Batch          # probe columns ++ build columns
+    num_rows: jnp.ndarray
+    overflow: jnp.ndarray
+
+
+jax.tree_util.register_dataclass(JoinResult,
+                                 data_fields=["batch", "num_rows", "overflow"],
+                                 meta_fields=[])
+
+
+def _combined_key(cols: Sequence[Block], active) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Reduce a key tuple to sortable words; returns (words stacked as a
+    (k, n) list, usable_mask). Null keys never match in joins."""
+    words, any_null = key_words(cols)
+    # drop per-column null words (null keys are excluded wholesale)
+    usable = active & ~any_null
+    vwords = []
+    i = 0
+    for c in cols:
+        if isinstance(c, DictionaryColumn):
+            c = c.decode()
+        nw = 1 + ((c.max_len + 7) // 8 if isinstance(c, StringColumn) else 1)
+        vwords.extend(words[i + 1: i + nw])  # skip the null word
+        i += nw
+    return vwords, usable
+
+
+_MAXW = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def _sort_build(b_words: List[jnp.ndarray], b_usable: jnp.ndarray,
+                payload: Optional[jnp.ndarray]):
+    """Sort build rows so the word arrays are globally sorted AND
+    searchsorted-safe: unusable rows have all words forced to MAX so
+    they sink to the end without breaking sortedness; within equal
+    words, usable rows sort first (trailing tiebreak) so clamping
+    match ranges to n_usable keeps exactly the genuine rows."""
+    masked = [jnp.where(b_usable, w, _MAXW) for w in b_words]
+    tiebreak = jnp.where(b_usable, np.uint64(0), np.uint64(1))
+    ops = [*masked, tiebreak]
+    if payload is not None:
+        ops.append(payload)
+    out = jax.lax.sort(ops, num_keys=len(masked) + 1)
+    sorted_words = out[:len(masked)]
+    sorted_payload = out[-1] if payload is not None else None
+    return sorted_words, sorted_payload
+
+
+def _pack_ranks(build_words: List[jnp.ndarray], probe_words: List[jnp.ndarray]):
+    """Reduce multi-word keys to single int64 ranks, exactly.
+
+    Build side: sort rows by words; the rank of a build row is its dense
+    key index (cumsum of boundaries). Probe side: for each level,
+    compute the probe's position among build keys by searchsorted on
+    that level *given* the accumulated equality on previous levels --
+    implemented by mapping (prev_rank, word) pairs to a fresh dense rank
+    via another sort over the union. Cost: O((b+p) log(b+p)) per word.
+    """
+    nb = build_words[0].shape[0]
+    npr = probe_words[0].shape[0]
+    b_rank = jnp.zeros(nb, dtype=jnp.int64)
+    p_rank = jnp.zeros(npr, dtype=jnp.int64)
+    for bw, pw in zip(build_words, probe_words):
+        # union sort of (rank, word, is_probe, idx)
+        ranks = jnp.concatenate([b_rank, p_rank])
+        words = jnp.concatenate([bw, pw])
+        is_probe = jnp.concatenate([jnp.zeros(nb, dtype=jnp.uint64),
+                                    jnp.ones(npr, dtype=jnp.uint64)])
+        idx = jnp.arange(nb + npr, dtype=jnp.int32)
+        r, w, tag, pi = jax.lax.sort(
+            [ranks.astype(jnp.uint64), words, is_probe, idx], num_keys=3)
+        # dense rank over (rank, word) pairs
+        boundary = (r != jnp.concatenate([r[:1], r[:-1]])) | \
+                   (w != jnp.concatenate([w[:1], w[:-1]]))
+        boundary = boundary.at[0].set(False)
+        dense = jnp.cumsum(boundary.astype(jnp.int64))
+        new = jnp.zeros(nb + npr, dtype=jnp.int64).at[pi].set(dense)
+        b_rank, p_rank = new[:nb], new[nb:]
+    return b_rank, p_rank
+
+
+def hash_join(probe: Batch, build: Batch,
+              probe_key_channels: Sequence[int],
+              build_key_channels: Sequence[int],
+              out_capacity: int,
+              join_type: str = "inner",
+              build_output_channels: Optional[Sequence[int]] = None) -> JoinResult:
+    """Join probe x build. join_type in {inner, left}. Output columns are
+    probe.columns ++ build.columns[build_output_channels]."""
+    assert join_type in ("inner", "left")
+    if build_output_channels is None:
+        build_output_channels = range(build.num_columns)
+
+    p_keys = [probe.column(c) for c in probe_key_channels]
+    b_keys = [build.column(c) for c in build_key_channels]
+    p_words, p_usable = _combined_key(p_keys, probe.active)
+    b_words, b_usable = _combined_key(b_keys, build.active)
+
+    nb = build.capacity
+    npr = probe.capacity
+
+    # sort build by key words (unusable rows masked to MAX, sorted last)
+    sb_words, b_perm = _sort_build(b_words, b_usable,
+                                   jnp.arange(nb, dtype=jnp.int32))
+    n_build_usable = jnp.sum(b_usable.astype(jnp.int64))
+
+    if len(p_words) == 1:
+        start = jnp.searchsorted(sb_words[0], p_words[0], side="left")
+        end = jnp.searchsorted(sb_words[0], p_words[0], side="right")
+    else:
+        b_rank, p_rank = _pack_ranks(list(sb_words), list(p_words))
+        start = jnp.searchsorted(b_rank, p_rank, side="left")
+        end = jnp.searchsorted(b_rank, p_rank, side="right")
+    # clamp matches into the usable (sorted-front) region
+    start = jnp.minimum(start, n_build_usable)
+    end = jnp.minimum(end, n_build_usable)
+
+    cnt = jnp.where(p_usable, end - start, 0).astype(jnp.int64)
+    if join_type == "left":
+        emit = jnp.where(probe.active, jnp.maximum(cnt, 1), 0)
+    else:
+        emit = cnt
+    off = jnp.cumsum(emit) - emit  # exclusive
+    total = off[-1] + emit[-1]
+    overflow = total > out_capacity
+
+    k = jnp.arange(out_capacity, dtype=jnp.int64)
+    # map output slot -> probe row
+    prow = jnp.searchsorted(off, k, side="right") - 1
+    prow = jnp.clip(prow, 0, npr - 1)
+    j = k - off[prow]
+    valid = (k < total) & (j < emit[prow])
+    matched = j < cnt[prow]
+    srow = jnp.clip(start[prow] + j, 0, nb - 1)
+    brow = b_perm[srow]  # back to original build row order
+
+    out_cols: List[Block] = []
+    for c in probe.columns:
+        out_cols.append(_gather(c, prow, valid))
+    for ci in build_output_channels:
+        c = build.column(ci)
+        g = _gather(c, brow, valid & matched)
+        out_cols.append(g)
+    out = Batch(tuple(out_cols), valid)
+    return JoinResult(out, total, overflow)
+
+
+def _gather(b: Block, idx, valid) -> Block:
+    if isinstance(b, DictionaryColumn):
+        b = b.decode()
+    if isinstance(b, StringColumn):
+        return StringColumn(b.chars[idx], jnp.where(valid, b.lengths[idx], 0),
+                            jnp.where(valid, b.nulls[idx], True), b.type)
+    return Column(b.values[idx], jnp.where(valid, b.nulls[idx], True), b.type)
+
+
+def semi_join_mask(probe: Batch, build: Batch,
+                   probe_key_channels: Sequence[int],
+                   build_key_channels: Sequence[int]) -> jnp.ndarray:
+    """SemiJoinNode analog: per-probe-row boolean 'key IN build side'.
+    (NULL semantics of IN subqueries are applied by the planner's filter.)"""
+    p_keys = [probe.column(c) for c in probe_key_channels]
+    b_keys = [build.column(c) for c in build_key_channels]
+    p_words, p_usable = _combined_key(p_keys, probe.active)
+    b_words, b_usable = _combined_key(b_keys, build.active)
+    sb_words, _ = _sort_build(b_words, b_usable, None)
+    n_usable = jnp.sum(b_usable.astype(jnp.int64))
+    if len(p_words) == 1:
+        start = jnp.searchsorted(sb_words[0], p_words[0], side="left")
+        end = jnp.searchsorted(sb_words[0], p_words[0], side="right")
+    else:
+        b_rank, p_rank = _pack_ranks(list(sb_words), list(p_words))
+        start = jnp.searchsorted(b_rank, p_rank, side="left")
+        end = jnp.searchsorted(b_rank, p_rank, side="right")
+    start = jnp.minimum(start, n_usable)
+    end = jnp.minimum(end, n_usable)
+    return p_usable & (end > start)

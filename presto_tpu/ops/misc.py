@@ -1,0 +1,43 @@
+"""Limit, DistinctLimit, MarkDistinct analogs.
+
+Reference surface: operator/LimitOperator.java, DistinctLimitOperator.java,
+MarkDistinctOperator.java (and the MarkDistinctHash it shares with
+aggregation). Distinctness reuses the sort-based group-id machinery."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..block import Batch
+from .aggregation import _group_ids
+
+__all__ = ["limit", "distinct", "mark_distinct"]
+
+
+def limit(batch: Batch, n: int) -> Batch:
+    """Keep the first n active rows (in row order)."""
+    pos = jnp.cumsum(batch.active.astype(jnp.int64))
+    return batch.with_active(batch.active & (pos <= n))
+
+
+def mark_distinct(batch: Batch, key_channels: Sequence[int],
+                  max_groups: int) -> jnp.ndarray:
+    """Boolean column: True on the first active occurrence of each
+    distinct key (MarkDistinctOperator analog). Assumes distinct key
+    count <= max_groups."""
+    keys = [batch.column(c) for c in key_channels]
+    ids, _, _, _ = _group_ids(keys, batch.active, max_groups)
+    n = batch.capacity
+    rows = jnp.arange(n, dtype=jnp.int32)
+    first = jnp.full(max_groups, n, dtype=jnp.int32).at[
+        jnp.where(batch.active, ids, max_groups - 1)].min(
+        jnp.where(batch.active, rows, n))
+    return batch.active & (first[ids] == rows)
+
+
+def distinct(batch: Batch, key_channels: Sequence[int], max_groups: int) -> Batch:
+    """SELECT DISTINCT: deactivate duplicate rows."""
+    return batch.with_active(mark_distinct(batch, key_channels, max_groups))

@@ -9,12 +9,24 @@ exercised without TPU hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the profile env pins "axon"
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()
 
 import jax  # noqa: E402  (import after env setup)
+
+# The image's sitecustomize registers a remote-TPU PJRT plugin ("axon") in
+# every interpreter (importing jax in the process, so the env var above is
+# captured too late) and pins jax_platforms to it; when the axon relay is
+# down, *any* backend init hangs. Tests are CPU-only by design -- re-pin
+# the platform and drop the factory so the suite never touches the tunnel.
+jax.config.update("jax_platforms", "cpu")
+try:  # pragma: no cover - environment armor
+    import jax._src.xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
 
 import numpy as np
 import pytest

@@ -1,0 +1,191 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.block import batch_from_numpy, to_numpy
+from presto_tpu.expr import (call, compile_filter, compile_projections, const,
+                             input_ref, special)
+from presto_tpu.expr.ir import from_json, to_json
+
+
+def make_batch(cols, types, nulls=None, capacity=None):
+    return batch_from_numpy(types, [np.asarray(c) for c in cols], nulls,
+                            capacity=capacity)
+
+
+def ev(expr, batch):
+    out = compile_projections([expr])(batch)
+    return to_numpy(out.column(0))
+
+
+def test_arithmetic_and_nulls():
+    b = make_batch([[1, 2, 3, 4]], [T.BIGINT],
+                   nulls=[np.array([False, False, True, False])])
+    e = call("add", T.BIGINT, input_ref(0, T.BIGINT), const(10, T.BIGINT))
+    v, n = ev(e, b)
+    np.testing.assert_array_equal(v[[0, 1, 3]], [11, 12, 14])
+    assert list(n) == [False, False, True, False]
+
+
+def test_decimal_arithmetic():
+    d2 = T.decimal(12, 2)
+    # 1.50 * (1 - 0.06) = 1.41
+    price = make_batch([[150, 1000]], [d2])
+    one = const(100, d2)
+    disc = const(6, d2)
+    expr = call("multiply", T.decimal(24, 4), input_ref(0, d2),
+                call("subtract", d2, one, disc))
+    v, _ = ev(expr, price)
+    np.testing.assert_array_equal(v[:2], [150 * 94, 1000 * 94])
+
+
+def test_decimal_divide_rounding():
+    d2 = T.decimal(10, 2)
+    b = make_batch([[700, -700, 701, 5]], [d2])
+    e = call("divide", d2, input_ref(0, d2), const(200, d2))
+    v, n = ev(e, b)
+    np.testing.assert_array_equal(v[:4], [350, -350, 351, 3])  # 0.025 -> 0.03
+
+    z = call("divide", d2, input_ref(0, d2), const(0, d2))
+    v, n = ev(z, b)
+    assert n[:4].all()  # division by zero -> NULL
+
+
+def test_comparisons_and_between():
+    b = make_batch([[1, 5, 10, 7]], [T.BIGINT])
+    e = special("BETWEEN", T.BOOLEAN, input_ref(0, T.BIGINT),
+                const(5, T.BIGINT), const(9, T.BIGINT))
+    v, n = ev(e, b)
+    assert list(v[:4]) == [False, True, False, True]
+
+
+def test_kleene_and_or():
+    bools = np.array([True, False, True, False])
+    nulls = np.array([False, False, True, True])
+    b = make_batch([bools, bools], [T.BOOLEAN, T.BOOLEAN],
+                   nulls=[nulls, np.zeros(4, bool)])
+    # col0 AND col1: [T&T, F&F, N&T, N&F] = [T, F, N, F]
+    e = special("AND", T.BOOLEAN, input_ref(0, T.BOOLEAN), input_ref(1, T.BOOLEAN))
+    v, n = ev(e, b)
+    assert list(v[:4]) == [True, False, False, False]
+    assert list(n[:4]) == [False, False, True, False]
+    # col0 OR col1: [T, F, N|T=T, N|F=N]
+    e = special("OR", T.BOOLEAN, input_ref(0, T.BOOLEAN), input_ref(1, T.BOOLEAN))
+    v, n = ev(e, b)
+    assert list(v[:4]) == [True, False, True, False]
+    assert list(n[:4]) == [False, False, False, True]
+
+
+def test_if_coalesce_is_null():
+    b = make_batch([[1, 2, 3]], [T.BIGINT], nulls=[np.array([False, True, False])])
+    x = input_ref(0, T.BIGINT)
+    e = special("IF", T.BIGINT, special("IS_NULL", T.BOOLEAN, x),
+                const(-1, T.BIGINT), x)
+    v, n = ev(e, b)
+    assert list(v[:3]) == [1, -1, 3] and not n[:3].any()
+    e = special("COALESCE", T.BIGINT, x, const(99, T.BIGINT))
+    v, n = ev(e, b)
+    assert list(v[:3]) == [1, 99, 3]
+
+
+def test_in_null_semantics():
+    b = make_batch([[1, 2, 3]], [T.BIGINT])
+    x = input_ref(0, T.BIGINT)
+    e = special("IN", T.BOOLEAN, x, const(1, T.BIGINT), const(None, T.BIGINT))
+    v, n = ev(e, b)
+    assert v[0] and not n[0]       # 1 IN (1, NULL) -> TRUE
+    assert not v[1] and n[1]       # 2 IN (1, NULL) -> NULL
+
+
+def test_strings_eq_like():
+    b = make_batch([np.array(["PROMO BRUSHED TIN", "STANDARD TIN", "PROMOX",
+                              "special requests here"], dtype=object)],
+                   [T.varchar(25)])
+    x = input_ref(0, T.varchar(25))
+    e = call("like", T.BOOLEAN, x, const("PROMO%", T.varchar(6)))
+    v, _ = ev(e, b)
+    assert list(v[:4]) == [True, False, True, False]
+    e = call("like", T.BOOLEAN, x, const("%special%requests%", T.varchar(20)))
+    v, _ = ev(e, b)
+    assert list(v[:4]) == [False, False, False, True]
+    e = call("like", T.BOOLEAN, x, const("STANDARD TIN", T.varchar(12)))
+    v, _ = ev(e, b)
+    assert list(v[:4]) == [False, True, False, False]
+    e = call("like", T.BOOLEAN, x, const("%TIN", T.varchar(4)))
+    v, _ = ev(e, b)
+    assert list(v[:4]) == [True, True, False, False]
+    e = call("like", T.BOOLEAN, x, const("P_OMO%", T.varchar(6)))
+    v, _ = ev(e, b)
+    assert list(v[:4]) == [True, False, True, False]
+
+
+def test_string_functions():
+    b = make_batch([np.array(["  Hello ", "World", ""], dtype=object)],
+                   [T.varchar(10)])
+    x = input_ref(0, T.varchar(10))
+    v, _ = ev(call("trim", T.varchar(10), x), b)
+    assert list(v[:3]) == ["Hello", "World", ""]
+    v, _ = ev(call("upper", T.varchar(10), x), b)
+    assert v[1] == "WORLD"
+    v, _ = ev(call("length", T.BIGINT, x), b)
+    assert list(v[:3]) == [8, 5, 0]
+    v, _ = ev(call("substr", T.varchar(10), x, const(3, T.BIGINT),
+                   const(2, T.BIGINT)), b)
+    assert v[0] == "He"
+    v, _ = ev(call("concat", T.varchar(20), x, const("!", T.varchar(1))), b)
+    assert v[1] == "World!"
+
+
+def test_dates():
+    days = np.array([(np.datetime64(s) - np.datetime64("1970-01-01")).astype(int)
+                     for s in ["1994-01-01", "1998-12-31", "1996-02-29"]])
+    b = make_batch([days], [T.DATE])
+    x = input_ref(0, T.DATE)
+    v, _ = ev(call("year", T.BIGINT, x), b)
+    assert list(v[:3]) == [1994, 1998, 1996]
+    v, _ = ev(call("month", T.BIGINT, x), b)
+    assert list(v[:3]) == [1, 12, 2]
+    v, _ = ev(call("day", T.BIGINT, x), b)
+    assert list(v[:3]) == [1, 31, 29]
+    e = call("date_add", T.DATE, const("month", T.varchar(5)),
+             const(12, T.BIGINT), x)
+    v, _ = ev(e, b)
+    got = np.datetime64("1970-01-01") + v[2]
+    assert str(got) == "1997-02-28"  # leap-day clamp
+
+
+def test_filter_masks_rows():
+    b = make_batch([[1, 5, 10, 7]], [T.BIGINT], capacity=8)
+    f = compile_filter(call("gt", T.BOOLEAN, input_ref(0, T.BIGINT),
+                            const(5, T.BIGINT)))
+    out = f(b)
+    assert int(out.count()) == 2  # 10 and 7; padding rows stay inactive
+
+
+def test_jit_compilable():
+    b = make_batch([[1, 2, 3, 4]], [T.BIGINT], capacity=8)
+    e = call("multiply", T.BIGINT, input_ref(0, T.BIGINT), const(3, T.BIGINT))
+    run = jax.jit(compile_projections([e]))
+    out = run(b)
+    v, _ = to_numpy(out.column(0))
+    np.testing.assert_array_equal(v[:4], [3, 6, 9, 12])
+
+
+def test_json_roundtrip():
+    e = special("IF", T.BIGINT,
+                call("gt", T.BOOLEAN, input_ref(0, T.BIGINT), const(0, T.BIGINT)),
+                const(1, T.BIGINT), const(-1, T.BIGINT))
+    j = to_json(e)
+    assert from_json(j) == e
+
+
+def test_cast():
+    d2 = T.decimal(10, 2)
+    b = make_batch([[150, 250]], [d2])
+    v, _ = ev(call("cast", T.DOUBLE, input_ref(0, d2)), b)
+    np.testing.assert_allclose(v[:2], [1.5, 2.5])
+    b2 = make_batch([[3, 4]], [T.BIGINT])
+    v, _ = ev(call("cast", d2, input_ref(0, T.BIGINT)), b2)
+    np.testing.assert_array_equal(v[:2], [300, 400])
