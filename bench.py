@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Benchmark: TPC-H q1 (BASELINE.md config 1) on the real chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+value        = rows/sec through the full q1 pipeline (filter + project +
+               8-aggregate group-by over 6M*SF lineitem rows), steady
+               state, data resident in HBM (the reference measures its
+               operator pipelines the same way -- in-memory pages,
+               BenchmarkSuite.java:32 / HandTpchQuery1.java).
+vs_baseline  = speedup vs a single-core numpy columnar implementation of
+               the same query on this host (stand-in for the reference's
+               per-worker Java operator pipeline, which publishes no
+               absolute numbers -- BASELINE.md "published == {}").
+
+Env knobs: BENCH_SF (default 1.0), BENCH_ITERS (default 5).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _numpy_q1(cols, cutoff):
+    """Single-core columnar oracle/baseline of q1."""
+    m = cols["shipdate"] <= cutoff
+    rf = cols["returnflag"][m]
+    ls = cols["linestatus"][m]
+    qty = cols["quantity"][m]
+    price = cols["extendedprice"][m]
+    disc = cols["discount"][m]
+    tax = cols["tax"][m]
+    key = np.char.add(rf.astype(str), ls.astype(str))
+    uniq, inv = np.unique(key, return_inverse=True)
+    disc_price = price * (100 - disc)
+    charge = disc_price * (100 + tax)
+    out = {}
+    for i, k in enumerate(uniq):
+        g = inv == i
+        out[k] = (qty[g].sum(), price[g].sum(), disc_price[g].sum(),
+                  charge[g].sum(), g.sum())
+    return out
+
+
+def main():
+    sf = float(os.environ.get("BENCH_SF", "1"))
+    iters = int(os.environ.get("BENCH_ITERS", "5"))
+
+    import jax
+
+    from presto_tpu.connectors import tpch
+    from presto_tpu.queries import Q1_COLUMNS, q1_local
+
+    n = tpch.table_row_count("lineitem", sf)
+    capacity = -(-n // 1024) * 1024
+
+    t_gen = time.time()
+    host_cols = tpch.generate_columns("lineitem", sf, Q1_COLUMNS)
+    gen_s = time.time() - t_gen
+
+    # numpy single-core baseline (one run)
+    epoch = np.datetime64("1970-01-01")
+    cutoff = int((np.datetime64("1998-09-02") - epoch).astype(int))
+    t0 = time.time()
+    _numpy_q1(host_cols, cutoff)
+    numpy_s = time.time() - t0
+
+    # stage to device
+    from presto_tpu.block import batch_from_numpy
+    types = [tpch.column_type("lineitem", c) for c in Q1_COLUMNS]
+    batch = batch_from_numpy(types, [host_cols[c] for c in Q1_COLUMNS],
+                             capacity=capacity)
+    batch = jax.block_until_ready(jax.device_put(batch))
+
+    run = jax.jit(q1_local())
+    r = jax.block_until_ready(run(batch))  # warm-up / compile
+
+    t0 = time.time()
+    for _ in range(iters):
+        r = run(batch)
+    jax.block_until_ready(r)
+    dt = (time.time() - t0) / iters
+
+    rows_per_sec = n / dt
+    baseline_rows_per_sec = n / numpy_s
+    result = {
+        "metric": f"tpch_sf{sf:g}_q1_rows_per_sec",
+        "value": round(rows_per_sec),
+        "unit": "rows/s",
+        "vs_baseline": round(rows_per_sec / baseline_rows_per_sec, 3),
+        "detail": {
+            "query_wall_s": round(dt, 5),
+            "numpy_singlecore_wall_s": round(numpy_s, 4),
+            "datagen_wall_s": round(gen_s, 2),
+            "rows": n,
+            "platform": jax.devices()[0].platform,
+            "iters": iters,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,5 @@
+from .mesh import make_mesh, WORKERS_AXIS
+from .exchange import exchange_by_hash, broadcast_build, gather_to_root
+
+__all__ = ["make_mesh", "WORKERS_AXIS", "exchange_by_hash", "broadcast_build",
+           "gather_to_root"]

@@ -1,0 +1,120 @@
+"""Partitioned exchange over ICI: the shuffle data plane.
+
+Reference surface: operator/repartition/PartitionedOutputOperator.java:394
+(hash rows -> per-partition buffers -> outputBuffer.enqueue:484) and the
+consumer side operator/ExchangeClient.java:255 (HTTP long-poll pull of
+SerializedPages with token acks). The TPU-native redesign (SURVEY.md
+§2.3, §5 "north star") replaces the serialize->HTTP->deserialize hop
+with `jax.lax.all_to_all` between gang-scheduled stages on the mesh:
+rows hash to a destination worker, get packed into fixed-size per-
+destination send slots in HBM, and one collective moves every slot to
+its owner -- no host round-trip, no wire format, backpressure becomes a
+static slot-capacity overflow flag (exec reruns with a bigger bucket,
+the maxBufferedBytes analog).
+
+All functions here must run INSIDE shard_map over the workers axis.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..block import Batch, Block, Column, DictionaryColumn, StringColumn
+from ..expr.functions import combine_hash, hash64_block
+
+__all__ = ["exchange_by_hash", "broadcast_build", "gather_to_root"]
+
+
+def _row_hash(cols: Sequence[Block]) -> jnp.ndarray:
+    h = None
+    for c in cols:
+        if isinstance(c, DictionaryColumn):
+            c = c.decode()
+        hc = hash64_block(c)
+        h = hc if h is None else combine_hash(h, hc)
+    return h
+
+
+def _map_block(b: Block, fn) -> Block:
+    if isinstance(b, DictionaryColumn):
+        b = b.decode()
+    if isinstance(b, StringColumn):
+        return StringColumn(fn(b.chars), fn(b.lengths), fn(b.nulls), b.type)
+    return Column(fn(b.values), fn(b.nulls), b.type)
+
+
+def exchange_by_hash(batch: Batch, key_channels: Sequence[int], axis_name: str,
+                     slot_capacity: int) -> Tuple[Batch, jnp.ndarray]:
+    """All-to-all repartition by key hash (call inside shard_map).
+
+    Every worker packs its rows into `n_workers` buckets of
+    `slot_capacity` rows each and exchanges bucket i with worker i. The
+    returned batch has capacity n_workers * slot_capacity and holds all
+    rows whose keys hash to this worker. Also returns an `overflow` flag
+    (any source bucket exceeded slot_capacity; rows beyond it dropped --
+    exec layer must retry with a bigger bucket).
+
+    Hash routing matches the reference's HashPartitionFunction: workers
+    see disjoint key sets, so downstream per-worker group-by/join is
+    exact (SystemPartitioningHandle FIXED_HASH_DISTRIBUTION).
+    """
+    n = jax.lax.psum(1, axis_name)
+    cap = batch.capacity
+    h = _row_hash([batch.column(c) for c in key_channels])
+    dest = (h % jnp.uint64(n)).astype(jnp.int32)
+    dest = jnp.where(batch.active, dest, n)  # inactive rows -> dropped bucket
+
+    # slot within destination bucket: rank among same-dest rows
+    order = jax.lax.sort([dest, jnp.arange(cap, dtype=jnp.int32)], num_keys=1)
+    s_dest, perm = order
+    bucket_start = jnp.searchsorted(s_dest, jnp.arange(n + 1, dtype=jnp.int32))
+    pos_in_sorted = jnp.arange(cap, dtype=jnp.int32)
+    slot = pos_in_sorted - bucket_start[jnp.clip(s_dest, 0, n)]
+    counts = bucket_start[1:] - bucket_start[:-1]  # per-dest counts (n,)
+    overflow = jnp.any(counts > slot_capacity)
+
+    send_rows = n * slot_capacity
+    flat = jnp.clip(s_dest, 0, n - 1) * slot_capacity + jnp.clip(slot, 0, slot_capacity - 1)
+    keep = (s_dest < n) & (slot < slot_capacity)
+    # dropped/overflowed rows park in an extra scratch slot that is
+    # sliced away -- never a real slot (scatter order is unspecified)
+    idx = jnp.where(keep, flat, send_rows)
+
+    def pack(arr):
+        # arr: (cap, ...) in original row order -> (send_rows, ...) bucketed
+        src = arr[perm]
+        zeros = jnp.zeros((send_rows + 1,) + arr.shape[1:], dtype=arr.dtype)
+        return zeros.at[idx].set(src)[:send_rows]
+
+    sent_active = jnp.zeros(send_rows + 1, dtype=bool).at[idx].set(True)[:send_rows]
+
+    def a2a(arr):
+        return jax.lax.all_to_all(arr, axis_name, split_axis=0, concat_axis=0,
+                                  tiled=True)
+
+    new_cols = tuple(_map_block(c, lambda a: a2a(pack(a))) for c in batch.columns)
+    new_active = a2a(sent_active)
+    return Batch(new_cols, new_active), overflow
+
+
+def broadcast_build(batch: Batch, axis_name: str) -> Batch:
+    """Replicate a (typically small) build-side batch to every worker:
+    the FIXED_BROADCAST_DISTRIBUTION / BroadcastOutputBuffer analog, as
+    an all_gather over ICI. Output capacity = n_workers * capacity."""
+    def ag(arr):
+        g = jax.lax.all_gather(arr, axis_name, axis=0, tiled=True)
+        return g
+    cols = tuple(_map_block(c, ag) for c in batch.columns)
+    return Batch(cols, ag(batch.active))
+
+
+def gather_to_root(batch: Batch, axis_name: str) -> Batch:
+    """Gather all workers' rows everywhere (root picks its copy): the
+    single-node SINGLE_DISTRIBUTION output stage / coordinator result
+    fetch analog."""
+    return broadcast_build(batch, axis_name)

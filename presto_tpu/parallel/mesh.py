@@ -1,0 +1,32 @@
+"""Device mesh management.
+
+Reference surface: the cluster topology side of the scheduler --
+NodeScheduler/NodePartitioningManager map stages to worker nodes; here a
+"worker" is a TPU chip on a jax.sharding.Mesh and stage-to-stage data
+movement is an XLA collective over ICI instead of HTTP (SURVEY.md §2.3
+"Distributed communication backend").
+
+Round 1 uses a 1-D mesh axis ("workers"): every plan fragment is
+data-parallel across it, matching Presto's FIXED_HASH_DISTRIBUTION of N
+tasks per stage. Multi-dim meshes (separating scan parallelism from
+exchange parallelism across ICI x DCN) layer on later without changing
+kernel code -- kernels only name the axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+WORKERS_AXIS = "workers"
+
+__all__ = ["make_mesh", "WORKERS_AXIS"]
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    devs = jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    assert n_devices <= len(devs), (n_devices, len(devs))
+    return Mesh(np.array(devs[:n_devices]), (WORKERS_AXIS,))

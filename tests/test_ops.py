@@ -1,0 +1,315 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.block import batch_from_numpy, to_numpy
+from presto_tpu.ops import (AggSpec, distinct, group_by, hash_join, limit,
+                            merge_partials, sort_batch, top_n)
+from presto_tpu.ops.join import semi_join_mask
+from presto_tpu.ops.sort import SortKey
+
+
+def col(b, i):
+    return to_numpy(b.column(i))
+
+
+def active_rows(batch, *cols_idx):
+    a = np.asarray(batch.active)
+    return [col(batch, i)[0][a] for i in cols_idx]
+
+
+# ---------------------------------------------------------------------------
+# group_by
+# ---------------------------------------------------------------------------
+
+def test_group_by_sum_count():
+    keys = np.array([3, 1, 3, 2, 1, 3], dtype=np.int64)
+    vals = np.array([10, 20, 30, 40, 50, 60], dtype=np.int64)
+    b = batch_from_numpy([T.BIGINT, T.BIGINT], [keys, vals], capacity=8)
+    r = group_by(b, [0], [AggSpec("sum", 1, T.BIGINT),
+                          AggSpec("count_star", None, T.BIGINT)], max_groups=8)
+    assert int(r.num_groups) == 3 and not bool(r.overflow)
+    k, _ = col(r.batch, 0)
+    s, _ = col(r.batch, 1)
+    c, _ = col(r.batch, 2)
+    got = {int(k[i]): (int(s[i]), int(c[i]))
+           for i in range(8) if np.asarray(r.batch.active)[i]}
+    assert got == {1: (70, 2), 2: (40, 1), 3: (100, 3)}
+
+
+def test_group_by_null_keys_and_values():
+    keys = np.array([1, 1, 2, 2], dtype=np.int64)
+    knulls = np.array([False, False, True, True])
+    vals = np.array([5, 6, 7, 8], dtype=np.int64)
+    vnulls = np.array([False, True, False, False])
+    b = batch_from_numpy([T.BIGINT, T.BIGINT], [keys, vals],
+                         nulls=[knulls, vnulls], capacity=4)
+    r = group_by(b, [0], [AggSpec("sum", 1, T.BIGINT),
+                          AggSpec("count", 1, T.BIGINT)], max_groups=4)
+    # SQL: nulls form ONE group; sum skips null inputs
+    assert int(r.num_groups) == 2
+    k, kn = col(r.batch, 0)
+    s, _ = col(r.batch, 1)
+    c, _ = col(r.batch, 2)
+    act = np.asarray(r.batch.active)
+    m = {}
+    for i in range(4):
+        if act[i]:
+            m["null" if kn[i] else int(k[i])] = (int(s[i]), int(c[i]))
+    assert m == {1: (5, 1), "null": (15, 2)}
+
+
+def test_group_by_min_max_avg_double():
+    keys = np.array([1, 2, 1, 2], dtype=np.int64)
+    vals = np.array([1.5, -2.0, 3.25, 7.0])
+    b = batch_from_numpy([T.BIGINT, T.DOUBLE], [keys, vals], capacity=8)
+    r = group_by(b, [0], [AggSpec("min", 1, T.DOUBLE),
+                          AggSpec("max", 1, T.DOUBLE),
+                          AggSpec("avg", 1, T.DOUBLE)], max_groups=4)
+    k, _ = col(r.batch, 0)
+    mn, _ = col(r.batch, 1)
+    mx, _ = col(r.batch, 2)
+    s, _ = col(r.batch, 3)
+    c, _ = col(r.batch, 4)
+    act = np.asarray(r.batch.active)
+    got = {int(k[i]): (mn[i], mx[i], s[i] / c[i]) for i in range(4) if act[i]}
+    assert got[1] == (1.5, 3.25, 2.375)
+    assert got[2] == (-2.0, 7.0, 2.5)
+
+
+def test_group_by_string_keys():
+    keys = np.array(["R", "N", "A", "N", "R"], dtype=object)
+    vals = np.arange(5, dtype=np.int64)
+    b = batch_from_numpy([T.char(1), T.BIGINT], [keys, vals], capacity=8)
+    r = group_by(b, [0], [AggSpec("sum", 1, T.BIGINT)], max_groups=8)
+    k, _ = col(r.batch, 0)
+    s, _ = col(r.batch, 1)
+    act = np.asarray(r.batch.active)
+    got = {k[i]: int(s[i]) for i in range(8) if act[i]}
+    assert got == {"R": 4, "N": 4, "A": 2}
+
+
+def test_group_by_overflow_flag():
+    keys = np.arange(100, dtype=np.int64)
+    b = batch_from_numpy([T.BIGINT], [keys])
+    r = group_by(b, [0], [AggSpec("count_star", None, T.BIGINT)], max_groups=16)
+    assert bool(r.overflow)
+
+
+def test_merge_partials():
+    # two partial tables for keys {1,2} and {2,3}
+    p1 = batch_from_numpy([T.BIGINT, T.BIGINT, T.BIGINT],
+                          [np.array([1, 2]), np.array([10, 20]), np.array([1, 2])])
+    p2 = batch_from_numpy([T.BIGINT, T.BIGINT, T.BIGINT],
+                          [np.array([2, 3]), np.array([5, 7]), np.array([1, 1])])
+    from presto_tpu.block import concat_batches
+    merged = merge_partials(concat_batches([p1, p2]), 1,
+                            [AggSpec("sum", 1, T.BIGINT),
+                             AggSpec("count_star", None, T.BIGINT)], max_groups=8)
+    k, _ = col(merged.batch, 0)
+    s, _ = col(merged.batch, 1)
+    c, _ = col(merged.batch, 2)
+    act = np.asarray(merged.batch.active)
+    got = {int(k[i]): (int(s[i]), int(c[i])) for i in range(8) if act[i]}
+    assert got == {1: (10, 1), 2: (25, 3), 3: (7, 1)}
+
+
+# ---------------------------------------------------------------------------
+# sort / topn / limit / distinct
+# ---------------------------------------------------------------------------
+
+def test_sort_asc_desc_nulls():
+    vals = np.array([5, 1, 9, 3], dtype=np.int64)
+    nulls = np.array([False, True, False, False])
+    b = batch_from_numpy([T.BIGINT], [vals], nulls=[nulls], capacity=6)
+    s = sort_batch(b, [SortKey(0)])  # ASC NULLS LAST (presto default)
+    v, n = col(s, 0)
+    act = np.asarray(s.active)
+    assert list(v[act][:2]) == [3, 5] and v[act][2] == 9 and n[act][3]
+    s = sort_batch(b, [SortKey(0, descending=True)])
+    v, n = col(s, 0)
+    act = np.asarray(s.active)
+    assert list(v[act][:3]) == [9, 5, 3] and n[act][3]
+
+
+def test_sort_multi_key_string():
+    a = np.array(["b", "a", "b", "a"], dtype=object)
+    x = np.array([2, 9, 1, 3], dtype=np.int64)
+    b = batch_from_numpy([T.varchar(1), T.BIGINT], [a, x])
+    s = sort_batch(b, [SortKey(0), SortKey(1, descending=True)])
+    av, _ = col(s, 0)
+    xv, _ = col(s, 1)
+    assert list(av) == ["a", "a", "b", "b"]
+    assert list(xv) == [9, 3, 2, 1]
+
+
+def test_top_n():
+    vals = np.array([5, 1, 9, 3, 7], dtype=np.int64)
+    b = batch_from_numpy([T.BIGINT], [vals], capacity=8)
+    t = top_n(b, [SortKey(0, descending=True)], 3)
+    v, _ = col(t, 0)
+    act = np.asarray(t.active)
+    assert list(v[act]) == [9, 7, 5]
+    assert t.capacity == 3
+
+
+def test_limit_and_distinct():
+    vals = np.array([1, 1, 2, 3, 2, 1], dtype=np.int64)
+    b = batch_from_numpy([T.BIGINT], [vals], capacity=8)
+    l = limit(b, 4)
+    assert int(l.count()) == 4
+    d = distinct(b, [0], max_groups=8)
+    v, _ = col(d, 0)
+    act = np.asarray(d.active)
+    assert sorted(v[act]) == [1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# joins
+# ---------------------------------------------------------------------------
+
+def test_inner_join_unique_build():
+    probe = batch_from_numpy([T.BIGINT, T.BIGINT],
+                             [np.array([1, 2, 3, 4]), np.array([10, 20, 30, 40])],
+                             capacity=6)
+    build = batch_from_numpy([T.BIGINT, T.BIGINT],
+                             [np.array([2, 4, 5]), np.array([200, 400, 500])],
+                             capacity=4)
+    r = hash_join(probe, build, [0], [0], out_capacity=8)
+    assert not bool(r.overflow) and int(r.num_rows) == 2
+    pk, _ = col(r.batch, 0)
+    bv, _ = col(r.batch, 3)
+    act = np.asarray(r.batch.active)
+    got = {(int(pk[i]), int(bv[i])) for i in range(8) if act[i]}
+    assert got == {(2, 200), (4, 400)}
+
+
+def test_inner_join_one_to_many():
+    probe = batch_from_numpy([T.BIGINT], [np.array([7, 8, 7])], capacity=4)
+    build = batch_from_numpy([T.BIGINT, T.BIGINT],
+                             [np.array([7, 7, 9]), np.array([70, 71, 90])],
+                             capacity=4)
+    r = hash_join(probe, build, [0], [0], out_capacity=8)
+    assert int(r.num_rows) == 4  # two probe 7s x two build 7s
+    pk, _ = col(r.batch, 0)
+    bv, _ = col(r.batch, 2)
+    act = np.asarray(r.batch.active)
+    got = sorted((int(pk[i]), int(bv[i])) for i in range(8) if act[i])
+    assert got == [(7, 70), (7, 71), (7, 70), (7, 71)] or \
+           got == sorted([(7, 70), (7, 71), (7, 70), (7, 71)])
+
+
+def test_left_join_and_null_keys():
+    probe = batch_from_numpy([T.BIGINT], [np.array([1, 2, 3])],
+                             nulls=[np.array([False, True, False])], capacity=4)
+    build = batch_from_numpy([T.BIGINT, T.BIGINT],
+                             [np.array([1, 3]), np.array([100, 300])],
+                             nulls=[np.array([False, False]), None], capacity=2)
+    r = hash_join(probe, build, [0], [0], out_capacity=8, join_type="left")
+    assert int(r.num_rows) == 3
+    pk, pn = col(r.batch, 0)
+    bv, bn = col(r.batch, 2)
+    act = np.asarray(r.batch.active)
+    rows = [(("null" if pn[i] else int(pk[i])),
+             ("null" if bn[i] else int(bv[i]))) for i in range(8) if act[i]]
+    assert sorted(rows, key=str) == sorted([(1, 100), (3, 300), ("null", "null")],
+                                           key=str)
+
+
+def test_join_overflow():
+    probe = batch_from_numpy([T.BIGINT], [np.full(4, 1, dtype=np.int64)])
+    build = batch_from_numpy([T.BIGINT], [np.full(4, 1, dtype=np.int64)])
+    r = hash_join(probe, build, [0], [0], out_capacity=8)
+    assert bool(r.overflow)  # 16 output rows > 8
+
+
+def test_semi_join():
+    probe = batch_from_numpy([T.BIGINT], [np.array([1, 2, 3, 4])])
+    build = batch_from_numpy([T.BIGINT], [np.array([2, 4, 4])])
+    m = np.asarray(semi_join_mask(probe, build, [0], [0]))
+    assert list(m) == [False, True, False, True]
+
+
+def test_join_multiword_string_key():
+    probe = batch_from_numpy([T.varchar(12)],
+                             [np.array(["alpha", "beta", "gammagammagg"], dtype=object)],
+                             capacity=4)
+    build = batch_from_numpy([T.varchar(12), T.BIGINT],
+                             [np.array(["beta", "gammagammagg"], dtype=object),
+                              np.array([1, 2])], capacity=2)
+    r = hash_join(probe, build, [0], [0], out_capacity=6)
+    assert int(r.num_rows) == 2
+    pk, _ = col(r.batch, 0)
+    bv, _ = col(r.batch, 2)
+    act = np.asarray(r.batch.active)
+    got = {(pk[i], int(bv[i])) for i in range(6) if act[i]}
+    assert got == {("beta", 1), ("gammagammagg", 2)}
+
+
+# ---------------------------------------------------------------------------
+# q1-shaped end-to-end over generated data vs numpy oracle
+# ---------------------------------------------------------------------------
+
+def test_q1_pipeline_vs_oracle():
+    from presto_tpu.connectors import tpch
+    from presto_tpu.expr import call, compile_filter, compile_projections, \
+        const, input_ref
+
+    n = 20000
+    cols = ["returnflag", "linestatus", "quantity", "extendedprice",
+            "discount", "shipdate"]
+    batch = tpch.generate_batch("lineitem", 0.01, cols, count=n,
+                                capacity=1 << 15)
+    d2 = T.decimal(12, 2)
+    cutoff = const("1998-09-02", T.DATE)
+    filt = compile_filter(call("le", T.BOOLEAN, input_ref(5, T.DATE), cutoff))
+    # project: rf, ls, qty, price, disc_price = price*(1-disc)
+    proj = compile_projections([
+        input_ref(0, T.char(1)), input_ref(1, T.char(1)),
+        input_ref(2, d2), input_ref(3, d2),
+        call("multiply", T.decimal(24, 4), input_ref(3, d2),
+             call("subtract", d2, const(100, d2), input_ref(4, d2))),
+    ])
+
+    def pipeline(b):
+        b = filt(b)
+        b = proj(b)
+        return group_by(b, [0, 1], [
+            AggSpec("sum", 2, T.decimal(38, 2)),
+            AggSpec("sum", 4, T.decimal(38, 4)),
+            AggSpec("avg", 3, d2),
+            AggSpec("count_star", None, T.BIGINT)], max_groups=16)
+
+    r = jax.jit(pipeline)(batch)
+
+    # numpy oracle
+    c = tpch.generate_columns("lineitem", 0.01, cols, count=n)
+    epoch = np.datetime64("1970-01-01")
+    m = c["shipdate"] <= int((np.datetime64("1998-09-02") - epoch).astype(int))
+    import collections
+    want = collections.defaultdict(lambda: [0, 0, 0, 0])
+    for i in np.nonzero(m)[0]:
+        key = (c["returnflag"][i], c["linestatus"][i])
+        w = want[key]
+        w[0] += int(c["quantity"][i])
+        w[1] += int(c["extendedprice"][i]) * (100 - int(c["discount"][i]))
+        w[2] += int(c["extendedprice"][i])
+        w[3] += 1
+
+    rf, _ = col(r.batch, 0)
+    ls, _ = col(r.batch, 1)
+    sq, _ = col(r.batch, 2)
+    sdp, _ = col(r.batch, 3)
+    sp, _ = col(r.batch, 4)
+    cp, _ = col(r.batch, 5)
+    cnt, _ = col(r.batch, 6)
+    act = np.asarray(r.batch.active)
+    got = {}
+    for i in range(16):
+        if act[i]:
+            got[(rf[i], ls[i])] = [int(sq[i]), int(sdp[i]), int(sp[i]), int(cnt[i])]
+    assert set(got) == set(want)
+    for k in want:
+        assert got[k] == want[k], (k, got[k], want[k])
