@@ -100,7 +100,9 @@ def q1_distributed(mesh) -> Callable[[Batch], Tuple["GroupByResult", jnp.ndarray
 Q6_COLUMNS = ["shipdate", "discount", "quantity", "extendedprice"]
 
 
-def q6_local() -> Callable[[Batch], "GroupByResult"]:
+def q6_local() -> Callable[[Batch], jnp.ndarray]:
+    """Returns fn(batch) -> scalar revenue sum (global aggregation has a
+    single group; no group table is built)."""
     ship = input_ref(0, T.DATE)
     disc, qty, price = input_ref(1, D2), input_ref(2, D2), input_ref(3, D2)
     filt = compile_filter(special(
@@ -110,14 +112,10 @@ def q6_local() -> Callable[[Batch], "GroupByResult"]:
         special("BETWEEN", T.BOOLEAN, disc, const(5, D2), const(7, D2)),
         call("lt", T.BOOLEAN, qty, const(2400, D2))))
     proj = compile_projections([call("multiply", T.decimal(24, 4), price, disc)])
-    aggs = [AggSpec("sum", 0, T.decimal(38, 4))]
 
     def run(batch: Batch):
         b = proj(filt(batch))
-        # global aggregation: no keys -> single group. Model as group-by
-        # over a constant channel by reusing the revenue column's null
-        # flag? Simpler: group over zero key channels is not supported by
-        # _group_ids, so use a 1-slot dense sum directly.
+        # global aggregation (no keys -> one group): a direct masked sum
         vals = b.column(0)
         live = b.active & ~vals.nulls
         s = jnp.sum(jnp.where(live, vals.values, 0))

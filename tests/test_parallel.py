@@ -39,7 +39,6 @@ def test_exchange_by_hash_partitions_and_preserves_rows(mesh8):
     # every original row arrives exactly once
     assert sorted(v[act]) == list(range(total))
     # rows with equal keys land on the same worker shard
-    per_shard = out.capacity // 1  # global view: shard size = capacity/8
     shard_of = np.arange(out.capacity) // (out.capacity // 8)
     key_shards = collections.defaultdict(set)
     for i in np.nonzero(act)[0]:
@@ -146,7 +145,9 @@ def test_q1_distributed_matches_q1_local(mesh8):
         for i in range(r.batch.capacity):
             if act[i]:
                 key = (col(r.batch, 0)[0][i], col(r.batch, 1)[0][i])
-                out[key] = tuple(int(col(r.batch, c)[0][i]) for c in range(2, 11))
+                # all 11 aggregate state columns: 4 sums, 3 (sum,count)
+                # avg pairs, count_star
+                out[key] = tuple(int(col(r.batch, c)[0][i]) for c in range(2, 13))
         return out
 
     assert table(local) == table(dist)
