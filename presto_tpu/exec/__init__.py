@@ -1,0 +1,4 @@
+from .planner import compile_plan, CompiledPlan
+from .runner import run_query, QueryResult
+
+__all__ = ["compile_plan", "CompiledPlan", "run_query", "QueryResult"]

@@ -1,0 +1,85 @@
+"""ctypes bridge to the native serde kernels, with numpy fallbacks.
+
+The shared library is built by `make -C presto_tpu/native` (attempted
+once automatically); when unavailable, vectorized numpy implements the
+same contracts so the engine is pure-Python runnable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libserde_kernels.so")
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO):
+        try:
+            subprocess.run(["make", "-C", _DIR], capture_output=True,
+                           timeout=60, check=False)
+        except Exception:
+            pass
+    if os.path.exists(_SO):
+        try:
+            lib = ctypes.CDLL(_SO)
+            lib.pack_nonnull.restype = ctypes.c_int64
+            lib.pack_nonnull.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                         ctypes.c_int64, ctypes.c_int32,
+                                         ctypes.c_char_p]
+            lib.unpack_nonnull.restype = None
+            lib.unpack_nonnull.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                           ctypes.c_int64, ctypes.c_int32,
+                                           ctypes.c_char_p]
+            _lib = lib
+        except OSError:
+            _lib = None
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def pack_nonnull(values: np.ndarray, nulls: np.ndarray) -> bytes:
+    """Dense bytes of values at non-null rows."""
+    values = np.ascontiguousarray(values)
+    nulls = np.ascontiguousarray(nulls, dtype=np.uint8)
+    lib = _load()
+    if lib is None or values.ndim != 1:
+        return values[~nulls.astype(bool)].tobytes()
+    width = values.dtype.itemsize
+    rows = values.shape[0]
+    out = ctypes.create_string_buffer(rows * width)
+    n = lib.pack_nonnull(values.ctypes.data_as(ctypes.c_char_p),
+                         nulls.ctypes.data_as(ctypes.c_char_p),
+                         rows, width, out)
+    return out.raw[: n * width]
+
+
+def unpack_nonnull(packed: np.ndarray, nulls: np.ndarray) -> np.ndarray:
+    """Spread dense non-null values back to full rows (zeros at nulls)."""
+    nulls_b = np.ascontiguousarray(nulls, dtype=np.uint8)
+    packed = np.ascontiguousarray(packed)
+    rows = nulls_b.shape[0]
+    lib = _load()
+    if lib is None:
+        out = np.zeros(rows, dtype=packed.dtype)
+        out[~nulls_b.astype(bool)] = packed
+        return out
+    width = packed.dtype.itemsize
+    out = np.zeros(rows, dtype=packed.dtype)
+    lib.unpack_nonnull(packed.ctypes.data_as(ctypes.c_char_p),
+                       nulls_b.ctypes.data_as(ctypes.c_char_p),
+                       rows, width, out.ctypes.data_as(ctypes.c_char_p))
+    return out

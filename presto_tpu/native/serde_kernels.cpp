@@ -1,0 +1,59 @@
+// Native serde kernels: the hot byte-shuffling loops of the
+// SerializedPage codec (pack/unpack non-null values, LZ4-style block
+// framing arrives later).
+//
+// Reference surface: the reference's native worker does its page
+// serialization in C++ (presto-native-execution/presto_cpp wraps
+// Velox's PrestoSerializer); this library is the analog for the
+// Python/ctypes shell: presto_tpu/serde/pages.py dispatches here when
+// built (see presto_tpu/native/kernels.py), with numpy fallbacks.
+//
+// Build: make -C presto_tpu/native
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Copy the `width`-byte values of rows whose null flag is 0 into `out`,
+// densely. Returns the number of non-null rows.
+int64_t pack_nonnull(const char* values, const uint8_t* nulls, int64_t rows,
+                     int32_t width, char* out) {
+    int64_t w = 0;
+    for (int64_t i = 0; i < rows; ++i) {
+        if (!nulls[i]) {
+            std::memcpy(out + w * width, values + i * width, width);
+            ++w;
+        }
+    }
+    return w;
+}
+
+// Inverse: spread `packed` (dense non-null values) to full row positions,
+// zero-filling null slots.
+void unpack_nonnull(const char* packed, const uint8_t* nulls, int64_t rows,
+                    int32_t width, char* out) {
+    int64_t r = 0;
+    for (int64_t i = 0; i < rows; ++i) {
+        if (nulls[i]) {
+            std::memset(out + i * width, 0, width);
+        } else {
+            std::memcpy(out + i * width, packed + r * width, width);
+            ++r;
+        }
+    }
+}
+
+// Gather variable-width slices [starts[i], ends[i]) of `blob` into a
+// dense output; used by VARIABLE_WIDTH encode of padded char matrices.
+void gather_slices(const char* blob, const int32_t* starts,
+                   const int32_t* ends, int64_t rows, char* out) {
+    int64_t w = 0;
+    for (int64_t i = 0; i < rows; ++i) {
+        int32_t len = ends[i] - starts[i];
+        std::memcpy(out + w, blob + starts[i], len);
+        w += len;
+    }
+}
+
+}  // extern "C"

@@ -1,0 +1,10 @@
+from .nodes import (PlanNode, TableScanNode, ValuesNode, FilterNode,
+                    ProjectNode, AggregationNode, JoinNode, SemiJoinNode,
+                    SortNode, TopNNode, LimitNode, DistinctNode, ExchangeNode,
+                    OutputNode, from_json, to_json)
+from .fragment import PlanFragment, fragment_plan
+
+__all__ = ["PlanNode", "TableScanNode", "ValuesNode", "FilterNode",
+           "ProjectNode", "AggregationNode", "JoinNode", "SemiJoinNode",
+           "SortNode", "TopNNode", "LimitNode", "DistinctNode", "ExchangeNode",
+           "OutputNode", "from_json", "to_json", "PlanFragment", "fragment_plan"]

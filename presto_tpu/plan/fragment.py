@@ -1,0 +1,63 @@
+"""Plan fragmentation: stage boundaries at remote exchanges.
+
+Reference surface: sql/planner/PlanFragmenter.java:48 /
+BasePlanFragmenter.java:105 -- split the optimized plan at REMOTE
+ExchangeNodes into PlanFragments, each scheduled as a stage of tasks.
+
+In this engine all fragments of a query are gang-compiled into ONE SPMD
+program (exchanges become collectives), so fragments exist for protocol
+parity (JSON, per-stage introspection, future cross-slice DCN
+execution) rather than as independently scheduled units. fragment_plan
+records the exchange edges; exec.compile_plan consumes the whole tree
+directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Tuple
+
+from .nodes import ExchangeNode, PlanNode, to_json
+
+__all__ = ["PlanFragment", "fragment_plan"]
+
+
+@dataclasses.dataclass
+class PlanFragment:
+    id: int
+    root: PlanNode
+    # partitioning of this fragment's execution (SOURCE for leaf scans,
+    # HASH for intermediate, SINGLE/replicated for the output stage)
+    partitioning: str
+    # ids of fragments feeding this one through remote exchanges
+    remote_sources: List[int]
+
+    def to_json(self) -> dict:
+        return {"id": self.id, "partitioning": self.partitioning,
+                "remoteSources": self.remote_sources,
+                "root": to_json(self.root)}
+
+
+def fragment_plan(root: PlanNode) -> List[PlanFragment]:
+    """Walk the tree, cutting at REMOTE exchanges (child side becomes a
+    new fragment). Returns fragments root-last, ids in creation order."""
+    fragments: List[PlanFragment] = []
+
+    def walk(node: PlanNode) -> Tuple[PlanNode, List[int]]:
+        feeds: List[int] = []
+        if isinstance(node, ExchangeNode) and node.scope == "REMOTE":
+            child, child_feeds = walk(node.source)
+            part = ("HASH" if node.kind == "REPARTITION" else
+                    "BROADCAST" if node.kind == "REPLICATE" else "SINGLE")
+            frag = PlanFragment(len(fragments), child, part, child_feeds)
+            fragments.append(frag)
+            feeds.append(frag.id)
+            return node, feeds
+        for s in node.sources:
+            _, f = walk(s)
+            feeds.extend(f)
+        return node, feeds
+
+    _, feeds = walk(root)
+    fragments.append(PlanFragment(len(fragments), root, "SINGLE", feeds))
+    return fragments

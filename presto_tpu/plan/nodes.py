@@ -1,0 +1,363 @@
+"""Plan nodes: the worker-visible plan vocabulary.
+
+Reference surface: presto-spi/.../spi/plan/ (67 public plan-node files --
+TableScanNode, FilterNode, ProjectNode, AggregationNode, JoinNode,
+SemiJoinNode, SortNode, TopNNode, LimitNode, DistinctLimitNode,
+ExchangeNode, ValuesNode, OutputNode...) which every worker deserializes
+from PlanFragment JSON (the C++ worker mirrors them in generated
+presto_protocol_core structs).
+
+Differences from the reference, by design:
+  * Symbols are already resolved to channel indices (the reference ships
+    VariableReferenceExpressions + layout maps; resolving them is
+    coordinator-side bookkeeping that a worker redoes -- here the
+    protocol adapter will do it once at ingest).
+  * Aggregations carry explicit step (PARTIAL/FINAL/SINGLE) like the
+    reference's AggregationNode.Step.
+  * TableScanNode names a connector table + column list; the split is
+    supplied at execution time (ConnectorSplit analog).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .. import types as T
+from ..expr import ir as E
+from ..ops.aggregation import AggSpec
+
+__all__ = ["PlanNode", "TableScanNode", "ValuesNode", "FilterNode",
+           "ProjectNode", "AggregationNode", "JoinNode", "SemiJoinNode",
+           "SortNode", "TopNNode", "LimitNode", "DistinctNode",
+           "ExchangeNode", "OutputNode", "to_json", "from_json"]
+
+
+_next_id = [0]
+
+
+def _nid() -> str:
+    _next_id[0] += 1
+    return str(_next_id[0])
+
+
+@dataclasses.dataclass
+class PlanNode:
+    id: str = dataclasses.field(default_factory=_nid, kw_only=True)
+
+    @property
+    def sources(self) -> Tuple["PlanNode", ...]:
+        return ()
+
+    def output_types(self) -> List[T.Type]:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class TableScanNode(PlanNode):
+    connector: str
+    table: str
+    columns: List[str]
+    column_types: List[T.Type]
+
+    def output_types(self):
+        return list(self.column_types)
+
+
+@dataclasses.dataclass
+class ValuesNode(PlanNode):
+    types: List[T.Type]
+    rows: List[List[object]]
+
+    def output_types(self):
+        return list(self.types)
+
+
+@dataclasses.dataclass
+class FilterNode(PlanNode):
+    source: PlanNode
+    predicate: E.RowExpression
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_types(self):
+        return self.source.output_types()
+
+
+@dataclasses.dataclass
+class ProjectNode(PlanNode):
+    source: PlanNode
+    expressions: List[E.RowExpression]
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_types(self):
+        return [e.type for e in self.expressions]
+
+
+@dataclasses.dataclass
+class AggregationNode(PlanNode):
+    source: PlanNode
+    group_channels: List[int]
+    aggregates: List[AggSpec]
+    step: str = "SINGLE"  # SINGLE | PARTIAL | FINAL
+    max_groups: int = 1 << 16
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_types(self):
+        src = self.source.output_types()
+        out = [src[c] for c in self.group_channels]
+        from ..ops.aggregation import _sum_type
+        for a in self.aggregates:
+            if a.name == "avg":  # (sum, count) state pair at every step
+                out.extend([_sum_type(src[a.input_channel]), T.BIGINT])
+            else:
+                out.append(a.output_type)
+        return out
+
+
+@dataclasses.dataclass
+class JoinNode(PlanNode):
+    left: PlanNode
+    right: PlanNode
+    left_keys: List[int]
+    right_keys: List[int]
+    join_type: str = "inner"          # inner | left
+    distribution: str = "partitioned"  # partitioned | broadcast (REPLICATED)
+    right_output_channels: Optional[List[int]] = None
+    out_capacity: Optional[int] = None
+
+    @property
+    def sources(self):
+        return (self.left, self.right)
+
+    def output_types(self):
+        lt = self.left.output_types()
+        rt = self.right.output_types()
+        chans = self.right_output_channels
+        if chans is None:
+            chans = list(range(len(rt)))
+        return lt + [rt[c] for c in chans]
+
+
+@dataclasses.dataclass
+class SemiJoinNode(PlanNode):
+    source: PlanNode
+    filtering_source: PlanNode
+    source_key: int
+    filtering_key: int
+    negate: bool = False  # True => anti join semantics when filtered on
+
+    @property
+    def sources(self):
+        return (self.source, self.filtering_source)
+
+    def output_types(self):
+        return self.source.output_types() + [T.BOOLEAN]
+
+
+@dataclasses.dataclass
+class SortNode(PlanNode):
+    source: PlanNode
+    keys: List[Tuple[int, bool, bool]]  # (channel, descending, nulls_last)
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_types(self):
+        return self.source.output_types()
+
+
+@dataclasses.dataclass
+class TopNNode(PlanNode):
+    source: PlanNode
+    keys: List[Tuple[int, bool, bool]]
+    count: int
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_types(self):
+        return self.source.output_types()
+
+
+@dataclasses.dataclass
+class LimitNode(PlanNode):
+    source: PlanNode
+    count: int
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_types(self):
+        return self.source.output_types()
+
+
+@dataclasses.dataclass
+class DistinctNode(PlanNode):
+    """DISTINCT over all channels (MarkDistinct/DistinctLimit analog)."""
+    source: PlanNode
+    key_channels: Optional[List[int]] = None
+    max_groups: int = 1 << 16
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_types(self):
+        return self.source.output_types()
+
+
+@dataclasses.dataclass
+class ExchangeNode(PlanNode):
+    """scope REMOTE => stage boundary (collective over the mesh);
+    scope LOCAL => no-op in this engine (XLA fuses local pipelines).
+    kind: REPARTITION (hash by partition_channels), REPLICATE
+    (broadcast), GATHER (to single/replicated)."""
+    source: PlanNode
+    kind: str = "REPARTITION"
+    scope: str = "REMOTE"
+    partition_channels: List[int] = dataclasses.field(default_factory=list)
+    slot_capacity: Optional[int] = None
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_types(self):
+        return self.source.output_types()
+
+
+@dataclasses.dataclass
+class OutputNode(PlanNode):
+    source: PlanNode
+    names: List[str]
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_types(self):
+        return self.source.output_types()
+
+
+# ---------------------------------------------------------------------------
+# JSON (PlanFragment wire shape analog)
+# ---------------------------------------------------------------------------
+
+def _agg_to_json(a: AggSpec) -> dict:
+    return {"name": a.name, "input": a.input_channel, "type": str(a.output_type)}
+
+
+def _agg_from_json(j: dict) -> AggSpec:
+    return AggSpec(j["name"], j["input"], T.parse_type(j["type"]))
+
+
+def to_json(n: PlanNode) -> dict:
+    base = {"id": n.id}
+    if isinstance(n, TableScanNode):
+        return {**base, "@type": "tablescan", "connector": n.connector,
+                "table": n.table, "columns": n.columns,
+                "columnTypes": [str(t) for t in n.column_types]}
+    if isinstance(n, ValuesNode):
+        return {**base, "@type": "values", "types": [str(t) for t in n.types],
+                "rows": n.rows}
+    if isinstance(n, FilterNode):
+        return {**base, "@type": "filter", "source": to_json(n.source),
+                "predicate": E.to_json(n.predicate)}
+    if isinstance(n, ProjectNode):
+        return {**base, "@type": "project", "source": to_json(n.source),
+                "expressions": [E.to_json(e) for e in n.expressions]}
+    if isinstance(n, AggregationNode):
+        return {**base, "@type": "aggregation", "source": to_json(n.source),
+                "groupChannels": n.group_channels,
+                "aggregates": [_agg_to_json(a) for a in n.aggregates],
+                "step": n.step, "maxGroups": n.max_groups}
+    if isinstance(n, JoinNode):
+        return {**base, "@type": "join", "left": to_json(n.left),
+                "right": to_json(n.right), "leftKeys": n.left_keys,
+                "rightKeys": n.right_keys, "joinType": n.join_type,
+                "distribution": n.distribution,
+                "rightOutputChannels": n.right_output_channels,
+                "outCapacity": n.out_capacity}
+    if isinstance(n, SemiJoinNode):
+        return {**base, "@type": "semijoin", "source": to_json(n.source),
+                "filteringSource": to_json(n.filtering_source),
+                "sourceKey": n.source_key, "filteringKey": n.filtering_key,
+                "negate": n.negate}
+    if isinstance(n, SortNode):
+        return {**base, "@type": "sort", "source": to_json(n.source),
+                "keys": [list(k) for k in n.keys]}
+    if isinstance(n, TopNNode):
+        return {**base, "@type": "topn", "source": to_json(n.source),
+                "keys": [list(k) for k in n.keys], "count": n.count}
+    if isinstance(n, LimitNode):
+        return {**base, "@type": "limit", "source": to_json(n.source),
+                "count": n.count}
+    if isinstance(n, DistinctNode):
+        return {**base, "@type": "distinct", "source": to_json(n.source),
+                "keyChannels": n.key_channels, "maxGroups": n.max_groups}
+    if isinstance(n, ExchangeNode):
+        return {**base, "@type": "exchange", "source": to_json(n.source),
+                "kind": n.kind, "scope": n.scope,
+                "partitionChannels": n.partition_channels,
+                "slotCapacity": n.slot_capacity}
+    if isinstance(n, OutputNode):
+        return {**base, "@type": "output", "source": to_json(n.source),
+                "names": n.names}
+    raise TypeError(type(n))
+
+
+def from_json(j: dict) -> PlanNode:
+    t = j["@type"]
+    nid = j.get("id", None)
+    kw = {"id": nid} if nid else {}
+    if t == "tablescan":
+        return TableScanNode(j["connector"], j["table"], j["columns"],
+                             [T.parse_type(s) for s in j["columnTypes"]], **kw)
+    if t == "values":
+        return ValuesNode([T.parse_type(s) for s in j["types"]], j["rows"], **kw)
+    if t == "filter":
+        return FilterNode(from_json(j["source"]), E.from_json(j["predicate"]), **kw)
+    if t == "project":
+        return ProjectNode(from_json(j["source"]),
+                           [E.from_json(e) for e in j["expressions"]], **kw)
+    if t == "aggregation":
+        return AggregationNode(from_json(j["source"]), j["groupChannels"],
+                               [_agg_from_json(a) for a in j["aggregates"]],
+                               j["step"], j["maxGroups"], **kw)
+    if t == "join":
+        return JoinNode(from_json(j["left"]), from_json(j["right"]),
+                        j["leftKeys"], j["rightKeys"], j["joinType"],
+                        j["distribution"], j["rightOutputChannels"],
+                        j["outCapacity"], **kw)
+    if t == "semijoin":
+        return SemiJoinNode(from_json(j["source"]), from_json(j["filteringSource"]),
+                            j["sourceKey"], j["filteringKey"], j["negate"], **kw)
+    if t == "sort":
+        return SortNode(from_json(j["source"]),
+                        [tuple(k) for k in j["keys"]], **kw)
+    if t == "topn":
+        return TopNNode(from_json(j["source"]), [tuple(k) for k in j["keys"]],
+                        j["count"], **kw)
+    if t == "limit":
+        return LimitNode(from_json(j["source"]), j["count"], **kw)
+    if t == "distinct":
+        return DistinctNode(from_json(j["source"]), j["keyChannels"],
+                            j["maxGroups"], **kw)
+    if t == "exchange":
+        return ExchangeNode(from_json(j["source"]), j["kind"], j["scope"],
+                            j["partitionChannels"], j["slotCapacity"], **kw)
+    if t == "output":
+        return OutputNode(from_json(j["source"]), j["names"], **kw)
+    raise ValueError(f"unknown plan node {t!r}")

@@ -1,0 +1,5 @@
+from .pages import (serialize_page, deserialize_page, PageCodec,
+                    serialize_batch, deserialize_to_arrays)
+
+__all__ = ["serialize_page", "deserialize_page", "PageCodec",
+           "serialize_batch", "deserialize_to_arrays"]

@@ -1,0 +1,292 @@
+"""SerializedPage wire format: the exchange/spool byte contract.
+
+Reference surface: presto-spi/.../spi/page/PagesSerde.java,
+SerializedPage.java:26, PagesSerdeUtil.java:64,79 and the public format
+specification presto-docs/src/main/sphinx/develop/serialized-page.rst
+(implemented here from that spec, not from the Java code):
+
+  header: rows(i32) codec(u8: 1=compressed 2=encrypted 4=checksummed)
+          uncompressed_size(i32) size(i32) checksum(u64-le)
+  then:   column_count(i32), per column: name_len(i32) + encoding name
+          + encoding-specific payload.
+
+Checksum is CRC32 over [payload, codec, rows, uncompressed_size] per the
+spec. Compression algorithm is out-of-band cluster config in the
+reference (PagesSerdeFactory LZ4/GZIP/ZSTD); this build supports
+zstd (the `zstandard` wheel is in-image) and zlib; LZ4 arrives with the
+native serde kernels.
+
+Encodings: BYTE/SHORT/INT/LONG/INT128_ARRAY, VARIABLE_WIDTH, DICTIONARY,
+RLE. Nested ARRAY/MAP/ROW land with nested-type Block support.
+
+Hot packing loops (non-null compaction, null bitpacking, varwidth
+concat) dispatch to the C++ kernels in presto_tpu/native when built
+(ctypes), else vectorized numpy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+import zlib
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..block import Batch, Block, Column, DictionaryColumn, StringColumn, to_numpy
+from ..native import kernels as nk
+
+__all__ = ["PageCodec", "serialize_page", "deserialize_page",
+           "serialize_batch", "deserialize_to_arrays"]
+
+_COMPRESSED = 1
+_ENCRYPTED = 2
+_CHECKSUMMED = 4
+
+_FIXED_ENC = {1: b"BYTE_ARRAY", 2: b"SHORT_ARRAY", 4: b"INT_ARRAY",
+              8: b"LONG_ARRAY", 16: b"INT128_ARRAY"}
+_ENC_WIDTH = {v: k for k, v in _FIXED_ENC.items()}
+
+
+@dataclasses.dataclass
+class PageCodec:
+    compression: Optional[str] = None  # None | "zstd" | "zlib"
+    checksum: bool = True
+
+    def compress(self, payload: bytes) -> bytes:
+        if self.compression == "zstd":
+            import zstandard
+            return zstandard.ZstdCompressor().compress(payload)
+        if self.compression == "zlib":
+            return zlib.compress(payload)
+        raise ValueError(self.compression)
+
+    def decompress(self, payload: bytes, uncompressed_size: int) -> bytes:
+        if self.compression == "zstd":
+            import zstandard
+            return zstandard.ZstdDecompressor().decompress(
+                payload, max_output_size=uncompressed_size)
+        if self.compression == "zlib":
+            return zlib.decompress(payload)
+        raise ValueError(self.compression)
+
+
+def _bitpack_nulls(nulls: np.ndarray) -> bytes:
+    """has-nulls byte + big-endian-bit packed null flags (spec: first
+    flag of each byte is the high bit)."""
+    if not nulls.any():
+        return b"\x00"
+    return b"\x01" + np.packbits(nulls.astype(np.uint8)).tobytes()
+
+
+def _bitunpack_nulls(buf: memoryview, pos: int, rows: int
+                     ) -> Tuple[np.ndarray, int]:
+    has = buf[pos]
+    pos += 1
+    if not has:
+        return np.zeros(rows, dtype=bool), pos
+    nbytes = (rows + 7) // 8
+    bits = np.unpackbits(np.frombuffer(buf[pos:pos + nbytes], dtype=np.uint8))
+    return bits[:rows].astype(bool), pos + nbytes
+
+
+def _fixed_dtype(width: int, ty: Optional[T.Type]) -> np.dtype:
+    if ty is not None:
+        return ty.to_dtype()
+    return {1: np.int8, 2: np.int16, 4: np.int32, 8: np.int64}[width]
+
+
+def _serialize_fixed(values: np.ndarray, nulls: np.ndarray) -> bytes:
+    width = values.dtype.itemsize
+    if values.dtype == np.bool_:
+        width = 1
+        values = values.astype(np.int8)
+    enc = _FIXED_ENC[width]
+    out = [struct.pack("<i", len(enc)), enc,
+           struct.pack("<i", values.shape[0]),
+           _bitpack_nulls(nulls),
+           nk.pack_nonnull(values, nulls)]
+    return b"".join(out)
+
+
+def _serialize_varwidth(vals: np.ndarray, nulls: np.ndarray) -> bytes:
+    """vals: object array of str/bytes."""
+    rows = len(vals)
+    encoded = [b"" if (nulls[i] or vals[i] is None)
+               else (vals[i].encode("utf-8") if isinstance(vals[i], str)
+                     else bytes(vals[i]))
+               for i in range(rows)]
+    lengths = np.array([len(b) for b in encoded], dtype=np.int64)
+    offsets = np.cumsum(lengths).astype(np.int32)  # spec: end offsets per row
+    blob = b"".join(encoded)
+    enc = b"VARIABLE_WIDTH"
+    return b"".join([
+        struct.pack("<i", len(enc)), enc,
+        struct.pack("<i", rows),
+        offsets.tobytes(),
+        _bitpack_nulls(np.asarray(nulls, dtype=bool)),
+        struct.pack("<i", len(blob)),
+        blob])
+
+
+def _serialize_block(block: Block) -> bytes:
+    if isinstance(block, DictionaryColumn):
+        rows = len(block)
+        inner = _serialize_block(block.dictionary)
+        enc = b"DICTIONARY"
+        idx = np.asarray(block.indices, dtype=np.int32)
+        # 24-byte dictionary id (instance ids in the reference; zeros here)
+        return b"".join([struct.pack("<i", len(enc)), enc,
+                         struct.pack("<i", rows), inner, idx.tobytes(),
+                         b"\x00" * 24])
+    v, n = to_numpy(block)
+    if isinstance(block, StringColumn):
+        return _serialize_varwidth(v, n)
+    return _serialize_fixed(v, n)
+
+
+def serialize_batch(batch: Batch, codec: PageCodec = PageCodec()) -> bytes:
+    """Serialize the ACTIVE rows of a device Batch (compacts padding --
+    the wire format is the dense world; masks are an on-device concept)."""
+    act = np.asarray(batch.active)
+    idx = np.nonzero(act)[0]
+    cols = []
+    for c in range(batch.num_columns):
+        v, n = to_numpy(batch.column(c))
+        ty = batch.column(c).type
+        cols.append((ty, v[idx], n[idx]))
+    return serialize_page(cols, codec)
+
+
+def serialize_page(columns: Sequence[Tuple[T.Type, np.ndarray, np.ndarray]],
+                   codec: PageCodec = PageCodec()) -> bytes:
+    rows = len(columns[0][1]) if columns else 0
+    body = [struct.pack("<i", len(columns))]
+    for ty, vals, nulls in columns:
+        if ty.is_string:
+            body.append(_serialize_varwidth(vals, nulls))
+        else:
+            body.append(_serialize_fixed(np.asarray(vals, dtype=ty.to_dtype()),
+                                         np.asarray(nulls, dtype=bool)))
+    payload = b"".join(body)
+    uncompressed = len(payload)
+    flags = 0
+    if codec.compression:
+        compressed = codec.compress(payload)
+        if len(compressed) < uncompressed:
+            payload = compressed
+            flags |= _COMPRESSED
+    checksum = 0
+    if codec.checksum:
+        flags |= _CHECKSUMMED
+        checksum = _checksum(payload, flags, rows, uncompressed)
+    header = struct.pack("<iBiiq", rows, flags, uncompressed, len(payload),
+                         checksum)
+    return header + payload
+
+
+def _checksum(payload: bytes, codec_flags: int, rows: int,
+              uncompressed: int) -> int:
+    crc = zlib.crc32(payload)
+    crc = zlib.crc32(struct.pack("<B", codec_flags), crc)
+    crc = zlib.crc32(struct.pack("<i", rows), crc)
+    crc = zlib.crc32(struct.pack("<i", uncompressed), crc)
+    return crc
+
+
+def deserialize_page(buf: bytes, types: Sequence[T.Type],
+                     codec: PageCodec = PageCodec()
+                     ) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """-> [(values, nulls)] per column. `types` guide dtype mapping
+    (the wire encoding alone cannot distinguish e.g. BIGINT from DOUBLE)."""
+    rows, flags, uncompressed, size, checksum = struct.unpack_from("<iBiiq", buf)
+    payload = bytes(memoryview(buf)[21:21 + size])
+    if flags & _CHECKSUMMED:
+        want = _checksum(payload, flags, rows, uncompressed)
+        if want != checksum:
+            raise ValueError(f"page checksum mismatch: {want} != {checksum}")
+    if flags & _ENCRYPTED:
+        raise NotImplementedError("encrypted pages")
+    if flags & _COMPRESSED:
+        payload = codec.decompress(payload, uncompressed)
+    mv = memoryview(payload)
+    (ncols,) = struct.unpack_from("<i", mv, 0)
+    pos = 4
+    out = []
+    for ci in range(ncols):
+        ty = types[ci] if ci < len(types) else None
+        (vals, nulls), pos = _deserialize_block(mv, pos, ty)
+        out.append((vals, nulls))
+    return out
+
+
+def _deserialize_block(mv: memoryview, pos: int, ty: Optional[T.Type]):
+    (name_len,) = struct.unpack_from("<i", mv, pos)
+    pos += 4
+    enc = bytes(mv[pos:pos + name_len])
+    pos += name_len
+    if enc in _ENC_WIDTH:
+        width = _ENC_WIDTH[enc]
+        (rows,) = struct.unpack_from("<i", mv, pos)
+        pos += 4
+        nulls, pos = _bitunpack_nulls(mv, pos, rows)
+        n_nonnull = rows - int(nulls.sum())
+        dt = _fixed_dtype(width, ty)
+        if width == 16:
+            # INT128_ARRAY -> int64 lanes (round-1 long-decimal repr):
+            # values are (lo, hi) u64 pairs; accept only those that fit
+            pairs = np.frombuffer(mv[pos:pos + n_nonnull * 16],
+                                  dtype=np.int64).reshape(-1, 2)
+            lo, hi = pairs[:, 0], pairs[:, 1]
+            if not np.array_equal(hi, lo >> 63):
+                raise NotImplementedError(
+                    "INT128_ARRAY value exceeds int64 lanes (long-decimal "
+                    "int128 support is pending)")
+            raw = lo.copy()
+            pos += n_nonnull * 16
+            vals = nk.unpack_nonnull(raw, nulls)
+            return (vals, nulls), pos
+        raw = np.frombuffer(mv[pos:pos + n_nonnull * width],
+                            dtype=dt if dt.itemsize == width else
+                            {1: np.int8, 2: np.int16, 4: np.int32,
+                             8: np.int64}[width])
+        pos += n_nonnull * width
+        vals = nk.unpack_nonnull(raw, nulls)
+        if dt == np.bool_:
+            vals = vals.astype(bool)
+        elif vals.dtype != dt and dt.itemsize == width:
+            vals = vals.view(dt)
+        return (vals, nulls), pos
+    if enc == b"VARIABLE_WIDTH":
+        (rows,) = struct.unpack_from("<i", mv, pos)
+        pos += 4
+        offsets = np.frombuffer(mv[pos:pos + rows * 4], dtype=np.int32)
+        pos += rows * 4
+        nulls, pos = _bitunpack_nulls(mv, pos, rows)
+        (blob_len,) = struct.unpack_from("<i", mv, pos)
+        pos += 4
+        blob = bytes(mv[pos:pos + blob_len])
+        pos += blob_len
+        starts = np.concatenate([[0], offsets[:-1]]) if rows else offsets
+        vals = np.array([blob[starts[i]:offsets[i]].decode("utf-8", "replace")
+                         for i in range(rows)], dtype=object)
+        return (vals, nulls), pos
+    if enc == b"DICTIONARY":
+        (rows,) = struct.unpack_from("<i", mv, pos)
+        pos += 4
+        (dvals, dnulls), pos = _deserialize_block(mv, pos, ty)
+        idx = np.frombuffer(mv[pos:pos + rows * 4], dtype=np.int32)
+        pos += rows * 4
+        pos += 24  # dictionary instance id
+        return (dvals[idx], dnulls[idx]), pos
+    if enc == b"RLE":
+        (rows,) = struct.unpack_from("<i", mv, pos)
+        pos += 4
+        (dvals, dnulls), pos = _deserialize_block(mv, pos, ty)
+        return (np.repeat(dvals[:1], rows), np.repeat(dnulls[:1], rows)), pos
+    raise NotImplementedError(f"block encoding {enc!r}")
+
+
+def deserialize_to_arrays(buf: bytes, types: Sequence[T.Type], codec=PageCodec()):
+    return deserialize_page(buf, types, codec)

@@ -9,10 +9,11 @@ TPU mapping decisions (deliberately different from the JVM/Velox layouts):
 
 * Integral SQL types map to the narrowest JAX integer dtype; arithmetic is
   exact on-device.
-* DECIMAL(p, s) with p <= 18 maps to a scaled int64 ("short decimal") --
-  exact fixed-point arithmetic on the VPU. p > 18 (LongDecimalType's
-  int128) is represented as a (hi64, lo64) pair; round 1 supports
-  short decimals only in compute.
+* DECIMAL(p, s) maps to a scaled int64 -- exact fixed-point arithmetic
+  on the VPU. In round 1 this includes p > 18 (LongDecimalType): long
+  decimals ride int64 lanes too (exact at TPC-H-scale magnitudes,
+  documented overflow risk beyond +/-9.2e18 of scaled value); the
+  int128 (hi64, lo64) lane pair is the planned upgrade.
 * VARCHAR/CHAR map to fixed-width padded uint8 matrices + a length vector
   (TPU has no pointers; offsets+bytes heaps don't vectorize). Dictionary
   encoding is the preferred representation for wide/low-cardinality
@@ -124,9 +125,10 @@ class Type:
         if d is not None:
             return np.dtype(d)
         if self.is_decimal:
-            if self.is_short_decimal:
-                return np.dtype(np.int64)
-            raise NotImplementedError("long decimal (p>18) compute is not yet supported")
+            # long decimals (p > 18) also ride int64 lanes in round 1 --
+            # exact for TPC-H-scale magnitudes; the int128 (hi, lo) lane
+            # pair is the planned upgrade (SURVEY.md §7 hard part 2)
+            return np.dtype(np.int64)
         if self.is_string:
             return np.dtype(np.uint8)
         raise ValueError(f"no device dtype for type {self}")

@@ -1,0 +1,141 @@
+import json
+
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.connectors import tpch
+from presto_tpu.exec import run_query
+from presto_tpu.expr import call, const, input_ref
+from presto_tpu.ops.aggregation import AggSpec
+from presto_tpu.plan import (AggregationNode, DistinctNode, ExchangeNode,
+                             FilterNode, JoinNode, LimitNode, OutputNode,
+                             PlanFragment, ProjectNode, SemiJoinNode, SortNode,
+                             TableScanNode, TopNNode, ValuesNode, fragment_plan,
+                             from_json, to_json)
+
+D2 = T.decimal(12, 2)
+
+
+def scan(table, columns):
+    return TableScanNode("tpch", table, columns,
+                         [tpch.column_type(table, c) for c in columns])
+
+
+def q1_plan(distributed: bool):
+    s = scan("lineitem", ["returnflag", "linestatus", "quantity",
+                          "extendedprice", "shipdate"])
+    f = FilterNode(s, call("le", T.BOOLEAN, input_ref(4, T.DATE),
+                           const("1998-09-02", T.DATE)))
+    p = ProjectNode(f, [input_ref(0, T.char(1)), input_ref(1, T.char(1)),
+                        input_ref(2, D2), input_ref(3, D2)])
+    if distributed:
+        partial = AggregationNode(p, [0, 1],
+                                  [AggSpec("sum", 2, T.decimal(38, 2)),
+                                   AggSpec("count_star", None, T.BIGINT)],
+                                  step="PARTIAL", max_groups=16)
+        ex = ExchangeNode(partial, kind="REPARTITION", scope="REMOTE",
+                          partition_channels=[0, 1], slot_capacity=16)
+        agg = AggregationNode(ex, [0, 1],
+                              [AggSpec("sum", 2, T.decimal(38, 2)),
+                               AggSpec("count_star", None, T.BIGINT)],
+                              step="FINAL", max_groups=16)
+        gather = ExchangeNode(agg, kind="GATHER", scope="REMOTE")
+        return OutputNode(gather, ["rf", "ls", "sum_qty", "cnt"])
+    agg = AggregationNode(p, [0, 1],
+                          [AggSpec("sum", 2, T.decimal(38, 2)),
+                           AggSpec("count_star", None, T.BIGINT)],
+                          step="SINGLE", max_groups=16)
+    return OutputNode(agg, ["rf", "ls", "sum_qty", "cnt"])
+
+
+def result_map(res):
+    return {(r[0], r[1]): r[2:] for r in res.rows()}
+
+
+def test_run_query_q1_local():
+    res = run_query(q1_plan(False), sf=0.01)
+    got = result_map(res)
+    # oracle
+    c = tpch.generate_columns("lineitem", 0.01,
+                              ["returnflag", "linestatus", "quantity",
+                               "shipdate"])
+    cutoff = int((np.datetime64("1998-09-02") - np.datetime64("1970-01-01"))
+                 .astype(int))
+    m = c["shipdate"] <= cutoff
+    want = {}
+    for rf, ls, q in zip(c["returnflag"][m], c["linestatus"][m],
+                         c["quantity"][m]):
+        k = (rf, ls)
+        s, n = want.get(k, (0, 0))
+        want[k] = (s + int(q), n + 1)
+    assert got == want
+
+
+def test_run_query_q1_distributed_matches_local(mesh8):
+    local = result_map(run_query(q1_plan(False), sf=0.01))
+    dist = result_map(run_query(q1_plan(True), sf=0.01, mesh=mesh8))
+    assert local == dist
+
+
+def test_run_query_join_and_semijoin():
+    # orders join customer (nation of customer via semijoin-like filter)
+    o = scan("orders", ["orderkey", "custkey", "totalprice"])
+    cst = scan("customer", ["custkey", "nationkey"])
+    j = JoinNode(o, cst, [1], [0], "inner", "broadcast",
+                 right_output_channels=[1], out_capacity=1 << 15)
+    top = TopNNode(j, [(0, False, True)], 5)
+    res = run_query(OutputNode(top, ["orderkey", "custkey", "price", "nation"]),
+                    sf=0.01)
+    assert res.row_count == 5
+    ok = [r[0] for r in res.rows()]
+    assert ok == sorted(ok)
+    # oracle: nationkey matches generator
+    oc = tpch.generate_columns("orders", 0.01, ["orderkey", "custkey"])
+    cc = tpch.generate_columns("customer", 0.01, ["custkey", "nationkey"])
+    nmap = dict(zip(cc["custkey"], cc["nationkey"]))
+    omap = dict(zip(oc["orderkey"], oc["custkey"]))
+    for r in res.rows():
+        assert r[3] == nmap[omap[r[0]]]
+
+
+def test_run_query_semijoin_filter():
+    li = scan("lineitem", ["orderkey", "quantity"])
+    big = FilterNode(scan("orders", ["orderkey", "totalprice"]),
+                     call("gt", T.BOOLEAN, input_ref(1, T.decimal(15, 2)),
+                          const(50000000, T.decimal(15, 2))))
+    sj = SemiJoinNode(li, ProjectNode(big, [input_ref(0, T.BIGINT)]), 0, 0)
+    f = FilterNode(sj, input_ref(2, T.BOOLEAN))
+    res = run_query(OutputNode(LimitNode(f, 100), ["ok", "qty", "m"]), sf=0.01)
+    # oracle
+    oc = tpch.generate_columns("orders", 0.01, ["orderkey", "totalprice"])
+    keys = set(oc["orderkey"][oc["totalprice"] > 50000000])
+    assert res.row_count > 0
+    for r in res.rows():
+        assert r[0] in keys
+
+
+def test_values_sort_distinct():
+    v = ValuesNode([T.BIGINT, T.varchar(3)],
+                   [[3, "c"], [1, "a"], [3, "c"], [2, "b"]])
+    d = DistinctNode(v, max_groups=8)
+    s = SortNode(d, [(0, True, True)])
+    res = run_query(OutputNode(s, ["x", "s"]), sf=0.01)
+    assert res.rows() == [(3, "c"), (2, "b"), (1, "a")]
+
+
+def test_plan_json_roundtrip():
+    p = q1_plan(True)
+    j = to_json(p)
+    text = json.dumps(j)  # must be JSON-serializable
+    p2 = from_json(json.loads(text))
+    assert to_json(p2) == j
+
+
+def test_fragment_plan():
+    frags = fragment_plan(q1_plan(True))
+    assert len(frags) == 3  # partial stage, final stage, output stage
+    assert frags[0].partitioning == "HASH"
+    assert frags[1].partitioning == "SINGLE"
+    assert frags[-1].remote_sources == [1]
+    assert frags[1].remote_sources == [0]

@@ -34,9 +34,8 @@ def test_dtypes():
     assert T.INTEGER.to_dtype() == np.int32
     assert T.DATE.to_dtype() == np.int32
     assert T.decimal(12, 2).to_dtype() == np.int64
+    assert T.decimal(38, 2).to_dtype() == np.int64  # long decimal: int64 lanes
     assert T.BOOLEAN.to_dtype() == np.bool_
-    with pytest.raises(NotImplementedError):
-        T.decimal(38, 2).to_dtype()
 
 
 def test_roundtrip_str():
