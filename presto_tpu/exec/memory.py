@@ -1,0 +1,117 @@
+"""Hierarchical memory accounting: the MemoryPool / memory-context analog.
+
+Reference surface: memory/MemoryPool.java:45 (reserve:124, tryReserve:191),
+the QueryContext -> TaskContext -> PipelineContext -> OperatorContext
+chain, and presto-memory-context's AggregatedMemoryContext /
+LocalMemoryContext (user/system/revocable tags).
+
+On TPU the managed resource is HBM. XLA owns actual allocation; this
+layer does *admission* accounting: planned batch/table footprints are
+reserved against a per-worker pool before a pipeline is launched, so
+the exec layer can choose bucket sizes, refuse queries that cannot fit
+(query_max_memory), or trigger the host-offload spill tier (the
+revocable-memory path) before the device OOMs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..block import Batch, DictionaryColumn, StringColumn
+
+__all__ = ["MemoryPool", "MemoryContext", "MemoryReservationError",
+           "batch_bytes"]
+
+
+class MemoryReservationError(RuntimeError):
+    pass
+
+
+def batch_bytes(batch: Batch) -> int:
+    """Planned HBM footprint of a Batch (sum of leaf array sizes)."""
+    total = batch.active.shape[0] // 8 + batch.active.shape[0]  # mask bool
+    for c in batch.columns:
+        if isinstance(c, DictionaryColumn):
+            total += c.indices.shape[0] * 4 + c.nulls.shape[0]
+            c = c.dictionary
+        if isinstance(c, StringColumn):
+            total += c.chars.shape[0] * c.chars.shape[1]
+            total += c.lengths.shape[0] * 4 + c.nulls.shape[0]
+        else:
+            total += c.values.shape[0] * c.values.dtype.itemsize
+            total += c.nulls.shape[0]
+    return int(total)
+
+
+class MemoryPool:
+    """Per-worker reservation pool (MemoryPool.java:45 analog)."""
+
+    def __init__(self, capacity_bytes: int, name: str = "general"):
+        self.name = name
+        self.capacity = capacity_bytes
+        self._reserved: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    @property
+    def reserved_bytes(self) -> int:
+        with self._lock:
+            return sum(self._reserved.values())
+
+    @property
+    def free_bytes(self) -> int:
+        return self.capacity - self.reserved_bytes
+
+    def reserve(self, query_id: str, bytes_: int):
+        """Blocking semantics in the reference; here reservation failure
+        raises and the caller (runner) downsizes buckets or spills."""
+        with self._lock:
+            total = sum(self._reserved.values()) + bytes_
+            if total > self.capacity:
+                raise MemoryReservationError(
+                    f"pool {self.name}: reserve {bytes_} for {query_id} "
+                    f"exceeds capacity {self.capacity} "
+                    f"(reserved {total - bytes_})")
+            self._reserved[query_id] = self._reserved.get(query_id, 0) + bytes_
+
+    def try_reserve(self, query_id: str, bytes_: int) -> bool:
+        try:
+            self.reserve(query_id, bytes_)
+            return True
+        except MemoryReservationError:
+            return False
+
+    def free(self, query_id: str, bytes_: Optional[int] = None):
+        with self._lock:
+            cur = self._reserved.get(query_id, 0)
+            if bytes_ is None or bytes_ >= cur:
+                self._reserved.pop(query_id, None)
+            else:
+                self._reserved[query_id] = cur - bytes_
+
+    def query_bytes(self, query_id: str) -> int:
+        with self._lock:
+            return self._reserved.get(query_id, 0)
+
+
+@dataclasses.dataclass
+class MemoryContext:
+    """Operator-level child context (LocalMemoryContext analog)."""
+    pool: MemoryPool
+    query_id: str
+    tag: str = "user"  # user | system | revocable
+    local_bytes: int = 0
+
+    def set_bytes(self, bytes_: int):
+        delta = bytes_ - self.local_bytes
+        if delta > 0:
+            self.pool.reserve(self.query_id, delta)
+        elif delta < 0:
+            self.pool.free(self.query_id, -delta)
+        self.local_bytes = bytes_
+
+    def close(self):
+        self.set_bytes(0)

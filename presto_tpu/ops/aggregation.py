@@ -46,20 +46,37 @@ __all__ = ["AggSpec", "GroupByResult", "group_by", "grouped_aggregate",
 
 # aggregate function names supported round 1 (reference: the ~250-file
 # operator/aggregation/ library; the long tail lands with the function
-# registry's aggregation side)
-_AGGS = ("sum", "count", "count_star", "min", "max", "avg")
+# registry's aggregation side). approx_distinct is computed exactly via
+# sort-based distinct (within any epsilon; HLL sketch states land with
+# the sketch library).
+_AGGS = ("sum", "count", "count_star", "min", "max", "avg",
+         "var_samp", "var_pop", "stddev_samp", "stddev_pop", "stddev",
+         "variance", "bool_and", "bool_or", "every", "min_by", "max_by",
+         "count_distinct", "approx_distinct", "arbitrary", "any_value")
+
+# canonical name -> implementation family
+_ALIAS = {"stddev": "stddev_samp", "variance": "var_samp",
+          "every": "bool_and", "any_value": "arbitrary",
+          "approx_distinct": "count_distinct"}
 
 
 @dataclasses.dataclass(frozen=True)
 class AggSpec:
     """One aggregate: `name(input_channel)` -> output of `output_type`.
-    input_channel is None for count(*)."""
+    input_channel is None for count(*); min_by/max_by order by
+    `second_channel`."""
     name: str
     input_channel: Optional[int]
     output_type: T.Type
+    second_channel: Optional[int] = None
+    second_type: Optional[T.Type] = None  # order-value type for min_by/max_by
 
     def __post_init__(self):
         assert self.name in _AGGS, self.name
+
+    @property
+    def canonical(self) -> str:
+        return _ALIAS.get(self.name, self.name)
 
 
 @dataclasses.dataclass
@@ -127,12 +144,13 @@ def _sum_dtype(ty: T.Type):
     return jnp.int64
 
 
-def _acc_columns(spec: AggSpec, col: Optional[Block], ids, active, max_groups: int
-                 ) -> List[Tuple[str, Column]]:
+def _acc_columns(spec: AggSpec, col: Optional[Block], ids, active, max_groups: int,
+                 batch: Optional[Batch] = None) -> List[Tuple[str, Column]]:
     """Compute accumulator state tables for one aggregate. Returns a list
-    of named state columns (avg needs two)."""
+    of named state columns (avg and the variance family need several)."""
     g = max_groups
-    if spec.name == "count_star":
+    name = spec.canonical
+    if name == "count_star":
         cnt = jnp.zeros(g, dtype=jnp.int64).at[ids].add(active.astype(jnp.int64))
         return [("count", Column(cnt, jnp.zeros(g, dtype=bool), T.BIGINT))]
 
@@ -143,34 +161,120 @@ def _acc_columns(spec: AggSpec, col: Optional[Block], ids, active, max_groups: i
     nn = jnp.zeros(g, dtype=jnp.int64).at[ids].add(live.astype(jnp.int64))
     no_input = nn == 0
 
-    if spec.name == "count":
+    if name == "count":
         return [("count", Column(nn, jnp.zeros(g, dtype=bool), T.BIGINT))]
 
     if isinstance(col, StringColumn):
-        if spec.name in ("min", "max"):
+        if name in ("min", "max"):
             return _minmax_string(col, ids, live, g, spec)
         raise NotImplementedError(f"{spec.name} over strings")
 
     v = col.values
-    if spec.name == "sum" or spec.name == "avg":
+    if name == "sum" or name == "avg":
         sv = v.astype(_sum_dtype(col.type))
         s = jnp.zeros(g, dtype=sv.dtype).at[ids].add(jnp.where(live, sv, 0))
-        out = [("sum", Column(s, no_input, spec.output_type if spec.name == "sum"
+        out = [("sum", Column(s, no_input, spec.output_type if name == "sum"
                               else _sum_type(col.type)))]
-        if spec.name == "avg":
+        if name == "avg":
             out.append(("count", Column(nn, jnp.zeros(g, dtype=bool), T.BIGINT)))
         return out
-    if spec.name == "min":
+    if name == "min":
         ident = _max_ident(v.dtype)
         m = jnp.full(g, ident, dtype=v.dtype).at[ids].min(
             jnp.where(live, v, ident))
         return [("min", Column(m, no_input, spec.output_type))]
-    if spec.name == "max":
+    if name == "max":
         ident = _min_ident(v.dtype)
         m = jnp.full(g, ident, dtype=v.dtype).at[ids].max(
             jnp.where(live, v, ident))
         return [("max", Column(m, no_input, spec.output_type))]
+    if name in ("bool_and", "bool_or"):
+        bv = v.astype(jnp.int32)
+        if name == "bool_and":
+            m = jnp.ones(g, dtype=jnp.int32).at[ids].min(
+                jnp.where(live, bv, 1))
+        else:
+            m = jnp.zeros(g, dtype=jnp.int32).at[ids].max(
+                jnp.where(live, bv, 0))
+        return [(name, Column(m.astype(bool), no_input, T.BOOLEAN))]
+    if name in ("var_samp", "var_pop", "stddev_samp", "stddev_pop"):
+        # (count, sum, sum of squares) in float64; finalization happens in
+        # finalize_variance (exec layer / merge side)
+        f = v.astype(jnp.float64)
+        if col.type.is_decimal:
+            from ..expr.functions import _POW10
+            f = f / _POW10[col.type.scale]
+        s = jnp.zeros(g, dtype=jnp.float64).at[ids].add(jnp.where(live, f, 0.0))
+        s2 = jnp.zeros(g, dtype=jnp.float64).at[ids].add(
+            jnp.where(live, f * f, 0.0))
+        return [("count", Column(nn, jnp.zeros(g, dtype=bool), T.BIGINT)),
+                ("sum", Column(s, no_input, T.DOUBLE)),
+                ("sumsq", Column(s2, no_input, T.DOUBLE))]
+    if name == "arbitrary":
+        row_best = _argbest([jnp.zeros(len(col), dtype=jnp.uint64)], ids,
+                            live, g, minimize=True)
+        n = len(col)
+        valid = row_best < n
+        idx = jnp.clip(row_best, 0, n - 1)
+        return [(name, Column(v[idx], ~valid, spec.output_type))]
+    if name in ("min_by", "max_by"):
+        assert batch is not None
+        order_col = batch.column(spec.second_channel)
+        if isinstance(order_col, DictionaryColumn):
+            order_col = order_col.decode()
+        # Presto semantics: the winner is the row with the extreme ORDER
+        # value among non-null-order rows; a NULL value at that row is
+        # returned as NULL (so do NOT exclude value-nulls here)
+        live = active & ~order_col.nulls
+        order_words, _ = key_words([order_col])
+        order_words = order_words[1:]  # drop the null word (masked above)
+        row_best = _argbest(order_words, ids, live, g,
+                            minimize=(name == "min_by"))
+        n = len(col)
+        valid = row_best < n
+        idx = jnp.clip(row_best, 0, n - 1)
+        # state = (winning value, winning order value) -- the order value
+        # makes partial states mergeable (merge re-runs min_by on states)
+        oty = spec.second_type or order_col.type
+        return [(name, Column(v[idx], ~valid | col.nulls[idx],
+                              spec.output_type)),
+                ("order", Column(order_col.values[idx], ~valid, oty))]
+    if name == "count_distinct":
+        assert batch is not None
+        # exact: mark first occurrence of each (group, value) pair.
+        # pair count is bounded by the row count, so a row-count-sized
+        # table can never overflow
+        from .misc import mark_distinct
+        sub = Batch((Column(ids, jnp.zeros_like(col.nulls), T.INTEGER), col),
+                    live)
+        first = mark_distinct(sub, [0, 1], max_groups=len(col))
+        cnt = jnp.zeros(g, dtype=jnp.int64).at[ids].add(
+            (first & live).astype(jnp.int64))
+        return [("count", Column(cnt, jnp.zeros(g, dtype=bool), T.BIGINT))]
     raise NotImplementedError(spec.name)
+
+
+def _argbest(order_words: List[jnp.ndarray], ids, live, g, minimize: bool):
+    """Row index of the min (or max) order-key per group; ties -> lowest
+    row. Returns g-length int array; n (out of range) when group empty."""
+    n = live.shape[0]
+    remaining = live
+    w_prev = None
+    best_prev = None
+    for wk in order_words:
+        if w_prev is not None:
+            remaining = remaining & (w_prev == best_prev[ids])
+        if minimize:
+            sel = jnp.where(remaining, wk, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+            bk = jnp.full(g, np.uint64(0xFFFFFFFFFFFFFFFF),
+                          dtype=jnp.uint64).at[ids].min(sel)
+        else:
+            sel = jnp.where(remaining, wk, jnp.uint64(0))
+            bk = jnp.zeros(g, dtype=jnp.uint64).at[ids].max(sel)
+        w_prev, best_prev = wk, bk
+    winners = remaining & (w_prev == best_prev[ids])
+    row_sel = jnp.where(winners, jnp.arange(n, dtype=jnp.int64), n)
+    return jnp.full(g, n, dtype=jnp.int64).at[ids].min(row_sel)
 
 
 def _sum_type(in_ty: T.Type) -> T.Type:
@@ -248,7 +352,8 @@ def group_by(batch: Batch, key_channels: Sequence[int], aggs: Sequence[AggSpec],
         out_cols.append(_gather_block(k, perm_first, slot_active))
     for spec in aggs:
         col = None if spec.input_channel is None else batch.column(spec.input_channel)
-        for _, state in _acc_columns(spec, col, ids, batch.active, max_groups):
+        for _, state in _acc_columns(spec, col, ids, batch.active, max_groups,
+                                     batch):
             out_cols.append(state)
     out = Batch(tuple(out_cols), slot_active)
     return GroupByResult(out, num_groups, overflow)
@@ -261,25 +366,70 @@ def grouped_aggregate(batch: Batch, key_channels: Sequence[int],
 
 
 def state_width(spec: AggSpec) -> int:
-    return 2 if spec.name == "avg" else 1
+    c = spec.canonical
+    if c == "avg":
+        return 2
+    if c in ("var_samp", "var_pop", "stddev_samp", "stddev_pop"):
+        return 3
+    if c in ("min_by", "max_by"):
+        return 2
+    return 1
 
 
 def merge_spec(spec: AggSpec, state_channel: int) -> List[AggSpec]:
     """The merge-side aggregates for a partial state at `state_channel`
     (final aggregation step: sum<-sum, count<-sum, min<-min, max<-max,
-    avg <- sum(sum)/sum(count))."""
-    if spec.name in ("sum",):
+    avg <- (sum of sums, sum of counts), variance <- moment sums,
+    min_by/max_by <- min_by over (value, order) states)."""
+    c = spec.canonical
+    if c == "sum":
         return [AggSpec("sum", state_channel, spec.output_type)]
-    if spec.name in ("count", "count_star"):
+    if c in ("count", "count_star"):
         return [AggSpec("sum", state_channel, T.BIGINT)]
-    if spec.name == "min":
+    if c == "min":
         return [AggSpec("min", state_channel, spec.output_type)]
-    if spec.name == "max":
+    if c == "max":
         return [AggSpec("max", state_channel, spec.output_type)]
-    if spec.name == "avg":
+    if c in ("bool_and", "bool_or"):
+        return [AggSpec(c, state_channel, T.BOOLEAN)]
+    if c == "avg":
         return [AggSpec("sum", state_channel, T.decimal(38, 0)),
                 AggSpec("sum", state_channel + 1, T.BIGINT)]
+    if c in ("var_samp", "var_pop", "stddev_samp", "stddev_pop"):
+        return [AggSpec("sum", state_channel, T.BIGINT),
+                AggSpec("sum", state_channel + 1, T.DOUBLE),
+                AggSpec("sum", state_channel + 2, T.DOUBLE)]
+    if c in ("min_by", "max_by"):
+        # min_by over the (value, order) state re-emits BOTH columns
+        # (value + winning order), keeping state_width stable at 2
+        return [AggSpec(c, state_channel, spec.output_type,
+                        second_channel=state_channel + 1,
+                        second_type=spec.second_type)]
+    if c == "arbitrary":
+        return [AggSpec("arbitrary", state_channel, spec.output_type)]
+    if c == "count_distinct":
+        raise NotImplementedError(
+            "count_distinct/approx_distinct states don't merge across "
+            "partials; distributed plans must hash-exchange raw rows by the "
+            "group keys first, then aggregate in one step (the standard "
+            "mark_distinct plan shape)")
     raise NotImplementedError(spec.name)
+
+
+def finalize_variance(spec: AggSpec, count: jnp.ndarray, s: jnp.ndarray,
+                      s2: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(count, sum, sumsq) moments -> (value, nulls) for the variance
+    family. var = (sumsq - sum^2/n) / (n - ddof)."""
+    c = spec.canonical
+    ddof = 1 if c in ("var_samp", "stddev_samp") else 0
+    n = count.astype(jnp.float64)
+    denom = jnp.maximum(n - ddof, 1.0)
+    var = (s2 - s * s / jnp.maximum(n, 1.0)) / denom
+    var = jnp.maximum(var, 0.0)  # numeric floor
+    if c.startswith("stddev"):
+        var = jnp.sqrt(var)
+    nulls = count < (2 if ddof else 1)
+    return var, nulls
 
 
 def merge_partials(partials: Batch, num_keys: int, aggs: Sequence[AggSpec],

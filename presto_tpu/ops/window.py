@@ -1,0 +1,226 @@
+"""Window functions: the WindowOperator / TopNRowNumberOperator analog.
+
+Reference surface: operator/WindowOperator.java + operator/window/
+(RowNumberFunction, RankFunction, DenseRankFunction, framed aggregate
+windows; PagesIndex sorts each partition then streams frames).
+
+TPU-first redesign: one global lax.sort by (partition keys, order keys)
+turns every window computation into segmented prefix scans over the
+sorted order -- no per-partition loops:
+
+  part_start[i]  first sorted position of i's partition
+  run_start[i]   first sorted position of i's (partition, order) peer run
+  row_number     pos - part_start + 1
+  rank           run_start - part_start + 1
+  dense_rank     (# order boundaries in partition before pos) + 1
+  sum/count/avg/min/max over RANGE UNBOUNDED PRECEDING..CURRENT ROW
+                 prefix-scan value at the END of the peer run (peers are
+                 ties -- they share the frame result), minus the prefix
+                 before part_start
+  full-partition frame (UNBOUNDED..UNBOUNDED): value at partition end
+
+Results scatter back to original row positions through the sort
+permutation. NULLS in aggregates are skipped (masked to identity).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..block import Batch, Block, Column, DictionaryColumn, StringColumn
+from .keys import key_words
+from .sort import SortKey, _column_words
+
+__all__ = ["WindowSpec", "window"]
+
+_FUNCS = ("row_number", "rank", "dense_rank", "sum", "count", "avg", "min",
+          "max", "first_value", "last_value", "ntile", "percent_rank",
+          "cume_dist")
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowSpec:
+    name: str
+    input_channel: Optional[int] = None
+    output_type: T.Type = T.BIGINT
+    # frame: "range_current" (default: RANGE UNBOUNDED PRECEDING..CURRENT
+    # ROW) or "full" (whole partition)
+    frame: str = "range_current"
+    ntile_buckets: int = 0
+
+    def __post_init__(self):
+        assert self.name in _FUNCS, self.name
+        if self.name == "ntile":
+            assert self.ntile_buckets > 0, "ntile requires a positive bucket count"
+
+
+def _seg_positions(words: List[jnp.ndarray]) -> jnp.ndarray:
+    """Boundary mask: True where any word differs from the previous row."""
+    n = words[0].shape[0]
+    b = jnp.zeros(n, dtype=bool)
+    for w in words:
+        b = b | (w != jnp.concatenate([w[:1], w[:-1]]))
+    return b.at[0].set(True)
+
+
+def window(batch: Batch, partition_channels: Sequence[int],
+           order_keys: Sequence[SortKey], specs: Sequence[WindowSpec]) -> Batch:
+    """Returns the input batch with one appended column per spec (same
+    row order as the input; padding rows get nulls)."""
+    n = batch.capacity
+    pos = jnp.arange(n, dtype=jnp.int64)
+
+    pwords, _ = key_words([batch.column(c) for c in partition_channels])
+    owords: List[jnp.ndarray] = []
+    for sk in order_keys:
+        owords.extend(_column_words(batch.column(sk.channel), sk.descending,
+                                    sk.nulls_last))
+    lead = jnp.where(batch.active, np.uint64(0), np.uint64(1))
+    ops = [lead, *pwords, *owords, pos.astype(jnp.int32)]
+    sorted_ops = jax.lax.sort(ops, num_keys=len(ops) - 1, is_stable=True)
+    perm = sorted_ops[-1]
+    s_active = sorted_ops[0] == 0
+    s_pwords = sorted_ops[1:1 + len(pwords)]
+    s_owords = sorted_ops[1 + len(pwords):-1]
+
+    part_bound = _seg_positions(list(s_pwords)) | ~s_active
+    run_bound = part_bound | (_seg_positions(list(s_owords)) if s_owords
+                              else jnp.zeros(n, dtype=bool))
+
+    spos = jnp.arange(n, dtype=jnp.int64)
+    part_start = jnp.where(part_bound, spos, 0)
+    part_start = jax.lax.cummax(part_start)
+    run_start = jnp.where(run_bound, spos, 0)
+    run_start = jax.lax.cummax(run_start)
+
+    # partition end: next partition boundary - 1 (computed by reverse cummin)
+    next_bound = jnp.where(part_bound, spos, n)
+    # shift: boundary at i means partition ends at i-1 for previous rows
+    nb = jnp.concatenate([next_bound[1:], jnp.full((1,), n, dtype=jnp.int64)])
+    part_end = jax.lax.cummin(nb[::-1])[::-1]  # first boundary at/after i+1
+    part_end = part_end - 1
+    # run end likewise
+    nrb = jnp.where(run_bound, spos, n)
+    nrb = jnp.concatenate([nrb[1:], jnp.full((1,), n, dtype=jnp.int64)])
+    run_end = jax.lax.cummin(nrb[::-1])[::-1] - 1
+
+    row_number = spos - part_start + 1
+    rank = run_start - part_start + 1
+    # dense rank: count of run boundaries in (part_start, pos]
+    rb = jnp.cumsum(run_bound.astype(jnp.int64))
+    dense = rb - rb[part_start] + 1
+    part_rows = part_end - part_start + 1
+
+    out_cols: List[Block] = list(batch.columns)
+    inv = jnp.zeros(n, dtype=jnp.int64).at[perm].set(spos)
+
+    for spec in specs:
+        name = spec.name
+        if name == "row_number":
+            vals_sorted = row_number
+            nulls_sorted = ~s_active
+        elif name == "rank":
+            vals_sorted = rank
+            nulls_sorted = ~s_active
+        elif name == "dense_rank":
+            vals_sorted = dense
+            nulls_sorted = ~s_active
+        elif name == "percent_rank":
+            denom = jnp.maximum(part_rows - 1, 1).astype(jnp.float64)
+            vals_sorted = jnp.where(part_rows == 1, 0.0,
+                                    (rank - 1).astype(jnp.float64) / denom)
+            nulls_sorted = ~s_active
+        elif name == "cume_dist":
+            vals_sorted = (run_end - part_start + 1).astype(jnp.float64) / \
+                part_rows.astype(jnp.float64)
+            nulls_sorted = ~s_active
+        elif name == "ntile":
+            k = spec.ntile_buckets
+            r0 = (row_number - 1)
+            vals_sorted = jnp.minimum(r0 * k // jnp.maximum(part_rows, 1), k - 1) + 1
+            nulls_sorted = ~s_active
+        elif name in ("sum", "count", "avg", "min", "max", "first_value",
+                      "last_value"):
+            col = batch.column(spec.input_channel)
+            if isinstance(col, DictionaryColumn):
+                col = col.decode()
+            assert not isinstance(col, StringColumn), \
+                f"window {name} over strings is not yet supported"
+            v_sorted = col.values[perm]
+            nn_sorted = (~col.nulls & batch.active)[perm]
+            if name in ("sum", "avg", "count"):
+                sv = v_sorted.astype(jnp.float64 if col.type.is_floating
+                                     else jnp.int64)
+                ps = jnp.cumsum(jnp.where(nn_sorted, sv, 0))
+                pc = jnp.cumsum(nn_sorted.astype(jnp.int64))
+                end = run_end if spec.frame == "range_current" else part_end
+                base_s = jnp.where(part_start > 0, ps[part_start - 1], 0)
+                base_c = jnp.where(part_start > 0, pc[part_start - 1], 0)
+                wsum = ps[end] - base_s
+                wcnt = pc[end] - base_c
+                if name == "sum":
+                    vals_sorted = wsum
+                    nulls_sorted = (wcnt == 0) | ~s_active
+                elif name == "count":
+                    vals_sorted = wcnt
+                    nulls_sorted = ~s_active
+                else:
+                    vals_sorted = wsum.astype(jnp.float64) / \
+                        jnp.maximum(wcnt, 1).astype(jnp.float64)
+                    if col.type.is_decimal:
+                        vals_sorted = vals_sorted  # scaled float; cast below
+                    nulls_sorted = (wcnt == 0) | ~s_active
+            elif name in ("min", "max"):
+                ident = (jnp.iinfo(jnp.int64).max if name == "min"
+                         else jnp.iinfo(jnp.int64).min)
+                if col.type.is_floating:
+                    ident = jnp.inf if name == "min" else -jnp.inf
+                sv = jnp.where(nn_sorted, v_sorted, ident)
+                scan = jax.lax.cummin if name == "min" else jax.lax.cummax
+                ps = _segmented_scan(sv, part_bound, scan)
+                end = run_end if spec.frame == "range_current" else part_end
+                vals_sorted = ps[end]
+                pc = jnp.cumsum(nn_sorted.astype(jnp.int64))
+                base_c = jnp.where(part_start > 0, pc[part_start - 1], 0)
+                nulls_sorted = ((pc[end] - base_c) == 0) | ~s_active
+            elif name == "first_value":
+                vals_sorted = v_sorted[part_start]
+                nulls_sorted = col.nulls[perm][part_start] | ~s_active
+            else:  # last_value (frame-end semantics)
+                end = run_end if spec.frame == "range_current" else part_end
+                vals_sorted = v_sorted[end]
+                nulls_sorted = col.nulls[perm][end] | ~s_active
+        else:
+            raise NotImplementedError(name)
+
+        vals = jnp.asarray(vals_sorted)[inv]
+        nulls = jnp.asarray(nulls_sorted)[inv]
+        dt = spec.output_type.to_dtype()
+        vals = vals.astype(dt)
+        out_cols.append(Column(vals, nulls, spec.output_type))
+
+    return Batch(tuple(out_cols), batch.active)
+
+
+def _segmented_scan(vals, seg_bound, scan):
+    """Inclusive segmented cummin/cummax: restart at each boundary.
+    Implemented with the standard (flag, value) associative combine."""
+    def combine(a, b):
+        af, av = a
+        bf, bv = b
+        keep = bf
+        if scan is jax.lax.cummin:
+            nv = jnp.where(keep, bv, jnp.minimum(av, bv))
+        else:
+            nv = jnp.where(keep, bv, jnp.maximum(av, bv))
+        return (af | bf, nv)
+
+    flags = seg_bound
+    _, out = jax.lax.associative_scan(combine, (flags, vals))
+    return out

@@ -116,8 +116,15 @@ class AggregationNode(PlanNode):
         out = [src[c] for c in self.group_channels]
         from ..ops.aggregation import _sum_type
         for a in self.aggregates:
-            if a.name == "avg":  # (sum, count) state pair at every step
+            c = a.canonical
+            if c == "avg":  # (sum, count) state pair at every step
                 out.extend([_sum_type(src[a.input_channel]), T.BIGINT])
+            elif c in ("var_samp", "var_pop", "stddev_samp", "stddev_pop"):
+                # raw (count, sum, sumsq) moments; finalize_variance is a
+                # projection the plan builder adds on top
+                out.extend([T.BIGINT, T.DOUBLE, T.DOUBLE])
+            elif c in ("min_by", "max_by"):
+                out.extend([a.output_type, a.second_type or T.BIGINT])
             else:
                 out.append(a.output_type)
         return out
@@ -256,11 +263,18 @@ class OutputNode(PlanNode):
 # ---------------------------------------------------------------------------
 
 def _agg_to_json(a: AggSpec) -> dict:
-    return {"name": a.name, "input": a.input_channel, "type": str(a.output_type)}
+    out = {"name": a.name, "input": a.input_channel, "type": str(a.output_type)}
+    if a.second_channel is not None:
+        out["secondChannel"] = a.second_channel
+        out["secondType"] = str(a.second_type) if a.second_type else None
+    return out
 
 
 def _agg_from_json(j: dict) -> AggSpec:
-    return AggSpec(j["name"], j["input"], T.parse_type(j["type"]))
+    st = j.get("secondType")
+    return AggSpec(j["name"], j["input"], T.parse_type(j["type"]),
+                   second_channel=j.get("secondChannel"),
+                   second_type=T.parse_type(st) if st else None)
 
 
 def to_json(n: PlanNode) -> dict:

@@ -1,0 +1,3 @@
+from .config import ConfigSpec, Config, SESSION_PROPERTIES, Session
+
+__all__ = ["ConfigSpec", "Config", "SESSION_PROPERTIES", "Session"]

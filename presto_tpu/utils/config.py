@@ -1,0 +1,156 @@
+"""Config + session-property system.
+
+Reference surface: airlift @Config beans (TaskManagerConfig,
+QueryManagerConfig, MemoryManagerConfig, FeaturesConfig:72 -- 3.7k LoC
+of flags) parsed from etc/config.properties, plus
+SystemSessionProperties.java:96 (311 typed per-query session
+properties, where the north star's `tpu_execution_enabled` gate
+lives) and the native worker's SystemConfig (Configs.h:162).
+
+A ConfigSpec declares typed properties with defaults; Config binds a
+property file / dict against a spec with type coercion and unknown-key
+errors; Session resolves per-query overrides against SESSION_PROPERTIES
+the way SystemSessionProperties resolves them at query start.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+__all__ = ["ConfigSpec", "Config", "SESSION_PROPERTIES", "Session",
+           "SessionProperty"]
+
+
+def _parse_bool(v):
+    if isinstance(v, bool):
+        return v
+    return str(v).strip().lower() in ("true", "1", "yes", "on")
+
+
+def _parse_size(v):
+    """'512MB' / '16GB' / plain int bytes."""
+    if isinstance(v, (int, float)):
+        return int(v)
+    s = str(v).strip().upper()
+    for suffix, mult in (("TB", 1 << 40), ("GB", 1 << 30), ("MB", 1 << 20),
+                         ("KB", 1 << 10), ("B", 1)):
+        if s.endswith(suffix):
+            return int(float(s[: -len(suffix)]) * mult)
+    return int(s)
+
+
+_COERCE: Dict[str, Callable[[Any], Any]] = {
+    "bool": _parse_bool, "int": int, "float": float, "str": str,
+    "size": _parse_size,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Property:
+    name: str
+    kind: str
+    default: Any
+    description: str = ""
+
+
+class ConfigSpec:
+    def __init__(self, name: str):
+        self.name = name
+        self.properties: Dict[str, Property] = {}
+
+    def add(self, name: str, kind: str, default: Any, description: str = ""):
+        assert kind in _COERCE, kind
+        self.properties[name] = Property(name, kind, default, description)
+        return self
+
+
+class Config:
+    """Bound configuration: spec + overrides, with coercion."""
+
+    def __init__(self, spec: ConfigSpec, values: Optional[Dict[str, Any]] = None):
+        self.spec = spec
+        self._values: Dict[str, Any] = {}
+        for k, v in (values or {}).items():
+            self.set(k, v)
+
+    def set(self, key: str, value: Any):
+        prop = self.spec.properties.get(key)
+        if prop is None:
+            raise KeyError(f"unknown config property {key!r} for {self.spec.name}")
+        self._values[key] = _COERCE[prop.kind](value)
+
+    def get(self, key: str) -> Any:
+        prop = self.spec.properties.get(key)
+        if prop is None:
+            raise KeyError(f"unknown config property {key!r} for {self.spec.name}")
+        if key in self._values:
+            return self._values[key]
+        return _COERCE[prop.kind](prop.default)  # defaults coerce too ("12GB")
+
+    @classmethod
+    def from_properties_file(cls, spec: ConfigSpec, path: str) -> "Config":
+        values = {}
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                k, _, v = line.partition("=")
+                values[k.strip()] = v.strip()
+        return cls(spec, values)
+
+
+# ---------------------------------------------------------------------------
+# Engine configs (TaskManagerConfig / MemoryManagerConfig analog subset)
+# ---------------------------------------------------------------------------
+
+WORKER_CONFIG = (
+    ConfigSpec("worker")
+    .add("task.batch-capacity", "int", 1 << 20,
+         "rows per on-device batch bucket (PageProcessor batch-size analog)")
+    .add("task.max-groups", "int", 1 << 20,
+         "default dense group-table capacity per aggregation")
+    .add("memory.max-query-memory", "size", "12GB",
+         "per-query HBM reservation ceiling (query_max_memory analog)")
+    .add("exchange.slot-capacity", "int", 1 << 17,
+         "per-destination rows in all_to_all exchange buckets")
+    .add("join.out-capacity-factor", "float", 1.5,
+         "join output bucket = probe rows * factor")
+)
+
+
+# ---------------------------------------------------------------------------
+# Session properties (SystemSessionProperties analog)
+# ---------------------------------------------------------------------------
+
+SESSION_PROPERTIES = (
+    ConfigSpec("session")
+    .add("tpu_execution_enabled", "bool", True,
+         "offload plan fragments to the TPU engine (north-star gate; "
+         "pattern: SystemSessionProperties.java:398 native_execution_enabled)")
+    .add("query_max_memory", "size", "12GB", "per-query memory cap")
+    .add("join_distribution_type", "str", "AUTOMATIC",
+         "PARTITIONED | BROADCAST | AUTOMATIC "
+         "(DetermineJoinDistributionType analog)")
+    .add("hash_partition_count", "int", 8,
+         "workers per partitioned exchange (FIXED_HASH distribution width)")
+    .add("task_concurrency", "int", 1,
+         "local drivers per pipeline; on TPU, batches in flight per chip")
+    .add("exchange_compression", "str", "none",
+         "none | zstd | zlib for cross-slice SerializedPage exchanges")
+)
+
+
+class SessionProperty:
+    pass  # reserved for typed accessors
+
+
+class Session(Config):
+    """Per-query session: overrides resolved at query start."""
+
+    def __init__(self, values: Optional[Dict[str, Any]] = None,
+                 user: str = "presto_tpu", query_id: Optional[str] = None):
+        super().__init__(SESSION_PROPERTIES, values)
+        self.user = user
+        self.query_id = query_id or "q_0"

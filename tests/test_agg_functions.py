@@ -1,0 +1,122 @@
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.block import batch_from_numpy, concat_batches, to_numpy
+from presto_tpu.ops import AggSpec, group_by, merge_partials
+from presto_tpu.ops.aggregation import finalize_variance
+
+
+def col(b, i):
+    return to_numpy(b.column(i))
+
+
+def table(r, nstates):
+    act = np.asarray(r.batch.active)
+    out = {}
+    for i in range(r.batch.capacity):
+        if act[i]:
+            k = col(r.batch, 0)[0][i]
+            out[int(k)] = tuple(col(r.batch, 1 + c)[0][i] for c in range(nstates))
+    return out
+
+
+KEYS = np.array([1, 1, 1, 2, 2, 3], dtype=np.int64)
+VALS = np.array([4.0, 2.0, 6.0, 10.0, 10.0, 7.0])
+
+
+def test_variance_family():
+    b = batch_from_numpy([T.BIGINT, T.DOUBLE], [KEYS, VALS], capacity=8)
+    r = group_by(b, [0], [AggSpec("var_samp", 1, T.DOUBLE)], max_groups=8)
+    got = table(r, 3)
+    for k, (c, s, s2) in got.items():
+        m = KEYS == k
+        assert c == m.sum() and s == VALS[m].sum() and s2 == (VALS[m] ** 2).sum()
+    # finalize
+    import jax.numpy as jnp
+    spec = AggSpec("var_samp", 1, T.DOUBLE)
+    v, n = finalize_variance(spec, jnp.array([3]), jnp.array([12.0]),
+                             jnp.array([56.0]))
+    assert v[0] == pytest.approx(np.var([4.0, 2.0, 6.0], ddof=1))
+    spec = AggSpec("stddev_pop", 1, T.DOUBLE)
+    v, n = finalize_variance(spec, jnp.array([3]), jnp.array([12.0]),
+                             jnp.array([56.0]))
+    assert v[0] == pytest.approx(np.std([4.0, 2.0, 6.0]))
+
+
+def test_bool_and_or():
+    k = np.array([1, 1, 2, 2], dtype=np.int64)
+    v = np.array([True, False, True, True])
+    b = batch_from_numpy([T.BIGINT, T.BOOLEAN], [k, v], capacity=8)
+    r = group_by(b, [0], [AggSpec("bool_and", 1, T.BOOLEAN),
+                          AggSpec("bool_or", 1, T.BOOLEAN)], max_groups=8)
+    got = table(r, 2)
+    assert got == {1: (False, True), 2: (True, True)}
+
+
+def test_min_by_max_by():
+    k = np.array([1, 1, 1, 2, 2], dtype=np.int64)
+    v = np.array([100, 200, 300, 400, 500], dtype=np.int64)   # value
+    o = np.array([3, 1, 2, 9, 8], dtype=np.int64)             # order
+    b = batch_from_numpy([T.BIGINT, T.BIGINT, T.BIGINT], [k, v, o], capacity=8)
+    r = group_by(b, [0], [
+        AggSpec("min_by", 1, T.BIGINT, second_channel=2, second_type=T.BIGINT),
+        AggSpec("max_by", 1, T.BIGINT, second_channel=2, second_type=T.BIGINT),
+    ], max_groups=8)
+    got = table(r, 4)  # min_by val, order, max_by val, order
+    assert got[1][0] == 200 and got[1][2] == 100
+    assert got[2][0] == 500 and got[2][2] == 400
+
+
+def test_min_by_merges_across_partials():
+    spec = AggSpec("min_by", 1, T.BIGINT, second_channel=2, second_type=T.BIGINT)
+    k1 = np.array([1, 1], dtype=np.int64)
+    v1 = np.array([100, 200], dtype=np.int64)
+    o1 = np.array([5, 7], dtype=np.int64)
+    k2 = np.array([1], dtype=np.int64)
+    v2 = np.array([300], dtype=np.int64)
+    o2 = np.array([2], dtype=np.int64)
+    p1 = group_by(batch_from_numpy([T.BIGINT] * 3, [k1, v1, o1]), [0], [spec],
+                  max_groups=4)
+    p2 = group_by(batch_from_numpy([T.BIGINT] * 3, [k2, v2, o2]), [0], [spec],
+                  max_groups=4)
+    merged = merge_partials(concat_batches([p1.batch, p2.batch]), 1, [spec],
+                            max_groups=4)
+    got = table(merged, 2)
+    assert got[1][0] == 300  # order 2 wins globally
+
+
+def test_min_by_null_value_winner():
+    # Presto: min_by returns the value AT the minimum order, even if NULL
+    k = np.array([1, 1], dtype=np.int64)
+    v = np.array([0, 5], dtype=np.int64)
+    vn = np.array([True, False])
+    o = np.array([1, 2], dtype=np.int64)
+    b = batch_from_numpy([T.BIGINT, T.BIGINT, T.BIGINT], [k, v, o],
+                         nulls=[None, vn, None])
+    r = group_by(b, [0], [AggSpec("min_by", 1, T.BIGINT, second_channel=2,
+                                  second_type=T.BIGINT)], max_groups=4)
+    _, vnulls = to_numpy(r.batch.column(1))
+    act = np.asarray(r.batch.active)
+    i = int(np.nonzero(act)[0][0])
+    assert vnulls[i]  # the winner (order=1) has a NULL value
+
+
+def test_count_distinct_exact():
+    k = np.array([1, 1, 1, 1, 2, 2], dtype=np.int64)
+    v = np.array([7, 7, 8, 9, 5, 5], dtype=np.int64)
+    vn = np.array([False, False, False, True, False, False])
+    b = batch_from_numpy([T.BIGINT, T.BIGINT], [k, v], nulls=[None, vn],
+                         capacity=8)
+    r = group_by(b, [0], [AggSpec("approx_distinct", 1, T.BIGINT)], max_groups=8)
+    got = table(r, 1)
+    assert got == {1: (2,), 2: (1,)}  # nulls don't count
+
+
+def test_arbitrary():
+    k = np.array([1, 1, 2], dtype=np.int64)
+    v = np.array([10, 20, 30], dtype=np.int64)
+    b = batch_from_numpy([T.BIGINT, T.BIGINT], [k, v], capacity=4)
+    r = group_by(b, [0], [AggSpec("arbitrary", 1, T.BIGINT)], max_groups=4)
+    got = table(r, 1)
+    assert got[1][0] in (10, 20) and got[2][0] == 30

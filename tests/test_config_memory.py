@@ -1,0 +1,69 @@
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.block import batch_from_numpy
+from presto_tpu.exec.memory import (MemoryContext, MemoryPool,
+                                    MemoryReservationError, batch_bytes)
+from presto_tpu.utils.config import (SESSION_PROPERTIES, WORKER_CONFIG, Config,
+                                     Session)
+
+
+def test_config_defaults_and_coercion():
+    c = Config(WORKER_CONFIG)
+    assert c.get("task.batch-capacity") == 1 << 20
+    assert c.get("memory.max-query-memory") == 12 << 30
+    c.set("memory.max-query-memory", "512MB")
+    assert c.get("memory.max-query-memory") == 512 << 20
+    with pytest.raises(KeyError):
+        c.get("nope")
+    with pytest.raises(KeyError):
+        c.set("nope", 1)
+
+
+def test_properties_file(tmp_path):
+    p = tmp_path / "config.properties"
+    p.write_text("# worker config\ntask.batch-capacity=4096\n"
+                 "exchange.slot-capacity = 128\n")
+    c = Config.from_properties_file(WORKER_CONFIG, str(p))
+    assert c.get("task.batch-capacity") == 4096
+    assert c.get("exchange.slot-capacity") == 128
+
+
+def test_session_properties():
+    s = Session({"tpu_execution_enabled": "false", "hash_partition_count": 16})
+    assert s.get("tpu_execution_enabled") is False
+    assert s.get("hash_partition_count") == 16
+    assert s.get("join_distribution_type") == "AUTOMATIC"
+
+
+def test_memory_pool_reserve_free():
+    pool = MemoryPool(1000)
+    pool.reserve("q1", 400)
+    assert pool.free_bytes == 600
+    assert not pool.try_reserve("q2", 700)
+    pool.free("q1")
+    assert pool.try_reserve("q2", 700)
+    with pytest.raises(MemoryReservationError):
+        pool.reserve("q3", 400)
+
+
+def test_memory_context_tracks_deltas():
+    pool = MemoryPool(1000)
+    ctx = MemoryContext(pool, "q1")
+    ctx.set_bytes(300)
+    assert pool.query_bytes("q1") == 300
+    ctx.set_bytes(100)
+    assert pool.query_bytes("q1") == 100
+    ctx.close()
+    assert pool.query_bytes("q1") == 0
+
+
+def test_batch_bytes():
+    b = batch_from_numpy([T.BIGINT, T.varchar(8)],
+                         [np.arange(100, dtype=np.int64),
+                          np.array(["x" * 8] * 100, dtype=object)])
+    n = batch_bytes(b)
+    # 100*8 (values) + 100 (nulls) + 100*8 (chars) + 100*4 (lengths)
+    # + 100 (nulls) + active mask overhead
+    assert n >= 100 * 8 + 100 * 8 + 100 * 4

@@ -1,0 +1,114 @@
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.block import batch_from_numpy, to_numpy
+from presto_tpu.ops.sort import SortKey
+from presto_tpu.ops.window import WindowSpec, window
+
+
+def col(b, i):
+    return to_numpy(b.column(i))
+
+
+def make(parts, orders, vals, capacity=None, vnulls=None):
+    return batch_from_numpy(
+        [T.BIGINT, T.BIGINT, T.BIGINT],
+        [np.asarray(parts, np.int64), np.asarray(orders, np.int64),
+         np.asarray(vals, np.int64)],
+        nulls=[None, None, vnulls], capacity=capacity)
+
+
+PARTS = [1, 1, 1, 2, 2, 2, 2, 1]
+ORDERS = [10, 20, 20, 5, 5, 7, 9, 30]
+VALS = [1, 2, 3, 4, 5, 6, 7, 8]
+
+
+def run(specs, vnulls=None, capacity=None):
+    b = make(PARTS, ORDERS, VALS, capacity, vnulls)
+    out = window(b, [0], [SortKey(1)], specs)
+    return out
+
+
+def test_row_number_rank_dense_rank():
+    out = run([WindowSpec("row_number"), WindowSpec("rank"),
+               WindowSpec("dense_rank")])
+    rn, _ = col(out, 3)
+    rk, _ = col(out, 4)
+    dr, _ = col(out, 5)
+    # partition 1 sorted: orders 10,20,20,30 -> rows 0,1,2,7
+    assert [rn[0], rn[1], rn[2], rn[7]] == [1, 2, 3, 4]
+    assert [rk[0], rk[1], rk[2], rk[7]] == [1, 2, 2, 4]
+    assert [dr[0], dr[1], dr[2], dr[7]] == [1, 2, 2, 3]
+    # partition 2 sorted: orders 5,5,7,9 -> rows 3,4,5,6
+    assert [rn[3], rn[4], rn[5], rn[6]] == [1, 2, 3, 4]
+    assert [rk[3], rk[4], rk[5], rk[6]] == [1, 1, 3, 4]
+    assert [dr[3], dr[4], dr[5], dr[6]] == [1, 1, 2, 3]
+
+
+def test_running_sum_range_frame():
+    out = run([WindowSpec("sum", 2, T.BIGINT)])
+    s, n = col(out, 3)
+    # partition 1 order 10,20,20,30: rows 0(1), 1(2), 2(3), 7(8)
+    # RANGE frame: peers (rows 1,2) share the sum 1+2+3=6
+    assert s[0] == 1 and s[1] == 6 and s[2] == 6 and s[7] == 14
+    # partition 2 order 5,5,7,9: rows 3(4),4(5) peers -> 9; 5(6)->15; 6(7)->22
+    assert s[3] == 9 and s[4] == 9 and s[5] == 15 and s[6] == 22
+
+
+def test_full_partition_frame_and_minmax():
+    out = run([WindowSpec("sum", 2, T.BIGINT, frame="full"),
+               WindowSpec("min", 2, T.BIGINT),
+               WindowSpec("max", 2, T.BIGINT, frame="full")])
+    s, _ = col(out, 3)
+    mn, _ = col(out, 4)
+    mx, _ = col(out, 5)
+    assert all(s[i] == 14 for i in [0, 1, 2, 7])
+    assert all(s[i] == 22 for i in [3, 4, 5, 6])
+    # running min over partition 1 (order 10,20,20,30; vals 1,2,3,8)
+    assert mn[0] == 1 and mn[1] == 1 and mn[7] == 1
+    assert all(mx[i] == 8 for i in [0, 1, 2, 7])
+
+
+def test_nulls_skipped_in_window_agg():
+    vnulls = np.array([False, True, False, False, False, False, False, False])
+    out = run([WindowSpec("sum", 2, T.BIGINT),
+               WindowSpec("count", 2, T.BIGINT)], vnulls=vnulls)
+    s, sn = col(out, 3)
+    c, _ = col(out, 4)
+    # partition 1: row 1's val (2) is NULL -> sums skip it
+    assert s[1] == 4 and s[2] == 4  # 1 + 3
+    assert c[1] == 2 and c[7] == 3
+
+
+def test_avg_first_last_ntile():
+    out = run([WindowSpec("avg", 2, T.DOUBLE, frame="full"),
+               WindowSpec("first_value", 2, T.BIGINT),
+               WindowSpec("last_value", 2, T.BIGINT, frame="full"),
+               WindowSpec("ntile", None, T.BIGINT, ntile_buckets=2)])
+    a, _ = col(out, 3)
+    f, _ = col(out, 4)
+    l, _ = col(out, 5)
+    t, _ = col(out, 6)
+    assert a[0] == pytest.approx(14 / 4)
+    assert f[0] == 1 and f[7] == 1 and f[3] == 4
+    assert l[0] == 8 and l[3] == 7
+    # partition 1 has 4 rows -> buckets [1,1,2,2] by order
+    assert [t[0], t[1], t[2], t[7]] == [1, 1, 2, 2]
+
+
+def test_padding_rows_stay_null():
+    out = run([WindowSpec("row_number")], capacity=16)
+    rn, n = col(out, 3)
+    assert n[8:].all()
+    assert not n[:8].any()
+
+
+def test_percent_rank_cume_dist():
+    out = run([WindowSpec("percent_rank", None, T.DOUBLE),
+               WindowSpec("cume_dist", None, T.DOUBLE)])
+    pr, _ = col(out, 3)
+    cd, _ = col(out, 4)
+    assert pr[0] == 0.0 and pr[7] == pytest.approx(1.0)
+    assert pr[1] == pytest.approx(1 / 3)
+    assert cd[3] == pytest.approx(0.5) and cd[6] == pytest.approx(1.0)
