@@ -65,10 +65,31 @@ def _scan_batch(node: N.PlanNode, sf: float, capacity_hint: Optional[int],
 
 def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
               capacity_hints: Optional[Dict[str, int]] = None,
-              default_join_capacity: int = 1 << 16) -> QueryResult:
+              default_join_capacity: int = 1 << 16,
+              split_rows: Optional[int] = None) -> QueryResult:
     """Plan -> results, end to end (DistributedQueryRunner analog for
     programmatic plans). With a mesh, scan batches are padded to a
-    multiple of the mesh size and the plan runs SPMD."""
+    multiple of the mesh size and the plan runs SPMD. With `split_rows`,
+    streamable aggregation plans execute split-by-split with bounded
+    HBM (exec/streaming.py)."""
+    if split_rows is not None and mesh is None:
+        from .streaming import run_streaming_agg, streamable_agg_shape
+        if streamable_agg_shape(root) is not None:
+            r = run_streaming_agg(root, sf, split_rows)
+            out = r.batch
+            if bool(np.asarray(r.overflow)):
+                raise RuntimeError("streaming aggregation overflowed "
+                                   "max_groups; raise AggregationNode.max_groups")
+            act = np.asarray(out.active)
+            sel = np.nonzero(act)[0]
+            cols, nulls = [], []
+            for c in range(out.num_columns):
+                v, n = to_numpy(out.column(c))
+                cols.append(v[sel])
+                nulls.append(n[sel])
+            names = root.names if isinstance(root, N.OutputNode) else \
+                [f"col{i}" for i in range(out.num_columns)]
+            return QueryResult(cols, nulls, names, len(sel))
     plan = compile_plan(root, mesh, default_join_capacity)
     pad = (mesh.devices.size if mesh is not None else 1) * 8
     hints = capacity_hints or {}

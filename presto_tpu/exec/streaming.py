@@ -1,0 +1,95 @@
+"""Streaming split execution: bounded-HBM scans feeding running
+aggregation.
+
+Reference surface: the split-driven Driver loop -- SqlTaskExecution
+enqueues one DriverSplitRunner per split (execution/SqlTaskExecution.java:144),
+each Driver streams pages scan->ops (operator/Driver.java:310), and
+partial aggregation states merge at the end.
+
+TPU model: one jit'd per-split program (scan pipeline -> PARTIAL group
+table) plus one jit'd merge program (running table ⊕ split table ->
+running table). The Python loop over splits is the driver; each
+iteration reuses the same compiled executables (static shapes), so HBM
+holds one split batch + two group tables regardless of table size --
+the bounded-batch double-buffering the reference gets from page-sized
+streaming. Host-side split generation overlaps device compute naturally
+(dispatch is async until block_until_ready).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..block import Batch, concat_batches
+from ..connectors import tpch
+from ..ops.aggregation import AggSpec, GroupByResult, group_by, merge_partials
+from ..plan import nodes as N
+from .planner import compile_plan
+
+__all__ = ["streamable_agg_shape", "run_streaming_agg"]
+
+
+def streamable_agg_shape(root: N.PlanNode) -> Optional[Tuple[N.AggregationNode,
+                                                             N.TableScanNode]]:
+    """Detect Output?(Aggregation(linear filter/project pipeline(Scan)))
+    -- the shape streaming supports in round 1 (joins stream via the
+    exchange layer instead)."""
+    node = root.source if isinstance(root, N.OutputNode) else root
+    if not isinstance(node, N.AggregationNode) or node.step != "SINGLE":
+        return None
+    cur = node.source
+    while isinstance(cur, (N.FilterNode, N.ProjectNode)):
+        cur = cur.source
+    if isinstance(cur, N.TableScanNode):
+        return node, cur
+    return None
+
+
+def run_streaming_agg(root: N.PlanNode, sf: float, split_rows: int,
+                      ) -> GroupByResult:
+    """Execute a streamable aggregation plan split by split."""
+    shape = streamable_agg_shape(root)
+    assert shape is not None, "plan is not a streamable aggregation"
+    agg, scan = shape
+
+    # per-split program: pipeline + PARTIAL aggregation
+    partial_node = N.AggregationNode(agg.source, agg.group_channels,
+                                     agg.aggregates, step="PARTIAL",
+                                     max_groups=agg.max_groups)
+    per_split = compile_plan(partial_node)
+    nkeys = len(agg.group_channels)
+
+    @jax.jit
+    def split_step(batch: Batch):
+        out, ovf = per_split.fn((batch,))
+        return out, ovf
+
+    @jax.jit
+    def merge_step(running: Batch, part: Batch):
+        both = concat_batches([running, part])
+        r = merge_partials(both, nkeys, agg.aggregates, agg.max_groups)
+        return r.batch, r.overflow
+
+    total = tpch.table_row_count(scan.table, sf)
+    running: Optional[Batch] = None
+    overflow = False
+    for start in range(0, total, split_rows):
+        count = min(split_rows, total - start)
+        batch = tpch.generate_batch(scan.table, sf, scan.columns,
+                                    start=start, count=count,
+                                    capacity=split_rows)
+        part, ovf1 = split_step(batch)
+        if running is None:
+            running = part
+            overflow = overflow or bool(np.asarray(ovf1))
+        else:
+            running, ovf2 = merge_step(running, part)
+            overflow = overflow or bool(np.asarray(ovf1)) or bool(np.asarray(ovf2))
+    jax.block_until_ready(running)
+
+    import jax.numpy as jnp
+    num_groups = running.count()
+    return GroupByResult(running, num_groups, jnp.asarray(overflow))

@@ -1,0 +1,4 @@
+from .worker import TpuWorkerServer, TaskManager
+from .client import WorkerClient
+
+__all__ = ["TpuWorkerServer", "TaskManager", "WorkerClient"]

@@ -1,0 +1,94 @@
+"""Worker HTTP client: the remote-task / exchange-client consumer side.
+
+Reference surface: HttpRemoteTaskWithEventLoop.java:157 (sendUpdate:981
+POSTing TaskUpdateRequests) and ExchangeClient.java:255 / PageBufferClient
+(token/ack SerializedPage pull) -- collapsed into one small synchronous
+client suitable for tests and cross-slice fetches.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..plan import nodes as N
+from ..serde import PageCodec, deserialize_page
+
+__all__ = ["WorkerClient"]
+
+
+class WorkerClient:
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self.base = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _request(self, method: str, path: str, body: Optional[bytes] = None):
+        req = urllib.request.Request(self.base + path, data=body, method=method)
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return resp.read(), dict(resp.headers)
+
+    def info(self) -> dict:
+        data, _ = self._request("GET", "/v1/info")
+        return json.loads(data)
+
+    def submit(self, task_id: str, plan: N.PlanNode, sf: float = 0.01,
+               session: Optional[dict] = None) -> dict:
+        body = json.dumps({"plan": N.to_json(plan), "sf": sf,
+                           "session": session or {}}).encode()
+        data, _ = self._request("POST", f"/v1/task/{task_id}", body)
+        return json.loads(data)
+
+    def task_info(self, task_id: str) -> dict:
+        data, _ = self._request("GET", f"/v1/task/{task_id}")
+        return json.loads(data)
+
+    def wait(self, task_id: str, timeout: float = 60.0) -> dict:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            info = self.task_info(task_id)
+            if info["state"] in ("FINISHED", "FAILED", "ABORTED"):
+                return info
+            time.sleep(0.05)
+        raise TimeoutError(f"task {task_id} still {info['state']}")
+
+    def fetch_results(self, task_id: str, types: Sequence[T.Type],
+                      codec: PageCodec = PageCodec(), buffer_id: int = 0
+                      ) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """Token/ack pull loop until the buffer reports complete; returns
+        concatenated (values, nulls) per column."""
+        token = 0
+        pages = []
+        while True:
+            data, headers = self._request(
+                "GET", f"/v1/task/{task_id}/results/{buffer_id}/{token}")
+            complete = headers.get("X-Presto-Buffer-Complete") == "true"
+            next_token = int(headers.get("X-Presto-Page-Next-Token", token))
+            if data:
+                pages.append(deserialize_page(data, types, codec))
+                self._request(
+                    "GET",
+                    f"/v1/task/{task_id}/results/{buffer_id}/{next_token}/acknowledge")
+                token = next_token
+            elif complete:
+                break
+            else:
+                time.sleep(0.02)
+        if not pages:
+            return [(np.array([]), np.array([], dtype=bool)) for _ in types]
+        out = []
+        for c in range(len(types)):
+            vals = np.concatenate([p[c][0] for p in pages])
+            nulls = np.concatenate([p[c][1] for p in pages])
+            out.append((vals, nulls))
+        return out
+
+    def abort(self, task_id: str) -> dict:
+        data, _ = self._request("DELETE", f"/v1/task/{task_id}")
+        return json.loads(data)

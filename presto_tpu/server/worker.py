@@ -1,0 +1,273 @@
+"""Worker control/data plane: the TaskResource / TaskManager analog.
+
+Reference surface: the worker REST API contract
+(presto-docs/.../develop/worker-protocol.rst; Java TaskResource.java:79
+createOrUpdate:118 status-long-poll:182 results:283 acknowledge:244;
+C++ presto_cpp/main/TaskResource.cpp + TaskManager.cpp:506) and the
+discovery announcer (presto_cpp/main/Announcer.cpp).
+
+Endpoints (coordinator-facing contract):
+  GET    /v1/info                     server info (node id, state, uptime)
+  GET    /v1/status                   node status (memory, tasks)
+  POST   /v1/task/{taskId}            create/update: body carries the plan
+                                      JSON + scan config (TaskUpdateRequest
+                                      analog); idempotent
+  GET    /v1/task/{taskId}            TaskInfo JSON (state, stats)
+  GET    /v1/task/{taskId}/results/{bufferId}/{token}
+                                      SerializedPage bytes; token/ack pull
+                                      protocol with X-Presto-Page-* headers
+  GET    /v1/task/{taskId}/results/{bufferId}/{token}/acknowledge
+  DELETE /v1/task/{taskId}            abort
+
+Execution runs on a background thread per task (the TPU device stream
+serializes actual kernels); results buffer as SerializedPages with
+monotonically increasing tokens, deleted on ack -- the same
+at-least-once pull contract the reference's ExchangeClient speaks.
+
+This is the Python control-plane shell; the reference keeps its shell in
+C++ for RPC-throughput reasons and a C++ port of this module is planned
+once the protocol stabilizes (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..plan import nodes as N
+from ..serde import PageCodec, serialize_page
+from ..utils.config import Session
+
+__all__ = ["TpuWorkerServer", "TaskManager"]
+
+
+class _Task:
+    def __init__(self, task_id: str):
+        self.task_id = task_id
+        self.state = "PLANNED"  # PLANNED -> RUNNING -> FINISHED/FAILED/ABORTED
+        self.error: Optional[str] = None
+        self.pages: List[bytes] = []        # token -> page bytes
+        self.first_token = 0                # tokens < first_token are acked
+        self.no_more_pages = False
+        self.created_at = time.time()
+        self.stats: Dict[str, float] = {}
+        self.lock = threading.Lock()
+
+    def info(self) -> dict:
+        with self.lock:
+            return {
+                "taskId": self.task_id,
+                "state": self.state,
+                "error": self.error,
+                "bufferedPages": len(self.pages),
+                "noMorePages": self.no_more_pages,
+                "stats": dict(self.stats),
+                "elapsedSeconds": round(time.time() - self.created_at, 3),
+            }
+
+
+class TaskManager:
+    """createOrUpdateTask / result-buffer bookkeeping (TaskManager.cpp:506
+    analog). Owns a worker-wide execution lock: one plan executes on the
+    chip at a time (the TaskExecutor slot analog; multi-stream arrives
+    with task_concurrency)."""
+
+    def __init__(self, sf: float = 0.01, mesh=None):
+        self.sf = sf
+        self.mesh = mesh
+        self.tasks: Dict[str, _Task] = {}
+        self._exec_lock = threading.Lock()
+        self._tasks_lock = threading.Lock()
+
+    def create_or_update(self, task_id: str, body: dict) -> dict:
+        with self._tasks_lock:
+            task = self.tasks.get(task_id)
+            if task is None:
+                task = _Task(task_id)
+                self.tasks[task_id] = task
+                threading.Thread(target=self._run, args=(task, body),
+                                 daemon=True).start()
+        return task.info()
+
+    def _run(self, task: _Task, body: dict):
+        try:
+            with task.lock:
+                task.state = "RUNNING"
+            plan = N.from_json(body["plan"])
+            session = Session(body.get("session", {}))
+            sf = float(body.get("sf", self.sf))
+            codec = PageCodec(
+                compression=(session.get("exchange_compression")
+                             if session.get("exchange_compression") != "none"
+                             else None))
+            from ..exec.runner import run_query
+            t0 = time.time()
+            with self._exec_lock:
+                res = run_query(plan, sf=sf, mesh=self.mesh)
+            wall = time.time() - t0
+            types = plan.output_types()
+            cols = [(types[i], res.columns[i], res.nulls[i])
+                    for i in range(len(res.columns))]
+            page = serialize_page(cols, codec)
+            with task.lock:
+                task.pages.append(page)
+                task.no_more_pages = True
+                task.stats = {"wallSeconds": round(wall, 4),
+                              "outputRows": res.row_count,
+                              "outputBytes": len(page)}
+                task.state = "FINISHED"
+        except Exception as e:  # noqa: BLE001 - task failure is data
+            with task.lock:
+                task.state = "FAILED"
+                task.error = f"{type(e).__name__}: {e}"
+
+    def get(self, task_id: str) -> Optional[_Task]:
+        with self._tasks_lock:
+            return self.tasks.get(task_id)
+
+    def results(self, task_id: str, token: int):
+        """-> (page_bytes|None, next_token, complete). Tokens are absolute;
+        acked pages are dropped but their tokens remain consumed."""
+        task = self.get(task_id)
+        if task is None:
+            return None, token, True
+        with task.lock:
+            idx = token - task.first_token
+            if 0 <= idx < len(task.pages):
+                return task.pages[idx], token + 1, False
+            done = task.no_more_pages or task.state in ("FAILED", "ABORTED")
+            return None, token, done and idx >= len(task.pages)
+
+    def acknowledge(self, task_id: str, token: int):
+        task = self.get(task_id)
+        if task is None:
+            return
+        with task.lock:
+            drop = token - task.first_token
+            if drop > 0:
+                task.pages = task.pages[drop:]
+                task.first_token = token
+
+    def abort(self, task_id: str):
+        task = self.get(task_id)
+        if task is not None:
+            with task.lock:
+                if task.state not in ("FINISHED", "FAILED"):
+                    task.state = "ABORTED"
+                task.pages = []
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "presto-tpu/0.1"
+    protocol_version = "HTTP/1.1"
+
+    # injected by TpuWorkerServer
+    manager: TaskManager = None
+    node_id: str = ""
+    started_at: float = 0.0
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _send_json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_bytes(self, body: bytes, headers: Dict[str, str], code=200):
+        self.send_response(code)
+        self.send_header("Content-Type", "application/x-presto-pages")
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        parts = [p for p in self.path.split("/") if p]
+        if parts == ["v1", "info"]:
+            return self._send_json({
+                "nodeId": self.node_id, "nodeVersion": {"version": "0.1"},
+                "environment": "tpu", "coordinator": False,
+                "uptime": round(time.time() - self.started_at, 1),
+                "state": "ACTIVE"})
+        if parts == ["v1", "status"]:
+            with self.manager._tasks_lock:
+                ntasks = len(self.manager.tasks)
+            return self._send_json({"nodeId": self.node_id,
+                                    "activeTasks": ntasks})
+        if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+            task = self.manager.get(parts[2])
+            if task is None:
+                return self._send_json({"error": "no such task"}, 404)
+            return self._send_json(task.info())
+        if len(parts) == 7 and parts[:2] == ["v1", "task"] and \
+                parts[3] == "results" and parts[6] == "acknowledge":
+            self.manager.acknowledge(parts[2], int(parts[5]))
+            return self._send_json({"acknowledged": True})
+        if len(parts) == 6 and parts[:2] == ["v1", "task"] and parts[3] == "results":
+            task_id, token = parts[2], int(parts[5])
+            page, next_token, complete = self.manager.results(task_id, token)
+            task = self.manager.get(task_id)
+            if task is not None and task.state == "FAILED":
+                return self._send_json({"error": task.error}, 500)
+            headers = {
+                "X-Presto-Task-Instance-Id": task_id,
+                "X-Presto-Page-Token": str(token),
+                "X-Presto-Page-Next-Token": str(next_token),
+                "X-Presto-Buffer-Complete": str(complete).lower(),
+            }
+            return self._send_bytes(page or b"", headers)
+        return self._send_json({"error": f"unknown path {self.path}"}, 404)
+
+    def do_POST(self):  # noqa: N802
+        parts = [p for p in self.path.split("/") if p]
+        if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            info = self.manager.create_or_update(parts[2], body)
+            return self._send_json(info)
+        return self._send_json({"error": f"unknown path {self.path}"}, 404)
+
+    def do_DELETE(self):  # noqa: N802
+        parts = [p for p in self.path.split("/") if p]
+        if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+            self.manager.abort(parts[2])
+            task = self.manager.get(parts[2])
+            return self._send_json(task.info() if task else {"aborted": True})
+        return self._send_json({"error": f"unknown path {self.path}"}, 404)
+
+
+class TpuWorkerServer:
+    """HTTP worker shell (PrestoServer.cpp:493 registerHttpEndpoints
+    analog). start() binds a port and serves on background threads."""
+
+    def __init__(self, port: int = 0, sf: float = 0.01, mesh=None,
+                 node_id: Optional[str] = None):
+        self.manager = TaskManager(sf=sf, mesh=mesh)
+        self.node_id = node_id or f"tpu-worker-{uuid.uuid4().hex[:8]}"
+        handler = type("BoundHandler", (_Handler,), {
+            "manager": self.manager, "node_id": self.node_id,
+            "started_at": time.time()})
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()

@@ -1,0 +1,105 @@
+"""Worker shell tests: a real HTTP server on localhost, driven through
+the client -- the single-process DistributedQueryRunner pattern
+(SURVEY.md §4: multi-node semantics without a cluster)."""
+
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.connectors import tpch
+from presto_tpu.expr import call, const, input_ref
+from presto_tpu.ops.aggregation import AggSpec
+from presto_tpu.plan import (AggregationNode, FilterNode, OutputNode,
+                             TableScanNode, TopNNode)
+from presto_tpu.serde import PageCodec
+from presto_tpu.server import TpuWorkerServer, WorkerClient
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = TpuWorkerServer(sf=0.01).start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    return WorkerClient(f"http://127.0.0.1:{server.port}")
+
+
+def _scan(table, columns):
+    return TableScanNode("tpch", table, columns,
+                         [tpch.column_type(table, c) for c in columns])
+
+
+def q_plan():
+    s = _scan("orders", ["orderkey", "custkey", "totalprice"])
+    agg = AggregationNode(s, [1], [AggSpec("sum", 2, T.decimal(38, 2)),
+                                  AggSpec("count_star", None, T.BIGINT)],
+                          max_groups=1 << 14)
+    top = TopNNode(agg, [(1, True, True)], 5)
+    return OutputNode(top, ["custkey", "spend", "cnt"])
+
+
+def test_info_and_status(client):
+    info = client.info()
+    assert info["state"] == "ACTIVE" and info["nodeId"].startswith("tpu-worker")
+
+
+def test_submit_wait_fetch(client):
+    plan = q_plan()
+    client.submit("t1", plan, sf=0.01)
+    info = client.wait("t1")
+    assert info["state"] == "FINISHED", info
+    assert info["stats"]["outputRows"] == 5
+    cols = client.fetch_results("t1", plan.output_types())
+    spend = cols[1][0]
+    assert len(spend) == 5
+    assert list(spend) == sorted(spend, reverse=True)
+    # oracle: top spender
+    oc = tpch.generate_columns("orders", 0.01, ["custkey", "totalprice"])
+    import collections
+    want = collections.Counter()
+    for ck, tp in zip(oc["custkey"], oc["totalprice"]):
+        want[ck] += int(tp)
+    best = max(want.values())
+    assert spend[0] == best
+
+
+def test_idempotent_create(client):
+    plan = q_plan()
+    a = client.submit("t2", plan)
+    b = client.submit("t2", plan)  # second update must not re-execute
+    info = client.wait("t2")
+    assert info["state"] == "FINISHED"
+
+
+def test_task_failure_reported(client):
+    bad = OutputNode(TableScanNode("tpch", "nope_table", ["x"], [T.BIGINT]),
+                     ["x"])
+    client.submit("t3", bad)
+    info = client.wait("t3")
+    assert info["state"] == "FAILED"
+    assert "nope_table" in info["error"] or "KeyError" in info["error"]
+
+
+def test_unknown_task_404(client):
+    with pytest.raises(Exception):
+        client.task_info("missing")
+
+
+def test_abort(client):
+    plan = q_plan()
+    client.submit("t4", plan)
+    client.abort("t4")
+    info = client.task_info("t4")
+    assert info["state"] in ("ABORTED", "FINISHED")  # may already be done
+
+
+def test_compressed_results(client):
+    plan = q_plan()
+    client.submit("t5", plan, session={"exchange_compression": "zstd"})
+    client.wait("t5")
+    cols = client.fetch_results("t5", plan.output_types(),
+                                PageCodec(compression="zstd"))
+    assert len(cols[0][0]) == 5

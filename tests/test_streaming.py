@@ -1,0 +1,46 @@
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.connectors import tpch
+from presto_tpu.exec import run_query
+from presto_tpu.expr import call, const, input_ref
+from presto_tpu.ops.aggregation import AggSpec
+from presto_tpu.plan import (AggregationNode, FilterNode, OutputNode,
+                             ProjectNode, TableScanNode)
+
+
+def plan():
+    cols = ["returnflag", "quantity", "shipdate"]
+    s = TableScanNode("tpch", "lineitem", cols,
+                      [tpch.column_type("lineitem", c) for c in cols])
+    f = FilterNode(s, call("le", T.BOOLEAN, input_ref(2, T.DATE),
+                           const("1998-09-02", T.DATE)))
+    agg = AggregationNode(f, [0], [
+        AggSpec("sum", 1, T.decimal(38, 2)),
+        AggSpec("count_star", None, T.BIGINT),
+        AggSpec("min", 1, T.decimal(12, 2)),
+        AggSpec("avg", 1, T.decimal(12, 2))], max_groups=16)
+    return OutputNode(agg, ["rf", "sum_qty", "cnt", "min_qty",
+                            "avg_sum", "avg_cnt"])
+
+
+def as_map(res):
+    return {r[0]: r[1:] for r in res.rows()}
+
+
+def test_streaming_matches_single_batch():
+    whole = as_map(run_query(plan(), sf=0.02))
+    streamed = as_map(run_query(plan(), sf=0.02, split_rows=8192))
+    assert whole == streamed
+    # also with a split size that doesn't divide the row count
+    streamed2 = as_map(run_query(plan(), sf=0.02, split_rows=10000))
+    assert whole == streamed2
+
+
+def test_streaming_bounded_capacity():
+    # 120k rows with 4k splits: device batches never exceed 4k rows
+    res = run_query(plan(), sf=0.02, split_rows=4096)
+    c = tpch.generate_columns("lineitem", 0.02, ["shipdate"])
+    cutoff = int((np.datetime64("1998-09-02") - np.datetime64("1970-01-01"))
+                 .astype(int))
+    assert sum(r[2] for r in res.rows()) == int((c["shipdate"] <= cutoff).sum())
