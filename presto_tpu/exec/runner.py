@@ -76,20 +76,10 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
         from .streaming import run_streaming_agg, streamable_agg_shape
         if streamable_agg_shape(root) is not None:
             r = run_streaming_agg(root, sf, split_rows)
-            out = r.batch
             if bool(np.asarray(r.overflow)):
                 raise RuntimeError("streaming aggregation overflowed "
                                    "max_groups; raise AggregationNode.max_groups")
-            act = np.asarray(out.active)
-            sel = np.nonzero(act)[0]
-            cols, nulls = [], []
-            for c in range(out.num_columns):
-                v, n = to_numpy(out.column(c))
-                cols.append(v[sel])
-                nulls.append(n[sel])
-            names = root.names if isinstance(root, N.OutputNode) else \
-                [f"col{i}" for i in range(out.num_columns)]
-            return QueryResult(cols, nulls, names, len(sel))
+            return _batch_to_result(r.batch, root)
     plan = compile_plan(root, mesh, default_join_capacity)
     pad = (mesh.devices.size if mesh is not None else 1) * 8
     hints = capacity_hints or {}
@@ -102,7 +92,10 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
         raise RuntimeError(
             "plan execution overflowed a static bucket (join/exchange/"
             "group capacity); rerun with larger capacity_hints")
+    return _batch_to_result(out, root)
 
+
+def _batch_to_result(out: Batch, root: N.PlanNode) -> QueryResult:
     act = np.asarray(out.active)
     idx = np.nonzero(act)[0]
     cols, nulls = [], []

@@ -40,6 +40,8 @@ def streamable_agg_shape(root: N.PlanNode) -> Optional[Tuple[N.AggregationNode,
     node = root.source if isinstance(root, N.OutputNode) else root
     if not isinstance(node, N.AggregationNode) or node.step != "SINGLE":
         return None
+    if any(a.canonical == "count_distinct" for a in node.aggregates):
+        return None  # distinct states don't merge across splits
     cur = node.source
     while isinstance(cur, (N.FilterNode, N.ProjectNode)):
         cur = cur.source
@@ -73,23 +75,27 @@ def run_streaming_agg(root: N.PlanNode, sf: float, split_rows: int,
         r = merge_partials(both, nkeys, agg.aggregates, agg.max_groups)
         return r.batch, r.overflow
 
+    import jax.numpy as jnp
+
     total = tpch.table_row_count(scan.table, sf)
     running: Optional[Batch] = None
-    overflow = False
-    for start in range(0, total, split_rows):
-        count = min(split_rows, total - start)
+    overflow = jnp.zeros((), dtype=bool)  # accumulates on device: no
+    # per-split host sync, so split generation overlaps device compute
+    starts = list(range(0, total, split_rows)) or [0]  # empty table: one
+    # empty split still produces a well-formed (empty) group table
+    for start in starts:
+        count = min(split_rows, max(total - start, 0))
         batch = tpch.generate_batch(scan.table, sf, scan.columns,
                                     start=start, count=count,
                                     capacity=split_rows)
         part, ovf1 = split_step(batch)
+        overflow = overflow | ovf1
         if running is None:
             running = part
-            overflow = overflow or bool(np.asarray(ovf1))
         else:
             running, ovf2 = merge_step(running, part)
-            overflow = overflow or bool(np.asarray(ovf1)) or bool(np.asarray(ovf2))
+            overflow = overflow | ovf2
     jax.block_until_ready(running)
 
-    import jax.numpy as jnp
     num_groups = running.count()
-    return GroupByResult(running, num_groups, jnp.asarray(overflow))
+    return GroupByResult(running, num_groups, overflow)

@@ -51,12 +51,14 @@ class WorkerClient:
 
     def wait(self, task_id: str, timeout: float = 60.0) -> dict:
         deadline = time.time() + timeout
+        info = None
         while time.time() < deadline:
             info = self.task_info(task_id)
             if info["state"] in ("FINISHED", "FAILED", "ABORTED"):
                 return info
             time.sleep(0.05)
-        raise TimeoutError(f"task {task_id} still {info['state']}")
+        state = info["state"] if info else "<never polled>"
+        raise TimeoutError(f"task {task_id} still {state}")
 
     def fetch_results(self, task_id: str, types: Sequence[T.Type],
                       codec: PageCodec = PageCodec(), buffer_id: int = 0

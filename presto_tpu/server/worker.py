@@ -133,10 +133,12 @@ class TaskManager:
 
     def results(self, task_id: str, token: int):
         """-> (page_bytes|None, next_token, complete). Tokens are absolute;
-        acked pages are dropped but their tokens remain consumed."""
+        acked pages are dropped but their tokens remain consumed.
+        Unknown task ids raise (the HTTP layer 404s, matching the task-info
+        endpoint, so a typo'd id is distinguishable from an empty result)."""
         task = self.get(task_id)
         if task is None:
-            return None, token, True
+            raise KeyError(task_id)
         with task.lock:
             idx = token - task.first_token
             if 0 <= idx < len(task.pages):
@@ -216,7 +218,10 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_json({"acknowledged": True})
         if len(parts) == 6 and parts[:2] == ["v1", "task"] and parts[3] == "results":
             task_id, token = parts[2], int(parts[5])
-            page, next_token, complete = self.manager.results(task_id, token)
+            try:
+                page, next_token, complete = self.manager.results(task_id, token)
+            except KeyError:
+                return self._send_json({"error": f"no such task {task_id}"}, 404)
             task = self.manager.get(task_id)
             if task is not None and task.state == "FAILED":
                 return self._send_json({"error": task.error}, 500)
