@@ -193,6 +193,29 @@ def evaluate(expr: RowExpression, batch: Batch) -> Block:
                 raise NotImplementedError(f"date_add unit {unit.value!r}")
             return Column(vals, F._default_nulls(n, d), expr.type)
 
+        if name == "date_trunc":
+            unit = expr.arguments[0]
+            assert isinstance(unit, Constant)
+            d = evaluate(expr.arguments[1], batch)
+            vals = F.date_trunc_kernel(str(unit.value), d.values).astype(
+                d.values.dtype)
+            return Column(vals, d.nulls, expr.type)
+        if name == "date_diff":
+            unit = expr.arguments[0]
+            assert isinstance(unit, Constant)
+            d1 = evaluate(expr.arguments[1], batch)
+            d2 = evaluate(expr.arguments[2], batch)
+            vals = F.date_diff_kernel(str(unit.value), d1.values, d2.values)
+            return Column(vals.astype(expr.type.to_dtype()),
+                          F._default_nulls(d1, d2), expr.type)
+        if name == "split_part":
+            a = evaluate(expr.arguments[0], batch)
+            delim = expr.arguments[1]
+            idx = expr.arguments[2]
+            assert isinstance(delim, Constant) and isinstance(idx, Constant)
+            return F.split_part_kernel(a, str(delim.value).encode(),
+                                       int(idx.value), expr.type)
+
         args = [evaluate(a, batch) for a in expr.arguments]
         sf = F.lookup(name)
         out = sf.fn(expr.type, *args)

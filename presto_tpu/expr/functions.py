@@ -583,6 +583,178 @@ def _strpos(ret, a: StringColumn, b: StringColumn):
     return _col(ret, jnp.where(found, first + 1, 0).astype(ret.to_dtype()), a, b)
 
 
+@register("sign")
+def _sign(ret, a):
+    return _col(ret, jnp.sign(a.values).astype(ret.to_dtype()), a)
+
+
+@register("truncate")
+def _truncate(ret, a, *rest):
+    if a.type.is_decimal and not rest:
+        f = _POW10[a.type.scale]
+        v = jnp.where(a.values >= 0, a.values // f, -((-a.values) // f))
+        return _col(ret, rescale_decimal(v, 0, _scale_of(ret)), a)
+    x = a.values.astype(jnp.float64)
+    return _col(ret, jnp.trunc(x).astype(ret.to_dtype()), a)
+
+
+REGISTRY["mod"] = REGISTRY["modulus"]
+
+
+def _null_safe_eq_nulls(ret, a, b):
+    return jnp.zeros(len(a), dtype=bool)  # IS [NOT] DISTINCT FROM is never null
+
+
+@register("is_distinct_from", null_fn=_null_safe_eq_nulls)
+def _is_distinct_from(ret, a, b):
+    eq = _binary_cmp("eq")(T.BOOLEAN, a, b)
+    both_null = a.nulls & b.nulls
+    same = both_null | (~a.nulls & ~b.nulls & eq.values)
+    return Column(~same, jnp.zeros(len(a), dtype=bool), ret)
+
+
+@register("is_not_distinct_from", null_fn=_null_safe_eq_nulls)
+def _is_not_distinct_from(ret, a, b):
+    d = _is_distinct_from(T.BOOLEAN, a, b)
+    return Column(~d.values, jnp.zeros(len(a), dtype=bool), ret)
+
+
+# ---------------------------------------------------------------------------
+# more datetime kernels (unit arguments are compile-time constants,
+# specialized by the compiler like date_add)
+# ---------------------------------------------------------------------------
+
+def date_trunc_kernel(unit: str, days):
+    y, m, d = _civil(days)
+    one = jnp.ones_like(y)
+    if unit == "day":
+        return days
+    if unit == "week":  # ISO Monday
+        return days - (days.astype(jnp.int64) + 3) % 7
+    if unit == "month":
+        return _days_from_civil(y, m, one)
+    if unit == "quarter":
+        return _days_from_civil(y, ((m - 1) // 3) * 3 + 1, one)
+    if unit == "year":
+        return _days_from_civil(y, one, one)
+    raise NotImplementedError(f"date_trunc unit {unit!r}")
+
+
+def date_diff_kernel(unit: str, d1, d2):
+    """Presto date_diff(unit, start, end) = end - start in whole units,
+    truncated toward zero."""
+    if unit == "day":
+        return (d2 - d1).astype(jnp.int64)
+    if unit == "week":
+        delta = (d2 - d1).astype(jnp.int64)
+        return jnp.sign(delta) * (jnp.abs(delta) // 7)
+    y1, m1, dd1 = _civil(d1)
+    y2, m2, dd2 = _civil(d2)
+    months = (y2 * 12 + m2) - (y1 * 12 + m1)
+    # truncate partial months toward zero
+    adj = jnp.where((months > 0) & (dd2 < dd1), 1,
+                    jnp.where((months < 0) & (dd2 > dd1), -1, 0))
+    months = months - adj
+    if unit == "month":
+        return months
+    if unit == "quarter":
+        return jnp.sign(months) * (jnp.abs(months) // 3)
+    if unit == "year":
+        return jnp.sign(months) * (jnp.abs(months) // 12)
+    raise NotImplementedError(f"date_diff unit {unit!r}")
+
+
+@register("last_day_of_month")
+def _last_day_of_month(ret, a):
+    y, m, d = _civil(_as_days(a))
+    ny = jnp.where(m == 12, y + 1, y)
+    nm = jnp.where(m == 12, 1, m + 1)
+    v = _days_from_civil(ny, nm, jnp.ones_like(y)) - 1
+    return _col(ret, v.astype(ret.to_dtype()), a)
+
+
+# ---------------------------------------------------------------------------
+# more string kernels
+# ---------------------------------------------------------------------------
+
+@register("reverse")
+def _reverse(ret, a: StringColumn):
+    n, w = a.chars.shape
+    pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+    idx = jnp.clip(a.lengths[:, None] - 1 - pos, 0, w - 1)
+    out = jnp.take_along_axis(a.chars, idx, axis=1)
+    out = jnp.where(pos < a.lengths[:, None], out, 0).astype(jnp.uint8)
+    return StringColumn(out, a.lengths, a.nulls, ret)
+
+
+@register("ltrim")
+def _ltrim(ret, a: StringColumn):
+    n, w = a.chars.shape
+    pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+    is_sp = (a.chars == 32) | (pos >= a.lengths[:, None])
+    first = jnp.argmin(is_sp, axis=1).astype(jnp.int32)
+    all_sp = jnp.all(is_sp, axis=1)
+    st = jnp.where(all_sp, 0, first)
+    ln = jnp.where(all_sp, 0, a.lengths - st)
+    idx = jnp.clip(st[:, None] + pos, 0, w - 1)
+    out = jnp.where(pos < ln[:, None],
+                    jnp.take_along_axis(a.chars, idx, axis=1), 0)
+    return StringColumn(out.astype(jnp.uint8), ln, a.nulls, ret)
+
+
+@register("rtrim")
+def _rtrim(ret, a: StringColumn):
+    n, w = a.chars.shape
+    pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+    is_sp = (a.chars == 32) | (pos >= a.lengths[:, None])
+    all_sp = jnp.all(is_sp, axis=1)
+    last = (w - 1 - jnp.argmin(is_sp[:, ::-1], axis=1)).astype(jnp.int32)
+    ln = jnp.where(all_sp, 0, last + 1)
+    out = jnp.where(pos < ln[:, None], a.chars, 0)
+    return StringColumn(out.astype(jnp.uint8), ln, a.nulls, ret)
+
+
+@register("chr")
+def _chr(ret, a: Column):
+    v = jnp.clip(a.values, 0, 255).astype(jnp.uint8)[:, None]
+    return StringColumn(v, jnp.ones(len(a), dtype=jnp.int32), a.nulls, ret)
+
+
+@register("codepoint")
+def _codepoint(ret, a: StringColumn):
+    v = a.chars[:, 0].astype(ret.to_dtype())
+    return _col(ret, v, a)
+
+
+REGISTRY["position"] = REGISTRY["strpos"]
+
+
+def split_part_kernel(a: StringColumn, delim: bytes, index: int, ret):
+    """split_part(s, delim, n): the n-th (1-based) field. Constant delim
+    of length 1 in round 1 (covers the common CSV-ish uses)."""
+    assert len(delim) == 1, "split_part delimiter must be 1 byte in round 1"
+    n, w = a.chars.shape
+    pos = jnp.arange(w, dtype=jnp.int32)[None, :]
+    in_str = pos < a.lengths[:, None]
+    is_d = (a.chars == delim[0]) & in_str
+    field = jnp.cumsum(is_d, axis=1) - is_d.astype(jnp.int32)  # field id per char
+    target = index - 1
+    sel = (field == target) & ~is_d & in_str
+    ln = jnp.sum(sel, axis=1).astype(jnp.int32)
+    # start = first position with field==target that's not a delimiter
+    has = jnp.any(sel, axis=1)
+    st = jnp.argmax(sel, axis=1).astype(jnp.int32)
+    idx = jnp.clip(st[:, None] + pos, 0, w - 1)
+    g = jnp.take_along_axis(a.chars, idx, axis=1)
+    out = jnp.where(pos < ln[:, None], g, 0).astype(jnp.uint8)
+    ln = jnp.where(has, ln, 0)
+    # index beyond field count -> empty string (Presto returns NULL if
+    # index > fields; approximate with NULL via nulls flag)
+    nfields = jnp.sum(is_d, axis=1) + 1
+    nulls = a.nulls | (index > nfields)
+    return StringColumn(out, ln, nulls, ret)
+
+
 # ---------------------------------------------------------------------------
 # casts (one registry entry; dispatch on (from, to))
 # ---------------------------------------------------------------------------

@@ -3,8 +3,10 @@ from .nodes import (PlanNode, TableScanNode, ValuesNode, FilterNode,
                     SortNode, TopNNode, LimitNode, DistinctNode, ExchangeNode,
                     OutputNode, from_json, to_json)
 from .fragment import PlanFragment, fragment_plan
+from .explain import explain, explain_distributed
 
 __all__ = ["PlanNode", "TableScanNode", "ValuesNode", "FilterNode",
            "ProjectNode", "AggregationNode", "JoinNode", "SemiJoinNode",
            "SortNode", "TopNNode", "LimitNode", "DistinctNode", "ExchangeNode",
-           "OutputNode", "from_json", "to_json", "PlanFragment", "fragment_plan"]
+           "OutputNode", "from_json", "to_json", "PlanFragment", "fragment_plan",
+           "explain", "explain_distributed"]

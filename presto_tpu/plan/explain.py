@@ -1,0 +1,82 @@
+"""EXPLAIN: textual plan rendering.
+
+Reference surface: the EXPLAIN/EXPLAIN (TYPE DISTRIBUTED) plan printer
+(sql/planner/planPrinter/ in presto-main-base) that renders the plan
+tree with per-node details and fragment boundaries.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from . import nodes as N
+from .fragment import fragment_plan
+
+__all__ = ["explain", "explain_distributed"]
+
+
+def _node_line(n: N.PlanNode) -> str:
+    if isinstance(n, N.TableScanNode):
+        return f"TableScan[{n.connector}.{n.table} columns={n.columns}]"
+    if isinstance(n, N.ValuesNode):
+        return f"Values[{len(n.rows)} rows]"
+    if isinstance(n, N.FilterNode):
+        return f"Filter[{n.predicate}]"
+    if isinstance(n, N.ProjectNode):
+        exprs = ", ".join(str(e) for e in n.expressions)
+        return f"Project[{exprs}]"
+    if isinstance(n, N.AggregationNode):
+        aggs = ", ".join(f"{a.name}({'*' if a.input_channel is None else f'ch{a.input_channel}'})"
+                         for a in n.aggregates)
+        return (f"Aggregate[{n.step} keys=ch{n.group_channels} {aggs} "
+                f"maxGroups={n.max_groups}]")
+    if isinstance(n, N.JoinNode):
+        return (f"Join[{n.join_type.upper()} {n.distribution} "
+                f"left{n.left_keys}=right{n.right_keys}]")
+    if isinstance(n, N.SemiJoinNode):
+        return f"SemiJoin[ch{n.source_key} IN filteringSource ch{n.filtering_key}]"
+    if isinstance(n, N.SortNode):
+        return f"Sort[{_keys(n.keys)}]"
+    if isinstance(n, N.TopNNode):
+        return f"TopN[{n.count} by {_keys(n.keys)}]"
+    if isinstance(n, N.LimitNode):
+        return f"Limit[{n.count}]"
+    if isinstance(n, N.DistinctNode):
+        return f"Distinct[keys={n.key_channels or 'all'}]"
+    if isinstance(n, N.ExchangeNode):
+        part = f" by ch{n.partition_channels}" if n.partition_channels else ""
+        return f"{n.scope.title()}Exchange[{n.kind}{part}]"
+    if isinstance(n, N.OutputNode):
+        return f"Output[{n.names}]"
+    return type(n).__name__
+
+
+def _keys(keys) -> str:
+    return ", ".join(f"ch{c} {'DESC' if d else 'ASC'}"
+                     f"{' NULLS LAST' if nl else ' NULLS FIRST'}"
+                     for c, d, nl in keys)
+
+
+def explain(root: N.PlanNode) -> str:
+    """Single-plan tree rendering (EXPLAIN (TYPE LOGICAL) analog)."""
+    lines: List[str] = []
+
+    def walk(n: N.PlanNode, depth: int):
+        lines.append("    " * depth + "- " + _node_line(n))
+        for s in n.sources:
+            walk(s, depth + 1)
+
+    walk(root, 0)
+    return "\n".join(lines)
+
+
+def explain_distributed(root: N.PlanNode) -> str:
+    """Fragment-by-fragment rendering (EXPLAIN (TYPE DISTRIBUTED) analog)."""
+    out: List[str] = []
+    for frag in fragment_plan(root):
+        out.append(f"Fragment {frag.id} [{frag.partitioning}]"
+                   + (f" <- fragments {frag.remote_sources}"
+                      if frag.remote_sources else ""))
+        out.append(explain(frag.root))
+        out.append("")
+    return "\n".join(out).rstrip()

@@ -1,0 +1,103 @@
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.block import batch_from_numpy, to_numpy
+from presto_tpu.expr import call, compile_projections, const, input_ref
+
+
+def ev(e, b):
+    return to_numpy(compile_projections([e])(b).column(0))
+
+
+def days(s):
+    return int((np.datetime64(s) - np.datetime64("1970-01-01")).astype(int))
+
+
+def date_batch(*dates):
+    return batch_from_numpy([T.DATE], [np.array([days(d) for d in dates],
+                                                dtype=np.int32)])
+
+
+def test_date_trunc():
+    b = date_batch("1995-07-14", "1996-01-01", "1995-07-14")
+    for unit, want in [("year", "1995-01-01"), ("quarter", "1995-07-01"),
+                       ("month", "1995-07-01"), ("week", "1995-07-10"),
+                       ("day", "1995-07-14")]:
+        e = call("date_trunc", T.DATE, const(unit, T.varchar(7)),
+                 input_ref(0, T.DATE))
+        v, _ = ev(e, b)
+        assert v[0] == days(want), (unit, np.datetime64("1970-01-01") + v[0])
+
+
+def test_date_diff():
+    b = batch_from_numpy([T.DATE, T.DATE],
+                         [np.array([days("1994-01-15")], dtype=np.int32),
+                          np.array([days("1996-03-14")], dtype=np.int32)])
+    cases = {"day": 789, "week": 112, "month": 25, "quarter": 8, "year": 2}
+    for unit, want in cases.items():
+        e = call("date_diff", T.BIGINT, const(unit, T.varchar(7)),
+                 input_ref(0, T.DATE), input_ref(1, T.DATE))
+        v, _ = ev(e, b)
+        assert v[0] == want, (unit, v[0])
+
+
+def test_sign_truncate_mod():
+    b = batch_from_numpy([T.BIGINT], [np.array([-5, 0, 7])])
+    v, _ = ev(call("sign", T.BIGINT, input_ref(0, T.BIGINT)), b)
+    assert list(v) == [-1, 0, 1]
+    d = batch_from_numpy([T.decimal(10, 2)], [np.array([-155, 155])])
+    v, _ = ev(call("truncate", T.decimal(10, 2), input_ref(0, T.decimal(10, 2))), d)
+    assert list(v) == [-100, 100]
+    v, _ = ev(call("mod", T.BIGINT, input_ref(0, T.BIGINT), const(3, T.BIGINT)), b)
+    assert list(v) == [-2, 0, 1]
+
+
+def test_is_distinct_from():
+    b = batch_from_numpy([T.BIGINT, T.BIGINT],
+                         [np.array([1, 1, 2]), np.array([1, 5, 2])],
+                         nulls=[np.array([False, True, False]),
+                                np.array([False, True, False])])
+    e = call("is_distinct_from", T.BOOLEAN, input_ref(0, T.BIGINT),
+             input_ref(1, T.BIGINT))
+    v, n = ev(e, b)
+    assert list(v) == [False, False, False]  # NULL vs NULL -> not distinct
+    assert not n.any()
+
+
+def test_string_breadth():
+    b = batch_from_numpy([T.varchar(12)],
+                         [np.array(["hello", "  pad  ", "a,b,c"], dtype=object)])
+    x = input_ref(0, T.varchar(12))
+    v, _ = ev(call("reverse", T.varchar(12), x), b)
+    assert v[0] == "olleh"
+    v, _ = ev(call("ltrim", T.varchar(12), x), b)
+    assert v[1] == "pad  "
+    v, _ = ev(call("rtrim", T.varchar(12), x), b)
+    assert v[1] == "  pad"
+    e = call("split_part", T.varchar(12), x, const(",", T.varchar(1)),
+             const(2, T.BIGINT))
+    v, n = ev(e, b)
+    assert v[2] == "b"
+    assert n[0]  # "hello" has only 1 field -> NULL for index 2
+    v, _ = ev(call("codepoint", T.BIGINT,
+                   call("chr", T.varchar(1), const(65, T.BIGINT))), b)
+    assert v[0] == 65
+
+
+def test_explain_renders():
+    from presto_tpu.connectors import tpch
+    from presto_tpu.ops.aggregation import AggSpec
+    from presto_tpu.plan import (AggregationNode, ExchangeNode, OutputNode,
+                                 TableScanNode, explain, explain_distributed)
+    s = TableScanNode("tpch", "lineitem", ["quantity"],
+                      [tpch.column_type("lineitem", "quantity")])
+    agg = AggregationNode(s, [], [AggSpec("sum", 0, T.decimal(38, 2))],
+                          step="PARTIAL", max_groups=1)
+    ex = ExchangeNode(agg, kind="GATHER", scope="REMOTE")
+    root = OutputNode(AggregationNode(ex, [], [AggSpec("sum", 0, T.decimal(38, 2))],
+                                      step="FINAL", max_groups=1), ["s"])
+    text = explain(root)
+    assert "TableScan[tpch.lineitem" in text and "RemoteExchange[GATHER]" in text
+    dist = explain_distributed(root)
+    assert "Fragment 0" in dist and "Fragment 1" in dist

@@ -37,6 +37,37 @@ def test_streaming_matches_single_batch():
     assert whole == streamed2
 
 
+def test_grouped_execution_high_cardinality():
+    # group by orderkey (30k groups at sf 0.02) with per-bucket tables of
+    # only 8192 slots: grouped execution must cover all groups exactly
+    from presto_tpu.exec.streaming import run_grouped_agg
+    from presto_tpu.block import to_numpy
+    cols = ["orderkey", "quantity"]
+    s = TableScanNode("tpch", "lineitem", cols,
+                      [tpch.column_type("lineitem", c) for c in cols])
+    agg = AggregationNode(s, [0], [AggSpec("sum", 1, T.decimal(38, 2)),
+                                   AggSpec("count_star", None, T.BIGINT)],
+                          max_groups=8192)
+    root = OutputNode(agg, ["orderkey", "sum_qty", "cnt"])
+    buckets = run_grouped_agg(root, sf=0.02, split_rows=16384, n_buckets=8)
+    got = {}
+    for r in buckets:
+        assert not bool(np.asarray(r.overflow))
+        act = np.asarray(r.batch.active)
+        k, _ = to_numpy(r.batch.column(0))
+        sq, _ = to_numpy(r.batch.column(1))
+        c, _ = to_numpy(r.batch.column(2))
+        for i in np.nonzero(act)[0]:
+            assert int(k[i]) not in got  # buckets are disjoint
+            got[int(k[i])] = (int(sq[i]), int(c[i]))
+    li = tpch.generate_columns("lineitem", 0.02, cols)
+    want = {}
+    for ok, q in zip(li["orderkey"], li["quantity"]):
+        s0, c0 = want.get(int(ok), (0, 0))
+        want[int(ok)] = (s0 + int(q), c0 + 1)
+    assert got == want
+
+
 def test_streaming_bounded_capacity():
     # 120k rows with 4k splits: device batches never exceed 4k rows
     res = run_query(plan(), sf=0.02, split_rows=4096)
