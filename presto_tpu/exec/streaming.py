@@ -51,17 +51,12 @@ def streamable_agg_shape(root: N.PlanNode) -> Optional[Tuple[N.AggregationNode,
     return None
 
 
-def run_streaming_agg(root: N.PlanNode, sf: float, split_rows: int,
-                      n_buckets: int = 1, bucket: int = 0) -> GroupByResult:
-    """Execute a streamable aggregation plan split by split.
-
-    With n_buckets > 1 this is one lifespan of grouped execution
-    (execution/Lifespan.java:30, GroupedExecutionTagger.java:72 analog):
-    only rows whose group-key hash lands in `bucket` are aggregated, so
-    the dense table covers ~1/n_buckets of the groups. The caller runs
-    buckets sequentially (run_grouped_agg) -- trading extra scan passes
-    for bounded HBM, exactly the reference's bucket-by-bucket memory
-    bound (and its recovery unit)."""
+def _make_agg_executor(root: N.PlanNode, sf: float, split_rows: int,
+                       n_buckets: int):
+    """Build the jit'd per-split and merge programs ONCE; the returned
+    runner executes one bucket lifespan. Buckets share the compiled
+    executables (bucket id is a traced device scalar), so grouped
+    execution pays n_buckets scan passes but a single compilation."""
     shape = streamable_agg_shape(root)
     assert shape is not None, "plan is not a streamable aggregation"
     agg, scan = shape
@@ -87,28 +82,43 @@ def run_streaming_agg(root: N.PlanNode, sf: float, split_rows: int,
         return r.batch, r.overflow
 
     total = tpch.table_row_count(scan.table, sf)
-    running: Optional[Batch] = None
-    overflow = jnp.zeros((), dtype=bool)  # accumulates on device: no
-    # per-split host sync, so split generation overlaps device compute
     starts = list(range(0, total, split_rows)) or [0]  # empty table: one
     # empty split still produces a well-formed (empty) group table
-    bucket_arr = jnp.asarray(bucket, dtype=jnp.int32)
-    for start in starts:
-        count = min(split_rows, max(total - start, 0))
-        batch = tpch.generate_batch(scan.table, sf, scan.columns,
-                                    start=start, count=count,
-                                    capacity=split_rows)
-        part, ovf1 = split_step(batch, bucket_arr)
-        overflow = overflow | ovf1
-        if running is None:
-            running = part
-        else:
-            running, ovf2 = merge_step(running, part)
-            overflow = overflow | ovf2
-    jax.block_until_ready(running)
 
-    num_groups = running.count()
-    return GroupByResult(running, num_groups, overflow)
+    def run(bucket: int) -> GroupByResult:
+        running: Optional[Batch] = None
+        overflow = jnp.zeros((), dtype=bool)  # accumulates on device: no
+        # per-split host sync, so split generation overlaps device compute
+        bucket_arr = jnp.asarray(bucket, dtype=jnp.int32)
+        for start in starts:
+            count = min(split_rows, max(total - start, 0))
+            batch = tpch.generate_batch(scan.table, sf, scan.columns,
+                                        start=start, count=count,
+                                        capacity=split_rows)
+            part, ovf1 = split_step(batch, bucket_arr)
+            overflow = overflow | ovf1
+            if running is None:
+                running = part
+            else:
+                running, ovf2 = merge_step(running, part)
+                overflow = overflow | ovf2
+        jax.block_until_ready(running)
+        return GroupByResult(running, running.count(), overflow)
+
+    return run
+
+
+def run_streaming_agg(root: N.PlanNode, sf: float, split_rows: int,
+                      n_buckets: int = 1, bucket: int = 0) -> GroupByResult:
+    """Execute a streamable aggregation plan split by split.
+
+    With n_buckets > 1 this is one lifespan of grouped execution
+    (execution/Lifespan.java:30, GroupedExecutionTagger.java:72 analog):
+    only rows whose group-key hash lands in `bucket` are aggregated, so
+    the dense table covers ~1/n_buckets of the groups -- trading extra
+    scan passes for bounded HBM, exactly the reference's bucket-by-bucket
+    memory bound (and its recovery unit)."""
+    return _make_agg_executor(root, sf, split_rows, n_buckets)(bucket)
 
 
 def run_grouped_agg(root: N.PlanNode, sf: float, split_rows: int,
@@ -117,5 +127,5 @@ def run_grouped_agg(root: N.PlanNode, sf: float, split_rows: int,
     buckets' group sets are disjoint, so the concatenated tables are the
     full result. Peak HBM = one split batch + two bucket-sized group
     tables, independent of total group count."""
-    return [run_streaming_agg(root, sf, split_rows, n_buckets, b)
-            for b in range(n_buckets)]
+    runner = _make_agg_executor(root, sf, split_rows, n_buckets)
+    return [runner(b) for b in range(n_buckets)]

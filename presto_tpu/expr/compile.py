@@ -197,6 +197,8 @@ def evaluate(expr: RowExpression, batch: Batch) -> Block:
             unit = expr.arguments[0]
             assert isinstance(unit, Constant)
             d = evaluate(expr.arguments[1], batch)
+            assert d.type.base == "date", \
+                "date_trunc over timestamps lands with timestamp kernels"
             vals = F.date_trunc_kernel(str(unit.value), d.values).astype(
                 d.values.dtype)
             return Column(vals, d.nulls, expr.type)
@@ -205,6 +207,8 @@ def evaluate(expr: RowExpression, batch: Batch) -> Block:
             assert isinstance(unit, Constant)
             d1 = evaluate(expr.arguments[1], batch)
             d2 = evaluate(expr.arguments[2], batch)
+            assert d1.type.base == "date" and d2.type.base == "date", \
+                "date_diff over timestamps lands with timestamp kernels"
             vals = F.date_diff_kernel(str(unit.value), d1.values, d2.values)
             return Column(vals.astype(expr.type.to_dtype()),
                           F._default_nulls(d1, d2), expr.type)

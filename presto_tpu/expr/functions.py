@@ -590,11 +590,29 @@ def _sign(ret, a):
 
 @register("truncate")
 def _truncate(ret, a, *rest):
-    if a.type.is_decimal and not rest:
-        f = _POW10[a.type.scale]
-        v = jnp.where(a.values >= 0, a.values // f, -((-a.values) // f))
-        return _col(ret, rescale_decimal(v, 0, _scale_of(ret)), a)
+    if a.type.is_decimal:
+        s = a.type.scale
+        if not rest:
+            f = _POW10[s]
+            v = jnp.where(a.values >= 0, a.values // f, -((-a.values) // f))
+            return _col(ret, rescale_decimal(v, 0, _scale_of(ret)), a)
+        # truncate(decimal, d): zero digits below 10^-d, keep the scale
+        d = rest[0].values.astype(jnp.int32)
+        def trunc_to(k):
+            f = _POW10[s - k]
+            return jnp.where(a.values >= 0, a.values // f,
+                             -((-a.values) // f)) * f
+        v = rescale_decimal(a.values, s, _scale_of(ret))
+        candidates = [rescale_decimal(trunc_to(k), s, _scale_of(ret))
+                      for k in range(0, s + 1)]
+        out = candidates[-1]
+        for k in range(s - 1, -1, -1):
+            out = jnp.where(d <= k, candidates[k], out)
+        return _col(ret, out, a, rest[0])
     x = a.values.astype(jnp.float64)
+    if rest:
+        p = jnp.power(10.0, rest[0].values.astype(jnp.float64))
+        return _col(ret, jnp.trunc(x * p) / p, a, rest[0])
     return _col(ret, jnp.trunc(x).astype(ret.to_dtype()), a)
 
 
@@ -650,10 +668,22 @@ def date_diff_kernel(unit: str, d1, d2):
         return jnp.sign(delta) * (jnp.abs(delta) // 7)
     y1, m1, dd1 = _civil(d1)
     y2, m2, dd2 = _civil(d2)
+
+    def last_dom(y, m):
+        ny = jnp.where(m == 12, y + 1, y)
+        nm = jnp.where(m == 12, 1, m + 1)
+        return _civil(_days_from_civil(ny, nm, jnp.ones_like(y)) - 1)[2]
+
     months = (y2 * 12 + m2) - (y1 * 12 + m1)
-    # truncate partial months toward zero
-    adj = jnp.where((months > 0) & (dd2 < dd1), 1,
-                    jnp.where((months < 0) & (dd2 > dd1), -1, 0))
+    # truncate partial months toward zero, with end-of-month clamping
+    # (Joda chronology: Jan 31 + 1 month = Feb 28/29, so Jan 31 ->
+    # Feb 29 counts as a whole month)
+    eom2 = dd2 == last_dom(y2, m2)
+    eom1 = dd1 == last_dom(y1, m1)
+    partial_fwd = (dd2 < dd1) & ~eom2
+    partial_bwd = (dd2 > dd1) & ~eom1
+    adj = jnp.where((months > 0) & partial_fwd, 1,
+                    jnp.where((months < 0) & partial_bwd, -1, 0))
     months = months - adj
     if unit == "month":
         return months
@@ -733,6 +763,7 @@ def split_part_kernel(a: StringColumn, delim: bytes, index: int, ret):
     """split_part(s, delim, n): the n-th (1-based) field. Constant delim
     of length 1 in round 1 (covers the common CSV-ish uses)."""
     assert len(delim) == 1, "split_part delimiter must be 1 byte in round 1"
+    assert index >= 1, "split_part index must be greater than zero"
     n, w = a.chars.shape
     pos = jnp.arange(w, dtype=jnp.int32)[None, :]
     in_str = pos < a.lengths[:, None]
