@@ -183,11 +183,7 @@ def evaluate(expr: RowExpression, batch: Batch) -> Block:
                 months = n.values * 12 if str(unit.value) == "year" else n.values
                 tot = (y * 12 + (m - 1)) + months
                 ny, nm = tot // 12, tot % 12 + 1
-                # clamp day to last day of target month
-                ld = F._days_from_civil(ny + (nm == 12), jnp.where(nm == 12, 1, nm + 1),
-                                        jnp.ones_like(ny)) - 1
-                _, _, last_day = F._civil(ld)
-                nd = jnp.minimum(day, last_day)
+                nd = jnp.minimum(day, F.last_day_kernel(ny, nm))
                 vals = F._days_from_civil(ny, nm, nd).astype(d.values.dtype)
             else:
                 raise NotImplementedError(f"date_add unit {unit.value!r}")

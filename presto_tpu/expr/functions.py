@@ -406,6 +406,15 @@ def _as_days(a: Column):
     return a.values
 
 
+def last_day_kernel(y, m):
+    """Day-of-month of the last day of civil (y, m) -- the single home of
+    the next-month-minus-one trick (used by date_diff's clamp,
+    last_day_of_month, and date_add's month arithmetic)."""
+    ny = jnp.where(m == 12, y + 1, y)
+    nm = jnp.where(m == 12, 1, m + 1)
+    return _civil(_days_from_civil(ny, nm, jnp.ones_like(y)) - 1)[2]
+
+
 @register("year")
 def _year(ret, a):
     y, m, d = _civil(_as_days(a))
@@ -596,23 +605,29 @@ def _truncate(ret, a, *rest):
             f = _POW10[s]
             v = jnp.where(a.values >= 0, a.values // f, -((-a.values) // f))
             return _col(ret, rescale_decimal(v, 0, _scale_of(ret)), a)
-        # truncate(decimal, d): zero digits below 10^-d, keep the scale
+        # truncate(decimal, d): zero digits below 10^-d, keep the scale.
+        # Negative d zeroes digits LEFT of the point (reference TruncateN);
+        # d at or below -(18 - s) truncates everything to 0.
         d = rest[0].values.astype(jnp.int32)
+
         def trunc_to(k):
             f = _POW10[s - k]
             return jnp.where(a.values >= 0, a.values // f,
                              -((-a.values) // f)) * f
-        v = rescale_decimal(a.values, s, _scale_of(ret))
-        candidates = [rescale_decimal(trunc_to(k), s, _scale_of(ret))
-                      for k in range(0, s + 1)]
-        out = candidates[-1]
-        for k in range(s - 1, -1, -1):
+        k_min = -(18 - s)
+        ks = list(range(k_min, s + 1))
+        candidates = {k: rescale_decimal(trunc_to(k), s, _scale_of(ret))
+                      for k in ks}
+        out = candidates[ks[-1]]
+        for k in reversed(ks[:-1]):
             out = jnp.where(d <= k, candidates[k], out)
+        out = jnp.where(d <= k_min, 0, out)  # p - s + d <= 0 -> 0 (TruncateN)
         return _col(ret, out, a, rest[0])
     x = a.values.astype(jnp.float64)
     if rest:
         p = jnp.power(10.0, rest[0].values.astype(jnp.float64))
-        return _col(ret, jnp.trunc(x * p) / p, a, rest[0])
+        return _col(ret, (jnp.trunc(x * p) / p).astype(ret.to_dtype()),
+                    a, rest[0])
     return _col(ret, jnp.trunc(x).astype(ret.to_dtype()), a)
 
 
@@ -668,18 +683,12 @@ def date_diff_kernel(unit: str, d1, d2):
         return jnp.sign(delta) * (jnp.abs(delta) // 7)
     y1, m1, dd1 = _civil(d1)
     y2, m2, dd2 = _civil(d2)
-
-    def last_dom(y, m):
-        ny = jnp.where(m == 12, y + 1, y)
-        nm = jnp.where(m == 12, 1, m + 1)
-        return _civil(_days_from_civil(ny, nm, jnp.ones_like(y)) - 1)[2]
-
     months = (y2 * 12 + m2) - (y1 * 12 + m1)
     # truncate partial months toward zero, with end-of-month clamping
     # (Joda chronology: Jan 31 + 1 month = Feb 28/29, so Jan 31 ->
     # Feb 29 counts as a whole month)
-    eom2 = dd2 == last_dom(y2, m2)
-    eom1 = dd1 == last_dom(y1, m1)
+    eom2 = dd2 == last_day_kernel(y2, m2)
+    eom1 = dd1 == last_day_kernel(y1, m1)
     partial_fwd = (dd2 < dd1) & ~eom2
     partial_bwd = (dd2 > dd1) & ~eom1
     adj = jnp.where((months > 0) & partial_fwd, 1,
@@ -696,10 +705,8 @@ def date_diff_kernel(unit: str, d1, d2):
 
 @register("last_day_of_month")
 def _last_day_of_month(ret, a):
-    y, m, d = _civil(_as_days(a))
-    ny = jnp.where(m == 12, y + 1, y)
-    nm = jnp.where(m == 12, 1, m + 1)
-    v = _days_from_civil(ny, nm, jnp.ones_like(y)) - 1
+    y, m, _ = _civil(_as_days(a))
+    v = _days_from_civil(y, m, last_day_kernel(y, m))
     return _col(ret, v.astype(ret.to_dtype()), a)
 
 

@@ -1,0 +1,4 @@
+from .parser import parse_sql
+from .planner import plan_sql, sql
+
+__all__ = ["parse_sql", "plan_sql", "sql"]

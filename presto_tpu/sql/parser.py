@@ -1,0 +1,499 @@
+"""SQL frontend: lexer + recursive-descent parser for the SELECT subset.
+
+Reference surface: presto-parser (ANTLR grammar SqlBase.g4, 1071 lines,
+SqlParser.java -> AST in com.facebook.presto.sql.tree). This is a
+hand-written recursive-descent parser covering the engine's executable
+subset (the reference's full grammar -- DDL, lambdas, set operations,
+subqueries -- grows in over rounds):
+
+  SELECT [DISTINCT] items FROM t [[AS] a] [joins] [WHERE e]
+  [GROUP BY es] [HAVING e] [ORDER BY es [ASC|DESC] [NULLS F/L]] [LIMIT n]
+
+with expressions: arithmetic, comparisons, AND/OR/NOT, BETWEEN, IN
+(literal list), LIKE, IS [NOT] NULL, CASE, CAST, function calls,
+DATE/INTERVAL literals, qualified names.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import List, Optional, Tuple, Union
+
+__all__ = ["parse_sql", "Query", "Select", "TableRef", "Join", "OrderItem",
+           "Literal", "Name", "Func", "BinOp", "NotOp", "Between", "InList",
+           "Like", "IsNull", "Case", "Cast", "Star"]
+
+
+# ---------------------------------------------------------------------------
+# AST
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Literal:
+    value: object
+    kind: str  # "int" | "decimal" | "string" | "bool" | "null" | "date" | "interval_day"
+
+
+@dataclasses.dataclass
+class Name:
+    parts: Tuple[str, ...]  # ("t", "col") or ("col",)
+
+
+@dataclasses.dataclass
+class Star:
+    pass
+
+
+@dataclasses.dataclass
+class Func:
+    name: str
+    args: List[object]
+    distinct: bool = False
+
+
+@dataclasses.dataclass
+class BinOp:
+    op: str
+    left: object
+    right: object
+
+
+@dataclasses.dataclass
+class NotOp:
+    arg: object
+
+
+@dataclasses.dataclass
+class Between:
+    value: object
+    lo: object
+    hi: object
+    negate: bool = False
+
+
+@dataclasses.dataclass
+class InList:
+    value: object
+    items: List[object]
+    negate: bool = False
+
+
+@dataclasses.dataclass
+class Like:
+    value: object
+    pattern: str
+    negate: bool = False
+
+
+@dataclasses.dataclass
+class IsNull:
+    value: object
+    negate: bool = False
+
+
+@dataclasses.dataclass
+class Case:
+    operand: Optional[object]
+    whens: List[Tuple[object, object]]
+    default: Optional[object]
+
+
+@dataclasses.dataclass
+class Cast:
+    value: object
+    type_name: str
+
+
+@dataclasses.dataclass
+class SelectItem:
+    expr: object
+    alias: Optional[str]
+
+
+@dataclasses.dataclass
+class TableRef:
+    name: str
+    alias: Optional[str]
+
+
+@dataclasses.dataclass
+class Join:
+    kind: str  # "inner" | "left"
+    table: TableRef
+    condition: object
+
+
+@dataclasses.dataclass
+class OrderItem:
+    expr: object
+    descending: bool
+    nulls_last: bool
+
+
+@dataclasses.dataclass
+class Select:
+    items: List[SelectItem]
+    distinct: bool
+
+
+@dataclasses.dataclass
+class Query:
+    select: Select
+    table: TableRef
+    joins: List[Join]
+    where: Optional[object]
+    group_by: List[object]
+    having: Optional[object]
+    order_by: List[OrderItem]
+    limit: Optional[int]
+
+
+# ---------------------------------------------------------------------------
+# Lexer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(r"""
+    \s*(?:
+      (?P<number>\d+(?:\.\d+)?)
+    | (?P<string>'(?:[^']|'')*')
+    | (?P<ident>[A-Za-z_][A-Za-z_0-9]*)
+    | (?P<op><>|!=|>=|<=|=|<|>|\+|-|\*|/|%|\(|\)|,|\.)
+    )""", re.VERBOSE)
+
+_KEYWORDS = {
+    "select", "distinct", "from", "where", "group", "by", "having", "order",
+    "limit", "as", "and", "or", "not", "between", "in", "like", "is", "null",
+    "case", "when", "then", "else", "end", "cast", "join", "inner", "left",
+    "on", "true", "false", "asc", "desc", "nulls", "first", "last", "date",
+    "interval", "day", "month", "year", "extract", "outer",
+}
+
+
+def _tokenize(text: str) -> List[Tuple[str, str]]:
+    out = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN_RE.match(text, pos)
+        if m is None:
+            if text[pos:].strip() == "":
+                break
+            raise ValueError(f"cannot tokenize at: {text[pos:pos + 30]!r}")
+        pos = m.end()
+        if m.lastgroup == "number":
+            out.append(("number", m.group("number")))
+        elif m.lastgroup == "string":
+            out.append(("string", m.group("string")[1:-1].replace("''", "'")))
+        elif m.lastgroup == "ident":
+            word = m.group("ident")
+            if word.lower() in _KEYWORDS:
+                out.append(("kw", word.lower()))
+            else:
+                out.append(("ident", word))
+        else:
+            out.append(("op", m.group("op")))
+    out.append(("eof", ""))
+    return out
+
+
+class _Parser:
+    def __init__(self, tokens: List[Tuple[str, str]]):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self) -> Tuple[str, str]:
+        return self.toks[self.i]
+
+    def next(self) -> Tuple[str, str]:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def accept_kw(self, *words) -> Optional[str]:
+        k, v = self.peek()
+        if k == "kw" and v in words:
+            self.next()
+            return v
+        return None
+
+    def expect_kw(self, word: str):
+        if not self.accept_kw(word):
+            raise ValueError(f"expected {word.upper()}, got {self.peek()}")
+
+    def accept_op(self, *ops) -> Optional[str]:
+        k, v = self.peek()
+        if k == "op" and v in ops:
+            self.next()
+            return v
+        return None
+
+    def expect_op(self, op: str):
+        if not self.accept_op(op):
+            raise ValueError(f"expected {op!r}, got {self.peek()}")
+
+    def expect_ident(self) -> str:
+        k, v = self.next()
+        if k not in ("ident", "kw"):  # allow keywords as identifiers sparingly
+            raise ValueError(f"expected identifier, got {(k, v)}")
+        return v
+
+    # -- expressions --------------------------------------------------------
+
+    def expr(self):
+        return self.or_expr()
+
+    def or_expr(self):
+        left = self.and_expr()
+        while self.accept_kw("or"):
+            left = BinOp("or", left, self.and_expr())
+        return left
+
+    def and_expr(self):
+        left = self.not_expr()
+        while self.accept_kw("and"):
+            left = BinOp("and", left, self.not_expr())
+        return left
+
+    def not_expr(self):
+        if self.accept_kw("not"):
+            return NotOp(self.not_expr())
+        return self.predicate()
+
+    def predicate(self):
+        left = self.additive()
+        negate = bool(self.accept_kw("not"))
+        if self.accept_kw("between"):
+            lo = self.additive()
+            self.expect_kw("and")
+            hi = self.additive()
+            return Between(left, lo, hi, negate)
+        if self.accept_kw("in"):
+            self.expect_op("(")
+            items = [self.expr()]
+            while self.accept_op(","):
+                items.append(self.expr())
+            self.expect_op(")")
+            return InList(left, items, negate)
+        if self.accept_kw("like"):
+            k, v = self.next()
+            assert k == "string", "LIKE pattern must be a string literal"
+            return Like(left, v, negate)
+        if self.accept_kw("is"):
+            neg = bool(self.accept_kw("not"))
+            self.expect_kw("null")
+            return IsNull(left, neg)
+        assert not negate, "dangling NOT"
+        op = self.accept_op("=", "<>", "!=", "<", "<=", ">", ">=")
+        if op:
+            return BinOp(op, left, self.additive())
+        return left
+
+    def additive(self):
+        left = self.multiplicative()
+        while True:
+            op = self.accept_op("+", "-")
+            if not op:
+                return left
+            left = BinOp(op, left, self.multiplicative())
+
+    def multiplicative(self):
+        left = self.unary()
+        while True:
+            op = self.accept_op("*", "/", "%")
+            if not op:
+                return left
+            left = BinOp(op, left, self.unary())
+
+    def unary(self):
+        if self.accept_op("-"):
+            return Func("negate", [self.unary()])
+        return self.primary()
+
+    def primary(self):
+        k, v = self.peek()
+        if k == "number":
+            self.next()
+            if "." in v:
+                scale = len(v.split(".")[1])
+                return Literal(int(v.replace(".", "")), f"decimal:{scale}")
+            return Literal(int(v), "int")
+        if k == "string":
+            self.next()
+            return Literal(v, "string")
+        if k == "kw" and v in ("true", "false"):
+            self.next()
+            return Literal(v == "true", "bool")
+        if k == "kw" and v == "null":
+            self.next()
+            return Literal(None, "null")
+        if k == "kw" and v == "date":
+            self.next()
+            kk, vv = self.next()
+            assert kk == "string"
+            return Literal(vv, "date")
+        if k == "kw" and v == "interval":
+            self.next()
+            kk, vv = self.next()
+            assert kk == "string"
+            unit = self.next()[1]  # day | month | year
+            return Literal((int(vv), unit), "interval")
+        if k == "kw" and v == "cast":
+            self.next()
+            self.expect_op("(")
+            e = self.expr()
+            self.expect_kw("as")
+            tname = self._type_name()
+            self.expect_op(")")
+            return Cast(e, tname)
+        if k == "kw" and v == "case":
+            return self._case()
+        if k == "kw" and v == "extract":
+            self.next()
+            self.expect_op("(")
+            unit = self.next()[1]
+            self.expect_kw("from")
+            e = self.expr()
+            self.expect_op(")")
+            return Func(unit.lower(), [e])
+        if k == "op" and v == "(":
+            self.next()
+            e = self.expr()
+            self.expect_op(")")
+            return e
+        if k == "op" and v == "*":
+            self.next()
+            return Star()
+        if k in ("ident", "kw"):
+            self.next()
+            if self.peek() == ("op", "("):
+                self.next()
+                distinct = bool(self.accept_kw("distinct"))
+                args: List[object] = []
+                if self.peek() != ("op", ")"):
+                    args.append(self.expr())
+                    while self.accept_op(","):
+                        args.append(self.expr())
+                self.expect_op(")")
+                return Func(v.lower(), args, distinct)
+            parts = [v]
+            while self.accept_op("."):
+                parts.append(self.expect_ident())
+            return Name(tuple(parts))
+        raise ValueError(f"unexpected token {(k, v)}")
+
+    def _type_name(self) -> str:
+        name = self.expect_ident()
+        if self.accept_op("("):
+            params = [self.next()[1]]
+            while self.accept_op(","):
+                params.append(self.next()[1])
+            self.expect_op(")")
+            return f"{name}({', '.join(params)})"
+        return name
+
+    def _case(self):
+        self.expect_kw("case")
+        operand = None
+        if not (self.peek() == ("kw", "when")):
+            operand = self.expr()
+        whens = []
+        while self.accept_kw("when"):
+            c = self.expr()
+            self.expect_kw("then")
+            r = self.expr()
+            whens.append((c, r))
+        default = None
+        if self.accept_kw("else"):
+            default = self.expr()
+        self.expect_kw("end")
+        return Case(operand, whens, default)
+
+    # -- query --------------------------------------------------------------
+
+    def query(self) -> Query:
+        self.expect_kw("select")
+        distinct = bool(self.accept_kw("distinct"))
+        items = [self._select_item()]
+        while self.accept_op(","):
+            items.append(self._select_item())
+        self.expect_kw("from")
+        table = self._table_ref()
+        joins = []
+        while True:
+            kind = None
+            if self.accept_kw("inner"):
+                kind = "inner"
+                self.expect_kw("join")
+            elif self.accept_kw("left"):
+                kind = "left"
+                self.accept_kw("outer")
+                self.expect_kw("join")
+            elif self.accept_kw("join"):
+                kind = "inner"
+            if kind is None:
+                break
+            t = self._table_ref()
+            self.expect_kw("on")
+            cond = self.expr()
+            joins.append(Join(kind, t, cond))
+        where = self.expr() if self.accept_kw("where") else None
+        group_by: List[object] = []
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            group_by.append(self.expr())
+            while self.accept_op(","):
+                group_by.append(self.expr())
+        having = self.expr() if self.accept_kw("having") else None
+        order_by: List[OrderItem] = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order_by.append(self._order_item())
+            while self.accept_op(","):
+                order_by.append(self._order_item())
+        limit = None
+        if self.accept_kw("limit"):
+            k, v = self.next()
+            assert k == "number"
+            limit = int(v)
+        k, v = self.peek()
+        if k != "eof":
+            raise ValueError(f"trailing tokens at {(k, v)}")
+        return Query(Select(items, distinct), table, joins, where, group_by,
+                     having, order_by, limit)
+
+    def _select_item(self) -> SelectItem:
+        e = self.expr()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.expect_ident()
+        elif self.peek()[0] == "ident":
+            alias = self.next()[1]
+        return SelectItem(e, alias)
+
+    def _table_ref(self) -> TableRef:
+        name = self.expect_ident()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.expect_ident()
+        elif self.peek()[0] == "ident":
+            alias = self.next()[1]
+        return TableRef(name.lower(), alias)
+
+    def _order_item(self) -> OrderItem:
+        e = self.expr()
+        desc = False
+        if self.accept_kw("desc"):
+            desc = True
+        else:
+            self.accept_kw("asc")
+        nulls_last = True  # presto default for ASC; DESC default NULLS LAST too
+        if self.accept_kw("nulls"):
+            if self.accept_kw("first"):
+                nulls_last = False
+            else:
+                self.expect_kw("last")
+        return OrderItem(e, desc, nulls_last)
+
+
+def parse_sql(text: str) -> Query:
+    return _Parser(_tokenize(text)).query()

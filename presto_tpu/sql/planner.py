@@ -1,0 +1,609 @@
+"""SQL analyzer + logical planner: AST -> typed plan.
+
+Reference surface: presto-main-base's StatementAnalyzer.java:397 (name
+resolution, type checking, aggregate analysis), LogicalPlanner.java:182
+(AST -> PlanNode tree via QueryPlanner), and
+SqlToRowExpressionTranslator (expression lowering). Collapsed into one
+pass sized to the executable SELECT subset: resolve names against the
+tpch catalog, infer types (Presto decimal rules, simplified division
+scale), detect aggregates, and emit the same plan shapes the reference's
+planner would (scan -> filter -> project -> aggregate -> having ->
+project -> sort/topN/limit), with joins left-deep in FROM order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..connectors import tpch
+from ..expr import ir as E
+from ..ops.aggregation import AggSpec, state_width
+from ..plan import nodes as N
+from . import parser as P
+
+__all__ = ["plan_sql", "sql"]
+
+_AGG_NAMES = {"sum", "count", "min", "max", "avg", "approx_distinct",
+              "bool_and", "bool_or", "arbitrary", "every", "any_value",
+              "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp",
+              "var_pop"}
+
+
+@dataclasses.dataclass
+class _Scope:
+    """Name -> (channel, type); qualified and unqualified forms."""
+    channels: Dict[str, int]
+    types: List[T.Type]
+
+    def resolve(self, parts: Tuple[str, ...]) -> Tuple[int, T.Type]:
+        key = ".".join(parts).lower()
+        if key in self.channels:
+            ch = self.channels[key]
+            return ch, self.types[ch]
+        raise KeyError(f"column {key!r} not found; have {sorted(self.channels)}")
+
+
+def _days(lit: str) -> int:
+    return int((np.datetime64(lit) - np.datetime64("1970-01-01")).astype(int))
+
+
+class _Analyzer:
+    def __init__(self, query: P.Query, sf_catalog: str = "tpch"):
+        self.q = query
+        self.catalog = sf_catalog
+
+    # -- expression lowering ------------------------------------------------
+
+    def lower(self, node, scope: _Scope) -> E.RowExpression:
+        if isinstance(node, P.Literal):
+            return self._literal(node)
+        if isinstance(node, P.Name):
+            ch, ty = scope.resolve(node.parts)
+            return E.input_ref(ch, ty)
+        if isinstance(node, P.BinOp):
+            return self._binop(node, scope)
+        if isinstance(node, P.NotOp):
+            a = self.lower(node.arg, scope)
+            return E.call("not", T.BOOLEAN, a)
+        if isinstance(node, P.Between):
+            e = E.special("BETWEEN", T.BOOLEAN, self.lower(node.value, scope),
+                          *(self._coerce_pair(self.lower(node.value, scope),
+                                              self.lower(x, scope))[1]
+                            for x in (node.lo, node.hi)))
+            return E.call("not", T.BOOLEAN, e) if node.negate else e
+        if isinstance(node, P.InList):
+            v = self.lower(node.value, scope)
+            items = [self._coerce_pair(v, self.lower(x, scope))[1]
+                     for x in node.items]
+            e = E.special("IN", T.BOOLEAN, v, *items)
+            return E.call("not", T.BOOLEAN, e) if node.negate else e
+        if isinstance(node, P.Like):
+            v = self.lower(node.value, scope)
+            e = E.call("like", T.BOOLEAN, v,
+                       E.const(node.pattern, T.varchar(len(node.pattern))))
+            return E.call("not", T.BOOLEAN, e) if node.negate else e
+        if isinstance(node, P.IsNull):
+            e = E.special("IS_NULL", T.BOOLEAN, self.lower(node.value, scope))
+            return E.call("not", T.BOOLEAN, e) if node.negate else e
+        if isinstance(node, P.Case):
+            whens = []
+            for c, r in node.whens:
+                whens.append((self.lower(c, scope), self.lower(r, scope)))
+            default = self.lower(node.default, scope) if node.default else None
+            rty = whens[0][1].type if whens else (default.type if default else T.UNKNOWN)
+            args: List[E.RowExpression] = []
+            if node.operand is not None:
+                args.append(self.lower(node.operand, scope))
+            else:
+                args.append(E.const(True, T.BOOLEAN))
+            for c, r in whens:
+                args.append(E.special("WHEN", rty, c, r))
+            if default is not None:
+                args.append(default)
+            return E.special("SWITCH", rty, *args)
+        if isinstance(node, P.Cast):
+            v = self.lower(node.value, scope)
+            ty = T.parse_type(node.type_name)
+            return E.call("cast", ty, v)
+        if isinstance(node, P.Func):
+            return self._func(node, scope)
+        raise NotImplementedError(f"cannot lower {node}")
+
+    def _literal(self, lit: P.Literal) -> E.Constant:
+        if lit.kind == "int":
+            return E.const(lit.value, T.BIGINT)
+        if lit.kind.startswith("decimal:"):
+            scale = int(lit.kind.split(":")[1])
+            return E.const(lit.value, T.decimal(38, scale))
+        if lit.kind == "string":
+            return E.const(lit.value, T.varchar(max(len(lit.value), 1)))
+        if lit.kind == "bool":
+            return E.const(lit.value, T.BOOLEAN)
+        if lit.kind == "null":
+            return E.const(None, T.UNKNOWN)
+        if lit.kind == "date":
+            return E.const(_days(lit.value), T.DATE)
+        if lit.kind == "interval":
+            n, unit = lit.value
+            return E.const((n, unit), Type_INTERVAL)
+        raise NotImplementedError(lit.kind)
+
+    def _coerce_pair(self, a: E.RowExpression, b: E.RowExpression):
+        """Implicit coercions for comparisons: align string widths, keep
+        numerics (comparison kernels rescale internally)."""
+        return a, b
+
+    def _binop(self, node: P.BinOp, scope: _Scope) -> E.RowExpression:
+        op = node.op
+        if op in ("and", "or"):
+            return E.special(op.upper(), T.BOOLEAN,
+                             self.lower(node.left, scope),
+                             self.lower(node.right, scope))
+        a = self.lower(node.left, scope)
+        b = self.lower(node.right, scope)
+        if op in ("=", "<>", "!=", "<", "<=", ">", ">="):
+            name = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt",
+                    "<=": "le", ">": "gt", ">=": "ge"}[op]
+            return E.call(name, T.BOOLEAN, a, b)
+        # date +/- interval
+        if a.type.base == "date" and isinstance(b, E.Constant) and \
+                b.type is Type_INTERVAL:
+            n, unit = b.value
+            if op == "-":
+                n = -n
+            return E.call("date_add", T.DATE, E.const(unit, T.varchar(7)),
+                          E.const(n, T.BIGINT), a)
+        name = {"+": "add", "-": "subtract", "*": "multiply", "/": "divide",
+                "%": "modulus"}[op]
+        rty = self._arith_type(name, a.type, b.type)
+        return E.call(name, rty, a, b)
+
+    def _arith_type(self, name: str, t1: T.Type, t2: T.Type) -> T.Type:
+        if t1.is_floating or t2.is_floating:
+            return T.DOUBLE
+        if t1.is_decimal or t2.is_decimal:
+            s1 = t1.scale if t1.is_decimal else 0
+            s2 = t2.scale if t2.is_decimal else 0
+            if name in ("add", "subtract"):
+                return T.decimal(38, max(s1, s2))
+            if name == "multiply":
+                return T.decimal(38, s1 + s2)
+            if name == "divide":
+                # simplified scale rule (reference computes precision-aware
+                # scales); keep enough fractional digits for ratios
+                return T.decimal(38, min(max(s1, s2) + 6, 12))
+            if name == "modulus":
+                return T.decimal(38, max(s1, s2))
+        if t1.is_integral and t2.is_integral:
+            return T.BIGINT
+        if t1.base == "date" and t2.base == "date" and name == "subtract":
+            return T.BIGINT
+        return t1 if t1.is_numeric else t2
+
+    def _func(self, node: P.Func, scope: _Scope) -> E.RowExpression:
+        name = node.name
+        args = [self.lower(a, scope) for a in node.args
+                if not isinstance(a, P.Star)]
+        rty = self._func_type(name, args)
+        return E.call(name, rty, *args)
+
+    def _func_type(self, name: str, args: List[E.RowExpression]) -> T.Type:
+        if name in ("year", "month", "day", "quarter", "length", "strpos",
+                    "position", "codepoint", "day_of_week", "day_of_year",
+                    "date_diff", "sign"):
+            return T.BIGINT
+        if name in ("upper", "lower", "trim", "ltrim", "rtrim", "reverse",
+                    "substr", "split_part"):
+            return args[0].type
+        if name == "concat":
+            width = sum(a.type.max_length if a.type.is_string else 8
+                        for a in args)
+            return T.varchar(width)
+        if name in ("sqrt", "exp", "ln", "log10", "power", "pow"):
+            return T.DOUBLE
+        if name in ("abs", "negate", "floor", "ceil", "ceiling", "round",
+                    "truncate", "greatest", "least"):
+            return args[0].type
+        if name in ("date_trunc", "last_day_of_month", "date_add"):
+            return T.DATE
+        if name in ("like", "starts_with", "is_distinct_from", "not"):
+            return T.BOOLEAN
+        if name == "chr":
+            return T.varchar(1)
+        if name == "cast":
+            return args[0].type
+        raise NotImplementedError(f"no type rule for function {name!r}")
+
+    # -- aggregate detection ------------------------------------------------
+
+    def find_aggs(self, node) -> List[P.Func]:
+        out = []
+
+        def walk(n):
+            if isinstance(n, P.Func) and n.name in _AGG_NAMES:
+                out.append(n)
+                return  # no nested aggs
+            for f in dataclasses.fields(n) if dataclasses.is_dataclass(n) else []:
+                v = getattr(n, f.name)
+                if dataclasses.is_dataclass(v):
+                    walk(v)
+                elif isinstance(v, (list, tuple)):
+                    for x in v:
+                        if dataclasses.is_dataclass(x):
+                            walk(x)
+                        elif isinstance(x, tuple):
+                            for y in x:
+                                if dataclasses.is_dataclass(y):
+                                    walk(y)
+        if dataclasses.is_dataclass(node):
+            walk(node)
+        return out
+
+
+Type_INTERVAL = T.Type("interval")
+
+
+def _agg_output_type(name: str, input_type: Optional[T.Type]) -> T.Type:
+    if name == "count" or name == "approx_distinct":
+        return T.BIGINT
+    if name in ("bool_and", "bool_or", "every"):
+        return T.BOOLEAN
+    if name in ("stddev", "stddev_samp", "stddev_pop", "variance", "var_samp",
+                "var_pop"):
+        return T.DOUBLE
+    if name == "sum":
+        if input_type.is_decimal:
+            return T.decimal(38, input_type.scale)
+        if input_type.is_floating:
+            return T.DOUBLE
+        return T.BIGINT
+    if name == "avg":
+        if input_type.is_decimal:
+            return T.decimal(38, input_type.scale)
+        return T.DOUBLE
+    return input_type  # min/max/arbitrary
+
+
+def plan_sql(query_text: str, max_groups: int = 1 << 16,
+             join_capacity: Optional[int] = None) -> N.PlanNode:
+    """SQL text -> plan tree rooted at OutputNode."""
+    q = P.parse_sql(query_text)
+    an = _Analyzer(q)
+
+    # FROM: scans with pruned columns. First collect every referenced name.
+    tables: List[P.TableRef] = [q.table] + [j.table for j in q.joins]
+    table_schemas = {t.name: dict(tpch.TPCH_SCHEMA[t.name]) for t in tables}
+
+    referenced: Dict[str, List[str]] = {t.name: [] for t in tables}
+
+    def note_name(parts: Tuple[str, ...]):
+        parts = tuple(p.lower() for p in parts)
+        if len(parts) == 2:
+            alias, col = parts
+            for t in tables:
+                if (t.alias or t.name) == alias and col in table_schemas[t.name]:
+                    if col not in referenced[t.name]:
+                        referenced[t.name].append(col)
+                    return
+            raise KeyError(f"unknown qualified column {'.'.join(parts)}")
+        col = parts[0]
+        hits = [t for t in tables if col in table_schemas[t.name]]
+        if not hits:
+            raise KeyError(f"unknown column {col}")
+        if len(hits) > 1:
+            raise KeyError(f"ambiguous column {col}")
+        if col not in referenced[hits[0].name]:
+            referenced[hits[0].name].append(col)
+
+    def collect_names(n):
+        if isinstance(n, P.Name):
+            note_name(n.parts)
+        elif dataclasses.is_dataclass(n):
+            for f in dataclasses.fields(n):
+                v = getattr(n, f.name)
+                if dataclasses.is_dataclass(v):
+                    collect_names(v)
+                elif isinstance(v, (list, tuple)):
+                    for x in v:
+                        if dataclasses.is_dataclass(x):
+                            collect_names(x)
+                        elif isinstance(x, tuple):
+                            for y in x:
+                                if dataclasses.is_dataclass(y):
+                                    collect_names(y)
+
+    for item in q.select.items:
+        collect_names(item.expr)
+    for j in q.joins:
+        collect_names(j.condition)
+    aliases = {(_item_name(it, i)) for i, it in enumerate(q.select.items)}
+    for e in ([q.where] if q.where else []) + q.group_by + \
+            ([q.having] if q.having else []):
+        collect_names(e)
+    for o in q.order_by:
+        # select aliases shadow table columns in ORDER BY scope
+        if isinstance(o.expr, P.Name) and len(o.expr.parts) == 1 and \
+                o.expr.parts[0].lower() in aliases:
+            continue
+        collect_names(o.expr)
+
+    # build scans + running scope over the join chain
+    def scan_for(t: P.TableRef) -> Tuple[N.PlanNode, List[str], List[T.Type]]:
+        cols = referenced[t.name] or [next(iter(table_schemas[t.name]))]
+        tys = [table_schemas[t.name][c] for c in cols]
+        return (N.TableScanNode("tpch", t.name, cols, tys), cols, tys)
+
+    node, cols0, tys0 = scan_for(q.table)
+    scope_entries: List[Tuple[str, str]] = [((q.table.alias or q.table.name), c)
+                                            for c in cols0]
+    types: List[T.Type] = list(tys0)
+
+    def make_scope() -> _Scope:
+        channels: Dict[str, int] = {}
+        seen_unqualified: Dict[str, int] = {}
+        for i, (alias, c) in enumerate(scope_entries):
+            channels[f"{alias}.{c}"] = i
+            seen_unqualified[c] = seen_unqualified.get(c, 0) + 1
+        for i, (alias, c) in enumerate(scope_entries):
+            if seen_unqualified[c] == 1:
+                channels[c] = i
+        return _Scope(channels, types)
+
+    for j in q.joins:
+        right, rcols, rtys = scan_for(j.table)
+        # extract equi-join keys from the ON conjunction
+        left_scope = make_scope()
+        r_alias = j.table.alias or j.table.name
+        r_channels = {f"{r_alias}.{c}": i for i, c in enumerate(rcols)}
+        for i, c in enumerate(rcols):
+            r_channels.setdefault(c, i)
+        conds = _conjuncts(j.condition)
+        lkeys, rkeys, residual = [], [], []
+        for c in conds:
+            if isinstance(c, P.BinOp) and c.op == "=" and \
+                    isinstance(c.left, P.Name) and isinstance(c.right, P.Name):
+                lparts = ".".join(c.left.parts).lower()
+                rparts = ".".join(c.right.parts).lower()
+                if lparts in left_scope.channels and rparts in r_channels:
+                    lkeys.append(left_scope.channels[lparts])
+                    rkeys.append(r_channels[rparts])
+                    continue
+                if rparts in left_scope.channels and lparts in r_channels:
+                    lkeys.append(left_scope.channels[rparts])
+                    rkeys.append(r_channels[lparts])
+                    continue
+            residual.append(c)
+        assert lkeys, f"no equi-join keys in ON {j.condition}"
+        node = N.JoinNode(node, right, lkeys, rkeys, j.kind, "partitioned",
+                          out_capacity=join_capacity)
+        scope_entries += [(r_alias, c) for c in rcols]
+        types += rtys
+        scope = make_scope()
+        for r in residual:
+            node = N.FilterNode(node, an.lower(r, scope))
+
+    scope = make_scope()
+
+    if q.where is not None:
+        node = N.FilterNode(node, an.lower(q.where, scope))
+
+    # aggregation?
+    select_aggs: List[P.Func] = []
+    for item in q.select.items:
+        select_aggs += an.find_aggs(item.expr)
+    having_aggs = an.find_aggs(q.having) if q.having else []
+    order_aggs = [a for o in q.order_by for a in an.find_aggs(o.expr)]
+    all_aggs = select_aggs + having_aggs + order_aggs
+
+    if all_aggs or q.group_by:
+        node, scope, agg_map, key_map = _plan_aggregation(
+            an, node, scope, q, all_aggs, max_groups)
+        out_exprs, names, having_e = _plan_agg_outputs(an, q, scope, agg_map,
+                                                       key_map)
+        if having_e is not None:
+            node = N.FilterNode(node, having_e)
+    else:
+        out_exprs = []
+        names = []
+        for i, item in enumerate(q.select.items):
+            if isinstance(item.expr, P.Star):
+                for ch, (alias, c) in enumerate(scope_entries):
+                    out_exprs.append(E.input_ref(ch, types[ch]))
+                    names.append(c)
+                continue
+            e = an.lower(item.expr, scope)
+            out_exprs.append(e)
+            names.append(_item_name(item, i))
+
+    # ORDER BY/LIMIT operate on the projected outputs; project first
+    node = N.ProjectNode(node, out_exprs)
+    out_types = [e.type for e in out_exprs]
+    scope = _Scope({n.lower(): i for i, n in enumerate(names)}, out_types)
+
+    if q.having is not None and not (all_aggs or q.group_by):
+        raise ValueError("HAVING without aggregation")
+
+    if q.select.distinct:
+        node = N.DistinctNode(node, max_groups=max_groups)
+
+    if q.order_by:
+        keys = []
+        for o in q.order_by:
+            if isinstance(o.expr, P.Name) and \
+                    ".".join(o.expr.parts).lower() in scope.channels:
+                ch = scope.channels[".".join(o.expr.parts).lower()]
+            elif isinstance(o.expr, P.Literal) and o.expr.kind == "int":
+                ch = int(o.expr.value) - 1
+            else:
+                # expression order key: append a hidden projection channel
+                e = _relower_output(an, o.expr, q, scope, names, out_exprs)
+                out_exprs = out_exprs + [e]
+                node = _replace_projection(node, out_exprs)
+                ch = len(out_exprs) - 1
+            keys.append((ch, o.descending, o.nulls_last))
+        if q.limit is not None:
+            node = N.TopNNode(node, keys, q.limit)
+        else:
+            node = N.SortNode(node, keys)
+        if len(out_exprs) > len(names):
+            # drop hidden ORDER BY channels after the sort consumed them
+            node = N.ProjectNode(node, [
+                E.input_ref(i, out_exprs[i].type) for i in range(len(names))])
+    elif q.limit is not None:
+        node = N.LimitNode(node, q.limit)
+
+    return N.OutputNode(node, names)
+
+
+def _item_name(item: P.SelectItem, i: int) -> str:
+    if item.alias:
+        return item.alias
+    if isinstance(item.expr, P.Name):
+        return item.expr.parts[-1].lower()
+    return f"_col{i}"
+
+
+def _replace_projection(node: N.PlanNode, exprs) -> N.PlanNode:
+    # node is ... -> ProjectNode (possibly wrapped); round 1: node IS the
+    # projection (order-by rewrite happens right after projecting)
+    assert isinstance(node, N.ProjectNode)
+    return N.ProjectNode(node.source, list(exprs))
+
+
+def _relower_output(an, expr, q, scope, names, out_exprs):
+    """Lower an ORDER BY expression over the OUTPUT scope (select aliases
+    visible). Falls back to matching an identical select expression."""
+    for i, item in enumerate(q.select.items):
+        if item.expr == expr:
+            return E.input_ref(i, out_exprs[i].type)
+    return an.lower(expr, scope)
+
+
+def _conjuncts(e) -> List[object]:
+    if isinstance(e, P.BinOp) and e.op == "and":
+        return _conjuncts(e.left) + _conjuncts(e.right)
+    return [e]
+
+
+def _plan_aggregation(an, node, scope, q, all_aggs, max_groups):
+    """Emit pre-projection + AggregationNode; returns (node, post_scope,
+    agg result channel map, group key channel map)."""
+    # pre-projection: group keys then agg inputs
+    pre_exprs: List[E.RowExpression] = []
+    key_map: Dict[int, int] = {}  # index in q.group_by -> channel
+    for i, g in enumerate(q.group_by):
+        if isinstance(g, P.Literal) and g.kind == "int":
+            item = q.select.items[int(g.value) - 1].expr
+            e = an.lower(item, scope)
+        else:
+            e = an.lower(g, scope)
+        key_map[i] = len(pre_exprs)
+        pre_exprs.append(e)
+    specs: List[AggSpec] = []
+    agg_map: Dict[int, Tuple[int, AggSpec]] = {}  # id(ast) -> (state ch, spec)
+    state_ch = len(q.group_by)
+    for f in all_aggs:
+        name = f.name
+        if name == "count" and (not f.args or isinstance(f.args[0], P.Star)):
+            spec = AggSpec("count_star", None, T.BIGINT)
+        else:
+            arg = an.lower(f.args[0], scope)
+            in_ch = len(pre_exprs)
+            pre_exprs.append(arg)
+            aname = name
+            if name == "count" and f.distinct:
+                aname = "count_distinct"
+            spec = AggSpec(aname, in_ch, _agg_output_type(name, arg.type))
+        specs.append(spec)
+        agg_map[id(f)] = (state_ch, spec)
+        state_ch += state_width(spec)
+    node = N.ProjectNode(node, pre_exprs)
+    agg = N.AggregationNode(node, list(range(len(q.group_by))), specs,
+                            step="SINGLE", max_groups=max_groups)
+    return agg, scope, agg_map, key_map
+
+
+def _plan_agg_outputs(an, q, pre_scope, agg_map, key_map):
+    """Post-aggregation projection: replace aggregate calls with state
+    refs (finalizing avg as sum/count), group-by expressions with key
+    channels."""
+    agg_node_types: Dict[int, T.Type] = {}
+
+    def finalize(f: P.Func) -> E.RowExpression:
+        ch, spec = agg_map[id(f)]
+        if spec.canonical == "avg":
+            sum_t = T.decimal(38, spec.output_type.scale) \
+                if spec.output_type.is_decimal else T.DOUBLE
+            s = E.input_ref(ch, sum_t)
+            c = E.input_ref(ch + 1, T.BIGINT)
+            return E.call("divide", spec.output_type, s, c)
+        if spec.canonical in ("var_samp", "var_pop", "stddev_samp",
+                              "stddev_pop"):
+            raise NotImplementedError(
+                "variance finalization lands with expression-level state "
+                "finalizers")
+        return E.input_ref(ch, spec.output_type)
+
+    def rewrite(nde, scope_keys) -> E.RowExpression:
+        if isinstance(nde, P.Func) and id(nde) in agg_map:
+            return finalize(nde)
+        # group key expression?
+        for i, g in enumerate(q.group_by):
+            if nde == g or (isinstance(g, P.Literal) and g.kind == "int"
+                            and q.select.items[int(g.value) - 1].expr == nde):
+                ch = key_map[i]
+                return E.input_ref(ch, scope_keys[ch])
+        if isinstance(nde, P.BinOp):
+            l = rewrite(nde.left, scope_keys)
+            r = rewrite(nde.right, scope_keys)
+            if nde.op in ("and", "or"):
+                return E.special(nde.op.upper(), T.BOOLEAN, l, r)
+            if nde.op in ("=", "<>", "!=", "<", "<=", ">", ">="):
+                name = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt",
+                        "<=": "le", ">": "gt", ">=": "ge"}[nde.op]
+                return E.call(name, T.BOOLEAN, l, r)
+            name = {"+": "add", "-": "subtract", "*": "multiply",
+                    "/": "divide", "%": "modulus"}[nde.op]
+            return E.call(name, an._arith_type(name, l.type, r.type), l, r)
+        if isinstance(nde, P.Literal):
+            return an._literal(nde)
+        if isinstance(nde, P.Func):
+            args = [rewrite(a, scope_keys) for a in nde.args]
+            return E.call(nde.name, an._func_type(nde.name, args), *args)
+        if isinstance(nde, P.Cast):
+            v = rewrite(nde.value, scope_keys)
+            return E.call("cast", T.parse_type(nde.type_name), v)
+        raise NotImplementedError(
+            f"expression over aggregates not supported: {nde}")
+
+    # key channel types come from the pre-projection
+    nkeys = len(q.group_by)
+    key_types: Dict[int, T.Type] = {}
+    for i, g in enumerate(q.group_by):
+        if isinstance(g, P.Literal) and g.kind == "int":
+            e = an.lower(q.select.items[int(g.value) - 1].expr, pre_scope)
+        else:
+            e = an.lower(g, pre_scope)
+        key_types[key_map[i]] = e.type
+
+    out_exprs, names = [], []
+    for i, item in enumerate(q.select.items):
+        e = rewrite(item.expr, key_types)
+        out_exprs.append(e)
+        names.append(_item_name(item, i))
+
+    having_e = rewrite(q.having, key_types) if q.having is not None else None
+    return out_exprs, names, having_e
+
+
+def sql(query_text: str, sf: float = 0.01, mesh=None,
+        max_groups: int = 1 << 16, **kwargs):
+    """One-call SQL execution over the tpch catalog: the query-runner
+    front door (DistributedQueryRunner.execute analog)."""
+    from ..exec import run_query
+    root = plan_sql(query_text, max_groups=max_groups)
+    return run_query(root, sf=sf, mesh=mesh, **kwargs)
