@@ -25,6 +25,7 @@ from ..block import Batch, batch_from_numpy, to_numpy
 from ..connectors import tpch
 from ..plan import nodes as N
 from .planner import CompiledPlan, compile_plan
+from .stats import RuntimeStats
 
 __all__ = ["run_query", "QueryResult"]
 
@@ -35,6 +36,7 @@ class QueryResult:
     nulls: List[np.ndarray]
     names: List[str]
     row_count: int
+    stats: Dict[str, Dict[str, float]] = dataclasses.field(default_factory=dict)
 
     def rows(self) -> List[tuple]:
         out = []
@@ -72,27 +74,44 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     multiple of the mesh size and the plan runs SPMD. With `split_rows`,
     streamable aggregation plans execute split-by-split with bounded
     HBM (exec/streaming.py)."""
+    from ..plan.validator import validate_plan
+    violations = validate_plan(root, distributed=mesh is not None)
+    if violations:
+        raise ValueError("plan not executable by the TPU engine "
+                         f"(PlanChecker): {violations}")
+    stats = RuntimeStats()
     if split_rows is not None and mesh is None:
         from .streaming import run_streaming_agg, streamable_agg_shape
         if streamable_agg_shape(root) is not None:
-            r = run_streaming_agg(root, sf, split_rows)
+            with stats.timed("streaming_exec_s"):
+                r = run_streaming_agg(root, sf, split_rows)
             if bool(np.asarray(r.overflow)):
                 raise RuntimeError("streaming aggregation overflowed "
                                    "max_groups; raise AggregationNode.max_groups")
-            return _batch_to_result(r.batch, root)
+            res = _batch_to_result(r.batch, root)
+            res.stats = stats.snapshot()
+            return res
     plan = compile_plan(root, mesh, default_join_capacity)
     pad = (mesh.devices.size if mesh is not None else 1) * 8
     hints = capacity_hints or {}
-    batches = [
-        _scan_batch(s, sf, hints.get(s.id), pad) for s in plan.scan_nodes]
+    with stats.timed("scan_stage_s"):
+        batches = [
+            _scan_batch(s, sf, hints.get(s.id), pad) for s in plan.scan_nodes]
+    for b in batches:
+        stats.add("scan_rows", int(np.asarray(b.active).sum()))
     fn = jax.jit(plan.fn)
-    out, overflow = fn(tuple(batches))
-    jax.block_until_ready(out)
+    with stats.timed("execute_s"):
+        out, overflow = fn(tuple(batches))
+        jax.block_until_ready(out)
     if bool(np.asarray(overflow)):
         raise RuntimeError(
             "plan execution overflowed a static bucket (join/exchange/"
             "group capacity); rerun with larger capacity_hints")
-    return _batch_to_result(out, root)
+    with stats.timed("fetch_s"):
+        res = _batch_to_result(out, root)
+    stats.add("output_rows", res.row_count)
+    res.stats = stats.snapshot()
+    return res
 
 
 def _batch_to_result(out: Batch, root: N.PlanNode) -> QueryResult:

@@ -1,0 +1,72 @@
+"""RuntimeStats: named counters threaded through query execution.
+
+Reference surface: presto-common's RuntimeStats (named add/merge
+counters recorded anywhere and returned to clients in QueryStats) and
+the per-operator OperatorStats wall/cpu/rows plumbing
+(OperatorContext). Device-side per-operator timing inside one fused XLA
+program is not observable (that's the point of fusion); stats here are
+the host-visible boundaries: staging, compile, execute, rows/bytes --
+the numbers EXPLAIN ANALYZE and the UI surface.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["RuntimeStats", "timed"]
+
+
+@dataclasses.dataclass
+class _Stat:
+    count: int = 0
+    total: float = 0.0
+    max: float = 0.0
+
+    def add(self, v: float):
+        self.count += 1
+        self.total += v
+        self.max = max(self.max, v)
+
+
+class RuntimeStats:
+    def __init__(self):
+        self._stats: Dict[str, _Stat] = {}
+        self._lock = threading.Lock()
+
+    def add(self, name: str, value: float):
+        with self._lock:
+            self._stats.setdefault(name, _Stat()).add(value)
+
+    def merge(self, other: "RuntimeStats"):
+        with self._lock:
+            for k, s in other._stats.items():
+                mine = self._stats.setdefault(k, _Stat())
+                mine.count += s.count
+                mine.total += s.total
+                mine.max = max(mine.max, s.max)
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {k: {"count": s.count, "total": round(s.total, 6),
+                        "max": round(s.max, 6)}
+                    for k, s in self._stats.items()}
+
+    def timed(self, name: str):
+        return timed(self, name)
+
+
+class timed:
+    def __init__(self, stats: RuntimeStats, name: str):
+        self.stats = stats
+        self.name = name
+
+    def __enter__(self):
+        self.t0 = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        self.stats.add(self.name, time.time() - self.t0)
+        return False

@@ -4,9 +4,10 @@ from .nodes import (PlanNode, TableScanNode, ValuesNode, FilterNode,
                     OutputNode, from_json, to_json)
 from .fragment import PlanFragment, fragment_plan
 from .explain import explain, explain_distributed
+from .validator import validate_plan
 
 __all__ = ["PlanNode", "TableScanNode", "ValuesNode", "FilterNode",
            "ProjectNode", "AggregationNode", "JoinNode", "SemiJoinNode",
            "SortNode", "TopNNode", "LimitNode", "DistinctNode", "ExchangeNode",
            "OutputNode", "from_json", "to_json", "PlanFragment", "fragment_plan",
-           "explain", "explain_distributed"]
+           "explain", "explain_distributed", "validate_plan"]

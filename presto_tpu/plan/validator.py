@@ -1,0 +1,70 @@
+"""Plan validation: which plan shapes can this engine execute?
+
+Reference surface: the PlanChecker SPI (presto-spi/.../spi/plan/
+PlanChecker.java) and the native worker's VeloxPlanValidator
+(presto_cpp/main/types/VeloxPlanValidator.cpp), which the
+plan-checker-router plugin dry-runs to route unsupported queries to a
+Java cluster. `validate_plan` returns the list of violations; empty
+means executable (the `tpu_execution_enabled` admission check).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..expr import ir as E
+from ..expr.functions import REGISTRY
+from ..ops.aggregation import _AGGS
+from . import nodes as N
+
+__all__ = ["validate_plan"]
+
+_SPECIAL_INTERCEPTED = {"like", "date_add", "date_trunc", "date_diff",
+                        "split_part", "cast"}
+
+
+def _check_expr(e: E.RowExpression, out: List[str]):
+    if isinstance(e, E.Call):
+        name = e.name.lower()
+        if name not in REGISTRY and name not in _SPECIAL_INTERCEPTED:
+            out.append(f"unregistered scalar function {name!r}")
+        if name == "like" and not isinstance(e.arguments[1], E.Constant):
+            out.append("LIKE with non-constant pattern")
+        if name in ("date_add", "date_trunc", "date_diff") and \
+                not isinstance(e.arguments[0], E.Constant):
+            out.append(f"{name} with non-constant unit")
+    for c in e.children():
+        _check_expr(c, out)
+
+
+def validate_plan(root: N.PlanNode, distributed: bool = False) -> List[str]:
+    out: List[str] = []
+
+    def walk(n: N.PlanNode):
+        if isinstance(n, N.TableScanNode):
+            if n.connector != "tpch":
+                out.append(f"unknown connector {n.connector!r}")
+        elif isinstance(n, N.FilterNode):
+            _check_expr(n.predicate, out)
+        elif isinstance(n, N.ProjectNode):
+            for e in n.expressions:
+                _check_expr(e, out)
+        elif isinstance(n, N.AggregationNode):
+            for a in n.aggregates:
+                if a.name not in _AGGS:
+                    out.append(f"unsupported aggregate {a.name!r}")
+                elif distributed and a.canonical == "count_distinct" and \
+                        n.step != "SINGLE":
+                    out.append("count_distinct partials don't merge; "
+                               "pre-partition rows by group keys")
+        elif isinstance(n, N.JoinNode):
+            if n.join_type not in ("inner", "left"):
+                out.append(f"unsupported join type {n.join_type!r}")
+        elif isinstance(n, N.ExchangeNode):
+            if n.kind not in ("REPARTITION", "REPLICATE", "GATHER"):
+                out.append(f"unsupported exchange kind {n.kind!r}")
+        for s in n.sources:
+            walk(s)
+
+    walk(root)
+    return out

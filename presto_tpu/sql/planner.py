@@ -173,9 +173,12 @@ class _Analyzer:
             if name == "multiply":
                 return T.decimal(38, s1 + s2)
             if name == "divide":
-                # simplified scale rule (reference computes precision-aware
-                # scales); keep enough fractional digits for ratios
-                return T.decimal(38, min(max(s1, s2) + 6, 12))
+                # the reference computes precision-aware decimal scales on
+                # int128; on int64 lanes the dividend rescale overflows for
+                # wide operands, so SQL-level decimal division yields DOUBLE
+                # (exact decimal division survives where scales stay small,
+                # e.g. the avg finalizer)
+                return T.DOUBLE
             if name == "modulus":
                 return T.decimal(38, max(s1, s2))
         if t1.is_integral and t2.is_integral:
@@ -601,9 +604,13 @@ def _plan_agg_outputs(an, q, pre_scope, agg_map, key_map):
 
 
 def sql(query_text: str, sf: float = 0.01, mesh=None,
-        max_groups: int = 1 << 16, **kwargs):
+        max_groups: int = 1 << 16, join_capacity: Optional[int] = None,
+        **kwargs):
     """One-call SQL execution over the tpch catalog: the query-runner
     front door (DistributedQueryRunner.execute analog)."""
     from ..exec import run_query
-    root = plan_sql(query_text, max_groups=max_groups)
+    root = plan_sql(query_text, max_groups=max_groups,
+                    join_capacity=join_capacity)
+    if join_capacity is not None:
+        kwargs.setdefault("default_join_capacity", join_capacity)
     return run_query(root, sf=sf, mesh=mesh, **kwargs)

@@ -1,0 +1,155 @@
+"""Wider TPC-H SQL coverage (q5, q10, q12, q14 shapes) vs numpy oracles.
+
+Seed of the AbstractTestQueries-style suite (SURVEY.md §4): every query
+runs through parser -> planner -> SPMD lowering -> kernels and must
+match an independent host-side implementation exactly.
+"""
+
+import collections
+
+import numpy as np
+import pytest
+
+from presto_tpu.connectors import tpch
+from presto_tpu.sql import sql
+
+SF = 0.01
+EPOCH = np.datetime64("1970-01-01")
+
+
+def d(s):
+    return int((np.datetime64(s) - EPOCH).astype(int))
+
+
+def test_tpch_q12():
+    res = sql("""
+      SELECT shipmode,
+             sum(CASE WHEN orderpriority = '1-URGENT'
+                       OR orderpriority = '2-HIGH' THEN 1 ELSE 0 END) AS high,
+             sum(CASE WHEN orderpriority <> '1-URGENT'
+                      AND orderpriority <> '2-HIGH' THEN 1 ELSE 0 END) AS low
+      FROM orders o JOIN lineitem l ON o.orderkey = l.orderkey
+      WHERE l.shipmode IN ('MAIL', 'SHIP')
+        AND l.commitdate < l.receiptdate
+        AND l.shipdate < l.commitdate
+        AND l.receiptdate >= date '1994-01-01'
+        AND l.receiptdate < date '1995-01-01'
+      GROUP BY shipmode ORDER BY shipmode
+    """, sf=SF, max_groups=16, join_capacity=1 << 18)
+    li = tpch.generate_columns("lineitem", SF,
+                               ["orderkey", "shipmode", "commitdate",
+                                "receiptdate", "shipdate"])
+    od = tpch.generate_columns("orders", SF, ["orderkey", "orderpriority"])
+    pr = dict(zip(od["orderkey"], od["orderpriority"]))
+    m = (np.isin(li["shipmode"], ["MAIL", "SHIP"])
+         & (li["commitdate"] < li["receiptdate"])
+         & (li["shipdate"] < li["commitdate"])
+         & (li["receiptdate"] >= d("1994-01-01"))
+         & (li["receiptdate"] < d("1995-01-01")))
+    want = collections.defaultdict(lambda: [0, 0])
+    for ok, sm in zip(li["orderkey"][m], li["shipmode"][m]):
+        hi = pr[ok] in ("1-URGENT", "2-HIGH")
+        want[sm][0 if hi else 1] += 1
+    got = {r[0]: [r[1], r[2]] for r in res.rows()}
+    assert got == dict(want)
+    assert list(got) == sorted(got)
+
+
+def test_tpch_q14():
+    res = sql("""
+      SELECT 100.00 * sum(CASE WHEN p.type LIKE 'PROMO%'
+                          THEN l.extendedprice * (1 - l.discount)
+                          ELSE 0 END)
+             / sum(l.extendedprice * (1 - l.discount)) AS promo_revenue
+      FROM lineitem l JOIN part p ON l.partkey = p.partkey
+      WHERE l.shipdate >= date '1995-09-01' AND l.shipdate < date '1995-10-01'
+    """, sf=SF, max_groups=4, join_capacity=1 << 18)
+    li = tpch.generate_columns("lineitem", SF,
+                               ["partkey", "extendedprice", "discount",
+                                "shipdate"])
+    pt = tpch.generate_columns("part", SF, ["type"])
+    m = (li["shipdate"] >= d("1995-09-01")) & (li["shipdate"] < d("1995-10-01"))
+    promo = num = 0
+    for pk, p, disc in zip(li["partkey"][m], li["extendedprice"][m],
+                           li["discount"][m]):
+        rev = int(p) * (100 - int(disc))
+        num += rev
+        if pt["type"][pk - 1].startswith("PROMO"):
+            promo += rev
+    want = 100.0 * (promo / num)
+    got = res.rows()[0][0]
+    assert got == pytest.approx(want, rel=1e-9)
+
+
+def test_tpch_q10_shape():
+    res = sql("""
+      SELECT c.custkey, c.name, sum(l.extendedprice * (1 - l.discount)) AS rev,
+             c.acctbal, n.name AS nation
+      FROM customer c
+      JOIN orders o ON c.custkey = o.custkey
+      JOIN lineitem l ON l.orderkey = o.orderkey
+      JOIN nation n ON c.nationkey = n.nationkey
+      WHERE o.orderdate >= date '1993-10-01' AND o.orderdate < date '1994-01-01'
+        AND l.returnflag = 'R'
+      GROUP BY c.custkey, c.name, c.acctbal, n.name
+      ORDER BY rev DESC
+      LIMIT 20
+    """, sf=SF, max_groups=1 << 14, join_capacity=1 << 18)
+    assert res.row_count == 20
+    revs = [r[2] for r in res.rows()]
+    assert revs == sorted(revs, reverse=True)
+    # oracle for the top row
+    cu = tpch.generate_columns("customer", SF, ["custkey", "nationkey"])
+    od = tpch.generate_columns("orders", SF, ["orderkey", "custkey",
+                                              "orderdate"])
+    li = tpch.generate_columns("lineitem", SF,
+                               ["orderkey", "extendedprice", "discount",
+                                "returnflag"])
+    omask = (od["orderdate"] >= d("1993-10-01")) & (od["orderdate"] < d("1994-01-01"))
+    ocust = dict(zip(od["orderkey"][omask], od["custkey"][omask]))
+    lmask = (li["returnflag"] == "R") & np.isin(li["orderkey"], list(ocust))
+    want = collections.Counter()
+    for ok, p, disc in zip(li["orderkey"][lmask], li["extendedprice"][lmask],
+                           li["discount"][lmask]):
+        want[int(ocust[ok])] += int(p) * (100 - int(disc))
+    top_rev = max(want.values())
+    assert res.rows()[0][2] == top_rev
+
+
+def test_tpch_q5_five_way_join():
+    res = sql("""
+      SELECT n.name, sum(l.extendedprice * (1 - l.discount)) AS revenue
+      FROM customer c
+      JOIN orders o ON c.custkey = o.custkey
+      JOIN lineitem l ON l.orderkey = o.orderkey
+      JOIN nation n ON c.nationkey = n.nationkey
+      JOIN region r ON n.regionkey = r.regionkey
+      WHERE r.name = 'ASIA'
+        AND o.orderdate >= date '1994-01-01' AND o.orderdate < date '1995-01-01'
+      GROUP BY n.name ORDER BY revenue DESC
+    """, sf=SF, max_groups=64, join_capacity=1 << 18)
+    # oracle
+    cu = tpch.generate_columns("customer", SF, ["custkey", "nationkey"])
+    od = tpch.generate_columns("orders", SF, ["orderkey", "custkey", "orderdate"])
+    li = tpch.generate_columns("lineitem", SF,
+                               ["orderkey", "extendedprice", "discount"])
+    na = tpch.generate_columns("nation", SF, ["nationkey", "name", "regionkey"])
+    re_ = tpch.generate_columns("region", SF, ["regionkey", "name"])
+    asia = set(re_["regionkey"][re_["name"] == "ASIA"])
+    nkeys = {int(k): nm for k, nm, rk in zip(na["nationkey"], na["name"],
+                                             na["regionkey"]) if rk in asia}
+    cnation = {int(c): nkeys[int(n)] for c, n in zip(cu["custkey"],
+                                                     cu["nationkey"])
+               if int(n) in nkeys}
+    omask = (od["orderdate"] >= d("1994-01-01")) & (od["orderdate"] < d("1995-01-01"))
+    ocust = {int(k): int(c) for k, c in zip(od["orderkey"][omask],
+                                            od["custkey"][omask])
+             if int(c) in cnation}
+    want = collections.Counter()
+    for ok, p, disc in zip(li["orderkey"], li["extendedprice"], li["discount"]):
+        if int(ok) in ocust:
+            want[cnation[ocust[int(ok)]]] += int(p) * (100 - int(disc))
+    got = {r[0]: r[1] for r in res.rows()}
+    assert got == dict(want)
+    revs = [r[1] for r in res.rows()]
+    assert revs == sorted(revs, reverse=True)
