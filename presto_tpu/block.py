@@ -126,7 +126,37 @@ class DictionaryColumn:
 
 _register(DictionaryColumn, ["indices", "dictionary", "nulls"], ["type"])
 
-Block = Union[Column, StringColumn, DictionaryColumn]
+
+@dataclasses.dataclass
+class ArrayColumn:
+    """Fixed-fanout array column (ArrayBlock analog, TPU layout): row i's
+    array is elements[i, :lengths[i]]. The reference stores arrays as
+    offsets into a flat child block (pointer-shaped); a (N, K) matrix
+    keeps element access vectorizable -- K is the per-batch max
+    cardinality (shape bucketing, like string widths). Fixed-width
+    element types in round 1."""
+
+    elements: jax.Array    # (N, K) element values
+    elem_nulls: jax.Array  # (N, K)
+    lengths: jax.Array     # (N,)
+    nulls: jax.Array       # (N,) top-level null array
+    type: T.Type = dataclasses.field(metadata=dict(static=True))
+
+    def __len__(self):
+        return self.elements.shape[0]
+
+    @property
+    def capacity(self) -> int:
+        return self.elements.shape[0]
+
+    @property
+    def max_cardinality(self) -> int:
+        return self.elements.shape[1]
+
+
+_register(ArrayColumn, ["elements", "elem_nulls", "lengths", "nulls"], ["type"])
+
+Block = Union[Column, StringColumn, DictionaryColumn, ArrayColumn]
 
 
 @dataclasses.dataclass
@@ -182,7 +212,34 @@ def _pad(arr: np.ndarray, capacity: int, fill=0) -> np.ndarray:
 def from_numpy(ty: T.Type, values: np.ndarray, nulls: Optional[np.ndarray] = None,
                capacity: Optional[int] = None) -> Block:
     """Stage a host column to a device Block. For string types `values`
-    must be an object/str numpy array or a (N, L) uint8 matrix."""
+    must be an object/str numpy array or a (N, L) uint8 matrix; for
+    array types, an object array of Python lists (None elements = null,
+    None rows = null array)."""
+    if ty.base == "array":
+        ety = ty.element_type
+        rows = list(values)
+        n = len(rows)
+        capacity = capacity or n
+        k = max((len(r) for r in rows if r is not None), default=1) or 1
+        elems = np.zeros((n, k), dtype=ety.to_dtype())
+        enulls = np.ones((n, k), dtype=bool)
+        lengths = np.zeros(n, dtype=np.int32)
+        topn = np.zeros(n, dtype=bool) if nulls is None else \
+            np.asarray(nulls, dtype=bool).copy()
+        for i, r in enumerate(rows):
+            if r is None or topn[i]:
+                topn[i] = True
+                continue
+            lengths[i] = len(r)
+            for j, v in enumerate(r):
+                if v is None:
+                    continue
+                elems[i, j] = v
+                enulls[i, j] = False
+        return ArrayColumn(jnp.asarray(_pad(elems, capacity)),
+                           jnp.asarray(_pad(enulls, capacity, fill=True)),
+                           jnp.asarray(_pad(lengths, capacity)),
+                           jnp.asarray(_pad(topn, capacity, fill=True)), ty)
     n = values.shape[0]
     capacity = capacity or n
     if nulls is None:
@@ -230,9 +287,21 @@ def batch_from_numpy(types: Sequence[T.Type], arrays: Sequence[np.ndarray],
 
 
 def to_numpy(block: Block) -> Tuple[np.ndarray, np.ndarray]:
-    """Fetch (values, nulls) to host. Strings come back as an object array."""
+    """Fetch (values, nulls) to host. Strings come back as an object
+    array; arrays as an object array of Python lists."""
     if isinstance(block, DictionaryColumn):
         return to_numpy(block.decode())
+    if isinstance(block, ArrayColumn):
+        elems = np.asarray(block.elements)
+        enulls = np.asarray(block.elem_nulls)
+        lengths = np.asarray(block.lengths)
+        nulls = np.asarray(block.nulls)
+        out = np.empty(len(lengths), dtype=object)
+        for i in range(len(lengths)):
+            out[i] = None if nulls[i] else [
+                None if enulls[i, j] else elems[i, j].item()
+                for j in range(lengths[i])]
+        return out, nulls
     if isinstance(block, StringColumn):
         chars = np.asarray(block.chars)
         lengths = np.asarray(block.lengths)
@@ -240,6 +309,38 @@ def to_numpy(block: Block) -> Tuple[np.ndarray, np.ndarray]:
                          for i in range(chars.shape[0])], dtype=object)
         return vals, np.asarray(block.nulls)
     return np.asarray(block.values), np.asarray(block.nulls)
+
+
+def gather_block(b: Block, idx: jax.Array, valid: Optional[jax.Array] = None
+                 ) -> Block:
+    """Row gather for every Block kind (the one shared implementation
+    behind join/aggregation/unnest/sort row movement). `valid=None`
+    means a pure permutation (nulls ride along); with a mask, invalid
+    output rows become NULL/empty."""
+    if isinstance(b, DictionaryColumn):
+        if valid is None:
+            return DictionaryColumn(b.indices[idx], b.dictionary,
+                                    b.nulls[idx], b.type)
+        b = b.decode()
+    if isinstance(b, StringColumn):
+        lengths = b.lengths[idx]
+        nulls = b.nulls[idx]
+        if valid is not None:
+            lengths = jnp.where(valid, lengths, 0)
+            nulls = jnp.where(valid, nulls, True)
+        return StringColumn(b.chars[idx], lengths, nulls, b.type)
+    if isinstance(b, ArrayColumn):
+        lengths = b.lengths[idx]
+        nulls = b.nulls[idx]
+        if valid is not None:
+            lengths = jnp.where(valid, lengths, 0)
+            nulls = jnp.where(valid, nulls, True)
+        return ArrayColumn(b.elements[idx], b.elem_nulls[idx], lengths,
+                           nulls, b.type)
+    nulls = b.nulls[idx]
+    if valid is not None:
+        nulls = jnp.where(valid, nulls, True)
+    return Column(b.values[idx], nulls, b.type)
 
 
 def concat_batches(batches: Sequence[Batch]) -> Batch:

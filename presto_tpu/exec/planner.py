@@ -118,6 +118,14 @@ def compile_plan(root: N.PlanNode, mesh=None,
             if keys is None:
                 keys = list(range(len(node.output_types())))
             return distinct_op(lower(node.source, inputs), keys, node.max_groups)
+        if isinstance(node, N.UnnestNode):
+            from ..ops.unnest import unnest as unnest_op
+            src = lower(node.source, inputs)
+            cap = node.out_capacity or src.capacity * 4
+            out, ovf = unnest_op(src, node.array_channel, cap,
+                                 node.with_ordinality)
+            _note_overflow(ovf)
+            return out
         if isinstance(node, N.ExchangeNode):
             src = lower(node.source, inputs)
             if node.scope == "LOCAL" or not dist:

@@ -41,7 +41,10 @@ class RuntimeStats:
             self._stats.setdefault(name, _Stat()).add(value)
 
     def merge(self, other: "RuntimeStats"):
-        with self._lock:
+        # lock both sides (ordered by id to avoid deadlock): _Stat.add is
+        # multi-field, so reading `other` unlocked could tear mid-update
+        first, second = sorted((self._lock, other._lock), key=id)
+        with first, second:
             for k, s in other._stats.items():
                 mine = self._stats.setdefault(k, _Stat())
                 mine.count += s.count

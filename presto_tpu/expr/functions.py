@@ -542,11 +542,16 @@ def _trim(ret, a: StringColumn):
 
 
 def contains_pattern(a: StringColumn, needle: bytes):
-    """Vectorized substring search (LIKE '%needle%')."""
+    """Vectorized substring search (LIKE '%needle%'). On TPU this
+    dispatches to the Pallas VMEM-tiled kernel (ops/pallas_kernels.py);
+    the XLA fallback materializes the window gather."""
     L = max(len(needle), 1)
     n, w = a.chars.shape
     if L > w:
         return jnp.zeros(n, dtype=bool)
+    from ..ops.pallas_kernels import contains_bytes, pallas_supported
+    if pallas_supported():
+        return contains_bytes(a.chars, a.lengths, needle)
     pat = jnp.asarray(bytearray(needle), dtype=jnp.uint8)
     windows = w - L + 1
     idx = (jnp.arange(windows, dtype=jnp.int32)[:, None]
@@ -822,6 +827,72 @@ def _cast(ret, a):
         return _col(ret, (a.values // 86_400_000_000).astype(jnp.int32), a)
     # plain numeric widening/narrowing
     return _col(ret, a.values.astype(ret.to_dtype()), a)
+
+
+# ---------------------------------------------------------------------------
+# array functions (fixed-fanout ArrayColumn; see block.py)
+# ---------------------------------------------------------------------------
+
+@register("cardinality")
+def _cardinality(ret, a):
+    from ..block import ArrayColumn
+    assert isinstance(a, ArrayColumn)
+    return Column(a.lengths.astype(ret.to_dtype()), a.nulls, ret)
+
+
+@register("element_at")
+def _element_at(ret, a, idx: Column):
+    """element_at(array, i): 1-based; negative counts from the end;
+    out-of-range -> NULL (Presto element_at semantics)."""
+    from ..block import ArrayColumn
+    assert isinstance(a, ArrayColumn)
+    i0 = idx.values.astype(jnp.int32)
+    pos = jnp.where(i0 < 0, a.lengths + i0, i0 - 1)
+    oob = (pos < 0) | (pos >= a.lengths) | (i0 == 0)
+    pc = jnp.clip(pos, 0, a.max_cardinality - 1)
+    rows = jnp.arange(len(a), dtype=jnp.int32)
+    vals = a.elements[rows, pc]
+    nulls = a.nulls | idx.nulls | oob | a.elem_nulls[rows, pc]
+    return Column(vals, nulls, ret)
+
+
+@register("contains")
+def _contains(ret, a, x: Column):
+    from ..block import ArrayColumn
+    assert isinstance(a, ArrayColumn)
+    k = a.max_cardinality
+    in_len = jnp.arange(k, dtype=jnp.int32)[None, :] < a.lengths[:, None]
+    eq = (a.elements == x.values[:, None]) & ~a.elem_nulls & in_len
+    found = jnp.any(eq, axis=1)
+    saw_null = jnp.any(a.elem_nulls & in_len, axis=1)
+    nulls = a.nulls | x.nulls | (~found & saw_null)  # NULL-in-array 3VL
+    return Column(found & ~nulls, nulls, ret)
+
+
+@register("array_max")
+def _array_max(ret, a):
+    from ..block import ArrayColumn
+    assert isinstance(a, ArrayColumn)
+    k = a.max_cardinality
+    in_len = jnp.arange(k, dtype=jnp.int32)[None, :] < a.lengths[:, None]
+    live = in_len & ~a.elem_nulls
+    ident = jnp.iinfo(jnp.int64).min if not ret.is_floating else -jnp.inf
+    v = jnp.max(jnp.where(live, a.elements, ident), axis=1)
+    empty = ~jnp.any(live, axis=1)
+    return Column(v.astype(ret.to_dtype()), a.nulls | empty, ret)
+
+
+@register("array_min")
+def _array_min(ret, a):
+    from ..block import ArrayColumn
+    assert isinstance(a, ArrayColumn)
+    k = a.max_cardinality
+    in_len = jnp.arange(k, dtype=jnp.int32)[None, :] < a.lengths[:, None]
+    live = in_len & ~a.elem_nulls
+    ident = jnp.iinfo(jnp.int64).max if not ret.is_floating else jnp.inf
+    v = jnp.min(jnp.where(live, a.elements, ident), axis=1)
+    empty = ~jnp.any(live, axis=1)
+    return Column(v.astype(ret.to_dtype()), a.nulls | empty, ret)
 
 
 # ---------------------------------------------------------------------------

@@ -71,8 +71,9 @@ class AggSpec:
     second_channel: Optional[int] = None
     second_type: Optional[T.Type] = None  # order-value type for min_by/max_by
 
-    def __post_init__(self):
-        assert self.name in _AGGS, self.name
+    # NOTE: unknown names are allowed at construction so plan JSON from a
+    # newer coordinator can still be dry-run through validate_plan (the
+    # plan-checker router use case); execution fails in _acc_columns.
 
     @property
     def canonical(self) -> str:
@@ -129,13 +130,7 @@ def _group_ids(key_cols: Sequence[Block], active: jnp.ndarray, max_groups: int):
     return ids, perm_first, num_groups, overflow
 
 
-def _gather_block(b: Block, idx: jnp.ndarray, valid: jnp.ndarray) -> Block:
-    if isinstance(b, DictionaryColumn):
-        b = b.decode()
-    if isinstance(b, StringColumn):
-        return StringColumn(b.chars[idx], jnp.where(valid, b.lengths[idx], 0),
-                            jnp.where(valid, b.nulls[idx], True), b.type)
-    return Column(b.values[idx], jnp.where(valid, b.nulls[idx], True), b.type)
+from ..block import gather_block as _gather_block  # shared row gather
 
 
 def _sum_dtype(ty: T.Type):
@@ -251,7 +246,7 @@ def _acc_columns(spec: AggSpec, col: Optional[Block], ids, active, max_groups: i
         cnt = jnp.zeros(g, dtype=jnp.int64).at[ids].add(
             (first & live).astype(jnp.int64))
         return [("count", Column(cnt, jnp.zeros(g, dtype=bool), T.BIGINT))]
-    raise NotImplementedError(spec.name)
+    raise NotImplementedError(f"aggregate function {spec.name!r}")
 
 
 def _argbest(order_words: List[jnp.ndarray], ids, live, g, minimize: bool):

@@ -193,13 +193,7 @@ def hash_join(probe: Batch, build: Batch,
     return JoinResult(out, total, overflow)
 
 
-def _gather(b: Block, idx, valid) -> Block:
-    if isinstance(b, DictionaryColumn):
-        b = b.decode()
-    if isinstance(b, StringColumn):
-        return StringColumn(b.chars[idx], jnp.where(valid, b.lengths[idx], 0),
-                            jnp.where(valid, b.nulls[idx], True), b.type)
-    return Column(b.values[idx], jnp.where(valid, b.nulls[idx], True), b.type)
+from ..block import gather_block as _gather  # shared row gather
 
 
 def semi_join_mask(probe: Batch, build: Batch,

@@ -62,12 +62,7 @@ def sort_permutation(batch: Batch, keys: Sequence[SortKey]) -> jnp.ndarray:
     return out[-1]
 
 
-def _permute_block(b: Block, perm: jnp.ndarray) -> Block:
-    if isinstance(b, DictionaryColumn):
-        return DictionaryColumn(b.indices[perm], b.dictionary, b.nulls[perm], b.type)
-    if isinstance(b, StringColumn):
-        return StringColumn(b.chars[perm], b.lengths[perm], b.nulls[perm], b.type)
-    return Column(b.values[perm], b.nulls[perm], b.type)
+from ..block import gather_block as _permute_block  # perm = gather, no mask
 
 
 def sort_batch(batch: Batch, keys: Sequence[SortKey]) -> Batch:
@@ -80,14 +75,6 @@ def top_n(batch: Batch, keys: Sequence[SortKey], n: int) -> Batch:
     """TopN: sorted prefix of n rows (static output capacity n)."""
     s = sort_batch(batch, keys)
     take = min(n, s.capacity)
-    cols = []
-    for c in s.columns:
-        if isinstance(c, DictionaryColumn):
-            cols.append(DictionaryColumn(c.indices[:take], c.dictionary,
-                                         c.nulls[:take], c.type))
-        elif isinstance(c, StringColumn):
-            cols.append(StringColumn(c.chars[:take], c.lengths[:take],
-                                     c.nulls[:take], c.type))
-        else:
-            cols.append(Column(c.values[:take], c.nulls[:take], c.type))
-    return Batch(tuple(cols), s.active[:take])
+    head = jnp.arange(take, dtype=jnp.int32)
+    cols = tuple(_permute_block(c, head) for c in s.columns)
+    return Batch(cols, s.active[:take])

@@ -1,0 +1,59 @@
+"""Unnest: the UnnestOperator analog.
+
+Reference surface: operator/unnest/ (UnnestOperator expanding ARRAY/MAP
+columns into rows, replicating the other channels; UnnestNode in the
+plan vocabulary, WITH ORDINALITY variant).
+
+TPU-first: the same static-capacity prefix-sum expansion the join build
+uses (ops/join.py): output slot k maps back to its source row by
+binary-searching the exclusive offsets of per-row cardinalities, and to
+the element by k - offset[row]. One gather per output column -- no
+per-row loops, overflow flagged when out_capacity is short.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import types as T
+from ..block import ArrayColumn, Batch, Block, Column, DictionaryColumn, \
+    StringColumn, gather_block as _gather
+
+__all__ = ["unnest"]
+
+
+def unnest(batch: Batch, array_channel: int, out_capacity: int,
+           with_ordinality: bool = False) -> Tuple[Batch, jnp.ndarray]:
+    """Expand batch rows by the array at `array_channel`. Output columns:
+    all input columns except the array, then the element column (and an
+    ordinality BIGINT column when requested). NULL/empty arrays emit no
+    rows (Presto UNNEST semantics). Returns (batch, overflow)."""
+    arr = batch.column(array_channel)
+    assert isinstance(arr, ArrayColumn), "unnest requires an array column"
+    n = batch.capacity
+
+    cnt = jnp.where(batch.active & ~arr.nulls, arr.lengths, 0).astype(jnp.int64)
+    off = jnp.cumsum(cnt) - cnt
+    total = off[-1] + cnt[-1]
+    overflow = total > out_capacity
+
+    k = jnp.arange(out_capacity, dtype=jnp.int64)
+    row = jnp.clip(jnp.searchsorted(off, k, side="right") - 1, 0, n - 1)
+    j = k - off[row]
+    valid = (k < total) & (j < cnt[row])
+    jc = jnp.clip(j, 0, arr.max_cardinality - 1).astype(jnp.int32)
+
+    out_cols: List[Block] = []
+    for ci, c in enumerate(batch.columns):
+        if ci == array_channel:
+            continue
+        out_cols.append(_gather(c, row, valid))
+    elem_vals = arr.elements[row, jc]
+    elem_nulls = jnp.where(valid, arr.elem_nulls[row, jc], True)
+    out_cols.append(Column(elem_vals, elem_nulls, arr.type.element_type))
+    if with_ordinality:
+        out_cols.append(Column(j + 1, ~valid, T.BIGINT))
+    return Batch(tuple(out_cols), valid), overflow

@@ -226,6 +226,29 @@ class DistinctNode(PlanNode):
 
 
 @dataclasses.dataclass
+class UnnestNode(PlanNode):
+    """UNNEST(array) [WITH ORDINALITY] (operator/unnest/ analog). Output:
+    non-array source columns, then the element column (+ ordinality)."""
+    source: PlanNode
+    array_channel: int
+    out_capacity: Optional[int] = None
+    with_ordinality: bool = False
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_types(self):
+        src = self.source.output_types()
+        arr = src[self.array_channel]
+        out = [t for i, t in enumerate(src) if i != self.array_channel]
+        out.append(arr.element_type)
+        if self.with_ordinality:
+            out.append(T.BIGINT)
+        return out
+
+
+@dataclasses.dataclass
 class ExchangeNode(PlanNode):
     """scope REMOTE => stage boundary (collective over the mesh);
     scope LOCAL => no-op in this engine (XLA fuses local pipelines).
@@ -321,6 +344,11 @@ def to_json(n: PlanNode) -> dict:
     if isinstance(n, DistinctNode):
         return {**base, "@type": "distinct", "source": to_json(n.source),
                 "keyChannels": n.key_channels, "maxGroups": n.max_groups}
+    if isinstance(n, UnnestNode):
+        return {**base, "@type": "unnest", "source": to_json(n.source),
+                "arrayChannel": n.array_channel,
+                "outCapacity": n.out_capacity,
+                "withOrdinality": n.with_ordinality}
     if isinstance(n, ExchangeNode):
         return {**base, "@type": "exchange", "source": to_json(n.source),
                 "kind": n.kind, "scope": n.scope,
@@ -369,6 +397,9 @@ def from_json(j: dict) -> PlanNode:
     if t == "distinct":
         return DistinctNode(from_json(j["source"]), j["keyChannels"],
                             j["maxGroups"], **kw)
+    if t == "unnest":
+        return UnnestNode(from_json(j["source"]), j["arrayChannel"],
+                          j["outCapacity"], j["withOrdinality"], **kw)
     if t == "exchange":
         return ExchangeNode(from_json(j["source"]), j["kind"], j["scope"],
                             j["partitionChannels"], j["slotCapacity"], **kw)

@@ -21,6 +21,9 @@ __all__ = ["validate_plan"]
 
 _SPECIAL_INTERCEPTED = {"like", "date_add", "date_trunc", "date_diff",
                         "split_part", "cast"}
+_DATE_UNITS = {"date_add": {"day", "week", "month", "year"},
+               "date_trunc": {"day", "week", "month", "quarter", "year"},
+               "date_diff": {"day", "week", "month", "quarter", "year"}}
 
 
 def _check_expr(e: E.RowExpression, out: List[str]):
@@ -30,9 +33,19 @@ def _check_expr(e: E.RowExpression, out: List[str]):
             out.append(f"unregistered scalar function {name!r}")
         if name == "like" and not isinstance(e.arguments[1], E.Constant):
             out.append("LIKE with non-constant pattern")
-        if name in ("date_add", "date_trunc", "date_diff") and \
-                not isinstance(e.arguments[0], E.Constant):
-            out.append(f"{name} with non-constant unit")
+        if name in _DATE_UNITS:
+            unit = e.arguments[0]
+            if not isinstance(unit, E.Constant):
+                out.append(f"{name} with non-constant unit")
+            elif str(unit.value) not in _DATE_UNITS[name]:
+                out.append(f"{name} unit {unit.value!r} not supported")
+        if name == "split_part":
+            if not isinstance(e.arguments[1], E.Constant):
+                out.append("split_part with non-constant delimiter")
+            elif len(str(e.arguments[1].value)) != 1:
+                out.append("split_part delimiter must be 1 byte")
+            if not isinstance(e.arguments[2], E.Constant):
+                out.append("split_part with non-constant index")
     for c in e.children():
         _check_expr(c, out)
 
@@ -50,6 +63,10 @@ def validate_plan(root: N.PlanNode, distributed: bool = False) -> List[str]:
             for e in n.expressions:
                 _check_expr(e, out)
         elif isinstance(n, N.AggregationNode):
+            st = n.source.output_types()
+            for c in n.group_channels:
+                if st[c].base == "array":
+                    out.append("array-typed group key")
             for a in n.aggregates:
                 if a.name not in _AGGS:
                     out.append(f"unsupported aggregate {a.name!r}")
@@ -60,6 +77,19 @@ def validate_plan(root: N.PlanNode, distributed: bool = False) -> List[str]:
         elif isinstance(n, N.JoinNode):
             if n.join_type not in ("inner", "left"):
                 out.append(f"unsupported join type {n.join_type!r}")
+            lt = n.left.output_types()
+            rt = n.right.output_types()
+            for c in n.left_keys:
+                if lt[c].base == "array":
+                    out.append("array-typed join key")
+            for c in n.right_keys:
+                if rt[c].base == "array":
+                    out.append("array-typed join key")
+        elif isinstance(n, (N.SortNode, N.TopNNode)):
+            st = n.source.output_types()
+            for c, _, _ in n.keys:
+                if st[c].base == "array":
+                    out.append("array-typed sort key")
         elif isinstance(n, N.ExchangeNode):
             if n.kind not in ("REPARTITION", "REPLICATE", "GATHER"):
                 out.append(f"unsupported exchange kind {n.kind!r}")

@@ -1,0 +1,80 @@
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.block import ArrayColumn, Batch, batch_from_numpy, from_numpy, \
+    to_numpy
+from presto_tpu.expr import call, compile_projections, const, input_ref
+from presto_tpu.ops.unnest import unnest
+
+ARR = T.array_of(T.BIGINT)
+
+
+def make_batch(arrays, ids=None):
+    import jax.numpy as jnp
+    arr_col = from_numpy(ARR, np.array(arrays, dtype=object))
+    n = len(arrays)
+    id_col = from_numpy(T.BIGINT, np.arange(n, dtype=np.int64)
+                        if ids is None else np.asarray(ids))
+    active = jnp.ones(n, dtype=bool)
+    return Batch((id_col, arr_col), active)
+
+
+def test_array_roundtrip():
+    col = from_numpy(ARR, np.array([[1, 2, 3], [], None, [7, None]],
+                                   dtype=object))
+    v, n = to_numpy(col)
+    assert v[0] == [1, 2, 3] and v[1] == [] and v[2] is None
+    assert v[3] == [7, None]
+    assert list(n) == [False, False, True, False]
+
+
+def test_cardinality_element_at_contains():
+    b = make_batch([[10, 20, 30], [], None, [5]])
+    x = input_ref(1, ARR)
+
+    def ev(e):
+        return to_numpy(compile_projections([e])(b).column(0))
+
+    v, n = ev(call("cardinality", T.BIGINT, x))
+    assert list(v[:2]) == [3, 0] and n[2]
+    v, n = ev(call("element_at", T.BIGINT, x, const(2, T.BIGINT)))
+    assert v[0] == 20 and n[1] and n[2] and n[3]
+    v, n = ev(call("element_at", T.BIGINT, x, const(-1, T.BIGINT)))
+    assert v[0] == 30 and v[3] == 5
+    v, n = ev(call("contains", T.BOOLEAN, x, const(20, T.BIGINT)))
+    assert v[0] and not v[1] and not v[3]
+    v, n = ev(call("array_max", T.BIGINT, x))
+    assert v[0] == 30 and n[1] and n[2]
+
+
+def test_unnest_expansion():
+    b = make_batch([[10, 20], [], None, [30, 40, 50]])
+    out, ovf = unnest(b, 1, out_capacity=8)
+    assert not bool(np.asarray(ovf))
+    act = np.asarray(out.active)
+    ids, _ = to_numpy(out.column(0))
+    elems, en = to_numpy(out.column(1))
+    got = sorted((int(ids[i]), int(elems[i])) for i in np.nonzero(act)[0])
+    assert got == [(0, 10), (0, 20), (3, 30), (3, 40), (3, 50)]
+
+
+def test_unnest_with_ordinality_and_overflow():
+    b = make_batch([[10, 20], [30]])
+    out, ovf = unnest(b, 1, out_capacity=8, with_ordinality=True)
+    act = np.asarray(out.active)
+    ords, _ = to_numpy(out.column(2))
+    ids, _ = to_numpy(out.column(0))
+    got = sorted((int(ids[i]), int(ords[i])) for i in np.nonzero(act)[0])
+    assert got == [(0, 1), (0, 2), (1, 1)]
+    _, ovf = unnest(b, 1, out_capacity=2)
+    assert bool(np.asarray(ovf))
+
+
+def test_unnest_plan_node():
+    from presto_tpu.plan import UnnestNode, OutputNode, ValuesNode, to_json, \
+        from_json
+    v = ValuesNode([T.BIGINT], [[1]])
+    u = UnnestNode(v, 0, out_capacity=8)
+    j = to_json(OutputNode(u, ["e"]))
+    assert from_json(j).source.array_channel == 0
