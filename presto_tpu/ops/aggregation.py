@@ -52,7 +52,8 @@ __all__ = ["AggSpec", "GroupByResult", "group_by", "grouped_aggregate",
 _AGGS = ("sum", "count", "count_star", "min", "max", "avg",
          "var_samp", "var_pop", "stddev_samp", "stddev_pop", "stddev",
          "variance", "bool_and", "bool_or", "every", "min_by", "max_by",
-         "count_distinct", "approx_distinct", "arbitrary", "any_value")
+         "count_distinct", "approx_distinct", "arbitrary", "any_value",
+         "approx_percentile")
 
 # canonical name -> implementation family
 _ALIAS = {"stddev": "stddev_samp", "variance": "var_samp",
@@ -70,6 +71,7 @@ class AggSpec:
     output_type: T.Type
     second_channel: Optional[int] = None
     second_type: Optional[T.Type] = None  # order-value type for min_by/max_by
+    parameter: Optional[float] = None     # percentile fraction etc.
 
     # NOTE: unknown names are allowed at construction so plan JSON from a
     # newer coordinator can still be dry-run through validate_plan (the
@@ -234,6 +236,30 @@ def _acc_columns(spec: AggSpec, col: Optional[Block], ids, active, max_groups: i
         return [(name, Column(v[idx], ~valid | col.nulls[idx],
                               spec.output_type)),
                 ("order", Column(order_col.values[idx], ~valid, oty))]
+    if name == "approx_percentile":
+        # computed EXACTLY via sort (the reference uses KLL/tdigest
+        # sketches for mergeable states -- those land with the sketch
+        # library; exact is within any epsilon): rows sort by (group id,
+        # value); each group's answer sits at start + floor((n-1)*p).
+        assert spec.parameter is not None, "approx_percentile needs fraction"
+        p = float(spec.parameter)
+        n = len(col)
+        vwords, _ = key_words([col])
+        vwords = vwords[1:]  # drop null word; dead rows masked via lead
+        lead = jnp.where(live, np.uint64(0), np.uint64(1))
+        ops_ = [lead, ids.astype(jnp.uint64), *vwords,
+                jnp.arange(n, dtype=jnp.int32)]
+        perm = jax.lax.sort(ops_, num_keys=len(ops_) - 1)[-1]
+        pos = jnp.arange(n, dtype=jnp.int64)
+        sorted_ids = jnp.where(live[perm], ids[perm], g)
+        start = jnp.full(g, n, dtype=jnp.int64).at[
+            jnp.clip(sorted_ids, 0, g - 1)].min(
+            jnp.where(sorted_ids < g, pos, n))
+        target = start + jnp.floor((nn - 1).astype(jnp.float64) * p).astype(jnp.int64)
+        target = jnp.clip(target, 0, n - 1)
+        rows_sel = perm[target]
+        vals = v[rows_sel]
+        return [("percentile", Column(vals, no_input, spec.output_type))]
     if name == "count_distinct":
         assert batch is not None
         # exact: mark first occurrence of each (group, value) pair.
@@ -402,12 +428,12 @@ def merge_spec(spec: AggSpec, state_channel: int) -> List[AggSpec]:
                         second_type=spec.second_type)]
     if c == "arbitrary":
         return [AggSpec("arbitrary", state_channel, spec.output_type)]
-    if c == "count_distinct":
+    if c in ("count_distinct", "approx_percentile"):
         raise NotImplementedError(
-            "count_distinct/approx_distinct states don't merge across "
-            "partials; distributed plans must hash-exchange raw rows by the "
-            "group keys first, then aggregate in one step (the standard "
-            "mark_distinct plan shape)")
+            f"{spec.name} states don't merge across partials; distributed "
+            "plans must hash-exchange raw rows by the group keys first, "
+            "then aggregate in one step (the standard mark_distinct plan "
+            "shape; sketch states arrive with the KLL/HLL library)")
     raise NotImplementedError(spec.name)
 
 

@@ -70,10 +70,13 @@ def validate_plan(root: N.PlanNode, distributed: bool = False) -> List[str]:
             for a in n.aggregates:
                 if a.name not in _AGGS:
                     out.append(f"unsupported aggregate {a.name!r}")
-                elif distributed and a.canonical == "count_distinct" and \
+                elif distributed and a.canonical in ("count_distinct",
+                                                     "approx_percentile") and \
                         n.step != "SINGLE":
-                    out.append("count_distinct partials don't merge; "
+                    out.append(f"{a.name} partials don't merge; "
                                "pre-partition rows by group keys")
+                elif a.canonical == "approx_percentile" and a.parameter is None:
+                    out.append("approx_percentile without a fraction")
         elif isinstance(n, N.JoinNode):
             if n.join_type not in ("inner", "left"):
                 out.append(f"unsupported join type {n.join_type!r}")

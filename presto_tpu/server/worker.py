@@ -101,6 +101,10 @@ class TaskManager:
                 task.state = "RUNNING"
             plan = N.from_json(body["plan"])
             session = Session(body.get("session", {}))
+            if not session.get("tpu_execution_enabled"):
+                raise RuntimeError(
+                    "tpu_execution_enabled=false: fragment refused by the "
+                    "TPU worker (route to a row-engine cluster)")
             sf = float(body.get("sf", self.sf))
             codec = PageCodec(
                 compression=(session.get("exchange_compression")

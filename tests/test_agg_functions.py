@@ -113,6 +113,33 @@ def test_count_distinct_exact():
     assert got == {1: (2,), 2: (1,)}  # nulls don't count
 
 
+def test_approx_percentile_exact():
+    rng = np.random.default_rng(13)
+    keys = rng.integers(0, 5, 500).astype(np.int64)
+    vals = rng.integers(-1000, 1000, 500).astype(np.int64)
+    b = batch_from_numpy([T.BIGINT, T.BIGINT], [keys, vals], capacity=512)
+    for p in (0.5, 0.9, 0.0, 1.0):
+        r = group_by(b, [0], [AggSpec("approx_percentile", 1, T.BIGINT,
+                                      parameter=p)], max_groups=8)
+        got = table(r, 1)
+        for k in np.unique(keys):
+            sv = np.sort(vals[keys == k])
+            want = sv[int(np.floor((len(sv) - 1) * p))]
+            assert got[int(k)][0] == want, (p, k)
+
+
+def test_approx_percentile_with_nulls_and_other_aggs():
+    keys = np.array([1, 1, 1, 1], dtype=np.int64)
+    vals = np.array([10, 40, 20, 99], dtype=np.int64)
+    vn = np.array([False, False, False, True])
+    b = batch_from_numpy([T.BIGINT, T.BIGINT], [keys, vals], nulls=[None, vn])
+    r = group_by(b, [0], [AggSpec("approx_percentile", 1, T.BIGINT,
+                                  parameter=0.5),
+                          AggSpec("count", 1, T.BIGINT)], max_groups=4)
+    got = table(r, 2)
+    assert got[1] == (20, 3)  # median of {10,20,40}; null skipped
+
+
 def test_arbitrary():
     k = np.array([1, 1, 2], dtype=np.int64)
     v = np.array([10, 20, 30], dtype=np.int64)

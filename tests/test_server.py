@@ -96,6 +96,14 @@ def test_abort(client):
     assert info["state"] in ("ABORTED", "FINISHED")  # may already be done
 
 
+def test_tpu_execution_disabled_gate(client):
+    plan = q_plan()
+    client.submit("t6", plan, session={"tpu_execution_enabled": "false"})
+    info = client.wait("t6")
+    assert info["state"] == "FAILED"
+    assert "tpu_execution_enabled" in info["error"]
+
+
 def test_compressed_results(client):
     plan = q_plan()
     client.submit("t5", plan, session={"exchange_compression": "zstd"})
