@@ -1,0 +1,143 @@
+"""Discovery service + announcer + failure detection.
+
+Reference surface: the airlift discovery service embedded in the
+coordinator (workers announce via periodic POSTs -- Java
+DiscoveryNodeManager, native Announcer.cpp/CoordinatorDiscoverer.cpp)
+and HeartbeatFailureDetector (presto-main/.../failureDetector/) whose
+decayed failure rate gates scheduling.
+
+DiscoveryServer: stdlib HTTP service holding node announcements.
+Announcer: worker-side thread re-announcing on an interval.
+alive_nodes(): detector view -- nodes whose last announcement is
+fresher than the timeout (the scheduler's eligible-worker set).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+__all__ = ["DiscoveryServer", "Announcer", "alive_nodes"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    nodes: Dict[str, dict] = {}
+    lock = threading.Lock()
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_PUT(self):  # noqa: N802  /v1/announcement/{node_id}
+        parts = [p for p in self.path.split("/") if p]
+        if len(parts) == 3 and parts[:2] == ["v1", "announcement"]:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            with self.lock:
+                self.nodes[parts[2]] = {**body, "nodeId": parts[2],
+                                        "lastSeen": time.time()}
+            return self._json({"announced": True}, 202)
+        return self._json({"error": "bad path"}, 404)
+
+    def do_GET(self):  # noqa: N802  /v1/service/presto-tpu
+        parts = [p for p in self.path.split("/") if p]
+        if len(parts) >= 2 and parts[:2] == ["v1", "service"]:
+            now = time.time()
+            with self.lock:
+                services = [{**n, "ageSeconds": round(now - n["lastSeen"], 3)}
+                            for n in self.nodes.values()]
+            return self._json({"services": services})
+        return self._json({"error": "bad path"}, 404)
+
+    def do_DELETE(self):  # noqa: N802  graceful shutdown un-announce
+        parts = [p for p in self.path.split("/") if p]
+        if len(parts) == 3 and parts[:2] == ["v1", "announcement"]:
+            with self.lock:
+                self.nodes.pop(parts[2], None)
+            return self._json({"removed": True})
+        return self._json({"error": "bad path"}, 404)
+
+
+class DiscoveryServer:
+    def __init__(self, port: int = 0):
+        handler = type("BoundDiscovery", (_Handler,),
+                       {"nodes": {}, "lock": threading.Lock()})
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self.httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+
+    def start(self):
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class Announcer:
+    """Worker-side periodic announcement (Announcer.cpp analog)."""
+
+    def __init__(self, discovery_url: str, node_id: str, worker_url: str,
+                 interval_s: float = 1.0, environment: str = "tpu"):
+        self.discovery_url = discovery_url.rstrip("/")
+        self.node_id = node_id
+        self.body = json.dumps({"uri": worker_url,
+                                "environment": environment,
+                                "coordinator": False}).encode()
+        self.interval = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def announce_once(self):
+        req = urllib.request.Request(
+            f"{self.discovery_url}/v1/announcement/{self.node_id}",
+            data=self.body, method="PUT",
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=5).read()
+
+    def start(self):
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.announce_once()
+                except Exception:
+                    pass  # discovery outage: keep trying (airlift behavior)
+                self._stop.wait(self.interval)
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, unannounce: bool = True):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+        if unannounce:
+            try:
+                req = urllib.request.Request(
+                    f"{self.discovery_url}/v1/announcement/{self.node_id}",
+                    method="DELETE")
+                urllib.request.urlopen(req, timeout=5).read()
+            except Exception:
+                pass
+
+
+def alive_nodes(discovery_url: str, max_age_s: float = 5.0) -> List[dict]:
+    """HeartbeatFailureDetector view: nodes announced within max_age_s
+    (the scheduler's eligible set; stale nodes are failed)."""
+    with urllib.request.urlopen(f"{discovery_url.rstrip('/')}/v1/service/presto-tpu",
+                                timeout=5) as resp:
+        services = json.loads(resp.read())["services"]
+    return [s for s in services if s["ageSeconds"] <= max_age_s]

@@ -1,0 +1,57 @@
+"""Cross-worker HTTP exchange source: the DCN / mixed-cluster data plane.
+
+Reference surface: PrestoExchangeSource.cpp (the native worker's
+ExchangeSource pulling SerializedPages from peer workers over HTTP with
+token acks) and operator/ExchangeClient.java:255. Within a TPU slice,
+stage-to-stage traffic rides all_to_all over ICI (parallel/exchange.py);
+ACROSS slices -- or against Java workers in a mixed cluster -- pages
+move through this protocol-level path: fetch peer task results, decode
+SerializedPages, stage into a device Batch for the consuming fragment.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .. import types as T
+from ..block import Batch, batch_from_numpy
+from ..serde import PageCodec
+from .client import WorkerClient
+
+__all__ = ["fetch_remote_batch"]
+
+
+def fetch_remote_batch(sources: Sequence[str], task_ids: Sequence[str],
+                       types: Sequence[T.Type],
+                       codec: PageCodec = PageCodec(),
+                       capacity: Optional[int] = None,
+                       timeout: float = 60.0) -> Batch:
+    """Pull every page of `task_ids[i]` from worker base-url `sources[i]`,
+    concatenate, and stage as one device Batch -- the RemoteSourceNode
+    feed for a fragment whose upstream ran on other workers/slices."""
+    all_cols: List[List[np.ndarray]] = [[] for _ in types]
+    all_nulls: List[List[np.ndarray]] = [[] for _ in types]
+    total = 0
+    for base, tid in zip(sources, task_ids):
+        client = WorkerClient(base, timeout=timeout)
+        client.wait(tid, timeout=timeout)
+        cols = client.fetch_results(tid, types, codec)
+        n = len(cols[0][0]) if cols else 0
+        total += n
+        for c, (v, m) in enumerate(cols):
+            all_cols[c].append(v)
+            all_nulls[c].append(m)
+    arrays = []
+    nulls = []
+    for c, ty in enumerate(types):
+        if all_cols[c]:
+            arrays.append(np.concatenate(all_cols[c]))
+            nulls.append(np.concatenate(all_nulls[c]))
+        else:
+            arrays.append(np.array([], dtype=object if ty.is_string
+                                   else ty.to_dtype()))
+            nulls.append(np.array([], dtype=bool))
+    cap = capacity or max(-(-total // 8) * 8, 8)
+    return batch_from_numpy(types, arrays, nulls, capacity=cap)

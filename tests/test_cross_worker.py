@@ -1,0 +1,75 @@
+"""Two real worker processes + cross-worker HTTP exchange: the
+mixed-cluster / cross-slice two-stage query path.
+
+Worker A and worker B each run the PARTIAL stage of q1-style
+aggregation over DISJOINT splits of orders (split assignment by the
+scheduler analog = this test); the consumer pulls both partial tables
+over HTTP (SerializedPages, token/ack) and runs the FINAL merge --
+exactly the reference's multi-worker stage wiring
+(SURVEY.md §3.4), with the engine's merge kernel at the end.
+"""
+
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.block import to_numpy
+from presto_tpu.connectors import tpch
+from presto_tpu.ops.aggregation import AggSpec, merge_partials
+from presto_tpu.plan import (AggregationNode, FilterNode, OutputNode,
+                             ProjectNode, TableScanNode)
+from presto_tpu.expr import call, const, input_ref
+from presto_tpu.serde import PageCodec
+from presto_tpu.server import TpuWorkerServer, WorkerClient
+from presto_tpu.server.http_exchange import fetch_remote_batch
+
+
+def partial_plan(lo_half: bool):
+    cols = ["custkey", "totalprice", "orderkey"]
+    s = TableScanNode("tpch", "orders", cols,
+                      [tpch.column_type("orders", c) for c in cols])
+    n = tpch.table_row_count("orders", 0.01)
+    mid = const(n // 2, T.BIGINT)
+    f = FilterNode(s, call("le" if lo_half else "gt", T.BOOLEAN,
+                           input_ref(2, T.BIGINT), mid))
+    p = ProjectNode(f, [input_ref(0, T.BIGINT), input_ref(1, T.decimal(15, 2))])
+    agg = AggregationNode(p, [0], [AggSpec("sum", 1, T.decimal(38, 2)),
+                                  AggSpec("count_star", None, T.BIGINT)],
+                          step="PARTIAL", max_groups=1 << 13)
+    return OutputNode(agg, ["custkey", "sum_state", "cnt_state"])
+
+
+def test_two_worker_partial_final():
+    wa = TpuWorkerServer(sf=0.01).start()
+    wb = TpuWorkerServer(sf=0.01).start()
+    try:
+        ca = WorkerClient(f"http://127.0.0.1:{wa.port}")
+        cb = WorkerClient(f"http://127.0.0.1:{wb.port}")
+        plan_a, plan_b = partial_plan(True), partial_plan(False)
+        ca.submit("stage1a", plan_a, sf=0.01)
+        cb.submit("stage1b", plan_b, sf=0.01)
+        types = plan_a.output_types()
+        batch = fetch_remote_batch(
+            [f"http://127.0.0.1:{wa.port}", f"http://127.0.0.1:{wb.port}"],
+            ["stage1a", "stage1b"], types)
+        final = merge_partials(batch, 1,
+                               [AggSpec("sum", 1, T.decimal(38, 2)),
+                                AggSpec("count_star", None, T.BIGINT)],
+                               max_groups=1 << 13)
+        assert not bool(np.asarray(final.overflow))
+        act = np.asarray(final.batch.active)
+        k, _ = to_numpy(final.batch.column(0))
+        s, _ = to_numpy(final.batch.column(1))
+        c, _ = to_numpy(final.batch.column(2))
+        got = {int(k[i]): (int(s[i]), int(c[i]))
+               for i in np.nonzero(act)[0]}
+        # oracle over the whole table
+        oc = tpch.generate_columns("orders", 0.01, ["custkey", "totalprice"])
+        want = {}
+        for ck, tp in zip(oc["custkey"], oc["totalprice"]):
+            s0, c0 = want.get(int(ck), (0, 0))
+            want[int(ck)] = (s0 + int(tp), c0 + 1)
+        assert got == want
+    finally:
+        wa.stop()
+        wb.stop()

@@ -1,0 +1,29 @@
+import time
+
+from presto_tpu.server.discovery import Announcer, DiscoveryServer, alive_nodes
+
+
+def test_announce_discover_expire_unannounce():
+    d = DiscoveryServer().start()
+    try:
+        a1 = Announcer(d.url, "worker-1", "http://127.0.0.1:9001",
+                       interval_s=0.2).start()
+        a2 = Announcer(d.url, "worker-2", "http://127.0.0.1:9002",
+                       interval_s=0.2).start()
+        time.sleep(0.4)
+        nodes = alive_nodes(d.url, max_age_s=2.0)
+        assert {n["nodeId"] for n in nodes} == {"worker-1", "worker-2"}
+        assert nodes[0]["uri"].startswith("http://127.0.0.1:900")
+
+        # stop worker-2 WITHOUT unannounce: heartbeat detector must age it out
+        a2.stop(unannounce=False)
+        time.sleep(1.0)
+        nodes = alive_nodes(d.url, max_age_s=0.8)
+        assert {n["nodeId"] for n in nodes} == {"worker-1"}
+
+        # graceful shutdown unannounces immediately
+        a1.stop(unannounce=True)
+        nodes = alive_nodes(d.url, max_age_s=60.0)
+        assert nodes == []
+    finally:
+        d.stop()
