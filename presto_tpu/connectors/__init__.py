@@ -1,0 +1,33 @@
+"""Connector registry: catalog name -> generator module.
+
+Reference surface: the Plugin/ConnectorFactory registration path
+(presto-spi Plugin.java; MetadataManager catalog map). Each connector
+module exposes the same surface: TPCH_SCHEMA/TPCDS_SCHEMA-style schema
+dict (as `SCHEMA`), table_row_count, generate_columns, generate_batch,
+column_type.
+"""
+
+from . import tpch as _tpch_pkg
+
+
+def _load():
+    from . import tpch, tpcds
+    return {"tpch": tpch, "tpcds": tpcds}
+
+
+CATALOGS = None
+
+
+def catalog(name: str):
+    global CATALOGS
+    if CATALOGS is None:
+        CATALOGS = _load()
+    try:
+        return CATALOGS[name]
+    except KeyError:
+        raise KeyError(f"unknown connector/catalog {name!r}") from None
+
+
+def schema_of(name: str):
+    mod = catalog(name)
+    return getattr(mod, "TPCH_SCHEMA", None) or getattr(mod, "TPCDS_SCHEMA")

@@ -1,0 +1,5 @@
+from .generator import (TPCDS_SCHEMA, table_row_count, generate_columns,
+                        generate_batch, column_type)
+
+__all__ = ["TPCDS_SCHEMA", "table_row_count", "generate_columns",
+           "generate_batch", "column_type"]

@@ -1,0 +1,270 @@
+"""Deterministic columnar TPC-DS generator (core star-schema subset).
+
+Reference surface: presto-tpcds (the airlift dsdgen port exposed as a
+connector; deterministic generated data for the TPC-DS suites). Same
+stateless splitmix64 design as the tpch generator (see
+connectors/tpch/generator.py): any split of any table is a pure
+function of (table, column, row index, scale factor).
+
+Round-1 subset: the tables the join-heavy benchmark queries (q3, q42,
+q52, q55 family and kin) touch -- store_sales, date_dim, item,
+customer, store. Cardinalities follow the spec at SF1 with sqrt scaling
+for the dimension tables (the spec's sub-linear dimension growth,
+simplified). Remaining 19 tables arrive with the catalog build-out.
+
+Decimals are scaled int64 cents (engine-wide representation).
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ... import types as T
+from ...block import Batch, batch_from_numpy
+
+_D72 = T.decimal(7, 2)
+
+TPCDS_SCHEMA: Dict[str, List[Tuple[str, T.Type]]] = {
+    "store_sales": [
+        ("ss_sold_date_sk", T.BIGINT), ("ss_item_sk", T.BIGINT),
+        ("ss_customer_sk", T.BIGINT), ("ss_store_sk", T.BIGINT),
+        ("ss_quantity", T.INTEGER), ("ss_list_price", _D72),
+        ("ss_sales_price", _D72), ("ss_ext_sales_price", _D72),
+        ("ss_ext_discount_amt", _D72), ("ss_net_profit", _D72),
+        ("ss_ticket_number", T.BIGINT),
+    ],
+    "date_dim": [
+        ("d_date_sk", T.BIGINT), ("d_date", T.DATE), ("d_year", T.INTEGER),
+        ("d_moy", T.INTEGER), ("d_dom", T.INTEGER), ("d_qoy", T.INTEGER),
+        ("d_day_name", T.varchar(9)),
+    ],
+    "item": [
+        ("i_item_sk", T.BIGINT), ("i_item_id", T.varchar(16)),
+        ("i_brand_id", T.INTEGER), ("i_brand", T.varchar(50)),
+        ("i_manufact_id", T.INTEGER), ("i_category_id", T.INTEGER),
+        ("i_category", T.varchar(50)), ("i_manager_id", T.INTEGER),
+        ("i_current_price", _D72),
+    ],
+    "customer": [
+        ("c_customer_sk", T.BIGINT), ("c_customer_id", T.varchar(16)),
+        ("c_current_addr_sk", T.BIGINT), ("c_first_name", T.varchar(20)),
+        ("c_last_name", T.varchar(30)), ("c_birth_year", T.INTEGER),
+    ],
+    "store": [
+        ("s_store_sk", T.BIGINT), ("s_store_id", T.varchar(16)),
+        ("s_store_name", T.varchar(50)), ("s_state", T.varchar(2)),
+    ],
+}
+
+# date_dim spans 1900-01-01 .. 2100-01-01 in the spec; sk is julian-based.
+_DATE_ROWS = 73049
+_SK_BASE = 2415022          # spec JulianDate of row 0
+_EPOCH_OFFSET_DAYS = int((np.datetime64("1900-01-01")
+                          - np.datetime64("1970-01-01")).astype(int))
+
+# store_sales sold dates concentrate in 1998-01-01..2003-12-31
+_SOLD_LO = int((np.datetime64("1998-01-01") - np.datetime64("1900-01-01")).astype(int))
+_SOLD_HI = int((np.datetime64("2003-12-31") - np.datetime64("1900-01-01")).astype(int))
+
+_CATEGORIES = ["Books", "Children", "Electronics", "Home", "Jewelry", "Men",
+               "Music", "Shoes", "Sports", "Women"]
+_DAY_NAMES = ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
+              "Saturday", "Sunday"]
+_STATES = ["TN", "CA", "TX", "NY", "WA", "GA", "OH", "IL"]
+
+
+def table_row_count(table: str, sf: float) -> int:
+    if table == "store_sales":
+        return int(2_880_000 * sf)
+    if table == "date_dim":
+        return _DATE_ROWS
+    if table == "item":
+        return max(int(18_000 * max(sf, 1 / 36) ** 0.5), 500)
+    if table == "customer":
+        return max(int(100_000 * max(sf, 1 / 100) ** 0.5), 1_000)
+    if table == "store":
+        return max(int(12 * max(sf, 1) ** 0.5), 12)
+    raise KeyError(table)
+
+
+def column_type(table: str, column: str) -> T.Type:
+    for name, ty in TPCDS_SCHEMA[table]:
+        if name == column:
+            return ty
+    raise KeyError(f"{table}.{column}")
+
+
+_M1 = np.uint64(0xBF58476D1CE4E5B9)
+_M2 = np.uint64(0x94D049BB133111EB)
+_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    with np.errstate(over="ignore"):
+        z = (x + _GOLDEN).astype(np.uint64)
+        z = np.bitwise_xor(z, z >> np.uint64(30)) * _M1
+        z = np.bitwise_xor(z, z >> np.uint64(27)) * _M2
+        return np.bitwise_xor(z, z >> np.uint64(31))
+
+
+def _h(table: str, column: str, idx: np.ndarray) -> np.ndarray:
+    seed = _splitmix64(np.uint64(zlib.crc32(f"tpcds.{table}.{column}".encode())))
+    with np.errstate(over="ignore"):
+        return _splitmix64(idx.astype(np.uint64) * _GOLDEN + seed)
+
+
+def _uniform(table, column, idx, lo, hi):
+    return (_h(table, column, idx) % np.uint64(hi - lo + 1)).astype(np.int64) + lo
+
+
+def _pick(table, column, idx, choices):
+    codes = (_h(table, column, idx) % np.uint64(len(choices))).astype(np.int64)
+    return np.array(choices, dtype=object)[codes]
+
+
+def _gen_store_sales(column, idx, sf):
+    n_item = table_row_count("item", sf)
+    n_cust = table_row_count("customer", sf)
+    n_store = table_row_count("store", sf)
+    if column == "ss_sold_date_sk":
+        d = _uniform("store_sales", "sold", idx, _SOLD_LO, _SOLD_HI)
+        return d + _SK_BASE
+    if column == "ss_item_sk":
+        return _uniform("store_sales", "item", idx, 1, n_item)
+    if column == "ss_customer_sk":
+        return _uniform("store_sales", "cust", idx, 1, n_cust)
+    if column == "ss_store_sk":
+        return _uniform("store_sales", "store", idx, 1, n_store)
+    if column == "ss_quantity":
+        return _uniform("store_sales", "qty", idx, 1, 100).astype(np.int32)
+    if column == "ss_list_price":
+        return _uniform("store_sales", "list", idx, 100, 20000)
+    if column == "ss_sales_price":
+        lp = _uniform("store_sales", "list", idx, 100, 20000)
+        disc = _uniform("store_sales", "sdisc", idx, 0, 100)
+        return (lp * (100 - disc) // 100).astype(np.int64)
+    if column == "ss_ext_sales_price":
+        qty = _uniform("store_sales", "qty", idx, 1, 100)
+        lp = _uniform("store_sales", "list", idx, 100, 20000)
+        disc = _uniform("store_sales", "sdisc", idx, 0, 100)
+        return (qty * (lp * (100 - disc) // 100)).astype(np.int64)
+    if column == "ss_ext_discount_amt":
+        qty = _uniform("store_sales", "qty", idx, 1, 100)
+        lp = _uniform("store_sales", "list", idx, 100, 20000)
+        disc = _uniform("store_sales", "sdisc", idx, 0, 100)
+        return (qty * (lp * disc // 100)).astype(np.int64)
+    if column == "ss_net_profit":
+        return _uniform("store_sales", "profit", idx, -500000, 900000)
+    if column == "ss_ticket_number":
+        return (idx // 8 + 1).astype(np.int64)
+    raise KeyError(f"store_sales.{column}")
+
+
+def _gen_date_dim(column, idx, sf):
+    days = idx.astype(np.int64)  # days since 1900-01-01
+    if column == "d_date_sk":
+        return days + _SK_BASE
+    if column == "d_date":
+        return (days + _EPOCH_OFFSET_DAYS).astype(np.int32)
+    # civil calendar via numpy datetime64
+    dates = (np.datetime64("1900-01-01") + days).astype("datetime64[D]")
+    y = dates.astype("datetime64[Y]").astype(int) + 1970
+    m = dates.astype("datetime64[M]").astype(int) % 12 + 1
+    if column == "d_year":
+        return y.astype(np.int32)
+    if column == "d_moy":
+        return m.astype(np.int32)
+    if column == "d_dom":
+        dom = (dates - dates.astype("datetime64[M]")).astype(int) + 1
+        return dom.astype(np.int32)
+    if column == "d_qoy":
+        return ((m - 1) // 3 + 1).astype(np.int32)
+    if column == "d_day_name":
+        dow = ((days + 0) % 7).astype(np.int64)  # 1900-01-01 was a Monday
+        return np.array(_DAY_NAMES, dtype=object)[dow]
+    raise KeyError(f"date_dim.{column}")
+
+
+def _gen_item(column, idx, sf):
+    if column == "i_item_sk":
+        return (idx + 1).astype(np.int64)
+    if column == "i_item_id":
+        return np.array([f"AAAAAAAA{v:08d}" for v in idx], dtype=object)
+    if column == "i_brand_id":
+        return _uniform("item", "brand", idx, 1001001, 1010016).astype(np.int32)
+    if column == "i_brand":
+        b = _uniform("item", "brand", idx, 1001001, 1010016)
+        return np.char.add("Brand#", b.astype(str)).astype(object)
+    if column == "i_manufact_id":
+        return _uniform("item", "manufact", idx, 1, 1000).astype(np.int32)
+    if column == "i_category_id":
+        return (_h("item", "category", idx) % np.uint64(10) + 1).astype(np.int32)
+    if column == "i_category":
+        codes = (_h("item", "category", idx) % np.uint64(10)).astype(np.int64)
+        return np.array(_CATEGORIES, dtype=object)[codes]
+    if column == "i_manager_id":
+        return _uniform("item", "manager", idx, 1, 100).astype(np.int32)
+    if column == "i_current_price":
+        return _uniform("item", "price", idx, 100, 10000)
+    raise KeyError(f"item.{column}")
+
+
+def _gen_customer(column, idx, sf):
+    if column == "c_customer_sk":
+        return (idx + 1).astype(np.int64)
+    if column == "c_customer_id":
+        return np.array([f"AAAAAAAA{v:08d}" for v in idx], dtype=object)
+    if column == "c_current_addr_sk":
+        return _uniform("customer", "addr", idx, 1, max(table_row_count(
+            "customer", sf) // 2, 1))
+    if column == "c_first_name":
+        return _pick("customer", "first", idx,
+                     ["James", "Mary", "John", "Linda", "David", "Susan"])
+    if column == "c_last_name":
+        return _pick("customer", "last", idx,
+                     ["Smith", "Jones", "Brown", "Lee", "Garcia", "Miller"])
+    if column == "c_birth_year":
+        return _uniform("customer", "birth", idx, 1924, 1992).astype(np.int32)
+    raise KeyError(f"customer.{column}")
+
+
+def _gen_store(column, idx, sf):
+    if column == "s_store_sk":
+        return (idx + 1).astype(np.int64)
+    if column == "s_store_id":
+        return np.array([f"AAAAAAAA{v:08d}" for v in idx], dtype=object)
+    if column == "s_store_name":
+        return _pick("store", "name", idx, ["ought", "able", "pri", "ese",
+                                            "anti", "cally"])
+    if column == "s_state":
+        return _pick("store", "state", idx, _STATES)
+    raise KeyError(f"store.{column}")
+
+
+_GENERATORS = {
+    "store_sales": _gen_store_sales, "date_dim": _gen_date_dim,
+    "item": _gen_item, "customer": _gen_customer, "store": _gen_store,
+}
+
+
+def generate_columns(table: str, sf: float, columns: Sequence[str],
+                     start: int = 0, count: Optional[int] = None
+                     ) -> Dict[str, np.ndarray]:
+    total = table_row_count(table, sf)
+    if count is None:
+        count = total - start
+    assert 0 <= start and start + count <= total, (start, count, total)
+    idx = np.arange(start, start + count, dtype=np.int64)
+    gen = _GENERATORS[table]
+    return {c: gen(c, idx, sf) for c in columns}
+
+
+def generate_batch(table: str, sf: float, columns: Sequence[str],
+                   start: int = 0, count: Optional[int] = None,
+                   capacity: Optional[int] = None) -> Batch:
+    data = generate_columns(table, sf, columns, start, count)
+    tys = [column_type(table, c) for c in columns]
+    return batch_from_numpy(tys, [data[c] for c in columns], capacity=capacity)

@@ -59,10 +59,11 @@ def _scan_batch(node: N.PlanNode, sf: float, capacity_hint: Optional[int],
         cap = capacity_hint or -(-len(node.rows) // pad_multiple) * pad_multiple
         return batch_from_numpy(node.types, arrays, capacity=cap)
     assert isinstance(node, N.TableScanNode)
-    assert node.connector == "tpch", node.connector
-    n = tpch.table_row_count(node.table, sf)
+    from ..connectors import catalog
+    conn = catalog(node.connector)
+    n = conn.table_row_count(node.table, sf)
     cap = capacity_hint or -(-n // pad_multiple) * pad_multiple
-    return tpch.generate_batch(node.table, sf, node.columns, capacity=cap)
+    return conn.generate_batch(node.table, sf, node.columns, capacity=cap)
 
 
 def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,

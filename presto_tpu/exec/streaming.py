@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..block import Batch, concat_batches
-from ..connectors import tpch
+from ..connectors import catalog
 from ..ops.aggregation import AggSpec, GroupByResult, group_by, merge_partials
 from ..plan import nodes as N
 from .planner import compile_plan
@@ -81,7 +81,8 @@ def _make_agg_executor(root: N.PlanNode, sf: float, split_rows: int,
         r = merge_partials(both, nkeys, agg.aggregates, agg.max_groups)
         return r.batch, r.overflow
 
-    total = tpch.table_row_count(scan.table, sf)
+    conn = catalog(scan.connector)
+    total = conn.table_row_count(scan.table, sf)
     starts = list(range(0, total, split_rows)) or [0]  # empty table: one
     # empty split still produces a well-formed (empty) group table
 
@@ -92,7 +93,7 @@ def _make_agg_executor(root: N.PlanNode, sf: float, split_rows: int,
         bucket_arr = jnp.asarray(bucket, dtype=jnp.int32)
         for start in starts:
             count = min(split_rows, max(total - start, 0))
-            batch = tpch.generate_batch(scan.table, sf, scan.columns,
+            batch = conn.generate_batch(scan.table, sf, scan.columns,
                                         start=start, count=count,
                                         capacity=split_rows)
             part, ovf1 = split_step(batch, bucket_arr)

@@ -55,7 +55,10 @@ def validate_plan(root: N.PlanNode, distributed: bool = False) -> List[str]:
 
     def walk(n: N.PlanNode):
         if isinstance(n, N.TableScanNode):
-            if n.connector != "tpch":
+            try:
+                from ..connectors import catalog
+                catalog(n.connector)
+            except KeyError:
                 out.append(f"unknown connector {n.connector!r}")
         elif isinstance(n, N.FilterNode):
             _check_expr(n.predicate, out)

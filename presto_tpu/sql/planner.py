@@ -279,7 +279,21 @@ def plan_sql(query_text: str, max_groups: int = 1 << 16,
 
     # FROM: scans with pruned columns. First collect every referenced name.
     tables: List[P.TableRef] = [q.table] + [j.table for j in q.joins]
-    table_schemas = {t.name: dict(tpch.TPCH_SCHEMA[t.name]) for t in tables}
+
+    def find_table(name: str):
+        from ..connectors import catalog, schema_of
+        for cat in ("tpch", "tpcds"):
+            sch = schema_of(cat)
+            if name in sch:
+                return cat, dict(sch[name])
+        raise KeyError(f"table {name!r} not found in any catalog")
+
+    table_catalog = {}
+    table_schemas = {}
+    for t in tables:
+        cat, sch = find_table(t.name)
+        table_catalog[t.name] = cat
+        table_schemas[t.name] = sch
 
     referenced: Dict[str, List[str]] = {t.name: [] for t in tables}
 
@@ -338,7 +352,8 @@ def plan_sql(query_text: str, max_groups: int = 1 << 16,
     def scan_for(t: P.TableRef) -> Tuple[N.PlanNode, List[str], List[T.Type]]:
         cols = referenced[t.name] or [next(iter(table_schemas[t.name]))]
         tys = [table_schemas[t.name][c] for c in cols]
-        return (N.TableScanNode("tpch", t.name, cols, tys), cols, tys)
+        return (N.TableScanNode(table_catalog[t.name], t.name, cols, tys),
+                cols, tys)
 
     node, cols0, tys0 = scan_for(q.table)
     scope_entries: List[Tuple[str, str]] = [((q.table.alias or q.table.name), c)

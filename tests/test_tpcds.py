@@ -1,0 +1,102 @@
+"""TPC-DS connector + the q3/q42/q52 star-join family vs oracles."""
+
+import collections
+
+import numpy as np
+import pytest
+
+from presto_tpu.connectors import tpcds
+from presto_tpu.sql import sql
+
+SF = 0.02
+
+
+def test_row_counts_and_determinism():
+    assert tpcds.table_row_count("date_dim", SF) == 73049
+    a = tpcds.generate_columns("store_sales", SF,
+                               ["ss_item_sk", "ss_ext_sales_price"],
+                               start=500, count=100)
+    b = tpcds.generate_columns("store_sales", SF,
+                               ["ss_item_sk", "ss_ext_sales_price"],
+                               start=0, count=1000)
+    for c in a:
+        np.testing.assert_array_equal(a[c], b[c][500:600])
+
+
+def test_date_dim_calendar_consistency():
+    d = tpcds.generate_columns("date_dim", SF,
+                               ["d_date_sk", "d_date", "d_year", "d_moy",
+                                "d_dom", "d_qoy"], count=5000)
+    dates = np.datetime64("1970-01-01") + d["d_date"]
+    years = dates.astype("datetime64[Y]").astype(int) + 1970
+    np.testing.assert_array_equal(d["d_year"], years)
+    months = dates.astype("datetime64[M]").astype(int) % 12 + 1
+    np.testing.assert_array_equal(d["d_moy"], months)
+    np.testing.assert_array_equal(d["d_qoy"], (months - 1) // 3 + 1)
+    # sk is date-offset plus the julian base
+    np.testing.assert_array_equal(np.diff(d["d_date_sk"]), 1)
+
+
+def test_fk_ranges():
+    ss = tpcds.generate_columns("store_sales", SF,
+                                ["ss_item_sk", "ss_sold_date_sk"], count=5000)
+    assert ss["ss_item_sk"].min() >= 1
+    assert ss["ss_item_sk"].max() <= tpcds.table_row_count("item", SF)
+    dd = tpcds.generate_columns("date_dim", SF, ["d_date_sk"])
+    assert ss["ss_sold_date_sk"].min() >= dd["d_date_sk"].min()
+    assert ss["ss_sold_date_sk"].max() <= dd["d_date_sk"].max()
+
+
+def test_tpcds_q3_family():
+    # q3 shape: store_sales x date_dim x item, filter manufact + moy,
+    # group by year/brand, order by sum desc
+    res = sql("""
+      SELECT d.d_year, i.i_brand_id, i.i_brand,
+             sum(ss.ss_ext_sales_price) AS sum_agg
+      FROM store_sales ss
+      JOIN date_dim d ON ss.ss_sold_date_sk = d.d_date_sk
+      JOIN item i ON ss.ss_item_sk = i.i_item_sk
+      WHERE i.i_manufact_id = 128 AND d.d_moy = 11
+      GROUP BY d.d_year, i.i_brand_id, i.i_brand
+      ORDER BY d.d_year, sum_agg DESC, i.i_brand_id
+      LIMIT 100
+    """, sf=SF, max_groups=1 << 12, join_capacity=1 << 17)
+    # oracle
+    ss = tpcds.generate_columns("store_sales", SF,
+                                ["ss_sold_date_sk", "ss_item_sk",
+                                 "ss_ext_sales_price"])
+    it = tpcds.generate_columns("item", SF,
+                                ["i_manufact_id", "i_brand_id", "i_brand"])
+    dd = tpcds.generate_columns("date_dim", SF,
+                                ["d_date_sk", "d_year", "d_moy"])
+    moy = dict(zip(dd["d_date_sk"], dd["d_moy"]))
+    yr = dict(zip(dd["d_date_sk"], dd["d_year"]))
+    want = collections.defaultdict(int)
+    m128 = it["i_manufact_id"] == 128
+    for sk, isk, p in zip(ss["ss_sold_date_sk"], ss["ss_item_sk"],
+                          ss["ss_ext_sales_price"]):
+        if m128[isk - 1] and moy[sk] == 11:
+            want[(yr[sk], int(it["i_brand_id"][isk - 1]))] += int(p)
+    got = {(r[0], r[1]): r[3] for r in res.rows()}
+    for k, v in got.items():
+        assert want[k] == v
+    assert len(got) == min(len(want), 100)
+    # ordering contract: year asc then sum desc
+    rws = res.rows()
+    for a, b in zip(rws, rws[1:]):
+        assert (a[0], -a[3]) <= (b[0], -b[3])
+
+
+def test_tpcds_q52_shape():
+    res = sql("""
+      SELECT d.d_year, i.i_brand_id, sum(ss.ss_ext_sales_price) AS price
+      FROM store_sales ss
+      JOIN date_dim d ON ss.ss_sold_date_sk = d.d_date_sk
+      JOIN item i ON ss.ss_item_sk = i.i_item_sk
+      WHERE i.i_manager_id = 1 AND d.d_moy = 12 AND d.d_year = 2000
+      GROUP BY d.d_year, i.i_brand_id
+      ORDER BY price DESC LIMIT 10
+    """, sf=SF, max_groups=1 << 12, join_capacity=1 << 17)
+    prices = [r[2] for r in res.rows()]
+    assert prices == sorted(prices, reverse=True)
+    assert all(r[0] == 2000 for r in res.rows())
