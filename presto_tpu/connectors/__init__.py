@@ -7,9 +7,6 @@ dict (as `SCHEMA`), table_row_count, generate_columns, generate_batch,
 column_type.
 """
 
-from . import tpch as _tpch_pkg
-
-
 def _load():
     from . import tpch, tpcds
     return {"tpch": tpch, "tpcds": tpcds}
@@ -18,16 +15,20 @@ def _load():
 CATALOGS = None
 
 
-def catalog(name: str):
+def catalogs() -> dict:
     global CATALOGS
     if CATALOGS is None:
         CATALOGS = _load()
+    return CATALOGS
+
+
+def catalog(name: str):
     try:
-        return CATALOGS[name]
+        return catalogs()[name]
     except KeyError:
         raise KeyError(f"unknown connector/catalog {name!r}") from None
 
 
 def schema_of(name: str):
     mod = catalog(name)
-    return getattr(mod, "TPCH_SCHEMA", None) or getattr(mod, "TPCDS_SCHEMA")
+    return mod.SCHEMA

@@ -3,3 +3,6 @@ from .generator import (TPCDS_SCHEMA, table_row_count, generate_columns,
 
 __all__ = ["TPCDS_SCHEMA", "table_row_count", "generate_columns",
            "generate_batch", "column_type"]
+
+SCHEMA = TPCDS_SCHEMA  # uniform connector-registry surface
+__all__ = __all__ + ["SCHEMA"]

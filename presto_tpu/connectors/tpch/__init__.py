@@ -3,3 +3,6 @@ from .generator import (TPCH_SCHEMA, table_row_count, generate_columns,
 
 __all__ = ["TPCH_SCHEMA", "table_row_count", "generate_columns",
            "generate_batch", "column_type"]
+
+SCHEMA = TPCH_SCHEMA  # uniform connector-registry surface
+__all__ = __all__ + ["SCHEMA"]

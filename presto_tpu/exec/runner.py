@@ -22,7 +22,6 @@ import numpy as np
 
 from .. import types as T
 from ..block import Batch, batch_from_numpy, to_numpy
-from ..connectors import tpch
 from ..plan import nodes as N
 from .planner import CompiledPlan, compile_plan
 from .stats import RuntimeStats

@@ -36,13 +36,19 @@ def fetch_remote_batch(sources: Sequence[str], task_ids: Sequence[str],
     total = 0
     for base, tid in zip(sources, task_ids):
         client = WorkerClient(base, timeout=timeout)
-        client.wait(tid, timeout=timeout)
+        info = client.wait(tid, timeout=timeout)
+        if info["state"] != "FINISHED":
+            # upstream failure must fail the consumer, never produce a
+            # silently partial result (RemoteTask error propagation)
+            raise RuntimeError(f"upstream task {tid} at {base} is "
+                              f"{info['state']}: {info.get('error')}")
         cols = client.fetch_results(tid, types, codec)
         n = len(cols[0][0]) if cols else 0
         total += n
         for c, (v, m) in enumerate(cols):
-            all_cols[c].append(v)
-            all_nulls[c].append(m)
+            if len(v):  # skip empty pages: their default dtype would
+                all_cols[c].append(v)  # poison the concatenated dtype
+                all_nulls[c].append(m)
     arrays = []
     nulls = []
     for c, ty in enumerate(types):

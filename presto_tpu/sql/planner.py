@@ -281,12 +281,15 @@ def plan_sql(query_text: str, max_groups: int = 1 << 16,
     tables: List[P.TableRef] = [q.table] + [j.table for j in q.joins]
 
     def find_table(name: str):
-        from ..connectors import catalog, schema_of
-        for cat in ("tpch", "tpcds"):
-            sch = schema_of(cat)
-            if name in sch:
-                return cat, dict(sch[name])
-        raise KeyError(f"table {name!r} not found in any catalog")
+        from ..connectors import catalogs
+        hits = [(cat, mod.SCHEMA[name]) for cat, mod in catalogs().items()
+                if name in mod.SCHEMA]
+        if not hits:
+            raise KeyError(f"table {name!r} not found in any catalog")
+        if len(hits) > 1:
+            raise KeyError(f"table {name!r} is ambiguous across catalogs "
+                           f"{[h[0] for h in hits]}; qualify it")
+        return hits[0][0], dict(hits[0][1])
 
     table_catalog = {}
     table_schemas = {}
