@@ -281,15 +281,18 @@ def plan_sql(query_text: str, max_groups: int = 1 << 16,
     tables: List[P.TableRef] = [q.table] + [j.table for j in q.joins]
 
     def find_table(name: str):
+        # resolution follows the session catalog search path (the
+        # reference resolves unqualified names against the session's
+        # catalog/schema; both catalogs define e.g. `customer`, and the
+        # earlier catalog in the path wins deterministically)
         from ..connectors import catalogs
-        hits = [(cat, mod.SCHEMA[name]) for cat, mod in catalogs().items()
-                if name in mod.SCHEMA]
-        if not hits:
-            raise KeyError(f"table {name!r} not found in any catalog")
-        if len(hits) > 1:
-            raise KeyError(f"table {name!r} is ambiguous across catalogs "
-                           f"{[h[0] for h in hits]}; qualify it")
-        return hits[0][0], dict(hits[0][1])
+        search_path = ("tpch", "tpcds")
+        cats = catalogs()
+        for cat in search_path:
+            sch = cats[cat].SCHEMA
+            if name in sch:
+                return cat, dict(sch[name])
+        raise KeyError(f"table {name!r} not found in catalogs {search_path}")
 
     table_catalog = {}
     table_schemas = {}
