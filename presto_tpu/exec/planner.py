@@ -118,6 +118,44 @@ def compile_plan(root: N.PlanNode, mesh=None,
             if keys is None:
                 keys = list(range(len(node.output_types())))
             return distinct_op(lower(node.source, inputs), keys, node.max_groups)
+        if isinstance(node, N.UnionNode):
+            from ..block import concat_batches
+            parts = [lower(s, inputs) for s in node.inputs]
+            return concat_batches(parts)
+        if isinstance(node, N.SampleNode):
+            src = lower(node.source, inputs)
+            # deterministic Bernoulli: row-index hash vs threshold
+            from ..expr.functions import _mix64
+            h = _mix64(jnp.arange(src.capacity, dtype=jnp.uint64))
+            thresh = jnp.uint64(int(node.ratio * float(2**64 - 1)))
+            return src.with_active(src.active & (h <= thresh))
+        if isinstance(node, N.AssignUniqueIdNode):
+            from ..block import Column
+            src = lower(node.source, inputs)
+            rid = jnp.arange(src.capacity, dtype=jnp.int64)
+            if dist:
+                widx = jax.lax.axis_index(axis).astype(jnp.int64)
+                rid = rid | (widx << 40)  # task-salted high bits
+            col = Column(rid, jnp.zeros(src.capacity, dtype=bool), T.BIGINT)
+            return Batch(src.columns + (col,), src.active)
+        if isinstance(node, N.MarkDistinctNode):
+            from ..block import Column
+            from ..ops.misc import mark_distinct
+            src = lower(node.source, inputs)
+            m = mark_distinct(src, node.key_channels, node.max_groups)
+            col = Column(m, jnp.zeros(src.capacity, dtype=bool), T.BOOLEAN)
+            return Batch(src.columns + (col,), src.active)
+        if isinstance(node, N.RowNumberNode):
+            from ..ops.window import WindowSpec, window
+            src = lower(node.source, inputs)
+            out = window(src, node.partition_channels,
+                         [SortKey(*k) for k in node.order_keys],
+                         [WindowSpec("row_number")])
+            if node.max_rows_per_partition is not None:
+                rn = out.column(out.num_columns - 1)
+                keep = out.active & (rn.values <= node.max_rows_per_partition)
+                out = out.with_active(keep)
+            return out
         if isinstance(node, N.UnnestNode):
             from ..ops.unnest import unnest as unnest_op
             src = lower(node.source, inputs)

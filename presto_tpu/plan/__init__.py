@@ -1,7 +1,9 @@
 from .nodes import (PlanNode, TableScanNode, ValuesNode, FilterNode,
                     ProjectNode, AggregationNode, JoinNode, SemiJoinNode,
                     SortNode, TopNNode, LimitNode, DistinctNode, ExchangeNode,
-                    UnnestNode, OutputNode, from_json, to_json)
+                    UnnestNode, UnionNode, SampleNode, AssignUniqueIdNode,
+                    MarkDistinctNode, RowNumberNode, OutputNode, from_json,
+                    to_json)
 from .fragment import PlanFragment, fragment_plan
 from .explain import explain, explain_distributed
 from .validator import validate_plan
@@ -9,6 +11,7 @@ from .validator import validate_plan
 __all__ = ["PlanNode", "TableScanNode", "ValuesNode", "FilterNode",
            "ProjectNode", "AggregationNode", "JoinNode", "SemiJoinNode",
            "SortNode", "TopNNode", "LimitNode", "DistinctNode", "ExchangeNode",
-           "UnnestNode",
+           "UnnestNode", "UnionNode", "SampleNode", "AssignUniqueIdNode",
+           "MarkDistinctNode", "RowNumberNode",
            "OutputNode", "from_json", "to_json", "PlanFragment", "fragment_plan",
            "explain", "explain_distributed", "validate_plan"]

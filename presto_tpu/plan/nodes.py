@@ -226,6 +226,88 @@ class DistinctNode(PlanNode):
 
 
 @dataclasses.dataclass
+class UnionNode(PlanNode):
+    """UNION ALL (UnionNode analog; set-distinct UNION is Union+Distinct,
+    exactly how the reference plans it via SetFlatteningOptimizer)."""
+    inputs: List[PlanNode] = dataclasses.field(default_factory=list)
+
+    @property
+    def sources(self):
+        return tuple(self.inputs)
+
+    def output_types(self):
+        return self.inputs[0].output_types()
+
+
+@dataclasses.dataclass
+class SampleNode(PlanNode):
+    """BERNOULLI sampling (SampleNode analog): keep each row with
+    probability `ratio`, decided by a deterministic per-row hash (the
+    reference samples with a per-split RNG; hashing keeps splits
+    reproducible)."""
+    source: PlanNode
+    ratio: float = 1.0
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_types(self):
+        return self.source.output_types()
+
+
+@dataclasses.dataclass
+class AssignUniqueIdNode(PlanNode):
+    """Append a unique BIGINT per row (AssignUniqueId analog; the
+    reference salts with the task id -- here the worker index salts the
+    high bits under shard_map)."""
+    source: PlanNode
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_types(self):
+        return self.source.output_types() + [T.BIGINT]
+
+
+@dataclasses.dataclass
+class MarkDistinctNode(PlanNode):
+    """Append a BOOLEAN 'is first occurrence of these keys' column
+    (MarkDistinctOperator analog, the basis of mixed distinct/non-
+    distinct aggregations)."""
+    source: PlanNode
+    key_channels: List[int] = dataclasses.field(default_factory=list)
+    max_groups: int = 1 << 16
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_types(self):
+        return self.source.output_types() + [T.BOOLEAN]
+
+
+@dataclasses.dataclass
+class RowNumberNode(PlanNode):
+    """Append row_number() over partitions, optionally keeping only the
+    first max_rows per partition (RowNumberOperator /
+    TopNRowNumberOperator analog)."""
+    source: PlanNode
+    partition_channels: List[int] = dataclasses.field(default_factory=list)
+    order_keys: List[Tuple[int, bool, bool]] = dataclasses.field(default_factory=list)
+    max_rows_per_partition: Optional[int] = None
+    max_partitions: int = 1 << 16
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_types(self):
+        return self.source.output_types() + [T.BIGINT]
+
+
+@dataclasses.dataclass
 class UnnestNode(PlanNode):
     """UNNEST(array) [WITH ORDINALITY] (operator/unnest/ analog). Output:
     non-array source columns, then the element column (+ ordinality)."""
@@ -344,6 +426,23 @@ def to_json(n: PlanNode) -> dict:
     if isinstance(n, DistinctNode):
         return {**base, "@type": "distinct", "source": to_json(n.source),
                 "keyChannels": n.key_channels, "maxGroups": n.max_groups}
+    if isinstance(n, UnionNode):
+        return {**base, "@type": "union",
+                "inputs": [to_json(s) for s in n.inputs]}
+    if isinstance(n, SampleNode):
+        return {**base, "@type": "sample", "source": to_json(n.source),
+                "ratio": n.ratio}
+    if isinstance(n, AssignUniqueIdNode):
+        return {**base, "@type": "assignuniqueid", "source": to_json(n.source)}
+    if isinstance(n, MarkDistinctNode):
+        return {**base, "@type": "markdistinct", "source": to_json(n.source),
+                "keyChannels": n.key_channels, "maxGroups": n.max_groups}
+    if isinstance(n, RowNumberNode):
+        return {**base, "@type": "rownumber", "source": to_json(n.source),
+                "partitionChannels": n.partition_channels,
+                "orderKeys": [list(k) for k in n.order_keys],
+                "maxRowsPerPartition": n.max_rows_per_partition,
+                "maxPartitions": n.max_partitions}
     if isinstance(n, UnnestNode):
         return {**base, "@type": "unnest", "source": to_json(n.source),
                 "arrayChannel": n.array_channel,
@@ -397,6 +496,20 @@ def from_json(j: dict) -> PlanNode:
     if t == "distinct":
         return DistinctNode(from_json(j["source"]), j["keyChannels"],
                             j["maxGroups"], **kw)
+    if t == "union":
+        return UnionNode([from_json(s) for s in j["inputs"]], **kw)
+    if t == "sample":
+        return SampleNode(from_json(j["source"]), j["ratio"], **kw)
+    if t == "assignuniqueid":
+        return AssignUniqueIdNode(from_json(j["source"]), **kw)
+    if t == "markdistinct":
+        return MarkDistinctNode(from_json(j["source"]), j["keyChannels"],
+                                j["maxGroups"], **kw)
+    if t == "rownumber":
+        return RowNumberNode(from_json(j["source"]),
+                             j["partitionChannels"],
+                             [tuple(k) for k in j["orderKeys"]],
+                             j["maxRowsPerPartition"], j["maxPartitions"], **kw)
     if t == "unnest":
         return UnnestNode(from_json(j["source"]), j["arrayChannel"],
                           j["outCapacity"], j["withOrdinality"], **kw)
