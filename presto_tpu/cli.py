@@ -1,0 +1,102 @@
+"""Command-line SQL client: the presto-cli analog.
+
+Reference surface: presto-cli (Console.java REPL driving the REST
+protocol). Round 1 runs queries in-process against the embedded engine;
+`--server` mode speaks the worker HTTP protocol instead (submit plan
+JSON, pull SerializedPages) once a coordinator fronts it.
+
+  python -m presto_tpu.cli "SELECT ... FROM lineitem ..." [--sf 0.01]
+  python -m presto_tpu.cli              # REPL
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def _render(v, ty):
+    if v is None:
+        return "NULL"
+    if ty is not None and ty.is_decimal and ty.scale > 0:
+        s = ty.scale
+        sign = "-" if v < 0 else ""
+        a = abs(int(v))
+        return f"{sign}{a // 10**s}.{a % 10**s:0{s}d}"
+    if ty is not None and ty.base == "date":
+        import numpy as np
+        return str(np.datetime64("1970-01-01") + int(v))
+    return str(v)
+
+
+def _format_table(names, rows, types=None, max_rows=50):
+    types = types or [None] * len(names)
+    rendered = [[_render(r[i], types[i]) for i in range(len(names))]
+                for r in rows[:max_rows]]
+    widths = [max([len(str(n))] + [len(rr[i]) for rr in rendered])
+              for i, n in enumerate(names)]
+
+    def line(vals):
+        return " | ".join(v.ljust(w) for v, w in zip(vals, widths))
+
+    out = [line([str(n) for n in names]),
+           "-+-".join("-" * w for w in widths)]
+    for rr in rendered:
+        out.append(line(rr))
+    if len(rows) > max_rows:
+        out.append(f"... ({len(rows) - max_rows} more rows)")
+    return "\n".join(out)
+
+
+def run_one(query: str, sf: float, explain_only: bool = False) -> int:
+    from presto_tpu.plan import explain as explain_plan
+    from presto_tpu.sql import plan_sql, sql
+
+    if explain_only or query.lower().lstrip().startswith("explain"):
+        q = query.strip()
+        if q.lower().startswith("explain"):
+            q = q[len("explain"):].strip()
+        print(explain_plan(plan_sql(q)))
+        return 0
+    t0 = time.time()
+    res = sql(query, sf=sf)
+    dt = time.time() - t0
+    print(_format_table(res.names, res.rows(), res.types))
+    print(f"({res.row_count} rows in {dt:.2f}s)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="presto-tpu")
+    ap.add_argument("query", nargs="?", help="SQL to run (omit for a REPL)")
+    ap.add_argument("--sf", type=float, default=0.01,
+                    help="tpch/tpcds scale factor (default 0.01)")
+    ap.add_argument("--explain", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.query:
+        return run_one(args.query, args.sf, args.explain)
+
+    print("presto-tpu> (end statements with ';', \\q to quit)")
+    buf = []
+    while True:
+        try:
+            line = input("presto-tpu> " if not buf else "          > ")
+        except EOFError:
+            break
+        if line.strip() in ("\\q", "quit", "exit"):
+            break
+        buf.append(line)
+        if line.rstrip().endswith(";"):
+            stmt = "\n".join(buf).rstrip().rstrip(";")
+            buf = []
+            try:
+                run_one(stmt, args.sf)
+            except Exception as e:  # noqa: BLE001 - REPL reports and continues
+                print(f"error: {type(e).__name__}: {e}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

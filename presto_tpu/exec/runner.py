@@ -36,6 +36,7 @@ class QueryResult:
     names: List[str]
     row_count: int
     stats: Dict[str, Dict[str, float]] = dataclasses.field(default_factory=dict)
+    types: List[T.Type] = dataclasses.field(default_factory=list)
 
     def rows(self) -> List[tuple]:
         out = []
@@ -117,11 +118,12 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
 def _batch_to_result(out: Batch, root: N.PlanNode) -> QueryResult:
     act = np.asarray(out.active)
     idx = np.nonzero(act)[0]
-    cols, nulls = [], []
+    cols, nulls, types = [], [], []
     for c in range(out.num_columns):
         v, n = to_numpy(out.column(c))
         cols.append(v[idx])
         nulls.append(n[idx])
+        types.append(out.column(c).type)
     names = root.names if isinstance(root, N.OutputNode) else \
         [f"col{i}" for i in range(out.num_columns)]
-    return QueryResult(cols, nulls, names, len(idx))
+    return QueryResult(cols, nulls, names, len(idx), types=types)

@@ -1,0 +1,35 @@
+import subprocess
+import sys
+
+import pytest
+
+
+def run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "presto_tpu.cli", *args],
+        capture_output=True, text=True, timeout=300,
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": "", "HOME": "/root"},
+        cwd="/root/repo")
+
+
+def test_cli_query():
+    p = run_cli("SELECT count(*) AS n FROM nation", "--sf", "0.01")
+    assert p.returncode == 0, p.stderr
+    assert "25" in p.stdout and "(1 rows" in p.stdout
+
+
+def test_cli_decimal_rendering():
+    p = run_cli("SELECT sum(quantity) AS q FROM lineitem WHERE orderkey <= 8",
+                "--sf", "0.01")
+    assert p.returncode == 0, p.stderr
+    # scaled int rendered with 2 decimal places
+    line = [l for l in p.stdout.splitlines() if l.strip()
+            and l.strip()[0].isdigit()][0]
+    assert "." in line
+
+
+def test_cli_explain():
+    p = run_cli("--explain", "SELECT custkey FROM orders LIMIT 3")
+    assert p.returncode == 0, p.stderr
+    assert "TableScan[tpch.orders" in p.stdout and "Limit[3]" in p.stdout
