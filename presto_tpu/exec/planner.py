@@ -145,6 +145,16 @@ def compile_plan(root: N.PlanNode, mesh=None,
             m = mark_distinct(src, node.key_channels, node.max_groups)
             col = Column(m, jnp.zeros(src.capacity, dtype=bool), T.BOOLEAN)
             return Batch(src.columns + (col,), src.active)
+        if isinstance(node, N.WindowNode):
+            from ..ops.sort import SortKey as SK
+            from ..ops.window import WindowSpec, window
+            src = lower(node.source, inputs)
+            specs = [WindowSpec(name, ch,
+                                T.parse_type(ty) if isinstance(ty, str) else ty,
+                                frame, k or 0)
+                     for name, ch, ty, frame, k in node.functions]
+            return window(src, node.partition_channels,
+                          [SK(*o) for o in node.order_keys], specs)
         if isinstance(node, N.RowNumberNode):
             from ..ops.window import WindowSpec, window
             src = lower(node.source, inputs)

@@ -289,6 +289,27 @@ class MarkDistinctNode(PlanNode):
 
 
 @dataclasses.dataclass
+class WindowNode(PlanNode):
+    """Window functions over partitions (WindowNode/WindowOperator
+    analog). `functions` entries: (name, input_channel|None, type_sig,
+    frame, ntile_buckets)."""
+    source: PlanNode
+    partition_channels: List[int] = dataclasses.field(default_factory=list)
+    order_keys: List[Tuple[int, bool, bool]] = dataclasses.field(default_factory=list)
+    functions: List[Tuple] = dataclasses.field(default_factory=list)
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_types(self):
+        out = list(self.source.output_types())
+        for name, _ch, ty, _frame, _k in self.functions:
+            out.append(T.parse_type(ty) if isinstance(ty, str) else ty)
+        return out
+
+
+@dataclasses.dataclass
 class RowNumberNode(PlanNode):
     """Append row_number() over partitions, optionally keeping only the
     first max_rows per partition (RowNumberOperator /
@@ -437,6 +458,12 @@ def to_json(n: PlanNode) -> dict:
     if isinstance(n, MarkDistinctNode):
         return {**base, "@type": "markdistinct", "source": to_json(n.source),
                 "keyChannels": n.key_channels, "maxGroups": n.max_groups}
+    if isinstance(n, WindowNode):
+        return {**base, "@type": "window", "source": to_json(n.source),
+                "partitionChannels": n.partition_channels,
+                "orderKeys": [list(k) for k in n.order_keys],
+                "functions": [[f[0], f[1], str(f[2]), f[3], f[4]]
+                              for f in n.functions]}
     if isinstance(n, RowNumberNode):
         return {**base, "@type": "rownumber", "source": to_json(n.source),
                 "partitionChannels": n.partition_channels,
@@ -505,6 +532,11 @@ def from_json(j: dict) -> PlanNode:
     if t == "markdistinct":
         return MarkDistinctNode(from_json(j["source"]), j["keyChannels"],
                                 j["maxGroups"], **kw)
+    if t == "window":
+        return WindowNode(from_json(j["source"]), j["partitionChannels"],
+                          [tuple(k) for k in j["orderKeys"]],
+                          [(f[0], f[1], T.parse_type(f[2]), f[3], f[4])
+                           for f in j["functions"]], **kw)
     if t == "rownumber":
         return RowNumberNode(from_json(j["source"]),
                              j["partitionChannels"],

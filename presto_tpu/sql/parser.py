@@ -106,6 +106,13 @@ class Cast:
 
 
 @dataclasses.dataclass
+class WindowExpr:
+    func: "Func"
+    partition_by: List[object]
+    order_by: List["OrderItem"]
+
+
+@dataclasses.dataclass
 class SelectItem:
     expr: object
     alias: Optional[str]
@@ -166,7 +173,8 @@ _KEYWORDS = {
     "limit", "as", "and", "or", "not", "between", "in", "like", "is", "null",
     "case", "when", "then", "else", "end", "cast", "join", "inner", "left",
     "on", "true", "false", "asc", "desc", "nulls", "first", "last", "date",
-    "interval", "day", "month", "year", "extract", "outer",
+    "interval", "day", "month", "year", "extract", "outer", "over",
+    "partition",
 }
 
 
@@ -374,7 +382,24 @@ class _Parser:
                     while self.accept_op(","):
                         args.append(self.expr())
                 self.expect_op(")")
-                return Func(v.lower(), args, distinct)
+                fn = Func(v.lower(), args, distinct)
+                if self.accept_kw("over"):
+                    self.expect_op("(")
+                    part: List[object] = []
+                    order: List[OrderItem] = []
+                    if self.accept_kw("partition"):
+                        self.expect_kw("by")
+                        part.append(self.expr())
+                        while self.accept_op(","):
+                            part.append(self.expr())
+                    if self.accept_kw("order"):
+                        self.expect_kw("by")
+                        order.append(self._order_item())
+                        while self.accept_op(","):
+                            order.append(self._order_item())
+                    self.expect_op(")")
+                    return WindowExpr(fn, part, order)
+                return fn
             parts = [v]
             while self.accept_op("."):
                 parts.append(self.expect_ident())

@@ -415,6 +415,31 @@ def plan_sql(query_text: str, max_groups: int = 1 << 16,
     if q.where is not None:
         node = N.FilterNode(node, an.lower(q.where, scope))
 
+    # window functions? (round 1: not mixed with GROUP BY aggregation)
+    window_items = [(i, it) for i, it in enumerate(q.select.items)
+                    if isinstance(it.expr, P.WindowExpr)]
+    if window_items:
+        assert not q.group_by, "window functions with GROUP BY: planned later"
+        node, out_exprs, names = _plan_windows(an, node, scope, q, window_items)
+        out_types = [e.type for e in out_exprs]
+        node = N.ProjectNode(node, out_exprs)
+        scope = _Scope({n_.lower(): i for i, n_ in enumerate(names)}, out_types)
+        if q.select.distinct:
+            node = N.DistinctNode(node, max_groups=max_groups)
+        if q.order_by:
+            keys = []
+            for o in q.order_by:
+                key = ".".join(o.expr.parts).lower() \
+                    if isinstance(o.expr, P.Name) else None
+                assert key in scope.channels, \
+                    "ORDER BY after window functions must use select aliases"
+                keys.append((scope.channels[key], o.descending, o.nulls_last))
+            node = N.TopNNode(node, keys, q.limit) if q.limit is not None \
+                else N.SortNode(node, keys)
+        elif q.limit is not None:
+            node = N.LimitNode(node, q.limit)
+        return N.OutputNode(node, names)
+
     # aggregation?
     select_aggs: List[P.Func] = []
     for item in q.select.items:
@@ -481,6 +506,87 @@ def plan_sql(query_text: str, max_groups: int = 1 << 16,
         node = N.LimitNode(node, q.limit)
 
     return N.OutputNode(node, names)
+
+
+_WINDOW_FN_TYPES = {"row_number": T.BIGINT, "rank": T.BIGINT,
+                    "dense_rank": T.BIGINT, "ntile": T.BIGINT,
+                    "percent_rank": T.DOUBLE, "cume_dist": T.DOUBLE,
+                    "count": T.BIGINT}
+
+
+def _plan_windows(an, node, scope, q, window_items):
+    """Lower SELECT items containing window expressions: pre-project all
+    needed channels, one WindowNode (shared partition/order round 1 --
+    multiple identical OVER clauses allowed), post-project in select
+    order."""
+    pre_exprs: List[E.RowExpression] = []
+
+    def chan_of(expr_ast) -> int:
+        e = an.lower(expr_ast, scope)
+        pre_exprs.append(e)
+        return len(pre_exprs) - 1
+
+    # plain select items first
+    plain_chan: Dict[int, int] = {}
+    for i, item in enumerate(q.select.items):
+        if not isinstance(item.expr, P.WindowExpr):
+            plain_chan[i] = chan_of(item.expr)
+
+    w0 = window_items[0][1].expr
+    for _, it in window_items[1:]:
+        assert it.expr.partition_by == w0.partition_by and \
+            it.expr.order_by == w0.order_by, \
+            "multiple distinct OVER clauses: planned later"
+    part_chans = [chan_of(p) for p in w0.partition_by]
+    order_keys = []
+    for o in w0.order_by:
+        order_keys.append((chan_of(o.expr), o.descending, o.nulls_last))
+
+    functions = []
+    win_out_types = []
+    base = None  # filled after pre-projection length known
+    for _, it in window_items:
+        f = it.expr.func
+        name = f.name
+        in_ch = None
+        if f.args and not isinstance(f.args[0], P.Star):
+            in_ch = chan_of(f.args[0])
+        if name in _WINDOW_FN_TYPES:
+            oty = _WINDOW_FN_TYPES[name]
+        elif name == "sum":
+            oty = pre_exprs[in_ch].type
+            if oty.is_decimal:
+                oty = T.decimal(38, oty.scale)
+            elif oty.is_integral:
+                oty = T.BIGINT
+        elif name == "avg":
+            oty = T.DOUBLE
+        else:  # min/max/first_value/last_value
+            oty = pre_exprs[in_ch].type
+        buckets = 0
+        if name == "ntile":
+            arg = f.args[0]
+            assert isinstance(arg, P.Literal) and arg.kind == "int"
+            buckets = int(arg.value)
+            in_ch = None
+        functions.append((name, in_ch, oty, "range_current", buckets))
+        win_out_types.append(oty)
+
+    node = N.ProjectNode(node, pre_exprs)
+    node = N.WindowNode(node, part_chans, order_keys, functions)
+
+    nwpre = len(pre_exprs)
+    out_exprs, names = [], []
+    wi = 0
+    for i, item in enumerate(q.select.items):
+        if isinstance(item.expr, P.WindowExpr):
+            out_exprs.append(E.input_ref(nwpre + wi, win_out_types[wi]))
+            wi += 1
+        else:
+            ch = plain_chan[i]
+            out_exprs.append(E.input_ref(ch, pre_exprs[ch].type))
+        names.append(_item_name(item, i))
+    return node, out_exprs, names
 
 
 def _item_name(item: P.SelectItem, i: int) -> str:
