@@ -92,7 +92,7 @@ def main(argv=None) -> int:
             stmt = "\n".join(buf).rstrip().rstrip(";")
             buf = []
             try:
-                run_one(stmt, args.sf)
+                run_one(stmt, args.sf, args.explain)
             except Exception as e:  # noqa: BLE001 - REPL reports and continues
                 print(f"error: {type(e).__name__}: {e}", file=sys.stderr)
     return 0

@@ -145,6 +145,13 @@ def window(batch: Batch, partition_channels: Sequence[int],
             r0 = (row_number - 1)
             vals_sorted = jnp.minimum(r0 * k // jnp.maximum(part_rows, 1), k - 1) + 1
             nulls_sorted = ~s_active
+        elif name == "count" and spec.input_channel is None:
+            # count(*) over frame: rows (not non-null values)
+            pc = jnp.cumsum(s_active.astype(jnp.int64))
+            end = run_end if spec.frame == "range_current" else part_end
+            base_c = jnp.where(part_start > 0, pc[part_start - 1], 0)
+            vals_sorted = pc[end] - base_c
+            nulls_sorted = ~s_active
         elif name in ("sum", "count", "avg", "min", "max", "first_value",
                       "last_value"):
             col = batch.column(spec.input_channel)
@@ -173,8 +180,9 @@ def window(batch: Batch, partition_channels: Sequence[int],
                 else:
                     vals_sorted = wsum.astype(jnp.float64) / \
                         jnp.maximum(wcnt, 1).astype(jnp.float64)
-                    if col.type.is_decimal:
-                        vals_sorted = vals_sorted  # scaled float; cast below
+                    if not spec.output_type.is_floating:
+                        # decimal-typed avg: scaled float mean -> scaled int
+                        vals_sorted = jnp.round(vals_sorted)
                     nulls_sorted = (wcnt == 0) | ~s_active
             elif name in ("min", "max"):
                 ident = (jnp.iinfo(jnp.int64).max if name == "min"

@@ -2,8 +2,8 @@ from .nodes import (PlanNode, TableScanNode, ValuesNode, FilterNode,
                     ProjectNode, AggregationNode, JoinNode, SemiJoinNode,
                     SortNode, TopNNode, LimitNode, DistinctNode, ExchangeNode,
                     UnnestNode, UnionNode, SampleNode, AssignUniqueIdNode,
-                    MarkDistinctNode, RowNumberNode, OutputNode, from_json,
-                    to_json)
+                    MarkDistinctNode, RowNumberNode, WindowNode, OutputNode,
+                    from_json, to_json)
 from .fragment import PlanFragment, fragment_plan
 from .explain import explain, explain_distributed
 from .validator import validate_plan
@@ -12,6 +12,6 @@ __all__ = ["PlanNode", "TableScanNode", "ValuesNode", "FilterNode",
            "ProjectNode", "AggregationNode", "JoinNode", "SemiJoinNode",
            "SortNode", "TopNNode", "LimitNode", "DistinctNode", "ExchangeNode",
            "UnnestNode", "UnionNode", "SampleNode", "AssignUniqueIdNode",
-           "MarkDistinctNode", "RowNumberNode",
+           "MarkDistinctNode", "RowNumberNode", "WindowNode",
            "OutputNode", "from_json", "to_json", "PlanFragment", "fragment_plan",
            "explain", "explain_distributed", "validate_plan"]

@@ -313,7 +313,9 @@ class WindowNode(PlanNode):
 class RowNumberNode(PlanNode):
     """Append row_number() over partitions, optionally keeping only the
     first max_rows per partition (RowNumberOperator /
-    TopNRowNumberOperator analog)."""
+    TopNRowNumberOperator analog). `max_partitions` is accepted for
+    protocol parity with the reference's hash-table sizing hint; the
+    sort-based implementation needs no partition cap and ignores it."""
     source: PlanNode
     partition_channels: List[int] = dataclasses.field(default_factory=list)
     order_keys: List[Tuple[int, bool, bool]] = dataclasses.field(default_factory=list)

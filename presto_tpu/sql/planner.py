@@ -544,15 +544,21 @@ def _plan_windows(an, node, scope, q, window_items):
 
     functions = []
     win_out_types = []
-    base = None  # filled after pre-projection length known
     for _, it in window_items:
         f = it.expr.func
         name = f.name
         in_ch = None
-        if f.args and not isinstance(f.args[0], P.Star):
+        buckets = 0
+        if name == "ntile":
+            arg = f.args[0]
+            assert isinstance(arg, P.Literal) and arg.kind == "int"
+            buckets = int(arg.value)
+        elif f.args and not isinstance(f.args[0], P.Star):
             in_ch = chan_of(f.args[0])
-        if name in _WINDOW_FN_TYPES:
+        if name in _WINDOW_FN_TYPES and not (name == "count" and in_ch is not None):
             oty = _WINDOW_FN_TYPES[name]
+        elif name == "count":
+            oty = T.BIGINT
         elif name == "sum":
             oty = pre_exprs[in_ch].type
             if oty.is_decimal:
@@ -560,15 +566,10 @@ def _plan_windows(an, node, scope, q, window_items):
             elif oty.is_integral:
                 oty = T.BIGINT
         elif name == "avg":
-            oty = T.DOUBLE
+            ity = pre_exprs[in_ch].type
+            oty = T.decimal(38, ity.scale) if ity.is_decimal else T.DOUBLE
         else:  # min/max/first_value/last_value
             oty = pre_exprs[in_ch].type
-        buckets = 0
-        if name == "ntile":
-            arg = f.args[0]
-            assert isinstance(arg, P.Literal) and arg.kind == "int"
-            buckets = int(arg.value)
-            in_ch = None
         functions.append((name, in_ch, oty, "range_current", buckets))
         win_out_types.append(oty)
 
