@@ -101,7 +101,11 @@ def compile_plan(root: N.PlanNode, mesh=None,
             filt = lower(node.filtering_source, inputs)
             if dist:
                 filt = broadcast_build(filt, axis)
-            m = semi_join_mask(src, filt, [node.source_key], [node.filtering_key])
+            sk = node.source_key if isinstance(node.source_key, list) \
+                else [node.source_key]
+            fk = node.filtering_key if isinstance(node.filtering_key, list) \
+                else [node.filtering_key]
+            m = semi_join_mask(src, filt, sk, fk)
             from ..block import Column
             return Batch(src.columns + (Column(m, jnp.zeros_like(m), T.BOOLEAN),),
                          src.active)

@@ -145,6 +145,13 @@ class Select:
 
 
 @dataclasses.dataclass
+class InSubquery:
+    value: object
+    query: "Query"
+    negate: bool = False
+
+
+@dataclasses.dataclass
 class Query:
     select: Select
     table: TableRef
@@ -154,6 +161,15 @@ class Query:
     having: Optional[object]
     order_by: List[OrderItem]
     limit: Optional[int]
+
+
+@dataclasses.dataclass
+class SetQuery:
+    """UNION / INTERSECT / EXCEPT of two query terms."""
+    op: str                 # "union" | "intersect" | "except"
+    all: bool               # UNION ALL vs set semantics
+    left: object            # Query | SetQuery
+    right: object
 
 
 # ---------------------------------------------------------------------------
@@ -174,7 +190,7 @@ _KEYWORDS = {
     "case", "when", "then", "else", "end", "cast", "join", "inner", "left",
     "on", "true", "false", "asc", "desc", "nulls", "first", "last", "date",
     "interval", "day", "month", "year", "extract", "outer", "over",
-    "partition",
+    "partition", "union", "intersect", "except", "all",
 }
 
 
@@ -277,6 +293,10 @@ class _Parser:
             return Between(left, lo, hi, negate)
         if self.accept_kw("in"):
             self.expect_op("(")
+            if self.peek() == ("kw", "select"):
+                sub = self.query(allow_setops=False)
+                self.expect_op(")")
+                return InSubquery(left, sub, negate)
             items = [self.expr()]
             while self.accept_op(","):
                 items.append(self.expr())
@@ -435,7 +455,19 @@ class _Parser:
 
     # -- query --------------------------------------------------------------
 
-    def query(self) -> Query:
+    def query(self, allow_setops: bool = True):
+        left = self._query_term()
+        while allow_setops:
+            op = self.accept_kw("union", "intersect", "except")
+            if not op:
+                break
+            is_all = bool(self.accept_kw("all"))
+            self.accept_kw("distinct")
+            right = self._query_term()
+            left = SetQuery(op, is_all, left, right)
+        return left
+
+    def _query_term(self) -> Query:
         self.expect_kw("select")
         distinct = bool(self.accept_kw("distinct"))
         items = [self._select_item()]
@@ -480,9 +512,6 @@ class _Parser:
             k, v = self.next()
             assert k == "number"
             limit = int(v)
-        k, v = self.peek()
-        if k != "eof":
-            raise ValueError(f"trailing tokens at {(k, v)}")
         return Query(Select(items, distinct), table, joins, where, group_by,
                      having, order_by, limit)
 
@@ -520,5 +549,10 @@ class _Parser:
         return OrderItem(e, desc, nulls_last)
 
 
-def parse_sql(text: str) -> Query:
-    return _Parser(_tokenize(text)).query()
+def parse_sql(text: str):
+    p = _Parser(_tokenize(text))
+    q = p.query()
+    k, v = p.peek()
+    if k != "eof":
+        raise ValueError(f"trailing tokens at {(k, v)}")
+    return q

@@ -274,7 +274,50 @@ def _agg_output_type(name: str, input_type: Optional[T.Type]) -> T.Type:
 def plan_sql(query_text: str, max_groups: int = 1 << 16,
              join_capacity: Optional[int] = None) -> N.PlanNode:
     """SQL text -> plan tree rooted at OutputNode."""
-    q = P.parse_sql(query_text)
+    ast = P.parse_sql(query_text)
+    node, names = _plan_any(ast, max_groups, join_capacity)
+    if isinstance(node, N.OutputNode):
+        return node
+    return N.OutputNode(node, names)
+
+
+def _plan_any(ast, max_groups: int, join_capacity: Optional[int]):
+    """Query | SetQuery -> (plan node, output names)."""
+    if isinstance(ast, P.SetQuery):
+        lf, ln = _plan_any(ast.left, max_groups, join_capacity)
+        rt, rn = _plan_any(ast.right, max_groups, join_capacity)
+        lf = _strip_output(lf)
+        rt = _strip_output(rt)
+        ncols = len(lf.output_types())
+        assert ncols == len(rt.output_types()), \
+            "set operation requires equal column counts"
+        if ast.op == "union":
+            node = N.UnionNode([lf, rt])
+            if not ast.all:
+                node = N.DistinctNode(node, max_groups=max_groups)
+            return node, ln
+        # INTERSECT / EXCEPT (set semantics): distinct left, membership
+        # test against right over all channels, keep/drop, hide the mask
+        left_d = N.DistinctNode(lf, max_groups=max_groups)
+        sj = N.SemiJoinNode(left_d, rt, list(range(ncols)),
+                            list(range(ncols)))
+        mask = E.input_ref(ncols, T.BOOLEAN)
+        pred = mask if ast.op == "intersect" else \
+            E.call("not", T.BOOLEAN, E.special(
+                "COALESCE", T.BOOLEAN, mask, E.const(False, T.BOOLEAN)))
+        f = N.FilterNode(sj, pred)
+        proj = N.ProjectNode(f, [
+            E.input_ref(i, lf.output_types()[i]) for i in range(ncols)])
+        return proj, ln
+    return _plan_query(ast, max_groups, join_capacity)
+
+
+def _strip_output(node: N.PlanNode) -> N.PlanNode:
+    return node.source if isinstance(node, N.OutputNode) else node
+
+
+def _plan_query(q: P.Query, max_groups: int = 1 << 16,
+                join_capacity: Optional[int] = None) -> N.PlanNode:
     an = _Analyzer(q)
 
     # FROM: scans with pruned columns. First collect every referenced name.
@@ -325,6 +368,8 @@ def plan_sql(query_text: str, max_groups: int = 1 << 16,
     def collect_names(n):
         if isinstance(n, P.Name):
             note_name(n.parts)
+        elif isinstance(n, P.InSubquery):
+            collect_names(n.value)  # the subquery has its own table scope
         elif dataclasses.is_dataclass(n):
             for f in dataclasses.fields(n):
                 v = getattr(n, f.name)
@@ -413,7 +458,32 @@ def plan_sql(query_text: str, max_groups: int = 1 << 16,
     scope = make_scope()
 
     if q.where is not None:
-        node = N.FilterNode(node, an.lower(q.where, scope))
+        plain_conjs = []
+        for c in _conjuncts(q.where):
+            if isinstance(c, P.InSubquery):
+                # uncorrelated IN subquery -> SemiJoinNode + mask filter
+                # (IN-predicate planning, sql/planner's apply/semijoin path)
+                sub_node, _sub_names = _plan_any(c.query, max_groups,
+                                                 join_capacity)
+                sub_node = _strip_output(sub_node)
+                assert len(sub_node.output_types()) == 1, \
+                    "IN subquery must produce one column"
+                v = an.lower(c.value, scope)
+                assert isinstance(v, E.InputReference), \
+                    "IN subquery value must be a column (round 1)"
+                nch = len(scope.types)
+                sj = N.SemiJoinNode(node, sub_node, v.channel, 0)
+                mask = E.input_ref(nch, T.BOOLEAN)
+                pred = E.call("not", T.BOOLEAN, E.special(
+                    "COALESCE", T.BOOLEAN, mask, E.const(False, T.BOOLEAN))) \
+                    if c.negate else mask
+                f = N.FilterNode(sj, pred)
+                node = N.ProjectNode(f, [
+                    E.input_ref(i, scope.types[i]) for i in range(nch)])
+            else:
+                plain_conjs.append(c)
+        for c in plain_conjs:
+            node = N.FilterNode(node, an.lower(c, scope))
 
     # window functions? (round 1: not mixed with GROUP BY aggregation)
     window_items = [(i, it) for i, it in enumerate(q.select.items)
@@ -438,7 +508,7 @@ def plan_sql(query_text: str, max_groups: int = 1 << 16,
                 else N.SortNode(node, keys)
         elif q.limit is not None:
             node = N.LimitNode(node, q.limit)
-        return N.OutputNode(node, names)
+        return node, names
 
     # aggregation?
     select_aggs: List[P.Func] = []
@@ -505,7 +575,7 @@ def plan_sql(query_text: str, max_groups: int = 1 << 16,
     elif q.limit is not None:
         node = N.LimitNode(node, q.limit)
 
-    return N.OutputNode(node, names)
+    return node, names
 
 
 _WINDOW_FN_TYPES = {"row_number": T.BIGINT, "rank": T.BIGINT,

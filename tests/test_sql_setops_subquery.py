@@ -1,0 +1,66 @@
+import numpy as np
+import pytest
+
+from presto_tpu.connectors import tpch
+from presto_tpu.sql import sql
+
+
+def test_union_all_and_distinct():
+    r = sql("SELECT nationkey FROM nation WHERE nationkey < 3 "
+            "UNION ALL SELECT nationkey FROM nation WHERE nationkey < 2")
+    assert sorted(x[0] for x in r.rows()) == [0, 0, 1, 1, 2]
+    r = sql("SELECT nationkey FROM nation WHERE nationkey < 3 "
+            "UNION SELECT nationkey FROM nation WHERE nationkey < 2")
+    assert sorted(x[0] for x in r.rows()) == [0, 1, 2]
+
+
+def test_intersect_and_except():
+    r = sql("SELECT regionkey FROM nation "
+            "INTERSECT SELECT regionkey FROM region WHERE regionkey >= 3")
+    assert sorted(x[0] for x in r.rows()) == [3, 4]
+    r = sql("SELECT regionkey FROM region "
+            "EXCEPT SELECT regionkey FROM nation WHERE regionkey < 2")
+    assert sorted(x[0] for x in r.rows()) == [2, 3, 4]
+
+
+def test_in_subquery_semijoin():
+    # orders of customers in the AUTOMOBILE segment (q-shape like q18/q22)
+    r = sql("""
+      SELECT orderkey FROM orders
+      WHERE custkey IN (SELECT custkey FROM customer
+                        WHERE mktsegment = 'AUTOMOBILE')
+      LIMIT 500
+    """, sf=0.01)
+    cu = tpch.generate_columns("customer", 0.01, ["custkey", "mktsegment"])
+    auto = set(int(c) for c, m in zip(cu["custkey"], cu["mktsegment"])
+               if m == "AUTOMOBILE")
+    oc = tpch.generate_columns("orders", 0.01, ["orderkey", "custkey"])
+    omap = dict(zip(oc["orderkey"], oc["custkey"]))
+    assert r.row_count == 500
+    for row in r.rows():
+        assert int(omap[row[0]]) in auto
+
+
+def test_not_in_subquery():
+    r = sql("""
+      SELECT nationkey FROM nation
+      WHERE regionkey NOT IN (SELECT regionkey FROM region
+                              WHERE regionkey <= 2)
+    """)
+    na = tpch.generate_columns("nation", 0.01, ["nationkey", "regionkey"])
+    want = sorted(int(n) for n, rk in zip(na["nationkey"], na["regionkey"])
+                  if rk > 2)
+    assert sorted(x[0] for x in r.rows()) == want
+
+
+def test_in_subquery_with_aggregation_outer():
+    r = sql("""
+      SELECT count(*) FROM lineitem
+      WHERE orderkey IN (SELECT orderkey FROM orders
+                         WHERE totalprice > 400000.00)
+    """, sf=0.01, max_groups=4)
+    oc = tpch.generate_columns("orders", 0.01, ["orderkey", "totalprice"])
+    keys = set(oc["orderkey"][oc["totalprice"] > 40000000])  # cents
+    li = tpch.generate_columns("lineitem", 0.01, ["orderkey"])
+    want = int(np.isin(li["orderkey"], list(keys)).sum())
+    assert r.rows()[0][0] == want
