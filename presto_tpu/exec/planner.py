@@ -105,9 +105,9 @@ def compile_plan(root: N.PlanNode, mesh=None,
                 else [node.source_key]
             fk = node.filtering_key if isinstance(node.filtering_key, list) \
                 else [node.filtering_key]
-            m = semi_join_mask(src, filt, sk, fk)
+            m, mnull = semi_join_mask(src, filt, sk, fk)
             from ..block import Column
-            return Batch(src.columns + (Column(m, jnp.zeros_like(m), T.BOOLEAN),),
+            return Batch(src.columns + (Column(m, mnull, T.BOOLEAN),),
                          src.active)
         if isinstance(node, N.SortNode):
             return sort_batch(lower(node.source, inputs),

@@ -198,9 +198,16 @@ from ..block import gather_block as _gather  # shared row gather
 
 def semi_join_mask(probe: Batch, build: Batch,
                    probe_key_channels: Sequence[int],
-                   build_key_channels: Sequence[int]) -> jnp.ndarray:
-    """SemiJoinNode analog: per-probe-row boolean 'key IN build side'.
-    (NULL semantics of IN subqueries are applied by the planner's filter.)"""
+                   build_key_channels: Sequence[int]
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """SemiJoinNode analog: per-probe-row 'key IN build side' with SQL
+    three-valued semantics. Returns (match, null_flag):
+
+      match            TRUE iff the non-null key has a build match
+      null_flag        the IN result is NULL: probe key is NULL, or no
+                       match but the build side contains a NULL key
+
+    `NOT IN` then composes correctly through Kleene NOT + filters."""
     p_keys = [probe.column(c) for c in probe_key_channels]
     b_keys = [build.column(c) for c in build_key_channels]
     p_words, p_usable = _combined_key(p_keys, probe.active)
@@ -216,4 +223,8 @@ def semi_join_mask(probe: Batch, build: Batch,
         end = jnp.searchsorted(b_rank, p_rank, side="right")
     start = jnp.minimum(start, n_usable)
     end = jnp.minimum(end, n_usable)
-    return p_usable & (end > start)
+    match = p_usable & (end > start)
+    build_has_null = jnp.any(build.active & ~b_usable)
+    probe_key_null = probe.active & ~p_usable
+    null_flag = probe_key_null | (probe.active & ~match & build_has_null)
+    return match, null_flag

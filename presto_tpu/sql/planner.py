@@ -474,9 +474,9 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
                 nch = len(scope.types)
                 sj = N.SemiJoinNode(node, sub_node, v.channel, 0)
                 mask = E.input_ref(nch, T.BOOLEAN)
-                pred = E.call("not", T.BOOLEAN, E.special(
-                    "COALESCE", T.BOOLEAN, mask, E.const(False, T.BOOLEAN))) \
-                    if c.negate else mask
+                # the mask carries IN's 3VL NULL; plain Kleene NOT keeps
+                # NOT IN correct (NULL rows fail the filter either way)
+                pred = E.call("not", T.BOOLEAN, mask) if c.negate else mask
                 f = N.FilterNode(sj, pred)
                 node = N.ProjectNode(f, [
                     E.input_ref(i, scope.types[i]) for i in range(nch)])

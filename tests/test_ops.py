@@ -227,8 +227,20 @@ def test_join_overflow():
 def test_semi_join():
     probe = batch_from_numpy([T.BIGINT], [np.array([1, 2, 3, 4])])
     build = batch_from_numpy([T.BIGINT], [np.array([2, 4, 4])])
-    m = np.asarray(semi_join_mask(probe, build, [0], [0]))
-    assert list(m) == [False, True, False, True]
+    m, mn = semi_join_mask(probe, build, [0], [0])
+    assert list(np.asarray(m)) == [False, True, False, True]
+    assert not np.asarray(mn).any()
+
+
+def test_semi_join_null_semantics():
+    # 2 IN (2, NULL) -> TRUE; 3 IN (2, NULL) -> NULL; NULL IN (...) -> NULL
+    probe = batch_from_numpy([T.BIGINT], [np.array([2, 3, 0])],
+                             nulls=[np.array([False, False, True])])
+    build = batch_from_numpy([T.BIGINT], [np.array([2, 0])],
+                             nulls=[np.array([False, True])])
+    m, mn = semi_join_mask(probe, build, [0], [0])
+    assert list(np.asarray(m)) == [True, False, False]
+    assert list(np.asarray(mn)) == [False, True, True]
 
 
 def test_join_multiword_string_key():
