@@ -105,7 +105,8 @@ def compile_plan(root: N.PlanNode, mesh=None,
                 else [node.source_key]
             fk = node.filtering_key if isinstance(node.filtering_key, list) \
                 else [node.filtering_key]
-            m, mnull = semi_join_mask(src, filt, sk, fk)
+            m, mnull = semi_join_mask(src, filt, sk, fk,
+                                      node.null_keys_match)
             from ..block import Column
             return Batch(src.columns + (Column(m, mnull, T.BOOLEAN),),
                          src.active)

@@ -198,7 +198,8 @@ from ..block import gather_block as _gather  # shared row gather
 
 def semi_join_mask(probe: Batch, build: Batch,
                    probe_key_channels: Sequence[int],
-                   build_key_channels: Sequence[int]
+                   build_key_channels: Sequence[int],
+                   null_keys_match: bool = False
                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """SemiJoinNode analog: per-probe-row 'key IN build side' with SQL
     three-valued semantics. Returns (match, null_flag):
@@ -207,11 +208,22 @@ def semi_join_mask(probe: Batch, build: Batch,
       null_flag        the IN result is NULL: probe key is NULL, or no
                        match but the build side contains a NULL key
 
-    `NOT IN` then composes correctly through Kleene NOT + filters."""
+    `NOT IN` then composes correctly through Kleene NOT + filters.
+
+    With null_keys_match=True, NULL keys compare EQUAL (IS NOT DISTINCT
+    FROM) and null_flag is always False -- the INTERSECT/EXCEPT and
+    mark-distinct membership semantics."""
     p_keys = [probe.column(c) for c in probe_key_channels]
     b_keys = [build.column(c) for c in build_key_channels]
-    p_words, p_usable = _combined_key(p_keys, probe.active)
-    b_words, b_usable = _combined_key(b_keys, build.active)
+    if null_keys_match:
+        # include the per-column null words as key material: NULL == NULL
+        p_words, _ = key_words(p_keys)
+        b_words, _ = key_words(b_keys)
+        p_usable = probe.active
+        b_usable = build.active
+    else:
+        p_words, p_usable = _combined_key(p_keys, probe.active)
+        b_words, b_usable = _combined_key(b_keys, build.active)
     sb_words, _ = _sort_build(b_words, b_usable, None)
     n_usable = jnp.sum(b_usable.astype(jnp.int64))
     if len(p_words) == 1:
@@ -224,6 +236,8 @@ def semi_join_mask(probe: Batch, build: Batch,
     start = jnp.minimum(start, n_usable)
     end = jnp.minimum(end, n_usable)
     match = p_usable & (end > start)
+    if null_keys_match:
+        return match, jnp.zeros_like(match)
     build_has_null = jnp.any(build.active & ~b_usable)
     probe_key_null = probe.active & ~p_usable
     null_flag = probe_key_null | (probe.active & ~match & build_has_null)

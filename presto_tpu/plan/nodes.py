@@ -21,7 +21,7 @@ Differences from the reference, by design:
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 from .. import types as T
 from ..expr import ir as E
@@ -158,9 +158,10 @@ class JoinNode(PlanNode):
 class SemiJoinNode(PlanNode):
     source: PlanNode
     filtering_source: PlanNode
-    source_key: int
-    filtering_key: int
+    source_key: Union[int, List[int]]
+    filtering_key: Union[int, List[int]]
     negate: bool = False  # True => anti join semantics when filtered on
+    null_keys_match: bool = False  # True: NULL==NULL (set-op semantics)
 
     @property
     def sources(self):
@@ -436,7 +437,7 @@ def to_json(n: PlanNode) -> dict:
         return {**base, "@type": "semijoin", "source": to_json(n.source),
                 "filteringSource": to_json(n.filtering_source),
                 "sourceKey": n.source_key, "filteringKey": n.filtering_key,
-                "negate": n.negate}
+                "negate": n.negate, "nullKeysMatch": n.null_keys_match}
     if isinstance(n, SortNode):
         return {**base, "@type": "sort", "source": to_json(n.source),
                 "keys": [list(k) for k in n.keys]}
@@ -513,7 +514,8 @@ def from_json(j: dict) -> PlanNode:
                         j["outCapacity"], **kw)
     if t == "semijoin":
         return SemiJoinNode(from_json(j["source"]), from_json(j["filteringSource"]),
-                            j["sourceKey"], j["filteringKey"], j["negate"], **kw)
+                            j["sourceKey"], j["filteringKey"], j["negate"],
+                            j.get("nullKeysMatch", False), **kw)
     if t == "sort":
         return SortNode(from_json(j["source"]),
                         [tuple(k) for k in j["keys"]], **kw)

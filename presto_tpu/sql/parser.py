@@ -294,7 +294,7 @@ class _Parser:
         if self.accept_kw("in"):
             self.expect_op("(")
             if self.peek() == ("kw", "select"):
-                sub = self.query(allow_setops=False)
+                sub = self.query()  # set-op subqueries terminate on ")"
                 self.expect_op(")")
                 return InSubquery(left, sub, negate)
             items = [self.expr()]
@@ -456,15 +456,25 @@ class _Parser:
     # -- query --------------------------------------------------------------
 
     def query(self, allow_setops: bool = True):
-        left = self._query_term()
+        # standard precedence: INTERSECT binds tighter than UNION/EXCEPT
+        left = self._intersect_term()
         while allow_setops:
-            op = self.accept_kw("union", "intersect", "except")
+            op = self.accept_kw("union", "except")
             if not op:
                 break
             is_all = bool(self.accept_kw("all"))
             self.accept_kw("distinct")
-            right = self._query_term()
+            right = self._intersect_term()
             left = SetQuery(op, is_all, left, right)
+        return left
+
+    def _intersect_term(self):
+        left = self._query_term()
+        while self.accept_kw("intersect"):
+            is_all = bool(self.accept_kw("all"))
+            self.accept_kw("distinct")
+            right = self._query_term()
+            left = SetQuery("intersect", is_all, left, right)
         return left
 
     def _query_term(self) -> Query:

@@ -288,26 +288,33 @@ def _plan_any(ast, max_groups: int, join_capacity: Optional[int]):
         rt, rn = _plan_any(ast.right, max_groups, join_capacity)
         lf = _strip_output(lf)
         rt = _strip_output(rt)
-        ncols = len(lf.output_types())
-        assert ncols == len(rt.output_types()), \
-            "set operation requires equal column counts"
+        lt, rtt = lf.output_types(), rt.output_types()
+        ncols = len(lt)
+        assert ncols == len(rtt), "set operation requires equal column counts"
+        for i, (a, b) in enumerate(zip(lt, rtt)):
+            assert a.base == b.base or (a.is_numeric and b.is_numeric), \
+                f"set operation column {i} type mismatch: {a} vs {b}"
         if ast.op == "union":
             node = N.UnionNode([lf, rt])
             if not ast.all:
                 node = N.DistinctNode(node, max_groups=max_groups)
             return node, ln
+        if ast.all:
+            raise NotImplementedError(
+                f"{ast.op.upper()} ALL (bag multiplicity semantics) is not "
+                "implemented; remove ALL for set semantics")
         # INTERSECT / EXCEPT (set semantics): distinct left, membership
-        # test against right over all channels, keep/drop, hide the mask
+        # test against right over all channels (NULLs compare EQUAL per
+        # set-operation semantics), keep/drop, hide the mask
         left_d = N.DistinctNode(lf, max_groups=max_groups)
         sj = N.SemiJoinNode(left_d, rt, list(range(ncols)),
-                            list(range(ncols)))
+                            list(range(ncols)), null_keys_match=True)
         mask = E.input_ref(ncols, T.BOOLEAN)
         pred = mask if ast.op == "intersect" else \
-            E.call("not", T.BOOLEAN, E.special(
-                "COALESCE", T.BOOLEAN, mask, E.const(False, T.BOOLEAN)))
+            E.call("not", T.BOOLEAN, mask)
         f = N.FilterNode(sj, pred)
         proj = N.ProjectNode(f, [
-            E.input_ref(i, lf.output_types()[i]) for i in range(ncols)])
+            E.input_ref(i, lt[i]) for i in range(ncols)])
         return proj, ln
     return _plan_query(ast, max_groups, join_capacity)
 
@@ -458,9 +465,11 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
     scope = make_scope()
 
     if q.where is not None:
-        plain_conjs = []
-        for c in _conjuncts(q.where):
-            if isinstance(c, P.InSubquery):
+        # plain conjuncts first: shrink rows before the semijoin probes
+        conjs = _conjuncts(q.where)
+        for c in [c for c in conjs if not isinstance(c, P.InSubquery)]:
+            node = N.FilterNode(node, an.lower(c, scope))
+        for c in [c for c in conjs if isinstance(c, P.InSubquery)]:
                 # uncorrelated IN subquery -> SemiJoinNode + mask filter
                 # (IN-predicate planning, sql/planner's apply/semijoin path)
                 sub_node, _sub_names = _plan_any(c.query, max_groups,
@@ -480,10 +489,6 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
                 f = N.FilterNode(sj, pred)
                 node = N.ProjectNode(f, [
                     E.input_ref(i, scope.types[i]) for i in range(nch)])
-            else:
-                plain_conjs.append(c)
-        for c in plain_conjs:
-            node = N.FilterNode(node, an.lower(c, scope))
 
     # window functions? (round 1: not mixed with GROUP BY aggregation)
     window_items = [(i, it) for i, it in enumerate(q.select.items)
