@@ -152,6 +152,11 @@ class InSubquery:
 
 
 @dataclasses.dataclass
+class ScalarSubquery:
+    query: object  # Query | SetQuery
+
+
+@dataclasses.dataclass
 class Query:
     select: Select
     table: TableRef
@@ -385,6 +390,10 @@ class _Parser:
             return Func(unit.lower(), [e])
         if k == "op" and v == "(":
             self.next()
+            if self.peek() == ("kw", "select"):
+                sub = self.query()
+                self.expect_op(")")
+                return ScalarSubquery(sub)
             e = self.expr()
             self.expect_op(")")
             return e

@@ -377,6 +377,8 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
             note_name(n.parts)
         elif isinstance(n, P.InSubquery):
             collect_names(n.value)  # the subquery has its own table scope
+        elif isinstance(n, P.ScalarSubquery):
+            pass  # fully self-contained scope
         elif dataclasses.is_dataclass(n):
             for f in dataclasses.fields(n):
                 v = getattr(n, f.name)
@@ -467,8 +469,39 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
     if q.where is not None:
         # plain conjuncts first: shrink rows before the semijoin probes
         conjs = _conjuncts(q.where)
-        for c in [c for c in conjs if not isinstance(c, P.InSubquery)]:
+
+        def has_scalar_sub(c):
+            return isinstance(c, P.BinOp) and \
+                isinstance(c.right, P.ScalarSubquery)
+
+        for c in [c for c in conjs
+                  if not isinstance(c, P.InSubquery) and not has_scalar_sub(c)]:
             node = N.FilterNode(node, an.lower(c, scope))
+        for c in [c for c in conjs if has_scalar_sub(c)]:
+            # uncorrelated scalar subquery comparison: broadcast the
+            # 1-row subresult to every row via a constant-key join (the
+            # EnforceSingleRow + cross-join shape the reference plans)
+            sub_node, _ = _plan_any(c.right.query, max_groups, join_capacity)
+            sub_node = _strip_output(sub_node)
+            subt = sub_node.output_types()
+            assert len(subt) == 1, "scalar subquery must produce one column"
+            nch = len(scope.types)
+            left = N.ProjectNode(node, [
+                E.input_ref(i, scope.types[i]) for i in range(nch)
+            ] + [E.const(1, T.BIGINT)])
+            right = N.ProjectNode(sub_node, [E.const(1, T.BIGINT),
+                                             E.input_ref(0, subt[0])])
+            node = N.JoinNode(left, right, [nch], [0], "inner", "broadcast",
+                              right_output_channels=[1],
+                              out_capacity=join_capacity)
+            scalar_ref = E.input_ref(nch + 1, subt[0])
+            lhs = an.lower(c.left, scope)
+            opname = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt",
+                      "<=": "le", ">": "gt", ">=": "ge"}[c.op]
+            node = N.FilterNode(node, E.call(opname, T.BOOLEAN, lhs,
+                                             scalar_ref))
+            node = N.ProjectNode(node, [
+                E.input_ref(i, scope.types[i]) for i in range(nch)])
         for c in [c for c in conjs if isinstance(c, P.InSubquery)]:
                 # uncorrelated IN subquery -> SemiJoinNode + mask filter
                 # (IN-predicate planning, sql/planner's apply/semijoin path)
