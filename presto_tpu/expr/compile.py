@@ -193,19 +193,41 @@ def evaluate(expr: RowExpression, batch: Batch) -> Block:
             unit = expr.arguments[0]
             assert isinstance(unit, Constant)
             d = evaluate(expr.arguments[1], batch)
-            assert d.type.base == "date", \
-                "date_trunc over timestamps lands with timestamp kernels"
-            vals = F.date_trunc_kernel(str(unit.value), d.values).astype(
-                d.values.dtype)
+            u = str(unit.value)
+            if d.type.base == "timestamp":
+                micros = d.values
+                if u in ("second", "minute", "hour"):
+                    step = {"second": 1_000_000, "minute": 60_000_000,
+                            "hour": 3_600_000_000}[u]
+                    vals = (micros // step) * step
+                else:  # calendar units truncate through days
+                    days = micros // 86_400_000_000
+                    vals = F.date_trunc_kernel(u, days) * 86_400_000_000
+                return Column(vals.astype(d.values.dtype), d.nulls, expr.type)
+            assert d.type.base == "date", d.type
+            vals = F.date_trunc_kernel(u, d.values).astype(d.values.dtype)
             return Column(vals, d.nulls, expr.type)
         if name == "date_diff":
             unit = expr.arguments[0]
             assert isinstance(unit, Constant)
             d1 = evaluate(expr.arguments[1], batch)
             d2 = evaluate(expr.arguments[2], batch)
-            assert d1.type.base == "date" and d2.type.base == "date", \
-                "date_diff over timestamps lands with timestamp kernels"
-            vals = F.date_diff_kernel(str(unit.value), d1.values, d2.values)
+            u = str(unit.value)
+            if d1.type.base == "timestamp" or d2.type.base == "timestamp":
+                m1 = _as_micros(d1)
+                m2 = _as_micros(d2)
+                if u in ("millisecond", "second", "minute", "hour"):
+                    step = {"millisecond": 1_000, "second": 1_000_000,
+                            "minute": 60_000_000, "hour": 3_600_000_000}[u]
+                    delta = m2 - m1
+                    vals = jnp.sign(delta) * (jnp.abs(delta) // step)
+                else:
+                    vals = F.date_diff_kernel(u, m1 // 86_400_000_000,
+                                              m2 // 86_400_000_000)
+                return Column(vals.astype(expr.type.to_dtype()),
+                              F._default_nulls(d1, d2), expr.type)
+            assert d1.type.base == "date" and d2.type.base == "date"
+            vals = F.date_diff_kernel(u, d1.values, d2.values)
             return Column(vals.astype(expr.type.to_dtype()),
                           F._default_nulls(d1, d2), expr.type)
         if name == "split_part":
@@ -228,6 +250,12 @@ def evaluate(expr: RowExpression, batch: Batch) -> Block:
         return out
 
     raise TypeError(f"cannot evaluate {type(expr)}")
+
+
+def _as_micros(b: Block):
+    if b.type.base == "date":
+        return b.values.astype(jnp.int64) * 86_400_000_000
+    return b.values
 
 
 def _bool(b: Block):

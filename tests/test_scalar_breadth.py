@@ -42,6 +42,30 @@ def test_date_diff():
         assert v[0] == want, (unit, v[0])
 
 
+def test_timestamp_kernels():
+    us = 1_000_000
+    ts = np.array([
+        (days("1995-07-14") * 86400 + 13 * 3600 + 45 * 60 + 30) * us,
+        (days("1996-02-29") * 86400 + 1) * us,
+    ], dtype=np.int64)
+    b = batch_from_numpy([T.TIMESTAMP, T.TIMESTAMP], [ts, ts + 86400 * us * 40])
+    x = input_ref(0, T.TIMESTAMP)
+    v, _ = ev(call("year", T.BIGINT, x), b)
+    assert list(v) == [1995, 1996]
+    v, _ = ev(call("date_trunc", T.TIMESTAMP, const("hour", T.varchar(4)), x), b)
+    assert v[0] == (days("1995-07-14") * 86400 + 13 * 3600) * us
+    v, _ = ev(call("date_trunc", T.TIMESTAMP, const("month", T.varchar(5)), x), b)
+    assert v[0] == days("1995-07-01") * 86400 * us
+    e = call("date_diff", T.BIGINT, const("hour", T.varchar(4)), x,
+             input_ref(1, T.TIMESTAMP))
+    v, _ = ev(e, b)
+    assert list(v) == [40 * 24, 40 * 24]
+    e = call("date_diff", T.BIGINT, const("day", T.varchar(3)), x,
+             input_ref(1, T.TIMESTAMP))
+    v, _ = ev(e, b)
+    assert list(v) == [40, 40]
+
+
 def test_sign_truncate_mod():
     b = batch_from_numpy([T.BIGINT], [np.array([-5, 0, 7])])
     v, _ = ev(call("sign", T.BIGINT, input_ref(0, T.BIGINT)), b)
