@@ -56,7 +56,7 @@ class CompiledPlan:
 
 
 def _collect_scans(node: N.PlanNode, out: List[N.PlanNode]):
-    if isinstance(node, (N.TableScanNode, N.ValuesNode)):
+    if isinstance(node, (N.TableScanNode, N.ValuesNode, N.RemoteSourceNode)):
         out.append(node)
     for s in node.sources:
         _collect_scans(s, out)
@@ -70,7 +70,8 @@ def compile_plan(root: N.PlanNode, mesh=None,
     dist = mesh is not None
 
     def lower(node: N.PlanNode, inputs: Dict[str, Batch]) -> Batch:
-        if isinstance(node, (N.TableScanNode, N.ValuesNode)):
+        if isinstance(node, (N.TableScanNode, N.ValuesNode,
+                             N.RemoteSourceNode)):
             return inputs[node.id]
         if isinstance(node, N.FilterNode):
             return compile_filter(node.predicate)(lower(node.source, inputs))

@@ -47,7 +47,8 @@ class QueryResult:
 
 
 def _scan_batch(node: N.PlanNode, sf: float, capacity_hint: Optional[int],
-                pad_multiple: int) -> Batch:
+                pad_multiple: int,
+                scan_range: Optional[Tuple[int, int]] = None) -> Batch:
     if isinstance(node, N.ValuesNode):
         arrays = []
         for ci, ty in enumerate(node.types):
@@ -61,15 +62,22 @@ def _scan_batch(node: N.PlanNode, sf: float, capacity_hint: Optional[int],
     assert isinstance(node, N.TableScanNode)
     from ..connectors import catalog
     conn = catalog(node.connector)
-    n = conn.table_row_count(node.table, sf)
-    cap = capacity_hint or -(-n // pad_multiple) * pad_multiple
-    return conn.generate_batch(node.table, sf, node.columns, capacity=cap)
+    if scan_range is not None:
+        start, count = scan_range
+    else:
+        start, count = 0, conn.table_row_count(node.table, sf)
+    cap = capacity_hint or max(-(-count // pad_multiple) * pad_multiple,
+                               pad_multiple)
+    return conn.generate_batch(node.table, sf, node.columns, start=start,
+                               count=count, capacity=cap)
 
 
 def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
               capacity_hints: Optional[Dict[str, int]] = None,
               default_join_capacity: int = 1 << 16,
-              split_rows: Optional[int] = None) -> QueryResult:
+              split_rows: Optional[int] = None,
+              scan_ranges: Optional[Dict[str, Tuple[int, int]]] = None,
+              remote_sources: Optional[Dict[str, Batch]] = None) -> QueryResult:
     """Plan -> results, end to end (DistributedQueryRunner analog for
     programmatic plans). With a mesh, scan batches are padded to a
     multiple of the mesh size and the plan runs SPMD. With `split_rows`,
@@ -95,9 +103,18 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     plan = compile_plan(root, mesh, default_join_capacity)
     pad = (mesh.devices.size if mesh is not None else 1) * 8
     hints = capacity_hints or {}
+    scan_ranges = scan_ranges or {}
+    remote_sources = remote_sources or {}
     with stats.timed("scan_stage_s"):
-        batches = [
-            _scan_batch(s, sf, hints.get(s.id), pad) for s in plan.scan_nodes]
+        batches = []
+        for s in plan.scan_nodes:
+            if isinstance(s, N.RemoteSourceNode):
+                assert s.id in remote_sources, \
+                    f"no remote source batch supplied for node {s.id}"
+                batches.append(remote_sources[s.id])
+            else:
+                batches.append(_scan_batch(s, sf, hints.get(s.id), pad,
+                                           scan_ranges.get(s.id)))
     for b in batches:
         stats.add("scan_rows", int(np.asarray(b.active).sum()))
     fn = jax.jit(plan.fn)

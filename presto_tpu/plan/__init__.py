@@ -1,4 +1,5 @@
-from .nodes import (PlanNode, TableScanNode, ValuesNode, FilterNode,
+from .nodes import (PlanNode, TableScanNode, ValuesNode, RemoteSourceNode,
+                    FilterNode,
                     ProjectNode, AggregationNode, JoinNode, SemiJoinNode,
                     SortNode, TopNNode, LimitNode, DistinctNode, ExchangeNode,
                     UnnestNode, UnionNode, SampleNode, AssignUniqueIdNode,
@@ -8,7 +9,8 @@ from .fragment import PlanFragment, fragment_plan
 from .explain import explain, explain_distributed
 from .validator import validate_plan
 
-__all__ = ["PlanNode", "TableScanNode", "ValuesNode", "FilterNode",
+__all__ = ["PlanNode", "TableScanNode", "ValuesNode", "RemoteSourceNode",
+           "FilterNode",
            "ProjectNode", "AggregationNode", "JoinNode", "SemiJoinNode",
            "SortNode", "TopNNode", "LimitNode", "DistinctNode", "ExchangeNode",
            "UnnestNode", "UnionNode", "SampleNode", "AssignUniqueIdNode",

@@ -19,7 +19,41 @@ from typing import Dict, List, Tuple
 
 from .nodes import ExchangeNode, PlanNode, to_json
 
-__all__ = ["PlanFragment", "fragment_plan"]
+__all__ = ["PlanFragment", "fragment_plan", "distribute_simple_agg"]
+
+
+def distribute_simple_agg(root: PlanNode) -> PlanNode:
+    """The AddExchanges rule for the common shape: rewrite
+    Output(Aggregation(SINGLE, pipeline)) into
+    Output(FINAL-agg(REMOTE GATHER exchange(PARTIAL-agg(pipeline)))) so
+    the scheduler can run the scan+partial stage on every worker and
+    merge downstream (PushPartialAggregationThroughExchange analog)."""
+    from .nodes import AggregationNode, ExchangeNode, OutputNode
+
+    assert isinstance(root, OutputNode), "expected OutputNode root"
+    node = root.source
+    post = []
+    while not isinstance(node, AggregationNode):
+        # allow post-aggregation wrappers (project/sort/limit) to ride on top
+        post.append(node)
+        assert node.sources and len(node.sources) == 1, \
+            "distribute_simple_agg expects a linear post-agg chain"
+        node = node.sources[0]
+    agg = node
+    assert agg.step == "SINGLE", "aggregation already distributed"
+    partial = AggregationNode(agg.source, agg.group_channels, agg.aggregates,
+                              step="PARTIAL", max_groups=agg.max_groups)
+    ex = ExchangeNode(partial, kind="GATHER", scope="REMOTE")
+    final = AggregationNode(ex, list(range(len(agg.group_channels))),
+                            agg.aggregates, step="FINAL",
+                            max_groups=agg.max_groups)
+    # FINAL consumes partial STATE columns laid out keys-first, so group
+    # channels are 0..nkeys-1 in the exchanged table
+    rebuilt = final
+    import dataclasses as _dc
+    for wrapper in reversed(post):
+        rebuilt = _dc.replace(wrapper, source=rebuilt)
+    return OutputNode(rebuilt, root.names)
 
 
 @dataclasses.dataclass
@@ -39,25 +73,50 @@ class PlanFragment:
 
 
 def fragment_plan(root: PlanNode) -> List[PlanFragment]:
-    """Walk the tree, cutting at REMOTE exchanges (child side becomes a
-    new fragment). Returns fragments root-last, ids in creation order."""
+    """Walk the tree, cutting at REMOTE exchanges: the child side becomes
+    a new fragment and the consumer side is spliced with a
+    RemoteSourceNode naming it -- the shape the scheduler ships to
+    workers (each fragment is self-contained). Returns fragments
+    root-last, ids in creation order. The input tree is not mutated;
+    consumer-side nodes above a cut are shallow-copied."""
+    import dataclasses as _dc
+
+    from .nodes import RemoteSourceNode
+
     fragments: List[PlanFragment] = []
 
     def walk(node: PlanNode) -> Tuple[PlanNode, List[int]]:
-        feeds: List[int] = []
         if isinstance(node, ExchangeNode) and node.scope == "REMOTE":
             child, child_feeds = walk(node.source)
             part = ("HASH" if node.kind == "REPARTITION" else
                     "BROADCAST" if node.kind == "REPLICATE" else "SINGLE")
             frag = PlanFragment(len(fragments), child, part, child_feeds)
             fragments.append(frag)
-            feeds.append(frag.id)
-            return node, feeds
-        for s in node.sources:
-            _, f = walk(s)
-            feeds.extend(f)
+            rs = RemoteSourceNode(list(child.output_types()), frag.id)
+            return rs, [frag.id]
+        feeds: List[int] = []
+        replaced = {}
+        for f in _dc.fields(node):
+            v = getattr(node, f.name)
+            if isinstance(v, PlanNode):
+                nv, fs = walk(v)
+                feeds.extend(fs)
+                if nv is not v:
+                    replaced[f.name] = nv
+            elif isinstance(v, list) and v and isinstance(v[0], PlanNode):
+                nl = []
+                changed = False
+                for s in v:
+                    nv, fs = walk(s)
+                    feeds.extend(fs)
+                    changed = changed or nv is not s
+                    nl.append(nv)
+                if changed:
+                    replaced[f.name] = nl
+        if replaced:
+            node = _dc.replace(node, **replaced)
         return node, feeds
 
-    _, feeds = walk(root)
-    fragments.append(PlanFragment(len(fragments), root, "SINGLE", feeds))
+    new_root, feeds = walk(root)
+    fragments.append(PlanFragment(len(fragments), new_root, "SINGLE", feeds))
     return fragments

@@ -65,6 +65,20 @@ class TableScanNode(PlanNode):
 
 
 @dataclasses.dataclass
+class RemoteSourceNode(PlanNode):
+    """Input fed from upstream fragments' output buffers
+    (RemoteSourceNode analog): within a slice the exec layer wires it to
+    collectives; across workers the task body names upstream (worker,
+    task) pairs and the batch arrives via the HTTP SerializedPage pull
+    (server/http_exchange.py)."""
+    types: List[T.Type]
+    fragment_id: int = -1
+
+    def output_types(self):
+        return list(self.types)
+
+
+@dataclasses.dataclass
 class ValuesNode(PlanNode):
     types: List[T.Type]
     rows: List[List[object]]
@@ -412,6 +426,10 @@ def to_json(n: PlanNode) -> dict:
         return {**base, "@type": "tablescan", "connector": n.connector,
                 "table": n.table, "columns": n.columns,
                 "columnTypes": [str(t) for t in n.column_types]}
+    if isinstance(n, RemoteSourceNode):
+        return {**base, "@type": "remotesource",
+                "types": [str(t) for t in n.types],
+                "fragmentId": n.fragment_id}
     if isinstance(n, ValuesNode):
         return {**base, "@type": "values", "types": [str(t) for t in n.types],
                 "rows": n.rows}
@@ -496,6 +514,9 @@ def from_json(j: dict) -> PlanNode:
     if t == "tablescan":
         return TableScanNode(j["connector"], j["table"], j["columns"],
                              [T.parse_type(s) for s in j["columnTypes"]], **kw)
+    if t == "remotesource":
+        return RemoteSourceNode([T.parse_type(s) for s in j["types"]],
+                                j["fragmentId"], **kw)
     if t == "values":
         return ValuesNode([T.parse_type(s) for s in j["types"]], j["rows"], **kw)
     if t == "filter":

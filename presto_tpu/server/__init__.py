@@ -1,4 +1,5 @@
 from .worker import TpuWorkerServer, TaskManager
 from .client import WorkerClient
+from .coordinator import Coordinator
 
-__all__ = ["TpuWorkerServer", "TaskManager", "WorkerClient"]
+__all__ = ["TpuWorkerServer", "TaskManager", "WorkerClient", "Coordinator"]

@@ -40,9 +40,14 @@ class WorkerClient:
 
     def submit(self, task_id: str, plan: N.PlanNode, sf: float = 0.01,
                session: Optional[dict] = None) -> dict:
-        body = json.dumps({"plan": N.to_json(plan), "sf": sf,
-                           "session": session or {}}).encode()
-        data, _ = self._request("POST", f"/v1/task/{task_id}", body)
+        return self.submit_body(task_id, {"plan": N.to_json(plan), "sf": sf,
+                                          "session": session or {}})
+
+    def submit_body(self, task_id: str, body: dict) -> dict:
+        """Raw TaskUpdateRequest submission (scanRanges / remoteSources
+        and other fields pass through verbatim)."""
+        data, _ = self._request("POST", f"/v1/task/{task_id}",
+                                json.dumps(body).encode())
         return json.loads(data)
 
     def task_info(self, task_id: str) -> dict:

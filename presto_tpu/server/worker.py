@@ -110,10 +110,22 @@ class TaskManager:
                 compression=(session.get("exchange_compression")
                              if session.get("exchange_compression") != "none"
                              else None))
+            scan_ranges = {k: tuple(v) for k, v in
+                           body.get("scanRanges", {}).items()}
+            remote_sources = {}
+            for node_id, spec in body.get("remoteSources", {}).items():
+                # pull upstream pages peer-to-peer (PrestoExchangeSource)
+                from ..types import parse_type
+                from .http_exchange import fetch_remote_batch
+                remote_sources[node_id] = fetch_remote_batch(
+                    spec["sources"], spec["taskIds"],
+                    [parse_type(t) for t in spec["types"]])
             from ..exec.runner import run_query
             t0 = time.time()
             with self._exec_lock:
-                res = run_query(plan, sf=sf, mesh=self.mesh)
+                res = run_query(plan, sf=sf, mesh=self.mesh,
+                                scan_ranges=scan_ranges,
+                                remote_sources=remote_sources)
             wall = time.time() - t0
             types = plan.output_types()
             cols = [(types[i], res.columns[i], res.nulls[i])
