@@ -216,14 +216,29 @@ def evaluate(expr: RowExpression, batch: Batch) -> Block:
             if d1.type.base == "timestamp" or d2.type.base == "timestamp":
                 m1 = _as_micros(d1)
                 m2 = _as_micros(d2)
-                if u in ("millisecond", "second", "minute", "hour"):
+                if u in ("millisecond", "second", "minute", "hour", "day",
+                         "week"):
+                    # whole elapsed units, truncated toward zero
                     step = {"millisecond": 1_000, "second": 1_000_000,
-                            "minute": 60_000_000, "hour": 3_600_000_000}[u]
+                            "minute": 60_000_000, "hour": 3_600_000_000,
+                            "day": 86_400_000_000,
+                            "week": 7 * 86_400_000_000}[u]
                     delta = m2 - m1
                     vals = jnp.sign(delta) * (jnp.abs(delta) // step)
                 else:
-                    vals = F.date_diff_kernel(u, m1 // 86_400_000_000,
-                                              m2 // 86_400_000_000)
+                    # calendar units on days, with a time-of-day partial
+                    # adjustment when the day-of-month boundary ties
+                    day_us = 86_400_000_000
+                    vals = F.date_diff_kernel(u, m1 // day_us, m2 // day_us)
+                    _, _, dd1 = F._civil(m1 // day_us)
+                    _, _, dd2 = F._civil(m2 // day_us)
+                    tod1 = m1 % day_us
+                    tod2 = m2 % day_us
+                    tie = dd1 == dd2
+                    adj = jnp.where((vals > 0) & tie & (tod2 < tod1), 1,
+                                    jnp.where((vals < 0) & tie & (tod2 > tod1),
+                                              -1, 0))
+                    vals = vals - adj
                 return Column(vals.astype(expr.type.to_dtype()),
                               F._default_nulls(d1, d2), expr.type)
             assert d1.type.base == "date" and d2.type.base == "date"

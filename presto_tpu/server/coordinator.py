@@ -50,8 +50,9 @@ class Coordinator:
 
     def execute(self, root: N.PlanNode, sf: float = 0.01,
                 timeout: float = 120.0):
-        """Run a (possibly multi-fragment) plan; returns (columns, nulls,
-        names) pulled from the final task."""
+        """Run a (possibly multi-fragment) plan. Returns (cols, names)
+        where cols is a list of (values, nulls) numpy pairs per output
+        column, pulled from the final task."""
         workers = self.workers()
         fragments = fragment_plan(root)
         qid = uuid.uuid4().hex[:8]
@@ -68,7 +69,6 @@ class Coordinator:
             scans: List[N.TableScanNode] = []
             _collect_tables(frag.root, scans)
 
-            is_last = frag is fragments[-1]
             if scans and not remote_nodes:
                 # leaf fragment: range-split every scan across all workers
                 tasks = []

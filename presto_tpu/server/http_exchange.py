@@ -27,7 +27,8 @@ def fetch_remote_batch(sources: Sequence[str], task_ids: Sequence[str],
                        types: Sequence[T.Type],
                        codec: PageCodec = PageCodec(),
                        capacity: Optional[int] = None,
-                       timeout: float = 60.0) -> Batch:
+                       timeout: float = 60.0,
+                       pad_multiple: int = 8) -> Batch:
     """Pull every page of `task_ids[i]` from worker base-url `sources[i]`,
     concatenate, and stage as one device Batch -- the RemoteSourceNode
     feed for a fragment whose upstream ran on other workers/slices."""
@@ -59,5 +60,6 @@ def fetch_remote_batch(sources: Sequence[str], task_ids: Sequence[str],
             arrays.append(np.array([], dtype=object if ty.is_string
                                    else ty.to_dtype()))
             nulls.append(np.array([], dtype=bool))
-    cap = capacity or max(-(-total // 8) * 8, 8)
+    cap = capacity or max(-(-total // pad_multiple) * pad_multiple,
+                          pad_multiple)
     return batch_from_numpy(types, arrays, nulls, capacity=cap)

@@ -113,13 +113,15 @@ class TaskManager:
             scan_ranges = {k: tuple(v) for k, v in
                            body.get("scanRanges", {}).items()}
             remote_sources = {}
+            pad = (self.mesh.devices.size if self.mesh is not None else 1) * 8
             for node_id, spec in body.get("remoteSources", {}).items():
                 # pull upstream pages peer-to-peer (PrestoExchangeSource)
                 from ..types import parse_type
                 from .http_exchange import fetch_remote_batch
                 remote_sources[node_id] = fetch_remote_batch(
                     spec["sources"], spec["taskIds"],
-                    [parse_type(t) for t in spec["types"]])
+                    [parse_type(t) for t in spec["types"]],
+                    pad_multiple=pad)
             from ..exec.runner import run_query
             t0 = time.time()
             with self._exec_lock:

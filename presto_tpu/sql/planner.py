@@ -478,28 +478,40 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
                   if not isinstance(c, P.InSubquery) and not has_scalar_sub(c)]:
             node = N.FilterNode(node, an.lower(c, scope))
         for c in [c for c in conjs if has_scalar_sub(c)]:
-            # uncorrelated scalar subquery comparison: broadcast the
-            # 1-row subresult to every row via a constant-key join (the
-            # EnforceSingleRow + cross-join shape the reference plans)
+            # uncorrelated scalar subquery comparison. The subresult is
+            # collapsed through a 1-group aggregation to (value, count):
+            # the join build side is then provably one row, and rows are
+            # dropped when count != 1 (the reference's EnforceSingleRow
+            # raises instead; the error channel lands with task-level
+            # error reporting -- see ROADMAP).
             sub_node, _ = _plan_any(c.right.query, max_groups, join_capacity)
             sub_node = _strip_output(sub_node)
             subt = sub_node.output_types()
             assert len(subt) == 1, "scalar subquery must produce one column"
+            sub_one = N.AggregationNode(
+                sub_node, [],
+                [AggSpec("min", 0, subt[0]),
+                 AggSpec("count_star", None, T.BIGINT)],
+                step="SINGLE", max_groups=1)
             nch = len(scope.types)
             left = N.ProjectNode(node, [
                 E.input_ref(i, scope.types[i]) for i in range(nch)
             ] + [E.const(1, T.BIGINT)])
-            right = N.ProjectNode(sub_node, [E.const(1, T.BIGINT),
-                                             E.input_ref(0, subt[0])])
+            right = N.ProjectNode(sub_one, [E.const(1, T.BIGINT),
+                                            E.input_ref(0, subt[0]),
+                                            E.input_ref(1, T.BIGINT)])
             node = N.JoinNode(left, right, [nch], [0], "inner", "broadcast",
-                              right_output_channels=[1],
+                              right_output_channels=[1, 2],
                               out_capacity=join_capacity)
             scalar_ref = E.input_ref(nch + 1, subt[0])
+            count_ref = E.input_ref(nch + 2, T.BIGINT)
             lhs = an.lower(c.left, scope)
             opname = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt",
                       "<=": "le", ">": "gt", ">=": "ge"}[c.op]
-            node = N.FilterNode(node, E.call(opname, T.BOOLEAN, lhs,
-                                             scalar_ref))
+            node = N.FilterNode(node, E.special(
+                "AND", T.BOOLEAN,
+                E.call("le", T.BOOLEAN, count_ref, E.const(1, T.BIGINT)),
+                E.call(opname, T.BOOLEAN, lhs, scalar_ref)))
             node = N.ProjectNode(node, [
                 E.input_ref(i, scope.types[i]) for i in range(nch)])
         for c in [c for c in conjs if isinstance(c, P.InSubquery)]:
