@@ -363,9 +363,14 @@ def _minmax_string(col: StringColumn, ids, live, g, spec):
 
 def group_by(batch: Batch, key_channels: Sequence[int], aggs: Sequence[AggSpec],
              max_groups: int) -> GroupByResult:
-    """Grouped aggregation over one batch -> dense group table."""
+    """Grouped aggregation over one batch -> dense group table.
+
+    Global aggregation (no keys) always yields exactly one group, even
+    over zero input rows -- SQL's `SELECT count(*) ... -> 0` contract."""
     keys = [batch.column(c) for c in key_channels]
     ids, perm_first, num_groups, overflow = _group_ids(keys, batch.active, max_groups)
+    if not key_channels:
+        num_groups = jnp.maximum(num_groups, 1)
     slot = jnp.arange(max_groups, dtype=jnp.int32)
     slot_active = slot < jnp.minimum(num_groups, max_groups)
     out_cols: List[Block] = []
