@@ -86,7 +86,15 @@ def compile_plan(root: N.PlanNode, mesh=None,
                 r = group_by(src, node.group_channels, node.aggregates,
                              node.max_groups)
             _note_overflow(r.overflow)
-            return r.batch
+            out = r.batch
+            if dist and not node.group_channels and \
+                    node.step in ("FINAL", "SINGLE"):
+                # global aggregation's guaranteed single row belongs to
+                # worker 0 only (inputs were gathered there); other
+                # workers would otherwise emit spurious empty-state rows
+                is_root = jax.lax.axis_index(axis) == 0
+                out = out.with_active(out.active & is_root)
+            return out
         if isinstance(node, N.JoinNode):
             probe = lower(node.left, inputs)
             build = lower(node.right, inputs)

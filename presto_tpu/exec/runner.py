@@ -83,6 +83,12 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     multiple of the mesh size and the plan runs SPMD. With `split_rows`,
     streamable aggregation plans execute split-by-split with bounded
     HBM (exec/streaming.py)."""
+    if mesh is not None:
+        # make the plan SPMD-correct: single-node operators get the
+        # exchanges they need (AddExchanges; idempotent for plans that
+        # already carry PARTIAL/FINAL + exchange structure)
+        from ..plan.distribute import add_exchanges
+        root = add_exchanges(root)
     from ..plan.validator import validate_plan
     violations = validate_plan(root, distributed=mesh is not None)
     if violations:

@@ -1,0 +1,84 @@
+"""AddExchanges: make a single-node plan SPMD-correct.
+
+Reference surface: sql/planner/optimizations/AddExchanges.java:183 --
+the pass that decides distribution and inserts remote ExchangeNodes so
+every operator sees the rows it semantically needs. Without it, a
+SINGLE-step aggregation lowered under shard_map would aggregate each
+shard independently and emit per-shard partials as if they were final
+results (exactly the drift the verifier catches).
+
+Round-1 rules (correctness-first; cost-based variants per ROADMAP):
+  * Aggregation(SINGLE, keys)   -> PARTIAL -> REPARTITION(keys) -> FINAL
+  * Aggregation(SINGLE, global) -> PARTIAL -> GATHER -> FINAL
+  * Distinct                    -> REPARTITION(keys) -> Distinct
+  * Sort / TopN / Limit / Window / RowNumber / MarkDistinct
+                                -> GATHER -> op (single-node semantics)
+  * Join                        -> distribution=broadcast (build side is
+                                   all_gathered by the lowering)
+  * SemiJoin                    -> filtering side broadcast (lowering)
+"""
+
+from __future__ import annotations
+
+import dataclasses as _dc
+from typing import List
+
+from . import nodes as N
+
+__all__ = ["add_exchanges"]
+
+_GATHER_OPS = (N.SortNode, N.TopNNode, N.LimitNode, N.WindowNode,
+               N.RowNumberNode, N.MarkDistinctNode)
+
+
+def add_exchanges(node: N.PlanNode) -> N.PlanNode:
+    # rebuild children first
+    replaced = {}
+    for f in _dc.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, N.PlanNode):
+            nv = add_exchanges(v)
+            if nv is not v:
+                replaced[f.name] = nv
+        elif isinstance(v, list) and v and isinstance(v[0], N.PlanNode):
+            nl = [add_exchanges(s) for s in v]
+            if any(a is not b for a, b in zip(nl, v)):
+                replaced[f.name] = nl
+    if replaced:
+        node = _dc.replace(node, **replaced)
+
+    if isinstance(node, N.AggregationNode) and node.step == "SINGLE":
+        partial = N.AggregationNode(node.source, node.group_channels,
+                                    node.aggregates, step="PARTIAL",
+                                    max_groups=node.max_groups)
+        nkeys = len(node.group_channels)
+        if nkeys:
+            ex = N.ExchangeNode(partial, kind="REPARTITION", scope="REMOTE",
+                                partition_channels=list(range(nkeys)),
+                                slot_capacity=node.max_groups)
+        else:
+            ex = N.ExchangeNode(partial, kind="GATHER", scope="REMOTE")
+        return N.AggregationNode(ex, list(range(nkeys)), node.aggregates,
+                                 step="FINAL", max_groups=node.max_groups)
+
+    if isinstance(node, N.DistinctNode):
+        keys = node.key_channels
+        if keys is None:
+            keys = list(range(len(node.source.output_types())))
+        ex = N.ExchangeNode(node.source, kind="REPARTITION", scope="REMOTE",
+                            partition_channels=keys,
+                            slot_capacity=node.max_groups)
+        return _dc.replace(node, source=ex)
+
+    if isinstance(node, _GATHER_OPS):
+        src = node.sources[0]
+        if not isinstance(src, N.ExchangeNode):
+            ex = N.ExchangeNode(src, kind="GATHER", scope="REMOTE")
+            return _dc.replace(node, source=ex)
+        return node
+
+    if isinstance(node, N.JoinNode) and node.distribution != "broadcast":
+        # round-1 SPMD join strategy: replicate the build side
+        return _dc.replace(node, distribution="broadcast")
+
+    return node

@@ -48,6 +48,28 @@ class Coordinator:
         assert nodes, "no alive workers in discovery"
         return [n["uri"] for n in nodes]
 
+    def _submit_with_failover(self, urls: List[str], preferred: int,
+                              task_id: str, body: dict, timeout: float
+                              ) -> Tuple[str, str]:
+        """Submit a task, failing over to the next worker on submission
+        or execution failure (deterministic splits make any attempt
+        re-runnable on any worker -- the recoverable-execution property;
+        RemoteTask's RequestErrorTracker retries analog)."""
+        last_err = None
+        for attempt in range(len(urls)):
+            url = urls[(preferred + attempt) % len(urls)]
+            tid = task_id if attempt == 0 else f"{task_id}.r{attempt}"
+            try:
+                client = WorkerClient(url, timeout)
+                client.submit_body(tid, body)
+                info = client.wait(tid, timeout)
+                if info["state"] == "FINISHED":
+                    return url, tid
+                last_err = info.get("error")
+            except Exception as e:  # noqa: BLE001 - dead worker -> next
+                last_err = f"{type(e).__name__}: {e}"
+        raise RuntimeError(f"task {task_id} failed on every worker: {last_err}")
+
     def execute(self, root: N.PlanNode, sf: float = 0.01,
                 timeout: float = 120.0):
         """Run a (possibly multi-fragment) plan. Returns (cols, names)
@@ -72,7 +94,7 @@ class Coordinator:
             if scans and not remote_nodes:
                 # leaf fragment: range-split every scan across all workers
                 tasks = []
-                for w, url in enumerate(workers):
+                for w in range(len(workers)):
                     ranges = {}
                     for s in scans:
                         total = catalog(s.connector).table_row_count(s.table, sf)
@@ -80,14 +102,15 @@ class Coordinator:
                         hi = total * (w + 1) // len(workers)
                         ranges[s.id] = [lo, hi - lo]
                     tid = f"{qid}.f{frag.id}.w{w}"
-                    WorkerClient(url, timeout).submit_body(tid, {
-                        "plan": N.to_json(frag_plan), "sf": sf,
-                        "scanRanges": ranges})
+                    url, tid = self._submit_with_failover(
+                        workers, w, tid,
+                        {"plan": N.to_json(frag_plan), "sf": sf,
+                         "scanRanges": ranges}, timeout)
                     tasks.append((url, tid))
                 produced[frag.id] = tasks
             else:
-                # downstream fragment: single task on worker 0 consuming
-                # every upstream task buffer (FIXED/SINGLE distribution)
+                # downstream fragment: single task consuming every
+                # upstream task buffer (FIXED/SINGLE distribution)
                 spec = {}
                 for rn in remote_nodes:
                     ups = produced[rn.fragment_id]
@@ -95,18 +118,14 @@ class Coordinator:
                         "sources": [u for u, _ in ups],
                         "taskIds": [t for _, t in ups],
                         "types": [str(t) for t in rn.types]}
-                url = workers[0]
-                tid = f"{qid}.f{frag.id}"
-                WorkerClient(url, timeout).submit_body(tid, {
-                    "plan": N.to_json(frag_plan), "sf": sf,
-                    "remoteSources": spec})
+                url, tid = self._submit_with_failover(
+                    workers, 0, f"{qid}.f{frag.id}",
+                    {"plan": N.to_json(frag_plan), "sf": sf,
+                     "remoteSources": spec}, timeout)
                 produced[frag.id] = [(url, tid)]
 
         final_url, final_tid = produced[fragments[-1].id][0]
         client = WorkerClient(final_url, timeout)
-        info = client.wait(final_tid, timeout)
-        if info["state"] != "FINISHED":
-            raise RuntimeError(f"query {qid} failed: {info.get('error')}")
         types = fragments[-1].root.output_types()
         cols = client.fetch_results(final_tid, types)
         names = fragments[-1].root.names \

@@ -1,0 +1,84 @@
+"""Verifier: replay a query corpus across engine configurations and
+compare results.
+
+Reference surface: presto-verifier (24k LoC: replays production queries
+against control/test clusters with per-column checksums and drift
+resolvers). Here the "clusters" are execution configurations of one
+engine -- single-batch local, streaming splits, SPMD mesh -- and results
+must match EXACTLY (decimals are scaled int64: no tolerance needed,
+checksums are literal equality on sorted row sets).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence
+
+__all__ = ["VerifierResult", "verify_corpus", "DEFAULT_CORPUS"]
+
+
+@dataclasses.dataclass
+class VerifierResult:
+    query: str
+    configs: List[str]
+    ok: bool
+    detail: str = ""
+
+
+def _canon(res) -> list:
+    rows = [tuple(None if v is None else v for v in r) for r in res.rows()]
+    return sorted(rows, key=lambda r: tuple(str(x) for x in r))
+
+
+def verify_corpus(corpus: Sequence[str], sf: float = 0.01,
+                  mesh=None, split_rows: Optional[int] = None,
+                  max_groups: int = 1 << 14) -> List[VerifierResult]:
+    """Run each query under every applicable configuration; compare
+    sorted row sets for exact equality."""
+    from .sql import sql
+
+    out: List[VerifierResult] = []
+    for text in corpus:
+        runs: Dict[str, object] = {}
+        errors: Dict[str, str] = {}
+
+        def attempt(name: str, **kwargs):
+            try:
+                runs[name] = _canon(sql(text, sf=sf, max_groups=max_groups,
+                                        **kwargs))
+            except Exception as e:  # noqa: BLE001 - verifier records drift
+                errors[name] = f"{type(e).__name__}: {e}"
+
+        attempt("control")
+        if split_rows is not None:
+            attempt("streaming", split_rows=split_rows)
+        if mesh is not None:
+            attempt("mesh", mesh=mesh)
+
+        if errors:
+            out.append(VerifierResult(text, list(runs) + list(errors), False,
+                                      f"errors: {errors}"))
+            continue
+        names = list(runs)
+        base = runs[names[0]]
+        mismatch = [n for n in names[1:] if runs[n] != base]
+        if mismatch:
+            out.append(VerifierResult(text, names, False,
+                                      f"result drift in {mismatch}"))
+        else:
+            out.append(VerifierResult(text, names, True))
+    return out
+
+
+DEFAULT_CORPUS = [
+    "SELECT returnflag, linestatus, sum(quantity), count(*) FROM lineitem "
+    "WHERE shipdate <= date '1998-09-02' GROUP BY returnflag, linestatus",
+    "SELECT sum(extendedprice * discount) FROM lineitem "
+    "WHERE discount BETWEEN 0.05 AND 0.07 AND quantity < 24",
+    "SELECT custkey, count(*) FROM orders GROUP BY custkey "
+    "HAVING count(*) >= 25",
+    "SELECT shipmode, min(quantity), max(quantity) FROM lineitem "
+    "WHERE shipmode IN ('AIR', 'MAIL') GROUP BY shipmode",
+    "SELECT count(*) FROM lineitem WHERE orderkey IN "
+    "(SELECT orderkey FROM orders WHERE totalprice > 300000.00)",
+]

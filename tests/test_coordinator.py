@@ -58,6 +58,21 @@ def test_distributed_q1_matches_local(cluster):
     assert got == want
 
 
+def test_failover_to_live_worker(cluster):
+    """One configured worker URL is dead: tasks fail over to the live
+    ones and the query still returns correct results (recoverable
+    execution via deterministic splits)."""
+    sqltext = ("SELECT count(*) AS c FROM orders")
+    local = run_query(plan_sql(sqltext, max_groups=4), sf=0.01)
+    urls = [f"http://127.0.0.1:{cluster[0].port}",
+            "http://127.0.0.1:1",  # nothing listens here
+            f"http://127.0.0.1:{cluster[1].port}"]
+    coord = Coordinator(urls)
+    dist = distribute_simple_agg(plan_sql(sqltext, max_groups=4))
+    cols, _ = coord.execute(dist, sf=0.01, timeout=30.0)
+    assert int(cols[0][0][0]) == local.rows()[0][0]
+
+
 def test_distributed_high_cardinality(cluster):
     sqltext = ("SELECT custkey, sum(totalprice) AS s, count(*) AS c "
                "FROM orders GROUP BY custkey")

@@ -1,0 +1,11 @@
+from presto_tpu.verifier import DEFAULT_CORPUS, verify_corpus
+
+
+def test_corpus_consistent_across_configs(mesh8):
+    results = verify_corpus(DEFAULT_CORPUS, sf=0.01, mesh=mesh8,
+                            split_rows=16384)
+    bad = [r for r in results if not r.ok]
+    assert not bad, [f"{r.query[:60]}: {r.detail}" for r in bad]
+    # streaming config actually engaged for the streamable queries
+    assert any("streaming" in r.configs for r in results)
+    assert all("mesh" in r.configs for r in results)
