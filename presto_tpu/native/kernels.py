@@ -41,6 +41,12 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.unpack_nonnull.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
                                            ctypes.c_int64, ctypes.c_int32,
                                            ctypes.c_char_p]
+            lib.lz4_compress.restype = ctypes.c_int64
+            lib.lz4_compress.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                         ctypes.c_char_p, ctypes.c_int64]
+            lib.lz4_decompress.restype = ctypes.c_int64
+            lib.lz4_decompress.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                           ctypes.c_char_p, ctypes.c_int64]
             _lib = lib
         except OSError:
             _lib = None
@@ -65,6 +71,35 @@ def pack_nonnull(values: np.ndarray, nulls: np.ndarray) -> bytes:
                          nulls.ctypes.data_as(ctypes.c_char_p),
                          rows, width, out)
     return out.raw[: n * width]
+
+
+def lz4_available() -> bool:
+    return _load() is not None
+
+
+def lz4_compress(data: bytes) -> bytes:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native LZ4 codec unavailable (build "
+                           "presto_tpu/native)")
+    cap = len(data) + len(data) // 255 + 64
+    out = ctypes.create_string_buffer(cap)
+    n = lib.lz4_compress(data, len(data), out, cap)
+    if n < 0:
+        raise RuntimeError("lz4_compress: destination too small")
+    return out.raw[:n]
+
+
+def lz4_decompress(data: bytes, uncompressed_size: int) -> bytes:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native LZ4 codec unavailable (build "
+                           "presto_tpu/native)")
+    out = ctypes.create_string_buffer(max(uncompressed_size, 1))
+    n = lib.lz4_decompress(data, len(data), out, uncompressed_size)
+    if n != uncompressed_size:
+        raise ValueError("lz4_decompress: malformed block")
+    return out.raw[:uncompressed_size]
 
 
 def unpack_nonnull(packed: np.ndarray, nulls: np.ndarray) -> np.ndarray:

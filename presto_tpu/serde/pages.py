@@ -60,6 +60,8 @@ class PageCodec:
             return zstandard.ZstdCompressor().compress(payload)
         if self.compression == "zlib":
             return zlib.compress(payload)
+        if self.compression == "lz4":
+            return nk.lz4_compress(payload)
         raise ValueError(self.compression)
 
     def decompress(self, payload: bytes, uncompressed_size: int) -> bytes:
@@ -69,6 +71,8 @@ class PageCodec:
                 payload, max_output_size=uncompressed_size)
         if self.compression == "zlib":
             return zlib.decompress(payload)
+        if self.compression == "lz4":
+            return nk.lz4_decompress(payload, uncompressed_size)
         raise ValueError(self.compression)
 
 

@@ -1,0 +1,38 @@
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.native import kernels as nk
+from presto_tpu.serde import PageCodec, deserialize_page, serialize_page
+
+
+@pytest.mark.parametrize("case", range(6))
+def test_lz4_roundtrip(case):
+    rng = np.random.default_rng(case)
+    data = [
+        b"", b"a", b"hello world " * 1000,
+        bytes(rng.integers(0, 256, 10000, dtype=np.uint8)),
+        b"ab" * 5000,  # overlap-copy matches (offset < match length)
+        bytes(rng.integers(0, 4, 50000, dtype=np.uint8)),
+    ][case]
+    comp = nk.lz4_compress(data)
+    assert nk.lz4_decompress(comp, len(data)) == data
+
+
+def test_lz4_compresses_repetitive():
+    data = b"hello world " * 1000
+    assert len(nk.lz4_compress(data)) < len(data) // 10
+
+
+def test_lz4_rejects_malformed():
+    with pytest.raises(ValueError):
+        nk.lz4_decompress(b"\xff\xff\xff\xff", 100)
+
+
+def test_lz4_page_codec():
+    vals = np.arange(20000, dtype=np.int64) % 17
+    codec = PageCodec(compression="lz4")
+    buf = serialize_page([(T.BIGINT, vals, np.zeros(20000, bool))], codec)
+    assert len(buf) < 20000 * 8 // 3
+    out = deserialize_page(buf, [T.BIGINT], codec)
+    np.testing.assert_array_equal(out[0][0], vals)

@@ -1,0 +1,26 @@
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.connectors import tpch
+from presto_tpu.exec.streaming import run_spilled_sort
+from presto_tpu.expr import call, const, input_ref
+from presto_tpu.plan import FilterNode, OutputNode, SortNode, TableScanNode
+
+
+def test_spilled_sort_matches_oracle():
+    cols = ["orderkey", "totalprice"]
+    s = TableScanNode("tpch", "orders", cols,
+                      [tpch.column_type("orders", c) for c in cols])
+    f = FilterNode(s, call("gt", T.BOOLEAN, input_ref(1, T.decimal(15, 2)),
+                           const(50000000, T.decimal(15, 2))))
+    plan = OutputNode(SortNode(f, [(1, True, True), (0, False, True)]),
+                      ["orderkey", "totalprice"])
+    merged, nulls, names = run_spilled_sort(plan, sf=0.01, split_rows=4096)
+    oc = tpch.generate_columns("orders", 0.01, cols)
+    m = oc["totalprice"] > 50000000
+    want = sorted(zip(oc["totalprice"][m], oc["orderkey"][m]),
+                  key=lambda t: (-t[0], t[1]))
+    assert len(merged[0]) == int(m.sum())
+    got = list(zip(merged[1], merged[0]))
+    assert got == [(int(p), int(o)) for p, o in want]
+    assert names == ["orderkey", "totalprice"]
