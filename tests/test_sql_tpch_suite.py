@@ -116,6 +116,55 @@ def test_tpch_q10_shape():
     assert res.rows()[0][2] == top_rev
 
 
+def test_tpch_q18_in_subquery_having():
+    res = sql("""
+      SELECT o.custkey, o.orderkey, o.totalprice
+      FROM orders o
+      WHERE o.orderkey IN (SELECT orderkey FROM lineitem
+                           GROUP BY orderkey HAVING sum(quantity) > 210.00)
+      ORDER BY o.totalprice DESC LIMIT 20
+    """, sf=SF, max_groups=1 << 14)
+    li = tpch.generate_columns("lineitem", SF, ["orderkey", "quantity"])
+    sums = collections.Counter()
+    for ok, q in zip(li["orderkey"], li["quantity"]):
+        sums[int(ok)] += int(q)
+    big = {k for k, v in sums.items() if v > 21000}
+    oc = tpch.generate_columns("orders", SF, ["orderkey", "totalprice"])
+    want = sorted((int(p) for ok, p in zip(oc["orderkey"], oc["totalprice"])
+                   if int(ok) in big), reverse=True)[:20]
+    assert [r[2] for r in res.rows()] == want
+
+
+def test_tpch_q9_shape():
+    res = sql("""
+      SELECT n.name AS nation, sum(l.extendedprice * (1 - l.discount)) AS profit
+      FROM lineitem l
+      JOIN part p ON l.partkey = p.partkey
+      JOIN supplier s ON l.suppkey = s.suppkey
+      JOIN nation n ON s.nationkey = n.nationkey
+      WHERE p.name LIKE '%sleep%'
+      GROUP BY n.name ORDER BY profit DESC
+    """, sf=SF, max_groups=64, join_capacity=1 << 18)
+    pt = tpch.generate_columns("part", SF, ["name"])
+    li = tpch.generate_columns("lineitem", SF,
+                               ["partkey", "suppkey", "extendedprice",
+                                "discount"])
+    su = tpch.generate_columns("supplier", SF, ["suppkey", "nationkey"])
+    na = tpch.generate_columns("nation", SF, ["nationkey", "name"])
+    sleepers = np.array(["sleep" in nm for nm in pt["name"]])
+    snation = dict(zip(su["suppkey"], su["nationkey"]))
+    nname = dict(zip(na["nationkey"], na["name"]))
+    want = collections.Counter()
+    for pk, sk, p, d in zip(li["partkey"], li["suppkey"],
+                            li["extendedprice"], li["discount"]):
+        if sleepers[pk - 1]:
+            want[nname[snation[sk]]] += int(p) * (100 - int(d))
+    got = {r[0]: r[1] for r in res.rows()}
+    assert got == dict(want)
+    profits = [r[1] for r in res.rows()]
+    assert profits == sorted(profits, reverse=True)
+
+
 def test_tpch_q5_five_way_join():
     res = sql("""
       SELECT n.name, sum(l.extendedprice * (1 - l.discount)) AS revenue
