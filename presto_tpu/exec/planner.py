@@ -87,13 +87,22 @@ def compile_plan(root: N.PlanNode, mesh=None,
                              node.max_groups)
             _note_overflow(r.overflow)
             out = r.batch
-            if dist and not node.group_channels and \
-                    node.step in ("FINAL", "SINGLE"):
-                # global aggregation's guaranteed single row belongs to
-                # worker 0 only (inputs were gathered there); other
-                # workers would otherwise emit spurious empty-state rows
-                is_root = jax.lax.axis_index(axis) == 0
-                out = out.with_active(out.active & is_root)
+            if dist and not node.group_channels:
+                gathered = (isinstance(node.source, N.ExchangeNode)
+                            and node.source.kind == "GATHER"
+                            and node.source.scope == "REMOTE")
+                if node.step == "SINGLE" and not gathered:
+                    raise ValueError(
+                        "SINGLE global aggregation under a mesh would emit "
+                        "per-shard partials; run AddExchanges "
+                        "(plan.distribute) first -- run_query does this "
+                        "automatically")
+                if node.step == "FINAL" or gathered:
+                    # after a GATHER the guaranteed single row belongs to
+                    # worker 0 (where gathered rows are active); other
+                    # workers would emit spurious empty-state rows
+                    is_root = jax.lax.axis_index(axis) == 0
+                    out = out.with_active(out.active & is_root)
             return out
         if isinstance(node, N.JoinNode):
             probe = lower(node.left, inputs)

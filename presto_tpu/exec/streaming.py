@@ -132,13 +132,14 @@ def run_spilled_sort(root: N.PlanNode, sf: float, split_rows: int):
     scan = cur
 
     from ..block import to_numpy
-    from ..ops.sort import SortKey, sort_batch
     pipeline = compile_plan(node.source)
 
     @jax.jit
     def split_step(batch: Batch):
-        b, ovf = pipeline.fn((batch,))
-        return sort_batch(b, [SortKey(*k) for k in node.keys]), ovf
+        # pipeline only: runs spill unsorted, the host-side combine is a
+        # full lexsort so a device pre-sort would be wasted work (a true
+        # k-way merge of device-sorted runs is the planned upgrade)
+        return pipeline.fn((batch,))
 
     conn = catalog(scan.connector)
     total = conn.table_row_count(scan.table, sf)
@@ -160,8 +161,9 @@ def run_spilled_sort(root: N.PlanNode, sf: float, split_rows: int):
         runs.append(cols)
         run_nulls.append(nulls)
 
-    # host-side k-way merge of sorted runs (numpy lexsort on the key
-    # columns; runs already sorted so this is a stable merge in disguise)
+    # host-side combine: one lexsort over the spilled runs with
+    # tie-PRESERVING keys (equal values share a rank so later sort keys
+    # break ties, unlike positional argsort ranks)
     ncols = len(runs[0])
     merged = [np.concatenate([r[c] for r in runs]) for c in range(ncols)]
     merged_nulls = [np.concatenate([r[c] for r in run_nulls])
@@ -171,9 +173,15 @@ def run_spilled_sort(root: N.PlanNode, sf: float, split_rows: int):
         vals = merged[ch]
         nl = merged_nulls[ch]
         if vals.dtype == object:
-            vals = np.array([str(x) for x in vals])
-        order_key = np.argsort(np.argsort(vals, kind="stable"), kind="stable")
-        key = order_key.astype(np.float64)
+            svals = np.array([str(x) for x in vals])
+            _, key = np.unique(svals, return_inverse=True)
+            key = key.astype(np.float64)
+        elif np.issubdtype(vals.dtype, np.integer):
+            # longdouble's 64-bit mantissa keeps int64 keys exact while
+            # still admitting +/-inf null sentinels
+            key = vals.astype(np.longdouble)
+        else:
+            key = vals.astype(np.float64)
         if desc:
             key = -key
         key = np.where(nl, np.inf if nulls_last else -np.inf, key)

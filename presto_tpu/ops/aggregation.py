@@ -161,6 +161,20 @@ def _acc_columns(spec: AggSpec, col: Optional[Block], ids, active, max_groups: i
     if name == "count":
         return [("count", Column(nn, jnp.zeros(g, dtype=bool), T.BIGINT))]
 
+    if name == "count_distinct":
+        assert batch is not None
+        # exact: mark first occurrence of each (group, value) pair --
+        # works for any key-able type incl. strings. Pair count is
+        # bounded by the row count, so a row-count-sized table can
+        # never overflow.
+        from .misc import mark_distinct
+        sub = Batch((Column(ids, jnp.zeros_like(live), T.INTEGER), col),
+                    live)
+        first = mark_distinct(sub, [0, 1], max_groups=len(col))
+        cnt = jnp.zeros(g, dtype=jnp.int64).at[ids].add(
+            (first & live).astype(jnp.int64))
+        return [("count", Column(cnt, jnp.zeros(g, dtype=bool), T.BIGINT))]
+
     if isinstance(col, StringColumn):
         if name in ("min", "max"):
             return _minmax_string(col, ids, live, g, spec)
@@ -260,18 +274,6 @@ def _acc_columns(spec: AggSpec, col: Optional[Block], ids, active, max_groups: i
         rows_sel = perm[target]
         vals = v[rows_sel]
         return [("percentile", Column(vals, no_input, spec.output_type))]
-    if name == "count_distinct":
-        assert batch is not None
-        # exact: mark first occurrence of each (group, value) pair.
-        # pair count is bounded by the row count, so a row-count-sized
-        # table can never overflow
-        from .misc import mark_distinct
-        sub = Batch((Column(ids, jnp.zeros_like(col.nulls), T.INTEGER), col),
-                    live)
-        first = mark_distinct(sub, [0, 1], max_groups=len(col))
-        cnt = jnp.zeros(g, dtype=jnp.int64).at[ids].add(
-            (first & live).astype(jnp.int64))
-        return [("count", Column(cnt, jnp.zeros(g, dtype=bool), T.BIGINT))]
     raise NotImplementedError(f"aggregate function {spec.name!r}")
 
 

@@ -25,7 +25,29 @@ from typing import List
 
 from . import nodes as N
 
-__all__ = ["add_exchanges"]
+__all__ = ["add_exchanges", "split_single_agg"]
+
+
+def split_single_agg(agg: "N.AggregationNode",
+                     exchange_kind: str = None) -> "N.PlanNode":
+    """The one home of the SINGLE -> PARTIAL -> exchange -> FINAL rewrite
+    (layout-sensitive: FINAL's group channels are 0..nkeys-1 of the
+    exchanged partial table). exchange_kind defaults to REPARTITION by
+    keys (GATHER when global); the coordinator's simple scheduler passes
+    GATHER explicitly."""
+    partial = N.AggregationNode(agg.source, agg.group_channels,
+                                agg.aggregates, step="PARTIAL",
+                                max_groups=agg.max_groups)
+    nkeys = len(agg.group_channels)
+    kind = exchange_kind or ("REPARTITION" if nkeys else "GATHER")
+    if kind == "REPARTITION":
+        ex = N.ExchangeNode(partial, kind="REPARTITION", scope="REMOTE",
+                            partition_channels=list(range(nkeys)),
+                            slot_capacity=agg.max_groups)
+    else:
+        ex = N.ExchangeNode(partial, kind="GATHER", scope="REMOTE")
+    return N.AggregationNode(ex, list(range(nkeys)), agg.aggregates,
+                             step="FINAL", max_groups=agg.max_groups)
 
 _GATHER_OPS = (N.SortNode, N.TopNNode, N.LimitNode, N.WindowNode,
                N.RowNumberNode, N.MarkDistinctNode)
@@ -48,18 +70,19 @@ def add_exchanges(node: N.PlanNode) -> N.PlanNode:
         node = _dc.replace(node, **replaced)
 
     if isinstance(node, N.AggregationNode) and node.step == "SINGLE":
-        partial = N.AggregationNode(node.source, node.group_channels,
-                                    node.aggregates, step="PARTIAL",
-                                    max_groups=node.max_groups)
-        nkeys = len(node.group_channels)
-        if nkeys:
-            ex = N.ExchangeNode(partial, kind="REPARTITION", scope="REMOTE",
-                                partition_channels=list(range(nkeys)),
-                                slot_capacity=node.max_groups)
-        else:
-            ex = N.ExchangeNode(partial, kind="GATHER", scope="REMOTE")
-        return N.AggregationNode(ex, list(range(nkeys)), node.aggregates,
-                                 step="FINAL", max_groups=node.max_groups)
+        if any(a.canonical in ("count_distinct", "approx_percentile")
+               for a in node.aggregates):
+            # non-mergeable partials: move RAW ROWS so every group is
+            # wholly local, then aggregate in one step
+            nkeys = len(node.group_channels)
+            if nkeys:
+                ex = N.ExchangeNode(node.source, kind="REPARTITION",
+                                    scope="REMOTE",
+                                    partition_channels=list(node.group_channels))
+            else:
+                ex = N.ExchangeNode(node.source, kind="GATHER", scope="REMOTE")
+            return _dc.replace(node, source=ex)
+        return split_single_agg(node)
 
     if isinstance(node, N.DistinctNode):
         keys = node.key_channels

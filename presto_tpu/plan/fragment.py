@@ -41,15 +41,8 @@ def distribute_simple_agg(root: PlanNode) -> PlanNode:
         node = node.sources[0]
     agg = node
     assert agg.step == "SINGLE", "aggregation already distributed"
-    partial = AggregationNode(agg.source, agg.group_channels, agg.aggregates,
-                              step="PARTIAL", max_groups=agg.max_groups)
-    ex = ExchangeNode(partial, kind="GATHER", scope="REMOTE")
-    final = AggregationNode(ex, list(range(len(agg.group_channels))),
-                            agg.aggregates, step="FINAL",
-                            max_groups=agg.max_groups)
-    # FINAL consumes partial STATE columns laid out keys-first, so group
-    # channels are 0..nkeys-1 in the exchanged table
-    rebuilt = final
+    from .distribute import split_single_agg
+    rebuilt = split_single_agg(agg, exchange_kind="GATHER")
     import dataclasses as _dc
     for wrapper in reversed(post):
         rebuilt = _dc.replace(wrapper, source=rebuilt)

@@ -48,27 +48,47 @@ class Coordinator:
         assert nodes, "no alive workers in discovery"
         return [n["uri"] for n in nodes]
 
-    def _submit_with_failover(self, urls: List[str], preferred: int,
-                              task_id: str, body: dict, timeout: float
-                              ) -> Tuple[str, str]:
-        """Submit a task, failing over to the next worker on submission
-        or execution failure (deterministic splits make any attempt
-        re-runnable on any worker -- the recoverable-execution property;
-        RemoteTask's RequestErrorTracker retries analog)."""
+    def _submit(self, urls: List[str], preferred: int, task_id: str,
+                body: dict, timeout: float) -> Tuple[str, str, int]:
+        """Submit (without waiting), failing over on SUBMISSION errors.
+        Returns (url, tid, next_attempt)."""
         last_err = None
         for attempt in range(len(urls)):
             url = urls[(preferred + attempt) % len(urls)]
-            tid = task_id if attempt == 0 else f"{task_id}.r{attempt}"
+            tid = task_id if attempt == 0 else f"{task_id}.s{attempt}"
             try:
-                client = WorkerClient(url, timeout)
-                client.submit_body(tid, body)
-                info = client.wait(tid, timeout)
-                if info["state"] == "FINISHED":
-                    return url, tid
-                last_err = info.get("error")
+                WorkerClient(url, timeout).submit_body(tid, body)
+                return url, tid, attempt + 1
             except Exception as e:  # noqa: BLE001 - dead worker -> next
                 last_err = f"{type(e).__name__}: {e}"
-        raise RuntimeError(f"task {task_id} failed on every worker: {last_err}")
+        raise RuntimeError(
+            f"task {task_id} could not be submitted anywhere: {last_err}")
+
+    def _await_or_retry(self, urls: List[str], pending, body_of, timeout: float):
+        """Wait for submitted tasks (all executing concurrently); on an
+        execution failure, resubmit that task elsewhere (deterministic
+        splits make any attempt re-runnable -- the recoverable-execution
+        property; RequestErrorTracker retries analog). `pending` entries:
+        (key, url, tid, preferred). Returns {key: (url, tid)}."""
+        done = {}
+        for key, url, tid, preferred in pending:
+            attempt = 0
+            last_err = None
+            while attempt < len(urls) + 1:
+                try:
+                    info = WorkerClient(url, timeout).wait(tid, timeout)
+                    if info["state"] == "FINISHED":
+                        done[key] = (url, tid)
+                        break
+                    last_err = info.get("error")
+                except Exception as e:  # noqa: BLE001
+                    last_err = f"{type(e).__name__}: {e}"
+                attempt += 1
+                url, tid, _ = self._submit(urls, preferred + attempt,
+                                           f"{tid}.r", body_of(key), timeout)
+            else:
+                raise RuntimeError(f"task {tid} failed everywhere: {last_err}")
+        return done
 
     def execute(self, root: N.PlanNode, sf: float = 0.01,
                 timeout: float = 120.0):
@@ -92,8 +112,11 @@ class Coordinator:
             _collect_tables(frag.root, scans)
 
             if scans and not remote_nodes:
-                # leaf fragment: range-split every scan across all workers
-                tasks = []
+                # leaf fragment: range-split every scan across all
+                # workers; submit everything first so tasks execute
+                # concurrently, then await with per-task failover
+                bodies = {}
+                pending = []
                 for w in range(len(workers)):
                     ranges = {}
                     for s in scans:
@@ -101,13 +124,16 @@ class Coordinator:
                         lo = total * w // len(workers)
                         hi = total * (w + 1) // len(workers)
                         ranges[s.id] = [lo, hi - lo]
-                    tid = f"{qid}.f{frag.id}.w{w}"
-                    url, tid = self._submit_with_failover(
-                        workers, w, tid,
-                        {"plan": N.to_json(frag_plan), "sf": sf,
-                         "scanRanges": ranges}, timeout)
-                    tasks.append((url, tid))
-                produced[frag.id] = tasks
+                    body = {"plan": N.to_json(frag_plan), "sf": sf,
+                            "scanRanges": ranges}
+                    bodies[w] = body
+                    url, tid, _ = self._submit(workers, w,
+                                               f"{qid}.f{frag.id}.w{w}",
+                                               body, timeout)
+                    pending.append((w, url, tid, w))
+                done = self._await_or_retry(workers, pending,
+                                            lambda k: bodies[k], timeout)
+                produced[frag.id] = [done[w] for w in sorted(done)]
             else:
                 # downstream fragment: single task consuming every
                 # upstream task buffer (FIXED/SINGLE distribution)
@@ -118,11 +144,13 @@ class Coordinator:
                         "sources": [u for u, _ in ups],
                         "taskIds": [t for _, t in ups],
                         "types": [str(t) for t in rn.types]}
-                url, tid = self._submit_with_failover(
-                    workers, 0, f"{qid}.f{frag.id}",
-                    {"plan": N.to_json(frag_plan), "sf": sf,
-                     "remoteSources": spec}, timeout)
-                produced[frag.id] = [(url, tid)]
+                body = {"plan": N.to_json(frag_plan), "sf": sf,
+                        "remoteSources": spec}
+                url, tid, _ = self._submit(workers, 0, f"{qid}.f{frag.id}",
+                                           body, timeout)
+                done = self._await_or_retry(workers, [(0, url, tid, 0)],
+                                            lambda k: body, timeout)
+                produced[frag.id] = [done[0]]
 
         final_url, final_tid = produced[fragments[-1].id][0]
         client = WorkerClient(final_url, timeout)
