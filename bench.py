@@ -45,11 +45,52 @@ def _numpy_q1(cols, cutoff):
     return out
 
 
+def _watchdog_main() -> int:
+    """Parent mode: run the benchmark in a child process; if the child
+    produces no output within BENCH_INIT_TIMEOUT + runtime allowance
+    (the remote-TPU relay outage blocks backend init indefinitely --
+    observed in round 1; see tests/conftest.py), kill it and re-run on
+    pure CPU with the TPU plugin's site hook stripped."""
+    import subprocess
+    import sys
+
+    init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "240"))
+    run_timeout = float(os.environ.get("BENCH_RUN_TIMEOUT", "1800"))
+
+    def run(extra_env):
+        env = dict(os.environ)
+        env["BENCH_CHILD"] = "1"
+        env.update(extra_env)
+        try:
+            p = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                               capture_output=True, text=True,
+                               timeout=init_timeout + run_timeout, env=env)
+            line = [l for l in p.stdout.splitlines()
+                    if l.startswith("{")]
+            return line[-1] if line else None
+        except subprocess.TimeoutExpired:
+            return None
+
+    out = run({})
+    if out is None:
+        out = run({"JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+                   "BENCH_PLATFORM_NOTE": "cpu-fallback (tpu tunnel down)"})
+    if out is None:
+        out = json.dumps({"metric": "tpch_q1_rows_per_sec", "value": 0,
+                          "unit": "rows/s", "vs_baseline": 0,
+                          "detail": {"error": "both tpu and cpu runs hung"}})
+    print(out)
+    return 0
+
+
 def main():
     sf = float(os.environ.get("BENCH_SF", "1"))
     iters = int(os.environ.get("BENCH_ITERS", "5"))
 
     import jax
+
+    platform = os.environ.get("BENCH_PLATFORM_NOTE") or \
+        jax.devices()[0].platform
 
     from presto_tpu.connectors import tpch
     from presto_tpu.queries import Q1_COLUMNS, q1_local
@@ -96,7 +137,7 @@ def main():
             "numpy_singlecore_wall_s": round(numpy_s, 4),
             "datagen_wall_s": round(gen_s, 2),
             "rows": n,
-            "platform": jax.devices()[0].platform,
+            "platform": platform,
             "iters": iters,
         },
     }
@@ -104,4 +145,8 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+    if os.environ.get("BENCH_CHILD"):
+        main()
+    else:
+        sys.exit(_watchdog_main())
