@@ -53,15 +53,18 @@ def distribute_simple_agg(root: PlanNode) -> PlanNode:
 class PlanFragment:
     id: int
     root: PlanNode
-    # partitioning of this fragment's execution (SOURCE for leaf scans,
-    # HASH for intermediate, SINGLE/replicated for the output stage)
+    # partitioning of this fragment's OUTPUT (SINGLE for gathered,
+    # HASH for repartitioned, BROADCAST for replicated)
     partitioning: str
     # ids of fragments feeding this one through remote exchanges
     remote_sources: List[int]
+    # output-partitioning channels when partitioning == HASH
+    partition_channels: List[int] = dataclasses.field(default_factory=list)
 
     def to_json(self) -> dict:
         return {"id": self.id, "partitioning": self.partitioning,
                 "remoteSources": self.remote_sources,
+                "partitionChannels": self.partition_channels,
                 "root": to_json(self.root)}
 
 
@@ -83,7 +86,8 @@ def fragment_plan(root: PlanNode) -> List[PlanFragment]:
             child, child_feeds = walk(node.source)
             part = ("HASH" if node.kind == "REPARTITION" else
                     "BROADCAST" if node.kind == "REPLICATE" else "SINGLE")
-            frag = PlanFragment(len(fragments), child, part, child_feeds)
+            frag = PlanFragment(len(fragments), child, part, child_feeds,
+                                list(node.partition_channels))
             fragments.append(frag)
             rs = RemoteSourceNode(list(child.output_types()), frag.id)
             return rs, [frag.id]

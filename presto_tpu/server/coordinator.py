@@ -101,6 +101,7 @@ class Coordinator:
 
         # producer tasks per fragment id: list of (worker_url, task_id)
         produced: Dict[int, List[Tuple[str, str]]] = {}
+        frag_by_id = {f.id: f for f in fragments}
 
         for frag in fragments:
             frag_plan = N.OutputNode(frag.root, [
@@ -111,55 +112,78 @@ class Coordinator:
             scans: List[N.TableScanNode] = []
             _collect_tables(frag.root, scans)
 
-            if scans and not remote_nodes:
-                # leaf fragment: range-split every scan across all
-                # workers; submit everything first so tasks execute
-                # concurrently, then await with per-task failover
-                bodies = {}
-                pending = []
-                for w in range(len(workers)):
+            # a fragment whose output is HASH-partitioned emits one
+            # buffer per consumer task (PartitionedOutputBuffer analog)
+            out_part = None
+            if frag.partitioning == "HASH":
+                out_part = {"count": len(workers),
+                            "channels": frag.partition_channels}
+
+            # consumer parallelism: one task per hash partition when any
+            # upstream is HASH; otherwise a single gathered task
+            hash_ups = [rn for rn in remote_nodes
+                        if frag_by_id[rn.fragment_id].partitioning == "HASH"]
+            ntasks = len(workers) if (scans and not remote_nodes) or hash_ups \
+                else 1
+            if scans and remote_nodes and ntasks > 1:
+                raise NotImplementedError(
+                    "fragment mixes table scans with hash-partitioned remote "
+                    "sources; DAG scheduling lands with scheduler depth "
+                    "(ROADMAP)")
+
+            bodies = {}
+            pending = []
+            for w in range(ntasks):
+                body = {"plan": N.to_json(frag_plan), "sf": sf}
+                if out_part:
+                    body["outputPartitions"] = out_part
+                if scans and not remote_nodes:
                     ranges = {}
                     for s in scans:
                         total = catalog(s.connector).table_row_count(s.table, sf)
-                        lo = total * w // len(workers)
-                        hi = total * (w + 1) // len(workers)
+                        lo = total * w // ntasks
+                        hi = total * (w + 1) // ntasks
                         ranges[s.id] = [lo, hi - lo]
-                    body = {"plan": N.to_json(frag_plan), "sf": sf,
-                            "scanRanges": ranges}
-                    bodies[w] = body
-                    url, tid, _ = self._submit(workers, w,
-                                               f"{qid}.f{frag.id}.w{w}",
-                                               body, timeout)
-                    pending.append((w, url, tid, w))
-                done = self._await_or_retry(workers, pending,
-                                            lambda k: bodies[k], timeout)
-                produced[frag.id] = [done[w] for w in sorted(done)]
-            else:
-                # downstream fragment: single task consuming every
-                # upstream task buffer (FIXED/SINGLE distribution)
-                spec = {}
-                for rn in remote_nodes:
-                    ups = produced[rn.fragment_id]
-                    spec[rn.id] = {
-                        "sources": [u for u, _ in ups],
-                        "taskIds": [t for _, t in ups],
-                        "types": [str(t) for t in rn.types]}
-                body = {"plan": N.to_json(frag_plan), "sf": sf,
-                        "remoteSources": spec}
-                url, tid, _ = self._submit(workers, 0, f"{qid}.f{frag.id}",
+                    body["scanRanges"] = ranges
+                if remote_nodes:
+                    spec = {}
+                    for rn in remote_nodes:
+                        ups = produced[rn.fragment_id]
+                        entry = {"sources": [u for u, _ in ups],
+                                 "taskIds": [t for _, t in ups],
+                                 "types": [str(t) for t in rn.types]}
+                        if frag_by_id[rn.fragment_id].partitioning == "HASH":
+                            entry["bufferId"] = w
+                        spec[rn.id] = entry
+                    body["remoteSources"] = spec
+                bodies[w] = body
+                url, tid, _ = self._submit(workers, w,
+                                           f"{qid}.f{frag.id}.w{w}",
                                            body, timeout)
-                done = self._await_or_retry(workers, [(0, url, tid, 0)],
-                                            lambda k: body, timeout)
-                produced[frag.id] = [done[0]]
+                pending.append((w, url, tid, w))
+            done = self._await_or_retry(workers, pending,
+                                        lambda k: bodies[k], timeout)
+            produced[frag.id] = [done[w] for w in sorted(done)]
 
-        final_url, final_tid = produced[fragments[-1].id][0]
-        client = WorkerClient(final_url, timeout)
+        # pull + concatenate every final task's buffer (queries whose
+        # root fragment is hash-distributed return disjoint slices)
         types = fragments[-1].root.output_types()
-        cols = client.fetch_results(final_tid, types)
+        all_cols: List[List] = [[] for _ in types]
+        for url, tid in produced[fragments[-1].id]:
+            cols = WorkerClient(url, timeout).fetch_results(tid, types)
+            for c in range(len(types)):
+                all_cols[c].append(cols[c])
+        merged = []
+        for c in range(len(types)):
+            vals = np.concatenate([v for v, _ in all_cols[c]]) \
+                if all_cols[c] else np.array([])
+            nulls = np.concatenate([m for _, m in all_cols[c]]) \
+                if all_cols[c] else np.array([], dtype=bool)
+            merged.append((vals, nulls))
         names = fragments[-1].root.names \
             if isinstance(fragments[-1].root, N.OutputNode) else \
             [f"c{i}" for i in range(len(types))]
-        return cols, names
+        return merged, names
 
 
 def _collect_remote(node: N.PlanNode, out: List[N.RemoteSourceNode]):

@@ -47,13 +47,39 @@ from ..utils.config import Session
 __all__ = ["TpuWorkerServer", "TaskManager"]
 
 
+def _hash_partition_rows(res, channels: List[int], nparts: int):
+    """Destination partition per result row, using the engine's row hash
+    (expr.functions splitmix64) so routing matches on-device exchanges.
+    Returns a list of index arrays, one per partition."""
+    import numpy as np
+
+    from .. import types as _T
+    from ..block import batch_from_numpy
+    from ..expr.functions import combine_hash, hash64_block
+
+    n = res.row_count
+    if n == 0:
+        return [np.array([], dtype=np.int64)] * nparts
+    tys = [res.types[c] if res.types else _T.BIGINT for c in channels]
+    key_batch = batch_from_numpy(tys, [res.columns[c] for c in channels],
+                                 [res.nulls[c] for c in channels])
+    h = None
+    for i in range(len(channels)):
+        hc = hash64_block(key_batch.column(i))
+        h = hc if h is None else combine_hash(h, hc)
+    dest = np.asarray(h % np.uint64(nparts)).astype(np.int64)
+    return [np.nonzero(dest == p)[0] for p in range(nparts)]
+
+
 class _Task:
     def __init__(self, task_id: str):
         self.task_id = task_id
         self.state = "PLANNED"  # PLANNED -> RUNNING -> FINISHED/FAILED/ABORTED
         self.error: Optional[str] = None
-        self.pages: List[bytes] = []        # token -> page bytes
-        self.first_token = 0                # tokens < first_token are acked
+        # partition-addressed output buffers (OutputBufferId -> pages);
+        # unpartitioned results live in buffer 0
+        self.buffers: Dict[int, List[bytes]] = {0: []}
+        self.first_token: Dict[int, int] = {}  # per-buffer acked prefix
         self.no_more_pages = False
         self.created_at = time.time()
         self.stats: Dict[str, float] = {}
@@ -65,7 +91,7 @@ class _Task:
                 "taskId": self.task_id,
                 "state": self.state,
                 "error": self.error,
-                "bufferedPages": len(self.pages),
+                "bufferedPages": sum(len(p) for p in self.buffers.values()),
                 "noMorePages": self.no_more_pages,
                 "stats": dict(self.stats),
                 "elapsedSeconds": round(time.time() - self.created_at, 3),
@@ -121,7 +147,8 @@ class TaskManager:
                 remote_sources[node_id] = fetch_remote_batch(
                     spec["sources"], spec["taskIds"],
                     [parse_type(t) for t in spec["types"]],
-                    pad_multiple=pad)
+                    pad_multiple=pad,
+                    buffer_id=int(spec.get("bufferId", 0)))
             from ..exec.runner import run_query
             t0 = time.time()
             with self._exec_lock:
@@ -130,15 +157,36 @@ class TaskManager:
                                 remote_sources=remote_sources)
             wall = time.time() - t0
             types = plan.output_types()
-            cols = [(types[i], res.columns[i], res.nulls[i])
-                    for i in range(len(res.columns))]
-            page = serialize_page(cols, codec)
+            out_part = body.get("outputPartitions")
+            total_bytes = 0
+            if out_part:
+                # PartitionedOutputBuffer analog: rows hash to one page
+                # per destination partition (same hash as the engine's
+                # exchanges -> consistent routing across tiers)
+                nparts = int(out_part["count"])
+                channels = list(out_part["channels"])
+                parts = _hash_partition_rows(res, channels, nparts)
+                with task.lock:
+                    for pid in range(nparts):
+                        sel = parts[pid]
+                        cols = [(types[i], res.columns[i][sel],
+                                 res.nulls[i][sel])
+                                for i in range(len(res.columns))]
+                        page = serialize_page(cols, codec)
+                        total_bytes += len(page)
+                        task.buffers.setdefault(pid, []).append(page)
+            else:
+                cols = [(types[i], res.columns[i], res.nulls[i])
+                        for i in range(len(res.columns))]
+                page = serialize_page(cols, codec)
+                total_bytes = len(page)
+                with task.lock:
+                    task.buffers[0].append(page)
             with task.lock:
-                task.pages.append(page)
                 task.no_more_pages = True
                 task.stats = {"wallSeconds": round(wall, 4),
                               "outputRows": res.row_count,
-                              "outputBytes": len(page)}
+                              "outputBytes": total_bytes}
                 task.state = "FINISHED"
         except Exception as e:  # noqa: BLE001 - task failure is data
             with task.lock:
@@ -149,30 +197,35 @@ class TaskManager:
         with self._tasks_lock:
             return self.tasks.get(task_id)
 
-    def results(self, task_id: str, token: int):
-        """-> (page_bytes|None, next_token, complete). Tokens are absolute;
-        acked pages are dropped but their tokens remain consumed.
-        Unknown task ids raise (the HTTP layer 404s, matching the task-info
-        endpoint, so a typo'd id is distinguishable from an empty result)."""
+    def results(self, task_id: str, token: int, buffer_id: int = 0):
+        """-> (page_bytes|None, next_token, complete). Tokens are absolute
+        per buffer; acked pages are dropped but their tokens remain
+        consumed. Unknown task ids raise (the HTTP layer 404s, matching
+        the task-info endpoint, so a typo'd id is distinguishable from an
+        empty result)."""
         task = self.get(task_id)
         if task is None:
             raise KeyError(task_id)
         with task.lock:
-            idx = token - task.first_token
-            if 0 <= idx < len(task.pages):
-                return task.pages[idx], token + 1, False
+            pages = task.buffers.get(buffer_id, [])
+            first = task.first_token.get(buffer_id, 0)
+            idx = token - first
+            if 0 <= idx < len(pages):
+                return pages[idx], token + 1, False
             done = task.no_more_pages or task.state in ("FAILED", "ABORTED")
-            return None, token, done and idx >= len(task.pages)
+            return None, token, done and idx >= len(pages)
 
-    def acknowledge(self, task_id: str, token: int):
+    def acknowledge(self, task_id: str, token: int, buffer_id: int = 0):
         task = self.get(task_id)
         if task is None:
             return
         with task.lock:
-            drop = token - task.first_token
+            first = task.first_token.get(buffer_id, 0)
+            drop = token - first
+            pages = task.buffers.get(buffer_id, [])
             if drop > 0:
-                task.pages = task.pages[drop:]
-                task.first_token = token
+                task.buffers[buffer_id] = pages[drop:]
+                task.first_token[buffer_id] = token
 
     def abort(self, task_id: str):
         task = self.get(task_id)
@@ -180,7 +233,7 @@ class TaskManager:
             with task.lock:
                 if task.state not in ("FINISHED", "FAILED"):
                     task.state = "ABORTED"
-                task.pages = []
+                task.buffers = {0: []}
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -232,12 +285,13 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_json(task.info())
         if len(parts) == 7 and parts[:2] == ["v1", "task"] and \
                 parts[3] == "results" and parts[6] == "acknowledge":
-            self.manager.acknowledge(parts[2], int(parts[5]))
+            self.manager.acknowledge(parts[2], int(parts[5]), int(parts[4]))
             return self._send_json({"acknowledged": True})
         if len(parts) == 6 and parts[:2] == ["v1", "task"] and parts[3] == "results":
-            task_id, token = parts[2], int(parts[5])
+            task_id, buffer_id, token = parts[2], int(parts[4]), int(parts[5])
             try:
-                page, next_token, complete = self.manager.results(task_id, token)
+                page, next_token, complete = self.manager.results(
+                    task_id, token, buffer_id)
             except KeyError:
                 return self._send_json({"error": f"no such task {task_id}"}, 404)
             task = self.manager.get(task_id)

@@ -58,6 +58,26 @@ def test_distributed_q1_matches_local(cluster):
     assert got == want
 
 
+def test_repartitioned_exchange_across_workers(cluster):
+    """HASH exchange between worker sets: producers emit per-partition
+    buffers, N consumer tasks each pull their partition -- distributed
+    group-by without gathering to one task."""
+    from presto_tpu.plan.distribute import add_exchanges
+    sqltext = ("SELECT custkey, sum(totalprice) AS s, count(*) AS c "
+               "FROM orders GROUP BY custkey")
+    local = run_query(plan_sql(sqltext, max_groups=1 << 14), sf=0.01)
+    want = {r[0]: (int(r[1]), int(r[2])) for r in local.rows()}
+    dist = add_exchanges(plan_sql(sqltext, max_groups=1 << 14))
+    frags = fragment_plan(dist)
+    assert frags[0].partitioning == "HASH"  # repartition, not gather
+    coord = Coordinator([f"http://127.0.0.1:{w.port}" for w in cluster])
+    cols, _ = coord.execute(dist, sf=0.01)
+    got = {int(cols[0][0][i]): (int(cols[1][0][i]), int(cols[2][0][i]))
+           for i in range(len(cols[0][0]))}
+    assert got == want
+    assert len(got) == len(cols[0][0])  # partitions disjoint: no dup keys
+
+
 def test_failover_to_live_worker(cluster):
     """One configured worker URL is dead: tasks fail over to the live
     ones and the query still returns correct results (recoverable
