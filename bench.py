@@ -56,29 +56,42 @@ def _watchdog_main() -> int:
 
     init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "240"))
     run_timeout = float(os.environ.get("BENCH_RUN_TIMEOUT", "1800"))
+    errors = []
 
-    def run(extra_env):
+    def run(extra_env, timeout, probe=False):
         env = dict(os.environ)
         env["BENCH_CHILD"] = "1"
+        if probe:
+            env["BENCH_PROBE"] = "1"
         env.update(extra_env)
         try:
             p = subprocess.run([sys.executable, os.path.abspath(__file__)],
                                capture_output=True, text=True,
-                               timeout=init_timeout + run_timeout, env=env)
-            line = [l for l in p.stdout.splitlines()
-                    if l.startswith("{")]
-            return line[-1] if line else None
+                               timeout=timeout, env=env)
+            line = [l for l in p.stdout.splitlines() if l.startswith("{")]
+            if not line:
+                errors.append(f"rc={p.returncode} "
+                              f"stderr={p.stderr.strip()[-400:]}")
+                return None
+            return line[-1]
         except subprocess.TimeoutExpired:
+            errors.append(f"timed out after {timeout}s"
+                          + (" (backend init probe)" if probe else ""))
             return None
 
-    out = run({})
+    # phase 1: a cheap backend-init probe bounded by BENCH_INIT_TIMEOUT,
+    # so a wedged TPU tunnel is detected without the full run allowance
+    out = None
+    if run({}, init_timeout, probe=True) is not None:
+        out = run({}, run_timeout)
     if out is None:
         out = run({"JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
-                   "BENCH_PLATFORM_NOTE": "cpu-fallback (tpu tunnel down)"})
+                   "BENCH_PLATFORM_NOTE": "cpu-fallback (tpu tunnel down)"},
+                  run_timeout)
     if out is None:
         out = json.dumps({"metric": "tpch_q1_rows_per_sec", "value": 0,
                           "unit": "rows/s", "vs_baseline": 0,
-                          "detail": {"error": "both tpu and cpu runs hung"}})
+                          "detail": {"error": "; ".join(errors)[-500:]}})
     print(out)
     return 0
 
@@ -146,7 +159,11 @@ def main():
 
 if __name__ == "__main__":
     import sys
-    if os.environ.get("BENCH_CHILD"):
+    if os.environ.get("BENCH_PROBE"):
+        import jax
+        jax.devices()  # blocks while the tunnel is wedged; parent times out
+        print(json.dumps({"probe": "ok"}))
+    elif os.environ.get("BENCH_CHILD"):
         main()
     else:
         sys.exit(_watchdog_main())

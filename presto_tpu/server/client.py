@@ -69,10 +69,16 @@ class WorkerClient:
                       codec: PageCodec = PageCodec(), buffer_id: int = 0
                       ) -> List[Tuple[np.ndarray, np.ndarray]]:
         """Token/ack pull loop until the buffer reports complete; returns
-        concatenated (values, nulls) per column."""
+        concatenated (values, nulls) per column. Raises on deadline or on
+        HTTP 410 (pages acked away by a prior consumer attempt)."""
         token = 0
         pages = []
+        deadline = time.time() + self.timeout
         while True:
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"results of {task_id}/{buffer_id} not complete after "
+                    f"{self.timeout}s")
             data, headers = self._request(
                 "GET", f"/v1/task/{task_id}/results/{buffer_id}/{token}")
             complete = headers.get("X-Presto-Buffer-Complete") == "true"

@@ -130,6 +130,13 @@ class Coordinator:
                     "fragment mixes table scans with hash-partitioned remote "
                     "sources; DAG scheduling lands with scheduler depth "
                     "(ROADMAP)")
+            if len(scans) > 1 and ntasks > 1:
+                raise NotImplementedError(
+                    "leaf fragment contains a join between scans: range-"
+                    "splitting both sides would drop cross-slice matches "
+                    "(no all_gather across HTTP workers yet); run joins "
+                    "within a mesh slice or single-worker (ROADMAP: "
+                    "scheduler depth)")
 
             bodies = {}
             pending = []
@@ -166,19 +173,24 @@ class Coordinator:
             produced[frag.id] = [done[w] for w in sorted(done)]
 
         # pull + concatenate every final task's buffer (queries whose
-        # root fragment is hash-distributed return disjoint slices)
+        # root fragment is hash-distributed return disjoint slices);
+        # empties are skipped/typed like http_exchange to keep dtypes
         types = fragments[-1].root.output_types()
         all_cols: List[List] = [[] for _ in types]
         for url, tid in produced[fragments[-1].id]:
             cols = WorkerClient(url, timeout).fetch_results(tid, types)
             for c in range(len(types)):
-                all_cols[c].append(cols[c])
+                if len(cols[c][0]):
+                    all_cols[c].append(cols[c])
         merged = []
-        for c in range(len(types)):
-            vals = np.concatenate([v for v, _ in all_cols[c]]) \
-                if all_cols[c] else np.array([])
-            nulls = np.concatenate([m for _, m in all_cols[c]]) \
-                if all_cols[c] else np.array([], dtype=bool)
+        for c, ty in enumerate(types):
+            if all_cols[c]:
+                vals = np.concatenate([v for v, _ in all_cols[c]])
+                nulls = np.concatenate([m for _, m in all_cols[c]])
+            else:
+                vals = np.array([], dtype=object if ty.is_string
+                                else ty.to_dtype())
+                nulls = np.array([], dtype=bool)
             merged.append((vals, nulls))
         names = fragments[-1].root.names \
             if isinstance(fragments[-1].root, N.OutputNode) else \

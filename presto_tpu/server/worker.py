@@ -71,6 +71,10 @@ def _hash_partition_rows(res, channels: List[int], nparts: int):
     return [np.nonzero(dest == p)[0] for p in range(nparts)]
 
 
+class _GoneError(Exception):
+    """Requested pages were acked away by a prior consumer (HTTP 410)."""
+
+
 class _Task:
     def __init__(self, task_id: str):
         self.task_id = task_id
@@ -162,18 +166,22 @@ class TaskManager:
             if out_part:
                 # PartitionedOutputBuffer analog: rows hash to one page
                 # per destination partition (same hash as the engine's
-                # exchanges -> consistent routing across tiers)
+                # exchanges -> consistent routing across tiers).
+                # Serialize OUTSIDE the lock: status polls keep flowing.
                 nparts = int(out_part["count"])
                 channels = list(out_part["channels"])
                 parts = _hash_partition_rows(res, channels, nparts)
+                pages = []
+                for pid in range(nparts):
+                    sel = parts[pid]
+                    cols = [(types[i], res.columns[i][sel],
+                             res.nulls[i][sel])
+                            for i in range(len(res.columns))]
+                    page = serialize_page(cols, codec)
+                    total_bytes += len(page)
+                    pages.append(page)
                 with task.lock:
-                    for pid in range(nparts):
-                        sel = parts[pid]
-                        cols = [(types[i], res.columns[i][sel],
-                                 res.nulls[i][sel])
-                                for i in range(len(res.columns))]
-                        page = serialize_page(cols, codec)
-                        total_bytes += len(page)
+                    for pid, page in enumerate(pages):
                         task.buffers.setdefault(pid, []).append(page)
             else:
                 cols = [(types[i], res.columns[i], res.nulls[i])
@@ -209,8 +217,15 @@ class TaskManager:
         with task.lock:
             pages = task.buffers.get(buffer_id, [])
             first = task.first_token.get(buffer_id, 0)
+            if token < first:
+                # a prior consumer attempt acked past this token and the
+                # pages are gone; surface it (HTTP 410) so a retried
+                # consumer fails fast instead of polling forever
+                raise _GoneError(
+                    f"token {token} below acked prefix {first} of "
+                    f"{task_id}/{buffer_id}")
             idx = token - first
-            if 0 <= idx < len(pages):
+            if idx < len(pages):
                 return pages[idx], token + 1, False
             done = task.no_more_pages or task.state in ("FAILED", "ABORTED")
             return None, token, done and idx >= len(pages)
@@ -234,6 +249,7 @@ class TaskManager:
                 if task.state not in ("FINISHED", "FAILED"):
                     task.state = "ABORTED"
                 task.buffers = {0: []}
+                task.first_token = {}
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -294,6 +310,8 @@ class _Handler(BaseHTTPRequestHandler):
                     task_id, token, buffer_id)
             except KeyError:
                 return self._send_json({"error": f"no such task {task_id}"}, 404)
+            except _GoneError as e:
+                return self._send_json({"error": str(e)}, 410)
             task = self.manager.get(task_id)
             if task is not None and task.state == "FAILED":
                 return self._send_json({"error": task.error}, 500)
