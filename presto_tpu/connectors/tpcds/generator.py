@@ -48,6 +48,20 @@ TPCDS_SCHEMA: Dict[str, List[Tuple[str, T.Type]]] = {
         ("i_category", T.varchar(50)), ("i_manager_id", T.INTEGER),
         ("i_current_price", _D72),
     ],
+    "catalog_sales": [
+        ("cs_sold_date_sk", T.BIGINT), ("cs_item_sk", T.BIGINT),
+        ("cs_bill_customer_sk", T.BIGINT), ("cs_quantity", T.INTEGER),
+        ("cs_list_price", _D72), ("cs_sales_price", _D72),
+        ("cs_ext_sales_price", _D72), ("cs_net_profit", _D72),
+        ("cs_order_number", T.BIGINT),
+    ],
+    "web_sales": [
+        ("ws_sold_date_sk", T.BIGINT), ("ws_item_sk", T.BIGINT),
+        ("ws_bill_customer_sk", T.BIGINT), ("ws_quantity", T.INTEGER),
+        ("ws_list_price", _D72), ("ws_sales_price", _D72),
+        ("ws_ext_sales_price", _D72), ("ws_net_profit", _D72),
+        ("ws_order_number", T.BIGINT),
+    ],
     "customer": [
         ("c_customer_sk", T.BIGINT), ("c_customer_id", T.varchar(16)),
         ("c_current_addr_sk", T.BIGINT), ("c_first_name", T.varchar(20)),
@@ -79,6 +93,10 @@ _STATES = ["TN", "CA", "TX", "NY", "WA", "GA", "OH", "IL"]
 def table_row_count(table: str, sf: float) -> int:
     if table == "store_sales":
         return int(2_880_000 * sf)
+    if table == "catalog_sales":
+        return int(1_440_000 * sf)
+    if table == "web_sales":
+        return int(720_000 * sf)
     if table == "date_dim":
         return _DATE_ROWS
     if table == "item":
@@ -244,9 +262,48 @@ def _gen_store(column, idx, sf):
     raise KeyError(f"store.{column}")
 
 
+def _make_channel_gen(table: str, prefix: str, lines_per_order: int):
+    """catalog_sales / web_sales share store_sales' shape with their own
+    column prefixes and hash streams."""
+
+    def gen(column, idx, sf):
+        n_item = table_row_count("item", sf)
+        n_cust = table_row_count("customer", sf)
+        base = column[len(prefix):]
+        if base == "sold_date_sk":
+            d = _uniform(table, "sold", idx, _SOLD_LO, _SOLD_HI)
+            return d + _SK_BASE
+        if base == "item_sk":
+            return _uniform(table, "item", idx, 1, n_item)
+        if base == "bill_customer_sk":
+            return _uniform(table, "cust", idx, 1, n_cust)
+        if base == "quantity":
+            return _uniform(table, "qty", idx, 1, 100).astype(np.int32)
+        if base == "list_price":
+            return _uniform(table, "list", idx, 100, 20000)
+        if base == "sales_price":
+            lp = _uniform(table, "list", idx, 100, 20000)
+            disc = _uniform(table, "sdisc", idx, 0, 100)
+            return (lp * (100 - disc) // 100).astype(np.int64)
+        if base == "ext_sales_price":
+            qty = _uniform(table, "qty", idx, 1, 100)
+            lp = _uniform(table, "list", idx, 100, 20000)
+            disc = _uniform(table, "sdisc", idx, 0, 100)
+            return (qty * (lp * (100 - disc) // 100)).astype(np.int64)
+        if base == "net_profit":
+            return _uniform(table, "profit", idx, -500000, 900000)
+        if base == "order_number":
+            return (idx // lines_per_order + 1).astype(np.int64)
+        raise KeyError(f"{table}.{column}")
+
+    return gen
+
+
 _GENERATORS = {
     "store_sales": _gen_store_sales, "date_dim": _gen_date_dim,
     "item": _gen_item, "customer": _gen_customer, "store": _gen_store,
+    "catalog_sales": _make_channel_gen("catalog_sales", "cs_", 10),
+    "web_sales": _make_channel_gen("web_sales", "ws_", 12),
 }
 
 

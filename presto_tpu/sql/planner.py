@@ -191,6 +191,16 @@ class _Analyzer:
         name = node.name
         args = [self.lower(a, scope) for a in node.args
                 if not isinstance(a, P.Star)]
+        # special forms spelled as functions
+        if name == "coalesce":
+            rty = next((a.type for a in args if a.type != T.UNKNOWN),
+                       T.UNKNOWN)
+            return E.special("COALESCE", rty, *args)
+        if name == "nullif":
+            return E.special("NULL_IF", args[0].type, *args)
+        if name == "if":
+            rty = args[1].type
+            return E.special("IF", rty, *args)
         rty = self._func_type(name, args)
         return E.call(name, rty, *args)
 

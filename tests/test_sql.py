@@ -173,6 +173,15 @@ def test_case_and_like():
     assert got[1] == len(pc["type"])
 
 
+def test_coalesce_nullif_if_functions():
+    res = sql("SELECT coalesce(nullif(regionkey, 0), 99), "
+              "if(regionkey > 2, 1, 0) FROM region ORDER BY 1")
+    rows = res.rows()
+    vals = sorted(r[0] for r in rows)
+    assert vals == [1, 2, 3, 4, 99]  # regionkey 0 -> NULL -> 99
+    assert sorted(r[1] for r in rows) == [0, 0, 0, 1, 1]
+
+
 def test_explain_sql_plan():
     p = plan_sql("SELECT custkey, count(*) FROM orders GROUP BY custkey")
     text = explain(p)

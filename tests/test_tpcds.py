@@ -87,6 +87,29 @@ def test_tpcds_q3_family():
         assert (a[0], -a[3]) <= (b[0], -b[3])
 
 
+def test_cross_channel_union():
+    # q-family shape: revenue per item across store+catalog+web channels
+    res = sql("""
+      SELECT ss_item_sk AS item, ss_ext_sales_price AS rev FROM store_sales
+      UNION ALL
+      SELECT cs_item_sk, cs_ext_sales_price FROM catalog_sales
+      UNION ALL
+      SELECT ws_item_sk, ws_ext_sales_price FROM web_sales
+    """, sf=0.005)
+    total = (tpcds.table_row_count("store_sales", 0.005)
+             + tpcds.table_row_count("catalog_sales", 0.005)
+             + tpcds.table_row_count("web_sales", 0.005))
+    assert res.row_count == total
+    ss = tpcds.generate_columns("store_sales", 0.005, ["ss_ext_sales_price"])
+    cs = tpcds.generate_columns("catalog_sales", 0.005, ["cs_ext_sales_price"])
+    ws = tpcds.generate_columns("web_sales", 0.005, ["ws_ext_sales_price"])
+    want = (int(ss["ss_ext_sales_price"].sum())
+            + int(cs["cs_ext_sales_price"].sum())
+            + int(ws["ws_ext_sales_price"].sum()))
+    got = sum(int(r[1]) for r in res.rows())
+    assert got == want
+
+
 def test_tpcds_q52_shape():
     res = sql("""
       SELECT d.d_year, i.i_brand_id, sum(ss.ss_ext_sales_price) AS price
