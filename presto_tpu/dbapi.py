@@ -1,0 +1,166 @@
+"""PEP-249-style DBAPI: the presto-jdbc / presto-client analog for
+Python programs.
+
+Reference surface: presto-jdbc (PrestoDriver/PrestoConnection/
+PrestoStatement over the REST client protocol) and presto-client's
+StatementClientV1. Local mode executes in-process; server mode will ride
+the worker/coordinator HTTP protocol once the client protocol endpoint
+lands (ROADMAP).
+
+    import presto_tpu.dbapi as db
+    conn = db.connect(sf=0.1)
+    cur = conn.cursor()
+    cur.execute("SELECT custkey, count(*) FROM orders GROUP BY custkey")
+    print(cur.fetchmany(5))
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+apilevel = "2.0"
+threadsafety = 1
+paramstyle = "qmark"
+
+__all__ = ["connect", "Connection", "Cursor", "Error", "ProgrammingError"]
+
+
+class Error(Exception):
+    pass
+
+
+class ProgrammingError(Error):
+    pass
+
+
+def connect(sf: float = 0.01, mesh=None, max_groups: int = 1 << 16,
+            **kwargs) -> "Connection":
+    return Connection(sf=sf, mesh=mesh, max_groups=max_groups, **kwargs)
+
+
+class Connection:
+    def __init__(self, sf: float, mesh=None, max_groups: int = 1 << 16,
+                 **kwargs):
+        self.sf = sf
+        self.mesh = mesh
+        self.max_groups = max_groups
+        self.kwargs = kwargs
+        self._closed = False
+
+    def cursor(self) -> "Cursor":
+        if self._closed:
+            raise ProgrammingError("connection is closed")
+        return Cursor(self)
+
+    def close(self):
+        self._closed = True
+
+    def commit(self):
+        pass  # autocommit; writes land with the table-writer path
+
+    def rollback(self):
+        raise ProgrammingError("transactions are not supported")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class Cursor:
+    arraysize = 1
+
+    def __init__(self, conn: Connection):
+        self.conn = conn
+        self._rows: Optional[List[tuple]] = None
+        self._pos = 0
+        self.description = None
+        self.rowcount = -1
+
+    def execute(self, sql_text: str, parameters: Sequence[Any] = ()):
+        if self.conn._closed:
+            raise ProgrammingError("connection is closed")
+        if parameters:
+            sql_text = _bind(sql_text, parameters)
+        from .sql import sql as run_sql
+        try:
+            res = run_sql(sql_text, sf=self.conn.sf, mesh=self.conn.mesh,
+                          max_groups=self.conn.max_groups, **self.conn.kwargs)
+        except Error:
+            raise
+        except Exception as e:  # noqa: BLE001 - DBAPI error contract
+            raise ProgrammingError(str(e)) from e
+        self._rows = res.rows()
+        self._pos = 0
+        self.rowcount = res.row_count
+        self.description = [
+            (res.names[i], str(res.types[i]) if res.types else None,
+             None, None, None, None, None)
+            for i in range(len(res.names))]
+        return self
+
+    def executemany(self, sql_text: str, seq_of_params):
+        for p in seq_of_params:
+            self.execute(sql_text, p)
+        return self
+
+    def fetchone(self) -> Optional[tuple]:
+        self._check()
+        if self._pos >= len(self._rows):
+            return None
+        row = self._rows[self._pos]
+        self._pos += 1
+        return row
+
+    def fetchmany(self, size: Optional[int] = None) -> List[tuple]:
+        self._check()
+        size = size or self.arraysize
+        out = self._rows[self._pos:self._pos + size]
+        self._pos += len(out)
+        return out
+
+    def fetchall(self) -> List[tuple]:
+        self._check()
+        out = self._rows[self._pos:]
+        self._pos = len(self._rows)
+        return out
+
+    def close(self):
+        self._rows = None
+
+    def _check(self):
+        if self._rows is None:
+            raise ProgrammingError("no result set; call execute() first")
+
+    def __iter__(self):
+        self._check()
+        while True:
+            row = self.fetchone()
+            if row is None:
+                return
+            yield row
+
+
+def _bind(sql_text: str, parameters: Sequence[Any]) -> str:
+    parts = sql_text.split("?")
+    if len(parts) - 1 != len(parameters):
+        raise ProgrammingError(
+            f"{len(parts) - 1} placeholders but {len(parameters)} parameters")
+    out = []
+    for i, part in enumerate(parts):
+        out.append(part)
+        if i < len(parameters):
+            out.append(_quote(parameters[i]))
+    return "".join(out)
+
+
+def _quote(v: Any) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    s = str(v).replace("'", "''")
+    return f"'{s}'"

@@ -1,0 +1,39 @@
+import pytest
+
+import presto_tpu.dbapi as db
+
+
+def test_basic_cursor_flow():
+    with db.connect(sf=0.01) as conn:
+        cur = conn.cursor()
+        cur.execute("SELECT nationkey, name FROM nation ORDER BY nationkey")
+        assert cur.rowcount == 25
+        assert cur.description[0][0] == "nationkey"
+        first = cur.fetchone()
+        assert first[0] == 0 and first[1] == "ALGERIA"
+        some = cur.fetchmany(3)
+        assert [r[0] for r in some] == [1, 2, 3]
+        rest = cur.fetchall()
+        assert len(rest) == 21
+        assert cur.fetchone() is None
+
+
+def test_parameters_bind():
+    cur = db.connect(sf=0.01).cursor()
+    cur.execute("SELECT count(*) FROM nation WHERE regionkey = ? "
+                "AND name <> ?", (3, "x'y"))
+    assert cur.fetchone()[0] == 5
+
+
+def test_iteration_and_errors():
+    conn = db.connect(sf=0.01)
+    cur = conn.cursor()
+    with pytest.raises(db.ProgrammingError):
+        cur.fetchall()
+    with pytest.raises(db.ProgrammingError):
+        cur.execute("SELECT nope FROM nation")
+    cur.execute("SELECT regionkey FROM region")
+    assert sorted(r[0] for r in cur) == [0, 1, 2, 3, 4]
+    conn.close()
+    with pytest.raises(db.ProgrammingError):
+        conn.cursor()
