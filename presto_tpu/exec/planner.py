@@ -107,7 +107,11 @@ def compile_plan(root: N.PlanNode, mesh=None,
         if isinstance(node, N.JoinNode):
             probe = lower(node.left, inputs)
             build = lower(node.right, inputs)
-            if dist and node.distribution == "broadcast":
+            right_replicated = (isinstance(node.right, N.ExchangeNode)
+                                and node.right.kind == "REPLICATE"
+                                and node.right.scope == "REMOTE")
+            if dist and node.distribution == "broadcast" \
+                    and not right_replicated:  # exchange already gathered
                 build = broadcast_build(build, axis)
             cap = node.out_capacity or default_join_capacity
             r = hash_join(probe, build, node.left_keys, node.right_keys,
@@ -117,7 +121,10 @@ def compile_plan(root: N.PlanNode, mesh=None,
         if isinstance(node, N.SemiJoinNode):
             src = lower(node.source, inputs)
             filt = lower(node.filtering_source, inputs)
-            if dist:
+            filt_replicated = (isinstance(node.filtering_source, N.ExchangeNode)
+                               and node.filtering_source.kind == "REPLICATE"
+                               and node.filtering_source.scope == "REMOTE")
+            if dist and not filt_replicated:
                 filt = broadcast_build(filt, axis)
             sk = node.source_key if isinstance(node.source_key, list) \
                 else [node.source_key]

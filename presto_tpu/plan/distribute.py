@@ -100,8 +100,23 @@ def add_exchanges(node: N.PlanNode) -> N.PlanNode:
             return _dc.replace(node, source=ex)
         return node
 
-    if isinstance(node, N.JoinNode) and node.distribution != "broadcast":
-        # round-1 SPMD join strategy: replicate the build side
-        return _dc.replace(node, distribution="broadcast")
+    if isinstance(node, N.JoinNode):
+        # round-1 distribution strategy: replicate the build side via an
+        # explicit REMOTE REPLICATE exchange (the mesh tier lowers it to
+        # all_gather; the HTTP tier cuts a fragment whose one buffer all
+        # consumers pull). distribution flips to broadcast so lowering
+        # knows the build side is complete on every worker.
+        right = node.right
+        if not (isinstance(right, N.ExchangeNode)
+                and right.kind == "REPLICATE"):
+            right = N.ExchangeNode(right, kind="REPLICATE", scope="REMOTE")
+        return _dc.replace(node, right=right, distribution="broadcast")
+
+    if isinstance(node, N.SemiJoinNode):
+        filt = node.filtering_source
+        if not (isinstance(filt, N.ExchangeNode)
+                and filt.kind == "REPLICATE"):
+            filt = N.ExchangeNode(filt, kind="REPLICATE", scope="REMOTE")
+        return _dc.replace(node, filtering_source=filt)
 
     return node

@@ -120,23 +120,23 @@ class Coordinator:
                             "channels": frag.partition_channels}
 
             # consumer parallelism: one task per hash partition when any
-            # upstream is HASH; otherwise a single gathered task
+            # upstream is HASH; scans also fan out (range splits).
+            # BROADCAST upstreams are compatible with both -- every task
+            # pulls the full replicated buffer set.
             hash_ups = [rn for rn in remote_nodes
                         if frag_by_id[rn.fragment_id].partitioning == "HASH"]
-            ntasks = len(workers) if (scans and not remote_nodes) or hash_ups \
-                else 1
-            if scans and remote_nodes and ntasks > 1:
+            ntasks = len(workers) if (scans or hash_ups) else 1
+            if scans and hash_ups:
                 raise NotImplementedError(
-                    "fragment mixes table scans with hash-partitioned remote "
-                    "sources; DAG scheduling lands with scheduler depth "
-                    "(ROADMAP)")
+                    "fragment mixes range-split table scans with hash-"
+                    "partitioned remote sources; DAG scheduling lands with "
+                    "scheduler depth (ROADMAP)")
             if len(scans) > 1 and ntasks > 1:
                 raise NotImplementedError(
                     "leaf fragment contains a join between scans: range-"
-                    "splitting both sides would drop cross-slice matches "
-                    "(no all_gather across HTTP workers yet); run joins "
-                    "within a mesh slice or single-worker (ROADMAP: "
-                    "scheduler depth)")
+                    "splitting both sides would drop cross-slice matches; "
+                    "run add_exchanges so build sides become REPLICATE "
+                    "fragments (or execute single-worker)")
 
             bodies = {}
             pending = []
@@ -144,7 +144,7 @@ class Coordinator:
                 body = {"plan": N.to_json(frag_plan), "sf": sf}
                 if out_part:
                     body["outputPartitions"] = out_part
-                if scans and not remote_nodes:
+                if scans:
                     ranges = {}
                     for s in scans:
                         total = catalog(s.connector).table_row_count(s.table, sf)

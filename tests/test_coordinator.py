@@ -78,6 +78,29 @@ def test_repartitioned_exchange_across_workers(cluster):
     assert len(got) == len(cols[0][0])  # partitions disjoint: no dup keys
 
 
+def test_distributed_broadcast_join_dag(cluster):
+    """Join DAG over HTTP workers: the build side becomes a REPLICATE
+    fragment whose buffers every probe task pulls; probe scans range-
+    split; aggregation repartitions; TopN gathers -- four fragments."""
+    sqltext = """
+      SELECT c.mktsegment, count(*) AS cnt, sum(o.totalprice) AS s
+      FROM orders o JOIN customer c ON o.custkey = c.custkey
+      GROUP BY c.mktsegment ORDER BY cnt DESC LIMIT 3
+    """
+    from presto_tpu.plan.distribute import add_exchanges
+    local = run_query(plan_sql(sqltext, max_groups=64), sf=0.01)
+    want = [(r[0], r[1], r[2]) for r in local.rows()]
+    dist = add_exchanges(plan_sql(sqltext, max_groups=64))
+    frags = fragment_plan(dist)
+    assert len(frags) >= 3
+    assert any(f.partitioning == "BROADCAST" for f in frags)
+    coord = Coordinator([f"http://127.0.0.1:{w.port}" for w in cluster])
+    cols, names = coord.execute(dist, sf=0.01)
+    got = [(cols[0][0][i], int(cols[1][0][i]), int(cols[2][0][i]))
+           for i in range(len(cols[0][0]))]
+    assert got == want
+
+
 def test_failover_to_live_worker(cluster):
     """One configured worker URL is dead: tasks fail over to the live
     ones and the query still returns correct results (recoverable
