@@ -83,7 +83,8 @@ def _watchdog_main() -> int:
     # so a wedged TPU tunnel is detected without the full run allowance
     out = None
     if run({}, init_timeout, probe=True) is not None:
-        out = run({}, run_timeout)
+        # the real child re-pays backend init in its own process
+        out = run({}, init_timeout + run_timeout)
     if out is None:
         out = run({"JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
                    "BENCH_PLATFORM_NOTE": "cpu-fallback (tpu tunnel down)"},

@@ -143,15 +143,36 @@ class Cursor:
 
 
 def _bind(sql_text: str, parameters: Sequence[Any]) -> str:
-    parts = sql_text.split("?")
-    if len(parts) - 1 != len(parameters):
-        raise ProgrammingError(
-            f"{len(parts) - 1} placeholders but {len(parameters)} parameters")
+    """qmark substitution that ignores '?' inside string literals."""
     out = []
-    for i, part in enumerate(parts):
-        out.append(part)
-        if i < len(parameters):
-            out.append(_quote(parameters[i]))
+    pi = 0
+    in_str = False
+    i = 0
+    while i < len(sql_text):
+        ch = sql_text[i]
+        if in_str:
+            out.append(ch)
+            if ch == "'":
+                if i + 1 < len(sql_text) and sql_text[i + 1] == "'":
+                    out.append("'")
+                    i += 1  # escaped quote stays inside the literal
+                else:
+                    in_str = False
+        elif ch == "'":
+            in_str = True
+            out.append(ch)
+        elif ch == "?":
+            if pi >= len(parameters):
+                raise ProgrammingError(
+                    f"more placeholders than parameters ({len(parameters)})")
+            out.append(_quote(parameters[pi]))
+            pi += 1
+        else:
+            out.append(ch)
+        i += 1
+    if pi != len(parameters):
+        raise ProgrammingError(
+            f"{pi} placeholders but {len(parameters)} parameters")
     return "".join(out)
 
 

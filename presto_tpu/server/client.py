@@ -66,7 +66,8 @@ class WorkerClient:
         raise TimeoutError(f"task {task_id} still {state}")
 
     def fetch_results(self, task_id: str, types: Sequence[T.Type],
-                      codec: PageCodec = PageCodec(), buffer_id: int = 0
+                      codec: PageCodec = PageCodec(), buffer_id: int = 0,
+                      ack: bool = True
                       ) -> List[Tuple[np.ndarray, np.ndarray]]:
         """Token/ack pull loop until the buffer reports complete; returns
         concatenated (values, nulls) per column. Raises on deadline or on
@@ -85,9 +86,10 @@ class WorkerClient:
             next_token = int(headers.get("X-Presto-Page-Next-Token", token))
             if data:
                 pages.append(deserialize_page(data, types, codec))
-                self._request(
-                    "GET",
-                    f"/v1/task/{task_id}/results/{buffer_id}/{next_token}/acknowledge")
+                if ack:
+                    self._request(
+                        "GET",
+                        f"/v1/task/{task_id}/results/{buffer_id}/{next_token}/acknowledge")
                 token = next_token
             elif complete:
                 break

@@ -125,17 +125,26 @@ class Coordinator:
             # pulls the full replicated buffer set.
             hash_ups = [rn for rn in remote_nodes
                         if frag_by_id[rn.fragment_id].partitioning == "HASH"]
-            ntasks = len(workers) if (scans or hash_ups) else 1
+            single_ups = [rn for rn in remote_nodes
+                          if frag_by_id[rn.fragment_id].partitioning == "SINGLE"]
             if scans and hash_ups:
                 raise NotImplementedError(
                     "fragment mixes range-split table scans with hash-"
                     "partitioned remote sources; DAG scheduling lands with "
                     "scheduler depth (ROADMAP)")
-            if len(scans) > 1 and ntasks > 1:
+            # a SINGLE (gathered) upstream must not be duplicated by a
+            # scan fan-out: run the whole fragment as one task (correct,
+            # just not scan-parallel)
+            if scans and single_ups:
+                ntasks = 1
+            else:
+                ntasks = len(workers) if (scans or hash_ups) else 1
+            has_join = _contains_join(frag.root)
+            if len(scans) > 1 and ntasks > 1 and has_join:
                 raise NotImplementedError(
-                    "leaf fragment contains a join between scans: range-"
-                    "splitting both sides would drop cross-slice matches; "
-                    "run add_exchanges so build sides become REPLICATE "
+                    "leaf fragment joins two scans: range-splitting both "
+                    "sides would drop cross-slice matches; run "
+                    "add_exchanges so build sides become REPLICATE "
                     "fragments (or execute single-worker)")
 
             bodies = {}
@@ -159,8 +168,13 @@ class Coordinator:
                         entry = {"sources": [u for u, _ in ups],
                                  "taskIds": [t for _, t in ups],
                                  "types": [str(t) for t in rn.types]}
-                        if frag_by_id[rn.fragment_id].partitioning == "HASH":
+                        up_part = frag_by_id[rn.fragment_id].partitioning
+                        if up_part == "HASH":
                             entry["bufferId"] = w
+                        if up_part == "BROADCAST" and ntasks > 1:
+                            # shared buffer read by N consumers: reads
+                            # must be non-destructive (no token acks)
+                            entry["ack"] = False
                         spec[rn.id] = entry
                     body["remoteSources"] = spec
                 bodies[w] = body
@@ -196,6 +210,12 @@ class Coordinator:
             if isinstance(fragments[-1].root, N.OutputNode) else \
             [f"c{i}" for i in range(len(types))]
         return merged, names
+
+
+def _contains_join(node: N.PlanNode) -> bool:
+    if isinstance(node, (N.JoinNode, N.SemiJoinNode)):
+        return True
+    return any(_contains_join(s) for s in node.sources)
 
 
 def _collect_remote(node: N.PlanNode, out: List[N.RemoteSourceNode]):

@@ -29,7 +29,8 @@ def fetch_remote_batch(sources: Sequence[str], task_ids: Sequence[str],
                        capacity: Optional[int] = None,
                        timeout: float = 60.0,
                        pad_multiple: int = 8,
-                       buffer_id: int = 0) -> Batch:
+                       buffer_id: int = 0,
+                       ack: bool = True) -> Batch:
     """Pull every page of `task_ids[i]` from worker base-url `sources[i]`,
     concatenate, and stage as one device Batch -- the RemoteSourceNode
     feed for a fragment whose upstream ran on other workers/slices."""
@@ -44,7 +45,8 @@ def fetch_remote_batch(sources: Sequence[str], task_ids: Sequence[str],
             # silently partial result (RemoteTask error propagation)
             raise RuntimeError(f"upstream task {tid} at {base} is "
                               f"{info['state']}: {info.get('error')}")
-        cols = client.fetch_results(tid, types, codec, buffer_id=buffer_id)
+        cols = client.fetch_results(tid, types, codec, buffer_id=buffer_id,
+                                    ack=ack)
         n = len(cols[0][0]) if cols else 0
         total += n
         for c, (v, m) in enumerate(cols):

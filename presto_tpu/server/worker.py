@@ -152,7 +152,8 @@ class TaskManager:
                     spec["sources"], spec["taskIds"],
                     [parse_type(t) for t in spec["types"]],
                     pad_multiple=pad,
-                    buffer_id=int(spec.get("bufferId", 0)))
+                    buffer_id=int(spec.get("bufferId", 0)),
+                    ack=bool(spec.get("ack", True)))
             from ..exec.runner import run_query
             t0 = time.time()
             with self._exec_lock:

@@ -78,6 +78,47 @@ def test_repartitioned_exchange_across_workers(cluster):
     assert len(got) == len(cols[0][0])  # partitions disjoint: no dup keys
 
 
+def test_union_of_scans_range_splits(cluster):
+    # multi-scan UNION leaf fragments must still fan out (no join)
+    sqltext = ("SELECT custkey FROM orders UNION ALL "
+               "SELECT custkey FROM customer")
+    local = run_query(plan_sql(sqltext), sf=0.01)
+    coord = Coordinator([f"http://127.0.0.1:{w.port}" for w in cluster])
+    cols, _ = coord.execute(plan_sql(sqltext), sf=0.01)
+    import collections
+    got = collections.Counter(int(v) for v in cols[0][0])
+    want = collections.Counter(int(r[0]) for r in local.rows())
+    assert got == want
+
+
+def test_single_upstream_with_scan_runs_unduplicated(cluster):
+    # a gathered (SINGLE) upstream feeding a scan fragment must not be
+    # duplicated by scan fan-out: the fragment collapses to one task
+    from presto_tpu import types as T
+    from presto_tpu.connectors import tpch as tpch_conn
+    from presto_tpu.plan import (ExchangeNode, OutputNode, TableScanNode,
+                                 TopNNode, UnionNode)
+    cust = TableScanNode("tpch", "customer", ["custkey"],
+                         [tpch_conn.column_type("customer", "custkey")])
+    orders = TableScanNode("tpch", "orders", ["custkey", "totalprice"],
+                           [tpch_conn.column_type("orders", "custkey"),
+                            tpch_conn.column_type("orders", "totalprice")])
+    from presto_tpu.expr import input_ref
+    from presto_tpu.plan import ProjectNode
+    inner = ExchangeNode(orders, kind="GATHER", scope="REMOTE")
+    top = ProjectNode(TopNNode(inner, [(1, True, True)], 10),
+                      [input_ref(0, T.BIGINT)])
+    gathered = ExchangeNode(top, kind="GATHER", scope="REMOTE")
+    plan = OutputNode(UnionNode([cust, gathered]), ["custkey"])
+    local = run_query(plan, sf=0.01)
+    import collections
+    want = collections.Counter(int(r[0]) for r in local.rows())
+    coord = Coordinator([f"http://127.0.0.1:{w.port}" for w in cluster])
+    cols, _ = coord.execute(plan, sf=0.01)
+    got = collections.Counter(int(v) for v in cols[0][0])
+    assert got == want  # the 10 gathered rows appear exactly once
+
+
 def test_distributed_broadcast_join_dag(cluster):
     """Join DAG over HTTP workers: the build side becomes a REPLICATE
     fragment whose buffers every probe task pulls; probe scans range-

@@ -25,6 +25,15 @@ def test_parameters_bind():
     assert cur.fetchone()[0] == 5
 
 
+def test_question_mark_inside_literal():
+    cur = db.connect(sf=0.01).cursor()
+    cur.execute("SELECT count(*) FROM nation WHERE name <> 'A?' "
+                "AND regionkey = ?", (1,))
+    assert cur.fetchone()[0] == 5
+    with pytest.raises(db.ProgrammingError):
+        cur.execute("SELECT ? FROM nation", ())
+
+
 def test_iteration_and_errors():
     conn = db.connect(sf=0.01)
     cur = conn.cursor()
