@@ -16,7 +16,7 @@ lands (ROADMAP).
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Any, List, Optional, Sequence
 
 apilevel = "2.0"
 threadsafety = 1

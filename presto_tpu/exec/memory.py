@@ -19,8 +19,6 @@ import dataclasses
 import threading
 from typing import Dict, Optional
 
-import numpy as np
-
 from ..block import Batch, DictionaryColumn, StringColumn
 
 __all__ = ["MemoryPool", "MemoryContext", "MemoryReservationError",

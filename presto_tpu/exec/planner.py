@@ -22,7 +22,7 @@ spill feedback loop of the reference's Driver yield + revoke).
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -30,7 +30,7 @@ from jax.sharding import PartitionSpec as P
 
 from .. import types as T
 from ..block import Batch
-from ..expr.compile import compile_filter, compile_projections, evaluate
+from ..expr.compile import compile_filter, compile_projections
 from ..ops.aggregation import group_by, merge_partials
 from ..ops.join import hash_join, semi_join_mask
 from ..ops.misc import distinct as distinct_op

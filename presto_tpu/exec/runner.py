@@ -15,7 +15,7 @@ without changing lowered kernels.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -23,7 +23,7 @@ import numpy as np
 from .. import types as T
 from ..block import Batch, batch_from_numpy, to_numpy
 from ..plan import nodes as N
-from .planner import CompiledPlan, compile_plan
+from .planner import compile_plan
 from .stats import RuntimeStats
 
 __all__ = ["run_query", "QueryResult"]

@@ -14,7 +14,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict
 
 __all__ = ["RuntimeStats", "timed"]
 

@@ -26,7 +26,7 @@ import numpy as np
 
 from ..block import Batch, concat_batches
 from ..connectors import catalog
-from ..ops.aggregation import AggSpec, GroupByResult, group_by, merge_partials
+from ..ops.aggregation import GroupByResult, group_by, merge_partials
 from ..plan import nodes as N
 from .planner import compile_plan
 

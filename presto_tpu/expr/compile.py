@@ -24,7 +24,7 @@ constant-folding these in LocalExecutionPlanner/bytecode gen.
 from __future__ import annotations
 
 import functools
-from typing import Callable, List, Sequence, Union
+from typing import Callable, Sequence, Union
 
 import jax.numpy as jnp
 import numpy as np

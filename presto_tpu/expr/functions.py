@@ -23,7 +23,7 @@ Precisions that exceed 18 keep int64 device representation in round 1
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict, Optional, Sequence, Union
+from typing import Callable, Dict, Optional, Union
 
 import jax
 import jax.numpy as jnp

@@ -21,7 +21,7 @@ enough that a protocol adapter can translate mechanically:
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional, Sequence, Tuple
+from typing import Any, Tuple
 
 from .. import types as T
 

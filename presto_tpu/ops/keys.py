@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..block import Batch, Block, Column, DictionaryColumn, StringColumn
+from ..block import Block, Column, DictionaryColumn, StringColumn
 
 _SIGN = np.uint64(1 << 63)
 

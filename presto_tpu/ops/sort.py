@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..block import Batch, Block, Column, DictionaryColumn, StringColumn
+from ..block import Batch, Block
 from .keys import key_words
 
 __all__ = ["SortKey", "sort_batch", "top_n", "sort_permutation"]

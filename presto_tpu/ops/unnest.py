@@ -13,14 +13,14 @@ per-row loops, overflow flagged when out_capacity is short.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from .. import types as T
-from ..block import ArrayColumn, Batch, Block, Column, DictionaryColumn, \
-    StringColumn, gather_block as _gather
+from ..block import ArrayColumn, Batch, Block, Column, \
+    gather_block as _gather
 
 __all__ = ["unnest"]
 

@@ -26,7 +26,7 @@ permutation. NULLS in aggregates are skipped (masked to identity).
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp

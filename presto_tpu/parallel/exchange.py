@@ -17,8 +17,7 @@ All functions here must run INSIDE shard_map over the workers axis.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import List, Sequence, Tuple
+from typing import Sequence, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -13,7 +13,7 @@ ICI traffic instead of a scheduler overlapping tasks and HTTP.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -21,8 +21,6 @@ Round-1 rules (correctness-first; cost-based variants per ROADMAP):
 from __future__ import annotations
 
 import dataclasses as _dc
-from typing import List
-
 from . import nodes as N
 
 __all__ = ["add_exchanges", "split_single_agg"]

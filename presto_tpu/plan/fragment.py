@@ -15,7 +15,7 @@ directly.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Tuple
+from typing import List, Tuple
 
 from .nodes import ExchangeNode, PlanNode, to_json
 

@@ -21,7 +21,7 @@ Differences from the reference, by design:
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence, Tuple, Union
+from typing import List, Optional, Tuple, Union
 
 from .. import types as T
 from ..expr import ir as E

@@ -14,7 +14,6 @@ Batch pytrees.
 
 from __future__ import annotations
 
-from functools import partial as fpartial
 from typing import Callable, Tuple
 
 import jax
@@ -24,7 +23,7 @@ from jax.sharding import PartitionSpec as P
 from .. import types as T
 from ..block import Batch
 from ..expr import call, compile_filter, compile_projections, const, input_ref, special
-from ..ops.aggregation import AggSpec, group_by, merge_partials
+from ..ops.aggregation import AggSpec, group_by
 from ..ops.sort import SortKey, top_n
 from ..parallel.mesh import WORKERS_AXIS
 from ..parallel.stages import distributed_hash_join, two_stage_group_by

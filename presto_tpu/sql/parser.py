@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
-from typing import List, Optional, Tuple, Union
+from typing import List, Optional, Tuple
 
 __all__ = ["parse_sql", "Query", "Select", "TableRef", "Join", "OrderItem",
            "Literal", "Name", "Func", "BinOp", "NotOp", "Between", "InList",

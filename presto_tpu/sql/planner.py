@@ -14,7 +14,7 @@ project -> sort/topN/limit), with joins left-deep in FROM order.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 

@@ -12,7 +12,7 @@ checksums are literal equality on sorted row sets).
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 __all__ = ["VerifierResult", "verify_corpus", "DEFAULT_CORPUS"]
 
