@@ -1,0 +1,191 @@
+"""TPC-H q7/q8/q16/q19/q22 shapes vs numpy oracles (second wave --
+OR-of-ANDs predicates, CASE ratios, NOT IN + count distinct, substr +
+scalar subqueries)."""
+
+import collections
+
+import numpy as np
+import pytest
+
+from presto_tpu.connectors import tpch
+from presto_tpu.sql import sql
+
+SF = 0.01
+EPOCH = np.datetime64("1970-01-01")
+
+
+def d(s):
+    return int((np.datetime64(s) - EPOCH).astype(int))
+
+
+def test_tpch_q7_shape():
+    # volume shipped between two nations per year
+    res = sql("""
+      SELECT n1.name AS supp_nation, n2.name AS cust_nation,
+             sum(l.extendedprice * (1 - l.discount)) AS revenue
+      FROM lineitem l
+      JOIN supplier s ON l.suppkey = s.suppkey
+      JOIN orders o ON l.orderkey = o.orderkey
+      JOIN customer c ON o.custkey = c.custkey
+      JOIN nation n1 ON s.nationkey = n1.nationkey
+      JOIN nation n2 ON c.nationkey = n2.nationkey
+      WHERE l.shipdate >= date '1995-01-01' AND l.shipdate <= date '1996-12-31'
+        AND ((n1.name = 'FRANCE' AND n2.name = 'GERMANY')
+             OR (n1.name = 'GERMANY' AND n2.name = 'FRANCE'))
+      GROUP BY n1.name, n2.name ORDER BY supp_nation, cust_nation
+    """, sf=SF, max_groups=16, join_capacity=1 << 18)
+    li = tpch.generate_columns("lineitem", SF,
+                               ["orderkey", "suppkey", "extendedprice",
+                                "discount", "shipdate"])
+    su = tpch.generate_columns("supplier", SF, ["suppkey", "nationkey"])
+    od = tpch.generate_columns("orders", SF, ["orderkey", "custkey"])
+    cu = tpch.generate_columns("customer", SF, ["custkey", "nationkey"])
+    na = tpch.generate_columns("nation", SF, ["nationkey", "name"])
+    nname = dict(zip(na["nationkey"], na["name"]))
+    snat = {k: nname[v] for k, v in zip(su["suppkey"], su["nationkey"])}
+    ocust = dict(zip(od["orderkey"], od["custkey"]))
+    cnat = {k: nname[v] for k, v in zip(cu["custkey"], cu["nationkey"])}
+    want = collections.Counter()
+    m = (li["shipdate"] >= d("1995-01-01")) & (li["shipdate"] <= d("1996-12-31"))
+    for ok, sk, p, disc in zip(li["orderkey"][m], li["suppkey"][m],
+                               li["extendedprice"][m], li["discount"][m]):
+        sn = snat[sk]
+        cn = cnat[ocust[ok]]
+        if (sn, cn) in (("FRANCE", "GERMANY"), ("GERMANY", "FRANCE")):
+            want[(sn, cn)] += int(p) * (100 - int(disc))
+    got = {(r[0], r[1]): r[2] for r in res.rows()}
+    assert got == dict(want)
+
+
+def test_tpch_q19_or_of_ands():
+    res = sql("""
+      SELECT sum(l.extendedprice * (1 - l.discount)) AS revenue
+      FROM lineitem l JOIN part p ON l.partkey = p.partkey
+      WHERE (p.brand = 'Brand#12' AND l.quantity BETWEEN 1 AND 11
+             AND p.size BETWEEN 1 AND 5)
+         OR (p.brand = 'Brand#23' AND l.quantity BETWEEN 10 AND 20
+             AND p.size BETWEEN 1 AND 10)
+         OR (p.brand = 'Brand#34' AND l.quantity BETWEEN 20 AND 30
+             AND p.size BETWEEN 1 AND 15)
+    """, sf=SF, max_groups=4, join_capacity=1 << 18)
+    li = tpch.generate_columns("lineitem", SF,
+                               ["partkey", "quantity", "extendedprice",
+                                "discount"])
+    pt = tpch.generate_columns("part", SF, ["brand", "size"])
+    want = 0
+    for pk, q, p, disc in zip(li["partkey"], li["quantity"],
+                              li["extendedprice"], li["discount"]):
+        b = pt["brand"][pk - 1]
+        s = pt["size"][pk - 1]
+        qd = q // 100
+        if ((b == "Brand#12" and 1 <= qd <= 11 and 1 <= s <= 5)
+                or (b == "Brand#23" and 10 <= qd <= 20 and 1 <= s <= 10)
+                or (b == "Brand#34" and 20 <= qd <= 30 and 1 <= s <= 15)):
+            want += int(p) * (100 - int(disc))
+    got = res.rows()[0][0]
+    assert (got or 0) == want
+
+
+def test_tpch_q8_case_ratio():
+    res = sql("""
+      SELECT year(o.orderdate) AS o_year,
+             sum(CASE WHEN n.name = 'BRAZIL'
+                 THEN l.extendedprice * (1 - l.discount) ELSE 0 END) AS brazil,
+             sum(l.extendedprice * (1 - l.discount)) AS total
+      FROM lineitem l
+      JOIN orders o ON l.orderkey = o.orderkey
+      JOIN customer c ON o.custkey = c.custkey
+      JOIN nation n ON c.nationkey = n.nationkey
+      WHERE o.orderdate >= date '1995-01-01' AND o.orderdate <= date '1996-12-31'
+      GROUP BY year(o.orderdate) ORDER BY o_year
+    """, sf=SF, max_groups=16, join_capacity=1 << 18)
+    li = tpch.generate_columns("lineitem", SF,
+                               ["orderkey", "extendedprice", "discount"])
+    od = tpch.generate_columns("orders", SF, ["orderkey", "custkey",
+                                              "orderdate"])
+    cu = tpch.generate_columns("customer", SF, ["custkey", "nationkey"])
+    na = tpch.generate_columns("nation", SF, ["nationkey", "name"])
+    nname = dict(zip(na["nationkey"], na["name"]))
+    cnat = {k: nname[v] for k, v in zip(cu["custkey"], cu["nationkey"])}
+    omask = (od["orderdate"] >= d("1995-01-01")) & \
+            (od["orderdate"] <= d("1996-12-31"))
+    oinfo = {int(k): (int(dt), cnat[int(c)]) for k, c, dt in
+             zip(od["orderkey"][omask], od["custkey"][omask],
+                 od["orderdate"][omask])}
+    want = collections.defaultdict(lambda: [0, 0])
+    for ok, p, disc in zip(li["orderkey"], li["extendedprice"],
+                           li["discount"]):
+        if int(ok) in oinfo:
+            dt, nat = oinfo[int(ok)]
+            yr = (EPOCH + dt).astype("datetime64[Y]").astype(int) + 1970
+            rev = int(p) * (100 - int(disc))
+            want[yr][1] += rev
+            if nat == "BRAZIL":
+                want[yr][0] += rev
+    got = {r[0]: [r[1] or 0, r[2]] for r in res.rows()}
+    assert got == {y: v for y, v in want.items()}
+    assert [r[0] for r in res.rows()] == sorted(got)
+
+
+def test_tpch_q16_not_in_distinct():
+    res = sql("""
+      SELECT p.brand, p.type, p.size,
+             count(DISTINCT ps.suppkey) AS supplier_cnt
+      FROM partsupp ps JOIN part p ON p.partkey = ps.partkey
+      WHERE p.brand <> 'Brand#45'
+        AND p.size IN (9, 14, 23, 45, 19, 3, 36, 49)
+        AND ps.suppkey NOT IN (SELECT suppkey FROM supplier
+                               WHERE comment LIKE '%carefully%deposits%')
+      GROUP BY p.brand, p.type, p.size
+      ORDER BY supplier_cnt DESC, p.brand, p.type, p.size
+      LIMIT 20
+    """, sf=SF, max_groups=1 << 13, join_capacity=1 << 17)
+    ps = tpch.generate_columns("partsupp", SF, ["partkey", "suppkey"])
+    pt = tpch.generate_columns("part", SF, ["brand", "type", "size"])
+    su = tpch.generate_columns("supplier", SF, ["suppkey", "comment"])
+    import re
+    bad = {int(k) for k, cm in zip(su["suppkey"], su["comment"])
+           if re.search("carefully.*deposits", cm)}
+    sizes = {9, 14, 23, 45, 19, 3, 36, 49}
+    groups = collections.defaultdict(set)
+    for pk, sk in zip(ps["partkey"], ps["suppkey"]):
+        b = pt["brand"][pk - 1]
+        if b == "Brand#45" or int(pt["size"][pk - 1]) not in sizes:
+            continue
+        if int(sk) in bad:
+            continue
+        groups[(b, pt["type"][pk - 1], int(pt["size"][pk - 1]))].add(int(sk))
+    ordered = sorted(((len(v), k) for k, v in groups.items()),
+                     key=lambda t: (-t[0], t[1]))[:20]
+    got = [(r[3], (r[0], r[1], r[2])) for r in res.rows()]
+    assert got == [(c, k) for c, k in ordered]
+
+
+def test_tpch_q22_shape():
+    # customers with above-average balance and no orders, by phone prefix
+    res = sql("""
+      SELECT substr(c.phone, 1, 2) AS cntrycode, count(*) AS numcust,
+             sum(c.acctbal) AS totacctbal
+      FROM customer c
+      WHERE substr(c.phone, 1, 2) IN ('13', '31', '23', '29', '30', '18', '17')
+        AND c.acctbal > (SELECT avg(acctbal) FROM customer
+                         WHERE acctbal > 0.00)
+        AND c.custkey NOT IN (SELECT custkey FROM orders)
+      GROUP BY substr(c.phone, 1, 2) ORDER BY cntrycode
+    """, sf=SF, max_groups=64, join_capacity=1 << 17)
+    cu = tpch.generate_columns("customer", SF, ["custkey", "phone", "acctbal"])
+    od = tpch.generate_columns("orders", SF, ["custkey"])
+    have_orders = set(int(x) for x in od["custkey"])
+    pos = cu["acctbal"][cu["acctbal"] > 0]
+    # engine avg = round-half-away(sum/count) at scale 2
+    s, c = int(pos.sum()), len(pos)
+    avg = (2 * abs(s) + c) // (2 * c) * (1 if s >= 0 else -1)
+    codes = {"13", "31", "23", "29", "30", "18", "17"}
+    want = collections.defaultdict(lambda: [0, 0])
+    for ck, ph, ab in zip(cu["custkey"], cu["phone"], cu["acctbal"]):
+        code = ph[:2]
+        if code in codes and ab > avg and int(ck) not in have_orders:
+            want[code][0] += 1
+            want[code][1] += int(ab)
+    got = {r[0]: [r[1], r[2]] for r in res.rows()}
+    assert got == {k: v for k, v in want.items()}
