@@ -53,7 +53,13 @@ def run_one(query: str, sf: float, explain_only: bool = False) -> int:
     from presto_tpu.plan import explain as explain_plan
     from presto_tpu.sql import plan_sql, sql
 
-    if explain_only or query.lower().lstrip().startswith("explain"):
+    stripped = query.lower().lstrip()
+    if stripped.startswith("explain analyze"):
+        from presto_tpu.plan import explain_analyze
+        q = query.strip()[len("explain analyze"):].strip()
+        print(explain_analyze(plan_sql(q), sf=sf))
+        return 0
+    if explain_only or stripped.startswith("explain"):
         q = query.strip()
         if q.lower().startswith("explain"):
             q = q[len("explain"):].strip()

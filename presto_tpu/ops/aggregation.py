@@ -421,7 +421,11 @@ def merge_spec(spec: AggSpec, state_channel: int) -> List[AggSpec]:
     if c in ("bool_and", "bool_or"):
         return [AggSpec(c, state_channel, T.BOOLEAN)]
     if c == "avg":
-        return [AggSpec("sum", state_channel, T.decimal(38, 0)),
+        # the sum state keeps the avg's scale: downstream finalizers
+        # (divide sum/count) read the block's type metadata for rescaling
+        sum_ty = T.decimal(38, spec.output_type.scale) \
+            if spec.output_type.is_decimal else T.DOUBLE
+        return [AggSpec("sum", state_channel, sum_ty),
                 AggSpec("sum", state_channel + 1, T.BIGINT)]
     if c in ("var_samp", "var_pop", "stddev_samp", "stddev_pop"):
         return [AggSpec("sum", state_channel, T.BIGINT),

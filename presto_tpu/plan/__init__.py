@@ -6,7 +6,7 @@ from .nodes import (PlanNode, TableScanNode, ValuesNode, RemoteSourceNode,
                     MarkDistinctNode, RowNumberNode, WindowNode, OutputNode,
                     from_json, to_json)
 from .fragment import PlanFragment, fragment_plan
-from .explain import explain, explain_distributed
+from .explain import explain, explain_analyze, explain_distributed
 from .validator import validate_plan
 
 __all__ = ["PlanNode", "TableScanNode", "ValuesNode", "RemoteSourceNode",
@@ -16,4 +16,4 @@ __all__ = ["PlanNode", "TableScanNode", "ValuesNode", "RemoteSourceNode",
            "UnnestNode", "UnionNode", "SampleNode", "AssignUniqueIdNode",
            "MarkDistinctNode", "RowNumberNode", "WindowNode",
            "OutputNode", "from_json", "to_json", "PlanFragment", "fragment_plan",
-           "explain", "explain_distributed", "validate_plan"]
+           "explain", "explain_analyze", "explain_distributed", "validate_plan"]

@@ -70,6 +70,22 @@ def explain(root: N.PlanNode) -> str:
     return "\n".join(lines)
 
 
+def explain_analyze(root: N.PlanNode, sf: float = 0.01, **kwargs) -> str:
+    """EXPLAIN ANALYZE: execute the plan and annotate the tree with the
+    observed stats (ExplainAnalyzeOperator analog -- stats are the
+    host-visible boundaries; in-program per-operator timing is fused
+    away by XLA, by design)."""
+    from ..exec import run_query
+
+    res = run_query(root, sf=sf, **kwargs)
+    lines = [explain(root), "", "-- runtime --"]
+    for name, s in sorted(res.stats.items()):
+        lines.append(f"{name}: total={s['total']} count={s['count']} "
+                     f"max={s['max']}")
+    lines.append(f"output rows: {res.row_count}")
+    return "\n".join(lines)
+
+
 def explain_distributed(root: N.PlanNode) -> str:
     """Fragment-by-fragment rendering (EXPLAIN (TYPE DISTRIBUTED) analog)."""
     out: List[str] = []

@@ -81,4 +81,18 @@ DEFAULT_CORPUS = [
     "WHERE shipmode IN ('AIR', 'MAIL') GROUP BY shipmode",
     "SELECT count(*) FROM lineitem WHERE orderkey IN "
     "(SELECT orderkey FROM orders WHERE totalprice > 300000.00)",
+    # set operations (NULL=NULL membership, precedence)
+    "SELECT regionkey FROM nation INTERSECT "
+    "SELECT regionkey FROM region WHERE regionkey >= 2",
+    "SELECT nationkey FROM nation WHERE nationkey < 5 UNION "
+    "SELECT regionkey FROM region",
+    # join + aggregation
+    "SELECT n.name, count(*) FROM supplier s "
+    "JOIN nation n ON s.nationkey = n.nationkey GROUP BY n.name",
+    # distinct aggregates (non-mergeable partials: raw-row repartition)
+    "SELECT custkey, count(DISTINCT orderpriority) FROM orders "
+    "GROUP BY custkey HAVING count(*) > 20",
+    # scalar subquery
+    "SELECT count(*) FROM customer WHERE acctbal > "
+    "(SELECT avg(acctbal) FROM customer)",
 ]
