@@ -77,7 +77,8 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
               default_join_capacity: int = 1 << 16,
               split_rows: Optional[int] = None,
               scan_ranges: Optional[Dict[str, Tuple[int, int]]] = None,
-              remote_sources: Optional[Dict[str, Batch]] = None) -> QueryResult:
+              remote_sources: Optional[Dict[str, Batch]] = None,
+              memory_pool=None, query_id: str = "query") -> QueryResult:
     """Plan -> results, end to end (DistributedQueryRunner analog for
     programmatic plans). With a mesh, scan batches are padded to a
     multiple of the mesh size and the plan runs SPMD. With `split_rows`,
@@ -123,16 +124,29 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
                                            scan_ranges.get(s.id)))
     for b in batches:
         stats.add("scan_rows", int(np.asarray(b.active).sum()))
+    reserved = 0
+    if memory_pool is not None:
+        # admission accounting (MemoryPool.reserve analog): planned scan
+        # footprint charged before launch; reservation failure surfaces
+        # BEFORE the device OOMs so callers can stream/spill instead
+        from .memory import batch_bytes
+        reserved = sum(batch_bytes(b) for b in batches)
+        memory_pool.reserve(query_id, reserved)
+        stats.add("reserved_bytes", reserved)
     fn = jax.jit(plan.fn)
-    with stats.timed("execute_s"):
-        out, overflow = fn(tuple(batches))
-        jax.block_until_ready(out)
-    if bool(np.asarray(overflow)):
-        raise RuntimeError(
-            "plan execution overflowed a static bucket (join/exchange/"
-            "group capacity); rerun with larger capacity_hints")
-    with stats.timed("fetch_s"):
-        res = _batch_to_result(out, root)
+    try:
+        with stats.timed("execute_s"):
+            out, overflow = fn(tuple(batches))
+            jax.block_until_ready(out)
+        if bool(np.asarray(overflow)):
+            raise RuntimeError(
+                "plan execution overflowed a static bucket (join/exchange/"
+                "group capacity); rerun with larger capacity_hints")
+        with stats.timed("fetch_s"):
+            res = _batch_to_result(out, root)
+    finally:
+        if memory_pool is not None:
+            memory_pool.free(query_id, reserved)
     stats.add("output_rows", res.row_count)
     res.stats = stats.snapshot()
     return res

@@ -108,14 +108,20 @@ class TaskManager:
     chip at a time (the TaskExecutor slot analog; multi-stream arrives
     with task_concurrency)."""
 
-    def __init__(self, sf: float = 0.01, mesh=None):
+    def __init__(self, sf: float = 0.01, mesh=None,
+                 memory_bytes: int = 12 << 30):
+        from ..exec.memory import MemoryPool
         self.sf = sf
         self.mesh = mesh
         self.tasks: Dict[str, _Task] = {}
+        self.memory_pool = MemoryPool(memory_bytes)
+        self.draining = False  # GracefulShutdownHandler state
         self._exec_lock = threading.Lock()
         self._tasks_lock = threading.Lock()
 
     def create_or_update(self, task_id: str, body: dict) -> dict:
+        if self.draining:
+            raise RuntimeError("worker is SHUTTING_DOWN: not accepting tasks")
         with self._tasks_lock:
             task = self.tasks.get(task_id)
             if task is None:
@@ -124,6 +130,11 @@ class TaskManager:
                 threading.Thread(target=self._run, args=(task, body),
                                  daemon=True).start()
         return task.info()
+
+    def active_task_count(self) -> int:
+        with self._tasks_lock:
+            return sum(1 for t in self.tasks.values()
+                       if t.state in ("PLANNED", "RUNNING"))
 
     def _run(self, task: _Task, body: dict):
         try:
@@ -159,7 +170,9 @@ class TaskManager:
             with self._exec_lock:
                 res = run_query(plan, sf=sf, mesh=self.mesh,
                                 scan_ranges=scan_ranges,
-                                remote_sources=remote_sources)
+                                remote_sources=remote_sources,
+                                memory_pool=self.memory_pool,
+                                query_id=task.task_id)
             wall = time.time() - t0
             types = plan.output_types()
             out_part = body.get("outputPartitions")
@@ -291,10 +304,13 @@ class _Handler(BaseHTTPRequestHandler):
                 "uptime": round(time.time() - self.started_at, 1),
                 "state": "ACTIVE"})
         if parts == ["v1", "status"]:
-            with self.manager._tasks_lock:
-                ntasks = len(self.manager.tasks)
-            return self._send_json({"nodeId": self.node_id,
-                                    "activeTasks": ntasks})
+            return self._send_json({
+                "nodeId": self.node_id,
+                "activeTasks": self.manager.active_task_count(),
+                "state": ("SHUTTING_DOWN" if self.manager.draining
+                          else "ACTIVE"),
+                "memoryReservedBytes": self.manager.memory_pool.reserved_bytes,
+                "memoryCapacityBytes": self.manager.memory_pool.capacity})
         if len(parts) == 3 and parts[:2] == ["v1", "task"]:
             task = self.manager.get(parts[2])
             if task is None:
@@ -330,8 +346,23 @@ class _Handler(BaseHTTPRequestHandler):
         if len(parts) == 3 and parts[:2] == ["v1", "task"]:
             length = int(self.headers.get("Content-Length", "0"))
             body = json.loads(self.rfile.read(length) or b"{}")
-            info = self.manager.create_or_update(parts[2], body)
+            try:
+                info = self.manager.create_or_update(parts[2], body)
+            except RuntimeError as e:  # draining
+                return self._send_json({"error": str(e)}, 503)
             return self._send_json(info)
+        return self._send_json({"error": f"unknown path {self.path}"}, 404)
+
+    def do_PUT(self):  # noqa: N802  graceful shutdown (worker drain)
+        parts = [p for p in self.path.split("/") if p]
+        if parts == ["v1", "info", "state"]:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(length) or b'""')
+            if str(body).upper().replace('"', "") == "SHUTTING_DOWN":
+                # GracefulShutdownHandler: stop accepting, finish running
+                self.manager.draining = True
+                return self._send_json({"state": "SHUTTING_DOWN"})
+            return self._send_json({"error": f"unknown state {body}"}, 400)
         return self._send_json({"error": f"unknown path {self.path}"}, 404)
 
     def do_DELETE(self):  # noqa: N802

@@ -104,6 +104,37 @@ def test_tpu_execution_disabled_gate(client):
     assert "tpu_execution_enabled" in info["error"]
 
 
+def test_graceful_shutdown_drain(server):
+    import json
+    import urllib.request
+    base = f"http://127.0.0.1:{server.port}"
+    # dedicated server so draining doesn't affect the shared fixture
+    from presto_tpu.server import TpuWorkerServer, WorkerClient
+    s2 = TpuWorkerServer(sf=0.01).start()
+    try:
+        c2 = WorkerClient(f"http://127.0.0.1:{s2.port}")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{s2.port}/v1/info/state",
+            data=b'"SHUTTING_DOWN"', method="PUT")
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert json.loads(resp.read())["state"] == "SHUTTING_DOWN"
+        import pytest as _pytest
+        with _pytest.raises(Exception):  # 503 while draining
+            c2.submit("t-drain", q_plan())
+        status, _ = c2._request("GET", "/v1/status")
+        assert json.loads(status)["state"] == "SHUTTING_DOWN"
+    finally:
+        s2.stop()
+
+
+def test_status_reports_memory(client):
+    import json
+    status, _ = client._request("GET", "/v1/status")
+    st = json.loads(status)
+    assert st["memoryCapacityBytes"] > 0
+    assert "memoryReservedBytes" in st
+
+
 def test_compressed_results(client):
     plan = q_plan()
     client.submit("t5", plan, session={"exchange_compression": "zstd"})
