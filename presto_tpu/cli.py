@@ -53,16 +53,14 @@ def run_one(query: str, sf: float, explain_only: bool = False) -> int:
     from presto_tpu.plan import explain as explain_plan
     from presto_tpu.sql import plan_sql, sql
 
-    stripped = query.lower().lstrip()
-    if stripped.startswith("explain analyze"):
+    import re
+    m = re.match(r"\s*explain(\s+analyze)?\b", query, re.IGNORECASE)
+    if m and m.group(1):
         from presto_tpu.plan import explain_analyze
-        q = query.strip()[len("explain analyze"):].strip()
-        print(explain_analyze(plan_sql(q), sf=sf))
+        print(explain_analyze(plan_sql(query[m.end():].strip()), sf=sf))
         return 0
-    if explain_only or stripped.startswith("explain"):
-        q = query.strip()
-        if q.lower().startswith("explain"):
-            q = q[len("explain"):].strip()
+    if explain_only or m:
+        q = query[m.end():].strip() if m else query
         print(explain_plan(plan_sql(q)))
         return 0
     t0 = time.time()

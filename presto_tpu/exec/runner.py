@@ -112,27 +112,34 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     hints = capacity_hints or {}
     scan_ranges = scan_ranges or {}
     remote_sources = remote_sources or {}
-    with stats.timed("scan_stage_s"):
-        batches = []
-        for s in plan.scan_nodes:
-            if isinstance(s, N.RemoteSourceNode):
-                assert s.id in remote_sources, \
-                    f"no remote source batch supplied for node {s.id}"
-                batches.append(remote_sources[s.id])
-            else:
-                batches.append(_scan_batch(s, sf, hints.get(s.id), pad,
-                                           scan_ranges.get(s.id)))
-    for b in batches:
-        stats.add("scan_rows", int(np.asarray(b.active).sum()))
     reserved = 0
     if memory_pool is not None:
-        # admission accounting (MemoryPool.reserve analog): planned scan
-        # footprint charged before launch; reservation failure surfaces
-        # BEFORE the device OOMs so callers can stream/spill instead
-        from .memory import batch_bytes
-        reserved = sum(batch_bytes(b) for b in batches)
+        # admission accounting (MemoryPool.reserve analog): PLANNED scan
+        # footprints are charged before any device allocation, so a
+        # reservation failure surfaces before the scan stage can OOM
+        reserved = sum(
+            _planned_scan_bytes(s, sf, hints.get(s.id), pad,
+                                scan_ranges.get(s.id), remote_sources)
+            for s in plan.scan_nodes)
         memory_pool.reserve(query_id, reserved)
         stats.add("reserved_bytes", reserved)
+    try:
+        with stats.timed("scan_stage_s"):
+            batches = []
+            for s in plan.scan_nodes:
+                if isinstance(s, N.RemoteSourceNode):
+                    assert s.id in remote_sources, \
+                        f"no remote source batch supplied for node {s.id}"
+                    batches.append(remote_sources[s.id])
+                else:
+                    batches.append(_scan_batch(s, sf, hints.get(s.id), pad,
+                                               scan_ranges.get(s.id)))
+    except Exception:
+        if memory_pool is not None:
+            memory_pool.free(query_id, reserved)
+        raise
+    for b in batches:
+        stats.add("scan_rows", int(np.asarray(b.active).sum()))
     fn = jax.jit(plan.fn)
     try:
         with stats.timed("execute_s"):
@@ -150,6 +157,38 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     stats.add("output_rows", res.row_count)
     res.stats = stats.snapshot()
     return res
+
+
+def _planned_scan_bytes(node: N.PlanNode, sf: float,
+                        capacity_hint: Optional[int], pad_multiple: int,
+                        scan_range: Optional[Tuple[int, int]],
+                        remote_sources: Dict[str, Batch]) -> int:
+    """Planned HBM footprint of a scan input WITHOUT materializing it."""
+    if isinstance(node, N.RemoteSourceNode):
+        b = remote_sources.get(node.id)
+        if b is None:
+            return 0
+        from .memory import batch_bytes
+        return batch_bytes(b)
+    if isinstance(node, N.ValuesNode):
+        rows = len(node.rows)
+        types = node.types
+    else:
+        from ..connectors import catalog
+        conn = catalog(node.connector)
+        rows = scan_range[1] if scan_range is not None else \
+            conn.table_row_count(node.table, sf)
+        types = node.column_types
+    cap = capacity_hint or max(-(-rows // pad_multiple) * pad_multiple,
+                               pad_multiple)
+    per_row = 1  # active mask
+    for ty in types:
+        if ty.is_string:
+            per_row += ty.max_length if ty.max_length < 1 << 20 else 64
+            per_row += 5  # lengths + nulls
+        else:
+            per_row += ty.to_dtype().itemsize + 1
+    return cap * per_row
 
 
 def _batch_to_result(out: Batch, root: N.PlanNode) -> QueryResult:

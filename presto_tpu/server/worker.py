@@ -120,11 +120,14 @@ class TaskManager:
         self._tasks_lock = threading.Lock()
 
     def create_or_update(self, task_id: str, body: dict) -> dict:
-        if self.draining:
-            raise RuntimeError("worker is SHUTTING_DOWN: not accepting tasks")
         with self._tasks_lock:
             task = self.tasks.get(task_id)
             if task is None:
+                # drain refuses only NEW tasks; idempotent re-POSTs of
+                # running tasks still succeed (create-or-UPDATE contract)
+                if self.draining:
+                    raise RuntimeError(
+                        "worker is SHUTTING_DOWN: not accepting tasks")
                 task = _Task(task_id)
                 self.tasks[task_id] = task
                 threading.Thread(target=self._run, args=(task, body),

@@ -104,10 +104,9 @@ def test_tpu_execution_disabled_gate(client):
     assert "tpu_execution_enabled" in info["error"]
 
 
-def test_graceful_shutdown_drain(server):
+def test_graceful_shutdown_drain():
     import json
     import urllib.request
-    base = f"http://127.0.0.1:{server.port}"
     # dedicated server so draining doesn't affect the shared fixture
     from presto_tpu.server import TpuWorkerServer, WorkerClient
     s2 = TpuWorkerServer(sf=0.01).start()
