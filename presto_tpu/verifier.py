@@ -32,10 +32,13 @@ def _canon(res) -> list:
 
 def verify_corpus(corpus: Sequence[str], sf: float = 0.01,
                   mesh=None, split_rows: Optional[int] = None,
-                  max_groups: int = 1 << 14) -> List[VerifierResult]:
+                  max_groups: int = 1 << 14,
+                  cluster_urls: Optional[Sequence[str]] = None
+                  ) -> List[VerifierResult]:
     """Run each query under every applicable configuration; compare
-    sorted row sets for exact equality."""
-    from .sql import sql
+    sorted row sets for exact equality. `cluster_urls` adds the
+    multi-worker HTTP tier (coordinator-scheduled fragments)."""
+    from .sql import plan_sql, sql
 
     out: List[VerifierResult] = []
     for text in corpus:
@@ -54,6 +57,22 @@ def verify_corpus(corpus: Sequence[str], sf: float = 0.01,
             attempt("streaming", split_rows=split_rows)
         if mesh is not None:
             attempt("mesh", mesh=mesh)
+        if cluster_urls:
+            try:
+                from .plan.distribute import add_exchanges
+                from .server import Coordinator
+                plan = add_exchanges(plan_sql(text, max_groups=max_groups))
+                cols, _ = Coordinator(list(cluster_urls)).execute(plan, sf=sf)
+                nrows = len(cols[0][0]) if cols else 0
+                rows = [tuple(None if cols[c][1][i] else cols[c][0][i]
+                              for c in range(len(cols)))
+                        for i in range(nrows)]
+                runs["cluster"] = sorted(
+                    rows, key=lambda r: tuple(str(x) for x in r))
+            except NotImplementedError:
+                pass  # declared scheduler-depth gap, not drift
+            except Exception as e:  # noqa: BLE001
+                errors["cluster"] = f"{type(e).__name__}: {e}"
 
         if errors:
             out.append(VerifierResult(text, list(runs) + list(errors), False,

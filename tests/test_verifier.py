@@ -9,3 +9,19 @@ def test_corpus_consistent_across_configs(mesh8):
     # streaming config actually engaged for the streamable queries
     assert any("streaming" in r.configs for r in results)
     assert all("mesh" in r.configs for r in results)
+
+
+def test_corpus_consistent_on_http_cluster():
+    from presto_tpu.server import TpuWorkerServer
+    workers = [TpuWorkerServer(sf=0.01).start() for _ in range(2)]
+    try:
+        urls = [f"http://127.0.0.1:{w.port}" for w in workers]
+        results = verify_corpus(DEFAULT_CORPUS, sf=0.01, cluster_urls=urls)
+        bad = [r for r in results if not r.ok]
+        assert not bad, [f"{r.query[:60]}: {r.detail}" for r in bad]
+        # the cluster tier actually engaged for most queries
+        engaged = sum(1 for r in results if "cluster" in r.configs)
+        assert engaged >= len(DEFAULT_CORPUS) // 2, engaged
+    finally:
+        for w in workers:
+            w.stop()
