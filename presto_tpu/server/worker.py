@@ -382,7 +382,9 @@ class TpuWorkerServer:
     analog). start() binds a port and serves on background threads."""
 
     def __init__(self, port: int = 0, sf: float = 0.01, mesh=None,
-                 node_id: Optional[str] = None):
+                 node_id: Optional[str] = None,
+                 discovery_url: Optional[str] = None,
+                 announce_interval_s: float = 1.0):
         self.manager = TaskManager(sf=sf, mesh=mesh)
         self.node_id = node_id or f"tpu-worker-{uuid.uuid4().hex[:8]}"
         handler = type("BoundHandler", (_Handler,), {
@@ -391,13 +393,24 @@ class TpuWorkerServer:
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+        self._announcer = None
+        if discovery_url:
+            from .discovery import Announcer
+            self._announcer = Announcer(
+                discovery_url, self.node_id,
+                f"http://127.0.0.1:{self.port}",
+                interval_s=announce_interval_s)
 
     def start(self):
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
+        if self._announcer:
+            self._announcer.start()
         return self
 
     def stop(self):
+        if self._announcer:
+            self._announcer.stop(unannounce=True)
         self.httpd.shutdown()
         self.httpd.server_close()

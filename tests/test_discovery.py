@@ -3,6 +3,33 @@ import time
 from presto_tpu.server.discovery import Announcer, DiscoveryServer, alive_nodes
 
 
+def test_cluster_bootstrap_via_discovery():
+    """Full loop: workers announce themselves; the coordinator finds them
+    through discovery and runs a distributed query."""
+    from presto_tpu.plan.distribute import add_exchanges
+    from presto_tpu.server import Coordinator, TpuWorkerServer
+    from presto_tpu.sql import plan_sql
+    from presto_tpu.exec import run_query
+
+    d = DiscoveryServer().start()
+    workers = [TpuWorkerServer(sf=0.01, discovery_url=d.url,
+                               announce_interval_s=0.2).start()
+               for _ in range(2)]
+    try:
+        time.sleep(0.5)
+        assert len(alive_nodes(d.url, max_age_s=2.0)) == 2
+        sqltext = "SELECT count(*) AS n FROM orders"
+        local = run_query(plan_sql(sqltext, max_groups=4), sf=0.01)
+        coord = Coordinator(discovery_url=d.url)
+        cols, _ = coord.execute(
+            add_exchanges(plan_sql(sqltext, max_groups=4)), sf=0.01)
+        assert int(cols[0][0][0]) == local.rows()[0][0]
+    finally:
+        for w in workers:
+            w.stop()
+        d.stop()
+
+
 def test_announce_discover_expire_unannounce():
     d = DiscoveryServer().start()
     try:
