@@ -31,7 +31,12 @@ from ..plan import fragment_plan, nodes as N
 from .client import WorkerClient
 from .discovery import alive_nodes
 
-__all__ = ["Coordinator"]
+__all__ = ["Coordinator", "SchedulerGap"]
+
+
+class SchedulerGap(NotImplementedError):
+    """A declared round-1 scheduler limitation (see ROADMAP 'scheduler
+    depth'), distinct from unexpected NotImplementedErrors in kernels."""
 
 
 class Coordinator:
@@ -128,7 +133,7 @@ class Coordinator:
             single_ups = [rn for rn in remote_nodes
                           if frag_by_id[rn.fragment_id].partitioning == "SINGLE"]
             if scans and hash_ups:
-                raise NotImplementedError(
+                raise SchedulerGap(
                     "fragment mixes range-split table scans with hash-"
                     "partitioned remote sources; DAG scheduling lands with "
                     "scheduler depth (ROADMAP)")
@@ -141,7 +146,7 @@ class Coordinator:
                 ntasks = len(workers) if (scans or hash_ups) else 1
             has_join = _contains_join(frag.root)
             if len(scans) > 1 and ntasks > 1 and has_join:
-                raise NotImplementedError(
+                raise SchedulerGap(
                     "leaf fragment joins two scans: range-splitting both "
                     "sides would drop cross-slice matches; run "
                     "add_exchanges so build sides become REPLICATE "

@@ -123,7 +123,10 @@ class Announcer:
     def stop(self, unannounce: bool = True):
         self._stop.set()
         if self._thread:
-            self._thread.join(timeout=2)
+            # join past announce_once's 5s HTTP timeout: a still-in-
+            # flight PUT landing AFTER the DELETE would re-register a
+            # ghost node in discovery
+            self._thread.join(timeout=6)
         if unannounce:
             try:
                 req = urllib.request.Request(

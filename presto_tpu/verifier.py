@@ -59,17 +59,19 @@ def verify_corpus(corpus: Sequence[str], sf: float = 0.01,
             attempt("mesh", mesh=mesh)
         if cluster_urls:
             try:
+                from .exec.runner import QueryResult
                 from .plan.distribute import add_exchanges
                 from .server import Coordinator
+                from .server.coordinator import SchedulerGap
                 plan = add_exchanges(plan_sql(text, max_groups=max_groups))
-                cols, _ = Coordinator(list(cluster_urls)).execute(plan, sf=sf)
+                cols, names = Coordinator(list(cluster_urls)).execute(plan,
+                                                                      sf=sf)
                 nrows = len(cols[0][0]) if cols else 0
-                rows = [tuple(None if cols[c][1][i] else cols[c][0][i]
-                              for c in range(len(cols)))
-                        for i in range(nrows)]
-                runs["cluster"] = sorted(
-                    rows, key=lambda r: tuple(str(x) for x in r))
-            except NotImplementedError:
+                res = QueryResult(columns=[v for v, _ in cols],
+                                  nulls=[n for _, n in cols],
+                                  names=names, row_count=nrows)
+                runs["cluster"] = _canon(res)
+            except SchedulerGap:
                 pass  # declared scheduler-depth gap, not drift
             except Exception as e:  # noqa: BLE001
                 errors["cluster"] = f"{type(e).__name__}: {e}"
