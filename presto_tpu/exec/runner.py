@@ -78,7 +78,8 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
               split_rows: Optional[int] = None,
               scan_ranges: Optional[Dict[str, Tuple[int, int]]] = None,
               remote_sources: Optional[Dict[str, Batch]] = None,
-              memory_pool=None, query_id: str = "query") -> QueryResult:
+              memory_pool=None, query_id: str = "query",
+              session=None) -> QueryResult:
     """Plan -> results, end to end (DistributedQueryRunner analog for
     programmatic plans). With a mesh, scan batches are padded to a
     multiple of the mesh size and the plan runs SPMD. With `split_rows`,
@@ -87,9 +88,16 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     if mesh is not None:
         # make the plan SPMD-correct: single-node operators get the
         # exchanges they need (AddExchanges; idempotent for plans that
-        # already carry PARTIAL/FINAL + exchange structure)
+        # already carry PARTIAL/FINAL + exchange structure). The session's
+        # join_distribution_type picks broadcast vs partitioned joins
+        # (DetermineJoinDistributionType; AUTOMATIC -> broadcast in
+        # round 1, CBO pending)
         from ..plan.distribute import add_exchanges
-        root = add_exchanges(root)
+        strategy = "broadcast"
+        if session is not None and \
+                session.get("join_distribution_type") == "PARTITIONED":
+            strategy = "partitioned"
+        root = add_exchanges(root, join_strategy=strategy)
     from ..plan.validator import validate_plan
     violations = validate_plan(root, distributed=mesh is not None)
     if violations:

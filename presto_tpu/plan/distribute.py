@@ -51,17 +51,22 @@ _GATHER_OPS = (N.SortNode, N.TopNNode, N.LimitNode, N.WindowNode,
                N.RowNumberNode, N.MarkDistinctNode)
 
 
-def add_exchanges(node: N.PlanNode) -> N.PlanNode:
+def add_exchanges(node: N.PlanNode,
+                  join_strategy: str = "broadcast") -> N.PlanNode:
+    """join_strategy: "broadcast" replicates every build side (the safe
+    default); "partitioned" repartitions BOTH join sides by the join
+    keys (DetermineJoinDistributionType's PARTITIONED choice -- right
+    for large builds; cost-based selection is a ROADMAP item)."""
     # rebuild children first
     replaced = {}
     for f in _dc.fields(node):
         v = getattr(node, f.name)
         if isinstance(v, N.PlanNode):
-            nv = add_exchanges(v)
+            nv = add_exchanges(v, join_strategy)
             if nv is not v:
                 replaced[f.name] = nv
         elif isinstance(v, list) and v and isinstance(v[0], N.PlanNode):
-            nl = [add_exchanges(s) for s in v]
+            nl = [add_exchanges(s, join_strategy) for s in v]
             if any(a is not b for a, b in zip(nl, v)):
                 replaced[f.name] = nl
     if replaced:
@@ -99,11 +104,24 @@ def add_exchanges(node: N.PlanNode) -> N.PlanNode:
         return node
 
     if isinstance(node, N.JoinNode):
-        # round-1 distribution strategy: replicate the build side via an
-        # explicit REMOTE REPLICATE exchange (the mesh tier lowers it to
-        # all_gather; the HTTP tier cuts a fragment whose one buffer all
-        # consumers pull). distribution flips to broadcast so lowering
-        # knows the build side is complete on every worker.
+        if join_strategy == "partitioned":
+            # repartition BOTH sides by the join keys: consumers then see
+            # co-partitioned inputs and join locally (the large-build
+            # PARTITIONED distribution). Skip if exchanges are present.
+            left, right = node.left, node.right
+            if not isinstance(left, N.ExchangeNode):
+                left = N.ExchangeNode(left, kind="REPARTITION",
+                                      scope="REMOTE",
+                                      partition_channels=list(node.left_keys))
+            if not isinstance(right, N.ExchangeNode):
+                right = N.ExchangeNode(right, kind="REPARTITION",
+                                       scope="REMOTE",
+                                       partition_channels=list(node.right_keys))
+            return _dc.replace(node, left=left, right=right,
+                               distribution="partitioned")
+        # broadcast: replicate the build side via an explicit REMOTE
+        # REPLICATE exchange (the mesh tier lowers it to all_gather; the
+        # HTTP tier cuts a fragment whose one buffer all consumers pull).
         right = node.right
         if not (isinstance(right, N.ExchangeNode)
                 and right.kind == "REPLICATE"):
