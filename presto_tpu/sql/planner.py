@@ -488,42 +488,9 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
                   if not isinstance(c, P.InSubquery) and not has_scalar_sub(c)]:
             node = N.FilterNode(node, an.lower(c, scope))
         for c in [c for c in conjs if has_scalar_sub(c)]:
-            # uncorrelated scalar subquery comparison. The subresult is
-            # collapsed through a 1-group aggregation to (value, count):
-            # the join build side is then provably one row, and rows are
-            # dropped when count != 1 (the reference's EnforceSingleRow
-            # raises instead; the error channel lands with task-level
-            # error reporting -- see ROADMAP).
-            sub_node, _ = _plan_any(c.right.query, max_groups, join_capacity)
-            sub_node = _strip_output(sub_node)
-            subt = sub_node.output_types()
-            assert len(subt) == 1, "scalar subquery must produce one column"
-            sub_one = N.AggregationNode(
-                sub_node, [],
-                [AggSpec("min", 0, subt[0]),
-                 AggSpec("count_star", None, T.BIGINT)],
-                step="SINGLE", max_groups=1)
-            nch = len(scope.types)
-            left = N.ProjectNode(node, [
-                E.input_ref(i, scope.types[i]) for i in range(nch)
-            ] + [E.const(1, T.BIGINT)])
-            right = N.ProjectNode(sub_one, [E.const(1, T.BIGINT),
-                                            E.input_ref(0, subt[0]),
-                                            E.input_ref(1, T.BIGINT)])
-            node = N.JoinNode(left, right, [nch], [0], "inner", "broadcast",
-                              right_output_channels=[1, 2],
-                              out_capacity=join_capacity)
-            scalar_ref = E.input_ref(nch + 1, subt[0])
-            count_ref = E.input_ref(nch + 2, T.BIGINT)
-            lhs = an.lower(c.left, scope)
-            opname = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt",
-                      "<=": "le", ">": "gt", ">=": "ge"}[c.op]
-            node = N.FilterNode(node, E.special(
-                "AND", T.BOOLEAN,
-                E.call("le", T.BOOLEAN, count_ref, E.const(1, T.BIGINT)),
-                E.call(opname, T.BOOLEAN, lhs, scalar_ref)))
-            node = N.ProjectNode(node, [
-                E.input_ref(i, scope.types[i]) for i in range(nch)])
+            node = _attach_scalar_filter(node, an.lower(c.left, scope),
+                                         c.op, c.right, max_groups,
+                                         join_capacity)
         for c in [c for c in conjs if isinstance(c, P.InSubquery)]:
                 # uncorrelated IN subquery -> SemiJoinNode + mask filter
                 # (IN-predicate planning, sql/planner's apply/semijoin path)
@@ -581,10 +548,16 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
     if all_aggs or q.group_by:
         node, scope, agg_map, key_map = _plan_aggregation(
             an, node, scope, q, all_aggs, max_groups)
-        out_exprs, names, having_e = _plan_agg_outputs(an, q, scope, agg_map,
-                                                       key_map)
+        out_exprs, names, having_e, having_subs = _plan_agg_outputs(
+            an, q, scope, agg_map, key_map)
         if having_e is not None:
             node = N.FilterNode(node, having_e)
+        for lhs, op, sub in having_subs:
+            # HAVING <agg-expr> op (SELECT ...): attach the 1-row scalar
+            # to the group table via a const-key broadcast join, filter,
+            # and project the agg layout back (q11 shape)
+            node = _attach_scalar_filter(node, lhs, op, sub, max_groups,
+                                         join_capacity)
     else:
         out_exprs = []
         names = []
@@ -718,6 +691,49 @@ def _plan_windows(an, node, scope, q, window_items):
             out_exprs.append(E.input_ref(ch, pre_exprs[ch].type))
         names.append(_item_name(item, i))
     return node, out_exprs, names
+
+
+_CMP_NAMES = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt",
+              "<=": "le", ">": "gt", ">=": "ge"}
+
+
+def _attach_scalar_filter(node: N.PlanNode, lhs: E.RowExpression, op: str,
+                          sub: "P.ScalarSubquery", max_groups: int,
+                          join_capacity: Optional[int]) -> N.PlanNode:
+    """Filter `node` rows by `lhs op (scalar subquery)`: the subresult is
+    collapsed to (value, count) through a 1-group aggregation (provably
+    one build row; rows drop when count != 1 -- EnforceSingleRow's error
+    lands with task-level error channels), broadcast-joined on a
+    constant key, compared, and the original channel layout projected
+    back."""
+    sub_node, _ = _plan_any(sub.query, max_groups, join_capacity)
+    sub_node = _strip_output(sub_node)
+    subt = sub_node.output_types()
+    assert len(subt) == 1, "scalar subquery must produce one column"
+    sub_one = N.AggregationNode(
+        sub_node, [],
+        [AggSpec("min", 0, subt[0]),
+         AggSpec("count_star", None, T.BIGINT)],
+        step="SINGLE", max_groups=1)
+    ntypes = node.output_types()
+    nch = len(ntypes)
+    left = N.ProjectNode(node, [
+        E.input_ref(i, ntypes[i]) for i in range(nch)
+    ] + [E.const(1, T.BIGINT)])
+    right = N.ProjectNode(sub_one, [E.const(1, T.BIGINT),
+                                    E.input_ref(0, subt[0]),
+                                    E.input_ref(1, T.BIGINT)])
+    joined = N.JoinNode(left, right, [nch], [0], "inner", "broadcast",
+                        right_output_channels=[1, 2],
+                        out_capacity=join_capacity)
+    scalar_ref = E.input_ref(nch + 1, subt[0])
+    count_ref = E.input_ref(nch + 2, T.BIGINT)
+    f = N.FilterNode(joined, E.special(
+        "AND", T.BOOLEAN,
+        E.call("le", T.BOOLEAN, count_ref, E.const(1, T.BIGINT)),
+        E.call(_CMP_NAMES[op], T.BOOLEAN, lhs, scalar_ref)))
+    return N.ProjectNode(f, [
+        E.input_ref(i, ntypes[i]) for i in range(nch)])
 
 
 def _item_name(item: P.SelectItem, i: int) -> str:
@@ -857,8 +873,21 @@ def _plan_agg_outputs(an, q, pre_scope, agg_map, key_map):
         out_exprs.append(e)
         names.append(_item_name(item, i))
 
-    having_e = rewrite(q.having, key_types) if q.having is not None else None
-    return out_exprs, names, having_e
+    having_e = None
+    having_scalar_subs = []
+    if q.having is not None:
+        for conj in _conjuncts(q.having):
+            if isinstance(conj, P.BinOp) and \
+                    isinstance(conj.right, P.ScalarSubquery):
+                # lhs rewritten over agg channels; subquery planned by
+                # the caller (needs join plumbing above the agg node)
+                having_scalar_subs.append(
+                    (rewrite(conj.left, key_types), conj.op, conj.right))
+            else:
+                e = rewrite(conj, key_types)
+                having_e = e if having_e is None else \
+                    E.special("AND", T.BOOLEAN, having_e, e)
+    return out_exprs, names, having_e, having_scalar_subs
 
 
 def sql(query_text: str, sf: float = 0.01, mesh=None,

@@ -161,6 +161,30 @@ def test_tpch_q16_not_in_distinct():
     assert got == [(c, k) for c, k in ordered]
 
 
+def test_tpch_q11_having_scalar_subquery():
+    res = sql("""
+      SELECT ps.partkey, sum(ps.supplycost * ps.availqty) AS value
+      FROM partsupp ps
+      GROUP BY ps.partkey
+      HAVING sum(ps.supplycost * ps.availqty) >
+             (SELECT sum(supplycost * availqty) * 0.001 FROM partsupp)
+      ORDER BY value DESC LIMIT 25
+    """, sf=SF, max_groups=1 << 13, join_capacity=1 << 15)
+    ps = tpch.generate_columns("partsupp", SF,
+                               ["partkey", "supplycost", "availqty"])
+    per = collections.Counter()
+    total = 0
+    for pk, sc, aq in zip(ps["partkey"], ps["supplycost"], ps["availqty"]):
+        v = int(sc) * int(aq)
+        per[int(pk)] += v
+        total += v
+    # SQL: total(scale 2) * 0.001(scale 3) -> scale 5; comparison rescales
+    thresh5 = total * 1  # value at scale 2 vs threshold at scale 5
+    keep = {k: v for k, v in per.items() if v * 1000 > thresh5}
+    want = sorted(keep.values(), reverse=True)[:25]
+    assert [r[1] for r in res.rows()] == want
+
+
 def test_tpch_q22_shape():
     # customers with above-average balance and no orders, by phone prefix
     res = sql("""
