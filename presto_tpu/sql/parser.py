@@ -122,6 +122,7 @@ class SelectItem:
 class TableRef:
     name: str
     alias: Optional[str]
+    subquery: Optional[object] = None  # derived table: (SELECT ...) alias
 
 
 @dataclasses.dataclass
@@ -195,7 +196,7 @@ _KEYWORDS = {
     "case", "when", "then", "else", "end", "cast", "join", "inner", "left",
     "on", "true", "false", "asc", "desc", "nulls", "first", "last", "date",
     "interval", "day", "month", "year", "extract", "outer", "over",
-    "partition", "union", "intersect", "except", "all",
+    "partition", "union", "intersect", "except", "all", "with",
 }
 
 
@@ -544,6 +545,16 @@ class _Parser:
         return SelectItem(e, alias)
 
     def _table_ref(self) -> TableRef:
+        if self.accept_op("("):
+            sub = self.query()
+            self.expect_op(")")
+            alias = None
+            if self.accept_kw("as"):
+                alias = self.expect_ident()
+            elif self.peek()[0] == "ident":
+                alias = self.next()[1]
+            assert alias, "derived table requires an alias"
+            return TableRef(alias.lower(), alias, subquery=sub)
         name = self.expect_ident()
         alias = None
         if self.accept_kw("as"):
@@ -570,8 +581,56 @@ class _Parser:
 
 def parse_sql(text: str):
     p = _Parser(_tokenize(text))
+    ctes = {}
+    if p.accept_kw("with"):
+        while True:
+            name = p.expect_ident().lower()
+            p.expect_kw("as")
+            p.expect_op("(")
+            ctes[name] = p.query()
+            p.expect_op(")")
+            if not p.accept_op(","):
+                break
     q = p.query()
     k, v = p.peek()
     if k != "eof":
         raise ValueError(f"trailing tokens at {(k, v)}")
+    if ctes:
+        # earlier CTEs are visible inside later CTE bodies (no recursion)
+        names = list(ctes)
+        for i, n in enumerate(names):
+            _inline_ctes(ctes[n], {m: ctes[m] for m in names[:i]})
+        _inline_ctes(q, ctes)
     return q
+
+
+def _inline_ctes(q, ctes):
+    """CTEs inline as derived tables at each reference -- anywhere in the
+    AST, including FROM clauses of scalar/IN subqueries (the reference's
+    default; materialized CTEs are an optimizer feature)."""
+    seen = set()
+
+    def visit(obj):
+        if id(obj) in seen or not dataclasses.is_dataclass(obj):
+            return
+        seen.add(id(obj))
+        if isinstance(obj, TableRef):
+            if obj.subquery is None and obj.name in ctes:
+                obj.subquery = ctes[obj.name]
+            if obj.subquery is not None:
+                visit(obj.subquery)
+            return
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            if dataclasses.is_dataclass(v):
+                visit(v)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    if dataclasses.is_dataclass(x):
+                        visit(x)
+                    elif isinstance(x, tuple):
+                        for y in x:
+                            if dataclasses.is_dataclass(y):
+                                visit(y)
+
+    visit(q)

@@ -356,10 +356,24 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
 
     table_catalog = {}
     table_schemas = {}
+    derived_plans: Dict[str, Tuple[N.PlanNode, List[str]]] = {}
     for t in tables:
-        cat, sch = find_table(t.name)
-        table_catalog[t.name] = cat
-        table_schemas[t.name] = sch
+        if t.subquery is not None:
+            # derived table / inlined CTE: plan the sub-select; its
+            # output names+types form the "schema"
+            sub_node, sub_names = _plan_any(t.subquery, max_groups,
+                                            join_capacity)
+            sub_node = _strip_output(sub_node)
+            sub_types = sub_node.output_types()
+            table_catalog[t.name] = None
+            table_schemas[t.name] = {n.lower(): ty for n, ty in
+                                     zip(sub_names, sub_types)}
+            derived_plans[t.name] = (sub_node,
+                                     [n.lower() for n in sub_names])
+        else:
+            cat, sch = find_table(t.name)
+            table_catalog[t.name] = cat
+            table_schemas[t.name] = sch
 
     referenced: Dict[str, List[str]] = {t.name: [] for t in tables}
 
@@ -420,6 +434,10 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
 
     # build scans + running scope over the join chain
     def scan_for(t: P.TableRef) -> Tuple[N.PlanNode, List[str], List[T.Type]]:
+        if t.name in derived_plans:
+            sub_node, sub_cols = derived_plans[t.name]
+            tys = [table_schemas[t.name][c] for c in sub_cols]
+            return sub_node, sub_cols, tys
         cols = referenced[t.name] or [next(iter(table_schemas[t.name]))]
         tys = [table_schemas[t.name][c] for c in cols]
         return (N.TableScanNode(table_catalog[t.name], t.name, cols, tys),

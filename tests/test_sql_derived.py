@@ -1,0 +1,85 @@
+"""Derived tables (FROM subqueries) and CTEs, incl. the q13/q15 shapes
+(aggregation over aggregation; named revenue view)."""
+
+import collections
+
+import numpy as np
+
+from presto_tpu.connectors import tpch
+from presto_tpu.sql import sql
+
+SF = 0.01
+EPOCH = np.datetime64("1970-01-01")
+
+
+def d(s):
+    return int((np.datetime64(s) - EPOCH).astype(int))
+
+
+def test_from_subquery_basic():
+    r = sql("SELECT big.custkey FROM (SELECT custkey, totalprice "
+            "FROM orders WHERE totalprice > 400000.00) big "
+            "ORDER BY big.custkey LIMIT 5", sf=SF)
+    oc = tpch.generate_columns("orders", SF, ["custkey", "totalprice"])
+    want = sorted(int(c) for c, p in zip(oc["custkey"], oc["totalprice"])
+                  if p > 40000000)[:5]
+    assert [x[0] for x in r.rows()] == want
+
+
+def test_tpch_q13_agg_over_agg():
+    # distribution of customers by order count (outer agg over inner agg)
+    r = sql("""
+      SELECT c_count, count(*) AS custdist
+      FROM (SELECT custkey, count(*) AS c_count FROM orders
+            GROUP BY custkey) c_orders
+      GROUP BY c_count ORDER BY custdist DESC, c_count DESC
+    """, sf=SF, max_groups=1 << 13)
+    oc = tpch.generate_columns("orders", SF, ["custkey"])
+    per = collections.Counter(int(c) for c in oc["custkey"])
+    dist = collections.Counter(per.values())
+    want = sorted(dist.items(), key=lambda kv: (-kv[1], -kv[0]))
+    assert [(row[0], row[1]) for row in r.rows()] == want
+
+
+def test_tpch_q15_cte_revenue_view():
+    r = sql("""
+      WITH revenue AS (
+        SELECT suppkey AS supplier_no,
+               sum(extendedprice * (1 - discount)) AS total_revenue
+        FROM lineitem
+        WHERE shipdate >= date '1996-01-01' AND shipdate < date '1996-04-01'
+        GROUP BY suppkey)
+      SELECT s.suppkey, r.total_revenue
+      FROM supplier s JOIN revenue r ON s.suppkey = r.supplier_no
+      WHERE r.total_revenue >
+            (SELECT max(total_revenue) * 0.999 FROM revenue)
+      ORDER BY s.suppkey
+    """, sf=SF, max_groups=1 << 13, join_capacity=1 << 15)
+    li = tpch.generate_columns("lineitem", SF,
+                               ["suppkey", "extendedprice", "discount",
+                                "shipdate"])
+    m = (li["shipdate"] >= d("1996-01-01")) & (li["shipdate"] < d("1996-04-01"))
+    rev = collections.Counter()
+    for sk, p, disc in zip(li["suppkey"][m], li["extendedprice"][m],
+                           li["discount"][m]):
+        rev[int(sk)] += int(p) * (100 - int(disc))
+    mx = max(rev.values())
+    # threshold: max(scale 4) * 0.999(scale 3) -> compare at scale 7
+    keep = sorted(k for k, v in rev.items() if v * 1000 > mx * 999)
+    assert [row[0] for row in r.rows()] == keep
+    for row in r.rows():
+        assert row[1] == rev[row[0]]
+
+
+def test_cte_referencing_earlier_cte():
+    r = sql("""
+      WITH big AS (SELECT custkey, totalprice FROM orders
+                   WHERE totalprice > 300000.00),
+           cnts AS (SELECT custkey, count(*) AS c FROM big GROUP BY custkey)
+      SELECT max(c) FROM cnts
+    """, sf=SF, max_groups=1 << 13)
+    oc = tpch.generate_columns("orders", SF, ["custkey", "totalprice"])
+    per = collections.Counter(int(c) for c, p in zip(oc["custkey"],
+                                                     oc["totalprice"])
+                              if p > 30000000)
+    assert r.rows()[0][0] == max(per.values())
