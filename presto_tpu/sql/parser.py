@@ -158,6 +158,12 @@ class ScalarSubquery:
 
 
 @dataclasses.dataclass
+class Exists:
+    query: "Query"
+    negate: bool = False
+
+
+@dataclasses.dataclass
 class Query:
     select: Select
     table: TableRef
@@ -196,7 +202,7 @@ _KEYWORDS = {
     "case", "when", "then", "else", "end", "cast", "join", "inner", "left",
     "on", "true", "false", "asc", "desc", "nulls", "first", "last", "date",
     "interval", "day", "month", "year", "extract", "outer", "over",
-    "partition", "union", "intersect", "except", "all", "with",
+    "partition", "union", "intersect", "except", "all", "with", "exists",
 }
 
 
@@ -381,6 +387,12 @@ class _Parser:
             return Cast(e, tname)
         if k == "kw" and v == "case":
             return self._case()
+        if k == "kw" and v == "exists":
+            self.next()
+            self.expect_op("(")
+            sub = self.query()
+            self.expect_op(")")
+            return Exists(sub)
         if k == "kw" and v == "extract":
             self.next()
             self.expect_op("(")

@@ -402,7 +402,10 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
         elif isinstance(n, P.InSubquery):
             collect_names(n.value)  # the subquery has its own table scope
         elif isinstance(n, P.ScalarSubquery):
-            pass  # fully self-contained scope
+            # self-contained except for correlated equalities
+            _note_correlated(n.query, note_name)
+        elif isinstance(n, P.Exists):
+            _note_correlated(n.query, note_name)
         elif dataclasses.is_dataclass(n):
             for f in dataclasses.fields(n):
                 v = getattr(n, f.name)
@@ -502,13 +505,34 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
             return isinstance(c, P.BinOp) and \
                 isinstance(c.right, P.ScalarSubquery)
 
+        def is_exists(c):
+            return isinstance(c, P.Exists) or \
+                (isinstance(c, P.NotOp) and isinstance(c.arg, P.Exists))
+
         for c in [c for c in conjs
-                  if not isinstance(c, P.InSubquery) and not has_scalar_sub(c)]:
+                  if not isinstance(c, P.InSubquery) and not has_scalar_sub(c)
+                  and not is_exists(c)]:
             node = N.FilterNode(node, an.lower(c, scope))
+        for c in [c for c in conjs if is_exists(c)]:
+            negate = isinstance(c, P.NotOp)
+            ex = c.arg if negate else c
+            node = _decorrelate_exists(an, node, scope, tables,
+                                       table_schemas, ex.query, negate,
+                                       max_groups, join_capacity)
         for c in [c for c in conjs if has_scalar_sub(c)]:
-            node = _attach_scalar_filter(node, an.lower(c.left, scope),
-                                         c.op, c.right, max_groups,
-                                         join_capacity)
+            sub_q2 = c.right.query
+            corr = []
+            if isinstance(sub_q2, P.Query):
+                corr, _ = _split_correlations(sub_q2, tables, table_schemas)
+            if corr:
+                node = _decorrelate_scalar_agg(
+                    an, node, scope, tables, table_schemas,
+                    an.lower(c.left, scope), c.op, sub_q2, max_groups,
+                    join_capacity)
+            else:
+                node = _attach_scalar_filter(node, an.lower(c.left, scope),
+                                             c.op, c.right, max_groups,
+                                             join_capacity)
         for c in [c for c in conjs if isinstance(c, P.InSubquery)]:
                 # uncorrelated IN subquery -> SemiJoinNode + mask filter
                 # (IN-predicate planning, sql/planner's apply/semijoin path)
@@ -589,7 +613,11 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
             out_exprs.append(e)
             names.append(_item_name(item, i))
 
-    # ORDER BY/LIMIT operate on the projected outputs; project first
+    # ORDER BY/LIMIT operate on the projected outputs; project first.
+    # `source_scope` (pre-projection channels) stays available because
+    # hidden ORDER BY expressions are spliced INTO the projection and
+    # must be lowered in the source channel space, not the output's.
+    source_scope = scope
     node = N.ProjectNode(node, out_exprs)
     out_types = [e.type for e in out_exprs]
     scope = _Scope({n.lower(): i for i, n in enumerate(names)}, out_types)
@@ -610,7 +638,8 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
                 ch = int(o.expr.value) - 1
             else:
                 # expression order key: append a hidden projection channel
-                e = _relower_output(an, o.expr, q, scope, names, out_exprs)
+                # (source channel space -- it joins out_exprs)
+                e = _relower_output(an, o.expr, q, source_scope, out_exprs)
                 out_exprs = out_exprs + [e]
                 node = _replace_projection(node, out_exprs)
                 ch = len(out_exprs) - 1
@@ -715,6 +744,234 @@ _CMP_NAMES = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt",
               "<=": "le", ">": "gt", ">=": "ge"}
 
 
+def _note_correlated(sub_q, note_name):
+    """Record the CORRELATED outer columns of a subquery (inner names
+    raise KeyError against the outer schemas and are skipped)."""
+    if not isinstance(sub_q, P.Query) or sub_q.where is None:
+        return
+    for conj in _conjuncts(sub_q.where):
+        if isinstance(conj, P.BinOp) and conj.op == "=":
+            for side in (conj.left, conj.right):
+                if isinstance(side, P.Name):
+                    try:
+                        note_name(side.parts)
+                    except KeyError:
+                        pass
+
+
+def _split_correlations(sub_q, outer_tables, outer_schemas):
+    """Partition a subquery's WHERE into equality correlations
+    [(outer Name, inner Name)] and residual inner-only conjuncts."""
+    inner_aliases = {(t.alias or t.name).lower()
+                     for t in [sub_q.table] + [j.table for j in sub_q.joins]}
+    outer_aliases = {(t.alias or t.name).lower() for t in outer_tables}
+
+    def side_of(nm: P.Name):
+        if len(nm.parts) == 2:
+            a = nm.parts[0].lower()
+            if a in inner_aliases:
+                return "inner"
+            if a in outer_aliases:
+                return "outer"
+            return None
+        col = nm.parts[0].lower()
+        in_outer = any(col in outer_schemas[t.name] for t in outer_tables)
+        return "outer" if in_outer else "inner"
+
+    corr, residual = [], []
+    for conj in (_conjuncts(sub_q.where) if sub_q.where is not None else []):
+        if isinstance(conj, P.BinOp) and conj.op == "=" and \
+                isinstance(conj.left, P.Name) and \
+                isinstance(conj.right, P.Name):
+            sides = (side_of(conj.left), side_of(conj.right))
+            if sides == ("outer", "inner"):
+                corr.append((conj.left, conj.right))
+                continue
+            if sides == ("inner", "outer"):
+                corr.append((conj.right, conj.left))
+                continue
+        residual.append(conj)
+    return corr, residual
+
+
+def _decorrelate_scalar_agg(an, node, scope, outer_tables, outer_schemas,
+                            lhs, op, sub_q, max_groups, join_capacity):
+    """`expr op (SELECT agg... WHERE inner.k = outer.k ...)` -> group the
+    subquery by its correlation columns, inner-join on them, compare
+    (TransformCorrelatedScalarAggregation analog). An outer row with no
+    inner group drops -- identical to the NULL-comparison semantics."""
+    corr, residual = _split_correlations(sub_q, outer_tables, outer_schemas)
+    assert corr, "not a correlated scalar aggregate"
+    sub_ast = dataclasses.replace(
+        sub_q,
+        select=P.Select([P.SelectItem(inner, f"_corr{i}")
+                         for i, (_, inner) in enumerate(corr)]
+                        + list(sub_q.select.items), False),
+        where=_and_all(residual),
+        group_by=[inner for _, inner in corr])
+    sub_node, _ = _plan_any(sub_ast, max_groups, join_capacity)
+    sub_node = _strip_output(sub_node)
+    subt = sub_node.output_types()
+    ncorr = len(corr)
+    assert len(subt) == ncorr + 1, "scalar subquery must produce one column"
+
+    outer_chs = []
+    for outer_nm, _ in corr:
+        e = an.lower(outer_nm, scope)
+        assert isinstance(e, E.InputReference)
+        outer_chs.append(e.channel)
+
+    ntypes = node.output_types()
+    nch = len(ntypes)
+    joined = N.JoinNode(node, sub_node, outer_chs, list(range(ncorr)),
+                        "inner", "broadcast",
+                        right_output_channels=[ncorr],
+                        out_capacity=join_capacity)
+    scalar_ref = E.input_ref(nch, subt[ncorr])
+    f = N.FilterNode(joined, E.call(_CMP_NAMES[op], T.BOOLEAN, lhs,
+                                    scalar_ref))
+    return N.ProjectNode(f, [E.input_ref(i, ntypes[i]) for i in range(nch)])
+
+
+def _and_all(conjs):
+    out = None
+    for c in conjs:
+        out = c if out is None else P.BinOp("and", out, c)
+    return out
+
+
+def _has_outer_name(conj, outer_tables, outer_schemas, inner_aliases):
+    """Does this conjunct reference any OUTER column?"""
+    outer_aliases = {(t.alias or t.name).lower() for t in outer_tables}
+    found = []
+
+    def walk(n):
+        if isinstance(n, P.Name):
+            if len(n.parts) == 2:
+                a = n.parts[0].lower()
+                if a in outer_aliases and a not in inner_aliases:
+                    found.append(n)
+            else:
+                col = n.parts[0].lower()
+                if any(col in outer_schemas[t.name] for t in outer_tables):
+                    found.append(n)
+        elif dataclasses.is_dataclass(n):
+            for f in dataclasses.fields(n):
+                v = getattr(n, f.name)
+                if dataclasses.is_dataclass(v):
+                    walk(v)
+                elif isinstance(v, (list, tuple)):
+                    for x in v:
+                        if dataclasses.is_dataclass(x):
+                            walk(x)
+
+    walk(conj)
+    return bool(found)
+
+
+def _decorrelate_exists(an, node, scope, outer_tables, outer_schemas,
+                        sub_q, negate, max_groups, join_capacity):
+    """EXISTS/NOT EXISTS with equality correlations -> semi/anti join;
+    additional CORRELATED residual predicates (e.g. q21's
+    `l2.suppkey <> l1.suppkey`) decorrelate through the general
+    unique-id route: join candidates on the equalities, filter the
+    residuals over the combined row, and semi-join outer rows on their
+    unique ids (TransformCorrelated* rule family)."""
+    assert isinstance(sub_q, P.Query), "EXISTS over set operations: later"
+    corr, residual = _split_correlations(sub_q, outer_tables, outer_schemas)
+    assert corr, ("EXISTS subquery has no `inner.col = outer.col` equality "
+                  "correlation; general correlated subqueries are a ROADMAP "
+                  "item")
+    inner_aliases = {(t.alias or t.name).lower()
+                     for t in [sub_q.table] + [j.table for j in sub_q.joins]}
+    corr_residual = [c for c in residual
+                     if _has_outer_name(c, outer_tables, outer_schemas,
+                                        inner_aliases)]
+    inner_residual = [c for c in residual if c not in corr_residual]
+
+    ntypes = node.output_types()
+    nch = len(ntypes)
+
+    if not corr_residual:
+        # pure equi-correlation: direct semi/anti join
+        sub_ast = dataclasses.replace(
+            sub_q,
+            select=P.Select([P.SelectItem(inner, None) for _, inner in corr],
+                            False),
+            where=_and_all(inner_residual))
+        sub_node, _ = _plan_any(sub_ast, max_groups, join_capacity)
+        sub_node = _strip_output(sub_node)
+        outer_chs = [an.lower(nm, scope).channel for nm, _ in corr]
+        sj = N.SemiJoinNode(node, sub_node, outer_chs,
+                            list(range(len(corr))))
+        mask = E.input_ref(nch, T.BOOLEAN)
+    else:
+        # general route: tag outer rows with unique ids, join candidate
+        # inner rows on the equalities, filter correlated residuals over
+        # the combined row, and test uid membership
+        node_u = N.AssignUniqueIdNode(node)
+        uid_ch = nch
+
+        # inner select: equality columns first, then every inner column
+        # the correlated residuals need
+        inner_needed: List[P.Name] = []
+
+        def collect_inner(n):
+            if isinstance(n, P.Name):
+                if (len(n.parts) == 2 and n.parts[0].lower() in inner_aliases):
+                    if n.parts not in [x.parts for x in inner_needed]:
+                        inner_needed.append(n)
+            elif dataclasses.is_dataclass(n):
+                for f in dataclasses.fields(n):
+                    v = getattr(n, f.name)
+                    if dataclasses.is_dataclass(v):
+                        collect_inner(v)
+                    elif isinstance(v, (list, tuple)):
+                        for x in v:
+                            if dataclasses.is_dataclass(x):
+                                collect_inner(x)
+        for c in corr_residual:
+            collect_inner(c)
+        sub_ast = dataclasses.replace(
+            sub_q,
+            select=P.Select([P.SelectItem(inner, None) for _, inner in corr]
+                            + [P.SelectItem(nm, None) for nm in inner_needed],
+                            False),
+            where=_and_all(inner_residual))
+        sub_node, _ = _plan_any(sub_ast, max_groups, join_capacity)
+        sub_node = _strip_output(sub_node)
+        subt = sub_node.output_types()
+        ncorr = len(corr)
+        outer_chs = [an.lower(nm, scope).channel for nm, _ in corr]
+        joined = N.JoinNode(node_u, sub_node, outer_chs,
+                            list(range(ncorr)), "inner", "broadcast",
+                            right_output_channels=list(
+                                range(ncorr, len(subt))),
+                            out_capacity=join_capacity)
+        # combined scope: outer channels as-is, appended inner columns
+        comb_channels = dict(scope.channels)
+        comb_types = list(ntypes) + [T.BIGINT] + \
+            [subt[ncorr + i] for i in range(len(inner_needed))]
+        for i, nm in enumerate(inner_needed):
+            comb_channels[".".join(nm.parts).lower()] = nch + 1 + i
+        comb_scope = _Scope(comb_channels, comb_types)
+        pred = an.lower(_and_all(corr_residual), comb_scope)
+        survivors = N.ProjectNode(N.FilterNode(joined, pred),
+                                  [E.input_ref(uid_ch, T.BIGINT)])
+        sj = N.SemiJoinNode(node_u, survivors, uid_ch, 0)
+        mask = E.input_ref(nch + 1, T.BOOLEAN)
+
+    if negate:
+        # NOT EXISTS: "no matching row" -- a NULL mask (null outer key)
+        # means no match and must KEEP the row (unlike NOT IN)
+        pred = E.call("not", T.BOOLEAN, E.special(
+            "COALESCE", T.BOOLEAN, mask, E.const(False, T.BOOLEAN)))
+    else:
+        pred = mask
+    f = N.FilterNode(sj, pred)
+    return N.ProjectNode(f, [E.input_ref(i, ntypes[i]) for i in range(nch)])
+
+
 def _attach_scalar_filter(node: N.PlanNode, lhs: E.RowExpression, op: str,
                           sub: "P.ScalarSubquery", max_groups: int,
                           join_capacity: Optional[int]) -> N.PlanNode:
@@ -769,13 +1026,15 @@ def _replace_projection(node: N.PlanNode, exprs) -> N.PlanNode:
     return N.ProjectNode(node.source, list(exprs))
 
 
-def _relower_output(an, expr, q, scope, names, out_exprs):
-    """Lower an ORDER BY expression over the OUTPUT scope (select aliases
-    visible). Falls back to matching an identical select expression."""
+def _relower_output(an, expr, q, source_scope, out_exprs):
+    """Produce a SOURCE-channel-space expression for an ORDER BY key that
+    is spliced into the output projection: an identical select
+    expression reuses its already-lowered form; otherwise the key
+    lowers against the pre-projection scope."""
     for i, item in enumerate(q.select.items):
         if item.expr == expr:
-            return E.input_ref(i, out_exprs[i].type)
-    return an.lower(expr, scope)
+            return out_exprs[i]
+    return an.lower(expr, source_scope)
 
 
 def _conjuncts(e) -> List[object]:
