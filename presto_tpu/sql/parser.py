@@ -565,7 +565,8 @@ class _Parser:
                 alias = self.expect_ident()
             elif self.peek()[0] == "ident":
                 alias = self.next()[1]
-            assert alias, "derived table requires an alias"
+            if not alias:
+                raise ValueError("derived table requires an alias")
             return TableRef(alias.lower(), alias, subquery=sub)
         name = self.expect_ident()
         alias = None

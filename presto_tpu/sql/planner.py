@@ -501,6 +501,19 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
         # plain conjuncts first: shrink rows before the semijoin probes
         conjs = _conjuncts(q.where)
 
+        _MIRROR = {"<": ">", ">": "<", "<=": ">=", ">=": "<=",
+                   "=": "=", "<>": "<>", "!=": "!="}
+
+        def _normalize_scalar_side(c):
+            # (SELECT ...) op expr  ->  expr mirrored-op (SELECT ...)
+            if isinstance(c, P.BinOp) and c.op in _MIRROR and \
+                    isinstance(c.left, P.ScalarSubquery) and \
+                    not isinstance(c.right, P.ScalarSubquery):
+                return P.BinOp(_MIRROR[c.op], c.right, c.left)
+            return c
+
+        conjs = [_normalize_scalar_side(c) for c in conjs]
+
         def has_scalar_sub(c):
             return isinstance(c, P.BinOp) and \
                 isinstance(c.right, P.ScalarSubquery)
@@ -521,14 +534,15 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
                                        max_groups, join_capacity)
         for c in [c for c in conjs if has_scalar_sub(c)]:
             sub_q2 = c.right.query
-            corr = []
+            corr, residual2 = ([], [])
             if isinstance(sub_q2, P.Query):
-                corr, _ = _split_correlations(sub_q2, tables, table_schemas)
+                corr, residual2 = _split_correlations(sub_q2, tables,
+                                                      table_schemas)
             if corr:
                 node = _decorrelate_scalar_agg(
                     an, node, scope, tables, table_schemas,
                     an.lower(c.left, scope), c.op, sub_q2, max_groups,
-                    join_capacity)
+                    join_capacity, corr, residual2)
             else:
                 node = _attach_scalar_filter(node, an.lower(c.left, scope),
                                              c.op, c.right, max_groups,
@@ -598,6 +612,14 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
             # HAVING <agg-expr> op (SELECT ...): attach the 1-row scalar
             # to the group table via a const-key broadcast join, filter,
             # and project the agg layout back (q11 shape)
+            if isinstance(sub.query, P.Query):
+                corr_h, _ = _split_correlations(sub.query, tables,
+                                                table_schemas)
+                if corr_h:
+                    raise NotImplementedError(
+                        "correlated scalar subquery in HAVING is not "
+                        "supported (decorrelate over the aggregate output "
+                        "is a ROADMAP item)")
             node = _attach_scalar_filter(node, lhs, op, sub, max_groups,
                                          join_capacity)
     else:
@@ -759,6 +781,22 @@ def _note_correlated(sub_q, note_name):
                         pass
 
 
+def _inner_binds(sub_q, col: str) -> bool:
+    """Can an unqualified column bind to one of the subquery's tables?
+    SQL scoping prefers the INNERMOST binding, so this check runs before
+    any outer-schema lookup. Derived inner tables conservatively bind
+    everything (their schema isn't known without planning)."""
+    from ..connectors import catalogs
+    cats = catalogs()
+    for t in [sub_q.table] + [j.table for j in sub_q.joins]:
+        if t.subquery is not None:
+            return True
+        for cat in cats.values():
+            if t.name in cat.SCHEMA and col in dict(cat.SCHEMA[t.name]):
+                return True
+    return False
+
+
 def _split_correlations(sub_q, outer_tables, outer_schemas):
     """Partition a subquery's WHERE into equality correlations
     [(outer Name, inner Name)] and residual inner-only conjuncts."""
@@ -775,6 +813,8 @@ def _split_correlations(sub_q, outer_tables, outer_schemas):
                 return "outer"
             return None
         col = nm.parts[0].lower()
+        if _inner_binds(sub_q, col):  # innermost scope binds first
+            return "inner"
         in_outer = any(col in outer_schemas[t.name] for t in outer_tables)
         return "outer" if in_outer else "inner"
 
@@ -795,20 +835,32 @@ def _split_correlations(sub_q, outer_tables, outer_schemas):
 
 
 def _decorrelate_scalar_agg(an, node, scope, outer_tables, outer_schemas,
-                            lhs, op, sub_q, max_groups, join_capacity):
+                            lhs, op, sub_q, max_groups, join_capacity,
+                            corr, residual):
     """`expr op (SELECT agg... WHERE inner.k = outer.k ...)` -> group the
-    subquery by its correlation columns, inner-join on them, compare
-    (TransformCorrelatedScalarAggregation analog). An outer row with no
-    inner group drops -- identical to the NULL-comparison semantics."""
-    corr, residual = _split_correlations(sub_q, outer_tables, outer_schemas)
+    subquery by its correlation columns, LEFT-join on them, compare
+    (TransformCorrelatedScalarAggregationToJoin analog). Outer rows with
+    no inner group see a NULL scalar (comparison filters them) -- except
+    pure count aggregates, whose empty-group value is 0 via COALESCE."""
     assert corr, "not a correlated scalar aggregate"
+    if sub_q.group_by:
+        raise NotImplementedError(
+            "correlated scalar subquery with its own GROUP BY (multi-row "
+            "per outer key) is not supported")
+    if any(_has_outer_name(c, outer_tables, outer_schemas,
+                           {(t.alias or t.name).lower() for t in
+                            [sub_q.table] + [j.table for j in sub_q.joins]},
+                           sub_q) for c in residual):
+        raise NotImplementedError(
+            "correlated scalar subquery with non-equality correlations")
     sub_ast = dataclasses.replace(
         sub_q,
         select=P.Select([P.SelectItem(inner, f"_corr{i}")
                          for i, (_, inner) in enumerate(corr)]
                         + list(sub_q.select.items), False),
         where=_and_all(residual),
-        group_by=[inner for _, inner in corr])
+        group_by=[inner for _, inner in corr],
+        order_by=[], limit=None)
     sub_node, _ = _plan_any(sub_ast, max_groups, join_capacity)
     sub_node = _strip_output(sub_node)
     subt = sub_node.output_types()
@@ -824,10 +876,15 @@ def _decorrelate_scalar_agg(an, node, scope, outer_tables, outer_schemas,
     ntypes = node.output_types()
     nch = len(ntypes)
     joined = N.JoinNode(node, sub_node, outer_chs, list(range(ncorr)),
-                        "inner", "broadcast",
+                        "left", "broadcast",
                         right_output_channels=[ncorr],
                         out_capacity=join_capacity)
     scalar_ref = E.input_ref(nch, subt[ncorr])
+    sub_aggs = _Analyzer(sub_q).find_aggs(sub_q.select.items[0].expr)
+    if sub_aggs and all(a.name == "count" for a in sub_aggs):
+        # count over an empty correlation group is 0, not NULL
+        scalar_ref = E.special("COALESCE", subt[ncorr], scalar_ref,
+                               E.const(0, subt[ncorr]))
     f = N.FilterNode(joined, E.call(_CMP_NAMES[op], T.BOOLEAN, lhs,
                                     scalar_ref))
     return N.ProjectNode(f, [E.input_ref(i, ntypes[i]) for i in range(nch)])
@@ -840,8 +897,10 @@ def _and_all(conjs):
     return out
 
 
-def _has_outer_name(conj, outer_tables, outer_schemas, inner_aliases):
-    """Does this conjunct reference any OUTER column?"""
+def _has_outer_name(conj, outer_tables, outer_schemas, inner_aliases,
+                    sub_q):
+    """Does this conjunct reference any OUTER column? (Innermost scope
+    binds unqualified names first, mirroring _split_correlations.)"""
     outer_aliases = {(t.alias or t.name).lower() for t in outer_tables}
     found = []
 
@@ -853,7 +912,9 @@ def _has_outer_name(conj, outer_tables, outer_schemas, inner_aliases):
                     found.append(n)
             else:
                 col = n.parts[0].lower()
-                if any(col in outer_schemas[t.name] for t in outer_tables):
+                if not _inner_binds(sub_q, col) and \
+                        any(col in outer_schemas[t.name]
+                            for t in outer_tables):
                     found.append(n)
         elif dataclasses.is_dataclass(n):
             for f in dataclasses.fields(n):
@@ -884,9 +945,15 @@ def _decorrelate_exists(an, node, scope, outer_tables, outer_schemas,
                   "item")
     inner_aliases = {(t.alias or t.name).lower()
                      for t in [sub_q.table] + [j.table for j in sub_q.joins]}
+    if sub_q.group_by or sub_q.having is not None:
+        raise NotImplementedError(
+            "EXISTS over GROUP BY/HAVING subqueries is not supported yet")
+    # ORDER BY/LIMIT inside EXISTS don't affect (non)emptiness: drop them
+    # rather than letting a LIMIT truncate the filtering side globally
+    sub_q = dataclasses.replace(sub_q, order_by=[], limit=None)
     corr_residual = [c for c in residual
                      if _has_outer_name(c, outer_tables, outer_schemas,
-                                        inner_aliases)]
+                                        inner_aliases, sub_q)]
     inner_residual = [c for c in residual if c not in corr_residual]
 
     ntypes = node.output_types()
