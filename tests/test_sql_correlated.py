@@ -199,6 +199,45 @@ def test_tpch_q21_correlated_inequality_exists():
     assert got == ordered
 
 
+def test_unqualified_names_bind_innermost():
+    # commitdate/receiptdate inside the subquery bind to l2 (inner), not
+    # the outer table, per SQL scoping
+    r = sql("""SELECT count(*) FROM orders o
+      WHERE EXISTS (SELECT l.orderkey FROM lineitem l
+                    WHERE l.orderkey = o.orderkey
+                      AND commitdate > receiptdate)""",
+            sf=SF, max_groups=4, join_capacity=1 << 17)
+    li = tpch.generate_columns("lineitem", SF,
+                               ["orderkey", "commitdate", "receiptdate"])
+    keys = set(int(k) for k, c, rc in zip(li["orderkey"], li["commitdate"],
+                                          li["receiptdate"]) if c > rc)
+    od = tpch.generate_columns("orders", SF, ["orderkey"])
+    want = sum(1 for k in od["orderkey"] if int(k) in keys)
+    assert r.rows()[0][0] == want
+
+
+def test_limit_inside_exists_is_per_row():
+    r = sql("""SELECT count(*) FROM part p
+      WHERE EXISTS (SELECT ps.partkey FROM partsupp ps
+                    WHERE ps.partkey = p.partkey LIMIT 1)""", sf=SF,
+            max_groups=4, join_capacity=1 << 15)
+    assert r.rows()[0][0] == tpch.table_row_count("part", SF)
+
+
+def test_correlated_count_star_zero_matches():
+    # count(*) over an empty correlation group is 0, and the scalar
+    # subquery may sit on the LEFT of the comparison
+    r = sql("""SELECT count(*) FROM customer c
+      WHERE (SELECT count(*) FROM orders o
+             WHERE o.custkey = c.custkey) < 5""",
+            sf=SF, max_groups=1 << 12, join_capacity=1 << 15)
+    oc = tpch.generate_columns("orders", SF, ["custkey"])
+    per = collections.Counter(int(x) for x in oc["custkey"])
+    cu = tpch.generate_columns("customer", SF, ["custkey"])
+    want = sum(1 for ck in cu["custkey"] if per.get(int(ck), 0) < 5)
+    assert r.rows()[0][0] == want
+
+
 def test_exists_with_residual_inner_filter():
     r = sql("""
       SELECT count(*) FROM part p
