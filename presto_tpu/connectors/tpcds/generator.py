@@ -71,6 +71,15 @@ TPCDS_SCHEMA: Dict[str, List[Tuple[str, T.Type]]] = {
         ("s_store_sk", T.BIGINT), ("s_store_id", T.varchar(16)),
         ("s_store_name", T.varchar(50)), ("s_state", T.varchar(2)),
     ],
+    "time_dim": [
+        ("t_time_sk", T.BIGINT), ("t_hour", T.INTEGER),
+        ("t_minute", T.INTEGER), ("t_second", T.INTEGER),
+        ("t_meal_time", T.varchar(20)),
+    ],
+    "household_demographics": [
+        ("hd_demo_sk", T.BIGINT), ("hd_dep_count", T.INTEGER),
+        ("hd_vehicle_count", T.INTEGER), ("hd_buy_potential", T.varchar(15)),
+    ],
 }
 
 # date_dim spans 1900-01-01 .. 2100-01-01 in the spec; sk is julian-based.
@@ -105,6 +114,10 @@ def table_row_count(table: str, sf: float) -> int:
         return max(int(100_000 * max(sf, 1 / 100) ** 0.5), 1_000)
     if table == "store":
         return max(int(12 * max(sf, 1) ** 0.5), 12)
+    if table == "time_dim":
+        return 86400
+    if table == "household_demographics":
+        return 7200
     raise KeyError(table)
 
 
@@ -299,11 +312,47 @@ def _make_channel_gen(table: str, prefix: str, lines_per_order: int):
     return gen
 
 
+def _gen_time_dim(column, idx, sf):
+    secs = idx.astype(np.int64)
+    if column == "t_time_sk":
+        return secs
+    if column == "t_hour":
+        return (secs // 3600).astype(np.int32)
+    if column == "t_minute":
+        return (secs // 60 % 60).astype(np.int32)
+    if column == "t_second":
+        return (secs % 60).astype(np.int32)
+    if column == "t_meal_time":
+        h = secs // 3600
+        out = np.full(len(idx), "", dtype=object)
+        out[(h >= 6) & (h <= 8)] = "breakfast"
+        out[(h >= 11) & (h <= 13)] = "lunch"
+        out[(h >= 17) & (h <= 20)] = "dinner"
+        return out
+    raise KeyError(f"time_dim.{column}")
+
+
+def _gen_household_demographics(column, idx, sf):
+    if column == "hd_demo_sk":
+        return (idx + 1).astype(np.int64)
+    if column == "hd_dep_count":
+        return (idx % 10).astype(np.int32)
+    if column == "hd_vehicle_count":
+        return (idx // 10 % 5).astype(np.int32)
+    if column == "hd_buy_potential":
+        return _pick("household_demographics", "buy", idx,
+                     ["0-500", "501-1000", "1001-5000", "5001-10000",
+                      ">10000", "Unknown"])
+    raise KeyError(f"household_demographics.{column}")
+
+
 _GENERATORS = {
     "store_sales": _gen_store_sales, "date_dim": _gen_date_dim,
     "item": _gen_item, "customer": _gen_customer, "store": _gen_store,
     "catalog_sales": _make_channel_gen("catalog_sales", "cs_", 10),
     "web_sales": _make_channel_gen("web_sales", "ws_", 12),
+    "time_dim": _gen_time_dim,
+    "household_demographics": _gen_household_demographics,
 }
 
 

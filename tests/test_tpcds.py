@@ -87,6 +87,56 @@ def test_tpcds_q3_family():
         assert (a[0], -a[3]) <= (b[0], -b[3])
 
 
+def test_tpcds_q55_shape():
+    res = sql("""
+      SELECT i.i_brand_id, i.i_brand, sum(ss.ss_ext_sales_price) AS s
+      FROM store_sales ss
+      JOIN date_dim d ON ss.ss_sold_date_sk = d.d_date_sk
+      JOIN item i ON ss.ss_item_sk = i.i_item_sk
+      WHERE i.i_manager_id = 28 AND d.d_moy = 11 AND d.d_year = 1999
+      GROUP BY i.i_brand_id, i.i_brand
+      ORDER BY s DESC, i.i_brand_id LIMIT 100
+    """, sf=SF, max_groups=1 << 12, join_capacity=1 << 17)
+    ss = tpcds.generate_columns("store_sales", SF,
+                                ["ss_sold_date_sk", "ss_item_sk",
+                                 "ss_ext_sales_price"])
+    it = tpcds.generate_columns("item", SF, ["i_manager_id", "i_brand_id"])
+    dd = tpcds.generate_columns("date_dim", SF,
+                                ["d_date_sk", "d_year", "d_moy"])
+    ok = {int(k) for k, y, m in zip(dd["d_date_sk"], dd["d_year"],
+                                    dd["d_moy"]) if y == 1999 and m == 11}
+    want = collections.Counter()
+    for sk, isk, p in zip(ss["ss_sold_date_sk"], ss["ss_item_sk"],
+                          ss["ss_ext_sales_price"]):
+        if int(sk) in ok and it["i_manager_id"][isk - 1] == 28:
+            want[int(it["i_brand_id"][isk - 1])] += int(p)
+    got = {r[0]: r[2] for r in res.rows()}
+    assert got == dict(want)
+
+
+def test_tpcds_q96_count_with_demographics():
+    res = sql("""
+      SELECT count(*) AS cnt
+      FROM store_sales ss
+      JOIN household_demographics hd ON ss.ss_customer_sk = hd.hd_demo_sk
+      JOIN store s ON ss.ss_store_sk = s.s_store_sk
+      WHERE hd.hd_dep_count = 5 AND s.s_state = 'TN'
+    """, sf=SF, max_groups=4, join_capacity=1 << 17)
+    ss = tpcds.generate_columns("store_sales", SF,
+                                ["ss_customer_sk", "ss_store_sk"])
+    n_hd = tpcds.table_row_count("household_demographics", SF)
+    hd = tpcds.generate_columns("household_demographics", SF,
+                                ["hd_demo_sk", "hd_dep_count"])
+    dep = dict(zip(hd["hd_demo_sk"], hd["hd_dep_count"]))
+    st = tpcds.generate_columns("store", SF, ["s_store_sk", "s_state"])
+    tn = {int(k) for k, s_ in zip(st["s_store_sk"], st["s_state"])
+          if s_ == "TN"}
+    want = sum(1 for ck, sk in zip(ss["ss_customer_sk"], ss["ss_store_sk"])
+               if int(ck) <= n_hd and dep.get(int(ck)) == 5
+               and int(sk) in tn)
+    assert res.rows()[0][0] == want
+
+
 def test_cross_channel_union():
     # q-family shape: revenue per item across store+catalog+web channels
     res = sql("""
