@@ -309,17 +309,25 @@ def _plan_any(ast, max_groups: int, join_capacity: Optional[int]):
             if not ast.all:
                 node = N.DistinctNode(node, max_groups=max_groups)
             return node, ln
+        # INTERSECT / EXCEPT. Set semantics: distinct left, membership
+        # test against right over all channels (NULLs compare EQUAL).
+        # Bag (ALL) semantics: tag every row with its occurrence index
+        # (row_number over the full row), then the SAME membership test
+        # on (row, occurrence) keeps/drops exactly min/excess
+        # multiplicities -- the classic tagging decorrelation.
         if ast.all:
-            raise NotImplementedError(
-                f"{ast.op.upper()} ALL (bag multiplicity semantics) is not "
-                "implemented; remove ALL for set semantics")
-        # INTERSECT / EXCEPT (set semantics): distinct left, membership
-        # test against right over all channels (NULLs compare EQUAL per
-        # set-operation semantics), keep/drop, hide the mask
-        left_d = N.DistinctNode(lf, max_groups=max_groups)
-        sj = N.SemiJoinNode(left_d, rt, list(range(ncols)),
-                            list(range(ncols)), null_keys_match=True)
-        mask = E.input_ref(ncols, T.BOOLEAN)
+            all_chs = list(range(ncols))
+            lf = N.RowNumberNode(lf, all_chs, [], max_partitions=max_groups)
+            rt = N.RowNumberNode(rt, all_chs, [], max_partitions=max_groups)
+            key_chs = all_chs + [ncols]  # row + occurrence tag
+            left_in = lf
+        else:
+            key_chs = list(range(ncols))
+            left_in = N.DistinctNode(lf, max_groups=max_groups)
+        sj = N.SemiJoinNode(left_in, rt, key_chs, key_chs,
+                            null_keys_match=True)
+        mask_ch = len(left_in.output_types())
+        mask = E.input_ref(mask_ch, T.BOOLEAN)
         pred = mask if ast.op == "intersect" else \
             E.call("not", T.BOOLEAN, mask)
         f = N.FilterNode(sj, pred)

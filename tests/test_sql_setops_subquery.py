@@ -23,6 +23,33 @@ def test_intersect_and_except():
     assert sorted(x[0] for x in r.rows()) == [2, 3, 4]
 
 
+def test_intersect_except_all_bag_semantics():
+    # region keys: nation has 5 each per region (25 nations, 5 regions)
+    # INTERSECT ALL keeps min multiplicity; EXCEPT ALL subtracts
+    import collections
+    r = sql("SELECT regionkey FROM nation WHERE nationkey < 12 "
+            "INTERSECT ALL SELECT regionkey FROM nation")
+    na = tpch.generate_columns("nation", 0.01, ["nationkey", "regionkey"])
+    left = collections.Counter(int(r_) for n, r_ in
+                               zip(na["nationkey"], na["regionkey"])
+                               if n < 12)
+    right = collections.Counter(int(r_) for r_ in na["regionkey"])
+    want = collections.Counter()
+    for k in left:
+        want[k] = min(left[k], right[k])
+    got = collections.Counter(x[0] for x in r.rows())
+    assert got == want
+    r = sql("SELECT regionkey FROM nation "
+            "EXCEPT ALL SELECT regionkey FROM nation WHERE nationkey < 12")
+    want2 = collections.Counter()
+    for k in right:
+        d = right[k] - left.get(k, 0)
+        if d > 0:
+            want2[k] = d
+    got2 = collections.Counter(x[0] for x in r.rows())
+    assert got2 == want2
+
+
 def test_in_subquery_semijoin():
     # orders of customers in the AUTOMOBILE segment (q-shape like q18/q22)
     r = sql("""
