@@ -203,6 +203,7 @@ _KEYWORDS = {
     "on", "true", "false", "asc", "desc", "nulls", "first", "last", "date",
     "interval", "day", "month", "year", "extract", "outer", "over",
     "partition", "union", "intersect", "except", "all", "with", "exists",
+    "try_cast",
 }
 
 
@@ -377,13 +378,15 @@ class _Parser:
             assert kk == "string"
             unit = self.next()[1]  # day | month | year
             return Literal((int(vv), unit), "interval")
-        if k == "kw" and v == "cast":
+        if k == "kw" and v in ("cast", "try_cast"):
             self.next()
             self.expect_op("(")
             e = self.expr()
             self.expect_kw("as")
             tname = self._type_name()
             self.expect_op(")")
+            # TRY_CAST shares CAST's lowering: every cast kernel is total
+            # (out-of-domain lanes null instead of raising)
             return Cast(e, tname)
         if k == "kw" and v == "case":
             return self._case()

@@ -201,6 +201,10 @@ class _Analyzer:
         if name == "if":
             rty = args[1].type
             return E.special("IF", rty, *args)
+        if name == "try":
+            # kernels are total (errors produce NULL lanes, never raise),
+            # so TRY is the identity on this engine
+            return args[0]
         rty = self._func_type(name, args)
         return E.call(name, rty, *args)
 
