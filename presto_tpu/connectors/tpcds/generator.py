@@ -29,8 +29,9 @@ _D72 = T.decimal(7, 2)
 
 TPCDS_SCHEMA: Dict[str, List[Tuple[str, T.Type]]] = {
     "store_sales": [
-        ("ss_sold_date_sk", T.BIGINT), ("ss_item_sk", T.BIGINT),
-        ("ss_customer_sk", T.BIGINT), ("ss_store_sk", T.BIGINT),
+        ("ss_sold_date_sk", T.BIGINT), ("ss_sold_time_sk", T.BIGINT),
+        ("ss_item_sk", T.BIGINT), ("ss_customer_sk", T.BIGINT),
+        ("ss_hdemo_sk", T.BIGINT), ("ss_store_sk", T.BIGINT),
         ("ss_quantity", T.INTEGER), ("ss_list_price", _D72),
         ("ss_sales_price", _D72), ("ss_ext_sales_price", _D72),
         ("ss_ext_discount_amt", _D72), ("ss_net_profit", _D72),
@@ -163,10 +164,15 @@ def _gen_store_sales(column, idx, sf):
     if column == "ss_sold_date_sk":
         d = _uniform("store_sales", "sold", idx, _SOLD_LO, _SOLD_HI)
         return d + _SK_BASE
+    if column == "ss_sold_time_sk":
+        return _uniform("store_sales", "time", idx, 28800, 79200)  # 8am-10pm
     if column == "ss_item_sk":
         return _uniform("store_sales", "item", idx, 1, n_item)
     if column == "ss_customer_sk":
         return _uniform("store_sales", "cust", idx, 1, n_cust)
+    if column == "ss_hdemo_sk":
+        return _uniform("store_sales", "hdemo", idx, 1,
+                        table_row_count("household_demographics", sf))
     if column == "ss_store_sk":
         return _uniform("store_sales", "store", idx, 1, n_store)
     if column == "ss_quantity":

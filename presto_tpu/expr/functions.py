@@ -802,9 +802,40 @@ def split_part_kernel(a: StringColumn, delim: bytes, index: int, ret):
 # casts (one registry entry; dispatch on (from, to))
 # ---------------------------------------------------------------------------
 
+@register("try_cast")
+def _try_cast(ret, a):
+    """TRY_CAST: CAST with out-of-range results becoming NULL instead of
+    wrapping. String->number parsing lands with the string-parse
+    kernels (clean error until then)."""
+    if isinstance(a, StringColumn) and not ret.is_string:
+        raise NotImplementedError(
+            "TRY_CAST(varchar AS numeric) needs the string-parse kernels "
+            "(ROADMAP: function library breadth)")
+    out = _cast(ret, a)
+    ft = a.type
+    if ret.is_integral and (ft.is_integral or ft.is_decimal):
+        info = jnp.iinfo(ret.to_dtype())
+        src = a.values
+        if ft.is_decimal:
+            src = rescale_decimal(src.astype(jnp.int64), ft.scale, 0)
+        oob = (src.astype(jnp.int64) < info.min) | \
+              (src.astype(jnp.int64) > info.max)
+        return Column(out.values, out.nulls | oob, ret)
+    if ret.is_integral and ft.is_floating:
+        info = jnp.iinfo(ret.to_dtype())
+        oob = (a.values < float(info.min)) | (a.values > float(info.max)) | \
+            jnp.isnan(a.values)
+        return Column(out.values, out.nulls | oob, ret)
+    return out
+
+
 @register("cast")
 def _cast(ret, a):
     ft = a.type
+    if isinstance(a, StringColumn) and not ret.is_string:
+        raise NotImplementedError(
+            "CAST(varchar AS numeric) needs the string-parse kernels "
+            "(ROADMAP: function library breadth)")
     if isinstance(a, StringColumn) and ret.is_string:
         return StringColumn(a.chars, a.lengths, a.nulls, ret)
     if ft.is_decimal and ret.is_floating:

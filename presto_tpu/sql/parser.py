@@ -103,6 +103,7 @@ class Case:
 class Cast:
     value: object
     type_name: str
+    safe: bool = False  # TRY_CAST: out-of-domain -> NULL
 
 
 @dataclasses.dataclass
@@ -385,9 +386,7 @@ class _Parser:
             self.expect_kw("as")
             tname = self._type_name()
             self.expect_op(")")
-            # TRY_CAST shares CAST's lowering: every cast kernel is total
-            # (out-of-domain lanes null instead of raising)
-            return Cast(e, tname)
+            return Cast(e, tname, safe=(v == "try_cast"))
         if k == "kw" and v == "case":
             return self._case()
         if k == "kw" and v == "exists":

@@ -108,7 +108,7 @@ class _Analyzer:
         if isinstance(node, P.Cast):
             v = self.lower(node.value, scope)
             ty = T.parse_type(node.type_name)
-            return E.call("cast", ty, v)
+            return E.call("try_cast" if node.safe else "cast", ty, v)
         if isinstance(node, P.Func):
             return self._func(node, scope)
         raise NotImplementedError(f"cannot lower {node}")
@@ -202,6 +202,8 @@ class _Analyzer:
             rty = args[1].type
             return E.special("IF", rty, *args)
         if name == "try":
+            if len(args) != 1:
+                raise ValueError("TRY requires exactly one argument")
             # kernels are total (errors produce NULL lanes, never raise),
             # so TRY is the identity on this engine
             return args[0]

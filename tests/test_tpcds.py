@@ -114,25 +114,29 @@ def test_tpcds_q55_shape():
     assert got == dict(want)
 
 
-def test_tpcds_q96_count_with_demographics():
+def test_tpcds_q96_real_shape():
+    # q96: count of store sales at hour 20 by 4-dependent households
     res = sql("""
       SELECT count(*) AS cnt
       FROM store_sales ss
-      JOIN household_demographics hd ON ss.ss_customer_sk = hd.hd_demo_sk
+      JOIN household_demographics hd ON ss.ss_hdemo_sk = hd.hd_demo_sk
+      JOIN time_dim t ON ss.ss_sold_time_sk = t.t_time_sk
       JOIN store s ON ss.ss_store_sk = s.s_store_sk
-      WHERE hd.hd_dep_count = 5 AND s.s_state = 'TN'
+      WHERE hd.hd_dep_count = 4 AND t.t_hour = 20 AND s.s_state = 'TN'
     """, sf=SF, max_groups=4, join_capacity=1 << 17)
     ss = tpcds.generate_columns("store_sales", SF,
-                                ["ss_customer_sk", "ss_store_sk"])
-    n_hd = tpcds.table_row_count("household_demographics", SF)
+                                ["ss_hdemo_sk", "ss_sold_time_sk",
+                                 "ss_store_sk"])
     hd = tpcds.generate_columns("household_demographics", SF,
                                 ["hd_demo_sk", "hd_dep_count"])
     dep = dict(zip(hd["hd_demo_sk"], hd["hd_dep_count"]))
     st = tpcds.generate_columns("store", SF, ["s_store_sk", "s_state"])
     tn = {int(k) for k, s_ in zip(st["s_store_sk"], st["s_state"])
           if s_ == "TN"}
-    want = sum(1 for ck, sk in zip(ss["ss_customer_sk"], ss["ss_store_sk"])
-               if int(ck) <= n_hd and dep.get(int(ck)) == 5
+    want = sum(1 for hk, tk, sk in zip(ss["ss_hdemo_sk"],
+                                       ss["ss_sold_time_sk"],
+                                       ss["ss_store_sk"])
+               if dep[int(hk)] == 4 and int(tk) // 3600 == 20
                and int(sk) in tn)
     assert res.rows()[0][0] == want
 
