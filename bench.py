@@ -100,11 +100,15 @@ def _watchdog_main() -> int:
 def main():
     sf = float(os.environ.get("BENCH_SF", "1"))
     iters = int(os.environ.get("BENCH_ITERS", "5"))
+    query = os.environ.get("BENCH_QUERY", "q1")  # q1 | q6
 
     import jax
 
     platform = os.environ.get("BENCH_PLATFORM_NOTE") or \
         jax.devices()[0].platform
+
+    if query == "q6":
+        return _bench_q6(sf, iters, platform)
 
     from presto_tpu.connectors import tpch
     from presto_tpu.queries import Q1_COLUMNS, q1_local
@@ -156,6 +160,34 @@ def main():
         },
     }
     print(json.dumps(result))
+
+
+def _bench_q6(sf, iters, platform):
+    import jax
+
+    from presto_tpu.block import batch_from_numpy
+    from presto_tpu.connectors import tpch
+    from presto_tpu.queries import Q6_COLUMNS, q6_local
+
+    n = tpch.table_row_count("lineitem", sf)
+    capacity = -(-n // 1024) * 1024
+    host = tpch.generate_columns("lineitem", sf, Q6_COLUMNS)
+    types = [tpch.column_type("lineitem", c) for c in Q6_COLUMNS]
+    batch = jax.block_until_ready(jax.device_put(
+        batch_from_numpy(types, [host[c] for c in Q6_COLUMNS],
+                         capacity=capacity)))
+    run = jax.jit(q6_local())
+    jax.block_until_ready(run(batch))
+    t0 = time.time()
+    for _ in range(iters):
+        out = run(batch)
+    jax.block_until_ready(out)
+    dt = (time.time() - t0) / iters
+    print(json.dumps({
+        "metric": f"tpch_sf{sf:g}_q6_rows_per_sec",
+        "value": round(n / dt), "unit": "rows/s", "vs_baseline": 0,
+        "detail": {"query_wall_s": round(dt, 5), "rows": n,
+                   "platform": platform, "iters": iters}}))
 
 
 if __name__ == "__main__":
