@@ -182,6 +182,18 @@ def test_coalesce_nullif_if_functions():
     assert sorted(r[1] for r in rows) == [0, 0, 0, 1, 1]
 
 
+def test_self_join():
+    r = sql("""SELECT n1.name, count(*) AS same_region
+      FROM nation n1 JOIN nation n2 ON n1.regionkey = n2.regionkey
+      GROUP BY n1.name ORDER BY n1.name LIMIT 5""", sf=0.01, max_groups=64)
+    import collections
+    na = tpch.generate_columns("nation", 0.01, ["name", "regionkey"])
+    per_region = collections.Counter(int(x) for x in na["regionkey"])
+    want = sorted((nm, per_region[int(rk)])
+                  for nm, rk in zip(na["name"], na["regionkey"]))[:5]
+    assert [(row[0], row[1]) for row in r.rows()] == want
+
+
 def test_explain_sql_plan():
     p = plan_sql("SELECT custkey, count(*) FROM orders GROUP BY custkey")
     text = explain(p)
