@@ -179,9 +179,13 @@ def compile_plan(root: N.PlanNode, mesh=None,
             from ..ops.sort import SortKey as SK
             from ..ops.window import WindowSpec, window
             src = lower(node.source, inputs)
+            # the 5th tuple slot is the function's int parameter:
+            # ntile's bucket count, lag/lead's offset
             specs = [WindowSpec(name, ch,
                                 T.parse_type(ty) if isinstance(ty, str) else ty,
-                                frame, k or 0)
+                                frame,
+                                ntile_buckets=(k or 0) if name == "ntile" else 0,
+                                offset=(k or 1) if name in ("lag", "lead") else 1)
                      for name, ch, ty, frame, k in node.functions]
             return window(src, node.partition_channels,
                           [SK(*o) for o in node.order_keys], specs)

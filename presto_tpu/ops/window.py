@@ -41,7 +41,7 @@ __all__ = ["WindowSpec", "window"]
 
 _FUNCS = ("row_number", "rank", "dense_rank", "sum", "count", "avg", "min",
           "max", "first_value", "last_value", "ntile", "percent_rank",
-          "cume_dist")
+          "cume_dist", "lag", "lead")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,6 +53,7 @@ class WindowSpec:
     # ROW) or "full" (whole partition)
     frame: str = "range_current"
     ntile_buckets: int = 0
+    offset: int = 1  # lag/lead distance
 
     def __post_init__(self):
         assert self.name in _FUNCS, self.name
@@ -145,6 +146,21 @@ def window(batch: Batch, partition_channels: Sequence[int],
             r0 = (row_number - 1)
             vals_sorted = jnp.minimum(r0 * k // jnp.maximum(part_rows, 1), k - 1) + 1
             nulls_sorted = ~s_active
+        elif name in ("lag", "lead"):
+            col = batch.column(spec.input_channel)
+            if isinstance(col, DictionaryColumn):
+                col = col.decode()
+            assert not isinstance(col, StringColumn), \
+                "lag/lead over strings is not yet supported"
+            v_sorted = col.values[perm]
+            n_sorted = col.nulls[perm]
+            k = spec.offset if name == "lag" else -spec.offset
+            src = jnp.clip(spos - k, 0, n - 1)
+            same_part = part_start[jnp.clip(src, 0, n - 1)] == part_start
+            in_rng = (spos - k >= 0) & (spos - k < n)
+            ok = in_rng & same_part & s_active
+            vals_sorted = jnp.where(ok, v_sorted[src], v_sorted)
+            nulls_sorted = jnp.where(ok, n_sorted[src], True) | ~s_active
         elif name == "count" and spec.input_channel is None:
             # count(*) over frame: rows (not non-null values)
             pc = jnp.cumsum(s_active.astype(jnp.int64))

@@ -739,9 +739,20 @@ def _plan_windows(an, node, scope, q, window_items):
             arg = f.args[0]
             assert isinstance(arg, P.Literal) and arg.kind == "int"
             buckets = int(arg.value)
+        elif name in ("lag", "lead"):
+            in_ch = chan_of(f.args[0])
+            if len(f.args) > 1:
+                arg = f.args[1]
+                assert isinstance(arg, P.Literal) and arg.kind == "int", \
+                    "lag/lead offset must be an integer literal"
+                buckets = int(arg.value)  # generic int param slot
+            else:
+                buckets = 1
         elif f.args and not isinstance(f.args[0], P.Star):
             in_ch = chan_of(f.args[0])
-        if name in _WINDOW_FN_TYPES and not (name == "count" and in_ch is not None):
+        if name in ("lag", "lead"):
+            oty = pre_exprs[in_ch].type
+        elif name in _WINDOW_FN_TYPES and not (name == "count" and in_ch is not None):
             oty = _WINDOW_FN_TYPES[name]
         elif name == "count":
             oty = T.BIGINT

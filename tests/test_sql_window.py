@@ -55,6 +55,27 @@ def test_running_sum_and_rank_over():
         assert r[3] == r[1]  # linenumbers are 1..4 in order
 
 
+def test_lag_lead():
+    res = sql("""
+      SELECT orderkey, linenumber,
+             lag(quantity) OVER (PARTITION BY orderkey ORDER BY linenumber) AS prev,
+             lead(quantity, 2) OVER (PARTITION BY orderkey ORDER BY linenumber) AS nxt2
+      FROM lineitem WHERE orderkey <= 20
+    """, sf=0.01)
+    li = tpch.generate_columns("lineitem", 0.01,
+                               ["orderkey", "linenumber", "quantity"])
+    per = {}
+    for o, l, q in zip(li["orderkey"], li["linenumber"], li["quantity"]):
+        if o <= 20:
+            per[(int(o), int(l))] = int(q)
+    for row in res.rows():
+        o, l, prev, nxt2 = row
+        want_prev = per.get((o, l - 1))
+        want_nxt2 = per.get((o, l + 2))
+        assert prev == want_prev, (row, want_prev)
+        assert nxt2 == want_nxt2, (row, want_nxt2)
+
+
 def test_window_json_roundtrip():
     from presto_tpu.sql import plan_sql
     from presto_tpu.plan import to_json, from_json
