@@ -127,21 +127,7 @@ def main():
     _numpy_q1(host_cols, cutoff)
     numpy_s = time.time() - t0
 
-    # stage to device
-    from presto_tpu.block import batch_from_numpy
-    types = [tpch.column_type("lineitem", c) for c in Q1_COLUMNS]
-    batch = batch_from_numpy(types, [host_cols[c] for c in Q1_COLUMNS],
-                             capacity=capacity)
-    batch = jax.block_until_ready(jax.device_put(batch))
-
-    run = jax.jit(q1_local())
-    r = jax.block_until_ready(run(batch))  # warm-up / compile
-
-    t0 = time.time()
-    for _ in range(iters):
-        r = run(batch)
-    jax.block_until_ready(r)
-    dt = (time.time() - t0) / iters
+    dt = _stage_and_time(host_cols, Q1_COLUMNS, capacity, q1_local(), iters)
 
     rows_per_sec = n / dt
     baseline_rows_per_sec = n / numpy_s
@@ -162,27 +148,34 @@ def main():
     print(json.dumps(result))
 
 
-def _bench_q6(sf, iters, platform):
+def _stage_and_time(host_cols, columns, capacity, pipeline_fn, iters):
+    """The one staging/warmup/timing harness both benchmarks share."""
     import jax
 
     from presto_tpu.block import batch_from_numpy
+    from presto_tpu.connectors import tpch
+
+    types = [tpch.column_type("lineitem", c) for c in columns]
+    batch = jax.block_until_ready(jax.device_put(
+        batch_from_numpy(types, [host_cols[c] for c in columns],
+                         capacity=capacity)))
+    run = jax.jit(pipeline_fn)
+    jax.block_until_ready(run(batch))  # warm-up / compile
+    t0 = time.time()
+    for _ in range(iters):
+        out = run(batch)
+    jax.block_until_ready(out)
+    return (time.time() - t0) / iters
+
+
+def _bench_q6(sf, iters, platform):
     from presto_tpu.connectors import tpch
     from presto_tpu.queries import Q6_COLUMNS, q6_local
 
     n = tpch.table_row_count("lineitem", sf)
     capacity = -(-n // 1024) * 1024
     host = tpch.generate_columns("lineitem", sf, Q6_COLUMNS)
-    types = [tpch.column_type("lineitem", c) for c in Q6_COLUMNS]
-    batch = jax.block_until_ready(jax.device_put(
-        batch_from_numpy(types, [host[c] for c in Q6_COLUMNS],
-                         capacity=capacity)))
-    run = jax.jit(q6_local())
-    jax.block_until_ready(run(batch))
-    t0 = time.time()
-    for _ in range(iters):
-        out = run(batch)
-    jax.block_until_ready(out)
-    dt = (time.time() - t0) / iters
+    dt = _stage_and_time(host, Q6_COLUMNS, capacity, q6_local(), iters)
     print(json.dumps({
         "metric": f"tpch_sf{sf:g}_q6_rows_per_sec",
         "value": round(n / dt), "unit": "rows/s", "vs_baseline": 0,

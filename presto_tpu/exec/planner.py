@@ -185,7 +185,8 @@ def compile_plan(root: N.PlanNode, mesh=None,
                                 T.parse_type(ty) if isinstance(ty, str) else ty,
                                 frame,
                                 ntile_buckets=(k or 0) if name == "ntile" else 0,
-                                offset=(k or 1) if name in ("lag", "lead") else 1)
+                                offset=((1 if k is None else k)
+                                        if name in ("lag", "lead") else 1))
                      for name, ch, ty, frame, k in node.functions]
             return window(src, node.partition_channels,
                           [SK(*o) for o in node.order_keys], specs)

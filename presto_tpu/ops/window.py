@@ -156,7 +156,7 @@ def window(batch: Batch, partition_channels: Sequence[int],
             n_sorted = col.nulls[perm]
             k = spec.offset if name == "lag" else -spec.offset
             src = jnp.clip(spos - k, 0, n - 1)
-            same_part = part_start[jnp.clip(src, 0, n - 1)] == part_start
+            same_part = part_start[src] == part_start
             in_rng = (spos - k >= 0) & (spos - k < n)
             ok = in_rng & same_part & s_active
             vals_sorted = jnp.where(ok, v_sorted[src], v_sorted)

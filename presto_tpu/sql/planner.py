@@ -740,6 +740,9 @@ def _plan_windows(an, node, scope, q, window_items):
             assert isinstance(arg, P.Literal) and arg.kind == "int"
             buckets = int(arg.value)
         elif name in ("lag", "lead"):
+            if len(f.args) > 2:
+                raise NotImplementedError(
+                    "lag/lead default-value argument is not supported yet")
             in_ch = chan_of(f.args[0])
             if len(f.args) > 1:
                 arg = f.args[1]
