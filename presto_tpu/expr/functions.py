@@ -33,6 +33,7 @@ from .. import types as T
 from ..block import Column, StringColumn
 
 Block = Union[Column, StringColumn]
+_T_UNKNOWN = T.UNKNOWN
 
 __all__ = ["ScalarFunction", "REGISTRY", "register", "lookup",
            "rescale_decimal", "hash64_block", "combine_hash"]
@@ -838,6 +839,12 @@ def _cast(ret, a):
             "(ROADMAP: function library breadth)")
     if isinstance(a, StringColumn) and ret.is_string:
         return StringColumn(a.chars, a.lengths, a.nulls, ret)
+    if ft == _T_UNKNOWN and ret.is_string:
+        # typed NULL literal -> string column of NULLs
+        n = len(a)
+        return StringColumn(jnp.zeros((n, 1), dtype=jnp.uint8),
+                            jnp.zeros(n, dtype=jnp.int32),
+                            jnp.ones(n, dtype=bool) | a.nulls, ret)
     if ft.is_decimal and ret.is_floating:
         return _col(ret, a.values.astype(ret.to_dtype()) / _POW10[ft.scale], a)
     if ft.is_decimal and ret.is_decimal:

@@ -165,6 +165,11 @@ class Exists:
 
 
 @dataclasses.dataclass
+class Rollup:
+    items: List[object]
+
+
+@dataclasses.dataclass
 class Query:
     select: Select
     table: TableRef
@@ -204,7 +209,7 @@ _KEYWORDS = {
     "on", "true", "false", "asc", "desc", "nulls", "first", "last", "date",
     "interval", "day", "month", "year", "extract", "outer", "over",
     "partition", "union", "intersect", "except", "all", "with", "exists",
-    "try_cast",
+    "try_cast", "rollup",
 }
 
 
@@ -531,9 +536,17 @@ class _Parser:
         group_by: List[object] = []
         if self.accept_kw("group"):
             self.expect_kw("by")
-            group_by.append(self.expr())
-            while self.accept_op(","):
+            if self.accept_kw("rollup"):
+                self.expect_op("(")
+                rollup_items = [self.expr()]
+                while self.accept_op(","):
+                    rollup_items.append(self.expr())
+                self.expect_op(")")
+                group_by.append(Rollup(rollup_items))
+            else:
                 group_by.append(self.expr())
+                while self.accept_op(","):
+                    group_by.append(self.expr())
         having = self.expr() if self.accept_kw("having") else None
         order_by: List[OrderItem] = []
         if self.accept_kw("order"):

@@ -349,6 +349,8 @@ def _strip_output(node: N.PlanNode) -> N.PlanNode:
 
 def _plan_query(q: P.Query, max_groups: int = 1 << 16,
                 join_capacity: Optional[int] = None) -> N.PlanNode:
+    if len(q.group_by) == 1 and isinstance(q.group_by[0], P.Rollup):
+        return _plan_rollup(q, max_groups, join_capacity)
     an = _Analyzer(q)
 
     # FROM: scans with pruned columns. First collect every referenced name.
@@ -1104,6 +1106,60 @@ def _attach_scalar_filter(node: N.PlanNode, lhs: E.RowExpression, op: str,
         E.call(_CMP_NAMES[op], T.BOOLEAN, lhs, scalar_ref)))
     return N.ProjectNode(f, [
         E.input_ref(i, ntypes[i]) for i in range(nch)])
+
+
+def _plan_rollup(q: P.Query, max_groups: int, join_capacity: Optional[int]):
+    """GROUP BY ROLLUP(a, b, ...) -> UNION ALL of grouping-set
+    aggregations, dropped keys projected as typed NULLs (the reference's
+    GroupIdNode expansion, realized as a plan-level rewrite)."""
+    items = q.group_by[0].items
+    sub_plans = []
+    names0 = None
+    target_types = None
+    for k in range(len(items), -1, -1):
+        kept = items[:k]
+        dropped = items[k:]
+        select = P.Select(
+            [P.SelectItem(P.Literal(None, "null"), _item_name(it, i))
+             if any(it.expr == d for d in dropped) else it
+             for i, it in enumerate(q.select.items)],
+            q.select.distinct)
+        q_k = dataclasses.replace(q, select=select, group_by=list(kept),
+                                  order_by=[], limit=None, having=q.having)
+        node_k, names_k = _plan_query(q_k, max_groups, join_capacity)
+        node_k = _strip_output(node_k)
+        if target_types is None:
+            names0 = names_k
+            target_types = node_k.output_types()
+        else:
+            # typed-NULL alignment: cast every column to the full
+            # grouping's types so the union is type-consistent
+            node_k = N.ProjectNode(node_k, [
+                E.call("cast", target_types[i],
+                       E.input_ref(i, node_k.output_types()[i]))
+                for i in range(len(target_types))])
+        sub_plans.append(node_k)
+    node = N.UnionNode(sub_plans)
+    if q.order_by:
+        scope = _Scope({n.lower(): i for i, n in enumerate(names0)},
+                       list(target_types))
+        keys = []
+        for o in q.order_by:
+            if isinstance(o.expr, P.Name) and \
+                    ".".join(o.expr.parts).lower() in scope.channels:
+                ch = scope.channels[".".join(o.expr.parts).lower()]
+            elif isinstance(o.expr, P.Literal) and o.expr.kind == "int":
+                ch = int(o.expr.value) - 1
+            else:
+                raise NotImplementedError(
+                    "ORDER BY expressions with ROLLUP must be select "
+                    "aliases or ordinals")
+            keys.append((ch, o.descending, o.nulls_last))
+        node = N.TopNNode(node, keys, q.limit) if q.limit is not None \
+            else N.SortNode(node, keys)
+    elif q.limit is not None:
+        node = N.LimitNode(node, q.limit)
+    return node, names0
 
 
 def _item_name(item: P.SelectItem, i: int) -> str:

@@ -83,3 +83,28 @@ def test_cte_referencing_earlier_cte():
                                                      oc["totalprice"])
                               if p > 30000000)
     assert r.rows()[0][0] == max(per.values())
+
+
+def test_rollup_grouping_sets():
+    import collections
+    r = sql("""SELECT returnflag, linestatus, sum(quantity) AS q
+      FROM lineitem GROUP BY ROLLUP(returnflag, linestatus)
+      ORDER BY q DESC""", sf=SF, max_groups=64)
+    li = tpch.generate_columns("lineitem", SF,
+                               ["returnflag", "linestatus", "quantity"])
+    full = collections.Counter()
+    by_rf = collections.Counter()
+    total = 0
+    for rf, ls, q in zip(li["returnflag"], li["linestatus"], li["quantity"]):
+        full[(rf, ls)] += int(q)
+        by_rf[rf] += int(q)
+        total += int(q)
+    want = sorted(list(full.values()) + list(by_rf.values()) + [total],
+                  reverse=True)
+    assert [row[2] for row in r.rows()] == want
+    grand = [row for row in r.rows() if row[0] is None and row[1] is None]
+    assert len(grand) == 1 and grand[0][2] == total
+    # subtotal rows have NULL linestatus but real returnflag
+    subs = [row for row in r.rows()
+            if row[0] is not None and row[1] is None]
+    assert {row[0]: row[2] for row in subs} == dict(by_rf)
