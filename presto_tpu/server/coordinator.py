@@ -107,6 +107,27 @@ class Coordinator:
         # producer tasks per fragment id: list of (worker_url, task_id)
         produced: Dict[int, List[Tuple[str, str]]] = {}
         frag_by_id = {f.id: f for f in fragments}
+        parent_of: Dict[int, int] = {}
+        for f in fragments:
+            for src_id in f.remote_sources:
+                parent_of[src_id] = f.id
+
+        # pass 1: consumer task count per fragment (shape-driven), so
+        # producers can emit exactly that many output partitions
+        ntasks_of: Dict[int, int] = {}
+        for frag in fragments:
+            remote_nodes: List[N.RemoteSourceNode] = []
+            _collect_remote(frag.root, remote_nodes)
+            scans: List[N.TableScanNode] = []
+            _collect_tables(frag.root, scans)
+            hash_ups = [rn for rn in remote_nodes
+                        if frag_by_id[rn.fragment_id].partitioning == "HASH"]
+            single_ups = [rn for rn in remote_nodes
+                          if frag_by_id[rn.fragment_id].partitioning == "SINGLE"]
+            if (scans and single_ups) or _contains_global_agg(frag.root):
+                ntasks_of[frag.id] = 1
+            else:
+                ntasks_of[frag.id] = len(workers) if (scans or hash_ups) else 1
 
         for frag in fragments:
             frag_plan = N.OutputNode(frag.root, [
@@ -118,10 +139,11 @@ class Coordinator:
             _collect_tables(frag.root, scans)
 
             # a fragment whose output is HASH-partitioned emits one
-            # buffer per consumer task (PartitionedOutputBuffer analog)
+            # buffer per CONSUMER task (PartitionedOutputBuffer analog)
             out_part = None
             if frag.partitioning == "HASH":
-                out_part = {"count": len(workers),
+                consumers = ntasks_of.get(parent_of.get(frag.id, -1), 1)
+                out_part = {"count": consumers,
                             "channels": frag.partition_channels}
 
             # consumer parallelism: one task per hash partition when any
@@ -137,13 +159,7 @@ class Coordinator:
                     "fragment mixes range-split table scans with hash-"
                     "partitioned remote sources; DAG scheduling lands with "
                     "scheduler depth (ROADMAP)")
-            # a SINGLE (gathered) upstream must not be duplicated by a
-            # scan fan-out: run the whole fragment as one task (correct,
-            # just not scan-parallel)
-            if scans and single_ups:
-                ntasks = 1
-            else:
-                ntasks = len(workers) if (scans or hash_ups) else 1
+            ntasks = ntasks_of[frag.id]
             has_join = _contains_join(frag.root)
             if len(scans) > 1 and ntasks > 1 and has_join:
                 raise SchedulerGap(
@@ -172,14 +188,22 @@ class Coordinator:
                         ups = produced[rn.fragment_id]
                         entry = {"sources": [u for u, _ in ups],
                                  "taskIds": [t for _, t in ups],
-                                 "types": [str(t) for t in rn.types]}
+                                 "types": [str(t) for t in rn.types],
+                                 # coordinator-scheduled pulls are always
+                                 # non-destructive: retried consumers
+                                 # must be able to re-read (buffers are
+                                 # freed with the task, not per token)
+                                 "ack": False}
                         up_part = frag_by_id[rn.fragment_id].partitioning
                         if up_part == "HASH":
                             entry["bufferId"] = w
-                        if up_part == "BROADCAST" and ntasks > 1:
-                            # shared buffer read by N consumers: reads
-                            # must be non-destructive (no token acks)
-                            entry["ack"] = False
+                        elif up_part == "SINGLE" and ntasks > 1 and w > 0:
+                            # a gathered upstream feeds exactly ONE of
+                            # the fanned-out consumers; the rest see an
+                            # empty source (otherwise its rows would be
+                            # duplicated per consumer)
+                            entry["sources"] = []
+                            entry["taskIds"] = []
                         spec[rn.id] = entry
                     body["remoteSources"] = spec
                 bodies[w] = body
@@ -215,6 +239,15 @@ class Coordinator:
             if isinstance(fragments[-1].root, N.OutputNode) else \
             [f"c{i}" for i in range(len(types))]
         return merged, names
+
+
+def _contains_global_agg(node: N.PlanNode) -> bool:
+    """Global (keyless) FINAL/SINGLE aggregations always emit one row --
+    fanned-out consumers would each emit it (SQL's empty-input row)."""
+    if isinstance(node, N.AggregationNode) and not node.group_channels \
+            and node.step in ("FINAL", "SINGLE"):
+        return True
+    return any(_contains_global_agg(s) for s in node.sources)
 
 
 def _contains_join(node: N.PlanNode) -> bool:

@@ -116,4 +116,15 @@ DEFAULT_CORPUS = [
     # scalar subquery
     "SELECT count(*) FROM customer WHERE acctbal > "
     "(SELECT avg(acctbal) FROM customer)",
+    # grouping sets
+    "SELECT returnflag, linestatus, sum(quantity) AS q FROM lineitem "
+    "GROUP BY ROLLUP(returnflag, linestatus) ORDER BY q DESC",
+    # window functions
+    "SELECT orderkey, linenumber, "
+    "lag(quantity) OVER (PARTITION BY orderkey ORDER BY linenumber) AS p "
+    "FROM lineitem WHERE orderkey <= 30",
+    # correlated EXISTS
+    "SELECT count(*) FROM orders o WHERE EXISTS "
+    "(SELECT l.orderkey FROM lineitem l WHERE l.orderkey = o.orderkey "
+    " AND l.quantity > 49.00)",
 ]
