@@ -51,6 +51,12 @@ _GATHER_OPS = (N.SortNode, N.TopNNode, N.LimitNode, N.WindowNode,
                N.RowNumberNode, N.MarkDistinctNode)
 
 
+def _is_repartition_on(node: N.PlanNode, keys) -> bool:
+    return (isinstance(node, N.ExchangeNode)
+            and node.kind == "REPARTITION"
+            and list(node.partition_channels) == list(keys))
+
+
 def add_exchanges(node: N.PlanNode,
                   join_strategy: str = "broadcast") -> N.PlanNode:
     """join_strategy: "broadcast" replicates every build side (the safe
@@ -107,13 +113,17 @@ def add_exchanges(node: N.PlanNode,
         if join_strategy == "partitioned":
             # repartition BOTH sides by the join keys: consumers then see
             # co-partitioned inputs and join locally (the large-build
-            # PARTITIONED distribution). Skip if exchanges are present.
+            # PARTITIONED distribution). An existing exchange is reused
+            # ONLY when it already repartitions on exactly these keys;
+            # anything else (e.g. a GATHER under an ORDER BY subquery)
+            # gets re-exchanged, else fanned-out consumers would probe a
+            # side that lives wholly on task 0.
             left, right = node.left, node.right
-            if not isinstance(left, N.ExchangeNode):
+            if not _is_repartition_on(left, node.left_keys):
                 left = N.ExchangeNode(left, kind="REPARTITION",
                                       scope="REMOTE",
                                       partition_channels=list(node.left_keys))
-            if not isinstance(right, N.ExchangeNode):
+            if not _is_repartition_on(right, node.right_keys):
                 right = N.ExchangeNode(right, kind="REPARTITION",
                                        scope="REMOTE",
                                        partition_channels=list(node.right_keys))

@@ -69,17 +69,21 @@ class Coordinator:
         raise RuntimeError(
             f"task {task_id} could not be submitted anywhere: {last_err}")
 
-    def _await_or_retry(self, urls: List[str], pending, body_of, timeout: float):
+    def _await_or_retry(self, urls: List[str], pending, body_of,
+                        timeout: float, submitted=None):
         """Wait for submitted tasks (all executing concurrently); on an
         execution failure, resubmit that task elsewhere (deterministic
         splits make any attempt re-runnable -- the recoverable-execution
-        property; RequestErrorTracker retries analog). `pending` entries:
+        property; RequestErrorTracker retries analog). Failed attempts
+        are aborted (DELETE) before resubmission so no orphaned task
+        keeps running/buffering, and resubmission only happens when a
+        further wait attempt will actually follow. `pending` entries:
         (key, url, tid, preferred). Returns {key: (url, tid)}."""
         done = {}
         for key, url, tid, preferred in pending:
-            attempt = 0
+            retries_left = len(urls)
             last_err = None
-            while attempt < len(urls) + 1:
+            while True:
                 try:
                     info = WorkerClient(url, timeout).wait(tid, timeout)
                     if info["state"] == "FINISHED":
@@ -88,11 +92,21 @@ class Coordinator:
                     last_err = info.get("error")
                 except Exception as e:  # noqa: BLE001
                     last_err = f"{type(e).__name__}: {e}"
-                attempt += 1
-                url, tid, _ = self._submit(urls, preferred + attempt,
-                                           f"{tid}.r", body_of(key), timeout)
-            else:
-                raise RuntimeError(f"task {tid} failed everywhere: {last_err}")
+                # this attempt is abandoned: abort it so a possibly
+                # still-running task stops buffering pages
+                try:
+                    WorkerClient(url, timeout).abort(tid)
+                except Exception:  # noqa: BLE001 - worker may be dead
+                    pass
+                if retries_left <= 0:
+                    raise RuntimeError(
+                        f"task {tid} failed everywhere: {last_err}")
+                retries_left -= 1
+                url, tid, _ = self._submit(
+                    urls, preferred + (len(urls) - retries_left),
+                    f"{tid}.r", body_of(key), timeout)
+                if submitted is not None:
+                    submitted.append((url, tid))
         return done
 
     def execute(self, root: N.PlanNode, sf: float = 0.01,
@@ -106,6 +120,27 @@ class Coordinator:
 
         # producer tasks per fragment id: list of (worker_url, task_id)
         produced: Dict[int, List[Tuple[str, str]]] = {}
+        # EVERY task this query ever submitted (incl. failed/abandoned
+        # attempts of fragments that never completed) -- appended at
+        # submit time so error paths leak nothing
+        submitted: List[Tuple[str, str]] = []
+        try:
+            return self._execute_fragments(
+                workers, fragments, produced, submitted, qid, sf, timeout)
+        finally:
+            # release worker-side state: every scheduled task (and its
+            # buffered pages) is destroyed once the query is done, the
+            # reference's destroy-buffers-after-consumption contract.
+            # Short fixed timeout: cleanup is best-effort and must not
+            # stall a failing query behind dead workers.
+            for url, tid in submitted:
+                try:
+                    WorkerClient(url, min(timeout, 5.0)).abort(tid)
+                except Exception:  # noqa: BLE001 - best-effort cleanup
+                    pass
+
+    def _execute_fragments(self, workers, fragments, produced, submitted,
+                           qid, sf, timeout):
         frag_by_id = {f.id: f for f in fragments}
         parent_of: Dict[int, int] = {}
         for f in fragments:
@@ -161,6 +196,15 @@ class Coordinator:
                     "scheduler depth (ROADMAP)")
             ntasks = ntasks_of[frag.id]
             has_join = _contains_join(frag.root)
+            if ntasks > 1 and single_ups and _join_fed_by_single(
+                    frag.root, {rn.fragment_id for rn in single_ups}):
+                # the 'SINGLE upstream feeds only consumer w=0' rule is
+                # union-safe but join-wrong: tasks w>0 would probe an
+                # empty side and task 0 only holds hash partition 0
+                raise SchedulerGap(
+                    "fanned-out fragment joins against a SINGLE-gathered "
+                    "remote source; add_exchanges must repartition the "
+                    "gathered side on the join keys first")
             if len(scans) > 1 and ntasks > 1 and has_join:
                 raise SchedulerGap(
                     "leaf fragment joins two scans: range-splitting both "
@@ -210,9 +254,11 @@ class Coordinator:
                 url, tid, _ = self._submit(workers, w,
                                            f"{qid}.f{frag.id}.w{w}",
                                            body, timeout)
+                submitted.append((url, tid))
                 pending.append((w, url, tid, w))
             done = self._await_or_retry(workers, pending,
-                                        lambda k: bodies[k], timeout)
+                                        lambda k: bodies[k], timeout,
+                                        submitted)
             produced[frag.id] = [done[w] for w in sorted(done)]
 
         # pull + concatenate every final task's buffer (queries whose
@@ -254,6 +300,21 @@ def _contains_join(node: N.PlanNode) -> bool:
     if isinstance(node, (N.JoinNode, N.SemiJoinNode)):
         return True
     return any(_contains_join(s) for s in node.sources)
+
+
+def _join_fed_by_single(node: N.PlanNode, single_ids) -> bool:
+    """True when a Join/SemiJoin in this fragment is fed (transitively)
+    by a SINGLE-partitioned remote source -- a shape the fan-out
+    scheduler cannot run correctly (see SchedulerGap above)."""
+    def subtree_has_single(n: N.PlanNode) -> bool:
+        if isinstance(n, N.RemoteSourceNode) and n.fragment_id in single_ids:
+            return True
+        return any(subtree_has_single(s) for s in n.sources)
+
+    if isinstance(node, (N.JoinNode, N.SemiJoinNode)) and \
+            subtree_has_single(node):
+        return True
+    return any(_join_fed_by_single(s, single_ids) for s in node.sources)
 
 
 def _collect_remote(node: N.PlanNode, out: List[N.RemoteSourceNode]):

@@ -86,6 +86,7 @@ class _Task:
         self.first_token: Dict[int, int] = {}  # per-buffer acked prefix
         self.no_more_pages = False
         self.created_at = time.time()
+        self.finished_at: Optional[float] = None
         self.stats: Dict[str, float] = {}
         self.lock = threading.Lock()
 
@@ -109,18 +110,32 @@ class TaskManager:
     with task_concurrency)."""
 
     def __init__(self, sf: float = 0.01, mesh=None,
-                 memory_bytes: int = 12 << 30):
+                 memory_bytes: int = 12 << 30,
+                 task_ttl_s: float = 600.0):
         from ..exec.memory import MemoryPool
         self.sf = sf
         self.mesh = mesh
         self.tasks: Dict[str, _Task] = {}
         self.memory_pool = MemoryPool(memory_bytes)
         self.draining = False  # GracefulShutdownHandler state
+        self.task_ttl_s = task_ttl_s
         self._exec_lock = threading.Lock()
         self._tasks_lock = threading.Lock()
 
+    def _prune_locked(self):
+        """Drop terminal tasks (and their buffered pages) older than the
+        TTL -- coordinators DELETE tasks after consumption, this is the
+        backstop against leaked ones growing worker memory forever. Runs
+        opportunistically on task lookups AND submissions so an idle-but-
+        polled worker also reclaims."""
+        cutoff = time.time() - self.task_ttl_s
+        for tid in [tid for tid, t in self.tasks.items()
+                    if t.finished_at is not None and t.finished_at < cutoff]:
+            del self.tasks[tid]
+
     def create_or_update(self, task_id: str, body: dict) -> dict:
         with self._tasks_lock:
+            self._prune_locked()
             task = self.tasks.get(task_id)
             if task is None:
                 # drain refuses only NEW tasks; idempotent re-POSTs of
@@ -136,6 +151,7 @@ class TaskManager:
 
     def active_task_count(self) -> int:
         with self._tasks_lock:
+            self._prune_locked()
             return sum(1 for t in self.tasks.values()
                        if t.state in ("PLANNED", "RUNNING"))
 
@@ -177,6 +193,9 @@ class TaskManager:
                                 memory_pool=self.memory_pool,
                                 query_id=task.task_id)
             wall = time.time() - t0
+            with task.lock:
+                if task.state == "ABORTED":
+                    return  # abandoned by the coordinator: drop results
             types = plan.output_types()
             out_part = body.get("outputPartitions")
             total_bytes = 0
@@ -198,6 +217,8 @@ class TaskManager:
                     total_bytes += len(page)
                     pages.append(page)
                 with task.lock:
+                    if task.state == "ABORTED":
+                        return
                     for pid, page in enumerate(pages):
                         task.buffers.setdefault(pid, []).append(page)
             else:
@@ -206,17 +227,24 @@ class TaskManager:
                 page = serialize_page(cols, codec)
                 total_bytes = len(page)
                 with task.lock:
+                    if task.state == "ABORTED":
+                        return
                     task.buffers[0].append(page)
             with task.lock:
+                if task.state == "ABORTED":
+                    return
                 task.no_more_pages = True
                 task.stats = {"wallSeconds": round(wall, 4),
                               "outputRows": res.row_count,
                               "outputBytes": total_bytes}
                 task.state = "FINISHED"
+                task.finished_at = time.time()
         except Exception as e:  # noqa: BLE001 - task failure is data
             with task.lock:
-                task.state = "FAILED"
-                task.error = f"{type(e).__name__}: {e}"
+                if task.state != "ABORTED":
+                    task.state = "FAILED"
+                    task.error = f"{type(e).__name__}: {e}"
+                task.finished_at = time.time()
 
     def get(self, task_id: str) -> Optional[_Task]:
         with self._tasks_lock:
@@ -267,6 +295,8 @@ class TaskManager:
                     task.state = "ABORTED"
                 task.buffers = {0: []}
                 task.first_token = {}
+                if task.finished_at is None:
+                    task.finished_at = time.time()
 
 
 class _Handler(BaseHTTPRequestHandler):

@@ -209,7 +209,7 @@ _KEYWORDS = {
     "on", "true", "false", "asc", "desc", "nulls", "first", "last", "date",
     "interval", "day", "month", "year", "extract", "outer", "over",
     "partition", "union", "intersect", "except", "all", "with", "exists",
-    "try_cast", "rollup",
+    "try_cast",
 }
 
 
@@ -262,6 +262,21 @@ class _Parser:
     def expect_kw(self, word: str):
         if not self.accept_kw(word):
             raise ValueError(f"expected {word.upper()}, got {self.peek()}")
+
+    def accept_ctx_kw(self, word: str, before_op: Optional[str] = None) -> bool:
+        """Contextual (non-reserved) keyword: matches an identifier token
+        case-insensitively, optionally only when the NEXT token is the
+        given operator -- Presto keeps words like ROLLUP usable as plain
+        identifiers (SqlBase.g4 nonReserved rule)."""
+        k, v = self.peek()
+        if k == "ident" and v.lower() == word:
+            if before_op is not None:
+                k2, v2 = self.toks[self.i + 1]
+                if not (k2 == "op" and v2 == before_op):
+                    return False
+            self.next()
+            return True
+        return False
 
     def accept_op(self, *ops) -> Optional[str]:
         k, v = self.peek()
@@ -536,7 +551,7 @@ class _Parser:
         group_by: List[object] = []
         if self.accept_kw("group"):
             self.expect_kw("by")
-            if self.accept_kw("rollup"):
+            if self.accept_ctx_kw("rollup", before_op="("):
                 self.expect_op("(")
                 rollup_items = [self.expr()]
                 while self.accept_op(","):

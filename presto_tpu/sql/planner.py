@@ -1111,7 +1111,13 @@ def _attach_scalar_filter(node: N.PlanNode, lhs: E.RowExpression, op: str,
 def _plan_rollup(q: P.Query, max_groups: int, join_capacity: Optional[int]):
     """GROUP BY ROLLUP(a, b, ...) -> UNION ALL of grouping-set
     aggregations, dropped keys projected as typed NULLs (the reference's
-    GroupIdNode expansion, realized as a plan-level rewrite)."""
+    GroupIdNode expansion, realized as a plan-level rewrite).
+
+    Known gaps vs the reference's single-pass GroupIdNode plan (ROADMAP
+    'grouping sets'): the FROM/WHERE pipeline is re-planned and re-run
+    once per grouping set (k+1 scans/joins instead of one GroupId row
+    expansion), and HAVING referencing a dropped key errors instead of
+    evaluating it as NULL in the coarser sets."""
     items = q.group_by[0].items
     sub_plans = []
     names0 = None
