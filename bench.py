@@ -104,6 +104,12 @@ def main():
 
     import jax
 
+    if os.environ.get("BENCH_GROUPBY") == "sort":
+        # A/B hook: measure the retired sort-based group-id kernel
+        # against the default hash-slot kernel
+        from presto_tpu.ops import aggregation as _agg
+        _agg._group_ids = _agg._group_ids_sort
+
     platform = os.environ.get("BENCH_PLATFORM_NOTE") or \
         jax.devices()[0].platform
 

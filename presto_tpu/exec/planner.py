@@ -147,7 +147,10 @@ def compile_plan(root: N.PlanNode, mesh=None,
             keys = node.key_channels
             if keys is None:
                 keys = list(range(len(node.output_types())))
-            return distinct_op(lower(node.source, inputs), keys, node.max_groups)
+            out, ovf = distinct_op(lower(node.source, inputs), keys,
+                                   node.max_groups)
+            _note_overflow(ovf)
+            return out
         if isinstance(node, N.UnionNode):
             from ..block import concat_batches
             parts = [lower(s, inputs) for s in node.inputs]
@@ -172,7 +175,8 @@ def compile_plan(root: N.PlanNode, mesh=None,
             from ..block import Column
             from ..ops.misc import mark_distinct
             src = lower(node.source, inputs)
-            m = mark_distinct(src, node.key_channels, node.max_groups)
+            m, ovf = mark_distinct(src, node.key_channels, node.max_groups)
+            _note_overflow(ovf)
             col = Column(m, jnp.zeros(src.capacity, dtype=bool), T.BOOLEAN)
             return Batch(src.columns + (col,), src.active)
         if isinstance(node, N.WindowNode):

@@ -2,11 +2,13 @@
 
 Reference surface: operator/LimitOperator.java, DistinctLimitOperator.java,
 MarkDistinctOperator.java (and the MarkDistinctHash it shares with
-aggregation). Distinctness reuses the sort-based group-id machinery."""
+aggregation). Distinctness reuses the hash-slot group-id kernel; its
+overflow flag (capacity OR probe-budget exhaustion) is propagated so the
+exec layer's rerun contract covers DISTINCT too."""
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -24,20 +26,24 @@ def limit(batch: Batch, n: int) -> Batch:
 
 
 def mark_distinct(batch: Batch, key_channels: Sequence[int],
-                  max_groups: int) -> jnp.ndarray:
-    """Boolean column: True on the first active occurrence of each
-    distinct key (MarkDistinctOperator analog). Assumes distinct key
-    count <= max_groups."""
+                  max_groups: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(mask, overflow): mask is True on the first active occurrence of
+    each distinct key (MarkDistinctOperator analog); overflow is the
+    group-id kernel's rerun flag -- when set, parked rows may alias the
+    last group and the mask must not be trusted."""
     keys = [batch.column(c) for c in key_channels]
-    ids, _, _, _ = _group_ids(keys, batch.active, max_groups)
+    ids, _, _, overflow = _group_ids(keys, batch.active, max_groups)
     n = batch.capacity
     rows = jnp.arange(n, dtype=jnp.int32)
     first = jnp.full(max_groups, n, dtype=jnp.int32).at[
         jnp.where(batch.active, ids, max_groups - 1)].min(
         jnp.where(batch.active, rows, n))
-    return batch.active & (first[ids] == rows)
+    return batch.active & (first[ids] == rows), overflow
 
 
-def distinct(batch: Batch, key_channels: Sequence[int], max_groups: int) -> Batch:
-    """SELECT DISTINCT: deactivate duplicate rows."""
-    return batch.with_active(mark_distinct(batch, key_channels, max_groups))
+def distinct(batch: Batch, key_channels: Sequence[int], max_groups: int
+             ) -> Tuple[Batch, jnp.ndarray]:
+    """SELECT DISTINCT: deactivate duplicate rows. Returns
+    (batch, overflow)."""
+    mask, overflow = mark_distinct(batch, key_channels, max_groups)
+    return batch.with_active(mask), overflow

@@ -160,7 +160,8 @@ def test_limit_and_distinct():
     b = batch_from_numpy([T.BIGINT], [vals], capacity=8)
     l = limit(b, 4)
     assert int(l.count()) == 4
-    d = distinct(b, [0], max_groups=8)
+    d, ovf = distinct(b, [0], max_groups=8)
+    assert not bool(ovf)
     v, _ = col(d, 0)
     act = np.asarray(d.active)
     assert sorted(v[act]) == [1, 2, 3]
