@@ -108,17 +108,9 @@ jax.tree_util.register_dataclass(GroupByResult,
                                  meta_fields=[])
 
 
-_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
-_MIX1 = np.uint64(0xBF58476D1CE4E5B9)
-_MIX2 = np.uint64(0x94D049BB133111EB)
+from ..expr.functions import _GOLD as _GOLDEN, _mix64 as _splitmix64
+
 _MAX_PROBES = 64  # probe budget; exhaustion raises the overflow flag
-
-
-def _splitmix64(z: jnp.ndarray) -> jnp.ndarray:
-    z = z + _GOLDEN
-    z = (z ^ (z >> np.uint64(30))) * _MIX1
-    z = (z ^ (z >> np.uint64(27))) * _MIX2
-    return z ^ (z >> np.uint64(31))
 
 
 def _hash_words(words) -> jnp.ndarray:
