@@ -1,16 +1,26 @@
-"""Deterministic columnar TPC-DS generator (core star-schema subset).
+"""Deterministic columnar TPC-DS generator: the full 24-table schema.
 
 Reference surface: presto-tpcds (the airlift dsdgen port exposed as a
-connector; deterministic generated data for the TPC-DS suites). Same
-stateless splitmix64 design as the tpch generator (see
-connectors/tpch/generator.py): any split of any table is a pure
-function of (table, column, row index, scale factor).
+connector -- TpcdsMetadata.java table list, TpcdsRecordSetProvider.java
+on-the-fly generation). Same stateless splitmix64 design as the tpch
+generator (connectors/tpch/generator.py): any split of any table is a
+pure function of (table, column, row index, scale factor) -- no dsdgen
+state machine, so splits generate independently on any worker.
 
-Round-1 subset: the tables the join-heavy benchmark queries (q3, q42,
-q52, q55 family and kin) touch -- store_sales, date_dim, item,
-customer, store. Cardinalities follow the spec at SF1 with sqrt scaling
-for the dimension tables (the spec's sub-linear dimension growth,
-simplified). Remaining 19 tables arrive with the catalog build-out.
+Faithfulness contract: schemas carry the spec's column sets; fact
+tables scale linearly with SF, dimensions sub-linearly (sqrt) or fixed
+per the spec's dimension scaling; surrogate keys are 1-based dense;
+foreign keys land inside their dimension's key range; *returns* tables
+link to real parent sales rows (ticket/order number + item re-derived
+from the parent row index), so sales-to-returns joins behave like
+dsdgen output. Value distributions are uniform-hash approximations --
+the suite's oracle tests compare the engine against an independent
+SQL engine over THIS data, so correctness never depends on matching
+dsdgen's exact streams.
+
+customer_demographics is the spec's pure attribute cross-product: the
+surrogate key *encodes* the combination (mixed-radix decode), capped at
+a scaled row count so tiny test SFs stay fast.
 
 Decimals are scaled int64 cents (engine-wide representation).
 """
@@ -26,62 +36,11 @@ from ... import types as T
 from ...block import Batch, batch_from_numpy
 
 _D72 = T.decimal(7, 2)
+_D52 = T.decimal(5, 2)
 
-TPCDS_SCHEMA: Dict[str, List[Tuple[str, T.Type]]] = {
-    "store_sales": [
-        ("ss_sold_date_sk", T.BIGINT), ("ss_sold_time_sk", T.BIGINT),
-        ("ss_item_sk", T.BIGINT), ("ss_customer_sk", T.BIGINT),
-        ("ss_hdemo_sk", T.BIGINT), ("ss_store_sk", T.BIGINT),
-        ("ss_quantity", T.INTEGER), ("ss_list_price", _D72),
-        ("ss_sales_price", _D72), ("ss_ext_sales_price", _D72),
-        ("ss_ext_discount_amt", _D72), ("ss_net_profit", _D72),
-        ("ss_ticket_number", T.BIGINT),
-    ],
-    "date_dim": [
-        ("d_date_sk", T.BIGINT), ("d_date", T.DATE), ("d_year", T.INTEGER),
-        ("d_moy", T.INTEGER), ("d_dom", T.INTEGER), ("d_qoy", T.INTEGER),
-        ("d_day_name", T.varchar(9)),
-    ],
-    "item": [
-        ("i_item_sk", T.BIGINT), ("i_item_id", T.varchar(16)),
-        ("i_brand_id", T.INTEGER), ("i_brand", T.varchar(50)),
-        ("i_manufact_id", T.INTEGER), ("i_category_id", T.INTEGER),
-        ("i_category", T.varchar(50)), ("i_manager_id", T.INTEGER),
-        ("i_current_price", _D72),
-    ],
-    "catalog_sales": [
-        ("cs_sold_date_sk", T.BIGINT), ("cs_item_sk", T.BIGINT),
-        ("cs_bill_customer_sk", T.BIGINT), ("cs_quantity", T.INTEGER),
-        ("cs_list_price", _D72), ("cs_sales_price", _D72),
-        ("cs_ext_sales_price", _D72), ("cs_net_profit", _D72),
-        ("cs_order_number", T.BIGINT),
-    ],
-    "web_sales": [
-        ("ws_sold_date_sk", T.BIGINT), ("ws_item_sk", T.BIGINT),
-        ("ws_bill_customer_sk", T.BIGINT), ("ws_quantity", T.INTEGER),
-        ("ws_list_price", _D72), ("ws_sales_price", _D72),
-        ("ws_ext_sales_price", _D72), ("ws_net_profit", _D72),
-        ("ws_order_number", T.BIGINT),
-    ],
-    "customer": [
-        ("c_customer_sk", T.BIGINT), ("c_customer_id", T.varchar(16)),
-        ("c_current_addr_sk", T.BIGINT), ("c_first_name", T.varchar(20)),
-        ("c_last_name", T.varchar(30)), ("c_birth_year", T.INTEGER),
-    ],
-    "store": [
-        ("s_store_sk", T.BIGINT), ("s_store_id", T.varchar(16)),
-        ("s_store_name", T.varchar(50)), ("s_state", T.varchar(2)),
-    ],
-    "time_dim": [
-        ("t_time_sk", T.BIGINT), ("t_hour", T.INTEGER),
-        ("t_minute", T.INTEGER), ("t_second", T.INTEGER),
-        ("t_meal_time", T.varchar(20)),
-    ],
-    "household_demographics": [
-        ("hd_demo_sk", T.BIGINT), ("hd_dep_count", T.INTEGER),
-        ("hd_vehicle_count", T.INTEGER), ("hd_buy_potential", T.varchar(15)),
-    ],
-}
+# ---------------------------------------------------------------------------
+# calendar / key-space constants
+# ---------------------------------------------------------------------------
 
 # date_dim spans 1900-01-01 .. 2100-01-01 in the spec; sk is julian-based.
 _DATE_ROWS = 73049
@@ -89,36 +48,414 @@ _SK_BASE = 2415022          # spec JulianDate of row 0
 _EPOCH_OFFSET_DAYS = int((np.datetime64("1900-01-01")
                           - np.datetime64("1970-01-01")).astype(int))
 
-# store_sales sold dates concentrate in 1998-01-01..2003-12-31
-_SOLD_LO = int((np.datetime64("1998-01-01") - np.datetime64("1900-01-01")).astype(int))
-_SOLD_HI = int((np.datetime64("2003-12-31") - np.datetime64("1900-01-01")).astype(int))
+# fact-table sold dates concentrate in 1998-01-01..2003-12-31
+_SOLD_LO = int((np.datetime64("1998-01-01")
+                - np.datetime64("1900-01-01")).astype(int))
+_SOLD_HI = int((np.datetime64("2003-12-31")
+                - np.datetime64("1900-01-01")).astype(int))
 
 _CATEGORIES = ["Books", "Children", "Electronics", "Home", "Jewelry", "Men",
                "Music", "Shoes", "Sports", "Women"]
+_CLASSES = ["accent", "bathroom", "bedding", "blinds", "curtains", "decor",
+            "flatware", "furniture", "glassware", "kids", "lighting",
+            "mattresses", "paint", "rugs", "tables", "wallpaper"]
 _DAY_NAMES = ["Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
               "Saturday", "Sunday"]
 _STATES = ["TN", "CA", "TX", "NY", "WA", "GA", "OH", "IL"]
+_COUNTIES = ["Williamson County", "Walker County", "Ziebach County",
+             "Fairfield County", "Bronx County", "Franklin Parish",
+             "Barrow County", "Daviess County"]
+_CITIES = ["Midway", "Fairview", "Oakland", "Glendale", "Springdale",
+           "Riverside", "Centerville", "Pleasant Hill", "Salem", "Liberty"]
+_STREET_NAMES = ["Main", "Oak", "Park", "Elm", "Cedar", "Maple", "Lake",
+                 "Hill", "Pine", "River"]
+_STREET_TYPES = ["Street", "Ave", "Blvd", "Road", "Lane", "Court", "Drive",
+                 "Way", "Circle", "Parkway"]
+_FIRST_NAMES = ["James", "Mary", "John", "Linda", "David", "Susan",
+                "Robert", "Karen", "Michael", "Nancy"]
+_LAST_NAMES = ["Smith", "Jones", "Brown", "Lee", "Garcia", "Miller",
+               "Davis", "Wilson", "Moore", "Taylor"]
+_GENDERS = ["M", "F"]
+_MARITAL = ["M", "S", "D", "W", "U"]
+_EDUCATION = ["Primary", "Secondary", "College", "2 yr Degree",
+              "4 yr Degree", "Advanced Degree", "Unknown"]
+_CREDIT = ["Low Risk", "Good", "High Risk", "Unknown"]
+_BUY_POTENTIAL = ["0-500", "501-1000", "1001-5000", "5001-10000",
+                  ">10000", "Unknown"]
+_SM_TYPES = ["EXPRESS", "NEXT DAY", "OVERNIGHT", "REGULAR", "TWO DAY"]
+_SM_CODES = ["AIR", "SURFACE", "SEA"]
+_SM_CARRIERS = ["UPS", "FEDEX", "AIRBORNE", "USPS", "DHL", "TBS", "ZHOU",
+                "LATVIAN", "DIAMOND", "BARIAN"]
+_YN = ["N", "Y"]
+_COLORS = ["aquamarine", "azure", "beige", "black", "blue", "brown",
+           "burlywood", "chartreuse", "chiffon", "coral", "cornflower",
+           "cream", "cyan", "dark", "dim", "dodger", "drab", "firebrick",
+           "floral", "forest", "frosted", "gainsboro", "ghost", "goldenrod",
+           "green", "grey", "honeydew", "hot", "indian", "ivory", "khaki",
+           "lace", "lavender", "lawn", "lemon", "light", "lime", "linen",
+           "magenta", "maroon", "medium", "metallic", "midnight", "mint",
+           "misty", "moccasin", "navajo", "navy", "olive", "orange",
+           "orchid", "pale", "papaya", "peach", "peru", "pink", "plum",
+           "powder", "puff", "purple", "red", "rose", "rosy", "royal",
+           "saddle", "salmon", "sandy", "seashell", "sienna", "sky",
+           "slate", "smoke", "snow", "spring", "steel", "tan", "thistle",
+           "tomato", "turquoise", "violet", "wheat", "white", "yellow"]
+_UNITS = ["Unknown", "Oz", "Bunch", "Ton", "N/A", "Dozen", "Box", "Pound",
+          "Pallet", "Gross", "Cup", "Dram", "Each", "Tbl", "Lb", "Bundle"]
+_CONTAINERS = ["Unknown", "LARGE", "MEDIUM", "SMALL"]
+_SIZES = ["petite", "small", "medium", "large", "extra large", "N/A",
+          "economy"]
+_CC_CLASSES = ["small", "medium", "large"]
+_WEB_SITE_CLASSES = ["Unknown", "mail", "phone", "chat", "internet"]
+_CP_TYPES = ["bi-annual", "quarterly", "monthly"]
+_PROMO_PURPOSES = ["Unknown", "sale", "clearance", "holiday"]
+_SHIFTS = ["first", "second", "third"]
+
+# ---------------------------------------------------------------------------
+# schema (full spec column sets)
+# ---------------------------------------------------------------------------
+
+TPCDS_SCHEMA: Dict[str, List[Tuple[str, T.Type]]] = {
+    "store_sales": [
+        ("ss_sold_date_sk", T.BIGINT), ("ss_sold_time_sk", T.BIGINT),
+        ("ss_item_sk", T.BIGINT), ("ss_customer_sk", T.BIGINT),
+        ("ss_cdemo_sk", T.BIGINT), ("ss_hdemo_sk", T.BIGINT),
+        ("ss_addr_sk", T.BIGINT), ("ss_store_sk", T.BIGINT),
+        ("ss_promo_sk", T.BIGINT), ("ss_ticket_number", T.BIGINT),
+        ("ss_quantity", T.INTEGER), ("ss_wholesale_cost", _D72),
+        ("ss_list_price", _D72), ("ss_sales_price", _D72),
+        ("ss_ext_discount_amt", _D72), ("ss_ext_sales_price", _D72),
+        ("ss_ext_wholesale_cost", _D72), ("ss_ext_list_price", _D72),
+        ("ss_ext_tax", _D72), ("ss_coupon_amt", _D72),
+        ("ss_net_paid", _D72), ("ss_net_paid_inc_tax", _D72),
+        ("ss_net_profit", _D72),
+    ],
+    "store_returns": [
+        ("sr_returned_date_sk", T.BIGINT), ("sr_return_time_sk", T.BIGINT),
+        ("sr_item_sk", T.BIGINT), ("sr_customer_sk", T.BIGINT),
+        ("sr_cdemo_sk", T.BIGINT), ("sr_hdemo_sk", T.BIGINT),
+        ("sr_addr_sk", T.BIGINT), ("sr_store_sk", T.BIGINT),
+        ("sr_reason_sk", T.BIGINT), ("sr_ticket_number", T.BIGINT),
+        ("sr_return_quantity", T.INTEGER), ("sr_return_amt", _D72),
+        ("sr_return_tax", _D72), ("sr_return_amt_inc_tax", _D72),
+        ("sr_fee", _D72), ("sr_return_ship_cost", _D72),
+        ("sr_refunded_cash", _D72), ("sr_reversed_charge", _D72),
+        ("sr_store_credit", _D72), ("sr_net_loss", _D72),
+    ],
+    "catalog_sales": [
+        ("cs_sold_date_sk", T.BIGINT), ("cs_sold_time_sk", T.BIGINT),
+        ("cs_ship_date_sk", T.BIGINT), ("cs_bill_customer_sk", T.BIGINT),
+        ("cs_bill_cdemo_sk", T.BIGINT), ("cs_bill_hdemo_sk", T.BIGINT),
+        ("cs_bill_addr_sk", T.BIGINT), ("cs_ship_customer_sk", T.BIGINT),
+        ("cs_ship_cdemo_sk", T.BIGINT), ("cs_ship_hdemo_sk", T.BIGINT),
+        ("cs_ship_addr_sk", T.BIGINT), ("cs_call_center_sk", T.BIGINT),
+        ("cs_catalog_page_sk", T.BIGINT), ("cs_ship_mode_sk", T.BIGINT),
+        ("cs_warehouse_sk", T.BIGINT), ("cs_item_sk", T.BIGINT),
+        ("cs_promo_sk", T.BIGINT), ("cs_order_number", T.BIGINT),
+        ("cs_quantity", T.INTEGER), ("cs_wholesale_cost", _D72),
+        ("cs_list_price", _D72), ("cs_sales_price", _D72),
+        ("cs_ext_discount_amt", _D72), ("cs_ext_sales_price", _D72),
+        ("cs_ext_wholesale_cost", _D72), ("cs_ext_list_price", _D72),
+        ("cs_ext_tax", _D72), ("cs_coupon_amt", _D72),
+        ("cs_ext_ship_cost", _D72), ("cs_net_paid", _D72),
+        ("cs_net_paid_inc_tax", _D72), ("cs_net_paid_inc_ship", _D72),
+        ("cs_net_paid_inc_ship_tax", _D72), ("cs_net_profit", _D72),
+    ],
+    "catalog_returns": [
+        ("cr_returned_date_sk", T.BIGINT), ("cr_returned_time_sk", T.BIGINT),
+        ("cr_item_sk", T.BIGINT), ("cr_refunded_customer_sk", T.BIGINT),
+        ("cr_refunded_cdemo_sk", T.BIGINT), ("cr_refunded_hdemo_sk", T.BIGINT),
+        ("cr_refunded_addr_sk", T.BIGINT),
+        ("cr_returning_customer_sk", T.BIGINT),
+        ("cr_returning_cdemo_sk", T.BIGINT),
+        ("cr_returning_hdemo_sk", T.BIGINT),
+        ("cr_returning_addr_sk", T.BIGINT), ("cr_call_center_sk", T.BIGINT),
+        ("cr_catalog_page_sk", T.BIGINT), ("cr_ship_mode_sk", T.BIGINT),
+        ("cr_warehouse_sk", T.BIGINT), ("cr_reason_sk", T.BIGINT),
+        ("cr_order_number", T.BIGINT), ("cr_return_quantity", T.INTEGER),
+        ("cr_return_amount", _D72), ("cr_return_tax", _D72),
+        ("cr_return_amt_inc_tax", _D72), ("cr_fee", _D72),
+        ("cr_return_ship_cost", _D72), ("cr_refunded_cash", _D72),
+        ("cr_reversed_charge", _D72), ("cr_store_credit", _D72),
+        ("cr_net_loss", _D72),
+    ],
+    "web_sales": [
+        ("ws_sold_date_sk", T.BIGINT), ("ws_sold_time_sk", T.BIGINT),
+        ("ws_ship_date_sk", T.BIGINT), ("ws_item_sk", T.BIGINT),
+        ("ws_bill_customer_sk", T.BIGINT), ("ws_bill_cdemo_sk", T.BIGINT),
+        ("ws_bill_hdemo_sk", T.BIGINT), ("ws_bill_addr_sk", T.BIGINT),
+        ("ws_ship_customer_sk", T.BIGINT), ("ws_ship_cdemo_sk", T.BIGINT),
+        ("ws_ship_hdemo_sk", T.BIGINT), ("ws_ship_addr_sk", T.BIGINT),
+        ("ws_web_page_sk", T.BIGINT), ("ws_web_site_sk", T.BIGINT),
+        ("ws_ship_mode_sk", T.BIGINT), ("ws_warehouse_sk", T.BIGINT),
+        ("ws_promo_sk", T.BIGINT), ("ws_order_number", T.BIGINT),
+        ("ws_quantity", T.INTEGER), ("ws_wholesale_cost", _D72),
+        ("ws_list_price", _D72), ("ws_sales_price", _D72),
+        ("ws_ext_discount_amt", _D72), ("ws_ext_sales_price", _D72),
+        ("ws_ext_wholesale_cost", _D72), ("ws_ext_list_price", _D72),
+        ("ws_ext_tax", _D72), ("ws_coupon_amt", _D72),
+        ("ws_ext_ship_cost", _D72), ("ws_net_paid", _D72),
+        ("ws_net_paid_inc_tax", _D72), ("ws_net_paid_inc_ship", _D72),
+        ("ws_net_paid_inc_ship_tax", _D72), ("ws_net_profit", _D72),
+    ],
+    "web_returns": [
+        ("wr_returned_date_sk", T.BIGINT), ("wr_returned_time_sk", T.BIGINT),
+        ("wr_item_sk", T.BIGINT), ("wr_refunded_customer_sk", T.BIGINT),
+        ("wr_refunded_cdemo_sk", T.BIGINT), ("wr_refunded_hdemo_sk", T.BIGINT),
+        ("wr_refunded_addr_sk", T.BIGINT),
+        ("wr_returning_customer_sk", T.BIGINT),
+        ("wr_returning_cdemo_sk", T.BIGINT),
+        ("wr_returning_hdemo_sk", T.BIGINT),
+        ("wr_returning_addr_sk", T.BIGINT), ("wr_web_page_sk", T.BIGINT),
+        ("wr_reason_sk", T.BIGINT), ("wr_order_number", T.BIGINT),
+        ("wr_return_quantity", T.INTEGER), ("wr_return_amt", _D72),
+        ("wr_return_tax", _D72), ("wr_return_amt_inc_tax", _D72),
+        ("wr_fee", _D72), ("wr_return_ship_cost", _D72),
+        ("wr_refunded_cash", _D72), ("wr_reversed_charge", _D72),
+        ("wr_account_credit", _D72), ("wr_net_loss", _D72),
+    ],
+    "inventory": [
+        ("inv_date_sk", T.BIGINT), ("inv_item_sk", T.BIGINT),
+        ("inv_warehouse_sk", T.BIGINT), ("inv_quantity_on_hand", T.INTEGER),
+    ],
+    "date_dim": [
+        ("d_date_sk", T.BIGINT), ("d_date_id", T.varchar(16)),
+        ("d_date", T.DATE), ("d_month_seq", T.INTEGER),
+        ("d_week_seq", T.INTEGER), ("d_quarter_seq", T.INTEGER),
+        ("d_year", T.INTEGER), ("d_dow", T.INTEGER), ("d_moy", T.INTEGER),
+        ("d_dom", T.INTEGER), ("d_qoy", T.INTEGER),
+        ("d_fy_year", T.INTEGER), ("d_fy_quarter_seq", T.INTEGER),
+        ("d_fy_week_seq", T.INTEGER), ("d_day_name", T.varchar(9)),
+        ("d_quarter_name", T.varchar(6)), ("d_holiday", T.char(1)),
+        ("d_weekend", T.char(1)), ("d_following_holiday", T.char(1)),
+        ("d_first_dom", T.BIGINT), ("d_last_dom", T.BIGINT),
+        ("d_same_day_ly", T.BIGINT), ("d_same_day_lq", T.BIGINT),
+        ("d_current_day", T.char(1)), ("d_current_week", T.char(1)),
+        ("d_current_month", T.char(1)), ("d_current_quarter", T.char(1)),
+        ("d_current_year", T.char(1)),
+    ],
+    "time_dim": [
+        ("t_time_sk", T.BIGINT), ("t_time_id", T.varchar(16)),
+        ("t_time", T.INTEGER), ("t_hour", T.INTEGER),
+        ("t_minute", T.INTEGER), ("t_second", T.INTEGER),
+        ("t_am_pm", T.char(2)), ("t_shift", T.varchar(20)),
+        ("t_sub_shift", T.varchar(20)), ("t_meal_time", T.varchar(20)),
+    ],
+    "item": [
+        ("i_item_sk", T.BIGINT), ("i_item_id", T.varchar(16)),
+        ("i_rec_start_date", T.DATE), ("i_rec_end_date", T.DATE),
+        ("i_item_desc", T.varchar(200)), ("i_current_price", _D72),
+        ("i_wholesale_cost", _D72), ("i_brand_id", T.INTEGER),
+        ("i_brand", T.varchar(50)), ("i_class_id", T.INTEGER),
+        ("i_class", T.varchar(50)), ("i_category_id", T.INTEGER),
+        ("i_category", T.varchar(50)), ("i_manufact_id", T.INTEGER),
+        ("i_manufact", T.varchar(50)), ("i_size", T.varchar(20)),
+        ("i_formulation", T.varchar(20)), ("i_color", T.varchar(20)),
+        ("i_units", T.varchar(10)), ("i_container", T.varchar(10)),
+        ("i_manager_id", T.INTEGER), ("i_product_name", T.varchar(50)),
+    ],
+    "customer": [
+        ("c_customer_sk", T.BIGINT), ("c_customer_id", T.varchar(16)),
+        ("c_current_cdemo_sk", T.BIGINT), ("c_current_hdemo_sk", T.BIGINT),
+        ("c_current_addr_sk", T.BIGINT),
+        ("c_first_shipto_date_sk", T.BIGINT),
+        ("c_first_sales_date_sk", T.BIGINT),
+        ("c_salutation", T.varchar(10)), ("c_first_name", T.varchar(20)),
+        ("c_last_name", T.varchar(30)),
+        ("c_preferred_cust_flag", T.char(1)),
+        ("c_birth_day", T.INTEGER), ("c_birth_month", T.INTEGER),
+        ("c_birth_year", T.INTEGER), ("c_birth_country", T.varchar(20)),
+        ("c_login", T.varchar(13)), ("c_email_address", T.varchar(50)),
+        ("c_last_review_date_sk", T.BIGINT),
+    ],
+    "customer_address": [
+        ("ca_address_sk", T.BIGINT), ("ca_address_id", T.varchar(16)),
+        ("ca_street_number", T.varchar(10)),
+        ("ca_street_name", T.varchar(60)),
+        ("ca_street_type", T.varchar(15)),
+        ("ca_suite_number", T.varchar(10)), ("ca_city", T.varchar(60)),
+        ("ca_county", T.varchar(30)), ("ca_state", T.char(2)),
+        ("ca_zip", T.char(10)), ("ca_country", T.varchar(20)),
+        ("ca_gmt_offset", _D52), ("ca_location_type", T.varchar(20)),
+    ],
+    "customer_demographics": [
+        ("cd_demo_sk", T.BIGINT), ("cd_gender", T.char(1)),
+        ("cd_marital_status", T.char(1)),
+        ("cd_education_status", T.varchar(20)),
+        ("cd_purchase_estimate", T.INTEGER),
+        ("cd_credit_rating", T.varchar(10)), ("cd_dep_count", T.INTEGER),
+        ("cd_dep_employed_count", T.INTEGER),
+        ("cd_dep_college_count", T.INTEGER),
+    ],
+    "household_demographics": [
+        ("hd_demo_sk", T.BIGINT), ("hd_income_band_sk", T.BIGINT),
+        ("hd_buy_potential", T.varchar(15)), ("hd_dep_count", T.INTEGER),
+        ("hd_vehicle_count", T.INTEGER),
+    ],
+    "income_band": [
+        ("ib_income_band_sk", T.BIGINT), ("ib_lower_bound", T.INTEGER),
+        ("ib_upper_bound", T.INTEGER),
+    ],
+    "store": [
+        ("s_store_sk", T.BIGINT), ("s_store_id", T.varchar(16)),
+        ("s_rec_start_date", T.DATE), ("s_rec_end_date", T.DATE),
+        ("s_closed_date_sk", T.BIGINT), ("s_store_name", T.varchar(50)),
+        ("s_number_employees", T.INTEGER), ("s_floor_space", T.INTEGER),
+        ("s_hours", T.char(20)), ("s_manager", T.varchar(40)),
+        ("s_market_id", T.INTEGER), ("s_geography_class", T.varchar(100)),
+        ("s_market_desc", T.varchar(100)),
+        ("s_market_manager", T.varchar(40)), ("s_division_id", T.INTEGER),
+        ("s_division_name", T.varchar(50)), ("s_company_id", T.INTEGER),
+        ("s_company_name", T.varchar(50)),
+        ("s_street_number", T.varchar(10)),
+        ("s_street_name", T.varchar(60)), ("s_street_type", T.varchar(15)),
+        ("s_suite_number", T.varchar(10)), ("s_city", T.varchar(60)),
+        ("s_county", T.varchar(30)), ("s_state", T.char(2)),
+        ("s_zip", T.char(10)), ("s_country", T.varchar(20)),
+        ("s_gmt_offset", _D52), ("s_tax_precentage", _D52),
+    ],
+    "warehouse": [
+        ("w_warehouse_sk", T.BIGINT), ("w_warehouse_id", T.varchar(16)),
+        ("w_warehouse_name", T.varchar(20)),
+        ("w_warehouse_sq_ft", T.INTEGER),
+        ("w_street_number", T.varchar(10)),
+        ("w_street_name", T.varchar(60)), ("w_street_type", T.varchar(15)),
+        ("w_suite_number", T.varchar(10)), ("w_city", T.varchar(60)),
+        ("w_county", T.varchar(30)), ("w_state", T.char(2)),
+        ("w_zip", T.char(10)), ("w_country", T.varchar(20)),
+        ("w_gmt_offset", _D52),
+    ],
+    "ship_mode": [
+        ("sm_ship_mode_sk", T.BIGINT), ("sm_ship_mode_id", T.varchar(16)),
+        ("sm_type", T.varchar(30)), ("sm_code", T.varchar(10)),
+        ("sm_carrier", T.varchar(20)), ("sm_contract", T.varchar(20)),
+    ],
+    "reason": [
+        ("r_reason_sk", T.BIGINT), ("r_reason_id", T.varchar(16)),
+        ("r_reason_desc", T.varchar(100)),
+    ],
+    "promotion": [
+        ("p_promo_sk", T.BIGINT), ("p_promo_id", T.varchar(16)),
+        ("p_start_date_sk", T.BIGINT), ("p_end_date_sk", T.BIGINT),
+        ("p_item_sk", T.BIGINT), ("p_cost", T.decimal(15, 2)),
+        ("p_response_target", T.INTEGER), ("p_promo_name", T.varchar(50)),
+        ("p_channel_dmail", T.char(1)), ("p_channel_email", T.char(1)),
+        ("p_channel_catalog", T.char(1)), ("p_channel_tv", T.char(1)),
+        ("p_channel_radio", T.char(1)), ("p_channel_press", T.char(1)),
+        ("p_channel_event", T.char(1)), ("p_channel_demo", T.char(1)),
+        ("p_channel_details", T.varchar(100)), ("p_purpose", T.varchar(15)),
+        ("p_discount_active", T.char(1)),
+    ],
+    "call_center": [
+        ("cc_call_center_sk", T.BIGINT), ("cc_call_center_id", T.varchar(16)),
+        ("cc_rec_start_date", T.DATE), ("cc_rec_end_date", T.DATE),
+        ("cc_closed_date_sk", T.BIGINT), ("cc_open_date_sk", T.BIGINT),
+        ("cc_name", T.varchar(50)), ("cc_class", T.varchar(50)),
+        ("cc_employees", T.INTEGER), ("cc_sq_ft", T.INTEGER),
+        ("cc_hours", T.char(20)), ("cc_manager", T.varchar(40)),
+        ("cc_mkt_id", T.INTEGER), ("cc_mkt_class", T.char(50)),
+        ("cc_mkt_desc", T.varchar(100)),
+        ("cc_market_manager", T.varchar(40)), ("cc_division", T.INTEGER),
+        ("cc_division_name", T.varchar(50)), ("cc_company", T.INTEGER),
+        ("cc_company_name", T.char(50)),
+        ("cc_street_number", T.char(10)), ("cc_street_name", T.varchar(60)),
+        ("cc_street_type", T.char(15)), ("cc_suite_number", T.char(10)),
+        ("cc_city", T.varchar(60)), ("cc_county", T.varchar(30)),
+        ("cc_state", T.char(2)), ("cc_zip", T.char(10)),
+        ("cc_country", T.varchar(20)), ("cc_gmt_offset", _D52),
+        ("cc_tax_percentage", _D52),
+    ],
+    "catalog_page": [
+        ("cp_catalog_page_sk", T.BIGINT),
+        ("cp_catalog_page_id", T.varchar(16)),
+        ("cp_start_date_sk", T.BIGINT), ("cp_end_date_sk", T.BIGINT),
+        ("cp_department", T.varchar(50)), ("cp_catalog_number", T.INTEGER),
+        ("cp_catalog_page_number", T.INTEGER),
+        ("cp_description", T.varchar(100)), ("cp_type", T.varchar(100)),
+    ],
+    "web_site": [
+        ("web_site_sk", T.BIGINT), ("web_site_id", T.varchar(16)),
+        ("web_rec_start_date", T.DATE), ("web_rec_end_date", T.DATE),
+        ("web_name", T.varchar(50)), ("web_open_date_sk", T.BIGINT),
+        ("web_close_date_sk", T.BIGINT), ("web_class", T.varchar(50)),
+        ("web_manager", T.varchar(40)), ("web_mkt_id", T.INTEGER),
+        ("web_mkt_class", T.varchar(50)), ("web_mkt_desc", T.varchar(100)),
+        ("web_market_manager", T.varchar(40)), ("web_company_id", T.INTEGER),
+        ("web_company_name", T.char(50)),
+        ("web_street_number", T.char(10)),
+        ("web_street_name", T.varchar(60)), ("web_street_type", T.char(15)),
+        ("web_suite_number", T.char(10)), ("web_city", T.varchar(60)),
+        ("web_county", T.varchar(30)), ("web_state", T.char(2)),
+        ("web_zip", T.char(10)), ("web_country", T.varchar(20)),
+        ("web_gmt_offset", _D52), ("web_tax_percentage", _D52),
+    ],
+    "web_page": [
+        ("wp_web_page_sk", T.BIGINT), ("wp_web_page_id", T.varchar(16)),
+        ("wp_rec_start_date", T.DATE), ("wp_rec_end_date", T.DATE),
+        ("wp_creation_date_sk", T.BIGINT), ("wp_access_date_sk", T.BIGINT),
+        ("wp_autogen_flag", T.char(1)), ("wp_customer_sk", T.BIGINT),
+        ("wp_url", T.varchar(100)), ("wp_type", T.char(50)),
+        ("wp_char_count", T.INTEGER), ("wp_link_count", T.INTEGER),
+        ("wp_image_count", T.INTEGER), ("wp_max_ad_count", T.INTEGER),
+    ],
+}
+
+# ---------------------------------------------------------------------------
+# row counts: facts scale linearly, dimensions sub-linearly / fixed
+# ---------------------------------------------------------------------------
 
 
 def table_row_count(table: str, sf: float) -> int:
     if table == "store_sales":
         return int(2_880_000 * sf)
+    if table == "store_returns":
+        return int(288_000 * sf)
     if table == "catalog_sales":
         return int(1_440_000 * sf)
+    if table == "catalog_returns":
+        return int(144_000 * sf)
     if table == "web_sales":
         return int(720_000 * sf)
+    if table == "web_returns":
+        return int(72_000 * sf)
+    if table == "inventory":
+        return int(2_000_000 * sf)
     if table == "date_dim":
         return _DATE_ROWS
+    if table == "time_dim":
+        return 86400
     if table == "item":
         return max(int(18_000 * max(sf, 1 / 36) ** 0.5), 500)
     if table == "customer":
         return max(int(100_000 * max(sf, 1 / 100) ** 0.5), 1_000)
-    if table == "store":
-        return max(int(12 * max(sf, 1) ** 0.5), 12)
-    if table == "time_dim":
-        return 86400
+    if table == "customer_address":
+        return max(table_row_count("customer", sf) // 2, 500)
+    if table == "customer_demographics":
+        # spec: fixed 1,920,800 attribute cross-product; capped for test
+        # speed -- the sk->attribute decode below is unaffected
+        return min(1_920_800, max(int(1_920_800 * sf), 5_600))
     if table == "household_demographics":
         return 7200
+    if table == "income_band":
+        return 20
+    if table == "store":
+        return max(int(12 * max(sf, 1) ** 0.5), 12)
+    if table == "warehouse":
+        return max(int(5 * max(sf, 1) ** 0.5), 5)
+    if table == "ship_mode":
+        return 20
+    if table == "reason":
+        return 35
+    if table == "promotion":
+        return max(int(300 * max(sf, 1 / 100) ** 0.5), 30)
+    if table == "call_center":
+        return max(int(6 * max(sf, 1) ** 0.5), 6)
+    if table == "catalog_page":
+        return 11_718
+    if table == "web_site":
+        return max(int(30 * max(sf, 1) ** 0.5), 30)
+    if table == "web_page":
+        return max(int(60 * max(sf, 1) ** 0.5), 60)
     raise KeyError(table)
 
 
@@ -128,6 +465,10 @@ def column_type(table: str, column: str) -> T.Type:
             return ty
     raise KeyError(f"{table}.{column}")
 
+
+# ---------------------------------------------------------------------------
+# stateless hash streams
+# ---------------------------------------------------------------------------
 
 _M1 = np.uint64(0xBF58476D1CE4E5B9)
 _M2 = np.uint64(0x94D049BB133111EB)
@@ -157,177 +498,374 @@ def _pick(table, column, idx, choices):
     return np.array(choices, dtype=object)[codes]
 
 
+def _bid(idx):
+    """Business-id string column (the 16-char AAAA...-style ids)."""
+    return np.array([f"AAAAAAAA{v:08d}" for v in idx], dtype=object)
+
+
+# ---------------------------------------------------------------------------
+# generic per-table rules: column -> callable(idx, sf) -> np array.
+# Shared patterns get tiny factory helpers; genuinely derived columns
+# (calendars, parent-linked returns, attribute cross-products) are
+# hand-written below.
+# ---------------------------------------------------------------------------
+
+
+def _fk(table, column, dim):
+    def gen(idx, sf):
+        return _uniform(table, column, idx, 1, table_row_count(dim, sf))
+    return gen
+
+
+def _date_fk(table, column):
+    def gen(idx, sf):
+        return _uniform(table, column, idx, _SOLD_LO, _SOLD_HI) + _SK_BASE
+    return gen
+
+
+def _time_fk(table, column):
+    def gen(idx, sf):
+        return _uniform(table, column, idx, 28800, 79200)  # 8am-10pm
+    return gen
+
+
+def _seq(idx, sf):
+    return (idx + 1).astype(np.int64)
+
+
+def _zip_col(table, column):
+    def gen(idx, sf):
+        return np.array([f"{v:05d}" for v in
+                         _uniform(table, column, idx, 10000, 99999)],
+                        dtype=object)
+    return gen
+
+
+# ---------------------------------------------------------------------------
+# sales fact economics: one shared derivation so every channel's money
+# columns are mutually consistent (ext = qty * unit, net = ext - coupon,
+# tax = 5..9% of net, profit = net - wholesale*qty)
+# ---------------------------------------------------------------------------
+
+
+def _sales_econ(table, idx, sf, what):
+    # staged lazily: each stage's streams are only hashed when the
+    # requested column actually derives from them (column generation is
+    # per-column over millions of rows -- eager derivation would cost
+    # ~7x the hashing for e.g. a bare `quantity` request)
+    qty = _uniform(table, "qty", idx, 1, 100)
+    if what == "quantity":
+        return qty.astype(np.int32)
+    lp = _uniform(table, "list", idx, 100, 20000)
+    if what == "list_price":
+        return lp
+    if what == "ext_list_price":
+        return qty * lp
+    if what == "wholesale_cost":
+        return lp * _uniform(table, "wfrac", idx, 30, 90) // 100
+    if what == "ext_wholesale_cost":
+        return qty * (lp * _uniform(table, "wfrac", idx, 30, 90) // 100)
+    disc = _uniform(table, "sdisc", idx, 0, 100)
+    sp = lp * (100 - disc) // 100
+    if what == "sales_price":
+        return sp
+    if what == "ext_discount_amt":
+        return qty * (lp * disc // 100)
+    ext_sales = qty * sp
+    if what == "ext_sales_price":
+        return ext_sales
+    coupon_on = _uniform(table, "cpon", idx, 0, 9) == 0  # 10% of rows
+    coupon = np.where(coupon_on, ext_sales // 10, 0)
+    if what == "coupon_amt":
+        return coupon
+    net_paid = ext_sales - coupon
+    if what == "net_paid":
+        return net_paid
+    if what == "net_profit":
+        whole = lp * _uniform(table, "wfrac", idx, 30, 90) // 100
+        return net_paid - qty * whole
+    if what in ("ext_tax", "net_paid_inc_tax", "net_paid_inc_ship_tax"):
+        taxr = _uniform(table, "taxr", idx, 0, 9)
+        tax = net_paid * taxr // 100
+        if what == "ext_tax":
+            return tax
+        if what == "net_paid_inc_tax":
+            return net_paid + tax
+        ship = qty * _uniform(table, "shipc", idx, 50, 1000)
+        return net_paid + ship + tax
+    ship = qty * _uniform(table, "shipc", idx, 50, 1000)
+    if what == "ext_ship_cost":
+        return ship
+    if what == "net_paid_inc_ship":
+        return net_paid + ship
+    raise KeyError(what)
+
+
+_ECON_COLS = {"quantity", "list_price", "sales_price", "wholesale_cost",
+              "ext_discount_amt", "ext_sales_price", "ext_wholesale_cost",
+              "ext_list_price", "ext_tax", "coupon_amt", "ext_ship_cost",
+              "net_paid", "net_paid_inc_tax", "net_paid_inc_ship",
+              "net_paid_inc_ship_tax", "net_profit"}
+
+
+# ---------------------------------------------------------------------------
+# store_sales / catalog_sales / web_sales
+# ---------------------------------------------------------------------------
+
+
 def _gen_store_sales(column, idx, sf):
-    n_item = table_row_count("item", sf)
-    n_cust = table_row_count("customer", sf)
-    n_store = table_row_count("store", sf)
+    base = column[3:]
+    if base in _ECON_COLS:
+        return _sales_econ("store_sales", idx, sf, base)
     if column == "ss_sold_date_sk":
-        d = _uniform("store_sales", "sold", idx, _SOLD_LO, _SOLD_HI)
-        return d + _SK_BASE
+        return _date_fk("store_sales", "sold")(idx, sf)
     if column == "ss_sold_time_sk":
-        return _uniform("store_sales", "time", idx, 28800, 79200)  # 8am-10pm
+        return _time_fk("store_sales", "time")(idx, sf)
     if column == "ss_item_sk":
-        return _uniform("store_sales", "item", idx, 1, n_item)
+        return _fk("store_sales", "item", "item")(idx, sf)
     if column == "ss_customer_sk":
-        return _uniform("store_sales", "cust", idx, 1, n_cust)
+        return _fk("store_sales", "cust", "customer")(idx, sf)
+    if column == "ss_cdemo_sk":
+        return _fk("store_sales", "cdemo", "customer_demographics")(idx, sf)
     if column == "ss_hdemo_sk":
-        return _uniform("store_sales", "hdemo", idx, 1,
-                        table_row_count("household_demographics", sf))
+        return _fk("store_sales", "hdemo", "household_demographics")(idx, sf)
+    if column == "ss_addr_sk":
+        return _fk("store_sales", "addr", "customer_address")(idx, sf)
     if column == "ss_store_sk":
-        return _uniform("store_sales", "store", idx, 1, n_store)
-    if column == "ss_quantity":
-        return _uniform("store_sales", "qty", idx, 1, 100).astype(np.int32)
-    if column == "ss_list_price":
-        return _uniform("store_sales", "list", idx, 100, 20000)
-    if column == "ss_sales_price":
-        lp = _uniform("store_sales", "list", idx, 100, 20000)
-        disc = _uniform("store_sales", "sdisc", idx, 0, 100)
-        return (lp * (100 - disc) // 100).astype(np.int64)
-    if column == "ss_ext_sales_price":
-        qty = _uniform("store_sales", "qty", idx, 1, 100)
-        lp = _uniform("store_sales", "list", idx, 100, 20000)
-        disc = _uniform("store_sales", "sdisc", idx, 0, 100)
-        return (qty * (lp * (100 - disc) // 100)).astype(np.int64)
-    if column == "ss_ext_discount_amt":
-        qty = _uniform("store_sales", "qty", idx, 1, 100)
-        lp = _uniform("store_sales", "list", idx, 100, 20000)
-        disc = _uniform("store_sales", "sdisc", idx, 0, 100)
-        return (qty * (lp * disc // 100)).astype(np.int64)
-    if column == "ss_net_profit":
-        return _uniform("store_sales", "profit", idx, -500000, 900000)
+        return _fk("store_sales", "store", "store")(idx, sf)
+    if column == "ss_promo_sk":
+        return _fk("store_sales", "promo", "promotion")(idx, sf)
     if column == "ss_ticket_number":
         return (idx // 8 + 1).astype(np.int64)
     raise KeyError(f"store_sales.{column}")
+
+
+def _gen_channel_sales(table, prefix, lines_per_order):
+    def gen(column, idx, sf):
+        base = column[len(prefix):]
+        if base in _ECON_COLS:
+            return _sales_econ(table, idx, sf, base)
+        if base == "sold_date_sk":
+            return _date_fk(table, "sold")(idx, sf)
+        if base == "sold_time_sk":
+            return _time_fk(table, "time")(idx, sf)
+        if base == "ship_date_sk":
+            sold = _uniform(table, "sold", idx, _SOLD_LO, _SOLD_HI)
+            lag = _uniform(table, "shiplag", idx, 1, 150)
+            return sold + lag + _SK_BASE
+        if base == "item_sk":
+            return _fk(table, "item", "item")(idx, sf)
+        if base in ("bill_customer_sk", "ship_customer_sk"):
+            return _fk(table, base, "customer")(idx, sf)
+        if base in ("bill_cdemo_sk", "ship_cdemo_sk"):
+            return _fk(table, base, "customer_demographics")(idx, sf)
+        if base in ("bill_hdemo_sk", "ship_hdemo_sk"):
+            return _fk(table, base, "household_demographics")(idx, sf)
+        if base in ("bill_addr_sk", "ship_addr_sk"):
+            return _fk(table, base, "customer_address")(idx, sf)
+        if base == "call_center_sk":
+            return _fk(table, base, "call_center")(idx, sf)
+        if base == "catalog_page_sk":
+            return _fk(table, base, "catalog_page")(idx, sf)
+        if base == "ship_mode_sk":
+            return _fk(table, base, "ship_mode")(idx, sf)
+        if base == "warehouse_sk":
+            return _fk(table, base, "warehouse")(idx, sf)
+        if base == "web_page_sk":
+            return _fk(table, base, "web_page")(idx, sf)
+        if base == "web_site_sk":
+            return _fk(table, base, "web_site")(idx, sf)
+        if base == "promo_sk":
+            return _fk(table, base, "promotion")(idx, sf)
+        if base == "order_number":
+            return (idx // lines_per_order + 1).astype(np.int64)
+        raise KeyError(f"{table}.{column}")
+    return gen
+
+
+# ---------------------------------------------------------------------------
+# returns: each return row links to a real parent sales row, so
+# sales-to-returns joins (ticket/order number + item) behave like dsdgen
+# ---------------------------------------------------------------------------
+
+
+def _gen_returns(table, prefix, parent_table, parent_gen, parent_prefix,
+                 amount_name):
+    """Return-table generator. Row i's parent sales row index is a
+    uniform hash into the parent table; linking columns re-derive the
+    parent's values at that index (stateless cross-table consistency).
+    The returns:sales row-count ratio lives in table_row_count."""
+
+    def parent_idx(idx, sf):
+        n_parent = max(table_row_count(parent_table, sf), 1)
+        return _uniform(table, "parent", idx, 0, n_parent - 1)
+
+    def gen(column, idx, sf):
+        base = column[len(prefix):]
+
+        def p(col):
+            return parent_gen(parent_prefix + col, parent_idx(idx, sf), sf)
+
+        if base == "item_sk":
+            return p("item_sk")
+        if base in ("ticket_number", "order_number"):
+            return p(base)
+        if base in ("customer_sk", "refunded_customer_sk"):
+            return p("customer_sk") if parent_table == "store_sales" \
+                else p("bill_customer_sk")
+        if base == "returning_customer_sk":
+            return _fk(table, base, "customer")(idx, sf)
+        if base in ("cdemo_sk", "refunded_cdemo_sk", "returning_cdemo_sk"):
+            return _fk(table, base, "customer_demographics")(idx, sf)
+        if base in ("hdemo_sk", "refunded_hdemo_sk", "returning_hdemo_sk"):
+            return _fk(table, base, "household_demographics")(idx, sf)
+        if base in ("addr_sk", "refunded_addr_sk", "returning_addr_sk"):
+            return _fk(table, base, "customer_address")(idx, sf)
+        if base == "store_sk":
+            return p("store_sk")
+        if base == "reason_sk":
+            return _fk(table, base, "reason")(idx, sf)
+        if base == "call_center_sk":
+            return _fk(table, base, "call_center")(idx, sf)
+        if base == "catalog_page_sk":
+            return _fk(table, base, "catalog_page")(idx, sf)
+        if base == "ship_mode_sk":
+            return _fk(table, base, "ship_mode")(idx, sf)
+        if base == "warehouse_sk":
+            return _fk(table, base, "warehouse")(idx, sf)
+        if base == "web_page_sk":
+            return _fk(table, base, "web_page")(idx, sf)
+        if base == "returned_date_sk":
+            # returned within 90 days of the parent's sale date
+            sold = p("sold_date_sk") - _SK_BASE
+            lag = _uniform(table, "retlag", idx, 1, 90)
+            return np.minimum(sold + lag, _SOLD_HI + 90) + _SK_BASE
+        if base in ("returned_time_sk", "return_time_sk"):
+            return _time_fk(table, "rtime")(idx, sf)
+        # money columns derive from the parent's economics
+        pqty = p("quantity").astype(np.int64)
+        psp = p("sales_price")
+        rqty = 1 + _uniform(table, "rqty", idx, 0, 99) % np.maximum(pqty, 1)
+        amt = rqty * psp
+        taxr = _uniform(table, "rtaxr", idx, 0, 9)
+        tax = amt * taxr // 100
+        if base == "return_quantity":
+            return rqty.astype(np.int32)
+        if base == amount_name:   # return_amt / return_amount
+            return amt
+        if base == "return_tax":
+            return tax
+        if base == "return_amt_inc_tax":
+            return amt + tax
+        if base == "fee":
+            return _uniform(table, "fee", idx, 50, 10000)
+        if base == "return_ship_cost":
+            return rqty * _uniform(table, "rship", idx, 50, 1000)
+        if base == "refunded_cash":
+            return amt // 2
+        if base == "reversed_charge":
+            return amt // 4
+        if base in ("store_credit", "account_credit"):
+            return amt - amt // 2 - amt // 4
+        if base == "net_loss":
+            return tax + _uniform(table, "nloss", idx, 50, 10000)
+        raise KeyError(f"{table}.{column}")
+
+    return gen
+
+
+# ---------------------------------------------------------------------------
+# dimensions
+# ---------------------------------------------------------------------------
 
 
 def _gen_date_dim(column, idx, sf):
     days = idx.astype(np.int64)  # days since 1900-01-01
     if column == "d_date_sk":
         return days + _SK_BASE
+    if column == "d_date_id":
+        return _bid(idx)
     if column == "d_date":
         return (days + _EPOCH_OFFSET_DAYS).astype(np.int32)
-    # civil calendar via numpy datetime64
     dates = (np.datetime64("1900-01-01") + days).astype("datetime64[D]")
     y = dates.astype("datetime64[Y]").astype(int) + 1970
-    m = dates.astype("datetime64[M]").astype(int) % 12 + 1
-    if column == "d_year":
+    months = dates.astype("datetime64[M]")
+    m = months.astype(int) % 12 + 1
+    if column == "d_year" or column == "d_fy_year":
         return y.astype(np.int32)
     if column == "d_moy":
         return m.astype(np.int32)
     if column == "d_dom":
-        dom = (dates - dates.astype("datetime64[M]")).astype(int) + 1
-        return dom.astype(np.int32)
+        return ((dates - months).astype(int) + 1).astype(np.int32)
     if column == "d_qoy":
         return ((m - 1) // 3 + 1).astype(np.int32)
+    if column == "d_month_seq":
+        # month_seq 0 = 1900-01 (spec: q62-style windows use 1200=2000-01)
+        return ((y - 1900) * 12 + (m - 1)).astype(np.int32)
+    if column == "d_week_seq" or column == "d_fy_week_seq":
+        return (days // 7 + 1).astype(np.int32)
+    if column == "d_quarter_seq" or column == "d_fy_quarter_seq":
+        return ((y - 1900) * 4 + (m - 1) // 3).astype(np.int32)
+    if column == "d_dow":
+        return ((days + 1) % 7).astype(np.int32)  # 0=Sunday; 1900-01-01 Mon
     if column == "d_day_name":
-        dow = ((days + 0) % 7).astype(np.int64)  # 1900-01-01 was a Monday
-        return np.array(_DAY_NAMES, dtype=object)[dow]
+        return np.array(_DAY_NAMES, dtype=object)[(days % 7)]
+    if column == "d_quarter_name":
+        q = (m - 1) // 3 + 1
+        return np.array([f"{yy}Q{qq}" for yy, qq in zip(y, q)], dtype=object)
+    if column == "d_holiday":
+        return np.where((m == 12) & (((dates - months).astype(int) + 1) == 25),
+                        "Y", "N").astype(object)
+    if column == "d_weekend":
+        dow = (days + 1) % 7
+        return np.where((dow == 0) | (dow == 6), "Y", "N").astype(object)
+    if column == "d_following_holiday":
+        return np.where((m == 12) & (((dates - months).astype(int) + 1) == 26),
+                        "Y", "N").astype(object)
+    if column == "d_first_dom":
+        first = (months.astype("datetime64[D]")
+                 - np.datetime64("1900-01-01")).astype(int)
+        return first + _SK_BASE
+    if column == "d_last_dom":
+        nxt = (months + 1).astype("datetime64[D]")
+        last = (nxt - np.datetime64("1900-01-01")).astype(int) - 1
+        return last + _SK_BASE
+    if column == "d_same_day_ly":
+        return days - 365 + _SK_BASE
+    if column == "d_same_day_lq":
+        return days - 91 + _SK_BASE
+    if column in ("d_current_day", "d_current_week", "d_current_month",
+                  "d_current_quarter", "d_current_year"):
+        return np.full(len(idx), "N", dtype=object)
     raise KeyError(f"date_dim.{column}")
-
-
-def _gen_item(column, idx, sf):
-    if column == "i_item_sk":
-        return (idx + 1).astype(np.int64)
-    if column == "i_item_id":
-        return np.array([f"AAAAAAAA{v:08d}" for v in idx], dtype=object)
-    if column == "i_brand_id":
-        return _uniform("item", "brand", idx, 1001001, 1010016).astype(np.int32)
-    if column == "i_brand":
-        b = _uniform("item", "brand", idx, 1001001, 1010016)
-        return np.char.add("Brand#", b.astype(str)).astype(object)
-    if column == "i_manufact_id":
-        return _uniform("item", "manufact", idx, 1, 1000).astype(np.int32)
-    if column == "i_category_id":
-        return (_h("item", "category", idx) % np.uint64(10) + 1).astype(np.int32)
-    if column == "i_category":
-        codes = (_h("item", "category", idx) % np.uint64(10)).astype(np.int64)
-        return np.array(_CATEGORIES, dtype=object)[codes]
-    if column == "i_manager_id":
-        return _uniform("item", "manager", idx, 1, 100).astype(np.int32)
-    if column == "i_current_price":
-        return _uniform("item", "price", idx, 100, 10000)
-    raise KeyError(f"item.{column}")
-
-
-def _gen_customer(column, idx, sf):
-    if column == "c_customer_sk":
-        return (idx + 1).astype(np.int64)
-    if column == "c_customer_id":
-        return np.array([f"AAAAAAAA{v:08d}" for v in idx], dtype=object)
-    if column == "c_current_addr_sk":
-        return _uniform("customer", "addr", idx, 1, max(table_row_count(
-            "customer", sf) // 2, 1))
-    if column == "c_first_name":
-        return _pick("customer", "first", idx,
-                     ["James", "Mary", "John", "Linda", "David", "Susan"])
-    if column == "c_last_name":
-        return _pick("customer", "last", idx,
-                     ["Smith", "Jones", "Brown", "Lee", "Garcia", "Miller"])
-    if column == "c_birth_year":
-        return _uniform("customer", "birth", idx, 1924, 1992).astype(np.int32)
-    raise KeyError(f"customer.{column}")
-
-
-def _gen_store(column, idx, sf):
-    if column == "s_store_sk":
-        return (idx + 1).astype(np.int64)
-    if column == "s_store_id":
-        return np.array([f"AAAAAAAA{v:08d}" for v in idx], dtype=object)
-    if column == "s_store_name":
-        return _pick("store", "name", idx, ["ought", "able", "pri", "ese",
-                                            "anti", "cally"])
-    if column == "s_state":
-        return _pick("store", "state", idx, _STATES)
-    raise KeyError(f"store.{column}")
-
-
-def _make_channel_gen(table: str, prefix: str, lines_per_order: int):
-    """catalog_sales / web_sales share store_sales' shape with their own
-    column prefixes and hash streams."""
-
-    def gen(column, idx, sf):
-        n_item = table_row_count("item", sf)
-        n_cust = table_row_count("customer", sf)
-        base = column[len(prefix):]
-        if base == "sold_date_sk":
-            d = _uniform(table, "sold", idx, _SOLD_LO, _SOLD_HI)
-            return d + _SK_BASE
-        if base == "item_sk":
-            return _uniform(table, "item", idx, 1, n_item)
-        if base == "bill_customer_sk":
-            return _uniform(table, "cust", idx, 1, n_cust)
-        if base == "quantity":
-            return _uniform(table, "qty", idx, 1, 100).astype(np.int32)
-        if base == "list_price":
-            return _uniform(table, "list", idx, 100, 20000)
-        if base == "sales_price":
-            lp = _uniform(table, "list", idx, 100, 20000)
-            disc = _uniform(table, "sdisc", idx, 0, 100)
-            return (lp * (100 - disc) // 100).astype(np.int64)
-        if base == "ext_sales_price":
-            qty = _uniform(table, "qty", idx, 1, 100)
-            lp = _uniform(table, "list", idx, 100, 20000)
-            disc = _uniform(table, "sdisc", idx, 0, 100)
-            return (qty * (lp * (100 - disc) // 100)).astype(np.int64)
-        if base == "net_profit":
-            return _uniform(table, "profit", idx, -500000, 900000)
-        if base == "order_number":
-            return (idx // lines_per_order + 1).astype(np.int64)
-        raise KeyError(f"{table}.{column}")
-
-    return gen
 
 
 def _gen_time_dim(column, idx, sf):
     secs = idx.astype(np.int64)
     if column == "t_time_sk":
         return secs
+    if column == "t_time_id":
+        return _bid(idx)
+    if column == "t_time":
+        return secs.astype(np.int32)
     if column == "t_hour":
         return (secs // 3600).astype(np.int32)
     if column == "t_minute":
         return (secs // 60 % 60).astype(np.int32)
     if column == "t_second":
         return (secs % 60).astype(np.int32)
+    if column == "t_am_pm":
+        return np.where(secs < 43200, "AM", "PM").astype(object)
+    if column == "t_shift":
+        return np.array(_SHIFTS, dtype=object)[
+            np.minimum(secs // 28800, 2)]
+    if column == "t_sub_shift":
+        h = secs // 3600
+        out = np.full(len(idx), "night", dtype=object)
+        out[(h >= 6) & (h < 12)] = "morning"
+        out[(h >= 12) & (h < 18)] = "afternoon"
+        out[(h >= 18) & (h < 22)] = "evening"
+        return out
     if column == "t_meal_time":
         h = secs // 3600
         out = np.full(len(idx), "", dtype=object)
@@ -338,28 +876,644 @@ def _gen_time_dim(column, idx, sf):
     raise KeyError(f"time_dim.{column}")
 
 
+def _gen_item(column, idx, sf):
+    if column == "i_item_sk":
+        return _seq(idx, sf)
+    if column == "i_item_id":
+        # spec: pairs of sks share a business id (SCD type-2 history)
+        return _bid(idx // 2 * 2)
+    if column == "i_rec_start_date":
+        return np.full(len(idx), int((np.datetime64("1997-10-27")
+                                      - np.datetime64("1970-01-01"))
+                                     .astype(int)), dtype=np.int32)
+    if column == "i_rec_end_date":
+        return np.full(len(idx), int((np.datetime64("2001-10-26")
+                                      - np.datetime64("1970-01-01"))
+                                     .astype(int)), dtype=np.int32)
+    if column == "i_item_desc":
+        return _pick("item", "desc", idx,
+                     ["Some plain item", "A fine item", "Quality goods",
+                      "Imported stock", "Seasonal merchandise",
+                      "Standard issue", "Premium selection",
+                      "Classic style", "Modern design", "Budget line"])
+    if column == "i_current_price":
+        return _uniform("item", "price", idx, 100, 10000)
+    if column == "i_wholesale_cost":
+        return _uniform("item", "price", idx, 100, 10000) * \
+            _uniform("item", "wfrac", idx, 30, 80) // 100
+    if column == "i_brand_id":
+        return _uniform("item", "brand", idx, 1001001, 1010016).astype(np.int32)
+    if column == "i_brand":
+        b = _uniform("item", "brand", idx, 1001001, 1010016)
+        return np.char.add("Brand#", b.astype(str)).astype(object)
+    if column == "i_class_id":
+        return (_h("item", "class", idx) % np.uint64(16) + 1).astype(np.int32)
+    if column == "i_class":
+        codes = (_h("item", "class", idx) % np.uint64(16)).astype(np.int64)
+        return np.array(_CLASSES, dtype=object)[codes]
+    if column == "i_category_id":
+        return (_h("item", "category", idx) % np.uint64(10) + 1).astype(np.int32)
+    if column == "i_category":
+        codes = (_h("item", "category", idx) % np.uint64(10)).astype(np.int64)
+        return np.array(_CATEGORIES, dtype=object)[codes]
+    if column == "i_manufact_id":
+        return _uniform("item", "manufact", idx, 1, 1000).astype(np.int32)
+    if column == "i_manufact":
+        m = _uniform("item", "manufact", idx, 1, 1000)
+        return np.char.add("manufact#", m.astype(str)).astype(object)
+    if column == "i_size":
+        return _pick("item", "size", idx, _SIZES)
+    if column == "i_formulation":
+        return _bid(_uniform("item", "formul", idx, 0, 99999))
+    if column == "i_color":
+        return _pick("item", "color", idx, _COLORS)
+    if column == "i_units":
+        return _pick("item", "units", idx, _UNITS)
+    if column == "i_container":
+        return _pick("item", "container", idx, _CONTAINERS)
+    if column == "i_manager_id":
+        return _uniform("item", "manager", idx, 1, 100).astype(np.int32)
+    if column == "i_product_name":
+        return _pick("item", "pname", idx,
+                     ["oughtn st", "ableoughtn st", "prioughtn st",
+                      "eseoughtn st", "antioughtn st", "callyoughtn st",
+                      "ationoughtn st", "eingoughtn st", "baroughtn st",
+                      "n stoughtn st"])
+    raise KeyError(f"item.{column}")
+
+
+def _gen_customer(column, idx, sf):
+    if column == "c_customer_sk":
+        return _seq(idx, sf)
+    if column == "c_customer_id":
+        return _bid(idx)
+    if column == "c_current_cdemo_sk":
+        return _fk("customer", "cdemo", "customer_demographics")(idx, sf)
+    if column == "c_current_hdemo_sk":
+        return _fk("customer", "hdemo", "household_demographics")(idx, sf)
+    if column == "c_current_addr_sk":
+        return _fk("customer", "addr", "customer_address")(idx, sf)
+    if column in ("c_first_shipto_date_sk", "c_first_sales_date_sk",
+                  "c_last_review_date_sk"):
+        return _date_fk("customer", column)(idx, sf)
+    if column == "c_salutation":
+        return _pick("customer", "salut", idx,
+                     ["Mr.", "Mrs.", "Ms.", "Dr.", "Miss", "Sir"])
+    if column == "c_first_name":
+        return _pick("customer", "first", idx, _FIRST_NAMES)
+    if column == "c_last_name":
+        return _pick("customer", "last", idx, _LAST_NAMES)
+    if column == "c_preferred_cust_flag":
+        return _pick("customer", "pref", idx, _YN)
+    if column == "c_birth_day":
+        return _uniform("customer", "bday", idx, 1, 28).astype(np.int32)
+    if column == "c_birth_month":
+        return _uniform("customer", "bmon", idx, 1, 12).astype(np.int32)
+    if column == "c_birth_year":
+        return _uniform("customer", "birth", idx, 1924, 1992).astype(np.int32)
+    if column == "c_birth_country":
+        return _pick("customer", "bcountry", idx,
+                     ["UNITED STATES", "CANADA", "MEXICO", "GERMANY",
+                      "FRANCE", "JAPAN", "BRAZIL", "INDIA"])
+    if column == "c_login":
+        return np.full(len(idx), "", dtype=object)
+    if column == "c_email_address":
+        return np.array([f"user{v}@example.com" for v in idx], dtype=object)
+    raise KeyError(f"customer.{column}")
+
+
+def _gen_customer_address(column, idx, sf):
+    if column == "ca_address_sk":
+        return _seq(idx, sf)
+    if column == "ca_address_id":
+        return _bid(idx)
+    if column == "ca_street_number":
+        return _uniform("customer_address", "stno", idx, 1,
+                        999).astype(str).astype(object)
+    if column == "ca_street_name":
+        return _pick("customer_address", "stname", idx, _STREET_NAMES)
+    if column == "ca_street_type":
+        return _pick("customer_address", "sttype", idx, _STREET_TYPES)
+    if column == "ca_suite_number":
+        s = _uniform("customer_address", "suite", idx, 0, 99)
+        return np.array([f"Suite {v}" for v in s], dtype=object)
+    if column == "ca_city":
+        return _pick("customer_address", "city", idx, _CITIES)
+    if column == "ca_county":
+        return _pick("customer_address", "county", idx, _COUNTIES)
+    if column == "ca_state":
+        return _pick("customer_address", "state", idx, _STATES)
+    if column == "ca_zip":
+        return _zip_col("customer_address", "zip")(idx, sf)
+    if column == "ca_country":
+        return np.full(len(idx), "United States", dtype=object)
+    if column == "ca_gmt_offset":
+        return _uniform("customer_address", "gmt", idx, -8, -5) * 100
+    if column == "ca_location_type":
+        return _pick("customer_address", "loctype", idx,
+                     ["apartment", "condo", "single family"])
+    raise KeyError(f"customer_address.{column}")
+
+
+# cd: mixed-radix attribute cross-product keyed by sk (spec design)
+_CD_RADIX = [len(_GENDERS), len(_MARITAL), len(_EDUCATION), 20,
+             len(_CREDIT), 7, 7, 7]
+
+
+def _gen_customer_demographics(column, idx, sf):
+    code = idx.astype(np.int64)
+    parts = []
+    for r in _CD_RADIX:
+        parts.append(code % r)
+        code = code // r
+    g, m, e, pe, cr, dc, de, dcol = parts
+    if column == "cd_demo_sk":
+        return _seq(idx, sf)
+    if column == "cd_gender":
+        return np.array(_GENDERS, dtype=object)[g]
+    if column == "cd_marital_status":
+        return np.array(_MARITAL, dtype=object)[m]
+    if column == "cd_education_status":
+        return np.array(_EDUCATION, dtype=object)[e]
+    if column == "cd_purchase_estimate":
+        return ((pe + 1) * 500).astype(np.int32)
+    if column == "cd_credit_rating":
+        return np.array(_CREDIT, dtype=object)[cr]
+    if column == "cd_dep_count":
+        return dc.astype(np.int32)
+    if column == "cd_dep_employed_count":
+        return de.astype(np.int32)
+    if column == "cd_dep_college_count":
+        return dcol.astype(np.int32)
+    raise KeyError(f"customer_demographics.{column}")
+
+
 def _gen_household_demographics(column, idx, sf):
     if column == "hd_demo_sk":
-        return (idx + 1).astype(np.int64)
+        return _seq(idx, sf)
+    if column == "hd_income_band_sk":
+        return (idx % 20 + 1).astype(np.int64)
+    if column == "hd_buy_potential":
+        return _pick("household_demographics", "buy", idx, _BUY_POTENTIAL)
     if column == "hd_dep_count":
         return (idx % 10).astype(np.int32)
     if column == "hd_vehicle_count":
         return (idx // 10 % 5).astype(np.int32)
-    if column == "hd_buy_potential":
-        return _pick("household_demographics", "buy", idx,
-                     ["0-500", "501-1000", "1001-5000", "5001-10000",
-                      ">10000", "Unknown"])
     raise KeyError(f"household_demographics.{column}")
 
 
+def _gen_income_band(column, idx, sf):
+    if column == "ib_income_band_sk":
+        return _seq(idx, sf)
+    if column == "ib_lower_bound":
+        return (idx * 10000).astype(np.int32)
+    if column == "ib_upper_bound":
+        return ((idx + 1) * 10000).astype(np.int32)
+    raise KeyError(f"income_band.{column}")
+
+
+def _gen_inventory(column, idx, sf):
+    # The spec's inventory is a DENSE item x warehouse x week snapshot
+    # (23.5M rows at SF1). The scaled-down analog keeps that density by
+    # restricting the item domain to the first ~10% of items, so
+    # inventory-ratio queries (q21/q37/q82 family) see several
+    # snapshots per (item, warehouse, date window) instead of a
+    # vanishing uniform scatter.
+    if column == "inv_date_sk":
+        # weekly snapshots across the sold-date span
+        week = _uniform("inventory", "week", idx, _SOLD_LO // 7,
+                        _SOLD_HI // 7)
+        return week * 7 + _SK_BASE
+    if column == "inv_item_sk":
+        n = max(table_row_count("item", sf) // 10, 50)
+        return _uniform("inventory", "item", idx, 1, n)
+    if column == "inv_warehouse_sk":
+        return _fk("inventory", "wh", "warehouse")(idx, sf)
+    if column == "inv_quantity_on_hand":
+        return _uniform("inventory", "qoh", idx, 0, 1000).astype(np.int32)
+    raise KeyError(f"inventory.{column}")
+
+
+def _gen_store(column, idx, sf):
+    if column == "s_store_sk":
+        return _seq(idx, sf)
+    if column == "s_store_id":
+        return _bid(idx // 2 * 2)
+    if column == "s_rec_start_date":
+        return np.full(len(idx), int((np.datetime64("1997-03-13")
+                                      - np.datetime64("1970-01-01"))
+                                     .astype(int)), dtype=np.int32)
+    if column == "s_rec_end_date":
+        return np.full(len(idx), int((np.datetime64("2001-03-12")
+                                      - np.datetime64("1970-01-01"))
+                                     .astype(int)), dtype=np.int32)
+    if column == "s_closed_date_sk":
+        return np.zeros(len(idx), dtype=np.int64)
+    if column == "s_store_name":
+        return _pick("store", "name", idx, ["ought", "able", "pri", "ese",
+                                            "anti", "cally"])
+    if column == "s_number_employees":
+        return _uniform("store", "emps", idx, 200, 300).astype(np.int32)
+    if column == "s_floor_space":
+        return _uniform("store", "floor", idx, 5000000,
+                        10000000).astype(np.int32)
+    if column == "s_hours":
+        return _pick("store", "hours", idx, ["8AM-8AM", "8AM-4PM", "8AM-12AM"])
+    if column in ("s_manager", "s_market_manager"):
+        f = _pick("store", column + "f", idx, _FIRST_NAMES)
+        l_ = _pick("store", column + "l", idx, _LAST_NAMES)
+        return np.array([f"{a} {b}" for a, b in zip(f, l_)], dtype=object)
+    if column == "s_market_id":
+        return _uniform("store", "mkt", idx, 1, 10).astype(np.int32)
+    if column == "s_geography_class":
+        return np.full(len(idx), "Unknown", dtype=object)
+    if column == "s_market_desc":
+        return _pick("store", "mktdesc", idx,
+                     ["Great market", "Growing market", "Stable market"])
+    if column == "s_division_id":
+        return np.ones(len(idx), dtype=np.int32)
+    if column == "s_division_name":
+        return np.full(len(idx), "Unknown", dtype=object)
+    if column == "s_company_id":
+        return np.ones(len(idx), dtype=np.int32)
+    if column == "s_company_name":
+        return np.full(len(idx), "Unknown", dtype=object)
+    if column == "s_street_number":
+        return _uniform("store", "stno", idx, 1, 999).astype(str).astype(object)
+    if column == "s_street_name":
+        return _pick("store", "stname", idx, _STREET_NAMES)
+    if column == "s_street_type":
+        return _pick("store", "sttype", idx, _STREET_TYPES)
+    if column == "s_suite_number":
+        s = _uniform("store", "suite", idx, 0, 99)
+        return np.array([f"Suite {v}" for v in s], dtype=object)
+    if column == "s_city":
+        return _pick("store", "city", idx, _CITIES)
+    if column == "s_county":
+        return _pick("store", "county", idx, _COUNTIES)
+    if column == "s_state":
+        return _pick("store", "state", idx, _STATES)
+    if column == "s_zip":
+        return _zip_col("store", "zip")(idx, sf)
+    if column == "s_country":
+        return np.full(len(idx), "United States", dtype=object)
+    if column == "s_gmt_offset":
+        return _uniform("store", "gmt", idx, -8, -5) * 100
+    if column == "s_tax_precentage":
+        return _uniform("store", "tax", idx, 0, 11)
+    raise KeyError(f"store.{column}")
+
+
+def _gen_warehouse(column, idx, sf):
+    if column == "w_warehouse_sk":
+        return _seq(idx, sf)
+    if column == "w_warehouse_id":
+        return _bid(idx)
+    if column == "w_warehouse_name":
+        return _pick("warehouse", "name", idx,
+                     ["Conventional childr", "Important issues liv",
+                      "Doors canno", "Bad cards must make.",
+                      "Rooms cook ", "Simple facts m"])
+    if column == "w_warehouse_sq_ft":
+        return _uniform("warehouse", "sqft", idx, 50000,
+                        1000000).astype(np.int32)
+    if column == "w_street_number":
+        return _uniform("warehouse", "stno", idx, 1,
+                        999).astype(str).astype(object)
+    if column == "w_street_name":
+        return _pick("warehouse", "stname", idx, _STREET_NAMES)
+    if column == "w_street_type":
+        return _pick("warehouse", "sttype", idx, _STREET_TYPES)
+    if column == "w_suite_number":
+        s = _uniform("warehouse", "suite", idx, 0, 99)
+        return np.array([f"Suite {v}" for v in s], dtype=object)
+    if column == "w_city":
+        return _pick("warehouse", "city", idx, _CITIES)
+    if column == "w_county":
+        return _pick("warehouse", "county", idx, _COUNTIES)
+    if column == "w_state":
+        return _pick("warehouse", "state", idx, _STATES)
+    if column == "w_zip":
+        return _zip_col("warehouse", "zip")(idx, sf)
+    if column == "w_country":
+        return np.full(len(idx), "United States", dtype=object)
+    if column == "w_gmt_offset":
+        return _uniform("warehouse", "gmt", idx, -8, -5) * 100
+    raise KeyError(f"warehouse.{column}")
+
+
+def _gen_ship_mode(column, idx, sf):
+    if column == "sm_ship_mode_sk":
+        return _seq(idx, sf)
+    if column == "sm_ship_mode_id":
+        return _bid(idx)
+    if column == "sm_type":
+        return np.array(_SM_TYPES, dtype=object)[idx % len(_SM_TYPES)]
+    if column == "sm_code":
+        return np.array(_SM_CODES, dtype=object)[idx % len(_SM_CODES)]
+    if column == "sm_carrier":
+        return np.array(_SM_CARRIERS, dtype=object)[idx % len(_SM_CARRIERS)]
+    if column == "sm_contract":
+        return _bid(_uniform("ship_mode", "contract", idx, 0, 99999))
+    raise KeyError(f"ship_mode.{column}")
+
+
+def _gen_reason(column, idx, sf):
+    if column == "r_reason_sk":
+        return _seq(idx, sf)
+    if column == "r_reason_id":
+        return _bid(idx)
+    if column == "r_reason_desc":
+        return _pick("reason", "desc", idx,
+                     ["Package was damaged", "Stopped working",
+                      "Did not fit", "Found a better price",
+                      "Not the product that was ordred", "Parts missing",
+                      "Does not work with a product that I have",
+                      "Gift exchange", "Did not like the color",
+                      "Did not like the model", "Did not like the make",
+                      "Did not like the warranty", "No service location",
+                      "duplicate purchase", "unauthoized purchase",
+                      "reason 16", "reason 17", "reason 18"])
+    raise KeyError(f"reason.{column}")
+
+
+def _gen_promotion(column, idx, sf):
+    if column == "p_promo_sk":
+        return _seq(idx, sf)
+    if column == "p_promo_id":
+        return _bid(idx)
+    if column == "p_start_date_sk":
+        return _date_fk("promotion", "start")(idx, sf)
+    if column == "p_end_date_sk":
+        return _date_fk("promotion", "start")(idx, sf) + \
+            _uniform("promotion", "len", idx, 10, 60)
+    if column == "p_item_sk":
+        return _fk("promotion", "item", "item")(idx, sf)
+    if column == "p_cost":
+        return np.full(len(idx), 100000, dtype=np.int64)  # 1000.00
+    if column == "p_response_target":
+        return np.ones(len(idx), dtype=np.int32)
+    if column == "p_promo_name":
+        return _pick("promotion", "name", idx,
+                     ["anti", "ought", "able", "pri", "ese", "cally",
+                      "ation", "eing", "bar", "n st"])
+    if column.startswith("p_channel_") and column != "p_channel_details":
+        return _pick("promotion", column, idx, ["N", "N", "N", "Y"])
+    if column == "p_channel_details":
+        return _pick("promotion", "chdetails", idx,
+                     ["promo details A", "promo details B",
+                      "promo details C"])
+    if column == "p_purpose":
+        return _pick("promotion", "purpose", idx, _PROMO_PURPOSES)
+    if column == "p_discount_active":
+        return _pick("promotion", "active", idx, _YN)
+    raise KeyError(f"promotion.{column}")
+
+
+def _gen_call_center(column, idx, sf):
+    if column == "cc_call_center_sk":
+        return _seq(idx, sf)
+    if column == "cc_call_center_id":
+        return _bid(idx // 2 * 2)
+    if column in ("cc_rec_start_date",):
+        return np.full(len(idx), int((np.datetime64("1998-01-01")
+                                      - np.datetime64("1970-01-01"))
+                                     .astype(int)), dtype=np.int32)
+    if column in ("cc_rec_end_date",):
+        return np.full(len(idx), int((np.datetime64("2001-12-31")
+                                      - np.datetime64("1970-01-01"))
+                                     .astype(int)), dtype=np.int32)
+    if column == "cc_closed_date_sk":
+        return np.zeros(len(idx), dtype=np.int64)
+    if column == "cc_open_date_sk":
+        return _date_fk("call_center", "open")(idx, sf)
+    if column == "cc_name":
+        return _pick("call_center", "name", idx,
+                     ["NY Metro", "Mid Atlantic", "Pacific NW",
+                      "North Midwest", "California", "Hawaii/Alaska"])
+    if column == "cc_class":
+        return _pick("call_center", "class", idx, _CC_CLASSES)
+    if column == "cc_employees":
+        return _uniform("call_center", "emps", idx, 100,
+                        7000).astype(np.int32)
+    if column == "cc_sq_ft":
+        return _uniform("call_center", "sqft", idx, 1000000,
+                        40000000).astype(np.int32)
+    if column == "cc_hours":
+        return _pick("call_center", "hours", idx,
+                     ["8AM-8AM", "8AM-4PM", "8AM-12AM"])
+    if column in ("cc_manager", "cc_market_manager"):
+        f = _pick("call_center", column + "f", idx, _FIRST_NAMES)
+        l_ = _pick("call_center", column + "l", idx, _LAST_NAMES)
+        return np.array([f"{a} {b}" for a, b in zip(f, l_)], dtype=object)
+    if column == "cc_mkt_id":
+        return _uniform("call_center", "mkt", idx, 1, 6).astype(np.int32)
+    if column == "cc_mkt_class":
+        return _pick("call_center", "mktclass", idx,
+                     ["High class", "Medium class", "Low class"])
+    if column == "cc_mkt_desc":
+        return _pick("call_center", "mktdesc", idx,
+                     ["Great market", "Growing market", "Stable market"])
+    if column == "cc_division":
+        return _uniform("call_center", "div", idx, 1, 6).astype(np.int32)
+    if column == "cc_division_name":
+        return _pick("call_center", "divname", idx,
+                     ["ought", "able", "pri", "ese", "anti", "cally"])
+    if column == "cc_company":
+        return _uniform("call_center", "co", idx, 1, 6).astype(np.int32)
+    if column == "cc_company_name":
+        return _pick("call_center", "coname", idx,
+                     ["ought", "able", "pri", "ese", "anti", "cally"])
+    if column == "cc_street_number":
+        return _uniform("call_center", "stno", idx, 1,
+                        999).astype(str).astype(object)
+    if column == "cc_street_name":
+        return _pick("call_center", "stname", idx, _STREET_NAMES)
+    if column == "cc_street_type":
+        return _pick("call_center", "sttype", idx, _STREET_TYPES)
+    if column == "cc_suite_number":
+        s = _uniform("call_center", "suite", idx, 0, 99)
+        return np.array([f"Suite {v}" for v in s], dtype=object)
+    if column == "cc_city":
+        return _pick("call_center", "city", idx, _CITIES)
+    if column == "cc_county":
+        return _pick("call_center", "county", idx, _COUNTIES)
+    if column == "cc_state":
+        return _pick("call_center", "state", idx, _STATES)
+    if column == "cc_zip":
+        return _zip_col("call_center", "zip")(idx, sf)
+    if column == "cc_country":
+        return np.full(len(idx), "United States", dtype=object)
+    if column == "cc_gmt_offset":
+        return _uniform("call_center", "gmt", idx, -8, -5) * 100
+    if column == "cc_tax_percentage":
+        return _uniform("call_center", "tax", idx, 0, 11)
+    raise KeyError(f"call_center.{column}")
+
+
+def _gen_catalog_page(column, idx, sf):
+    if column == "cp_catalog_page_sk":
+        return _seq(idx, sf)
+    if column == "cp_catalog_page_id":
+        return _bid(idx)
+    if column == "cp_start_date_sk":
+        return _date_fk("catalog_page", "start")(idx, sf)
+    if column == "cp_end_date_sk":
+        return _date_fk("catalog_page", "start")(idx, sf) + 30
+    if column == "cp_department":
+        return np.full(len(idx), "DEPARTMENT", dtype=object)
+    if column == "cp_catalog_number":
+        return (idx // 108 + 1).astype(np.int32)
+    if column == "cp_catalog_page_number":
+        return (idx % 108 + 1).astype(np.int32)
+    if column == "cp_description":
+        return _pick("catalog_page", "desc", idx,
+                     ["Fine page", "Seasonal page", "Clearance page",
+                      "Holiday page", "Standard page"])
+    if column == "cp_type":
+        return _pick("catalog_page", "type", idx, _CP_TYPES)
+    raise KeyError(f"catalog_page.{column}")
+
+
+def _gen_web_site(column, idx, sf):
+    if column == "web_site_sk":
+        return _seq(idx, sf)
+    if column == "web_site_id":
+        return _bid(idx // 2 * 2)
+    if column == "web_rec_start_date":
+        return np.full(len(idx), int((np.datetime64("1997-08-16")
+                                      - np.datetime64("1970-01-01"))
+                                     .astype(int)), dtype=np.int32)
+    if column == "web_rec_end_date":
+        return np.full(len(idx), int((np.datetime64("2001-08-15")
+                                      - np.datetime64("1970-01-01"))
+                                     .astype(int)), dtype=np.int32)
+    if column == "web_name":
+        return np.array([f"site_{v}" for v in idx // 6], dtype=object)
+    if column == "web_open_date_sk":
+        return _date_fk("web_site", "open")(idx, sf)
+    if column == "web_close_date_sk":
+        return np.zeros(len(idx), dtype=np.int64)
+    if column == "web_class":
+        return _pick("web_site", "class", idx, _WEB_SITE_CLASSES)
+    if column in ("web_manager", "web_market_manager"):
+        f = _pick("web_site", column + "f", idx, _FIRST_NAMES)
+        l_ = _pick("web_site", column + "l", idx, _LAST_NAMES)
+        return np.array([f"{a} {b}" for a, b in zip(f, l_)], dtype=object)
+    if column == "web_mkt_id":
+        return _uniform("web_site", "mkt", idx, 1, 6).astype(np.int32)
+    if column == "web_mkt_class":
+        return _pick("web_site", "mktclass", idx,
+                     ["High class", "Medium class", "Low class"])
+    if column == "web_mkt_desc":
+        return _pick("web_site", "mktdesc", idx,
+                     ["Great market", "Growing market", "Stable market"])
+    if column == "web_company_id":
+        return _uniform("web_site", "co", idx, 1, 6).astype(np.int32)
+    if column == "web_company_name":
+        return _pick("web_site", "coname", idx,
+                     ["ought", "able", "pri", "ese", "anti", "cally"])
+    if column == "web_street_number":
+        return _uniform("web_site", "stno", idx, 1,
+                        999).astype(str).astype(object)
+    if column == "web_street_name":
+        return _pick("web_site", "stname", idx, _STREET_NAMES)
+    if column == "web_street_type":
+        return _pick("web_site", "sttype", idx, _STREET_TYPES)
+    if column == "web_suite_number":
+        s = _uniform("web_site", "suite", idx, 0, 99)
+        return np.array([f"Suite {v}" for v in s], dtype=object)
+    if column == "web_city":
+        return _pick("web_site", "city", idx, _CITIES)
+    if column == "web_county":
+        return _pick("web_site", "county", idx, _COUNTIES)
+    if column == "web_state":
+        return _pick("web_site", "state", idx, _STATES)
+    if column == "web_zip":
+        return _zip_col("web_site", "zip")(idx, sf)
+    if column == "web_country":
+        return np.full(len(idx), "United States", dtype=object)
+    if column == "web_gmt_offset":
+        return _uniform("web_site", "gmt", idx, -8, -5) * 100
+    if column == "web_tax_percentage":
+        return _uniform("web_site", "tax", idx, 0, 11)
+    raise KeyError(f"web_site.{column}")
+
+
+def _gen_web_page(column, idx, sf):
+    if column == "wp_web_page_sk":
+        return _seq(idx, sf)
+    if column == "wp_web_page_id":
+        return _bid(idx // 2 * 2)
+    if column == "wp_rec_start_date":
+        return np.full(len(idx), int((np.datetime64("1997-09-03")
+                                      - np.datetime64("1970-01-01"))
+                                     .astype(int)), dtype=np.int32)
+    if column == "wp_rec_end_date":
+        return np.full(len(idx), int((np.datetime64("2001-09-02")
+                                      - np.datetime64("1970-01-01"))
+                                     .astype(int)), dtype=np.int32)
+    if column == "wp_creation_date_sk":
+        return _date_fk("web_page", "created")(idx, sf)
+    if column == "wp_access_date_sk":
+        return _date_fk("web_page", "access")(idx, sf)
+    if column == "wp_autogen_flag":
+        return _pick("web_page", "autogen", idx, _YN)
+    if column == "wp_customer_sk":
+        return _fk("web_page", "cust", "customer")(idx, sf)
+    if column == "wp_url":
+        return np.full(len(idx), "http://www.foo.com", dtype=object)
+    if column == "wp_type":
+        return _pick("web_page", "type", idx,
+                     ["bi-weekly", "daily", "monthly", "quarterly",
+                      "weekly", "dynamic", "feedback", "general",
+                      "order", "welcome", "protected", "ad"])
+    if column == "wp_char_count":
+        return _uniform("web_page", "chars", idx, 100, 8000).astype(np.int32)
+    if column == "wp_link_count":
+        return _uniform("web_page", "links", idx, 2, 25).astype(np.int32)
+    if column == "wp_image_count":
+        return _uniform("web_page", "images", idx, 1, 7).astype(np.int32)
+    if column == "wp_max_ad_count":
+        return _uniform("web_page", "ads", idx, 0, 4).astype(np.int32)
+    raise KeyError(f"web_page.{column}")
+
+
+_GEN_CATALOG_SALES = _gen_channel_sales("catalog_sales", "cs_", 10)
+_GEN_WEB_SALES = _gen_channel_sales("web_sales", "ws_", 12)
+
 _GENERATORS = {
-    "store_sales": _gen_store_sales, "date_dim": _gen_date_dim,
-    "item": _gen_item, "customer": _gen_customer, "store": _gen_store,
-    "catalog_sales": _make_channel_gen("catalog_sales", "cs_", 10),
-    "web_sales": _make_channel_gen("web_sales", "ws_", 12),
+    "store_sales": _gen_store_sales,
+    "store_returns": _gen_returns("store_returns", "sr_", "store_sales",
+                                  _gen_store_sales, "ss_", "return_amt"),
+    "catalog_sales": _GEN_CATALOG_SALES,
+    "catalog_returns": _gen_returns("catalog_returns", "cr_",
+                                    "catalog_sales", _GEN_CATALOG_SALES,
+                                    "cs_", "return_amount"),
+    "web_sales": _GEN_WEB_SALES,
+    "web_returns": _gen_returns("web_returns", "wr_", "web_sales",
+                                _GEN_WEB_SALES, "ws_", "return_amt"),
+    "inventory": _gen_inventory,
+    "date_dim": _gen_date_dim,
     "time_dim": _gen_time_dim,
+    "item": _gen_item,
+    "customer": _gen_customer,
+    "customer_address": _gen_customer_address,
+    "customer_demographics": _gen_customer_demographics,
     "household_demographics": _gen_household_demographics,
+    "income_band": _gen_income_band,
+    "store": _gen_store,
+    "warehouse": _gen_warehouse,
+    "ship_mode": _gen_ship_mode,
+    "reason": _gen_reason,
+    "promotion": _gen_promotion,
+    "call_center": _gen_call_center,
+    "catalog_page": _gen_catalog_page,
+    "web_site": _gen_web_site,
+    "web_page": _gen_web_page,
 }
+
+assert set(_GENERATORS) == set(TPCDS_SCHEMA)
 
 
 def generate_columns(table: str, sf: float, columns: Sequence[str],

@@ -1,0 +1,608 @@
+"""TPC-DS query corpus (engine dialect).
+
+Shapes follow the published TPC-DS benchmark specification (the same
+query text the reference ships in presto-benchto-benchmarks/.../tpcds/
+q*.sql -- published spec text, parameter-substituted). Adaptations to
+this engine's dialect, applied uniformly:
+
+* ``DECIMAL '100.00'``     -> ``100.00``   (plain decimal literals)
+* ``CAST('d' AS DATE)``    -> ``date 'd'`` (+- INTERVAL folded into the
+                                            literal)
+* decimal/decimal division -> double division or integer-side
+  multiplication (``10 * x <= y`` for ``x <= 0.1 * y``) so the oracle
+  engine computes the identical value
+* mixed LEFT JOIN + comma FROM lists (q40/q93) -> explicit JOIN chains
+* spec parameter values that our generator's value domains don't
+  contain (city/state names) -> values drawn from the generator's
+  domains; selectivity structure is preserved
+
+Tests run every query against an independent SQL engine (sqlite) over
+the same generated data (tests/tpcds_harness.py) -- the H2QueryRunner
+oracle pattern (presto-tests/.../H2QueryRunner.java).
+"""
+
+TPCDS_QUERIES = {
+    # q3: star join, brand revenue by year
+    "q3": """
+SELECT dt.d_year, item.i_brand_id brand_id, item.i_brand brand,
+       sum(ss_ext_sales_price) sum_agg
+FROM date_dim dt, store_sales, item
+WHERE dt.d_date_sk = store_sales.ss_sold_date_sk
+  AND store_sales.ss_item_sk = item.i_item_sk
+  AND item.i_manufact_id = 128 AND dt.d_moy = 11
+GROUP BY dt.d_year, item.i_brand, item.i_brand_id
+ORDER BY dt.d_year ASC, sum_agg DESC, brand_id ASC
+LIMIT 100
+""",
+    # q7: demographic/promotion averages per item
+    "q7": """
+SELECT i_item_id, avg(ss_quantity) agg1, avg(ss_list_price) agg2,
+       avg(ss_coupon_amt) agg3, avg(ss_sales_price) agg4
+FROM store_sales, customer_demographics, date_dim, item, promotion
+WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+  AND ss_cdemo_sk = cd_demo_sk AND ss_promo_sk = p_promo_sk
+  AND cd_gender = 'M' AND cd_marital_status = 'S'
+  AND cd_education_status = 'College'
+  AND (p_channel_email = 'N' OR p_channel_event = 'N')
+  AND d_year = 2000
+GROUP BY i_item_id
+ORDER BY i_item_id ASC
+LIMIT 100
+""",
+    # q13: OR-blocks of demographic/address bands (join keys inside ORs)
+    "q13": """
+SELECT avg(ss_quantity), avg(ss_ext_sales_price),
+       avg(ss_ext_wholesale_cost), sum(ss_ext_wholesale_cost)
+FROM store_sales, store, customer_demographics,
+     household_demographics, customer_address, date_dim
+WHERE s_store_sk = ss_store_sk AND ss_sold_date_sk = d_date_sk
+  AND d_year = 2001
+  AND ((ss_hdemo_sk = hd_demo_sk AND cd_demo_sk = ss_cdemo_sk
+        AND cd_marital_status = 'M'
+        AND cd_education_status = 'Advanced Degree'
+        AND ss_sales_price BETWEEN 100.00 AND 150.00
+        AND hd_dep_count = 3)
+    OR (ss_hdemo_sk = hd_demo_sk AND cd_demo_sk = ss_cdemo_sk
+        AND cd_marital_status = 'S' AND cd_education_status = 'College'
+        AND ss_sales_price BETWEEN 50.00 AND 100.00
+        AND hd_dep_count = 1)
+    OR (ss_hdemo_sk = hd_demo_sk AND cd_demo_sk = ss_cdemo_sk
+        AND cd_marital_status = 'W'
+        AND cd_education_status = '2 yr Degree'
+        AND ss_sales_price BETWEEN 150.00 AND 200.00
+        AND hd_dep_count = 1))
+  AND ((ss_addr_sk = ca_address_sk AND ca_country = 'United States'
+        AND ca_state IN ('TX', 'OH', 'IL')
+        AND ss_net_profit BETWEEN 100.00 AND 200.00)
+    OR (ss_addr_sk = ca_address_sk AND ca_country = 'United States'
+        AND ca_state IN ('CA', 'WA', 'GA')
+        AND ss_net_profit BETWEEN 150.00 AND 300.00)
+    OR (ss_addr_sk = ca_address_sk AND ca_country = 'United States'
+        AND ca_state IN ('NY', 'TN', 'IL')
+        AND ss_net_profit BETWEEN 50.00 AND 250.00))
+""",
+    # q15: catalog sales by zip with OR of zip/state/price predicates
+    "q15": """
+SELECT ca_zip, sum(cs_sales_price)
+FROM catalog_sales, customer, customer_address, date_dim
+WHERE cs_bill_customer_sk = c_customer_sk
+  AND c_current_addr_sk = ca_address_sk
+  AND (substr(ca_zip, 1, 5) IN ('85669', '86197', '88274', '83405',
+                                '86475', '85392', '85460', '80348', '81792')
+       OR ca_state IN ('CA', 'WA', 'GA')
+       OR cs_sales_price > 500.00)
+  AND cs_sold_date_sk = d_date_sk AND d_qoy = 2 AND d_year = 2001
+GROUP BY ca_zip
+ORDER BY ca_zip ASC
+LIMIT 100
+""",
+    # q19: brand revenue where buyer and store zips differ
+    "q19": """
+SELECT i_brand_id brand_id, i_brand brand, i_manufact_id, i_manufact,
+       sum(ss_ext_sales_price) ext_price
+FROM date_dim, store_sales, item, customer, customer_address, store
+WHERE d_date_sk = ss_sold_date_sk AND ss_item_sk = i_item_sk
+  AND i_manager_id = 8 AND d_moy = 11 AND d_year = 1998
+  AND ss_customer_sk = c_customer_sk
+  AND c_current_addr_sk = ca_address_sk
+  AND substr(ca_zip, 1, 5) <> substr(s_zip, 1, 5)
+  AND ss_store_sk = s_store_sk
+GROUP BY i_brand, i_brand_id, i_manufact_id, i_manufact
+ORDER BY ext_price DESC, i_brand ASC, i_brand_id ASC,
+         i_manufact_id ASC, i_manufact ASC
+LIMIT 100
+""",
+    # q21: inventory before/after a cutoff date, ratio-banded
+    "q21": """
+SELECT *
+FROM (SELECT w_warehouse_name, i_item_id,
+             sum(CASE WHEN d_date < date '2000-03-11'
+                      THEN inv_quantity_on_hand ELSE 0 END) inv_before,
+             sum(CASE WHEN d_date >= date '2000-03-11'
+                      THEN inv_quantity_on_hand ELSE 0 END) inv_after
+      FROM inventory, warehouse, item, date_dim
+      WHERE i_current_price BETWEEN 0.99 AND 9.99
+        AND i_item_sk = inv_item_sk
+        AND inv_warehouse_sk = w_warehouse_sk
+        AND inv_date_sk = d_date_sk
+        AND d_date BETWEEN date '1999-09-11' AND date '2000-09-11'
+      GROUP BY w_warehouse_name, i_item_id) x
+WHERE CASE WHEN inv_before > 0
+           THEN CAST(inv_after AS double) / inv_before
+           ELSE null END BETWEEN 0.666667 AND 1.500
+ORDER BY w_warehouse_name ASC, i_item_id ASC
+LIMIT 100
+""",
+    # q25: store sales -> returns -> catalog re-purchase profit chain
+    "q25": """
+SELECT i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_net_profit) store_sales_profit,
+       sum(sr_net_loss) store_returns_loss,
+       sum(cs_net_profit) catalog_sales_profit
+FROM store_sales, store_returns, catalog_sales, date_dim d1,
+     date_dim d2, date_dim d3, store, item
+WHERE d1.d_moy = 4 AND d1.d_year = 2001
+  AND d1.d_date_sk = ss_sold_date_sk
+  AND i_item_sk = ss_item_sk AND s_store_sk = ss_store_sk
+  AND ss_customer_sk = sr_customer_sk AND ss_item_sk = sr_item_sk
+  AND ss_ticket_number = sr_ticket_number
+  AND sr_returned_date_sk = d2.d_date_sk
+  AND d2.d_moy BETWEEN 4 AND 10 AND d2.d_year = 2001
+  AND sr_customer_sk = cs_bill_customer_sk AND sr_item_sk = cs_item_sk
+  AND cs_sold_date_sk = d3.d_date_sk
+  AND d3.d_moy BETWEEN 4 AND 10 AND d3.d_year = 2001
+GROUP BY i_item_id, i_item_desc, s_store_id, s_store_name
+ORDER BY i_item_id ASC, i_item_desc ASC, s_store_id ASC, s_store_name ASC
+LIMIT 100
+""",
+    # q26: catalog demographic/promotion averages per item
+    "q26": """
+SELECT i_item_id, avg(cs_quantity) agg1, avg(cs_list_price) agg2,
+       avg(cs_coupon_amt) agg3, avg(cs_sales_price) agg4
+FROM catalog_sales, customer_demographics, date_dim, item, promotion
+WHERE cs_sold_date_sk = d_date_sk AND cs_item_sk = i_item_sk
+  AND cs_bill_cdemo_sk = cd_demo_sk AND cs_promo_sk = p_promo_sk
+  AND cd_gender = 'M' AND cd_marital_status = 'S'
+  AND cd_education_status = 'College'
+  AND (p_channel_email = 'N' OR p_channel_event = 'N')
+  AND d_year = 2000
+GROUP BY i_item_id
+ORDER BY i_item_id ASC
+LIMIT 100
+""",
+    # q29: quantity flow through sale -> return -> catalog re-purchase
+    "q29": """
+SELECT i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_quantity) store_sales_quantity,
+       sum(sr_return_quantity) store_returns_quantity,
+       sum(cs_quantity) catalog_sales_quantity
+FROM store_sales, store_returns, catalog_sales, date_dim d1,
+     date_dim d2, date_dim d3, store, item
+WHERE d1.d_moy = 9 AND d1.d_year = 1999
+  AND d1.d_date_sk = ss_sold_date_sk
+  AND i_item_sk = ss_item_sk AND s_store_sk = ss_store_sk
+  AND ss_customer_sk = sr_customer_sk AND ss_item_sk = sr_item_sk
+  AND ss_ticket_number = sr_ticket_number
+  AND sr_returned_date_sk = d2.d_date_sk
+  AND d2.d_moy BETWEEN 9 AND 12 AND d2.d_year = 1999
+  AND sr_customer_sk = cs_bill_customer_sk AND sr_item_sk = cs_item_sk
+  AND cs_sold_date_sk = d3.d_date_sk
+  AND d3.d_year IN (1999, 2000, 2001)
+GROUP BY i_item_id, i_item_desc, s_store_id, s_store_name
+ORDER BY i_item_id ASC, i_item_desc ASC, s_store_id ASC, s_store_name ASC
+LIMIT 100
+""",
+    # q37: items with mid-range inventory also sold by catalog
+    "q37": """
+SELECT i_item_id, i_item_desc, i_current_price
+FROM item, inventory, date_dim, catalog_sales
+WHERE i_current_price BETWEEN 68.00 AND 98.00
+  AND inv_item_sk = i_item_sk AND d_date_sk = inv_date_sk
+  AND d_date BETWEEN date '2000-02-01' AND date '2000-07-30'
+  AND i_manufact_id BETWEEN 600 AND 700
+  AND inv_quantity_on_hand BETWEEN 100 AND 500
+  AND cs_item_sk = i_item_sk
+GROUP BY i_item_id, i_item_desc, i_current_price
+ORDER BY i_item_id ASC
+LIMIT 100
+""",
+    # q40: catalog sales net of returns around a cutoff, by warehouse state
+    "q40": """
+SELECT w_state, i_item_id,
+       sum(CASE WHEN d_date < date '2000-03-11'
+                THEN cs_sales_price - coalesce(cr_refunded_cash, 0)
+                ELSE 0 END) sales_before,
+       sum(CASE WHEN d_date >= date '2000-03-11'
+                THEN cs_sales_price - coalesce(cr_refunded_cash, 0)
+                ELSE 0 END) sales_after
+FROM catalog_sales
+LEFT JOIN catalog_returns ON cs_order_number = cr_order_number
+                         AND cs_item_sk = cr_item_sk
+JOIN warehouse ON cs_warehouse_sk = w_warehouse_sk
+JOIN item ON i_item_sk = cs_item_sk
+JOIN date_dim ON cs_sold_date_sk = d_date_sk
+WHERE i_current_price BETWEEN 0.99 AND 1.49
+  AND d_date BETWEEN date '2000-02-10' AND date '2000-04-10'
+GROUP BY w_state, i_item_id
+ORDER BY w_state ASC, i_item_id ASC
+LIMIT 100
+""",
+    # q42: category revenue for a month
+    "q42": """
+SELECT dt.d_year, item.i_category_id, item.i_category,
+       sum(ss_ext_sales_price) s
+FROM date_dim dt, store_sales, item
+WHERE dt.d_date_sk = store_sales.ss_sold_date_sk
+  AND store_sales.ss_item_sk = item.i_item_sk
+  AND item.i_manager_id = 1 AND dt.d_moy = 11 AND dt.d_year = 2000
+GROUP BY dt.d_year, item.i_category_id, item.i_category
+ORDER BY s DESC, dt.d_year ASC, item.i_category_id ASC,
+         item.i_category ASC
+LIMIT 100
+""",
+    # q43: store revenue pivoted by day of week
+    "q43": """
+SELECT s_store_name, s_store_id,
+       sum(CASE WHEN d_day_name = 'Sunday' THEN ss_sales_price
+                ELSE null END) sun_sales,
+       sum(CASE WHEN d_day_name = 'Monday' THEN ss_sales_price
+                ELSE null END) mon_sales,
+       sum(CASE WHEN d_day_name = 'Tuesday' THEN ss_sales_price
+                ELSE null END) tue_sales,
+       sum(CASE WHEN d_day_name = 'Wednesday' THEN ss_sales_price
+                ELSE null END) wed_sales,
+       sum(CASE WHEN d_day_name = 'Thursday' THEN ss_sales_price
+                ELSE null END) thu_sales,
+       sum(CASE WHEN d_day_name = 'Friday' THEN ss_sales_price
+                ELSE null END) fri_sales,
+       sum(CASE WHEN d_day_name = 'Saturday' THEN ss_sales_price
+                ELSE null END) sat_sales
+FROM date_dim, store_sales, store
+WHERE d_date_sk = ss_sold_date_sk AND s_store_sk = ss_store_sk
+  AND s_gmt_offset = -5.00 AND d_year = 2000
+GROUP BY s_store_name, s_store_id
+ORDER BY s_store_name ASC, s_store_id ASC
+LIMIT 100
+""",
+    # q46: out-of-town weekend shoppers per trip
+    "q46": """
+SELECT c_last_name, c_first_name, current_addr.ca_city, bought_city,
+       ss_ticket_number, amt, profit
+FROM (SELECT ss_ticket_number, ss_customer_sk, ca_city bought_city,
+             sum(ss_coupon_amt) amt, sum(ss_net_profit) profit
+      FROM store_sales, date_dim, store, household_demographics,
+           customer_address
+      WHERE store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        AND store_sales.ss_store_sk = store.s_store_sk
+        AND store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+        AND store_sales.ss_addr_sk = customer_address.ca_address_sk
+        AND (household_demographics.hd_dep_count = 4
+             OR household_demographics.hd_vehicle_count = 3)
+        AND date_dim.d_dow IN (6, 0)
+        AND date_dim.d_year IN (1999, 2000, 2001)
+        AND store.s_city IN ('Fairview', 'Midway')
+      GROUP BY ss_ticket_number, ss_customer_sk, ss_addr_sk, ca_city) dn,
+     customer, customer_address current_addr
+WHERE ss_customer_sk = c_customer_sk
+  AND customer.c_current_addr_sk = current_addr.ca_address_sk
+  AND current_addr.ca_city <> bought_city
+ORDER BY c_last_name ASC, c_first_name ASC, current_addr.ca_city ASC,
+         bought_city ASC, ss_ticket_number ASC
+LIMIT 100
+""",
+    # q48: OR-banded quantity sum (q13 shape without the group keys)
+    "q48": """
+SELECT sum(ss_quantity)
+FROM store_sales, store, customer_demographics, customer_address,
+     date_dim
+WHERE s_store_sk = ss_store_sk AND ss_sold_date_sk = d_date_sk
+  AND d_year = 2000
+  AND ((cd_demo_sk = ss_cdemo_sk AND cd_marital_status = 'M'
+        AND cd_education_status = '4 yr Degree'
+        AND ss_sales_price BETWEEN 100.00 AND 150.00)
+    OR (cd_demo_sk = ss_cdemo_sk AND cd_marital_status = 'D'
+        AND cd_education_status = '2 yr Degree'
+        AND ss_sales_price BETWEEN 50.00 AND 100.00)
+    OR (cd_demo_sk = ss_cdemo_sk AND cd_marital_status = 'S'
+        AND cd_education_status = 'College'
+        AND ss_sales_price BETWEEN 150.00 AND 200.00))
+  AND ((ss_addr_sk = ca_address_sk AND ca_country = 'United States'
+        AND ca_state IN ('TX', 'OH', 'IL')
+        AND ss_net_profit BETWEEN 0.00 AND 2000.00)
+    OR (ss_addr_sk = ca_address_sk AND ca_country = 'United States'
+        AND ca_state IN ('CA', 'WA', 'GA')
+        AND ss_net_profit BETWEEN 150.00 AND 3000.00)
+    OR (ss_addr_sk = ca_address_sk AND ca_country = 'United States'
+        AND ca_state IN ('NY', 'TN', 'IL')
+        AND ss_net_profit BETWEEN 50.00 AND 25000.00))
+""",
+    # q50: return-lag buckets per store
+    "q50": """
+SELECT s_store_name, s_company_id, s_street_number, s_street_name,
+       s_street_type, s_suite_number, s_city, s_county, s_state, s_zip,
+       sum(CASE WHEN sr_returned_date_sk - ss_sold_date_sk <= 30
+                THEN 1 ELSE 0 END) days_30,
+       sum(CASE WHEN sr_returned_date_sk - ss_sold_date_sk > 30
+                 AND sr_returned_date_sk - ss_sold_date_sk <= 60
+                THEN 1 ELSE 0 END) days_31_60,
+       sum(CASE WHEN sr_returned_date_sk - ss_sold_date_sk > 60
+                 AND sr_returned_date_sk - ss_sold_date_sk <= 90
+                THEN 1 ELSE 0 END) days_61_90,
+       sum(CASE WHEN sr_returned_date_sk - ss_sold_date_sk > 90
+                 AND sr_returned_date_sk - ss_sold_date_sk <= 120
+                THEN 1 ELSE 0 END) days_91_120,
+       sum(CASE WHEN sr_returned_date_sk - ss_sold_date_sk > 120
+                THEN 1 ELSE 0 END) days_gt_120
+FROM store_sales, store_returns, store, date_dim d1, date_dim d2
+WHERE d2.d_year = 2001 AND d2.d_moy = 8
+  AND ss_ticket_number = sr_ticket_number AND ss_item_sk = sr_item_sk
+  AND ss_sold_date_sk = d1.d_date_sk
+  AND sr_returned_date_sk = d2.d_date_sk
+  AND ss_customer_sk = sr_customer_sk AND ss_store_sk = s_store_sk
+GROUP BY s_store_name, s_company_id, s_street_number, s_street_name,
+         s_street_type, s_suite_number, s_city, s_county, s_state, s_zip
+ORDER BY s_store_name ASC, s_company_id ASC, s_street_number ASC,
+         s_street_name ASC, s_street_type ASC, s_suite_number ASC,
+         s_city ASC, s_county ASC, s_state ASC, s_zip ASC
+LIMIT 100
+""",
+    # q52: brand revenue for a month
+    "q52": """
+SELECT dt.d_year, item.i_brand_id brand_id, item.i_brand brand,
+       sum(ss_ext_sales_price) ext_price
+FROM date_dim dt, store_sales, item
+WHERE dt.d_date_sk = store_sales.ss_sold_date_sk
+  AND store_sales.ss_item_sk = item.i_item_sk
+  AND item.i_manager_id = 1 AND dt.d_moy = 11 AND dt.d_year = 2000
+GROUP BY dt.d_year, item.i_brand, item.i_brand_id
+ORDER BY dt.d_year ASC, ext_price DESC, brand_id ASC
+LIMIT 100
+""",
+    # q55: brand revenue for a manager's month
+    "q55": """
+SELECT i_brand_id brand_id, i_brand brand,
+       sum(ss_ext_sales_price) ext_price
+FROM date_dim, store_sales, item
+WHERE d_date_sk = ss_sold_date_sk AND ss_item_sk = i_item_sk
+  AND i_manager_id = 28 AND d_moy = 11 AND d_year = 1999
+GROUP BY i_brand, i_brand_id
+ORDER BY ext_price DESC, brand_id ASC
+LIMIT 100
+""",
+    # q62: web shipping-lag buckets by warehouse/mode/site
+    "q62": """
+SELECT substr(w_warehouse_name, 1, 20) wname, sm_type, web_name,
+       sum(CASE WHEN ws_ship_date_sk - ws_sold_date_sk <= 30
+                THEN 1 ELSE 0 END) days_30,
+       sum(CASE WHEN ws_ship_date_sk - ws_sold_date_sk > 30
+                 AND ws_ship_date_sk - ws_sold_date_sk <= 60
+                THEN 1 ELSE 0 END) days_31_60,
+       sum(CASE WHEN ws_ship_date_sk - ws_sold_date_sk > 60
+                 AND ws_ship_date_sk - ws_sold_date_sk <= 90
+                THEN 1 ELSE 0 END) days_61_90,
+       sum(CASE WHEN ws_ship_date_sk - ws_sold_date_sk > 90
+                 AND ws_ship_date_sk - ws_sold_date_sk <= 120
+                THEN 1 ELSE 0 END) days_91_120,
+       sum(CASE WHEN ws_ship_date_sk - ws_sold_date_sk > 120
+                THEN 1 ELSE 0 END) days_gt_120
+FROM web_sales, warehouse, ship_mode, web_site, date_dim
+WHERE d_month_seq BETWEEN 1200 AND 1211
+  AND ws_ship_date_sk = d_date_sk
+  AND ws_warehouse_sk = w_warehouse_sk
+  AND ws_ship_mode_sk = sm_ship_mode_sk
+  AND ws_web_site_sk = web_site_sk
+GROUP BY substr(w_warehouse_name, 1, 20), sm_type, web_name
+ORDER BY wname ASC, sm_type ASC, web_name ASC
+LIMIT 100
+""",
+    # q65: items selling below a tenth of their store's average revenue
+    # (spec's `revenue <= 0.1 * ave` written integer-side: 10*rev <= ave)
+    "q65": """
+SELECT s_store_name, i_item_desc, sc.revenue, i_current_price,
+       i_wholesale_cost, i_brand
+FROM store, item,
+     (SELECT ss_store_sk, avg(revenue) ave
+      FROM (SELECT ss_store_sk, ss_item_sk, sum(ss_sales_price) revenue
+            FROM store_sales, date_dim
+            WHERE ss_sold_date_sk = d_date_sk
+              AND d_month_seq BETWEEN 1176 AND 1187
+            GROUP BY ss_store_sk, ss_item_sk) sa
+      GROUP BY ss_store_sk) sb,
+     (SELECT ss_store_sk, ss_item_sk, sum(ss_sales_price) revenue
+      FROM store_sales, date_dim
+      WHERE ss_sold_date_sk = d_date_sk
+        AND d_month_seq BETWEEN 1176 AND 1187
+      GROUP BY ss_store_sk, ss_item_sk) sc
+WHERE sb.ss_store_sk = sc.ss_store_sk
+  AND 10 * sc.revenue <= sb.ave
+  AND s_store_sk = sc.ss_store_sk
+  AND i_item_sk = sc.ss_item_sk
+ORDER BY s_store_name ASC, i_item_desc ASC, sc.revenue ASC,
+         i_current_price ASC, i_wholesale_cost ASC, i_brand ASC
+LIMIT 100
+""",
+    # q68: two-day city trips with differing current address
+    "q68": """
+SELECT c_last_name, c_first_name, current_addr.ca_city, bought_city,
+       ss_ticket_number, extended_price, extended_tax, list_price
+FROM (SELECT ss_ticket_number, ss_customer_sk, ca_city bought_city,
+             sum(ss_ext_sales_price) extended_price,
+             sum(ss_ext_list_price) list_price,
+             sum(ss_ext_tax) extended_tax
+      FROM store_sales, date_dim, store, household_demographics,
+           customer_address
+      WHERE store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        AND store_sales.ss_store_sk = store.s_store_sk
+        AND store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+        AND store_sales.ss_addr_sk = customer_address.ca_address_sk
+        AND date_dim.d_dom BETWEEN 1 AND 2
+        AND (household_demographics.hd_dep_count = 4
+             OR household_demographics.hd_vehicle_count = 3)
+        AND date_dim.d_year IN (1999, 2000, 2001)
+        AND store.s_city IN ('Midway', 'Fairview')
+      GROUP BY ss_ticket_number, ss_customer_sk, ss_addr_sk, ca_city) dn,
+     customer, customer_address current_addr
+WHERE ss_customer_sk = c_customer_sk
+  AND customer.c_current_addr_sk = current_addr.ca_address_sk
+  AND current_addr.ca_city <> bought_city
+ORDER BY c_last_name ASC, ss_ticket_number ASC
+LIMIT 100
+""",
+    # q73: frequent-shopper tickets (1-5 items) for big households
+    "q73": """
+SELECT c_last_name, c_first_name, c_salutation, c_preferred_cust_flag,
+       ss_ticket_number, cnt
+FROM (SELECT ss_ticket_number, ss_customer_sk, count(*) cnt
+      FROM store_sales, date_dim, store, household_demographics
+      WHERE store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        AND store_sales.ss_store_sk = store.s_store_sk
+        AND store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+        AND date_dim.d_dom BETWEEN 1 AND 2
+        AND (household_demographics.hd_buy_potential = '>10000'
+             OR household_demographics.hd_buy_potential = 'Unknown')
+        AND household_demographics.hd_vehicle_count > 0
+        AND CASE WHEN household_demographics.hd_vehicle_count > 0
+                 THEN CAST(household_demographics.hd_dep_count AS double)
+                      / household_demographics.hd_vehicle_count
+                 ELSE null END > 1
+        AND date_dim.d_year IN (1999, 2000, 2001)
+        AND store.s_county IN ('Williamson County', 'Franklin Parish',
+                               'Bronx County', 'Walker County')
+      GROUP BY ss_ticket_number, ss_customer_sk) dj, customer
+WHERE ss_customer_sk = c_customer_sk AND cnt BETWEEN 1 AND 5
+ORDER BY cnt DESC, c_last_name ASC
+""",
+    # q79: Monday shopping trips for large/mobile households
+    "q79": """
+SELECT c_last_name, c_first_name, substr(s_city, 1, 30) city,
+       ss_ticket_number, amt, profit
+FROM (SELECT ss_ticket_number, ss_customer_sk, store.s_city,
+             sum(ss_coupon_amt) amt, sum(ss_net_profit) profit
+      FROM store_sales, date_dim, store, household_demographics
+      WHERE store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        AND store_sales.ss_store_sk = store.s_store_sk
+        AND store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+        AND (household_demographics.hd_dep_count = 6
+             OR household_demographics.hd_vehicle_count > 2)
+        AND date_dim.d_dow = 1
+        AND date_dim.d_year IN (1999, 2000, 2001)
+        AND store.s_number_employees BETWEEN 200 AND 295
+      GROUP BY ss_ticket_number, ss_customer_sk, ss_addr_sk,
+               store.s_city) ms, customer
+WHERE ss_customer_sk = c_customer_sk
+ORDER BY c_last_name ASC, c_first_name ASC, city ASC, profit ASC,
+         ss_ticket_number ASC
+LIMIT 100
+""",
+    # q82: items with mid-range inventory also sold in store
+    "q82": """
+SELECT i_item_id, i_item_desc, i_current_price
+FROM item, inventory, date_dim, store_sales
+WHERE i_current_price BETWEEN 62.00 AND 92.00
+  AND inv_item_sk = i_item_sk AND d_date_sk = inv_date_sk
+  AND d_date BETWEEN date '2000-03-25' AND date '2000-09-24'
+  AND i_manufact_id BETWEEN 120 AND 220
+  AND inv_quantity_on_hand BETWEEN 100 AND 500
+  AND ss_item_sk = i_item_sk
+GROUP BY i_item_id, i_item_desc, i_current_price
+ORDER BY i_item_id ASC
+LIMIT 100
+""",
+    # q84: income-band customers with store returns
+    "q84": """
+SELECT c_customer_id customer_id,
+       concat(c_last_name, ', ', c_first_name) customername
+FROM customer, customer_address, customer_demographics,
+     household_demographics, income_band, store_returns
+WHERE ca_city = 'Midway' AND c_current_addr_sk = ca_address_sk
+  AND ib_lower_bound >= 38128 AND ib_upper_bound <= 88128
+  AND ib_income_band_sk = hd_income_band_sk
+  AND cd_demo_sk = c_current_cdemo_sk
+  AND hd_demo_sk = c_current_hdemo_sk
+  AND sr_cdemo_sk = cd_demo_sk
+ORDER BY c_customer_id ASC
+LIMIT 100
+""",
+    # q91: call-center catalog-return losses for a demographic slice
+    "q91": """
+SELECT cc_call_center_id call_center, cc_name call_center_name,
+       cc_manager manager, sum(cr_net_loss) returns_loss
+FROM call_center, catalog_returns, date_dim, customer,
+     customer_address, customer_demographics, household_demographics
+WHERE cr_call_center_sk = cc_call_center_sk
+  AND cr_returned_date_sk = d_date_sk
+  AND cr_returning_customer_sk = c_customer_sk
+  AND cd_demo_sk = c_current_cdemo_sk
+  AND hd_demo_sk = c_current_hdemo_sk
+  AND ca_address_sk = c_current_addr_sk
+  AND d_year = 1998 AND d_moy = 11
+  AND ((cd_marital_status = 'M'
+        AND cd_education_status IN ('Unknown', 'College', 'Primary',
+                                    'Secondary'))
+    OR (cd_marital_status = 'W'
+        AND cd_education_status IN ('Advanced Degree', '2 yr Degree',
+                                    '4 yr Degree')))
+  AND hd_buy_potential LIKE 'Unknown%'
+  AND ca_gmt_offset = -7.00
+GROUP BY cc_call_center_id, cc_name, cc_manager, cd_marital_status,
+         cd_education_status
+ORDER BY returns_loss DESC
+""",
+    # q93: actual sales net of returns per customer (explicit-join form
+    # of the spec's LEFT JOIN + comma FROM; the WHERE on sr_reason_sk
+    # makes the join effectively inner, as in the reference text)
+    "q93": """
+SELECT ss_customer_sk, sum(act_sales) sumsales
+FROM (SELECT ss_item_sk, ss_ticket_number, ss_customer_sk,
+             CASE WHEN sr_return_quantity IS NOT NULL
+                  THEN (ss_quantity - sr_return_quantity) * ss_sales_price
+                  ELSE ss_quantity * ss_sales_price END act_sales
+      FROM store_sales
+      JOIN store_returns ON sr_item_sk = ss_item_sk
+                        AND sr_ticket_number = ss_ticket_number
+      JOIN reason ON sr_reason_sk = r_reason_sk
+      WHERE r_reason_desc = 'Package was damaged') t
+GROUP BY ss_customer_sk
+ORDER BY sumsales ASC, ss_customer_sk ASC
+LIMIT 100
+""",
+    # q96: count of store sales in an evening hour to big households
+    "q96": """
+SELECT count(*) cnt
+FROM store_sales, household_demographics, time_dim, store
+WHERE ss_sold_time_sk = time_dim.t_time_sk
+  AND ss_hdemo_sk = household_demographics.hd_demo_sk
+  AND ss_store_sk = s_store_sk
+  AND time_dim.t_hour = 20 AND time_dim.t_minute >= 30
+  AND household_demographics.hd_dep_count = 7
+  AND store.s_store_name = 'ese'
+ORDER BY count(*) ASC
+LIMIT 100
+""",
+    # q99: catalog shipping-lag buckets by warehouse/mode/call center
+    "q99": """
+SELECT substr(w_warehouse_name, 1, 20) wname, sm_type, cc_name,
+       sum(CASE WHEN cs_ship_date_sk - cs_sold_date_sk <= 30
+                THEN 1 ELSE 0 END) days_30,
+       sum(CASE WHEN cs_ship_date_sk - cs_sold_date_sk > 30
+                 AND cs_ship_date_sk - cs_sold_date_sk <= 60
+                THEN 1 ELSE 0 END) days_31_60,
+       sum(CASE WHEN cs_ship_date_sk - cs_sold_date_sk > 60
+                 AND cs_ship_date_sk - cs_sold_date_sk <= 90
+                THEN 1 ELSE 0 END) days_61_90,
+       sum(CASE WHEN cs_ship_date_sk - cs_sold_date_sk > 90
+                 AND cs_ship_date_sk - cs_sold_date_sk <= 120
+                THEN 1 ELSE 0 END) days_91_120,
+       sum(CASE WHEN cs_ship_date_sk - cs_sold_date_sk > 120
+                THEN 1 ELSE 0 END) days_gt_120
+FROM catalog_sales, warehouse, ship_mode, call_center, date_dim
+WHERE d_month_seq BETWEEN 1200 AND 1211
+  AND cs_ship_date_sk = d_date_sk
+  AND cs_warehouse_sk = w_warehouse_sk
+  AND cs_ship_mode_sk = sm_ship_mode_sk
+  AND cs_call_center_sk = cc_call_center_sk
+GROUP BY substr(w_warehouse_name, 1, 20), sm_type, cc_name
+ORDER BY wname ASC, sm_type ASC, cc_name ASC
+LIMIT 100
+""",
+}

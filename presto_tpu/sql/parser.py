@@ -263,16 +263,21 @@ class _Parser:
         if not self.accept_kw(word):
             raise ValueError(f"expected {word.upper()}, got {self.peek()}")
 
-    def accept_ctx_kw(self, word: str, before_op: Optional[str] = None) -> bool:
+    def accept_ctx_kw(self, word: str, before_op: Optional[str] = None,
+                      before_kw: Optional[str] = None) -> bool:
         """Contextual (non-reserved) keyword: matches an identifier token
         case-insensitively, optionally only when the NEXT token is the
-        given operator -- Presto keeps words like ROLLUP usable as plain
-        identifiers (SqlBase.g4 nonReserved rule)."""
+        given operator/keyword -- Presto keeps words like ROLLUP and
+        CROSS usable as plain identifiers (SqlBase.g4 nonReserved rule)."""
         k, v = self.peek()
         if k == "ident" and v.lower() == word:
             if before_op is not None:
                 k2, v2 = self.toks[self.i + 1]
                 if not (k2 == "op" and v2 == before_op):
+                    return False
+            if before_kw is not None:
+                k2, v2 = self.toks[self.i + 1]
+                if not (k2 == "kw" and v2 == before_kw):
                     return False
             self.next()
             return True
@@ -531,6 +536,16 @@ class _Parser:
         table = self._table_ref()
         joins = []
         while True:
+            # comma-separated FROM items / CROSS JOIN: a join with no ON
+            # condition; equi-keys come from WHERE conjuncts (the
+            # planner's join-graph extraction, TPC-DS benchmark style)
+            if self.accept_op(","):
+                joins.append(Join("cross", self._table_ref(), None))
+                continue
+            if self.accept_ctx_kw("cross", before_kw="join"):
+                self.expect_kw("join")
+                joins.append(Join("cross", self._table_ref(), None))
+                continue
             kind = None
             if self.accept_kw("inner"):
                 kind = "inner"
@@ -586,6 +601,17 @@ class _Parser:
             alias = self.next()[1]
         return SelectItem(e, alias)
 
+    def _implicit_alias(self) -> Optional[str]:
+        """An identifier alias -- but not the contextual keyword CROSS
+        when it introduces the next CROSS JOIN."""
+        if self.peek()[0] != "ident":
+            return None
+        if self.peek()[1].lower() == "cross":
+            k2, v2 = self.toks[self.i + 1]
+            if k2 == "kw" and v2 == "join":
+                return None
+        return self.next()[1]
+
     def _table_ref(self) -> TableRef:
         if self.accept_op("("):
             sub = self.query()
@@ -593,8 +619,8 @@ class _Parser:
             alias = None
             if self.accept_kw("as"):
                 alias = self.expect_ident()
-            elif self.peek()[0] == "ident":
-                alias = self.next()[1]
+            else:
+                alias = self._implicit_alias()
             if not alias:
                 raise ValueError("derived table requires an alias")
             return TableRef(alias.lower(), alias, subquery=sub)
@@ -602,8 +628,8 @@ class _Parser:
         alias = None
         if self.accept_kw("as"):
             alias = self.expect_ident()
-        elif self.peek()[0] == "ident":
-            alias = self.next()[1]
+        else:
+            alias = self._implicit_alias()
         return TableRef(name.lower(), alias)
 
     def _order_item(self) -> OrderItem:

@@ -13,6 +13,7 @@ project -> sort/topN/limit), with joins left-deep in FROM order.
 
 from __future__ import annotations
 
+import contextvars
 import dataclasses
 from typing import Dict, List, Optional, Tuple
 
@@ -287,11 +288,28 @@ def _agg_output_type(name: str, input_type: Optional[T.Type]) -> T.Type:
     return input_type  # min/max/arbitrary
 
 
+# Session catalog search path (the reference resolves unqualified table
+# names against the session catalog/schema; `USE tpcds.sf1` analog).
+_SEARCH_PATH: contextvars.ContextVar = contextvars.ContextVar(
+    "search_path", default=("tpch", "tpcds"))
+
+
 def plan_sql(query_text: str, max_groups: int = 1 << 16,
-             join_capacity: Optional[int] = None) -> N.PlanNode:
-    """SQL text -> plan tree rooted at OutputNode."""
+             join_capacity: Optional[int] = None,
+             catalog: Optional[str] = None) -> N.PlanNode:
+    """SQL text -> plan tree rooted at OutputNode. `catalog` moves that
+    catalog to the front of the table-name search path."""
     ast = P.parse_sql(query_text)
-    node, names = _plan_any(ast, max_groups, join_capacity)
+    token = None
+    if catalog is not None:
+        path = (catalog,) + tuple(c for c in _SEARCH_PATH.get()
+                                  if c != catalog)
+        token = _SEARCH_PATH.set(path)
+    try:
+        node, names = _plan_any(ast, max_groups, join_capacity)
+    finally:
+        if token is not None:
+            _SEARCH_PATH.reset(token)
     if isinstance(node, N.OutputNode):
         return node
     return N.OutputNode(node, names)
@@ -362,7 +380,7 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
         # catalog/schema; both catalogs define e.g. `customer`, and the
         # earlier catalog in the path wins deterministically)
         from ..connectors import catalogs
-        search_path = ("tpch", "tpcds")
+        search_path = _SEARCH_PATH.get()
         cats = catalogs()
         for cat in search_path:
             sch = cats[cat].SCHEMA
@@ -451,6 +469,102 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
             continue
         collect_names(o.expr)
 
+    # -- WHERE-conjunct classification: predicate pushdown + join graph --
+    # The PredicatePushDown / EliminateCrossJoins analog
+    # (sql/planner/optimizations/PredicatePushDown.java,
+    # iterative/rule/EliminateCrossJoins.java): for all-inner queries,
+    # single-table WHERE conjuncts are planned as filters directly above
+    # that table's scan, and two-table column equalities become edges of
+    # a join graph. Comma-style FROM lists (the TPC-DS benchmark shape)
+    # are joined greedily over that graph -- largest table first (it
+    # stays the probe side; each dimension becomes a build side),
+    # smallest connected candidate next -- so generated query text never
+    # plans a cross product or builds on the fact table.
+    all_inner = all(j.kind in ("inner", "cross") for j in q.joins)
+    has_cross = any(j.kind == "cross" for j in q.joins)
+    alias_list = [(t.alias or t.name) for t in tables]
+
+    def _resolve_alias(parts) -> Optional[Tuple[str, str]]:
+        parts = tuple(p.lower() for p in parts)
+        if len(parts) == 2:
+            a, col = parts
+            for t in tables:
+                if (t.alias or t.name) == a and col in table_schemas[t.name]:
+                    return a, col
+            return None
+        col = parts[0]
+        hits = [t for t in tables if col in table_schemas[t.name]]
+        if len(hits) == 1:
+            return (hits[0].alias or hits[0].name), col
+        return None
+
+    def _names_in(n, out: List[P.Name]) -> bool:
+        """Collect every Name under `n`; False if a subquery lurks."""
+        if isinstance(n, (P.InSubquery, P.ScalarSubquery, P.Exists)):
+            return False
+        if isinstance(n, P.Name):
+            out.append(n)
+            return True
+        ok = True
+        if dataclasses.is_dataclass(n):
+            for f in dataclasses.fields(n):
+                v = getattr(n, f.name)
+                for x in (v if isinstance(v, (list, tuple)) else [v]):
+                    if isinstance(x, tuple):
+                        for y in x:
+                            if dataclasses.is_dataclass(y):
+                                ok = _names_in(y, out) and ok
+                    elif dataclasses.is_dataclass(x):
+                        ok = _names_in(x, out) and ok
+        return ok
+
+    pushed: Dict[str, list] = {a: [] for a in alias_list}
+    edges: List[Tuple[str, str, str, str]] = []
+    where_rest: list = []
+
+    def _classify(c, allow_edges: bool):
+        if isinstance(c, P.BinOp) and c.op == "or":
+            # hoist branch-common conjuncts (join predicates hide inside
+            # every OR branch in TPC-DS text -- q13/q25/q48 shape)
+            common, rest = _extract_common_or(c)
+            if common:
+                for x in common:
+                    _classify(x, allow_edges)
+                if rest is not None:
+                    _classify(rest, allow_edges)
+                return
+        names: List[P.Name] = []
+        if not _names_in(c, names):
+            where_rest.append(c)
+            return
+        resolved = [_resolve_alias(nm.parts) for nm in names]
+        if any(r is None for r in resolved) or not resolved:
+            where_rest.append(c)
+            return
+        aliases_here = {r[0] for r in resolved}
+        if len(aliases_here) == 1:
+            pushed[next(iter(aliases_here))].append(c)
+            return
+        if allow_edges and len(aliases_here) == 2 and \
+                isinstance(c, P.BinOp) and c.op == "=" and \
+                isinstance(c.left, P.Name) and isinstance(c.right, P.Name):
+            la, lc = _resolve_alias(c.left.parts)
+            ra, rc = _resolve_alias(c.right.parts)
+            edges.append((la, lc, ra, rc))
+            return
+        where_rest.append(c)
+
+    if all_inner:
+        for c in (_conjuncts(q.where) if q.where is not None else []):
+            _classify(c, allow_edges=has_cross)
+        if has_cross:
+            for j in q.joins:
+                if j.condition is not None:
+                    for c in _conjuncts(j.condition):
+                        _classify(c, allow_edges=True)
+    else:
+        where_rest = _conjuncts(q.where) if q.where is not None else []
+
     # build scans + running scope over the join chain
     def scan_for(t: P.TableRef) -> Tuple[N.PlanNode, List[str], List[T.Type]]:
         if t.name in derived_plans:
@@ -462,10 +576,19 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
         return (N.TableScanNode(table_catalog[t.name], t.name, cols, tys),
                 cols, tys)
 
-    node, cols0, tys0 = scan_for(q.table)
-    scope_entries: List[Tuple[str, str]] = [((q.table.alias or q.table.name), c)
-                                            for c in cols0]
-    types: List[T.Type] = list(tys0)
+    def scan_planned(t: P.TableRef):
+        """Scan with this table's pushed-down WHERE filters applied."""
+        snode, cols, tys = scan_for(t)
+        a = t.alias or t.name
+        filters = pushed.get(a, [])
+        if filters:
+            ch = {f"{a}.{c}": i for i, c in enumerate(cols)}
+            for i, c in enumerate(cols):
+                ch.setdefault(c, i)
+            sc = _Scope(ch, list(tys))
+            for c in filters:
+                snode = N.FilterNode(snode, an.lower(c, sc))
+        return snode, cols, tys
 
     def make_scope() -> _Scope:
         channels: Dict[str, int] = {}
@@ -478,44 +601,109 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
                 channels[c] = i
         return _Scope(channels, types)
 
-    for j in q.joins:
-        right, rcols, rtys = scan_for(j.table)
-        # extract equi-join keys from the ON conjunction
-        left_scope = make_scope()
-        r_alias = j.table.alias or j.table.name
-        r_channels = {f"{r_alias}.{c}": i for i, c in enumerate(rcols)}
-        for i, c in enumerate(rcols):
-            r_channels.setdefault(c, i)
-        conds = _conjuncts(j.condition)
-        lkeys, rkeys, residual = [], [], []
-        for c in conds:
-            if isinstance(c, P.BinOp) and c.op == "=" and \
-                    isinstance(c.left, P.Name) and isinstance(c.right, P.Name):
-                lparts = ".".join(c.left.parts).lower()
-                rparts = ".".join(c.right.parts).lower()
-                if lparts in left_scope.channels and rparts in r_channels:
-                    lkeys.append(left_scope.channels[lparts])
-                    rkeys.append(r_channels[rparts])
+    scope_entries: List[Tuple[str, str]] = []
+    types: List[T.Type] = []
+
+    if has_cross:
+        if not all_inner:
+            raise NotImplementedError(
+                "comma/CROSS JOIN mixed with outer joins")
+
+        def _weight(t: P.TableRef) -> float:
+            if t.subquery is not None:
+                return 0.0
+            from ..connectors import catalogs as _cats
+            try:
+                return float(_cats()[table_catalog[t.name]]
+                             .table_row_count(t.name, 1.0))
+            except Exception:
+                return 1.0
+
+        start = max(tables, key=_weight)  # ties: first in FROM order
+        node, cols0, tys0 = scan_planned(start)
+        scope_entries += [((start.alias or start.name), c) for c in cols0]
+        types += tys0
+        joined = {start.alias or start.name}
+        remaining = [t for t in tables if t is not start]
+        used_edges: set = set()
+        while remaining:
+            cands = [t for t in remaining
+                     if any((e[0] == (t.alias or t.name) and e[2] in joined)
+                            or (e[2] == (t.alias or t.name) and e[0] in joined)
+                            for e in edges)]
+            if not cands:
+                raise NotImplementedError(
+                    "cross product (no equi-join predicate connects "
+                    f"{[t.alias or t.name for t in remaining]} to {joined})")
+            nxt = min(cands, key=_weight)
+            a = nxt.alias or nxt.name
+            right, rcols, rtys = scan_planned(nxt)
+            lkeys, rkeys = [], []
+            for ei, e in enumerate(edges):
+                if ei in used_edges:
                     continue
-                if rparts in left_scope.channels and lparts in r_channels:
-                    lkeys.append(left_scope.channels[rparts])
-                    rkeys.append(r_channels[lparts])
-                    continue
-            residual.append(c)
-        assert lkeys, f"no equi-join keys in ON {j.condition}"
-        node = N.JoinNode(node, right, lkeys, rkeys, j.kind, "partitioned",
-                          out_capacity=join_capacity)
-        scope_entries += [(r_alias, c) for c in rcols]
-        types += rtys
-        scope = make_scope()
-        for r in residual:
-            node = N.FilterNode(node, an.lower(r, scope))
+                la, lc, ra, rc = e
+                if la == a and ra in joined:
+                    la, lc, ra, rc = ra, rc, la, lc
+                if ra == a and la in joined:
+                    lkeys.append(scope_entries.index((la, lc)))
+                    rkeys.append(rcols.index(rc))
+                    used_edges.add(ei)
+            if not lkeys:
+                raise NotImplementedError(
+                    f"join graph edge resolution failed for {a}")
+            node = N.JoinNode(node, right, lkeys, rkeys, "inner",
+                              "partitioned", out_capacity=join_capacity)
+            scope_entries += [(a, c) for c in rcols]
+            types += rtys
+            joined.add(a)
+            remaining.remove(nxt)
+        if len(used_edges) != len(edges):
+            raise NotImplementedError("unconsumed join-graph edge")
+    else:
+        node, cols0, tys0 = scan_planned(q.table)
+        scope_entries += [((q.table.alias or q.table.name), c) for c in cols0]
+        types += tys0
+
+        for j in q.joins:
+            right, rcols, rtys = scan_planned(j.table)
+            # extract equi-join keys from the ON conjunction
+            left_scope = make_scope()
+            r_alias = j.table.alias or j.table.name
+            r_channels = {f"{r_alias}.{c}": i for i, c in enumerate(rcols)}
+            for i, c in enumerate(rcols):
+                r_channels.setdefault(c, i)
+            conds = _conjuncts(j.condition)
+            lkeys, rkeys, residual = [], [], []
+            for c in conds:
+                if isinstance(c, P.BinOp) and c.op == "=" and \
+                        isinstance(c.left, P.Name) and \
+                        isinstance(c.right, P.Name):
+                    lparts = ".".join(c.left.parts).lower()
+                    rparts = ".".join(c.right.parts).lower()
+                    if lparts in left_scope.channels and rparts in r_channels:
+                        lkeys.append(left_scope.channels[lparts])
+                        rkeys.append(r_channels[rparts])
+                        continue
+                    if rparts in left_scope.channels and lparts in r_channels:
+                        lkeys.append(left_scope.channels[rparts])
+                        rkeys.append(r_channels[lparts])
+                        continue
+                residual.append(c)
+            assert lkeys, f"no equi-join keys in ON {j.condition}"
+            node = N.JoinNode(node, right, lkeys, rkeys, j.kind, "partitioned",
+                              out_capacity=join_capacity)
+            scope_entries += [(r_alias, c) for c in rcols]
+            types += rtys
+            scope = make_scope()
+            for r in residual:
+                node = N.FilterNode(node, an.lower(r, scope))
 
     scope = make_scope()
 
-    if q.where is not None:
+    if where_rest:
         # plain conjuncts first: shrink rows before the semijoin probes
-        conjs = _conjuncts(q.where)
+        conjs = where_rest
 
         _MIRROR = {"<": ">", ">": "<", "<=": ">=", ">=": "<=",
                    "=": "=", "<>": "<>", "!=": "!="}
@@ -1200,6 +1388,49 @@ def _conjuncts(e) -> List[object]:
     return [e]
 
 
+def _disjuncts(e) -> List[object]:
+    if isinstance(e, P.BinOp) and e.op == "or":
+        return _disjuncts(e.left) + _disjuncts(e.right)
+    return [e]
+
+
+def _extract_common_or(c):
+    """OR(A AND X, A AND Y) -> ([A], OR(X, Y)).
+
+    The LogicalRowExpressions.extractCommonPredicates analog
+    (presto-expressions/.../LogicalRowExpressions.java): TPC-DS text
+    hides join predicates inside every branch of an OR (q13/q25/q48
+    shape); hoisting the branch-common conjuncts exposes them to the
+    join-graph/pushdown classifier. Pure Kleene-logic distributivity,
+    so 3VL NULL semantics are preserved. Returns ([], c) when nothing
+    is common; residual None when some branch becomes empty (the OR is
+    implied by the common part)."""
+    ds = _disjuncts(c)
+    if len(ds) < 2:
+        return [], c
+    branch_conjs = [_conjuncts(d) for d in ds]
+    common = []
+    for cand in branch_conjs[0]:
+        if all(any(cand == other for other in bc) for bc in branch_conjs[1:]):
+            if not any(cand == x for x in common):
+                common.append(cand)
+    if not common:
+        return [], c
+    residuals = []
+    for bc in branch_conjs:
+        rem = [x for x in bc if not any(x == y for y in common)]
+        if not rem:
+            return common, None  # a branch reduced to TRUE
+        r = rem[0]
+        for x in rem[1:]:
+            r = P.BinOp("and", r, x)
+        residuals.append(r)
+    new_or = residuals[0]
+    for r in residuals[1:]:
+        new_or = P.BinOp("or", new_or, r)
+    return common, new_or
+
+
 def _plan_aggregation(an, node, scope, q, all_aggs, max_groups):
     """Emit pre-projection + AggregationNode; returns (node, post_scope,
     agg result channel map, group key channel map)."""
@@ -1326,12 +1557,12 @@ def _plan_agg_outputs(an, q, pre_scope, agg_map, key_map):
 
 def sql(query_text: str, sf: float = 0.01, mesh=None,
         max_groups: int = 1 << 16, join_capacity: Optional[int] = None,
-        **kwargs):
-    """One-call SQL execution over the tpch catalog: the query-runner
+        catalog: Optional[str] = None, **kwargs):
+    """One-call SQL execution over the session catalogs: the query-runner
     front door (DistributedQueryRunner.execute analog)."""
     from ..exec import run_query
     root = plan_sql(query_text, max_groups=max_groups,
-                    join_capacity=join_capacity)
+                    join_capacity=join_capacity, catalog=catalog)
     if join_capacity is not None:
         kwargs.setdefault("default_join_capacity", join_capacity)
     return run_query(root, sf=sf, mesh=mesh, **kwargs)

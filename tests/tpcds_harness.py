@@ -1,0 +1,245 @@
+"""TPC-DS oracle harness: an independent SQL engine over the same data.
+
+The H2QueryRunner pattern (presto-tests/.../H2QueryRunner.java,
+QueryAssertions.assertQuery): every TPC-DS query runs on the engine AND
+on sqlite over identical generated columns; result sets must agree.
+
+Dialect bridge (engine text -> sqlite text), applied automatically:
+* ``date 'yyyy-mm-dd'``   -> days-since-epoch int (DATE columns are
+                             staged as int days)
+* money literals ``d.dd`` (exactly two decimals) -> cents int (the
+  engine's decimals are scaled int64 cents; sqlite sees raw cents).
+  Non-money decimal literals must be written with 1 or 3+ decimals.
+* trailing LIMIT is stripped (the oracle computes the full set; the
+  comparator is limit/tie-aware)
+* ``concat(a, b, ...)``   -> ``a || b || ...`` (sqlite 3.40 lacks
+  concat())
+
+Comparison: multiset equality with per-cell tolerance -- ints/strings
+exact; floats (or int-vs-float, e.g. the engine's integer-cents avg
+against sqlite's float avg) to within 1 cent + 1e-6 relative.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import sqlite3
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from presto_tpu.connectors import tpcds
+
+# ---------------------------------------------------------------------------
+# oracle database construction (cached per scale factor)
+# ---------------------------------------------------------------------------
+
+_CONNS: Dict[float, Tuple[sqlite3.Connection, set]] = {}
+
+
+def _sqlite_type(ty) -> str:
+    if ty.is_string:
+        return "TEXT"
+    if ty.is_floating:
+        return "REAL"
+    return "INTEGER"  # ints, decimals-as-cents, dates-as-days
+
+
+def oracle_conn(sf: float, tables: Sequence[str]) -> sqlite3.Connection:
+    if sf not in _CONNS:
+        _CONNS[sf] = (sqlite3.connect(":memory:"), set())
+    conn, loaded = _CONNS[sf]
+    for t in tables:
+        if t in loaded:
+            continue
+        cols = tpcds.TPCDS_SCHEMA[t]
+        names = [c for c, _ in cols]
+        decl = ", ".join(f"{c} {_sqlite_type(ty)}" for c, ty in cols)
+        conn.execute(f"CREATE TABLE {t} ({decl})")
+        data = tpcds.generate_columns(t, sf, names)
+        rows = zip(*(_pyify(data[c]) for c in names))
+        ph = ", ".join("?" * len(names))
+        conn.executemany(f"INSERT INTO {t} VALUES ({ph})", rows)
+        for c in names:  # join keys: keep sqlite's planner out of
+            if c.endswith("_sk") or c.endswith("_number"):  # nested loops
+                conn.execute(f"CREATE INDEX idx_{t}_{c} ON {t} ({c})")
+        loaded.add(t)
+    conn.commit()
+    return conn
+
+
+def _pyify(a: np.ndarray) -> list:
+    if a.dtype == object:
+        return [None if v is None else str(v) for v in a]
+    if np.issubdtype(a.dtype, np.floating):
+        return [float(v) for v in a]
+    return [int(v) for v in a]
+
+
+# ---------------------------------------------------------------------------
+# engine-dialect -> sqlite-dialect
+# ---------------------------------------------------------------------------
+
+_DATE_RE = re.compile(r"date\s+'(\d{4}-\d{2}-\d{2})'", re.IGNORECASE)
+_MONEY_RE = re.compile(r"(?<![\w.])(\d+)\.(\d{2})(?![\d])")
+_LIMIT_RE = re.compile(r"\bLIMIT\s+\d+\s*$", re.IGNORECASE)
+_CONCAT_RE = re.compile(r"\bconcat\s*\(", re.IGNORECASE)
+
+
+def _days(s: str) -> int:
+    return int((np.datetime64(s) - np.datetime64("1970-01-01"))
+               .astype(int))
+
+
+def to_oracle_sql(sql: str, keep_limit: bool = False) -> str:
+    out = _DATE_RE.sub(lambda m: str(_days(m.group(1))), sql)
+    out = _MONEY_RE.sub(lambda m: str(int(m.group(1)) * 100
+                                      + int(m.group(2))), out)
+    if not keep_limit:
+        out = _LIMIT_RE.sub("", out.rstrip())
+    while _CONCAT_RE.search(out):
+        m = _CONCAT_RE.search(out)
+        depth, i = 1, m.end()
+        args, start = [], m.end()
+        while depth:
+            ch = out[i]
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    args.append(out[start:i])
+            elif ch == "," and depth == 1:
+                args.append(out[start:i])
+                start = i + 1
+            elif ch == "'":  # skip string literal
+                i += 1
+                while out[i] != "'":
+                    i += 1
+            i += 1
+        joined = "(" + " || ".join(a.strip() for a in args) + ")"
+        out = out[:m.start()] + joined + out[i:]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# result comparison
+# ---------------------------------------------------------------------------
+
+
+def _norm(v):
+    if v is None:
+        return None
+    if isinstance(v, (np.integer, int, bool)):
+        return int(v)
+    if isinstance(v, (np.floating, float)):
+        return float(v)
+    return str(v)
+
+
+def _cell_eq(a, b) -> bool:
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, str) or isinstance(b, str):
+        return str(a) == str(b)
+    if isinstance(a, int) and isinstance(b, int):
+        return a == b
+    # float against float-or-int: cents rounding + fp tolerance
+    return math.isclose(float(a), float(b), rel_tol=1e-6, abs_tol=1.01)
+
+
+def _sort_key(row):
+    return tuple((v is None,
+                  round(v, 3) if isinstance(v, float) else v,
+                  str(type(v).__name__) if v is None else "")
+                 for v in row)
+
+
+def assert_rows_match(got: List[tuple], want: List[tuple],
+                      limit: Optional[int] = None):
+    got = [tuple(_norm(v) for v in r) for r in got]
+    want = [tuple(_norm(v) for v in r) for r in want]
+    if limit is not None:
+        assert len(want) <= limit, (
+            f"oracle produced {len(want)} rows >= LIMIT {limit}: boundary "
+            "ties would make the comparison ambiguous -- shrink the test "
+            "scale factor or widen the predicate")
+    assert len(got) == len(want), f"row count {len(got)} != {len(want)}"
+    gs = sorted(got, key=_sort_key)
+    ws = sorted(want, key=_sort_key)
+    for g, w in zip(gs, ws):
+        assert len(g) == len(w), f"column count {len(g)} != {len(w)}"
+        ok = all(_cell_eq(a, b) for a, b in zip(g, w))
+        assert ok, f"row mismatch:\n  engine: {g}\n  oracle: {w}"
+
+
+def assert_sorted(rows: List[tuple], keys: List[Tuple[int, bool]]):
+    """Check the engine honored ORDER BY (keys: [(col, descending)])."""
+    def key(r):
+        out = []
+        for c, desc in keys:
+            v = _norm(r[c])
+            rank = (v is None)  # engine default: nulls last
+            if isinstance(v, (int, float)) and desc:
+                v = -v
+                out.append((rank, v, ""))
+            elif desc:
+                out.append((rank, 0, v))  # desc strings: checked pairwise
+            else:
+                out.append((rank, v if not isinstance(v, str) else 0,
+                            v if isinstance(v, str) else ""))
+        return tuple(out)
+
+    if any(desc and not isinstance(_norm(rows[0][c]) if rows else 0,
+                                   (int, float, type(None)))
+           for c, desc in keys):
+        return  # descending strings: skip (rare; covered by row compare)
+    ks = [key(r) for r in rows]
+    assert ks == sorted(ks), "engine rows not in ORDER BY order"
+
+
+# ---------------------------------------------------------------------------
+# the one-call runner
+# ---------------------------------------------------------------------------
+
+
+def run_tpcds_case(name: str, sf: float = 0.02, *, sql_text: str = None,
+                   oracle_sql: str = None, max_groups: int = 1 << 13,
+                   join_capacity: int = 1 << 18,
+                   order_keys: Optional[List[Tuple[int, bool]]] = None,
+                   min_rows: int = 1, keep_limit: bool = False,
+                   **engine_kwargs):
+    """Run a corpus query on the engine and on sqlite; assert equality.
+
+    keep_limit: the query's ORDER BY keys uniquely determine row order
+    (e.g. ORDER BY on the lone group key), so the oracle keeps its
+    LIMIT and the comparison is an exact top-k prefix match.
+
+    Returns the engine rows so tests can make extra assertions."""
+    from presto_tpu.queries.tpcds_queries import TPCDS_QUERIES
+    from presto_tpu.sql import sql as engine_sql
+
+    text = sql_text if sql_text is not None else TPCDS_QUERIES[name]
+    limit_m = re.search(r"\bLIMIT\s+(\d+)\s*$", text.rstrip(),
+                        re.IGNORECASE)
+    limit = int(limit_m.group(1)) if limit_m else None
+
+    res = engine_sql(text, sf=sf, catalog="tpcds", max_groups=max_groups,
+                     join_capacity=join_capacity, **engine_kwargs)
+    got = res.rows()
+
+    tables = set(re.findall(
+        r"\b(" + "|".join(tpcds.TPCDS_SCHEMA) + r")\b", text))
+    conn = oracle_conn(sf, sorted(tables))
+    otext = to_oracle_sql(oracle_sql if oracle_sql is not None else text,
+                          keep_limit=keep_limit)
+    want = conn.execute(otext).fetchall()
+
+    assert_rows_match(got, want, limit=None if keep_limit else limit)
+    assert len(want) >= min_rows, (
+        f"{name}: oracle produced only {len(want)} rows -- the case is "
+        "vacuous at this scale; adjust sf or constants")
+    if order_keys:
+        assert_sorted(got, order_keys)
+    return got
