@@ -36,7 +36,8 @@ from ..ops.join import hash_join, semi_join_mask
 from ..ops.misc import distinct as distinct_op
 from ..ops.misc import limit as limit_op
 from ..ops.sort import SortKey, sort_batch, top_n
-from ..parallel.exchange import broadcast_build, exchange_by_hash, gather_to_root
+from ..parallel.exchange import (broadcast_build, exchange_by_hash,
+                                 exchange_by_range, gather_to_root)
 from ..parallel.mesh import WORKERS_AXIS
 from ..plan import nodes as N
 
@@ -63,11 +64,22 @@ def _collect_scans(node: N.PlanNode, out: List[N.PlanNode]):
 
 
 def compile_plan(root: N.PlanNode, mesh=None,
-                 default_join_capacity: int = 1 << 16) -> CompiledPlan:
+                 default_join_capacity: int = 1 << 16,
+                 exchange_slot_scale: int = 1) -> CompiledPlan:
+    """`exchange_slot_scale` geometrically grows every exchange's
+    per-destination slot capacity (clamped at the sender's row capacity,
+    where overflow is impossible): the runner's overflow->rerun policy
+    passes 1, 2, 4, ... until the plan fits -- the memory-feedback
+    analog of the reference's reserve/revoke loop."""
     scans: List[N.PlanNode] = []
     _collect_scans(root, scans)
     axis = WORKERS_AXIS
     dist = mesh is not None
+
+    def _scaled_slot(base: int, sender_capacity: int) -> int:
+        # a sender never has more than `sender_capacity` rows for any
+        # one destination, so slots beyond that cannot overflow
+        return min(base * exchange_slot_scale, max(sender_capacity, 1))
 
     def lower(node: N.PlanNode, inputs: Dict[str, Batch]) -> Batch:
         if isinstance(node, (N.TableScanNode, N.ValuesNode,
@@ -214,11 +226,34 @@ def compile_plan(root: N.PlanNode, mesh=None,
             _note_overflow(ovf)
             return out
         if isinstance(node, N.ExchangeNode):
+            if node.kind == "MERGE" and dist and node.scope == "REMOTE":
+                # MergeOperator analog on the mesh: sampled range
+                # repartition + per-worker sort => globally sorted
+                # DISTRIBUTED output (the full row set never lands on
+                # one device). The local pre-sort below the exchange
+                # (which the HTTP tier's producers need for the k-way
+                # merge) is redundant here -- the post-exchange sort
+                # orders everything -- so lowering skips it.
+                src_node = node.source
+                if isinstance(src_node, N.SortNode):
+                    src_node = src_node.source
+                inner = lower(src_node, inputs)
+                n_workers = mesh.devices.size
+                slot = _scaled_slot(
+                    node.slot_capacity
+                    or max(4 * inner.capacity // max(n_workers, 1), 64),
+                    inner.capacity)
+                out, ovf = exchange_by_range(inner, node.sort_keys, axis,
+                                             slot)
+                _note_overflow(ovf)
+                return sort_batch(out, [SortKey(*k) for k in node.sort_keys])
             src = lower(node.source, inputs)
             if node.scope == "LOCAL" or not dist:
                 return src
             if node.kind == "REPARTITION":
-                slot = node.slot_capacity or max(src.capacity, 1)
+                slot = _scaled_slot(
+                    node.slot_capacity or max(src.capacity, 1),
+                    src.capacity)
                 out, ovf = exchange_by_hash(src, node.partition_channels,
                                             axis, slot)
                 _note_overflow(ovf)

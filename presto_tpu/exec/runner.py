@@ -148,15 +148,31 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
         raise
     for b in batches:
         stats.add("scan_rows", int(np.asarray(b.active).sum()))
-    fn = jax.jit(plan.fn)
     try:
         with stats.timed("execute_s"):
-            out, overflow = fn(tuple(batches))
-            jax.block_until_ready(out)
-        if bool(np.asarray(overflow)):
-            raise RuntimeError(
-                "plan execution overflowed a static bucket (join/exchange/"
-                "group capacity); rerun with larger capacity_hints")
+            # overflow -> rerun with geometrically larger exchange slots
+            # (exchange slots clamp at the sender capacity, where
+            # overflow is impossible, so this converges; join/group
+            # overflow is not slot-scalable and still errors out).
+            # This is the memory-feedback loop the reference runs as
+            # reserve/revoke -- here it recompiles with bigger static
+            # buckets instead.
+            scale = 1
+            while True:
+                fn = jax.jit(plan.fn)
+                out, overflow = fn(tuple(batches))
+                jax.block_until_ready(out)
+                if not bool(np.asarray(overflow)):
+                    break
+                if mesh is None or scale >= 64:
+                    raise RuntimeError(
+                        "plan execution overflowed a static bucket (join/"
+                        "group capacity); rerun with larger capacity "
+                        "hints (max_groups / join_capacity)")
+                scale *= 2
+                stats.add("exchange_slot_reruns", 1)
+                plan = compile_plan(root, mesh, default_join_capacity,
+                                    exchange_slot_scale=scale)
         with stats.timed("fetch_s"):
             res = _batch_to_result(out, root)
     finally:

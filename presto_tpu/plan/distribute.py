@@ -7,12 +7,27 @@ SINGLE-step aggregation lowered under shard_map would aggregate each
 shard independently and emit per-shard partials as if they were final
 results (exactly the drift the verifier catches).
 
-Round-1 rules (correctness-first; cost-based variants per ROADMAP):
+Distribution rules (cost-based join choice per ROADMAP):
   * Aggregation(SINGLE, keys)   -> PARTIAL -> REPARTITION(keys) -> FINAL
   * Aggregation(SINGLE, global) -> PARTIAL -> GATHER -> FINAL
   * Distinct                    -> REPARTITION(keys) -> Distinct
-  * Sort / TopN / Limit / Window / RowNumber / MarkDistinct
+  * Sort (order observable at root)
+                                -> MERGE exchange over local Sort: on
+                                   the mesh a sampled range repartition
+                                   + per-worker sort (globally sorted,
+                                   stays distributed); on the HTTP tier
+                                   producers sort locally and the
+                                   consumer k-way merges
+                                   (MergeOperator.java:45)
+  * Sort (order consumed above) -> GATHER -> Sort (single-node)
+  * TopN / Limit                -> partial per worker -> GATHER -> final
+                                   (full input never gathers)
+  * Window / RowNumber with PARTITION BY
+                                -> REPARTITION(partition keys) -> local
+                                   (partitions are wholly local)
+  * Window / RowNumber unpartitioned
                                 -> GATHER -> op (single-node semantics)
+  * MarkDistinct                -> REPARTITION(keys) -> MarkDistinct
   * Join                        -> distribution=broadcast (build side is
                                    all_gathered by the lowering)
   * SemiJoin                    -> filtering side broadcast (lowering)
@@ -47,14 +62,21 @@ def split_single_agg(agg: "N.AggregationNode",
     return N.AggregationNode(ex, list(range(nkeys)), agg.aggregates,
                              step="FINAL", max_groups=agg.max_groups)
 
-_GATHER_OPS = (N.SortNode, N.TopNNode, N.LimitNode, N.WindowNode,
-               N.RowNumberNode, N.MarkDistinctNode)
-
 
 def _is_repartition_on(node: N.PlanNode, keys) -> bool:
     return (isinstance(node, N.ExchangeNode)
             and node.kind == "REPARTITION"
             and list(node.partition_channels) == list(keys))
+
+
+def _is_remote_exchange(node: N.PlanNode) -> bool:
+    return isinstance(node, N.ExchangeNode) and node.scope == "REMOTE"
+
+
+# node kinds through which output ordering survives to the root (the
+# runner materializes distributed output in worker-then-row order, so a
+# globally range-sorted distributed batch concatenates correctly)
+_ORDER_TRANSPARENT = (N.ProjectNode, N.OutputNode)
 
 
 def add_exchanges(node: N.PlanNode,
@@ -63,16 +85,30 @@ def add_exchanges(node: N.PlanNode,
     default); "partitioned" repartitions BOTH join sides by the join
     keys (DetermineJoinDistributionType's PARTITIONED choice -- right
     for large builds; cost-based selection is a ROADMAP item)."""
+    return _visit(node, join_strategy, order_root=True, under=None)
+
+
+def _visit(node: N.PlanNode, join_strategy: str, order_root: bool,
+           under) -> N.PlanNode:
+    """`order_root`: this node's output order is observable at the plan
+    root (only Project/Output ancestors). `under`: the exchange kind
+    directly above, so already-distributed partials (the local Sort of a
+    MERGE, the partial TopN/Limit of a GATHER) are not rewritten again
+    on idempotent re-application."""
+    child_order = order_root and isinstance(node, _ORDER_TRANSPARENT)
     # rebuild children first
     replaced = {}
     for f in _dc.fields(node):
         v = getattr(node, f.name)
+        child_under = node.kind if isinstance(node, N.ExchangeNode) \
+            and node.scope == "REMOTE" else None
         if isinstance(v, N.PlanNode):
-            nv = add_exchanges(v, join_strategy)
+            nv = _visit(v, join_strategy, child_order, child_under)
             if nv is not v:
                 replaced[f.name] = nv
         elif isinstance(v, list) and v and isinstance(v[0], N.PlanNode):
-            nl = [add_exchanges(s, join_strategy) for s in v]
+            nl = [_visit(s, join_strategy, child_order, child_under)
+                  for s in v]
             if any(a is not b for a, b in zip(nl, v)):
                 replaced[f.name] = nl
     if replaced:
@@ -97,17 +133,54 @@ def add_exchanges(node: N.PlanNode,
         keys = node.key_channels
         if keys is None:
             keys = list(range(len(node.source.output_types())))
+        if _is_repartition_on(node.source, keys):
+            return node
         ex = N.ExchangeNode(node.source, kind="REPARTITION", scope="REMOTE",
                             partition_channels=keys,
                             slot_capacity=node.max_groups)
         return _dc.replace(node, source=ex)
 
-    if isinstance(node, _GATHER_OPS):
-        src = node.sources[0]
-        if not isinstance(src, N.ExchangeNode):
-            ex = N.ExchangeNode(src, kind="GATHER", scope="REMOTE")
-            return _dc.replace(node, source=ex)
-        return node
+    if isinstance(node, N.SortNode):
+        if under == "MERGE" or _is_remote_exchange(node.source):
+            return node  # the local sort of a MERGE / pre-distributed
+        if order_root:
+            local = N.SortNode(node.source, node.keys)
+            return N.ExchangeNode(local, kind="MERGE", scope="REMOTE",
+                                  sort_keys=list(node.keys))
+        ex = N.ExchangeNode(node.source, kind="GATHER", scope="REMOTE")
+        return _dc.replace(node, source=ex)
+
+    if isinstance(node, (N.TopNNode, N.LimitNode)):
+        if under == "GATHER" or _is_remote_exchange(node.source):
+            return node  # the partial below / the final above the gather
+        if isinstance(node, N.TopNNode):
+            partial = N.TopNNode(node.source, node.keys, node.count)
+        else:
+            partial = N.LimitNode(node.source, node.count)
+        ex = N.ExchangeNode(partial, kind="GATHER", scope="REMOTE")
+        return _dc.replace(node, source=ex)
+
+    if isinstance(node, (N.WindowNode, N.RowNumberNode)):
+        keys = list(node.partition_channels)
+        if keys:
+            if _is_repartition_on(node.source, keys):
+                return node
+            # every PARTITION BY group lands wholly on one worker; the
+            # window then runs partition-local with no gather
+            ex = N.ExchangeNode(node.source, kind="REPARTITION",
+                                scope="REMOTE", partition_channels=keys)
+        else:
+            if _is_remote_exchange(node.source):
+                return node
+            ex = N.ExchangeNode(node.source, kind="GATHER", scope="REMOTE")
+        return _dc.replace(node, source=ex)
+
+    if isinstance(node, N.MarkDistinctNode):
+        if _is_repartition_on(node.source, node.key_channels):
+            return node
+        ex = N.ExchangeNode(node.source, kind="REPARTITION", scope="REMOTE",
+                            partition_channels=list(node.key_channels))
+        return _dc.replace(node, source=ex)
 
     if isinstance(node, N.JoinNode):
         if join_strategy == "partitioned":

@@ -54,17 +54,22 @@ class PlanFragment:
     id: int
     root: PlanNode
     # partitioning of this fragment's OUTPUT (SINGLE for gathered,
-    # HASH for repartitioned, BROADCAST for replicated)
+    # HASH for repartitioned, BROADCAST for replicated, SORTED for a
+    # locally sorted fragment whose consumer must k-way merge its tasks'
+    # streams by `sort_keys` -- the MergeOperator edge)
     partitioning: str
     # ids of fragments feeding this one through remote exchanges
     remote_sources: List[int]
     # output-partitioning channels when partitioning == HASH
     partition_channels: List[int] = dataclasses.field(default_factory=list)
+    # (channel, descending, nulls_last) when partitioning == SORTED
+    sort_keys: List[tuple] = dataclasses.field(default_factory=list)
 
     def to_json(self) -> dict:
         return {"id": self.id, "partitioning": self.partitioning,
                 "remoteSources": self.remote_sources,
                 "partitionChannels": self.partition_channels,
+                "sortKeys": [list(k) for k in self.sort_keys],
                 "root": to_json(self.root)}
 
 
@@ -85,9 +90,11 @@ def fragment_plan(root: PlanNode) -> List[PlanFragment]:
         if isinstance(node, ExchangeNode) and node.scope == "REMOTE":
             child, child_feeds = walk(node.source)
             part = ("HASH" if node.kind == "REPARTITION" else
-                    "BROADCAST" if node.kind == "REPLICATE" else "SINGLE")
+                    "BROADCAST" if node.kind == "REPLICATE" else
+                    "SORTED" if node.kind == "MERGE" else "SINGLE")
             frag = PlanFragment(len(fragments), child, part, child_feeds,
-                                list(node.partition_channels))
+                                list(node.partition_channels),
+                                list(node.sort_keys or []))
             fragments.append(frag)
             rs = RemoteSourceNode(list(child.output_types()), frag.id)
             return rs, [frag.id]

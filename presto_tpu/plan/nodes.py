@@ -373,12 +373,19 @@ class ExchangeNode(PlanNode):
     """scope REMOTE => stage boundary (collective over the mesh);
     scope LOCAL => no-op in this engine (XLA fuses local pipelines).
     kind: REPARTITION (hash by partition_channels), REPLICATE
-    (broadcast), GATHER (to single/replicated)."""
+    (broadcast), GATHER (to single/replicated), MERGE (order-preserving
+    exchange of locally sorted inputs by `sort_keys` -- the
+    MergeOperator.java:45 analog; on the mesh it lowers to a sampled
+    range repartition + local sort so the globally sorted result stays
+    DISTRIBUTED, on the HTTP tier consumers k-way merge sorted upstream
+    streams)."""
     source: PlanNode
     kind: str = "REPARTITION"
     scope: str = "REMOTE"
     partition_channels: List[int] = dataclasses.field(default_factory=list)
     slot_capacity: Optional[int] = None
+    # (channel, descending, nulls_last) triples when kind == "MERGE"
+    sort_keys: Optional[List[Tuple[int, bool, bool]]] = None
 
     @property
     def sources(self):
@@ -500,7 +507,9 @@ def to_json(n: PlanNode) -> dict:
         return {**base, "@type": "exchange", "source": to_json(n.source),
                 "kind": n.kind, "scope": n.scope,
                 "partitionChannels": n.partition_channels,
-                "slotCapacity": n.slot_capacity}
+                "slotCapacity": n.slot_capacity,
+                "sortKeys": [list(k) for k in n.sort_keys]
+                if n.sort_keys is not None else None}
     if isinstance(n, OutputNode):
         return {**base, "@type": "output", "source": to_json(n.source),
                 "names": n.names}
@@ -572,7 +581,9 @@ def from_json(j: dict) -> PlanNode:
                           j["outCapacity"], j["withOrdinality"], **kw)
     if t == "exchange":
         return ExchangeNode(from_json(j["source"]), j["kind"], j["scope"],
-                            j["partitionChannels"], j["slotCapacity"], **kw)
+                            j["partitionChannels"], j["slotCapacity"],
+                            sort_keys=[tuple(k) for k in j["sortKeys"]]
+                            if j.get("sortKeys") is not None else None, **kw)
     if t == "output":
         return OutputNode(from_json(j["source"]), j["names"], **kw)
     raise ValueError(f"unknown plan node {t!r}")

@@ -97,8 +97,10 @@ def validate_plan(root: N.PlanNode, distributed: bool = False) -> List[str]:
                 if st[c].base == "array":
                     out.append("array-typed sort key")
         elif isinstance(n, N.ExchangeNode):
-            if n.kind not in ("REPARTITION", "REPLICATE", "GATHER"):
+            if n.kind not in ("REPARTITION", "REPLICATE", "GATHER", "MERGE"):
                 out.append(f"unsupported exchange kind {n.kind!r}")
+            if n.kind == "MERGE" and not n.sort_keys:
+                out.append("MERGE exchange without sort_keys")
         for s in n.sources:
             walk(s)
 

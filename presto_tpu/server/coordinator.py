@@ -158,7 +158,8 @@ class Coordinator:
             hash_ups = [rn for rn in remote_nodes
                         if frag_by_id[rn.fragment_id].partitioning == "HASH"]
             single_ups = [rn for rn in remote_nodes
-                          if frag_by_id[rn.fragment_id].partitioning == "SINGLE"]
+                          if frag_by_id[rn.fragment_id].partitioning
+                          in ("SINGLE", "SORTED")]
             if (scans and single_ups) or _contains_global_agg(frag.root):
                 ntasks_of[frag.id] = 1
             else:
@@ -188,7 +189,8 @@ class Coordinator:
             hash_ups = [rn for rn in remote_nodes
                         if frag_by_id[rn.fragment_id].partitioning == "HASH"]
             single_ups = [rn for rn in remote_nodes
-                          if frag_by_id[rn.fragment_id].partitioning == "SINGLE"]
+                          if frag_by_id[rn.fragment_id].partitioning
+                          in ("SINGLE", "SORTED")]
             if scans and hash_ups:
                 raise SchedulerGap(
                     "fragment mixes range-split table scans with hash-"
@@ -239,9 +241,16 @@ class Coordinator:
                                  # freed with the task, not per token)
                                  "ack": False}
                         up_part = frag_by_id[rn.fragment_id].partitioning
+                        if up_part == "SORTED":
+                            # consumer must k-way merge the sorted
+                            # upstream task streams (MergeOperator)
+                            entry["mergeKeys"] = [
+                                list(k)
+                                for k in frag_by_id[rn.fragment_id].sort_keys]
                         if up_part == "HASH":
                             entry["bufferId"] = w
-                        elif up_part == "SINGLE" and ntasks > 1 and w > 0:
+                        elif up_part in ("SINGLE", "SORTED") \
+                                and ntasks > 1 and w > 0:
                             # a gathered upstream feeds exactly ONE of
                             # the fanned-out consumers; the rest see an
                             # empty source (otherwise its rows would be

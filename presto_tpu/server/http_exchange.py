@@ -20,7 +20,35 @@ from ..block import Batch, batch_from_numpy
 from ..serde import PageCodec
 from .client import WorkerClient
 
-__all__ = ["fetch_remote_batch"]
+__all__ = ["fetch_remote_batch", "merge_permutation"]
+
+
+def merge_permutation(arrays: Sequence[np.ndarray],
+                      nulls: Sequence[np.ndarray],
+                      merge_keys: Sequence[Sequence]) -> np.ndarray:
+    """Permutation that k-way merges concatenated sorted runs by
+    (channel, descending, nulls_last) keys -- the host half of the
+    MergeOperator.java:45 analog. Each key column is reduced to dense
+    int64 rank codes (direction/null placement folded in), then
+    np.lexsort's stable mergesort does the merge: on input that is a
+    concatenation of sorted runs its passes are exactly the k-way merge,
+    and stability keeps the upstream task order for equal keys."""
+    n = len(arrays[0]) if arrays else 0
+    cols = []
+    for ch, desc, nulls_last in merge_keys:
+        v, m = arrays[ch], nulls[ch]
+        # np.unique sorts NaN last, matching Presto's NaN-largest rule
+        _, inv = np.unique(v, return_inverse=True)
+        inv = inv.astype(np.int64) + 1
+        if desc:
+            inv = -inv
+        # nulls placed outside the value code range
+        null_code = np.int64(1 << 40) if nulls_last else np.int64(-(1 << 40))
+        code = np.where(m, null_code, inv)
+        cols.append(code)
+    # np.lexsort: LAST key is primary -> reverse
+    return np.lexsort(tuple(reversed(cols))) if cols \
+        else np.arange(n, dtype=np.int64)
 
 
 def fetch_remote_batch(sources: Sequence[str], task_ids: Sequence[str],
@@ -30,10 +58,14 @@ def fetch_remote_batch(sources: Sequence[str], task_ids: Sequence[str],
                        timeout: float = 60.0,
                        pad_multiple: int = 8,
                        buffer_id: int = 0,
-                       ack: bool = True) -> Batch:
+                       ack: bool = True,
+                       merge_keys: Optional[Sequence[Sequence]] = None
+                       ) -> Batch:
     """Pull every page of `task_ids[i]` from worker base-url `sources[i]`,
     concatenate, and stage as one device Batch -- the RemoteSourceNode
-    feed for a fragment whose upstream ran on other workers/slices."""
+    feed for a fragment whose upstream ran on other workers/slices.
+    With `merge_keys`, upstream streams are locally sorted and the
+    concatenation is k-way merged by those keys (MergeOperator)."""
     all_cols: List[List[np.ndarray]] = [[] for _ in types]
     all_nulls: List[List[np.ndarray]] = [[] for _ in types]
     total = 0
@@ -63,6 +95,10 @@ def fetch_remote_batch(sources: Sequence[str], task_ids: Sequence[str],
             arrays.append(np.array([], dtype=object if ty.is_string
                                    else ty.to_dtype()))
             nulls.append(np.array([], dtype=bool))
+    if merge_keys and total:
+        perm = merge_permutation(arrays, nulls, merge_keys)
+        arrays = [a[perm] for a in arrays]
+        nulls = [m[perm] for m in nulls]
     cap = capacity or max(-(-total // pad_multiple) * pad_multiple,
                           pad_multiple)
     return batch_from_numpy(types, arrays, nulls, capacity=cap)

@@ -183,7 +183,8 @@ class TaskManager:
                     [parse_type(t) for t in spec["types"]],
                     pad_multiple=pad,
                     buffer_id=int(spec.get("bufferId", 0)),
-                    ack=bool(spec.get("ack", True)))
+                    ack=bool(spec.get("ack", True)),
+                    merge_keys=spec.get("mergeKeys"))
             from ..exec.runner import run_query
             t0 = time.time()
             with self._exec_lock:
