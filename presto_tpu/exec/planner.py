@@ -245,7 +245,7 @@ def compile_plan(root: N.PlanNode, mesh=None,
                     inner.capacity)
                 out, ovf = exchange_by_range(inner, node.sort_keys, axis,
                                              slot)
-                _note_overflow(ovf)
+                _note_overflow(ovf, scalable=True)
                 return sort_batch(out, [SortKey(*k) for k in node.sort_keys])
             src = lower(node.source, inputs)
             if node.scope == "LOCAL" or not dist:
@@ -256,7 +256,7 @@ def compile_plan(root: N.PlanNode, mesh=None,
                     src.capacity)
                 out, ovf = exchange_by_hash(src, node.partition_channels,
                                             axis, slot)
-                _note_overflow(ovf)
+                _note_overflow(ovf, scalable=True)
                 return out
             if node.kind == "REPLICATE":
                 return broadcast_build(src, axis)
@@ -273,19 +273,28 @@ def compile_plan(root: N.PlanNode, mesh=None,
 
     overflow_box: List = []
 
-    def _note_overflow(flag):
-        overflow_box.append(flag)
+    def _note_overflow(flag, scalable: bool = False):
+        """scalable=True marks exchange-slot overflow, which the runner
+        can cure by recompiling with a bigger exchange_slot_scale;
+        join/group overflow needs bigger declared capacities instead."""
+        overflow_box.append((flag, scalable))
 
     def run(scan_batches: Sequence[Batch]):
         overflow_box.clear()
         inputs = {n.id: b for n, b in zip(scans, scan_batches)}
         out = lower(root, inputs)
-        ovf = jnp.zeros((), dtype=bool)
-        for f in overflow_box:
-            ovf = ovf | f
+        hard = jnp.zeros((), dtype=bool)   # join/group capacity
+        slots = jnp.zeros((), dtype=bool)  # exchange slots (rescalable)
+        for f, scalable in overflow_box:
+            if scalable:
+                slots = slots | f
+            else:
+                hard = hard | f
         if dist:
-            ovf = jax.lax.psum(ovf.astype(jnp.int32), axis) > 0
-        return out, ovf
+            hard = jax.lax.psum(hard.astype(jnp.int32), axis) > 0
+            slots = jax.lax.psum(slots.astype(jnp.int32), axis) > 0
+        # bitmask: bit0 = hard (non-scalable), bit1 = exchange slots
+        return out, hard.astype(jnp.int32) + 2 * slots.astype(jnp.int32)
 
     if dist:
         in_specs = tuple(P(WORKERS_AXIS) for _ in scans)

@@ -150,25 +150,29 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
         stats.add("scan_rows", int(np.asarray(b.active).sum()))
     try:
         with stats.timed("execute_s"):
-            # overflow -> rerun with geometrically larger exchange slots
-            # (exchange slots clamp at the sender capacity, where
-            # overflow is impossible, so this converges; join/group
-            # overflow is not slot-scalable and still errors out).
-            # This is the memory-feedback loop the reference runs as
-            # reserve/revoke -- here it recompiles with bigger static
-            # buckets instead.
+            # exchange-slot overflow (flag bit1) -> rerun with
+            # geometrically larger slots; slots clamp at the sender
+            # capacity, where overflow is impossible, so this converges.
+            # Join/group overflow (bit0) is not slot-scalable and errors
+            # out immediately. This is the memory-feedback loop the
+            # reference runs as reserve/revoke -- here it recompiles
+            # with bigger static buckets instead.
             scale = 1
             while True:
                 fn = jax.jit(plan.fn)
                 out, overflow = fn(tuple(batches))
                 jax.block_until_ready(out)
-                if not bool(np.asarray(overflow)):
+                flags = int(np.asarray(overflow))
+                if flags == 0:
                     break
-                if mesh is None or scale >= 64:
+                if flags & 1:
                     raise RuntimeError(
                         "plan execution overflowed a static bucket (join/"
                         "group capacity); rerun with larger capacity "
                         "hints (max_groups / join_capacity)")
+                if mesh is None or scale >= 1 << 20:  # unreachable: clamp
+                    raise RuntimeError(
+                        "exchange slot overflow did not converge")
                 scale *= 2
                 stats.add("exchange_slot_reruns", 1)
                 plan = compile_plan(root, mesh, default_join_capacity,

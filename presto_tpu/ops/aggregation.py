@@ -6,8 +6,26 @@ GroupByHash/BigintGroupByHash/MultiChannelGroupByHash (operator/*.java)
 and the partial/final split the planner produces
 (PushPartialAggregationThroughExchange rule).
 
-TPU-first redesign: no pointer-chasing hash table, no row loop. Group
-resolution is a fully data-parallel HASH-SLOT kernel, static-shape:
+TPU-first redesign: no pointer-chasing hash table, no row loop. TWO
+kernels, picked by the static group capacity (measured on a v5e chip,
+6M rows -- see scripts/microbench_groupby.py):
+
+SMALL tables (max_groups <= _SMALL_G, the TPC-H q1 shape): XLA lowers
+large scatters to a serialized per-update loop on TPU (436ms for ONE
+6M->16 scatter-add on v5e), so the small path uses none:
+
+  1. group ids by FIRST-OCCURRENCE EXTRACTION: a lax.while_loop that,
+     per round, finds the first unresolved row (argmin), broadcasts its
+     key words, and resolves every equal row -- at most max_groups data
+     passes, 8.6ms vs the hash kernel's 364ms
+  2. integer/decimal sums ride the MXU exactly: values split into
+     13-bit limbs, one-hot(ids) @ limbs einsum in f32 over 2048-row
+     chunks (each chunk sum < 2^24, exact in f32), chunk partials
+     combined in int64 -- 1.2ms per 6M-row aggregate
+  3. float sums and min/max reduce with per-group masked reductions
+     (max_groups fused where+reduce passes, ~1ms at G=16)
+
+LARGE tables: the HASH-SLOT kernel:
 
   1. normalize key columns to uint64 words (ops/keys.py), splitmix-hash
      them to a slot in a power-of-two table of 2*max_groups slots
@@ -19,12 +37,10 @@ resolution is a fully data-parallel HASH-SLOT kernel, static-shape:
      resolve within the probe budget raise the overflow flag (the
      exec-layer rerun/spill trigger), mirroring capacity overflow
   4. every aggregate becomes a masked scatter-add/min/max into a dense
-     (max_groups,) table -- XLA lowers these to efficient TPU scatters
+     (max_groups,) table
 
-This replaced a sort-based kernel (lax.sort by key words): the hash
-kernel is O(n) scatters/gathers vs O(n log n) sort and benchmarked ~8x
-faster on TPC-H q1's group-by (sort variant kept as _group_ids_sort;
-A/B via BENCH_GROUPBY=sort in bench.py).
+(A sort-based kernel is kept as _group_ids_sort for A/B via
+BENCH_GROUPBY=sort in bench.py.)
 
 `max_groups` is a static capacity (shape-bucketing policy lives in the
 exec layer; overflow is reported via the result's `overflow` flag --
@@ -120,11 +136,14 @@ def _hash_words(words) -> jnp.ndarray:
     return h
 
 
+_SMALL_G = 64  # crossover below which the scatter-free kernels win
+
+
 def _group_ids(key_cols: Sequence[Block], active: jnp.ndarray, max_groups: int):
-    """Dense group ids per row (exact, hash-slot based; see module
-    docstring). Returns (ids, perm_first, num_groups, overflow) where
-    perm_first[g] is the row index of the slot-owning member of group g,
-    used to gather representative key values."""
+    """Dense group ids per row (exact). Returns (ids, perm_first,
+    num_groups, overflow) where perm_first[g] is the row index of a
+    representative member of group g, used to gather key values.
+    Dispatches on the static table size (see module docstring)."""
     n = active.shape[0]
     words, _ = key_words(key_cols)
     if not words:  # global aggregation: every active row is group 0
@@ -132,7 +151,114 @@ def _group_ids(key_cols: Sequence[Block], active: jnp.ndarray, max_groups: int):
         perm_first = jnp.zeros(max_groups, dtype=jnp.int32)
         num_groups = jnp.any(active).astype(jnp.int32)
         return ids, perm_first, num_groups, jnp.asarray(False)
+    if max_groups <= _SMALL_G:
+        return _group_ids_small(words, active, max_groups)
+    return _group_ids_hash(words, active, max_groups)
 
+
+def _group_ids_small(words, active: jnp.ndarray, max_groups: int):
+    """First-occurrence extraction (no scatters): each round resolves
+    one whole group -- find the first unresolved row, broadcast its key
+    words, match all equal rows. At most max_groups rounds; leftover
+    unresolved active rows mean >max_groups distinct keys -> overflow
+    (parked in the last slot, invalidated by the rerun)."""
+    n = active.shape[0]
+    rows = jnp.arange(n, dtype=jnp.int32)
+
+    def cond(state):
+        g, ids, _ = state
+        return (g < max_groups) & jnp.any(active & (ids < 0))
+
+    def body(state):
+        g, ids, first = state
+        unres = active & (ids < 0)
+        i = jnp.min(jnp.where(unres, rows, n))
+        i_safe = jnp.clip(i, 0, n - 1)
+        match = unres
+        for w in words:
+            match = match & (w == w[i_safe])
+        ids = jnp.where(match, g, ids)
+        first = first.at[g].set(i_safe)  # single-element scatter: cheap
+        return g + jnp.int32(1), ids, first
+
+    num_groups, ids, perm_first = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), jnp.full(n, -1, dtype=jnp.int32),
+                     jnp.zeros(max_groups, dtype=jnp.int32)))
+    overflow = jnp.any(active & (ids < 0))
+    ids = jnp.where(active & (ids >= 0), ids, max_groups - 1) \
+        .astype(jnp.int32)
+    return ids, perm_first, num_groups, overflow
+
+
+def _limb_matmul_sum(ids, v, max_groups: int, nlimbs: int = 5,
+                     chunk: int = 2048) -> jnp.ndarray:
+    """Exact int64 per-group sums on the MXU: split values into 13-bit
+    limbs (top limb signed), one-hot(ids) @ limbs in f32 over
+    `chunk`-row blocks -- every block-level f32 sum is < 2^24 so f32
+    accumulation is exact -- then combine block partials in int64.
+    `nlimbs=1` covers 0/1 count flags."""
+    n = v.shape[0]
+    c = -(-n // chunk)
+    pad = c * chunk - n
+    i = jnp.pad(ids, (0, pad), constant_values=max_groups)
+    x = jnp.pad(v.astype(jnp.int64), (0, pad))
+    limbs = []
+    rem = x
+    for _ in range(nlimbs - 1):
+        limbs.append((rem & 0x1FFF).astype(jnp.float32))
+        rem = rem >> 13
+    limbs.append(rem.astype(jnp.float32))  # signed top limb
+    lm = jnp.stack(limbs, axis=1).reshape(c, chunk, nlimbs)
+    oh = (i.reshape(c, chunk)[:, :, None]
+          == jnp.arange(max_groups, dtype=jnp.int32)).astype(jnp.float32)
+    part = jnp.einsum("ckg,ckl->cgl", oh, lm,
+                      precision=jax.lax.Precision.HIGHEST,
+                      preferred_element_type=jnp.float32)
+    tot = jnp.sum(part.astype(jnp.int64), axis=0)  # (G, L)
+    scale = jnp.int64(1) << (13 * jnp.arange(nlimbs, dtype=jnp.int64))
+    return jnp.sum(tot * scale[None, :], axis=1)
+
+
+def _seg_add(ids, contrib, max_groups: int) -> jnp.ndarray:
+    """Per-group sum of `contrib` (already masked: dead rows contribute
+    the dtype's zero). Small tables avoid TPU scatter: exact limb
+    matmuls for integers, per-group masked reductions for floats."""
+    if max_groups <= _SMALL_G:
+        if contrib.dtype in (jnp.int64, jnp.int32):
+            return _limb_matmul_sum(ids, contrib, max_groups)
+        zero = jnp.zeros((), dtype=contrib.dtype)
+        return jnp.stack([jnp.sum(jnp.where(ids == g, contrib, zero))
+                          for g in range(max_groups)])
+    return jnp.zeros(max_groups, dtype=contrib.dtype).at[ids].add(contrib)
+
+
+def _seg_count(ids, flags, max_groups: int) -> jnp.ndarray:
+    """Per-group count of True flags (int64)."""
+    if max_groups <= _SMALL_G:
+        return _limb_matmul_sum(ids, flags.astype(jnp.int64), max_groups,
+                                nlimbs=1)
+    return jnp.zeros(max_groups, dtype=jnp.int64).at[ids].add(
+        flags.astype(jnp.int64))
+
+
+def _seg_min(ids, contrib, max_groups: int, ident) -> jnp.ndarray:
+    """Per-group min of `contrib` (dead rows pre-masked to `ident`)."""
+    if max_groups <= _SMALL_G:
+        return jnp.stack([jnp.min(jnp.where(ids == g, contrib, ident))
+                          for g in range(max_groups)])
+    return jnp.full(max_groups, ident, dtype=contrib.dtype).at[ids].min(contrib)
+
+
+def _seg_max(ids, contrib, max_groups: int, ident) -> jnp.ndarray:
+    if max_groups <= _SMALL_G:
+        return jnp.stack([jnp.max(jnp.where(ids == g, contrib, ident))
+                          for g in range(max_groups)])
+    return jnp.full(max_groups, ident, dtype=contrib.dtype).at[ids].max(contrib)
+
+
+def _group_ids_hash(words, active: jnp.ndarray, max_groups: int):
+    """Hash-slot kernel for large tables (see module docstring)."""
+    n = active.shape[0]
     m = max(1024, 1 << int(max(2 * max_groups - 1, 1)).bit_length())
     mask = np.uint64(m - 1)
     h = _hash_words(words)
@@ -234,14 +360,14 @@ def _acc_columns(spec: AggSpec, col: Optional[Block], ids, active, max_groups: i
     g = max_groups
     name = spec.canonical
     if name == "count_star":
-        cnt = jnp.zeros(g, dtype=jnp.int64).at[ids].add(active.astype(jnp.int64))
+        cnt = _seg_count(ids, active, g)
         return [("count", Column(cnt, jnp.zeros(g, dtype=bool), T.BIGINT))]
 
     assert col is not None
     if isinstance(col, DictionaryColumn):
         col = col.decode()
     live = active & ~col.nulls
-    nn = jnp.zeros(g, dtype=jnp.int64).at[ids].add(live.astype(jnp.int64))
+    nn = _seg_count(ids, live, g)
     no_input = nn == 0
 
     if name == "count":
@@ -260,8 +386,7 @@ def _acc_columns(spec: AggSpec, col: Optional[Block], ids, active, max_groups: i
         first, ovf = mark_distinct(sub, [0, 1], max_groups=len(col))
         if overflow_out is not None:
             overflow_out.append(ovf)
-        cnt = jnp.zeros(g, dtype=jnp.int64).at[ids].add(
-            (first & live).astype(jnp.int64))
+        cnt = _seg_count(ids, first & live, g)
         return [("count", Column(cnt, jnp.zeros(g, dtype=bool), T.BIGINT))]
 
     if isinstance(col, StringColumn):
@@ -272,7 +397,7 @@ def _acc_columns(spec: AggSpec, col: Optional[Block], ids, active, max_groups: i
     v = col.values
     if name == "sum" or name == "avg":
         sv = v.astype(_sum_dtype(col.type))
-        s = jnp.zeros(g, dtype=sv.dtype).at[ids].add(jnp.where(live, sv, 0))
+        s = _seg_add(ids, jnp.where(live, sv, sv.dtype.type(0)), g)
         out = [("sum", Column(s, no_input, spec.output_type if name == "sum"
                               else _sum_type(col.type)))]
         if name == "avg":
@@ -280,22 +405,18 @@ def _acc_columns(spec: AggSpec, col: Optional[Block], ids, active, max_groups: i
         return out
     if name == "min":
         ident = _max_ident(v.dtype)
-        m = jnp.full(g, ident, dtype=v.dtype).at[ids].min(
-            jnp.where(live, v, ident))
+        m = _seg_min(ids, jnp.where(live, v, ident), g, ident)
         return [("min", Column(m, no_input, spec.output_type))]
     if name == "max":
         ident = _min_ident(v.dtype)
-        m = jnp.full(g, ident, dtype=v.dtype).at[ids].max(
-            jnp.where(live, v, ident))
+        m = _seg_max(ids, jnp.where(live, v, ident), g, ident)
         return [("max", Column(m, no_input, spec.output_type))]
     if name in ("bool_and", "bool_or"):
         bv = v.astype(jnp.int32)
         if name == "bool_and":
-            m = jnp.ones(g, dtype=jnp.int32).at[ids].min(
-                jnp.where(live, bv, 1))
+            m = _seg_min(ids, jnp.where(live, bv, 1), g, 1)
         else:
-            m = jnp.zeros(g, dtype=jnp.int32).at[ids].max(
-                jnp.where(live, bv, 0))
+            m = _seg_max(ids, jnp.where(live, bv, 0), g, 0)
         return [(name, Column(m.astype(bool), no_input, T.BOOLEAN))]
     if name in ("var_samp", "var_pop", "stddev_samp", "stddev_pop"):
         # (count, sum, sum of squares) in float64; finalization happens in
@@ -304,9 +425,8 @@ def _acc_columns(spec: AggSpec, col: Optional[Block], ids, active, max_groups: i
         if col.type.is_decimal:
             from ..expr.functions import _POW10
             f = f / _POW10[col.type.scale]
-        s = jnp.zeros(g, dtype=jnp.float64).at[ids].add(jnp.where(live, f, 0.0))
-        s2 = jnp.zeros(g, dtype=jnp.float64).at[ids].add(
-            jnp.where(live, f * f, 0.0))
+        s = _seg_add(ids, jnp.where(live, f, 0.0), g)
+        s2 = _seg_add(ids, jnp.where(live, f * f, 0.0), g)
         return [("count", Column(nn, jnp.zeros(g, dtype=bool), T.BIGINT)),
                 ("sum", Column(s, no_input, T.DOUBLE)),
                 ("sumsq", Column(s2, no_input, T.DOUBLE))]
@@ -355,9 +475,8 @@ def _acc_columns(spec: AggSpec, col: Optional[Block], ids, active, max_groups: i
         perm = jax.lax.sort(ops_, num_keys=len(ops_) - 1)[-1]
         pos = jnp.arange(n, dtype=jnp.int64)
         sorted_ids = jnp.where(live[perm], ids[perm], g)
-        start = jnp.full(g, n, dtype=jnp.int64).at[
-            jnp.clip(sorted_ids, 0, g - 1)].min(
-            jnp.where(sorted_ids < g, pos, n))
+        start = _seg_min(jnp.clip(sorted_ids, 0, g - 1),
+                         jnp.where(sorted_ids < g, pos, n), g, n)
         target = start + jnp.floor((nn - 1).astype(jnp.float64) * p).astype(jnp.int64)
         target = jnp.clip(target, 0, n - 1)
         rows_sel = perm[target]
@@ -370,6 +489,19 @@ def _argbest(order_words: List[jnp.ndarray], ids, live, g, minimize: bool):
     """Row index of the min (or max) order-key per group; ties -> lowest
     row. Returns g-length int array; n (out of range) when group empty."""
     n = live.shape[0]
+    if g <= _SMALL_G:
+        # per-group masked lexicographic reduction (no scatters)
+        full = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+        rows = jnp.arange(n, dtype=jnp.int64)
+        out = []
+        for k in range(g):
+            rem = live & (ids == k)
+            for wk in order_words:
+                sel = jnp.where(rem, wk, full if minimize else jnp.uint64(0))
+                best = jnp.min(sel) if minimize else jnp.max(sel)
+                rem = rem & (wk == best)
+            out.append(jnp.min(jnp.where(rem, rows, n)))
+        return jnp.stack(out)
     remaining = live
     w_prev = None
     best_prev = None
@@ -406,45 +538,15 @@ def _min_ident(dt):
 
 
 def _minmax_string(col: StringColumn, ids, live, g, spec):
-    """min/max over strings: reduce via per-group scatter-min/max over the
-    packed big-endian words, then gather the winning row's chars. Uses an
-    argmin-by-(word, rowid) trick per word chunk -- exact for widths
-    <= 8 bytes; wider strings fall back to iterative refinement."""
+    """min/max over strings: per-group lexicographic argbest over the
+    packed big-endian key words, then gather the winning row's chars
+    (small tables reduce per group, large tables scatter-min/max with
+    iterative tie refinement -- both inside _argbest)."""
     from .keys import _string_words
     words = _string_words(col)
     n = col.chars.shape[0]
-    # combine words with row index to make a total order, then scatter-min
-    # (or max) the packed (word_chain..., row) tuple; for practicality we
-    # reduce on the first word and tie-break iteratively.
-    best_row = None
-    remaining = live
-    # single-chunk fast path covers <=8-byte strings exactly
-    w0 = words[0]
-    if spec.name == "min":
-        sel = jnp.where(remaining, w0, jnp.uint64(0xFFFFFFFFFFFFFFFF))
-        best_w = jnp.full(g, np.uint64(0xFFFFFFFFFFFFFFFF), dtype=jnp.uint64).at[ids].min(sel)
-    else:
-        sel = jnp.where(remaining, w0, jnp.uint64(0))
-        best_w = jnp.zeros(g, dtype=jnp.uint64).at[ids].max(sel)
-    if len(words) > 1:
-        # refine ties on subsequent chunks
-        for wk in words[1:]:
-            tie = remaining & (w0 == best_w[ids])
-            if spec.name == "min":
-                selk = jnp.where(tie, wk, jnp.uint64(0xFFFFFFFFFFFFFFFF))
-                bk = jnp.full(g, np.uint64(0xFFFFFFFFFFFFFFFF), dtype=jnp.uint64).at[ids].min(selk)
-            else:
-                selk = jnp.where(tie, wk, jnp.uint64(0))
-                bk = jnp.zeros(g, dtype=jnp.uint64).at[ids].max(selk)
-            remaining = tie & (wk == bk[ids])
-            w0 = wk
-            best_w = bk
-        winners = remaining
-    else:
-        winners = remaining & (w0 == best_w[ids])
-    # pick the first winning row id per group
-    row_sel = jnp.where(winners, jnp.arange(n, dtype=jnp.int32), n)
-    best_row = jnp.full(g, n, dtype=jnp.int32).at[ids].min(row_sel)
+    best_row = _argbest(words, ids, live, g,
+                        minimize=(spec.name == "min"))
     valid = best_row < n
     idx = jnp.clip(best_row, 0, n - 1)
     return [(spec.name,

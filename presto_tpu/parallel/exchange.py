@@ -140,10 +140,10 @@ def exchange_by_range(batch: Batch, sort_keys, axis_name: str,
     # draw evenly spaced samples from the locally ordered active rows
     act_word = jnp.where(batch.active, jnp.uint64(0), jnp.uint64(1))
     local_sorted = jax.lax.sort([act_word] + words, num_keys=1 + nw)[1:]
-    count = jnp.sum(batch.active.astype(jnp.int32))
+    count = jnp.sum(batch.active.astype(jnp.int64))
     s = samples_per_worker
-    pos = ((jnp.arange(s, dtype=jnp.int32) * 2 + 1) * count) // (2 * s)
-    pos = jnp.clip(pos, 0, cap - 1)
+    pos = ((jnp.arange(s, dtype=jnp.int64) * 2 + 1) * count) // (2 * s)
+    pos = jnp.clip(pos, 0, cap - 1).astype(jnp.int32)
     full = jnp.uint64(0xFFFFFFFFFFFFFFFF)
     samp = [jnp.where(count > 0, w[pos], full) for w in local_sorted]
 
@@ -152,7 +152,7 @@ def exchange_by_range(batch: Batch, sort_keys, axis_name: str,
     gathered = [jax.lax.all_gather(w, axis_name, axis=0, tiled=True)
                 for w in samp]
     gsorted = jax.lax.sort(gathered, num_keys=nw)
-    spos = jnp.array([(j * n * s) // n for j in range(1, n)], dtype=jnp.int32)
+    spos = jnp.arange(s, n * s, s, dtype=jnp.int32)  # (n-1,) quantiles
     splitters = [w[spos] for w in gsorted]  # each (n-1,)
 
     # dest = #splitters <= row, compared lexicographically word by word
