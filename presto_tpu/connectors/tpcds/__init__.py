@@ -1,8 +1,9 @@
 from .generator import (TPCDS_SCHEMA, table_row_count, generate_columns,
                         generate_batch, column_type)
+from .stats import column_distinct_count
 
 __all__ = ["TPCDS_SCHEMA", "table_row_count", "generate_columns",
-           "generate_batch", "column_type"]
+           "generate_batch", "column_type", "column_distinct_count"]
 
 SCHEMA = TPCDS_SCHEMA  # uniform connector-registry surface
 __all__ = __all__ + ["SCHEMA"]

@@ -85,6 +85,11 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     multiple of the mesh size and the plan runs SPMD. With `split_rows`,
     streamable aggregation plans execute split-by-split with bounded
     HBM (exec/streaming.py)."""
+    # capacity refinement (CBO stats): shrink group tables to the
+    # connector-proven NDV bound so group-by rides the scatter-free
+    # small-table kernels wherever statistics allow
+    from ..plan.stats import refine_capacities
+    root = refine_capacities(root, sf)
     if mesh is not None:
         # make the plan SPMD-correct: single-node operators get the
         # exchanges they need (AddExchanges; idempotent for plans that
@@ -94,10 +99,13 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
         # round 1, CBO pending)
         from ..plan.distribute import add_exchanges
         strategy = "broadcast"
-        if session is not None and \
-                session.get("join_distribution_type") == "PARTITIONED":
-            strategy = "partitioned"
-        root = add_exchanges(root, join_strategy=strategy)
+        if session is not None:
+            jd = session.get("join_distribution_type")
+            if jd == "PARTITIONED":
+                strategy = "partitioned"
+            elif jd == "AUTOMATIC":
+                strategy = "automatic"
+        root = add_exchanges(root, join_strategy=strategy, sf=sf)
     from ..plan.validator import validate_plan
     violations = validate_plan(root, distributed=mesh is not None)
     if violations:

@@ -79,17 +79,28 @@ def _is_remote_exchange(node: N.PlanNode) -> bool:
 _ORDER_TRANSPARENT = (N.ProjectNode, N.OutputNode)
 
 
+# AUTOMATIC: build sides estimated at or below this many rows broadcast;
+# larger builds repartition both sides (the reference's
+# join-max-broadcast-table-size knob, expressed in rows because the
+# engine's capacities are row-static)
+_BROADCAST_ROW_LIMIT = 1 << 20
+
+
 def add_exchanges(node: N.PlanNode,
-                  join_strategy: str = "broadcast") -> N.PlanNode:
+                  join_strategy: str = "broadcast",
+                  sf: float = None) -> N.PlanNode:
     """join_strategy: "broadcast" replicates every build side (the safe
     default); "partitioned" repartitions BOTH join sides by the join
     keys (DetermineJoinDistributionType's PARTITIONED choice -- right
-    for large builds; cost-based selection is a ROADMAP item)."""
-    return _visit(node, join_strategy, order_root=True, under=None)
+    for large builds); "automatic" decides per join from connector
+    statistics (DetermineJoinDistributionType.java's AUTOMATIC with a
+    row-count cost model) and needs `sf` for the row estimates --
+    without it, unknown-size builds fall back to broadcast."""
+    return _visit(node, join_strategy, order_root=True, under=None, sf=sf)
 
 
 def _visit(node: N.PlanNode, join_strategy: str, order_root: bool,
-           under) -> N.PlanNode:
+           under, sf=None) -> N.PlanNode:
     """`order_root`: this node's output order is observable at the plan
     root (only Project/Output ancestors). `under`: the exchange kind
     directly above, so already-distributed partials (the local Sort of a
@@ -103,11 +114,11 @@ def _visit(node: N.PlanNode, join_strategy: str, order_root: bool,
         child_under = node.kind if isinstance(node, N.ExchangeNode) \
             and node.scope == "REMOTE" else None
         if isinstance(v, N.PlanNode):
-            nv = _visit(v, join_strategy, child_order, child_under)
+            nv = _visit(v, join_strategy, child_order, child_under, sf)
             if nv is not v:
                 replaced[f.name] = nv
         elif isinstance(v, list) and v and isinstance(v[0], N.PlanNode):
-            nl = [_visit(s, join_strategy, child_order, child_under)
+            nl = [_visit(s, join_strategy, child_order, child_under, sf)
                   for s in v]
             if any(a is not b for a, b in zip(nl, v)):
                 replaced[f.name] = nl
@@ -183,7 +194,19 @@ def _visit(node: N.PlanNode, join_strategy: str, order_root: bool,
         return _dc.replace(node, source=ex)
 
     if isinstance(node, N.JoinNode):
-        if join_strategy == "partitioned":
+        strategy = join_strategy
+        if strategy == "automatic":
+            # cost model: broadcast only when the build side is provably
+            # small (its replicated copy must fit every worker); unknown
+            # sizes (or no sf to cost with) default to broadcast,
+            # matching the pre-CBO behavior
+            strategy = "broadcast"
+            if sf is not None:
+                from .stats import estimate_rows
+                build = estimate_rows(node.right, sf)
+                if build is not None and build > _BROADCAST_ROW_LIMIT:
+                    strategy = "partitioned"
+        if strategy == "partitioned":
             # repartition BOTH sides by the join keys: consumers then see
             # co-partitioned inputs and join locally (the large-build
             # PARTITIONED distribution). An existing exchange is reused

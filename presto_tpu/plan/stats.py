@@ -1,0 +1,215 @@
+"""Plan statistics: column provenance, NDV and row estimates.
+
+Reference surface: the cost/stats stack --
+presto-main-base/.../cost/StatsCalculator.java (per-PlanNode stats
+propagation), cost/CostCalculatorUsingExchanges.java, and the connector
+statistics providers (TpchMetadata.getTableStatistics). This is the
+deliberately small TPU-engine version: statistics answer exactly the
+questions the physical planner asks --
+
+  * how many distinct groups can this GROUP BY produce?  (sizes the
+    static group table; small tables unlock the scatter-free MXU
+    kernels in ops/aggregation.py)
+  * roughly how many rows feed this join side?  (broadcast vs
+    partitioned distribution)
+
+NDV answers are UPPER BOUNDS (connector contract), so capacities sized
+from them cannot overflow. Row estimates are heuristic (filters taken
+at face value x selectivity guess) and are only used for relative
+cost choices, never for capacities.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ..expr import ir as E
+from . import nodes as N
+
+__all__ = ["column_source", "estimate_distinct", "estimate_group_bound",
+           "estimate_rows", "refine_capacities"]
+
+# guessed fraction of rows surviving one filter conjunct (Presto's
+# UNKNOWN_FILTER_COEFFICIENT analog, FilterStatsCalculator.java)
+_FILTER_SELECTIVITY = 0.33
+
+
+def column_source(node: N.PlanNode, channel: int
+                  ) -> Optional[Tuple[str, str, str]]:
+    """Trace an output channel to its originating base-table column:
+    (connector, table, column), or None when the channel is computed
+    (expressions, aggregates) or crosses an un-traceable operator."""
+    if isinstance(node, N.TableScanNode):
+        if 0 <= channel < len(node.columns):
+            return (node.connector, node.table, node.columns[channel])
+        return None
+    if isinstance(node, N.ProjectNode):
+        e = node.expressions[channel] \
+            if 0 <= channel < len(node.expressions) else None
+        if isinstance(e, E.InputReference):
+            return column_source(node.source, e.channel)
+        return None
+    if isinstance(node, (N.FilterNode, N.SortNode, N.TopNNode, N.LimitNode,
+                         N.DistinctNode, N.SampleNode, N.ExchangeNode,
+                         N.OutputNode)):
+        return column_source(node.sources[0], channel)
+    if isinstance(node, N.JoinNode):
+        nleft = len(node.left.output_types())
+        if channel < nleft:
+            return column_source(node.left, channel)
+        rch = channel - nleft
+        out = node.right_output_channels
+        if out is not None:
+            if 0 <= rch < len(out):
+                rch = out[rch]
+            else:
+                return None
+        return column_source(node.right, rch)
+    if isinstance(node, N.SemiJoinNode):
+        n_src = len(node.source.output_types())
+        if channel < n_src:
+            return column_source(node.source, channel)
+        return None  # the appended membership mask
+    if isinstance(node, N.AggregationNode):
+        # group-key channels pass the source column through (so a FINAL
+        # step traces through its PARTIAL's keys); state channels do not
+        if 0 <= channel < len(node.group_channels):
+            return column_source(node.source, node.group_channels[channel])
+        return None
+    if isinstance(node, (N.WindowNode, N.RowNumberNode, N.MarkDistinctNode,
+                         N.AssignUniqueIdNode)):
+        n_src = len(node.sources[0].output_types())
+        if channel < n_src:
+            return column_source(node.sources[0], channel)
+        return None  # appended function outputs
+    return None
+
+
+def estimate_distinct(node: N.PlanNode, channel: int,
+                      sf: float) -> Optional[int]:
+    """Distinct-count upper bound for one output channel, from the
+    originating connector's statistics."""
+    src = column_source(node, channel)
+    if src is None:
+        return None
+    connector, table, column = src
+    from ..connectors import catalog
+    mod = catalog(connector)
+    fn = getattr(mod, "column_distinct_count", None)
+    if fn is None:
+        return None
+    try:
+        return fn(table, column, sf)
+    except KeyError:
+        return None
+
+
+def estimate_group_bound(node: N.PlanNode, channels, sf: float,
+                         nullable_slack: int = 1) -> Optional[int]:
+    """Upper bound on distinct key TUPLES over `channels` (product of
+    per-channel bounds, +nullable_slack per channel for a possible NULL
+    group). None when any channel is unbounded."""
+    bound = 1
+    for ch in channels:
+        ndv = estimate_distinct(node, ch, sf)
+        if ndv is None:
+            return None
+        bound *= ndv + nullable_slack
+        if bound > 1 << 30:  # stop multiplying into the void
+            return None
+    return bound
+
+
+def refine_capacities(node: N.PlanNode, sf: float) -> N.PlanNode:
+    """Physical-capacity pass (run at execution time, when sf is known):
+    SHRINK group-table capacities to the NDV bound the connector proves.
+    Small tables route group-by to the scatter-free MXU kernels
+    (ops/aggregation.py _SMALL_G), which measured ~500x faster than the
+    scatter path on TPU. Bounds are upper bounds, so shrinking can never
+    cause overflow; capacities are never grown (a user's explicit small
+    max_groups stays authoritative, and an explicit large one only
+    shrinks when the connector PROVES fewer groups are possible)."""
+    import dataclasses as _dc
+
+    replaced = {}
+    for f in _dc.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, N.PlanNode):
+            nv = refine_capacities(v, sf)
+            if nv is not v:
+                replaced[f.name] = nv
+        elif isinstance(v, list) and v and isinstance(v[0], N.PlanNode):
+            nl = [refine_capacities(s, sf) for s in v]
+            if any(a is not b for a, b in zip(nl, v)):
+                replaced[f.name] = nl
+    if replaced:
+        node = _dc.replace(node, **replaced)
+
+    if isinstance(node, N.AggregationNode) and node.group_channels:
+        bound = estimate_group_bound(node.source, node.group_channels, sf)
+        if bound is not None:
+            cap = max(-(-bound // 8) * 8, 8)
+            if cap < node.max_groups:
+                node = _dc.replace(node, max_groups=cap)
+    elif isinstance(node, N.DistinctNode) and node.key_channels is not None:
+        bound = estimate_group_bound(node.source, node.key_channels, sf)
+        if bound is not None:
+            cap = max(-(-bound // 8) * 8, 8)
+            if cap < node.max_groups:
+                node = _dc.replace(node, max_groups=cap)
+    return node
+
+
+def estimate_rows(node: N.PlanNode, sf: float) -> Optional[float]:
+    """Heuristic output-row estimate, for relative cost choices only."""
+    if isinstance(node, N.TableScanNode):
+        from ..connectors import catalog
+        try:
+            return float(catalog(node.connector)
+                         .table_row_count(node.table, sf))
+        except Exception:  # noqa: BLE001 - unknown table
+            return None
+    if isinstance(node, N.ValuesNode):
+        return float(len(node.rows))
+    if isinstance(node, N.FilterNode):
+        r = estimate_rows(node.source, sf)
+        return None if r is None else r * _FILTER_SELECTIVITY
+    if isinstance(node, N.SemiJoinNode):
+        r = estimate_rows(node.source, sf)
+        return r  # mask append; filtering happens in a FilterNode above
+    if isinstance(node, N.JoinNode):
+        left = estimate_rows(node.left, sf)
+        right = estimate_rows(node.right, sf)
+        if left is None or right is None:
+            return None
+        # equi-join fan-out guess: the larger side survives (the
+        # PK-FK common case); outer joins keep at least the outer side
+        return max(left, right)
+    if isinstance(node, N.AggregationNode):
+        r = estimate_rows(node.source, sf)
+        bound = estimate_group_bound(node.source, node.group_channels, sf)
+        if not node.group_channels:
+            return 1.0
+        if bound is not None and r is not None:
+            return float(min(r, bound))
+        return r
+    if isinstance(node, N.DistinctNode):
+        return estimate_rows(node.source, sf)
+    if isinstance(node, (N.TopNNode, N.LimitNode)):
+        r = estimate_rows(node.sources[0], sf)
+        cnt = float(node.count)
+        return cnt if r is None else min(r, cnt)
+    if isinstance(node, N.UnionNode):
+        parts = [estimate_rows(s, sf) for s in node.inputs]
+        if any(p is None for p in parts):
+            return None
+        return sum(parts)
+    if isinstance(node, N.UnnestNode):
+        r = estimate_rows(node.source, sf)
+        return None if r is None else r * 4.0
+    if isinstance(node, N.SampleNode):
+        r = estimate_rows(node.source, sf)
+        return None if r is None else r * node.ratio
+    if node.sources:
+        return estimate_rows(node.sources[0], sf)
+    return None

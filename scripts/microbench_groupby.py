@@ -124,12 +124,14 @@ def main():
     from presto_tpu.block import Column
     from presto_tpu import types as T
     col = Column(v, jnp.zeros(N, dtype=bool), T.BIGINT)
+    # inputs passed as jit ARGUMENTS (not closure constants) so XLA
+    # cannot constant-fold any of the kernel away
     timeit("hash-slot _group_ids (1 int64 col)",
-           lambda: _group_ids([col], active, G))
+           lambda c, a: _group_ids([c], a, G), col, active)
 
     from presto_tpu.ops.aggregation import _group_ids_sort
     timeit("sort-based _group_ids (1 int64 col)",
-           lambda: _group_ids_sort([col], active, G))
+           lambda c, a: _group_ids_sort([c], a, G), col, active)
 
     def first_occurrence_ids(words, act):
         """Candidate small-G id kernel: iteratively extract the first
