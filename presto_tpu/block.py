@@ -40,8 +40,8 @@ import numpy as np
 
 from . import types as T
 
-__all__ = ["Column", "StringColumn", "DictionaryColumn", "Batch",
-           "Block", "from_numpy", "to_numpy", "concat_batches"]
+__all__ = ["Column", "StringColumn", "DictionaryColumn", "Int128Column",
+           "Batch", "Block", "from_numpy", "to_numpy", "concat_batches"]
 
 
 def _register(cls, data_fields, meta_fields):
@@ -156,7 +156,31 @@ class ArrayColumn:
 
 _register(ArrayColumn, ["elements", "elem_nulls", "lengths", "nulls"], ["type"])
 
-Block = Union[Column, StringColumn, DictionaryColumn, ArrayColumn]
+
+@dataclasses.dataclass
+class Int128Column:
+    """Long-decimal lanes (Int128ArrayBlock / Decimals.java analog):
+    value = hi * 2^64 + lo in two's complement, stored SoA (two flat
+    64-bit lanes) so every op stays a plain VPU elementwise op -- see
+    int128.py for the arithmetic."""
+
+    hi: jax.Array   # int64
+    lo: jax.Array   # uint64
+    nulls: jax.Array
+    type: T.Type = dataclasses.field(metadata=dict(static=True))
+
+    def __len__(self):
+        return self.hi.shape[0]
+
+    @property
+    def capacity(self) -> int:
+        return self.hi.shape[0]
+
+
+_register(Int128Column, ["hi", "lo", "nulls"], ["type"])
+
+Block = Union[Column, StringColumn, DictionaryColumn, ArrayColumn,
+              Int128Column]
 
 
 @dataclasses.dataclass
@@ -270,6 +294,18 @@ def from_numpy(ty: T.Type, values: np.ndarray, nulls: Optional[np.ndarray] = Non
         return StringColumn(jnp.asarray(_pad(values, capacity)),
                             jnp.asarray(_pad(lengths, capacity)),
                             jnp.asarray(nulls), ty)
+    if ty.is_decimal and not ty.is_short_decimal:
+        # long decimals stage as 128-bit lane pairs (Int128Column); host
+        # values arrive as Python ints (exact) or any int64-safe array
+        from .int128 import python_to_int128
+        if values.dtype == object:
+            hi, lo = python_to_int128(list(values))
+        else:
+            v = np.asarray(values, dtype=np.int64)
+            hi, lo = (v >> 63).astype(np.int64), v.astype(np.uint64)
+        return Int128Column(jnp.asarray(_pad(hi, capacity)),
+                            jnp.asarray(_pad(lo, capacity)),
+                            jnp.asarray(nulls), ty)
     values = _pad(np.asarray(values, dtype=ty.to_dtype()), capacity)
     return Column(jnp.asarray(values), jnp.asarray(nulls), ty)
 
@@ -308,6 +344,10 @@ def to_numpy(block: Block) -> Tuple[np.ndarray, np.ndarray]:
         vals = np.array([chars[i, : lengths[i]].tobytes().decode("utf-8", "replace")
                          for i in range(chars.shape[0])], dtype=object)
         return vals, np.asarray(block.nulls)
+    if isinstance(block, Int128Column):
+        from .int128 import int128_to_python
+        vals = int128_to_python(np.asarray(block.hi), np.asarray(block.lo))
+        return vals, np.asarray(block.nulls)
     return np.asarray(block.values), np.asarray(block.nulls)
 
 
@@ -337,6 +377,11 @@ def gather_block(b: Block, idx: jax.Array, valid: Optional[jax.Array] = None
             nulls = jnp.where(valid, nulls, True)
         return ArrayColumn(b.elements[idx], b.elem_nulls[idx], lengths,
                            nulls, b.type)
+    if isinstance(b, Int128Column):
+        nulls = b.nulls[idx]
+        if valid is not None:
+            nulls = jnp.where(valid, nulls, True)
+        return Int128Column(b.hi[idx], b.lo[idx], nulls, b.type)
     nulls = b.nulls[idx]
     if valid is not None:
         nulls = jnp.where(valid, nulls, True)
@@ -358,6 +403,11 @@ def concat_batches(batches: Sequence[Batch]) -> Batch:
                                      jnp.concatenate([b.lengths for b in blocks]),
                                      jnp.concatenate([b.nulls for b in blocks]),
                                      b0.type))
+        elif isinstance(b0, Int128Column):
+            cols.append(Int128Column(
+                jnp.concatenate([b.hi for b in blocks]),
+                jnp.concatenate([b.lo for b in blocks]),
+                jnp.concatenate([b.nulls for b in blocks]), b0.type))
         else:
             cols.append(Column(jnp.concatenate([b.values for b in blocks]),
                                jnp.concatenate([b.nulls for b in blocks]), b0.type))

@@ -53,7 +53,7 @@ def _scan_batch(node: N.PlanNode, sf: float, capacity_hint: Optional[int],
         arrays = []
         for ci, ty in enumerate(node.types):
             col = [r[ci] for r in node.rows]
-            if ty.is_string:
+            if ty.is_string or (ty.is_decimal and not ty.is_short_decimal):
                 arrays.append(np.array(col, dtype=object))
             else:
                 arrays.append(np.array(col, dtype=ty.to_dtype()))

@@ -261,7 +261,11 @@ def evaluate(expr: RowExpression, batch: Batch) -> Block:
             if isinstance(out, StringColumn):
                 out = StringColumn(out.chars, out.lengths, nulls, out.type)
             else:
-                out = Column(out.values, nulls, out.type)
+                from ..block import Int128Column
+                if isinstance(out, Int128Column):
+                    out = Int128Column(out.hi, out.lo, nulls, out.type)
+                else:
+                    out = Column(out.values, nulls, out.type)
         return out
 
     raise TypeError(f"cannot evaluate {type(expr)}")
@@ -382,6 +386,15 @@ def _eval_special(expr: SpecialForm, batch: Batch) -> Block:
 
 def _select(take_a, a: Block, b: Block, ty: T.Type) -> Block:
     """Lane-select between two blocks of the same logical type."""
+    from ..block import Int128Column
+    if isinstance(a, Int128Column) or isinstance(b, Int128Column):
+        # mixed representations happen (a long-decimal branch vs an
+        # int64-lane literal of the same type): widen both to 128
+        ah, al = F._as128(a)
+        bh, bl = F._as128(b)
+        return Int128Column(jnp.where(take_a, ah, bh),
+                            jnp.where(take_a, al, bl),
+                            jnp.where(take_a, a.nulls, b.nulls), ty)
     if isinstance(a, StringColumn) or isinstance(b, StringColumn):
         w = max(a.max_len, b.max_len)
         ca = jnp.pad(a.chars, ((0, 0), (0, w - a.max_len)))

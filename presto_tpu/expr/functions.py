@@ -13,11 +13,14 @@ only compute value lanes and must keep lanes finite/in-domain under
 nulls so masked garbage never poisons downstream reductions. Functions
 with non-default null behavior set `null_fn`.
 
-Decimal arithmetic follows Presto's short-decimal rules with results
-held in int64: add/subtract rescale to max scale, multiply adds scales,
-divide rescales the dividend (round-half-up like the reference).
-Precisions that exceed 18 keep int64 device representation in round 1
-(documented overflow risk; int128 lanes are a planned Pallas kernel).
+Decimal arithmetic follows Presto's rules: add/subtract rescale to max
+scale, multiply adds scales, divide rescales the dividend
+(round-half-up like the reference). Short decimals (precision <= 18)
+live in int64 lanes; LONG decimals (19..38) compute in exact 128-bit
+(hi, lo) lane pairs (int128.py, the Int128ArrayBlock /
+UnscaledDecimal128Arithmetic analog) -- results arrive as Int128Column
+and every consumer (compare, sort, group, hash, serde) dispatches on
+the representation.
 """
 
 from __future__ import annotations
@@ -30,9 +33,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import types as T
-from ..block import Column, StringColumn
+from ..block import Column, Int128Column, StringColumn
+from .. import int128 as I128
 
-Block = Union[Column, StringColumn]
+Block = Union[Column, StringColumn, Int128Column]
 _T_UNKNOWN = T.UNKNOWN
 
 __all__ = ["ScalarFunction", "REGISTRY", "register", "lookup",
@@ -96,12 +100,45 @@ def _scale_of(ty: T.Type) -> int:
     return ty.scale if ty.is_decimal else 0
 
 
+def _is_long_decimal(ty: T.Type) -> bool:
+    return ty.is_decimal and not ty.is_short_decimal
+
+
+def _any128(*blocks) -> bool:
+    return any(isinstance(b, Int128Column) for b in blocks)
+
+
+def _as128(b) -> tuple:
+    """(hi, lo) lanes of a numeric block at ITS OWN scale."""
+    if isinstance(b, Int128Column):
+        return b.hi, b.lo
+    return I128.from_int64(b.values.astype(jnp.int64))
+
+
+def _as128_at_scale(b, to_scale: int) -> tuple:
+    s = _scale_of(b.type)
+    hi, lo = _as128(b)
+    if to_scale > s:
+        hi, lo = I128.rescale128_up(hi, lo, 10 ** (to_scale - s))
+    elif to_scale < s:
+        raise NotImplementedError("long-decimal downscale (round)")
+    return hi, lo
+
+
 def _promote(ret_type: T.Type, *blocks: Column):
     """Bring numeric args to the ret_type's representation: decimals to
     ret scale, everything to ret dtype family."""
     out = []
     rd = jnp.dtype(ret_type.to_dtype())
     for b in blocks:
+        if isinstance(b, Int128Column):
+            if ret_type.is_floating:
+                f = (b.hi.astype(jnp.float64) * np.float64(2.0 ** 64)
+                     + b.lo.astype(jnp.float64))
+                out.append(f / _POW10[_scale_of(b.type)])
+                continue
+            raise NotImplementedError(
+                f"long-decimal lanes cannot promote to {ret_type}")
         v = b.values
         if ret_type.is_decimal:
             if b.type.is_decimal or b.type.is_integral:
@@ -126,14 +163,30 @@ def _promote(ret_type: T.Type, *blocks: Column):
 # arithmetic
 # ---------------------------------------------------------------------------
 
+def _needs128(ret, *blocks) -> bool:
+    """Long-decimal result or any 128-bit-lane argument routes an
+    arithmetic op to the exact 128-bit path."""
+    return (ret.is_decimal and _is_long_decimal(ret)) or _any128(*blocks)
+
+
 @register("add")
 def _add(ret, a, b):
+    if ret.is_decimal and _needs128(ret, a, b):
+        ah, al = _as128_at_scale(a, ret.scale)
+        bh, bl = _as128_at_scale(b, ret.scale)
+        hi, lo = I128.add128(ah, al, bh, bl)
+        return Int128Column(hi, lo, _default_nulls(a, b), ret)
     x, y = _promote(ret, a, b)
     return _col(ret, x + y, a, b)
 
 
 @register("subtract")
 def _subtract(ret, a, b):
+    if ret.is_decimal and _needs128(ret, a, b):
+        ah, al = _as128_at_scale(a, ret.scale)
+        bh, bl = _as128_at_scale(b, ret.scale)
+        hi, lo = I128.add128(ah, al, *I128.neg128(bh, bl))
+        return Int128Column(hi, lo, _default_nulls(a, b), ret)
     x, y = _promote(ret, a, b)
     return _col(ret, x - y, a, b)
 
@@ -144,13 +197,30 @@ def _multiply(ret, a, b):
         # multiply: scale_out = s1 + s2; operate on raw scaled ints
         assert _scale_of(a.type) + _scale_of(b.type) == ret.scale, \
             (a.type, b.type, ret)
+        if _needs128(ret, a, b):
+            # exact 128-bit product (decimal(38) domain); int64-lane
+            # inputs widen through the signed 64x64 -> 128 multiply
+            if not _any128(a, b):
+                hi, lo = I128.mul_i64_i64_128(
+                    a.values.astype(jnp.int64), b.values.astype(jnp.int64))
+            else:
+                ah, al = _as128(a)
+                bh, bl = _as128(b)
+                hi, lo = I128.mul128(ah, al, bh, bl)
+            return Int128Column(hi, lo, _default_nulls(a, b), ret)
         return _col(ret, a.values.astype(jnp.int64) * b.values.astype(jnp.int64), a, b)
     x, y = _promote(ret, a, b)
     return _col(ret, x * y, a, b)
 
 
+def _zero_lanes(b):
+    if isinstance(b, Int128Column):
+        return (b.hi == 0) & (b.lo == jnp.uint64(0))
+    return b.values == 0
+
+
 def _div_nulls(ret, a, b):
-    zero = (b.values == 0) & ~b.nulls
+    zero = _zero_lanes(b) & ~b.nulls
     return _default_nulls(a, b) | zero
 
 
@@ -160,6 +230,10 @@ def _divide(ret, a, b):
     a jit'd kernel cannot throw -- task-level checking arrives with the
     error-channel in exec)."""
     nulls = _div_nulls(ret, a, b)
+    if ret.is_decimal and (_needs128(ret, a, b) or
+                           _scale_of(b.type) + ret.scale - _scale_of(a.type)
+                           > 18):
+        return _divide128(ret, a, b, nulls)
     if ret.is_decimal:
         sa, sb = _scale_of(a.type), _scale_of(b.type)
         # presto: rescale dividend by 10^(s_out + s_b - s_a), round half away
@@ -180,6 +254,41 @@ def _divide(ret, a, b):
     return Column(x / y, nulls, ret)
 
 
+def _divide128(ret, a, b, nulls):
+    """Exact long-decimal division, round half away from zero. The
+    divisor must fit 64-bit lanes (|b| < 2^63 -- covers counts and every
+    short-decimal divisor; a 128/128 division would need the full
+    Knuth-D loop and no engine query shape produces one yet)."""
+    sa, sb = _scale_of(a.type), _scale_of(b.type)
+    ah, al = _as128(a)
+    factor = 10 ** (ret.scale + sb - sa)
+    if factor > 1:
+        ah, al = I128.rescale128_up(ah, al, factor)
+    if isinstance(b, Int128Column):
+        bv = b.lo.astype(jnp.int64)  # valid when |b| < 2^63
+        bneg = b.hi < 0
+        bv = jnp.where(bneg, -bv, bv)  # magnitude (64-bit divisors only)
+    else:
+        bv = b.values.astype(jnp.int64)
+        bneg = bv < 0
+        bv = jnp.where(bneg, -bv, bv)
+    bv = jnp.where(bv == 0, 1, bv)
+    aneg = ah < 0
+    mh, ml = I128.neg128(ah, al)
+    mh = jnp.where(aneg, mh, ah)
+    ml = jnp.where(aneg, ml, al)
+    qh, ql, rem = I128.divmod128_by_u64(mh, ml, bv)
+    half_up = (2 * rem >= bv.astype(jnp.uint64)).astype(jnp.int64)
+    qh2, ql2 = I128.add128(qh.astype(jnp.int64), ql,
+                           jnp.zeros_like(qh, dtype=jnp.int64),
+                           half_up.astype(jnp.uint64))
+    neg = aneg != bneg
+    nh, nl = I128.neg128(qh2, ql2)
+    hi = jnp.where(neg, nh, qh2)
+    lo = jnp.where(neg, nl, ql2)
+    return Int128Column(hi, lo, nulls, ret)
+
+
 @register("modulus", null_fn=_div_nulls)
 def _modulus(ret, a, b):
     x, y = _promote(ret, a, b)
@@ -190,11 +299,19 @@ def _modulus(ret, a, b):
 
 @register("negate")
 def _negate(ret, a):
+    if isinstance(a, Int128Column):
+        hi, lo = I128.neg128(a.hi, a.lo)
+        return Int128Column(hi, lo, a.nulls, ret)
     return _col(ret, -a.values, a)
 
 
 @register("abs")
 def _abs(ret, a):
+    if isinstance(a, Int128Column):
+        nh, nl = I128.neg128(a.hi, a.lo)
+        neg = a.hi < 0
+        return Int128Column(jnp.where(neg, nh, a.hi),
+                            jnp.where(neg, nl, a.lo), a.nulls, ret)
     return _col(ret, jnp.abs(a.values), a)
 
 
@@ -251,6 +368,14 @@ def _binary_cmp(op):
             else:
                 d = _str_cmp(a, b)
                 v = {"lt": d < 0, "le": d <= 0, "gt": d > 0, "ge": d >= 0}[op]
+            return _col(ret, v, a, b)
+        if _any128(a, b):
+            s = max(_scale_of(a.type), _scale_of(b.type))
+            ah, al = _as128_at_scale(a, s)
+            bh, bl = _as128_at_scale(b, s)
+            lt, eq = I128.cmp128(ah, al, bh, bl)
+            v = {"eq": eq, "ne": ~eq, "lt": lt, "le": lt | eq,
+                 "gt": ~(lt | eq), "ge": ~lt}[op]
             return _col(ret, v, a, b)
         x, y = _cmp_values(a, b)
         v = {"eq": x == y, "ne": x != y, "lt": x < y,
@@ -833,6 +958,26 @@ def _try_cast(ret, a):
 @register("cast")
 def _cast(ret, a):
     ft = a.type
+    if isinstance(a, Int128Column):
+        # long decimal -> double / integral / decimal (exact where the
+        # target can hold it; double conversion rounds like the
+        # reference's Int128 -> double path)
+        f = a.hi.astype(jnp.float64) * (2.0 ** 64) + a.lo.astype(jnp.float64)
+        if ret.is_floating:
+            return _col(ret, f / _POW10[ft.scale], a)
+        if ret.is_decimal and _is_long_decimal(ret):
+            if ret.scale >= ft.scale:
+                hi, lo = I128.rescale128_up(a.hi, a.lo,
+                                            10 ** (ret.scale - ft.scale))
+                return Int128Column(hi, lo, a.nulls, ret)
+            raise NotImplementedError("long-decimal downscale cast")
+        if ret.is_decimal or ret.is_integral:
+            # narrow through int64 lanes (values must fit; the domain of
+            # a query casting down is short by declaration)
+            v = a.lo.astype(jnp.int64)
+            v = rescale_decimal(v, ft.scale, _scale_of(ret))
+            return _col(ret, v.astype(ret.to_dtype()), a)
+        raise NotImplementedError(f"cast long decimal -> {ret}")
     if isinstance(a, StringColumn) and not ret.is_string:
         raise NotImplementedError(
             "CAST(varchar AS numeric) needs the string-parse kernels "
@@ -954,6 +1099,9 @@ def _mix64(z):
 def hash64_block(b: Block):
     """Per-row 64-bit hash of a block (nulls hash to a fixed value),
     the analog of the $hashValue channels HashGenerationOptimizer adds."""
+    if isinstance(b, Int128Column):
+        h = _mix64(_mix64(b.hi.astype(jnp.uint64)) ^ b.lo)
+        return jnp.where(b.nulls, jnp.uint64(0x9E3779B97F4A7C15), h)
     if isinstance(b, StringColumn):
         h = jnp.zeros(b.chars.shape[0], dtype=jnp.uint64)
         # mix 8 chars at a time as a little-endian word

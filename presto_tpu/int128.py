@@ -1,0 +1,235 @@
+"""128-bit integer lanes for long decimals: the Int128ArrayBlock analog.
+
+Reference surface: presto-common/.../common/block/Int128ArrayBlock.java
+and common/type/Decimals.java (long decimals, precision 19..38, live as
+two 64-bit words) plus UnscaledDecimal128Arithmetic.java.
+
+TPU-first layout: a value is (hi: int64, lo: uint64) = hi * 2^64 + lo in
+two's complement, as two flat lanes (SoA, not the reference's
+interleaved [hi, lo] pairs) so every op is a plain VPU elementwise op.
+There is no 128-bit scalar unit anywhere on the chip -- all arithmetic
+is composed from 64-bit ops with explicit carries, and SUM aggregation
+never adds 128-bit values pairwise at all: values decompose into small
+limbs whose int64 (or exact-f32-matmul) totals recombine into 128 bits
+once per group (ops/aggregation.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["add128", "shl128_const", "from_int64", "neg128",
+           "combine_limb_totals_128", "limbs13_of_128", "div128_by_count",
+           "mulu64_wide", "mul_i64_i64_128", "mul128_by_u64",
+           "rescale128_up", "cmp128",
+           "int128_to_python", "python_to_int128", "INT64_MIN", "INT64_MAX"]
+
+_U64 = jnp.uint64
+_I64 = jnp.int64
+INT64_MIN = -(1 << 63)
+INT64_MAX = (1 << 63) - 1
+
+
+def from_int64(v):
+    """Sign-extend int64 lanes to (hi, lo)."""
+    return (v >> np.int64(63), v.astype(_U64))
+
+
+def add128(ah, al, bh, bl):
+    """(ah, al) + (bh, bl) with carry; wraps at 2^127 like the hardware
+    would (callers that care detect overflow separately)."""
+    lo = al + bl
+    carry = (lo < al).astype(_I64)
+    return ah + bh + carry, lo
+
+
+def neg128(h, l):
+    """Two's-complement negate."""
+    nl = (~l) + _U64(1)
+    borrow = (nl == 0).astype(_I64)  # only -0 wraps
+    return (~h) + borrow, nl
+
+
+def shl128_const(v, s: int):
+    """(hi, lo) of int64 lanes `v` shifted left by the STATIC amount s
+    (0 <= s < 128), sign-extended first."""
+    if s == 0:
+        return from_int64(v)
+    if s < 64:
+        lo = v.astype(_U64) << _U64(s)
+        hi = v >> np.int64(64 - s)  # arithmetic: keeps the sign bits
+        return hi, lo
+    return v << np.int64(s - 64), jnp.zeros_like(v, dtype=_U64)
+
+
+def combine_limb_totals_128(totals, limb_bits: int = 13):
+    """Recombine exact per-limb totals into (hi, lo).
+
+    `totals` is (..., L) int64 where totals[..., l] is the exact sum of
+    the l-th limb over some group; the true group sum is
+    sum_l totals[..., l] * 2^(limb_bits*l), which may exceed int64 --
+    each term is shifted into 128 bits and added with carries."""
+    nlimbs = totals.shape[-1]
+    hi = jnp.zeros(totals.shape[:-1], dtype=_I64)
+    lo = jnp.zeros(totals.shape[:-1], dtype=_U64)
+    for l in range(nlimbs):
+        th, tl = shl128_const(totals[..., l], limb_bits * l)
+        hi, lo = add128(hi, lo, th, tl)
+    return hi, lo
+
+
+def limbs13_of_128(hi, lo, nlimbs: int = 10):
+    """Split (hi, lo) into `nlimbs` 13-bit limbs (low first; the last
+    limb is the signed remainder) for exact-matmul or scatter
+    re-aggregation of already-128-bit partial states. 10 limbs cover
+    117 bits + sign -- enough for decimal(38) (< 2^127)."""
+    out = []
+    chi, clo = hi, lo
+    for _ in range(nlimbs - 1):
+        out.append((clo & _U64(0x1FFF)).astype(_I64))
+        # 128-bit arithmetic shift right by 13
+        clo = (clo >> _U64(13)) | (chi.astype(_U64) << _U64(51))
+        chi = chi >> np.int64(13)
+    out.append(clo.astype(_I64) | (chi << np.int64(51)))  # signed top
+    return out
+
+
+def div128_by_count(hi, lo, count, round_half_up: bool = True):
+    """(hi, lo) / count -> int64, rounding half away from zero (Presto's
+    decimal average). `count` must be a positive int64 < 2^47 (row
+    counts; the 16-bit-limb long division needs rem*2^16 + limb < 2^63).
+    Quotients beyond int64 saturate (the caller's result type is a
+    decimal whose average cannot exceed the input domain, so a saturated
+    quotient only occurs on inputs that already overflowed)."""
+    neg = hi < 0
+    mh, ml = neg128(hi, lo)
+    mh = jnp.where(neg, mh, hi)
+    ml = jnp.where(neg, ml, lo)
+    d = count.astype(_I64)
+    d = jnp.maximum(d, 1)
+    # 8 x 16-bit limbs of the 128-bit magnitude, high first
+    limbs = []
+    for k in range(3, -1, -1):
+        limbs.append(((mh.astype(_U64) >> _U64(16 * k)) & _U64(0xFFFF)).astype(_I64))
+    for k in range(3, -1, -1):
+        limbs.append(((ml >> _U64(16 * k)) & _U64(0xFFFF)).astype(_I64))
+    q = jnp.zeros_like(d)
+    rem = jnp.zeros_like(d)
+    overflow = jnp.zeros(d.shape, dtype=bool)
+    for limb in limbs:
+        cur = (rem << np.int64(16)) | limb
+        ql = cur // d
+        rem = cur - ql * d
+        overflow = overflow | (q > (INT64_MAX >> 16))
+        q = (q << np.int64(16)) | ql
+    if round_half_up:
+        q = q + (2 * rem >= d).astype(_I64)
+    q = jnp.where(overflow, INT64_MAX, q)
+    return jnp.where(neg, -q, q)
+
+
+_M32 = _U64(0xFFFFFFFF)
+
+
+def mulu64_wide(a, b):
+    """Unsigned 64x64 -> 128 multiply via 32-bit half products."""
+    a0, a1 = a & _M32, a >> _U64(32)
+    b0, b1 = b & _M32, b >> _U64(32)
+    p00 = a0 * b0
+    p01 = a0 * b1
+    p10 = a1 * b0
+    p11 = a1 * b1
+    mid = (p00 >> _U64(32)) + (p01 & _M32) + (p10 & _M32)
+    hi = p11 + (p01 >> _U64(32)) + (p10 >> _U64(32)) + (mid >> _U64(32))
+    lo = (mid << _U64(32)) | (p00 & _M32)
+    return hi, lo
+
+
+def mul_i64_i64_128(a, b):
+    """Signed 64x64 -> exact signed 128 product (hi int64, lo uint64):
+    unsigned wide product plus the standard two's-complement high-word
+    corrections."""
+    au, bu = a.astype(_U64), b.astype(_U64)
+    hi_u, lo = mulu64_wide(au, bu)
+    corr = jnp.where(a < 0, bu, _U64(0)) + jnp.where(b < 0, au, _U64(0))
+    return (hi_u - corr).astype(_I64), lo
+
+
+def mul128_by_u64(hi, lo, m):
+    """(hi, lo) * m for a NON-NEGATIVE multiplier m < 2^63 (e.g. a power
+    of ten); wraps beyond 127 bits like the rest of the lane math."""
+    mu = _U64(m) if isinstance(m, int) else m.astype(_U64)
+    ph, pl = mulu64_wide(lo, mu)
+    return (hi * mu.astype(_I64) + ph.astype(_I64)), pl
+
+
+def mul128(ah, al, bh, bl):
+    """Full 128x128 product modulo 2^128 (exact whenever the true
+    product fits, i.e. everywhere in the decimal(38) domain):
+    (ah*2^64+al)(bh*2^64+bl) = al*bl + (ah*bl + al*bh)*2^64 (mod 2^128).
+    Two's complement makes every sign combination fall out."""
+    wh, wl = mulu64_wide(al, bl)
+    hi = (wh.astype(_I64) + ah * bl.astype(_I64)
+          + al.astype(_I64) * bh)
+    return hi, wl
+
+
+def divmod128_by_u64(hi, lo, d):
+    """Binary long division of the NON-NEGATIVE (hi, lo) by uint64-lane
+    divisor d (1 <= d < 2^63): 128 shift-subtract steps, all cheap
+    elementwise VPU ops. Returns (qhi, qlo, rem)."""
+    du = d.astype(_U64)
+    qhi = jnp.zeros_like(lo)
+    qlo = jnp.zeros_like(lo)
+    rem = jnp.zeros_like(lo)
+    hu = hi.astype(_U64)
+    for i in range(127, -1, -1):
+        bit = ((hu >> _U64(i - 64)) if i >= 64 else (lo >> _U64(i))) & _U64(1)
+        rem = (rem << _U64(1)) | bit
+        ge = rem >= du
+        rem = jnp.where(ge, rem - du, rem)
+        if i >= 64:
+            qhi = qhi | (ge.astype(_U64) << _U64(i - 64))
+        else:
+            qlo = qlo | (ge.astype(_U64) << _U64(i))
+    return qhi, qlo, rem
+
+
+def rescale128_up(hi, lo, factor: int):
+    """Multiply by 10^k given as the integer factor (upscale only --
+    exact; downscale needs division and lives with the caller)."""
+    h, l = hi, lo
+    while factor > (1 << 62):  # compose out-of-range factors
+        h, l = mul128_by_u64(h, l, 10 ** 18)
+        factor //= 10 ** 18
+    return mul128_by_u64(h, l, factor)
+
+
+def cmp128(ah, al, bh, bl):
+    """Signed comparison: returns (lt, eq) boolean lanes."""
+    lt = (ah < bh) | ((ah == bh) & (al < bl))
+    eq = (ah == bh) & (al == bl)
+    return lt, eq
+
+
+def int128_to_python(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    """Host: (hi, lo) numpy arrays -> object array of exact Python ints."""
+    out = np.empty(hi.shape[0], dtype=object)
+    for i in range(hi.shape[0]):
+        out[i] = int(hi[i]) * (1 << 64) + int(lo[i])
+    return out
+
+
+def python_to_int128(values) -> tuple:
+    """Host: iterable of Python ints (None -> 0) -> (hi, lo) arrays."""
+    n = len(values)
+    hi = np.zeros(n, dtype=np.int64)
+    lo = np.zeros(n, dtype=np.uint64)
+    for i, v in enumerate(values):
+        if v is None:
+            continue
+        v = int(v)
+        lo[i] = np.uint64(v & ((1 << 64) - 1))
+        hi[i] = np.int64(v >> 64)  # floor shift == two's-complement hi
+    return hi, lo

@@ -62,7 +62,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import types as T
-from ..block import Batch, Block, Column, DictionaryColumn, StringColumn
+from ..block import (Batch, Block, Column, DictionaryColumn, Int128Column,
+                     StringColumn)
 from .keys import key_words
 
 __all__ = ["AggSpec", "GroupByResult", "group_by", "grouped_aggregate",
@@ -256,6 +257,29 @@ def _seg_max(ids, contrib, max_groups: int, ident) -> jnp.ndarray:
     return jnp.full(max_groups, ident, dtype=contrib.dtype).at[ids].max(contrib)
 
 
+def _sum128(ids, col, live, max_groups: int):
+    """Exact per-group 128-bit sums (the SpillableHashAggregationBuilder
+    never needs this in the reference because Java BigDecimal-backed
+    states exist; here the TPU lanes are 64-bit, so sums that can exceed
+    int64 decompose into 13-bit limbs whose int64/matmul totals are
+    exact, then recombine into (hi, lo) once per group -- no 128-bit
+    pairwise adds anywhere in the hot loop)."""
+    from ..int128 import combine_limb_totals_128, limbs13_of_128
+    if isinstance(col, Int128Column):
+        limbs = limbs13_of_128(col.hi, col.lo)  # 10 x int64
+    else:
+        v = col.values.astype(jnp.int64)
+        limbs = []
+        rem = v
+        for _ in range(4):
+            limbs.append(rem & 0x1FFF)
+            rem = rem >> 13
+        limbs.append(rem)  # signed top
+    totals = [_seg_add(ids, jnp.where(live, l, 0), max_groups)
+              for l in limbs]
+    return combine_limb_totals_128(jnp.stack(totals, axis=-1))
+
+
 def _group_ids_hash(words, active: jnp.ndarray, max_groups: int):
     """Hash-slot kernel for large tables (see module docstring)."""
     n = active.shape[0]
@@ -393,6 +417,35 @@ def _acc_columns(spec: AggSpec, col: Optional[Block], ids, active, max_groups: i
         if name in ("min", "max"):
             return _minmax_string(col, ids, live, g, spec)
         raise NotImplementedError(f"{spec.name} over strings")
+
+    if isinstance(col, Int128Column) or (
+            name in ("sum", "avg") and col.type.is_decimal):
+        # decimal sums always produce decimal(38, s) -- a LONG decimal --
+        # so they accumulate exactly in 128 bits: per-limb totals (exact
+        # int64 everywhere) recombine into (hi, lo) once per group.
+        # Int128-lane inputs take the same path for min/max via argbest.
+        if name in ("sum", "avg"):
+            sum_ty = spec.output_type if name == "sum" \
+                else _sum_type(col.type)
+            hi, lo = _sum128(ids, col, live, g)
+            out = [("sum", Int128Column(hi, lo, no_input, sum_ty))]
+            if name == "avg":
+                out.append(("count",
+                            Column(nn, jnp.zeros(g, dtype=bool), T.BIGINT)))
+            return out
+        if isinstance(col, Int128Column):
+            if name in ("min", "max"):
+                from .keys import _SIGN
+                words = [col.hi.astype(jnp.uint64) ^ _SIGN, col.lo]
+                row_best = _argbest(words, ids, live, g,
+                                    minimize=(name == "min"))
+                n = len(col)
+                valid = row_best < n
+                idx = jnp.clip(row_best, 0, n - 1)
+                return [(name, Int128Column(col.hi[idx], col.lo[idx],
+                                            ~valid | col.nulls[idx],
+                                            spec.output_type))]
+            raise NotImplementedError(f"{spec.name} over long decimals")
 
     v = col.values
     if name == "sum" or name == "avg":

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..block import Block, Column, DictionaryColumn, StringColumn
+from ..block import Block, Column, DictionaryColumn, Int128Column, StringColumn
 
 _SIGN = np.uint64(1 << 63)
 
@@ -85,7 +85,13 @@ def key_words(cols: Sequence[Block], nulls_last: Union[bool, Sequence[bool]] = F
         null_word = jnp.where(isnull, np.uint64(0 if not nl else 1),
                               np.uint64(1 if not nl else 0))
         words.append(null_word)
-        vws = _string_words(col) if isinstance(col, StringColumn) else _fixed_words(col)
+        if isinstance(col, StringColumn):
+            vws = _string_words(col)
+        elif isinstance(col, Int128Column):
+            # 128-bit two's complement: sign-flipped hi word then lo
+            vws = [col.hi.astype(jnp.uint64) ^ _SIGN, col.lo]
+        else:
+            vws = _fixed_words(col)
         for vw in vws:
             words.append(jnp.where(isnull, np.uint64(0), vw))
     if any_null is None:
@@ -100,6 +106,8 @@ def num_key_words(cols: Sequence[Block]) -> int:
             col = col.dictionary
         if isinstance(col, StringColumn):
             total += 1 + (col.max_len + 7) // 8
+        elif isinstance(col, Int128Column):
+            total += 3
         else:
             total += 2
     return total

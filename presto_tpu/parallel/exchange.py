@@ -23,7 +23,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..block import Batch, Block, Column, DictionaryColumn, StringColumn
+from ..block import (Batch, Block, Column, DictionaryColumn, Int128Column,
+                     StringColumn)
 from ..expr.functions import combine_hash, hash64_block
 
 __all__ = ["exchange_by_hash", "exchange_by_range", "broadcast_build",
@@ -45,6 +46,8 @@ def _map_block(b: Block, fn) -> Block:
         b = b.decode()
     if isinstance(b, StringColumn):
         return StringColumn(fn(b.chars), fn(b.lengths), fn(b.nulls), b.type)
+    if isinstance(b, Int128Column):
+        return Int128Column(fn(b.hi), fn(b.lo), fn(b.nulls), b.type)
     return Column(fn(b.values), fn(b.nulls), b.type)
 
 

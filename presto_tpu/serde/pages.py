@@ -114,6 +114,22 @@ def _serialize_fixed(values: np.ndarray, nulls: np.ndarray) -> bytes:
     return b"".join(out)
 
 
+def _serialize_int128(vals: np.ndarray, nulls: np.ndarray) -> bytes:
+    """Long decimals: INT128_ARRAY of (lo, hi) u64 pairs per non-null
+    position. `vals` holds exact Python ints (object) or int64s."""
+    rows = len(vals)
+    enc = _FIXED_ENC[16]
+    nn = [int(vals[i]) for i in range(rows) if not nulls[i]]
+    pairs = np.zeros((len(nn), 2), dtype=np.uint64)
+    for i, v in enumerate(nn):
+        pairs[i, 0] = np.uint64(v & ((1 << 64) - 1))
+        pairs[i, 1] = np.uint64((v >> 64) & ((1 << 64) - 1))
+    return b"".join([struct.pack("<i", len(enc)), enc,
+                     struct.pack("<i", rows),
+                     _bitpack_nulls(np.asarray(nulls, dtype=bool)),
+                     pairs.tobytes()])
+
+
 def _serialize_varwidth(vals: np.ndarray, nulls: np.ndarray) -> bytes:
     """vals: object array of str/bytes."""
     rows = len(vals)
@@ -147,6 +163,9 @@ def _serialize_block(block: Block) -> bytes:
     v, n = to_numpy(block)
     if isinstance(block, StringColumn):
         return _serialize_varwidth(v, n)
+    from ..block import Int128Column
+    if isinstance(block, Int128Column):
+        return _serialize_int128(v, n)
     return _serialize_fixed(v, n)
 
 
@@ -170,6 +189,9 @@ def serialize_page(columns: Sequence[Tuple[T.Type, np.ndarray, np.ndarray]],
     for ty, vals, nulls in columns:
         if ty.is_string:
             body.append(_serialize_varwidth(vals, nulls))
+        elif ty.is_decimal and not ty.is_short_decimal:
+            body.append(_serialize_int128(vals,
+                                          np.asarray(nulls, dtype=bool)))
         else:
             body.append(_serialize_fixed(np.asarray(vals, dtype=ty.to_dtype()),
                                          np.asarray(nulls, dtype=bool)))
@@ -238,18 +260,17 @@ def _deserialize_block(mv: memoryview, pos: int, ty: Optional[T.Type]):
         n_nonnull = rows - int(nulls.sum())
         dt = _fixed_dtype(width, ty)
         if width == 16:
-            # INT128_ARRAY -> int64 lanes (round-1 long-decimal repr):
-            # values are (lo, hi) u64 pairs; accept only those that fit
+            # INT128_ARRAY: (lo, hi) u64 pairs -> exact Python ints in
+            # an object array (Int128Column lanes on the device side)
             pairs = np.frombuffer(mv[pos:pos + n_nonnull * 16],
                                   dtype=np.int64).reshape(-1, 2)
-            lo, hi = pairs[:, 0], pairs[:, 1]
-            if not np.array_equal(hi, lo >> 63):
-                raise NotImplementedError(
-                    "INT128_ARRAY value exceeds int64 lanes (long-decimal "
-                    "int128 support is pending)")
-            raw = lo.copy()
+            lo, hi = pairs[:, 0].astype(np.uint64), pairs[:, 1]
             pos += n_nonnull * 16
-            vals = nk.unpack_nonnull(raw, nulls)
+            nn_vals = np.empty(n_nonnull, dtype=object)
+            for i in range(n_nonnull):
+                nn_vals[i] = int(hi[i]) * (1 << 64) + int(lo[i])
+            vals = np.zeros(rows, dtype=object)
+            vals[~nulls] = nn_vals
             return (vals, nulls), pos
         raw = np.frombuffer(mv[pos:pos + n_nonnull * width],
                             dtype=dt if dt.itemsize == width else

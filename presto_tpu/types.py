@@ -125,9 +125,11 @@ class Type:
         if d is not None:
             return np.dtype(d)
         if self.is_decimal:
-            # long decimals (p > 18) also ride int64 lanes in round 1 --
-            # exact for TPC-H-scale magnitudes; the int128 (hi, lo) lane
-            # pair is the planned upgrade (SURVEY.md §7 hard part 2)
+            # long decimals (p > 18) live as Int128Column (hi, lo) lane
+            # pairs on device (block.py); host-side long-decimal arrays
+            # are object arrays of exact Python ints. int64 here is the
+            # dtype of each LANE (and the staging dtype for values that
+            # happen to fit 64 bits).
             return np.dtype(np.int64)
         if self.is_string:
             return np.dtype(np.uint8)

@@ -1,0 +1,166 @@
+"""int128 lane arithmetic (Int128ArrayBlock / UnscaledDecimal128 analog)
+checked exhaustively against Python big-int oracles."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from presto_tpu import int128 as I
+
+
+def _py(hi, lo):
+    return [int(h) * (1 << 64) + int(l) for h, l in
+            zip(np.asarray(hi), np.asarray(lo))]
+
+
+@pytest.fixture
+def vals(rng):
+    return rng.integers(-(2**62), 2**62, 64).astype(np.int64)
+
+
+def test_from_int64_roundtrip(vals):
+    hi, lo = I.from_int64(jnp.asarray(vals))
+    assert _py(hi, lo) == [int(v) for v in vals]
+
+
+def test_add128_matches_bigint(rng):
+    a = [int(x) for x in rng.integers(-(2**62), 2**62, 32)]
+    b = [int(x) for x in rng.integers(-(2**62), 2**62, 32)]
+    a128 = [v * 3_000_000_007 for v in a]  # spill past 64 bits
+    b128 = [v * 2_147_483_629 for v in b]
+    ah, al = I.python_to_int128(a128)
+    bh, bl = I.python_to_int128(b128)
+    h, l = I.add128(jnp.asarray(ah), jnp.asarray(al),
+                    jnp.asarray(bh), jnp.asarray(bl))
+    assert _py(h, l) == [x + y for x, y in zip(a128, b128)]
+
+
+def test_mul_i64_i64_128_exact(rng):
+    a = rng.integers(-(2**62), 2**62, 256).astype(np.int64)
+    b = rng.integers(-(2**62), 2**62, 256).astype(np.int64)
+    h, l = I.mul_i64_i64_128(jnp.asarray(a), jnp.asarray(b))
+    assert _py(h, l) == [int(x) * int(y) for x, y in zip(a, b)]
+
+
+def test_mul128_by_u64_and_rescale(rng):
+    # keep base * 10^6 inside int128 (|v| < 1.7e38)
+    base = [int(x) * 10**21 + int(y) for x, y in
+            zip(rng.integers(-(10**10), 10**10, 32),
+                rng.integers(0, 10**9, 32))]
+    hi, lo = I.python_to_int128(base)
+    h, l = I.rescale128_up(jnp.asarray(hi), jnp.asarray(lo), 10**6)
+    assert _py(h, l) == [v * 10**6 for v in base]
+
+
+def test_limb_roundtrip(rng):
+    base = [int(x) * 10**20 - int(y) for x, y in
+            zip(rng.integers(-(10**17), 10**17, 64),
+                rng.integers(0, 10**12, 64))]
+    hi, lo = I.python_to_int128(base)
+    limbs = I.limbs13_of_128(jnp.asarray(hi), jnp.asarray(lo))
+    totals = jnp.stack(limbs, axis=-1)  # (N, L): identity "sums"
+    h, l = I.combine_limb_totals_128(totals)
+    assert _py(h, l) == base
+
+
+def test_combine_limb_totals_sums_beyond_int64(rng):
+    # simulate per-limb totals of a sum that exceeds int64
+    vals = [int(v) for v in rng.integers(0, 2**62, 1000)]
+    arrs = np.array(vals, dtype=np.int64)
+    limbs = []
+    rem = jnp.asarray(arrs)
+    for _ in range(4):
+        limbs.append((rem & 0x1FFF).astype(jnp.int64))
+        rem = rem >> 13
+    limbs.append(rem)
+    totals = jnp.stack([jnp.sum(w) for w in limbs])[None, :]
+    h, l = I.combine_limb_totals_128(totals)
+    assert _py(h, l) == [sum(vals)]
+    assert sum(vals) > 2**63  # the point: int64 would have wrapped
+
+
+def test_div128_by_count_half_away(rng):
+    sums = [10**25 + 7, -(10**25) - 7, 5, -5, 10, 0]
+    counts = [3, 3, 2, 2, 4, 9]
+    hi, lo = I.python_to_int128(sums)
+    q = I.div128_by_count(jnp.asarray(hi), jnp.asarray(lo),
+                          jnp.asarray(np.array(counts, dtype=np.int64)))
+    def oracle(s, c):
+        neg = s < 0
+        m, r = divmod(abs(s), c)
+        m += 1 if 2 * r >= c else 0
+        return -m if neg else m
+    want = [oracle(s, c) for s, c in zip(sums, counts)]
+    got = [int(x) for x in np.asarray(q)]
+    assert got[2:] == want[2:]
+    # big quotients exceed int64 -> saturate (flagged domain)
+    assert got[0] == I.INT64_MAX and got[1] == -I.INT64_MAX
+
+
+def test_sum_long_decimal_beyond_int64_local_and_mesh(mesh8):
+    """VERDICT round-2 criterion: sums of long decimals whose total
+    exceeds int64 are EXACT vs a Python big-int oracle, on the local
+    engine and under the 8-device mesh (partial/final + exchange)."""
+    from presto_tpu import types as T
+    from presto_tpu.exec import run_query
+    from presto_tpu.ops.aggregation import AggSpec
+    from presto_tpu.plan import nodes as N
+
+    rows = []
+    vals = []
+    base = 4 * 10**18  # each near int64 max; 24 rows sum ~ 1e20
+    for i in range(24):
+        v = base + i * 10**15 + i
+        rows.append([i % 3, v])
+        vals.append(v)
+    values = N.ValuesNode([T.INTEGER, T.decimal(38, 2)], rows)
+    agg = N.AggregationNode(values, [0], [
+        AggSpec("sum", 1, T.decimal(38, 2)),
+        AggSpec("avg", 1, T.decimal(38, 2)),
+        AggSpec("min", 1, T.decimal(38, 2)),
+        AggSpec("max", 1, T.decimal(38, 2)),
+    ], step="SINGLE", max_groups=8)
+    root = N.OutputNode(agg, ["k", "s", "a", "mn", "mx"])
+
+    def oracle():
+        out = {}
+        for k in range(3):
+            g = [v for i, v in enumerate(vals) if i % 3 == k]
+            s = sum(g)
+            q, r = divmod(s, len(g))
+            out[k] = (s, q + (1 if 2 * r >= len(g) else 0),
+                      min(g), max(g))
+        return out
+
+    want = oracle()
+    for mesh in (None, mesh8):
+        res = run_query(root, sf=1.0, mesh=mesh)
+        got = {row[0]: row[1:] for row in res.rows()}
+        assert got == want, f"mesh={mesh is not None}"
+        assert all(isinstance(row[1], int) and row[1] > 2**63
+                   for row in res.rows())
+
+
+def test_long_decimal_serde_roundtrip():
+    from presto_tpu import types as T
+    from presto_tpu.serde.pages import deserialize_page, serialize_page
+    vals = np.array([10**25 + 7, -(10**30), 5, 0], dtype=object)
+    nulls = np.array([False, False, False, True])
+    ty = T.decimal(38, 2)
+    buf = serialize_page([(ty, vals, nulls)])
+    [(got, gn)] = deserialize_page(buf, [ty])
+    assert list(gn) == list(nulls)
+    assert [got[i] for i in range(3)] == [vals[i] for i in range(3)]
+
+
+def test_cmp128(rng):
+    vals = [(-(10**30), 10**30), (5, 5), (10**25, 10**25 + 1)]
+    a = [x for x, _ in vals]
+    b = [y for _, y in vals]
+    ah, al = I.python_to_int128(a)
+    bh, bl = I.python_to_int128(b)
+    lt, eq = I.cmp128(jnp.asarray(ah), jnp.asarray(al),
+                      jnp.asarray(bh), jnp.asarray(bl))
+    assert list(np.asarray(lt)) == [x < y for x, y in vals]
+    assert list(np.asarray(eq)) == [x == y for x, y in vals]
