@@ -19,7 +19,7 @@ import dataclasses
 import threading
 from typing import Dict, Optional
 
-from ..block import Batch, DictionaryColumn, StringColumn
+from ..block import Batch
 
 __all__ = ["MemoryPool", "MemoryContext", "MemoryReservationError",
            "batch_bytes"]
@@ -30,19 +30,20 @@ class MemoryReservationError(RuntimeError):
 
 
 def batch_bytes(batch: Batch) -> int:
-    """Planned HBM footprint of a Batch (sum of leaf array sizes)."""
-    total = batch.active.shape[0] // 8 + batch.active.shape[0]  # mask bool
-    for c in batch.columns:
-        if isinstance(c, DictionaryColumn):
-            total += c.indices.shape[0] * 4 + c.nulls.shape[0]
-            c = c.dictionary
-        if isinstance(c, StringColumn):
-            total += c.chars.shape[0] * c.chars.shape[1]
-            total += c.lengths.shape[0] * 4 + c.nulls.shape[0]
-        else:
-            total += c.values.shape[0] * c.values.dtype.itemsize
-            total += c.nulls.shape[0]
-    return int(total)
+    """Planned HBM footprint of a Batch.
+
+    Batches (and every Block kind) are registered pytrees, so the
+    footprint is the sum over tree leaves — structurally complete for
+    any present or future column layout (Int128Column's hi/lo lanes,
+    dictionary indices, string char matrices) with no per-kind branch
+    to forget. Reference: memory accounting on Page.getSizeInBytes().
+    """
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(batch):
+        total += int(leaf.size) * leaf.dtype.itemsize
+    return total
 
 
 class MemoryPool:

@@ -31,7 +31,7 @@ from jax.sharding import PartitionSpec as P
 from .. import types as T
 from ..block import Batch
 from ..expr.compile import compile_filter, compile_projections
-from ..ops.aggregation import group_by, merge_partials
+from ..ops.aggregation import finalize_states, group_by, merge_partials
 from ..ops.join import hash_join, semi_join_mask
 from ..ops.misc import distinct as distinct_op
 from ..ops.misc import limit as limit_op
@@ -99,6 +99,9 @@ def compile_plan(root: N.PlanNode, mesh=None,
                              node.max_groups)
             _note_overflow(r.overflow)
             out = r.batch
+            if node.step in ("SINGLE", "FINAL"):
+                out = finalize_states(out, len(node.group_channels),
+                                      node.aggregates)
             if dist and not node.group_channels:
                 gathered = (isinstance(node.source, N.ExchangeNode)
                             and node.source.kind == "GATHER"
@@ -122,6 +125,14 @@ def compile_plan(root: N.PlanNode, mesh=None,
             right_replicated = (isinstance(node.right, N.ExchangeNode)
                                 and node.right.kind == "REPLICATE"
                                 and node.right.scope == "REMOTE")
+            if dist and node.join_type in ("right", "full") \
+                    and (node.distribution == "broadcast" or right_replicated):
+                raise ValueError(
+                    "RIGHT/FULL OUTER join under a mesh needs PARTITIONED "
+                    "distribution (a replicated build side would emit its "
+                    "unmatched rows once per worker); run AddExchanges "
+                    "(plan.distribute) first -- run_query does this "
+                    "automatically")
             if dist and node.distribution == "broadcast" \
                     and not right_replicated:  # exchange already gathered
                 build = broadcast_build(build, axis)

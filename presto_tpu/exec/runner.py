@@ -88,8 +88,10 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     # capacity refinement (CBO stats): shrink group tables to the
     # connector-proven NDV bound so group-by rides the scatter-free
     # small-table kernels wherever statistics allow
-    from ..plan.stats import refine_capacities
-    root = refine_capacities(root, sf)
+    refine = session is None or session.get("stats_capacity_refinement")
+    if refine:
+        from ..plan.stats import refine_capacities
+        root = refine_capacities(root, sf)
     if mesh is not None:
         # make the plan SPMD-correct: single-node operators get the
         # exchanges they need (AddExchanges; idempotent for plans that
@@ -114,13 +116,20 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     stats = RuntimeStats()
     if split_rows is not None and mesh is None:
         from .streaming import run_streaming_agg, streamable_agg_shape
-        if streamable_agg_shape(root) is not None:
+        shape = streamable_agg_shape(root)
+        if shape is not None:
             with stats.timed("streaming_exec_s"):
                 r = run_streaming_agg(root, sf, split_rows)
             if bool(np.asarray(r.overflow)):
                 raise RuntimeError("streaming aggregation overflowed "
                                    "max_groups; raise AggregationNode.max_groups")
-            res = _batch_to_result(r.batch, root)
+            # the streaming executor accumulates raw states; SINGLE-step
+            # plans still owe the evaluateFinal step
+            from ..ops.aggregation import finalize_states
+            agg_node, _ = shape
+            out_b = finalize_states(r.batch, len(agg_node.group_channels),
+                                    agg_node.aggregates)
+            res = _batch_to_result(out_b, root)
             res.stats = stats.snapshot()
             return res
     plan = compile_plan(root, mesh, default_join_capacity)
@@ -174,10 +183,15 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
                 if flags == 0:
                     break
                 if flags & 1:
+                    hint = (" (note: connector NDV statistics shrank "
+                            "group capacities this run; set session "
+                            "stats_capacity_refinement=false if a "
+                            "hand-set max_groups must stand)"
+                            if refine else "")
                     raise RuntimeError(
                         "plan execution overflowed a static bucket (join/"
                         "group capacity); rerun with larger capacity "
-                        "hints (max_groups / join_capacity)")
+                        "hints (max_groups / join_capacity)" + hint)
                 if mesh is None or scale >= 1 << 20:  # unreachable: clamp
                     raise RuntimeError(
                         "exchange slot overflow did not converge")

@@ -67,7 +67,7 @@ from ..block import (Batch, Block, Column, DictionaryColumn, Int128Column,
 from .keys import key_words
 
 __all__ = ["AggSpec", "GroupByResult", "group_by", "grouped_aggregate",
-           "merge_partials"]
+           "merge_partials", "finalize_states"]
 
 
 # aggregate function names supported round 1 (reference: the ~250-file
@@ -368,6 +368,285 @@ def _group_ids_sort(key_cols: Sequence[Block], active: jnp.ndarray,
 from ..block import gather_block as _gather_block  # shared row gather
 
 
+# ---------------------------------------------------------------------------
+# Sorted-mode group-by: the large-table kernel (G in 2^7 .. 2^20+)
+# ---------------------------------------------------------------------------
+# XLA lowers big scatters to a serialized per-update loop on TPU (436 ms
+# for ONE 6M->16 scatter-add on v5e; scripts/microbench_groupby.py), so
+# the hash-slot kernel and its per-accumulator scatters cannot carry
+# TPC-DS-scale cardinalities (MultiChannelGroupByHash.java:55 territory,
+# G ~ 10^4..10^7). Sorted mode is scatter-free end to end:
+#
+#   1. ONE lax.sort of the key words (+ row ids) -- 30-90 ms at 6M rows
+#      on v5e, amortized over every aggregate
+#   2. segment boundaries by adjacent-word inequality; dense group ids
+#      are positions in sorted order; per-group [start, end) row ranges
+#      come from searchsorted over the (nondecreasing) segment ids
+#   3. every accumulator is a segmented reduction in sorted order:
+#      sums/counts via padded-cumsum gather-diffs (ints decompose into
+#      13-bit limbs so int64 cumsums are exact); min/max/arbitrary via a
+#      flag-reset segmented associative scan; bool_and/or via counts
+#   4. count_distinct / approx_percentile piggyback on the SAME sort:
+#      their value column's words append to the sort key, making equal
+#      values adjacent within each group (distinct = first-occurrence
+#      flags; percentile = direct index into the value-sorted segment)
+#
+# The dense output table gathers keys from each segment's first row.
+# No scatter appears anywhere. This is the TPU answer to
+# InMemoryHashAggregationBuilder: sort IS the hash table.
+
+def _padded_cumsum(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.concatenate([jnp.zeros(1, dtype=x.dtype), jnp.cumsum(x)])
+
+
+def _seg_total(x: jnp.ndarray, start: jnp.ndarray, end: jnp.ndarray):
+    """Per-segment totals of x (sorted order) over [start, end) ranges."""
+    p = _padded_cumsum(x)
+    return p[end] - p[start]
+
+
+def _seg_scan_extreme(new_seg: jnp.ndarray, val: jnp.ndarray,
+                      minimize: bool) -> jnp.ndarray:
+    """Flag-reset segmented running min/max (textbook segmented scan:
+    combine((v1,f1),(v2,f2)) = (f2 ? v2 : op(v1,v2), f1|f2), associative
+    for any grouping). Returns the running extreme; a segment's answer
+    sits at its last row."""
+    def comb(a, b):
+        va, fa = a
+        vb, fb = b
+        m = jnp.minimum(va, vb) if minimize else jnp.maximum(va, vb)
+        return jnp.where(fb, vb, m), fa | fb
+
+    run, _ = jax.lax.associative_scan(comb, (val, new_seg))
+    return run
+
+
+def _seg_extreme_at(new_seg, val, start, end, ident, minimize):
+    n = val.shape[0]
+    run = _seg_scan_extreme(new_seg, val, minimize)
+    res = run[jnp.clip(end - 1, 0, n - 1)]
+    return jnp.where(end > start, res, ident)
+
+
+_VALUE_ORDER_AGGS = ("count_distinct", "approx_percentile")
+
+
+def _sorted_capable(batch: Batch, key_channels, aggs) -> bool:
+    """Can this aggregation run in sorted mode? (Everything TPC-H/DS
+    SQL produces can; exotic combinations fall back to the hash-slot
+    kernel.)"""
+    if not key_channels:
+        return False
+    vo_chans = {s.input_channel for s in aggs
+                if s.canonical in _VALUE_ORDER_AGGS}
+    if len(vo_chans) > 1:
+        return False  # only one column can piggyback on the sort order
+    for s in aggs:
+        c = s.canonical
+        if c in ("min_by", "max_by"):
+            return False
+        if s.input_channel is None:
+            continue
+        col = batch.column(s.input_channel)
+        if isinstance(col, DictionaryColumn):
+            col = col.dictionary
+        if isinstance(col, StringColumn) and c in ("min", "max"):
+            return False
+        if isinstance(col, Int128Column) and c in ("min", "max"):
+            return False
+    return True
+
+
+def _sorted_states(spec: AggSpec, scol, live, start, end, new_seg,
+                   s_active, pair_first, max_groups: int):
+    """Sorted-order accumulator states for one aggregate; mirrors
+    _acc_columns' state layout exactly (merge_spec/state_width parity)."""
+    g = max_groups
+    name = spec.canonical
+    zeros_g = jnp.zeros(g, dtype=bool)
+    if name == "count_star":
+        cnt = (end - start).astype(jnp.int64)
+        return [("count", Column(cnt, zeros_g, T.BIGINT))]
+
+    nn = _seg_total(live.astype(jnp.int64), start, end)
+    no_input = nn == 0
+    if name == "count":
+        return [("count", Column(nn, zeros_g, T.BIGINT))]
+    if name == "count_distinct":
+        cnt = _seg_total((live & pair_first).astype(jnp.int64), start, end)
+        return [("count", Column(cnt, zeros_g, T.BIGINT))]
+    if name == "approx_percentile":
+        assert spec.parameter is not None, "approx_percentile needs fraction"
+        n = live.shape[0]
+        # value-sorted segment, nulls last: live values sit at
+        # [start, start+nn); answer at start + floor((nn-1)*p)
+        target = start + jnp.floor(
+            jnp.maximum(nn - 1, 0).astype(jnp.float64)
+            * float(spec.parameter)).astype(jnp.int64)
+        idx = jnp.clip(target, 0, max(n - 1, 0))
+        got = _gather_block(scol, idx, ~no_input)
+        return [("percentile", got)]
+
+    if name == "arbitrary":
+        n = live.shape[0]
+        pos = jnp.where(live, jnp.arange(n, dtype=jnp.int64), n)
+        first = _seg_extreme_at(new_seg, pos, start, end,
+                                jnp.int64(n), minimize=True)
+        valid = first < n
+        got = _gather_block(scol, jnp.clip(first, 0, max(n - 1, 0)), valid)
+        return [(name, got)]
+
+    if name in ("sum", "avg") and (isinstance(scol, Int128Column)
+                                   or scol.type.is_decimal):
+        from ..int128 import combine_limb_totals_128, limbs13_of_128
+        sum_ty = spec.output_type if name == "sum" else _sum_type(scol.type)
+        if isinstance(scol, Int128Column):
+            limbs = limbs13_of_128(scol.hi, scol.lo)
+        else:
+            v = scol.values.astype(jnp.int64)
+            limbs = []
+            rem = v
+            for _ in range(4):
+                limbs.append(rem & 0x1FFF)
+                rem = rem >> 13
+            limbs.append(rem)
+        totals = [_seg_total(jnp.where(live, l, 0), start, end)
+                  for l in limbs]
+        hi, lo = combine_limb_totals_128(jnp.stack(totals, axis=-1))
+        out = [("sum", Int128Column(hi, lo, no_input, sum_ty))]
+        if name == "avg":
+            out.append(("count", Column(nn, zeros_g, T.BIGINT)))
+        return out
+
+    v = scol.values
+    if name in ("sum", "avg"):
+        sv = v.astype(_sum_dtype(scol.type))
+        if sv.dtype == jnp.int64:
+            # 13-bit limb cumsums keep every intermediate exact
+            limbs = []
+            rem = sv
+            for _ in range(4):
+                limbs.append(rem & 0x1FFF)
+                rem = rem >> 13
+            limbs.append(rem)
+            tot = jnp.zeros(g, dtype=jnp.int64)
+            for li, l in enumerate(limbs):
+                tot = tot + (_seg_total(jnp.where(live, l, 0), start, end)
+                             << (13 * li))
+            s = tot
+        else:
+            s = _seg_total(jnp.where(live, sv, sv.dtype.type(0)), start, end)
+        out = [("sum", Column(s, no_input, spec.output_type if name == "sum"
+                              else _sum_type(scol.type)))]
+        if name == "avg":
+            out.append(("count", Column(nn, zeros_g, T.BIGINT)))
+        return out
+    if name in ("min", "max"):
+        minimize = name == "min"
+        ident = _max_ident(v.dtype) if minimize else _min_ident(v.dtype)
+        val = jnp.where(live, v, ident)
+        m = _seg_extreme_at(new_seg, val, start, end, ident, minimize)
+        return [(name, Column(m, no_input, spec.output_type))]
+    if name in ("bool_and", "bool_or"):
+        if name == "bool_and":
+            bad = _seg_total((live & ~v).astype(jnp.int64), start, end)
+            out_v = bad == 0
+        else:
+            good = _seg_total((live & v).astype(jnp.int64), start, end)
+            out_v = good > 0
+        return [(name, Column(out_v, no_input, T.BOOLEAN))]
+    if name in ("var_samp", "var_pop", "stddev_samp", "stddev_pop"):
+        f = v.astype(jnp.float64)
+        if scol.type.is_decimal:
+            from ..expr.functions import _POW10
+            f = f / _POW10[scol.type.scale]
+        s = _seg_total(jnp.where(live, f, 0.0), start, end)
+        s2 = _seg_total(jnp.where(live, f * f, 0.0), start, end)
+        return [("count", Column(nn, zeros_g, T.BIGINT)),
+                ("sum", Column(s, no_input, T.DOUBLE)),
+                ("sumsq", Column(s2, no_input, T.DOUBLE))]
+    raise NotImplementedError(f"sorted-mode aggregate {spec.name!r}")
+
+
+def _group_by_sorted(batch: Batch, key_channels, aggs, max_groups: int
+                     ) -> "GroupByResult":
+    """Sorted-mode group_by (see block comment above)."""
+    n = batch.capacity
+    keys = [batch.column(c) for c in key_channels]
+    words, _ = key_words(keys)
+    lead = jnp.where(batch.active, np.uint64(0), np.uint64(1))
+    ops = [lead, *words]
+    nkw = len(words)
+    # value-order piggyback: count_distinct / approx_percentile columns
+    # sort WITHIN each group (nulls last so live values are a prefix)
+    vo_chans = [s.input_channel for s in aggs
+                if s.canonical in _VALUE_ORDER_AGGS]
+    n_pair_words = 0
+    if vo_chans:
+        vo_col = batch.column(vo_chans[0])
+        vwords, _ = key_words([vo_col], nulls_last=True)
+        ops.extend(vwords)
+        n_pair_words = len(vwords)
+    ops.append(jnp.arange(n, dtype=jnp.int32))
+    out = jax.lax.sort(ops, num_keys=len(ops) - 1)
+    s_lead = out[0]
+    s_words = out[1:1 + nkw]
+    s_pair_words = out[1 + nkw:1 + nkw + n_pair_words]
+    perm = out[-1]
+    s_active = s_lead == 0
+
+    diffs = jnp.zeros(n, dtype=bool)
+    for w in s_words:
+        diffs = diffs | (w != jnp.concatenate([w[:1], w[:-1]]))
+    diffs = diffs.at[0].set(False)
+    seg = jnp.cumsum(diffs.astype(jnp.int32))
+    new_seg = diffs.at[0].set(True)
+    # distinct-value first-occurrence flags (pair = keys ++ value words)
+    pair_first = diffs
+    for w in s_pair_words:
+        pair_first = pair_first | (w != jnp.concatenate([w[:1], w[:-1]]))
+    pair_first = pair_first.at[0].set(True)
+
+    n_act = jnp.sum(s_active.astype(jnp.int32))
+    num_groups = jnp.where(n_act > 0,
+                           seg[jnp.clip(n_act - 1, 0, max(n - 1, 0))] + 1, 0)
+    overflow = num_groups > max_groups
+
+    # per-slot [start, end) ranges; inactive rows get a sentinel segment
+    seg_search = jnp.where(s_active, seg, jnp.int32(0x7FFFFFFF))
+    gids = jnp.arange(max_groups, dtype=jnp.int32)
+    start = jnp.searchsorted(seg_search, gids, side="left")
+    end = jnp.searchsorted(seg_search, gids, side="right")
+    slot_active = gids < jnp.minimum(num_groups, max_groups)
+
+    perm_first = perm[jnp.clip(start, 0, max(n - 1, 0))]
+    out_cols: List[Block] = [
+        _gather_block(k, perm_first, slot_active) for k in keys]
+
+    sorted_cols: dict = {}
+
+    def sorted_col(ch: int):
+        if ch not in sorted_cols:
+            c = batch.column(ch)
+            if isinstance(c, DictionaryColumn):
+                c = c.decode()
+            sorted_cols[ch] = _gather_block(c, perm)
+        return sorted_cols[ch]
+
+    for spec in aggs:
+        if spec.input_channel is None:
+            scol, live = None, s_active
+        else:
+            scol = sorted_col(spec.input_channel)
+            live = s_active & ~scol.nulls
+        for _, state in _sorted_states(spec, scol, live, start, end,
+                                       new_seg, s_active, pair_first,
+                                       max_groups):
+            out_cols.append(state)
+    return GroupByResult(Batch(tuple(out_cols), slot_active),
+                         num_groups, overflow)
+
+
 def _sum_dtype(ty: T.Type):
     if ty.is_floating:
         return jnp.float64
@@ -607,12 +886,22 @@ def _minmax_string(col: StringColumn, ids, live, g, spec):
                           ~valid, spec.output_type))]
 
 
+import os as _os
+
+# A/B override for the large-table kernel: "sort" (default; scatter-free
+# segmented reductions) or "hash" (the scatter-based hash-slot kernel)
+_LARGE_G_MODE = _os.environ.get("PRESTO_TPU_GROUPBY", "sort")
+
+
 def group_by(batch: Batch, key_channels: Sequence[int], aggs: Sequence[AggSpec],
              max_groups: int) -> GroupByResult:
     """Grouped aggregation over one batch -> dense group table.
 
     Global aggregation (no keys) always yields exactly one group, even
     over zero input rows -- SQL's `SELECT count(*) ... -> 0` contract."""
+    if max_groups > _SMALL_G and _LARGE_G_MODE == "sort" \
+            and _sorted_capable(batch, key_channels, aggs):
+        return _group_by_sorted(batch, key_channels, aggs, max_groups)
     keys = [batch.column(c) for c in key_channels]
     ids, perm_first, num_groups, overflow = _group_ids(keys, batch.active, max_groups)
     if not key_channels:
@@ -709,6 +998,40 @@ def finalize_variance(spec: AggSpec, count: jnp.ndarray, s: jnp.ndarray,
         var = jnp.sqrt(var)
     nulls = count < (2 if ddof else 1)
     return var, nulls
+
+
+def finalize_states(table: Batch, num_keys: int, aggs: Sequence[AggSpec]
+                    ) -> Batch:
+    """State table (keys..., states...) -> finalized output: exactly ONE
+    column per aggregate, in spec order.
+
+    This is the evaluateFinal step of the reference's accumulators
+    (operator/aggregation/GroupedAccumulator, InMemoryHashAggregationBuilder):
+    SINGLE and FINAL aggregation steps emit finalized values; only
+    PARTIAL/INTERMEDIATE steps ship raw states. avg divides sum by count
+    (exact int128 half-away rounding for decimals via the registered
+    `divide` kernel); the variance family folds its (count, sum, sumsq)
+    moments; min_by/max_by drop the bookkeeping order column."""
+    cols: List[Block] = list(table.columns[:num_keys])
+    ch = num_keys
+    for spec in aggs:
+        w = state_width(spec)
+        states = table.columns[ch:ch + w]
+        ch += w
+        c = spec.canonical
+        if c == "avg":
+            from ..expr.functions import lookup
+            cols.append(lookup("divide").fn(spec.output_type,
+                                            states[0], states[1]))
+        elif c in ("var_samp", "var_pop", "stddev_samp", "stddev_pop"):
+            cnt, s, s2 = states
+            v, nulls = finalize_variance(spec, cnt.values, s.values, s2.values)
+            cols.append(Column(v, nulls, T.DOUBLE))
+        else:
+            # single-state aggregates pass through; min_by/max_by keep
+            # only the value column (states[0])
+            cols.append(states[0])
+    return Batch(tuple(cols), table.active)
 
 
 def merge_partials(partials: Batch, num_keys: int, aggs: Sequence[AggSpec],

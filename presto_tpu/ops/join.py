@@ -133,9 +133,20 @@ def hash_join(probe: Batch, build: Batch,
               out_capacity: int,
               join_type: str = "inner",
               build_output_channels: Optional[Sequence[int]] = None) -> JoinResult:
-    """Join probe x build. join_type in {inner, left}. Output columns are
-    probe.columns ++ build.columns[build_output_channels]."""
-    assert join_type in ("inner", "left")
+    """Join probe x build. join_type in {inner, left, right, full}
+    (spi/plan/JoinType.java:20-23). Output columns are probe.columns ++
+    build.columns[build_output_channels].
+
+    Outer-build emission (RIGHT/FULL, LookupOuterOperator analog): the
+    reference scatters per-build-row match flags during the probe loop
+    and walks unvisited positions afterwards. Here the match flag comes
+    from a scatter-free REVERSE probe -- build keys binary-search the
+    sorted probe keys -- and unmatched build rows append after the
+    matched region through the same prefix-sum expansion, with NULL
+    probe columns. Under a mesh this requires PARTITIONED distribution
+    (each build row must live on exactly one worker; plan.distribute
+    forces it)."""
+    assert join_type in ("inner", "left", "right", "full"), join_type
     if build_output_channels is None:
         build_output_channels = range(build.num_columns)
 
@@ -164,13 +175,35 @@ def hash_join(probe: Batch, build: Batch,
     end = jnp.minimum(end, n_build_usable)
 
     cnt = jnp.where(p_usable, end - start, 0).astype(jnp.int64)
-    if join_type == "left":
+    if join_type in ("left", "full"):
         emit = jnp.where(probe.active, jnp.maximum(cnt, 1), 0)
     else:
         emit = cnt
     off = jnp.cumsum(emit) - emit  # exclusive
     total = off[-1] + emit[-1]
-    overflow = total > out_capacity
+
+    outer_build = join_type in ("right", "full")
+    if outer_build:
+        # reverse probe: does any usable probe row carry this build key?
+        sp_words, _ = _sort_build(p_words, p_usable, None)
+        n_probe_usable = jnp.sum(p_usable.astype(jnp.int64))
+        if len(b_words) == 1:
+            bs = jnp.searchsorted(sp_words[0], b_words[0], side="left")
+            be = jnp.searchsorted(sp_words[0], b_words[0], side="right")
+        else:
+            sp_rank, bq_rank = _pack_ranks(list(sp_words), list(b_words))
+            bs = jnp.searchsorted(sp_rank, bq_rank, side="left")
+            be = jnp.searchsorted(sp_rank, bq_rank, side="right")
+        bs = jnp.minimum(bs, n_probe_usable)
+        be = jnp.minimum(be, n_probe_usable)
+        b_matched = b_usable & (be > bs)
+        unmatched = build.active & ~b_matched
+        u = unmatched.astype(jnp.int64)
+        off2 = jnp.cumsum(u) - u  # exclusive, original build row order
+        total2 = total + off2[-1] + u[-1]
+    else:
+        total2 = total
+    overflow = total2 > out_capacity
 
     k = jnp.arange(out_capacity, dtype=jnp.int64)
     # map output slot -> probe row
@@ -182,15 +215,28 @@ def hash_join(probe: Batch, build: Batch,
     srow = jnp.clip(start[prow] + j, 0, nb - 1)
     brow = b_perm[srow]  # back to original build row order
 
+    build_valid = valid & matched
+    all_valid = valid
+    if outer_build:
+        # region 2: slots [total, total2) emit unmatched build rows
+        k2 = k - total
+        brow2 = jnp.clip(jnp.searchsorted(off2, k2, side="right") - 1,
+                         0, nb - 1)
+        valid2 = (k >= total) & (k < total2) & \
+            (k2 - off2[brow2] < u[brow2])
+        brow = jnp.where(valid2, brow2, brow)
+        build_valid = build_valid | valid2
+        all_valid = all_valid | valid2
+
     out_cols: List[Block] = []
     for c in probe.columns:
         out_cols.append(_gather(c, prow, valid))
     for ci in build_output_channels:
         c = build.column(ci)
-        g = _gather(c, brow, valid & matched)
+        g = _gather(c, brow, build_valid)
         out_cols.append(g)
-    out = Batch(tuple(out_cols), valid)
-    return JoinResult(out, total, overflow)
+    out = Batch(tuple(out_cols), all_valid)
+    return JoinResult(out, total2, overflow)
 
 
 from ..block import gather_block as _gather  # shared row gather

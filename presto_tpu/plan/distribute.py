@@ -69,8 +69,19 @@ def _is_repartition_on(node: N.PlanNode, keys) -> bool:
             and list(node.partition_channels) == list(keys))
 
 
-def _is_remote_exchange(node: N.PlanNode) -> bool:
-    return isinstance(node, N.ExchangeNode) and node.scope == "REMOTE"
+def _is_remote_exchange(node: N.PlanNode, *kinds: str) -> bool:
+    """True when `node` is a REMOTE exchange of one of `kinds` (any kind
+    when none given). Idempotency guards must name the kinds THIS pass
+    inserts below the operator in question -- treating any remote
+    exchange as already-distributed would skip e.g. a Sort above a
+    pre-existing REPARTITION, leaving per-worker order only."""
+    return (isinstance(node, N.ExchangeNode) and node.scope == "REMOTE"
+            and (not kinds or node.kind in kinds))
+
+
+def _is_merge_on(node: N.PlanNode, keys) -> bool:
+    return (_is_remote_exchange(node, "MERGE")
+            and list(node.sort_keys) == list(keys))
 
 
 # node kinds through which output ordering survives to the root (the
@@ -152,8 +163,9 @@ def _visit(node: N.PlanNode, join_strategy: str, order_root: bool,
         return _dc.replace(node, source=ex)
 
     if isinstance(node, N.SortNode):
-        if under == "MERGE" or _is_remote_exchange(node.source):
-            return node  # the local sort of a MERGE / pre-distributed
+        if under == "MERGE" or _is_remote_exchange(node.source, "GATHER") \
+                or _is_merge_on(node.source, node.keys):
+            return node  # the local sort of a MERGE / already gathered
         if order_root:
             local = N.SortNode(node.source, node.keys)
             return N.ExchangeNode(local, kind="MERGE", scope="REMOTE",
@@ -162,7 +174,9 @@ def _visit(node: N.PlanNode, join_strategy: str, order_root: bool,
         return _dc.replace(node, source=ex)
 
     if isinstance(node, (N.TopNNode, N.LimitNode)):
-        if under == "GATHER" or _is_remote_exchange(node.source):
+        if under == "GATHER" or _is_remote_exchange(node.source, "GATHER") \
+                or (isinstance(node, N.TopNNode)
+                    and _is_merge_on(node.source, node.keys)):
             return node  # the partial below / the final above the gather
         if isinstance(node, N.TopNNode):
             partial = N.TopNNode(node.source, node.keys, node.count)
@@ -181,7 +195,7 @@ def _visit(node: N.PlanNode, join_strategy: str, order_root: bool,
             ex = N.ExchangeNode(node.source, kind="REPARTITION",
                                 scope="REMOTE", partition_channels=keys)
         else:
-            if _is_remote_exchange(node.source):
+            if _is_remote_exchange(node.source, "GATHER"):
                 return node
             ex = N.ExchangeNode(node.source, kind="GATHER", scope="REMOTE")
         return _dc.replace(node, source=ex)
@@ -195,6 +209,13 @@ def _visit(node: N.PlanNode, join_strategy: str, order_root: bool,
 
     if isinstance(node, N.JoinNode):
         strategy = join_strategy
+        if node.join_type in ("right", "full"):
+            # outer-build emission requires each build row to live on
+            # exactly ONE worker (a replicated build would emit its
+            # unmatched rows once per worker) -- PARTITIONED always,
+            # like the reference's mustPartition join-type check in
+            # DetermineJoinDistributionType
+            strategy = "partitioned"
         if strategy == "automatic":
             # cost model: broadcast only when the build side is provably
             # small (its replicated copy must fit every worker); unknown

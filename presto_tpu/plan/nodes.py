@@ -128,14 +128,19 @@ class AggregationNode(PlanNode):
     def output_types(self):
         src = self.source.output_types()
         out = [src[c] for c in self.group_channels]
+        if self.step in ("SINGLE", "FINAL"):
+            # finalized steps emit exactly one column per aggregate
+            # (the reference's evaluateFinal contract); only PARTIAL
+            # ships raw state columns over exchanges
+            out.extend(a.output_type for a in self.aggregates)
+            return out
         from ..ops.aggregation import _sum_type
         for a in self.aggregates:
             c = a.canonical
-            if c == "avg":  # (sum, count) state pair at every step
+            if c == "avg":  # (sum, count) state pair
                 out.extend([_sum_type(src[a.input_channel]), T.BIGINT])
             elif c in ("var_samp", "var_pop", "stddev_samp", "stddev_pop"):
-                # raw (count, sum, sumsq) moments; finalize_variance is a
-                # projection the plan builder adds on top
+                # raw (count, sum, sumsq) moments
                 out.extend([T.BIGINT, T.DOUBLE, T.DOUBLE])
             elif c in ("min_by", "max_by"):
                 out.extend([a.output_type, a.second_type or T.BIGINT])
@@ -150,7 +155,7 @@ class JoinNode(PlanNode):
     right: PlanNode
     left_keys: List[int]
     right_keys: List[int]
-    join_type: str = "inner"          # inner | left
+    join_type: str = "inner"          # inner | left | right | full
     distribution: str = "partitioned"  # partitioned | broadcast (REPLICATED)
     right_output_channels: Optional[List[int]] = None
     out_capacity: Optional[int] = None

@@ -81,7 +81,7 @@ def validate_plan(root: N.PlanNode, distributed: bool = False) -> List[str]:
                 elif a.canonical == "approx_percentile" and a.parameter is None:
                     out.append("approx_percentile without a fraction")
         elif isinstance(n, N.JoinNode):
-            if n.join_type not in ("inner", "left"):
+            if n.join_type not in ("inner", "left", "right", "full"):
                 out.append(f"unsupported join type {n.join_type!r}")
             lt = n.left.output_types()
             rt = n.right.output_types()

@@ -128,7 +128,7 @@ class TableRef:
 
 @dataclasses.dataclass
 class Join:
-    kind: str  # "inner" | "left"
+    kind: str  # "inner" | "left" | "right" | "full" | "cross"
     table: TableRef
     condition: object
 
@@ -554,6 +554,16 @@ class _Parser:
                 kind = "left"
                 self.accept_kw("outer")
                 self.expect_kw("join")
+            elif self.accept_ctx_kw("right", before_kw="join") or \
+                    self.accept_ctx_kw("right", before_kw="outer"):
+                kind = "right"
+                self.accept_kw("outer")
+                self.expect_kw("join")
+            elif self.accept_ctx_kw("full", before_kw="join") or \
+                    self.accept_ctx_kw("full", before_kw="outer"):
+                kind = "full"
+                self.accept_kw("outer")
+                self.expect_kw("join")
             elif self.accept_kw("join"):
                 kind = "inner"
             if kind is None:
@@ -602,13 +612,15 @@ class _Parser:
         return SelectItem(e, alias)
 
     def _implicit_alias(self) -> Optional[str]:
-        """An identifier alias -- but not the contextual keyword CROSS
-        when it introduces the next CROSS JOIN."""
+        """An identifier alias -- but not the contextual keywords CROSS/
+        RIGHT/FULL when they introduce the next join (Presto keeps them
+        non-reserved; SqlBase.g4 nonReserved)."""
         if self.peek()[0] != "ident":
             return None
-        if self.peek()[1].lower() == "cross":
+        w = self.peek()[1].lower()
+        if w in ("cross", "right", "full"):
             k2, v2 = self.toks[self.i + 1]
-            if k2 == "kw" and v2 == "join":
+            if k2 == "kw" and v2 in ("join", "outer"):
                 return None
         return self.next()[1]
 

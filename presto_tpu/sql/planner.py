@@ -22,7 +22,7 @@ import numpy as np
 from .. import types as T
 from ..connectors import tpch
 from ..expr import ir as E
-from ..ops.aggregation import AggSpec, state_width
+from ..ops.aggregation import AggSpec
 from ..plan import nodes as N
 from . import parser as P
 
@@ -691,12 +691,37 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
                         continue
                 residual.append(c)
             assert lkeys, f"no equi-join keys in ON {j.condition}"
+            # Residual (non-equi) ON conjuncts: for INNER joins a
+            # post-join filter is equivalent; for OUTER joins it is NOT
+            # (it would drop the preserved side's unmatched rows), so
+            # single-side residuals push below the join onto the
+            # NON-preserved side (valid: rows failing them simply do not
+            # match) and anything else is rejected. Reference:
+            # PredicatePushDown.processInnerJoin/processOuterJoin.
+            post_join = []
+            r_scope = _Scope(dict(r_channels), list(rtys))
+            for r in residual:
+                names: List[P.Name] = []
+                _names_in(r, names)
+                keys_ = [".".join(nm.parts).lower() for nm in names]
+                only_right = all(k_ in r_channels for k_ in keys_)
+                only_left = all(k_ in left_scope.channels for k_ in keys_)
+                if j.kind in ("inner", "left") and only_right:
+                    right = N.FilterNode(right, an.lower(r, r_scope))
+                elif j.kind in ("inner", "right") and only_left:
+                    node = N.FilterNode(node, an.lower(r, left_scope))
+                elif j.kind == "inner":
+                    post_join.append(r)
+                else:
+                    raise NotImplementedError(
+                        f"{j.kind.upper()} JOIN with a residual ON "
+                        f"condition across both sides: {r}")
             node = N.JoinNode(node, right, lkeys, rkeys, j.kind, "partitioned",
                               out_capacity=join_capacity)
             scope_entries += [(r_alias, c) for c in rcols]
             types += rtys
             scope = make_scope()
-            for r in residual:
+            for r in post_join:
                 node = N.FilterNode(node, an.lower(r, scope))
 
     scope = make_scope()
@@ -1462,7 +1487,7 @@ def _plan_aggregation(an, node, scope, q, all_aggs, max_groups):
             spec = AggSpec(aname, in_ch, _agg_output_type(name, arg.type))
         specs.append(spec)
         agg_map[id(f)] = (state_ch, spec)
-        state_ch += state_width(spec)
+        state_ch += 1  # SINGLE-step aggregations emit finalized columns
     node = N.ProjectNode(node, pre_exprs)
     agg = N.AggregationNode(node, list(range(len(q.group_by))), specs,
                             step="SINGLE", max_groups=max_groups)
@@ -1470,24 +1495,15 @@ def _plan_aggregation(an, node, scope, q, all_aggs, max_groups):
 
 
 def _plan_agg_outputs(an, q, pre_scope, agg_map, key_map):
-    """Post-aggregation projection: replace aggregate calls with state
-    refs (finalizing avg as sum/count), group-by expressions with key
+    """Post-aggregation projection: replace aggregate calls with refs to
+    the aggregation node's finalized output channels (avg/variance
+    finalization happens inside the SINGLE/FINAL aggregation step —
+    ops.aggregation.finalize_states), group-by expressions with key
     channels."""
     agg_node_types: Dict[int, T.Type] = {}
 
     def finalize(f: P.Func) -> E.RowExpression:
         ch, spec = agg_map[id(f)]
-        if spec.canonical == "avg":
-            sum_t = T.decimal(38, spec.output_type.scale) \
-                if spec.output_type.is_decimal else T.DOUBLE
-            s = E.input_ref(ch, sum_t)
-            c = E.input_ref(ch + 1, T.BIGINT)
-            return E.call("divide", spec.output_type, s, c)
-        if spec.canonical in ("var_samp", "var_pop", "stddev_samp",
-                              "stddev_pop"):
-            raise NotImplementedError(
-                "variance finalization lands with expression-level state "
-                "finalizers")
         return E.input_ref(ch, spec.output_type)
 
     def rewrite(nde, scope_keys) -> E.RowExpression:

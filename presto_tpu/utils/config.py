@@ -139,6 +139,10 @@ SESSION_PROPERTIES = (
          "local drivers per pipeline; on TPU, batches in flight per chip")
     .add("exchange_compression", "str", "none",
          "none | zstd | zlib for cross-slice SerializedPage exchanges")
+    .add("stats_capacity_refinement", "bool", True,
+         "let connector NDV statistics SHRINK group-table capacities "
+         "(plan.stats.refine_capacities); disable when a hand-set "
+         "max_groups must stay authoritative")
 )
 
 

@@ -1,0 +1,19 @@
+"""Import this FIRST in ad-hoc scripts to force CPU jax (the repo's
+conftest armor, shared): the image sitecustomize registers the axon
+remote-TPU plugin in every interpreter and pins jax_platforms to it;
+when the relay is down any backend init hangs in a retry sleep."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] = (os.environ["XLA_FLAGS"]
+                               + " --xla_force_host_platform_device_count=8").strip()
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    import jax._src.xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass

@@ -20,8 +20,7 @@ def plan():
         AggSpec("count_star", None, T.BIGINT),
         AggSpec("min", 1, T.decimal(12, 2)),
         AggSpec("avg", 1, T.decimal(12, 2))], max_groups=16)
-    return OutputNode(agg, ["rf", "sum_qty", "cnt", "min_qty",
-                            "avg_sum", "avg_cnt"])
+    return OutputNode(agg, ["rf", "sum_qty", "cnt", "min_qty", "avg_qty"])
 
 
 def as_map(res):
