@@ -3,25 +3,52 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-value        = rows/sec through the full q1 pipeline (filter + project +
-               8-aggregate group-by over 6M*SF lineitem rows), steady
-               state, data resident in HBM (the reference measures its
-               operator pipelines the same way -- in-memory pages,
-               BenchmarkSuite.java:32 / HandTpchQuery1.java).
+value        = rows/sec through the FULL SQL front door: the official
+               q1 text goes parser -> analyzer/planner -> connector-NDV
+               capacity refinement -> XLA lowering -> kernels (the
+               engine pipeline the reference benchmarks with
+               BenchmarkSuite.java:32; its HandTpchQuery1 hand-built
+               variant is reported in detail.hand_built_rows_per_sec).
 vs_baseline  = speedup vs a single-core numpy columnar implementation of
                the same query on this host (stand-in for the reference's
                per-worker Java operator pipeline, which publishes no
                absolute numbers -- BASELINE.md "published == {}").
 
-Env knobs: BENCH_SF (default 1.0), BENCH_ITERS (default 5).
+The run is only SCORING when it executed on the TPU: detail.platform
+says where it ran, and detail.scoring is false on the CPU fallback (the
+remote-TPU relay can be down; the watchdog retries with backoff before
+giving up -- round-2's one-shot fallback recorded a meaningless CPU
+number as the round artifact).
+
+Env knobs: BENCH_SF (default 1.0), BENCH_ITERS (default 5),
+BENCH_TUNNEL_RETRIES (default 4), BENCH_INIT_TIMEOUT (seconds, per
+probe attempt), BENCH_QUERY (q1 | q6).
 """
 
 import json
 import os
-import sys
 import time
 
 import numpy as np
+
+# Official TPC-H q1 (spec text, dialect-adapted to this engine's
+# unprefixed tpch column names -- same adaptation documented in
+# queries/tpch_queries.py).
+TPCH_Q1 = """
+SELECT returnflag, linestatus,
+       sum(quantity) AS sum_qty,
+       sum(extendedprice) AS sum_base_price,
+       sum(extendedprice * (1 - discount)) AS sum_disc_price,
+       sum(extendedprice * (1 - discount) * (1 + tax)) AS sum_charge,
+       avg(quantity) AS avg_qty,
+       avg(extendedprice) AS avg_price,
+       avg(discount) AS avg_disc,
+       count(*) AS count_order
+FROM lineitem
+WHERE shipdate <= date '1998-09-02'
+GROUP BY returnflag, linestatus
+ORDER BY returnflag, linestatus
+"""
 
 
 def _numpy_q1(cols, cutoff):
@@ -46,16 +73,18 @@ def _numpy_q1(cols, cutoff):
 
 
 def _watchdog_main() -> int:
-    """Parent mode: run the benchmark in a child process; if the child
-    produces no output within BENCH_INIT_TIMEOUT + runtime allowance
-    (the remote-TPU relay outage blocks backend init indefinitely --
-    observed in round 1; see tests/conftest.py), kill it and re-run on
-    pure CPU with the TPU plugin's site hook stripped."""
+    """Parent mode: run the benchmark in a child process. Backend init
+    against the remote-TPU relay can hang indefinitely when the tunnel
+    is down (observed rounds 1-2; see tests/conftest.py), so a cheap
+    init probe bounds each attempt -- and the probe RETRIES with backoff
+    (the tunnel has come back within minutes historically) before the
+    run is allowed to fall back to CPU, where it is marked non-scoring."""
     import subprocess
     import sys
 
     init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "240"))
     run_timeout = float(os.environ.get("BENCH_RUN_TIMEOUT", "1800"))
+    retries = int(os.environ.get("BENCH_TUNNEL_RETRIES", "4"))
     errors = []
 
     def run(extra_env, timeout, probe=False):
@@ -79,12 +108,17 @@ def _watchdog_main() -> int:
                           + (" (backend init probe)" if probe else ""))
             return None
 
-    # phase 1: a cheap backend-init probe bounded by BENCH_INIT_TIMEOUT,
-    # so a wedged TPU tunnel is detected without the full run allowance
     out = None
-    if run({}, init_timeout, probe=True) is not None:
-        # the real child re-pays backend init in its own process
-        out = run({}, init_timeout + run_timeout)
+    for attempt in range(retries):
+        if run({}, init_timeout, probe=True) is not None:
+            # tunnel is up: the real child re-pays backend init itself
+            out = run({}, init_timeout + run_timeout)
+            break
+        if attempt < retries - 1:
+            backoff = min(60 * (2 ** attempt), 300)
+            errors.append(f"probe attempt {attempt + 1}/{retries} failed; "
+                          f"retrying in {backoff:.0f}s")
+            time.sleep(backoff)
     if out is None:
         out = run({"JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
                    "BENCH_PLATFORM_NOTE": "cpu-fallback (tpu tunnel down)"},
@@ -92,7 +126,8 @@ def _watchdog_main() -> int:
     if out is None:
         out = json.dumps({"metric": "tpch_q1_rows_per_sec", "value": 0,
                           "unit": "rows/s", "vs_baseline": 0,
-                          "detail": {"error": "; ".join(errors)[-500:]}})
+                          "detail": {"error": "; ".join(errors)[-500:],
+                                     "scoring": False}})
     print(out)
     return 0
 
@@ -104,16 +139,9 @@ def main():
 
     import jax
 
-    if os.environ.get("BENCH_GROUPBY") == "sort":
-        # A/B hook: measure the retired sort-based group-id kernel
-        # against the default hash-slot kernel. misc.py bound the name
-        # by value at import, so patch both modules.
-        from presto_tpu.ops import aggregation as _agg, misc as _misc
-        _agg._group_ids = _agg._group_ids_sort
-        _misc._group_ids = _agg._group_ids_sort
-
     platform = os.environ.get("BENCH_PLATFORM_NOTE") or \
         jax.devices()[0].platform
+    scoring = not platform.startswith("cpu")
 
     if query == "q6":
         return _bench_q6(sf, iters, platform)
@@ -135,10 +163,28 @@ def main():
     _numpy_q1(host_cols, cutoff)
     numpy_s = time.time() - t0
 
-    dt, staged_bytes = _stage_and_time(host_cols, Q1_COLUMNS, capacity,
-                                       q1_local(), iters)
+    # --- SQL front door (the headline): parse/plan/refine ONCE, then
+    # time the compiled engine pipeline exactly like the hand-built one
+    t_plan = time.time()
+    from presto_tpu.exec.planner import compile_plan
+    from presto_tpu.plan.stats import refine_capacities
+    from presto_tpu.sql.planner import plan_sql
+    plan = refine_capacities(plan_sql(TPCH_Q1), sf)
+    cp = compile_plan(plan)
+    plan_s = time.time() - t_plan
+    assert len(cp.scan_nodes) == 1
+    scan_cols = cp.scan_nodes[0].columns
+    sql_host = tpch.generate_columns("lineitem", sf, scan_cols)
+    dt_sql, sql_staged_bytes = _stage_and_time(sql_host, scan_cols, capacity,
+                                               cp.fn, iters, wrap_seq=True)
+    sql_fallback = _TIMING_FALLBACK
 
-    rows_per_sec = n / dt
+    # --- hand-built plan (HandTpchQuery1 analog), for engine-overhead
+    # comparison
+    dt_hand, staged_bytes = _stage_and_time(host_cols, Q1_COLUMNS, capacity,
+                                            q1_local(), iters)
+
+    rows_per_sec = n / dt_sql
     baseline_rows_per_sec = n / numpy_s
     result = {
         "metric": f"tpch_sf{sf:g}_q1_rows_per_sec",
@@ -146,21 +192,28 @@ def main():
         "unit": "rows/s",
         "vs_baseline": round(rows_per_sec / baseline_rows_per_sec, 3),
         "detail": {
-            "query_wall_s": round(dt, 5),
+            "path": "sql-front-door (parser->planner->NDV refine->XLA)",
+            "query_wall_s": round(dt_sql, 5),
+            "hand_built_wall_s": round(dt_hand, 5),
+            "hand_built_rows_per_sec": round(n / dt_hand),
+            "plan_wall_s": round(plan_s, 3),
             "numpy_singlecore_wall_s": round(numpy_s, 4),
             "datagen_wall_s": round(gen_s, 2),
             "rows": n,
-            "staged_mb": round(staged_bytes / 1e6, 1),
-            "achieved_gb_per_s": round(staged_bytes / dt / 1e9, 1),
-            "timing_fallback": _TIMING_FALLBACK,
+            "staged_mb": round(sql_staged_bytes / 1e6, 1),
+            "achieved_gb_per_s": round(sql_staged_bytes / dt_sql / 1e9, 1),
+            "hand_built_staged_mb": round(staged_bytes / 1e6, 1),
+            "timing_fallback": sql_fallback or _TIMING_FALLBACK,
             "platform": platform,
+            "scoring": scoring,
             "iters": iters,
         },
     }
     print(json.dumps(result))
 
 
-def _stage_and_time(host_cols, columns, capacity, pipeline_fn, iters):
+def _stage_and_time(host_cols, columns, capacity, pipeline_fn, iters,
+                    wrap_seq=False):
     """The one staging/warmup/timing harness both benchmarks share.
 
     Timing is done by *differencing* two windows -- ``iters`` and
@@ -173,6 +226,9 @@ def _stage_and_time(host_cols, columns, capacity, pipeline_fn, iters):
     execution finished.  Fetching the (tiny) result forces a full
     round-trip; differencing the two windows cancels that fixed
     latency, leaving pure per-iteration device time.
+
+    ``wrap_seq``: pipeline_fn is a CompiledPlan.fn taking a SEQUENCE of
+    scan batches (vs a single batch).
     """
     import jax
 
@@ -183,8 +239,12 @@ def _stage_and_time(host_cols, columns, capacity, pipeline_fn, iters):
     batch = jax.block_until_ready(jax.device_put(
         batch_from_numpy(types, [host_cols[c] for c in columns],
                          capacity=capacity)))
-    run = jax.jit(pipeline_fn)
-    jax.device_get(run(batch))  # warm-up / compile + full round trip
+    fn = (lambda b: pipeline_fn([b])) if wrap_seq else pipeline_fn
+    run = jax.jit(fn)
+    warm = jax.device_get(run(batch))  # warm-up / compile + round trip
+    if wrap_seq and int(np.asarray(warm[1])) != 0:
+        raise RuntimeError("benchmark plan overflowed a static capacity; "
+                           "timing would measure garbage")
 
     def window(k):
         t0 = time.time()
@@ -224,7 +284,9 @@ def _bench_q6(sf, iters, platform):
                    "staged_mb": round(staged_bytes / 1e6, 1),
                    "achieved_gb_per_s": round(staged_bytes / dt / 1e9, 1),
                    "timing_fallback": _TIMING_FALLBACK,
-                   "platform": platform, "iters": iters}}))
+                   "platform": platform,
+                   "scoring": not platform.startswith("cpu"),
+                   "iters": iters}}))
 
 
 if __name__ == "__main__":

@@ -42,8 +42,9 @@ def streamable_agg_shape(root: N.PlanNode) -> Optional[Tuple[N.AggregationNode,
     node = root.source if isinstance(root, N.OutputNode) else root
     if not isinstance(node, N.AggregationNode) or node.step != "SINGLE":
         return None
-    if any(a.canonical == "count_distinct" for a in node.aggregates):
-        return None  # distinct states don't merge across splits
+    if any(a.canonical in ("count_distinct", "approx_percentile")
+           for a in node.aggregates):
+        return None  # value-order states don't merge across splits
     cur = node.source
     while isinstance(cur, (N.FilterNode, N.ProjectNode)):
         cur = cur.source

@@ -79,6 +79,20 @@ def combine_limb_totals_128(totals, limb_bits: int = 13):
     return hi, lo
 
 
+def limbs13_of_i64(v, nlimbs: int = 5):
+    """Split int64 values into `nlimbs` 13-bit limbs (low first; last
+    limb is the signed remainder). The one shared decomposition behind
+    the exact-sum kernels (limb matmuls, segmented limb cumsums) --
+    limb width must match combine_limb_totals_128's limb_bits=13."""
+    out = []
+    rem = v.astype(_I64)
+    for _ in range(nlimbs - 1):
+        out.append(rem & _I64(0x1FFF))
+        rem = rem >> _I64(13)
+    out.append(rem)  # signed top
+    return out
+
+
 def limbs13_of_128(hi, lo, nlimbs: int = 10):
     """Split (hi, lo) into `nlimbs` 13-bit limbs (low first; the last
     limb is the signed remainder) for exact-matmul or scatter

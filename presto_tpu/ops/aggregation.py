@@ -203,12 +203,8 @@ def _limb_matmul_sum(ids, v, max_groups: int, nlimbs: int = 5,
     pad = c * chunk - n
     i = jnp.pad(ids, (0, pad), constant_values=max_groups)
     x = jnp.pad(v.astype(jnp.int64), (0, pad))
-    limbs = []
-    rem = x
-    for _ in range(nlimbs - 1):
-        limbs.append((rem & 0x1FFF).astype(jnp.float32))
-        rem = rem >> 13
-    limbs.append(rem.astype(jnp.float32))  # signed top limb
+    from ..int128 import limbs13_of_i64
+    limbs = [l.astype(jnp.float32) for l in limbs13_of_i64(x, nlimbs)]
     lm = jnp.stack(limbs, axis=1).reshape(c, chunk, nlimbs)
     oh = (i.reshape(c, chunk)[:, :, None]
           == jnp.arange(max_groups, dtype=jnp.int32)).astype(jnp.float32)
@@ -264,17 +260,12 @@ def _sum128(ids, col, live, max_groups: int):
     int64 decompose into 13-bit limbs whose int64/matmul totals are
     exact, then recombine into (hi, lo) once per group -- no 128-bit
     pairwise adds anywhere in the hot loop)."""
-    from ..int128 import combine_limb_totals_128, limbs13_of_128
+    from ..int128 import (combine_limb_totals_128, limbs13_of_128,
+                          limbs13_of_i64)
     if isinstance(col, Int128Column):
         limbs = limbs13_of_128(col.hi, col.lo)  # 10 x int64
     else:
-        v = col.values.astype(jnp.int64)
-        limbs = []
-        rem = v
-        for _ in range(4):
-            limbs.append(rem & 0x1FFF)
-            rem = rem >> 13
-        limbs.append(rem)  # signed top
+        limbs = limbs13_of_i64(col.values)  # 5 x int64
     totals = [_seg_add(ids, jnp.where(live, l, 0), max_groups)
               for l in limbs]
     return combine_limb_totals_128(jnp.stack(totals, axis=-1))
@@ -498,18 +489,13 @@ def _sorted_states(spec: AggSpec, scol, live, start, end, new_seg,
 
     if name in ("sum", "avg") and (isinstance(scol, Int128Column)
                                    or scol.type.is_decimal):
-        from ..int128 import combine_limb_totals_128, limbs13_of_128
+        from ..int128 import (combine_limb_totals_128, limbs13_of_128,
+                              limbs13_of_i64)
         sum_ty = spec.output_type if name == "sum" else _sum_type(scol.type)
         if isinstance(scol, Int128Column):
             limbs = limbs13_of_128(scol.hi, scol.lo)
         else:
-            v = scol.values.astype(jnp.int64)
-            limbs = []
-            rem = v
-            for _ in range(4):
-                limbs.append(rem & 0x1FFF)
-                rem = rem >> 13
-            limbs.append(rem)
+            limbs = limbs13_of_i64(scol.values)
         totals = [_seg_total(jnp.where(live, l, 0), start, end)
                   for l in limbs]
         hi, lo = combine_limb_totals_128(jnp.stack(totals, axis=-1))
@@ -523,12 +509,8 @@ def _sorted_states(spec: AggSpec, scol, live, start, end, new_seg,
         sv = v.astype(_sum_dtype(scol.type))
         if sv.dtype == jnp.int64:
             # 13-bit limb cumsums keep every intermediate exact
-            limbs = []
-            rem = sv
-            for _ in range(4):
-                limbs.append(rem & 0x1FFF)
-                rem = rem >> 13
-            limbs.append(rem)
+            from ..int128 import limbs13_of_i64
+            limbs = limbs13_of_i64(sv)
             tot = jnp.zeros(g, dtype=jnp.int64)
             for li, l in enumerate(limbs):
                 tot = tot + (_seg_total(jnp.where(live, l, 0), start, end)

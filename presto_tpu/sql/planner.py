@@ -715,7 +715,9 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
                 else:
                     raise NotImplementedError(
                         f"{j.kind.upper()} JOIN with a residual ON "
-                        f"condition across both sides: {r}")
+                        f"condition that references the preserved side "
+                        f"(it cannot be pushed below the join without "
+                        f"dropping unmatched rows): {r}")
             node = N.JoinNode(node, right, lkeys, rkeys, j.kind, "partitioned",
                               out_capacity=join_capacity)
             scope_entries += [(r_alias, c) for c in rcols]

@@ -8,25 +8,15 @@ exercised without TPU hardware.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force: the profile env pins "axon"
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-).strip()
+# The one shared CPU-forcing armor (env + axon-factory removal) lives in
+# scripts/_cpu.py so ad-hoc scripts and the suite can't drift apart.
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+import _cpu  # noqa: E402,F401
 
 import jax  # noqa: E402  (import after env setup)
-
-# The image's sitecustomize registers a remote-TPU PJRT plugin ("axon") in
-# every interpreter (importing jax in the process, so the env var above is
-# captured too late) and pins jax_platforms to it; when the axon relay is
-# down, *any* backend init hangs. Tests are CPU-only by design -- re-pin
-# the platform and drop the factory so the suite never touches the tunnel.
-jax.config.update("jax_platforms", "cpu")
-try:  # pragma: no cover - environment armor
-    import jax._src.xla_bridge as _xb
-    _xb._backend_factories.pop("axon", None)
-except Exception:
-    pass
 
 import numpy as np
 import pytest
