@@ -14,7 +14,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional, Sequence
 
-__all__ = ["VerifierResult", "verify_corpus", "DEFAULT_CORPUS"]
+__all__ = ["VerifierResult", "verify_corpus", "DEFAULT_CORPUS",
+           "TPCDS_CORPUS"]
 
 
 @dataclasses.dataclass
@@ -127,4 +128,34 @@ DEFAULT_CORPUS = [
     "SELECT count(*) FROM orders o WHERE EXISTS "
     "(SELECT l.orderkey FROM lineitem l WHERE l.orderkey = o.orderkey "
     " AND l.quantity > 49.00)",
+    # long-decimal (int128 lane) sums + avg finalization across the
+    # PARTIAL -> exchange -> FINAL path (round-2's shipped regressions)
+    "SELECT returnflag, sum(extendedprice) AS s, avg(extendedprice) AS a "
+    "FROM lineitem GROUP BY returnflag ORDER BY returnflag",
+    # MERGE exchange: root-observable global order, no gather
+    "SELECT orderkey, totalprice FROM orders "
+    "WHERE totalprice > 400000.00 ORDER BY totalprice DESC, orderkey",
+    # RIGHT/FULL OUTER: unmatched-build emission under partitioned
+    # distribution
+    "SELECT r.name, count(n.nationkey) FROM nation n "
+    "RIGHT JOIN region r ON n.regionkey = r.regionkey GROUP BY r.name",
+    "SELECT count(*), count(o.orderkey), count(c.custkey) FROM orders o "
+    "FULL OUTER JOIN customer c ON o.custkey = c.custkey",
+    # large-cardinality group-by (sorted-mode kernel): ~15k groups at
+    # sf=0.01 -- kernel output must be OBSERVABLE (a filter that empties
+    # the result would compare empty==empty and hide drift)
+    "SELECT orderkey, count(*), sum(quantity) FROM lineitem "
+    "GROUP BY orderkey HAVING sum(quantity) >= 90.00",
+]
+
+# TPC-DS shapes resolved against the tpcds catalog (star join + dim
+# filters -- the q3 family the CBO/dynamic-filter work targets)
+TPCDS_CORPUS = [
+    "SELECT dt.d_year, item.i_brand_id, sum(ss_sales_price) AS s "
+    "FROM date_dim dt, store_sales, item "
+    "WHERE dt.d_date_sk = store_sales.ss_sold_date_sk "
+    "  AND store_sales.ss_item_sk = item.i_item_sk "
+    "  AND item.i_manufact_id = 128 AND dt.d_moy = 11 "
+    "GROUP BY dt.d_year, item.i_brand_id "
+    "ORDER BY dt.d_year, s DESC, item.i_brand_id",
 ]
