@@ -388,6 +388,25 @@ def gather_block(b: Block, idx: jax.Array, valid: Optional[jax.Array] = None
     return Column(b.values[idx], nulls, b.type)
 
 
+def null_like(b: Block) -> Block:
+    """An all-NULL block with the same capacity/type/layout as `b`
+    (GroupIdNode's dropped-key columns; the reference materializes the
+    same via null Blocks in GroupIdOperator)."""
+    n = len(b)
+    ones = jnp.ones(n, dtype=bool)
+    if isinstance(b, DictionaryColumn):
+        b = b.decode()
+    if isinstance(b, StringColumn):
+        return StringColumn(b.chars, jnp.zeros(n, dtype=jnp.int32), ones,
+                            b.type)
+    if isinstance(b, ArrayColumn):
+        return ArrayColumn(b.elements, b.elem_nulls,
+                           jnp.zeros(n, dtype=jnp.int32), ones, b.type)
+    if isinstance(b, Int128Column):
+        return Int128Column(b.hi, b.lo, ones, b.type)
+    return Column(b.values, ones, b.type)
+
+
 def concat_batches(batches: Sequence[Batch]) -> Batch:
     """Concatenate batches (device-side). Capacities add."""
     cols = []

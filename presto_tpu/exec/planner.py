@@ -56,11 +56,18 @@ class CompiledPlan:
     distributed: bool
 
 
-def _collect_scans(node: N.PlanNode, out: List[N.PlanNode]):
+def _collect_scans(node: N.PlanNode, out: List[N.PlanNode], _seen=None):
+    """Leaf collection, identity-deduped: a plan DAG (shared CTE
+    subtree) stages each shared scan ONCE."""
+    if _seen is None:
+        _seen = set()
+    if id(node) in _seen:
+        return
+    _seen.add(id(node))
     if isinstance(node, (N.TableScanNode, N.ValuesNode, N.RemoteSourceNode)):
         out.append(node)
     for s in node.sources:
-        _collect_scans(s, out)
+        _collect_scans(s, out, _seen)
 
 
 def compile_plan(root: N.PlanNode, mesh=None,
@@ -82,6 +89,16 @@ def compile_plan(root: N.PlanNode, mesh=None,
         return min(base * exchange_slot_scale, max(sender_capacity, 1))
 
     def lower(node: N.PlanNode, inputs: Dict[str, Batch]) -> Batch:
+        # identity memo: a shared subtree (CTE planned once -> plan DAG)
+        # is traced once and its staged batch reused at every reference
+        key = id(node)
+        if key in _lower_memo:
+            return _lower_memo[key]
+        out = _lower(node, inputs)
+        _lower_memo[key] = out
+        return out
+
+    def _lower(node: N.PlanNode, inputs: Dict[str, Batch]) -> Batch:
         if isinstance(node, (N.TableScanNode, N.ValuesNode,
                              N.RemoteSourceNode)):
             return inputs[node.id]
@@ -236,6 +253,22 @@ def compile_plan(root: N.PlanNode, mesh=None,
                                  node.with_ordinality)
             _note_overflow(ovf)
             return out
+        if isinstance(node, N.GroupIdNode):
+            from ..block import Column, concat_batches, null_like
+            src = lower(node.source, inputs)
+            keyset = set(node.key_channels)
+            parts = []
+            for gi, kept in enumerate(node.grouping_sets):
+                cols = []
+                for ci, c in enumerate(src.columns):
+                    if ci in keyset and ci not in kept:
+                        cols.append(null_like(c))
+                    else:
+                        cols.append(c)
+                gid = Column(jnp.full(src.capacity, gi, dtype=jnp.int64),
+                             jnp.zeros(src.capacity, dtype=bool), T.BIGINT)
+                parts.append(Batch(tuple(cols) + (gid,), src.active))
+            return concat_batches(parts)
         if isinstance(node, N.ExchangeNode):
             if node.kind == "MERGE" and dist and node.scope == "REMOTE":
                 # MergeOperator analog on the mesh: sampled range
@@ -283,6 +316,7 @@ def compile_plan(root: N.PlanNode, mesh=None,
         raise TypeError(type(node))
 
     overflow_box: List = []
+    _lower_memo: Dict[int, Batch] = {}
 
     def _note_overflow(flag, scalable: bool = False):
         """scalable=True marks exchange-slot overflow, which the runner
@@ -292,6 +326,7 @@ def compile_plan(root: N.PlanNode, mesh=None,
 
     def run(scan_batches: Sequence[Batch]):
         overflow_box.clear()
+        _lower_memo.clear()
         inputs = {n.id: b for n, b in zip(scans, scan_batches)}
         out = lower(root, inputs)
         hard = jnp.zeros((), dtype=bool)   # join/group capacity

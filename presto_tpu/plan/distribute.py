@@ -107,16 +107,24 @@ def add_exchanges(node: N.PlanNode,
     statistics (DetermineJoinDistributionType.java's AUTOMATIC with a
     row-count cost model) and needs `sf` for the row estimates --
     without it, unknown-size builds fall back to broadcast."""
-    return _visit(node, join_strategy, order_root=True, under=None, sf=sf)
+    return _visit(node, join_strategy, order_root=True, under=None, sf=sf,
+                  memo={})
 
 
 def _visit(node: N.PlanNode, join_strategy: str, order_root: bool,
-           under, sf=None) -> N.PlanNode:
+           under, sf=None, memo=None) -> N.PlanNode:
     """`order_root`: this node's output order is observable at the plan
     root (only Project/Output ancestors). `under`: the exchange kind
     directly above, so already-distributed partials (the local Sort of a
     MERGE, the partial TopN/Limit of a GATHER) are not rewritten again
-    on idempotent re-application."""
+    on idempotent re-application. `memo` keys on (node identity,
+    context) so a shared CTE subtree (plan DAG) stays SHARED through
+    the rewrite instead of splitting into copies."""
+    if memo is None:
+        memo = {}
+    memo_key = (id(node), order_root, under)
+    if memo_key in memo:
+        return memo[memo_key]
     child_order = order_root and isinstance(node, _ORDER_TRANSPARENT)
     # rebuild children first
     replaced = {}
@@ -125,16 +133,22 @@ def _visit(node: N.PlanNode, join_strategy: str, order_root: bool,
         child_under = node.kind if isinstance(node, N.ExchangeNode) \
             and node.scope == "REMOTE" else None
         if isinstance(v, N.PlanNode):
-            nv = _visit(v, join_strategy, child_order, child_under, sf)
+            nv = _visit(v, join_strategy, child_order, child_under, sf, memo)
             if nv is not v:
                 replaced[f.name] = nv
         elif isinstance(v, list) and v and isinstance(v[0], N.PlanNode):
-            nl = [_visit(s, join_strategy, child_order, child_under, sf)
+            nl = [_visit(s, join_strategy, child_order, child_under, sf, memo)
                   for s in v]
             if any(a is not b for a, b in zip(nl, v)):
                 replaced[f.name] = nl
     if replaced:
         node = _dc.replace(node, **replaced)
+    memo[memo_key] = _rewrite(node, join_strategy, order_root, sf, under)
+    return memo[memo_key]
+
+
+def _rewrite(node: N.PlanNode, join_strategy: str, order_root: bool,
+             sf, under) -> N.PlanNode:
 
     if isinstance(node, N.AggregationNode) and node.step == "SINGLE":
         if any(a.canonical in ("count_distinct", "approx_percentile")

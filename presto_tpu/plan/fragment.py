@@ -79,23 +79,51 @@ def fragment_plan(root: PlanNode) -> List[PlanFragment]:
     RemoteSourceNode naming it -- the shape the scheduler ships to
     workers (each fragment is self-contained). Returns fragments
     root-last, ids in creation order. The input tree is not mutated;
-    consumer-side nodes above a cut are shallow-copied."""
+    consumer-side nodes above a cut are shallow-copied.
+
+    DAG-aware (CTE planned once): identical cuts -- same shared child
+    subtree by identity, same output partitioning -- reuse ONE producer
+    fragment; every reference gets its own RemoteSourceNode naming it
+    (buffer pulls are non-destructive, so multiple consumers can read
+    one producer -- the CteProducer/CteConsumer analog realized through
+    buffer fan-out). Shared subtrees cut under DIFFERENT partitionings
+    still duplicate (true CTE materialization + re-shuffle is a
+    scheduler-depth item)."""
     import dataclasses as _dc
 
     from .nodes import RemoteSourceNode
 
     fragments: List[PlanFragment] = []
+    memo = {}       # id(original node) -> (rebuilt node, feeds)
+    cut_memo = {}   # (id(child), partitioning signature) -> fragment id
 
     def walk(node: PlanNode) -> Tuple[PlanNode, List[int]]:
+        hit = memo.get(id(node))
+        if hit is not None:
+            return hit
+        out = _walk(node)
+        memo[id(node)] = out
+        return out
+
+    def _walk(node: PlanNode) -> Tuple[PlanNode, List[int]]:
         if isinstance(node, ExchangeNode) and node.scope == "REMOTE":
-            child, child_feeds = walk(node.source)
             part = ("HASH" if node.kind == "REPARTITION" else
                     "BROADCAST" if node.kind == "REPLICATE" else
                     "SORTED" if node.kind == "MERGE" else "SINGLE")
+            ck = (id(node.source), part, tuple(node.partition_channels),
+                  tuple(map(tuple, node.sort_keys or [])))
+            if ck in cut_memo:
+                fid = cut_memo[ck]
+                types = fragments[fid].root.output_types()
+                # a FRESH RemoteSourceNode per reference: consumers name
+                # the shared producer independently in their specs
+                return RemoteSourceNode(list(types), fid), [fid]
+            child, child_feeds = walk(node.source)
             frag = PlanFragment(len(fragments), child, part, child_feeds,
                                 list(node.partition_channels),
                                 list(node.sort_keys or []))
             fragments.append(frag)
+            cut_memo[ck] = frag.id
             rs = RemoteSourceNode(list(child.output_types()), frag.id)
             return rs, [frag.id]
         feeds: List[int] = []

@@ -82,6 +82,14 @@ def column_source(node: N.PlanNode, channel: int
         if channel < n_src:
             return column_source(node.sources[0], channel)
         return None  # appended function outputs
+    if isinstance(node, N.GroupIdNode):
+        # key channels keep their source NDV bound (NULL injection adds
+        # at most the nullable_slack group); the gid channel is handled
+        # in estimate_distinct
+        n_src = len(node.source.output_types())
+        if channel < n_src:
+            return column_source(node.source, channel)
+        return None
     return None
 
 
@@ -89,6 +97,9 @@ def estimate_distinct(node: N.PlanNode, channel: int,
                       sf: float) -> Optional[int]:
     """Distinct-count upper bound for one output channel, from the
     originating connector's statistics."""
+    if isinstance(node, N.GroupIdNode) and \
+            channel == len(node.source.output_types()):
+        return len(node.grouping_sets)  # the appended gid column
     src = column_source(node, channel)
     if src is None:
         return None
@@ -120,7 +131,7 @@ def estimate_group_bound(node: N.PlanNode, channels, sf: float,
     return bound
 
 
-def refine_capacities(node: N.PlanNode, sf: float) -> N.PlanNode:
+def refine_capacities(node: N.PlanNode, sf: float, _memo=None) -> N.PlanNode:
     """Physical-capacity pass (run at execution time, when sf is known):
     SHRINK group-table capacities to the NDV bound the connector proves.
     Small tables route group-by to the scatter-free MXU kernels
@@ -128,18 +139,25 @@ def refine_capacities(node: N.PlanNode, sf: float) -> N.PlanNode:
     scatter path on TPU. Bounds are upper bounds, so shrinking can never
     cause overflow; capacities are never grown (a user's explicit small
     max_groups stays authoritative, and an explicit large one only
-    shrinks when the connector PROVES fewer groups are possible)."""
+    shrinks when the connector PROVES fewer groups are possible).
+    Identity-memoized so shared CTE subtrees (plan DAGs) stay shared."""
     import dataclasses as _dc
+
+    if _memo is None:
+        _memo = {}
+    if id(node) in _memo:
+        return _memo[id(node)]
+    orig_key = id(node)
 
     replaced = {}
     for f in _dc.fields(node):
         v = getattr(node, f.name)
         if isinstance(v, N.PlanNode):
-            nv = refine_capacities(v, sf)
+            nv = refine_capacities(v, sf, _memo)
             if nv is not v:
                 replaced[f.name] = nv
         elif isinstance(v, list) and v and isinstance(v[0], N.PlanNode):
-            nl = [refine_capacities(s, sf) for s in v]
+            nl = [refine_capacities(s, sf, _memo) for s in v]
             if any(a is not b for a, b in zip(nl, v)):
                 replaced[f.name] = nl
     if replaced:
@@ -157,6 +175,7 @@ def refine_capacities(node: N.PlanNode, sf: float) -> N.PlanNode:
             cap = max(-(-bound // 8) * 8, 8)
             if cap < node.max_groups:
                 node = _dc.replace(node, max_groups=cap)
+    _memo[orig_key] = node
     return node
 
 
@@ -210,6 +229,9 @@ def estimate_rows(node: N.PlanNode, sf: float) -> Optional[float]:
     if isinstance(node, N.SampleNode):
         r = estimate_rows(node.source, sf)
         return None if r is None else r * node.ratio
+    if isinstance(node, N.GroupIdNode):
+        r = estimate_rows(node.source, sf)
+        return None if r is None else r * len(node.grouping_sets)
     if node.sources:
         return estimate_rows(node.sources[0], sf)
     return None

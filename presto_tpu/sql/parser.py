@@ -170,6 +170,16 @@ class Rollup:
 
 
 @dataclasses.dataclass
+class Cube:
+    items: List[object]
+
+
+@dataclasses.dataclass
+class GroupingSets:
+    sets: List[List[object]]
+
+
+@dataclasses.dataclass
 class Query:
     select: Select
     table: TableRef
@@ -264,7 +274,8 @@ class _Parser:
             raise ValueError(f"expected {word.upper()}, got {self.peek()}")
 
     def accept_ctx_kw(self, word: str, before_op: Optional[str] = None,
-                      before_kw: Optional[str] = None) -> bool:
+                      before_kw: Optional[str] = None,
+                      before_ident: Optional[str] = None) -> bool:
         """Contextual (non-reserved) keyword: matches an identifier token
         case-insensitively, optionally only when the NEXT token is the
         given operator/keyword -- Presto keeps words like ROLLUP and
@@ -279,9 +290,33 @@ class _Parser:
                 k2, v2 = self.toks[self.i + 1]
                 if not (k2 == "kw" and v2 == before_kw):
                     return False
+            if before_ident is not None:
+                k2, v2 = self.toks[self.i + 1]
+                if not (k2 == "ident" and v2.lower() == before_ident):
+                    return False
             self.next()
             return True
         return False
+
+    def _paren_expr_list(self) -> List[object]:
+        self.expect_op("(")
+        items = [self.expr()]
+        while self.accept_op(","):
+            items.append(self.expr())
+        self.expect_op(")")
+        return items
+
+    def _grouping_set(self) -> List[object]:
+        """One GROUPING SETS element: (a, b) | (single) | () | bare expr."""
+        if self.accept_op("("):
+            if self.accept_op(")"):
+                return []
+            items = [self.expr()]
+            while self.accept_op(","):
+                items.append(self.expr())
+            self.expect_op(")")
+            return items
+        return [self.expr()]
 
     def accept_op(self, *ops) -> Optional[str]:
         k, v = self.peek()
@@ -577,12 +612,18 @@ class _Parser:
         if self.accept_kw("group"):
             self.expect_kw("by")
             if self.accept_ctx_kw("rollup", before_op="("):
+                group_by.append(Rollup(self._paren_expr_list()))
+            elif self.accept_ctx_kw("cube", before_op="("):
+                group_by.append(Cube(self._paren_expr_list()))
+            elif self.accept_ctx_kw("grouping", before_kw=None,
+                                    before_ident="sets"):
+                self.next()  # the already-matched SETS token
                 self.expect_op("(")
-                rollup_items = [self.expr()]
+                sets = [self._grouping_set()]
                 while self.accept_op(","):
-                    rollup_items.append(self.expr())
+                    sets.append(self._grouping_set())
                 self.expect_op(")")
-                group_by.append(Rollup(rollup_items))
+                group_by.append(GroupingSets(sets))
             else:
                 group_by.append(self.expr())
                 while self.accept_op(","):

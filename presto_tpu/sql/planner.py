@@ -293,6 +293,16 @@ def _agg_output_type(name: str, input_type: Optional[T.Type]) -> T.Type:
 _SEARCH_PATH: contextvars.ContextVar = contextvars.ContextVar(
     "search_path", default=("tpch", "tpcds"))
 
+# CTE plan-once cache, scoped to one plan_sql call: the parser inlines a
+# WITH binding as the SAME Query AST object at every reference, so
+# planning memoizes on that object identity and all references share ONE
+# plan subtree. The plan becomes a DAG; lowering traces shared nodes
+# once (exec/planner memoizes by node identity), so a CTE referenced k
+# times is scanned and computed once -- the LogicalCteOptimizer analog,
+# realized by compiler-level sharing instead of materialization.
+_SUBPLAN_CACHE: contextvars.ContextVar = contextvars.ContextVar(
+    "subplan_cache", default=None)
+
 
 def plan_sql(query_text: str, max_groups: int = 1 << 16,
              join_capacity: Optional[int] = None,
@@ -305,9 +315,11 @@ def plan_sql(query_text: str, max_groups: int = 1 << 16,
         path = (catalog,) + tuple(c for c in _SEARCH_PATH.get()
                                   if c != catalog)
         token = _SEARCH_PATH.set(path)
+    cache_token = _SUBPLAN_CACHE.set({})
     try:
         node, names = _plan_any(ast, max_groups, join_capacity)
     finally:
+        _SUBPLAN_CACHE.reset(cache_token)
         if token is not None:
             _SEARCH_PATH.reset(token)
     if isinstance(node, N.OutputNode):
@@ -365,10 +377,44 @@ def _strip_output(node: N.PlanNode) -> N.PlanNode:
     return node.source if isinstance(node, N.OutputNode) else node
 
 
+def _expand_grouping_sets(q: P.Query):
+    """ROLLUP/CUBE/GROUPING SETS -> (query with flattened GROUP BY,
+    kept-index subsets). The single-pass GroupIdNode expansion replaces
+    the k+1-pass UNION rewrite (match: spi/plan/GroupIdNode.java via
+    StatementAnalyzer's grouping-set analysis)."""
+    g = q.group_by[0]
+    if isinstance(g, P.Rollup):
+        items = list(g.items)
+        sets = [list(range(k)) for k in range(len(items), -1, -1)]
+    elif isinstance(g, P.Cube):
+        import itertools
+        items = list(g.items)
+        idx = range(len(items))
+        sets = [list(c) for r in range(len(items), -1, -1)
+                for c in itertools.combinations(idx, r)]
+    else:  # GroupingSets
+        items = []
+        sets = []
+        for s in g.sets:
+            one = []
+            for e in s:
+                for i, it in enumerate(items):
+                    if it == e:
+                        one.append(i)
+                        break
+                else:
+                    items.append(e)
+                    one.append(len(items) - 1)
+            sets.append(one)
+    return dataclasses.replace(q, group_by=items), sets
+
+
 def _plan_query(q: P.Query, max_groups: int = 1 << 16,
                 join_capacity: Optional[int] = None) -> N.PlanNode:
-    if len(q.group_by) == 1 and isinstance(q.group_by[0], P.Rollup):
-        return _plan_rollup(q, max_groups, join_capacity)
+    grouping_sets = None
+    if len(q.group_by) == 1 and isinstance(
+            q.group_by[0], (P.Rollup, P.Cube, P.GroupingSets)):
+        q, grouping_sets = _expand_grouping_sets(q)
     an = _Analyzer(q)
 
     # FROM: scans with pruned columns. First collect every referenced name.
@@ -394,10 +440,19 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
     for t in tables:
         if t.subquery is not None:
             # derived table / inlined CTE: plan the sub-select; its
-            # output names+types form the "schema"
-            sub_node, sub_names = _plan_any(t.subquery, max_groups,
-                                            join_capacity)
-            sub_node = _strip_output(sub_node)
+            # output names+types form the "schema". A CTE referenced
+            # more than once shares ONE planned subtree (plan-once
+            # cache keyed on AST object identity -- see _SUBPLAN_CACHE)
+            cache = _SUBPLAN_CACHE.get()
+            hit = cache.get(id(t.subquery)) if cache is not None else None
+            if hit is not None:
+                sub_node, sub_names = hit
+            else:
+                sub_node, sub_names = _plan_any(t.subquery, max_groups,
+                                                join_capacity)
+                sub_node = _strip_output(sub_node)
+                if cache is not None:
+                    cache[id(t.subquery)] = (sub_node, sub_names)
             sub_types = sub_node.output_types()
             table_catalog[t.name] = None
             table_schemas[t.name] = {n.lower(): ty for n, ty in
@@ -834,7 +889,8 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
 
     if all_aggs or q.group_by:
         node, scope, agg_map, key_map = _plan_aggregation(
-            an, node, scope, q, all_aggs, max_groups)
+            an, node, scope, q, all_aggs, max_groups,
+            grouping_sets=grouping_sets)
         out_exprs, names, having_e, having_subs = _plan_agg_outputs(
             an, q, scope, agg_map, key_map)
         if having_e is not None:
@@ -1323,66 +1379,6 @@ def _attach_scalar_filter(node: N.PlanNode, lhs: E.RowExpression, op: str,
         E.input_ref(i, ntypes[i]) for i in range(nch)])
 
 
-def _plan_rollup(q: P.Query, max_groups: int, join_capacity: Optional[int]):
-    """GROUP BY ROLLUP(a, b, ...) -> UNION ALL of grouping-set
-    aggregations, dropped keys projected as typed NULLs (the reference's
-    GroupIdNode expansion, realized as a plan-level rewrite).
-
-    Known gaps vs the reference's single-pass GroupIdNode plan (ROADMAP
-    'grouping sets'): the FROM/WHERE pipeline is re-planned and re-run
-    once per grouping set (k+1 scans/joins instead of one GroupId row
-    expansion), and HAVING referencing a dropped key errors instead of
-    evaluating it as NULL in the coarser sets."""
-    items = q.group_by[0].items
-    sub_plans = []
-    names0 = None
-    target_types = None
-    for k in range(len(items), -1, -1):
-        kept = items[:k]
-        dropped = items[k:]
-        select = P.Select(
-            [P.SelectItem(P.Literal(None, "null"), _item_name(it, i))
-             if any(it.expr == d for d in dropped) else it
-             for i, it in enumerate(q.select.items)],
-            q.select.distinct)
-        q_k = dataclasses.replace(q, select=select, group_by=list(kept),
-                                  order_by=[], limit=None, having=q.having)
-        node_k, names_k = _plan_query(q_k, max_groups, join_capacity)
-        node_k = _strip_output(node_k)
-        if target_types is None:
-            names0 = names_k
-            target_types = node_k.output_types()
-        else:
-            # typed-NULL alignment: cast every column to the full
-            # grouping's types so the union is type-consistent
-            node_k = N.ProjectNode(node_k, [
-                E.call("cast", target_types[i],
-                       E.input_ref(i, node_k.output_types()[i]))
-                for i in range(len(target_types))])
-        sub_plans.append(node_k)
-    node = N.UnionNode(sub_plans)
-    if q.order_by:
-        scope = _Scope({n.lower(): i for i, n in enumerate(names0)},
-                       list(target_types))
-        keys = []
-        for o in q.order_by:
-            if isinstance(o.expr, P.Name) and \
-                    ".".join(o.expr.parts).lower() in scope.channels:
-                ch = scope.channels[".".join(o.expr.parts).lower()]
-            elif isinstance(o.expr, P.Literal) and o.expr.kind == "int":
-                ch = int(o.expr.value) - 1
-            else:
-                raise NotImplementedError(
-                    "ORDER BY expressions with ROLLUP must be select "
-                    "aliases or ordinals")
-            keys.append((ch, o.descending, o.nulls_last))
-        node = N.TopNNode(node, keys, q.limit) if q.limit is not None \
-            else N.SortNode(node, keys)
-    elif q.limit is not None:
-        node = N.LimitNode(node, q.limit)
-    return node, names0
-
-
 def _item_name(item: P.SelectItem, i: int) -> str:
     if item.alias:
         return item.alias
@@ -1458,9 +1454,11 @@ def _extract_common_or(c):
     return common, new_or
 
 
-def _plan_aggregation(an, node, scope, q, all_aggs, max_groups):
-    """Emit pre-projection + AggregationNode; returns (node, post_scope,
-    agg result channel map, group key channel map)."""
+def _plan_aggregation(an, node, scope, q, all_aggs, max_groups,
+                      grouping_sets=None):
+    """Emit pre-projection (+ GroupIdNode for grouping sets) +
+    AggregationNode; returns (node, post_scope, agg result channel map,
+    group key channel map)."""
     # pre-projection: group keys then agg inputs
     pre_exprs: List[E.RowExpression] = []
     key_map: Dict[int, int] = {}  # index in q.group_by -> channel
@@ -1474,7 +1472,8 @@ def _plan_aggregation(an, node, scope, q, all_aggs, max_groups):
         pre_exprs.append(e)
     specs: List[AggSpec] = []
     agg_map: Dict[int, Tuple[int, AggSpec]] = {}  # id(ast) -> (state ch, spec)
-    state_ch = len(q.group_by)
+    # grouping sets add a hidden group-id KEY channel after the keys
+    state_ch = len(q.group_by) + (1 if grouping_sets is not None else 0)
     for f in all_aggs:
         name = f.name
         if name == "count" and (not f.args or isinstance(f.args[0], P.Star)):
@@ -1491,8 +1490,16 @@ def _plan_aggregation(an, node, scope, q, all_aggs, max_groups):
         agg_map[id(f)] = (state_ch, spec)
         state_ch += 1  # SINGLE-step aggregations emit finalized columns
     node = N.ProjectNode(node, pre_exprs)
-    agg = N.AggregationNode(node, list(range(len(q.group_by))), specs,
-                            step="SINGLE", max_groups=max_groups)
+    nkeys = len(q.group_by)
+    if grouping_sets is not None:
+        node = N.GroupIdNode(node, [list(s) for s in grouping_sets])
+        group_channels = list(range(nkeys)) + [len(pre_exprs)]
+        eff_max_groups = max_groups * len(grouping_sets)
+    else:
+        group_channels = list(range(nkeys))
+        eff_max_groups = max_groups
+    agg = N.AggregationNode(node, group_channels, specs,
+                            step="SINGLE", max_groups=eff_max_groups)
     return agg, scope, agg_map, key_map
 
 

@@ -1,0 +1,130 @@
+"""Grouping sets via GroupIdNode (single-pass row expansion) and CTE
+plan-once sharing (plan DAG + identity-memoized lowering).
+
+Reference behavior: spi/plan/GroupIdNode.java (grouping-set expansion),
+sql/analyzer grouping-set analysis, and
+optimizations/LogicalCteOptimizer.java (CTE planned once)."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.plan import nodes as N
+from presto_tpu.sql.planner import plan_sql, sql
+
+
+def _unique_nodes(plan):
+    ids = {}
+
+    def walk(n, seen):
+        if id(n) in seen:
+            return
+        seen.add(id(n))
+        ids[id(n)] = n
+        for s in n.sources:
+            walk(s, seen)
+
+    walk(plan, set())
+    return list(ids.values())
+
+
+def test_rollup_single_pass_groupid_plan_shape():
+    plan = plan_sql("SELECT returnflag, linestatus, count(*) FROM lineitem "
+                    "GROUP BY ROLLUP(returnflag, linestatus)")
+    nodes = _unique_nodes(plan)
+    gids = [n for n in nodes if isinstance(n, N.GroupIdNode)]
+    scans = [n for n in nodes if isinstance(n, N.TableScanNode)]
+    unions = [n for n in nodes if isinstance(n, N.UnionNode)]
+    assert len(gids) == 1 and gids[0].grouping_sets == [[0, 1], [0], []]
+    assert len(scans) == 1, "single-pass: one scan, not k+1"
+    assert not unions, "GroupIdNode replaces the UNION rewrite"
+
+
+def test_rollup_results_consistent():
+    r = sql("SELECT returnflag, linestatus, sum(quantity) AS q, "
+            "count(*) AS c FROM lineitem "
+            "GROUP BY ROLLUP(returnflag, linestatus) ORDER BY q DESC",
+            sf=0.01)
+    rows = r.rows()
+    full = [x for x in rows if x[0] is not None and x[1] is not None]
+    mid = [x for x in rows if x[0] is not None and x[1] is None]
+    total = [x for x in rows if x[0] is None and x[1] is None]
+    assert len(total) == 1
+    assert sum(x[3] for x in full) == total[0][3]
+    assert sum(x[2] for x in full) == total[0][2]
+    assert sum(x[3] for x in mid) == total[0][3]
+    assert {x[0] for x in mid} == {x[0] for x in full}
+    # ORDER BY q DESC holds (None sorts per nulls_last)
+    qs = [x[2] for x in rows if x[2] is not None]
+    assert qs == sorted(qs, reverse=True)
+
+
+def test_cube_and_grouping_sets():
+    r = sql("SELECT returnflag, linestatus, count(*) AS c FROM lineitem "
+            "GROUP BY CUBE(returnflag, linestatus)", sf=0.01)
+    rows = r.rows()
+    total = [x for x in rows if x[0] is None and x[1] is None]
+    ls_only = [x for x in rows if x[0] is None and x[1] is not None]
+    rf_only = [x for x in rows if x[0] is not None and x[1] is None]
+    assert len(total) == 1 and ls_only and rf_only
+    assert sum(x[2] for x in ls_only) == total[0][2]
+    assert sum(x[2] for x in rf_only) == total[0][2]
+
+    r2 = sql("SELECT returnflag, linestatus, count(*) AS c FROM lineitem "
+             "GROUP BY GROUPING SETS ((returnflag), (linestatus), ())",
+             sf=0.01)
+    rows2 = r2.rows()
+    assert len([x for x in rows2 if x[0] is None and x[1] is None]) == 1
+    # no (rf, ls) pairs: that set was not requested
+    assert not [x for x in rows2 if x[0] is not None and x[1] is not None]
+
+
+def test_having_over_rollup_dropped_key():
+    # HAVING must evaluate over the coarser sets too (NULL keys), not
+    # error -- the gap the old k+1-pass rewrite had
+    r = sql("SELECT returnflag, linestatus, count(*) AS c FROM lineitem "
+            "GROUP BY ROLLUP(returnflag, linestatus) "
+            "HAVING count(*) > 10000", sf=0.01)
+    assert any(x[0] is None for x in r.rows())  # grand total survives
+
+
+def test_rollup_on_mesh_matches_local():
+    from presto_tpu.parallel.mesh import make_mesh
+    q = ("SELECT returnflag, linestatus, sum(quantity) AS q FROM lineitem "
+         "GROUP BY ROLLUP(returnflag, linestatus) ORDER BY q DESC")
+    local = sql(q, sf=0.01)
+    mesh = sql(q, sf=0.01, mesh=make_mesh(8))
+    assert sorted(map(str, local.rows())) == sorted(map(str, mesh.rows()))
+
+
+CTE_Q = """
+WITH big AS (SELECT custkey, sum(totalprice) AS t FROM orders
+             GROUP BY custkey)
+SELECT a.custkey, a.t, b.t FROM big a JOIN big b ON a.custkey = b.custkey
+WHERE a.t > 1000000.00
+"""
+
+
+def test_cte_planned_once_shared_subtree():
+    plan = plan_sql(CTE_Q)
+    nodes = _unique_nodes(plan)
+    scans = [n for n in nodes if isinstance(n, N.TableScanNode)]
+    aggs = [n for n in nodes if isinstance(n, N.AggregationNode)]
+    assert len(scans) == 1, "CTE subtree must be one shared object"
+    assert len(aggs) == 1
+
+    # sharing survives AddExchanges and capacity refinement
+    from presto_tpu.plan.distribute import add_exchanges
+    from presto_tpu.plan.stats import refine_capacities
+    for p in (add_exchanges(plan), refine_capacities(plan, 0.01)):
+        ns = _unique_nodes(p)
+        assert len([n for n in ns if isinstance(n, N.TableScanNode)]) == 1
+
+
+def test_cte_self_join_executes_and_matches_mesh():
+    from presto_tpu.parallel.mesh import make_mesh
+    local = sql(CTE_Q, sf=0.01)
+    assert local.row_count > 0
+    for row in local.rows():
+        assert row[1] == row[2]  # both references see identical data
+    mesh = sql(CTE_Q, sf=0.01, mesh=make_mesh(8))
+    assert sorted(map(str, local.rows())) == sorted(map(str, mesh.rows()))
