@@ -133,8 +133,17 @@ def _promote(ret_type: T.Type, *blocks: Column):
     for b in blocks:
         if isinstance(b, Int128Column):
             if ret_type.is_floating:
-                f = (b.hi.astype(jnp.float64) * np.float64(2.0 ** 64)
-                     + b.lo.astype(jnp.float64))
+                # convert via the MAGNITUDE: for negative values the
+                # two's-complement lo lane sits near 2^64 where float64
+                # granularity is ~2048, so hi*2^64+lo would lose the low
+                # bits (observed as ~1e-6 relative error on sums)
+                neg = b.hi < 0
+                mh, ml = I128.neg128(b.hi, b.lo)
+                mh = jnp.where(neg, mh, b.hi)
+                ml = jnp.where(neg, ml, b.lo)
+                f = (mh.astype(jnp.float64) * np.float64(2.0 ** 64)
+                     + ml.astype(jnp.float64))
+                f = jnp.where(neg, -f, f)
                 out.append(f / _POW10[_scale_of(b.type)])
                 continue
             raise NotImplementedError(

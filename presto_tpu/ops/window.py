@@ -33,7 +33,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import types as T
-from ..block import Batch, Block, Column, DictionaryColumn, StringColumn
+from ..block import (Batch, Block, Column, DictionaryColumn, Int128Column,
+                     StringColumn)
 from .keys import key_words
 from .sort import SortKey, _column_words
 
@@ -90,7 +91,11 @@ def window(batch: Batch, partition_channels: Sequence[int],
     s_pwords = sorted_ops[1:1 + len(pwords)]
     s_owords = sorted_ops[1 + len(pwords):-1]
 
-    part_bound = _seg_positions(list(s_pwords)) | ~s_active
+    if s_pwords:
+        part_bound = _seg_positions(list(s_pwords)) | ~s_active
+    else:
+        # OVER () / no PARTITION BY: one whole-input partition
+        part_bound = jnp.zeros(n, dtype=bool).at[0].set(True) | ~s_active
     run_bound = part_bound | (_seg_positions(list(s_owords)) if s_owords
                               else jnp.zeros(n, dtype=bool))
 
@@ -175,6 +180,43 @@ def window(batch: Batch, partition_channels: Sequence[int],
                 col = col.decode()
             assert not isinstance(col, StringColumn), \
                 f"window {name} over strings is not yet supported"
+            if isinstance(col, Int128Column):
+                # long-decimal inputs (aggregation states feeding a
+                # window stage, the q53/q12 shapes): EXACT windowed sums
+                # via 13-bit limb cumsums recombined to (hi, lo); avg
+                # divides with the decimal half-up rule
+                if name not in ("sum", "avg", "count"):
+                    raise NotImplementedError(
+                        f"window {name} over long decimals")
+                from ..int128 import (combine_limb_totals_128,
+                                      div128_by_count, limbs13_of_128)
+                nn_sorted = (~col.nulls & batch.active)[perm]
+                end = run_end if spec.frame == "range_current" else part_end
+                pc = jnp.cumsum(nn_sorted.astype(jnp.int64))
+                base_c = jnp.where(part_start > 0, pc[part_start - 1], 0)
+                wcnt = pc[end] - base_c
+                if name == "count":
+                    out_cols.append(Column(wcnt[inv],
+                                           jnp.asarray(~s_active)[inv],
+                                           spec.output_type))
+                    continue
+                totals = []
+                for l in limbs13_of_128(col.hi, col.lo):
+                    ls = jnp.where(nn_sorted, l[perm], 0)
+                    ps = jnp.cumsum(ls)
+                    base = jnp.where(part_start > 0, ps[part_start - 1], 0)
+                    totals.append(ps[end] - base)
+                hi, lo = combine_limb_totals_128(
+                    jnp.stack(totals, axis=-1))
+                empty = (wcnt == 0) | ~s_active
+                if name == "avg":
+                    qv = div128_by_count(hi, lo, jnp.maximum(wcnt, 1))
+                    hi = (qv >> 63).astype(hi.dtype)
+                    lo = qv.astype(jnp.uint64)
+                out_cols.append(Int128Column(hi[inv], lo[inv],
+                                             jnp.asarray(empty)[inv],
+                                             spec.output_type))
+                continue
             v_sorted = col.values[perm]
             nn_sorted = (~col.nulls & batch.active)[perm]
             if name in ("sum", "avg", "count"):

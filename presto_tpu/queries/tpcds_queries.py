@@ -13,8 +13,17 @@ this engine's dialect, applied uniformly:
   engine computes the identical value
 * mixed LEFT JOIN + comma FROM lists (q40/q93) -> explicit JOIN chains
 * spec parameter values that our generator's value domains don't
-  contain (city/state names) -> values drawn from the generator's
-  domains; selectivity structure is preserved
+  contain (city/state names, class/brand lists) -> values drawn from
+  the generator's domains; selectivity structure is preserved
+* ROLLUP queries (q18/q22/q27/q36/q86) drop their LIMIT so the oracle
+  comparison is full-set (LIMIT over tied orderings is ambiguous at
+  test scale); sqlite has no ROLLUP, so their oracles are explicit
+  UNION ALL level stacks (see TPCDS_ORACLE below)
+* q34's cnt band starts at 1 and q76 inverts IS NULL -> IS NOT NULL
+  (this generator emits independent ticket lines and no NULL link
+  keys; both documented at the query)
+* spec CASTs like avg(CAST(x AS DECIMAL(12,2))) read as plain avg(x)
+  (same quotient; the comparator tolerates the cents rounding)
 
 Tests run every query against an independent SQL engine (sqlite) over
 the same generated data (tests/tpcds_harness.py) -- the H2QueryRunner
@@ -605,4 +614,1099 @@ GROUP BY substr(w_warehouse_name, 1, 20), sm_type, cc_name
 ORDER BY wname ASC, sm_type ASC, cc_name ASC
 LIMIT 100
 """,
+    # q18: demographic catalog averages, 4-level ROLLUP (GroupIdNode
+    # single-pass expansion). Spec CASTs int columns to decimal(12,2)
+    # before avg; plain int avg computes the same quotient (comparator
+    # tolerance covers rounding).
+    "q18": """
+SELECT i_item_id, ca_country, ca_state, ca_county,
+       avg(cs_quantity) agg1, avg(cs_list_price) agg2,
+       avg(cs_coupon_amt) agg3, avg(cs_sales_price) agg4,
+       avg(cs_net_profit) agg5, avg(c_birth_year) agg6,
+       avg(cd1.cd_dep_count) agg7
+FROM catalog_sales, customer_demographics cd1,
+     customer_demographics cd2, customer, customer_address,
+     date_dim, item
+WHERE cs_sold_date_sk = d_date_sk AND cs_item_sk = i_item_sk
+  AND cs_bill_cdemo_sk = cd1.cd_demo_sk
+  AND cs_bill_customer_sk = c_customer_sk
+  AND cd1.cd_gender = 'F' AND cd1.cd_education_status = 'Unknown'
+  AND c_current_cdemo_sk = cd2.cd_demo_sk
+  AND c_current_addr_sk = ca_address_sk
+  AND c_birth_month IN (1, 6, 8, 9, 12, 2)
+  AND d_year = 1998
+  AND ca_state IN ('TX', 'NY', 'OH', 'IL', 'WA', 'GA', 'TN')
+GROUP BY ROLLUP(i_item_id, ca_country, ca_state, ca_county)
+ORDER BY ca_country ASC, ca_state ASC, ca_county ASC, i_item_id ASC
+""",
+    # q22: inventory quantity-on-hand, 4-level ROLLUP over item hierarchy
+    "q22": """
+SELECT i_product_name, i_brand, i_class, i_category,
+       avg(inv_quantity_on_hand) qoh
+FROM inventory, date_dim, item
+WHERE inv_date_sk = d_date_sk AND inv_item_sk = i_item_sk
+  AND d_month_seq BETWEEN 1200 AND 1211
+GROUP BY ROLLUP(i_product_name, i_brand, i_class, i_category)
+ORDER BY qoh ASC, i_product_name ASC, i_brand ASC, i_class ASC,
+         i_category ASC
+""",
+    # q27: store demographics, ROLLUP(i_item_id, s_state) + grouping()
+    "q27": """
+SELECT i_item_id, s_state, grouping(s_state) g_state,
+       avg(ss_quantity) agg1, avg(ss_list_price) agg2,
+       avg(ss_coupon_amt) agg3, avg(ss_sales_price) agg4
+FROM store_sales, customer_demographics, date_dim, store, item
+WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+  AND ss_store_sk = s_store_sk AND ss_cdemo_sk = cd_demo_sk
+  AND cd_gender = 'M' AND cd_marital_status = 'S'
+  AND cd_education_status = 'College'
+  AND d_year = 2002 AND s_state IN ('TN', 'CA')
+GROUP BY ROLLUP(i_item_id, s_state)
+ORDER BY i_item_id ASC, s_state ASC
+""",
+    # q97: store/catalog buyer overlap via FULL OUTER JOIN of two
+    # grouped CTEs
+    "q97": """
+WITH ssci AS (
+  SELECT ss_customer_sk customer_sk, ss_item_sk item_sk
+  FROM store_sales, date_dim
+  WHERE ss_sold_date_sk = d_date_sk AND d_month_seq BETWEEN 1200 AND 1211
+  GROUP BY ss_customer_sk, ss_item_sk
+),
+csci AS (
+  SELECT cs_bill_customer_sk customer_sk, cs_item_sk item_sk
+  FROM catalog_sales, date_dim
+  WHERE cs_sold_date_sk = d_date_sk AND d_month_seq BETWEEN 1200 AND 1211
+  GROUP BY cs_bill_customer_sk, cs_item_sk
+)
+SELECT sum(CASE WHEN ssci.customer_sk IS NOT NULL
+                 AND csci.customer_sk IS NULL THEN 1 ELSE 0 END) store_only,
+       sum(CASE WHEN ssci.customer_sk IS NULL
+                 AND csci.customer_sk IS NOT NULL THEN 1 ELSE 0 END)
+           catalog_only,
+       sum(CASE WHEN ssci.customer_sk IS NOT NULL
+                 AND csci.customer_sk IS NOT NULL THEN 1 ELSE 0 END)
+           store_and_catalog
+FROM ssci FULL OUTER JOIN csci ON ssci.customer_sk = csci.customer_sk
+                              AND ssci.item_sk = csci.item_sk
+""",
+    # q11: store-vs-web year-over-year growth per customer; the
+    # year_total CTE is referenced FOUR times and planned ONCE (plan
+    # DAG; LogicalCteOptimizer analog). Alias dyear keeps the reserved
+    # word YEAR out of the grammar.
+    "q11": """
+WITH year_total AS (
+  SELECT c_customer_id customer_id, c_first_name customer_first_name,
+         c_last_name customer_last_name,
+         c_preferred_cust_flag customer_preferred_cust_flag,
+         c_birth_country customer_birth_country,
+         c_login customer_login,
+         c_email_address customer_email_address,
+         d_year dyear,
+         sum(ss_ext_list_price - ss_ext_discount_amt) year_total,
+         's' sale_type
+  FROM customer, store_sales, date_dim
+  WHERE c_customer_sk = ss_customer_sk AND ss_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name,
+           c_preferred_cust_flag, c_birth_country, c_login,
+           c_email_address, d_year
+  UNION ALL
+  SELECT c_customer_id customer_id, c_first_name customer_first_name,
+         c_last_name customer_last_name,
+         c_preferred_cust_flag customer_preferred_cust_flag,
+         c_birth_country customer_birth_country,
+         c_login customer_login,
+         c_email_address customer_email_address,
+         d_year dyear,
+         sum(ws_ext_list_price - ws_ext_discount_amt) year_total,
+         'w' sale_type
+  FROM customer, web_sales, date_dim
+  WHERE c_customer_sk = ws_bill_customer_sk
+    AND ws_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name,
+           c_preferred_cust_flag, c_birth_country, c_login,
+           c_email_address, d_year
+)
+SELECT t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+       t_s_secyear.customer_last_name,
+       t_s_secyear.customer_preferred_cust_flag,
+       t_s_secyear.customer_birth_country, t_s_secyear.customer_login
+FROM year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+WHERE t_s_secyear.customer_id = t_s_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_secyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  AND t_s_firstyear.sale_type = 's' AND t_w_firstyear.sale_type = 'w'
+  AND t_s_secyear.sale_type = 's' AND t_w_secyear.sale_type = 'w'
+  AND t_s_firstyear.dyear = 2001 AND t_s_secyear.dyear = 2002
+  AND t_w_firstyear.dyear = 2001 AND t_w_secyear.dyear = 2002
+  AND t_s_firstyear.year_total > 0.000
+  AND t_w_firstyear.year_total > 0.000
+  AND (CASE WHEN t_w_firstyear.year_total > 0.000
+            THEN t_w_secyear.year_total / t_w_firstyear.year_total
+            ELSE NULL END)
+    > (CASE WHEN t_s_firstyear.year_total > 0.000
+            THEN t_s_secyear.year_total / t_s_firstyear.year_total
+            ELSE NULL END)
+ORDER BY t_s_secyear.customer_id ASC,
+         t_s_secyear.customer_first_name ASC,
+         t_s_secyear.customer_last_name ASC,
+         t_s_secyear.customer_preferred_cust_flag ASC
+LIMIT 100
+""",
+    # q74: like q11 over net_paid with a leaner select list
+    "q74": """
+WITH year_total AS (
+  SELECT c_customer_id customer_id, c_first_name customer_first_name,
+         c_last_name customer_last_name, d_year dyear,
+         sum(ss_net_paid) year_total, 's' sale_type
+  FROM customer, store_sales, date_dim
+  WHERE c_customer_sk = ss_customer_sk AND ss_sold_date_sk = d_date_sk
+    AND d_year IN (2001, 2002)
+  GROUP BY c_customer_id, c_first_name, c_last_name, d_year
+  UNION ALL
+  SELECT c_customer_id customer_id, c_first_name customer_first_name,
+         c_last_name customer_last_name, d_year dyear,
+         sum(ws_net_paid) year_total, 'w' sale_type
+  FROM customer, web_sales, date_dim
+  WHERE c_customer_sk = ws_bill_customer_sk
+    AND ws_sold_date_sk = d_date_sk AND d_year IN (2001, 2002)
+  GROUP BY c_customer_id, c_first_name, c_last_name, d_year
+)
+SELECT t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+       t_s_secyear.customer_last_name
+FROM year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+WHERE t_s_secyear.customer_id = t_s_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_secyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  AND t_s_firstyear.sale_type = 's' AND t_w_firstyear.sale_type = 'w'
+  AND t_s_secyear.sale_type = 's' AND t_w_secyear.sale_type = 'w'
+  AND t_s_firstyear.dyear = 2001 AND t_s_secyear.dyear = 2002
+  AND t_w_firstyear.dyear = 2001 AND t_w_secyear.dyear = 2002
+  AND t_s_firstyear.year_total > 0.000
+  AND t_w_firstyear.year_total > 0.000
+  AND (CASE WHEN t_w_firstyear.year_total > 0.000
+            THEN t_w_secyear.year_total / t_w_firstyear.year_total
+            ELSE NULL END)
+    > (CASE WHEN t_s_firstyear.year_total > 0.000
+            THEN t_s_secyear.year_total / t_s_firstyear.year_total
+            ELSE NULL END)
+ORDER BY 1 ASC, 2 ASC, 3 ASC
+LIMIT 100
+""",
+    # q4: q11's shape widened to all three channels (SIX references to
+    # one CTE; catalog branch added)
+    "q4": """
+WITH year_total AS (
+  SELECT c_customer_id customer_id, c_first_name customer_first_name,
+         c_last_name customer_last_name, d_year dyear,
+         sum((ss_ext_list_price - ss_ext_wholesale_cost
+              - ss_ext_discount_amt + ss_ext_sales_price) / 2)
+           year_total,
+         's' sale_type
+  FROM customer, store_sales, date_dim
+  WHERE c_customer_sk = ss_customer_sk AND ss_sold_date_sk = d_date_sk
+    AND d_year IN (2001, 2002)
+  GROUP BY c_customer_id, c_first_name, c_last_name, d_year
+  UNION ALL
+  SELECT c_customer_id customer_id, c_first_name customer_first_name,
+         c_last_name customer_last_name, d_year dyear,
+         sum((cs_ext_list_price - cs_ext_wholesale_cost
+              - cs_ext_discount_amt + cs_ext_sales_price) / 2)
+           year_total,
+         'c' sale_type
+  FROM customer, catalog_sales, date_dim
+  WHERE c_customer_sk = cs_bill_customer_sk
+    AND cs_sold_date_sk = d_date_sk AND d_year IN (2001, 2002)
+  GROUP BY c_customer_id, c_first_name, c_last_name, d_year
+  UNION ALL
+  SELECT c_customer_id customer_id, c_first_name customer_first_name,
+         c_last_name customer_last_name, d_year dyear,
+         sum((ws_ext_list_price - ws_ext_wholesale_cost
+              - ws_ext_discount_amt + ws_ext_sales_price) / 2)
+           year_total,
+         'w' sale_type
+  FROM customer, web_sales, date_dim
+  WHERE c_customer_sk = ws_bill_customer_sk
+    AND ws_sold_date_sk = d_date_sk AND d_year IN (2001, 2002)
+  GROUP BY c_customer_id, c_first_name, c_last_name, d_year
+)
+SELECT t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+       t_s_secyear.customer_last_name
+FROM year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_c_firstyear, year_total t_c_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+WHERE t_s_secyear.customer_id = t_s_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_c_secyear.customer_id
+  AND t_s_firstyear.customer_id = t_c_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_secyear.customer_id
+  AND t_s_firstyear.sale_type = 's' AND t_c_firstyear.sale_type = 'c'
+  AND t_w_firstyear.sale_type = 'w' AND t_s_secyear.sale_type = 's'
+  AND t_c_secyear.sale_type = 'c' AND t_w_secyear.sale_type = 'w'
+  AND t_s_firstyear.dyear = 2001 AND t_s_secyear.dyear = 2002
+  AND t_c_firstyear.dyear = 2001 AND t_c_secyear.dyear = 2002
+  AND t_w_firstyear.dyear = 2001 AND t_w_secyear.dyear = 2002
+  AND t_s_firstyear.year_total > 0.000
+  AND t_c_firstyear.year_total > 0.000
+  AND t_w_firstyear.year_total > 0.000
+  AND (CASE WHEN t_c_firstyear.year_total > 0.000
+            THEN t_c_secyear.year_total / t_c_firstyear.year_total
+            ELSE NULL END)
+    > (CASE WHEN t_s_firstyear.year_total > 0.000
+            THEN t_s_secyear.year_total / t_s_firstyear.year_total
+            ELSE NULL END)
+  AND (CASE WHEN t_c_firstyear.year_total > 0.000
+            THEN t_c_secyear.year_total / t_c_firstyear.year_total
+            ELSE NULL END)
+    > (CASE WHEN t_w_firstyear.year_total > 0.000
+            THEN t_w_secyear.year_total / t_w_firstyear.year_total
+            ELSE NULL END)
+ORDER BY 1 ASC, 2 ASC, 3 ASC
+LIMIT 100
+""",
+    # q12/q20/q98: per-item revenue share of its class (windowed sum
+    # over the aggregation output). Date window folded into literals.
+    "q12": """
+SELECT i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       sum(ws_ext_sales_price) itemrevenue,
+       sum(ws_ext_sales_price) * 100 / sum(sum(ws_ext_sales_price))
+         OVER (PARTITION BY i_class) revenueratio
+FROM web_sales, item, date_dim
+WHERE ws_item_sk = i_item_sk
+  AND i_category IN ('Sports', 'Books', 'Home')
+  AND ws_sold_date_sk = d_date_sk
+  AND d_date BETWEEN date '1999-02-22' AND date '1999-03-24'
+GROUP BY i_item_id, i_item_desc, i_category, i_class, i_current_price
+ORDER BY i_category ASC, i_class ASC, i_item_id ASC, i_item_desc ASC,
+         revenueratio ASC
+""",
+    "q20": """
+SELECT i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       sum(cs_ext_sales_price) itemrevenue,
+       sum(cs_ext_sales_price) * 100 / sum(sum(cs_ext_sales_price))
+         OVER (PARTITION BY i_class) revenueratio
+FROM catalog_sales, item, date_dim
+WHERE cs_item_sk = i_item_sk
+  AND i_category IN ('Sports', 'Books', 'Home')
+  AND cs_sold_date_sk = d_date_sk
+  AND d_date BETWEEN date '1999-02-22' AND date '1999-03-24'
+GROUP BY i_item_id, i_item_desc, i_category, i_class, i_current_price
+ORDER BY i_category ASC, i_class ASC, i_item_id ASC, i_item_desc ASC,
+         revenueratio ASC
+""",
+    "q98": """
+SELECT i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       sum(ss_ext_sales_price) itemrevenue,
+       sum(ss_ext_sales_price) * 100 / sum(sum(ss_ext_sales_price))
+         OVER (PARTITION BY i_class) revenueratio
+FROM store_sales, item, date_dim
+WHERE ss_item_sk = i_item_sk
+  AND i_category IN ('Sports', 'Books', 'Home')
+  AND ss_sold_date_sk = d_date_sk
+  AND d_date BETWEEN date '1999-02-22' AND date '1999-03-24'
+GROUP BY i_item_id, i_item_desc, i_category, i_class, i_current_price
+ORDER BY i_category ASC, i_class ASC, i_item_id ASC, i_item_desc ASC,
+         revenueratio ASC
+""",
+    # q53: manufacturer quarterly sales vs their average (window over
+    # aggregation + outer deviation filter). Spec's class/brand filter
+    # values adapted to the generator's domains; OR structure preserved.
+    "q53": """
+SELECT * FROM (
+  SELECT i_manufact_id, sum(ss_sales_price) sum_sales,
+         avg(sum(ss_sales_price)) OVER (PARTITION BY i_manufact_id)
+           avg_quarterly_sales
+  FROM item, store_sales, date_dim, store
+  WHERE ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+    AND ss_store_sk = s_store_sk
+    AND d_month_seq BETWEEN 1200 AND 1211
+    AND ((i_category IN ('Books', 'Children', 'Electronics')
+          AND i_class IN ('accent', 'bathroom', 'bedding', 'blinds'))
+      OR (i_category IN ('Women', 'Music', 'Men')
+          AND i_class IN ('curtains', 'decor', 'flatware', 'kids')))
+  GROUP BY i_manufact_id, d_qoy
+) tmp1
+WHERE CASE WHEN avg_quarterly_sales > 0.000
+           THEN abs(sum_sales - avg_quarterly_sales)
+                / avg_quarterly_sales
+           ELSE NULL END > 0.100
+ORDER BY avg_quarterly_sales ASC, sum_sales ASC, i_manufact_id ASC
+""",
+    # q63: like q53 keyed by manager/month
+    "q63": """
+SELECT * FROM (
+  SELECT i_manager_id, sum(ss_sales_price) sum_sales,
+         avg(sum(ss_sales_price)) OVER (PARTITION BY i_manager_id)
+           avg_monthly_sales
+  FROM item, store_sales, date_dim, store
+  WHERE ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+    AND ss_store_sk = s_store_sk
+    AND d_month_seq BETWEEN 1200 AND 1211
+    AND ((i_category IN ('Books', 'Children', 'Electronics')
+          AND i_class IN ('accent', 'bathroom', 'bedding', 'blinds'))
+      OR (i_category IN ('Women', 'Music', 'Men')
+          AND i_class IN ('curtains', 'decor', 'flatware', 'kids')))
+  GROUP BY i_manager_id, d_moy
+) tmp1
+WHERE CASE WHEN avg_monthly_sales > 0.000
+           THEN abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+           ELSE NULL END > 0.100
+ORDER BY i_manager_id ASC, avg_monthly_sales ASC, sum_sales ASC
+""",
+    # q89: store/brand monthly sales vs category average
+    "q89": """
+SELECT * FROM (
+  SELECT i_category, i_class, i_brand, s_store_name, s_company_name,
+         d_moy, sum(ss_sales_price) sum_sales,
+         avg(sum(ss_sales_price)) OVER (PARTITION BY i_category,
+           i_brand, s_store_name, s_company_name) avg_monthly_sales
+  FROM item, store_sales, date_dim, store
+  WHERE ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+    AND ss_store_sk = s_store_sk AND d_year = 1999
+    AND ((i_category IN ('Books', 'Electronics', 'Sports')
+          AND i_class IN ('accent', 'bathroom', 'bedding'))
+      OR (i_category IN ('Men', 'Jewelry', 'Women')
+          AND i_class IN ('blinds', 'curtains', 'decor')))
+  GROUP BY i_category, i_class, i_brand, s_store_name, s_company_name,
+           d_moy
+) tmp1
+WHERE CASE WHEN avg_monthly_sales <> 0.000
+           THEN abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+           ELSE NULL END > 0.100
+ORDER BY sum_sales - avg_monthly_sales ASC, s_store_name ASC,
+         sum_sales ASC, i_category ASC, i_class ASC, i_brand ASC
+""",
+    # q32: excess catalog discounts (correlated scalar subquery per item)
+    "q32": """
+SELECT sum(cs_ext_discount_amt) excess_discount_amount
+FROM catalog_sales, item, date_dim
+WHERE i_manufact_id = 977 AND i_item_sk = cs_item_sk
+  AND d_date BETWEEN date '2000-01-27' AND date '2000-04-26'
+  AND d_date_sk = cs_sold_date_sk
+  AND cs_ext_discount_amt > (
+    SELECT 1.3 * avg(cs_ext_discount_amt)
+    FROM catalog_sales, date_dim
+    WHERE cs_item_sk = i_item_sk
+      AND d_date BETWEEN date '2000-01-27' AND date '2000-04-26'
+      AND d_date_sk = cs_sold_date_sk)
+""",
+    # q38: customers active in ALL three channels (INTERSECT of
+    # distinct name/date sets)
+    "q38": """
+SELECT count(*) FROM (
+  SELECT DISTINCT c_last_name, c_first_name, d_date
+  FROM store_sales, date_dim, customer
+  WHERE store_sales.ss_sold_date_sk = date_dim.d_date_sk
+    AND store_sales.ss_customer_sk = customer.c_customer_sk
+    AND d_month_seq BETWEEN 1200 AND 1211
+  INTERSECT
+  SELECT DISTINCT c_last_name, c_first_name, d_date
+  FROM catalog_sales, date_dim, customer
+  WHERE catalog_sales.cs_sold_date_sk = date_dim.d_date_sk
+    AND catalog_sales.cs_bill_customer_sk = customer.c_customer_sk
+    AND d_month_seq BETWEEN 1200 AND 1211
+  INTERSECT
+  SELECT DISTINCT c_last_name, c_first_name, d_date
+  FROM web_sales, date_dim, customer
+  WHERE web_sales.ws_sold_date_sk = date_dim.d_date_sk
+    AND web_sales.ws_bill_customer_sk = customer.c_customer_sk
+    AND d_month_seq BETWEEN 1200 AND 1211
+) hot_cust
+""",
+    # q87: store-only customers (EXCEPT chain over the same three sets)
+    "q87": """
+SELECT count(*) FROM (
+  SELECT DISTINCT c_last_name, c_first_name, d_date
+  FROM store_sales, date_dim, customer
+  WHERE store_sales.ss_sold_date_sk = date_dim.d_date_sk
+    AND store_sales.ss_customer_sk = customer.c_customer_sk
+    AND d_month_seq BETWEEN 1200 AND 1211
+  EXCEPT
+  SELECT DISTINCT c_last_name, c_first_name, d_date
+  FROM catalog_sales, date_dim, customer
+  WHERE catalog_sales.cs_sold_date_sk = date_dim.d_date_sk
+    AND catalog_sales.cs_bill_customer_sk = customer.c_customer_sk
+    AND d_month_seq BETWEEN 1200 AND 1211
+  EXCEPT
+  SELECT DISTINCT c_last_name, c_first_name, d_date
+  FROM web_sales, date_dim, customer
+  WHERE web_sales.ws_sold_date_sk = date_dim.d_date_sk
+    AND web_sales.ws_bill_customer_sk = customer.c_customer_sk
+    AND d_month_seq BETWEEN 1200 AND 1211
+) cool_cust
+""",
+    # q6: states whose buyers favor items priced 20% above their
+    # category average (correlated avg subquery + scalar month lookup)
+    "q6": """
+SELECT a.ca_state state_, count(*) cnt
+FROM customer_address a, customer c, store_sales s, date_dim d, item i
+WHERE a.ca_address_sk = c.c_current_addr_sk
+  AND c.c_customer_sk = s.ss_customer_sk
+  AND s.ss_sold_date_sk = d.d_date_sk
+  AND s.ss_item_sk = i.i_item_sk
+  AND d.d_month_seq = (SELECT DISTINCT d_month_seq FROM date_dim
+                       WHERE d_year = 2001 AND d_moy = 1)
+  AND i.i_current_price > (SELECT 1.2 * avg(j.i_current_price)
+                           FROM item j
+                           WHERE j.i_category = i.i_category)
+GROUP BY a.ca_state
+HAVING count(*) >= 10
+ORDER BY cnt ASC, state_ ASC
+""",
+    # q33: Electronics manufacturer sales across all three channels
+    # (three CTEs with IN-subquery item filters, UNION ALL, re-agg)
+    "q33": """
+WITH ss AS (
+  SELECT i_manufact_id, sum(ss_ext_sales_price) total_sales
+  FROM store_sales, date_dim, customer_address, item
+  WHERE i_manufact_id IN (SELECT i_manufact_id FROM item
+                          WHERE i_category IN ('Electronics'))
+    AND ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+    AND d_year = 1998 AND d_moy = 5 AND ss_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -5.00
+  GROUP BY i_manufact_id
+),
+cs AS (
+  SELECT i_manufact_id, sum(cs_ext_sales_price) total_sales
+  FROM catalog_sales, date_dim, customer_address, item
+  WHERE i_manufact_id IN (SELECT i_manufact_id FROM item
+                          WHERE i_category IN ('Electronics'))
+    AND cs_item_sk = i_item_sk AND cs_sold_date_sk = d_date_sk
+    AND d_year = 1998 AND d_moy = 5 AND cs_bill_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -5.00
+  GROUP BY i_manufact_id
+),
+ws AS (
+  SELECT i_manufact_id, sum(ws_ext_sales_price) total_sales
+  FROM web_sales, date_dim, customer_address, item
+  WHERE i_manufact_id IN (SELECT i_manufact_id FROM item
+                          WHERE i_category IN ('Electronics'))
+    AND ws_item_sk = i_item_sk AND ws_sold_date_sk = d_date_sk
+    AND d_year = 1998 AND d_moy = 5 AND ws_bill_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -5.00
+  GROUP BY i_manufact_id
+)
+SELECT i_manufact_id, sum(total_sales) total_sales
+FROM (SELECT * FROM ss UNION ALL SELECT * FROM cs
+      UNION ALL SELECT * FROM ws) tmp1
+GROUP BY i_manufact_id
+ORDER BY total_sales ASC, i_manufact_id ASC
+""",
+    # q34: frequent-ticket buyers. Spec's dep/vehicle CASE ratio is
+    # rewritten as the equivalent integer-side multiplication, and the
+    # cnt band starts at 1 (this generator's ticket lines are
+    # independent rows, so per-(ticket, customer) counts stay small).
+    "q34": """
+SELECT c_last_name, c_first_name, c_salutation,
+       c_preferred_cust_flag, ss_ticket_number, cnt
+FROM (
+  SELECT ss_ticket_number, ss_customer_sk, count(*) cnt
+  FROM store_sales, date_dim, store, household_demographics
+  WHERE store_sales.ss_sold_date_sk = date_dim.d_date_sk
+    AND store_sales.ss_store_sk = store.s_store_sk
+    AND store_sales.ss_hdemo_sk = household_demographics.hd_demo_sk
+    AND (date_dim.d_dom BETWEEN 1 AND 3
+         OR date_dim.d_dom BETWEEN 25 AND 28)
+    AND (household_demographics.hd_buy_potential = '>10000'
+         OR household_demographics.hd_buy_potential = 'Unknown')
+    AND household_demographics.hd_vehicle_count > 0
+    AND 10 * household_demographics.hd_dep_count
+        > 12 * household_demographics.hd_vehicle_count
+    AND date_dim.d_year IN (1999, 2000, 2001)
+    AND store.s_county IN ('Williamson County', 'Walker County')
+  GROUP BY ss_ticket_number, ss_customer_sk
+) dn, customer
+WHERE ss_customer_sk = c_customer_sk AND cnt BETWEEN 1 AND 20
+ORDER BY c_last_name ASC, c_first_name ASC, c_salutation ASC,
+         c_preferred_cust_flag DESC, ss_ticket_number ASC
+""",
+    # q56/q60: three-channel item-id sales unions (color / category
+    # item filters; colors drawn from the generator's domain)
+    "q56": """
+WITH ss AS (
+  SELECT i_item_id, sum(ss_ext_sales_price) total_sales
+  FROM store_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_color IN ('slate', 'salmon', 'sienna'))
+    AND ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+    AND d_year = 2001 AND d_moy = 2 AND ss_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -5.00
+  GROUP BY i_item_id
+),
+cs AS (
+  SELECT i_item_id, sum(cs_ext_sales_price) total_sales
+  FROM catalog_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_color IN ('slate', 'salmon', 'sienna'))
+    AND cs_item_sk = i_item_sk AND cs_sold_date_sk = d_date_sk
+    AND d_year = 2001 AND d_moy = 2 AND cs_bill_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -5.00
+  GROUP BY i_item_id
+),
+ws AS (
+  SELECT i_item_id, sum(ws_ext_sales_price) total_sales
+  FROM web_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_color IN ('slate', 'salmon', 'sienna'))
+    AND ws_item_sk = i_item_sk AND ws_sold_date_sk = d_date_sk
+    AND d_year = 2001 AND d_moy = 2 AND ws_bill_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -5.00
+  GROUP BY i_item_id
+)
+SELECT i_item_id, sum(total_sales) total_sales
+FROM (SELECT * FROM ss UNION ALL SELECT * FROM cs
+      UNION ALL SELECT * FROM ws) tmp1
+GROUP BY i_item_id
+ORDER BY total_sales ASC, i_item_id ASC
+""",
+    "q60": """
+WITH ss AS (
+  SELECT i_item_id, sum(ss_ext_sales_price) total_sales
+  FROM store_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_category IN ('Music'))
+    AND ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+    AND d_year = 1998 AND d_moy = 9 AND ss_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -5.00
+  GROUP BY i_item_id
+),
+cs AS (
+  SELECT i_item_id, sum(cs_ext_sales_price) total_sales
+  FROM catalog_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_category IN ('Music'))
+    AND cs_item_sk = i_item_sk AND cs_sold_date_sk = d_date_sk
+    AND d_year = 1998 AND d_moy = 9 AND cs_bill_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -5.00
+  GROUP BY i_item_id
+),
+ws AS (
+  SELECT i_item_id, sum(ws_ext_sales_price) total_sales
+  FROM web_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_category IN ('Music'))
+    AND ws_item_sk = i_item_sk AND ws_sold_date_sk = d_date_sk
+    AND d_year = 1998 AND d_moy = 9 AND ws_bill_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -5.00
+  GROUP BY i_item_id
+)
+SELECT i_item_id, sum(total_sales) total_sales
+FROM (SELECT * FROM ss UNION ALL SELECT * FROM cs
+      UNION ALL SELECT * FROM ws) tmp1
+GROUP BY i_item_id
+ORDER BY total_sales ASC, i_item_id ASC
+""",
+    # q61: promotional share of Jewelry revenue (two single-row scalar
+    # reports cross-joined by the const-key broadcast path)
+    "q61": """
+SELECT promotions, total,
+       promotions / cast(total AS double) * 100
+FROM (
+  SELECT sum(ss_ext_sales_price) promotions
+  FROM store_sales, store, promotion, date_dim, customer,
+       customer_address, item
+  WHERE ss_sold_date_sk = d_date_sk AND ss_store_sk = s_store_sk
+    AND ss_promo_sk = p_promo_sk AND ss_customer_sk = c_customer_sk
+    AND ca_address_sk = c_current_addr_sk AND ss_item_sk = i_item_sk
+    AND ca_gmt_offset = -5.00 AND i_category = 'Jewelry'
+    AND (p_channel_dmail = 'Y' OR p_channel_email = 'Y'
+         OR p_channel_tv = 'Y')
+    AND s_gmt_offset = -5.00 AND d_year = 1998 AND d_moy = 11
+) promotional_sales, (
+  SELECT sum(ss_ext_sales_price) total
+  FROM store_sales, store, date_dim, customer, customer_address, item
+  WHERE ss_sold_date_sk = d_date_sk AND ss_store_sk = s_store_sk
+    AND ss_customer_sk = c_customer_sk
+    AND ca_address_sk = c_current_addr_sk AND ss_item_sk = i_item_sk
+    AND ca_gmt_offset = -5.00 AND i_category = 'Jewelry'
+    AND s_gmt_offset = -5.00 AND d_year = 1998 AND d_moy = 11
+) all_sales
+ORDER BY promotions ASC, total ASC
+""",
+    # q88: store activity in eight half-hour bands (eight single-row
+    # counts cross-joined)
+    "q88": """
+SELECT * FROM
+ (SELECT count(*) h8_30_to_9 FROM store_sales, household_demographics,
+         time_dim, store
+  WHERE ss_sold_time_sk = time_dim.t_time_sk
+    AND ss_hdemo_sk = household_demographics.hd_demo_sk
+    AND ss_store_sk = s_store_sk
+    AND time_dim.t_hour = 8 AND time_dim.t_minute >= 30
+    AND ((household_demographics.hd_dep_count = 4
+          AND household_demographics.hd_vehicle_count <= 6)
+      OR (household_demographics.hd_dep_count = 2
+          AND household_demographics.hd_vehicle_count <= 4)
+      OR (household_demographics.hd_dep_count = 0
+          AND household_demographics.hd_vehicle_count <= 2))
+    AND store.s_store_name = 'ese') s1,
+ (SELECT count(*) h9_to_9_30 FROM store_sales, household_demographics,
+         time_dim, store
+  WHERE ss_sold_time_sk = time_dim.t_time_sk
+    AND ss_hdemo_sk = household_demographics.hd_demo_sk
+    AND ss_store_sk = s_store_sk
+    AND time_dim.t_hour = 9 AND time_dim.t_minute < 30
+    AND ((household_demographics.hd_dep_count = 4
+          AND household_demographics.hd_vehicle_count <= 6)
+      OR (household_demographics.hd_dep_count = 2
+          AND household_demographics.hd_vehicle_count <= 4)
+      OR (household_demographics.hd_dep_count = 0
+          AND household_demographics.hd_vehicle_count <= 2))
+    AND store.s_store_name = 'ese') s2,
+ (SELECT count(*) h9_30_to_10 FROM store_sales, household_demographics,
+         time_dim, store
+  WHERE ss_sold_time_sk = time_dim.t_time_sk
+    AND ss_hdemo_sk = household_demographics.hd_demo_sk
+    AND ss_store_sk = s_store_sk
+    AND time_dim.t_hour = 9 AND time_dim.t_minute >= 30
+    AND ((household_demographics.hd_dep_count = 4
+          AND household_demographics.hd_vehicle_count <= 6)
+      OR (household_demographics.hd_dep_count = 2
+          AND household_demographics.hd_vehicle_count <= 4)
+      OR (household_demographics.hd_dep_count = 0
+          AND household_demographics.hd_vehicle_count <= 2))
+    AND store.s_store_name = 'ese') s3,
+ (SELECT count(*) h10_to_10_30 FROM store_sales, household_demographics,
+         time_dim, store
+  WHERE ss_sold_time_sk = time_dim.t_time_sk
+    AND ss_hdemo_sk = household_demographics.hd_demo_sk
+    AND ss_store_sk = s_store_sk
+    AND time_dim.t_hour = 10 AND time_dim.t_minute < 30
+    AND ((household_demographics.hd_dep_count = 4
+          AND household_demographics.hd_vehicle_count <= 6)
+      OR (household_demographics.hd_dep_count = 2
+          AND household_demographics.hd_vehicle_count <= 4)
+      OR (household_demographics.hd_dep_count = 0
+          AND household_demographics.hd_vehicle_count <= 2))
+    AND store.s_store_name = 'ese') s4
+""",
+    # q90: web am/pm activity ratio (two single-row counts)
+    "q90": """
+SELECT amc / cast(pmc AS double) am_pm_ratio
+FROM (
+  SELECT count(*) amc FROM web_sales, household_demographics,
+         time_dim, web_page
+  WHERE ws_sold_time_sk = time_dim.t_time_sk
+    AND ws_ship_hdemo_sk = household_demographics.hd_demo_sk
+    AND ws_web_page_sk = web_page.wp_web_page_sk
+    AND time_dim.t_hour BETWEEN 8 AND 9
+    AND household_demographics.hd_dep_count = 6
+    AND web_page.wp_char_count BETWEEN 2000 AND 5200
+) at_, (
+  SELECT count(*) pmc FROM web_sales, household_demographics,
+         time_dim, web_page
+  WHERE ws_sold_time_sk = time_dim.t_time_sk
+    AND ws_ship_hdemo_sk = household_demographics.hd_demo_sk
+    AND ws_web_page_sk = web_page.wp_web_page_sk
+    AND time_dim.t_hour BETWEEN 19 AND 20
+    AND household_demographics.hd_dep_count = 6
+    AND web_page.wp_char_count BETWEEN 2000 AND 5200
+) pt
+ORDER BY am_pm_ratio ASC
+""",
+    # q92: excess web discounts (q32's web twin)
+    "q92": """
+SELECT sum(ws_ext_discount_amt) excess_discount_amount
+FROM web_sales, item, date_dim
+WHERE i_manufact_id = 350 AND i_item_sk = ws_item_sk
+  AND d_date BETWEEN date '2000-01-27' AND date '2000-04-26'
+  AND d_date_sk = ws_sold_date_sk
+  AND ws_ext_discount_amt > (
+    SELECT 1.3 * avg(ws_ext_discount_amt)
+    FROM web_sales, date_dim
+    WHERE ws_item_sk = i_item_sk
+      AND d_date BETWEEN date '2000-01-27' AND date '2000-04-26'
+      AND d_date_sk = ws_sold_date_sk)
+""",
+    # q69: store-only shoppers' demographics (EXISTS + two NOT EXISTS;
+    # states drawn from the generator's domain)
+    "q69": """
+SELECT cd_gender, cd_marital_status, cd_education_status, count(*) cnt1,
+       cd_purchase_estimate, count(*) cnt2, cd_credit_rating, count(*) cnt3
+FROM customer c, customer_address ca, customer_demographics
+WHERE c.c_current_addr_sk = ca.ca_address_sk
+  AND ca_state IN ('GA', 'TX', 'NY')
+  AND cd_demo_sk = c.c_current_cdemo_sk
+  AND EXISTS (SELECT ss_customer_sk FROM store_sales, date_dim
+              WHERE c.c_customer_sk = ss_customer_sk
+                AND ss_sold_date_sk = d_date_sk AND d_year = 2001
+                AND d_moy BETWEEN 4 AND 6)
+  AND NOT EXISTS (SELECT ws_bill_customer_sk FROM web_sales, date_dim
+                  WHERE c.c_customer_sk = ws_bill_customer_sk
+                    AND ws_sold_date_sk = d_date_sk AND d_year = 2001
+                    AND d_moy BETWEEN 4 AND 6)
+  AND NOT EXISTS (SELECT cs_ship_customer_sk FROM catalog_sales, date_dim
+                  WHERE c.c_customer_sk = cs_ship_customer_sk
+                    AND cs_sold_date_sk = d_date_sk AND d_year = 2001
+                    AND d_moy BETWEEN 4 AND 6)
+GROUP BY cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating
+ORDER BY cd_gender ASC, cd_marital_status ASC, cd_education_status ASC,
+         cd_purchase_estimate ASC, cd_credit_rating ASC
+""",
+    # q76: channel totals with constant-string group keys over a 3-way
+    # UNION ALL. Spec filters on NULL link keys; this generator emits
+    # none, so the test inverts to IS NOT NULL to stay non-vacuous.
+    "q76": """
+SELECT channel, col_name, d_year, d_qoy, i_category,
+       count(*) sales_cnt, sum(ext_sales_price) sales_amt
+FROM (
+  SELECT 'store' channel, 'ss_store_sk' col_name, d_year, d_qoy,
+         i_category, ss_ext_sales_price ext_sales_price
+  FROM store_sales, item, date_dim
+  WHERE ss_store_sk IS NOT NULL AND ss_sold_date_sk = d_date_sk
+    AND ss_item_sk = i_item_sk
+  UNION ALL
+  SELECT 'web' channel, 'ws_ship_customer_sk' col_name, d_year, d_qoy,
+         i_category, ws_ext_sales_price ext_sales_price
+  FROM web_sales, item, date_dim
+  WHERE ws_ship_customer_sk IS NOT NULL AND ws_sold_date_sk = d_date_sk
+    AND ws_item_sk = i_item_sk
+  UNION ALL
+  SELECT 'catalog' channel, 'cs_ship_addr_sk' col_name, d_year, d_qoy,
+         i_category, cs_ext_sales_price ext_sales_price
+  FROM catalog_sales, item, date_dim
+  WHERE cs_ship_addr_sk IS NOT NULL AND cs_sold_date_sk = d_date_sk
+    AND cs_item_sk = i_item_sk
+) foo
+GROUP BY channel, col_name, d_year, d_qoy, i_category
+ORDER BY channel ASC, col_name ASC, d_year ASC, d_qoy ASC,
+         i_category ASC
+""",
+    # q83: returned quantities across channels in three chosen weeks
+    # (nested IN subqueries + 3-way CTE join)
+    "q83": """
+WITH sr_items AS (
+  SELECT i_item_id item_id, sum(sr_return_quantity) sr_item_qty
+  FROM store_returns, item, date_dim
+  WHERE sr_item_sk = i_item_sk
+    AND d_date IN (SELECT d_date FROM date_dim
+                   WHERE d_week_seq IN (SELECT d_week_seq FROM date_dim
+                                        WHERE d_date IN (date '2000-06-30',
+                                                         date '2000-09-27',
+                                                         date '2000-11-17')))
+    AND sr_returned_date_sk = d_date_sk
+  GROUP BY i_item_id
+),
+cr_items AS (
+  SELECT i_item_id item_id, sum(cr_return_quantity) cr_item_qty
+  FROM catalog_returns, item, date_dim
+  WHERE cr_item_sk = i_item_sk
+    AND d_date IN (SELECT d_date FROM date_dim
+                   WHERE d_week_seq IN (SELECT d_week_seq FROM date_dim
+                                        WHERE d_date IN (date '2000-06-30',
+                                                         date '2000-09-27',
+                                                         date '2000-11-17')))
+    AND cr_returned_date_sk = d_date_sk
+  GROUP BY i_item_id
+),
+wr_items AS (
+  SELECT i_item_id item_id, sum(wr_return_quantity) wr_item_qty
+  FROM web_returns, item, date_dim
+  WHERE wr_item_sk = i_item_sk
+    AND d_date IN (SELECT d_date FROM date_dim
+                   WHERE d_week_seq IN (SELECT d_week_seq FROM date_dim
+                                        WHERE d_date IN (date '2000-06-30',
+                                                         date '2000-09-27',
+                                                         date '2000-11-17')))
+    AND wr_returned_date_sk = d_date_sk
+  GROUP BY i_item_id
+)
+SELECT sr_items.item_id, sr_item_qty,
+       cast(sr_item_qty AS double)
+         / (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0 * 100 sr_dev,
+       cr_item_qty,
+       cast(cr_item_qty AS double)
+         / (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0 * 100 cr_dev,
+       wr_item_qty,
+       cast(wr_item_qty AS double)
+         / (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0 * 100 wr_dev,
+       (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0 average
+FROM sr_items, cr_items, wr_items
+WHERE sr_items.item_id = cr_items.item_id
+  AND sr_items.item_id = wr_items.item_id
+ORDER BY sr_items.item_id ASC, sr_item_qty ASC
+""",
+    # q28: six quantity-band price profiles (single-row cross joins;
+    # exact global count(DISTINCT))
+    "q28": """
+SELECT * FROM
+ (SELECT avg(ss_list_price) b1_lp, count(ss_list_price) b1_cnt,
+         count(DISTINCT ss_list_price) b1_cntd
+  FROM store_sales WHERE ss_quantity BETWEEN 0 AND 5
+    AND (ss_list_price BETWEEN 8.00 AND 18.00
+         OR ss_coupon_amt BETWEEN 459.00 AND 1459.00
+         OR ss_wholesale_cost BETWEEN 57.00 AND 77.00)) b1,
+ (SELECT avg(ss_list_price) b2_lp, count(ss_list_price) b2_cnt,
+         count(DISTINCT ss_list_price) b2_cntd
+  FROM store_sales WHERE ss_quantity BETWEEN 6 AND 10
+    AND (ss_list_price BETWEEN 90.00 AND 100.00
+         OR ss_coupon_amt BETWEEN 2323.00 AND 3323.00
+         OR ss_wholesale_cost BETWEEN 31.00 AND 51.00)) b2,
+ (SELECT avg(ss_list_price) b3_lp, count(ss_list_price) b3_cnt,
+         count(DISTINCT ss_list_price) b3_cntd
+  FROM store_sales WHERE ss_quantity BETWEEN 11 AND 15
+    AND (ss_list_price BETWEEN 142.00 AND 152.00
+         OR ss_coupon_amt BETWEEN 12214.00 AND 13214.00
+         OR ss_wholesale_cost BETWEEN 79.00 AND 99.00)) b3,
+ (SELECT avg(ss_list_price) b4_lp, count(ss_list_price) b4_cnt,
+         count(DISTINCT ss_list_price) b4_cntd
+  FROM store_sales WHERE ss_quantity BETWEEN 16 AND 20
+    AND (ss_list_price BETWEEN 135.00 AND 145.00
+         OR ss_coupon_amt BETWEEN 6071.00 AND 7071.00
+         OR ss_wholesale_cost BETWEEN 38.00 AND 58.00)) b4,
+ (SELECT avg(ss_list_price) b5_lp, count(ss_list_price) b5_cnt,
+         count(DISTINCT ss_list_price) b5_cntd
+  FROM store_sales WHERE ss_quantity BETWEEN 21 AND 25
+    AND (ss_list_price BETWEEN 122.00 AND 132.00
+         OR ss_coupon_amt BETWEEN 836.00 AND 1836.00
+         OR ss_wholesale_cost BETWEEN 17.00 AND 37.00)) b5,
+ (SELECT avg(ss_list_price) b6_lp, count(ss_list_price) b6_cnt,
+         count(DISTINCT ss_list_price) b6_cntd
+  FROM store_sales WHERE ss_quantity BETWEEN 26 AND 30
+    AND (ss_list_price BETWEEN 154.00 AND 164.00
+         OR ss_coupon_amt BETWEEN 7326.00 AND 8326.00
+         OR ss_wholesale_cost BETWEEN 7.00 AND 27.00)) b6
+""",
+    # q71: brand revenue at breakfast/dinner times across all channels
+    "q71": """
+SELECT i_brand_id brand_id, i_brand brand, t_hour, t_minute,
+       sum(ext_price) ext_price
+FROM item, (
+  SELECT ws_ext_sales_price ext_price, ws_sold_date_sk sold_date_sk,
+         ws_item_sk sold_item_sk, ws_sold_time_sk time_sk
+  FROM web_sales, date_dim
+  WHERE d_date_sk = ws_sold_date_sk AND d_moy = 11 AND d_year = 1999
+  UNION ALL
+  SELECT cs_ext_sales_price ext_price, cs_sold_date_sk sold_date_sk,
+         cs_item_sk sold_item_sk, cs_sold_time_sk time_sk
+  FROM catalog_sales, date_dim
+  WHERE d_date_sk = cs_sold_date_sk AND d_moy = 11 AND d_year = 1999
+  UNION ALL
+  SELECT ss_ext_sales_price ext_price, ss_sold_date_sk sold_date_sk,
+         ss_item_sk sold_item_sk, ss_sold_time_sk time_sk
+  FROM store_sales, date_dim
+  WHERE d_date_sk = ss_sold_date_sk AND d_moy = 11 AND d_year = 1999
+) tmp, time_dim
+WHERE sold_item_sk = i_item_sk AND i_manager_id = 1
+  AND time_sk = t_time_sk
+  AND (t_meal_time = 'breakfast' OR t_meal_time = 'dinner')
+GROUP BY i_brand, i_brand_id, t_hour, t_minute
+ORDER BY ext_price DESC, brand_id ASC, t_hour ASC, t_minute ASC
+""",
+    # q86: web revenue hierarchy (ROLLUP + grouping() + rank() window
+    # over the grouping-set aggregates). Spec's CASE order key is
+    # replaced with plain alias keys (deterministic; compared sorted).
+    "q86": """
+SELECT sum(ws_net_paid) total_sum, i_category, i_class,
+       grouping(i_category) + grouping(i_class) lochierarchy,
+       rank() OVER (PARTITION BY grouping(i_category)
+                                 + grouping(i_class),
+                                 CASE WHEN grouping(i_class) = 0
+                                      THEN i_category END
+                    ORDER BY sum(ws_net_paid) DESC) rank_within_parent
+FROM web_sales, date_dim d1, item
+WHERE d1.d_month_seq BETWEEN 1200 AND 1211
+  AND d1.d_date_sk = ws_sold_date_sk AND i_item_sk = ws_item_sk
+GROUP BY ROLLUP(i_category, i_class)
+ORDER BY lochierarchy DESC, rank_within_parent ASC, i_category ASC,
+         i_class ASC
+""",
+    # q36: store gross-margin hierarchy (ROLLUP + grouping() + ranked
+    # margin ratio; states from the generator domain)
+    "q36": """
+SELECT sum(ss_net_profit) / sum(ss_ext_sales_price) gross_margin,
+       i_category, i_class,
+       grouping(i_category) + grouping(i_class) lochierarchy,
+       rank() OVER (PARTITION BY grouping(i_category)
+                                 + grouping(i_class),
+                                 CASE WHEN grouping(i_class) = 0
+                                      THEN i_category END
+                    ORDER BY sum(ss_net_profit)
+                             / sum(ss_ext_sales_price) ASC)
+         rank_within_parent
+FROM store_sales, date_dim d1, item, store
+WHERE d1.d_year = 2001 AND d1.d_date_sk = ss_sold_date_sk
+  AND i_item_sk = ss_item_sk AND s_store_sk = ss_store_sk
+  AND s_state IN ('TN', 'CA')
+GROUP BY ROLLUP(i_category, i_class)
+ORDER BY lochierarchy DESC, rank_within_parent ASC, i_category ASC,
+         i_class ASC
+""",
+}
+
+
+def _rollup_oracle(select_cols, aggs, from_where, keys, order_by):
+    """Build the sqlite oracle for a ROLLUP query (sqlite has no
+    ROLLUP): UNION ALL of one grouped SELECT per prefix, dropped keys
+    projected as NULL."""
+    parts = []
+    for k in range(len(keys), -1, -1):
+        kept = keys[:k]
+        sel = []
+        for c in select_cols:
+            sel.append(c if c in kept else f"NULL AS {c}")
+        gb = f" GROUP BY {', '.join(kept)}" if kept else ""
+        parts.append(f"SELECT {', '.join(sel)}, {aggs} {from_where}{gb}")
+    return "\nUNION ALL\n".join(parts) + (f"\n{order_by}" if order_by else "")
+
+
+# sqlite-dialect oracle variants where the engine text cannot run on
+# sqlite verbatim: ROLLUP (unsupported there) becomes explicit UNION
+# ALL; decimal/decimal division (cents/cents would integer-divide in
+# sqlite) gets CAST(... AS REAL).
+_Q18_FROM = """
+FROM catalog_sales, customer_demographics cd1,
+     customer_demographics cd2, customer, customer_address,
+     date_dim, item
+WHERE cs_sold_date_sk = d_date_sk AND cs_item_sk = i_item_sk
+  AND cs_bill_cdemo_sk = cd1.cd_demo_sk
+  AND cs_bill_customer_sk = c_customer_sk
+  AND cd1.cd_gender = 'F' AND cd1.cd_education_status = 'Unknown'
+  AND c_current_cdemo_sk = cd2.cd_demo_sk
+  AND c_current_addr_sk = ca_address_sk
+  AND c_birth_month IN (1, 6, 8, 9, 12, 2)
+  AND d_year = 1998
+  AND ca_state IN ('TX', 'NY', 'OH', 'IL', 'WA', 'GA', 'TN')
+"""
+
+_Q22_FROM = """
+FROM inventory, date_dim, item
+WHERE inv_date_sk = d_date_sk AND inv_item_sk = i_item_sk
+  AND d_month_seq BETWEEN 1200 AND 1211
+"""
+
+_Q27_FROM = """
+FROM store_sales, customer_demographics, date_dim, store, item
+WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+  AND ss_store_sk = s_store_sk AND ss_cdemo_sk = cd_demo_sk
+  AND cd_gender = 'M' AND cd_marital_status = 'S'
+  AND cd_education_status = 'College'
+  AND d_year = 2002 AND s_state IN ('TN', 'CA')
+"""
+
+
+def _q27_oracle():
+    parts = []
+    for k in range(2, -1, -1):
+        kept = ["i_item_id", "s_state"][:k]
+        sel = []
+        for c in ["i_item_id", "s_state"]:
+            sel.append(c if c in kept else f"NULL AS {c}")
+        g_state = 0 if "s_state" in kept else 1
+        gb = f" GROUP BY {', '.join(kept)}" if kept else ""
+        parts.append(
+            f"SELECT {', '.join(sel)}, {g_state} g_state, "
+            "avg(ss_quantity) agg1, avg(ss_list_price) agg2, "
+            "avg(ss_coupon_amt) agg3, avg(ss_sales_price) agg4 "
+            + _Q27_FROM + gb)
+    return "\nUNION ALL\n".join(parts)
+
+
+def _yoy_oracle(text: str) -> str:
+    """q4/q11/q74 oracle: cast the ratio numerators to REAL (and q4's
+    /2 to /2.0) so sqlite's cents/cents division matches the engine's
+    real division."""
+    import re as _re
+    out = _re.sub(r"THEN (t_\w+)\.year_total /",
+                  r"THEN CAST(\1.year_total AS REAL) /", text)
+    return out.replace(" / 2)", " / 2.0)")
+
+
+def _cents_avg_window_oracle(name: str) -> str:
+    """q53/q63/q89 oracle: the engine's window avg over decimal cents
+    rounds half-away to cents (Presto decimal avg); sqlite's avg is
+    real. Round the oracle's windowed avg so the deviation-threshold
+    row inclusion matches exactly."""
+    import re as _re
+    return _re.sub(
+        r"avg\(sum\(ss_sales_price\)\) OVER \(PARTITION BY[^)]*\)",
+        lambda m: f"round({m.group(0)})", TPCDS_QUERIES[name])
+
+
+
+_Q86_BASE = """
+  SELECT sum(ws_net_paid) total_sum, i_category, i_class,
+         0 lochierarchy
+  FROM web_sales, date_dim d1, item
+  WHERE d1.d_month_seq BETWEEN 1200 AND 1211
+    AND d1.d_date_sk = ws_sold_date_sk AND i_item_sk = ws_item_sk
+  GROUP BY i_category, i_class
+  UNION ALL
+  SELECT sum(ws_net_paid), i_category, NULL, 1
+  FROM web_sales, date_dim d1, item
+  WHERE d1.d_month_seq BETWEEN 1200 AND 1211
+    AND d1.d_date_sk = ws_sold_date_sk AND i_item_sk = ws_item_sk
+  GROUP BY i_category
+  UNION ALL
+  SELECT sum(ws_net_paid), NULL, NULL, 2
+  FROM web_sales, date_dim d1, item
+  WHERE d1.d_month_seq BETWEEN 1200 AND 1211
+    AND d1.d_date_sk = ws_sold_date_sk AND i_item_sk = ws_item_sk
+"""
+
+_Q86_ORACLE = ("SELECT total_sum, i_category, i_class, lochierarchy, "
+               "rank() OVER (PARTITION BY lochierarchy, "
+               "CASE WHEN lochierarchy = 0 THEN i_category END "
+               "ORDER BY total_sum DESC) rank_within_parent "
+               "FROM (" + _Q86_BASE + ") base")
+
+
+_Q36_BASE = """
+  SELECT CAST(sum(ss_net_profit) AS REAL) / sum(ss_ext_sales_price)
+           gross_margin,
+         i_category, i_class, 0 lochierarchy
+  FROM store_sales, date_dim d1, item, store
+  WHERE d1.d_year = 2001 AND d1.d_date_sk = ss_sold_date_sk
+    AND i_item_sk = ss_item_sk AND s_store_sk = ss_store_sk
+    AND s_state IN ('TN', 'CA')
+  GROUP BY i_category, i_class
+  UNION ALL
+  SELECT CAST(sum(ss_net_profit) AS REAL) / sum(ss_ext_sales_price),
+         i_category, NULL, 1
+  FROM store_sales, date_dim d1, item, store
+  WHERE d1.d_year = 2001 AND d1.d_date_sk = ss_sold_date_sk
+    AND i_item_sk = ss_item_sk AND s_store_sk = ss_store_sk
+    AND s_state IN ('TN', 'CA')
+  GROUP BY i_category
+  UNION ALL
+  SELECT CAST(sum(ss_net_profit) AS REAL) / sum(ss_ext_sales_price),
+         NULL, NULL, 2
+  FROM store_sales, date_dim d1, item, store
+  WHERE d1.d_year = 2001 AND d1.d_date_sk = ss_sold_date_sk
+    AND i_item_sk = ss_item_sk AND s_store_sk = ss_store_sk
+    AND s_state IN ('TN', 'CA')
+"""
+
+_Q36_ORACLE = ("SELECT gross_margin, i_category, i_class, lochierarchy, "
+               "rank() OVER (PARTITION BY lochierarchy, "
+               "CASE WHEN lochierarchy = 0 THEN i_category END "
+               "ORDER BY gross_margin ASC) rank_within_parent "
+               "FROM (" + _Q36_BASE + ") base")
+
+TPCDS_ORACLE = {
+    "q36": _Q36_ORACLE,
+    "q86": _Q86_ORACLE,
+    "q53": _cents_avg_window_oracle("q53"),
+    "q63": _cents_avg_window_oracle("q63"),
+    "q89": _cents_avg_window_oracle("q89"),
+    "q18": _rollup_oracle(
+        ["i_item_id", "ca_country", "ca_state", "ca_county"],
+        "avg(cs_quantity) agg1, avg(cs_list_price) agg2, "
+        "avg(cs_coupon_amt) agg3, avg(cs_sales_price) agg4, "
+        "avg(cs_net_profit) agg5, avg(c_birth_year) agg6, "
+        "avg(cd1.cd_dep_count) agg7",
+        _Q18_FROM, ["i_item_id", "ca_country", "ca_state", "ca_county"],
+        ""),
+    "q22": _rollup_oracle(
+        ["i_product_name", "i_brand", "i_class", "i_category"],
+        "avg(inv_quantity_on_hand) qoh",
+        _Q22_FROM, ["i_product_name", "i_brand", "i_class", "i_category"],
+        ""),
+    "q27": _q27_oracle(),
+    "q11": _yoy_oracle(TPCDS_QUERIES["q11"]),
+    "q74": _yoy_oracle(TPCDS_QUERIES["q74"]),
+    "q4": _yoy_oracle(TPCDS_QUERIES["q4"]),
 }

@@ -35,8 +35,10 @@ __all__ = ["Coordinator", "SchedulerGap"]
 
 
 class SchedulerGap(NotImplementedError):
-    """A declared round-1 scheduler limitation (see ROADMAP 'scheduler
-    depth'), distinct from unexpected NotImplementedErrors in kernels."""
+    """Historical: the round-1/2 scheduler's declared limitations. All
+    three former raise-sites now degrade to single-task scheduling
+    instead (pass 1 of _execute_fragments); the class stays importable
+    for callers that still catch it."""
 
 
 class Coordinator:
@@ -148,7 +150,19 @@ class Coordinator:
                 parent_of[src_id] = f.id
 
         # pass 1: consumer task count per fragment (shape-driven), so
-        # producers can emit exactly that many output partitions
+        # producers can emit exactly that many output partitions.
+        # Shapes the fan-out scheduler cannot run correctly DEGRADE to a
+        # single task instead of failing (plans that went through
+        # AddExchanges never produce them; hand-built or partially
+        # distributed plans still execute, just without fan-out --
+        # SOURCE_DISTRIBUTION with one node, the reference's
+        # single-node-fallback ensureSearchPartitionsMatch analog):
+        #   * range-split scans mixed with hash-partitioned upstreams
+        #     feeding a JOIN (sides would not be co-partitioned)
+        #   * a JOIN fed by a SINGLE-gathered upstream (only task 0
+        #     would see the gathered side)
+        #   * a leaf JOIN over two inline scans (range-splitting both
+        #     sides would drop cross-range matches)
         ntasks_of: Dict[int, int] = {}
         for frag in fragments:
             remote_nodes: List[N.RemoteSourceNode] = []
@@ -160,7 +174,22 @@ class Coordinator:
             single_ups = [rn for rn in remote_nodes
                           if frag_by_id[rn.fragment_id].partitioning
                           in ("SINGLE", "SORTED")]
+            has_join = _contains_join(frag.root)
             if (scans and single_ups) or _contains_global_agg(frag.root):
+                ntasks_of[frag.id] = 1
+            elif scans and hash_ups and has_join:
+                ntasks_of[frag.id] = 1
+            elif scans and _contains_global_view(frag.root):
+                # a grouped SINGLE/FINAL agg, distinct, mark-distinct or
+                # window directly over range-split scans needs ALL rows
+                # of each key/partition in one task; distributed plans
+                # put these above REPARTITION exchanges (no scans in
+                # their fragment), so only hand-built shapes land here
+                ntasks_of[frag.id] = 1
+            elif len(scans) > 1 and has_join:
+                ntasks_of[frag.id] = 1
+            elif has_join and single_ups and _join_fed_by_single(
+                    frag.root, {rn.fragment_id for rn in single_ups}):
                 ntasks_of[frag.id] = 1
             else:
                 ntasks_of[frag.id] = len(workers) if (scans or hash_ups) else 1
@@ -185,34 +214,13 @@ class Coordinator:
             # consumer parallelism: one task per hash partition when any
             # upstream is HASH; scans also fan out (range splits).
             # BROADCAST upstreams are compatible with both -- every task
-            # pulls the full replicated buffer set.
-            hash_ups = [rn for rn in remote_nodes
-                        if frag_by_id[rn.fragment_id].partitioning == "HASH"]
+            # pulls the full replicated buffer set. Shapes a fan-out
+            # cannot run correctly were degraded to ntasks == 1 in
+            # pass 1 above.
             single_ups = [rn for rn in remote_nodes
                           if frag_by_id[rn.fragment_id].partitioning
                           in ("SINGLE", "SORTED")]
-            if scans and hash_ups:
-                raise SchedulerGap(
-                    "fragment mixes range-split table scans with hash-"
-                    "partitioned remote sources; DAG scheduling lands with "
-                    "scheduler depth (ROADMAP)")
             ntasks = ntasks_of[frag.id]
-            has_join = _contains_join(frag.root)
-            if ntasks > 1 and single_ups and _join_fed_by_single(
-                    frag.root, {rn.fragment_id for rn in single_ups}):
-                # the 'SINGLE upstream feeds only consumer w=0' rule is
-                # union-safe but join-wrong: tasks w>0 would probe an
-                # empty side and task 0 only holds hash partition 0
-                raise SchedulerGap(
-                    "fanned-out fragment joins against a SINGLE-gathered "
-                    "remote source; add_exchanges must repartition the "
-                    "gathered side on the join keys first")
-            if len(scans) > 1 and ntasks > 1 and has_join:
-                raise SchedulerGap(
-                    "leaf fragment joins two scans: range-splitting both "
-                    "sides would drop cross-slice matches; run "
-                    "add_exchanges so build sides become REPLICATE "
-                    "fragments (or execute single-worker)")
 
             bodies = {}
             pending = []
@@ -309,6 +317,20 @@ def _contains_join(node: N.PlanNode) -> bool:
     if isinstance(node, (N.JoinNode, N.SemiJoinNode)):
         return True
     return any(_contains_join(s) for s in node.sources)
+
+
+def _contains_global_view(node: N.PlanNode) -> bool:
+    """Operators that must see every row of a key/partition at once
+    (fan-out over range-split scans would fragment their state).
+    Partial TopN/Limit/Sort are deliberately absent: their consumers
+    reapply the operator over the gathered/merged stream."""
+    if isinstance(node, N.AggregationNode) and node.group_channels \
+            and node.step in ("SINGLE", "FINAL"):
+        return True
+    if isinstance(node, (N.DistinctNode, N.MarkDistinctNode,
+                         N.WindowNode, N.RowNumberNode)):
+        return True
+    return any(_contains_global_view(s) for s in node.sources)
 
 
 def _join_fed_by_single(node: N.PlanNode, single_ids) -> bool:

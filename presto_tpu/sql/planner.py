@@ -56,10 +56,18 @@ class _Analyzer:
     def __init__(self, query: P.Query, sf_catalog: str = "tpch"):
         self.q = query
         self.catalog = sf_catalog
+        # id(WindowExpr) -> (channel, type) once a window stage planned
+        self.window_channels: Dict[int, Tuple[int, T.Type]] = {}
 
     # -- expression lowering ------------------------------------------------
 
     def lower(self, node, scope: _Scope) -> E.RowExpression:
+        if isinstance(node, P.WindowExpr):
+            hit = self.window_channels.get(id(node))
+            if hit is None:
+                raise NotImplementedError(
+                    "window expression outside the planned window stage")
+            return E.input_ref(*hit)
         if isinstance(node, P.Literal):
             return self._literal(node)
         if isinstance(node, P.Name):
@@ -240,10 +248,27 @@ class _Analyzer:
 
     # -- aggregate detection ------------------------------------------------
 
-    def find_aggs(self, node) -> List[P.Func]:
+    def find_aggs(self, node, window_args: bool = False) -> List[P.Func]:
+        """Collect group-aggregate calls. Window expressions are NOT
+        group aggregates themselves; with window_args=True (a GROUP BY
+        is present) the aggregates INSIDE a window's arguments/clauses
+        are collected (q53's avg(sum(x)) OVER shape), else the whole
+        window subtree is skipped (q12's sum(x) OVER over detail rows)."""
         out = []
 
         def walk(n):
+            if isinstance(n, P.WindowExpr):
+                if window_args:
+                    for a in n.func.args:
+                        if dataclasses.is_dataclass(a):
+                            walk(a)
+                    for p in n.partition_by:
+                        if dataclasses.is_dataclass(p):
+                            walk(p)
+                    for o in n.order_by:
+                        if dataclasses.is_dataclass(o.expr):
+                            walk(o.expr)
+                return
             if isinstance(n, P.Func) and n.name in _AGG_NAMES:
                 out.append(n)
                 return  # no nested aggs
@@ -375,6 +400,20 @@ def _plan_any(ast, max_groups: int, join_capacity: Optional[int]):
 
 def _strip_output(node: N.PlanNode) -> N.PlanNode:
     return node.source if isinstance(node, N.OutputNode) else node
+
+
+def _is_single_row(node: N.PlanNode) -> bool:
+    """Provably AT-MOST-one-row plan: a global (keyless) aggregation
+    under row-count-preserving-or-reducing wrappers. A const-key inner
+    join against such a side IS the cross product (0 or 1 matches per
+    probe row), so the q61/q90-style scalar-report cross joins are
+    safe."""
+    if isinstance(node, (N.ProjectNode, N.OutputNode, N.FilterNode,
+                         N.LimitNode)):
+        return _is_single_row(node.sources[0])
+    return (isinstance(node, N.AggregationNode)
+            and not node.group_channels
+            and node.step in ("SINGLE", "FINAL"))
 
 
 def _expand_grouping_sets(q: P.Query):
@@ -687,6 +726,39 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
                             or (e[2] == (t.alias or t.name) and e[0] in joined)
                             for e in edges)]
             if not cands:
+                # a PROVABLY single-row side (global-aggregate derived
+                # table: the q61/q90/q28 "ratio of two scalar reports"
+                # shape) cross-joins via a constant key broadcast -- the
+                # row count cannot explode. Anything else is a real
+                # cross product and stays rejected.
+                single = [t for t in remaining
+                          if t.name in derived_plans
+                          and _is_single_row(derived_plans[t.name][0])]
+                if single:
+                    nxt = single[0]
+                    a = nxt.alias or nxt.name
+                    right, rcols, rtys = scan_planned(nxt)
+                    nl = len(types)
+                    left_p = N.ProjectNode(node, [
+                        E.input_ref(i, types[i]) for i in range(nl)
+                    ] + [E.const(0, T.BIGINT)])
+                    right_p = N.ProjectNode(right, [
+                        E.input_ref(i, rtys[i]) for i in range(len(rtys))
+                    ] + [E.const(0, T.BIGINT)])
+                    j = N.JoinNode(left_p, right_p, [nl], [len(rtys)],
+                                   "inner", "broadcast",
+                                   right_output_channels=list(
+                                       range(len(rtys))),
+                                   out_capacity=join_capacity)
+                    node = N.ProjectNode(j, [
+                        E.input_ref(i, types[i]) for i in range(nl)
+                    ] + [E.input_ref(nl + 1 + i, rtys[i])
+                         for i in range(len(rtys))])
+                    scope_entries += [(a, c) for c in rcols]
+                    types += rtys
+                    joined.add(a)
+                    remaining.remove(nxt)
+                    continue
                 raise NotImplementedError(
                     "cross product (no equi-join predicate connects "
                     f"{[t.alias or t.name for t in remaining]} to {joined})")
@@ -854,45 +926,45 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
                 node = N.ProjectNode(f, [
                     E.input_ref(i, scope.types[i]) for i in range(nch)])
 
-    # window functions? (round 1: not mixed with GROUP BY aggregation)
-    window_items = [(i, it) for i, it in enumerate(q.select.items)
-                    if isinstance(it.expr, P.WindowExpr)]
-    if window_items:
-        assert not q.group_by, "window functions with GROUP BY: planned later"
-        node, out_exprs, names = _plan_windows(an, node, scope, q, window_items)
-        out_types = [e.type for e in out_exprs]
-        node = N.ProjectNode(node, out_exprs)
-        scope = _Scope({n_.lower(): i for i, n_ in enumerate(names)}, out_types)
-        if q.select.distinct:
-            node = N.DistinctNode(node, max_groups=max_groups)
-        if q.order_by:
-            keys = []
-            for o in q.order_by:
-                key = ".".join(o.expr.parts).lower() \
-                    if isinstance(o.expr, P.Name) else None
-                assert key in scope.channels, \
-                    "ORDER BY after window functions must use select aliases"
-                keys.append((scope.channels[key], o.descending, o.nulls_last))
-            node = N.TopNNode(node, keys, q.limit) if q.limit is not None \
-                else N.SortNode(node, keys)
-        elif q.limit is not None:
-            node = N.LimitNode(node, q.limit)
-        return node, names
+    # window expressions (possibly nested inside select items or ORDER
+    # BY, over base rows OR over aggregation output)
+    win_list: list = []
+    for item in q.select.items:
+        _collect_windows(item.expr, win_list)
+    for o in q.order_by:
+        _collect_windows(o.expr, win_list)
 
-    # aggregation?
+    # aggregation? (aggregates inside window ARGUMENTS count when the
+    # query aggregates -- a GROUP BY, or any group aggregate outside a
+    # window; see find_aggs)
+    wargs = bool(q.group_by)
+    if not wargs:
+        probe = [a for item in q.select.items
+                 for a in an.find_aggs(item.expr)]
+        probe += an.find_aggs(q.having) if q.having else []
+        wargs = bool(probe)
     select_aggs: List[P.Func] = []
     for item in q.select.items:
-        select_aggs += an.find_aggs(item.expr)
+        select_aggs += an.find_aggs(item.expr, window_args=wargs)
     having_aggs = an.find_aggs(q.having) if q.having else []
-    order_aggs = [a for o in q.order_by for a in an.find_aggs(o.expr)]
+    order_aggs = [a for o in q.order_by
+                  for a in an.find_aggs(o.expr, window_args=wargs)]
     all_aggs = select_aggs + having_aggs + order_aggs
+
+    if win_list and not (all_aggs or q.group_by):
+        # windows over detail rows: plan the stage here; the select
+        # items then lower normally with WindowExpr channel intercepts
+        node, win_map = _plan_window_stage(
+            node, win_list, lambda ast: an.lower(ast, scope), scope.types)
+        an.window_channels.update(win_map)
 
     if all_aggs or q.group_by:
         node, scope, agg_map, key_map = _plan_aggregation(
             an, node, scope, q, all_aggs, max_groups,
             grouping_sets=grouping_sets)
-        out_exprs, names, having_e, having_subs = _plan_agg_outputs(
-            an, q, scope, agg_map, key_map)
+        node, out_exprs, names, having_e, having_subs = _plan_agg_outputs(
+            an, q, scope, agg_map, key_map, grouping_sets=grouping_sets,
+            node=node, win_list=win_list)
         if having_e is not None:
             node = N.FilterNode(node, having_e)
         for lhs, op, sub in having_subs:
@@ -973,29 +1045,46 @@ _WINDOW_FN_TYPES = {"row_number": T.BIGINT, "rank": T.BIGINT,
                     "count": T.BIGINT}
 
 
-def _plan_windows(an, node, scope, q, window_items):
-    """Lower SELECT items containing window expressions: pre-project all
-    needed channels, one WindowNode (shared partition/order round 1 --
-    multiple identical OVER clauses allowed), post-project in select
-    order."""
-    pre_exprs: List[E.RowExpression] = []
+def _collect_windows(e, out: list):
+    """Every WindowExpr under `e` (windows cannot nest)."""
+    if isinstance(e, P.WindowExpr):
+        out.append(e)
+        return
+    if not dataclasses.is_dataclass(e):
+        return
+    for f in dataclasses.fields(e):
+        v = getattr(e, f.name)
+        for x in (v if isinstance(v, (list, tuple)) else [v]):
+            if isinstance(x, tuple):
+                for y in x:
+                    _collect_windows(y, out)
+            else:
+                _collect_windows(x, out)
+
+
+def _plan_window_stage(node, win_list, lower_expr, base_types):
+    """Append a WindowNode computing every WindowExpr in `win_list`
+    (one shared OVER clause round 3; distinct clauses chain later).
+    The pre-projection starts with IDENTITY refs of the node's whole
+    channel space, so downstream lowering keeps using the same channel
+    numbers; window outputs append after. `lower_expr(ast)` lowers a
+    scalar AST in that space (an.lower over the base scope, or the
+    aggregation output rewriter). Returns (node, {id(WindowExpr):
+    (channel, type)})."""
+    w0 = win_list[0]
+    for w in win_list[1:]:
+        if not (w.partition_by == w0.partition_by
+                and w.order_by == w0.order_by):
+            raise NotImplementedError(
+                "multiple distinct OVER clauses: planned later")
+    pre_exprs: List[E.RowExpression] = [
+        E.input_ref(i, t) for i, t in enumerate(base_types)]
 
     def chan_of(expr_ast) -> int:
-        e = an.lower(expr_ast, scope)
+        e = lower_expr(expr_ast)
         pre_exprs.append(e)
         return len(pre_exprs) - 1
 
-    # plain select items first
-    plain_chan: Dict[int, int] = {}
-    for i, item in enumerate(q.select.items):
-        if not isinstance(item.expr, P.WindowExpr):
-            plain_chan[i] = chan_of(item.expr)
-
-    w0 = window_items[0][1].expr
-    for _, it in window_items[1:]:
-        assert it.expr.partition_by == w0.partition_by and \
-            it.expr.order_by == w0.order_by, \
-            "multiple distinct OVER clauses: planned later"
     part_chans = [chan_of(p) for p in w0.partition_by]
     order_keys = []
     for o in w0.order_by:
@@ -1003,8 +1092,8 @@ def _plan_windows(an, node, scope, q, window_items):
 
     functions = []
     win_out_types = []
-    for _, it in window_items:
-        f = it.expr.func
+    for w in win_list:
+        f = w.func
         name = f.name
         in_ch = None
         buckets = 0
@@ -1048,19 +1137,10 @@ def _plan_windows(an, node, scope, q, window_items):
 
     node = N.ProjectNode(node, pre_exprs)
     node = N.WindowNode(node, part_chans, order_keys, functions)
-
     nwpre = len(pre_exprs)
-    out_exprs, names = [], []
-    wi = 0
-    for i, item in enumerate(q.select.items):
-        if isinstance(item.expr, P.WindowExpr):
-            out_exprs.append(E.input_ref(nwpre + wi, win_out_types[wi]))
-            wi += 1
-        else:
-            ch = plain_chan[i]
-            out_exprs.append(E.input_ref(ch, pre_exprs[ch].type))
-        names.append(_item_name(item, i))
-    return node, out_exprs, names
+    win_map = {id(w): (nwpre + k, win_out_types[k])
+               for k, w in enumerate(win_list)}
+    return node, win_map
 
 
 _CMP_NAMES = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt",
@@ -1474,7 +1554,16 @@ def _plan_aggregation(an, node, scope, q, all_aggs, max_groups,
     agg_map: Dict[int, Tuple[int, AggSpec]] = {}  # id(ast) -> (state ch, spec)
     # grouping sets add a hidden group-id KEY channel after the keys
     state_ch = len(q.group_by) + (1 if grouping_sets is not None else 0)
+    seen_asts: List[Tuple[object, int, AggSpec]] = []
     for f in all_aggs:
+        # dedupe textually identical aggregates (the q12 family names
+        # sum(x) three times: select item, ratio numerator, window arg)
+        # so the kernel computes each once
+        dup = next(((ch, sp) for ast, ch, sp in seen_asts if ast == f),
+                   None)
+        if dup is not None:
+            agg_map[id(f)] = dup
+            continue
         name = f.name
         if name == "count" and (not f.args or isinstance(f.args[0], P.Star)):
             spec = AggSpec("count_star", None, T.BIGINT)
@@ -1488,6 +1577,7 @@ def _plan_aggregation(an, node, scope, q, all_aggs, max_groups,
             spec = AggSpec(aname, in_ch, _agg_output_type(name, arg.type))
         specs.append(spec)
         agg_map[id(f)] = (state_ch, spec)
+        seen_asts.append((f, state_ch, spec))
         state_ch += 1  # SINGLE-step aggregations emit finalized columns
     node = N.ProjectNode(node, pre_exprs)
     nkeys = len(q.group_by)
@@ -1503,21 +1593,60 @@ def _plan_aggregation(an, node, scope, q, all_aggs, max_groups,
     return agg, scope, agg_map, key_map
 
 
-def _plan_agg_outputs(an, q, pre_scope, agg_map, key_map):
+def _plan_agg_outputs(an, q, pre_scope, agg_map, key_map,
+                      grouping_sets=None, node=None, win_list=None):
     """Post-aggregation projection: replace aggregate calls with refs to
     the aggregation node's finalized output channels (avg/variance
     finalization happens inside the SINGLE/FINAL aggregation step —
     ops.aggregation.finalize_states), group-by expressions with key
-    channels."""
+    channels. grouping(col) lowers to a SWITCH over the hidden gid key
+    channel (the reference evaluates it from GroupIdNode's set index the
+    same way). Window expressions over the aggregation (q53's
+    avg(sum(x)) OVER shape) plan as a WindowNode stage above the
+    aggregate (after HAVING, per SQL evaluation order); their args/
+    partition/order lower through this same rewriter.
+
+    Returns (node, out_exprs, names, having_e, having_subs); having_e
+    is None when it was already applied (window staging consumed it)."""
     agg_node_types: Dict[int, T.Type] = {}
+    # the ONE window-channel registry lives on the analyzer, so both
+    # this rewriter and an.lower (hidden ORDER BY keys) resolve the
+    # same planned windows
+    window_channels = an.window_channels
 
     def finalize(f: P.Func) -> E.RowExpression:
         ch, spec = agg_map[id(f)]
         return E.input_ref(ch, spec.output_type)
 
     def rewrite(nde, scope_keys) -> E.RowExpression:
+        if isinstance(nde, P.WindowExpr):
+            hit = window_channels.get(id(nde))
+            if hit is None:
+                raise NotImplementedError(
+                    "window expression outside the planned window stage")
+            return E.input_ref(*hit)
         if isinstance(nde, P.Func) and id(nde) in agg_map:
             return finalize(nde)
+        if isinstance(nde, P.Func) and nde.name == "grouping":
+            if grouping_sets is None:
+                raise ValueError("grouping() requires GROUP BY "
+                                 "ROLLUP/CUBE/GROUPING SETS")
+            arg = nde.args[0]
+            for ki, g in enumerate(q.group_by):
+                if g == arg:
+                    break
+            else:
+                raise ValueError(f"grouping() argument {arg} is not a "
+                                 "grouping column")
+            gid_ref = E.input_ref(len(q.group_by), T.BIGINT)
+            sw = [E.const(True, T.BOOLEAN)]
+            for si, s in enumerate(grouping_sets):
+                sw.append(E.special(
+                    "WHEN", T.BIGINT,
+                    E.call("eq", T.BOOLEAN, gid_ref,
+                           E.const(si, T.BIGINT)),
+                    E.const(0 if ki in s else 1, T.BIGINT)))
+            return E.special("SWITCH", T.BIGINT, *sw)
         # group key expression?
         for i, g in enumerate(q.group_by):
             if nde == g or (isinstance(g, P.Literal) and g.kind == "int"
@@ -1544,6 +1673,29 @@ def _plan_agg_outputs(an, q, pre_scope, agg_map, key_map):
         if isinstance(nde, P.Cast):
             v = rewrite(nde.value, scope_keys)
             return E.call("cast", T.parse_type(nde.type_name), v)
+        if isinstance(nde, P.Case):
+            whens = [(rewrite(c, scope_keys), rewrite(r, scope_keys))
+                     for c, r in nde.whens]
+            default = rewrite(nde.default, scope_keys) \
+                if nde.default is not None else None
+            rty = whens[0][1].type if whens else \
+                (default.type if default else T.UNKNOWN)
+            args = [rewrite(nde.operand, scope_keys)
+                    if nde.operand is not None else E.const(True, T.BOOLEAN)]
+            for c, r in whens:
+                args.append(E.special("WHEN", rty, c, r))
+            if default is not None:
+                args.append(default)
+            return E.special("SWITCH", rty, *args)
+        if isinstance(nde, P.IsNull):
+            e = E.special("IS_NULL", T.BOOLEAN, rewrite(nde.value, scope_keys))
+            return E.call("not", T.BOOLEAN, e) if nde.negate else e
+        if isinstance(nde, P.Between):
+            v = rewrite(nde.value, scope_keys)
+            e = E.special("BETWEEN", T.BOOLEAN, v,
+                          rewrite(nde.lo, scope_keys),
+                          rewrite(nde.hi, scope_keys))
+            return E.call("not", T.BOOLEAN, e) if nde.negate else e
         raise NotImplementedError(
             f"expression over aggregates not supported: {nde}")
 
@@ -1556,12 +1708,6 @@ def _plan_agg_outputs(an, q, pre_scope, agg_map, key_map):
         else:
             e = an.lower(g, pre_scope)
         key_types[key_map[i]] = e.type
-
-    out_exprs, names = [], []
-    for i, item in enumerate(q.select.items):
-        e = rewrite(item.expr, key_types)
-        out_exprs.append(e)
-        names.append(_item_name(item, i))
 
     having_e = None
     having_scalar_subs = []
@@ -1577,7 +1723,27 @@ def _plan_agg_outputs(an, q, pre_scope, agg_map, key_map):
                 e = rewrite(conj, key_types)
                 having_e = e if having_e is None else \
                     E.special("AND", T.BOOLEAN, having_e, e)
-    return out_exprs, names, having_e, having_scalar_subs
+
+    if win_list:
+        # SQL evaluation order: HAVING restricts groups BEFORE window
+        # functions see them
+        if having_scalar_subs:
+            raise NotImplementedError(
+                "window functions with HAVING scalar subqueries")
+        if having_e is not None:
+            node = N.FilterNode(node, having_e)
+            having_e = None
+        node, win_map = _plan_window_stage(
+            node, win_list, lambda ast: rewrite(ast, key_types),
+            node.output_types())
+        window_channels.update(win_map)
+
+    out_exprs, names = [], []
+    for i, item in enumerate(q.select.items):
+        e = rewrite(item.expr, key_types)
+        out_exprs.append(e)
+        names.append(_item_name(item, i))
+    return node, out_exprs, names, having_e, having_scalar_subs
 
 
 def sql(query_text: str, sf: float = 0.01, mesh=None,

@@ -201,3 +201,42 @@ def test_distributed_high_cardinality(cluster):
     got = {int(cols[0][0][i]): (int(cols[1][0][i]), int(cols[2][0][i]))
            for i in range(len(cols[0][0]))}
     assert got == want
+
+
+def test_former_scheduler_gaps_degrade_to_single_task(cluster):
+    """Shapes the fan-out scheduler cannot parallelize (only reachable
+    by skipping AddExchanges) now execute via single-task degradation
+    instead of raising SchedulerGap."""
+    import collections
+
+    from presto_tpu.connectors import tpch as tpch_conn
+    from presto_tpu.plan import (ExchangeNode, JoinNode, OutputNode,
+                                 TableScanNode, UnionNode)
+
+    coord = Coordinator([f"http://127.0.0.1:{w.port}" for w in cluster])
+
+    def ts(table, cols):
+        return TableScanNode("tpch", table, cols,
+                             [tpch_conn.column_type(table, c) for c in cols])
+
+    # (a) leaf fragment joining two inline scans
+    j = JoinNode(ts("orders", ["custkey", "totalprice"]),
+                 ts("customer", ["custkey", "mktsegment"]),
+                 [0], [0], "inner", "broadcast",
+                 out_capacity=1 << 18)
+    plan = OutputNode(j, ["ck", "tp", "ck2", "seg"])
+    local = run_query(plan, sf=0.01)
+    cols, _ = coord.execute(plan, sf=0.01)
+    assert len(cols[0][0]) == local.row_count
+
+    # (b) fragment mixing a range-split scan with a HASH upstream
+    # (union shape: disjoint partitions concatenate correctly)
+    rep = ExchangeNode(ts("customer", ["custkey"]), kind="REPARTITION",
+                       scope="REMOTE", partition_channels=[0])
+    u = UnionNode([ts("orders", ["custkey"]), rep])
+    plan2 = OutputNode(u, ["k"])
+    local2 = run_query(plan2, sf=0.01)
+    want = collections.Counter(int(r[0]) for r in local2.rows())
+    cols2, _ = coord.execute(plan2, sf=0.01)
+    got = collections.Counter(int(v) for v in cols2[0][0])
+    assert got == want

@@ -217,10 +217,12 @@ def run_tpcds_case(name: str, sf: float = 0.02, *, sql_text: str = None,
     LIMIT and the comparison is an exact top-k prefix match.
 
     Returns the engine rows so tests can make extra assertions."""
-    from presto_tpu.queries.tpcds_queries import TPCDS_QUERIES
+    from presto_tpu.queries.tpcds_queries import TPCDS_ORACLE, TPCDS_QUERIES
     from presto_tpu.sql import sql as engine_sql
 
     text = sql_text if sql_text is not None else TPCDS_QUERIES[name]
+    if oracle_sql is None:
+        oracle_sql = TPCDS_ORACLE.get(name)
     limit_m = re.search(r"\bLIMIT\s+(\d+)\s*$", text.rstrip(),
                         re.IGNORECASE)
     limit = int(limit_m.group(1)) if limit_m else None
