@@ -47,13 +47,23 @@ def batch_bytes(batch: Batch) -> int:
 
 
 class MemoryPool:
-    """Per-worker reservation pool (MemoryPool.java:45 analog)."""
+    """Per-worker reservation pool (MemoryPool.java:45 analog), with
+    revocation: holders of REVOCABLE reservations (spillable state --
+    partial group tables, join build sides) register a callback that
+    moves their device state to host DRAM; a reservation that would
+    exceed capacity triggers revocation (largest holdings first, the
+    MemoryRevokingScheduler's TASK_REVOCABLE_MEMORY policy) before it
+    fails."""
 
     def __init__(self, capacity_bytes: int, name: str = "general"):
         self.name = name
         self.capacity = capacity_bytes
         self._reserved: Dict[str, int] = {}
+        # revocable registrations: id -> (query_id, bytes, callback)
+        self._revocables: Dict[int, tuple] = {}
+        self._next_rid = 0
         self._lock = threading.Lock()
+        self.revoked_bytes = 0  # counter: surfaced in stats/EXPLAIN
 
     @property
     def reserved_bytes(self) -> int:
@@ -64,17 +74,68 @@ class MemoryPool:
     def free_bytes(self) -> int:
         return self.capacity - self.reserved_bytes
 
-    def reserve(self, query_id: str, bytes_: int):
-        """Blocking semantics in the reference; here reservation failure
-        raises and the caller (runner) downsizes buckets or spills."""
+    def register_revocable(self, query_id: str, bytes_: int, revoke_cb
+                           ) -> int:
+        """Reserve `bytes_` as revocable state; `revoke_cb()` must move
+        the state off-device and returns the bytes actually freed.
+        Returns a registration id for unregister_revocable."""
+        self.reserve(query_id, bytes_)
         with self._lock:
-            total = sum(self._reserved.values()) + bytes_
-            if total > self.capacity:
-                raise MemoryReservationError(
-                    f"pool {self.name}: reserve {bytes_} for {query_id} "
-                    f"exceeds capacity {self.capacity} "
-                    f"(reserved {total - bytes_})")
-            self._reserved[query_id] = self._reserved.get(query_id, 0) + bytes_
+            rid = self._next_rid
+            self._next_rid += 1
+            self._revocables[rid] = (query_id, bytes_, revoke_cb)
+        return rid
+
+    def unregister_revocable(self, rid: int):
+        with self._lock:
+            entry = self._revocables.pop(rid, None)
+        if entry is not None:
+            self.free(entry[0], entry[1])
+
+    def _revoke(self, needed: int) -> int:
+        """Revoke registrations (largest first) until `needed` bytes are
+        freed or none remain. Called WITHOUT the lock held (callbacks do
+        device work). Revocation releases the WHOLE registration: the
+        callback's contract is to move all of that state off-device, and
+        the reservation is freed even if it raises (the state owner can
+        no longer count on the reservation either way -- no residue may
+        leak into the pool)."""
+        freed_total = 0
+        while freed_total < needed:
+            with self._lock:
+                if not self._revocables:
+                    break
+                rid, (qid, bytes_, cb) = max(
+                    self._revocables.items(), key=lambda kv: kv[1][1])
+                del self._revocables[rid]
+            try:
+                cb()
+            finally:
+                self.free(qid, bytes_)
+                with self._lock:
+                    self.revoked_bytes += bytes_
+                freed_total += bytes_
+        return freed_total
+
+    def reserve(self, query_id: str, bytes_: int):
+        """Failure first triggers revocation of spillable state; only
+        when nothing (more) can be revoked does it raise -- the caller
+        then downsizes buckets or spills its own inputs."""
+        for attempt in (0, 1):
+            with self._lock:
+                total = sum(self._reserved.values()) + bytes_
+                if total <= self.capacity:
+                    self._reserved[query_id] = \
+                        self._reserved.get(query_id, 0) + bytes_
+                    return
+                shortfall = total - self.capacity
+                can_revoke = bool(self._revocables) and attempt == 0
+            if not can_revoke or self._revoke(shortfall) <= 0:
+                break
+        raise MemoryReservationError(
+            f"pool {self.name}: reserve {bytes_} for {query_id} "
+            f"exceeds capacity {self.capacity} "
+            f"(reserved {self.reserved_bytes})")
 
     def try_reserve(self, query_id: str, bytes_: int) -> bool:
         try:

@@ -79,7 +79,8 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
               scan_ranges: Optional[Dict[str, Tuple[int, int]]] = None,
               remote_sources: Optional[Dict[str, Batch]] = None,
               memory_pool=None, query_id: str = "query",
-              session=None) -> QueryResult:
+              session=None,
+              hbm_budget_bytes: Optional[int] = None) -> QueryResult:
     """Plan -> results, end to end (DistributedQueryRunner analog for
     programmatic plans). With a mesh, scan batches are padded to a
     multiple of the mesh size and the plan runs SPMD. With `split_rows`,
@@ -114,10 +115,26 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
         raise ValueError("plan not executable by the TPU engine "
                          f"(PlanChecker): {violations}")
     stats = RuntimeStats()
+    hbm_budget = hbm_budget_bytes
+    if hbm_budget is None and session is not None:
+        hbm_budget = session.get("hbm_budget_bytes")
     if split_rows is not None and mesh is None:
         from .streaming import run_streaming_agg, streamable_agg_shape
         shape = streamable_agg_shape(root)
         if shape is not None:
+            agg_node, _ = shape
+            if hbm_budget:  # 0 / None = uncapped (the config default)
+                from .spill import plan_state_bytes, run_spilled_agg
+                if 2 * plan_state_bytes(agg_node) > hbm_budget:
+                    # the full state table cannot fit the budget: grouped
+                    # execution with per-bucket host offload (the
+                    # SpillableHashAggregationBuilder path)
+                    with stats.timed("spilled_exec_s"):
+                        out_b = run_spilled_agg(root, sf, split_rows,
+                                                hbm_budget, stats)
+                    res = _batch_to_result(out_b, root)
+                    res.stats = stats.snapshot()
+                    return res
             with stats.timed("streaming_exec_s"):
                 r = run_streaming_agg(root, sf, split_rows)
             if bool(np.asarray(r.overflow)):
@@ -126,7 +143,6 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
             # the streaming executor accumulates raw states; SINGLE-step
             # plans still owe the evaluateFinal step
             from ..ops.aggregation import finalize_states
-            agg_node, _ = shape
             out_b = finalize_states(r.batch, len(agg_node.group_channels),
                                     agg_node.aggregates)
             res = _batch_to_result(out_b, root)

@@ -143,6 +143,10 @@ SESSION_PROPERTIES = (
          "let connector NDV statistics SHRINK group-table capacities "
          "(plan.stats.refine_capacities); disable when a hand-set "
          "max_groups must stay authoritative")
+    .add("hbm_budget_bytes", "int", 0,
+         "cap on per-query device state; aggregations whose planned "
+         "group table exceeds it run grouped-execution spill to host "
+         "DRAM (exec/spill.py; 0 = uncapped)")
 )
 
 
