@@ -1,0 +1,524 @@
+"""Reference-protocol adapter: parse REAL coordinator documents.
+
+Reference surface: the worker RPC seam a Presto coordinator speaks --
+server/TaskUpdateRequest.java:50-55 ({session, extraCredentials,
+fragment(base64 JSON bytes), sources, outputIds, tableWriteInfo}),
+PlanFragment.java:50 and the spi/plan JSON vocabulary mirrored by
+presto_protocol_core.yml (the C++ worker generates 12.9k lines of
+struct mirrors from it), and worker-protocol.rst. This module is the
+PrestoToVeloxQueryPlan.cpp analog: deserialized reference JSON lowers
+into THIS engine's channel-indexed plan nodes; anything outside the
+supported vocabulary raises ProtocolUnsupported with the construct
+named (the VeloxPlanValidator rejection contract, which the
+plan-checker-router uses to fall back to a Java cluster).
+
+Supported slice (round 3): TableScanNode (tpch connector handle),
+FilterNode, ProjectNode, AggregationNode (SINGLE + single-state
+PARTIAL/FINAL), ValuesNode, LimitNode, SortNode, TopNNode, REMOTE/LOCAL
+ExchangeNode, RemoteSourceNode, OutputNode; RowExpressions (variable /
+constant-with-valueBlock / call / special); TaskInfo & TaskStatus
+emitted with the spec's field names (main/tests/data/TaskInfo.json
+shape).
+
+Symbol resolution: the reference ships VariableReferenceExpressions +
+per-node output layouts; translation resolves them ONCE at ingest into
+channel indices (the design note in plan/nodes.py). Constants arrive as
+base64 single-row SerializedBlocks -- decoded by the engine's own
+serde (serde/pages.py implements the same spec).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..expr import ir as E
+from ..ops.aggregation import AggSpec
+from ..plan import nodes as N
+
+__all__ = ["ProtocolUnsupported", "parse_task_update_request",
+           "translate_fragment", "translate_row_expression",
+           "decode_constant_block", "task_info_json", "task_status_json"]
+
+
+class ProtocolUnsupported(ValueError):
+    """A protocol construct outside the supported slice (PlanChecker
+    rejection: route this fragment to a Java worker)."""
+
+
+# ---------------------------------------------------------------------------
+# types, constants, expressions
+# ---------------------------------------------------------------------------
+
+
+def _type_of(sig: str) -> T.Type:
+    try:
+        return T.parse_type(sig)
+    except Exception as e:  # noqa: BLE001
+        raise ProtocolUnsupported(f"type signature {sig!r}: {e}") from e
+
+
+def decode_constant_block(b64: str, ty: T.Type):
+    """ConstantExpression.valueBlock: a base64 single-row block in the
+    spec's block-encoding format ([len][encoding name][payload])."""
+    from ..serde.pages import _deserialize_block
+
+    buf = base64.b64decode(b64)
+    (vals, nulls), _pos = _deserialize_block(memoryview(buf), 0, ty)
+    if len(vals) == 0 or (len(nulls) and nulls[0]):
+        return None
+    v = vals[0]
+    if isinstance(v, (np.generic,)):
+        v = v.item()
+    return v
+
+
+_OPERATORS = {
+    "$operator$equal": "eq", "$operator$not_equal": "ne",
+    "$operator$less_than": "lt", "$operator$less_than_or_equal": "le",
+    "$operator$greater_than": "gt", "$operator$greater_than_or_equal": "ge",
+    "$operator$add": "add", "$operator$subtract": "subtract",
+    "$operator$multiply": "multiply", "$operator$divide": "divide",
+    "$operator$modulus": "modulus", "$operator$negation": "negate",
+    "$operator$cast": "cast", "$operator$between": None,  # special-cased
+    "not": "not",
+}
+
+
+def _function_name(handle: dict) -> str:
+    sig = handle.get("signature", {})
+    name = sig.get("name", "")
+    if name.startswith("presto.default."):
+        name = name[len("presto.default."):]
+    return name
+
+
+def translate_row_expression(j: dict, layout: Dict[str, Tuple[int, T.Type]]
+                             ) -> E.RowExpression:
+    t = j.get("@type")
+    if t == "variable":
+        hit = layout.get(j["name"])
+        if hit is None:
+            raise ProtocolUnsupported(
+                f"variable {j['name']!r} not in source layout "
+                f"{sorted(layout)}")
+        ch, ty = hit
+        return E.input_ref(ch, ty)
+    if t == "constant":
+        ty = _type_of(j["type"])
+        return E.const(decode_constant_block(j["valueBlock"], ty), ty)
+    if t == "call":
+        name = _function_name(j.get("functionHandle", {})) or \
+            j.get("displayName", "").lower()
+        rty = _type_of(j["returnType"])
+        args = [translate_row_expression(a, layout)
+                for a in j.get("arguments", [])]
+        if name == "$operator$between":
+            return E.special("BETWEEN", T.BOOLEAN, *args)
+        mapped = _OPERATORS.get(name, name)
+        if mapped is None or mapped.startswith("$"):
+            raise ProtocolUnsupported(f"function {name!r}")
+        return E.call(mapped, rty, *args)
+    if t == "special":
+        form = j.get("form")
+        rty = _type_of(j["returnType"])
+        args = [translate_row_expression(a, layout)
+                for a in j.get("arguments", [])]
+        if form in ("AND", "OR", "IF", "SWITCH", "WHEN", "COALESCE", "IN",
+                    "IS_NULL", "NULL_IF", "BETWEEN"):
+            return E.special(form, rty, *args)
+        raise ProtocolUnsupported(f"special form {form!r}")
+    raise ProtocolUnsupported(f"row expression @type {t!r}")
+
+
+# ---------------------------------------------------------------------------
+# plan nodes
+# ---------------------------------------------------------------------------
+
+
+def _node_kind(j: dict) -> str:
+    t = j.get("@type", "")
+    return t.rsplit(".", 1)[-1]  # ".FilterNode" / full class name / bare
+
+
+def _vars(lst) -> List[Tuple[str, T.Type]]:
+    return [(v["name"], _type_of(v["type"])) for v in lst]
+
+
+def _layout_of(pairs: List[Tuple[str, T.Type]]
+               ) -> Dict[str, Tuple[int, T.Type]]:
+    return {name: (i, ty) for i, (name, ty) in enumerate(pairs)}
+
+
+# Presto's tpch column names carry the table prefix (l_orderkey); this
+# engine's tpch schema is unprefixed (generator.py) -- strip it.
+_TPCH_PREFIXES = ("l_", "o_", "c_", "p_", "s_", "ps_", "n_", "r_")
+
+
+def _tpch_column(name: str) -> str:
+    for p in _TPCH_PREFIXES:
+        if name.startswith(p):
+            return name[len(p):]
+    return name
+
+
+def _strip_type_suffix(key: str) -> str:
+    # assignment keys look like "sum_20<double>"
+    return key.split("<", 1)[0]
+
+
+def translate_node(j: dict) -> Tuple[N.PlanNode, List[Tuple[str, T.Type]]]:
+    """Reference plan-node JSON -> (engine node, output layout)."""
+    kind = _node_kind(j)
+
+    if kind == "TableScanNode":
+        table = j.get("table", {})
+        handle = table.get("connectorHandle", {})
+        connector = table.get("connectorId", handle.get("@type"))
+        if connector not in ("tpch", "tpcds"):
+            raise ProtocolUnsupported(
+                f"connector {connector!r} (tpch/tpcds supported)")
+        table_name = handle.get("tableName") or handle.get("table")
+        if not table_name:
+            raise ProtocolUnsupported("table handle without tableName")
+        out = _vars(j["outputVariables"])
+        assignments = j.get("assignments", {})
+        columns = []
+        for name, _ty in out:
+            col = None
+            for k, h in assignments.items():
+                if _strip_type_suffix(k) == name:
+                    col = h.get("columnName") or h.get("name")
+                    break
+            col = col or name
+            if connector == "tpch":
+                col = _tpch_column(col)
+            columns.append(col)
+        node = N.TableScanNode(connector, table_name, columns,
+                               [ty for _, ty in out])
+        return node, out
+
+    if kind == "ValuesNode":
+        out = _vars(j["outputVariables"])
+        rows = []
+        for r in j.get("rows", []):
+            row = []
+            for cell, (_n, ty) in zip(r, out):
+                if cell.get("@type") != "constant":
+                    raise ProtocolUnsupported("non-constant VALUES cell")
+                row.append(decode_constant_block(cell["valueBlock"], ty))
+            rows.append(row)
+        return N.ValuesNode([ty for _, ty in out], rows), out
+
+    if kind == "FilterNode":
+        src, src_out = translate_node(j["source"])
+        pred = translate_row_expression(j["predicate"], _layout_of(src_out))
+        return N.FilterNode(src, pred), src_out
+
+    if kind == "ProjectNode":
+        src, src_out = translate_node(j["source"])
+        layout = _layout_of(src_out)
+        assignments = j["assignments"].get("assignments", j["assignments"])
+        exprs, out = [], []
+        for key, ex in assignments.items():
+            name = _strip_type_suffix(key)
+            e = translate_row_expression(ex, layout)
+            exprs.append(e)
+            out.append((name, e.type))
+        return N.ProjectNode(src, exprs), out
+
+    if kind == "AggregationNode":
+        src, src_out = translate_node(j["source"])
+        layout = _layout_of(src_out)
+        gs = j.get("groupingSets", {})
+        if gs.get("groupingSetCount", 1) != 1 or gs.get("globalGroupingSets"):
+            raise ProtocolUnsupported(
+                "multiple grouping sets arrive via GroupIdNode")
+        keys = []
+        out: List[Tuple[str, T.Type]] = []
+        for v in gs.get("groupingKeys", []):
+            ch, ty = layout[v["name"]]
+            keys.append(ch)
+            out.append((v["name"], ty))
+        step = j.get("step", "SINGLE")
+        specs = []
+        for key, agg in j.get("aggregations", {}).items():
+            name = _strip_type_suffix(key)
+            call = agg.get("call", agg)
+            fname = _function_name(call.get("functionHandle",
+                                            agg.get("functionHandle", {})))
+            rty = _type_of(call["returnType"])
+            args = call.get("arguments", [])
+            if agg.get("mask") is not None or agg.get("orderBy"):
+                raise ProtocolUnsupported("masked/ordered aggregation")
+            if agg.get("distinct"):
+                if fname != "count":
+                    raise ProtocolUnsupported(
+                        f"DISTINCT qualifier on {fname!r}")
+                fname = "count_distinct"
+            if fname == "count" and not args:
+                spec = AggSpec("count_star", None, T.BIGINT)
+            else:
+                if len(args) != 1 or args[0].get("@type") != "variable":
+                    raise ProtocolUnsupported(
+                        f"aggregation argument shape for {fname!r}")
+                ch, _ty = layout[args[0]["name"]]
+                spec = AggSpec(fname, ch, rty)
+            if step in ("PARTIAL", "FINAL", "INTERMEDIATE") and \
+                    spec.canonical in ("avg", "var_samp", "var_pop",
+                                       "stddev_samp", "stddev_pop",
+                                       "min_by", "max_by"):
+                raise ProtocolUnsupported(
+                    f"{fname} with multi-column intermediate state over "
+                    "the wire (row-typed states land with the sketch "
+                    "library)")
+            specs.append(spec)
+            out.append((name, spec.output_type))
+        node = N.AggregationNode(src, keys, specs, step=step)
+        return node, out
+
+    if kind == "LimitNode":
+        src, src_out = translate_node(j["source"])
+        return N.LimitNode(src, int(j["count"])), src_out
+
+    if kind in ("SortNode", "TopNNode"):
+        src, src_out = translate_node(j["source"])
+        layout = _layout_of(src_out)
+        scheme = j.get("orderingScheme", {})
+        sort_keys = []
+        for ob in scheme.get("orderBy", []):
+            v = ob.get("variable", ob)
+            ch, _ty = layout[v["name"]]
+            order = ob.get("sortOrder") or \
+                scheme.get("orderings", {}).get(v["name"], "ASC_NULLS_LAST")
+            sort_keys.append((ch, order.startswith("DESC"),
+                              order.endswith("NULLS_LAST")))
+        if kind == "TopNNode":
+            return N.TopNNode(src, sort_keys, int(j["count"])), src_out
+        return N.SortNode(src, sort_keys), src_out
+
+    if kind == "ExchangeNode":
+        sources = j.get("sources", [])
+        scope = j.get("scope", "REMOTE")
+        ex_type = j.get("type", "REPARTITION")
+        if not sources and scope.upper().startswith("LOCAL"):
+            # a source-less LOCAL exchange is an intra-task pipeline
+            # seam (LocalExchange source operator); this engine fuses
+            # local pipelines into one program, so the seam carries no
+            # operator -- stand it in as a typed empty source (only
+            # isolated node fixtures ship this shape; complete
+            # fragments wire real sources)
+            out = _vars(j.get("partitioningScheme", {})
+                        .get("outputLayout", []))
+            node = N.ValuesNode([ty for _, ty in out], [])
+            return N.ExchangeNode(node, kind="REPARTITION",
+                                  scope="LOCAL"), out
+        if len(sources) != 1:
+            raise ProtocolUnsupported(
+                f"exchange with {len(sources)} sources")
+        src, src_out = translate_node(sources[0])
+        if scope.upper().startswith("LOCAL"):
+            return N.ExchangeNode(src, kind="REPARTITION", scope="LOCAL"), \
+                src_out
+        scheme = j.get("partitioningScheme", {})
+        layout = _layout_of(src_out)
+        if ex_type == "GATHER":
+            ordering = j.get("orderingScheme")
+            if ordering:
+                # a merging gather (MergeOperator edge): keep the order
+                sort_keys = []
+                for ob in ordering.get("orderBy", []):
+                    v = ob.get("variable", ob)
+                    order = ob.get("sortOrder", "ASC_NULLS_LAST")
+                    sort_keys.append((layout[v["name"]][0],
+                                      order.startswith("DESC"),
+                                      order.endswith("NULLS_LAST")))
+                return N.ExchangeNode(src, kind="MERGE", scope="REMOTE",
+                                      sort_keys=sort_keys), src_out
+            return N.ExchangeNode(src, kind="GATHER", scope="REMOTE"), src_out
+        if ex_type == "REPARTITION":
+            args = scheme.get("partitioning", {}).get("arguments", [])
+            chans = []
+            for a in args:
+                if a.get("@type") != "variable":
+                    raise ProtocolUnsupported("non-variable partition arg")
+                chans.append(layout[a["name"]][0])
+            return N.ExchangeNode(src, kind="REPARTITION", scope="REMOTE",
+                                  partition_channels=chans), src_out
+        if ex_type == "REPLICATE":
+            return N.ExchangeNode(src, kind="REPLICATE", scope="REMOTE"), \
+                src_out
+        raise ProtocolUnsupported(f"exchange type {ex_type!r}")
+
+    if kind == "RemoteSourceNode":
+        out = _vars(j["outputVariables"])
+        frag_ids = j.get("sourceFragmentIds", [])
+        fid = int(frag_ids[0]) if frag_ids else -1
+        return N.RemoteSourceNode([ty for _, ty in out], fid), out
+
+    if kind == "OutputNode":
+        src, src_out = translate_node(j["source"])
+        return N.OutputNode(src, list(j.get("columnNames", []))), src_out
+
+    raise ProtocolUnsupported(f"plan node {j.get('@type')!r}")
+
+
+def translate_fragment(j: dict) -> Tuple[N.PlanNode, dict]:
+    """PlanFragment JSON -> (engine plan root, fragment info). Accepts
+    the fragment object directly or its base64-encoded bytes (the
+    TaskUpdateRequest wire form)."""
+    if isinstance(j, str):
+        j = json.loads(base64.b64decode(j))
+    root, _out = translate_node(j["root"])
+    info = {
+        "id": j.get("id"),
+        "partitioning": (j.get("partitioning", {})
+                         .get("connectorHandle", {}).get("partitioning")),
+        "tableScanSchedulingOrder": j.get("tableScanSchedulingOrder", []),
+        "scaleFactor": _find_scale(j["root"]),
+    }
+    return root, info
+
+
+def _find_scale(j):
+    """The tpch/tpcds connector handles carry scaleFactor; splits are
+    assigned separately, so the fragment-level value seeds the worker's
+    generator."""
+    if isinstance(j, dict):
+        if "scaleFactor" in j:
+            return j["scaleFactor"]
+        for v in j.values():
+            r = _find_scale(v)
+            if r is not None:
+                return r
+    elif isinstance(j, list):
+        for v in j:
+            r = _find_scale(v)
+            if r is not None:
+                return r
+    return None
+
+
+def parse_task_update_request(j: dict) -> dict:
+    """TaskUpdateRequest JSON (server/TaskUpdateRequest.java:50-55) ->
+    {plan, fragmentInfo, splits, outputBuffers, session}. Raises
+    ProtocolUnsupported outside the slice."""
+    out: dict = {"plan": None, "fragmentInfo": None}
+    if j.get("fragment") is not None:
+        out["plan"], out["fragmentInfo"] = translate_fragment(j["fragment"])
+    splits = []
+    for src in j.get("sources", []):
+        for sched in src.get("splits", []):
+            s = sched.get("split", sched)
+            splits.append({
+                "planNodeId": src.get("planNodeId"),
+                "sequenceId": sched.get("sequenceId"),
+                "connectorId": s.get("connectorId"),
+                "connectorSplit": s.get("connectorSplit"),
+            })
+    out["splits"] = splits
+    buffers = j.get("outputIds", {})
+    out["outputBuffers"] = {
+        "type": buffers.get("type"),
+        "buffers": buffers.get("buffers", {}),
+        "noMoreBufferIds": buffers.get("noMoreBufferIds", False),
+    }
+    sess = j.get("session", {})
+    out["session"] = {
+        "queryId": sess.get("queryId"),
+        "user": sess.get("user"),
+        "systemProperties": sess.get("systemProperties", {}),
+    }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TaskInfo / TaskStatus (spec field names; TaskInfo.json shape)
+# ---------------------------------------------------------------------------
+
+_STATE_MAP = {"PENDING": "PLANNED", "RUNNING": "RUNNING",
+              "FINISHED": "FINISHED", "FAILED": "FAILED",
+              "ABORTED": "ABORTED", "CANCELED": "CANCELED"}
+
+
+def task_status_json(task_id: str, state: str, worker_uri: str,
+                     version: int = 1,
+                     memory_bytes: int = 0,
+                     failures: Optional[List[str]] = None) -> dict:
+    return {
+        "taskInstanceIdLeastSignificantBits": 0,
+        "taskInstanceIdMostSignificantBits": 0,
+        "version": version,
+        "state": _STATE_MAP.get(state, state),
+        "self": f"{worker_uri}/v1/task/{task_id}",
+        "completedDriverGroups": [],
+        "failures": [{"message": m, "type": "USER_ERROR"}
+                     for m in (failures or [])],
+        "queuedPartitionedDrivers": 0,
+        "runningPartitionedDrivers": 1 if state == "RUNNING" else 0,
+        "outputBufferUtilization": 0.0,
+        "outputBufferOverutilized": False,
+        "physicalWrittenDataSizeInBytes": 0,
+        "memoryReservationInBytes": memory_bytes,
+        "systemMemoryReservationInBytes": 0,
+        "fullGcCount": 0,
+        "fullGcTimeInMillis": 0,
+        "peakNodeTotalMemoryReservationInBytes": memory_bytes,
+        "totalCpuTimeInNanos": 0,
+        "taskAgeInMillis": 0,
+        "queuedPartitionedSplitsWeight": 0,
+        "runningPartitionedSplitsWeight": 0,
+    }
+
+
+def task_info_json(task_id: str, state: str, worker_uri: str,
+                   node_id: str, last_heartbeat_ms: int,
+                   rows: int = 0, version: int = 1,
+                   memory_bytes: int = 0,
+                   failures: Optional[List[str]] = None) -> dict:
+    done = state in ("FINISHED", "FAILED", "ABORTED", "CANCELED")
+    return {
+        "taskId": task_id,
+        "taskStatus": task_status_json(task_id, state, worker_uri,
+                                       version, memory_bytes, failures),
+        "lastHeartbeatInMillis": last_heartbeat_ms,
+        "outputBuffers": {
+            "type": "PARTITIONED",
+            "state": "FINISHED" if done else "OPEN",
+            "canAddBuffers": False,
+            "canAddPages": not done,
+            "totalBufferedBytes": 0,
+            "totalBufferedPages": 0,
+            "totalRowsSent": rows,
+            "totalPagesSent": 1 if rows else 0,
+            "buffers": [],
+        },
+        "noMoreSplits": [],
+        "stats": {
+            "createTimeInMillis": last_heartbeat_ms,
+            "elapsedTimeInNanos": 0,
+            "queuedTimeInNanos": 0,
+            "totalDrivers": 1,
+            "queuedDrivers": 0,
+            "runningDrivers": 0 if done else 1,
+            "blockedDrivers": 0,
+            "completedDrivers": 1 if done else 0,
+            "totalSplits": 1,
+            "queuedSplits": 0,
+            "runningSplits": 0 if done else 1,
+            "completedSplits": 1 if done else 0,
+            "cumulativeUserMemory": 0.0,
+            "userMemoryReservationInBytes": memory_bytes,
+            "revocableMemoryReservationInBytes": 0,
+            "systemMemoryReservationInBytes": 0,
+            "rawInputPositions": rows,
+            "processedInputPositions": rows,
+            "outputPositions": rows,
+        },
+        "needsPlan": False,
+        "nodeId": node_id,
+    }

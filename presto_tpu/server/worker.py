@@ -346,10 +346,32 @@ class _Handler(BaseHTTPRequestHandler):
                 "memoryReservedBytes": self.manager.memory_pool.reserved_bytes,
                 "memoryCapacityBytes": self.manager.memory_pool.capacity})
         if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+            tid, _, query = parts[2].partition("?")
+            task = self.manager.get(tid)
+            if task is None:
+                return self._send_json({"error": "no such task"}, 404)
+            if "format=spec" in query:
+                # spec-shaped TaskInfo (main/tests/data/TaskInfo.json)
+                from .protocol import task_info_json
+                return self._send_json(task_info_json(
+                    tid, task.state, f"http://{self.node_id}",
+                    self.node_id, int(time.time() * 1000),
+                    rows=task.stats.get("outputRows", 0)
+                    if isinstance(getattr(task, "stats", None), dict)
+                    else 0))
+            return self._send_json(task.info())
+        if len(parts) == 4 and parts[:2] == ["v1", "task"] and \
+                parts[3] == "status":
+            # spec-shaped TaskStatus long-poll target (TaskResource
+            # status:182 analog; the reference coordinator polls this)
             task = self.manager.get(parts[2])
             if task is None:
                 return self._send_json({"error": "no such task"}, 404)
-            return self._send_json(task.info())
+            from .protocol import task_status_json
+            return self._send_json(task_status_json(
+                parts[2], task.state, f"http://{self.node_id}",
+                failures=[task.error] if getattr(task, "error", None)
+                else None))
         if len(parts) == 7 and parts[:2] == ["v1", "task"] and \
                 parts[3] == "results" and parts[6] == "acknowledge":
             self.manager.acknowledge(parts[2], int(parts[5]), int(parts[4]))
@@ -380,6 +402,40 @@ class _Handler(BaseHTTPRequestHandler):
         if len(parts) == 3 and parts[:2] == ["v1", "task"]:
             length = int(self.headers.get("Content-Length", "0"))
             body = json.loads(self.rfile.read(length) or b"{}")
+            if "outputIds" in body or "extraCredentials" in body:
+                # a REFERENCE-protocol TaskUpdateRequest (the document a
+                # Presto coordinator POSTs): translate its PlanFragment
+                # into the engine vocabulary; unsupported constructs are
+                # rejected with the PlanChecker contract (400 + reason)
+                from ..plan import nodes as _N
+                from ..plan.validator import validate_plan
+                from .protocol import (ProtocolUnsupported,
+                                       parse_task_update_request)
+                try:
+                    parsed = parse_task_update_request(body)
+                except (ProtocolUnsupported, KeyError, TypeError) as e:
+                    # malformed documents (missing fields, unresolved
+                    # variables) reject with the same contract as
+                    # out-of-slice constructs
+                    return self._send_json(
+                        {"error": f"plan not executable: "
+                                  f"{type(e).__name__}: {e}",
+                         "retriable": False}, 400)
+                if parsed["plan"] is None:
+                    return self._send_json(
+                        {"error": "TaskUpdateRequest without fragment"}, 400)
+                violations = validate_plan(parsed["plan"])
+                if violations:
+                    return self._send_json(
+                        {"error": f"plan not executable: {violations}",
+                         "retriable": False}, 400)
+                body = {"plan": _N.to_json(parsed["plan"]),
+                        # coordinator session properties flow through
+                        "session": parsed["session"].get(
+                            "systemProperties", {})}
+                sf = parsed["fragmentInfo"].get("scaleFactor")
+                if sf is not None:  # else the worker's configured sf
+                    body["sf"] = sf
             try:
                 info = self.manager.create_or_update(parts[2], body)
             except RuntimeError as e:  # draining
