@@ -48,7 +48,8 @@ class QueryResult:
 
 def _scan_batch(node: N.PlanNode, sf: float, capacity_hint: Optional[int],
                 pad_multiple: int,
-                scan_range: Optional[Tuple[int, int]] = None) -> Batch:
+                scan_range: Optional[Tuple[int, int]] = None,
+                dyn_filters=None, stats=None) -> Batch:
     if isinstance(node, N.ValuesNode):
         arrays = []
         for ci, ty in enumerate(node.types):
@@ -66,6 +67,23 @@ def _scan_batch(node: N.PlanNode, sf: float, capacity_hint: Optional[int],
         start, count = scan_range
     else:
         start, count = 0, conn.table_row_count(node.table, sf)
+    if dyn_filters:
+        # dynamic filtering: prune fact rows host-side BEFORE they are
+        # staged into HBM (DynamicFilterSourceOperator pushdown; the
+        # win here is smaller staged shapes)
+        from .dynfilter import apply_dynamic_filters
+        data = conn.generate_columns(node.table, sf, node.columns,
+                                     start, count)
+        keep, pruned = apply_dynamic_filters(data, node.columns,
+                                             dyn_filters)
+        if stats is not None:
+            stats.add("dynamic_filter_rows_pruned", pruned)
+            stats.add("dynamic_filter_rows_staged", int(keep.sum()))
+        arrays = [data[c][keep] for c in node.columns]
+        tys = node.column_types
+        nrows = len(arrays[0])
+        cap = max(-(-nrows // pad_multiple) * pad_multiple, pad_multiple)
+        return batch_from_numpy(tys, arrays, capacity=cap)
     cap = capacity_hint or max(-(-count // pad_multiple) * pad_multiple,
                                pad_multiple)
     return conn.generate_batch(node.table, sf, node.columns, start=start,
@@ -153,6 +171,24 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     hints = capacity_hints or {}
     scan_ranges = scan_ranges or {}
     remote_sources = remote_sources or {}
+    # dynamic filtering (local tier): dimension build sides run first
+    # and their key domains prune fact scans at staging time
+    dyn_filters = {}
+    if session is None:
+        dyn_on = True
+    else:
+        try:
+            v = session.get("dynamic_filtering")
+        except (KeyError, TypeError):  # plain dicts / older sessions
+            v = None
+        dyn_on = True if v is None else bool(v)
+    if dyn_on and mesh is None:
+        from .dynfilter import collect_dynamic_filters
+        with stats.timed("dynamic_filter_collect_s"):
+            dyn_filters = collect_dynamic_filters(root, sf)
+        if dyn_filters:
+            stats.add("dynamic_filters", sum(len(v)
+                                             for v in dyn_filters.values()))
     reserved = 0
     if memory_pool is not None:
         # admission accounting (MemoryPool.reserve analog): PLANNED scan
@@ -173,8 +209,9 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
                         f"no remote source batch supplied for node {s.id}"
                     batches.append(remote_sources[s.id])
                 else:
-                    batches.append(_scan_batch(s, sf, hints.get(s.id), pad,
-                                               scan_ranges.get(s.id)))
+                    batches.append(_scan_batch(
+                        s, sf, hints.get(s.id), pad, scan_ranges.get(s.id),
+                        dyn_filters=dyn_filters.get(s.id), stats=stats))
     except Exception:
         if memory_pool is not None:
             memory_pool.free(query_id, reserved)

@@ -170,6 +170,24 @@ def evaluate(expr: RowExpression, batch: Batch) -> Block:
             assert isinstance(pat, Constant), "LIKE pattern must be constant"
             v = _like(a, str(pat.value))
             return Column(v, a.nulls, expr.type)
+        if name == "regexp_like":
+            a = evaluate(expr.arguments[0], batch)
+            pat = expr.arguments[1]
+            assert isinstance(pat, Constant), \
+                "regexp_like pattern must be constant"
+            from ..ops.regex import compile_dfa, regexp_like_kernel
+            table, accepting = compile_dfa(str(pat.value))
+            v = regexp_like_kernel(a.chars, a.lengths, table, accepting)
+            return Column(v, a.nulls, expr.type)
+        if name == "date_format":
+            d = evaluate(expr.arguments[0], batch)
+            fmt = expr.arguments[1]
+            assert isinstance(fmt, Constant), \
+                "date_format format must be constant"
+            chars, lengths = F.date_format_kernel(d.values, d.type,
+                                                  str(fmt.value))
+            from ..block import StringColumn
+            return StringColumn(chars, lengths, d.nulls, expr.type)
         if name == "date_add":
             unit = expr.arguments[0]
             assert isinstance(unit, Constant)

@@ -797,6 +797,90 @@ def _is_not_distinct_from(ret, a, b):
 # specialized by the compiler like date_add)
 # ---------------------------------------------------------------------------
 
+_DATE_FMT_WIDTHS = {"Y": 4, "y": 2, "m": 2, "d": 2, "H": 2, "i": 2,
+                    "s": 2, "j": 3, "%": 1}
+
+
+def date_format_width(fmt: str) -> int:
+    """Output width of a date_format pattern; raises NotImplementedError
+    on unsupported specifiers (the validator calls this so unsupported
+    formats reject at plan time, not mid-trace). %e (unpadded day) is
+    deliberately unsupported: it is variable-width mid-string, which a
+    fixed-width char matrix cannot express without per-row shifts."""
+    width = 0
+    i = 0
+    while i < len(fmt):
+        if fmt[i] == "%" and i + 1 < len(fmt):
+            sp = fmt[i + 1]
+            if sp not in _DATE_FMT_WIDTHS:
+                raise NotImplementedError(f"date_format %{sp}")
+            width += _DATE_FMT_WIDTHS[sp]
+            i += 2
+        else:
+            width += 1
+            i += 1
+    return max(width, 1)
+
+
+def date_format_kernel(values, ty, fmt: str):
+    """date_format(x, 'mysql-format') -> (chars, lengths); the
+    DateTimeFunctions.dateFormat analog with the common specifiers
+    (%Y %y %m %d %H %i %s %j), built as fixed-width digit columns
+    (strings are (chars, lengths) matrices here, so formatting is pure
+    integer arithmetic per output column -- no per-row loop)."""
+    if ty.base == "timestamp":
+        days = values // 86_400_000_000
+        secs_of_day = (values // 1_000_000) % 86_400
+    else:
+        days = values
+        secs_of_day = jnp.zeros_like(values)
+    y, m, d = _civil(days)
+    hh = secs_of_day // 3600
+    mi = (secs_of_day // 60) % 60
+    ss = secs_of_day % 60
+    jan1 = _days_from_civil(y, jnp.ones_like(m), jnp.ones_like(m))
+    doy = (days - jan1 + 1).astype(jnp.int64)
+
+    def digits(v, k):
+        return [((v // (10 ** (k - 1 - i))) % 10 + 48).astype(jnp.uint8)
+                for i in range(k)]
+
+    cols = []
+    i = 0
+    n = values.shape[0]
+    while i < len(fmt):
+        c = fmt[i]
+        if c == "%" and i + 1 < len(fmt):
+            sp = fmt[i + 1]
+            i += 2
+            if sp == "Y":
+                cols += digits(y, 4)
+            elif sp == "y":
+                cols += digits(y % 100, 2)
+            elif sp == "m":
+                cols += digits(m, 2)
+            elif sp == "d":
+                cols += digits(d, 2)
+            elif sp == "H":
+                cols += digits(hh, 2)
+            elif sp == "i":
+                cols += digits(mi, 2)
+            elif sp == "s":
+                cols += digits(ss, 2)
+            elif sp == "j":
+                cols += digits(doy, 3)
+            elif sp == "%":
+                cols.append(jnp.full(n, ord("%"), dtype=jnp.uint8))
+            else:
+                raise NotImplementedError(f"date_format %{sp}")
+        else:
+            cols.append(jnp.full(n, ord(c), dtype=jnp.uint8))
+            i += 1
+    chars = jnp.stack(cols, axis=1)
+    lengths = jnp.full(n, chars.shape[1], dtype=jnp.int32)
+    return chars, lengths
+
+
 def date_trunc_kernel(unit: str, days):
     y, m, d = _civil(days)
     one = jnp.ones_like(y)

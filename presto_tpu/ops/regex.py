@@ -1,0 +1,357 @@
+"""Regular expressions on TPU: compile-to-DFA, scan as gathers.
+
+Reference surface: operator/scalar/JoniRegexpFunctions.java (regexp_like
+and friends, evaluated row-at-a-time with the Joni backtracking engine).
+
+TPU-first redesign: a backtracking matcher is the opposite of SIMD. A
+CONSTANT pattern (the analytical-SQL case; LIKE has the same
+restriction here) compiles ONCE on the host into a DFA over bytes --
+Thompson construction to an epsilon-NFA, subset construction to a DFA,
+search semantics via a start-state self-loop -- and matching every row
+is then one lax.scan over the char-matrix columns: per step a single
+(row-vector) gather `state = table[state, char]` plus an accept-flag
+OR. Cost: max_len steps x n rows of gathers, no data-dependent control
+flow, identical work per row -- exactly what the VPU wants.
+
+Supported syntax: literals, '.', escapes (\\d \\D \\w \\W \\s \\S and
+escaped metachars), character classes [a-z0-9_] with negation and
+ranges, grouping (), alternation |, quantifiers * + ? and bounded
+{m,n}, anchors ^ $. Unanchored containment semantics (Presto
+regexp_like). Patterns exceeding the state budget raise (the caller
+surfaces plan-checker rejection).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["compile_dfa", "regexp_like_kernel", "RegexUnsupported"]
+
+_MAX_DFA_STATES = 255
+
+
+class RegexUnsupported(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# pattern -> AST
+# ---------------------------------------------------------------------------
+# AST: ("char", frozenset(bytes)) | ("cat", [a..]) | ("alt", [a..])
+#      | ("star", a) | ("plus", a) | ("opt", a) | ("empty",)
+#      | ("bol",) | ("eol",)
+
+_ALL = frozenset(range(256))
+_DIGIT = frozenset(range(ord("0"), ord("9") + 1))
+_WORD = (_DIGIT | frozenset(range(ord("a"), ord("z") + 1))
+         | frozenset(range(ord("A"), ord("Z") + 1)) | {ord("_")})
+_SPACE = frozenset(b" \t\n\r\f\v")
+_ESCAPES = {
+    ord("d"): _DIGIT, ord("D"): _ALL - _DIGIT,
+    ord("w"): _WORD, ord("W"): _ALL - _WORD,
+    ord("s"): _SPACE, ord("S"): _ALL - _SPACE,
+}
+
+
+class _Parser:
+    def __init__(self, pat: bytes):
+        self.p = pat
+        self.i = 0
+
+    def peek(self) -> Optional[int]:
+        return self.p[self.i] if self.i < len(self.p) else None
+
+    def next(self) -> int:
+        c = self.p[self.i]
+        self.i += 1
+        return c
+
+    def parse(self):
+        ast = self.alt()
+        if self.i != len(self.p):
+            raise RegexUnsupported(f"trailing {self.p[self.i:]!r}")
+        return ast
+
+    def alt(self):
+        parts = [self.cat()]
+        while self.peek() == ord("|"):
+            self.next()
+            parts.append(self.cat())
+        return parts[0] if len(parts) == 1 else ("alt", parts)
+
+    def cat(self):
+        parts = []
+        while self.peek() is not None and self.peek() not in (ord("|"),
+                                                              ord(")")):
+            parts.append(self.repeat())
+        if not parts:
+            return ("empty",)
+        return parts[0] if len(parts) == 1 else ("cat", parts)
+
+    def repeat(self):
+        a = self.atom()
+        while self.peek() in (ord("*"), ord("+"), ord("?"), ord("{")):
+            c = self.next()
+            if c == ord("*"):
+                a = ("star", a)
+            elif c == ord("+"):
+                a = ("plus", a)
+            elif c == ord("?"):
+                a = ("opt", a)
+            else:  # {m}, {m,}, {m,n}
+                spec = b""
+                while self.peek() is not None and self.peek() != ord("}"):
+                    spec += bytes([self.next()])
+                if self.peek() is None:
+                    raise RegexUnsupported("unterminated {")
+                self.next()
+                txt = spec.decode()
+                if "," in txt:
+                    lo_s, hi_s = txt.split(",", 1)
+                    lo = int(lo_s or 0)
+                    hi = int(hi_s) if hi_s else None
+                else:
+                    lo = hi = int(txt)
+                if hi is not None and hi < lo:
+                    raise RegexUnsupported("{m,n} with n < m")
+                if (hi or lo) > 64:
+                    raise RegexUnsupported("{m,n} bound > 64")
+                parts = [a] * lo
+                if hi is None:
+                    parts.append(("star", a))
+                else:
+                    parts.extend([("opt", a)] * (hi - lo))
+                a = ("cat", parts) if parts else ("empty",)
+        return a
+
+    def atom(self):
+        c = self.next()
+        if c == ord("("):
+            # non-capturing prefix (?: accepted; captures not tracked
+            if self.peek() == ord("?"):
+                self.next()
+                if self.peek() == ord(":"):
+                    self.next()
+                else:
+                    raise RegexUnsupported("(?...) extension")
+            a = self.alt()
+            if self.peek() != ord(")"):
+                raise RegexUnsupported("unbalanced (")
+            self.next()
+            return a
+        if c == ord("["):
+            return ("char", self.char_class())
+        if c == ord("."):
+            return ("char", _ALL)
+        if c == ord("^"):
+            return ("bol",)
+        if c == ord("$"):
+            return ("eol",)
+        if c == ord("\\"):
+            e = self.next()
+            if e in _ESCAPES:
+                return ("char", _ESCAPES[e])
+            return ("char", frozenset([e]))
+        if c in b"*+?{":
+            raise RegexUnsupported(f"dangling quantifier {chr(c)!r}")
+        return ("char", frozenset([c]))
+
+    def char_class(self):
+        neg = False
+        if self.peek() == ord("^"):
+            neg = True
+            self.next()
+        chars: Set[int] = set()
+        first = True
+        while True:
+            c = self.peek()
+            if c is None:
+                raise RegexUnsupported("unterminated [")
+            if c == ord("]") and not first:
+                self.next()
+                break
+            first = False
+            c = self.next()
+            if c == ord("\\"):
+                e = self.next()
+                if e in _ESCAPES:
+                    chars |= _ESCAPES[e]
+                    continue
+                c = e
+            if self.peek() == ord("-") and self.i + 1 < len(self.p) \
+                    and self.p[self.i + 1] != ord("]"):
+                self.next()
+                hi = self.next()
+                if hi == ord("\\"):
+                    hi = self.next()
+                chars |= set(range(c, hi + 1))
+            else:
+                chars.add(c)
+        return frozenset(chars) if not neg else _ALL - frozenset(chars)
+
+
+# ---------------------------------------------------------------------------
+# AST -> epsilon-NFA -> DFA
+# ---------------------------------------------------------------------------
+
+# sentinel byte values for anchors (outside 0..255)
+_BOL, _EOL = 256, 257
+
+
+class _NFA:
+    def __init__(self):
+        self.eps: List[Set[int]] = []
+        self.edges: List[List[Tuple[FrozenSet[int], int]]] = []
+
+    def state(self) -> int:
+        self.eps.append(set())
+        self.edges.append([])
+        return len(self.eps) - 1
+
+    def build(self, ast, s: int, t: int):
+        """Wire `ast` between states s -> t."""
+        kind = ast[0]
+        if kind == "empty":
+            self.eps[s].add(t)
+        elif kind == "char":
+            self.edges[s].append((ast[1], t))
+        elif kind in ("bol", "eol"):
+            self.edges[s].append((frozenset([_BOL if kind == "bol"
+                                             else _EOL]), t))
+        elif kind == "cat":
+            cur = s
+            for part in ast[1][:-1]:
+                nxt = self.state()
+                self.build(part, cur, nxt)
+                cur = nxt
+            self.build(ast[1][-1], cur, t)
+        elif kind == "alt":
+            for part in ast[1]:
+                a, b = self.state(), self.state()
+                self.eps[s].add(a)
+                self.eps[b].add(t)
+                self.build(part, a, b)
+        elif kind == "star":
+            a, b = self.state(), self.state()
+            self.eps[s].update((a, t))
+            self.eps[b].update((a, t))
+            self.build(ast[1], a, b)
+        elif kind == "plus":
+            a, b = self.state(), self.state()
+            self.eps[s].add(a)
+            self.eps[b].update((a, t))
+            self.build(ast[1], a, b)
+        elif kind == "opt":
+            self.eps[s].add(t)
+            self.build(ast[1], s, t)
+        else:  # pragma: no cover
+            raise RegexUnsupported(kind)
+
+
+def _eclose(nfa: _NFA, states: FrozenSet[int]) -> FrozenSet[int]:
+    out = set(states)
+    work = list(states)
+    while work:
+        s = work.pop()
+        for t in nfa.eps[s]:
+            if t not in out:
+                out.add(t)
+                work.append(t)
+    return frozenset(out)
+
+
+from functools import lru_cache
+
+
+@lru_cache(maxsize=256)
+def compile_dfa(pattern: str):
+    """Pattern -> (table (S, 258) uint8, accepting (S,) bool). Symbol
+    258/257 columns are the virtual BOL/EOL anchors consumed before the
+    first and after the last char of each row. Search semantics: the
+    DFA is for `.*(pattern)` with a sticky accept state. Cached: the
+    validator pre-compiles the same pattern the evaluator uses."""
+    try:
+        ast = _Parser(pattern.encode("utf-8")).parse()
+    except (IndexError, ValueError) as e:
+        if isinstance(e, RegexUnsupported):
+            raise
+        raise RegexUnsupported(
+            f"malformed pattern {pattern!r}: {type(e).__name__}") from e
+    nfa = _NFA()
+    start, accept = nfa.state(), nfa.state()
+    # search: allow skipping any prefix BEFORE consuming BOL is wrong --
+    # instead: optional ^: if the pattern starts with BOL, no skip; the
+    # generic transform is (.*)pattern, with .* built as a start
+    # self-loop added AFTER the BOL anchor step below.
+    nfa.build(ast, start, accept)
+
+    d0 = _eclose(nfa, frozenset([start]))
+    states: Dict[FrozenSet[int], int] = {d0: 0}
+    order: List[FrozenSet[int]] = [d0]
+    table_rows: List[List[int]] = []
+    accepting: List[bool] = []
+    ACCEPT_SINK = None
+
+    i = 0
+    while i < len(order):
+        cur = order[i]
+        i += 1
+        row = [0] * 258
+        acc = accept in cur
+        for sym in range(258):
+            targets: Set[int] = set()
+            for s in cur:
+                for chars, t in nfa.edges[s]:
+                    if sym in chars:
+                        targets.add(t)
+            if sym < 256:
+                # search semantics: a new match may start at any
+                # position -> the start set is always live
+                targets |= set(d0)
+            else:
+                # anchors: states that don't consume the anchor persist
+                targets |= set(cur)
+            nxt = _eclose(nfa, frozenset(targets))
+            if nxt not in states:
+                if len(states) > _MAX_DFA_STATES:
+                    raise RegexUnsupported(
+                        f"pattern needs > {_MAX_DFA_STATES} DFA states")
+                states[nxt] = len(order)
+                order.append(nxt)
+            row[sym] = states[nxt]
+        table_rows.append(row)
+        accepting.append(acc)
+
+    table = np.asarray(table_rows, dtype=np.uint8)
+    return table, np.asarray(accepting, dtype=bool)
+
+
+def regexp_like_kernel(chars: jnp.ndarray, lengths: jnp.ndarray,
+                       table: np.ndarray, accepting: np.ndarray
+                       ) -> jnp.ndarray:
+    """Row-vectorized DFA search over a (n, w) char matrix."""
+    n, w = chars.shape
+    tbl = jnp.asarray(table)
+    acc = jnp.asarray(accepting)
+
+    state = tbl[jnp.zeros(n, dtype=jnp.int32), 256]  # consume BOL
+    matched = acc[state]
+
+    def step(carry, col):
+        state, matched = carry
+        ch, j = col
+        nxt = tbl[state, ch]
+        live = j < lengths
+        state = jnp.where(live, nxt, state)
+        matched = matched | (live & acc[state])
+        return (state, matched), None
+
+    cols = (chars.T.astype(jnp.int32), jnp.arange(w))
+    (state, matched), _ = jax.lax.scan(step, (state, matched), cols)
+    state = tbl[state, 257]  # consume EOL
+    matched = matched | acc[state]
+    return matched

@@ -20,7 +20,7 @@ from . import nodes as N
 __all__ = ["validate_plan"]
 
 _SPECIAL_INTERCEPTED = {"like", "date_add", "date_trunc", "date_diff",
-                        "split_part", "cast"}
+                        "split_part", "cast", "regexp_like", "date_format"}
 _DATE_UNITS = {"date_add": {"day", "week", "month", "year"},
                "date_trunc": {"day", "week", "month", "quarter", "year"},
                "date_diff": {"day", "week", "month", "quarter", "year"}}
@@ -33,6 +33,24 @@ def _check_expr(e: E.RowExpression, out: List[str]):
             out.append(f"unregistered scalar function {name!r}")
         if name == "like" and not isinstance(e.arguments[1], E.Constant):
             out.append("LIKE with non-constant pattern")
+        if name == "regexp_like":
+            if not isinstance(e.arguments[1], E.Constant):
+                out.append("regexp_like with non-constant pattern")
+            else:
+                from ..ops.regex import RegexUnsupported, compile_dfa
+                try:
+                    compile_dfa(str(e.arguments[1].value))
+                except RegexUnsupported as ex:
+                    out.append(f"regexp_like pattern: {ex}")
+        if name == "date_format":
+            if not isinstance(e.arguments[1], E.Constant):
+                out.append("date_format with non-constant format")
+            else:
+                from ..expr.functions import date_format_width
+                try:
+                    date_format_width(str(e.arguments[1].value))
+                except NotImplementedError as ex:
+                    out.append(str(ex))
         if name in _DATE_UNITS:
             unit = e.arguments[0]
             if not isinstance(unit, E.Constant):

@@ -227,6 +227,14 @@ class _Analyzer:
         if name in ("upper", "lower", "trim", "ltrim", "rtrim", "reverse",
                     "substr", "split_part"):
             return args[0].type
+        if name == "regexp_like":
+            return T.BOOLEAN
+        if name == "date_format":
+            width = 32
+            if isinstance(args[1], E.Constant):
+                from ..expr.functions import date_format_width
+                width = date_format_width(str(args[1].value))
+            return T.varchar(width)
         if name == "concat":
             width = sum(a.type.max_length if a.type.is_string else 8
                         for a in args)

@@ -143,6 +143,9 @@ SESSION_PROPERTIES = (
          "let connector NDV statistics SHRINK group-table capacities "
          "(plan.stats.refine_capacities); disable when a hand-set "
          "max_groups must stay authoritative")
+    .add("dynamic_filtering", "bool", True,
+         "run small dimension build sides first and prune fact scans "
+         "by their join-key domains at staging time (exec/dynfilter.py)")
     .add("hbm_budget_bytes", "int", 0,
          "cap on per-query device state; aggregations whose planned "
          "group table exceeds it run grouped-execution spill to host "

@@ -1,0 +1,90 @@
+"""regexp_like (DFA-scan kernel) and date_format vs Python oracles.
+
+Reference behavior: operator/scalar/JoniRegexpFunctions.java (Java
+regex semantics; containment search) and DateTimeFunctions.dateFormat
+(MySQL specifiers)."""
+
+import re
+import datetime
+
+import numpy as np
+import pytest
+
+from presto_tpu.ops.regex import RegexUnsupported, compile_dfa
+
+
+def _match_all(pattern, strings):
+    import jax.numpy as jnp
+    from presto_tpu.ops.regex import regexp_like_kernel
+    table, acc = compile_dfa(pattern)
+    w = max((len(s) for s in strings), default=1) or 1
+    chars = np.zeros((len(strings), w), dtype=np.uint8)
+    lengths = np.zeros(len(strings), dtype=np.int32)
+    for i, s in enumerate(strings):
+        b = s.encode()
+        chars[i, :len(b)] = list(b)
+        lengths[i] = len(b)
+    got = regexp_like_kernel(jnp.asarray(chars), jnp.asarray(lengths),
+                             table, acc)
+    return [bool(x) for x in np.asarray(got)]
+
+
+CORPUS = ["", "a", "ab", "abc", "xabcy", "aaab", "b", "ba", "hello world",
+          "42", "x42y", "a1b2", "AbC", "abab", "aab", "  ", "a-b", "zzz",
+          "special requests", "nospecial", "1994-01-01", "foo_bar"]
+
+
+@pytest.mark.parametrize("pattern", [
+    "abc", "^abc", "abc$", "^abc$", "a.c", "a*", "a+b", "ab?c",
+    "[abc]+", "[^abc]+", "[a-z]+[0-9]", "\\d+", "\\w+", "\\s",
+    "a|b", "(ab)+", "(?:ab|ba)c?", "a{2,3}b", "a{2}b", "x\\d{2}y",
+    "^$", "^\\d{4}-\\d{2}-\\d{2}$", "special.*requests",
+])
+def test_dfa_matches_python_re(pattern):
+    want = [re.search(pattern, s) is not None for s in CORPUS]
+    assert _match_all(pattern, CORPUS) == want, pattern
+
+
+def test_unsupported_patterns_raise():
+    for p in ("a(?=b)", "a{100}", "(a", "abc\\\\"[:4], "a{x}", "[abc"):
+        with pytest.raises(RegexUnsupported):
+            compile_dfa(p)
+
+
+def test_sql_regexp_like_and_date_format():
+    from presto_tpu.sql import sql
+    r = sql("SELECT count(*) FROM orders "
+            "WHERE regexp_like(clerk, 'Clerk#0+1\\d')", sf=0.01)
+    from presto_tpu.connectors import tpch
+    clerks = tpch.generate_columns("orders", 0.01, ["clerk"])["clerk"]
+    want = sum(1 for c in clerks if re.search(r"Clerk#0+1\d", c))
+    assert r.rows()[0][0] == want
+
+    r2 = sql("SELECT orderkey, date_format(orderdate, '%Y-%m-%d') d "
+             "FROM orders ORDER BY orderkey LIMIT 5", sf=0.01)
+    od = tpch.generate_columns("orders", 0.01, ["orderkey", "orderdate"])
+    by_key = dict(zip(od["orderkey"].tolist(), od["orderdate"].tolist()))
+    for k, s in r2.rows():
+        want_s = (datetime.date(1970, 1, 1)
+                  + datetime.timedelta(days=int(by_key[k]))).isoformat()
+        assert s == want_s
+
+
+def test_date_format_specifiers():
+    import jax.numpy as jnp
+    from presto_tpu import types as T
+    from presto_tpu.expr.functions import date_format_kernel
+    days = jnp.asarray(np.array([0, 10957, 19723]))  # 1970-01-01, 2000-01-01, 2024-01-01
+    chars, lengths = date_format_kernel(days, T.DATE, "%d/%m/%y (%j)")
+    got = ["".join(chr(c) for c in np.asarray(chars)[i][:lengths[i]])
+           for i in range(3)]
+    assert got == ["01/01/70 (001)", "01/01/00 (001)", "01/01/24 (001)"]
+
+
+def test_validator_rejects_bad_patterns():
+    from presto_tpu.plan.validator import validate_plan
+    from presto_tpu.sql import plan_sql
+    p = plan_sql("SELECT count(*) FROM orders "
+                 "WHERE regexp_like(clerk, '(unclosed')")
+    out = validate_plan(p)
+    assert any("regexp_like" in v for v in out)
