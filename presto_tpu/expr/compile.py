@@ -186,7 +186,6 @@ def evaluate(expr: RowExpression, batch: Batch) -> Block:
                 "date_format format must be constant"
             chars, lengths = F.date_format_kernel(d.values, d.type,
                                                   str(fmt.value))
-            from ..block import StringColumn
             return StringColumn(chars, lengths, d.nulls, expr.type)
         if name == "date_add":
             unit = expr.arguments[0]

@@ -1535,6 +1535,84 @@ GROUP BY ROLLUP(i_category, i_class)
 ORDER BY lochierarchy DESC, rank_within_parent ASC, i_category ASC,
          i_class ASC
 """,
+    # q47/q57: month-over-month deviation around a yearly average (TWO
+    # distinct OVER clauses in one CTE, referenced three times and
+    # planned once; lag/lead realized as rn-arithmetic self-joins)
+    "q47": """
+WITH v1 AS (
+  SELECT i_category, i_brand, s_store_name, s_company_name, d_year,
+         d_moy, sum(ss_sales_price) sum_sales,
+         avg(sum(ss_sales_price)) OVER (PARTITION BY i_category,
+           i_brand, s_store_name, s_company_name, d_year)
+           avg_monthly_sales,
+         rank() OVER (PARTITION BY i_category, i_brand, s_store_name,
+           s_company_name ORDER BY d_year ASC, d_moy ASC) rn
+  FROM item, store_sales, date_dim, store
+  WHERE ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+    AND ss_store_sk = s_store_sk
+    AND (d_year = 1999 OR (d_year = 1998 AND d_moy = 12)
+         OR (d_year = 2000 AND d_moy = 1))
+  GROUP BY i_category, i_brand, s_store_name, s_company_name,
+           d_year, d_moy
+),
+v2 AS (
+  SELECT v1.i_category, v1.i_brand, v1.s_store_name,
+         v1.s_company_name, v1.d_year, v1.d_moy, v1.avg_monthly_sales,
+         v1.sum_sales, v1_lag.sum_sales psum, v1_lead.sum_sales nsum
+  FROM v1, v1 v1_lag, v1 v1_lead
+  WHERE v1.i_category = v1_lag.i_category
+    AND v1.i_category = v1_lead.i_category
+    AND v1.i_brand = v1_lag.i_brand
+    AND v1.i_brand = v1_lead.i_brand
+    AND v1.s_store_name = v1_lag.s_store_name
+    AND v1.s_store_name = v1_lead.s_store_name
+    AND v1.s_company_name = v1_lag.s_company_name
+    AND v1.s_company_name = v1_lead.s_company_name
+    AND v1.rn = v1_lag.rn + 1 AND v1.rn = v1_lead.rn - 1
+)
+SELECT * FROM v2
+WHERE d_year = 1999 AND avg_monthly_sales > 0.000
+  AND CASE WHEN avg_monthly_sales > 0.000
+           THEN abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+           ELSE NULL END > 0.100
+ORDER BY sum_sales - avg_monthly_sales ASC, 3 ASC, 1 ASC, 2 ASC,
+         4 ASC, 5 ASC, 6 ASC
+""",
+    "q57": """
+WITH v1 AS (
+  SELECT i_category, i_brand, cc_name, d_year, d_moy,
+         sum(cs_sales_price) sum_sales,
+         avg(sum(cs_sales_price)) OVER (PARTITION BY i_category,
+           i_brand, cc_name, d_year) avg_monthly_sales,
+         rank() OVER (PARTITION BY i_category, i_brand, cc_name
+           ORDER BY d_year ASC, d_moy ASC) rn
+  FROM item, catalog_sales, date_dim, call_center
+  WHERE cs_item_sk = i_item_sk AND cs_sold_date_sk = d_date_sk
+    AND cc_call_center_sk = cs_call_center_sk
+    AND (d_year = 1999 OR (d_year = 1998 AND d_moy = 12)
+         OR (d_year = 2000 AND d_moy = 1))
+  GROUP BY i_category, i_brand, cc_name, d_year, d_moy
+),
+v2 AS (
+  SELECT v1.i_category, v1.i_brand, v1.cc_name, v1.d_year, v1.d_moy,
+         v1.avg_monthly_sales, v1.sum_sales, v1_lag.sum_sales psum,
+         v1_lead.sum_sales nsum
+  FROM v1, v1 v1_lag, v1 v1_lead
+  WHERE v1.i_category = v1_lag.i_category
+    AND v1.i_category = v1_lead.i_category
+    AND v1.i_brand = v1_lag.i_brand
+    AND v1.i_brand = v1_lead.i_brand
+    AND v1.cc_name = v1_lag.cc_name AND v1.cc_name = v1_lead.cc_name
+    AND v1.rn = v1_lag.rn + 1 AND v1.rn = v1_lead.rn - 1
+)
+SELECT * FROM v2
+WHERE d_year = 1999 AND avg_monthly_sales > 0.000
+  AND CASE WHEN avg_monthly_sales > 0.000
+           THEN abs(sum_sales - avg_monthly_sales) / avg_monthly_sales
+           ELSE NULL END > 0.100
+ORDER BY sum_sales - avg_monthly_sales ASC, 3 ASC, 1 ASC, 2 ASC,
+         4 ASC, 5 ASC
+""",
 }
 
 
@@ -1686,7 +1764,20 @@ _Q36_ORACLE = ("SELECT gross_margin, i_category, i_class, lochierarchy, "
                "ORDER BY gross_margin ASC) rank_within_parent "
                "FROM (" + _Q36_BASE + ") base")
 
+
+def _q47_oracle(name: str) -> str:
+    import re as _re
+    out = _re.sub(
+        r"avg\(sum\((ss|cs)_sales_price\)\) OVER \(PARTITION BY[^)]*\)",
+        lambda m: f"round({m.group(0)})", TPCDS_QUERIES[name])
+    return out.replace(
+        "THEN abs(sum_sales - avg_monthly_sales) / avg_monthly_sales",
+        "THEN abs(sum_sales - avg_monthly_sales) / CAST(avg_monthly_sales AS REAL)")
+
+
 TPCDS_ORACLE = {
+    "q47": _q47_oracle("q47"),
+    "q57": _q47_oracle("q57"),
     "q36": _Q36_ORACLE,
     "q86": _Q86_ORACLE,
     "q53": _cents_avg_window_oracle("q53"),

@@ -962,7 +962,7 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
     if win_list and not (all_aggs or q.group_by):
         # windows over detail rows: plan the stage here; the select
         # items then lower normally with WindowExpr channel intercepts
-        node, win_map = _plan_window_stage(
+        node, win_map = _plan_window_stages(
             node, win_list, lambda ast: an.lower(ast, scope), scope.types)
         an.window_channels.update(win_map)
 
@@ -1070,21 +1070,37 @@ def _collect_windows(e, out: list):
                 _collect_windows(x, out)
 
 
+def _plan_window_stages(node, win_list, lower_expr, base_types):
+    """Plan every WindowExpr in `win_list`, chaining one WindowNode
+    stage per DISTINCT OVER clause (each stage's identity prefix keeps
+    the original channel space valid, so later stages and the final
+    projection lower against unchanged channel numbers)."""
+    groups: List[list] = []
+    for w in win_list:
+        for g in groups:
+            if g[0].partition_by == w.partition_by \
+                    and g[0].order_by == w.order_by:
+                g.append(w)
+                break
+        else:
+            groups.append([w])
+    win_map: Dict[int, Tuple[int, T.Type]] = {}
+    for g in groups:
+        node, m = _plan_window_stage(node, g, lower_expr,
+                                     node.output_types())
+        win_map.update(m)
+    return node, win_map
+
+
 def _plan_window_stage(node, win_list, lower_expr, base_types):
-    """Append a WindowNode computing every WindowExpr in `win_list`
-    (one shared OVER clause round 3; distinct clauses chain later).
-    The pre-projection starts with IDENTITY refs of the node's whole
-    channel space, so downstream lowering keeps using the same channel
-    numbers; window outputs append after. `lower_expr(ast)` lowers a
-    scalar AST in that space (an.lower over the base scope, or the
-    aggregation output rewriter). Returns (node, {id(WindowExpr):
-    (channel, type)})."""
+    """Append ONE WindowNode computing the WindowExprs in `win_list`
+    (all sharing one OVER clause). The pre-projection starts with
+    IDENTITY refs of the node's whole channel space, so downstream
+    lowering keeps using the same channel numbers; window outputs
+    append after. `lower_expr(ast)` lowers a scalar AST in that space
+    (an.lower over the base scope, or the aggregation output rewriter).
+    Returns (node, {id(WindowExpr): (channel, type)})."""
     w0 = win_list[0]
-    for w in win_list[1:]:
-        if not (w.partition_by == w0.partition_by
-                and w.order_by == w0.order_by):
-            raise NotImplementedError(
-                "multiple distinct OVER clauses: planned later")
     pre_exprs: List[E.RowExpression] = [
         E.input_ref(i, t) for i, t in enumerate(base_types)]
 
@@ -1741,7 +1757,7 @@ def _plan_agg_outputs(an, q, pre_scope, agg_map, key_map,
         if having_e is not None:
             node = N.FilterNode(node, having_e)
             having_e = None
-        node, win_map = _plan_window_stage(
+        node, win_map = _plan_window_stages(
             node, win_list, lambda ast: rewrite(ast, key_types),
             node.output_types())
         window_channels.update(win_map)

@@ -68,9 +68,11 @@ SLOW_CASES = [
     ("q34", 0.1, {}),
     ("q36", 0.02, {}),
     ("q46", 0.02, {"keep_limit": True}),
+    ("q47", 0.05, {"max_groups": 1 << 15, "min_rows": 0}),
     ("q50", 0.05, {"min_rows": 0}),
     ("q53", 0.05, {"min_rows": 0}),
     ("q56", 0.05, {"min_rows": 0}),
+    ("q57", 0.05, {"max_groups": 1 << 15, "min_rows": 0}),
     ("q61", 0.05, {"min_rows": 0}),
     ("q63", 0.05, {"min_rows": 0}),
     ("q65", 0.02, {"max_groups": 1 << 17, "keep_limit": True}),
