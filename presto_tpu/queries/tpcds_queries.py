@@ -1700,7 +1700,7 @@ def _cents_avg_window_oracle(name: str) -> str:
     row inclusion matches exactly."""
     import re as _re
     return _re.sub(
-        r"avg\(sum\(ss_sales_price\)\) OVER \(PARTITION BY[^)]*\)",
+        r"avg\(sum\((ss|cs)_sales_price\)\) OVER \(PARTITION BY[^)]*\)",
         lambda m: f"round({m.group(0)})", TPCDS_QUERIES[name])
 
 
@@ -1766,13 +1766,10 @@ _Q36_ORACLE = ("SELECT gross_margin, i_category, i_class, lochierarchy, "
 
 
 def _q47_oracle(name: str) -> str:
-    import re as _re
-    out = _re.sub(
-        r"avg\(sum\((ss|cs)_sales_price\)\) OVER \(PARTITION BY[^)]*\)",
-        lambda m: f"round({m.group(0)})", TPCDS_QUERIES[name])
-    return out.replace(
+    return _cents_avg_window_oracle(name).replace(
         "THEN abs(sum_sales - avg_monthly_sales) / avg_monthly_sales",
-        "THEN abs(sum_sales - avg_monthly_sales) / CAST(avg_monthly_sales AS REAL)")
+        "THEN abs(sum_sales - avg_monthly_sales) / "
+        "CAST(avg_monthly_sales AS REAL)")
 
 
 TPCDS_ORACLE = {

@@ -963,7 +963,7 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
         # windows over detail rows: plan the stage here; the select
         # items then lower normally with WindowExpr channel intercepts
         node, win_map = _plan_window_stages(
-            node, win_list, lambda ast: an.lower(ast, scope), scope.types)
+            node, win_list, lambda ast: an.lower(ast, scope))
         an.window_channels.update(win_map)
 
     if all_aggs or q.group_by:
@@ -1070,7 +1070,7 @@ def _collect_windows(e, out: list):
                 _collect_windows(x, out)
 
 
-def _plan_window_stages(node, win_list, lower_expr, base_types):
+def _plan_window_stages(node, win_list, lower_expr):
     """Plan every WindowExpr in `win_list`, chaining one WindowNode
     stage per DISTINCT OVER clause (each stage's identity prefix keeps
     the original channel space valid, so later stages and the final
@@ -1758,8 +1758,7 @@ def _plan_agg_outputs(an, q, pre_scope, agg_map, key_map,
             node = N.FilterNode(node, having_e)
             having_e = None
         node, win_map = _plan_window_stages(
-            node, win_list, lambda ast: rewrite(ast, key_types),
-            node.output_types())
+            node, win_list, lambda ast: rewrite(ast, key_types))
         window_channels.update(win_map)
 
     out_exprs, names = [], []
