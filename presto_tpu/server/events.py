@@ -1,0 +1,74 @@
+"""Event listeners: query lifecycle events fanned out to plugins.
+
+Reference surface: presto-spi/.../spi/eventlistener/ (QueryCreatedEvent,
+QueryCompletedEvent, SplitCompletedEvent) dispatched by
+EventListenerManager to every registered plugin listener (the
+openlineage emitter is one consumer).
+
+Here events are plain dicts (the JSON the reference serializes anyway)
+and listeners are callables registered on the process-global manager;
+the engine fires QueryCreated/QueryCompleted around run_query and
+TaskCompleted on the worker. Listener errors are swallowed (the
+reference logs-and-continues: observers must not fail queries).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List
+
+__all__ = ["EventListenerManager", "event_listeners"]
+
+
+class EventListenerManager:
+    def __init__(self):
+        self._listeners: List[Callable[[str, Dict], None]] = []
+        self._lock = threading.Lock()
+
+    def register(self, listener: Callable[[str, Dict], None]):
+        """listener(event_name, payload). Returns an unregister handle."""
+        with self._lock:
+            self._listeners.append(listener)
+
+        def unregister():
+            with self._lock:
+                try:
+                    self._listeners.remove(listener)
+                except ValueError:
+                    pass
+        return unregister
+
+    def fire(self, name: str, payload: Dict):
+        payload = dict(payload)
+        payload.setdefault("timestampMs", int(time.time() * 1000))
+        with self._lock:
+            listeners = list(self._listeners)
+        for cb in listeners:
+            try:
+                cb(name, payload)
+            except Exception:  # noqa: BLE001 - observers never fail queries
+                pass
+
+    def query_created(self, query_id: str, text: str = "", user: str = ""):
+        self.fire("QueryCreated", {"queryId": query_id, "query": text,
+                                   "user": user})
+
+    def query_completed(self, query_id: str, state: str, rows: int = 0,
+                        wall_s: float = 0.0, error: str = ""):
+        self.fire("QueryCompleted", {"queryId": query_id, "state": state,
+                                     "outputRows": rows,
+                                     "wallTimeSeconds": wall_s,
+                                     "error": error})
+
+    def task_completed(self, task_id: str, state: str, rows: int = 0):
+        self.fire("TaskCompleted", {"taskId": task_id, "state": state,
+                                    "outputRows": rows})
+
+
+_MANAGER = EventListenerManager()
+
+
+def event_listeners() -> EventListenerManager:
+    """The process-global manager (EventListenerManager analog)."""
+    return _MANAGER

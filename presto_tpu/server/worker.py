@@ -121,6 +121,17 @@ class TaskManager:
         self.task_ttl_s = task_ttl_s
         self._exec_lock = threading.Lock()
         self._tasks_lock = threading.Lock()
+        # lifetime counters for /v1/info/metrics (Prometheus)
+        self.counters: Dict[str, int] = {"tasks_created": 0,
+                                         "tasks_finished": 0,
+                                         "tasks_failed": 0,
+                                         "tasks_aborted": 0,
+                                         "rows_produced": 0}
+        self._counters_lock = threading.Lock()
+
+    def _count(self, name: str, delta: int = 1):
+        with self._counters_lock:
+            self.counters[name] += delta
 
     def _prune_locked(self):
         """Drop terminal tasks (and their buffered pages) older than the
@@ -145,6 +156,7 @@ class TaskManager:
                         "worker is SHUTTING_DOWN: not accepting tasks")
                 task = _Task(task_id)
                 self.tasks[task_id] = task
+                self._count("tasks_created")
                 threading.Thread(target=self._run, args=(task, body),
                                  daemon=True).start()
         return task.info()
@@ -156,6 +168,22 @@ class TaskManager:
                        if t.state in ("PLANNED", "RUNNING"))
 
     def _run(self, task: _Task, body: dict):
+        try:
+            self._run_inner(task, body)
+        finally:
+            # every exit path accounts the task exactly once; the
+            # mid-execution ABORT early-returns land here uncounted
+            if not getattr(task, "_accounted", False):
+                task._accounted = True
+                with task.lock:
+                    state = task.state
+                if state == "ABORTED":
+                    self._count("tasks_aborted")
+                    from .events import event_listeners
+                    event_listeners().task_completed(task.task_id,
+                                                     "ABORTED")
+
+    def _run_inner(self, task: _Task, body: dict):
         try:
             with task.lock:
                 task.state = "RUNNING"
@@ -240,12 +268,26 @@ class TaskManager:
                               "outputBytes": total_bytes}
                 task.state = "FINISHED"
                 task.finished_at = time.time()
+            task._accounted = True
+            self._count("tasks_finished")
+            self._count("rows_produced", res.row_count)
+            from .events import event_listeners
+            event_listeners().task_completed(task.task_id, "FINISHED",
+                                             res.row_count)
         except Exception as e:  # noqa: BLE001 - task failure is data
             with task.lock:
-                if task.state != "ABORTED":
+                aborted = task.state == "ABORTED"
+                if not aborted:
                     task.state = "FAILED"
                     task.error = f"{type(e).__name__}: {e}"
                 task.finished_at = time.time()
+            # a failure AFTER coordinator abort is a routine cancellation,
+            # not a task failure -- count/report what the status says
+            task._accounted = True
+            self._count("tasks_aborted" if aborted else "tasks_failed")
+            from .events import event_listeners
+            event_listeners().task_completed(
+                task.task_id, "ABORTED" if aborted else "FAILED")
 
     def get(self, task_id: str) -> Optional[_Task]:
         with self._tasks_lock:
@@ -337,6 +379,44 @@ class _Handler(BaseHTTPRequestHandler):
                 "environment": "tpu", "coordinator": False,
                 "uptime": round(time.time() - self.started_at, 1),
                 "state": "ACTIVE"})
+        if parts == ["v1", "info", "metrics"]:
+            # Prometheus text format (PrometheusStatsReporter.cpp /
+            # PrestoServer.cpp:562 registerHttpEndpoints analog)
+            m = self.manager
+            lines = []
+
+            def emit(name, value, help_, mtype):
+                lines.append(f"# HELP {name} {help_}")
+                lines.append(f"# TYPE {name} {mtype}")
+                lines.append(f"{name} {value}")
+
+            def gauge(name, value, help_):
+                emit(name, value, help_, "gauge")
+
+            def counter(name, value, help_):
+                emit(name, value, help_, "counter")
+
+            gauge("presto_tpu_active_tasks", m.active_task_count(),
+                  "tasks in PLANNED/RUNNING state")
+            gauge("presto_tpu_memory_reserved_bytes",
+                  m.memory_pool.reserved_bytes, "admission pool reserved")
+            gauge("presto_tpu_memory_capacity_bytes",
+                  m.memory_pool.capacity, "admission pool capacity")
+            gauge("presto_tpu_memory_revoked_bytes",
+                  m.memory_pool.revoked_bytes,
+                  "bytes freed by spill revocation")
+            gauge("presto_tpu_uptime_seconds",
+                  round(time.time() - self.started_at, 1), "worker uptime")
+            for k, v in m.counters.items():
+                counter(f"presto_tpu_{k}_total", v, f"lifetime {k}")
+            body = ("\n".join(lines) + "\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if parts == ["v1", "status"]:
             return self._send_json({
                 "nodeId": self.node_id,

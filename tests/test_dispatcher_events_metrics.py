@@ -1,0 +1,126 @@
+"""Dispatcher admission (resource groups), event listeners, and the
+worker's Prometheus metrics endpoint.
+
+Reference behavior: dispatcher/DispatchManager.java:234 + resource
+groups (hard concurrency / queue caps), spi/eventlistener (QueryCreated
+/ QueryCompleted / task events), PrometheusStatsReporter's
+/v1/info/metrics."""
+
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from presto_tpu.server.dispatcher import (Dispatcher, QueryRejected,
+                                          ResourceGroup)
+from presto_tpu.server.events import event_listeners
+
+
+def test_dispatcher_concurrency_and_queue():
+    g = ResourceGroup("etl", hard_concurrency_limit=2, max_queued=1)
+    d = Dispatcher([g], selector=lambda s: "etl")
+    running = []
+    release = threading.Event()
+
+    def slow(query_id):
+        running.append(query_id)
+        release.wait(10)
+        return None
+
+    threads = [threading.Thread(target=lambda: d.submit(slow), daemon=True)
+               for _ in range(2)]
+    for t in threads:
+        t.start()
+    for _ in range(100):
+        if len(running) == 2:
+            break
+        time.sleep(0.02)
+    assert len(running) == 2
+    assert g.stats()["running"] == 2
+
+    # 3rd query queues; 4th overflows the 1-slot queue
+    q3 = threading.Thread(target=lambda: d.submit(slow), daemon=True)
+    q3.start()
+    for _ in range(100):
+        if g.stats()["queued"] == 1:
+            break
+        time.sleep(0.02)
+    assert g.stats()["queued"] == 1
+    with pytest.raises(QueryRejected, match="queue is full"):
+        d.submit(slow)
+    # queued-too-long rejection
+    release.set()
+    for t in threads:
+        t.join(10)
+    q3.join(10)
+    assert g.stats()["running"] == 0
+
+
+def test_dispatcher_fires_lifecycle_events():
+    seen = []
+    unregister = event_listeners().register(
+        lambda name, payload: seen.append((name, payload)))
+    try:
+        d = Dispatcher()
+
+        class R:
+            row_count = 7
+        d.submit(lambda qid: R(), query_text="SELECT 7")
+        with pytest.raises(RuntimeError):
+            d.submit(lambda qid: (_ for _ in ()).throw(RuntimeError("x")))
+    finally:
+        unregister()
+    names = [n for n, _ in seen]
+    assert names.count("QueryCreated") == 2
+    completed = [p for n, p in seen if n == "QueryCompleted"]
+    assert {c["state"] for c in completed} == {"FINISHED", "FAILED"}
+    ok = next(c for c in completed if c["state"] == "FINISHED")
+    assert ok["outputRows"] == 7
+
+
+def test_listener_errors_do_not_fail_queries():
+    unregister = event_listeners().register(
+        lambda name, payload: 1 / 0)
+    try:
+        d = Dispatcher()
+        assert d.submit(lambda qid: "ok") == "ok"
+    finally:
+        unregister()
+
+
+def test_worker_prometheus_metrics_and_task_events():
+    from presto_tpu.server import TpuWorkerServer
+    from presto_tpu.server.client import WorkerClient
+    from presto_tpu.plan import nodes as N
+    from presto_tpu.connectors import tpch as tpch_conn
+
+    events = []
+    unregister = event_listeners().register(
+        lambda name, p: events.append((name, p)))
+    w = TpuWorkerServer(sf=0.01).start()
+    try:
+        url = f"http://127.0.0.1:{w.port}"
+        scan = N.TableScanNode("tpch", "region", ["regionkey", "name"],
+                               [tpch_conn.column_type("region", c)
+                                for c in ("regionkey", "name")])
+        plan = N.OutputNode(scan, ["k", "n"])
+        c = WorkerClient(url, 60.0)
+        c.submit_body("m.t0", {"plan": N.to_json(plan), "sf": 0.01})
+        info = c.wait("m.t0", 60.0)
+        assert info["state"] == "FINISHED"
+
+        with urllib.request.urlopen(f"{url}/v1/info/metrics") as r:
+            assert "text/plain" in r.headers["Content-Type"]
+            text = r.read().decode()
+        assert "presto_tpu_tasks_created_total 1" in text
+        assert "presto_tpu_tasks_finished_total 1" in text
+        assert "presto_tpu_rows_produced_total 5" in text
+        assert "presto_tpu_memory_capacity_bytes" in text
+        assert "# TYPE presto_tpu_active_tasks gauge" in text
+    finally:
+        unregister()
+        w.stop()
+    task_events = [p for n, p in events if n == "TaskCompleted"]
+    assert any(p["taskId"] == "m.t0" and p["state"] == "FINISHED"
+               and p["outputRows"] == 5 for p in task_events)
