@@ -224,13 +224,14 @@ def compile_plan(root: N.PlanNode, mesh=None,
             from ..ops.window import WindowSpec, window
             src = lower(node.source, inputs)
             # the 5th tuple slot is the function's int parameter:
-            # ntile's bucket count, lag/lead's offset
+            # ntile's bucket count, lag/lead's offset, nth_value's n
             specs = [WindowSpec(name, ch,
                                 T.parse_type(ty) if isinstance(ty, str) else ty,
                                 frame,
                                 ntile_buckets=(k or 0) if name == "ntile" else 0,
                                 offset=((1 if k is None else k)
-                                        if name in ("lag", "lead") else 1))
+                                        if name in ("lag", "lead",
+                                                    "nth_value") else 1))
                      for name, ch, ty, frame, k in node.functions]
             return window(src, node.partition_channels,
                           [SK(*o) for o in node.order_keys], specs)

@@ -42,7 +42,7 @@ __all__ = ["WindowSpec", "window"]
 
 _FUNCS = ("row_number", "rank", "dense_rank", "sum", "count", "avg", "min",
           "max", "first_value", "last_value", "ntile", "percent_rank",
-          "cume_dist", "lag", "lead")
+          "cume_dist", "lag", "lead", "nth_value")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -51,15 +51,20 @@ class WindowSpec:
     input_channel: Optional[int] = None
     output_type: T.Type = T.BIGINT
     # frame: "range_current" (default: RANGE UNBOUNDED PRECEDING..CURRENT
-    # ROW) or "full" (whole partition)
-    frame: str = "range_current"
+    # ROW), "full" (whole partition), or a ROWS frame ("rows", start,
+    # end) with signed row offsets (None = unbounded on that side)
+    frame: object = "range_current"
     ntile_buckets: int = 0
-    offset: int = 1  # lag/lead distance
+    offset: int = 1  # lag/lead distance; nth_value's n
 
     def __post_init__(self):
         assert self.name in _FUNCS, self.name
         if self.name == "ntile":
             assert self.ntile_buckets > 0, "ntile requires a positive bucket count"
+        if self.name == "nth_value":
+            assert self.offset >= 1, "nth_value's n must be at least 1"
+        if isinstance(self.frame, (tuple, list)):
+            assert self.frame[0] == "rows", self.frame
 
 
 def _seg_positions(words: List[jnp.ndarray]) -> jnp.ndarray:
@@ -168,18 +173,29 @@ def window(batch: Batch, partition_channels: Sequence[int],
             nulls_sorted = jnp.where(ok, n_sorted[src], True) | ~s_active
         elif name == "count" and spec.input_channel is None:
             # count(*) over frame: rows (not non-null values)
-            pc = jnp.cumsum(s_active.astype(jnp.int64))
-            end = run_end if spec.frame == "range_current" else part_end
-            base_c = jnp.where(part_start > 0, pc[part_start - 1], 0)
-            vals_sorted = pc[end] - base_c
+            f_lo, f_hi = _frame_bounds(spec.frame, spos, part_start,
+                                       part_end, run_end)
+            vals_sorted = jnp.maximum(f_hi - f_lo + 1, 0)
             nulls_sorted = ~s_active
         elif name in ("sum", "count", "avg", "min", "max", "first_value",
-                      "last_value"):
+                      "last_value", "nth_value"):
             col = batch.column(spec.input_channel)
             if isinstance(col, DictionaryColumn):
                 col = col.decode()
             assert not isinstance(col, StringColumn), \
                 f"window {name} over strings is not yet supported"
+            f_lo, f_hi = _frame_bounds(spec.frame, spos, part_start,
+                                       part_end, run_end)
+            f_hi_c = jnp.clip(f_hi, 0, n - 1)
+            f_lo_c = jnp.clip(f_lo, 0, n - 1)
+            empty_frame = f_hi < f_lo
+
+            def frame_total(contrib):
+                """Inclusive [f_lo, f_hi] totals via padded-cumsum diff."""
+                ps = jnp.cumsum(contrib)
+                base = jnp.where(f_lo > 0, ps[jnp.maximum(f_lo - 1, 0)], 0)
+                return jnp.where(empty_frame, 0, ps[f_hi_c] - base)
+
             if isinstance(col, Int128Column):
                 # long-decimal inputs (aggregation states feeding a
                 # window stage, the q53/q12 shapes): EXACT windowed sums
@@ -191,21 +207,14 @@ def window(batch: Batch, partition_channels: Sequence[int],
                 from ..int128 import (combine_limb_totals_128,
                                       div128_by_count, limbs13_of_128)
                 nn_sorted = (~col.nulls & batch.active)[perm]
-                end = run_end if spec.frame == "range_current" else part_end
-                pc = jnp.cumsum(nn_sorted.astype(jnp.int64))
-                base_c = jnp.where(part_start > 0, pc[part_start - 1], 0)
-                wcnt = pc[end] - base_c
+                wcnt = frame_total(nn_sorted.astype(jnp.int64))
                 if name == "count":
                     out_cols.append(Column(wcnt[inv],
                                            jnp.asarray(~s_active)[inv],
                                            spec.output_type))
                     continue
-                totals = []
-                for l in limbs13_of_128(col.hi, col.lo):
-                    ls = jnp.where(nn_sorted, l[perm], 0)
-                    ps = jnp.cumsum(ls)
-                    base = jnp.where(part_start > 0, ps[part_start - 1], 0)
-                    totals.append(ps[end] - base)
+                totals = [frame_total(jnp.where(nn_sorted, l[perm], 0))
+                          for l in limbs13_of_128(col.hi, col.lo)]
                 hi, lo = combine_limb_totals_128(
                     jnp.stack(totals, axis=-1))
                 empty = (wcnt == 0) | ~s_active
@@ -222,13 +231,8 @@ def window(batch: Batch, partition_channels: Sequence[int],
             if name in ("sum", "avg", "count"):
                 sv = v_sorted.astype(jnp.float64 if col.type.is_floating
                                      else jnp.int64)
-                ps = jnp.cumsum(jnp.where(nn_sorted, sv, 0))
-                pc = jnp.cumsum(nn_sorted.astype(jnp.int64))
-                end = run_end if spec.frame == "range_current" else part_end
-                base_s = jnp.where(part_start > 0, ps[part_start - 1], 0)
-                base_c = jnp.where(part_start > 0, pc[part_start - 1], 0)
-                wsum = ps[end] - base_s
-                wcnt = pc[end] - base_c
+                wsum = frame_total(jnp.where(nn_sorted, sv, 0))
+                wcnt = frame_total(nn_sorted.astype(jnp.int64))
                 if name == "sum":
                     vals_sorted = wsum
                     nulls_sorted = (wcnt == 0) | ~s_active
@@ -243,25 +247,46 @@ def window(batch: Batch, partition_channels: Sequence[int],
                         vals_sorted = jnp.round(vals_sorted)
                     nulls_sorted = (wcnt == 0) | ~s_active
             elif name in ("min", "max"):
-                ident = (jnp.iinfo(jnp.int64).max if name == "min"
+                minimize = name == "min"
+                ident = (jnp.iinfo(jnp.int64).max if minimize
                          else jnp.iinfo(jnp.int64).min)
                 if col.type.is_floating:
-                    ident = jnp.inf if name == "min" else -jnp.inf
+                    ident = jnp.inf if minimize else -jnp.inf
                 sv = jnp.where(nn_sorted, v_sorted, ident)
-                scan = jax.lax.cummin if name == "min" else jax.lax.cummax
-                ps = _segmented_scan(sv, part_bound, scan)
-                end = run_end if spec.frame == "range_current" else part_end
-                vals_sorted = ps[end]
-                pc = jnp.cumsum(nn_sorted.astype(jnp.int64))
-                base_c = jnp.where(part_start > 0, pc[part_start - 1], 0)
-                nulls_sorted = ((pc[end] - base_c) == 0) | ~s_active
-            elif name == "first_value":
-                vals_sorted = v_sorted[part_start]
-                nulls_sorted = col.nulls[perm][part_start] | ~s_active
-            else:  # last_value (frame-end semantics)
-                end = run_end if spec.frame == "range_current" else part_end
-                vals_sorted = v_sorted[end]
-                nulls_sorted = col.nulls[perm][end] | ~s_active
+                bounded_start = isinstance(spec.frame, (tuple, list)) \
+                    and spec.frame[1] is not None
+                if bounded_start:
+                    # general ROWS frame: sparse-table range extreme.
+                    # With a bounded end too, the static offsets cap the
+                    # frame length, so only log2(w) levels are built.
+                    _s, _e = spec.frame[1], spec.frame[2]
+                    cap = (_e - _s + 1) if _e is not None else None
+                    vals_sorted = _range_extreme(sv, f_lo_c, f_hi_c,
+                                                 ident, minimize,
+                                                 max_len=cap)
+                else:
+                    # frame starts at the partition head: the cheaper
+                    # O(n) segmented running scan answers any end bound
+                    scan = jax.lax.cummin if minimize else jax.lax.cummax
+                    ps = _segmented_scan(sv, part_bound, scan)
+                    vals_sorted = ps[f_hi_c]
+                wcnt = frame_total(nn_sorted.astype(jnp.int64))
+                nulls_sorted = (wcnt == 0) | empty_frame | ~s_active
+            elif name in ("first_value", "last_value", "nth_value"):
+                if name == "first_value":
+                    idx = f_lo_c
+                elif name == "last_value":
+                    idx = f_hi_c
+                else:  # nth_value(x, n): n-th row of the frame
+                    idx = jnp.clip(f_lo + (spec.offset - 1), 0, n - 1)
+                # membership is tested on the UNCLIPPED index: a clipped
+                # idx can land back on a valid slot (e.g. n beyond the
+                # frame at the last array position) and must stay NULL
+                in_frame = (~empty_frame) & \
+                    (f_lo + (spec.offset - 1 if name == "nth_value" else 0)
+                     <= f_hi)
+                vals_sorted = v_sorted[idx]
+                nulls_sorted = col.nulls[perm][idx] | ~in_frame | ~s_active
         else:
             raise NotImplementedError(name)
 
@@ -272,6 +297,58 @@ def window(batch: Batch, partition_channels: Sequence[int],
         out_cols.append(Column(vals, nulls, spec.output_type))
 
     return Batch(tuple(out_cols), batch.active)
+
+
+def _frame_bounds(frame, spos, part_start, part_end, run_end):
+    """Inclusive [lo, hi] sorted-position bounds of each row's frame.
+    "range_current" = RANGE UNBOUNDED PRECEDING..CURRENT ROW (peer-
+    inclusive via run_end); "full" = whole partition; ("rows", s, e) =
+    signed row offsets (None = unbounded on that side)."""
+    if isinstance(frame, (tuple, list)):
+        _mode, s, e = frame
+        lo = part_start if s is None else jnp.maximum(part_start, spos + s)
+        hi = part_end if e is None else jnp.minimum(part_end, spos + e)
+        return lo, hi
+    if frame == "full":
+        return part_start, part_end
+    return part_start, run_end
+
+
+def _range_extreme(sv, lo, hi, ident, minimize: bool, max_len=None):
+    """Min/max over arbitrary inclusive [lo, hi] ranges via a sparse
+    table: level k holds extrema of length-2^k blocks; a query combines
+    the two blocks covering the range (O(n log n) build, O(1) gathers
+    per row -- the vectorizable answer to sliding-window extrema).
+    `max_len` (a static bound on hi-lo+1, when the caller knows one)
+    caps the level count at log2(max_len)."""
+    n = sv.shape[0]
+    op = jnp.minimum if minimize else jnp.maximum
+    levels = [sv]
+    k = 1
+    k_stop = max(min(n, max_len if max_len is not None else n), 1)
+    while k < k_stop:
+        prev = levels[-1]
+        shifted = jnp.concatenate(
+            [prev[k:], jnp.full((min(k, n),), ident, dtype=sv.dtype)])
+        levels.append(op(prev, shifted))
+        k *= 2
+    table = jnp.stack(levels)  # (L, n)
+    length = jnp.maximum(hi - lo + 1, 1)
+    # floor(log2(length)) seeded by f32 log2, then corrected one step in
+    # each direction: f32 rounding is off by at most 1 (e.g. log2 of
+    # 2^21 - 1 rounds UP to exactly 21.0, which would overshoot the
+    # frame by one element and leak an out-of-frame value into min/max)
+    kk = jnp.floor(jnp.log2(length.astype(jnp.float32))).astype(jnp.int64)
+    kk = jnp.clip(kk, 0, len(levels) - 1)
+    one = jnp.int64(1)
+    kk = jnp.where(jnp.left_shift(one, kk) > length, kk - 1, kk)
+    kk = jnp.where((kk + 1 < len(levels)) &
+                   (jnp.left_shift(one, kk + 1) <= length), kk + 1, kk)
+    kk = jnp.clip(kk, 0, len(levels) - 1).astype(jnp.int32)
+    a = table[kk, lo]
+    blk = jnp.left_shift(jnp.int64(1), kk.astype(jnp.int64))
+    b = table[kk, jnp.clip(hi - blk + 1, 0, n - 1)]
+    return op(a, b)
 
 
 def _segmented_scan(vals, seg_bound, scan):

@@ -111,6 +111,11 @@ class WindowExpr:
     func: "Func"
     partition_by: List[object]
     order_by: List["OrderItem"]
+    # None = default (RANGE UNBOUNDED PRECEDING..CURRENT ROW with ORDER
+    # BY, full partition without); else ("rows"|"range", start, end)
+    # where start/end is None (unbounded) or a signed row offset
+    # (negative = PRECEDING, 0 = CURRENT ROW, positive = FOLLOWING)
+    frame: object = None
 
 
 @dataclasses.dataclass
@@ -501,8 +506,9 @@ class _Parser:
                         order.append(self._order_item())
                         while self.accept_op(","):
                             order.append(self._order_item())
+                    frame = self._window_frame()
                     self.expect_op(")")
-                    return WindowExpr(fn, part, order)
+                    return WindowExpr(fn, part, order, frame)
                 return fn
             parts = [v]
             while self.accept_op("."):
@@ -684,6 +690,57 @@ class _Parser:
         else:
             alias = self._implicit_alias()
         return TableRef(name.lower(), alias)
+
+    def _window_frame(self):
+        """[ROWS|RANGE [BETWEEN] bound [AND bound]] inside OVER (...).
+        bound: UNBOUNDED PRECEDING|FOLLOWING, CURRENT ROW, n
+        PRECEDING|FOLLOWING. Returns None or (mode, start, end)."""
+        mode = None
+        if self.accept_ctx_kw("rows"):
+            mode = "rows"
+        elif self.accept_ctx_kw("range"):
+            mode = "range"
+        if mode is None:
+            return None
+
+        def bound():
+            if self.accept_ctx_kw("unbounded"):
+                which = self.next()[1].lower()
+                assert which in ("preceding", "following"), which
+                return "unbounded_precede" if which == "preceding" \
+                    else "unbounded_follow"
+            if self.accept_ctx_kw("current"):
+                k, v = self.next()
+                assert v.lower() == "row", (k, v)
+                return 0
+            k, v = self.next()
+            assert k == "number", f"expected frame bound, got {(k, v)}"
+            n = int(v)
+            which = self.next()[1].lower()
+            assert which in ("preceding", "following"), which
+            return -n if which == "preceding" else n
+
+        if self.accept_kw("between"):
+            start = bound()
+            self.expect_kw("and")
+            end = bound()
+        else:
+            start = bound()
+            end = 0  # implicit CURRENT ROW
+        # normalize to (mode, start, end) with None = unbounded on that
+        # side; the invalid corner sentinels are rejected, not coerced
+        if start == "unbounded_follow":
+            raise ValueError("frame start cannot be UNBOUNDED FOLLOWING")
+        if end == "unbounded_precede":
+            raise ValueError("frame end cannot be UNBOUNDED PRECEDING")
+        start_v = None if start == "unbounded_precede" else start
+        end_v = None if end == "unbounded_follow" else end
+        # ANSI ordering rule: a bounded start must not sit after a
+        # bounded end (covers ROWS n FOLLOWING => implicit CURRENT ROW
+        # end, and BETWEEN CURRENT ROW AND n PRECEDING)
+        if start_v is not None and end_v is not None and start_v > end_v:
+            raise ValueError("window frame start cannot follow frame end")
+        return (mode, start_v, end_v)
 
     def _order_item(self) -> OrderItem:
         e = self.expr()

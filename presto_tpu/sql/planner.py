@@ -1070,6 +1070,26 @@ def _collect_windows(e, out: list):
                 _collect_windows(x, out)
 
 
+def _frame_of(w) -> object:
+    """WindowExpr.frame (parser form) -> the kernel's frame spec."""
+    fr = getattr(w, "frame", None)
+    if fr is None:
+        return "range_current"
+    mode, s, e = fr
+    if mode == "range":
+        if s is None and e == 0:
+            return "range_current"
+        if s is None and e is None:
+            return "full"
+        raise NotImplementedError(
+            "unsupported RANGE frame shape: only UNBOUNDED PRECEDING .. "
+            "CURRENT ROW / UNBOUNDED FOLLOWING are supported (any ROWS "
+            "frame works)")
+    if s is None and e is None:
+        return "full"  # whole partition: cheaper non-tuple kernel path
+    return ("rows", s, e)
+
+
 def _plan_window_stages(node, win_list, lower_expr):
     """Plan every WindowExpr in `win_list`, chaining one WindowNode
     stage per DISTINCT OVER clause (each stage's identity prefix keeps
@@ -1125,21 +1145,26 @@ def _plan_window_stage(node, win_list, lower_expr, base_types):
             arg = f.args[0]
             assert isinstance(arg, P.Literal) and arg.kind == "int"
             buckets = int(arg.value)
-        elif name in ("lag", "lead"):
-            if len(f.args) > 2:
+        elif name in ("lag", "lead", "nth_value"):
+            if name != "nth_value" and len(f.args) > 2:
                 raise NotImplementedError(
                     "lag/lead default-value argument is not supported yet")
+            if name == "nth_value" and len(f.args) != 2:
+                raise ValueError("nth_value requires exactly two arguments")
             in_ch = chan_of(f.args[0])
             if len(f.args) > 1:
                 arg = f.args[1]
                 assert isinstance(arg, P.Literal) and arg.kind == "int", \
-                    "lag/lead offset must be an integer literal"
+                    f"{name} offset must be an integer literal"
                 buckets = int(arg.value)  # generic int param slot
+                if name == "nth_value" and buckets < 1:
+                    raise ValueError("nth_value offset must be at least 1")
             else:
                 buckets = 1
         elif f.args and not isinstance(f.args[0], P.Star):
             in_ch = chan_of(f.args[0])
-        if name in ("lag", "lead"):
+        frame = _frame_of(w)
+        if name in ("lag", "lead", "nth_value"):
             oty = pre_exprs[in_ch].type
         elif name in _WINDOW_FN_TYPES and not (name == "count" and in_ch is not None):
             oty = _WINDOW_FN_TYPES[name]
@@ -1156,7 +1181,7 @@ def _plan_window_stage(node, win_list, lower_expr, base_types):
             oty = T.decimal(38, ity.scale) if ity.is_decimal else T.DOUBLE
         else:  # min/max/first_value/last_value
             oty = pre_exprs[in_ch].type
-        functions.append((name, in_ch, oty, "range_current", buckets))
+        functions.append((name, in_ch, oty, frame, buckets))
         win_out_types.append(oty)
 
     node = N.ProjectNode(node, pre_exprs)

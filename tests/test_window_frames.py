@@ -1,0 +1,172 @@
+"""ROWS BETWEEN window frames + nth_value vs Python oracles.
+
+Reference behavior: WindowOperator frame evaluation (ROWS mode) and
+operator/window/NthValueFunction. min/max over sliding frames use a
+sparse table (vectorized range extrema); sums/counts use padded-cumsum
+diffs over [lo, hi]."""
+
+import collections
+
+import pytest
+
+from presto_tpu.sql import sql
+
+
+def _partitions(rows):
+    parts = collections.defaultdict(list)
+    for row in rows:
+        parts[row[0]].append(row)
+    return parts
+
+
+def test_rows_frames_against_oracle():
+    q = ("SELECT orderkey, linenumber, quantity, "
+         "sum(quantity) OVER (PARTITION BY orderkey ORDER BY linenumber "
+         "  ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING) msum, "
+         "min(quantity) OVER (PARTITION BY orderkey ORDER BY linenumber "
+         "  ROWS BETWEEN 2 PRECEDING AND CURRENT ROW) mmin, "
+         "max(quantity) OVER (PARTITION BY orderkey ORDER BY linenumber "
+         "  ROWS BETWEEN CURRENT ROW AND UNBOUNDED FOLLOWING) mmax, "
+         "nth_value(quantity, 2) OVER (PARTITION BY orderkey "
+         "  ORDER BY linenumber ROWS BETWEEN UNBOUNDED PRECEDING AND "
+         "  UNBOUNDED FOLLOWING) nv, "
+         "count(*) OVER (PARTITION BY orderkey ORDER BY linenumber "
+         "  ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING) mcnt "
+         "FROM lineitem WHERE orderkey <= 100 "
+         "ORDER BY orderkey, linenumber")
+    checked = 0
+    for ok, rws in _partitions(sql(q, sf=0.01).rows()).items():
+        qs = [x[2] for x in rws]
+        for i, row in enumerate(rws):
+            lo, hi = max(0, i - 1), min(len(qs) - 1, i + 1)
+            assert row[3] == sum(qs[lo:hi + 1])
+            assert row[4] == min(qs[max(0, i - 2):i + 1])
+            assert row[5] == max(qs[i:])
+            assert row[6] == (qs[1] if len(qs) >= 2 else None)
+            assert row[7] == hi - lo + 1
+            checked += 1
+    assert checked == 400
+
+
+def test_rows_frame_avg_and_empty_frames():
+    # a frame strictly in the future empties out at partition end
+    q = ("SELECT orderkey, linenumber, quantity, "
+         "avg(quantity) OVER (PARTITION BY orderkey ORDER BY linenumber "
+         "  ROWS BETWEEN 1 FOLLOWING AND 2 FOLLOWING) a "
+         "FROM lineitem WHERE orderkey <= 40 ORDER BY orderkey, linenumber")
+    for ok, rws in _partitions(sql(q, sf=0.01).rows()).items():
+        qs = [x[2] for x in rws]
+        for i, row in enumerate(rws):
+            win = qs[i + 1:i + 3]
+            if not win:
+                assert row[3] is None
+            else:
+                assert abs(row[3] - sum(win) / len(win)) <= 1
+
+
+def test_first_last_value_honor_rows_frames():
+    q = ("SELECT orderkey, linenumber, quantity, "
+         "first_value(quantity) OVER (PARTITION BY orderkey "
+         "  ORDER BY linenumber ROWS BETWEEN 1 PRECEDING AND "
+         "  CURRENT ROW) f, "
+         "last_value(quantity) OVER (PARTITION BY orderkey "
+         "  ORDER BY linenumber ROWS BETWEEN CURRENT ROW AND "
+         "  1 FOLLOWING) l "
+         "FROM lineitem WHERE orderkey <= 40 ORDER BY orderkey, linenumber")
+    for ok, rws in _partitions(sql(q, sf=0.01).rows()).items():
+        qs = [x[2] for x in rws]
+        for i, row in enumerate(rws):
+            assert row[3] == qs[max(0, i - 1)]
+            assert row[4] == qs[min(len(qs) - 1, i + 1)]
+
+
+def test_range_offset_frames_rejected():
+    with pytest.raises(NotImplementedError, match="RANGE frame shape"):
+        sql("SELECT sum(quantity) OVER (ORDER BY linenumber "
+            "RANGE BETWEEN 5 PRECEDING AND CURRENT ROW) "
+            "FROM lineitem WHERE orderkey <= 10", sf=0.01)
+
+
+def test_inverted_frames_rejected():
+    for frame in ("ROWS 2 FOLLOWING",
+                  "ROWS BETWEEN CURRENT ROW AND 2 PRECEDING"):
+        with pytest.raises(ValueError, match="follow frame end"):
+            sql(f"SELECT sum(quantity) OVER (ORDER BY linenumber {frame}) "
+                "FROM lineitem WHERE orderkey <= 10", sf=0.01)
+
+
+def test_unbounded_preceding_start_with_bounded_end():
+    # prefix-path min/max: frame start pinned to the partition head
+    q = ("SELECT orderkey, linenumber, quantity, "
+         "min(quantity) OVER (PARTITION BY orderkey ORDER BY linenumber "
+         "  ROWS BETWEEN UNBOUNDED PRECEDING AND 1 FOLLOWING) m "
+         "FROM lineitem WHERE orderkey <= 40 ORDER BY orderkey, linenumber")
+    for ok, rws in _partitions(sql(q, sf=0.01).rows()).items():
+        qs = [x[2] for x in rws]
+        for i, row in enumerate(rws):
+            assert row[3] == min(qs[:min(len(qs), i + 2)])
+
+
+def test_frame_end_unbounded_preceding_rejected():
+    with pytest.raises(ValueError, match="UNBOUNDED PRECEDING"):
+        sql("SELECT sum(quantity) OVER (ORDER BY linenumber "
+            "ROWS BETWEEN 2 PRECEDING AND UNBOUNDED PRECEDING) "
+            "FROM lineitem WHERE orderkey <= 10", sf=0.01)
+
+
+def test_nth_value_argument_validation():
+    with pytest.raises(ValueError, match="two arguments"):
+        sql("SELECT nth_value(quantity) OVER (ORDER BY linenumber) "
+            "FROM lineitem WHERE orderkey <= 10", sf=0.01)
+    with pytest.raises(ValueError, match="at least 1"):
+        sql("SELECT nth_value(quantity, 0) OVER (ORDER BY linenumber) "
+            "FROM lineitem WHERE orderkey <= 10", sf=0.01)
+
+
+def test_nth_value_beyond_frame_is_null_on_fully_active_batch():
+    """n past the frame end must be NULL even when the clipped gather
+    index lands on a live row (a fully-active batch with the frame
+    ending on the last array slot — the clip-collapse corner)."""
+    import jax.numpy as jnp
+    from presto_tpu.block import Batch, Column
+    from presto_tpu import types as T
+    from presto_tpu.ops.window import WindowSpec, window
+
+    vals = jnp.array([10, 20, 30, 40], dtype=jnp.int64)
+    part = jnp.zeros(4, dtype=jnp.int64)
+    batch = Batch((Column(part, jnp.zeros(4, bool), T.BIGINT),
+                   Column(vals, jnp.zeros(4, bool), T.BIGINT)),
+                  jnp.ones(4, dtype=bool))
+    out = window(batch, [0], [],
+                 [WindowSpec("nth_value", 1, T.BIGINT,
+                             frame=("rows", None, None), offset=10)])
+    nv = out.column(2)
+    assert bool(nv.nulls.all()), (nv.values, nv.nulls)
+
+
+def test_range_extreme_sparse_table_randomized():
+    """min/max over random inclusive ranges vs a numpy oracle, with
+    lengths crossing power-of-two boundaries (the f32-log2 corner)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from presto_tpu.ops.window import _range_extreme
+
+    rng = np.random.default_rng(7)
+    n = 4096
+    sv = rng.integers(-10**6, 10**6, n).astype(np.int64)
+    lo = rng.integers(0, n, 300)
+    hi = np.minimum(n - 1, lo + rng.integers(0, n, 300))
+    # force boundary lengths: 2^k and 2^k - 1 ranges
+    for k in (1, 2, 4, 8, 64, 1024, 2048, 4096):
+        lo = np.append(lo, [0, n - k])
+        hi = np.append(hi, [k - 1, n - 1])
+    got_min = np.asarray(_range_extreme(
+        jnp.asarray(sv), jnp.asarray(lo), jnp.asarray(hi),
+        np.iinfo(np.int64).max, True))
+    got_max = np.asarray(_range_extreme(
+        jnp.asarray(sv), jnp.asarray(lo), jnp.asarray(hi),
+        np.iinfo(np.int64).min, False))
+    for i in range(len(lo)):
+        seg = sv[lo[i]:hi[i] + 1]
+        assert got_min[i] == seg.min(), (lo[i], hi[i])
+        assert got_max[i] == seg.max(), (lo[i], hi[i])
