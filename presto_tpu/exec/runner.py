@@ -104,6 +104,12 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     multiple of the mesh size and the plan runs SPMD. With `split_rows`,
     streamable aggregation plans execute split-by-split with bounded
     HBM (exec/streaming.py)."""
+    # rule-based simplification + channel pruning (IterativeOptimizer /
+    # PruneUnreferencedOutputs analog): narrows intermediates before
+    # stats and distribution decide capacities and exchange widths
+    if session is None or session.get("iterative_optimizer"):
+        from ..plan.rules import optimize_plan
+        root = optimize_plan(root)
     # capacity refinement (CBO stats): shrink group tables to the
     # connector-proven NDV bound so group-by rides the scatter-free
     # small-table kernels wherever statistics allow

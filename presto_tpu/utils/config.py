@@ -143,6 +143,10 @@ SESSION_PROPERTIES = (
          "let connector NDV statistics SHRINK group-table capacities "
          "(plan.stats.refine_capacities); disable when a hand-set "
          "max_groups must stay authoritative")
+    .add("iterative_optimizer", "bool", True,
+         "run the rule-based simplification + channel-pruning passes "
+         "(plan.rules; IterativeOptimizer/PruneUnreferencedOutputs "
+         "analog) before capacity refinement and distribution")
     .add("dynamic_filtering", "bool", True,
          "run small dimension build sides first and prune fact scans "
          "by their join-key domains at staging time (exec/dynfilter.py)")
