@@ -23,14 +23,20 @@ __all__ = ["WorkerClient"]
 
 
 class WorkerClient:
-    def __init__(self, base_url: str, timeout: float = 30.0):
+    def __init__(self, base_url: str, timeout: float = 30.0,
+                 shared_secret: Optional[str] = None):
+        from .auth import make_authenticator
         self.base = base_url.rstrip("/")
         self.timeout = timeout
+        self._auth = make_authenticator(shared_secret, "client")
 
     def _request(self, method: str, path: str, body: Optional[bytes] = None):
+        from .auth import bearer_headers
         req = urllib.request.Request(self.base + path, data=body, method=method)
         if body is not None:
             req.add_header("Content-Type", "application/json")
+        for k, v in bearer_headers(self._auth).items():
+            req.add_header(k, v)
         with urllib.request.urlopen(req, timeout=self.timeout) as resp:
             return resp.read(), dict(resp.headers)
 

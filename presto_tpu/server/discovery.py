@@ -28,9 +28,14 @@ class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     nodes: Dict[str, dict] = {}
     lock = threading.Lock()
+    authenticator = None  # InternalAuthenticator when a secret is set
 
     def log_message(self, fmt, *args):
         pass
+
+    def _authorized(self) -> bool:
+        from .auth import authorize_request
+        return authorize_request(self, self.authenticator, self._json)
 
     def _json(self, obj, code=200):
         body = json.dumps(obj).encode()
@@ -41,6 +46,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_PUT(self):  # noqa: N802  /v1/announcement/{node_id}
+        if not self._authorized():
+            return
         parts = [p for p in self.path.split("/") if p]
         if len(parts) == 3 and parts[:2] == ["v1", "announcement"]:
             length = int(self.headers.get("Content-Length", "0"))
@@ -52,6 +59,8 @@ class _Handler(BaseHTTPRequestHandler):
         return self._json({"error": "bad path"}, 404)
 
     def do_GET(self):  # noqa: N802  /v1/service/presto-tpu
+        if not self._authorized():
+            return
         parts = [p for p in self.path.split("/") if p]
         if len(parts) >= 2 and parts[:2] == ["v1", "service"]:
             now = time.time()
@@ -62,6 +71,8 @@ class _Handler(BaseHTTPRequestHandler):
         return self._json({"error": "bad path"}, 404)
 
     def do_DELETE(self):  # noqa: N802  graceful shutdown un-announce
+        if not self._authorized():
+            return
         parts = [p for p in self.path.split("/") if p]
         if len(parts) == 3 and parts[:2] == ["v1", "announcement"]:
             with self.lock:
@@ -71,9 +82,13 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class DiscoveryServer:
-    def __init__(self, port: int = 0):
+    def __init__(self, port: int = 0,
+                 shared_secret: Optional[str] = None):
+        from .auth import make_authenticator
         handler = type("BoundDiscovery", (_Handler,),
-                       {"nodes": {}, "lock": threading.Lock()})
+                       {"nodes": {}, "lock": threading.Lock(),
+                        "authenticator": make_authenticator(
+                            shared_secret, "discovery")})
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self.httpd.server_address[1]
         self.url = f"http://127.0.0.1:{self.port}"
@@ -91,21 +106,28 @@ class Announcer:
     """Worker-side periodic announcement (Announcer.cpp analog)."""
 
     def __init__(self, discovery_url: str, node_id: str, worker_url: str,
-                 interval_s: float = 1.0, environment: str = "tpu"):
+                 interval_s: float = 1.0, environment: str = "tpu",
+                 shared_secret: Optional[str] = None):
+        from .auth import make_authenticator
         self.discovery_url = discovery_url.rstrip("/")
         self.node_id = node_id
         self.body = json.dumps({"uri": worker_url,
                                 "environment": environment,
                                 "coordinator": False}).encode()
         self.interval = interval_s
+        self._auth = make_authenticator(shared_secret, node_id)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def _headers(self) -> dict:
+        from .auth import bearer_headers
+        return {"Content-Type": "application/json",
+                **bearer_headers(self._auth)}
 
     def announce_once(self):
         req = urllib.request.Request(
             f"{self.discovery_url}/v1/announcement/{self.node_id}",
-            data=self.body, method="PUT",
-            headers={"Content-Type": "application/json"})
+            data=self.body, method="PUT", headers=self._headers())
         urllib.request.urlopen(req, timeout=5).read()
 
     def start(self):
@@ -131,16 +153,23 @@ class Announcer:
             try:
                 req = urllib.request.Request(
                     f"{self.discovery_url}/v1/announcement/{self.node_id}",
-                    method="DELETE")
+                    method="DELETE",
+                    headers=dict(self._headers()))
                 urllib.request.urlopen(req, timeout=5).read()
             except Exception:
                 pass
 
 
-def alive_nodes(discovery_url: str, max_age_s: float = 5.0) -> List[dict]:
+def alive_nodes(discovery_url: str, max_age_s: float = 5.0,
+                shared_secret: Optional[str] = None) -> List[dict]:
     """HeartbeatFailureDetector view: nodes announced within max_age_s
     (the scheduler's eligible set; stale nodes are failed)."""
-    with urllib.request.urlopen(f"{discovery_url.rstrip('/')}/v1/service/presto-tpu",
-                                timeout=5) as resp:
+    from .auth import bearer_headers, make_authenticator
+    auth = make_authenticator(shared_secret, "detector") \
+        if shared_secret is not None else None
+    req = urllib.request.Request(
+        f"{discovery_url.rstrip('/')}/v1/service/presto-tpu",
+        headers=bearer_headers(auth))
+    with urllib.request.urlopen(req, timeout=5) as resp:
         services = json.loads(resp.read())["services"]
     return [s for s in services if s["ageSeconds"] <= max_age_s]

@@ -350,9 +350,16 @@ class _Handler(BaseHTTPRequestHandler):
     manager: TaskManager = None
     node_id: str = ""
     started_at: float = 0.0
+    authenticator = None  # InternalAuthenticator when a secret is set
 
     def log_message(self, fmt, *args):  # quiet
         pass
+
+    def _authorized(self) -> bool:
+        """InternalAuthenticationFilter analog: with a cluster secret
+        configured, every endpoint requires a valid internal bearer."""
+        from .auth import authorize_request
+        return authorize_request(self, self.authenticator, self._send_json)
 
     def _send_json(self, obj, code=200):
         body = json.dumps(obj).encode()
@@ -372,6 +379,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self):  # noqa: N802
+        if not self._authorized():
+            return
         parts = [p for p in self.path.split("/") if p]
         if parts == ["v1", "info"]:
             return self._send_json({
@@ -478,6 +487,8 @@ class _Handler(BaseHTTPRequestHandler):
         return self._send_json({"error": f"unknown path {self.path}"}, 404)
 
     def do_POST(self):  # noqa: N802
+        if not self._authorized():
+            return
         parts = [p for p in self.path.split("/") if p]
         if len(parts) == 3 and parts[:2] == ["v1", "task"]:
             length = int(self.headers.get("Content-Length", "0"))
@@ -524,6 +535,8 @@ class _Handler(BaseHTTPRequestHandler):
         return self._send_json({"error": f"unknown path {self.path}"}, 404)
 
     def do_PUT(self):  # noqa: N802  graceful shutdown (worker drain)
+        if not self._authorized():
+            return
         parts = [p for p in self.path.split("/") if p]
         if parts == ["v1", "info", "state"]:
             length = int(self.headers.get("Content-Length", "0"))
@@ -536,6 +549,8 @@ class _Handler(BaseHTTPRequestHandler):
         return self._send_json({"error": f"unknown path {self.path}"}, 404)
 
     def do_DELETE(self):  # noqa: N802
+        if not self._authorized():
+            return
         parts = [p for p in self.path.split("/") if p]
         if len(parts) == 3 and parts[:2] == ["v1", "task"]:
             self.manager.abort(parts[2])
@@ -551,12 +566,15 @@ class TpuWorkerServer:
     def __init__(self, port: int = 0, sf: float = 0.01, mesh=None,
                  node_id: Optional[str] = None,
                  discovery_url: Optional[str] = None,
-                 announce_interval_s: float = 1.0):
+                 announce_interval_s: float = 1.0,
+                 shared_secret: Optional[str] = None):
+        from .auth import make_authenticator
         self.manager = TaskManager(sf=sf, mesh=mesh)
         self.node_id = node_id or f"tpu-worker-{uuid.uuid4().hex[:8]}"
+        auth = make_authenticator(shared_secret, self.node_id)
         handler = type("BoundHandler", (_Handler,), {
             "manager": self.manager, "node_id": self.node_id,
-            "started_at": time.time()})
+            "started_at": time.time(), "authenticator": auth})
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
@@ -566,7 +584,8 @@ class TpuWorkerServer:
             self._announcer = Announcer(
                 discovery_url, self.node_id,
                 f"http://127.0.0.1:{self.port}",
-                interval_s=announce_interval_s)
+                interval_s=announce_interval_s,
+                shared_secret=shared_secret)
 
     def start(self):
         self._thread = threading.Thread(target=self.httpd.serve_forever,
