@@ -198,15 +198,54 @@ def window(batch: Batch, partition_channels: Sequence[int],
 
             if isinstance(col, Int128Column):
                 # long-decimal inputs (aggregation states feeding a
-                # window stage, the q53/q12 shapes): EXACT windowed sums
-                # via 13-bit limb cumsums recombined to (hi, lo); avg
-                # divides with the decimal half-up rule
-                if name not in ("sum", "avg", "count"):
-                    raise NotImplementedError(
-                        f"window {name} over long decimals")
+                # window stage, the q53/q12/q51 shapes): EXACT windowed
+                # sums via 13-bit limb cumsums recombined to (hi, lo);
+                # avg divides with the decimal half-up rule; min/max by
+                # a segmented 128-bit-lexicographic scan; value picks by
+                # frame-edge gathers
                 from ..int128 import (combine_limb_totals_128,
                                       div128_by_count, limbs13_of_128)
                 nn_sorted = (~col.nulls & batch.active)[perm]
+                if name in ("first_value", "last_value", "nth_value"):
+                    if name == "first_value":
+                        idx = f_lo_c
+                    elif name == "last_value":
+                        idx = f_hi_c
+                    else:
+                        idx = jnp.clip(f_lo + (spec.offset - 1), 0, n - 1)
+                    in_frame = (~empty_frame) & \
+                        (f_lo + (spec.offset - 1 if name == "nth_value"
+                                 else 0) <= f_hi)
+                    nl = (col.nulls | ~batch.active)[perm]
+                    nulls = jnp.asarray(nl[idx] | ~in_frame | ~s_active)
+                    out_cols.append(Int128Column(
+                        col.hi[perm][idx][inv], col.lo[perm][idx][inv],
+                        nulls[inv], spec.output_type))
+                    continue
+                if name in ("min", "max"):
+                    if isinstance(spec.frame, (tuple, list)) and \
+                            spec.frame[1] is not None:
+                        raise NotImplementedError(
+                            "bounded-start ROWS min/max over long "
+                            "decimals")
+                    minimize = name == "min"
+                    ih = (jnp.iinfo(jnp.int64).max if minimize
+                          else jnp.iinfo(jnp.int64).min)
+                    il = jnp.uint64(0xFFFFFFFFFFFFFFFF) if minimize \
+                        else jnp.uint64(0)
+                    h_s = jnp.where(nn_sorted, col.hi[perm], ih)
+                    l_s = jnp.where(nn_sorted, col.lo[perm], il)
+                    sh, sl = _segmented_extreme128(h_s, l_s, part_bound,
+                                                   minimize)
+                    wcnt = frame_total(nn_sorted.astype(jnp.int64))
+                    empty = (wcnt == 0) | empty_frame | ~s_active
+                    out_cols.append(Int128Column(
+                        sh[f_hi_c][inv], sl[f_hi_c][inv],
+                        jnp.asarray(empty)[inv], spec.output_type))
+                    continue
+                if name not in ("sum", "avg", "count"):
+                    raise NotImplementedError(
+                        f"window {name} over long decimals")
                 wcnt = frame_total(nn_sorted.astype(jnp.int64))
                 if name == "count":
                     out_cols.append(Column(wcnt[inv],
@@ -349,6 +388,25 @@ def _range_extreme(sv, lo, hi, ident, minimize: bool, max_len=None):
     blk = jnp.left_shift(jnp.int64(1), kk.astype(jnp.int64))
     b = table[kk, jnp.clip(hi - blk + 1, 0, n - 1)]
     return op(a, b)
+
+
+def _segmented_extreme128(h, l, seg_bound, minimize: bool):
+    """Inclusive segmented running min/max over int128 (hi, lo) lanes:
+    the (flag, value) associative combine with a 128-bit lexicographic
+    comparison (signed hi, unsigned lo) picking the winner."""
+    from ..int128 import cmp128
+
+    def combine(a, b):
+        fa, ha, la = a
+        fb, hb, lb = b
+        a_lt_b, _ = cmp128(ha, la, hb, lb)
+        pick_b = fb | (a_lt_b if not minimize else ~a_lt_b)
+        return (fa | fb,
+                jnp.where(pick_b, hb, ha),
+                jnp.where(pick_b, lb, la))
+
+    _, sh, sl = jax.lax.associative_scan(combine, (seg_bound, h, l))
+    return sh, sl
 
 
 def _segmented_scan(vals, seg_bound, scan):

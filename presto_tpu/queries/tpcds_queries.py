@@ -1613,6 +1613,155 @@ WHERE d_year = 1999 AND avg_monthly_sales > 0.000
 ORDER BY sum_sales - avg_monthly_sales ASC, 3 ASC, 1 ASC, 2 ASC,
          4 ASC, 5 ASC
 """,
+    # q1: customers returning above 1.2x their store's average (CTE
+    # referenced twice; correlated scalar subquery over the CTE). LIMIT
+    # dropped: full-set oracle comparison (ties under LIMIT ambiguous).
+    "q1": """
+WITH customer_total_return AS (
+  SELECT sr_customer_sk ctr_customer_sk, sr_store_sk ctr_store_sk,
+         sum(sr_return_amt) ctr_total_return
+  FROM store_returns, date_dim
+  WHERE sr_returned_date_sk = d_date_sk AND d_year = 2000
+  GROUP BY sr_customer_sk, sr_store_sk)
+SELECT c_customer_id
+FROM customer_total_return ctr1, store, customer
+WHERE ctr1.ctr_total_return > (SELECT avg(ctr_total_return) * 1.2
+                               FROM customer_total_return ctr2
+                               WHERE ctr1.ctr_store_sk = ctr2.ctr_store_sk)
+  AND s_store_sk = ctr1.ctr_store_sk AND s_state = 'TN'
+  AND ctr1.ctr_customer_sk = c_customer_sk
+ORDER BY c_customer_id
+""",
+    # q30: q1's shape over web returns grouped by customer state
+    "q30": """
+WITH customer_total_return AS (
+  SELECT wr_returning_customer_sk ctr_customer_sk, ca_state ctr_state,
+         sum(wr_return_amt) ctr_total_return
+  FROM web_returns, date_dim, customer_address
+  WHERE wr_returned_date_sk = d_date_sk AND d_year = 2002
+    AND wr_returning_addr_sk = ca_address_sk
+  GROUP BY wr_returning_customer_sk, ca_state)
+SELECT c_customer_id, c_salutation, c_first_name, c_last_name,
+       c_preferred_cust_flag, c_birth_day, c_birth_month, c_birth_year,
+       c_birth_country, c_login, c_email_address, c_last_review_date_sk,
+       ctr_total_return
+FROM customer_total_return ctr1, customer_address, customer
+WHERE ctr1.ctr_total_return > (SELECT avg(ctr_total_return) * 1.2
+                               FROM customer_total_return ctr2
+                               WHERE ctr1.ctr_state = ctr2.ctr_state)
+  AND ca_address_sk = c_current_addr_sk AND ca_state = 'GA'
+  AND ctr1.ctr_customer_sk = c_customer_sk
+ORDER BY c_customer_id, ctr_total_return
+""",
+    # q81: q30's shape over catalog returns (return amount incl. tax)
+    "q81": """
+WITH customer_total_return AS (
+  SELECT cr_returning_customer_sk ctr_customer_sk, ca_state ctr_state,
+         sum(cr_return_amt_inc_tax) ctr_total_return
+  FROM catalog_returns, date_dim, customer_address
+  WHERE cr_returned_date_sk = d_date_sk AND d_year = 2000
+    AND cr_returning_addr_sk = ca_address_sk
+  GROUP BY cr_returning_customer_sk, ca_state)
+SELECT c_customer_id, c_salutation, c_first_name, c_last_name,
+       ca_street_number, ca_street_name, ca_street_type, ca_suite_number,
+       ca_city, ca_county, ca_state, ca_zip, ca_country, ca_gmt_offset,
+       ca_location_type, ctr_total_return
+FROM customer_total_return ctr1, customer_address, customer
+WHERE ctr1.ctr_total_return > (SELECT avg(ctr_total_return) * 1.2
+                               FROM customer_total_return ctr2
+                               WHERE ctr1.ctr_state = ctr2.ctr_state)
+  AND ca_address_sk = c_current_addr_sk AND ca_state = 'GA'
+  AND ctr1.ctr_customer_sk = c_customer_sk
+ORDER BY c_customer_id, ctr_total_return
+""",
+    # q59: year-over-year weekly sales ratios per store (CTE referenced
+    # twice; day-of-week pivot sums; ratios via CAST AS double so the
+    # oracle computes the identical float). Full-set comparison (the
+    # spec ORDER BY is not unique: s_store_id is an SCD business key).
+    "q59": """
+WITH wss AS (
+  SELECT d_week_seq, ss_store_sk,
+         sum(CASE WHEN d_day_name = 'Sunday' THEN ss_sales_price ELSE NULL END) sun_sales,
+         sum(CASE WHEN d_day_name = 'Monday' THEN ss_sales_price ELSE NULL END) mon_sales,
+         sum(CASE WHEN d_day_name = 'Tuesday' THEN ss_sales_price ELSE NULL END) tue_sales,
+         sum(CASE WHEN d_day_name = 'Wednesday' THEN ss_sales_price ELSE NULL END) wed_sales,
+         sum(CASE WHEN d_day_name = 'Thursday' THEN ss_sales_price ELSE NULL END) thu_sales,
+         sum(CASE WHEN d_day_name = 'Friday' THEN ss_sales_price ELSE NULL END) fri_sales,
+         sum(CASE WHEN d_day_name = 'Saturday' THEN ss_sales_price ELSE NULL END) sat_sales
+  FROM store_sales, date_dim
+  WHERE d_date_sk = ss_sold_date_sk
+  GROUP BY d_week_seq, ss_store_sk)
+SELECT s_store_name1, s_store_id1, d_week_seq1,
+       CAST(sun_sales1 AS double) / sun_sales2,
+       CAST(mon_sales1 AS double) / mon_sales2,
+       CAST(tue_sales1 AS double) / tue_sales2,
+       CAST(wed_sales1 AS double) / wed_sales2,
+       CAST(thu_sales1 AS double) / thu_sales2,
+       CAST(fri_sales1 AS double) / fri_sales2,
+       CAST(sat_sales1 AS double) / sat_sales2
+FROM (SELECT s_store_name s_store_name1, wss.d_week_seq d_week_seq1,
+             s_store_id s_store_id1, sun_sales sun_sales1,
+             mon_sales mon_sales1, tue_sales tue_sales1,
+             wed_sales wed_sales1, thu_sales thu_sales1,
+             fri_sales fri_sales1, sat_sales sat_sales1
+      FROM wss, store, date_dim d
+      WHERE d.d_week_seq = wss.d_week_seq AND ss_store_sk = s_store_sk
+        AND d_month_seq BETWEEN 1212 AND 1223) y,
+     (SELECT s_store_name s_store_name2, wss.d_week_seq d_week_seq2,
+             s_store_id s_store_id2, sun_sales sun_sales2,
+             mon_sales mon_sales2, tue_sales tue_sales2,
+             wed_sales wed_sales2, thu_sales thu_sales2,
+             fri_sales fri_sales2, sat_sales sat_sales2
+      FROM wss, store, date_dim d
+      WHERE d.d_week_seq = wss.d_week_seq AND ss_store_sk = s_store_sk
+        AND d_month_seq BETWEEN 1224 AND 1235) x
+WHERE s_store_id1 = s_store_id2 AND d_week_seq1 = d_week_seq2 - 52
+ORDER BY s_store_name1, s_store_id1, d_week_seq1
+""",
+    # q51: web-vs-store cumulative sales race -- FULL OUTER JOIN of two
+    # windowed (sum over sum()) series, running max over ROWS frames.
+    # ORDER BY (item_sk, d_date) is unique, so the LIMIT is kept and
+    # compared as an exact top-k prefix.
+    "q51": """
+WITH web_v1 AS (
+  SELECT ws_item_sk item_sk, d_date,
+         sum(sum(ws_sales_price)) OVER (PARTITION BY ws_item_sk
+           ORDER BY d_date ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW)
+           cume_sales
+  FROM web_sales, date_dim
+  WHERE ws_sold_date_sk = d_date_sk AND d_month_seq BETWEEN 1200 AND 1211
+    AND ws_item_sk IS NOT NULL
+  GROUP BY ws_item_sk, d_date),
+store_v1 AS (
+  SELECT ss_item_sk item_sk, d_date,
+         sum(sum(ss_sales_price)) OVER (PARTITION BY ss_item_sk
+           ORDER BY d_date ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW)
+           cume_sales
+  FROM store_sales, date_dim
+  WHERE ss_sold_date_sk = d_date_sk AND d_month_seq BETWEEN 1200 AND 1211
+    AND ss_item_sk IS NOT NULL
+  GROUP BY ss_item_sk, d_date)
+SELECT *
+FROM (SELECT item_sk, d_date, web_sales, store_sales,
+             max(web_sales) OVER (PARTITION BY item_sk ORDER BY d_date
+               ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW)
+               web_cumulative,
+             max(store_sales) OVER (PARTITION BY item_sk ORDER BY d_date
+               ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW)
+               store_cumulative
+      FROM (SELECT CASE WHEN web.item_sk IS NOT NULL THEN web.item_sk
+                        ELSE store.item_sk END item_sk,
+                   CASE WHEN web.d_date IS NOT NULL THEN web.d_date
+                        ELSE store.d_date END d_date,
+                   web.cume_sales web_sales, store.cume_sales store_sales
+            FROM web_v1 web FULL JOIN store_v1 store
+              ON web.item_sk = store.item_sk AND web.d_date = store.d_date
+           ) x
+     ) y
+WHERE web_cumulative > store_cumulative
+ORDER BY item_sk, d_date
+LIMIT 100
+""",
 }
 
 

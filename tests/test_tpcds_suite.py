@@ -54,6 +54,7 @@ FAST_CASES = [
 ]
 
 SLOW_CASES = [
+    ("q1", 0.02, {"max_groups": 1 << 15}),
     ("q4", 0.05, {"max_groups": 1 << 15}),
     ("q6", 0.02, {"min_rows": 0}),
     ("q11", 0.02, {"max_groups": 1 << 17, "keep_limit": True}),
@@ -64,14 +65,17 @@ SLOW_CASES = [
     ("q25", 0.05, {"min_rows": 0}),
     ("q28", 0.02, {}),
     ("q29", 0.05, {"min_rows": 0}),
+    ("q30", 0.02, {"max_groups": 1 << 15}),
     ("q33", 0.02, {"min_rows": 0}),
     ("q34", 0.1, {}),
     ("q36", 0.02, {}),
     ("q46", 0.02, {"keep_limit": True}),
     ("q47", 0.05, {"max_groups": 1 << 15, "min_rows": 0}),
     ("q50", 0.05, {"min_rows": 0}),
+    ("q51", 0.01, {"max_groups": 1 << 16, "keep_limit": True}),
     ("q53", 0.05, {"min_rows": 0}),
     ("q56", 0.05, {"min_rows": 0}),
+    ("q59", 0.01, {"max_groups": 1 << 17, "join_capacity": 1 << 22}),
     ("q57", 0.05, {"max_groups": 1 << 15, "min_rows": 0}),
     ("q61", 0.05, {"min_rows": 0}),
     ("q63", 0.05, {"min_rows": 0}),
@@ -79,6 +83,7 @@ SLOW_CASES = [
     ("q68", 0.01, {}),
     ("q69", 0.05, {"min_rows": 0}),
     ("q74", 0.05, {"max_groups": 1 << 15, "keep_limit": True}),
+    ("q81", 0.05, {"max_groups": 1 << 15}),
     ("q83", 0.2, {"min_rows": 0}),
     ("q87", 0.02, {"max_groups": 1 << 17}),
     ("q88", 0.05, {}),
