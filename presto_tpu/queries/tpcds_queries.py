@@ -1762,6 +1762,355 @@ WHERE web_cumulative > store_cumulative
 ORDER BY item_sk, d_date
 LIMIT 100
 """,
+    # q31: county quarter-over-quarter growth, web vs store -- two CTEs
+    # each referenced three times, joined six ways (year adapted to a
+    # non-vacuous region of the generated data)
+    "q31": """
+WITH ss AS (
+  SELECT ca_county, d_qoy, d_year, sum(ss_ext_sales_price) store_sales
+  FROM store_sales, date_dim, customer_address
+  WHERE ss_sold_date_sk = d_date_sk AND ss_addr_sk = ca_address_sk
+  GROUP BY ca_county, d_qoy, d_year),
+ws AS (
+  SELECT ca_county, d_qoy, d_year, sum(ws_ext_sales_price) web_sales
+  FROM web_sales, date_dim, customer_address
+  WHERE ws_sold_date_sk = d_date_sk AND ws_bill_addr_sk = ca_address_sk
+  GROUP BY ca_county, d_qoy, d_year)
+SELECT ss1.ca_county, ss1.d_year,
+       CAST(ws2.web_sales AS double) / ws1.web_sales web_q1_q2_increase,
+       CAST(ss2.store_sales AS double) / ss1.store_sales store_q1_q2_increase,
+       CAST(ws3.web_sales AS double) / ws2.web_sales web_q2_q3_increase,
+       CAST(ss3.store_sales AS double) / ss2.store_sales store_q2_q3_increase
+FROM ss ss1, ss ss2, ss ss3, ws ws1, ws ws2, ws ws3
+WHERE ss1.d_qoy = 1 AND ss1.d_year = 2001
+  AND ss1.ca_county = ss2.ca_county AND ss2.d_qoy = 2 AND ss2.d_year = 2001
+  AND ss2.ca_county = ss3.ca_county AND ss3.d_qoy = 3 AND ss3.d_year = 2001
+  AND ss1.ca_county = ws1.ca_county AND ws1.d_qoy = 1 AND ws1.d_year = 2001
+  AND ws1.ca_county = ws2.ca_county AND ws2.d_qoy = 2 AND ws2.d_year = 2001
+  AND ws1.ca_county = ws3.ca_county AND ws3.d_qoy = 3 AND ws3.d_year = 2001
+  AND CASE WHEN ws1.web_sales > 0.00
+           THEN CAST(ws2.web_sales AS double) / ws1.web_sales
+           ELSE NULL END
+    > CASE WHEN ss1.store_sales > 0.00
+           THEN CAST(ss2.store_sales AS double) / ss1.store_sales
+           ELSE NULL END
+  AND CASE WHEN ws2.web_sales > 0.00
+           THEN CAST(ws3.web_sales AS double) / ws2.web_sales
+           ELSE NULL END
+    > CASE WHEN ss2.store_sales > 0.00
+           THEN CAST(ss3.store_sales AS double) / ss2.store_sales
+           ELSE NULL END
+ORDER BY ss1.ca_county
+""",
+    # q41: items whose manufacturer carries attribute-combo products
+    # (correlated count(*) scalar subquery; the correlation equality is
+    # factored out of the spec's OR -- algebraically identical -- and
+    # attribute combos are drawn from the generator's co-occurring
+    # domains so the case is non-vacuous)
+    "q41": """
+SELECT DISTINCT i_product_name
+FROM item i1
+WHERE i_manufact_id BETWEEN 1 AND 1000
+  AND (SELECT count(*) item_cnt FROM item
+       WHERE i_manufact = i1.i_manufact
+         AND ((i_category = 'Men'
+               AND (i_color = 'cyan' OR i_color = 'dim')
+               AND (i_units = 'Unknown' OR i_units = 'N/A')
+               AND (i_size = 'medium' OR i_size = 'economy'))
+           OR (i_category = 'Men'
+               AND (i_color = 'firebrick' OR i_color = 'rose')
+               AND (i_units = 'Each' OR i_units = 'Ton')
+               AND (i_size = 'extra large' OR i_size = 'N/A'))
+           OR (i_category = 'Men'
+               AND (i_color = 'forest' OR i_color = 'metallic')
+               AND (i_units = 'Gross' OR i_units = 'Oz')
+               AND (i_size = 'N/A' OR i_size = 'small'))
+           OR (i_category = 'Men'
+               AND (i_color = 'navajo' OR i_color = 'thistle')
+               AND (i_units = 'Tbl' OR i_units = 'Ton')
+               AND (i_size = 'medium' OR i_size = 'large')))) > 0
+ORDER BY i_product_name
+""",
+    # q44: best/worst performing items by store-4 average net profit
+    # (rank windows over a HAVING gated by an uncorrelated scalar
+    # subquery; the spec's null-addr baseline group is empty in this
+    # generator, so the baseline is the plain store-wide average)
+    "q44": """
+SELECT asceding.rnk, i1.i_product_name best_performing,
+       i2.i_product_name worst_performing
+FROM (SELECT * FROM (SELECT item_sk, rank() OVER (ORDER BY rank_col) rnk
+                     FROM (SELECT ss_item_sk item_sk,
+                                  avg(ss_net_profit) rank_col
+                           FROM store_sales ss1 WHERE ss_store_sk = 4
+                           GROUP BY ss_item_sk
+                           HAVING avg(ss_net_profit) >
+                             (SELECT avg(ss_net_profit) * 0.9
+                              FROM store_sales
+                              WHERE ss_store_sk = 4)) v1) v11
+      WHERE rnk < 11) asceding,
+     (SELECT * FROM (SELECT item_sk, rank() OVER (ORDER BY rank_col DESC) rnk
+                     FROM (SELECT ss_item_sk item_sk,
+                                  avg(ss_net_profit) rank_col
+                           FROM store_sales ss1 WHERE ss_store_sk = 4
+                           GROUP BY ss_item_sk
+                           HAVING avg(ss_net_profit) >
+                             (SELECT avg(ss_net_profit) * 0.9
+                              FROM store_sales
+                              WHERE ss_store_sk = 4)) v2) v21
+      WHERE rnk < 11) descending,
+     item i1, item i2
+WHERE asceding.rnk = descending.rnk
+  AND i1.i_item_sk = asceding.item_sk
+  AND i2.i_item_sk = descending.item_sk
+ORDER BY asceding.rnk
+""",
+    # q45: web sales by zip/city where zip in a list OR item in a
+    # subquery list -- an IN subquery in DISJUNCTIVE position (planned
+    # as a semijoin mask column; zips from the generator domain)
+    "q45": """
+SELECT ca_zip, ca_city, sum(ws_sales_price) s
+FROM web_sales, customer, customer_address, date_dim, item
+WHERE ws_bill_customer_sk = c_customer_sk
+  AND c_current_addr_sk = ca_address_sk
+  AND ws_item_sk = i_item_sk
+  AND (substr(ca_zip, 1, 5) IN ('99019', '22939', '83468', '99551',
+                                '60099', '47792', '43391', '98407',
+                                '53519')
+       OR i_item_id IN (SELECT i_item_id FROM item
+                        WHERE i_item_sk IN (2, 3, 5, 7, 11, 13, 17, 19,
+                                            23, 29)))
+  AND ws_sold_date_sk = d_date_sk
+  AND d_qoy = 2 AND d_year = 2001
+GROUP BY ca_zip, ca_city
+ORDER BY ca_zip, ca_city
+""",
+    # q10: demographics of store customers also active on web OR
+    # catalog -- correlated EXISTS under OR (semijoin mask columns;
+    # counties from the generator domain)
+    "q10": """
+SELECT cd_gender, cd_marital_status, cd_education_status, count(*) cnt1,
+       cd_purchase_estimate, count(*) cnt2, cd_credit_rating, count(*) cnt3,
+       cd_dep_count, count(*) cnt4, cd_dep_employed_count, count(*) cnt5,
+       cd_dep_college_count, count(*) cnt6
+FROM customer c, customer_address ca, customer_demographics
+WHERE c.c_current_addr_sk = ca.ca_address_sk
+  AND ca_county IN ('Ziebach County', 'Daviess County', 'Barrow County',
+                    'Walker County', 'Fairfield County')
+  AND cd_demo_sk = c.c_current_cdemo_sk
+  AND EXISTS (SELECT * FROM store_sales, date_dim
+              WHERE c.c_customer_sk = ss_customer_sk
+                AND ss_sold_date_sk = d_date_sk
+                AND d_year = 2002 AND d_moy BETWEEN 1 AND 4)
+  AND (EXISTS (SELECT * FROM web_sales, date_dim
+               WHERE c.c_customer_sk = ws_bill_customer_sk
+                 AND ws_sold_date_sk = d_date_sk
+                 AND d_year = 2002 AND d_moy BETWEEN 1 AND 4)
+       OR EXISTS (SELECT * FROM catalog_sales, date_dim
+                  WHERE c.c_customer_sk = cs_ship_customer_sk
+                    AND cs_sold_date_sk = d_date_sk
+                    AND d_year = 2002 AND d_moy BETWEEN 1 AND 4))
+GROUP BY cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+ORDER BY cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+""",
+    # q35: q10's shape with min/max/avg dependent-count profiles
+    "q35": """
+SELECT ca_state, cd_gender, cd_marital_status, cd_dep_count, count(*) cnt1,
+       min(cd_dep_count) mn1, max(cd_dep_count) mx1, avg(cd_dep_count) av1,
+       cd_dep_employed_count, count(*) cnt2, min(cd_dep_employed_count) mn2,
+       max(cd_dep_employed_count) mx2, avg(cd_dep_employed_count) av2,
+       cd_dep_college_count, count(*) cnt3, min(cd_dep_college_count) mn3,
+       max(cd_dep_college_count) mx3, avg(cd_dep_college_count) av3
+FROM customer c, customer_address ca, customer_demographics
+WHERE c.c_current_addr_sk = ca.ca_address_sk
+  AND cd_demo_sk = c.c_current_cdemo_sk
+  AND EXISTS (SELECT * FROM store_sales, date_dim
+              WHERE c.c_customer_sk = ss_customer_sk
+                AND ss_sold_date_sk = d_date_sk
+                AND d_year = 2002 AND d_qoy < 4)
+  AND (EXISTS (SELECT * FROM web_sales, date_dim
+               WHERE c.c_customer_sk = ws_bill_customer_sk
+                 AND ws_sold_date_sk = d_date_sk
+                 AND d_year = 2002 AND d_qoy < 4)
+       OR EXISTS (SELECT * FROM catalog_sales, date_dim
+                  WHERE c.c_customer_sk = cs_ship_customer_sk
+                    AND cs_sold_date_sk = d_date_sk
+                    AND d_year = 2002 AND d_qoy < 4))
+GROUP BY ca_state, cd_gender, cd_marital_status, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+ORDER BY ca_state, cd_gender, cd_marital_status, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+""",
+    # q67: store sales rollup over 8 keys, top-100 rank per category
+    # (ROLLUP inside a derived table under a rank window; the sqlite
+    # oracle stacks 9 UNION ALL levels -- see TPCDS_ORACLE)
+    "q67": """
+SELECT i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+       d_moy, s_store_id, sumsales, rk
+FROM (SELECT i_category, i_class, i_brand, i_product_name, d_year,
+             d_qoy, d_moy, s_store_id, sumsales,
+             rank() OVER (PARTITION BY i_category
+                          ORDER BY sumsales DESC) rk
+      FROM (SELECT i_category, i_class, i_brand, i_product_name, d_year,
+                   d_qoy, d_moy, s_store_id,
+                   sum(coalesce(ss_sales_price * ss_quantity, 0.00)) sumsales
+            FROM store_sales, date_dim, store, item
+            WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+              AND ss_store_sk = s_store_sk
+              AND d_month_seq BETWEEN 1200 AND 1211
+            GROUP BY ROLLUP (i_category, i_class, i_brand, i_product_name,
+                             d_year, d_qoy, d_moy, s_store_id)) dw1) dw2
+WHERE rk <= 100
+ORDER BY i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+         d_moy, s_store_id, sumsales, rk
+""",
+    # q70: state/county profit hierarchy -- ROLLUP + grouping() inside
+    # the rank partition + a windowed IN subquery choosing top-5 states.
+    # ORDER BY follows the q86 adaptation (plain keys for the spec's
+    # CASE key; deterministic full ordering)
+    "q70": """
+SELECT sum(ss_net_profit) total_sum, s_state, s_county,
+       grouping(s_state) + grouping(s_county) lochierarchy,
+       rank() OVER (PARTITION BY grouping(s_state) + grouping(s_county),
+                    CASE WHEN grouping(s_county) = 0 THEN s_state END
+                    ORDER BY sum(ss_net_profit) DESC) rank_within_parent
+FROM store_sales, date_dim d1, store
+WHERE d1.d_month_seq BETWEEN 1200 AND 1211
+  AND d1.d_date_sk = ss_sold_date_sk AND s_store_sk = ss_store_sk
+  AND s_state IN (SELECT s_state
+                  FROM (SELECT s_state s_state,
+                               rank() OVER (PARTITION BY s_state
+                                 ORDER BY sum(ss_net_profit) DESC) ranking
+                        FROM store_sales, store, date_dim
+                        WHERE d_month_seq BETWEEN 1200 AND 1211
+                          AND d_date_sk = ss_sold_date_sk
+                          AND s_store_sk = ss_store_sk
+                        GROUP BY s_state) tmp1
+                  WHERE ranking <= 5)
+GROUP BY ROLLUP (s_state, s_county)
+ORDER BY lochierarchy DESC, rank_within_parent, s_state, s_county
+""",
+    # q17: items returned in-quarter then re-bought by catalog --
+    # ss->sr (ticket) ->cs (customer+item) chain with quantity
+    # count/avg/stddev/cov profiles (sqlite has no stddev_samp; the
+    # oracle computes sqrt((sumsq - sum^2/n)/(n-1)) -- see TPCDS_ORACLE)
+    "q17": """
+SELECT i_item_id, i_item_desc, s_state,
+       count(ss_quantity) store_sales_quantitycount,
+       avg(ss_quantity) store_sales_quantityave,
+       stddev_samp(ss_quantity) store_sales_quantitystdev,
+       stddev_samp(ss_quantity) / avg(ss_quantity) store_sales_quantitycov,
+       count(sr_return_quantity) store_returns_quantitycount,
+       avg(sr_return_quantity) store_returns_quantityave,
+       stddev_samp(sr_return_quantity) store_returns_quantitystdev,
+       stddev_samp(sr_return_quantity) / avg(sr_return_quantity)
+         store_returns_quantitycov,
+       count(cs_quantity) catalog_sales_quantitycount,
+       avg(cs_quantity) catalog_sales_quantityave,
+       stddev_samp(cs_quantity) catalog_sales_quantitystdev,
+       stddev_samp(cs_quantity) / avg(cs_quantity) catalog_sales_quantitycov
+FROM store_sales, store_returns, catalog_sales, date_dim d1, date_dim d2,
+     date_dim d3, store, item
+WHERE d1.d_quarter_name = '2001Q1' AND d1.d_date_sk = ss_sold_date_sk
+  AND i_item_sk = ss_item_sk AND s_store_sk = ss_store_sk
+  AND ss_customer_sk = sr_customer_sk AND ss_item_sk = sr_item_sk
+  AND ss_ticket_number = sr_ticket_number
+  AND sr_returned_date_sk = d2.d_date_sk
+  AND d2.d_quarter_name IN ('2001Q1', '2001Q2', '2001Q3')
+  AND sr_customer_sk = cs_bill_customer_sk AND sr_item_sk = cs_item_sk
+  AND cs_sold_date_sk = d3.d_date_sk
+  AND d3.d_quarter_name IN ('2001Q1', '2001Q2', '2001Q3')
+GROUP BY i_item_id, i_item_desc, s_state
+ORDER BY i_item_id, i_item_desc, s_state
+""",
+    # q9: quantity-band discount/net-paid buckets -- ten UNCORRELATED
+    # scalar subqueries in SELECT CASE position (planned as broadcast
+    # single-row value channels); thresholds scaled to the suite's
+    # sf=0.05 volume (~28.6k rows per 20-quantity band) so the CASE
+    # branches split both ways
+    "q9": """
+SELECT CASE WHEN (SELECT count(*) FROM store_sales
+                  WHERE ss_quantity BETWEEN 1 AND 20) > 25000
+            THEN (SELECT avg(ss_ext_discount_amt) FROM store_sales
+                  WHERE ss_quantity BETWEEN 1 AND 20)
+            ELSE (SELECT avg(ss_net_paid) FROM store_sales
+                  WHERE ss_quantity BETWEEN 1 AND 20) END bucket1,
+       CASE WHEN (SELECT count(*) FROM store_sales
+                  WHERE ss_quantity BETWEEN 21 AND 40) > 1000000000
+            THEN (SELECT avg(ss_ext_discount_amt) FROM store_sales
+                  WHERE ss_quantity BETWEEN 21 AND 40)
+            ELSE (SELECT avg(ss_net_paid) FROM store_sales
+                  WHERE ss_quantity BETWEEN 21 AND 40) END bucket2,
+       CASE WHEN (SELECT count(*) FROM store_sales
+                  WHERE ss_quantity BETWEEN 41 AND 60) > 15000
+            THEN (SELECT avg(ss_ext_discount_amt) FROM store_sales
+                  WHERE ss_quantity BETWEEN 41 AND 60)
+            ELSE (SELECT avg(ss_net_paid) FROM store_sales
+                  WHERE ss_quantity BETWEEN 41 AND 60) END bucket3,
+       CASE WHEN (SELECT count(*) FROM store_sales
+                  WHERE ss_quantity BETWEEN 61 AND 80) > 1000000000
+            THEN (SELECT avg(ss_ext_discount_amt) FROM store_sales
+                  WHERE ss_quantity BETWEEN 61 AND 80)
+            ELSE (SELECT avg(ss_net_paid) FROM store_sales
+                  WHERE ss_quantity BETWEEN 61 AND 80) END bucket4,
+       CASE WHEN (SELECT count(*) FROM store_sales
+                  WHERE ss_quantity BETWEEN 81 AND 100) > 15000
+            THEN (SELECT avg(ss_ext_discount_amt) FROM store_sales
+                  WHERE ss_quantity BETWEEN 81 AND 100)
+            ELSE (SELECT avg(ss_net_paid) FROM store_sales
+                  WHERE ss_quantity BETWEEN 81 AND 100) END bucket5
+FROM reason WHERE r_reason_sk = 1
+""",
+    # q2: web+catalog weekly day-of-week sales, year-over-year ratio
+    # (UNION ALL CTE feeding a pivot CTE referenced twice; the spec's
+    # d_week_seq1 = d_week_seq2 - 53 offset equality is computed inside
+    # the second derived table so it joins as a plain equi-key)
+    "q2": """
+WITH wscs AS (
+  SELECT sold_date_sk, sales_price
+  FROM (SELECT ws_sold_date_sk sold_date_sk, ws_ext_sales_price sales_price
+        FROM web_sales
+        UNION ALL
+        SELECT cs_sold_date_sk sold_date_sk, cs_ext_sales_price sales_price
+        FROM catalog_sales) x),
+wswscs AS (
+  SELECT d_week_seq,
+         sum(CASE WHEN d_day_name = 'Sunday' THEN sales_price ELSE NULL END) sun_sales,
+         sum(CASE WHEN d_day_name = 'Monday' THEN sales_price ELSE NULL END) mon_sales,
+         sum(CASE WHEN d_day_name = 'Tuesday' THEN sales_price ELSE NULL END) tue_sales,
+         sum(CASE WHEN d_day_name = 'Wednesday' THEN sales_price ELSE NULL END) wed_sales,
+         sum(CASE WHEN d_day_name = 'Thursday' THEN sales_price ELSE NULL END) thu_sales,
+         sum(CASE WHEN d_day_name = 'Friday' THEN sales_price ELSE NULL END) fri_sales,
+         sum(CASE WHEN d_day_name = 'Saturday' THEN sales_price ELSE NULL END) sat_sales
+  FROM wscs, date_dim
+  WHERE d_date_sk = sold_date_sk
+  GROUP BY d_week_seq)
+SELECT d_week_seq1,
+       CAST(sun_sales1 AS double) / sun_sales2 r1,
+       CAST(mon_sales1 AS double) / mon_sales2 r2,
+       CAST(tue_sales1 AS double) / tue_sales2 r3,
+       CAST(wed_sales1 AS double) / wed_sales2 r4,
+       CAST(thu_sales1 AS double) / thu_sales2 r5,
+       CAST(fri_sales1 AS double) / fri_sales2 r6,
+       CAST(sat_sales1 AS double) / sat_sales2 r7
+FROM (SELECT wswscs.d_week_seq d_week_seq1, sun_sales sun_sales1,
+             mon_sales mon_sales1, tue_sales tue_sales1,
+             wed_sales wed_sales1, thu_sales thu_sales1,
+             fri_sales fri_sales1, sat_sales sat_sales1
+      FROM wswscs, date_dim
+      WHERE date_dim.d_week_seq = wswscs.d_week_seq AND d_year = 2001) y,
+     (SELECT wswscs.d_week_seq - 53 d_week_seq2_m53, sun_sales sun_sales2,
+             mon_sales mon_sales2, tue_sales tue_sales2,
+             wed_sales wed_sales2, thu_sales thu_sales2,
+             fri_sales fri_sales2, sat_sales sat_sales2
+      FROM wswscs, date_dim
+      WHERE date_dim.d_week_seq = wswscs.d_week_seq AND d_year = 2002) z
+WHERE d_week_seq1 = d_week_seq2_m53
+ORDER BY d_week_seq1
+""",
 }
 
 
@@ -1921,7 +2270,83 @@ def _q47_oracle(name: str) -> str:
         "CAST(avg_monthly_sales AS REAL)")
 
 
+_Q67_KEYS = ["i_category", "i_class", "i_brand", "i_product_name",
+             "d_year", "d_qoy", "d_moy", "s_store_id"]
+_Q67_FROM = """
+FROM store_sales, date_dim, store, item
+WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+  AND ss_store_sk = s_store_sk AND d_month_seq BETWEEN 1200 AND 1211
+"""
+_Q67_ORACLE = ("""
+SELECT i_category, i_class, i_brand, i_product_name, d_year, d_qoy,
+       d_moy, s_store_id, sumsales, rk
+FROM (SELECT i_category, i_class, i_brand, i_product_name, d_year,
+             d_qoy, d_moy, s_store_id, sumsales,
+             rank() OVER (PARTITION BY i_category
+                          ORDER BY sumsales DESC) rk
+      FROM (""" + _rollup_oracle(
+    _Q67_KEYS,
+    "sum(coalesce(ss_sales_price * ss_quantity, 0.00)) sumsales",
+    _Q67_FROM, _Q67_KEYS, "") + """) dw1) dw2
+WHERE rk <= 100
+""")
+
+_Q70_ORACLE = """
+WITH base AS (
+  SELECT s_state, s_county, ss_net_profit
+  FROM store_sales, date_dim d1, store
+  WHERE d1.d_month_seq BETWEEN 1200 AND 1211
+    AND d1.d_date_sk = ss_sold_date_sk AND s_store_sk = ss_store_sk
+    AND s_state IN (SELECT s_state
+                    FROM (SELECT s_state s_state,
+                                 rank() OVER (PARTITION BY s_state
+                                   ORDER BY sum(ss_net_profit) DESC) ranking
+                          FROM store_sales, store, date_dim
+                          WHERE d_month_seq BETWEEN 1200 AND 1211
+                            AND d_date_sk = ss_sold_date_sk
+                            AND s_store_sk = ss_store_sk
+                          GROUP BY s_state) tmp1
+                    WHERE ranking <= 5)),
+rolled AS (
+  SELECT sum(ss_net_profit) total_sum, s_state, s_county, 0 lochierarchy
+  FROM base GROUP BY s_state, s_county
+  UNION ALL
+  SELECT sum(ss_net_profit), s_state, NULL, 1 FROM base GROUP BY s_state
+  UNION ALL
+  SELECT sum(ss_net_profit), NULL, NULL, 2 FROM base)
+SELECT total_sum, s_state, s_county, lochierarchy,
+       rank() OVER (PARTITION BY lochierarchy,
+                    CASE WHEN lochierarchy = 0 THEN s_state END
+                    ORDER BY total_sum DESC) rank_within_parent
+FROM rolled
+"""
+
+_Q44_ORACLE = TPCDS_QUERIES["q44"].replace(
+    "avg(ss_net_profit) rank_col",
+    "avg(CAST(ss_net_profit AS REAL)) rank_col")
+
+
+
+def _sqlite_stddev(col: str) -> str:
+    """stddev_samp emulation for sqlite (no stddev builtin)."""
+    n = f"CAST(count({col}) AS REAL)"
+    return (f"CASE WHEN count({col}) > 1 THEN "
+            f"sqrt(max(0.0, (sum(1.0*{col}*{col}) - "
+            f"sum(1.0*{col})*sum(1.0*{col})/{n}) / (count({col}) - 1))) "
+            f"ELSE NULL END")
+
+
+def _q17_oracle() -> str:
+    text = TPCDS_QUERIES["q17"]
+    for c in ("ss_quantity", "sr_return_quantity", "cs_quantity"):
+        text = text.replace(f"stddev_samp({c})", _sqlite_stddev(c))
+    return text
+
 TPCDS_ORACLE = {
+    "q17": _q17_oracle(),
+    "q67": _Q67_ORACLE,
+    "q70": _Q70_ORACLE,
+    "q44": _Q44_ORACLE,
     "q47": _q47_oracle("q47"),
     "q57": _q47_oracle("q57"),
     "q36": _Q36_ORACLE,

@@ -58,10 +58,17 @@ class _Analyzer:
         self.catalog = sf_catalog
         # id(WindowExpr) -> (channel, type) once a window stage planned
         self.window_channels: Dict[int, Tuple[int, T.Type]] = {}
+        # id(InSubquery/Exists) -> mask expression, for subqueries in
+        # DISJUNCTIVE predicate positions (planned as semijoin mask
+        # columns before the enclosing predicate lowers)
+        self.subquery_masks: Dict[int, E.RowExpression] = {}
 
     # -- expression lowering ------------------------------------------------
 
     def lower(self, node, scope: _Scope) -> E.RowExpression:
+        if not isinstance(node, (str, int, float)) and \
+                id(node) in self.subquery_masks:
+            return self.subquery_masks[id(node)]
         if isinstance(node, P.WindowExpr):
             hit = self.window_channels.get(id(node))
             if hit is None:
@@ -277,6 +284,8 @@ class _Analyzer:
                         if dataclasses.is_dataclass(o.expr):
                             walk(o.expr)
                 return
+            if isinstance(n, (P.InSubquery, P.Exists, P.ScalarSubquery)):
+                return  # subqueries aggregate in their own scope
             if isinstance(n, P.Func) and n.name in _AGG_NAMES:
                 out.append(n)
                 return  # no nested aggs
@@ -888,9 +897,20 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
             return isinstance(c, P.Exists) or \
                 (isinstance(c, P.NotOp) and isinstance(c.arg, P.Exists))
 
+        def is_disjunctive_sub(c):
+            """Subqueries in non-conjunct positions (under OR/CASE/...):
+            the q45 `zip IN (...) OR id IN (subquery)` / q10
+            `EXISTS(...) OR EXISTS(...)` family."""
+            if isinstance(c, P.InSubquery) or has_scalar_sub(c) or \
+                    is_exists(c):
+                return False
+            subs: list = []
+            _embedded_subqueries(c, subs)
+            return bool(subs)
+
         for c in [c for c in conjs
                   if not isinstance(c, P.InSubquery) and not has_scalar_sub(c)
-                  and not is_exists(c)]:
+                  and not is_exists(c) and not is_disjunctive_sub(c)]:
             node = N.FilterNode(node, an.lower(c, scope))
         for c in [c for c in conjs if is_exists(c)]:
             negate = isinstance(c, P.NotOp)
@@ -933,6 +953,80 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
                 f = N.FilterNode(sj, pred)
                 node = N.ProjectNode(f, [
                     E.input_ref(i, scope.types[i]) for i in range(nch)])
+        for c in [c for c in conjs if is_disjunctive_sub(c)]:
+            # subqueries under OR/CASE: plan each as a semijoin MASK
+            # column, register the mask against the AST node, lower the
+            # whole predicate (masks substitute in), then drop the masks
+            # (the reference routes these through ApplyNode ->
+            # TransformCorrelatedInPredicateToJoin and keeps the
+            # 'subquery as boolean expression' semantics; same here)
+            subs: list = []
+            _embedded_subqueries(c, subs)
+            base_types = node.output_types()
+            base_nch = len(base_types)
+            cur = base_nch
+            for s in subs:
+                if isinstance(s, P.InSubquery):
+                    sub_node, _ = _plan_any(s.query, max_groups,
+                                            join_capacity)
+                    sub_node = _strip_output(sub_node)
+                    assert len(sub_node.output_types()) == 1, \
+                        "IN subquery must produce one column"
+                    v = an.lower(s.value, scope)
+                    assert isinstance(v, E.InputReference), \
+                        "IN subquery value must be a column"
+                    node = N.SemiJoinNode(node, sub_node, v.channel, 0)
+                    mask = E.input_ref(cur, T.BOOLEAN)
+                    an.subquery_masks[id(s)] = \
+                        E.call("not", T.BOOLEAN, mask) if s.negate else mask
+                elif isinstance(s, P.Exists):
+                    sub_q3 = s.query
+                    assert isinstance(sub_q3, P.Query), \
+                        "EXISTS over set operations: later"
+                    if sub_q3.group_by or sub_q3.having is not None:
+                        raise NotImplementedError(
+                            "EXISTS over GROUP BY in disjunction")
+                    corr3, residual3 = _split_correlations(
+                        sub_q3, tables, table_schemas)
+                    if not corr3:
+                        raise NotImplementedError(
+                            "uncorrelated EXISTS in disjunction")
+                    inner_aliases3 = {(t.alias or t.name).lower()
+                                      for t in [sub_q3.table]
+                                      + [j.table for j in sub_q3.joins]}
+                    if any(_has_outer_name(r, tables, table_schemas,
+                                           inner_aliases3, sub_q3)
+                           for r in residual3):
+                        raise NotImplementedError(
+                            "correlated residual predicates under EXISTS "
+                            "in disjunction")
+                    sub_ast3 = dataclasses.replace(
+                        sub_q3,
+                        select=P.Select([P.SelectItem(inner, None)
+                                         for _, inner in corr3], False),
+                        where=_and_all(residual3),
+                        order_by=[], limit=None)
+                    sub_node, _ = _plan_any(sub_ast3, max_groups,
+                                            join_capacity)
+                    sub_node = _strip_output(sub_node)
+                    outer_chs = [an.lower(nm, scope).channel
+                                 for nm, _ in corr3]
+                    node = N.SemiJoinNode(node, sub_node, outer_chs,
+                                          list(range(len(corr3))))
+                    mask = E.input_ref(cur, T.BOOLEAN)
+                    # EXISTS is two-valued: a NULL mask (null outer key)
+                    # means no match -> FALSE
+                    an.subquery_masks[id(s)] = E.special(
+                        "COALESCE", T.BOOLEAN, mask,
+                        E.const(False, T.BOOLEAN))
+                else:
+                    raise NotImplementedError(
+                        "scalar subquery in disjunctive position")
+                cur += 1
+            pred = an.lower(c, scope)
+            node = N.ProjectNode(
+                N.FilterNode(node, pred),
+                [E.input_ref(i, base_types[i]) for i in range(base_nch)])
 
     # window expressions (possibly nested inside select items or ORDER
     # BY, over base rows OR over aggregation output)
@@ -990,6 +1084,28 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
             node = _attach_scalar_filter(node, lhs, op, sub, max_groups,
                                          join_capacity)
     else:
+        # SELECT-position uncorrelated scalar subqueries (the q9 CASE-
+        # bucket shape): attach each as a broadcast single-row value
+        # channel, registered so an.lower substitutes the channel ref
+        sel_subs: list = []
+        for item in q.select.items:
+            _embedded_subqueries(item.expr, sel_subs)
+        for s in sel_subs:
+            if id(s) in an.subquery_masks:
+                continue
+            if not isinstance(s, P.ScalarSubquery):
+                raise NotImplementedError(
+                    "IN/EXISTS subqueries in SELECT position")
+            if isinstance(s.query, P.Query):
+                corr_s, _ = _split_correlations(s.query, tables,
+                                                table_schemas)
+                if corr_s:
+                    raise NotImplementedError(
+                        "correlated scalar subquery in SELECT position")
+            cur_w = len(node.output_types())
+            node, vty = _attach_scalar_value(node, s, max_groups,
+                                             join_capacity)
+            an.subquery_masks[id(s)] = E.input_ref(cur_w, vty)
         out_exprs = []
         names = []
         for i, item in enumerate(q.select.items):
@@ -1360,6 +1476,74 @@ def _has_outer_name(conj, outer_tables, outer_schemas, inner_aliases,
     return bool(found)
 
 
+def _embedded_subqueries(c, out):
+    """Subquery nodes nested anywhere under `c` (descent stops at each:
+    a subquery's own subqueries belong to its scope)."""
+    if isinstance(c, (P.InSubquery, P.Exists, P.ScalarSubquery)):
+        out.append(c)
+        return
+    if dataclasses.is_dataclass(c):
+        for f in dataclasses.fields(c):
+            v = getattr(c, f.name)
+            for x in (v if isinstance(v, (list, tuple)) else [v]):
+                if isinstance(x, tuple):
+                    for y in x:
+                        if dataclasses.is_dataclass(y):
+                            _embedded_subqueries(y, out)
+                elif dataclasses.is_dataclass(x):
+                    _embedded_subqueries(x, out)
+
+
+def _broadcast_scalar(node: N.PlanNode, sub: "P.ScalarSubquery",
+                      max_groups: int, join_capacity: Optional[int]):
+    """Shared EnforceSingleRow + cross-join shape for scalar subqueries
+    in expression position: collapse the subresult to (value, count)
+    through a 1-group aggregation and broadcast-join it on a constant
+    key. Returns (joined, value_ref, count_ref, outer_types)."""
+    sub_node, _ = _plan_any(sub.query, max_groups, join_capacity)
+    sub_node = _strip_output(sub_node)
+    subt = sub_node.output_types()
+    assert len(subt) == 1, "scalar subquery must produce one column"
+    sub_one = N.AggregationNode(
+        sub_node, [],
+        [AggSpec("min", 0, subt[0]),
+         AggSpec("count_star", None, T.BIGINT)],
+        step="SINGLE", max_groups=1)
+    ntypes = node.output_types()
+    nch = len(ntypes)
+    left = N.ProjectNode(node, [
+        E.input_ref(i, ntypes[i]) for i in range(nch)
+    ] + [E.const(1, T.BIGINT)])
+    right = N.ProjectNode(sub_one, [E.const(1, T.BIGINT),
+                                    E.input_ref(0, subt[0]),
+                                    E.input_ref(1, T.BIGINT)])
+    joined = N.JoinNode(left, right, [nch], [0], "inner", "broadcast",
+                        right_output_channels=[1, 2],
+                        out_capacity=join_capacity)
+    return (joined, E.input_ref(nch + 1, subt[0]),
+            E.input_ref(nch + 2, T.BIGINT), ntypes)
+
+
+def _attach_scalar_value(node: N.PlanNode, sub: "P.ScalarSubquery",
+                         max_groups: int, join_capacity: Optional[int]):
+    """Append an UNCORRELATED scalar subquery's value as one new channel
+    (scalar subqueries in SELECT/expression position). An empty
+    subresult yields NULL per spec; a multi-row subresult also yields
+    NULL (the reference raises SCALAR_SUBQUERY_MULTIPLE_ROWS -- routing
+    that through the jit-safe error channel is a ROADMAP item). Returns
+    (new_node, value_type); the value channel is the last output."""
+    joined, value_ref, count_ref, ntypes = _broadcast_scalar(
+        node, sub, max_groups, join_capacity)
+    nch = len(ntypes)
+    guarded = E.special(
+        "IF", value_ref.type,
+        E.call("eq", T.BOOLEAN, count_ref, E.const(1, T.BIGINT)),
+        value_ref, E.const(None, value_ref.type))
+    out = N.ProjectNode(joined, [
+        E.input_ref(i, ntypes[i]) for i in range(nch)] + [guarded])
+    return out, value_ref.type
+
+
 def _decorrelate_exists(an, node, scope, outer_tables, outer_schemas,
                         sub_q, negate, max_groups, join_capacity):
     """EXISTS/NOT EXISTS with equality correlations -> semi/anti join;
@@ -1478,28 +1662,9 @@ def _attach_scalar_filter(node: N.PlanNode, lhs: E.RowExpression, op: str,
     lands with task-level error channels), broadcast-joined on a
     constant key, compared, and the original channel layout projected
     back."""
-    sub_node, _ = _plan_any(sub.query, max_groups, join_capacity)
-    sub_node = _strip_output(sub_node)
-    subt = sub_node.output_types()
-    assert len(subt) == 1, "scalar subquery must produce one column"
-    sub_one = N.AggregationNode(
-        sub_node, [],
-        [AggSpec("min", 0, subt[0]),
-         AggSpec("count_star", None, T.BIGINT)],
-        step="SINGLE", max_groups=1)
-    ntypes = node.output_types()
+    joined, scalar_ref, count_ref, ntypes = _broadcast_scalar(
+        node, sub, max_groups, join_capacity)
     nch = len(ntypes)
-    left = N.ProjectNode(node, [
-        E.input_ref(i, ntypes[i]) for i in range(nch)
-    ] + [E.const(1, T.BIGINT)])
-    right = N.ProjectNode(sub_one, [E.const(1, T.BIGINT),
-                                    E.input_ref(0, subt[0]),
-                                    E.input_ref(1, T.BIGINT)])
-    joined = N.JoinNode(left, right, [nch], [0], "inner", "broadcast",
-                        right_output_channels=[1, 2],
-                        out_capacity=join_capacity)
-    scalar_ref = E.input_ref(nch + 1, subt[0])
-    count_ref = E.input_ref(nch + 2, T.BIGINT)
     f = N.FilterNode(joined, E.special(
         "AND", T.BOOLEAN,
         E.call("le", T.BOOLEAN, count_ref, E.const(1, T.BIGINT)),

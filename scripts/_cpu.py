@@ -5,6 +5,10 @@ when the relay is down any backend init hangs in a retry sleep."""
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# the persistent-cache AOT loader logs a benign ERROR about the
+# prefer-no-scatter/gather tuning pseudo-features on every load; keep
+# the test tier readable (override via TF_CPP_MIN_LOG_LEVEL)
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 os.environ.setdefault("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
     os.environ["XLA_FLAGS"] = (os.environ["XLA_FLAGS"]

@@ -106,3 +106,24 @@ def test_in_subquery_with_aggregation_outer():
     li = tpch.generate_columns("lineitem", 0.01, ["orderkey"])
     want = int(np.isin(li["orderkey"], list(keys)).sum())
     assert r.rows()[0][0] == want
+
+
+def test_select_position_scalar_subquery_value_and_guards():
+    # uncorrelated scalar subqueries in SELECT position (q9's shape):
+    # single-row -> value; empty -> NULL; multi-row -> NULL (the
+    # reference errors; jit-safe error channels are a ROADMAP item)
+    r = sql("""
+      SELECT n.name,
+             (SELECT max(r.name) FROM region r WHERE r.regionkey = 0) x,
+             (SELECT r.name FROM region r WHERE r.regionkey = 99) empty
+      FROM nation n WHERE n.nationkey < 3 ORDER BY n.name
+    """, sf=0.01, max_groups=8)
+    rows = r.rows()
+    assert len(rows) == 3
+    assert all(x[1] == "AFRICA" for x in rows)
+    assert all(x[2] is None for x in rows)
+    multi = sql("""
+      SELECT n.name, (SELECT r.name FROM region r) several
+      FROM nation n WHERE n.nationkey < 2 ORDER BY n.name
+    """, sf=0.01, max_groups=8)
+    assert all(x[1] is None for x in multi.rows())

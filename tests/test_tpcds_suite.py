@@ -55,10 +55,22 @@ FAST_CASES = [
 
 SLOW_CASES = [
     ("q1", 0.02, {"max_groups": 1 << 15}),
+    ("q2", 0.02, {"max_groups": 1 << 17, "join_capacity": 1 << 21}),
+    ("q9", 0.05, {"max_groups": 1 << 15}),
+    ("q10", 0.05, {"max_groups": 1 << 17}),
+    ("q31", 0.05, {"max_groups": 1 << 16}),
+    ("q35", 0.05, {"max_groups": 1 << 17}),
+    ("q41", 0.1, {"max_groups": 1 << 15}),
+    ("q44", 0.02, {"max_groups": 1 << 16}),
+    ("q45", 0.05, {"max_groups": 1 << 16}),
+    ("q67", 0.01, {"max_groups": 1 << 17}),
+    ("q70", 0.02, {"max_groups": 1 << 16}),
+
     ("q4", 0.05, {"max_groups": 1 << 15}),
     ("q6", 0.02, {"min_rows": 0}),
     ("q11", 0.02, {"max_groups": 1 << 17, "keep_limit": True}),
     ("q12", 0.05, {"min_rows": 0}),
+    ("q17", 0.05, {"max_groups": 1 << 16}),
     ("q18", 0.05, {}),
     ("q20", 0.02, {}),
     ("q22", 0.02, {}),
