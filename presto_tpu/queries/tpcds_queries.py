@@ -2111,6 +2111,179 @@ FROM (SELECT wswscs.d_week_seq d_week_seq1, sun_sales sun_sales1,
 WHERE d_week_seq1 = d_week_seq2_m53
 ORDER BY d_week_seq1
 """,
+    # q16: catalog orders shipped from multiple warehouses with no
+    # returns -- conjunct EXISTS with a correlated INEQUALITY residual
+    # (general unique-id decorrelation route) + NOT EXISTS anti join +
+    # count(DISTINCT); 60-day window folded into the end date literal
+    "q16": """
+SELECT count(DISTINCT cs_order_number) order_count,
+       sum(cs_ext_ship_cost) total_shipping_cost,
+       sum(cs_net_profit) total_net_profit
+FROM catalog_sales cs1, date_dim, customer_address, call_center
+WHERE d_date BETWEEN date '2002-02-01' AND date '2002-04-02'
+  AND cs1.cs_ship_date_sk = d_date_sk
+  AND cs1.cs_ship_addr_sk = ca_address_sk
+  AND ca_state = 'GA'
+  AND cs1.cs_call_center_sk = cc_call_center_sk
+  AND cc_county IN ('Bronx County', 'Walker County', 'Franklin Parish')
+  AND EXISTS (SELECT * FROM catalog_sales cs2
+              WHERE cs1.cs_order_number = cs2.cs_order_number
+                AND cs1.cs_warehouse_sk <> cs2.cs_warehouse_sk)
+  AND NOT EXISTS (SELECT * FROM catalog_returns cr1
+                  WHERE cs1.cs_order_number = cr1.cr_order_number)
+ORDER BY count(DISTINCT cs_order_number)
+""",
+    # q94: q16's shape over web sales
+    "q94": """
+SELECT count(DISTINCT ws_order_number) order_count,
+       sum(ws_ext_ship_cost) total_shipping_cost,
+       sum(ws_net_profit) total_net_profit
+FROM web_sales ws1, date_dim, customer_address, web_site
+WHERE d_date BETWEEN date '1999-02-01' AND date '1999-04-02'
+  AND ws1.ws_ship_date_sk = d_date_sk
+  AND ws1.ws_ship_addr_sk = ca_address_sk
+  AND ca_state = 'IL'
+  AND ws1.ws_web_site_sk = web_site_sk
+  AND web_company_name = 'pri'
+  AND EXISTS (SELECT * FROM web_sales ws2
+              WHERE ws1.ws_order_number = ws2.ws_order_number
+                AND ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+  AND NOT EXISTS (SELECT * FROM web_returns wr1
+                  WHERE ws1.ws_order_number = wr1.wr_order_number)
+ORDER BY count(DISTINCT ws_order_number)
+""",
+    # q95: q94 through a self-join CTE (ws_wh referenced by two IN
+    # subqueries, one joined against returns)
+    "q95": """
+WITH ws_wh AS (
+  SELECT ws1.ws_order_number, ws1.ws_warehouse_sk wh1,
+         ws2.ws_warehouse_sk wh2
+  FROM web_sales ws1, web_sales ws2
+  WHERE ws1.ws_order_number = ws2.ws_order_number
+    AND ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+SELECT count(DISTINCT ws_order_number) order_count,
+       sum(ws_ext_ship_cost) total_shipping_cost,
+       sum(ws_net_profit) total_net_profit
+FROM web_sales ws1, date_dim, customer_address, web_site
+WHERE d_date BETWEEN date '1999-02-01' AND date '1999-04-02'
+  AND ws1.ws_ship_date_sk = d_date_sk
+  AND ws1.ws_ship_addr_sk = ca_address_sk
+  AND ca_state = 'IL'
+  AND ws1.ws_web_site_sk = web_site_sk
+  AND web_company_name = 'pri'
+  AND ws1.ws_order_number IN (SELECT ws_order_number FROM ws_wh)
+  AND ws1.ws_order_number IN (SELECT wr_order_number
+                              FROM web_returns, ws_wh
+                              WHERE wr_order_number = ws_wh.ws_order_number)
+ORDER BY count(DISTINCT ws_order_number)
+""",
+    # q85: web-return reason profiles under OR-of-AND demographic and
+    # geographic blocks (bands widened to the generated domains -- the
+    # spec's narrow bands + double demographic match are vacuous at
+    # test scale; money comparisons use explicit money literals)
+    "q85": """
+SELECT substr(r_reason_desc, 1, 20) r, avg(ws_quantity) q,
+       avg(wr_refunded_cash) c, avg(wr_fee) f
+FROM web_sales, web_returns, web_page, customer_demographics cd1,
+     customer_demographics cd2, customer_address, date_dim, reason
+WHERE ws_web_page_sk = wp_web_page_sk AND ws_item_sk = wr_item_sk
+  AND ws_order_number = wr_order_number AND ws_sold_date_sk = d_date_sk
+  AND d_year = 2000
+  AND cd1.cd_demo_sk = wr_refunded_cdemo_sk
+  AND cd2.cd_demo_sk = wr_returning_cdemo_sk
+  AND ca_address_sk = wr_refunded_addr_sk
+  AND r_reason_sk = wr_reason_sk
+  AND ((cd1.cd_marital_status = 'M'
+        AND cd1.cd_marital_status = cd2.cd_marital_status
+        AND ws_sales_price BETWEEN 50.00 AND 200.00)
+    OR (cd1.cd_marital_status = 'S'
+        AND cd1.cd_marital_status = cd2.cd_marital_status
+        AND ws_sales_price BETWEEN 0.00 AND 100.00)
+    OR (cd1.cd_marital_status = 'W'
+        AND cd1.cd_marital_status = cd2.cd_marital_status
+        AND ws_sales_price BETWEEN 100.00 AND 300.00))
+  AND ((ca_country = 'United States' AND ca_state IN ('IL', 'OH', 'NY')
+        AND ws_net_profit BETWEEN -10000.00 AND 10000.00)
+    OR (ca_country = 'United States' AND ca_state IN ('WA', 'CA', 'TX')
+        AND ws_net_profit BETWEEN -5000.00 AND 10000.00)
+    OR (ca_country = 'United States' AND ca_state IN ('TN', 'GA', 'IL')
+        AND ws_net_profit BETWEEN 0.00 AND 10000.00))
+GROUP BY r_reason_desc
+ORDER BY substr(r_reason_desc, 1, 20), avg(ws_quantity),
+         avg(wr_refunded_cash), avg(wr_fee)
+""",
+    # q49: worst return ratios per channel (LEFT JOIN made effective-
+    # inner by the return-amount filter, per spec; dual rank windows;
+    # UNION distinct across channels; comma date_dim join rewritten as
+    # an explicit JOIN -- the engine rejects comma+outer mixes)
+    "q49": """
+SELECT 'w' channel, w_t.item, w_t.return_ratio,
+       w_t.return_rank, w_t.currency_rank
+FROM (SELECT item, return_ratio, currency_ratio,
+             rank() OVER (ORDER BY return_ratio) return_rank,
+             rank() OVER (ORDER BY currency_ratio) currency_rank
+      FROM (SELECT web_sales.ws_item_sk item,
+                   CAST(sum(coalesce(web_returns.wr_return_quantity, 0)) AS double) /
+                     sum(coalesce(web_sales.ws_quantity, 0)) return_ratio,
+                   CAST(sum(coalesce(web_returns.wr_return_amt, 0.00)) AS double) /
+                     sum(coalesce(web_sales.ws_net_paid, 0.00)) currency_ratio
+            FROM web_sales LEFT JOIN web_returns
+              ON web_sales.ws_order_number = web_returns.wr_order_number
+             AND web_sales.ws_item_sk = web_returns.wr_item_sk
+            JOIN date_dim ON web_sales.ws_sold_date_sk = d_date_sk
+            WHERE web_returns.wr_return_amt > 100.00
+              AND web_sales.ws_net_profit > 1.00
+              AND web_sales.ws_net_paid > 0.00
+              AND web_sales.ws_quantity > 0
+              AND d_year = 2001 AND d_moy = 12
+            GROUP BY web_sales.ws_item_sk) in_w) w_t
+WHERE w_t.return_rank <= 10 OR w_t.currency_rank <= 10
+UNION
+SELECT 'c' channel, c_t.item, c_t.return_ratio,
+       c_t.return_rank, c_t.currency_rank
+FROM (SELECT item, return_ratio, currency_ratio,
+             rank() OVER (ORDER BY return_ratio) return_rank,
+             rank() OVER (ORDER BY currency_ratio) currency_rank
+      FROM (SELECT catalog_sales.cs_item_sk item,
+                   CAST(sum(coalesce(catalog_returns.cr_return_quantity, 0)) AS double) /
+                     sum(coalesce(catalog_sales.cs_quantity, 0)) return_ratio,
+                   CAST(sum(coalesce(catalog_returns.cr_return_amount, 0.00)) AS double) /
+                     sum(coalesce(catalog_sales.cs_net_paid, 0.00)) currency_ratio
+            FROM catalog_sales LEFT JOIN catalog_returns
+              ON catalog_sales.cs_order_number = catalog_returns.cr_order_number
+             AND catalog_sales.cs_item_sk = catalog_returns.cr_item_sk
+            JOIN date_dim ON catalog_sales.cs_sold_date_sk = d_date_sk
+            WHERE catalog_returns.cr_return_amount > 100.00
+              AND catalog_sales.cs_net_profit > 1.00
+              AND catalog_sales.cs_net_paid > 0.00
+              AND catalog_sales.cs_quantity > 0
+              AND d_year = 2001 AND d_moy = 12
+            GROUP BY catalog_sales.cs_item_sk) in_c) c_t
+WHERE c_t.return_rank <= 10 OR c_t.currency_rank <= 10
+UNION
+SELECT 's' channel, s_t.item, s_t.return_ratio,
+       s_t.return_rank, s_t.currency_rank
+FROM (SELECT item, return_ratio, currency_ratio,
+             rank() OVER (ORDER BY return_ratio) return_rank,
+             rank() OVER (ORDER BY currency_ratio) currency_rank
+      FROM (SELECT store_sales.ss_item_sk item,
+                   CAST(sum(coalesce(store_returns.sr_return_quantity, 0)) AS double) /
+                     sum(coalesce(store_sales.ss_quantity, 0)) return_ratio,
+                   CAST(sum(coalesce(store_returns.sr_return_amt, 0.00)) AS double) /
+                     sum(coalesce(store_sales.ss_net_paid, 0.00)) currency_ratio
+            FROM store_sales LEFT JOIN store_returns
+              ON store_sales.ss_ticket_number = store_returns.sr_ticket_number
+             AND store_sales.ss_item_sk = store_returns.sr_item_sk
+            JOIN date_dim ON store_sales.ss_sold_date_sk = d_date_sk
+            WHERE store_returns.sr_return_amt > 100.00
+              AND store_sales.ss_net_profit > 1.00
+              AND store_sales.ss_net_paid > 0.00
+              AND store_sales.ss_quantity > 0
+              AND d_year = 2001 AND d_moy = 12
+            GROUP BY store_sales.ss_item_sk) in_s) s_t
+WHERE s_t.return_rank <= 10 OR s_t.currency_rank <= 10
+ORDER BY 1, 4, 5, 2
+""",
 }
 
 

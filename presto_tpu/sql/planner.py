@@ -1313,18 +1313,32 @@ _CMP_NAMES = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt",
 
 
 def _note_correlated(sub_q, note_name):
-    """Record the CORRELATED outer columns of a subquery (inner names
-    raise KeyError against the outer schemas and are skipped)."""
+    """Record the CORRELATED outer columns of a subquery: every name
+    under its WHERE that does not bind to an inner table (covers
+    residual predicates like q16's `cs1.cs_warehouse_sk <>
+    cs2.cs_warehouse_sk`, not just `=` correlations). Names that raise
+    KeyError against the outer schemas are inner-only and skipped."""
     if not isinstance(sub_q, P.Query) or sub_q.where is None:
         return
-    for conj in _conjuncts(sub_q.where):
-        if isinstance(conj, P.BinOp) and conj.op == "=":
-            for side in (conj.left, conj.right):
-                if isinstance(side, P.Name):
-                    try:
-                        note_name(side.parts)
-                    except KeyError:
-                        pass
+
+    def walk(n):
+        if isinstance(n, P.Name):
+            if len(n.parts) == 1 and _inner_binds(sub_q, n.parts[0].lower()):
+                return  # innermost scope wins for unqualified names
+            try:
+                note_name(n.parts)
+            except KeyError:
+                pass
+            return
+        if isinstance(n, P.InSubquery):
+            walk(n.value)  # the IN's left operand is THIS scope's
+            return  # (the subquery body collects on its own pass)
+        if isinstance(n, (P.Exists, P.ScalarSubquery)):
+            return  # deeper scopes collect on their own pass
+        for x in _child_nodes(n):
+            walk(x)
+
+    walk(sub_q.where)
 
 
 def _inner_binds(sub_q, col: str) -> bool:
@@ -1476,22 +1490,31 @@ def _has_outer_name(conj, outer_tables, outer_schemas, inner_aliases,
     return bool(found)
 
 
+def _child_nodes(c):
+    """Every dataclass child of an AST node, including those inside
+    list/tuple fields and (cond, result) pair tuples -- the ONE shared
+    iteration body for this module's recursive AST walkers."""
+    if not dataclasses.is_dataclass(c):
+        return
+    for f in dataclasses.fields(c):
+        v = getattr(c, f.name)
+        for x in (v if isinstance(v, (list, tuple)) else [v]):
+            if isinstance(x, tuple):
+                for y in x:
+                    if dataclasses.is_dataclass(y):
+                        yield y
+            elif dataclasses.is_dataclass(x):
+                yield x
+
+
 def _embedded_subqueries(c, out):
     """Subquery nodes nested anywhere under `c` (descent stops at each:
     a subquery's own subqueries belong to its scope)."""
     if isinstance(c, (P.InSubquery, P.Exists, P.ScalarSubquery)):
         out.append(c)
         return
-    if dataclasses.is_dataclass(c):
-        for f in dataclasses.fields(c):
-            v = getattr(c, f.name)
-            for x in (v if isinstance(v, (list, tuple)) else [v]):
-                if isinstance(x, tuple):
-                    for y in x:
-                        if dataclasses.is_dataclass(y):
-                            _embedded_subqueries(y, out)
-                elif dataclasses.is_dataclass(x):
-                    _embedded_subqueries(x, out)
+    for x in _child_nodes(c):
+        _embedded_subqueries(x, out)
 
 
 def _broadcast_scalar(node: N.PlanNode, sub: "P.ScalarSubquery",

@@ -70,6 +70,7 @@ SLOW_CASES = [
     ("q6", 0.02, {"min_rows": 0}),
     ("q11", 0.02, {"max_groups": 1 << 17, "keep_limit": True}),
     ("q12", 0.05, {"min_rows": 0}),
+    ("q16", 0.05, {"max_groups": 1 << 15, "join_capacity": 1 << 21}),
     ("q17", 0.05, {"max_groups": 1 << 16}),
     ("q18", 0.05, {}),
     ("q20", 0.02, {}),
@@ -83,6 +84,7 @@ SLOW_CASES = [
     ("q36", 0.02, {}),
     ("q46", 0.02, {"keep_limit": True}),
     ("q47", 0.05, {"max_groups": 1 << 15, "min_rows": 0}),
+    ("q49", 0.05, {"max_groups": 1 << 16, "join_capacity": 1 << 21}),
     ("q50", 0.05, {"min_rows": 0}),
     ("q51", 0.01, {"max_groups": 1 << 16, "keep_limit": True}),
     ("q53", 0.05, {"min_rows": 0}),
@@ -97,12 +99,15 @@ SLOW_CASES = [
     ("q74", 0.05, {"max_groups": 1 << 15, "keep_limit": True}),
     ("q81", 0.05, {"max_groups": 1 << 15}),
     ("q83", 0.2, {"min_rows": 0}),
+    ("q85", 0.05, {"max_groups": 1 << 15, "join_capacity": 1 << 21}),
     ("q87", 0.02, {"max_groups": 1 << 17}),
     ("q88", 0.05, {}),
     ("q89", 0.02, {"min_rows": 0}),
     ("q90", 0.05, {}),
     ("q91", 0.2, {}),
     ("q92", 0.02, {"min_rows": 0}),
+    ("q94", 0.05, {"max_groups": 1 << 15, "join_capacity": 1 << 21}),
+    ("q95", 0.05, {"max_groups": 1 << 15, "join_capacity": 1 << 22}),
 ]
 
 
