@@ -1085,6 +1085,16 @@ def _cast(ret, a):
                             jnp.ones(n, dtype=bool) | a.nulls, ret)
     if ft.is_decimal and ret.is_floating:
         return _col(ret, a.values.astype(ret.to_dtype()) / _POW10[ft.scale], a)
+    if (ft.is_decimal or ft.is_integral) and _is_long_decimal(ret):
+        # widen onto int128 lanes, then rescale exactly
+        src_scale = ft.scale if ft.is_decimal else 0
+        hi, lo = I128.from_int64(a.values.astype(jnp.int64))
+        if ret.scale > src_scale:
+            hi, lo = I128.rescale128_up(hi, lo,
+                                        10 ** (ret.scale - src_scale))
+        elif ret.scale < src_scale:
+            raise NotImplementedError("long-decimal downscale cast")
+        return Int128Column(hi, lo, a.nulls, ret)
     if ft.is_decimal and ret.is_decimal:
         return _col(ret, rescale_decimal(a.values, ft.scale, ret.scale), a)
     if ft.is_decimal and ret.is_integral:

@@ -110,16 +110,17 @@ class _Analyzer:
             for c, r in node.whens:
                 whens.append((self.lower(c, scope), self.lower(r, scope)))
             default = self.lower(node.default, scope) if node.default else None
-            rty = whens[0][1].type if whens else (default.type if default else T.UNKNOWN)
+            rty = _case_result_type([r for _, r in whens]
+                                    + ([default] if default else []))
             args: List[E.RowExpression] = []
             if node.operand is not None:
                 args.append(self.lower(node.operand, scope))
             else:
                 args.append(E.const(True, T.BOOLEAN))
             for c, r in whens:
-                args.append(E.special("WHEN", rty, c, r))
+                args.append(E.special("WHEN", rty, c, _cast_branch(r, rty)))
             if default is not None:
-                args.append(default)
+                args.append(_cast_branch(default, rty))
             return E.special("SWITCH", rty, *args)
         if isinstance(node, P.Cast):
             v = self.lower(node.value, scope)
@@ -207,16 +208,20 @@ class _Analyzer:
         name = node.name
         args = [self.lower(a, scope) for a in node.args
                 if not isinstance(a, P.Star)]
-        # special forms spelled as functions
+        # special forms spelled as functions (branch types align to the
+        # common type, same as CASE -- see _case_result_type)
         if name == "coalesce":
-            rty = next((a.type for a in args if a.type != T.UNKNOWN),
-                       T.UNKNOWN)
-            return E.special("COALESCE", rty, *args)
+            rty = _case_result_type(args)
+            return E.special("COALESCE", rty,
+                             *[_cast_branch(a, rty) for a in args])
         if name == "nullif":
-            return E.special("NULL_IF", args[0].type, *args)
+            rty = _case_result_type(args[:1])
+            return E.special("NULL_IF", rty, *args)
         if name == "if":
-            rty = args[1].type
-            return E.special("IF", rty, *args)
+            rty = _case_result_type(args[1:])
+            return E.special("IF", rty,
+                             args[0], *[_cast_branch(a, rty)
+                                        for a in args[1:]])
         if name == "try":
             if len(args) != 1:
                 raise ValueError("TRY requires exactly one argument")
@@ -1507,6 +1512,42 @@ def _child_nodes(c):
                 yield x
 
 
+def _case_result_type(branches) -> T.Type:
+    """Common result type across conditional branches (SWITCH/IF/
+    COALESCE/NULL_IF -- the coercion the reference's TypeCoercer
+    applies): the WIDEST numeric type wins so no branch is narrowed
+    (mixed float+fixed -> DOUBLE; any decimal -> decimal at the widest
+    precision class and scale; mixed integrals -> BIGINT). Typed-NULL
+    branches don't vote."""
+    types = [b.type for b in branches
+             if not (isinstance(b, E.Constant) and b.value is None)
+             and b.type != T.UNKNOWN]
+    if not types:
+        return branches[0].type if branches else T.UNKNOWN
+    if all(t == types[0] for t in types):
+        return types[0]
+    if any(t.is_floating for t in types):
+        return T.DOUBLE if any(t.is_numeric for t in types) else types[0]
+    if any(t.is_decimal for t in types):
+        scale = max(t.scale for t in types if t.is_decimal)
+        prec = max(t.precision for t in types if t.is_decimal)
+        return T.decimal(38 if prec > 18 else 18, scale)
+    if all(t.is_integral for t in types):
+        return T.BIGINT
+    return types[0]
+
+
+def _cast_branch(e: E.RowExpression, rty: T.Type) -> E.RowExpression:
+    """Align one CASE branch to the common type: typed NULLs re-type in
+    place, everything else casts through the kernel (a same-type cast
+    is the identity)."""
+    if e.type == rty:
+        return e
+    if isinstance(e, E.Constant) and e.value is None:
+        return E.const(None, rty)
+    return E.call("cast", rty, e)
+
+
 def _embedded_subqueries(c, out):
     """Subquery nodes nested anywhere under `c` (descent stops at each:
     a subquery's own subqueries belong to its scope)."""
@@ -1915,14 +1956,14 @@ def _plan_agg_outputs(an, q, pre_scope, agg_map, key_map,
                      for c, r in nde.whens]
             default = rewrite(nde.default, scope_keys) \
                 if nde.default is not None else None
-            rty = whens[0][1].type if whens else \
-                (default.type if default else T.UNKNOWN)
+            rty = _case_result_type([r for _, r in whens]
+                                    + ([default] if default else []))
             args = [rewrite(nde.operand, scope_keys)
                     if nde.operand is not None else E.const(True, T.BOOLEAN)]
             for c, r in whens:
-                args.append(E.special("WHEN", rty, c, r))
+                args.append(E.special("WHEN", rty, c, _cast_branch(r, rty)))
             if default is not None:
-                args.append(default)
+                args.append(_cast_branch(default, rty))
             return E.special("SWITCH", rty, *args)
         if isinstance(nde, P.IsNull):
             e = E.special("IS_NULL", T.BOOLEAN, rewrite(nde.value, scope_keys))

@@ -60,6 +60,7 @@ SLOW_CASES = [
     ("q10", 0.05, {"max_groups": 1 << 17}),
     ("q31", 0.05, {"max_groups": 1 << 16}),
     ("q35", 0.05, {"max_groups": 1 << 17}),
+    ("q39", 0.05, {"max_groups": 1 << 17, "join_capacity": 1 << 21}),
     ("q41", 0.1, {"max_groups": 1 << 15}),
     ("q44", 0.02, {"max_groups": 1 << 16}),
     ("q45", 0.05, {"max_groups": 1 << 16}),
@@ -94,9 +95,12 @@ SLOW_CASES = [
     ("q61", 0.05, {"min_rows": 0}),
     ("q63", 0.05, {"min_rows": 0}),
     ("q65", 0.02, {"max_groups": 1 << 17, "keep_limit": True}),
+    ("q66", 0.05, {"max_groups": 1 << 15, "join_capacity": 1 << 21}),
     ("q68", 0.01, {}),
     ("q69", 0.05, {"min_rows": 0}),
     ("q74", 0.05, {"max_groups": 1 << 15, "keep_limit": True}),
+    ("q75", 0.05, {"max_groups": 1 << 17, "join_capacity": 1 << 21}),
+    ("q78", 0.05, {"max_groups": 1 << 18, "join_capacity": 1 << 21}),
     ("q81", 0.05, {"max_groups": 1 << 15}),
     ("q83", 0.2, {"min_rows": 0}),
     ("q85", 0.05, {"max_groups": 1 << 15, "join_capacity": 1 << 21}),
