@@ -2423,6 +2423,214 @@ WHERE COALESCE(ws_qty, 0) > 0 AND COALESCE(cs_qty, 0) > 0
 ORDER BY ss_sold_year, ss_item_sk, ss_customer_sk, ss_qty DESC,
          ss_wc DESC, ss_sp DESC
 """,
+    # q5/q77/q80: per-channel sales/returns/profit summaries rolled up
+    # over (channel, id). The sqlite oracles stack the three rollup
+    # levels as UNION ALL (see TPCDS_ORACLE). q77's catalog side joins
+    # returns per call center (the spec's bare cross join of two
+    # grouped CTEs needs an equi key here); comma+outer join mixes are
+    # rewritten as explicit JOIN chains throughout.
+    "q5": """
+WITH ssr AS (
+  SELECT s_store_id, sum(sales_price) sales, sum(profit) profit,
+         sum(return_amt) returns, sum(net_loss) profit_loss
+  FROM (SELECT ss_store_sk store_sk, ss_sold_date_sk date_sk,
+               ss_ext_sales_price sales_price, ss_net_profit profit,
+               0.00 return_amt, 0.00 net_loss
+        FROM store_sales
+        UNION ALL
+        SELECT sr_store_sk store_sk, sr_returned_date_sk date_sk,
+               0.00 sales_price, 0.00 profit,
+               sr_return_amt return_amt, sr_net_loss net_loss
+        FROM store_returns) salesreturns, date_dim, store
+  WHERE date_sk = d_date_sk
+    AND d_date BETWEEN date '2000-08-23' AND date '2000-09-06'
+    AND store_sk = s_store_sk
+  GROUP BY s_store_id),
+csr AS (
+  SELECT cp_catalog_page_id, sum(sales_price) sales, sum(profit) profit,
+         sum(return_amt) returns, sum(net_loss) profit_loss
+  FROM (SELECT cs_catalog_page_sk page_sk, cs_sold_date_sk date_sk,
+               cs_ext_sales_price sales_price, cs_net_profit profit,
+               0.00 return_amt, 0.00 net_loss
+        FROM catalog_sales
+        UNION ALL
+        SELECT cr_catalog_page_sk page_sk, cr_returned_date_sk date_sk,
+               0.00 sales_price, 0.00 profit,
+               cr_return_amount return_amt, cr_net_loss net_loss
+        FROM catalog_returns) salesreturns, date_dim, catalog_page
+  WHERE date_sk = d_date_sk
+    AND d_date BETWEEN date '2000-08-23' AND date '2000-09-06'
+    AND page_sk = cp_catalog_page_sk
+  GROUP BY cp_catalog_page_id),
+wsr AS (
+  SELECT web_site_id, sum(sales_price) sales, sum(profit) profit,
+         sum(return_amt) returns, sum(net_loss) profit_loss
+  FROM (SELECT ws_web_site_sk wsr_web_site_sk, ws_sold_date_sk date_sk,
+               ws_ext_sales_price sales_price, ws_net_profit profit,
+               0.00 return_amt, 0.00 net_loss
+        FROM web_sales
+        UNION ALL
+        SELECT ws_web_site_sk wsr_web_site_sk,
+               wr_returned_date_sk date_sk,
+               0.00 sales_price, 0.00 profit,
+               wr_return_amt return_amt, wr_net_loss net_loss
+        FROM web_returns
+        LEFT JOIN web_sales ON wr_item_sk = ws_item_sk
+          AND wr_order_number = ws_order_number) salesreturns,
+       date_dim, web_site
+  WHERE date_sk = d_date_sk
+    AND d_date BETWEEN date '2000-08-23' AND date '2000-09-06'
+    AND wsr_web_site_sk = web_site_sk
+  GROUP BY web_site_id)
+
+SELECT channel, id, sum(sales) sales, sum(returns) returns,
+       sum(profit) profit
+FROM
+  (SELECT 'store channel' channel, concat('store', s_store_id) id,
+          sales, returns, profit - profit_loss profit
+   FROM ssr
+   UNION ALL
+   SELECT 'catalog channel' channel,
+          concat('catalog_page', cp_catalog_page_id) id,
+          sales, returns, profit - profit_loss profit
+   FROM csr
+   UNION ALL
+   SELECT 'web channel' channel, concat('web_site', web_site_id) id,
+          sales, returns, profit - profit_loss profit
+   FROM wsr) x
+
+GROUP BY ROLLUP (channel, id)
+ORDER BY channel, id
+""",
+    "q77": """
+WITH ss AS (
+  SELECT s_store_sk, sum(ss_ext_sales_price) sales,
+         sum(ss_net_profit) profit
+  FROM store_sales, date_dim, store
+  WHERE ss_sold_date_sk = d_date_sk
+    AND d_date BETWEEN date '2000-08-23' AND date '2000-09-22'
+    AND ss_store_sk = s_store_sk
+  GROUP BY s_store_sk),
+sr AS (
+  SELECT s_store_sk, sum(sr_return_amt) returns,
+         sum(sr_net_loss) profit_loss
+  FROM store_returns, date_dim, store
+  WHERE sr_returned_date_sk = d_date_sk
+    AND d_date BETWEEN date '2000-08-23' AND date '2000-09-22'
+    AND sr_store_sk = s_store_sk
+  GROUP BY s_store_sk),
+cs AS (
+  SELECT cs_call_center_sk, sum(cs_ext_sales_price) sales,
+         sum(cs_net_profit) profit
+  FROM catalog_sales, date_dim
+  WHERE cs_sold_date_sk = d_date_sk
+    AND d_date BETWEEN date '2000-08-23' AND date '2000-09-22'
+  GROUP BY cs_call_center_sk),
+cr AS (
+  SELECT cr_call_center_sk, sum(cr_return_amount) returns,
+         sum(cr_net_loss) profit_loss
+  FROM catalog_returns, date_dim
+  WHERE cr_returned_date_sk = d_date_sk
+    AND d_date BETWEEN date '2000-08-23' AND date '2000-09-22'
+  GROUP BY cr_call_center_sk),
+ws AS (
+  SELECT wp_web_page_sk, sum(ws_ext_sales_price) sales,
+         sum(ws_net_profit) profit
+  FROM web_sales, date_dim, web_page
+  WHERE ws_sold_date_sk = d_date_sk
+    AND d_date BETWEEN date '2000-08-23' AND date '2000-09-22'
+    AND ws_web_page_sk = wp_web_page_sk
+  GROUP BY wp_web_page_sk),
+wr AS (
+  SELECT wp_web_page_sk, sum(wr_return_amt) returns,
+         sum(wr_net_loss) profit_loss
+  FROM web_returns, date_dim, web_page
+  WHERE wr_returned_date_sk = d_date_sk
+    AND d_date BETWEEN date '2000-08-23' AND date '2000-09-22'
+    AND wr_web_page_sk = wp_web_page_sk
+  GROUP BY wp_web_page_sk)
+
+SELECT channel, id, sum(sales) sales, sum(returns) returns,
+       sum(profit) profit
+FROM
+  (SELECT 'store channel' channel, ss.s_store_sk id, sales,
+          COALESCE(returns, 0.00) returns,
+          profit - COALESCE(profit_loss, 0.00) profit
+   FROM ss LEFT JOIN sr ON ss.s_store_sk = sr.s_store_sk
+   UNION ALL
+   SELECT 'catalog channel' channel, cs_call_center_sk id, sales,
+          COALESCE(returns, 0.00) returns,
+          profit - COALESCE(profit_loss, 0.00) profit
+   FROM cs LEFT JOIN cr ON cs_call_center_sk = cr_call_center_sk
+   UNION ALL
+   SELECT 'web channel' channel, ws.wp_web_page_sk id, sales,
+          COALESCE(returns, 0.00) returns,
+          profit - COALESCE(profit_loss, 0.00) profit
+   FROM ws LEFT JOIN wr ON ws.wp_web_page_sk = wr.wp_web_page_sk) x
+
+GROUP BY ROLLUP (channel, id)
+ORDER BY channel, id, sales
+""",
+    "q80": """
+WITH ssr AS (
+  SELECT s_store_id store_id, sum(ss_ext_sales_price) sales,
+         sum(COALESCE(sr_return_amt, 0.00)) returns,
+         sum(ss_net_profit - COALESCE(sr_net_loss, 0.00)) profit
+  FROM store_sales
+  LEFT JOIN store_returns ON ss_item_sk = sr_item_sk
+    AND ss_ticket_number = sr_ticket_number
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+  JOIN store ON ss_store_sk = s_store_sk
+  JOIN item ON ss_item_sk = i_item_sk
+  JOIN promotion ON ss_promo_sk = p_promo_sk
+  WHERE d_date BETWEEN date '2000-08-23' AND date '2000-09-22'
+    AND i_current_price > 50.00 AND p_channel_tv = 'N'
+  GROUP BY s_store_id),
+csr AS (
+  SELECT cp_catalog_page_id catalog_page_id, sum(cs_ext_sales_price) sales,
+         sum(COALESCE(cr_return_amount, 0.00)) returns,
+         sum(cs_net_profit - COALESCE(cr_net_loss, 0.00)) profit
+  FROM catalog_sales
+  LEFT JOIN catalog_returns ON cs_item_sk = cr_item_sk
+    AND cs_order_number = cr_order_number
+  JOIN date_dim ON cs_sold_date_sk = d_date_sk
+  JOIN catalog_page ON cs_catalog_page_sk = cp_catalog_page_sk
+  JOIN item ON cs_item_sk = i_item_sk
+  JOIN promotion ON cs_promo_sk = p_promo_sk
+  WHERE d_date BETWEEN date '2000-08-23' AND date '2000-09-22'
+    AND i_current_price > 50.00 AND p_channel_tv = 'N'
+  GROUP BY cp_catalog_page_id),
+wsr AS (
+  SELECT web_site_id, sum(ws_ext_sales_price) sales,
+         sum(COALESCE(wr_return_amt, 0.00)) returns,
+         sum(ws_net_profit - COALESCE(wr_net_loss, 0.00)) profit
+  FROM web_sales
+  LEFT JOIN web_returns ON ws_item_sk = wr_item_sk
+    AND ws_order_number = wr_order_number
+  JOIN date_dim ON ws_sold_date_sk = d_date_sk
+  JOIN web_site ON ws_web_site_sk = web_site_sk
+  JOIN item ON ws_item_sk = i_item_sk
+  JOIN promotion ON ws_promo_sk = p_promo_sk
+  WHERE d_date BETWEEN date '2000-08-23' AND date '2000-09-22'
+    AND i_current_price > 50.00 AND p_channel_tv = 'N'
+  GROUP BY web_site_id)
+
+SELECT channel, id, sum(sales) sales, sum(returns) returns,
+       sum(profit) profit
+FROM
+  (SELECT 'store channel' channel, store_id id, sales, returns, profit
+   FROM ssr
+   UNION ALL
+   SELECT 'catalog channel' channel, catalog_page_id id, sales, returns,
+          profit
+   FROM csr
+   UNION ALL
+   SELECT 'web channel' channel, web_site_id id, sales, returns, profit
+   FROM wsr) x
+
+GROUP BY ROLLUP (channel, id)
+ORDER BY channel, id, sales
+""",
 }
 
 # q66: warehouse monthly pivot over web+catalog (36 pivot aggregates per
@@ -2721,8 +2929,26 @@ def _q39_oracle() -> str:
     return text
 
 
+def _channel_rollup_oracle(name: str) -> str:
+    """Derive the sqlite oracle for the q5/q77/q80 family from the
+    REGISTERED query text: the GROUP BY ROLLUP (channel, id) tail
+    becomes the three stacked UNION ALL levels, so oracle and engine
+    provably run the same CTEs."""
+    text = TPCDS_QUERIES[name]
+    head = text.rindex("\nSELECT channel, id,")
+    tail = text.index("GROUP BY ROLLUP", head)
+    prefix, selbase = text[:head], text[head:tail]
+    return (prefix + selbase + "GROUP BY channel, id\nUNION ALL"
+            + selbase.replace("channel, id,", "channel, NULL,", 1)
+            + "GROUP BY channel\nUNION ALL"
+            + selbase.replace("channel, id,", "NULL, NULL,", 1))
+
+
 TPCDS_ORACLE = {
     "q17": _q17_oracle(),
+    "q5": _channel_rollup_oracle("q5"),
+    "q77": _channel_rollup_oracle("q77"),
+    "q80": _channel_rollup_oracle("q80"),
     "q39": _q39_oracle(),
     "q66": TPCDS_QUERIES["q66"].replace(
         "AS double) / w_warehouse_sq_ft",

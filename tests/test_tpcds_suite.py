@@ -68,6 +68,7 @@ SLOW_CASES = [
     ("q70", 0.02, {"max_groups": 1 << 16}),
 
     ("q4", 0.05, {"max_groups": 1 << 15}),
+    ("q5", 0.05, {"max_groups": 1 << 16, "join_capacity": 1 << 21}),
     ("q6", 0.02, {"min_rows": 0}),
     ("q11", 0.02, {"max_groups": 1 << 17, "keep_limit": True}),
     ("q12", 0.05, {"min_rows": 0}),
@@ -100,7 +101,9 @@ SLOW_CASES = [
     ("q69", 0.05, {"min_rows": 0}),
     ("q74", 0.05, {"max_groups": 1 << 15, "keep_limit": True}),
     ("q75", 0.05, {"max_groups": 1 << 17, "join_capacity": 1 << 21}),
+    ("q77", 0.05, {"max_groups": 1 << 15, "join_capacity": 1 << 21}),
     ("q78", 0.05, {"max_groups": 1 << 18, "join_capacity": 1 << 21}),
+    ("q80", 0.05, {"max_groups": 1 << 16, "join_capacity": 1 << 21}),
     ("q81", 0.05, {"max_groups": 1 << 15}),
     ("q83", 0.2, {"min_rows": 0}),
     ("q85", 0.05, {"max_groups": 1 << 15, "join_capacity": 1 << 21}),
