@@ -1207,14 +1207,19 @@ def hash64_block(b: Block):
         return jnp.where(b.nulls, jnp.uint64(0x9E3779B97F4A7C15), h)
     if isinstance(b, StringColumn):
         h = jnp.zeros(b.chars.shape[0], dtype=jnp.uint64)
-        # mix 8 chars at a time as a little-endian word
+        # mix 8 chars at a time as a little-endian word. Only words that
+        # carry content (i*8 < length) participate, so the hash is
+        # WIDTH-INDEPENDENT: equal strings from columns of different
+        # declared varchar widths hash identically -- the contract
+        # distributed partitioned joins route by.
         w = b.chars.shape[1]
         padded = jnp.pad(b.chars, ((0, 0), (0, (-w) % 8)))
         words = padded.reshape(padded.shape[0], -1, 8).astype(jnp.uint64)
         shifts = (jnp.arange(8, dtype=jnp.uint64) * 8)[None, None, :]
         packed = jnp.sum(words << shifts, axis=2)
         for i in range(packed.shape[1]):
-            h = _mix64(h ^ packed[:, i])
+            live = (i * 8) < b.lengths
+            h = jnp.where(live, _mix64(h ^ packed[:, i]), h)
         h = _mix64(h ^ b.lengths.astype(jnp.uint64))
     else:
         v = b.values

@@ -56,6 +56,32 @@ jax.tree_util.register_dataclass(JoinResult,
                                  meta_fields=[])
 
 
+def _pad_chars(c: StringColumn, width: int) -> StringColumn:
+    if c.chars.shape[1] == width:
+        return c
+    return StringColumn(jnp.pad(c.chars,
+                                ((0, 0), (0, width - c.chars.shape[1]))),
+                        c.lengths, c.nulls, c.type)
+
+
+def _align_key_widths(p_keys: Sequence[Block], b_keys: Sequence[Block]):
+    """String key columns on the two sides may declare different widths
+    (ca_county vs s_county): their key words would then disagree in
+    COUNT and the multi-word lexicographic search compares misaligned
+    words. Pad the narrower side per column so both sides build
+    identical word layouts."""
+    out_p, out_b = [], []
+    for pc, bc in zip(p_keys, b_keys):
+        pd = pc.decode() if isinstance(pc, DictionaryColumn) else pc
+        bd = bc.decode() if isinstance(bc, DictionaryColumn) else bc
+        if isinstance(pd, StringColumn) and isinstance(bd, StringColumn):
+            w = max(pd.chars.shape[1], bd.chars.shape[1])
+            pd, bd = _pad_chars(pd, w), _pad_chars(bd, w)
+        out_p.append(pd)
+        out_b.append(bd)
+    return out_p, out_b
+
+
 def _combined_key(cols: Sequence[Block], active) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Reduce a key tuple to sortable words; returns (words stacked as a
     (k, n) list, usable_mask). Null keys never match in joins."""
@@ -152,6 +178,7 @@ def hash_join(probe: Batch, build: Batch,
 
     p_keys = [probe.column(c) for c in probe_key_channels]
     b_keys = [build.column(c) for c in build_key_channels]
+    p_keys, b_keys = _align_key_widths(p_keys, b_keys)
     p_words, p_usable = _combined_key(p_keys, probe.active)
     b_words, b_usable = _combined_key(b_keys, build.active)
 
@@ -261,6 +288,7 @@ def semi_join_mask(probe: Batch, build: Batch,
     mark-distinct membership semantics."""
     p_keys = [probe.column(c) for c in probe_key_channels]
     b_keys = [build.column(c) for c in build_key_channels]
+    p_keys, b_keys = _align_key_widths(p_keys, b_keys)
     if null_keys_match:
         # include the per-column null words as key material: NULL == NULL
         p_words, _ = key_words(p_keys)

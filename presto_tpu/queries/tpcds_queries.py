@@ -2631,6 +2631,132 @@ FROM
 GROUP BY ROLLUP (channel, id)
 ORDER BY channel, id, sales
 """,
+    # q58: items with balanced revenue across all three channels in one
+    # week (nested scalar subquery inside an IN subquery; bands widened
+    # to 0.2x..5x -- the spec's +-10% triple coincidence is vacuous at
+    # test scale)
+    "q58": """
+WITH ss_items AS (
+  SELECT i_item_id item_id, sum(ss_ext_sales_price) ss_item_rev
+  FROM store_sales, item, date_dim
+  WHERE ss_item_sk = i_item_sk
+    AND d_date IN (SELECT d_date FROM date_dim
+                   WHERE d_week_seq = (SELECT d_week_seq FROM date_dim
+                                       WHERE d_date = date '2000-01-03'))
+    AND ss_sold_date_sk = d_date_sk
+  GROUP BY i_item_id),
+cs_items AS (
+  SELECT i_item_id item_id, sum(cs_ext_sales_price) cs_item_rev
+  FROM catalog_sales, item, date_dim
+  WHERE cs_item_sk = i_item_sk
+    AND d_date IN (SELECT d_date FROM date_dim
+                   WHERE d_week_seq = (SELECT d_week_seq FROM date_dim
+                                       WHERE d_date = date '2000-01-03'))
+    AND cs_sold_date_sk = d_date_sk
+  GROUP BY i_item_id),
+ws_items AS (
+  SELECT i_item_id item_id, sum(ws_ext_sales_price) ws_item_rev
+  FROM web_sales, item, date_dim
+  WHERE ws_item_sk = i_item_sk
+    AND d_date IN (SELECT d_date FROM date_dim
+                   WHERE d_week_seq = (SELECT d_week_seq FROM date_dim
+                                       WHERE d_date = date '2000-01-03'))
+    AND ws_sold_date_sk = d_date_sk
+  GROUP BY i_item_id)
+SELECT ss_items.item_id, ss_item_rev,
+       CAST(ss_item_rev AS double) /
+         ((ss_item_rev + cs_item_rev + ws_item_rev) / 3.0) * 100 ss_dev,
+       cs_item_rev,
+       CAST(cs_item_rev AS double) /
+         ((ss_item_rev + cs_item_rev + ws_item_rev) / 3.0) * 100 cs_dev,
+       ws_item_rev,
+       CAST(ws_item_rev AS double) /
+         ((ss_item_rev + cs_item_rev + ws_item_rev) / 3.0) * 100 ws_dev,
+       (ss_item_rev + cs_item_rev + ws_item_rev) / 3.0 average
+FROM ss_items, cs_items, ws_items
+WHERE ss_items.item_id = cs_items.item_id
+  AND ss_items.item_id = ws_items.item_id
+  AND ss_item_rev BETWEEN 0.2 * cs_item_rev AND 5 * cs_item_rev
+  AND ss_item_rev BETWEEN 0.2 * ws_item_rev AND 5 * ws_item_rev
+  AND cs_item_rev BETWEEN 0.2 * ss_item_rev AND 5 * ss_item_rev
+  AND cs_item_rev BETWEEN 0.2 * ws_item_rev AND 5 * ws_item_rev
+  AND ws_item_rev BETWEEN 0.2 * ss_item_rev AND 5 * ss_item_rev
+  AND ws_item_rev BETWEEN 0.2 * cs_item_rev AND 5 * cs_item_rev
+ORDER BY ss_items.item_id, ss_item_rev
+""",
+    # q72: promotion effect on late catalog shipments with low same-week
+    # inventory -- 11-table join with cross-table inequality residuals
+    # (inv qty < order qty; ship > sale + 5 days). hd_buy_potential
+    # widened to two buckets (single-bucket is vacuous at test scale).
+    # The query that exposed (and now regression-tests) wide composite
+    # string-key joins downstream.
+    "q72": """
+SELECT i_item_desc, w_warehouse_name, d1.d_week_seq,
+       sum(CASE WHEN p_promo_sk IS NULL THEN 1 ELSE 0 END) no_promo,
+       sum(CASE WHEN p_promo_sk IS NOT NULL THEN 1 ELSE 0 END) promo,
+       count(*) total_cnt
+FROM catalog_sales
+JOIN inventory ON cs_item_sk = inv_item_sk
+JOIN warehouse ON w_warehouse_sk = inv_warehouse_sk
+JOIN item ON i_item_sk = cs_item_sk
+JOIN customer_demographics ON cs_bill_cdemo_sk = cd_demo_sk
+JOIN household_demographics ON cs_bill_hdemo_sk = hd_demo_sk
+JOIN date_dim d1 ON cs_sold_date_sk = d1.d_date_sk
+JOIN date_dim d2 ON inv_date_sk = d2.d_date_sk
+JOIN date_dim d3 ON cs_ship_date_sk = d3.d_date_sk
+LEFT JOIN promotion ON cs_promo_sk = p_promo_sk
+LEFT JOIN catalog_returns ON cr_item_sk = cs_item_sk
+  AND cr_order_number = cs_order_number
+WHERE d1.d_week_seq = d2.d_week_seq
+  AND inv_quantity_on_hand < cs_quantity
+  AND d3.d_date > d1.d_date + 5
+  AND hd_buy_potential IN ('>10000', '5001-10000')
+  AND d1.d_year = 1999
+  AND cd_marital_status = 'D'
+GROUP BY i_item_desc, w_warehouse_name, d1.d_week_seq
+ORDER BY total_cnt DESC, i_item_desc, w_warehouse_name, d1.d_week_seq
+""",
+    # q54: revenue segments of customers acquired through catalog/web
+    # who then shop in county-matched stores -- scalar subqueries as
+    # BETWEEN bounds (broadcast value channels) and a composite
+    # (county, state) STRING-key join: the query that exposed the
+    # cross-width string join-key misalignment. Cohort widened to the
+    # acquisition year; i_class from the generator domain.
+    "q54": """
+WITH my_customers AS (
+  SELECT DISTINCT c_customer_sk, c_current_addr_sk
+  FROM (SELECT cs_sold_date_sk sold_date_sk,
+               cs_bill_customer_sk customer_sk, cs_item_sk item_sk
+        FROM catalog_sales
+        UNION ALL
+        SELECT ws_sold_date_sk sold_date_sk,
+               ws_bill_customer_sk customer_sk, ws_item_sk item_sk
+        FROM web_sales) cs_or_ws_sales, item, date_dim, customer
+  WHERE sold_date_sk = d_date_sk AND item_sk = i_item_sk
+    AND i_category = 'Women' AND i_class = 'bedding'
+    AND c_customer_sk = cs_or_ws_sales.customer_sk
+    AND d_year = 1998),
+my_revenue AS (
+  SELECT c_customer_sk, sum(ss_ext_sales_price) revenue
+  FROM my_customers, store_sales, customer_address, store, date_dim
+  WHERE c_current_addr_sk = ca_address_sk
+    AND ca_county = s_county AND ca_state = s_state
+    AND ss_sold_date_sk = d_date_sk
+    AND c_customer_sk = ss_customer_sk
+    AND d_month_seq BETWEEN (SELECT DISTINCT d_month_seq + 1
+                             FROM date_dim
+                             WHERE d_year = 1998 AND d_moy = 12)
+                        AND (SELECT DISTINCT d_month_seq + 3
+                             FROM date_dim
+                             WHERE d_year = 1998 AND d_moy = 12)
+  GROUP BY c_customer_sk),
+segments AS (
+  SELECT CAST(revenue / 50 AS integer) segment FROM my_revenue)
+SELECT segment, count(*) num_customers, segment * 50 segment_base
+FROM segments
+GROUP BY segment
+ORDER BY segment, num_customers
+""",
 }
 
 # q66: warehouse monthly pivot over web+catalog (36 pivot aggregates per
@@ -2946,6 +3072,14 @@ def _channel_rollup_oracle(name: str) -> str:
 
 TPCDS_ORACLE = {
     "q17": _q17_oracle(),
+    # engine money math is in dollars; sqlite sees raw cents. Presto's
+    # CAST(double AS integer) ROUNDS; sqlite CAST truncates.
+    "q54": TPCDS_QUERIES["q54"].replace(
+        "CAST(revenue / 50 AS integer)",
+        "CAST(round(revenue / 100.0 / 50.0) AS integer)"),
+    "q58": TPCDS_QUERIES["q58"].replace(
+        "ws_item_rev) / 3.0 average",
+        "ws_item_rev) / 3.0 / 100.0 average"),
     "q5": _channel_rollup_oracle("q5"),
     "q77": _channel_rollup_oracle("q77"),
     "q80": _channel_rollup_oracle("q80"),

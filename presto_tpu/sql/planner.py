@@ -1024,9 +1024,18 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
                     an.subquery_masks[id(s)] = E.special(
                         "COALESCE", T.BOOLEAN, mask,
                         E.const(False, T.BOOLEAN))
-                else:
-                    raise NotImplementedError(
-                        "scalar subquery in disjunctive position")
+                else:  # ScalarSubquery inside an expression (BETWEEN
+                    # bounds, arithmetic): attach its single-row value
+                    if isinstance(s.query, P.Query):
+                        corr_sv, _ = _split_correlations(s.query, tables,
+                                                         table_schemas)
+                        if corr_sv:
+                            raise NotImplementedError(
+                                "correlated scalar subquery in "
+                                "expression position")
+                    node, vty = _attach_scalar_value(node, s, max_groups,
+                                                     join_capacity)
+                    an.subquery_masks[id(s)] = E.input_ref(cur, vty)
                 cur += 1
             pred = an.lower(c, scope)
             node = N.ProjectNode(

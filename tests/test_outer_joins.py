@@ -172,3 +172,47 @@ def test_distribute_forces_partitioned_for_outer_build():
     assert join.left.kind == "REPARTITION"
     assert isinstance(join.right, N.ExchangeNode)
     assert join.right.kind == "REPARTITION"
+
+
+def test_composite_string_keys_with_different_widths():
+    """Join keys whose varchar widths differ between the two sides must
+    still match (the q54 county+state shape): key words are padded to a
+    common layout, and the partition hash is width-independent."""
+    import jax.numpy as jnp
+    import numpy as np
+    from presto_tpu import types as T
+    from presto_tpu.block import batch_from_numpy
+    from presto_tpu.expr.functions import hash64_block
+    from presto_tpu.ops.join import hash_join
+
+    from presto_tpu.block import Batch, StringColumn
+
+    def scol(width, vals):
+        chars = np.zeros((len(vals), width), dtype=np.uint8)
+        lens = np.zeros(len(vals), dtype=np.int32)
+        for i, v in enumerate(vals):
+            b = v.encode()
+            chars[i, :len(b)] = list(b)
+            lens[i] = len(b)
+        return StringColumn(jnp.asarray(chars), jnp.asarray(lens),
+                            jnp.zeros(len(vals), bool), T.varchar(width))
+
+    def sbatch(width, names, states):
+        # explicit chars width: the declared varchar width drives the
+        # word-count layout this test exists to exercise (30 -> 4 words
+        # vs 12 -> 2 words per key column)
+        return Batch((scol(width, names), scol(width, states)),
+                     jnp.ones(len(names), dtype=bool))
+
+    left = sbatch(30, ["Daviess County", "Walker County", "Bronx County"],
+                  ["CA", "NY", "TX"])
+    right = sbatch(16, ["Walker County", "Bronx County", "Barrow County"],
+                   ["NY", "TX", "GA"])
+    res = hash_join(left, right, [0, 1], [0, 1], out_capacity=16)
+    assert int(res.num_rows) == 2
+    # equal strings hash identically regardless of declared width
+    h30 = np.asarray(hash64_block(left.column(0)))
+    h16 = np.asarray(hash64_block(
+        sbatch(16, ["Daviess County", "Walker County", "Bronx County"],
+               ["CA", "NY", "TX"]).column(0)))
+    assert (h30 == h16).all()

@@ -90,7 +90,9 @@ SLOW_CASES = [
     ("q50", 0.05, {"min_rows": 0}),
     ("q51", 0.01, {"max_groups": 1 << 16, "keep_limit": True}),
     ("q53", 0.05, {"min_rows": 0}),
+    ("q54", 0.05, {"max_groups": 1 << 16, "join_capacity": 1 << 21}),
     ("q56", 0.05, {"min_rows": 0}),
+    ("q58", 0.1, {"max_groups": 1 << 16, "join_capacity": 1 << 21}),
     ("q59", 0.01, {"max_groups": 1 << 17, "join_capacity": 1 << 22}),
     ("q57", 0.05, {"max_groups": 1 << 15, "min_rows": 0}),
     ("q61", 0.05, {"min_rows": 0}),
@@ -99,6 +101,7 @@ SLOW_CASES = [
     ("q66", 0.05, {"max_groups": 1 << 15, "join_capacity": 1 << 21}),
     ("q68", 0.01, {}),
     ("q69", 0.05, {"min_rows": 0}),
+    ("q72", 0.1, {"max_groups": 1 << 17, "join_capacity": 1 << 23}),
     ("q74", 0.05, {"max_groups": 1 << 15, "keep_limit": True}),
     ("q75", 0.05, {"max_groups": 1 << 17, "join_capacity": 1 << 21}),
     ("q77", 0.05, {"max_groups": 1 << 15, "join_capacity": 1 << 21}),
