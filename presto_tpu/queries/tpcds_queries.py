@@ -2757,6 +2757,259 @@ FROM segments
 GROUP BY segment
 ORDER BY segment, num_customers
 """,
+    # q8: store profits in zip prefixes shared with frequent preferred
+    # customers -- INTERSECT of a zip list with a HAVING-filtered
+    # aggregate, joined to stores on 2-char zip PREFIXES (the spec's
+    # substr()=substr() join keys are computed inside derived tables;
+    # zip list drawn from the generator's frequent-preferred set and
+    # the HAVING threshold scaled 10 -> 3 so the INTERSECT is
+    # non-vacuous at test scale)
+    "q8": """
+SELECT s_store_name, sum(ss_net_profit) p
+FROM store_sales, date_dim,
+     (SELECT s_store_sk ss_sk, s_store_name,
+             substr(s_zip, 1, 2) s_zip2 FROM store) st,
+     (SELECT ca_zip, substr(ca_zip, 1, 2) ca_zip2
+      FROM (SELECT substr(ca_zip, 1, 5) ca_zip FROM customer_address
+            WHERE substr(ca_zip, 1, 5) IN (
+              '10895', '10978', '11325', '11566', '12162', '12866',
+              '13735', '14121', '14329', '14685', '14737', '14927',
+              '15234', '15628', '15791', '15865', '17095', '17277',
+              '17793', '18094')
+            INTERSECT
+            SELECT ca_zip
+            FROM (SELECT substr(ca_zip, 1, 5) ca_zip, count(*) cnt
+                  FROM customer_address, customer
+                  WHERE ca_address_sk = c_current_addr_sk
+                    AND c_preferred_cust_flag = 'Y'
+                  GROUP BY ca_zip
+                  HAVING count(*) > 3) a1) a2) v1
+WHERE ss_store_sk = ss_sk AND ss_sold_date_sk = d_date_sk
+  AND d_qoy = 2 AND d_year = 1998
+  AND st.s_zip2 = v1.ca_zip2
+GROUP BY s_store_name
+ORDER BY s_store_name
+""",
+    # q14: items selling in ALL THREE channels (3-way INTERSECT over
+    # brand/class/category triples) vs the all-channel average --
+    # ROLLUP over (channel, brand, class, category); the oracle stacks
+    # the five rollup levels (see TPCDS_ORACLE)
+    "q14": """
+WITH cross_items AS (
+  SELECT i_item_sk ss_item_sk
+  FROM item,
+       (SELECT iss.i_brand_id brand_id, iss.i_class_id class_id,
+               iss.i_category_id category_id
+        FROM store_sales, item iss, date_dim d1
+        WHERE ss_item_sk = iss.i_item_sk
+          AND ss_sold_date_sk = d1.d_date_sk
+          AND d1.d_year BETWEEN 1999 AND 2001
+        INTERSECT
+        SELECT ics.i_brand_id, ics.i_class_id, ics.i_category_id
+        FROM catalog_sales, item ics, date_dim d2
+        WHERE cs_item_sk = ics.i_item_sk
+          AND cs_sold_date_sk = d2.d_date_sk
+          AND d2.d_year BETWEEN 1999 AND 2001
+        INTERSECT
+        SELECT iws.i_brand_id, iws.i_class_id, iws.i_category_id
+        FROM web_sales, item iws, date_dim d3
+        WHERE ws_item_sk = iws.i_item_sk
+          AND ws_sold_date_sk = d3.d_date_sk
+          AND d3.d_year BETWEEN 1999 AND 2001) x
+  WHERE i_brand_id = brand_id AND i_class_id = class_id
+    AND i_category_id = category_id),
+avg_sales AS (
+  SELECT avg(quantity * list_price) average_sales
+  FROM (SELECT ss_quantity quantity, ss_list_price list_price
+        FROM store_sales, date_dim
+        WHERE ss_sold_date_sk = d_date_sk
+          AND d_year BETWEEN 1999 AND 2001
+        UNION ALL
+        SELECT cs_quantity quantity, cs_list_price list_price
+        FROM catalog_sales, date_dim
+        WHERE cs_sold_date_sk = d_date_sk
+          AND d_year BETWEEN 1999 AND 2001
+        UNION ALL
+        SELECT ws_quantity quantity, ws_list_price list_price
+        FROM web_sales, date_dim
+        WHERE ws_sold_date_sk = d_date_sk
+          AND d_year BETWEEN 1999 AND 2001) x)
+
+SELECT channel, i_brand_id, i_class_id, i_category_id, sum(sales) s,
+       sum(number_sales) n
+FROM
+  (
+   SELECT 'store' channel, i_brand_id, i_class_id, i_category_id,
+          sum(ss_quantity * ss_list_price) sales, count(*) number_sales
+   FROM store_sales, item, date_dim
+   WHERE ss_item_sk IN (SELECT ss_item_sk FROM cross_items)
+     AND ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+     AND d_year = 2001 AND d_moy = 11
+   GROUP BY i_brand_id, i_class_id, i_category_id
+   HAVING sum(ss_quantity * ss_list_price) > (SELECT average_sales FROM avg_sales)
+   UNION ALL
+   SELECT 'catalog' channel, i_brand_id, i_class_id, i_category_id,
+          sum(cs_quantity * cs_list_price) sales, count(*) number_sales
+   FROM catalog_sales, item, date_dim
+   WHERE cs_item_sk IN (SELECT ss_item_sk FROM cross_items)
+     AND cs_item_sk = i_item_sk AND cs_sold_date_sk = d_date_sk
+     AND d_year = 2001 AND d_moy = 11
+   GROUP BY i_brand_id, i_class_id, i_category_id
+   HAVING sum(cs_quantity * cs_list_price) > (SELECT average_sales FROM avg_sales)
+   UNION ALL
+   SELECT 'web' channel, i_brand_id, i_class_id, i_category_id,
+          sum(ws_quantity * ws_list_price) sales, count(*) number_sales
+   FROM web_sales, item, date_dim
+   WHERE ws_item_sk IN (SELECT ss_item_sk FROM cross_items)
+     AND ws_item_sk = i_item_sk AND ws_sold_date_sk = d_date_sk
+     AND d_year = 2001 AND d_moy = 11
+   GROUP BY i_brand_id, i_class_id, i_category_id
+   HAVING sum(ws_quantity * ws_list_price) > (SELECT average_sales FROM avg_sales)) y
+
+GROUP BY ROLLUP (channel, i_brand_id, i_class_id, i_category_id)
+ORDER BY channel, i_brand_id, i_class_id, i_category_id
+""",
+    # q23: February catalog+web sales of frequently-store-sold items to
+    # the best store customers (HAVING against a max-over-sums CTE
+    # scalar; count threshold 4 -> 1 and 0.500 written with 3 decimals
+    # for the cents-literal convention)
+    "q23": """
+WITH frequent_ss_items AS (
+  SELECT substr(i_item_desc, 1, 30) itemdesc, i_item_sk item_sk,
+         d_date solddate, count(*) cnt
+  FROM store_sales, date_dim, item
+  WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+    AND d_year IN (2000, 2001, 2002, 2003)
+  GROUP BY substr(i_item_desc, 1, 30), i_item_sk, d_date
+  HAVING count(*) > 1),
+max_store_sales AS (
+  SELECT max(csales) tpcds_cmax
+  FROM (SELECT c_customer_sk, sum(ss_quantity * ss_sales_price) csales
+        FROM store_sales, customer, date_dim
+        WHERE ss_customer_sk = c_customer_sk
+          AND ss_sold_date_sk = d_date_sk
+          AND d_year IN (2000, 2001, 2002, 2003)
+        GROUP BY c_customer_sk) x),
+best_ss_customer AS (
+  SELECT c_customer_sk, sum(ss_quantity * ss_sales_price) ssales
+  FROM store_sales, customer
+  WHERE ss_customer_sk = c_customer_sk
+  GROUP BY c_customer_sk
+  HAVING sum(ss_quantity * ss_sales_price) >
+         (SELECT 0.500 * tpcds_cmax FROM max_store_sales))
+SELECT sum(sales) total
+FROM (SELECT cs_quantity * cs_list_price sales
+      FROM catalog_sales, date_dim
+      WHERE d_year = 2000 AND d_moy = 2 AND cs_sold_date_sk = d_date_sk
+        AND cs_item_sk IN (SELECT item_sk FROM frequent_ss_items)
+        AND cs_bill_customer_sk IN (SELECT c_customer_sk
+                                    FROM best_ss_customer)
+      UNION ALL
+      SELECT ws_quantity * ws_list_price sales
+      FROM web_sales, date_dim
+      WHERE d_year = 2000 AND d_moy = 2 AND ws_sold_date_sk = d_date_sk
+        AND ws_item_sk IN (SELECT item_sk FROM frequent_ss_items)
+        AND ws_bill_customer_sk IN (SELECT c_customer_sk
+                                    FROM best_ss_customer)) y
+""",
+    # q24: returned-item spenders above 5% of the average (CTE
+    # referenced by the outer query AND its HAVING scalar subquery;
+    # upper(ca_country) computed in a derived table so it joins as a
+    # plain key; geographic link at state level -- the generated
+    # s_zip/ca_zip domains share only 2 values; market 7 and a domain
+    # color; explicit JOIN chain keeps intermediates customer-bounded)
+    "q24": """
+WITH ssales AS (
+  SELECT c_last_name, c_first_name, s_store_name, ca_state, s_state,
+         i_color, i_current_price, i_manager_id, i_units, i_size,
+         sum(ss_net_paid) netpaid
+  FROM store_sales
+  JOIN store_returns ON ss_ticket_number = sr_ticket_number
+    AND ss_item_sk = sr_item_sk
+  JOIN customer ON ss_customer_sk = c_customer_sk
+  JOIN (SELECT ca_address_sk, ca_state, upper(ca_country) ca_country_up
+        FROM customer_address) ca
+    ON c_current_addr_sk = ca_address_sk
+    AND c_birth_country = ca_country_up
+  JOIN store ON ss_store_sk = s_store_sk AND ca_state = s_state
+  JOIN item ON ss_item_sk = i_item_sk
+  WHERE s_market_id = 7
+  GROUP BY c_last_name, c_first_name, s_store_name, ca_state, s_state,
+           i_color, i_current_price, i_manager_id, i_units, i_size)
+SELECT c_last_name, c_first_name, s_store_name, sum(netpaid) paid
+FROM ssales
+WHERE i_color = 'blue'
+GROUP BY c_last_name, c_first_name, s_store_name
+HAVING sum(netpaid) > (SELECT 0.050 * avg(netpaid) FROM ssales)
+ORDER BY c_last_name, c_first_name, s_store_name
+""",
+    # q64: items returned and re-bought at the same store across
+    # consecutive years -- the 17-table cross_sales CTE (profitable
+    # catalog items via a HAVING sum > 2*sum gate, both customer
+    # demographic/address/income-band sides, a cross-table
+    # marital-status inequality) self-joined on item+store. Colors from
+    # the generator domain; price band widened (the spec double band is
+    # vacuous at test scale).
+    "q64": """
+WITH cs_ui AS (
+  SELECT cs_item_sk,
+         sum(cs_ext_list_price) sale,
+         sum(cr_refunded_cash + cr_reversed_charge + cr_store_credit)
+           refund
+  FROM catalog_sales, catalog_returns
+  WHERE cs_item_sk = cr_item_sk AND cs_order_number = cr_order_number
+  GROUP BY cs_item_sk
+  HAVING sum(cs_ext_list_price) >
+         2 * sum(cr_refunded_cash + cr_reversed_charge
+                 + cr_store_credit)),
+cross_sales AS (
+  SELECT i_product_name product_name, i_item_sk item_sk,
+         s_store_name store_name, s_zip store_zip,
+         ad1.ca_street_number b_street_number,
+         ad1.ca_street_name b_street_name, ad1.ca_city b_city,
+         ad1.ca_zip b_zip, ad2.ca_street_number c_street_number,
+         ad2.ca_street_name c_street_name, ad2.ca_city c_city,
+         ad2.ca_zip c_zip, d1.d_year syear, d2.d_year fsyear,
+         d3.d_year s2year, count(*) cnt, sum(ss_wholesale_cost) s1,
+         sum(ss_list_price) s2, sum(ss_coupon_amt) s3
+  FROM store_sales, store_returns, cs_ui, date_dim d1, date_dim d2,
+       date_dim d3, store, customer, customer_demographics cd1,
+       customer_demographics cd2, promotion, household_demographics hd1,
+       household_demographics hd2, customer_address ad1,
+       customer_address ad2, income_band ib1, income_band ib2, item
+  WHERE ss_store_sk = s_store_sk AND ss_sold_date_sk = d1.d_date_sk
+    AND ss_customer_sk = c_customer_sk AND ss_cdemo_sk = cd1.cd_demo_sk
+    AND ss_hdemo_sk = hd1.hd_demo_sk AND ss_addr_sk = ad1.ca_address_sk
+    AND ss_item_sk = i_item_sk AND ss_item_sk = sr_item_sk
+    AND ss_ticket_number = sr_ticket_number
+    AND ss_item_sk = cs_ui.cs_item_sk
+    AND c_current_cdemo_sk = cd2.cd_demo_sk
+    AND c_current_hdemo_sk = hd2.hd_demo_sk
+    AND c_current_addr_sk = ad2.ca_address_sk
+    AND c_first_sales_date_sk = d2.d_date_sk
+    AND c_first_shipto_date_sk = d3.d_date_sk
+    AND ss_promo_sk = p_promo_sk
+    AND hd1.hd_income_band_sk = ib1.ib_income_band_sk
+    AND hd2.hd_income_band_sk = ib2.ib_income_band_sk
+    AND cd1.cd_marital_status <> cd2.cd_marital_status
+    AND i_color IN ('azure', 'blue', 'black', 'beige', 'coral', 'cream')
+    AND i_current_price BETWEEN 10.00 AND 90.00
+  GROUP BY i_product_name, i_item_sk, s_store_name, s_zip,
+           ad1.ca_street_number, ad1.ca_street_name, ad1.ca_city,
+           ad1.ca_zip, ad2.ca_street_number, ad2.ca_street_name,
+           ad2.ca_city, ad2.ca_zip, d1.d_year, d2.d_year, d3.d_year)
+SELECT cs1.product_name, cs1.store_name, cs1.store_zip,
+       cs1.b_street_number, cs1.b_street_name, cs1.b_city, cs1.b_zip,
+       cs1.c_street_number, cs1.c_street_name, cs1.c_city, cs1.c_zip,
+       cs1.syear, cs1.cnt, cs1.s1 s11, cs1.s2 s21, cs1.s3 s31,
+       cs2.s1 s12, cs2.s2 s22, cs2.s3 s32, cs2.syear syear2, cs2.cnt cnt2
+FROM cross_sales cs1, cross_sales cs2
+WHERE cs1.item_sk = cs2.item_sk AND cs1.syear = 1999
+  AND cs2.syear = 2000 AND cs2.cnt <= cs1.cnt
+  AND cs1.store_name = cs2.store_name
+  AND cs1.store_zip = cs2.store_zip
+ORDER BY cs1.product_name, cs1.store_name, cs2.cnt
+""",
 }
 
 # q66: warehouse monthly pivot over web+catalog (36 pivot aggregates per
@@ -3055,19 +3308,28 @@ def _q39_oracle() -> str:
     return text
 
 
-def _channel_rollup_oracle(name: str) -> str:
-    """Derive the sqlite oracle for the q5/q77/q80 family from the
-    REGISTERED query text: the GROUP BY ROLLUP (channel, id) tail
-    becomes the three stacked UNION ALL levels, so oracle and engine
-    provably run the same CTEs."""
+def _rollup_stack_oracle(name: str, keys) -> str:
+    """Derive a sqlite ROLLUP oracle from the REGISTERED query text:
+    the GROUP BY ROLLUP (keys...) tail becomes len(keys)+1 stacked
+    UNION ALL levels (dropped keys projected as typed NULLs), so
+    oracle and engine provably run the same CTEs."""
     text = TPCDS_QUERIES[name]
-    head = text.rindex("\nSELECT channel, id,")
+    key_str = ", ".join(keys)
+    head = text.rindex("\nSELECT " + keys[0] + ",")
     tail = text.index("GROUP BY ROLLUP", head)
     prefix, selbase = text[:head], text[head:tail]
-    return (prefix + selbase + "GROUP BY channel, id\nUNION ALL"
-            + selbase.replace("channel, id,", "channel, NULL,", 1)
-            + "GROUP BY channel\nUNION ALL"
-            + selbase.replace("channel, id,", "NULL, NULL,", 1))
+    assert key_str in selbase, name
+    parts = []
+    for k in range(len(keys), -1, -1):
+        kept = list(keys[:k])
+        sel = ", ".join(kept + [f"NULL {c}" for c in keys[k:]])
+        gb = f"GROUP BY {', '.join(kept)}" if kept else ""
+        parts.append(selbase.replace(key_str, sel, 1) + gb)
+    return prefix + "\nUNION ALL".join(parts)
+
+
+def _channel_rollup_oracle(name: str) -> str:
+    return _rollup_stack_oracle(name, ["channel", "id"])
 
 
 TPCDS_ORACLE = {
@@ -3081,6 +3343,8 @@ TPCDS_ORACLE = {
         "ws_item_rev) / 3.0 average",
         "ws_item_rev) / 3.0 / 100.0 average"),
     "q5": _channel_rollup_oracle("q5"),
+    "q14": _rollup_stack_oracle(
+        "q14", ["channel", "i_brand_id", "i_class_id", "i_category_id"]),
     "q77": _channel_rollup_oracle("q77"),
     "q80": _channel_rollup_oracle("q80"),
     "q39": _q39_oracle(),
