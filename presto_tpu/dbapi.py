@@ -40,12 +40,19 @@ def connect(sf: float = 0.01, mesh=None, max_groups: int = 1 << 16,
 
 class Connection:
     def __init__(self, sf: float, mesh=None, max_groups: int = 1 << 16,
-                 **kwargs):
+                 read_only: bool = True, **kwargs):
+        from .transaction import TransactionManager
         self.sf = sf
         self.mesh = mesh
         self.max_groups = max_groups
+        self.read_only = read_only  # implicit-transaction mode; pass
+        # read_only=False once the table-writer path lands
         self.kwargs = kwargs
         self._closed = False
+        # PEP-249 implicit transaction: begun lazily on first execute,
+        # ended by commit()/rollback() (TransactionManager analog)
+        self._txn_manager = TransactionManager()
+        self._txn_id = None
 
     def cursor(self) -> "Cursor":
         if self._closed:
@@ -53,13 +60,29 @@ class Connection:
         return Cursor(self)
 
     def close(self):
+        if self._txn_id is not None:
+            self._txn_manager.rollback(self._txn_id)
+            self._txn_id = None
         self._closed = True
 
+    def _current_txn(self) -> str:
+        if self._txn_id is None:
+            self._txn_id = self._txn_manager.begin(
+                read_only=self.read_only)
+        return self._txn_id
+
+    def _end_txn(self, end) -> None:
+        if self._closed:
+            raise ProgrammingError("connection is closed")
+        if self._txn_id is not None:
+            end(self._txn_id)
+            self._txn_id = None
+
     def commit(self):
-        pass  # autocommit; writes land with the table-writer path
+        self._end_txn(self._txn_manager.commit)
 
     def rollback(self):
-        raise ProgrammingError("transactions are not supported")
+        self._end_txn(self._txn_manager.rollback)
 
     def __enter__(self):
         return self
@@ -81,6 +104,7 @@ class Cursor:
     def execute(self, sql_text: str, parameters: Sequence[Any] = ()):
         if self.conn._closed:
             raise ProgrammingError("connection is closed")
+        self.conn._current_txn()  # PEP-249 implicit transaction
         if parameters:
             sql_text = _bind(sql_text, parameters)
         from .sql import sql as run_sql

@@ -1,0 +1,149 @@
+"""Transaction management: the TransactionManager analog.
+
+Reference surface: presto-main-base's transaction/ package
+(InMemoryTransactionManager: begin/commit/rollback, per-connector
+transaction handles created lazily on first access, auto-commit
+single-statement transactions, idle-timeout reaping) and the SPI's
+ConnectorTransactionHandle. The TPU engine's connectors are read-only
+generators today, so connector handles carry isolation metadata rather
+than write state -- but the lifecycle, the auto-commit contract, and
+the access bookkeeping mirror the reference so the DBAPI layer and the
+coordinator speak the same protocol as Presto clients expect
+(START TRANSACTION / COMMIT / ROLLBACK in the statement API).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import uuid
+from typing import Dict, Optional
+
+__all__ = ["TransactionManager", "TransactionInfo", "IsolationLevel",
+           "NotInTransaction"]
+
+
+# SQL standard levels the reference accepts (spi/transaction/IsolationLevel)
+ISOLATION_LEVELS = ("READ UNCOMMITTED", "READ COMMITTED",
+                    "REPEATABLE READ", "SERIALIZABLE")
+IsolationLevel = str
+
+
+class NotInTransaction(RuntimeError):
+    """Operation referenced an unknown/expired transaction id."""
+
+
+@dataclasses.dataclass
+class TransactionInfo:
+    transaction_id: str
+    isolation: IsolationLevel
+    read_only: bool
+    auto_commit: bool
+    created_at: float
+    # connector name -> opaque transaction handle (lazily created on
+    # first catalog access, like InMemoryTransactionManager)
+    connector_handles: Dict[str, dict] = dataclasses.field(
+        default_factory=dict)
+    last_access: float = 0.0
+
+    def to_json(self) -> dict:
+        return {"transactionId": self.transaction_id,
+                "isolationLevel": self.isolation,
+                "readOnly": self.read_only,
+                "autoCommitContext": self.auto_commit,
+                "catalogs": sorted(self.connector_handles)}
+
+
+class TransactionManager:
+    """begin/commit/rollback + auto-commit contexts + idle reaping."""
+
+    def __init__(self, idle_timeout_s: float = 300.0):
+        self._lock = threading.Lock()
+        self._txns: Dict[str, TransactionInfo] = {}
+        self.idle_timeout_s = idle_timeout_s
+
+    def begin(self, isolation: IsolationLevel = "READ UNCOMMITTED",
+              read_only: bool = False,
+              auto_commit: bool = False) -> str:
+        if isolation not in ISOLATION_LEVELS:
+            raise ValueError(f"unknown isolation level {isolation!r}")
+        tid = f"tx_{uuid.uuid4().hex[:16]}"
+        now = time.time()
+        with self._lock:
+            self._reap_locked(now)
+            self._txns[tid] = TransactionInfo(
+                tid, isolation, read_only, auto_commit, now,
+                last_access=now)
+        return tid
+
+    def get(self, tid: str) -> TransactionInfo:
+        with self._lock:
+            info = self._txns.get(tid)
+            if info is None:
+                raise NotInTransaction(f"unknown transaction {tid}")
+            info.last_access = time.time()
+            return info
+
+    def connector_handle(self, tid: str, connector: str) -> dict:
+        """Lazily create the per-connector handle on first access
+        (InMemoryTransactionManager.getConnectorTransaction). Lookup
+        and create happen under ONE lock acquisition so a concurrent
+        commit/rollback can't race a handle onto a finished txn."""
+        with self._lock:
+            info = self._txns.get(tid)
+            if info is None:
+                raise NotInTransaction(f"unknown transaction {tid}")
+            info.last_access = time.time()
+            handle = info.connector_handles.get(connector)
+            if handle is None:
+                handle = {"connector": connector,
+                          "transactionId": tid,
+                          "readOnly": info.read_only,
+                          "isolation": info.isolation}
+                info.connector_handles[connector] = handle
+            return handle
+
+    def access_check_write(self, tid: str, connector: str) -> None:
+        """Reject writes in read-only transactions (the reference's
+        checkConnectorWrite); the engine has no write path yet, so this
+        is the seam INSERT/CTAS will call."""
+        info = self.get(tid)
+        if info.read_only:
+            raise RuntimeError(
+                f"transaction {tid} is read-only; cannot write to "
+                f"{connector}")
+
+    def _end(self, tid: str) -> None:
+        with self._lock:
+            if self._txns.pop(tid, None) is None:
+                raise NotInTransaction(f"unknown transaction {tid}")
+
+    def commit(self, tid: str) -> None:
+        self._end(tid)
+
+    def rollback(self, tid: str) -> None:
+        self._end(tid)
+
+    def active(self) -> list:
+        with self._lock:
+            return [t.to_json() for t in self._txns.values()]
+
+    def run_autocommit(self, fn, *, read_only: bool = True):
+        """Single-statement auto-commit context: begin, run, commit on
+        success / rollback on error (DispatchManager's autocommit
+        wrapping of bare statements)."""
+        tid = self.begin(read_only=read_only, auto_commit=True)
+        try:
+            out = fn(tid)
+        except BaseException:
+            self.rollback(tid)
+            raise
+        self.commit(tid)
+        return out
+
+    def _reap_locked(self, now: float) -> None:
+        cutoff = now - self.idle_timeout_s
+        for tid in [t for t, info in self._txns.items()
+                    if not info.auto_commit and info.last_access < cutoff]:
+            del self._txns[tid]

@@ -1,0 +1,93 @@
+"""Transaction manager (InMemoryTransactionManager analog) + DBAPI
+implicit transactions."""
+
+import time
+
+import pytest
+
+from presto_tpu.transaction import (NotInTransaction, TransactionManager)
+
+
+def test_begin_commit_rollback_lifecycle():
+    tm = TransactionManager()
+    tid = tm.begin(read_only=True)
+    assert tm.get(tid).read_only
+    tm.commit(tid)
+    with pytest.raises(NotInTransaction):
+        tm.get(tid)
+    tid2 = tm.begin()
+    tm.rollback(tid2)
+    with pytest.raises(NotInTransaction):
+        tm.commit(tid2)
+
+
+def test_connector_handles_created_lazily_and_cached():
+    tm = TransactionManager()
+    tid = tm.begin()
+    h1 = tm.connector_handle(tid, "tpch")
+    h2 = tm.connector_handle(tid, "tpch")
+    assert h1 is h2 and h1["connector"] == "tpch"
+    assert sorted(tm.get(tid).connector_handles) == ["tpch"]
+    assert tm.active()[0]["catalogs"] == ["tpch"]
+
+
+def test_read_only_rejects_writes_and_isolation_validated():
+    tm = TransactionManager()
+    tid = tm.begin(read_only=True)
+    with pytest.raises(RuntimeError, match="read-only"):
+        tm.access_check_write(tid, "tpch")
+    with pytest.raises(ValueError):
+        tm.begin(isolation="CHAOS")
+
+
+def test_autocommit_context_commits_and_rolls_back():
+    tm = TransactionManager()
+    out = tm.run_autocommit(lambda tid: (tm.get(tid).auto_commit, 42))
+    assert out == (True, 42)
+    assert tm.active() == []
+    with pytest.raises(RuntimeError, match="boom"):
+        tm.run_autocommit(lambda tid: (_ for _ in ()).throw(
+            RuntimeError("boom")))
+    assert tm.active() == []
+
+
+def test_idle_transactions_reaped():
+    tm = TransactionManager(idle_timeout_s=0.01)
+    tid = tm.begin()
+    time.sleep(0.05)
+    tm.begin()  # reap runs on begin
+    with pytest.raises(NotInTransaction):
+        tm.get(tid)
+
+
+def test_dbapi_implicit_transaction():
+    from presto_tpu.dbapi import connect
+    conn = connect(sf=0.001)
+    cur = conn.cursor()
+    cur.execute("SELECT count(*) FROM region")
+    assert conn._txn_id is not None
+    conn.commit()
+    assert conn._txn_id is None
+    cur.execute("SELECT count(*) FROM region")
+    conn.rollback()
+    assert conn._txn_id is None
+    conn.close()
+
+
+def test_dbapi_closed_connection_rejects_txn_ops():
+    from presto_tpu.dbapi import ProgrammingError, connect
+    conn = connect(sf=0.001)
+    conn.close()
+    for op in (conn.commit, conn.rollback):
+        with pytest.raises(ProgrammingError):
+            op()
+
+
+def test_dbapi_writable_connection_mode():
+    from presto_tpu.dbapi import connect
+    conn = connect(sf=0.001, read_only=False)
+    cur = conn.cursor()
+    cur.execute("SELECT count(*) FROM region")
+    assert not conn._txn_manager.get(conn._txn_id).read_only
+    conn.commit()
+    conn.close()
