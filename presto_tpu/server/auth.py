@@ -62,13 +62,21 @@ def _b64url_decode(s: str) -> bytes:
     return base64.urlsafe_b64decode(s + pad)
 
 
+def _signing_key(secret: str) -> bytes:
+    """SHA-256 of the shared secret, matching the reference
+    InternalAuthenticationManager's key derivation — tokens minted here
+    validate against a Presto coordinator/worker sharing the secret."""
+    return hashlib.sha256(secret.encode()).digest()
+
+
 def sign_jwt(secret: str, payload: dict) -> str:
     """Compact HS256 JWS over `payload`."""
     header = _b64url(b'{"alg":"HS256","typ":"JWT"}')
     body = _b64url(json.dumps(payload, separators=(",", ":"),
                               sort_keys=True).encode())
     signing_input = f"{header}.{body}".encode()
-    sig = hmac.new(secret.encode(), signing_input, hashlib.sha256).digest()
+    sig = hmac.new(_signing_key(secret), signing_input,
+                   hashlib.sha256).digest()
     return f"{header}.{body}.{_b64url(sig)}"
 
 
@@ -78,7 +86,7 @@ def verify_jwt(secret: str, token: str, leeway_s: float = 30.0) -> dict:
     try:
         header_b64, body_b64, sig_b64 = token.split(".")
         signing_input = f"{header_b64}.{body_b64}".encode()
-        expect = hmac.new(secret.encode(), signing_input,
+        expect = hmac.new(_signing_key(secret), signing_input,
                           hashlib.sha256).digest()
         if not hmac.compare_digest(expect, _b64url_decode(sig_b64)):
             raise AuthError("bad signature")

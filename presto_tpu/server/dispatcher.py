@@ -73,7 +73,10 @@ class ResourceGroup:
     def release(self):
         with self._cv:
             self._running -= 1
-            self._cv.notify()
+            # notify_all, not notify: a waiter that times out may have
+            # just consumed the single notify without taking the slot,
+            # which would leave another queued waiter blocked forever.
+            self._cv.notify_all()
 
 
 class Dispatcher:

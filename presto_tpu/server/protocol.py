@@ -101,12 +101,7 @@ def translate_row_expression(j: dict, layout: Dict[str, Tuple[int, T.Type]]
                              ) -> E.RowExpression:
     t = j.get("@type")
     if t == "variable":
-        hit = layout.get(j["name"])
-        if hit is None:
-            raise ProtocolUnsupported(
-                f"variable {j['name']!r} not in source layout "
-                f"{sorted(layout)}")
-        ch, ty = hit
+        ch, ty = _lookup(layout, j["name"])
         return E.input_ref(ch, ty)
     if t == "constant":
         ty = _type_of(j["type"])
@@ -152,6 +147,18 @@ def _vars(lst) -> List[Tuple[str, T.Type]]:
 def _layout_of(pairs: List[Tuple[str, T.Type]]
                ) -> Dict[str, Tuple[int, T.Type]]:
     return {name: (i, ty) for i, (name, ty) in enumerate(pairs)}
+
+
+def _lookup(layout: Dict[str, Tuple[int, T.Type]], name: str
+            ) -> Tuple[int, T.Type]:
+    """Layout resolution that honors the PlanChecker contract: a missing
+    variable means the fragment is outside the slice (fall back to a
+    Java worker), never an internal KeyError."""
+    hit = layout.get(name)
+    if hit is None:
+        raise ProtocolUnsupported(
+            f"variable {name!r} not in source layout {sorted(layout)}")
+    return hit
 
 
 # Presto's tpch column names carry the table prefix (l_orderkey); this
@@ -241,7 +248,7 @@ def translate_node(j: dict) -> Tuple[N.PlanNode, List[Tuple[str, T.Type]]]:
         keys = []
         out: List[Tuple[str, T.Type]] = []
         for v in gs.get("groupingKeys", []):
-            ch, ty = layout[v["name"]]
+            ch, ty = _lookup(layout, v["name"])
             keys.append(ch)
             out.append((v["name"], ty))
         step = j.get("step", "SINGLE")
@@ -266,7 +273,7 @@ def translate_node(j: dict) -> Tuple[N.PlanNode, List[Tuple[str, T.Type]]]:
                 if len(args) != 1 or args[0].get("@type") != "variable":
                     raise ProtocolUnsupported(
                         f"aggregation argument shape for {fname!r}")
-                ch, _ty = layout[args[0]["name"]]
+                ch, _ty = _lookup(layout, args[0]["name"])
                 spec = AggSpec(fname, ch, rty)
             if step in ("PARTIAL", "FINAL", "INTERMEDIATE") and \
                     spec.canonical in ("avg", "var_samp", "var_pop",
@@ -292,7 +299,7 @@ def translate_node(j: dict) -> Tuple[N.PlanNode, List[Tuple[str, T.Type]]]:
         sort_keys = []
         for ob in scheme.get("orderBy", []):
             v = ob.get("variable", ob)
-            ch, _ty = layout[v["name"]]
+            ch, _ty = _lookup(layout, v["name"])
             order = ob.get("sortOrder") or \
                 scheme.get("orderings", {}).get(v["name"], "ASC_NULLS_LAST")
             sort_keys.append((ch, order.startswith("DESC"),
@@ -334,7 +341,7 @@ def translate_node(j: dict) -> Tuple[N.PlanNode, List[Tuple[str, T.Type]]]:
                 for ob in ordering.get("orderBy", []):
                     v = ob.get("variable", ob)
                     order = ob.get("sortOrder", "ASC_NULLS_LAST")
-                    sort_keys.append((layout[v["name"]][0],
+                    sort_keys.append((_lookup(layout, v["name"])[0],
                                       order.startswith("DESC"),
                                       order.endswith("NULLS_LAST")))
                 return N.ExchangeNode(src, kind="MERGE", scope="REMOTE",
@@ -346,7 +353,7 @@ def translate_node(j: dict) -> Tuple[N.PlanNode, List[Tuple[str, T.Type]]]:
             for a in args:
                 if a.get("@type") != "variable":
                     raise ProtocolUnsupported("non-variable partition arg")
-                chans.append(layout[a["name"]][0])
+                chans.append(_lookup(layout, a["name"])[0])
             return N.ExchangeNode(src, kind="REPARTITION", scope="REMOTE",
                                   partition_channels=chans), src_out
         if ex_type == "REPLICATE":

@@ -46,6 +46,10 @@ class TransactionInfo:
     connector_handles: Dict[str, dict] = dataclasses.field(
         default_factory=dict)
     last_access: float = 0.0
+    # True while run_autocommit is executing the statement body; an
+    # in-flight context must never be reaped no matter how long the
+    # statement runs (nothing touches last_access during execution).
+    in_use: bool = False
 
     def to_json(self) -> dict:
         return {"transactionId": self.transaction_id,
@@ -134,6 +138,8 @@ class TransactionManager:
         success / rollback on error (DispatchManager's autocommit
         wrapping of bare statements)."""
         tid = self.begin(read_only=read_only, auto_commit=True)
+        with self._lock:
+            self._txns[tid].in_use = True
         try:
             out = fn(tid)
         except BaseException:
@@ -143,7 +149,11 @@ class TransactionManager:
         return out
 
     def _reap_locked(self, now: float) -> None:
+        # Idle autocommit transactions are reaped too: one begun via
+        # begin(auto_commit=True) and abandoned holds no client state,
+        # so letting it linger would only leak _txns entries. In-flight
+        # run_autocommit contexts are exempt (in_use).
         cutoff = now - self.idle_timeout_s
         for tid in [t for t, info in self._txns.items()
-                    if not info.auto_commit and info.last_access < cutoff]:
+                    if info.last_access < cutoff and not info.in_use]:
             del self._txns[tid]
