@@ -23,6 +23,15 @@ number as the round artifact).
 Env knobs: BENCH_SF (default 1.0), BENCH_ITERS (default 5),
 BENCH_TUNNEL_RETRIES (default 4), BENCH_INIT_TIMEOUT (seconds, per
 probe attempt), BENCH_QUERY (q1 | q6).
+
+`bench.py --full` is the chip-evidence mode (VERDICT round-3 item 2):
+q1 + q6 + the join config (BASELINE config 2: q3, q14) + the
+sorted-mode large-G group-by microbench, written as a timestamped JSON
+under chip_evidence/ when (and only when) the run executed on the TPU.
+Every tunnel probe -- scheduled by scripts/relay_watch.py throughout a
+round -- appends an attempt record to chip_evidence/relay_attempts.log,
+so a relay-down round leaves a verifiable trail of tries instead of one
+silent CPU fallback.
 """
 
 import json
@@ -130,6 +139,222 @@ def _watchdog_main() -> int:
                                      "scoring": False}})
     print(out)
     return 0
+
+
+EVIDENCE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "chip_evidence")
+ATTEMPT_LOG = os.path.join(EVIDENCE_DIR, "relay_attempts.log")
+
+
+def _log_attempt(status: str, detail: str = "") -> None:
+    """One line per tunnel attempt: the per-attempt relay log the
+    round-3 verdict asked for (proof capture was tried repeatedly)."""
+    os.makedirs(EVIDENCE_DIR, exist_ok=True)
+    ts = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(ATTEMPT_LOG, "a") as f:
+        f.write(f"{ts} {status}{' ' + detail if detail else ''}\n")
+
+
+def _full_main() -> int:
+    """`--full` parent: probe the tunnel (honoring BENCH_TUNNEL_RETRIES
+    unless --no-retry), then run the full suite in a child on the chip
+    and persist a timestamped evidence JSON. Exit 2 when the relay is
+    down -- the watcher keeps trying."""
+    import subprocess
+    import sys
+
+    init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "240"))
+    run_timeout = float(os.environ.get("BENCH_FULL_TIMEOUT", "3600"))
+    retries = 1 if "--no-retry" in sys.argv else \
+        int(os.environ.get("BENCH_TUNNEL_RETRIES", "4"))
+
+    def child(extra_env, timeout):
+        env = dict(os.environ)
+        env.update(extra_env)
+        try:
+            p = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                               capture_output=True, text=True,
+                               timeout=timeout, env=env)
+        except subprocess.TimeoutExpired:
+            return None, f"timed out after {timeout:.0f}s"
+        lines = [l for l in p.stdout.splitlines() if l.startswith("{")]
+        if not lines:
+            return None, f"rc={p.returncode} stderr={p.stderr[-400:]}"
+        return lines[-1], ""
+
+    up = False
+    for attempt in range(retries):
+        out, err = child({"BENCH_CHILD": "1", "BENCH_PROBE": "1"},
+                         init_timeout)
+        if out is not None:
+            up = True
+            break
+        _log_attempt("DOWN", f"probe {attempt + 1}/{retries}: {err}")
+        if attempt < retries - 1:
+            time.sleep(min(60 * (2 ** attempt), 300))
+    if not up:
+        print(json.dumps({"metric": "full_suite", "value": 0,
+                          "unit": "rows/s", "vs_baseline": 0,
+                          "detail": {"scoring": False,
+                                     "error": "tpu tunnel down; see "
+                                              "chip_evidence/relay_attempts.log"}}))
+        return 2
+    _log_attempt("UP", "running full suite")
+    out, err = child({"BENCH_CHILD": "1", "BENCH_FULL": "1"},
+                     init_timeout + run_timeout)
+    if out is None:
+        _log_attempt("FAIL", err)
+        print(json.dumps({"metric": "full_suite", "value": 0,
+                          "unit": "rows/s", "vs_baseline": 0,
+                          "detail": {"scoring": False, "error": err}}))
+        return 1
+    doc = json.loads(out)
+    if not doc.get("detail", {}).get("scoring"):
+        # probe succeeded but the backend is CPU (axon plugin absent /
+        # misconfigured): NOT chip evidence -- log, don't persist
+        _log_attempt("NON-SCORING",
+                     doc.get("detail", {}).get("platform", "?"))
+        print(out)
+        return 2
+    ts = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    os.makedirs(EVIDENCE_DIR, exist_ok=True)
+    path = os.path.join(EVIDENCE_DIR, f"evidence_{ts}.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    _log_attempt("CAPTURED", path)
+    print(out)
+    return 0
+
+
+def _bench_full():
+    """BENCH_FULL child: every benchmark in one process (backend init
+    and the staged lineitem columns are paid once)."""
+    import contextlib
+    import io
+
+    import jax
+
+    sf = float(os.environ.get("BENCH_SF", "1"))
+    iters = int(os.environ.get("BENCH_ITERS", "5"))
+    platform = jax.devices()[0].platform
+    results = {}
+
+    def capture(name, fn):
+        buf = io.StringIO()
+        t0 = time.time()
+        try:
+            with contextlib.redirect_stdout(buf):
+                fn()
+            line = [l for l in buf.getvalue().splitlines()
+                    if l.startswith("{")][-1]
+            results[name] = json.loads(line)
+        except Exception as e:  # noqa: BLE001 -- evidence for every bench
+            results[name] = {"error": f"{type(e).__name__}: {e}"[:500]}
+        results[name]["bench_wall_s"] = round(time.time() - t0, 1)
+
+    os.environ["BENCH_QUERY"] = "q1"
+    capture("q1", main)
+    capture("q6", lambda: _bench_q6(sf, iters, platform))
+    # no capacity hints: the connector-NDV refinement pass sizes group
+    # tables and join capacities (the stats-driven path the round-3
+    # verdict asked to stand on its own)
+    capture("q3", lambda: _bench_sql_join("q3", TPCH_Q3, sf, platform))
+    capture("q14", lambda: _bench_sql_join("q14", TPCH_Q14, sf, platform))
+    capture("groupby_large_g", lambda: _bench_large_g(platform, iters))
+    value = results.get("q1", {}).get("value", 0)
+    vsb = results.get("q1", {}).get("vs_baseline", 0)
+    print(json.dumps({
+        "metric": "full_suite", "value": value, "unit": "rows/s",
+        "vs_baseline": vsb,
+        "detail": {"platform": platform,
+                   "scoring": not platform.startswith("cpu"),
+                   "sf": sf,
+                   "captured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                 time.gmtime()),
+                   "benchmarks": results}}))
+
+
+# Official TPC-H q3/q14 (BASELINE config 2), dialect-adapted like
+# queries/tpch_queries.py (unprefixed generator columns via aliases).
+TPCH_Q3 = """
+SELECT l.orderkey, sum(l.extendedprice * (1 - l.discount)) AS revenue,
+       o.orderdate, o.shippriority
+FROM customer c
+JOIN orders o ON c.custkey = o.custkey
+JOIN lineitem l ON l.orderkey = o.orderkey
+WHERE c.mktsegment = 'BUILDING'
+  AND o.orderdate < date '1995-03-15' AND l.shipdate > date '1995-03-15'
+GROUP BY l.orderkey, o.orderdate, o.shippriority
+ORDER BY revenue DESC, o.orderdate
+LIMIT 10
+"""
+
+TPCH_Q14 = """
+SELECT 100.00 * sum(CASE WHEN p.type LIKE 'PROMO%'
+                    THEN l.extendedprice * (1 - l.discount)
+                    ELSE 0 END)
+       / sum(l.extendedprice * (1 - l.discount)) AS promo_revenue
+FROM lineitem l JOIN part p ON l.partkey = p.partkey
+WHERE l.shipdate >= date '1995-09-01' AND l.shipdate < date '1995-10-01'
+"""
+
+
+def _bench_sql_join(name, sql_text, sf, platform, **hints):
+    """End-to-end wall time of a join config through the SQL front door
+    (plan + NDV refine + stage + execute; second run reuses the XLA
+    compile cache, so run2 - run1 separates compile from execute)."""
+    from presto_tpu.connectors import tpch
+    from presto_tpu.sql import sql as run_sql
+
+    n = tpch.table_row_count("lineitem", sf)
+    t0 = time.time()
+    run_sql(sql_text, sf=sf, **hints)
+    cold_s = time.time() - t0
+    t0 = time.time()
+    res = run_sql(sql_text, sf=sf, **hints)
+    warm_s = time.time() - t0
+    print(json.dumps({
+        "metric": f"tpch_sf{sf:g}_{name}_rows_per_sec",
+        "value": round(n / warm_s), "unit": "rows/s", "vs_baseline": 0,
+        "detail": {"path": "sql-front-door end-to-end (incl. staging)",
+                   "cold_wall_s": round(cold_s, 3),
+                   "warm_wall_s": round(warm_s, 3),
+                   "rows": n, "row_count": res.row_count,
+                   "platform": platform,
+                   "scoring": not platform.startswith("cpu")}}))
+
+
+def _bench_large_g(platform, iters):
+    """Sorted-mode group-by (the G>64 default since round 3, never yet
+    measured on a chip): N=4M rows, G=128k groups, sum(int64)."""
+    import jax
+
+    from presto_tpu import types as T
+    from presto_tpu.block import batch_from_numpy
+    from presto_tpu.ops.aggregation import AggSpec, group_by
+
+    n, g = 4_000_000, 1 << 17
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, g, n).astype(np.int64)
+    vals = rng.integers(-(10 ** 6), 10 ** 6, n).astype(np.int64)
+    batch = jax.block_until_ready(jax.device_put(
+        batch_from_numpy([T.BIGINT, T.BIGINT], [keys, vals], capacity=n)))
+    spec = [AggSpec("sum", 1, T.BIGINT)]
+
+    t_compile = time.time()
+    run = jax.jit(lambda b: group_by(b, [0], spec, g).batch)
+    jax.device_get(run(batch))
+    compile_s = time.time() - t_compile
+
+    dt, fallback = _diff_windows(run, batch, iters)
+    print(json.dumps({
+        "metric": "groupby_sorted_128k_rows_per_sec",
+        "value": round(n / dt), "unit": "rows/s", "vs_baseline": 0,
+        "detail": {"n": n, "groups": g, "wall_s": round(dt, 5),
+                   "compile_s": round(compile_s, 1),
+                   "timing_fallback": fallback,
+                   "platform": platform,
+                   "scoring": not platform.startswith("cpu")}}))
 
 
 def main():
@@ -246,6 +471,20 @@ def _stage_and_time(host_cols, columns, capacity, pipeline_fn, iters,
         raise RuntimeError("benchmark plan overflowed a static capacity; "
                            "timing would measure garbage")
 
+    global _TIMING_FALLBACK
+    dt, _TIMING_FALLBACK = _diff_windows(run, batch, iters)
+    staged_bytes = sum(x.nbytes for x in jax.tree_util.tree_leaves(batch))
+    return dt, staged_bytes
+
+
+def _diff_windows(run, batch, iters):
+    """The one timing method every benchmark shares: time `iters` and
+    `2*iters` windows (each ended by a real host fetch) and difference
+    them, cancelling the fixed tunnel round-trip. Returns (dt, fallback);
+    fallback=True means the differencing hit the noise floor and the
+    larger window's mean (round trip included) was reported instead."""
+    import jax
+
     def window(k):
         t0 = time.time()
         out = None
@@ -257,12 +496,9 @@ def _stage_and_time(host_cols, columns, capacity, pipeline_fn, iters,
     t_small = window(iters)
     t_big = window(2 * iters)
     dt = (t_big - t_small) / iters
-    global _TIMING_FALLBACK
-    _TIMING_FALLBACK = dt <= 0
-    if _TIMING_FALLBACK:  # noise floor: larger window's mean, round trip
-        dt = t_big / (2 * iters)  # included -- flagged in the JSON detail
-    staged_bytes = sum(x.nbytes for x in jax.tree_util.tree_leaves(batch))
-    return dt, staged_bytes
+    if dt <= 0:
+        return t_big / (2 * iters), True
+    return dt, False
 
 
 _TIMING_FALLBACK = False
@@ -295,7 +531,11 @@ if __name__ == "__main__":
         import jax
         jax.devices()  # blocks while the tunnel is wedged; parent times out
         print(json.dumps({"probe": "ok"}))
+    elif os.environ.get("BENCH_FULL"):
+        _bench_full()
     elif os.environ.get("BENCH_CHILD"):
         main()
+    elif "--full" in sys.argv:
+        sys.exit(_full_main())
     else:
         sys.exit(_watchdog_main())

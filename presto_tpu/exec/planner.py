@@ -54,6 +54,11 @@ class CompiledPlan:
     scan_nodes: List[N.PlanNode]
     output_types: List[T.Type]
     distributed: bool
+    # the exact plan object this program was traced from; a cache hit
+    # must route node-id-keyed side computations (dynamic filters,
+    # output names) through THIS tree, not the structurally-equal twin
+    # the caller handed in (ids differ across plannings)
+    root: "N.PlanNode" = None
 
 
 def _collect_scans(node: N.PlanNode, out: List[N.PlanNode], _seen=None):
@@ -349,4 +354,4 @@ def compile_plan(root: N.PlanNode, mesh=None,
                            out_specs=(P(WORKERS_AXIS), P()), check_vma=False)
     else:
         fn = run
-    return CompiledPlan(fn, scans, root.output_types(), dist)
+    return CompiledPlan(fn, scans, root.output_types(), dist, root)

@@ -54,8 +54,12 @@ def _scan_batch(node: N.PlanNode, sf: float, capacity_hint: Optional[int],
         arrays = []
         for ci, ty in enumerate(node.types):
             col = [r[ci] for r in node.rows]
-            if ty.is_string or (ty.is_decimal and not ty.is_short_decimal):
-                arrays.append(np.array(col, dtype=object))
+            if ty.is_string or ty.base == "array" or \
+                    (ty.is_decimal and not ty.is_short_decimal):
+                a = np.empty(len(col), dtype=object)
+                for i, v in enumerate(col):
+                    a[i] = v
+                arrays.append(a)
             else:
                 arrays.append(np.array(col, dtype=ty.to_dtype()))
         cap = capacity_hint or -(-len(node.rows) // pad_multiple) * pad_multiple
@@ -172,11 +176,24 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
             res = _batch_to_result(out_b, root)
             res.stats = stats.snapshot()
             return res
-    plan = compile_plan(root, mesh, default_join_capacity)
     pad = (mesh.devices.size if mesh is not None else 1) * 8
     hints = capacity_hints or {}
     scan_ranges = scan_ranges or {}
     remote_sources = remote_sources or {}
+    # Compiled-plan cache (exec/plan_cache.py): repeat submissions of a
+    # structurally identical plan reuse the jitted executable instead of
+    # re-tracing + re-compiling. Per-node-id kwargs (hints/ranges/remote
+    # sources) refer to THIS plan object's ids, which a cached plan does
+    # not share -- those callers (the fragment tier) compile fresh.
+    use_cache = not hints and not scan_ranges and not remote_sources
+    if use_cache:
+        from .plan_cache import cached_compile
+        plan, jfn, call_lock = cached_compile(root, mesh,
+                                              default_join_capacity)
+        root = plan.root  # canonical tree: node ids match plan.scan_nodes
+    else:
+        plan = compile_plan(root, mesh, default_join_capacity)
+        jfn, call_lock = None, None
     # dynamic filtering (local tier): dimension build sides run first
     # and their key domains prune fact scans at staging time
     dyn_filters = {}
@@ -235,8 +252,12 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
             # with bigger static buckets instead.
             scale = 1
             while True:
-                fn = jax.jit(plan.fn)
-                out, overflow = fn(tuple(batches))
+                if jfn is None:
+                    fn = jax.jit(plan.fn)
+                    out, overflow = fn(tuple(batches))
+                else:
+                    with call_lock:  # serialize trace-time closure state
+                        out, overflow = jfn(tuple(batches))
                 jax.block_until_ready(out)
                 flags = int(np.asarray(overflow))
                 if flags == 0:
@@ -256,8 +277,14 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
                         "exchange slot overflow did not converge")
                 scale *= 2
                 stats.add("exchange_slot_reruns", 1)
-                plan = compile_plan(root, mesh, default_join_capacity,
-                                    exchange_slot_scale=scale)
+                if use_cache:
+                    from .plan_cache import cached_compile
+                    plan, jfn, call_lock = cached_compile(
+                        root, mesh, default_join_capacity,
+                        exchange_slot_scale=scale)
+                else:
+                    plan = compile_plan(root, mesh, default_join_capacity,
+                                        exchange_slot_scale=scale)
         with stats.timed("fetch_s"):
             res = _batch_to_result(out, root)
     finally:

@@ -98,6 +98,10 @@ class AggSpec:
     second_channel: Optional[int] = None
     second_type: Optional[T.Type] = None  # order-value type for min_by/max_by
     parameter: Optional[float] = None     # percentile fraction etc.
+    # BOOLEAN channel restricting which rows this aggregate consumes
+    # (Aggregation.getMask() -- the MarkDistinct + masked-agg lowering of
+    # DISTINCT aggregates, and FILTER (WHERE ...) clauses)
+    mask_channel: Optional[int] = None
 
     # NOTE: unknown names are allowed at construction so plan JSON from a
     # newer coordinator can still be dry-run through validate_plan (the
@@ -428,6 +432,12 @@ def _sorted_capable(batch: Batch, key_channels, aggs) -> bool:
     kernel.)"""
     if not key_channels:
         return False
+    # a masked value-order agg would miscount: the mask doesn't join the
+    # sort, so a masked-off row can shadow a live duplicate's
+    # first-occurrence flag. The hash path's dedicated kernel is exact.
+    if any(s.mask_channel is not None and s.canonical in _VALUE_ORDER_AGGS
+           for s in aggs):
+        return False
     vo_chans = {s.input_channel for s in aggs
                 if s.canonical in _VALUE_ORDER_AGGS}
     if len(vo_chans) > 1:
@@ -456,7 +466,10 @@ def _sorted_states(spec: AggSpec, scol, live, start, end, new_seg,
     name = spec.canonical
     zeros_g = jnp.zeros(g, dtype=bool)
     if name == "count_star":
-        cnt = (end - start).astype(jnp.int64)
+        if spec.mask_channel is not None:
+            cnt = _seg_total(live.astype(jnp.int64), start, end)
+        else:
+            cnt = (end - start).astype(jnp.int64)
         return [("count", Column(cnt, zeros_g, T.BIGINT))]
 
     nn = _seg_total(live.astype(jnp.int64), start, end)
@@ -616,17 +629,32 @@ def _group_by_sorted(batch: Batch, key_channels, aggs, max_groups: int
         return sorted_cols[ch]
 
     for spec in aggs:
+        act = s_active
+        if spec.mask_channel is not None:
+            m = sorted_col(spec.mask_channel)
+            act = act & m.values.astype(bool) & ~m.nulls
         if spec.input_channel is None:
-            scol, live = None, s_active
+            scol, live = None, act
         else:
             scol = sorted_col(spec.input_channel)
-            live = s_active & ~scol.nulls
+            live = act & ~scol.nulls
         for _, state in _sorted_states(spec, scol, live, start, end,
                                        new_seg, s_active, pair_first,
                                        max_groups):
             out_cols.append(state)
     return GroupByResult(Batch(tuple(out_cols), slot_active),
                          num_groups, overflow)
+
+
+def _masked_active(batch: Batch, spec: AggSpec) -> jnp.ndarray:
+    """Rows this aggregate consumes: batch.active further restricted by
+    the spec's BOOLEAN mask column (NULL mask = excluded)."""
+    if spec.mask_channel is None:
+        return batch.active
+    mc = batch.column(spec.mask_channel)
+    if isinstance(mc, DictionaryColumn):
+        mc = mc.decode()
+    return batch.active & mc.values.astype(bool) & ~mc.nulls
 
 
 def _sum_dtype(ty: T.Type):
@@ -896,7 +924,8 @@ def group_by(batch: Batch, key_channels: Sequence[int], aggs: Sequence[AggSpec],
         out_cols.append(_gather_block(k, perm_first, slot_active))
     for spec in aggs:
         col = None if spec.input_channel is None else batch.column(spec.input_channel)
-        for _, state in _acc_columns(spec, col, ids, batch.active, max_groups,
+        for _, state in _acc_columns(spec, col, ids,
+                                     _masked_active(batch, spec), max_groups,
                                      batch, overflow_out=sub_overflow):
             out_cols.append(state)
     for f in sub_overflow:

@@ -114,11 +114,20 @@ def q6_local() -> Callable[[Batch], jnp.ndarray]:
 
     def run(batch: Batch):
         b = proj(filt(batch))
-        # global aggregation (no keys -> one group): a direct masked sum
+        # global aggregation (no keys -> one group): a direct masked sum.
+        # decimal(24,4) values ride int128 lanes; the exact-sum recipe is
+        # the same 13-bit-limb decomposition the group-by kernel uses.
         vals = b.column(0)
         live = b.active & ~vals.nulls
-        s = jnp.sum(jnp.where(live, vals.values, 0))
-        return s
+        from ..block import Int128Column
+        if isinstance(vals, Int128Column):
+            from ..int128 import combine_limb_totals_128, limbs13_of_128
+            limbs = limbs13_of_128(vals.hi, vals.lo)
+            totals = jnp.stack([jnp.sum(jnp.where(live, l, 0))
+                                for l in limbs], axis=-1)
+            hi, lo = combine_limb_totals_128(totals[None, :])
+            return hi[0], lo[0]
+        return jnp.sum(jnp.where(live, vals.values, 0))
 
     return run
 

@@ -150,6 +150,40 @@ def _serialize_varwidth(vals: np.ndarray, nulls: np.ndarray) -> bytes:
         blob])
 
 
+def _serialize_array(vals: np.ndarray, nulls: np.ndarray,
+                     ty: T.Type) -> bytes:
+    """ARRAY encoding (ArrayBlockEncoding.java): flattened child block,
+    then positionCount, then N+1 cumulative offsets, then null bits.
+    `vals` is an object array of per-row lists (None = null row)."""
+    rows = len(vals)
+    elem_ty = ty.element_type
+    flat, offsets = [], [0]
+    for i in range(rows):
+        if nulls[i] or vals[i] is None:
+            offsets.append(offsets[-1])
+            continue
+        flat.extend(vals[i])
+        offsets.append(offsets[-1] + len(vals[i]))
+    fnulls = np.array([e is None for e in flat], dtype=bool)
+    if elem_ty.is_string:
+        fvals = np.array(["" if e is None else e for e in flat],
+                         dtype=object)
+        child = _serialize_varwidth(fvals, fnulls)
+    elif elem_ty.is_decimal and not elem_ty.is_short_decimal:
+        fvals = np.array([0 if e is None else e for e in flat],
+                         dtype=object)
+        child = _serialize_int128(fvals, fnulls)
+    else:
+        fvals = np.array([0 if e is None else e for e in flat],
+                         dtype=elem_ty.to_dtype())
+        child = _serialize_fixed(fvals, fnulls)
+    enc = b"ARRAY"
+    return b"".join([struct.pack("<i", len(enc)), enc, child,
+                     struct.pack("<i", rows),
+                     np.asarray(offsets, dtype=np.int32).tobytes(),
+                     _bitpack_nulls(np.asarray(nulls, dtype=bool))])
+
+
 def _serialize_block(block: Block) -> bytes:
     if isinstance(block, DictionaryColumn):
         rows = len(block)
@@ -163,9 +197,11 @@ def _serialize_block(block: Block) -> bytes:
     v, n = to_numpy(block)
     if isinstance(block, StringColumn):
         return _serialize_varwidth(v, n)
-    from ..block import Int128Column
+    from ..block import ArrayColumn, Int128Column
     if isinstance(block, Int128Column):
         return _serialize_int128(v, n)
+    if isinstance(block, ArrayColumn):
+        return _serialize_array(v, n, block.type)
     return _serialize_fixed(v, n)
 
 
@@ -189,6 +225,9 @@ def serialize_page(columns: Sequence[Tuple[T.Type, np.ndarray, np.ndarray]],
     for ty, vals, nulls in columns:
         if ty.is_string:
             body.append(_serialize_varwidth(vals, nulls))
+        elif ty.base == "array":
+            body.append(_serialize_array(vals,
+                                         np.asarray(nulls, dtype=bool), ty))
         elif ty.is_decimal and not ty.is_short_decimal:
             body.append(_serialize_int128(vals,
                                           np.asarray(nulls, dtype=bool)))
@@ -310,6 +349,27 @@ def _deserialize_block(mv: memoryview, pos: int, ty: Optional[T.Type]):
         pos += 4
         (dvals, dnulls), pos = _deserialize_block(mv, pos, ty)
         return (np.repeat(dvals[:1], rows), np.repeat(dnulls[:1], rows)), pos
+    if enc == b"ARRAY":
+        elem_ty = ty.element_type if ty is not None and \
+            ty.base == "array" else None
+        (evals, enulls), pos = _deserialize_block(mv, pos, elem_ty)
+        (rows,) = struct.unpack_from("<i", mv, pos)
+        pos += 4
+        offsets = np.frombuffer(mv[pos:pos + (rows + 1) * 4],
+                                dtype=np.int32)
+        pos += (rows + 1) * 4
+        nulls, pos = _bitunpack_nulls(mv, pos, rows)
+        vals = np.empty(rows, dtype=object)
+        for i in range(rows):
+            if nulls[i]:
+                vals[i] = None
+            else:
+                vals[i] = [None if enulls[k] else
+                           (evals[k].item() if isinstance(evals[k],
+                                                          np.generic)
+                            else evals[k])
+                           for k in range(offsets[i], offsets[i + 1])]
+        return (vals, nulls), pos
     raise NotImplementedError(f"block encoding {enc!r}")
 
 

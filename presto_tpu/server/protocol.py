@@ -12,9 +12,14 @@ supported vocabulary raises ProtocolUnsupported with the construct
 named (the VeloxPlanValidator rejection contract, which the
 plan-checker-router uses to fall back to a Java cluster).
 
-Supported slice (round 3): TableScanNode (tpch connector handle),
-FilterNode, ProjectNode, AggregationNode (SINGLE + single-state
-PARTIAL/FINAL), ValuesNode, LimitNode, SortNode, TopNNode, REMOTE/LOCAL
+Supported slice (round 4): TableScanNode (tpch/tpcds connector
+handles), FilterNode, ProjectNode, AggregationNode (SINGLE +
+single-state PARTIAL/FINAL, masks, DISTINCT via MarkDistinct lowering),
+ValuesNode, LimitNode, SortNode, TopNNode, JoinNode (INNER/LEFT/RIGHT/
+FULL equi-joins + INNER residual filters, PrestoToVeloxQueryPlan.cpp:60
+analog), SemiJoinNode, WindowNode (ranking family + framed aggregates),
+RowNumberNode, TopNRowNumberNode (ROW_NUMBER ranking), MarkDistinctNode,
+DistinctLimitNode, GroupIdNode, UnnestNode (single array), REMOTE/LOCAL
 ExchangeNode, RemoteSourceNode, OutputNode; RowExpressions (variable /
 constant-with-valueBlock / call / special); TaskInfo & TaskStatus
 emitted with the spec's field names (main/tests/data/TaskInfo.json
@@ -178,6 +183,74 @@ def _strip_type_suffix(key: str) -> str:
     return key.split("<", 1)[0]
 
 
+def _ordering_keys(scheme: dict, layout) -> List[Tuple[int, bool, bool]]:
+    """OrderingScheme JSON -> engine (channel, descending, nulls_last)
+    triples."""
+    keys = []
+    for ob in scheme.get("orderBy", []):
+        v = ob.get("variable", ob)
+        order = ob.get("sortOrder", "ASC_NULLS_LAST")
+        keys.append((_lookup(layout, v["name"])[0],
+                     order.startswith("DESC"), order.endswith("NULLS_LAST")))
+    return keys
+
+
+def _project_to(src: N.PlanNode, src_out: List[Tuple[str, T.Type]],
+                want: List[Tuple[str, T.Type]]
+                ) -> Tuple[N.PlanNode, List[Tuple[str, T.Type]]]:
+    """Select/reorder `src` columns to the `want` layout (identity when
+    already aligned) -- how outputVariables contracts are honored."""
+    if [n for n, _ in src_out] == [n for n, _ in want]:
+        return src, src_out
+    layout = _layout_of(src_out)
+    exprs = []
+    for name, _ty in want:
+        ch, ty = _lookup(layout, name)
+        exprs.append(E.input_ref(ch, ty))
+    return N.ProjectNode(src, exprs), [(n, e.type)
+                                       for (n, _), e in zip(want, exprs)]
+
+
+# ranking-family window functions take their frame from the partition
+# itself; the reference always ships them with a default frame
+_RANKING_WINDOW_FUNCS = ("row_number", "rank", "dense_rank",
+                         "percent_rank", "cume_dist", "ntile",
+                         "lag", "lead")
+
+
+def _window_frame(fj: dict, fname: str):
+    """WindowNode.Frame JSON -> engine frame descriptor."""
+    if fname in _RANKING_WINDOW_FUNCS:
+        return "range_current"
+    t = fj.get("type", "RANGE")
+    st, et = fj.get("startType"), fj.get("endType")
+    if st == "UNBOUNDED_PRECEDING" and et == "UNBOUNDED_FOLLOWING":
+        return "full"
+    if t == "RANGE":
+        if st == "UNBOUNDED_PRECEDING" and et == "CURRENT_ROW":
+            return "range_current"
+        raise ProtocolUnsupported(f"RANGE frame {st}..{et}")
+    if t == "ROWS":
+        def bound(side, orig):
+            if side in ("UNBOUNDED_PRECEDING", "UNBOUNDED_FOLLOWING"):
+                return None
+            if side == "CURRENT_ROW":
+                return 0
+            if side in ("PRECEDING", "FOLLOWING"):
+                # bound values ship as pre-projected variables; the
+                # original literal text rides originalStart/EndValue
+                s = str(orig) if orig is not None else ""
+                if not s.lstrip("-").isdigit():
+                    raise ProtocolUnsupported(
+                        f"non-literal ROWS frame bound {orig!r}")
+                k = int(s)
+                return -k if side == "PRECEDING" else k
+            raise ProtocolUnsupported(f"frame bound type {side!r}")
+        return ("rows", bound(st, fj.get("originalStartValue")),
+                bound(et, fj.get("originalEndValue")))
+    raise ProtocolUnsupported(f"window frame type {t!r}")
+
+
 def translate_node(j: dict) -> Tuple[N.PlanNode, List[Tuple[str, T.Type]]]:
     """Reference plan-node JSON -> (engine node, output layout)."""
     kind = _node_kind(j)
@@ -253,6 +326,7 @@ def translate_node(j: dict) -> Tuple[N.PlanNode, List[Tuple[str, T.Type]]]:
             out.append((v["name"], ty))
         step = j.get("step", "SINGLE")
         specs = []
+        n_markers = 0  # MarkDistinct wrappers appended below src
         for key, agg in j.get("aggregations", {}).items():
             name = _strip_type_suffix(key)
             call = agg.get("call", agg)
@@ -260,21 +334,43 @@ def translate_node(j: dict) -> Tuple[N.PlanNode, List[Tuple[str, T.Type]]]:
                                             agg.get("functionHandle", {})))
             rty = _type_of(call["returnType"])
             args = call.get("arguments", [])
-            if agg.get("mask") is not None or agg.get("orderBy"):
-                raise ProtocolUnsupported("masked/ordered aggregation")
-            if agg.get("distinct"):
-                if fname != "count":
+            if agg.get("orderBy"):
+                raise ProtocolUnsupported("ordered aggregation")
+            mask_ch = None
+            if agg.get("mask") is not None:
+                # Aggregation.getMask(): a BOOLEAN column (the
+                # coordinator's MarkDistinct / FILTER lowering) gating
+                # which rows this aggregate consumes
+                mask_ch, mty = _lookup(layout, agg["mask"]["name"])
+                if not mty.base == "boolean":
                     raise ProtocolUnsupported(
-                        f"DISTINCT qualifier on {fname!r}")
-                fname = "count_distinct"
+                        f"non-boolean aggregation mask {agg['mask']!r}")
+            if agg.get("distinct"):
+                if mask_ch is not None:
+                    raise ProtocolUnsupported("DISTINCT with explicit mask")
+                if fname in ("count", "approx_distinct"):
+                    fname = "count_distinct"
+                elif step == "SINGLE" and len(args) == 1 and \
+                        args[0].get("@type") == "variable":
+                    # worker-side MultipleDistinctAggregationToMarkDistinct
+                    # analog: mark first (group keys, arg) occurrences,
+                    # aggregate only marked rows
+                    ch, _ty = _lookup(layout, args[0]["name"])
+                    src = N.MarkDistinctNode(src, key_channels=keys + [ch])
+                    mask_ch = len(src_out) + n_markers
+                    n_markers += 1
+                else:
+                    raise ProtocolUnsupported(
+                        f"DISTINCT {fname!r} at step {step}")
             if fname == "count" and not args:
-                spec = AggSpec("count_star", None, T.BIGINT)
+                spec = AggSpec("count_star", None, T.BIGINT,
+                               mask_channel=mask_ch)
             else:
                 if len(args) != 1 or args[0].get("@type") != "variable":
                     raise ProtocolUnsupported(
                         f"aggregation argument shape for {fname!r}")
                 ch, _ty = _lookup(layout, args[0]["name"])
-                spec = AggSpec(fname, ch, rty)
+                spec = AggSpec(fname, ch, rty, mask_channel=mask_ch)
             if step in ("PARTIAL", "FINAL", "INTERMEDIATE") and \
                     spec.canonical in ("avg", "var_samp", "var_pop",
                                        "stddev_samp", "stddev_pop",
@@ -370,6 +466,216 @@ def translate_node(j: dict) -> Tuple[N.PlanNode, List[Tuple[str, T.Type]]]:
     if kind == "OutputNode":
         src, src_out = translate_node(j["source"])
         return N.OutputNode(src, list(j.get("columnNames", []))), src_out
+
+    if kind == "JoinNode":
+        # PrestoToVeloxQueryPlan.cpp:60 analog: equi-criteria to engine
+        # key channels, outputVariables honored via projection
+        left, left_out = translate_node(j["left"])
+        right, right_out = translate_node(j["right"])
+        jt = j.get("type", "INNER").upper()
+        if jt not in ("INNER", "LEFT", "RIGHT", "FULL"):
+            raise ProtocolUnsupported(f"join type {jt!r}")
+        criteria = j.get("criteria", [])
+        if not criteria:
+            raise ProtocolUnsupported("cross join (no equi criteria)")
+        llay, rlay = _layout_of(left_out), _layout_of(right_out)
+        lkeys = [_lookup(llay, c["left"]["name"])[0] for c in criteria]
+        rkeys = [_lookup(rlay, c["right"]["name"])[0] for c in criteria]
+        dist = j.get("distributionType") or "PARTITIONED"
+        node = N.JoinNode(left, right, lkeys, rkeys, join_type=jt.lower(),
+                          distribution="broadcast" if dist == "REPLICATED"
+                          else "partitioned")
+        comb = left_out + right_out
+        filt = j.get("filter")
+        if filt is not None:
+            if jt != "INNER":
+                raise ProtocolUnsupported(
+                    f"residual join filter on {jt} join (post-filter "
+                    "changes outer-join semantics)")
+            node = N.FilterNode(node, translate_row_expression(
+                filt, _layout_of(comb)))
+        want = _vars(j["outputVariables"])
+        return _project_to(node, comb, want)
+
+    if kind == "SemiJoinNode":
+        src, src_out = translate_node(j["source"])
+        filt, filt_out = translate_node(j["filteringSource"])
+        slay, flay = _layout_of(src_out), _layout_of(filt_out)
+        s_ch = _lookup(slay, j["sourceJoinVariable"]["name"])[0]
+        f_ch = _lookup(flay, j["filteringSourceJoinVariable"]["name"])[0]
+        node = N.SemiJoinNode(src, filt, s_ch, f_ch)
+        out = src_out + [(j["semiJoinOutput"]["name"], T.BOOLEAN)]
+        return node, out
+
+    if kind == "WindowNode":
+        src, src_out = translate_node(j["source"])
+        layout = _layout_of(src_out)
+        spec = j.get("specification", {})
+        parts = [_lookup(layout, v["name"])[0]
+                 for v in spec.get("partitionBy", [])]
+        order = _ordering_keys(spec.get("orderingScheme") or {}, layout)
+        functions, out = [], list(src_out)
+        for key, fn_j in j.get("windowFunctions", {}).items():
+            if fn_j.get("ignoreNulls"):
+                raise ProtocolUnsupported("IGNORE NULLS window function")
+            fc = fn_j.get("functionCall", {})
+            fname = _function_name(fc.get("functionHandle", {}))
+            rty = _type_of(fc["returnType"])
+            args = fc.get("arguments", [])
+
+            def const_int(a):
+                if a.get("@type") != "constant":
+                    raise ProtocolUnsupported(
+                        "non-constant window function parameter")
+                v = decode_constant_block(a["valueBlock"],
+                                          _type_of(a["type"]))
+                return int(v)
+
+            ch, k = None, None
+            if fname in ("lag", "lead"):
+                if not args or args[0].get("@type") != "variable":
+                    raise ProtocolUnsupported(f"{fname} argument shape")
+                ch = _lookup(layout, args[0]["name"])[0]
+                if len(args) > 2:
+                    raise ProtocolUnsupported(f"{fname} default value")
+                if len(args) == 2:
+                    k = const_int(args[1])
+            elif fname == "nth_value":
+                if len(args) != 2 or args[0].get("@type") != "variable":
+                    raise ProtocolUnsupported("nth_value argument shape")
+                ch = _lookup(layout, args[0]["name"])[0]
+                k = const_int(args[1])
+            elif fname == "ntile":
+                if len(args) != 1:
+                    raise ProtocolUnsupported("ntile argument shape")
+                k = const_int(args[0])
+            elif fname in ("row_number", "rank", "dense_rank",
+                           "percent_rank", "cume_dist"):
+                pass
+            elif fname in ("sum", "count", "avg", "min", "max",
+                           "first_value", "last_value"):
+                if len(args) != 1 or args[0].get("@type") != "variable":
+                    raise ProtocolUnsupported(f"window {fname} args")
+                ch = _lookup(layout, args[0]["name"])[0]
+            else:
+                raise ProtocolUnsupported(f"window function {fname!r}")
+            frame = _window_frame(fn_j.get("frame", {}), fname)
+            functions.append((fname, ch, rty, frame, k))
+            out.append((_strip_type_suffix(key), rty))
+        node = N.WindowNode(src, parts, order, functions)
+        return node, out
+
+    if kind == "RowNumberNode":
+        src, src_out = translate_node(j["source"])
+        layout = _layout_of(src_out)
+        parts = [_lookup(layout, v["name"])[0]
+                 for v in j.get("partitionBy", [])]
+        node = N.RowNumberNode(src, parts, [],
+                               j.get("maxRowCountPerPartition"))
+        out = list(src_out)
+        if not j.get("partial"):
+            out.append((j["rowNumberVariable"]["name"], T.BIGINT))
+            return node, out
+        # partial: the row-number column is consumed, not emitted
+        return _project_to(node, src_out + [("$row_number", T.BIGINT)],
+                           src_out)
+
+    if kind == "TopNRowNumberNode":
+        src, src_out = translate_node(j["source"])
+        layout = _layout_of(src_out)
+        if j.get("rankingType", "ROW_NUMBER") != "ROW_NUMBER":
+            raise ProtocolUnsupported(
+                f"ranking function {j.get('rankingType')!r}")
+        spec = j.get("specification", {})
+        parts = [_lookup(layout, v["name"])[0]
+                 for v in spec.get("partitionBy", [])]
+        order = _ordering_keys(spec.get("orderingScheme") or {}, layout)
+        node = N.RowNumberNode(src, parts, order,
+                               int(j["maxRowCountPerPartition"]))
+        if j.get("partial"):
+            return _project_to(node, src_out + [("$row_number", T.BIGINT)],
+                               src_out)
+        out = src_out + [(j["rowNumberVariable"]["name"], T.BIGINT)]
+        return node, out
+
+    if kind == "MarkDistinctNode":
+        src, src_out = translate_node(j["source"])
+        layout = _layout_of(src_out)
+        chans = [_lookup(layout, v["name"])[0]
+                 for v in j.get("distinctVariables", [])]
+        node = N.MarkDistinctNode(src, key_channels=chans)
+        return node, src_out + [(j["markerVariable"]["name"], T.BOOLEAN)]
+
+    if kind == "DistinctLimitNode":
+        src, src_out = translate_node(j["source"])
+        want = _vars(j["distinctVariables"])
+        proj, proj_out = _project_to(src, src_out, want)
+        node = N.LimitNode(
+            N.DistinctNode(proj, list(range(len(proj_out)))),
+            int(j["limit"]))
+        return node, proj_out
+
+    if kind == "GroupIdNode":
+        src, src_out = translate_node(j["source"])
+        layout = _layout_of(src_out)
+        sets = j.get("groupingSets", [])
+        gcols = {_strip_type_suffix(k): v
+                 for k, v in j.get("groupingColumns", {}).items()}
+        grouping_out: List[Tuple[str, T.Type]] = []
+        seen = set()
+        for s in sets:
+            for v in s:
+                if v["name"] not in seen:
+                    seen.add(v["name"])
+                    grouping_out.append((v["name"], _type_of(v["type"])))
+        agg_args = _vars(j.get("aggregationArguments", []))
+        # project the source to [grouping inputs][agg args]
+        exprs = []
+        for name, _ty in grouping_out:
+            inp = gcols.get(name)
+            if inp is None:
+                raise ProtocolUnsupported(
+                    f"grouping output {name!r} missing from "
+                    "groupingColumns")
+            ch, ty = _lookup(layout, inp["name"])
+            exprs.append(E.input_ref(ch, ty))
+        for name, _ty in agg_args:
+            ch, ty = _lookup(layout, name)
+            exprs.append(E.input_ref(ch, ty))
+        proj = N.ProjectNode(src, exprs)
+        pos = {name: i for i, (name, _) in enumerate(grouping_out)}
+        node = N.GroupIdNode(proj, grouping_sets=[
+            [pos[v["name"]] for v in s] for s in sets])
+        out = grouping_out + agg_args + \
+            [(j["groupIdVariable"]["name"], T.BIGINT)]
+        return node, out
+
+    if kind == "UnnestNode":
+        src, src_out = translate_node(j["source"])
+        layout = _layout_of(src_out)
+        unnest_vars = j.get("unnestVariables", {})
+        if len(unnest_vars) != 1:
+            raise ProtocolUnsupported(
+                f"unnest of {len(unnest_vars)} columns (single ARRAY "
+                "supported)")
+        arr_key, elems = next(iter(unnest_vars.items()))
+        if len(elems) != 1:
+            raise ProtocolUnsupported(
+                "unnest emitting multiple element columns (maps land "
+                "with the MAP block)")
+        arr_name = _strip_type_suffix(arr_key)
+        arr_ch, arr_ty = _lookup(layout, arr_name)
+        if arr_ty.base != "array":
+            raise ProtocolUnsupported(f"unnest of {arr_ty.base!r}")
+        repl = _vars(j.get("replicateVariables", []))
+        proj, _ = _project_to(src, src_out, repl + [(arr_name, arr_ty)])
+        ordinality = j.get("ordinalityVariable")
+        node = N.UnnestNode(proj, array_channel=len(repl),
+                            with_ordinality=ordinality is not None)
+        out = repl + [(elems[0]["name"], _type_of(elems[0]["type"]))]
+        if ordinality is not None:
+            out.append((ordinality["name"], T.BIGINT))
+        return node, out
 
     raise ProtocolUnsupported(f"plan node {j.get('@type')!r}")
 

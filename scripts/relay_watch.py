@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Opportunistic TPU-relay watcher (VERDICT round-3 item 2).
+
+The remote-TPU relay ("axon" platform) has been down for whole rounds;
+a single capture attempt at bench time therefore records nothing. This
+watcher probes the tunnel every RELAY_WATCH_INTERVAL seconds for up to
+RELAY_WATCH_HOURS, appending one line per attempt to
+chip_evidence/relay_attempts.log; the moment a probe succeeds it runs
+`bench.py --full --no-retry`, which persists a timestamped chip-evidence
+JSON under chip_evidence/. After a successful capture it keeps watching
+at a lower cadence (fresh evidence beats stale evidence, and the tunnel
+can flap), but never re-captures more than once per hour.
+
+Run it in the background at the start of a round:
+    nohup python scripts/relay_watch.py >> chip_evidence/relay_watch.out &
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+sys.path.insert(0, REPO)
+from bench import _log_attempt as log  # one writer, one log format
+
+INTERVAL_S = float(os.environ.get("RELAY_WATCH_INTERVAL", "900"))
+HOURS = float(os.environ.get("RELAY_WATCH_HOURS", "11"))
+PROBE_TIMEOUT_S = float(os.environ.get("RELAY_WATCH_PROBE_TIMEOUT", "60"))
+RECAPTURE_MIN_GAP_S = 3600.0
+
+
+def probe() -> bool:
+    env = dict(os.environ)
+    env["BENCH_CHILD"] = "1"
+    env["BENCH_PROBE"] = "1"
+    try:
+        p = subprocess.run([sys.executable, BENCH], capture_output=True,
+                           text=True, timeout=PROBE_TIMEOUT_S, env=env)
+        return any(l.startswith("{") for l in p.stdout.splitlines())
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main() -> int:
+    deadline = time.time() + HOURS * 3600
+    last_capture = 0.0
+    log("WATCH-START",
+        f"interval={INTERVAL_S:.0f}s hours={HOURS:g}")
+    while time.time() < deadline:
+        if probe():
+            if time.time() - last_capture >= RECAPTURE_MIN_GAP_S:
+                log("UP", "watcher: capturing full suite")
+                p = subprocess.run([sys.executable, BENCH, "--full",
+                                    "--no-retry"],
+                                   capture_output=True, text=True)
+                ok = False
+                for l in p.stdout.splitlines():
+                    if l.startswith("{"):
+                        ok = json.loads(l).get("detail", {}).get("scoring")
+                log("CAPTURE-" + ("OK" if ok else "FAILED"))
+                last_capture = time.time()
+            # captured recently: idle at the normal cadence
+        else:
+            log("DOWN", "watcher probe")
+        time.sleep(INTERVAL_S)
+    log("WATCH-END")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
