@@ -12,6 +12,7 @@ JSON, pull SerializedPages) once a coordinator fronts it.
 from __future__ import annotations
 
 import argparse
+import re
 import sys
 import time
 
@@ -71,15 +72,48 @@ def run_one(query: str, sf: float, explain_only: bool = False) -> int:
     return 0
 
 
+def run_one_remote(query: str, server: str, user: str = "presto",
+                   session=None) -> int:
+    """Run one statement over the client statement protocol (the
+    presto-cli-to-coordinator path: POST /v1/statement + nextUri)."""
+    from presto_tpu.client import QueryError, execute
+
+    t0 = time.time()
+    try:
+        client = execute(server, query, user=user, session=session or {})
+    except QueryError as e:
+        print(f"error [{e.error_name}]: {e}", file=sys.stderr)
+        return 1
+    dt = time.time() - t0
+    names = [c["name"] for c in (client.columns or [])]
+    # wire values arrive pre-rendered (decimals/dates as strings)
+    rows = [tuple(r) for r in client.data]
+    print(_format_table(names, rows))
+    extra = f", {client.update_type}" if client.update_type else ""
+    print(f"({len(rows)} rows in {dt:.2f}s via {client.query_id}{extra})")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="presto-tpu")
     ap.add_argument("query", nargs="?", help="SQL to run (omit for a REPL)")
     ap.add_argument("--sf", type=float, default=0.01,
                     help="tpch/tpcds scale factor (default 0.01)")
     ap.add_argument("--explain", action="store_true")
+    ap.add_argument("--server", default=None,
+                    help="coordinator URL; statements ride the client "
+                         "protocol instead of the embedded engine")
+    ap.add_argument("--user", default="presto")
     args = ap.parse_args(argv)
 
     if args.query:
+        if args.server:
+            query = args.query
+            if args.explain and not re.match(r"\s*explain\b", query,
+                                             re.IGNORECASE):
+                query = f"EXPLAIN {query}"  # server-side EXPLAIN
+            return run_one_remote(query, args.server, args.user,
+                                  {"sf": str(args.sf)})
         return run_one(args.query, args.sf, args.explain)
 
     print("presto-tpu> (end statements with ';', \\q to quit)")
@@ -96,7 +130,11 @@ def main(argv=None) -> int:
             stmt = "\n".join(buf).rstrip().rstrip(";")
             buf = []
             try:
-                run_one(stmt, args.sf, args.explain)
+                if args.server:
+                    run_one_remote(stmt, args.server, args.user,
+                                   {"sf": str(args.sf)})
+                else:
+                    run_one(stmt, args.sf, args.explain)
             except Exception as e:  # noqa: BLE001 - REPL reports and continues
                 print(f"error: {type(e).__name__}: {e}", file=sys.stderr)
     return 0

@@ -1,0 +1,129 @@
+"""Statement-protocol client: the StatementClientV1 analog.
+
+Reference surface: presto-client's StatementClientV1
+(StatementClientV1.java:88 ctor POSTs /v1/statement; advance():365
+follows `nextUri` until absent, accumulating data pages; response
+headers X-Presto-Set-Session / X-Presto-Started-Transaction-Id /
+X-Presto-Clear-Transaction-Id mutate the client session). This client
+speaks that protocol over the TPU coordinator's statement resource
+(server/statement.py) -- pure stdlib HTTP, no engine imports, so any
+process (or the reference's own clients, which speak the same wire
+shape) can drive the engine remotely.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+from typing import Dict, Iterator, List, Optional, Tuple
+
+__all__ = ["StatementClient", "QueryError", "execute"]
+
+
+class QueryError(RuntimeError):
+    def __init__(self, error: dict):
+        super().__init__(error.get("message", "query failed"))
+        self.error = error
+        self.error_name = error.get("errorName", "GENERIC_INTERNAL_ERROR")
+        self.error_type = error.get("errorType", "INTERNAL_ERROR")
+
+
+class StatementClient:
+    """One statement's lifecycle: POST, then advance() until done."""
+
+    def __init__(self, server_url: str, text: str, user: str = "presto",
+                 session: Optional[Dict[str, str]] = None,
+                 transaction_id: Optional[str] = None,
+                 timeout: float = 120.0):
+        self.server_url = server_url.rstrip("/")
+        self.timeout = timeout
+        self.columns: Optional[List[dict]] = None
+        self.data: List[list] = []
+        self.stats: Dict = {}
+        self.update_type: Optional[str] = None
+        self.set_session: Dict[str, str] = {}
+        self.started_transaction_id: Optional[str] = None
+        self.clear_transaction: bool = False
+        self.query_id: Optional[str] = None
+        self._error: Optional[dict] = None
+
+        headers = {"X-Presto-User": user,
+                   "Content-Type": "text/plain"}
+        if session:
+            headers["X-Presto-Session"] = ",".join(
+                f"{k}={v}" for k, v in session.items())
+        if transaction_id:
+            headers["X-Presto-Transaction-Id"] = transaction_id
+        doc, _ = self._request(f"{self.server_url}/v1/statement",
+                               method="POST", body=text.encode(),
+                               headers=headers)
+        self._absorb(doc, {})
+        self._next_uri = doc.get("nextUri")
+
+    # -- protocol -------------------------------------------------------
+
+    def _request(self, url: str, method: str = "GET",
+                 body: Optional[bytes] = None,
+                 headers: Optional[Dict] = None) -> Tuple[dict, Dict]:
+        req = urllib.request.Request(url, data=body, method=method,
+                                     headers=headers or {})
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            doc = json.loads(resp.read().decode())
+            return doc, dict(resp.headers)
+
+    def _absorb(self, doc: dict, headers: Dict) -> None:
+        self.query_id = doc.get("id", self.query_id)
+        if doc.get("columns") and self.columns is None:
+            self.columns = doc["columns"]
+        if doc.get("data"):
+            self.data.extend(doc["data"])
+        if doc.get("stats"):
+            self.stats = doc["stats"]
+        if doc.get("updateType"):
+            self.update_type = doc["updateType"]
+        if doc.get("error"):
+            self._error = doc["error"]
+        for k, v in headers.items():
+            lk = k.lower()
+            if lk == "x-presto-set-session" and "=" in v:
+                sk, sv = v.split("=", 1)
+                self.set_session[sk] = sv
+            elif lk == "x-presto-started-transaction-id":
+                self.started_transaction_id = v
+            elif lk == "x-presto-clear-transaction-id":
+                self.clear_transaction = True
+
+    def advance(self) -> bool:
+        """Fetch the next results document; False when finished."""
+        if self._next_uri is None:
+            return False
+        doc, headers = self._request(self._next_uri)
+        self._absorb(doc, headers)
+        self._next_uri = doc.get("nextUri")
+        return self._next_uri is not None
+
+    def drain(self) -> "StatementClient":
+        while self.advance():
+            pass
+        if self._error is not None:
+            raise QueryError(self._error)
+        return self
+
+    def cancel(self) -> None:
+        if self._next_uri is not None:
+            try:
+                self._request(self._next_uri, method="DELETE")
+            except Exception:  # noqa: BLE001 - best-effort
+                pass
+            self._next_uri = None
+
+
+def execute(server_url: str, text: str, user: str = "presto",
+            session: Optional[Dict[str, str]] = None,
+            transaction_id: Optional[str] = None,
+            timeout: float = 120.0) -> StatementClient:
+    """POST + drain: returns the finished client (columns/data/stats)."""
+    return StatementClient(server_url, text, user=user, session=session,
+                          transaction_id=transaction_id,
+                          timeout=timeout).drain()

@@ -34,7 +34,15 @@ class ProgrammingError(Error):
 
 
 def connect(sf: float = 0.01, mesh=None, max_groups: int = 1 << 16,
-            **kwargs) -> "Connection":
+            server: Optional[str] = None, user: str = "presto",
+            **kwargs):
+    """Local mode embeds the engine; `server="http://host:port"` speaks
+    the client statement protocol to a coordinator (PrestoDriver's
+    jdbc:presto://host URL analog)."""
+    if server is not None:
+        session = dict(kwargs.pop("session", None) or {})
+        session.setdefault("sf", str(sf))
+        return HttpConnection(server, user=user, session=session, **kwargs)
     return Connection(sf=sf, mesh=mesh, max_groups=max_groups, **kwargs)
 
 
@@ -209,3 +217,123 @@ def _quote(v: Any) -> str:
         return repr(v)
     s = str(v).replace("'", "''")
     return f"'{s}'"
+
+
+# ---------------------------------------------------------------------------
+# HTTP mode: the statement protocol (StatementClientV1 / presto-jdbc wire)
+# ---------------------------------------------------------------------------
+
+
+import datetime as _datetime
+import decimal as _decimal
+
+
+def _parse_wire_value(v, type_sig: str):
+    """Wire JSON -> python value (reference client conventions: decimals
+    as Decimal, dates/timestamps as datetime objects)."""
+    if v is None:
+        return None
+    datetime, decimal = _datetime, _decimal
+    base = type_sig.split("(", 1)[0].strip()
+    if base == "decimal":
+        return decimal.Decimal(v)
+    if base == "date":
+        return datetime.date.fromisoformat(v)
+    if base == "timestamp":
+        return datetime.datetime.fromisoformat(v)
+    if base == "array":
+        inner = type_sig.split("(", 1)[1].rsplit(")", 1)[0]
+        return [_parse_wire_value(e, inner) for e in v]
+    return v
+
+
+class HttpConnection:
+    """PEP-249 connection over the client statement protocol."""
+
+    def __init__(self, server: str, user: str = "presto",
+                 session: Optional[dict] = None, **kwargs):
+        self.server = server.rstrip("/")
+        self.user = user
+        self.session = dict(session or {})
+        self._txn_id: Optional[str] = None
+        self._closed = False
+
+    def cursor(self) -> "HttpCursor":
+        if self._closed:
+            raise ProgrammingError("connection is closed")
+        return HttpCursor(self)
+
+    def _run(self, text: str):
+        from .client import QueryError, execute
+        try:
+            client = execute(self.server, text, user=self.user,
+                             session=self.session,
+                             transaction_id=self._txn_id)
+        except QueryError as e:
+            raise ProgrammingError(str(e)) from e
+        # apply server-directed session/transaction mutations
+        self.session.update(client.set_session)
+        if client.started_transaction_id:
+            self._txn_id = client.started_transaction_id
+        if client.clear_transaction:
+            self._txn_id = None
+        return client
+
+    def _ensure_txn(self):
+        if self._txn_id is None:
+            self._run("START TRANSACTION")
+
+    def commit(self):
+        if self._closed:
+            raise ProgrammingError("connection is closed")
+        if self._txn_id is not None:
+            self._run("COMMIT")
+
+    def rollback(self):
+        if self._closed:
+            raise ProgrammingError("connection is closed")
+        if self._txn_id is not None:
+            self._run("ROLLBACK")
+
+    def close(self):
+        if self._txn_id is not None:
+            try:
+                self._run("ROLLBACK")
+            except Exception:  # noqa: BLE001 - close is best-effort
+                pass
+        self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class HttpCursor(Cursor):
+    """Cursor whose execute() rides the wire protocol."""
+
+    def __init__(self, conn: HttpConnection):
+        self.conn = conn
+        self._rows = None
+        self._pos = 0
+        self.description = None
+        self.rowcount = -1
+
+    def execute(self, sql_text: str, parameters: Sequence[Any] = ()):
+        if self.conn._closed:
+            raise ProgrammingError("connection is closed")
+        if parameters:
+            sql_text = _bind(sql_text, parameters)
+        self.conn._ensure_txn()
+        client = self.conn._run(sql_text)
+        cols = client.columns or []
+        self.description = [(c["name"], c["type"], None, None, None,
+                             None, None) for c in cols]
+        types = [c["type"] for c in cols]
+        self._rows = [tuple(_parse_wire_value(v, types[i])
+                            for i, v in enumerate(row))
+                      for row in client.data]
+        self._pos = 0
+        self.rowcount = len(self._rows)
+        return self

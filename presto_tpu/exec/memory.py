@@ -55,14 +55,22 @@ class MemoryPool:
     MemoryRevokingScheduler's TASK_REVOCABLE_MEMORY policy) before it
     fails."""
 
-    def __init__(self, capacity_bytes: int, name: str = "general"):
+    def __init__(self, capacity_bytes: int, name: str = "general",
+                 admission_timeout_s: float = 0.0):
+        """`admission_timeout_s` > 0 makes a contended reserve() WAIT
+        for other queries to release (bounded by the timeout) instead of
+        failing immediately -- the admission-queue behavior concurrent
+        worker tasks need (a request that exceeds pool capacity outright
+        still fails fast; only contention waits)."""
         self.name = name
         self.capacity = capacity_bytes
+        self.admission_timeout_s = admission_timeout_s
         self._reserved: Dict[str, int] = {}
         # revocable registrations: id -> (query_id, bytes, callback)
         self._revocables: Dict[int, tuple] = {}
         self._next_rid = 0
         self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
         self.revoked_bytes = 0  # counter: surfaced in stats/EXPLAIN
 
     @property
@@ -118,24 +126,36 @@ class MemoryPool:
         return freed_total
 
     def reserve(self, query_id: str, bytes_: int):
-        """Failure first triggers revocation of spillable state; only
-        when nothing (more) can be revoked does it raise -- the caller
-        then downsizes buckets or spills its own inputs."""
-        for attempt in (0, 1):
-            with self._lock:
+        """Failure first triggers revocation of spillable state; then,
+        when the pool is merely CONTENDED (the request alone would fit
+        an empty pool) and admission_timeout_s is set, waits for other
+        queries to release; only then does it raise -- the caller then
+        downsizes buckets or spills its own inputs."""
+        import time as _time
+        deadline = _time.time() + self.admission_timeout_s
+        revoke_tried = False
+        while True:
+            with self._cv:
                 total = sum(self._reserved.values()) + bytes_
                 if total <= self.capacity:
                     self._reserved[query_id] = \
                         self._reserved.get(query_id, 0) + bytes_
                     return
                 shortfall = total - self.capacity
-                can_revoke = bool(self._revocables) and attempt == 0
-            if not can_revoke or self._revoke(shortfall) <= 0:
-                break
-        raise MemoryReservationError(
-            f"pool {self.name}: reserve {bytes_} for {query_id} "
-            f"exceeds capacity {self.capacity} "
-            f"(reserved {self.reserved_bytes})")
+                can_revoke = bool(self._revocables) and not revoke_tried
+            if can_revoke:
+                revoke_tried = self._revoke(shortfall) <= 0
+                continue
+            remaining = deadline - _time.time()
+            if bytes_ <= self.capacity and remaining > 0:
+                with self._cv:
+                    self._cv.wait(min(0.05, remaining))
+                revoke_tried = False  # new revocables may have appeared
+                continue
+            raise MemoryReservationError(
+                f"pool {self.name}: reserve {bytes_} for {query_id} "
+                f"exceeds capacity {self.capacity} "
+                f"(reserved {self.reserved_bytes})")
 
     def try_reserve(self, query_id: str, bytes_: int) -> bool:
         try:
@@ -145,12 +165,13 @@ class MemoryPool:
             return False
 
     def free(self, query_id: str, bytes_: Optional[int] = None):
-        with self._lock:
+        with self._cv:
             cur = self._reserved.get(query_id, 0)
             if bytes_ is None or bytes_ >= cur:
                 self._reserved.pop(query_id, None)
             else:
                 self._reserved[query_id] = cur - bytes_
+            self._cv.notify_all()  # admission waiters re-check
 
     def query_bytes(self, query_id: str) -> int:
         with self._lock:

@@ -43,17 +43,30 @@ class SchedulerGap(NotImplementedError):
 
 class Coordinator:
     def __init__(self, worker_urls: Optional[Sequence[str]] = None,
-                 discovery_url: Optional[str] = None):
+                 discovery_url: Optional[str] = None,
+                 prober=None):
+        """`prober`: an optional discovery.HeartbeatProber; when set,
+        workers the prober has marked failed are excluded from
+        scheduling AND from retry targets (HeartbeatFailureDetector ->
+        NodeScheduler exclusion, the reference wiring)."""
         assert worker_urls or discovery_url
         self._urls = list(worker_urls) if worker_urls else None
         self.discovery_url = discovery_url
+        self.prober = prober
 
     def workers(self) -> List[str]:
         if self._urls:
-            return self._urls
-        nodes = alive_nodes(self.discovery_url)
-        assert nodes, "no alive workers in discovery"
-        return [n["uri"] for n in nodes]
+            urls = self._urls
+        else:
+            nodes = alive_nodes(self.discovery_url)
+            assert nodes, "no alive workers in discovery"
+            urls = [n["uri"] for n in nodes]
+        if self.prober is not None:
+            healthy = set(self.prober.healthy())  # normalized (no /)
+            filtered = [u for u in urls if u.rstrip("/") in healthy]
+            if filtered:  # never filter down to nothing
+                urls = filtered
+        return urls
 
     def _submit(self, urls: List[str], preferred: int, task_id: str,
                 body: dict, timeout: float) -> Tuple[str, str, int]:
@@ -104,12 +117,24 @@ class Coordinator:
                     raise RuntimeError(
                         f"task {tid} failed everywhere: {last_err}")
                 retries_left -= 1
+                # re-derive the candidate set: the prober/discovery view
+                # may have excluded the dead worker by now
+                retry_urls = self._retry_urls(urls)
                 url, tid, _ = self._submit(
-                    urls, preferred + (len(urls) - retries_left),
+                    retry_urls, preferred + (len(urls) - retries_left),
                     f"{tid}.r", body_of(key), timeout)
                 if submitted is not None:
                     submitted.append((url, tid))
         return done
+
+    def _retry_urls(self, fallback: List[str]) -> List[str]:
+        """Freshest healthy worker view for a retry (falls back to the
+        original list when discovery/prober cannot answer)."""
+        try:
+            urls = self.workers()
+            return urls or list(fallback)
+        except Exception:  # noqa: BLE001
+            return list(fallback)
 
     def execute(self, root: N.PlanNode, sf: float = 0.01,
                 timeout: float = 120.0):
@@ -283,8 +308,26 @@ class Coordinator:
         # empties are skipped/typed like http_exchange to keep dtypes
         types = fragments[-1].root.output_types()
         all_cols: List[List] = [[] for _ in types]
-        for url, tid in produced[fragments[-1].id]:
-            cols = WorkerClient(url, timeout).fetch_results(tid, types)
+        final_bodies = bodies  # last fragment's task bodies, keyed by w
+        for w, (url, tid) in enumerate(produced[fragments[-1].id]):
+            try:
+                cols = WorkerClient(url, timeout).fetch_results(tid, types)
+            except Exception:  # noqa: BLE001
+                # the producer died between finishing and the result
+                # pull: re-run that final task on a surviving worker
+                # (deterministic splits make it re-runnable; a re-run
+                # whose own upstream buffers died with the worker still
+                # fails -- the reference's behavior without recoverable
+                # grouped execution)
+                retry = self._retry_urls(workers)
+                url, tid, _ = self._submit(retry, w + 1, f"{tid}.rf",
+                                           final_bodies[w], timeout)
+                submitted.append((url, tid))
+                done = self._await_or_retry(
+                    retry, [(w, url, tid, w + 1)],
+                    lambda k: final_bodies[k], timeout, submitted)
+                url, tid = done[w]
+                cols = WorkerClient(url, timeout).fetch_results(tid, types)
             for c in range(len(types)):
                 if len(cols[c][0]):
                     all_cols[c].append(cols[c])

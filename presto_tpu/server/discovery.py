@@ -21,7 +21,8 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
-__all__ = ["DiscoveryServer", "Announcer", "alive_nodes"]
+__all__ = ["DiscoveryServer", "Announcer", "alive_nodes",
+           "HeartbeatProber"]
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -158,6 +159,97 @@ class Announcer:
                 urllib.request.urlopen(req, timeout=5).read()
             except Exception:
                 pass
+
+
+class HeartbeatProber:
+    """Active worker prober (HeartbeatFailureDetector.java:76 analog):
+    GETs each node's /v1/info on an interval and keeps an exponentially
+    decayed failure rate per node; healthy() is the scheduler-eligible
+    subset. Unlike the announcement-age detector (alive_nodes), this
+    notices a wedged-but-announcing worker and recovers a node as soon
+    as probes succeed again."""
+
+    def __init__(self, urls_fn, interval_s: float = 0.5,
+                 decay: float = 0.7, threshold: float = 0.5,
+                 probe_timeout_s: float = 2.0,
+                 shared_secret: Optional[str] = None):
+        self._urls_fn = urls_fn if callable(urls_fn) else (lambda: urls_fn)
+        self.interval = interval_s
+        self.decay = decay          # rate <- rate*decay + outcome*(1-decay)
+        self.threshold = threshold  # above this = failed
+        self.probe_timeout = probe_timeout_s
+        self._rates: Dict[str, float] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        from .auth import make_authenticator
+        self._auth = make_authenticator(shared_secret, "prober") \
+            if shared_secret is not None else None
+
+    def _probe(self, url: str) -> bool:
+        from .auth import bearer_headers
+        try:
+            req = urllib.request.Request(
+                f"{url.rstrip('/')}/v1/info",
+                headers=bearer_headers(self._auth))
+            with urllib.request.urlopen(req, timeout=self.probe_timeout):
+                return True
+        except Exception:  # noqa: BLE001 - any failure counts
+            return False
+
+    def probe_all_once(self) -> None:
+        # concurrent probes: one black-holed worker must not stretch the
+        # cycle (and so failure detection of every OTHER node) by its
+        # full timeout
+        urls = [u.rstrip("/") for u in self._urls_fn()]
+        results: Dict[str, bool] = {}
+        rlock = threading.Lock()
+
+        def one(u):
+            ok = self._probe(u)
+            with rlock:
+                results[u] = ok
+
+        threads = [threading.Thread(target=one, args=(u,), daemon=True)
+                   for u in urls]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(self.probe_timeout + 1)
+        with self._lock:
+            for u in urls:
+                prev = self._rates.get(u, 0.0)
+                ok = results.get(u, False)
+                self._rates[u] = prev * self.decay + \
+                    (0.0 if ok else 1.0) * (1 - self.decay)
+            # forget nodes that left the view (discovery churn would
+            # otherwise grow this dict forever)
+            for gone in [u for u in self._rates if u not in urls]:
+                del self._rates[gone]
+
+    def failure_rate(self, url: str) -> float:
+        with self._lock:
+            return self._rates.get(url.rstrip("/"), 0.0)
+
+    def healthy(self) -> List[str]:
+        urls = [u.rstrip("/") for u in self._urls_fn()]
+        with self._lock:
+            return [u for u in urls
+                    if self._rates.get(u, 0.0) <= self.threshold]
+
+    def start(self):
+        def loop():
+            while not self._stop.is_set():
+                self.probe_all_once()
+                self._stop.wait(self.interval)
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=self.probe_timeout + 1)
 
 
 def alive_nodes(discovery_url: str, max_age_s: float = 5.0,

@@ -98,16 +98,19 @@ class Dispatcher:
     def submit(self, executor: Callable[[str], object],
                session: Optional[Dict] = None,
                query_text: str = "",
-               queue_timeout: Optional[float] = None):
+               queue_timeout: Optional[float] = None,
+               query_id: Optional[str] = None):
         """Admit + run one query synchronously (the reference's async
         dispatch is its HTTP shell; the admission semantics live here).
-        Raises QueryRejected when the group's queue is full."""
+        Raises QueryRejected when the group's queue is full. The caller
+        may supply the query id (the statement resource mints ids at
+        POST time, before admission, like QueuedStatementResource)."""
         session = session or {}
         group_name = self._selector(session)
         group = self.groups.get(group_name)
         if group is None:
             raise QueryRejected(f"no resource group {group_name!r}")
-        query_id = f"q-{uuid.uuid4().hex[:12]}"
+        query_id = query_id or f"q-{uuid.uuid4().hex[:12]}"
         events = event_listeners()
         events.query_created(query_id, query_text,
                              session.get("user", ""))

@@ -1,0 +1,460 @@
+"""Client statement protocol: the coordinator's POST /v1/statement seam.
+
+Reference surface: the REST protocol every Presto client speaks --
+QueuedStatementResource (presto-main/.../server/protocol/
+QueuedStatementResource.java:210 `POST /v1/statement` -> QueryResults
+with a `nextUri` into the queued resource, redirecting to
+ExecutingStatementResource once dispatch completes) and
+StatementClientV1 (presto-client/.../StatementClientV1.java:88,365 --
+advance() polls nextUri until it disappears). Response documents carry
+{id, infoUri, nextUri, partialCancelUri, columns, data, stats, error,
+updateType}; session mutations ride response headers
+(X-Presto-Set-Session / X-Presto-Started-Transaction-Id / ...).
+
+This server fronts the engine: queries admit through the Dispatcher
+(resource groups + events), transact through the TransactionManager,
+progress through a QueryStateMachine (query_state.py), and execute on a
+background thread -- the LocalDispatchQuery.startWaitingForPrerequisites
+-> SqlQueryExecution.start pipeline condensed to one process. Results
+page out `page_rows` rows per nextUri hop, values rendered with the
+reference's JSON conventions (decimals/dates/timestamps as strings).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import types as T
+from ..transaction import TransactionManager
+from .dispatcher import Dispatcher, QueryRejected
+from .query_state import QueryState, QueryStateMachine
+
+__all__ = ["StatementServer", "render_value"]
+
+
+def render_value(v, null: bool, ty: T.Type):
+    """Engine-native value -> client JSON (the reference's column
+    rendering: decimals and temporals as strings)."""
+    if null or v is None:
+        return None
+    if ty.is_decimal:
+        s = ty.scale
+        v = int(v)
+        if s == 0:
+            return str(v)
+        sign = "-" if v < 0 else ""
+        a = abs(v)
+        return f"{sign}{a // 10**s}.{a % 10**s:0{s}d}"
+    if ty.base == "date":
+        return str(np.datetime64("1970-01-01") + int(v))
+    if ty.base == "timestamp":
+        us = int(v)
+        base = np.datetime64("1970-01-01T00:00:00") + np.timedelta64(us, "us")
+        return str(base).replace("T", " ")
+    if ty.base == "array":
+        return [render_value(e, e is None, ty.element_type) for e in v]
+    if ty.is_floating:
+        return float(v)
+    if ty.base == "boolean":
+        return bool(v)
+    if ty.is_integral:
+        return int(v)
+    return str(v)
+
+
+_ERROR_CODES = {
+    "SYNTAX_ERROR": (1, "USER_ERROR"),
+    "USER_CANCELED": (20000, "USER_ERROR"),
+    "QUERY_QUEUE_FULL": (131075, "INSUFFICIENT_RESOURCES"),
+    "GENERIC_INTERNAL_ERROR": (65536, "INTERNAL_ERROR"),
+}
+
+
+def _error_doc(name: str, message: str) -> dict:
+    code, etype = _ERROR_CODES.get(name, _ERROR_CODES["GENERIC_INTERNAL_ERROR"])
+    return {"message": message, "errorCode": code, "errorName": name,
+            "errorType": etype,
+            "failureInfo": {"type": name, "message": message}}
+
+
+class _Query:
+    """One statement's server-side lifecycle + result store."""
+
+    def __init__(self, query_id: str, slug: str, text: str,
+                 session_values: Dict, user: str, txn_id: Optional[str]):
+        self.id = query_id
+        self.slug = slug
+        self.text = text
+        self.session_values = session_values
+        self.user = user
+        self.txn_id = txn_id
+        self.machine = QueryStateMachine(query_id)
+        self.columns: Optional[List[dict]] = None
+        self.rows: List[list] = []
+        self.update_type: Optional[str] = None
+        self.update_count: Optional[int] = None
+        # response-header mutations for the client to apply
+        self.set_session: Dict[str, str] = {}
+        self.started_txn: Optional[str] = None
+        self.clear_txn: bool = False
+
+
+_SESSION_STMT = re.compile(
+    r"\s*(start\s+transaction|commit|rollback|set\s+session)\b",
+    re.IGNORECASE)
+
+
+class StatementServer:
+    """Coordinator statement resource over the local engine (or any
+    executor callable). `executor(text, session_values, query_id,
+    txn_id)` returns an object with .rows()/.names/.types (QueryResult);
+    default executes through the SQL front door."""
+
+    def __init__(self, port: int = 0, sf: float = 0.01,
+                 dispatcher: Optional[Dispatcher] = None,
+                 executor=None, page_rows: int = 1024,
+                 queue_poll_s: float = 1.0):
+        self.sf = sf
+        self.page_rows = page_rows
+        self.queue_poll_s = queue_poll_s
+        self.dispatcher = dispatcher or Dispatcher()
+        self.transactions = TransactionManager()
+        self._executor = executor or self._default_executor
+        self._queries: Dict[str, _Query] = {}
+        self._qlock = threading.Lock()
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self._httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self):
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- execution ------------------------------------------------------
+
+    def _default_executor(self, text: str, session_values: Dict,
+                          query_id: str, txn_id: Optional[str]):
+        from ..sql import sql as run_sql
+        sf = float(session_values.get("sf", self.sf))
+        kwargs = {}
+        if "max_groups" in session_values:
+            kwargs["max_groups"] = int(session_values["max_groups"])
+        if "join_capacity" in session_values:
+            kwargs["join_capacity"] = int(session_values["join_capacity"])
+        return run_sql(text, sf=sf, **kwargs)
+
+    def create_query(self, text: str, user: str,
+                     session_values: Dict, txn_id: Optional[str]) -> _Query:
+        q = _Query(f"20260730_{uuid.uuid4().hex[:12]}",
+                   uuid.uuid4().hex[:12], text, session_values, user,
+                   txn_id)
+        with self._qlock:
+            self._queries[q.id] = q
+        threading.Thread(target=self._run, args=(q,), daemon=True).start()
+        return q
+
+    def _run(self, q: _Query):
+        m = _SESSION_STMT.match(q.text)
+        try:
+            if m:
+                self._run_session_statement(q, m.group(1).lower())
+                return
+            self.dispatcher.submit(
+                lambda qid: self._run_engine(q),
+                session={"user": q.user, **q.session_values},
+                query_text=q.text, query_id=q.id,
+                queue_timeout=float(q.session_values.get(
+                    "queue_timeout_s", 60.0)))
+        except QueryRejected as e:
+            q.machine.to_failed(_error_doc("QUERY_QUEUE_FULL", str(e)))
+        except Exception as e:  # noqa: BLE001
+            name = "SYNTAX_ERROR" if "parse" in type(e).__name__.lower() \
+                or "Syntax" in str(e) else "GENERIC_INTERNAL_ERROR"
+            q.machine.to_failed(_error_doc(name, f"{type(e).__name__}: {e}"))
+
+    def _run_engine(self, q: _Query):
+        q.machine.to_planning()
+        m = re.match(r"\s*explain(\s+analyze)?\b", q.text, re.IGNORECASE)
+        if m:
+            # EXPLAIN [ANALYZE]: one varchar plan-text column (the
+            # reference's EXPLAIN output shape)
+            from ..plan import explain as explain_plan
+            from ..plan import explain_analyze
+            from ..sql import plan_sql
+            inner = q.text[m.end():].strip()
+            sf = float(q.session_values.get("sf", self.sf))
+            q.machine.to_running()
+            text = explain_analyze(plan_sql(inner), sf=sf) if m.group(1) \
+                else explain_plan(plan_sql(inner))
+            q.columns = [{"name": "Query Plan", "type": "varchar"}]
+            q.rows = [[line] for line in text.splitlines()]
+            q.machine.to_finishing()
+            q.machine.to_finished()
+            return
+        q.machine.to_running()
+        if q.txn_id is not None:
+            self.transactions.get(q.txn_id)  # validates + touches
+            res = self._executor(q.text, q.session_values, q.id, q.txn_id)
+        else:
+            res = self.transactions.run_autocommit(
+                lambda tid: self._executor(q.text, q.session_values, q.id,
+                                           tid))
+        q.machine.to_finishing()
+        q.columns = [{"name": n, "type": str(t)}
+                     for n, t in zip(res.names, res.types)]
+        rendered = []
+        for i in range(res.row_count):
+            rendered.append([
+                render_value(res.columns[c][i], bool(res.nulls[c][i]),
+                             res.types[c])
+                for c in range(len(res.types))])
+        q.rows = rendered
+        q.machine.to_finished()
+        return res
+
+    def _run_session_statement(self, q: _Query, kind: str):
+        q.machine.to_planning()
+        q.machine.to_running()
+        kind = " ".join(kind.split())
+        if kind == "start transaction":
+            if q.txn_id is not None:
+                raise RuntimeError("already in a transaction")
+            read_only = bool(re.search(r"read\s+only", q.text, re.I))
+            q.started_txn = self.transactions.begin(read_only=read_only)
+            q.update_type = "START TRANSACTION"
+        elif kind in ("commit", "rollback"):
+            if q.txn_id is None:
+                raise RuntimeError(f"{kind.upper()} outside a transaction")
+            if kind == "commit":
+                self.transactions.commit(q.txn_id)
+            else:
+                self.transactions.rollback(q.txn_id)
+            q.clear_txn = True
+            q.update_type = kind.upper()
+        else:  # SET SESSION k = v
+            m = re.match(r"\s*set\s+session\s+([A-Za-z_][\w.]*)\s*=\s*(.+?)\s*$",
+                         q.text, re.IGNORECASE)
+            if not m:
+                raise ValueError(f"cannot parse SET SESSION: {q.text!r}")
+            key, raw = m.group(1), m.group(2).strip().rstrip(";").strip()
+            if raw.startswith("'") and raw.endswith("'"):
+                raw = raw[1:-1]
+            q.set_session[key] = raw
+            q.update_type = "SET SESSION"
+        q.columns = [{"name": "result", "type": "boolean"}]
+        q.rows = [[True]]
+        q.machine.to_finishing()
+        q.machine.to_finished()
+
+    # -- document assembly ---------------------------------------------
+
+    def get_query(self, query_id: str, slug: str) -> Optional[_Query]:
+        with self._qlock:
+            q = self._queries.get(query_id)
+        if q is None or q.slug != slug:
+            return None
+        return q
+
+    def queued_doc(self, q: _Query, token: int) -> dict:
+        state = q.machine.state
+        doc = self._base_doc(q, state)
+        if state == QueryState.QUEUED:
+            doc["nextUri"] = \
+                f"{self.url}/v1/statement/queued/{q.id}/{q.slug}/{token + 1}"
+        elif state in (QueryState.FAILED, QueryState.CANCELED):
+            doc["error"] = q.machine.error or \
+                _error_doc("USER_CANCELED", "query was canceled")
+        else:
+            doc["nextUri"] = \
+                f"{self.url}/v1/statement/executing/{q.id}/{q.slug}/0"
+        return doc
+
+    def executing_doc(self, q: _Query, token: int) -> dict:
+        state = q.machine.state
+        doc = self._base_doc(q, state)
+        if state in (QueryState.FAILED, QueryState.CANCELED):
+            doc["error"] = q.machine.error or \
+                _error_doc("USER_CANCELED", "query was canceled")
+            return doc
+        if state != QueryState.FINISHED:
+            # results not materialized yet: poll the same token
+            doc["nextUri"] = \
+                f"{self.url}/v1/statement/executing/{q.id}/{q.slug}/{token}"
+            return doc
+        doc["columns"] = q.columns
+        lo = token * self.page_rows
+        hi = lo + self.page_rows
+        page = q.rows[lo:hi]
+        if page:
+            doc["data"] = page
+        if q.update_type:
+            doc["updateType"] = q.update_type
+        if hi < len(q.rows):
+            doc["nextUri"] = \
+                f"{self.url}/v1/statement/executing/{q.id}/{q.slug}/{token + 1}"
+        return doc
+
+    def _base_doc(self, q: _Query, state: str) -> dict:
+        queued = state == QueryState.QUEUED
+        return {
+            "id": q.id,
+            "infoUri": f"{self.url}/v1/query/{q.id}",
+            "stats": {
+                "state": state,
+                "queued": queued,
+                "scheduled": state not in (QueryState.QUEUED,
+                                           QueryState.PLANNING),
+                "elapsedTimeMillis": q.machine.elapsed_ms(),
+                "processedRows": len(q.rows),
+                "processedBytes": 0,
+                "peakMemoryBytes": 0,
+            },
+        }
+
+    def cancel(self, q: _Query) -> None:
+        q.machine.to_canceled()
+
+    def admin_doc(self, query_id: str) -> Optional[dict]:
+        with self._qlock:
+            q = self._queries.get(query_id)
+        if q is None:
+            return None
+        return {"queryId": q.id, "state": q.machine.state,
+                "query": q.text, "user": q.user,
+                "sessionProperties": q.session_values,
+                "timings": q.machine.timings(),
+                "errorInfo": q.machine.error}
+
+    def queries_doc(self) -> List[dict]:
+        with self._qlock:
+            ids = list(self._queries)
+        return [self.admin_doc(i) for i in ids]
+
+
+def _parse_session_header(value: str) -> Dict[str, str]:
+    out = {}
+    for part in value.split(","):
+        part = part.strip()
+        if part and "=" in part:
+            k, v = part.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+def _make_handler(server: StatementServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+        def _send(self, doc, code=200, headers: Optional[Dict] = None):
+            body = json.dumps(doc).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):  # noqa: N802
+            if self.path.rstrip("/") != "/v1/statement":
+                self._send({"error": "not found"}, 404)
+                return
+            length = int(self.headers.get("Content-Length", "0") or 0)
+            text = self.rfile.read(length).decode("utf-8", "replace")
+            if not text.strip():
+                self._send(_error_doc("SYNTAX_ERROR", "empty statement"),
+                           400)
+                return
+            user = self.headers.get("X-Presto-User", "anonymous")
+            session_values = _parse_session_header(
+                self.headers.get("X-Presto-Session", ""))
+            txn = self.headers.get("X-Presto-Transaction-Id")
+            if txn in (None, "", "NONE"):
+                txn = None
+            q = server.create_query(text, user, session_values, txn)
+            # give fast statements a beat to leave QUEUED (the reference
+            # responds immediately; one poll saves a client round trip)
+            q.machine.wait_past_queued(0.05)
+            self._send(server.queued_doc(q, 0))
+
+        def do_GET(self):  # noqa: N802
+            parts = [p for p in self.path.split("/") if p]
+            # /v1/statement/{queued|executing}/{id}/{slug}/{token}
+            if len(parts) == 6 and parts[:2] == ["v1", "statement"] and \
+                    parts[2] in ("queued", "executing"):
+                q = server.get_query(parts[3], parts[4])
+                if q is None:
+                    self._send({"error": "query not found"}, 404)
+                    return
+                token = int(parts[5])
+                headers = {}
+                if parts[2] == "queued":
+                    q.machine.wait_past_queued(server.queue_poll_s)
+                    doc = server.queued_doc(q, token)
+                else:
+                    q.machine.wait_done(server.queue_poll_s)
+                    doc = server.executing_doc(q, token)
+                    if q.machine.is_done():
+                        for k, v in q.set_session.items():
+                            headers["X-Presto-Set-Session"] = f"{k}={v}"
+                        if q.started_txn:
+                            headers["X-Presto-Started-Transaction-Id"] = \
+                                q.started_txn
+                        if q.clear_txn:
+                            headers["X-Presto-Clear-Transaction-Id"] = "true"
+                self._send(doc, headers=headers)
+                return
+            if len(parts) == 3 and parts[:2] == ["v1", "query"]:
+                doc = server.admin_doc(parts[2])
+                self._send(doc if doc else {"error": "not found"},
+                           200 if doc else 404)
+                return
+            if parts == ["v1", "query"]:
+                self._send(server.queries_doc())
+                return
+            if parts == ["v1", "info"]:
+                self._send({"nodeVersion": {"version": "presto-tpu-0.4"},
+                            "coordinator": True, "starting": False,
+                            "uptime": "0m"})
+                return
+            self._send({"error": "not found"}, 404)
+
+        def do_DELETE(self):  # noqa: N802
+            parts = [p for p in self.path.split("/") if p]
+            if len(parts) >= 5 and parts[:2] == ["v1", "statement"]:
+                q = server.get_query(parts[3], parts[4])
+                if q is None:
+                    self._send({"error": "query not found"}, 404)
+                    return
+                server.cancel(q)
+                self._send({"id": q.id, "canceled": True}, 200)
+                return
+            self._send({"error": "not found"}, 404)
+
+    return Handler

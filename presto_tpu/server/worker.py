@@ -105,21 +105,31 @@ class _Task:
 
 class TaskManager:
     """createOrUpdateTask / result-buffer bookkeeping (TaskManager.cpp:506
-    analog). Owns a worker-wide execution lock: one plan executes on the
-    chip at a time (the TaskExecutor slot analog; multi-stream arrives
-    with task_concurrency)."""
+    analog). Execution admits through a bounded slot pool
+    (`task_concurrency` concurrent plans, the TaskExecutor analog of
+    execution/executor/TaskExecutor.java:87): a long task occupies one
+    slot while short tasks proceed through the others, and HBM admission
+    stays with the shared MemoryPool each run_query reserves from. XLA
+    serializes actual device streams; overlapping tasks still overlap
+    their host-side staging, serde, and compile phases, which dominate
+    short-task latency."""
 
     def __init__(self, sf: float = 0.01, mesh=None,
                  memory_bytes: int = 12 << 30,
-                 task_ttl_s: float = 600.0):
+                 task_ttl_s: float = 600.0,
+                 task_concurrency: int = 4):
         from ..exec.memory import MemoryPool
         self.sf = sf
         self.mesh = mesh
         self.tasks: Dict[str, _Task] = {}
-        self.memory_pool = MemoryPool(memory_bytes)
+        # concurrent tasks contend for HBM admission: waits (bounded)
+        # beat failing a query that fit fine under serial execution
+        self.memory_pool = MemoryPool(memory_bytes,
+                                      admission_timeout_s=60.0)
         self.draining = False  # GracefulShutdownHandler state
         self.task_ttl_s = task_ttl_s
-        self._exec_lock = threading.Lock()
+        self.task_concurrency = max(1, int(task_concurrency))
+        self._exec_slots = threading.BoundedSemaphore(self.task_concurrency)
         self._tasks_lock = threading.Lock()
         # lifetime counters for /v1/info/metrics (Prometheus)
         self.counters: Dict[str, int] = {"tasks_created": 0,
@@ -215,7 +225,7 @@ class TaskManager:
                     merge_keys=spec.get("mergeKeys"))
             from ..exec.runner import run_query
             t0 = time.time()
-            with self._exec_lock:
+            with self._exec_slots:
                 res = run_query(plan, sf=sf, mesh=self.mesh,
                                 scan_ranges=scan_ranges,
                                 remote_sources=remote_sources,
@@ -567,9 +577,11 @@ class TpuWorkerServer:
                  node_id: Optional[str] = None,
                  discovery_url: Optional[str] = None,
                  announce_interval_s: float = 1.0,
-                 shared_secret: Optional[str] = None):
+                 shared_secret: Optional[str] = None,
+                 task_concurrency: int = 4):
         from .auth import make_authenticator
-        self.manager = TaskManager(sf=sf, mesh=mesh)
+        self.manager = TaskManager(sf=sf, mesh=mesh,
+                                   task_concurrency=task_concurrency)
         self.node_id = node_id or f"tpu-worker-{uuid.uuid4().hex[:8]}"
         auth = make_authenticator(shared_secret, self.node_id)
         handler = type("BoundHandler", (_Handler,), {

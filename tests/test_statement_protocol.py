@@ -1,0 +1,184 @@
+"""Client statement protocol: POST /v1/statement -> queued -> nextUri
+polling -> paged results, plus session/transaction statements and the
+CLI/DBAPI clients speaking the wire.
+
+Reference contract: QueuedStatementResource.java:210 +
+StatementClientV1.java:88,365 (see server/statement.py docstring).
+"""
+
+import datetime
+import decimal
+import json
+import urllib.request
+
+import pytest
+
+from presto_tpu.client import QueryError, StatementClient, execute
+from presto_tpu.server.dispatcher import Dispatcher, ResourceGroup
+from presto_tpu.server.statement import StatementServer
+
+SF = 0.01
+
+
+@pytest.fixture(scope="module")
+def server():
+    with StatementServer(sf=SF, page_rows=3) as s:
+        yield s
+
+
+def test_lifecycle_and_paging(server):
+    # local-engine truth
+    from presto_tpu.sql import sql
+    want = sql("SELECT custkey, count(*) AS n FROM orders "
+               "GROUP BY custkey ORDER BY custkey LIMIT 10", sf=SF)
+
+    client = StatementClient(server.url,
+                             "SELECT custkey, count(*) AS n FROM orders "
+                             "GROUP BY custkey ORDER BY custkey LIMIT 10",
+                             session={"sf": str(SF)})
+    assert client.query_id
+    hops = 0
+    while client.advance():
+        hops += 1
+        assert hops < 100
+    assert client.columns == [{"name": "custkey", "type": "bigint"},
+                              {"name": "n", "type": "bigint"}]
+    # 10 rows / 3 per page => 4 pages; the last page arrives on the
+    # final advance() (which returns False), so >= 3 True-hops
+    assert hops >= 3
+    assert client.data == [[int(k), int(n)] for k, n in want.rows()]
+    assert client.stats["state"] == "FINISHED"
+
+
+def test_rendering_decimals_and_dates(server):
+    client = execute(server.url,
+                     "SELECT totalprice, orderdate FROM orders "
+                     "ORDER BY orderkey LIMIT 1",
+                     session={"sf": str(SF)})
+    (price, od), = client.data
+    assert isinstance(price, str) and "." in price  # decimal rendering
+    assert len(od) == 10 and od[4] == "-"           # YYYY-MM-DD
+
+
+def test_error_model_syntax(server):
+    with pytest.raises(QueryError) as ei:
+        execute(server.url, "SELEC nonsense FROM nowhere",
+                session={"sf": str(SF)})
+    assert ei.value.error["errorCode"] >= 1
+    assert ei.value.error["failureInfo"]["message"]
+
+
+def test_info_and_admin_endpoints(server):
+    with urllib.request.urlopen(f"{server.url}/v1/info") as r:
+        info = json.loads(r.read())
+    assert info["coordinator"] is True
+    client = execute(server.url, "SELECT count(*) AS one FROM region",
+                     session={"sf": str(SF)})
+    with urllib.request.urlopen(
+            f"{server.url}/v1/query/{client.query_id}") as r:
+        admin = json.loads(r.read())
+    assert admin["state"] == "FINISHED"
+    assert admin["query"] == "SELECT count(*) AS one FROM region"
+    assert "QUEUED" in admin["timings"]
+
+
+def test_session_and_transaction_statements(server):
+    c = execute(server.url, "SET SESSION sf = 0.01")
+    assert c.update_type == "SET SESSION"
+    assert c.set_session == {"sf": "0.01"}
+
+    c = execute(server.url, "START TRANSACTION")
+    assert c.update_type == "START TRANSACTION"
+    tid = c.started_transaction_id
+    assert tid
+    # statement inside the transaction
+    c2 = execute(server.url, "SELECT count(*) AS n FROM region",
+                 transaction_id=tid, session={"sf": str(SF)})
+    assert c2.data == [[5]]
+    c3 = execute(server.url, "COMMIT", transaction_id=tid)
+    assert c3.clear_transaction
+    # the txn is gone now
+    with pytest.raises(QueryError):
+        execute(server.url, "COMMIT", transaction_id=tid)
+
+
+def test_queue_full_rejection():
+    # 1 running + 1 queued allowed; the third statement is rejected
+    # (every admission passes through the queue counter, so max_queued
+    # must cover the admitted query itself)
+    d = Dispatcher([ResourceGroup("global", hard_concurrency_limit=1,
+                                  max_queued=1)])
+    with StatementServer(sf=SF, dispatcher=d) as s:
+        import threading
+        release = threading.Event()
+
+        def slow_exec(text, sess, qid, tid):
+            release.set()
+            import time
+            time.sleep(1.0)
+            from presto_tpu.sql import sql
+            return sql("SELECT count(*) AS n FROM region", sf=SF)
+
+        s._executor = slow_exec
+        slow = StatementClient(s.url, "SELECT count(*) AS n FROM region")
+        release.wait(5)
+        queued = StatementClient(s.url, "SELECT count(*) AS n FROM region")
+        import time
+        time.sleep(0.3)  # let it reach the queue before the third POSTs
+        with pytest.raises(QueryError) as ei:
+            execute(s.url, "SELECT count(*) AS n FROM nation")
+        assert ei.value.error_name == "QUERY_QUEUE_FULL"
+        slow.drain()
+        queued.drain()
+
+
+def test_dbapi_over_the_wire(server):
+    import presto_tpu.dbapi as db
+    conn = db.connect(server=server.url, user="tester")
+    cur = conn.cursor()
+    cur.execute("SELECT totalprice, orderdate, custkey FROM orders "
+                "ORDER BY orderkey LIMIT 2")
+    rows = cur.fetchall()
+    assert cur.rowcount == 2
+    assert isinstance(rows[0][0], decimal.Decimal)
+    assert isinstance(rows[0][1], datetime.date)
+    assert isinstance(rows[0][2], int)
+    assert [d[0] for d in cur.description] == ["totalprice", "orderdate",
+                                               "custkey"]
+    # implicit transaction began on the wire; commit clears it
+    assert conn._txn_id is not None
+    conn.commit()
+    assert conn._txn_id is None
+    conn.close()
+
+
+def test_cli_over_the_wire(server, capsys):
+    from presto_tpu.cli import main
+    rc = main(["--server", server.url, "--sf", str(SF),
+               "SELECT count(*) AS n FROM nation"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "25" in out and "rows in" in out
+
+
+def test_cancel(server):
+    client = StatementClient(server.url,
+                             "SELECT count(*) FROM lineitem",
+                             session={"sf": str(SF)})
+    client.cancel()
+    # canceled or finished-before-cancel are both legal; the server must
+    # still answer the admin endpoint
+    with urllib.request.urlopen(
+            f"{server.url}/v1/query/{client.query_id}") as r:
+        admin = json.loads(r.read())
+    assert admin["state"] in ("CANCELED", "FINISHED", "RUNNING",
+                              "PLANNING", "FINISHING")
+
+
+def test_remote_explain(server):
+    client = execute(server.url,
+                     "EXPLAIN SELECT count(*) AS n FROM nation",
+                     session={"sf": str(SF)})
+    assert client.columns == [{"name": "Query Plan", "type": "varchar"}]
+    text = "\n".join(r[0] for r in client.data)
+    assert "Aggregate" in text or "TableScan" in text

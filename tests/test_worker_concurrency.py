@@ -1,0 +1,119 @@
+"""Worker task concurrency: the bounded slot pool replacing the global
+execution lock (TaskExecutor.java:87 analog -- a long task must not
+starve a short one)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.plan import nodes as N
+
+
+def _plan(marker: str):
+    vn = N.ValuesNode([T.BIGINT], [[1]])
+    return N.to_json(N.OutputNode(vn, [marker]))
+
+
+class _FakeResult:
+    row_count = 1
+    columns = [np.array([1], dtype=np.int64)]
+    nulls = [np.array([False])]
+
+
+def _patched_run_query(monkeypatch, durations):
+    """run_query stub keyed by the plan's output name; records
+    (start, end) wall times per marker."""
+    import presto_tpu.exec.runner as runner
+    spans = {}
+
+    def fake(plan, **kw):
+        marker = plan.names[0]
+        spans[marker] = [time.time(), None]
+        time.sleep(durations[marker])
+        spans[marker][1] = time.time()
+        return _FakeResult()
+
+    monkeypatch.setattr(runner, "run_query", fake)
+    return spans
+
+
+def _submit(mgr, tid, marker):
+    return mgr.create_or_update(tid, {
+        "plan": _plan(marker),
+        "session": {"tpu_execution_enabled": True},
+    })
+
+
+def _wait_state(mgr, tid, want, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        t = mgr.get(tid)
+        if t is not None and t.info()["state"] in want:
+            return t.info()["state"]
+        time.sleep(0.01)
+    raise AssertionError(f"task {tid} never reached {want}")
+
+
+def test_short_task_passes_long_task(monkeypatch):
+    from presto_tpu.server.worker import TaskManager
+    mgr = TaskManager(task_concurrency=2)
+    spans = _patched_run_query(monkeypatch, {"long": 1.5, "short": 0.05})
+    _submit(mgr, "t-long", "long")
+    time.sleep(0.1)  # the long task takes its slot
+    _submit(mgr, "t-short", "short")
+    _wait_state(mgr, "t-short", ("FINISHED",), timeout=5)
+    # the long task is STILL running when the short one finished
+    assert mgr.get("t-long").info()["state"] == "RUNNING"
+    _wait_state(mgr, "t-long", ("FINISHED",), timeout=5)
+    assert spans["short"][1] < spans["long"][1]
+
+
+def test_concurrency_one_serializes(monkeypatch):
+    from presto_tpu.server.worker import TaskManager
+    mgr = TaskManager(task_concurrency=1)
+    spans = _patched_run_query(monkeypatch, {"a": 0.4, "b": 0.05})
+    _submit(mgr, "t-a", "a")
+    time.sleep(0.1)
+    _submit(mgr, "t-b", "b")
+    _wait_state(mgr, "t-b", ("FINISHED",), timeout=5)
+    # with one slot, b cannot start until a's slot frees
+    assert spans["b"][0] >= spans["a"][1] - 0.01
+
+
+def test_two_concurrent_tasks_both_progress(monkeypatch):
+    from presto_tpu.server.worker import TaskManager
+    mgr = TaskManager(task_concurrency=2)
+    spans = _patched_run_query(monkeypatch, {"x": 0.4, "y": 0.4})
+    _submit(mgr, "t-x", "x")
+    _submit(mgr, "t-y", "y")
+    _wait_state(mgr, "t-x", ("FINISHED",), timeout=5)
+    _wait_state(mgr, "t-y", ("FINISHED",), timeout=5)
+    # overlap: combined wall < serial sum
+    overlap = min(spans["x"][1], spans["y"][1]) - max(spans["x"][0],
+                                                      spans["y"][0])
+    assert overlap > 0.2
+
+
+def test_memory_pool_blocking_admission():
+    """Contended reserve waits for release instead of failing (the
+    concurrent-task admission queue); an impossible request still fails
+    fast."""
+    from presto_tpu.exec.memory import MemoryPool, MemoryReservationError
+    pool = MemoryPool(100, admission_timeout_s=5.0)
+    pool.reserve("a", 80)
+    t = threading.Timer(0.2, lambda: pool.free("a"))
+    t.start()
+    t0 = time.time()
+    pool.reserve("b", 50)  # waits for a's release
+    assert time.time() - t0 >= 0.15
+    pool.free("b")
+    with pytest.raises(MemoryReservationError):
+        pool.reserve("c", 101)  # exceeds capacity outright: fail fast
+    # non-blocking pool (default) keeps the old fail-fast contract
+    p2 = MemoryPool(100)
+    p2.reserve("a", 80)
+    with pytest.raises(MemoryReservationError):
+        p2.reserve("b", 50)
