@@ -179,8 +179,62 @@ class Int128Column:
 
 _register(Int128Column, ["hi", "lo", "nulls"], ["type"])
 
+
+@dataclasses.dataclass
+class MapColumn:
+    """Fixed-fanout map column (MapBlock analog, TPU layout): row i's
+    entries are (keys[i, j], values[i, j]) for j < lengths[i]. Keys are
+    non-null by SQL contract; fixed-width key/value types in this
+    revision (string keys ride dictionary-encoded ints upstream)."""
+
+    keys: jax.Array        # (N, K) key lanes
+    values: jax.Array      # (N, K) value lanes
+    value_nulls: jax.Array  # (N, K)
+    lengths: jax.Array     # (N,)
+    nulls: jax.Array       # (N,) top-level null map
+    type: T.Type = dataclasses.field(metadata=dict(static=True))
+
+    def __len__(self):
+        return self.keys.shape[0]
+
+    @property
+    def capacity(self) -> int:
+        return self.keys.shape[0]
+
+    @property
+    def max_cardinality(self) -> int:
+        return self.keys.shape[1]
+
+
+_register(MapColumn, ["keys", "values", "value_nulls", "lengths", "nulls"],
+          ["type"])
+
+
+@dataclasses.dataclass
+class RowColumn:
+    """Struct column (RowBlock analog): one child Block per field plus a
+    top-level null mask -- already SoA, the natural TPU layout (the
+    reference's RowBlock is the same design)."""
+
+    fields: Tuple["Block", ...]
+    nulls: jax.Array
+    type: T.Type = dataclasses.field(metadata=dict(static=True))
+
+    def __len__(self):
+        return self.nulls.shape[0]
+
+    @property
+    def capacity(self) -> int:
+        return self.nulls.shape[0]
+
+    def field(self, i: int) -> "Block":
+        return self.fields[i]
+
+
+_register(RowColumn, ["fields", "nulls"], ["type"])
+
 Block = Union[Column, StringColumn, DictionaryColumn, ArrayColumn,
-              Int128Column]
+              Int128Column, MapColumn, RowColumn]
 
 
 @dataclasses.dataclass
@@ -264,6 +318,60 @@ def from_numpy(ty: T.Type, values: np.ndarray, nulls: Optional[np.ndarray] = Non
                            jnp.asarray(_pad(enulls, capacity, fill=True)),
                            jnp.asarray(_pad(lengths, capacity)),
                            jnp.asarray(_pad(topn, capacity, fill=True)), ty)
+    if ty.base == "map":
+        # object array of python dicts (None = null map)
+        kty, vty = ty.key_type, ty.value_type
+        rows = list(values)
+        n = len(rows)
+        capacity = capacity or n
+        k = max((len(r) for r in rows if r is not None), default=1) or 1
+        keys = np.zeros((n, k), dtype=kty.to_dtype())
+        vals = np.zeros((n, k), dtype=vty.to_dtype())
+        vnulls = np.ones((n, k), dtype=bool)
+        lengths = np.zeros(n, dtype=np.int32)
+        topn = np.zeros(n, dtype=bool) if nulls is None else \
+            np.asarray(nulls, dtype=bool).copy()
+        for i, r in enumerate(rows):
+            if r is None or topn[i]:
+                topn[i] = True
+                continue
+            lengths[i] = len(r)
+            for j, (kk, vv) in enumerate(r.items()):
+                keys[i, j] = kk
+                if vv is not None:
+                    vals[i, j] = vv
+                    vnulls[i, j] = False
+        return MapColumn(jnp.asarray(_pad(keys, capacity)),
+                         jnp.asarray(_pad(vals, capacity)),
+                         jnp.asarray(_pad(vnulls, capacity, fill=True)),
+                         jnp.asarray(_pad(lengths, capacity)),
+                         jnp.asarray(_pad(topn, capacity, fill=True)), ty)
+    if ty.base == "row":
+        # object array of python tuples/lists (None = null row)
+        ftys = ty.field_types
+        rows = list(values)
+        n = len(rows)
+        capacity = capacity or n
+        topn = np.zeros(n, dtype=bool) if nulls is None else \
+            np.asarray(nulls, dtype=bool).copy()
+        fields = []
+        for fi, fty in enumerate(ftys):
+            col = np.empty(n, dtype=object)
+            for i, r in enumerate(rows):
+                col[i] = None if (r is None or topn[i]) else r[fi]
+            if not (fty.is_string or fty.base in ("array", "map", "row")
+                    or (fty.is_decimal and not fty.is_short_decimal)):
+                fn = np.array([v is None for v in col], dtype=bool)
+                col = np.array([0 if v is None else v for v in col],
+                               dtype=fty.to_dtype())
+                fields.append(from_numpy(fty, col, fn, capacity))
+            else:
+                fields.append(from_numpy(fty, col, None, capacity))
+        for i, r in enumerate(rows):
+            if r is None:
+                topn[i] = True
+        return RowColumn(tuple(fields),
+                         jnp.asarray(_pad(topn, capacity, fill=True)), ty)
     n = values.shape[0]
     capacity = capacity or n
     if nulls is None:
@@ -348,6 +456,30 @@ def to_numpy(block: Block) -> Tuple[np.ndarray, np.ndarray]:
         from .int128 import int128_to_python
         vals = int128_to_python(np.asarray(block.hi), np.asarray(block.lo))
         return vals, np.asarray(block.nulls)
+    if isinstance(block, MapColumn):
+        keys = np.asarray(block.keys)
+        vals = np.asarray(block.values)
+        vnulls = np.asarray(block.value_nulls)
+        lengths = np.asarray(block.lengths)
+        nulls = np.asarray(block.nulls)
+        out = np.empty(len(lengths), dtype=object)
+        for i in range(len(lengths)):
+            out[i] = None if nulls[i] else {
+                keys[i, j].item(): (None if vnulls[i, j]
+                                    else vals[i, j].item())
+                for j in range(lengths[i])}
+        return out, nulls
+    if isinstance(block, RowColumn):
+        nulls = np.asarray(block.nulls)
+        fvals = [to_numpy(f) for f in block.fields]
+        out = np.empty(len(nulls), dtype=object)
+        for i in range(len(nulls)):
+            out[i] = None if nulls[i] else tuple(
+                None if fn[i] else (fv[i].item()
+                                    if isinstance(fv[i], np.generic)
+                                    else fv[i])
+                for fv, fn in fvals)
+        return out, nulls
     return np.asarray(block.values), np.asarray(block.nulls)
 
 
@@ -377,6 +509,20 @@ def gather_block(b: Block, idx: jax.Array, valid: Optional[jax.Array] = None
             nulls = jnp.where(valid, nulls, True)
         return ArrayColumn(b.elements[idx], b.elem_nulls[idx], lengths,
                            nulls, b.type)
+    if isinstance(b, MapColumn):
+        lengths = b.lengths[idx]
+        nulls = b.nulls[idx]
+        if valid is not None:
+            lengths = jnp.where(valid, lengths, 0)
+            nulls = jnp.where(valid, nulls, True)
+        return MapColumn(b.keys[idx], b.values[idx], b.value_nulls[idx],
+                         lengths, nulls, b.type)
+    if isinstance(b, RowColumn):
+        nulls = b.nulls[idx]
+        if valid is not None:
+            nulls = jnp.where(valid, nulls, True)
+        return RowColumn(tuple(gather_block(f, idx, valid)
+                               for f in b.fields), nulls, b.type)
     if isinstance(b, Int128Column):
         nulls = b.nulls[idx]
         if valid is not None:
@@ -427,6 +573,40 @@ def concat_batches(batches: Sequence[Batch]) -> Batch:
                 jnp.concatenate([b.hi for b in blocks]),
                 jnp.concatenate([b.lo for b in blocks]),
                 jnp.concatenate([b.nulls for b in blocks]), b0.type))
+        elif isinstance(b0, ArrayColumn):
+            max_k = max(b.elements.shape[1] for b in blocks)
+            cols.append(ArrayColumn(
+                jnp.concatenate([
+                    jnp.pad(b.elements,
+                            ((0, 0), (0, max_k - b.elements.shape[1])))
+                    for b in blocks]),
+                jnp.concatenate([
+                    jnp.pad(b.elem_nulls,
+                            ((0, 0), (0, max_k - b.elements.shape[1])))
+                    for b in blocks]),
+                jnp.concatenate([b.lengths for b in blocks]),
+                jnp.concatenate([b.nulls for b in blocks]), b0.type))
+        elif isinstance(b0, MapColumn):
+            max_k = max(b.keys.shape[1] for b in blocks)
+
+            def cat2(field):
+                return jnp.concatenate([
+                    jnp.pad(getattr(b, field),
+                            ((0, 0), (0, max_k - b.keys.shape[1])))
+                    for b in blocks])
+            cols.append(MapColumn(
+                cat2("keys"), cat2("values"), cat2("value_nulls"),
+                jnp.concatenate([b.lengths for b in blocks]),
+                jnp.concatenate([b.nulls for b in blocks]), b0.type))
+        elif isinstance(b0, RowColumn):
+            fields = tuple(
+                concat_batches([Batch((b.fields[fi],),
+                                      jnp.ones(len(b), dtype=bool))
+                                for b in blocks]).columns[0]
+                for fi in range(len(b0.fields)))
+            cols.append(RowColumn(
+                fields, jnp.concatenate([b.nulls for b in blocks]),
+                b0.type))
         else:
             cols.append(Column(jnp.concatenate([b.values for b in blocks]),
                                jnp.concatenate([b.nulls for b in blocks]), b0.type))

@@ -131,6 +131,9 @@ def main(argv=None) -> int:
             buf = []
             try:
                 if args.server:
+                    if args.explain and not re.match(r"\s*explain\b", stmt,
+                                                     re.IGNORECASE):
+                        stmt = f"EXPLAIN {stmt}"
                     run_one_remote(stmt, args.server, args.user,
                                    {"sf": str(args.sf)})
                 else:

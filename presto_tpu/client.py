@@ -29,6 +29,11 @@ class QueryError(RuntimeError):
         self.error_type = error.get("errorType", "INTERNAL_ERROR")
 
 
+def _wire_error(message: str) -> dict:
+    return {"message": str(message), "errorCode": 16,
+            "errorName": "PROTOCOL_ERROR", "errorType": "EXTERNAL"}
+
+
 class StatementClient:
     """One statement's lifecycle: POST, then advance() until done."""
 
@@ -68,9 +73,20 @@ class StatementClient:
                  headers: Optional[Dict] = None) -> Tuple[dict, Dict]:
         req = urllib.request.Request(url, data=body, method=method,
                                      headers=headers or {})
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            doc = json.loads(resp.read().decode())
-            return doc, dict(resp.headers)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                doc = json.loads(resp.read().decode())
+                return doc, dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            # non-2xx still carries the protocol's JSON error document
+            try:
+                doc = json.loads(e.read().decode())
+            except Exception:  # noqa: BLE001
+                doc = {}
+            if isinstance(doc.get("error"), dict):
+                raise QueryError(doc["error"]) from None
+            raise QueryError(_wire_error(
+                doc.get("error") or f"HTTP {e.code}: {e.reason}")) from None
 
     def _absorb(self, doc: dict, headers: Dict) -> None:
         self.query_id = doc.get("id", self.query_id)

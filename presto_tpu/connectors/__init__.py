@@ -8,8 +8,8 @@ column_type.
 """
 
 def _load():
-    from . import tpch, tpcds
-    return {"tpch": tpch, "tpcds": tpcds}
+    from . import memory, tpch, tpcds
+    return {"tpch": tpch, "tpcds": tpcds, "memory": memory}
 
 
 CATALOGS = None

@@ -1,0 +1,260 @@
+"""Writable in-memory connector: the presto-memory analog.
+
+Reference surface: presto-memory (MemoryConnector: MemoryMetadata
+creates/drops tables, MemoryPagesStore holds per-node page lists,
+MemoryPageSinkProvider appends, reads scan the stored pages). This
+engine's version stores numpy column vectors host-side; scans stage
+them into HBM Batches exactly like the generator connectors, so the
+whole read pipeline (stats, dynamic filtering, mesh sharding) treats a
+written table no differently from tpch/tpcds.
+
+Write protocol (the TableWriter/TableFinish contract):
+    h = begin_insert(table[, create_columns=...])   # per query
+    append(h, columns, nulls)                       # per task, any thread
+    finish_insert(h) -> rows                        # atomic publish
+    abort_insert(h)                                 # rollback: no trace
+Appends stage into the handle, invisible to readers until
+finish_insert -- the reference's ConnectorPageSink.finish() ->
+ConnectorMetadata.finishInsert() publish point.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import types as T
+from ..block import batch_from_numpy
+
+__all__ = ["SCHEMA", "create_table", "drop_table", "reset",
+           "table_row_count", "generate_columns", "generate_batch",
+           "column_type", "begin_insert", "append", "finish_insert",
+           "abort_insert", "table_names"]
+
+
+class _Table:
+    def __init__(self, columns: List[str], types: List[T.Type]):
+        self.columns = list(columns)
+        self.types = list(types)
+        # one numpy array + null mask per column; object dtype for
+        # strings/long decimals/arrays, native dtypes otherwise
+        self.values: List[np.ndarray] = [
+            np.array([], dtype=_storage_dtype(t)) for t in types]
+        self.nulls: List[np.ndarray] = [
+            np.array([], dtype=bool) for _ in types]
+
+    @property
+    def row_count(self) -> int:
+        return len(self.values[0]) if self.values else 0
+
+
+def _storage_dtype(ty: T.Type):
+    if ty.is_string or ty.base in ("array", "map", "row") or \
+            (ty.is_decimal and not ty.is_short_decimal):
+        return object
+    return ty.to_dtype()
+
+
+_lock = threading.RLock()
+_tables: Dict[str, _Table] = {}
+_pending: Dict[str, dict] = {}  # handle id -> staging
+
+
+class SCHEMA(dict):  # noqa: N801 - registry expects a SCHEMA mapping
+    """Live view: table -> {column: Type} (reads the store)."""
+
+    def __getitem__(self, table):
+        with _lock:
+            t = _tables[table]
+            return {c: ty for c, ty in zip(t.columns, t.types)}
+
+    def __contains__(self, table):
+        with _lock:
+            return table in _tables
+
+    def __iter__(self):
+        with _lock:
+            return iter(list(_tables))
+
+    def __len__(self):
+        with _lock:
+            return len(_tables)
+
+    def keys(self):
+        with _lock:
+            return list(_tables)
+
+    def items(self):
+        return [(t, self[t]) for t in self.keys()]
+
+    def values(self):
+        return [self[t] for t in self.keys()]
+
+
+SCHEMA = SCHEMA()
+
+
+def table_names() -> List[str]:
+    with _lock:
+        return sorted(_tables)
+
+
+def reset() -> None:
+    """Test hook: drop everything."""
+    with _lock:
+        _tables.clear()
+        _pending.clear()
+
+
+def create_table(name: str, columns: Sequence[str],
+                 types: Sequence[T.Type],
+                 if_not_exists: bool = False) -> None:
+    with _lock:
+        if name in _tables:
+            if if_not_exists:
+                return
+            raise ValueError(f"memory table {name!r} already exists")
+        _tables[name] = _Table(list(columns), list(types))
+
+
+def drop_table(name: str, if_exists: bool = False) -> None:
+    with _lock:
+        if name not in _tables and not if_exists:
+            raise KeyError(f"no memory table {name!r}")
+        _tables.pop(name, None)
+
+
+def column_type(table: str, column: str) -> T.Type:
+    with _lock:
+        t = _tables[table]
+        return t.types[t.columns.index(column)]
+
+
+def table_row_count(table: str, sf: float = 0.0) -> int:
+    with _lock:
+        return _tables[table].row_count
+
+
+def generate_columns(table: str, sf: float, columns: Sequence[str],
+                     start: int = 0, count: Optional[int] = None
+                     ) -> Dict[str, np.ndarray]:
+    """Scan surface (sf is ignored -- stored tables have one size)."""
+    with _lock:
+        t = _tables[table]
+        n = t.row_count
+        count = n - start if count is None else count
+        out = {}
+        for c in columns:
+            i = t.columns.index(c)
+            out[c] = t.values[i][start:start + count].copy()
+        return out
+
+
+def generate_nulls(table: str, columns: Sequence[str], start: int = 0,
+                   count: Optional[int] = None) -> Dict[str, np.ndarray]:
+    with _lock:
+        t = _tables[table]
+        n = t.row_count
+        count = n - start if count is None else count
+        return {c: t.nulls[t.columns.index(c)][start:start + count].copy()
+                for c in columns}
+
+
+def generate_batch(table: str, sf: float, columns: Sequence[str],
+                   start: int = 0, count: Optional[int] = None,
+                   capacity: Optional[int] = None):
+    with _lock:
+        t = _tables[table]
+        n = t.row_count
+        count = n - start if count is None else count
+        vals = []
+        nulls = []
+        types = []
+        for c in columns:
+            i = t.columns.index(c)
+            vals.append(t.values[i][start:start + count])
+            nulls.append(t.nulls[i][start:start + count])
+            types.append(t.types[i])
+    cap = capacity or max(count, 1)
+    return batch_from_numpy(types, vals, capacity=cap, nulls=nulls)
+
+
+# -- write protocol ---------------------------------------------------------
+
+
+def begin_insert(table: str,
+                 create_columns: Optional[Sequence[str]] = None,
+                 create_types: Optional[Sequence[T.Type]] = None) -> str:
+    """Start a staged insert; with create_columns/types this is CTAS:
+    the (empty) table is created NOW so concurrent CTAS to one name
+    conflict early, and dropped again on abort."""
+    with _lock:
+        created = False
+        if create_columns is not None:
+            create_table(table, create_columns, create_types)
+            created = True
+        if table not in _tables:
+            raise KeyError(f"no memory table {table!r}")
+        h = f"ins_{uuid.uuid4().hex[:12]}"
+        t = _tables[table]
+        _pending[h] = {"table": table, "created": created,
+                       "values": [[] for _ in t.columns],
+                       "nulls": [[] for _ in t.columns]}
+        return h
+
+
+def append(handle: str, columns: Sequence[np.ndarray],
+           nulls: Optional[Sequence[np.ndarray]] = None) -> int:
+    """Stage one result chunk (a task's output). Returns rows staged."""
+    with _lock:
+        st = _pending[handle]
+        t = _tables[st["table"]]
+        if len(columns) != len(t.columns):
+            raise ValueError(
+                f"insert arity {len(columns)} != table arity "
+                f"{len(t.columns)}")
+        n = len(columns[0]) if len(columns) else 0
+        for i, col in enumerate(columns):
+            st["values"][i].append(np.asarray(col))
+            st["nulls"][i].append(
+                np.asarray(nulls[i], dtype=bool) if nulls is not None
+                else np.zeros(n, dtype=bool))
+        return n
+
+
+def finish_insert(handle: str) -> int:
+    """Atomic publish of every staged chunk; returns rows written."""
+    with _lock:
+        st = _pending.pop(handle)
+        t = _tables[st["table"]]
+        rows = 0
+        for i in range(len(t.columns)):
+            chunks = st["values"][i]
+            if not chunks:
+                continue
+            add = np.concatenate([np.asarray(c, dtype=t.values[i].dtype)
+                                  for c in chunks]) \
+                if t.values[i].dtype != object else \
+                np.concatenate([_to_object(c) for c in chunks])
+            t.values[i] = np.concatenate([t.values[i], add])
+            t.nulls[i] = np.concatenate(
+                [t.nulls[i], np.concatenate(st["nulls"][i])])
+        rows = sum(len(c) for c in st["values"][0]) if t.columns else 0
+        return rows
+
+
+def _to_object(arr) -> np.ndarray:
+    out = np.empty(len(arr), dtype=object)
+    for i, v in enumerate(arr):
+        out[i] = v
+    return out
+
+
+def abort_insert(handle: str) -> None:
+    with _lock:
+        st = _pending.pop(handle, None)
+        if st is not None and st["created"]:
+            _tables.pop(st["table"], None)

@@ -52,18 +52,23 @@ def _scan_batch(node: N.PlanNode, sf: float, capacity_hint: Optional[int],
                 dyn_filters=None, stats=None) -> Batch:
     if isinstance(node, N.ValuesNode):
         arrays = []
+        null_masks = []
         for ci, ty in enumerate(node.types):
             col = [r[ci] for r in node.rows]
-            if ty.is_string or ty.base == "array" or \
+            nulls = np.array([v is None for v in col], dtype=bool)
+            if ty.is_string or ty.base in ("array", "map", "row") or \
                     (ty.is_decimal and not ty.is_short_decimal):
                 a = np.empty(len(col), dtype=object)
                 for i, v in enumerate(col):
                     a[i] = v
                 arrays.append(a)
             else:
-                arrays.append(np.array(col, dtype=ty.to_dtype()))
+                arrays.append(np.array([0 if v is None else v for v in col],
+                                       dtype=ty.to_dtype()))
+            null_masks.append(nulls)
         cap = capacity_hint or -(-len(node.rows) // pad_multiple) * pad_multiple
-        return batch_from_numpy(node.types, arrays, capacity=cap)
+        return batch_from_numpy(node.types, arrays, nulls=null_masks,
+                                capacity=cap)
     assert isinstance(node, N.TableScanNode)
     from ..connectors import catalog
     conn = catalog(node.connector)
@@ -87,7 +92,12 @@ def _scan_batch(node: N.PlanNode, sf: float, capacity_hint: Optional[int],
         tys = node.column_types
         nrows = len(arrays[0])
         cap = max(-(-nrows // pad_multiple) * pad_multiple, pad_multiple)
-        return batch_from_numpy(tys, arrays, capacity=cap)
+        nulls = None
+        if hasattr(conn, "generate_nulls"):  # stored tables carry nulls
+            nmap = conn.generate_nulls(node.table, node.columns,
+                                       start, count)
+            nulls = [nmap[c][keep] for c in node.columns]
+        return batch_from_numpy(tys, arrays, capacity=cap, nulls=nulls)
     cap = capacity_hint or max(-(-count // pad_multiple) * pad_multiple,
                                pad_multiple)
     return conn.generate_batch(node.table, sf, node.columns, start=start,
@@ -108,6 +118,19 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     multiple of the mesh size and the plan runs SPMD. With `split_rows`,
     streamable aggregation plans execute split-by-split with bounded
     HBM (exec/streaming.py)."""
+    # write/DDL roots execute their source on device, then write
+    # host-side (TableWriterOperator.java:76 analog -- the sink is a
+    # host effect, fed by one DMA-out of the computed rows)
+    inner_root = root.source if isinstance(root, N.OutputNode) else root
+    if isinstance(inner_root, (N.DdlNode, N.TableFinishNode,
+                               N.TableWriterNode)):
+        return _run_write_root(
+            inner_root, sf=sf, mesh=mesh, capacity_hints=capacity_hints,
+            default_join_capacity=default_join_capacity,
+            split_rows=split_rows, scan_ranges=scan_ranges,
+            remote_sources=remote_sources, memory_pool=memory_pool,
+            query_id=query_id, session=session,
+            hbm_budget_bytes=hbm_budget_bytes)
     # rule-based simplification + channel pruning (IterativeOptimizer /
     # PruneUnreferencedOutputs analog): narrows intermediates before
     # stats and distribution decide capacities and exchange widths
@@ -187,13 +210,22 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     # not share -- those callers (the fragment tier) compile fresh.
     use_cache = not hints and not scan_ranges and not remote_sources
     if use_cache:
-        from .plan_cache import cached_compile
-        plan, jfn, call_lock = cached_compile(root, mesh,
-                                              default_join_capacity)
+        from .plan_cache import plan_fingerprint
+        plan, jfn, call_lock = _compile_any(root, mesh,
+                                            default_join_capacity, 1, True)
         root = plan.root  # canonical tree: node ids match plan.scan_nodes
+        fp = plan_fingerprint(root)
     else:
-        plan = compile_plan(root, mesh, default_join_capacity)
-        jfn, call_lock = None, None
+        plan, jfn, call_lock = _compile_any(root, mesh,
+                                            default_join_capacity, 1, False)
+        fp = None
+    adaptive_off = False
+    if session is not None:
+        try:
+            v = session.get("adaptive_capacity")
+        except (KeyError, TypeError):
+            v = None
+        adaptive_off = v is not None and not v
     # dynamic filtering (local tier): dimension build sides run first
     # and their key domains prune fact scans at staging time
     dyn_filters = {}
@@ -251,6 +283,17 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
             # reference runs as reserve/revoke -- here it recompiles
             # with bigger static buckets instead.
             scale = 1
+            cap_scale = _CAPACITY_FEEDBACK.get(fp, 1) if fp else 1
+            exec_root = root if cap_scale == 1 else None  # set below
+            if cap_scale > 1:
+                # HBO-lite: a structurally identical plan overflowed
+                # before; start from the capacities that worked
+                from ..plan.stats import scale_capacities
+                exec_root = scale_capacities(root, cap_scale)
+                plan, jfn, call_lock = _compile_any(
+                    exec_root, mesh, default_join_capacity * cap_scale,
+                    1, use_cache)
+                stats.add("capacity_feedback_scale", cap_scale)
             while True:
                 if jfn is None:
                     fn = jax.jit(plan.fn)
@@ -261,30 +304,42 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
                 jax.block_until_ready(out)
                 flags = int(np.asarray(overflow))
                 if flags == 0:
+                    if cap_scale > 1 and fp:
+                        _CAPACITY_FEEDBACK[fp] = cap_scale
                     break
                 if flags & 1:
-                    hint = (" (note: connector NDV statistics shrank "
-                            "group capacities this run; set session "
-                            "stats_capacity_refinement=false if a "
-                            "hand-set max_groups must stand)"
-                            if refine else "")
-                    raise RuntimeError(
-                        "plan execution overflowed a static bucket (join/"
-                        "group capacity); rerun with larger capacity "
-                        "hints (max_groups / join_capacity)" + hint)
+                    # hard (join/group/unnest) overflow: adaptive rerun
+                    # with geometrically larger capacities (the
+                    # memory-feedback loop that replaces per-query hand
+                    # hints; reserve/revoke analog)
+                    if cap_scale >= _MAX_CAPACITY_SCALE or adaptive_off:
+                        hint = (" (note: connector NDV statistics shrank "
+                                "group capacities this run; set session "
+                                "stats_capacity_refinement=false if a "
+                                "hand-set max_groups must stand)"
+                                if refine else "")
+                        raise RuntimeError(
+                            "plan execution overflowed a static bucket "
+                            "(join/group capacity) beyond the adaptive "
+                            "rerun ceiling; rerun with larger capacity "
+                            "hints (max_groups / join_capacity)" + hint)
+                    from ..plan.stats import scale_capacities
+                    cap_scale *= 4
+                    stats.add("capacity_reruns", 1)
+                    exec_root = scale_capacities(root, cap_scale)
+                    scale = 1
+                    plan, jfn, call_lock = _compile_any(
+                        exec_root, mesh, default_join_capacity * cap_scale,
+                        1, use_cache)
+                    continue
                 if mesh is None or scale >= 1 << 20:  # unreachable: clamp
                     raise RuntimeError(
                         "exchange slot overflow did not converge")
                 scale *= 2
                 stats.add("exchange_slot_reruns", 1)
-                if use_cache:
-                    from .plan_cache import cached_compile
-                    plan, jfn, call_lock = cached_compile(
-                        root, mesh, default_join_capacity,
-                        exchange_slot_scale=scale)
-                else:
-                    plan = compile_plan(root, mesh, default_join_capacity,
-                                        exchange_slot_scale=scale)
+                plan, jfn, call_lock = _compile_any(
+                    exec_root if exec_root is not None else root, mesh,
+                    default_join_capacity * cap_scale, scale, use_cache)
         with stats.timed("fetch_s"):
             res = _batch_to_result(out, root)
     finally:
@@ -293,6 +348,90 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     stats.add("output_rows", res.row_count)
     res.stats = stats.snapshot()
     return res
+
+
+# adaptive-capacity feedback (HBO-lite, HistoryBasedPlanStatistics
+# analog): plan fingerprint -> the capacity scale that made it fit.
+# Bounded process-local memory; structurally identical future
+# submissions start at the known-good size instead of re-laddering.
+_CAPACITY_FEEDBACK: Dict[str, int] = {}
+_MAX_CAPACITY_SCALE = 1 << 10
+
+
+def _compile_any(root: N.PlanNode, mesh, default_join_capacity: int,
+                 slot_scale: int, use_cache: bool):
+    """(CompiledPlan, jitted-fn-or-None, lock-or-None) via the
+    compiled-plan cache when node-id-keyed kwargs aren't in play."""
+    if use_cache:
+        from .plan_cache import cached_compile
+        return cached_compile(root, mesh, default_join_capacity,
+                              exchange_slot_scale=slot_scale)
+    return (compile_plan(root, mesh, default_join_capacity,
+                         exchange_slot_scale=slot_scale), None, None)
+
+
+def _count_result(rows: int, name: str = "rows") -> QueryResult:
+    return QueryResult([np.array([rows], dtype=np.int64)],
+                       [np.array([False])], [name], 1,
+                       types=[T.BIGINT])
+
+
+def _run_write_root(node: N.PlanNode, **kw) -> QueryResult:
+    """Execute a DdlNode / TableFinishNode / TableWriterNode root.
+
+    Local + mesh tiers run the whole write under one TableFinish
+    (staged handle, atomic publish). On the HTTP tier the fragmenter
+    splits writer and finish: each worker task's TableWriterNode
+    publishes its own chunk (the presto-memory per-node append
+    semantics) and the finish fragment just sums counts."""
+    from ..connectors import catalog
+
+    if isinstance(node, N.DdlNode):
+        assert node.op == "drop_table", node.op
+        catalog(node.connector).drop_table(node.table,
+                                           if_exists=node.if_exists)
+        res = QueryResult([np.array([True])], [np.array([False])],
+                          ["result"], 1, types=[T.BOOLEAN])
+        return res
+
+    if isinstance(node, N.TableWriterNode):
+        res = run_query(N.OutputNode(node.source, node.column_names), **kw)
+        mod = catalog(node.connector)
+        h = mod.begin_insert(node.table)
+        try:
+            mod.append(h, res.columns, res.nulls)
+            rows = mod.finish_insert(h)
+        except BaseException:
+            mod.abort_insert(h)
+            raise
+        return _count_result(rows)
+
+    finish: N.TableFinishNode = node
+    mod = catalog(finish.connector)
+    src = finish.source
+    # single-process execution collapses the writer/finish exchange seam
+    while isinstance(src, N.ExchangeNode):
+        src = src.source
+    if isinstance(src, N.TableWriterNode):
+        # single-process (local/mesh) write: stage + atomic publish
+        h = mod.begin_insert(
+            finish.table,
+            create_columns=finish.create_columns if finish.create else None,
+            create_types=finish.create_types if finish.create else None)
+        try:
+            res = run_query(N.OutputNode(src.source, src.column_names),
+                            **kw)
+            mod.append(h, res.columns, res.nulls)
+            rows = mod.finish_insert(h)
+        except BaseException:
+            mod.abort_insert(h)
+            raise
+        return _count_result(rows)
+    # distributed finish: the source plan delivers per-task counts
+    res = run_query(N.OutputNode(finish.source, ["rows"]), **kw)
+    total = int(sum(int(v) for v, nl in zip(res.columns[0], res.nulls[0])
+                    if not nl))
+    return _count_result(total)
 
 
 def _planned_scan_bytes(node: N.PlanNode, sf: float,

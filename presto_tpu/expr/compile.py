@@ -164,6 +164,19 @@ def evaluate(expr: RowExpression, batch: Batch) -> Block:
     if isinstance(expr, Call):
         name = expr.name.lower()
         # compile-time interceptions
+        if name == "row_field":
+            # the field index is plan structure, not data: resolve it
+            # at trace time (a traced index would force a dynamic gather
+            # across fields of possibly different types)
+            from ..block import RowColumn, gather_block
+            r = evaluate(expr.arguments[0], batch)
+            idx = expr.arguments[1]
+            assert isinstance(idx, Constant), "row_field index is static"
+            assert isinstance(r, RowColumn), type(r)
+            import jax.numpy as _jnp
+            return gather_block(r.fields[int(idx.value)],
+                                _jnp.arange(len(r), dtype=_jnp.int32),
+                                ~r.nulls)
         if name == "like":
             a = evaluate(expr.arguments[0], batch)
             pat = expr.arguments[1]

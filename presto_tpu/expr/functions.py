@@ -1121,16 +1121,30 @@ def _cast(ret, a):
 
 @register("cardinality")
 def _cardinality(ret, a):
-    from ..block import ArrayColumn
-    assert isinstance(a, ArrayColumn)
+    from ..block import ArrayColumn, MapColumn
+    assert isinstance(a, (ArrayColumn, MapColumn))
     return Column(a.lengths.astype(ret.to_dtype()), a.nulls, ret)
 
 
 @register("element_at")
 def _element_at(ret, a, idx: Column):
     """element_at(array, i): 1-based; negative counts from the end;
-    out-of-range -> NULL (Presto element_at semantics)."""
-    from ..block import ArrayColumn
+    out-of-range -> NULL. element_at(map, key): value at key or NULL
+    (Presto element_at semantics)."""
+    from ..block import ArrayColumn, MapColumn
+    if isinstance(a, MapColumn):
+        # per-row key probe across the fixed-fanout lanes (K is small:
+        # one masked compare + argmax, no gather scatter)
+        k = idx.values[:, None]
+        lanes = jnp.arange(a.max_cardinality, dtype=jnp.int32)[None, :]
+        in_range = lanes < a.lengths[:, None]
+        hit = in_range & (a.keys == k)
+        has = jnp.any(hit, axis=1)
+        j = jnp.argmax(hit, axis=1)
+        rows = jnp.arange(len(a), dtype=jnp.int32)
+        vals = a.values[rows, j]
+        nulls = a.nulls | idx.nulls | ~has | a.value_nulls[rows, j]
+        return Column(vals, nulls, ret)
     assert isinstance(a, ArrayColumn)
     i0 = idx.values.astype(jnp.int32)
     pos = jnp.where(i0 < 0, a.lengths + i0, i0 - 1)
@@ -1140,6 +1154,43 @@ def _element_at(ret, a, idx: Column):
     vals = a.elements[rows, pc]
     nulls = a.nulls | idx.nulls | oob | a.elem_nulls[rows, pc]
     return Column(vals, nulls, ret)
+
+
+@register("row_pack")
+def _row_pack(ret, *fields):
+    """Pack columns into one ROW-typed column (the wire shape of
+    multi-column aggregation intermediate states: avg's (sum, count)
+    pair ships as one row(sum_type, bigint) variable, exactly like the
+    reference's serialized accumulator states)."""
+    from ..block import RowColumn
+    n = len(fields[0])
+    return RowColumn(tuple(fields), jnp.zeros(n, dtype=bool), ret)
+
+
+@register("row_field")
+def _row_field(ret, r, idx: Column):
+    """0-based struct field access (the dereference primitive)."""
+    from ..block import RowColumn, gather_block
+    assert isinstance(r, RowColumn)
+    i = int(np.asarray(idx.values)[0])
+    f = r.fields[i]
+    # a NULL row nulls every field
+    return gather_block(f, jnp.arange(len(r), dtype=jnp.int32), ~r.nulls)
+
+
+@register("map_keys")
+def _map_keys(ret, m):
+    from ..block import ArrayColumn, MapColumn
+    assert isinstance(m, MapColumn)
+    return ArrayColumn(m.keys, jnp.zeros_like(m.value_nulls), m.lengths,
+                       m.nulls, ret)
+
+
+@register("map_values")
+def _map_values(ret, m):
+    from ..block import ArrayColumn, MapColumn
+    assert isinstance(m, MapColumn)
+    return ArrayColumn(m.values, m.value_nulls, m.lengths, m.nulls, ret)
 
 
 @register("contains")

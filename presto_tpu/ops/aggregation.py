@@ -83,8 +83,19 @@ _AGGS = ("sum", "count", "count_star", "min", "max", "avg",
 
 # canonical name -> implementation family
 _ALIAS = {"stddev": "stddev_samp", "variance": "var_samp",
-          "every": "bool_and", "any_value": "arbitrary",
-          "approx_distinct": "count_distinct"}
+          "every": "bool_and", "any_value": "arbitrary"}
+
+# HyperLogLog (approx_distinct): dense 2^p x int8 register vectors --
+# a natural TPU state (flat, fixed-shape, merged by elementwise max).
+# p=11 gives ~2.3% standard error (the reference default maps
+# approx_distinct's 2.3% max error to the same register count --
+# ApproximateCountDistinctAggregation.java).
+_HLL_P = 11
+_HLL_M = 1 << _HLL_P
+
+
+def hll_state_type() -> T.Type:
+    return T.array_of(T.TINYINT)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -479,6 +490,16 @@ def _sorted_states(spec: AggSpec, scol, live, start, end, new_seg,
     if name == "count_distinct":
         cnt = _seg_total((live & pair_first).astype(jnp.int64), start, end)
         return [("count", Column(cnt, zeros_g, T.BIGINT))]
+    if name in ("approx_distinct", "hll_merge"):
+        # the HLL scatter kernels are sort-order-agnostic: rebuild the
+        # per-row segment ids from the boundary flags and reuse them
+        seg_ids = jnp.cumsum(new_seg.astype(jnp.int32)) - 1
+        seg_ids = jnp.clip(seg_ids, 0, max(g - 1, 0))
+        if name == "approx_distinct":
+            regs = _hll_registers_from_values(scol, live, seg_ids, g)
+        else:
+            regs = _hll_registers_merge(scol, live, seg_ids, g)
+        return [("hll", _hll_state_column(regs))]
     if name == "approx_percentile":
         assert spec.parameter is not None, "approx_percentile needs fraction"
         n = live.shape[0]
@@ -646,6 +667,56 @@ def _group_by_sorted(batch: Batch, key_channels, aggs, max_groups: int
                          num_groups, overflow)
 
 
+def _hll_registers_from_values(col: Block, live, ids, g: int) -> jnp.ndarray:
+    """(g, m) int8 register matrix: scatter-max of leading-zero ranks.
+    Works for every key-able Block kind (hash via ops.keys words)."""
+    vwords, _ = key_words([col])
+    h = _hash_words(vwords[1:])  # value words only; nulls excluded by live
+    reg = (h >> np.uint64(64 - _HLL_P)).astype(jnp.int64)
+    w = (h << np.uint64(_HLL_P)).astype(jnp.uint64)
+    rank = jnp.where(w == 0, 64 - _HLL_P + 1,
+                     jax.lax.clz(w) + 1).astype(jnp.int8)
+    flat = jnp.where(live, ids.astype(jnp.int64) * _HLL_M + reg,
+                     g * _HLL_M)
+    regs = jnp.zeros(g * _HLL_M + 1, dtype=jnp.int8).at[flat].max(
+        jnp.where(live, rank, jnp.int8(0)))
+    return regs[:g * _HLL_M].reshape(g, _HLL_M)
+
+
+def _hll_registers_merge(col, live, ids, g: int) -> jnp.ndarray:
+    """Merge partial register vectors (ArrayColumn rows) per group:
+    elementwise max -- the HLL union, exact over merges."""
+    from ..block import ArrayColumn
+    assert isinstance(col, ArrayColumn), type(col)
+    elems = col.elements.astype(jnp.int8)
+    contrib = jnp.where(live[:, None], elems, jnp.int8(0))
+    safe = jnp.where(live, ids, g).astype(jnp.int32)
+    regs = jnp.zeros((g + 1, _HLL_M), dtype=jnp.int8).at[safe].max(contrib)
+    return regs[:g]
+
+
+def _hll_state_column(regs: jnp.ndarray) -> "Block":
+    from ..block import ArrayColumn
+    g = regs.shape[0]
+    return ArrayColumn(regs, jnp.zeros_like(regs, dtype=bool),
+                       jnp.full(g, _HLL_M, dtype=jnp.int32),
+                       jnp.zeros(g, dtype=bool), hll_state_type())
+
+
+def hll_estimate(regs: jnp.ndarray) -> jnp.ndarray:
+    """Registers (g, m) -> int64 cardinality estimates (the standard
+    HLL estimator + linear counting in the small range)."""
+    m = float(_HLL_M)
+    r = regs.astype(jnp.float64)
+    z = jnp.sum(jnp.exp2(-r), axis=1)
+    alpha = 0.7213 / (1 + 1.079 / m)
+    e = alpha * m * m / z
+    v = jnp.sum(regs == 0, axis=1)
+    lin = m * jnp.log(m / jnp.maximum(v, 1).astype(jnp.float64))
+    est = jnp.where((e <= 2.5 * m) & (v > 0), lin, e)
+    return jnp.round(est).astype(jnp.int64)
+
+
 def _masked_active(batch: Batch, spec: AggSpec) -> jnp.ndarray:
     """Rows this aggregate consumes: batch.active further restricted by
     the spec's BOOLEAN mask column (NULL mask = excluded)."""
@@ -701,6 +772,13 @@ def _acc_columns(spec: AggSpec, col: Optional[Block], ids, active, max_groups: i
             overflow_out.append(ovf)
         cnt = _seg_count(ids, first & live, g)
         return [("count", Column(cnt, jnp.zeros(g, dtype=bool), T.BIGINT))]
+
+    if name == "approx_distinct":
+        regs = _hll_registers_from_values(col, live, ids, g)
+        return [("hll", _hll_state_column(regs))]
+    if name == "hll_merge":
+        regs = _hll_registers_merge(col, live, ids, g)
+        return [("hll", _hll_state_column(regs))]
 
     if isinstance(col, StringColumn):
         if name in ("min", "max"):
@@ -986,6 +1064,10 @@ def merge_spec(spec: AggSpec, state_channel: int) -> List[AggSpec]:
                         second_type=spec.second_type)]
     if c == "arbitrary":
         return [AggSpec("arbitrary", state_channel, spec.output_type)]
+    if c == "approx_distinct":
+        # register vectors union by elementwise max -- exactly mergeable
+        # across PARTIAL tables, workers, and the mesh
+        return [AggSpec("hll_merge", state_channel, T.BIGINT)]
     if c in ("count_distinct", "approx_percentile"):
         raise NotImplementedError(
             f"{spec.name} states don't merge across partials; distributed "
@@ -1038,6 +1120,10 @@ def finalize_states(table: Batch, num_keys: int, aggs: Sequence[AggSpec]
             cnt, s, s2 = states
             v, nulls = finalize_variance(spec, cnt.values, s.values, s2.values)
             cols.append(Column(v, nulls, T.DOUBLE))
+        elif c == "approx_distinct":
+            est = hll_estimate(states[0].elements)
+            cols.append(Column(est, jnp.zeros(len(est), dtype=bool),
+                               T.BIGINT))
         else:
             # single-state aggregates pass through; min_by/max_by keep
             # only the value column (states[0])

@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import types as T
-from ..block import ArrayColumn, Batch, Block, Column, \
+from ..block import ArrayColumn, Batch, Block, Column, MapColumn, \
     gather_block as _gather
 
 __all__ = ["unnest"]
@@ -27,12 +27,14 @@ __all__ = ["unnest"]
 
 def unnest(batch: Batch, array_channel: int, out_capacity: int,
            with_ordinality: bool = False) -> Tuple[Batch, jnp.ndarray]:
-    """Expand batch rows by the array at `array_channel`. Output columns:
-    all input columns except the array, then the element column (and an
-    ordinality BIGINT column when requested). NULL/empty arrays emit no
-    rows (Presto UNNEST semantics). Returns (batch, overflow)."""
+    """Expand batch rows by the array (or map) at `array_channel`.
+    Output columns: all input columns except the unnested one, then the
+    element column -- for maps, a key column THEN a value column -- and
+    an ordinality BIGINT column when requested. NULL/empty collections
+    emit no rows (Presto UNNEST semantics). Returns (batch, overflow)."""
     arr = batch.column(array_channel)
-    assert isinstance(arr, ArrayColumn), "unnest requires an array column"
+    assert isinstance(arr, (ArrayColumn, MapColumn)), \
+        "unnest requires an array or map column"
     n = batch.capacity
 
     cnt = jnp.where(batch.active & ~arr.nulls, arr.lengths, 0).astype(jnp.int64)
@@ -51,9 +53,17 @@ def unnest(batch: Batch, array_channel: int, out_capacity: int,
         if ci == array_channel:
             continue
         out_cols.append(_gather(c, row, valid))
-    elem_vals = arr.elements[row, jc]
-    elem_nulls = jnp.where(valid, arr.elem_nulls[row, jc], True)
-    out_cols.append(Column(elem_vals, elem_nulls, arr.type.element_type))
+    if isinstance(arr, MapColumn):
+        key_vals = arr.keys[row, jc]
+        out_cols.append(Column(key_vals, ~valid, arr.type.key_type))
+        val_vals = arr.values[row, jc]
+        val_nulls = jnp.where(valid, arr.value_nulls[row, jc], True)
+        out_cols.append(Column(val_vals, val_nulls, arr.type.value_type))
+    else:
+        elem_vals = arr.elements[row, jc]
+        elem_nulls = jnp.where(valid, arr.elem_nulls[row, jc], True)
+        out_cols.append(Column(elem_vals, elem_nulls,
+                               arr.type.element_type))
     if with_ordinality:
         out_cols.append(Column(j + 1, ~valid, T.BIGINT))
     return Batch(tuple(out_cols), valid), overflow

@@ -48,6 +48,16 @@ def _map_block(b: Block, fn) -> Block:
         return StringColumn(fn(b.chars), fn(b.lengths), fn(b.nulls), b.type)
     if isinstance(b, Int128Column):
         return Int128Column(fn(b.hi), fn(b.lo), fn(b.nulls), b.type)
+    from ..block import ArrayColumn, MapColumn, RowColumn
+    if isinstance(b, ArrayColumn):
+        return ArrayColumn(fn(b.elements), fn(b.elem_nulls), fn(b.lengths),
+                           fn(b.nulls), b.type)
+    if isinstance(b, MapColumn):
+        return MapColumn(fn(b.keys), fn(b.values), fn(b.value_nulls),
+                         fn(b.lengths), fn(b.nulls), b.type)
+    if isinstance(b, RowColumn):
+        return RowColumn(tuple(_map_block(f, fn) for f in b.fields),
+                         fn(b.nulls), b.type)
     return Column(fn(b.values), fn(b.nulls), b.type)
 
 

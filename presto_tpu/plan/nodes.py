@@ -30,7 +30,8 @@ from ..ops.aggregation import AggSpec
 __all__ = ["PlanNode", "TableScanNode", "ValuesNode", "FilterNode",
            "ProjectNode", "AggregationNode", "JoinNode", "SemiJoinNode",
            "SortNode", "TopNNode", "LimitNode", "DistinctNode",
-           "ExchangeNode", "OutputNode", "to_json", "from_json"]
+           "ExchangeNode", "OutputNode", "TableWriterNode",
+           "TableFinishNode", "DdlNode", "to_json", "from_json"]
 
 
 _next_id = [0]
@@ -134,10 +135,12 @@ class AggregationNode(PlanNode):
             # ships raw state columns over exchanges
             out.extend(a.output_type for a in self.aggregates)
             return out
-        from ..ops.aggregation import _sum_type
+        from ..ops.aggregation import _sum_type, hll_state_type
         for a in self.aggregates:
             c = a.canonical
-            if c == "avg":  # (sum, count) state pair
+            if c == "approx_distinct":
+                out.append(hll_state_type())
+            elif c == "avg":  # (sum, count) state pair
                 out.extend([_sum_type(src[a.input_channel]), T.BIGINT])
             elif c in ("var_samp", "var_pop", "stddev_samp", "stddev_pop"):
                 # raw (count, sum, sumsq) moments
@@ -367,7 +370,10 @@ class UnnestNode(PlanNode):
         src = self.source.output_types()
         arr = src[self.array_channel]
         out = [t for i, t in enumerate(src) if i != self.array_channel]
-        out.append(arr.element_type)
+        if arr.base == "map":
+            out.extend([arr.key_type, arr.value_type])
+        else:
+            out.append(arr.element_type)
         if self.with_ordinality:
             out.append(T.BIGINT)
         return out
@@ -400,6 +406,63 @@ class GroupIdNode(PlanNode):
 
     def output_types(self):
         return self.source.output_types() + [T.BIGINT]
+
+
+@dataclasses.dataclass
+class DdlNode(PlanNode):
+    """Coordinator-side data definition (the DataDefinitionTask family,
+    execution/CreateTableTask etc.): executes host-side against
+    connector metadata, no device work. `op`: drop_table (more arrive
+    with the DDL surface)."""
+    op: str
+    connector: str
+    table: str
+    if_exists: bool = False
+
+    def output_types(self):
+        return [T.BOOLEAN]
+
+
+@dataclasses.dataclass
+class TableWriterNode(PlanNode):
+    """Write source rows into a connector table
+    (spi/plan/TableWriterNode + operator/TableWriterOperator.java:76
+    analog). Executes host-side AFTER the source program runs on
+    device (writes are a host effect; the device computes, one DMA-out
+    feeds the sink). Output: one BIGINT row -- rows this task wrote."""
+    source: PlanNode
+    connector: str
+    table: str
+    column_names: List[str] = dataclasses.field(default_factory=list)
+    insert_handle: Optional[str] = None  # runtime: shared staging handle
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_types(self):
+        return [T.BIGINT]
+
+
+@dataclasses.dataclass
+class TableFinishNode(PlanNode):
+    """Commit point (spi/plan/TableFinishNode analog): sums the
+    per-task written-row counts and atomically publishes the staged
+    insert (ConnectorMetadata.finishInsert / finishCreateTable).
+    `create_*` carry CTAS table metadata."""
+    source: PlanNode
+    connector: str
+    table: str
+    create: bool = False
+    create_columns: List[str] = dataclasses.field(default_factory=list)
+    create_types: List[T.Type] = dataclasses.field(default_factory=list)
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_types(self):
+        return [T.BIGINT]
 
 
 @dataclasses.dataclass
@@ -547,6 +610,20 @@ def to_json(n: PlanNode) -> dict:
                 "slotCapacity": n.slot_capacity,
                 "sortKeys": [list(k) for k in n.sort_keys]
                 if n.sort_keys is not None else None}
+    if isinstance(n, DdlNode):
+        return {**base, "@type": "ddl", "op": n.op,
+                "connector": n.connector, "table": n.table,
+                "ifExists": n.if_exists}
+    if isinstance(n, TableWriterNode):
+        return {**base, "@type": "tablewriter", "source": to_json(n.source),
+                "connector": n.connector, "table": n.table,
+                "columnNames": n.column_names,
+                "insertHandle": n.insert_handle}
+    if isinstance(n, TableFinishNode):
+        return {**base, "@type": "tablefinish", "source": to_json(n.source),
+                "connector": n.connector, "table": n.table,
+                "create": n.create, "createColumns": n.create_columns,
+                "createTypes": [str(t) for t in n.create_types]}
     if isinstance(n, OutputNode):
         return {**base, "@type": "output", "source": to_json(n.source),
                 "names": n.names}
@@ -624,6 +701,19 @@ def from_json(j: dict) -> PlanNode:
                             j["partitionChannels"], j["slotCapacity"],
                             sort_keys=[tuple(k) for k in j["sortKeys"]]
                             if j.get("sortKeys") is not None else None, **kw)
+    if t == "ddl":
+        return DdlNode(j["op"], j["connector"], j["table"],
+                       j.get("ifExists", False), **kw)
+    if t == "tablewriter":
+        return TableWriterNode(from_json(j["source"]), j["connector"],
+                               j["table"], j["columnNames"],
+                               j.get("insertHandle"), **kw)
+    if t == "tablefinish":
+        return TableFinishNode(from_json(j["source"]), j["connector"],
+                               j["table"], j["create"],
+                               j["createColumns"],
+                               [T.parse_type(s) for s in j["createTypes"]],
+                               **kw)
     if t == "output":
         return OutputNode(from_json(j["source"]), j["names"], **kw)
     raise ValueError(f"unknown plan node {t!r}")

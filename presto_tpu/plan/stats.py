@@ -1,5 +1,11 @@
 """Plan statistics: column provenance, NDV and row estimates.
 
+Also home of `scale_capacities`, the adaptive-rerun rewrite: when a
+static bucket overflows at runtime, the runner re-plans with every
+capacity geometrically enlarged (the memory-feedback analog of the
+reference's reserve/revoke loop) instead of failing the query -- the
+piece that lets NDV-driven sizing stand WITHOUT per-query hand hints.
+
 Reference surface: the cost/stats stack --
 presto-main-base/.../cost/StatsCalculator.java (per-PlanNode stats
 propagation), cost/CostCalculatorUsingExchanges.java, and the connector
@@ -235,3 +241,47 @@ def estimate_rows(node: N.PlanNode, sf: float) -> Optional[float]:
     if node.sources:
         return estimate_rows(node.sources[0], sf)
     return None
+
+
+_MAX_GROUPS_CEILING = 1 << 23
+_CAPACITY_CEILING = 1 << 24
+
+
+def scale_capacities(root: N.PlanNode, factor: int) -> N.PlanNode:
+    """Rebuild the plan with every static capacity multiplied by
+    `factor` (group tables, join/unnest out-capacities), preserving
+    shared subtrees (CTE DAGs). Exchange slot capacities are excluded:
+    slot overflow has its own (cheaper) rerun loop in the executor."""
+    import dataclasses
+
+    memo: dict = {}
+
+    def walk(n: N.PlanNode) -> N.PlanNode:
+        if id(n) in memo:
+            return memo[id(n)]
+        changes = {}
+        for f in dataclasses.fields(n):
+            v = getattr(n, f.name)
+            if isinstance(v, N.PlanNode):
+                w = walk(v)
+                if w is not v:
+                    changes[f.name] = w
+            elif isinstance(v, list) and v and isinstance(v[0], N.PlanNode):
+                w = [walk(x) for x in v]
+                if any(a is not b for a, b in zip(w, v)):
+                    changes[f.name] = w
+        if isinstance(n, (N.AggregationNode, N.DistinctNode,
+                          N.MarkDistinctNode)):
+            changes["max_groups"] = min(n.max_groups * factor,
+                                        _MAX_GROUPS_CEILING)
+        if isinstance(n, N.JoinNode) and n.out_capacity is not None:
+            changes["out_capacity"] = min(n.out_capacity * factor,
+                                          _CAPACITY_CEILING)
+        if isinstance(n, N.UnnestNode) and n.out_capacity is not None:
+            changes["out_capacity"] = min(n.out_capacity * factor,
+                                          _CAPACITY_CEILING)
+        out = dataclasses.replace(n, **changes) if changes else n
+        memo[id(n)] = out
+        return out
+
+    return walk(root)

@@ -95,6 +95,10 @@ def _bitunpack_nulls(buf: memoryview, pos: int, rows: int
     return bits[:rows].astype(bool), pos + nbytes
 
 
+def _item(v):
+    return v.item() if isinstance(v, np.generic) else v
+
+
 def _fixed_dtype(width: int, ty: Optional[T.Type]) -> np.dtype:
     if ty is not None:
         return ty.to_dtype()
@@ -184,6 +188,84 @@ def _serialize_array(vals: np.ndarray, nulls: np.ndarray,
                      _bitpack_nulls(np.asarray(nulls, dtype=bool))])
 
 
+def _serialize_child(vals, nulls, ty: T.Type) -> bytes:
+    """Serialize a flattened child column by type (shared by the nested
+    encodings)."""
+    if ty.is_string:
+        return _serialize_varwidth(np.asarray(vals, dtype=object),
+                                   np.asarray(nulls, dtype=bool))
+    if ty.is_decimal and not ty.is_short_decimal:
+        return _serialize_int128(np.asarray(vals, dtype=object),
+                                 np.asarray(nulls, dtype=bool))
+    if ty.base == "array":
+        return _serialize_array(np.asarray(vals, dtype=object),
+                                np.asarray(nulls, dtype=bool), ty)
+    if ty.base == "map":
+        return _serialize_map(np.asarray(vals, dtype=object),
+                              np.asarray(nulls, dtype=bool), ty)
+    if ty.base == "row":
+        return _serialize_row(np.asarray(vals, dtype=object),
+                              np.asarray(nulls, dtype=bool), ty)
+    return _serialize_fixed(np.asarray(vals, dtype=ty.to_dtype()),
+                            np.asarray(nulls, dtype=bool))
+
+
+def _serialize_map(vals: np.ndarray, nulls: np.ndarray,
+                   ty: T.Type) -> bytes:
+    """MAP encoding (MapBlockEncoding.java): key block, value block,
+    hashtable length (-1 = absent), positionCount, N+1 offsets, null
+    bits. `vals` = object array of dicts."""
+    rows = len(vals)
+    flat_k, flat_v, flat_vn, offsets = [], [], [], [0]
+    for i in range(rows):
+        if nulls[i] or vals[i] is None:
+            offsets.append(offsets[-1])
+            continue
+        for k, v in vals[i].items():
+            flat_k.append(k)
+            flat_v.append(0 if v is None else v)
+            flat_vn.append(v is None)
+        offsets.append(offsets[-1] + len(vals[i]))
+    enc = b"MAP"
+    kn = np.zeros(len(flat_k), dtype=bool)
+    return b"".join([
+        struct.pack("<i", len(enc)), enc,
+        _serialize_child(flat_k, kn, ty.key_type),
+        _serialize_child(flat_v, np.asarray(flat_vn, dtype=bool),
+                         ty.value_type),
+        struct.pack("<i", -1),  # no precomputed hash table
+        struct.pack("<i", rows),
+        np.asarray(offsets, dtype=np.int32).tobytes(),
+        _bitpack_nulls(np.asarray(nulls, dtype=bool))])
+
+
+def _serialize_row(vals: np.ndarray, nulls: np.ndarray,
+                   ty: T.Type) -> bytes:
+    """ROW encoding (RowBlockEncoding.java): numFields, field blocks
+    (non-null rows only), positionCount, N+1 offsets, null bits.
+    `vals` = object array of tuples."""
+    rows = len(vals)
+    ftys = ty.field_types
+    present = [i for i in range(rows)
+               if not (nulls[i] or vals[i] is None)]
+    offsets = [0]
+    for i in range(rows):
+        offsets.append(offsets[-1]
+                       + (0 if (nulls[i] or vals[i] is None) else 1))
+    enc = b"ROW"
+    parts = [struct.pack("<i", len(enc)), enc,
+             struct.pack("<i", len(ftys))]
+    for fi, fty in enumerate(ftys):
+        fvals = [vals[i][fi] for i in present]
+        fnulls = np.array([v is None for v in fvals], dtype=bool)
+        fvals = [0 if v is None else v for v in fvals]
+        parts.append(_serialize_child(fvals, fnulls, fty))
+    parts.append(struct.pack("<i", rows))
+    parts.append(np.asarray(offsets, dtype=np.int32).tobytes())
+    parts.append(_bitpack_nulls(np.asarray(nulls, dtype=bool)))
+    return b"".join(parts)
+
+
 def _serialize_block(block: Block) -> bytes:
     if isinstance(block, DictionaryColumn):
         rows = len(block)
@@ -197,11 +279,15 @@ def _serialize_block(block: Block) -> bytes:
     v, n = to_numpy(block)
     if isinstance(block, StringColumn):
         return _serialize_varwidth(v, n)
-    from ..block import ArrayColumn, Int128Column
+    from ..block import ArrayColumn, Int128Column, MapColumn, RowColumn
     if isinstance(block, Int128Column):
         return _serialize_int128(v, n)
     if isinstance(block, ArrayColumn):
         return _serialize_array(v, n, block.type)
+    if isinstance(block, MapColumn):
+        return _serialize_map(v, n, block.type)
+    if isinstance(block, RowColumn):
+        return _serialize_row(v, n, block.type)
     return _serialize_fixed(v, n)
 
 
@@ -228,6 +314,12 @@ def serialize_page(columns: Sequence[Tuple[T.Type, np.ndarray, np.ndarray]],
         elif ty.base == "array":
             body.append(_serialize_array(vals,
                                          np.asarray(nulls, dtype=bool), ty))
+        elif ty.base == "map":
+            body.append(_serialize_map(vals,
+                                       np.asarray(nulls, dtype=bool), ty))
+        elif ty.base == "row":
+            body.append(_serialize_row(vals,
+                                       np.asarray(nulls, dtype=bool), ty))
         elif ty.is_decimal and not ty.is_short_decimal:
             body.append(_serialize_int128(vals,
                                           np.asarray(nulls, dtype=bool)))
@@ -349,6 +441,55 @@ def _deserialize_block(mv: memoryview, pos: int, ty: Optional[T.Type]):
         pos += 4
         (dvals, dnulls), pos = _deserialize_block(mv, pos, ty)
         return (np.repeat(dvals[:1], rows), np.repeat(dnulls[:1], rows)), pos
+    if enc == b"MAP":
+        kty = ty.key_type if ty is not None and ty.base == "map" else None
+        vty = ty.value_type if ty is not None and ty.base == "map" else None
+        (kvals, _kn), pos = _deserialize_block(mv, pos, kty)
+        (vvals, vnulls), pos = _deserialize_block(mv, pos, vty)
+        (ht_len,) = struct.unpack_from("<i", mv, pos)
+        pos += 4
+        if ht_len >= 0:
+            pos += ht_len * 4  # precomputed hash table: skip
+        (rows,) = struct.unpack_from("<i", mv, pos)
+        pos += 4
+        offsets = np.frombuffer(mv[pos:pos + (rows + 1) * 4],
+                                dtype=np.int32)
+        pos += (rows + 1) * 4
+        nulls, pos = _bitunpack_nulls(mv, pos, rows)
+        vals = np.empty(rows, dtype=object)
+        for i in range(rows):
+            if nulls[i]:
+                vals[i] = None
+            else:
+                vals[i] = {
+                    _item(kvals[k]): (None if vnulls[k]
+                                      else _item(vvals[k]))
+                    for k in range(offsets[i], offsets[i + 1])}
+        return (vals, nulls), pos
+    if enc == b"ROW":
+        (nfields,) = struct.unpack_from("<i", mv, pos)
+        pos += 4
+        ftys = ty.field_types if ty is not None and ty.base == "row" \
+            else [None] * nfields
+        fcols = []
+        for fi in range(nfields):
+            (fv, fn), pos = _deserialize_block(mv, pos, ftys[fi])
+            fcols.append((fv, fn))
+        (rows,) = struct.unpack_from("<i", mv, pos)
+        pos += 4
+        offsets = np.frombuffer(mv[pos:pos + (rows + 1) * 4],
+                                dtype=np.int32)
+        pos += (rows + 1) * 4
+        nulls, pos = _bitunpack_nulls(mv, pos, rows)
+        vals = np.empty(rows, dtype=object)
+        for i in range(rows):
+            if nulls[i]:
+                vals[i] = None
+            else:
+                k = offsets[i]
+                vals[i] = tuple(None if fn[k] else _item(fv[k])
+                                for fv, fn in fcols)
+        return (vals, nulls), pos
     if enc == b"ARRAY":
         elem_ty = ty.element_type if ty is not None and \
             ty.base == "array" else None

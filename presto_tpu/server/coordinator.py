@@ -200,7 +200,10 @@ class Coordinator:
                           if frag_by_id[rn.fragment_id].partitioning
                           in ("SINGLE", "SORTED")]
             has_join = _contains_join(frag.root)
-            if (scans and single_ups) or _contains_global_agg(frag.root):
+            if _contains_commit(frag.root):
+                # TableFinish/DDL run exactly once (the commit point)
+                ntasks_of[frag.id] = 1
+            elif (scans and single_ups) or _contains_global_agg(frag.root):
                 ntasks_of[frag.id] = 1
             elif scans and hash_ups and has_join:
                 ntasks_of[frag.id] = 1
@@ -354,6 +357,12 @@ def _contains_global_agg(node: N.PlanNode) -> bool:
             and node.step in ("FINAL", "SINGLE"):
         return True
     return any(_contains_global_agg(s) for s in node.sources)
+
+
+def _contains_commit(node: N.PlanNode) -> bool:
+    if isinstance(node, (N.TableFinishNode, N.DdlNode)):
+        return True
+    return any(_contains_commit(s) for s in node.sources)
 
 
 def _contains_join(node: N.PlanNode) -> bool:

@@ -326,6 +326,7 @@ def translate_node(j: dict) -> Tuple[N.PlanNode, List[Tuple[str, T.Type]]]:
             out.append((v["name"], ty))
         step = j.get("step", "SINGLE")
         specs = []
+        agg_srcs = []  # per agg: (state src channel, declared type) @FINAL
         n_markers = 0  # MarkDistinct wrappers appended below src
         for key, agg in j.get("aggregations", {}).items():
             name = _strip_type_suffix(key)
@@ -365,23 +366,77 @@ def translate_node(j: dict) -> Tuple[N.PlanNode, List[Tuple[str, T.Type]]]:
             if fname == "count" and not args:
                 spec = AggSpec("count_star", None, T.BIGINT,
                                mask_channel=mask_ch)
+                agg_srcs.append((None, None))
             else:
                 if len(args) != 1 or args[0].get("@type") != "variable":
                     raise ProtocolUnsupported(
                         f"aggregation argument shape for {fname!r}")
-                ch, _ty = _lookup(layout, args[0]["name"])
+                ch, aty = _lookup(layout, args[0]["name"])
                 spec = AggSpec(fname, ch, rty, mask_channel=mask_ch)
-            if step in ("PARTIAL", "FINAL", "INTERMEDIATE") and \
-                    spec.canonical in ("avg", "var_samp", "var_pop",
-                                       "stddev_samp", "stddev_pop",
-                                       "min_by", "max_by"):
+                agg_srcs.append((ch, aty))
+            if step != "SINGLE" and spec.canonical in ("min_by", "max_by",
+                                                       "count_distinct",
+                                                       "approx_percentile"):
                 raise ProtocolUnsupported(
-                    f"{fname} with multi-column intermediate state over "
-                    "the wire (row-typed states land with the sketch "
-                    "library)")
+                    f"{fname} intermediate states over the wire")
+            if step == "INTERMEDIATE":
+                raise ProtocolUnsupported("INTERMEDIATE aggregation step")
             specs.append(spec)
             out.append((name, spec.output_type))
+
+        from ..ops.aggregation import state_width
+        names = [n for n, _ in out[len(keys):]]
+        if step == "FINAL" and any(state_width(s) > 1 for s in specs):
+            # multi-column states arrive packed as ONE row-typed variable
+            # per aggregate (the reference's serialized accumulator
+            # shape); unpack with row_field before the engine's merge
+            proj_exprs = [E.input_ref(ch, layout_ty)
+                          for ch, layout_ty in
+                          [_lookup(layout, v["name"])
+                           for v in gs.get("groupingKeys", [])]]
+            for spec, (src_ch, decl_ty) in zip(specs, agg_srcs):
+                w = state_width(spec)
+                if w == 1:
+                    proj_exprs.append(E.input_ref(src_ch, decl_ty))
+                    continue
+                if decl_ty is None or decl_ty.base != "row" or \
+                        len(decl_ty.field_types) != w:
+                    raise ProtocolUnsupported(
+                        f"{spec.name} FINAL expects a row({w} fields) "
+                        f"state, got {decl_ty}")
+                for i, ft in enumerate(decl_ty.field_types):
+                    proj_exprs.append(E.call(
+                        "row_field", ft,
+                        E.input_ref(src_ch, decl_ty),
+                        E.const(i, T.INTEGER)))
+            proj = N.ProjectNode(src, proj_exprs)
+            node = N.AggregationNode(proj, list(range(len(keys))), specs,
+                                     step="FINAL")
+            return node, out
         node = N.AggregationNode(src, keys, specs, step=step)
+        if step == "PARTIAL":
+            # emit ONE variable per aggregate: multi-column states pack
+            # into a row-typed column (row_pack) for the wire
+            otys = node.output_types()
+            exprs = [E.input_ref(i, otys[i]) for i in range(len(keys))]
+            out2 = list(out[:len(keys)])
+            ch = len(keys)
+            for spec, name in zip(specs, names):
+                w = state_width(spec)
+                if w == 1:
+                    exprs.append(E.input_ref(ch, otys[ch]))
+                    out2.append((name, otys[ch]))
+                else:
+                    fts = otys[ch:ch + w]
+                    rty = T.row_of(*fts)
+                    exprs.append(E.call(
+                        "row_pack", rty,
+                        *[E.input_ref(ch + i, fts[i]) for i in range(w)]))
+                    out2.append((name, rty))
+                ch += w
+            if any(state_width(s) > 1 for s in specs):
+                return N.ProjectNode(node, exprs), out2
+            return node, out2
         return node, out
 
     if kind == "LimitNode":
@@ -659,20 +714,24 @@ def translate_node(j: dict) -> Tuple[N.PlanNode, List[Tuple[str, T.Type]]]:
                 f"unnest of {len(unnest_vars)} columns (single ARRAY "
                 "supported)")
         arr_key, elems = next(iter(unnest_vars.items()))
-        if len(elems) != 1:
-            raise ProtocolUnsupported(
-                "unnest emitting multiple element columns (maps land "
-                "with the MAP block)")
         arr_name = _strip_type_suffix(arr_key)
         arr_ch, arr_ty = _lookup(layout, arr_name)
-        if arr_ty.base != "array":
+        if arr_ty.base == "array":
+            if len(elems) != 1:
+                raise ProtocolUnsupported(
+                    f"array unnest emitting {len(elems)} columns")
+        elif arr_ty.base == "map":
+            if len(elems) != 2:
+                raise ProtocolUnsupported(
+                    f"map unnest emitting {len(elems)} columns")
+        else:
             raise ProtocolUnsupported(f"unnest of {arr_ty.base!r}")
         repl = _vars(j.get("replicateVariables", []))
         proj, _ = _project_to(src, src_out, repl + [(arr_name, arr_ty)])
         ordinality = j.get("ordinalityVariable")
         node = N.UnnestNode(proj, array_channel=len(repl),
                             with_ordinality=ordinality is not None)
-        out = repl + [(elems[0]["name"], _type_of(elems[0]["type"]))]
+        out = repl + [(e["name"], _type_of(e["type"])) for e in elems]
         if ordinality is not None:
             out.append((ordinality["name"], T.BIGINT))
         return node, out

@@ -120,10 +120,12 @@ class StatementServer:
     def __init__(self, port: int = 0, sf: float = 0.01,
                  dispatcher: Optional[Dispatcher] = None,
                  executor=None, page_rows: int = 1024,
-                 queue_poll_s: float = 1.0):
+                 queue_poll_s: float = 1.0,
+                 query_ttl_s: float = 600.0):
         self.sf = sf
         self.page_rows = page_rows
         self.queue_poll_s = queue_poll_s
+        self.query_ttl_s = query_ttl_s
         self.dispatcher = dispatcher or Dispatcher()
         self.transactions = TransactionManager()
         self._executor = executor or self._default_executor
@@ -166,12 +168,24 @@ class StatementServer:
             kwargs["join_capacity"] = int(session_values["join_capacity"])
         return run_sql(text, sf=sf, **kwargs)
 
+    def _reap_locked(self) -> None:
+        """Drop terminal queries (and their materialized result rows)
+        older than query_ttl_s -- QueryTracker's expiration (the worker
+        side reaps tasks the same way)."""
+        import time as _time
+        cutoff = _time.time() - self.query_ttl_s
+        for qid in [qid for qid, q in self._queries.items()
+                    if q.machine.is_done()
+                    and q.machine.timings().get(q.machine.state, 0) < cutoff]:
+            del self._queries[qid]
+
     def create_query(self, text: str, user: str,
                      session_values: Dict, txn_id: Optional[str]) -> _Query:
         q = _Query(f"20260730_{uuid.uuid4().hex[:12]}",
                    uuid.uuid4().hex[:12], text, session_values, user,
                    txn_id)
         with self._qlock:
+            self._reap_locked()
             self._queries[q.id] = q
         threading.Thread(target=self._run, args=(q,), daemon=True).start()
         return q
@@ -223,6 +237,16 @@ class StatementServer:
                 lambda tid: self._executor(q.text, q.session_values, q.id,
                                            tid))
         q.machine.to_finishing()
+        wm = re.match(r"\s*(insert|create\s+table|drop\s+table)\b",
+                      q.text, re.IGNORECASE)
+        if wm:
+            kind = " ".join(wm.group(1).upper().split())
+            q.update_type = {"INSERT": "INSERT",
+                             "CREATE TABLE": "CREATE TABLE AS",
+                             "DROP TABLE": "DROP TABLE"}[kind]
+            if res.types and res.types[0].base == "bigint" and \
+                    res.row_count == 1:
+                q.update_count = int(res.columns[0][0])
         q.columns = [{"name": n, "type": str(t)}
                      for n, t in zip(res.names, res.types)]
         rendered = []
@@ -312,6 +336,8 @@ class StatementServer:
             doc["data"] = page
         if q.update_type:
             doc["updateType"] = q.update_type
+        if q.update_count is not None:
+            doc["updateCount"] = q.update_count
         if hi < len(q.rows):
             doc["nextUri"] = \
                 f"{self.url}/v1/statement/executing/{q.id}/{q.slug}/{token + 1}"

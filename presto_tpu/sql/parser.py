@@ -197,6 +197,32 @@ class Query:
 
 
 @dataclasses.dataclass
+class Insert:
+    """INSERT INTO t [(cols)] (SELECT ... | VALUES (...), ...)."""
+    table: str                      # bare or catalog-qualified name
+    columns: Optional[List[str]]
+    query: object                   # Query | SetQuery | ValuesRows
+
+
+@dataclasses.dataclass
+class ValuesRows:
+    rows: List[List[object]]        # expression ASTs per cell
+
+
+@dataclasses.dataclass
+class CreateTableAs:
+    table: str
+    query: object
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass
+class DropTable:
+    table: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass
 class SetQuery:
     """UNION / INTERSECT / EXCEPT of two query terms."""
     op: str                 # "union" | "intersect" | "except"
@@ -684,11 +710,24 @@ class _Parser:
                 raise ValueError("derived table requires an alias")
             return TableRef(alias.lower(), alias, subquery=sub)
         name = self.expect_ident()
+        # catalog-qualified reference: memory.t (two parts; deeper
+        # schemas collapse into the catalog-level names this engine uses)
+        while True:
+            k, v = self.peek()
+            if not (k == "op" and v == "."):
+                break
+            k2, _v2 = self.toks[self.i + 1]
+            if k2 != "ident":
+                break
+            self.next()
+            name += "." + self.expect_ident()
         alias = None
         if self.accept_kw("as"):
             alias = self.expect_ident()
         else:
             alias = self._implicit_alias()
+        if alias is None and "." in name:
+            alias = name.rsplit(".", 1)[1]  # bare table name qualifies
         return TableRef(name.lower(), alias)
 
     def _window_frame(self):
@@ -760,6 +799,9 @@ class _Parser:
 
 def parse_sql(text: str):
     p = _Parser(_tokenize(text))
+    k, v = p.peek()
+    if k == "ident" and v.lower() in ("insert", "create", "drop"):
+        return _parse_dml(p, v.lower())
     ctes = {}
     if p.accept_kw("with"):
         while True:
@@ -781,6 +823,87 @@ def parse_sql(text: str):
             _inline_ctes(ctes[n], {m: ctes[m] for m in names[:i]})
         _inline_ctes(q, ctes)
     return q
+
+
+def _parse_dml(p: "_Parser", first: str):
+    """INSERT INTO / CREATE TABLE [IF NOT EXISTS] t AS / DROP TABLE
+    [IF EXISTS] t. The write verbs are contextual identifiers (like the
+    reference's nonReserved words), matched case-insensitively."""
+
+    def ctx(word):
+        k, v = p.peek()
+        if k == "ident" and v.lower() == word:
+            p.next()
+            return True
+        return False
+
+    def expect_ctx(word):
+        if not ctx(word):
+            raise ValueError(f"expected {word.upper()}, got {p.peek()}")
+
+    def qualified_name() -> str:
+        name = p.expect_ident()
+        while True:
+            k, v = p.peek()
+            if k == "op" and v == ".":
+                p.next()
+                name += "." + p.expect_ident()
+            else:
+                return name.lower()
+
+    p.next()  # consume the verb
+    if first == "insert":
+        expect_ctx("into")
+        table = qualified_name()
+        columns = None
+        if p.accept_op("("):
+            columns = [p.expect_ident().lower()]
+            while p.accept_op(","):
+                columns.append(p.expect_ident().lower())
+            p.expect_op(")")
+        if ctx("values"):
+            rows = []
+            while True:
+                p.expect_op("(")
+                row = [p.expr()]
+                while p.accept_op(","):
+                    row.append(p.expr())
+                p.expect_op(")")
+                rows.append(row)
+                if not p.accept_op(","):
+                    break
+            query = ValuesRows(rows)
+        else:
+            query = p.query()
+        k, _ = p.peek()
+        if k != "eof":
+            raise ValueError(f"trailing tokens at {p.peek()}")
+        return Insert(table, columns, query)
+    if first == "create":
+        expect_ctx("table")
+        if_not_exists = False
+        if ctx("if"):
+            p.expect_kw("not")
+            p.expect_kw("exists")
+            if_not_exists = True
+        table = qualified_name()
+        p.expect_kw("as")
+        q = p.query()
+        k, _ = p.peek()
+        if k != "eof":
+            raise ValueError(f"trailing tokens at {p.peek()}")
+        return CreateTableAs(table, q, if_not_exists)
+    # DROP TABLE [IF EXISTS] t
+    expect_ctx("table")
+    if_exists = False
+    if ctx("if"):
+        p.expect_kw("exists")
+        if_exists = True
+    table = qualified_name()
+    k, _ = p.peek()
+    if k != "eof":
+        raise ValueError(f"trailing tokens at {p.peek()}")
+    return DropTable(table, if_exists)
 
 
 def _inline_ctes(q, ctes):

@@ -264,6 +264,21 @@ class _Analyzer:
             return T.varchar(1)
         if name == "cast":
             return args[0].type
+        if name == "cardinality":
+            return T.BIGINT
+        if name == "element_at":
+            t0 = args[0].type
+            if t0.base == "map":
+                return t0.value_type
+            if t0.base == "array":
+                return t0.element_type
+            raise NotImplementedError(f"element_at over {t0}")
+        if name == "contains":
+            return T.BOOLEAN
+        if name == "map_keys":
+            return T.array_of(args[0].type.key_type)
+        if name == "map_values":
+            return T.array_of(args[0].type.value_type)
         raise NotImplementedError(f"no type rule for function {name!r}")
 
     # -- aggregate detection ------------------------------------------------
@@ -338,7 +353,7 @@ def _agg_output_type(name: str, input_type: Optional[T.Type]) -> T.Type:
 # Session catalog search path (the reference resolves unqualified table
 # names against the session catalog/schema; `USE tpcds.sf1` analog).
 _SEARCH_PATH: contextvars.ContextVar = contextvars.ContextVar(
-    "search_path", default=("tpch", "tpcds"))
+    "search_path", default=("tpch", "tpcds", "memory"))
 
 # CTE plan-once cache, scoped to one plan_sql call: the parser inlines a
 # WITH binding as the SAME Query AST object at every reference, so
@@ -364,6 +379,8 @@ def plan_sql(query_text: str, max_groups: int = 1 << 16,
         token = _SEARCH_PATH.set(path)
     cache_token = _SUBPLAN_CACHE.set({})
     try:
+        if isinstance(ast, (P.Insert, P.CreateTableAs, P.DropTable)):
+            return _plan_write(ast, max_groups, join_capacity)
         node, names = _plan_any(ast, max_groups, join_capacity)
     finally:
         _SUBPLAN_CACHE.reset(cache_token)
@@ -372,6 +389,153 @@ def plan_sql(query_text: str, max_groups: int = 1 << 16,
     if isinstance(node, N.OutputNode):
         return node
     return N.OutputNode(node, names)
+
+
+def _writable_target(name: str):
+    """'memory.t' or bare 't' -> (connector, table); only the memory
+    catalog is writable (the engine's generator connectors are
+    read-only, like the reference's tpch/tpcds connectors)."""
+    if "." in name:
+        conn, table = name.split(".", 1)
+    else:
+        conn, table = "memory", name
+    if conn != "memory":
+        raise NotImplementedError(
+            f"catalog {conn!r} is read-only; writes go to the memory "
+            "connector")
+    return conn, table
+
+
+def _plan_write(ast, max_groups: int, join_capacity):
+    """INSERT / CTAS / DROP TABLE -> TableWriter/TableFinish/Ddl plans
+    (LogicalPlanner.createTableWriterPlan / DataDefinitionTask analog)."""
+    from ..connectors import catalog as get_catalog
+
+    if isinstance(ast, P.DropTable):
+        conn, table = _writable_target(ast.table)
+        return N.OutputNode(N.DdlNode("drop_table", conn, table,
+                                      ast.if_exists), ["result"])
+
+    if isinstance(ast, P.CreateTableAs):
+        conn, table = _writable_target(ast.table)
+        if ast.if_not_exists and table in get_catalog(conn).SCHEMA:
+            # no-op create: zero rows written (reference behavior)
+            return N.OutputNode(N.ValuesNode([T.BIGINT], [[0]]), ["rows"])
+        node, names = _plan_any(ast.query, max_groups, join_capacity)
+        node = _strip_output(node)
+        types = node.output_types()
+        writer = N.TableWriterNode(node, conn, table, list(names))
+        finish = N.TableFinishNode(writer, conn, table, create=True,
+                                   create_columns=list(names),
+                                   create_types=list(types))
+        return N.OutputNode(finish, ["rows"])
+
+    # INSERT
+    conn, table = _writable_target(ast.table)
+    mod = get_catalog(conn)
+    try:
+        schema = mod.SCHEMA[table]
+    except KeyError:
+        raise KeyError(f"memory table {table!r} does not exist") from None
+    target_cols = list(schema)
+    target_types = [schema[c] for c in target_cols]
+    insert_cols = ast.columns or target_cols
+    for c in insert_cols:
+        if c not in schema:
+            raise KeyError(f"column {c!r} not in table {table!r}")
+
+    if isinstance(ast.query, P.ValuesRows):
+        an = _Analyzer(None)
+        scope = _Scope({}, [])
+        rows = []
+        for row in ast.query.rows:
+            if len(row) != len(insert_cols):
+                raise ValueError(
+                    f"INSERT row arity {len(row)} != column count "
+                    f"{len(insert_cols)}")
+            rows.append([an.lower(cell, scope) for cell in row])
+        # VALUES rows lower to constants; ship them as a ValuesNode in
+        # INSERT-column order
+        const_rows = []
+        for row in rows:
+            vals = []
+            for e in row:
+                if not isinstance(e, E.Constant):
+                    raise NotImplementedError(
+                        "INSERT ... VALUES cells must be literals")
+                vals.append(e)
+            const_rows.append(vals)
+        src_types = [_common_values_type([r[i] for r in const_rows],
+                                         schema[insert_cols[i]])
+                     for i in range(len(insert_cols))]
+        node = N.ValuesNode(
+            src_types,
+            [[_coerce_const(e, ty) for e, ty in zip(r, src_types)]
+             for r in const_rows])
+        names = list(insert_cols)
+    else:
+        node, names = _plan_any(ast.query, max_groups, join_capacity)
+        node = _strip_output(node)
+        if len(node.output_types()) != len(insert_cols):
+            raise ValueError(
+                f"INSERT query produces {len(node.output_types())} "
+                f"columns, expected {len(insert_cols)}")
+
+    # project to the FULL target layout: insert columns from the query
+    # (cast to the declared type), unmentioned columns as typed NULLs
+    src_types = node.output_types()
+    exprs = []
+    for c, ty in zip(target_cols, target_types):
+        if c in insert_cols:
+            ch = insert_cols.index(c)
+            e = E.input_ref(ch, src_types[ch])
+            if src_types[ch] != ty:
+                e = E.call("cast", ty, e)
+            exprs.append(e)
+        else:
+            exprs.append(E.const(None, ty))
+    proj = N.ProjectNode(node, exprs)
+    writer = N.TableWriterNode(proj, conn, table, target_cols)
+    # the GATHER seam lets the fragmenter fan writers out per worker
+    # while the finish (count sum) runs once (ScaledWriterScheduler's
+    # writer-stage/commit-stage split, minus the scaling policy)
+    gather = N.ExchangeNode(writer, kind="GATHER", scope="REMOTE")
+    finish = N.TableFinishNode(gather, conn, table)
+    return N.OutputNode(finish, ["rows"])
+
+
+def _common_values_type(consts, target_ty: T.Type) -> T.Type:
+    """Type a VALUES column: the target type when every literal can
+    coerce to it, else the literals' own type."""
+    return target_ty
+
+
+def _coerce_const(e: "E.Constant", ty: T.Type):
+    """Literal -> target-type python value (the implicit INSERT
+    coercions: integer->decimal scaling, string width, date)."""
+    v = e.value
+    if v is None:
+        return None
+    if ty.is_decimal:
+        if e.type.is_decimal:
+            return v * 10 ** (ty.scale - e.type.scale) \
+                if ty.scale >= e.type.scale else \
+                _exact_downscale(v, e.type.scale - ty.scale)
+        if e.type.is_integral:
+            return int(v) * 10 ** ty.scale
+        raise TypeError(f"cannot coerce {e.type} literal to {ty}")
+    if ty.is_integral or ty.base in ("date", "timestamp"):
+        return int(v)
+    if ty.is_floating:
+        return float(v)
+    return v
+
+
+def _exact_downscale(v: int, drop: int) -> int:
+    q, r = divmod(v, 10 ** drop)
+    if r:
+        raise ValueError(f"literal loses precision at scale -{drop}")
+    return q
 
 
 def _plan_any(ast, max_groups: int, join_capacity: Optional[int]):
@@ -485,14 +649,23 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
         # resolution follows the session catalog search path (the
         # reference resolves unqualified names against the session's
         # catalog/schema; both catalogs define e.g. `customer`, and the
-        # earlier catalog in the path wins deterministically)
+        # earlier catalog in the path wins deterministically). A dotted
+        # name ("memory.t") names the catalog explicitly.
         from ..connectors import catalogs
-        search_path = _SEARCH_PATH.get()
         cats = catalogs()
+        if "." in name:
+            cat, bare = name.split(".", 1)
+            if cat not in cats:
+                raise KeyError(f"unknown catalog {cat!r}")
+            sch = cats[cat].SCHEMA
+            if bare not in sch:
+                raise KeyError(f"table {bare!r} not in catalog {cat!r}")
+            return cat, bare, dict(sch[bare])
+        search_path = _SEARCH_PATH.get()
         for cat in search_path:
             sch = cats[cat].SCHEMA
             if name in sch:
-                return cat, dict(sch[name])
+                return cat, name, dict(sch[name])
         raise KeyError(f"table {name!r} not found in catalogs {search_path}")
 
     table_catalog = {}
@@ -521,8 +694,8 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
             derived_plans[t.name] = (sub_node,
                                      [n.lower() for n in sub_names])
         else:
-            cat, sch = find_table(t.name)
-            table_catalog[t.name] = cat
+            cat, bare, sch = find_table(t.name)
+            table_catalog[t.name] = (cat, bare)
             table_schemas[t.name] = sch
 
     referenced: Dict[str, List[str]] = {t.name: [] for t in tables}
@@ -689,7 +862,8 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
             return sub_node, sub_cols, tys
         cols = referenced[t.name] or [next(iter(table_schemas[t.name]))]
         tys = [table_schemas[t.name][c] for c in cols]
-        return (N.TableScanNode(table_catalog[t.name], t.name, cols, tys),
+        cat, bare = table_catalog[t.name]
+        return (N.TableScanNode(cat, bare, cols, tys),
                 cols, tys)
 
     def scan_planned(t: P.TableRef):
@@ -730,8 +904,8 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
                 return 0.0
             from ..connectors import catalogs as _cats
             try:
-                return float(_cats()[table_catalog[t.name]]
-                             .table_row_count(t.name, 1.0))
+                cat, bare = table_catalog[t.name]
+                return float(_cats()[cat].table_row_count(bare, 1.0))
             except Exception:
                 return 1.0
 

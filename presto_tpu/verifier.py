@@ -114,6 +114,9 @@ DEFAULT_CORPUS = [
     # distinct aggregates (non-mergeable partials: raw-row repartition)
     "SELECT custkey, count(DISTINCT orderpriority) FROM orders "
     "GROUP BY custkey HAVING count(*) > 20",
+    # HLL sketch states (mergeable registers across the mesh)
+    "SELECT returnflag, approx_distinct(partkey) FROM lineitem "
+    "GROUP BY returnflag",
     # scalar subquery
     "SELECT count(*) FROM customer WHERE acctbal > "
     "(SELECT avg(acctbal) FROM customer)",

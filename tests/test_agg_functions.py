@@ -108,9 +108,20 @@ def test_count_distinct_exact():
     vn = np.array([False, False, False, True, False, False])
     b = batch_from_numpy([T.BIGINT, T.BIGINT], [k, v], nulls=[None, vn],
                          capacity=8)
-    r = group_by(b, [0], [AggSpec("approx_distinct", 1, T.BIGINT)], max_groups=8)
+    r = group_by(b, [0], [AggSpec("count_distinct", 1, T.BIGINT)],
+                 max_groups=8)
     got = table(r, 1)
     assert got == {1: (2,), 2: (1,)}  # nulls don't count
+    # approx_distinct (HLL since round 4) is exact at these cardinalities
+    from presto_tpu.ops.aggregation import finalize_states
+    spec = [AggSpec("approx_distinct", 1, T.BIGINT)]
+    r2 = group_by(b, [0], spec, max_groups=8)
+    out = finalize_states(r2.batch, 1, spec)
+    act = np.asarray(out.active)
+    kv, _ = to_numpy(out.column(0))
+    dv, _ = to_numpy(out.column(1))
+    got2 = {int(kv[i]): int(dv[i]) for i in np.nonzero(act)[0]}
+    assert got2 == {1: 2, 2: 1}
 
 
 def test_approx_percentile_exact():

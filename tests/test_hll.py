@@ -1,0 +1,83 @@
+"""HyperLogLog approx_distinct: dense mergeable register states
+(ApproximateCountDistinctAggregation.java analog, TPU-shaped: int8
+register vectors merged by elementwise max)."""
+
+import numpy as np
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.block import batch_from_numpy, to_numpy
+from presto_tpu.connectors import tpch
+from presto_tpu.ops.aggregation import (AggSpec, finalize_states,
+                                        group_by, merge_partials)
+from presto_tpu.sql import sql
+
+SF = 0.01
+
+
+def _run_global(vals, dtype=np.int64, max_groups=4):
+    b = batch_from_numpy([T.BIGINT], [np.asarray(vals, dtype=dtype)],
+                        capacity=max(len(vals), 1))
+    r = group_by(b, [], [AggSpec("approx_distinct", 0, T.BIGINT)],
+                 max_groups)
+    out = finalize_states(r.batch, 0, [AggSpec("approx_distinct", 0,
+                                               T.BIGINT)])
+    v, _ = to_numpy(out.column(0))
+    return int(v[0])
+
+
+def test_small_cardinalities_near_exact():
+    # linear-counting range: tiny error expected
+    for true_n in (1, 10, 100, 1000):
+        got = _run_global(np.arange(true_n * 3) % true_n)
+        assert abs(got - true_n) <= max(2, 0.05 * true_n), (true_n, got)
+
+
+def test_large_cardinality_within_error():
+    n = 200_000
+    got = _run_global(np.arange(n))
+    assert abs(got - n) / n < 0.08  # p=11 => ~2.3% sigma; 3+ sigma slack
+
+
+def test_merge_equals_single_pass():
+    """PARTIAL states over disjoint halves merged -> same registers as
+    one pass (HLL union is exact over merges)."""
+    data = np.arange(50_000) % 7_777
+    spec = [AggSpec("approx_distinct", 0, T.BIGINT)]
+    whole = _run_global(data)
+
+    halves = []
+    for part in (data[:25_000], data[25_000:]):
+        b = batch_from_numpy([T.BIGINT], [part.astype(np.int64)],
+                            capacity=25_000)
+        halves.append(group_by(b, [], spec, 4).batch)
+    from presto_tpu.block import concat_batches
+    partials = concat_batches(halves)
+    merged = merge_partials(partials, 0, spec, 4)
+    out = finalize_states(merged.batch, 0, spec)
+    v, _ = to_numpy(out.column(0))
+    assert int(v[0]) == whole
+
+
+def test_sql_approx_distinct_grouped():
+    res = sql("SELECT returnflag, approx_distinct(orderkey) AS d, "
+              "count(DISTINCT orderkey) AS exact "
+              "FROM lineitem GROUP BY returnflag ORDER BY returnflag",
+              sf=SF, max_groups=8)
+    for _flag, approx, exact in res.rows():
+        assert abs(int(approx) - int(exact)) / max(int(exact), 1) < 0.08
+
+
+def test_sql_approx_distinct_strings():
+    res = sql("SELECT approx_distinct(shipmode) AS d FROM lineitem",
+              sf=SF)
+    got = int(res.rows()[0][0])
+    assert abs(got - 7) <= 1  # 7 ship modes
+
+
+def test_mesh_matches_local(mesh8):
+    q = ("SELECT returnflag, approx_distinct(partkey) AS d "
+         "FROM lineitem GROUP BY returnflag ORDER BY returnflag")
+    local = sql(q, sf=SF, max_groups=8)
+    dist = sql(q, sf=SF, mesh=mesh8, max_groups=8)
+    assert local.rows() == dist.rows()

@@ -230,3 +230,97 @@ def test_unsupported_shapes_still_rejected_loudly():
         assert False, "expected ProtocolUnsupported"
     except ProtocolUnsupported:
         pass
+
+
+def _avg_agg_json(step, arg_var, arg_ty, out_ty="decimal(38,2)"):
+    return {"@type": ".AggregationNode", "id": "9",
+            "source": None,  # caller fills
+            "aggregations": {
+                f"avg_p<{out_ty}>": {
+                    "call": {"@type": "call", "displayName": "avg",
+                             "functionHandle": {"@type": "$static",
+                                                "signature": {
+                                 "name": "presto.default.avg",
+                                 "kind": "AGGREGATE",
+                                 "returnType": out_ty,
+                                 "argumentTypes": [arg_ty]}},
+                             "returnType": out_ty,
+                             "arguments": [{"@type": "variable",
+                                            "name": arg_var,
+                                            "type": arg_ty}]},
+                    "distinct": False}},
+            "groupingSets": {"groupingSetCount": 1,
+                             "globalGroupingSets": [],
+                             "groupingKeys": [{"@type": "variable",
+                                               "name": "o_custkey",
+                                               "type": "bigint"}]},
+            "step": step}
+
+
+def test_multistate_partial_final_over_the_wire():
+    """avg PARTIAL ships its (sum, count) state as ONE row-typed
+    variable; a FINAL fragment ingests the row states and merges --
+    the reference's serialized-accumulator wire contract."""
+    import base64 as b64
+    from presto_tpu.serde.pages import PageCodec, deserialize_page, \
+        serialize_page
+    from presto_tpu.server.protocol import translate_node as tn
+
+    scan = json.loads(json.dumps(load("JoinNode.json")["left"]))  # ORDERS
+    part = _avg_agg_json("PARTIAL", "o_totalprice", "decimal(12,2)")
+    part["source"] = scan
+    node, out = tn(part)
+    assert [n for n, _ in out] == ["o_custkey", "avg_p"]
+    state_ty = out[1][1]
+    assert state_ty.base == "row" and len(state_ty.field_types) == 2
+    res = run_query(N.OutputNode(node, ["k", "s"]), sf=SF)
+    assert res.row_count >= 1
+    states = res.columns[1]
+    assert isinstance(states[0], tuple)  # packed (sum, count)
+
+    # wire leg: the partial table round-trips the SerializedPage format
+    page = serialize_page(
+        [(res.types[0], res.columns[0], res.nulls[0]),
+         (res.types[1], res.columns[1], res.nulls[1])], PageCodec())
+    back = deserialize_page(page, res.types, PageCodec())
+    assert list(back[0][0]) == list(res.columns[0])
+    assert back[1][0][0] == states[0]
+
+    # FINAL fragment over the shipped states (a VALUES source carrying
+    # row-typed constants, like an exchange-fed fragment would)
+    from presto_tpu.serde.pages import _serialize_row
+    import numpy as np
+    rows_json = []
+    for i in range(res.row_count):
+        key_blk = b64.b64encode(
+            __import__("presto_tpu.serde.pages", fromlist=["x"])
+            ._serialize_fixed(np.array([res.columns[0][i]],
+                                       dtype=np.int64),
+                              np.array([False]))).decode()
+        arr = np.empty(1, dtype=object)
+        arr[0] = states[i]
+        st_blk = b64.b64encode(
+            _serialize_row(arr, np.array([False]), state_ty)).decode()
+        rows_json.append([
+            {"@type": "constant", "type": "bigint", "valueBlock": key_blk},
+            {"@type": "constant", "type": str(state_ty).replace(" ", ""),
+             "valueBlock": st_blk}])
+    values = {"@type": ".ValuesNode", "id": "1",
+              "outputVariables": [
+                  {"@type": "variable", "name": "o_custkey",
+                   "type": "bigint"},
+                  {"@type": "variable", "name": "avg_state",
+                   "type": str(state_ty).replace(" ", "")}],
+              "rows": rows_json}
+    fin = _avg_agg_json("FINAL", "avg_state",
+                        str(state_ty).replace(" ", ""))
+    fin["source"] = values
+    fnode, fout = tn(fin)
+    fres = run_query(N.OutputNode(fnode, ["k", "a"]), sf=SF)
+
+    want = run_query(N.OutputNode(tn(_avg_agg_json(
+        "SINGLE", "o_totalprice", "decimal(12,2)")
+        | {"source": scan})[0], ["k", "a"]), sf=SF)
+    got = {int(r[0]): r[1] for r in fres.rows()}
+    exp = {int(r[0]): r[1] for r in want.rows()}
+    assert got == exp

@@ -30,7 +30,7 @@ FAST_CASES = [
     ("q27", 0.02, {}),
     ("q32", 0.02, {"min_rows": 0}),
     ("q37", 0.02, {}),
-    ("q38", 0.02, {"max_groups": 1 << 17}),
+    ("q38", 0.02, {}),
     ("q40", 0.02, {}),
     ("q42", 0.02, {}),
     ("q43", 0.02, {}),
@@ -48,82 +48,81 @@ FAST_CASES = [
     ("q86", 0.02, {}),
     ("q93", 0.02, {"keep_limit": True}),
     ("q96", 0.02, {"min_rows": 0}),
-    ("q97", 0.02, {"max_groups": 1 << 17}),
+    ("q97", 0.02, {}),
     ("q98", 0.02, {}),
     ("q99", 0.02, {}),
 ]
 
 SLOW_CASES = [
-    ("q1", 0.02, {"max_groups": 1 << 15}),
-    ("q2", 0.02, {"max_groups": 1 << 17, "join_capacity": 1 << 21}),
-    ("q8", 0.05, {"max_groups": 1 << 15, "join_capacity": 1 << 22}),
-    ("q9", 0.05, {"max_groups": 1 << 15}),
-    ("q10", 0.05, {"max_groups": 1 << 17}),
-    ("q31", 0.05, {"max_groups": 1 << 16}),
-    ("q35", 0.05, {"max_groups": 1 << 17}),
-    ("q39", 0.05, {"max_groups": 1 << 17, "join_capacity": 1 << 21}),
-    ("q41", 0.1, {"max_groups": 1 << 15}),
-    ("q44", 0.02, {"max_groups": 1 << 16}),
-    ("q45", 0.05, {"max_groups": 1 << 16}),
-    ("q67", 0.01, {"max_groups": 1 << 17}),
-    ("q70", 0.02, {"max_groups": 1 << 16}),
+    ("q1", 0.02, {}),
+    ("q2", 0.02, {}),
+    ("q8", 0.05, {}),
+    ("q9", 0.05, {}),
+    ("q10", 0.05, {}),
+    ("q31", 0.05, {}),
+    ("q35", 0.05, {}),
+    ("q39", 0.05, {}),
+    ("q41", 0.1, {}),
+    ("q44", 0.02, {}),
+    ("q45", 0.05, {}),
+    ("q67", 0.01, {}),
+    ("q70", 0.02, {}),
 
-    ("q4", 0.05, {"max_groups": 1 << 15}),
-    ("q5", 0.05, {"max_groups": 1 << 16, "join_capacity": 1 << 21}),
+    ("q4", 0.05, {}),
+    ("q5", 0.05, {}),
     ("q6", 0.02, {"min_rows": 0}),
-    ("q11", 0.02, {"max_groups": 1 << 17, "keep_limit": True}),
+    ("q11", 0.02, {"keep_limit": True}),
     ("q12", 0.05, {"min_rows": 0}),
-    ("q14", 0.05, {"max_groups": 1 << 17, "join_capacity": 1 << 22}),
-    ("q16", 0.05, {"max_groups": 1 << 15, "join_capacity": 1 << 21}),
-    ("q17", 0.05, {"max_groups": 1 << 16}),
+    ("q14", 0.05, {}),
+    ("q16", 0.05, {}),
+    ("q17", 0.05, {}),
     ("q18", 0.05, {}),
     ("q20", 0.02, {}),
     ("q22", 0.02, {}),
-    ("q23", 0.05, {"max_groups": 1 << 17, "join_capacity": 1 << 22}),
-    ("q24", 0.05, {"max_groups": 1 << 17, "join_capacity": 1 << 21}),
+    ("q23", 0.05, {}),
+    ("q24", 0.05, {}),
     ("q25", 0.05, {"min_rows": 0}),
     ("q28", 0.02, {}),
     ("q29", 0.05, {"min_rows": 0}),
-    ("q30", 0.02, {"max_groups": 1 << 15}),
+    ("q30", 0.02, {}),
     ("q33", 0.02, {"min_rows": 0}),
     ("q34", 0.1, {}),
     ("q36", 0.02, {}),
     ("q46", 0.02, {"keep_limit": True}),
-    ("q47", 0.05, {"max_groups": 1 << 15, "min_rows": 0}),
-    ("q49", 0.05, {"max_groups": 1 << 16, "join_capacity": 1 << 21}),
+    ("q47", 0.05, {"min_rows": 0}),
+    ("q49", 0.05, {}),
     ("q50", 0.05, {"min_rows": 0}),
-    ("q51", 0.01, {"max_groups": 1 << 16, "keep_limit": True}),
+    ("q51", 0.01, {"keep_limit": True}),
     ("q53", 0.05, {"min_rows": 0}),
-    ("q54", 0.05, {"max_groups": 1 << 16, "join_capacity": 1 << 21}),
+    ("q54", 0.05, {}),
     ("q56", 0.05, {"min_rows": 0}),
-    ("q58", 0.1, {"max_groups": 1 << 16, "join_capacity": 1 << 21}),
-    ("q59", 0.01, {"max_groups": 1 << 17, "join_capacity": 1 << 22}),
-    ("q57", 0.05, {"max_groups": 1 << 15, "min_rows": 0}),
+    ("q58", 0.1, {}),
+    ("q59", 0.01, {}),
+    ("q57", 0.05, {"min_rows": 0}),
     ("q61", 0.05, {"min_rows": 0}),
     ("q63", 0.05, {"min_rows": 0}),
-    ("q64", 0.05, {"max_groups": 1 << 18, "join_capacity": 1 << 22,
-                   "min_rows": 0}),
-    ("q65", 0.02, {"max_groups": 1 << 17, "keep_limit": True}),
-    ("q66", 0.05, {"max_groups": 1 << 15, "join_capacity": 1 << 21}),
+    ("q64", 0.05, {"min_rows": 0}),
+    ("q65", 0.02, {"keep_limit": True}),
+    ("q66", 0.05, {}),
     ("q68", 0.01, {}),
     ("q69", 0.05, {"min_rows": 0}),
-    ("q72", 0.1, {"max_groups": 1 << 17, "join_capacity": 1 << 23}),
-    ("q74", 0.05, {"max_groups": 1 << 15, "keep_limit": True}),
-    ("q75", 0.05, {"max_groups": 1 << 17, "join_capacity": 1 << 21}),
-    ("q77", 0.05, {"max_groups": 1 << 15, "join_capacity": 1 << 21}),
-    ("q78", 0.05, {"max_groups": 1 << 18, "join_capacity": 1 << 21}),
-    ("q80", 0.05, {"max_groups": 1 << 16, "join_capacity": 1 << 21}),
-    ("q81", 0.05, {"max_groups": 1 << 15}),
+    ("q72", 0.1, {}),
+    ("q74", 0.05, {"keep_limit": True}),
+    ("q75", 0.05, {}),
+    ("q77", 0.05, {}),
+    ("q78", 0.05, {}),
+    ("q80", 0.05, {}),
+    ("q81", 0.05, {}),
     ("q83", 0.2, {"min_rows": 0}),
-    ("q85", 0.05, {"max_groups": 1 << 15, "join_capacity": 1 << 21}),
-    ("q87", 0.02, {"max_groups": 1 << 17}),
+    ("q85", 0.05, {}),
+    ("q87", 0.02, {}),
     ("q88", 0.05, {}),
     ("q89", 0.02, {"min_rows": 0}),
     ("q90", 0.05, {}),
     ("q91", 0.2, {}),
     ("q92", 0.02, {"min_rows": 0}),
-    ("q94", 0.05, {"max_groups": 1 << 15, "join_capacity": 1 << 21}),
-    ("q95", 0.05, {"max_groups": 1 << 15, "join_capacity": 1 << 22}),
+    ("q94", 0.05, {}),
+    ("q95", 0.05, {}),
 ]
 
 
