@@ -8,8 +8,16 @@ column_type.
 """
 
 def _load():
-    from . import memory, tpch, tpcds
-    return {"tpch": tpch, "tpcds": tpcds, "memory": memory}
+    from . import memory, system, tpch, tpcds
+    cats = {"tpch": tpch, "tpcds": tpcds, "memory": memory,
+            "system": system}
+    try:
+        import pyarrow  # noqa: F401  (parquet.py imports it lazily)
+        from . import parquet
+        cats["parquet"] = parquet
+    except ImportError:
+        pass  # pyarrow absent: the parquet catalog is gated off
+    return cats
 
 
 CATALOGS = None

@@ -61,6 +61,18 @@ def _storage_dtype(ty: T.Type):
 _lock = threading.RLock()
 _tables: Dict[str, _Table] = {}
 _pending: Dict[str, dict] = {}  # handle id -> staging
+_versions: Dict[str, int] = {}  # table -> mutation counter
+
+
+def table_version(name: str) -> int:
+    """Monotonic per-table mutation counter: fragment-result caching
+    keys on it so cached scans invalidate when a table changes."""
+    with _lock:
+        return _versions.get(name, 0)
+
+
+def _bump_version(name: str) -> None:
+    _versions[name] = _versions.get(name, 0) + 1
 
 
 class SCHEMA(dict):  # noqa: N801 - registry expects a SCHEMA mapping
@@ -118,6 +130,7 @@ def create_table(name: str, columns: Sequence[str],
                 return
             raise ValueError(f"memory table {name!r} already exists")
         _tables[name] = _Table(list(columns), list(types))
+        _bump_version(name)
 
 
 def drop_table(name: str, if_exists: bool = False) -> None:
@@ -125,6 +138,7 @@ def drop_table(name: str, if_exists: bool = False) -> None:
         if name not in _tables and not if_exists:
             raise KeyError(f"no memory table {name!r}")
         _tables.pop(name, None)
+        _bump_version(name)
 
 
 def column_type(table: str, column: str) -> T.Type:
@@ -243,6 +257,7 @@ def finish_insert(handle: str) -> int:
             t.nulls[i] = np.concatenate(
                 [t.nulls[i], np.concatenate(st["nulls"][i])])
         rows = sum(len(c) for c in st["values"][0]) if t.columns else 0
+        _bump_version(st["table"])
         return rows
 
 

@@ -1,0 +1,194 @@
+"""System connector: cluster introspection as queryable tables.
+
+Reference surface: presto-main's system connector (runtime.queries /
+runtime.tasks / runtime.nodes / metadata.catalogs system tables) and
+the native worker's SystemConnector.cpp (task info served as tables).
+Servers register themselves at start (statement servers, worker task
+managers, discovery urls); scans snapshot live state host-side -- no
+device work, these are control-plane reads.
+
+    SELECT query_id, state, query FROM system.queries
+    SELECT task_id, state, rows FROM system.tasks
+    SELECT * FROM system.catalogs
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import types as T
+from ..block import batch_from_numpy
+
+__all__ = ["SCHEMA", "register_statement_server", "register_task_manager",
+           "register_discovery", "reset", "table_row_count",
+           "generate_columns", "generate_nulls", "generate_batch",
+           "column_type"]
+
+_lock = threading.Lock()
+# weak references: registration must not keep dead servers alive (test
+# suites churn through hundreds of them)
+_statement_servers: List[weakref.ref] = []
+_task_managers: List[weakref.ref] = []
+_discovery_urls: List[str] = []
+
+
+def _live(refs: List[weakref.ref]) -> List[object]:
+    out = []
+    dead = []
+    for r in refs:
+        o = r()
+        (out if o is not None else dead).append(o if o is not None else r)
+    for r in dead:
+        refs.remove(r)
+    return out
+
+
+def register_statement_server(server) -> None:
+    with _lock:
+        if server not in _live(_statement_servers):
+            _statement_servers.append(weakref.ref(server))
+
+
+def register_task_manager(manager) -> None:
+    with _lock:
+        if manager not in _live(_task_managers):
+            _task_managers.append(weakref.ref(manager))
+
+
+def register_discovery(url: str) -> None:
+    with _lock:
+        if url not in _discovery_urls:
+            _discovery_urls.append(url)
+
+
+def reset() -> None:
+    with _lock:
+        _statement_servers.clear()
+        _task_managers.clear()
+        _discovery_urls.clear()
+
+
+_V = T.varchar(256)
+SCHEMA = {
+    "queries": {"query_id": _V, "state": _V, "user": _V, "query": _V,
+                "elapsed_ms": T.BIGINT},
+    "tasks": {"task_id": _V, "state": _V, "rows": T.BIGINT,
+              "buffered_pages": T.BIGINT, "elapsed_s": T.DOUBLE},
+    "nodes": {"node_id": _V, "uri": _V, "coordinator": T.BOOLEAN,
+              "age_seconds": T.DOUBLE},
+    "catalogs": {"catalog_name": _V, "connector_id": _V},
+    "tables": {"catalog_name": _V, "table_name": _V,
+               "column_count": T.BIGINT},
+    "plan_cache": {"entries": T.BIGINT, "hits": T.BIGINT,
+                   "misses": T.BIGINT},
+}
+
+
+def _rows_of(table: str) -> List[tuple]:
+    if table == "queries":
+        out = []
+        with _lock:
+            servers = _live(_statement_servers)
+        for s in servers:
+            for doc in s.queries_doc():
+                out.append((doc["queryId"], doc["state"], doc["user"],
+                            doc["query"],
+                            int(doc.get("elapsedTimeMillis", 0))))
+        return out
+    if table == "tasks":
+        out = []
+        with _lock:
+            managers = _live(_task_managers)
+        for m in managers:
+            with m._tasks_lock:
+                infos = [t.info() for t in m.tasks.values()]
+            for i in infos:
+                out.append((i["taskId"], i["state"],
+                            int(i.get("stats", {}).get("outputRows", 0)),
+                            i["bufferedPages"], i["elapsedSeconds"]))
+        return out
+    if table == "nodes":
+        from ..server.discovery import alive_nodes
+        out = []
+        with _lock:
+            urls = list(_discovery_urls)
+        for url in urls:
+            try:
+                for n in alive_nodes(url, max_age_s=1e9):
+                    out.append((n.get("nodeId", ""), n.get("uri", ""),
+                                bool(n.get("coordinator", False)),
+                                float(n.get("ageSeconds", 0.0))))
+            except Exception:  # noqa: BLE001 - discovery may be down
+                pass
+        return out
+    if table == "catalogs":
+        from . import catalogs
+        return [(name, name) for name in sorted(catalogs())]
+    if table == "tables":
+        from . import catalogs
+        out = []
+        for cat, mod in sorted(catalogs().items()):
+            if cat == "system":
+                sch = SCHEMA
+            else:
+                sch = mod.SCHEMA
+            for t in sorted(sch.keys()):
+                try:
+                    out.append((cat, t, len(sch[t])))
+                except Exception:  # noqa: BLE001 - live schemas may churn
+                    pass
+        return out
+    if table == "plan_cache":
+        from ..exec.plan_cache import cache_stats
+        st = cache_stats()
+        return [(st["entries"], st["hits"], st["misses"])]
+    raise KeyError(f"no system table {table!r}")
+
+
+def column_type(table: str, column: str) -> T.Type:
+    return SCHEMA[table][column]
+
+
+def table_row_count(table: str, sf: float = 0.0) -> int:
+    return len(_rows_of(table))
+
+
+def generate_columns(table: str, sf: float, columns: Sequence[str],
+                     start: int = 0, count: Optional[int] = None
+                     ) -> Dict[str, np.ndarray]:
+    rows = _rows_of(table)
+    count = len(rows) - start if count is None else count
+    rows = rows[start:start + count]
+    names = list(SCHEMA[table])
+    out = {}
+    for c in columns:
+        i = names.index(c)
+        ty = SCHEMA[table][c]
+        vals = [r[i] for r in rows]
+        if ty.is_string:
+            out[c] = np.array([str(v) for v in vals], dtype=object)
+        else:
+            out[c] = np.array(vals, dtype=ty.to_dtype())
+    return out
+
+
+def generate_nulls(table: str, columns: Sequence[str], start: int = 0,
+                   count: Optional[int] = None) -> Dict[str, np.ndarray]:
+    n = table_row_count(table) - start if count is None else count
+    return {c: np.zeros(max(n, 0), dtype=bool) for c in columns}
+
+
+def generate_batch(table: str, sf: float, columns: Sequence[str],
+                   start: int = 0, count: Optional[int] = None,
+                   capacity: Optional[int] = None):
+    data = generate_columns(table, sf, columns, start, count)
+    vals = [data[c] for c in columns]
+    types = [SCHEMA[table][c] for c in columns]
+    n = len(vals[0]) if vals else 0
+    cap = capacity or max(n, 1)
+    return batch_from_numpy(types, vals, capacity=cap)

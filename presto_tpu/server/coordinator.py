@@ -137,10 +137,21 @@ class Coordinator:
             return list(fallback)
 
     def execute(self, root: N.PlanNode, sf: float = 0.01,
-                timeout: float = 120.0):
+                timeout: float = 120.0, policy: str = "phased"):
         """Run a (possibly multi-fragment) plan. Returns (cols, names)
         where cols is a list of (values, nulls) numpy pairs per output
-        column, pulled from the final task."""
+        column, pulled from the final task.
+
+        `policy` (ExecutionPolicy analog): "phased" (default) runs
+        stages bottom-up, waiting for each -- every task is individually
+        retryable on surviving workers. "all_at_once" submits EVERY
+        stage's tasks immediately with deterministically predicted task
+        ids; consumers long-poll their upstreams inside the worker
+        (fetch_remote_batch waits), so stage submission overlaps and
+        deep pipelines avoid the per-stage coordinator round trips --
+        at the cost of task-level retry (a mid-query failure fails the
+        query, like the reference's AllAtOnceExecutionPolicy without
+        recoverable grouped execution)."""
         workers = self.workers()
         fragments = fragment_plan(root)
         qid = uuid.uuid4().hex[:8]
@@ -153,7 +164,8 @@ class Coordinator:
         submitted: List[Tuple[str, str]] = []
         try:
             return self._execute_fragments(
-                workers, fragments, produced, submitted, qid, sf, timeout)
+                workers, fragments, produced, submitted, qid, sf, timeout,
+                policy)
         finally:
             # release worker-side state: every scheduled task (and its
             # buffered pages) is destroyed once the query is done, the
@@ -167,7 +179,7 @@ class Coordinator:
                     pass
 
     def _execute_fragments(self, workers, fragments, produced, submitted,
-                           qid, sf, timeout):
+                           qid, sf, timeout, policy="phased"):
         frag_by_id = {f.id: f for f in fragments}
         parent_of: Dict[int, int] = {}
         for f in fragments:
@@ -221,6 +233,16 @@ class Coordinator:
                 ntasks_of[frag.id] = 1
             else:
                 ntasks_of[frag.id] = len(workers) if (scans or hash_ups) else 1
+
+        all_pending = []  # all_at_once: awaited together at the end
+        if policy == "all_at_once":
+            # predicted placement: task ids are deterministic, so every
+            # consumer can name its upstream tasks BEFORE they finish
+            # (fetch_remote_batch long-polls upstream completion)
+            for frag in fragments:
+                produced[frag.id] = [
+                    (workers[w % len(workers)], f"{qid}.f{frag.id}.w{w}")
+                    for w in range(ntasks_of[frag.id])]
 
         for frag in fragments:
             frag_plan = N.OutputNode(frag.root, [
@@ -296,15 +318,33 @@ class Coordinator:
                         spec[rn.id] = entry
                     body["remoteSources"] = spec
                 bodies[w] = body
+                if policy == "all_at_once":
+                    # land exactly on the predicted (url, id): no
+                    # submission failover (consumers already hold the
+                    # prediction)
+                    url, tid = produced[frag.id][w]
+                    WorkerClient(url, timeout).submit_body(tid, body)
+                    submitted.append((url, tid))
+                    all_pending.append((url, tid))
+                    continue
                 url, tid, _ = self._submit(workers, w,
                                            f"{qid}.f{frag.id}.w{w}",
                                            body, timeout)
                 submitted.append((url, tid))
                 pending.append((w, url, tid, w))
+            if policy == "all_at_once":
+                continue  # awaited together after every stage launched
             done = self._await_or_retry(workers, pending,
                                         lambda k: bodies[k], timeout,
                                         submitted)
             produced[frag.id] = [done[w] for w in sorted(done)]
+
+        for url, tid in all_pending:
+            info = WorkerClient(url, timeout).wait(tid, timeout)
+            if info["state"] != "FINISHED":
+                raise RuntimeError(
+                    f"all_at_once task {tid} at {url} is "
+                    f"{info['state']}: {info.get('error')}")
 
         # pull + concatenate every final task's buffer (queries whose
         # root fragment is hash-distributed return disjoint slices);

@@ -140,6 +140,8 @@ class StatementServer:
     # -- lifecycle ------------------------------------------------------
 
     def start(self):
+        from ..connectors.system import register_statement_server
+        register_statement_server(self)  # system.queries introspection
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
@@ -372,6 +374,7 @@ class StatementServer:
                 "query": q.text, "user": q.user,
                 "sessionProperties": q.session_values,
                 "timings": q.machine.timings(),
+                "elapsedTimeMillis": q.machine.elapsed_ms(),
                 "errorInfo": q.machine.error}
 
     def queries_doc(self) -> List[dict]:

@@ -75,6 +75,95 @@ class _GoneError(Exception):
     """Requested pages were acked away by a prior consumer (HTTP 410)."""
 
 
+class FragmentResultCache:
+    """Leaf-fragment output cache (FileFragmentResultCacheManager
+    analog): serialized result pages keyed by (canonical plan
+    fingerprint, sf, scan ranges, output partitioning, connector data
+    versions). Deterministic generator scans key on sf alone; memory
+    tables key on their mutation counters; parquet on file mtimes;
+    volatile catalogs (system) are uncacheable. Bounded LRU by bytes."""
+
+    def __init__(self, max_bytes: int = 256 << 20):
+        import collections
+        self.max_bytes = max_bytes
+        self._entries = collections.OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def key_of(plan: N.PlanNode, sf: float, scan_ranges: dict,
+               out_part, compression) -> Optional[tuple]:
+        """None = not cacheable."""
+        scans: List[N.TableScanNode] = []
+
+        def walk(n):
+            if isinstance(n, (N.RemoteSourceNode, N.TableWriterNode,
+                              N.TableFinishNode, N.DdlNode)):
+                # remote inputs aren't pure; writes/DDL are SIDE EFFECTS
+                # a replayed page must never skip
+                scans.append(None)
+            if isinstance(n, N.TableScanNode):
+                scans.append(n)
+            for s in n.sources:
+                walk(s)
+        walk(plan)
+        versions = []
+        for s in scans:
+            if s is None:
+                return None
+            if s.connector in ("tpch", "tpcds"):
+                versions.append((s.connector, s.table, 0))
+            elif s.connector == "memory":
+                from ..connectors import memory
+                versions.append(("memory", s.table,
+                                 memory.table_version(s.table)))
+            elif s.connector == "parquet":
+                from ..connectors import parquet as pq
+                try:
+                    # the registration-time mtime snapshot (what the
+                    # pinned reader handle actually serves), NOT the
+                    # file's current mtime
+                    versions.append(("parquet", s.table,
+                                     pq._tables[s.table]["mtime"]))
+                except Exception:  # noqa: BLE001
+                    return None
+            else:
+                return None  # system & unknown catalogs are volatile
+        from ..exec.plan_cache import plan_fingerprint
+        return (plan_fingerprint(plan), sf,
+                tuple(sorted((k, tuple(v)) for k, v in scan_ranges.items())),
+                repr(out_part), compression, tuple(versions))
+
+    def get(self, key) -> Optional[dict]:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return e
+
+    def put(self, key, buffers: Dict[int, List[bytes]], rows: int,
+            stats: Dict[str, float]) -> None:
+        size = sum(len(p) for pages in buffers.values() for p in pages)
+        if size > self.max_bytes:
+            return
+        with self._lock:
+            if key in self._entries:
+                return
+            self._entries[key] = {"buffers": {k: list(v) for k, v
+                                              in buffers.items()},
+                                  "rows": rows, "stats": dict(stats),
+                                  "bytes": size}
+            self._bytes += size
+            while self._bytes > self.max_bytes and self._entries:
+                _k, old = self._entries.popitem(last=False)
+                self._bytes -= old["bytes"]
+
+
 class _Task:
     def __init__(self, task_id: str):
         self.task_id = task_id
@@ -131,6 +220,9 @@ class TaskManager:
         self.task_concurrency = max(1, int(task_concurrency))
         self._exec_slots = threading.BoundedSemaphore(self.task_concurrency)
         self._tasks_lock = threading.Lock()
+        self.fragment_cache = FragmentResultCache()
+        from ..connectors.system import register_task_manager
+        register_task_manager(self)  # system.tasks introspection
         # lifetime counters for /v1/info/metrics (Prometheus)
         self.counters: Dict[str, int] = {"tasks_created": 0,
                                          "tasks_finished": 0,
@@ -224,6 +316,41 @@ class TaskManager:
                     ack=bool(spec.get("ack", True)),
                     merge_keys=spec.get("mergeKeys"))
             from ..exec.runner import run_query
+            # fragment result cache: identical leaf fragments (same
+            # canonical plan, splits, data versions) replay their
+            # serialized pages without touching the chip
+            cache_on = True
+            try:
+                v = session.get("fragment_result_cache")
+                cache_on = v is None or bool(v)
+            except (KeyError, TypeError):
+                pass
+            ckey = None
+            if cache_on and not body.get("remoteSources"):
+                ckey = FragmentResultCache.key_of(
+                    plan, sf, scan_ranges, body.get("outputPartitions"),
+                    session.get("exchange_compression"))
+            if ckey is not None:
+                hit = self.fragment_cache.get(ckey)
+                if hit is not None:
+                    with task.lock:
+                        if task.state == "ABORTED":
+                            return
+                        for pid, pages in hit["buffers"].items():
+                            task.buffers.setdefault(pid, []).extend(pages)
+                        task.no_more_pages = True
+                        task.stats = {**hit["stats"],
+                                      "fragmentCacheHit": 1}
+                        task.state = "FINISHED"
+                        task.finished_at = time.time()
+                    task._accounted = True
+                    self._count("tasks_finished")
+                    self._count("rows_produced", hit["rows"])
+                    from .events import event_listeners
+                    event_listeners().task_completed(task.task_id,
+                                                     "FINISHED",
+                                                     hit["rows"])
+                    return
             t0 = time.time()
             with self._exec_slots:
                 res = run_query(plan, sf=sf, mesh=self.mesh,
@@ -238,6 +365,7 @@ class TaskManager:
             types = plan.output_types()
             out_part = body.get("outputPartitions")
             total_bytes = 0
+            built: Dict[int, List[bytes]] = {}
             if out_part:
                 # PartitionedOutputBuffer analog: rows hash to one page
                 # per destination partition (same hash as the engine's
@@ -260,6 +388,7 @@ class TaskManager:
                         return
                     for pid, page in enumerate(pages):
                         task.buffers.setdefault(pid, []).append(page)
+                built = {pid: [page] for pid, page in enumerate(pages)}
             else:
                 cols = [(types[i], res.columns[i], res.nulls[i])
                         for i in range(len(res.columns))]
@@ -269,6 +398,7 @@ class TaskManager:
                     if task.state == "ABORTED":
                         return
                     task.buffers[0].append(page)
+                built = {0: [page]}
             with task.lock:
                 if task.state == "ABORTED":
                     return
@@ -281,6 +411,9 @@ class TaskManager:
             task._accounted = True
             self._count("tasks_finished")
             self._count("rows_produced", res.row_count)
+            if ckey is not None:
+                self.fragment_cache.put(ckey, built, res.row_count,
+                                        task.stats)
             from .events import event_listeners
             event_listeners().task_completed(task.task_id, "FINISHED",
                                              res.row_count)

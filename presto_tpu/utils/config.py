@@ -154,6 +154,14 @@ SESSION_PROPERTIES = (
          "cap on per-query device state; aggregations whose planned "
          "group table exceeds it run grouped-execution spill to host "
          "DRAM (exec/spill.py; 0 = uncapped)")
+    .add("fragment_result_cache", "bool", True,
+         "replay identical leaf fragments' serialized pages from the "
+         "worker's data-versioned cache (FileFragmentResultCacheManager "
+         "analog); disable when benchmarking raw execution")
+    .add("adaptive_capacity", "bool", True,
+         "on bucket overflow, re-plan with geometrically larger "
+         "capacities instead of failing (exec/runner.py rerun ladder + "
+         "plan-fingerprint feedback)")
 )
 
 

@@ -240,3 +240,22 @@ def test_former_scheduler_gaps_degrade_to_single_task(cluster):
     cols2, _ = coord.execute(plan2, sf=0.01)
     got = collections.Counter(int(v) for v in cols2[0][0])
     assert got == want
+
+
+def test_all_at_once_policy_matches_phased(cluster):
+    """AllAtOnceExecutionPolicy analog: every stage's tasks submit
+    before any completes; consumers long-poll upstreams worker-side.
+    Results must equal the phased policy exactly."""
+    sqltext = ("SELECT custkey, sum(totalprice) AS s, count(*) AS c "
+               "FROM orders GROUP BY custkey")
+    coord = Coordinator([f"http://127.0.0.1:{w.port}" for w in cluster])
+    dist = distribute_simple_agg(plan_sql(sqltext, max_groups=1 << 14))
+    cols_p, _ = coord.execute(dist, sf=0.01, policy="phased")
+    dist2 = distribute_simple_agg(plan_sql(sqltext, max_groups=1 << 14))
+    cols_a, _ = coord.execute(dist2, sf=0.01, policy="all_at_once")
+
+    def as_map(cols):
+        return {int(cols[0][0][i]): (int(cols[1][0][i]),
+                                     int(cols[2][0][i]))
+                for i in range(len(cols[0][0]))}
+    assert as_map(cols_a) == as_map(cols_p)

@@ -1,0 +1,85 @@
+"""Fragment result cache: identical leaf fragments replay serialized
+pages (FileFragmentResultCacheManager analog), invalidated by data
+versions."""
+
+import numpy as np
+
+from presto_tpu import types as T
+from presto_tpu.connectors import memory
+from presto_tpu.server import TpuWorkerServer, WorkerClient
+from presto_tpu.sql import plan_sql
+
+
+def test_hit_replay_and_version_invalidation():
+    memory.reset()
+    memory.create_table("fc", ["x"], [T.BIGINT])
+    h = memory.begin_insert("fc")
+    memory.append(h, [np.array([1, 2, 3], dtype=np.int64)])
+    memory.finish_insert(h)
+    w = TpuWorkerServer(sf=0.01).start()
+    try:
+        c = WorkerClient(f"http://127.0.0.1:{w.port}")
+        plan = plan_sql("SELECT sum(x) AS s FROM fc", catalog="memory")
+        c.submit("fc-1", plan, sf=0.01)
+        c.wait("fc-1", 30)
+        cache = w.manager.fragment_cache
+        assert cache.misses >= 1 and cache.hits == 0
+        types = plan.output_types()
+        (v1, _), = c.fetch_results("fc-1", types)
+
+        # same fragment again: replayed from cache
+        c.submit("fc-2", plan_sql("SELECT sum(x) AS s FROM fc",
+                                  catalog="memory"), sf=0.01)
+        info = c.wait("fc-2", 30)
+        assert info["stats"].get("fragmentCacheHit") == 1
+        assert cache.hits == 1
+        (v2, _), = c.fetch_results("fc-2", types)
+        assert list(v1) == list(v2) == [6]
+
+        # mutate the table: version bump must invalidate
+        h = memory.begin_insert("fc")
+        memory.append(h, [np.array([10], dtype=np.int64)])
+        memory.finish_insert(h)
+        c.submit("fc-3", plan_sql("SELECT sum(x) AS s FROM fc",
+                                  catalog="memory"), sf=0.01)
+        info = c.wait("fc-3", 30)
+        assert "fragmentCacheHit" not in info["stats"]
+        (v3, _), = c.fetch_results("fc-3", types)
+        assert list(v3) == [16]
+    finally:
+        w.stop()
+        memory.reset()
+
+
+def test_generator_scans_cache_by_sf():
+    w = TpuWorkerServer(sf=0.01).start()
+    try:
+        c = WorkerClient(f"http://127.0.0.1:{w.port}")
+        plan = plan_sql("SELECT count(*) AS n FROM nation")
+        c.submit("g-1", plan, sf=0.01)
+        c.wait("g-1", 30)
+        c.submit("g-2", plan_sql("SELECT count(*) AS n FROM nation"),
+                 sf=0.01)
+        info = c.wait("g-2", 30)
+        assert info["stats"].get("fragmentCacheHit") == 1
+        # system catalog scans must NOT cache (volatile)
+        key = w.manager.fragment_cache.key_of(
+            plan_sql("SELECT count(*) AS n FROM system.catalogs"),
+            0.01, {}, None, None)
+        assert key is None
+    finally:
+        w.stop()
+
+
+def test_write_and_ddl_fragments_never_cache():
+    """A replayed page must never skip a side effect: TableWriter/
+    TableFinish/Ddl fragments are uncacheable."""
+    from presto_tpu.server.worker import FragmentResultCache
+    memory.reset()
+    memory.create_table("wfc", ["x"], [T.BIGINT])
+    for text in ("INSERT INTO memory.wfc VALUES (1)",
+                 "DROP TABLE memory.wfc"):
+        key = FragmentResultCache.key_of(plan_sql(text), 0.01, {}, None,
+                                         None)
+        assert key is None, text
+    memory.reset()

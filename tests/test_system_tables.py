@@ -1,0 +1,52 @@
+"""System connector: runtime introspection as SQL tables (the
+system connector / SystemConnector.cpp analog)."""
+
+import numpy as np
+
+from presto_tpu.connectors import system
+from presto_tpu.sql import sql
+
+
+def test_catalogs_and_tables():
+    res = sql("SELECT catalog_name FROM system.catalogs "
+              "ORDER BY catalog_name")
+    names = [r[0] for r in res.rows()]
+    assert "tpch" in names and "memory" in names and "system" in names
+    res2 = sql("SELECT count(*) AS n FROM system.tables "
+               "WHERE catalog_name = 'tpch'")
+    assert res2.rows()[0][0] == 8  # the 8 tpch tables
+
+
+def test_queries_table_sees_statement_server():
+    from presto_tpu.client import execute
+    from presto_tpu.server.statement import StatementServer
+    with StatementServer(sf=0.01) as s:
+        execute(s.url, "SELECT count(*) AS n FROM region",
+                session={"sf": "0.01"})
+        res = sql("SELECT query_id, state, query FROM system.queries")
+        rows = [r for r in res.rows()
+                if r[2] == "SELECT count(*) AS n FROM region"]
+        assert rows and rows[-1][1] == "FINISHED"
+
+
+def test_tasks_table_sees_worker():
+    from presto_tpu.server import TpuWorkerServer, WorkerClient
+    from presto_tpu.sql import plan_sql
+    from presto_tpu.plan import nodes as N
+    w = TpuWorkerServer(sf=0.01).start()
+    try:
+        c = WorkerClient(f"http://127.0.0.1:{w.port}")
+        c.submit("sys-t1", plan_sql("SELECT count(*) AS n FROM region"),
+                 sf=0.01)
+        c.wait("sys-t1", 30)
+        res = sql("SELECT task_id, state, rows FROM system.tasks")
+        mine = [r for r in res.rows() if r[0] == "sys-t1"]
+        assert mine and mine[0][1] == "FINISHED" and mine[0][2] == 1
+    finally:
+        w.stop()
+
+
+def test_plan_cache_stats_table():
+    res = sql("SELECT entries, hits, misses FROM system.plan_cache")
+    e, h, m = res.rows()[0]
+    assert e >= 0 and h >= 0 and m >= 1  # this very query compiles

@@ -44,7 +44,7 @@ FAST_CASES = [
     ("q76", 0.01, {}),
     ("q79", 0.02, {"keep_limit": True}),
     ("q82", 0.02, {}),
-    ("q84", 0.02, {}),
+    ("q84", 0.01, {}),
     ("q86", 0.02, {}),
     ("q93", 0.02, {"keep_limit": True}),
     ("q96", 0.02, {"min_rows": 0}),
@@ -56,7 +56,7 @@ FAST_CASES = [
 SLOW_CASES = [
     ("q1", 0.02, {}),
     ("q2", 0.02, {}),
-    ("q8", 0.05, {}),
+    ("q8", 0.1, {}),
     ("q9", 0.05, {}),
     ("q10", 0.05, {}),
     ("q31", 0.05, {}),
@@ -75,12 +75,12 @@ SLOW_CASES = [
     ("q12", 0.05, {"min_rows": 0}),
     ("q14", 0.05, {}),
     ("q16", 0.05, {}),
-    ("q17", 0.05, {}),
+    ("q17", 0.2, {}),
     ("q18", 0.05, {}),
     ("q20", 0.02, {}),
     ("q22", 0.02, {}),
     ("q23", 0.05, {}),
-    ("q24", 0.05, {}),
+    ("q24", 0.2, {}),
     ("q25", 0.05, {"min_rows": 0}),
     ("q28", 0.02, {}),
     ("q29", 0.05, {"min_rows": 0}),
