@@ -273,3 +273,8 @@ def abort_insert(handle: str) -> None:
         st = _pending.pop(handle, None)
         if st is not None and st["created"]:
             _tables.pop(st["table"], None)
+
+
+def data_version(table: str) -> int:
+    """Fragment-result-cache seam (alias of table_version)."""
+    return table_version(table)

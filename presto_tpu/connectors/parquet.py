@@ -173,7 +173,17 @@ def _column_to_engine(arr, ty: T.Type) -> Tuple[np.ndarray, np.ndarray]:
     import pyarrow.compute as pc
     nulls = np.asarray(arr.is_null().to_numpy(zero_copy_only=False))
     if ty.is_decimal:
-        # exact: decimal128 -> scaled integers
+        if ty.is_short_decimal and pa.types.is_decimal128(arr.type) and \
+                arr.type.scale == ty.scale:
+            # vectorized: a decimal128's unscaled value is a 16-byte
+            # two's-complement int; for p <= 18 it fits int64, so the
+            # little-endian LOW word IS the value -- no Python loop on
+            # the hot scan path
+            data = np.frombuffer(arr.buffers()[1], dtype=np.int64)
+            lo = data[0::2]
+            vals = lo[arr.offset:arr.offset + len(arr)].copy()
+            return np.where(nulls, 0, vals), nulls
+        # long decimals (int128) decode exactly through Python ints
         vals = np.array([0 if v is None else int(v.scaleb(ty.scale))
                          for v in arr.to_pylist()], dtype=object)
         if ty.is_short_decimal:
@@ -301,3 +311,10 @@ def write_table(path: str, columns: Dict[str, np.ndarray],
         fields.append(pa.field(name, arrays[-1].type))
     tbl = pa.Table.from_arrays(arrays, schema=pa.schema(fields))
     pq.write_table(tbl, path, row_group_size=row_group_size)
+
+
+def data_version(table: str) -> float:
+    """Fragment-result-cache seam: the registration-time mtime snapshot
+    (what the pinned reader handle actually serves)."""
+    with _lock:
+        return _tables[table]["mtime"]

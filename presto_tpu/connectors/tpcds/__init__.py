@@ -7,3 +7,12 @@ __all__ = ["TPCDS_SCHEMA", "table_row_count", "generate_columns",
 
 SCHEMA = TPCDS_SCHEMA  # uniform connector-registry surface
 __all__ = __all__ + ["SCHEMA"]
+
+
+def data_version(table: str) -> int:
+    """Fragment-result-cache seam: generated data is a pure function
+    of (table, sf), so the version never changes."""
+    return 0
+
+
+__all__ = __all__ + ["data_version"]

@@ -134,13 +134,18 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     # rule-based simplification + channel pruning (IterativeOptimizer /
     # PruneUnreferencedOutputs analog): narrows intermediates before
     # stats and distribution decide capacities and exchange widths
-    if session is None or session.get("iterative_optimizer"):
+    from ..utils.config import session_flag, session_value
+
+    def _session_on(name: str) -> bool:
+        return session_flag(session, name, True)
+
+    if _session_on("iterative_optimizer"):
         from ..plan.rules import optimize_plan
         root = optimize_plan(root)
     # capacity refinement (CBO stats): shrink group tables to the
     # connector-proven NDV bound so group-by rides the scatter-free
     # small-table kernels wherever statistics allow
-    refine = session is None or session.get("stats_capacity_refinement")
+    refine = _session_on("stats_capacity_refinement")
     if refine:
         from ..plan.stats import refine_capacities
         root = refine_capacities(root, sf)
@@ -176,13 +181,18 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
             agg_node, _ = shape
             if hbm_budget:  # 0 / None = uncapped (the config default)
                 from .spill import plan_state_bytes, run_spilled_agg
+                spill_dir = session_value(session, "spill_path") or None
+                spill_thresh = int(session_value(
+                    session, "spill_file_threshold_bytes", 256 << 20))
                 if 2 * plan_state_bytes(agg_node) > hbm_budget:
                     # the full state table cannot fit the budget: grouped
                     # execution with per-bucket host offload (the
                     # SpillableHashAggregationBuilder path)
                     with stats.timed("spilled_exec_s"):
-                        out_b = run_spilled_agg(root, sf, split_rows,
-                                                hbm_budget, stats)
+                        out_b = run_spilled_agg(
+                            root, sf, split_rows, hbm_budget, stats,
+                            spill_dir=spill_dir,
+                            spill_file_threshold=spill_thresh)
                     res = _batch_to_result(out_b, root)
                     res.stats = stats.snapshot()
                     return res

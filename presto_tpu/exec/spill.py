@@ -81,15 +81,27 @@ def _cpu_device():
 
 class _HostRows:
     """Compacted host staging: live rows only, as numpy arrays (the
-    spill medium). Appending pulls the batch's ACTIVE rows off-device;
-    `to_batch` re-stages them as one padded Batch."""
+    first spill medium). Appending pulls the batch's ACTIVE rows
+    off-device; `to_batch` re-stages them as one padded Batch.
 
-    def __init__(self, types: List[T.Type]):
+    Disk tier (FileSingleStreamSpiller / TempStorage analog): with a
+    `disk_dir`, accumulated host chunks flush to .npz run files once
+    they exceed `disk_threshold_bytes`, bounding host DRAM too; reads
+    re-load the runs in order. Bucket states are disjoint (module
+    docstring), so runs concatenate -- no merge pass."""
+
+    def __init__(self, types: List[T.Type], disk_dir: Optional[str] = None,
+                 disk_threshold_bytes: int = 256 << 20):
         self.types = types
         self._cols: List[List[np.ndarray]] = [[] for _ in types]
         self._nulls: List[List[np.ndarray]] = [[] for _ in types]
         self.rows = 0
         self.bytes = 0
+        self._mem_bytes = 0
+        self.disk_dir = disk_dir
+        self.disk_threshold = disk_threshold_bytes
+        self._runs: List[str] = []  # flushed .npz paths, in order
+        self.disk_bytes = 0
 
     def append(self, batch: Batch, stats: Optional[RuntimeStats]):
         act = np.asarray(batch.active)
@@ -104,15 +116,63 @@ class _HostRows:
             moved += (v.nbytes if v.dtype != object else 32 * len(v)) \
                 + nl.nbytes
         self.bytes += moved
+        self._mem_bytes += moved
         if stats is not None:
             stats.add("spilled_bytes", moved)
+        if self.disk_dir is not None and \
+                self._mem_bytes >= self.disk_threshold:
+            self._flush_run(stats)
+
+    def _flush_run(self, stats: Optional[RuntimeStats]):
+        import os
+        import uuid as _uuid
+        if self.rows == 0 or not self._cols[0]:
+            return
+        os.makedirs(self.disk_dir, exist_ok=True)
+        path = os.path.join(self.disk_dir,
+                            f"spill_{_uuid.uuid4().hex[:12]}.npz")
+        payload = {}
+        for c in range(len(self.types)):
+            payload[f"v{c}"] = np.concatenate(self._cols[c]) \
+                if self._cols[c] else np.array([], dtype=object)
+            payload[f"n{c}"] = np.concatenate(self._nulls[c]) \
+                if self._nulls[c] else np.array([], dtype=bool)
+            self._cols[c] = []
+            self._nulls[c] = []
+        np.savez(path, **{k: v for k, v in payload.items()})
+        self._runs.append(path)
+        written = os.path.getsize(path)
+        self.disk_bytes += written
+        self._mem_bytes = 0
+        if stats is not None:
+            stats.add("spilled_to_disk_bytes", written)
+            stats.add("spill_run_files", 1)
 
     def columns(self) -> Tuple[List[np.ndarray], List[np.ndarray]]:
+        cols_runs: List[List[np.ndarray]] = [[] for _ in self.types]
+        nulls_runs: List[List[np.ndarray]] = [[] for _ in self.types]
+        for path in self._runs:
+            with np.load(path, allow_pickle=True) as z:
+                for c in range(len(self.types)):
+                    cols_runs[c].append(z[f"v{c}"])
+                    nulls_runs[c].append(z[f"n{c}"])
+        for c in range(len(self.types)):
+            cols_runs[c].extend(self._cols[c])
+            nulls_runs[c].extend(self._nulls[c])
         cols = [np.concatenate(c) if c else np.array([], dtype=object)
-                for c in self._cols]
+                for c in cols_runs]
         nulls = [np.concatenate(n) if n else np.array([], dtype=bool)
-                 for n in self._nulls]
+                 for n in nulls_runs]
         return cols, nulls
+
+    def close(self):
+        import os
+        for path in self._runs:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        self._runs = []
 
     def to_batch(self, capacity: Optional[int] = None,
                  on_host: bool = False) -> Batch:
@@ -127,7 +187,9 @@ class _HostRows:
 
 def run_spilled_agg(root: N.PlanNode, sf: float, split_rows: int,
                     hbm_budget_bytes: int,
-                    stats: Optional[RuntimeStats] = None) -> Batch:
+                    stats: Optional[RuntimeStats] = None,
+                    spill_dir: Optional[str] = None,
+                    spill_file_threshold: int = 256 << 20) -> Batch:
     """Streamable aggregation whose state table exceeds the HBM budget:
     grouped execution with per-bucket host offload. The bucket executor
     compiles ONCE (bucket id is a traced scalar); each finished
@@ -151,19 +213,27 @@ def run_spilled_agg(root: N.PlanNode, sf: float, split_rows: int,
     nkeys = len(agg.group_channels)
     runner = _make_agg_executor(root_b, sf, split_rows, n_buckets)
     staged: Optional[_HostRows] = None
-    for b in range(n_buckets):
-        r = runner(b)
-        if bool(np.asarray(r.overflow)):
-            raise RuntimeError(
-                f"spilled aggregation bucket {b} overflowed its "
-                f"{bucket_groups}-group table; raise max_groups")
-        out = finalize_states(r.batch, nkeys, agg.aggregates)
-        if staged is None:
-            staged = _HostRows([c.type for c in out.columns])
-        staged.append(out, stats)
-        if stats is not None:
-            stats.add("spill_buckets", 1)
-    return staged.to_batch(on_host=True)
+    try:
+        for b in range(n_buckets):
+            r = runner(b)
+            if bool(np.asarray(r.overflow)):
+                raise RuntimeError(
+                    f"spilled aggregation bucket {b} overflowed its "
+                    f"{bucket_groups}-group table; raise max_groups")
+            out = finalize_states(r.batch, nkeys, agg.aggregates)
+            if staged is None:
+                staged = _HostRows(
+                    [c.type for c in out.columns], disk_dir=spill_dir,
+                    disk_threshold_bytes=spill_file_threshold)
+            staged.append(out, stats)
+            if stats is not None:
+                stats.add("spill_buckets", 1)
+        return staged.to_batch(on_host=True)
+    finally:
+        # run files must not outlive the query, success OR failure (a
+        # mid-loop overflow raise would otherwise leak every flushed run)
+        if staged is not None:
+            staged.close()
 
 
 def _rebuild_above(root: N.PlanNode, old: N.PlanNode,

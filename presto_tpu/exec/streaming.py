@@ -26,6 +26,7 @@ import numpy as np
 
 from ..block import Batch, concat_batches
 from ..connectors import catalog
+from ..expr import ir as E
 from ..ops.aggregation import GroupByResult, group_by, merge_partials
 from ..plan import nodes as N
 from .planner import compile_plan
@@ -40,6 +41,15 @@ def streamable_agg_shape(root: N.PlanNode) -> Optional[Tuple[N.AggregationNode,
     -- the shape streaming supports in round 1 (joins stream via the
     exchange layer instead)."""
     node = root.source if isinstance(root, N.OutputNode) else root
+    # identity projections (column renames the planner emits above an
+    # aggregation) don't change the streamable shape; a projection that
+    # DROPS or reorders columns does (same arity check as
+    # plan.rules._is_identity)
+    while isinstance(node, N.ProjectNode) and \
+            len(node.expressions) == len(node.source.output_types()) and \
+            all(isinstance(e, E.InputReference) and e.channel == i
+                for i, e in enumerate(node.expressions)):
+        node = node.source
     if not isinstance(node, N.AggregationNode) or node.step != "SINGLE":
         return None
     if any(a.canonical in ("count_distinct", "approx_percentile")

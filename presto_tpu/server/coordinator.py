@@ -297,7 +297,11 @@ class Coordinator:
                                  # non-destructive: retried consumers
                                  # must be able to re-read (buffers are
                                  # freed with the task, not per token)
-                                 "ack": False}
+                                 "ack": False,
+                                 # consumers wait for upstreams at most
+                                 # the query timeout (all_at_once
+                                 # long-polls unfinished producers)
+                                 "timeoutS": timeout}
                         up_part = frag_by_id[rn.fragment_id].partitioning
                         if up_part == "SORTED":
                             # consumer must k-way merge the sorted

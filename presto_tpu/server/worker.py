@@ -113,24 +113,17 @@ class FragmentResultCache:
         for s in scans:
             if s is None:
                 return None
-            if s.connector in ("tpch", "tpcds"):
-                versions.append((s.connector, s.table, 0))
-            elif s.connector == "memory":
-                from ..connectors import memory
-                versions.append(("memory", s.table,
-                                 memory.table_version(s.table)))
-            elif s.connector == "parquet":
-                from ..connectors import parquet as pq
-                try:
-                    # the registration-time mtime snapshot (what the
-                    # pinned reader handle actually serves), NOT the
-                    # file's current mtime
-                    versions.append(("parquet", s.table,
-                                     pq._tables[s.table]["mtime"]))
-                except Exception:  # noqa: BLE001
+            # connector-level seam: a catalog is cacheable iff it
+            # exposes data_version(table) (system & unknown catalogs
+            # don't -- volatile by default)
+            from ..connectors import catalog as _catalog
+            try:
+                fn = getattr(_catalog(s.connector), "data_version", None)
+                if fn is None:
                     return None
-            else:
-                return None  # system & unknown catalogs are volatile
+                versions.append((s.connector, s.table, fn(s.table)))
+            except KeyError:
+                return None  # table/catalog vanished: don't cache
         from ..exec.plan_cache import plan_fingerprint
         return (plan_fingerprint(plan), sf,
                 tuple(sorted((k, tuple(v)) for k, v in scan_ranges.items())),
@@ -314,17 +307,14 @@ class TaskManager:
                     pad_multiple=pad,
                     buffer_id=int(spec.get("bufferId", 0)),
                     ack=bool(spec.get("ack", True)),
-                    merge_keys=spec.get("mergeKeys"))
+                    merge_keys=spec.get("mergeKeys"),
+                    timeout=float(spec.get("timeoutS", 60.0)))
             from ..exec.runner import run_query
             # fragment result cache: identical leaf fragments (same
             # canonical plan, splits, data versions) replay their
             # serialized pages without touching the chip
-            cache_on = True
-            try:
-                v = session.get("fragment_result_cache")
-                cache_on = v is None or bool(v)
-            except (KeyError, TypeError):
-                pass
+            from ..utils.config import session_flag
+            cache_on = session_flag(session, "fragment_result_cache", True)
             ckey = None
             if cache_on and not body.get("remoteSources"):
                 ckey = FragmentResultCache.key_of(

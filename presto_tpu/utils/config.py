@@ -162,6 +162,14 @@ SESSION_PROPERTIES = (
          "on bucket overflow, re-plan with geometrically larger "
          "capacities instead of failing (exec/runner.py rerun ladder + "
          "plan-fingerprint feedback)")
+    .add("spill_path", "str", "",
+         "directory for the DISK spill tier: spilled bucket outputs "
+         "flush from host DRAM to .npz run files once they exceed "
+         "spill_file_threshold_bytes (FileSingleStreamSpiller/"
+         "TempStorage analog; empty = host-DRAM only)")
+    .add("spill_file_threshold_bytes", "int", 256 << 20,
+         "host-DRAM bytes a spill staging area may hold before "
+         "flushing a run file to spill_path")
 )
 
 
@@ -177,3 +185,28 @@ class Session(Config):
         super().__init__(SESSION_PROPERTIES, values)
         self.user = user
         self.query_id = query_id or "q_0"
+
+
+def session_flag(session, name: str, default: bool = True) -> bool:
+    """Default-on boolean session property over Session objects OR plain
+    dicts: missing/None = `default`; only an explicit value overrides.
+    The one shared parser -- hand-rolled copies drifted."""
+    if session is None:
+        return default
+    try:
+        v = session.get(name)
+    except (KeyError, TypeError):
+        return default
+    return default if v is None else bool(v)
+
+
+def session_value(session, name: str, default=None):
+    """Typed session property with a fallback for plain dicts/absent
+    keys."""
+    if session is None:
+        return default
+    try:
+        v = session.get(name)
+    except (KeyError, TypeError):
+        return default
+    return default if v is None else v
