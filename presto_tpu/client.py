@@ -62,7 +62,7 @@ class StatementClient:
             headers["X-Presto-Transaction-Id"] = transaction_id
         doc, _ = self._request(f"{self.server_url}/v1/statement",
                                method="POST", body=text.encode(),
-                               headers=headers)
+                               headers=headers, follow_307=True)
         self._absorb(doc, {})
         self._next_uri = doc.get("nextUri")
 
@@ -70,7 +70,8 @@ class StatementClient:
 
     def _request(self, url: str, method: str = "GET",
                  body: Optional[bytes] = None,
-                 headers: Optional[Dict] = None) -> Tuple[dict, Dict]:
+                 headers: Optional[Dict] = None,
+                 follow_307: bool = False) -> Tuple[dict, Dict]:
         req = urllib.request.Request(url, data=body, method=method,
                                      headers=headers or {})
         try:
@@ -78,6 +79,11 @@ class StatementClient:
                 doc = json.loads(resp.read().decode())
                 return doc, dict(resp.headers)
         except urllib.error.HTTPError as e:
+            if e.code == 307 and follow_307 and e.headers.get("Location"):
+                # a router redirected the statement (presto-router
+                # contract); re-POST to the scheduled cluster
+                return self._request(e.headers["Location"], method=method,
+                                     body=body, headers=headers)
             # non-2xx still carries the protocol's JSON error document
             try:
                 doc = json.loads(e.read().decode())

@@ -1289,3 +1289,165 @@ def hash64_block(b: Block):
 
 def combine_hash(h1, h2):
     return _mix64(h1 ^ (h2 + _GOLD + (h1 << jnp.uint64(6)) + (h1 >> jnp.uint64(2))))
+
+
+# ---------------------------------------------------------------------------
+# round-4 breadth: trig/log/bitwise/unixtime/array positionals -- each an
+# elementwise VPU kernel with the registry's shared null handling
+# (reference: operator/scalar/MathFunctions.java, BitwiseFunctions.java,
+# DateTimeFunctions.java, ArrayFunctions)
+# ---------------------------------------------------------------------------
+
+
+def _f64(a):
+    (x,) = _promote(T.DOUBLE, a)  # descale decimals, widen ints
+    return x
+
+
+def _register_float1(name, fn):
+    @register(name)
+    def _impl(ret, a, _fn=fn):
+        return _col(ret, _fn(_f64(a)), a)
+    return _impl
+
+
+for _name, _fn in [
+        ("sin", jnp.sin), ("cos", jnp.cos), ("tan", jnp.tan),
+        ("asin", jnp.arcsin), ("acos", jnp.arccos), ("atan", jnp.arctan),
+        ("sinh", jnp.sinh), ("cosh", jnp.cosh), ("tanh", jnp.tanh),
+        ("cbrt", jnp.cbrt), ("log2", jnp.log2),
+        ("degrees", jnp.degrees), ("radians", jnp.radians)]:
+    _register_float1(_name, _fn)
+
+
+@register("atan2")
+def _atan2(ret, y, x):
+    return _col(ret, jnp.arctan2(_f64(y), _f64(x)), y, x)
+
+
+@register("log")
+def _log(ret, base, x):
+    return _col(ret, jnp.log(_f64(x)) / jnp.log(_f64(base)), base, x)
+
+
+@register("is_nan")
+def _is_nan(ret, a):
+    return _col(ret, jnp.isnan(_f64(a)), a)
+
+
+@register("is_finite")
+def _is_finite(ret, a):
+    return _col(ret, jnp.isfinite(_f64(a)), a)
+
+
+@register("is_infinite")
+def _is_infinite(ret, a):
+    return _col(ret, jnp.isinf(_f64(a)), a)
+
+
+def _bitwise(name, op):
+    @register(name)
+    def _impl(ret, a, b, _op=op):
+        return _col(ret, _op(a.values.astype(jnp.int64),
+                             b.values.astype(jnp.int64)), a, b)
+    return _impl
+
+
+_bitwise("bitwise_and", jnp.bitwise_and)
+_bitwise("bitwise_or", jnp.bitwise_or)
+_bitwise("bitwise_xor", jnp.bitwise_xor)
+
+
+@register("bitwise_not")
+def _bitwise_not(ret, a):
+    return _col(ret, ~a.values.astype(jnp.int64), a)
+
+
+@register("bitwise_left_shift")
+def _shl(ret, a, b):
+    s = b.values.astype(jnp.int64) & 63  # Java/Presto shift mod 64
+    return _col(ret, a.values.astype(jnp.int64) << s, a, b)
+
+
+@register("bitwise_right_shift")
+def _shr(ret, a, b):
+    s = b.values.astype(jnp.int64) & 63
+    # Presto's logical shift over the 64-bit pattern
+    u = a.values.astype(jnp.int64).astype(jnp.uint64)
+    return _col(ret, (u >> s.astype(jnp.uint64)).astype(jnp.int64), a, b)
+
+
+@register("bitwise_right_shift_arithmetic")
+def _sar(ret, a, b):
+    s = b.values.astype(jnp.int64) & 63
+    return _col(ret, a.values.astype(jnp.int64) >> s, a, b)
+
+
+@register("bit_count")
+def _bit_count(ret, a, bits=None):
+    u = a.values.astype(jnp.int64).astype(jnp.uint64)
+    if bits is not None:
+        width = bits.values.astype(jnp.uint64)
+        mask = jnp.where(width >= jnp.uint64(64),
+                         jnp.uint64(0xFFFFFFFFFFFFFFFF),
+                         (jnp.uint64(1) << width) - jnp.uint64(1))
+        u = u & mask
+    cnt = jax.lax.population_count(u).astype(jnp.int64)
+    return _col(ret, cnt, a) if bits is None else _col(ret, cnt, a, bits)
+
+
+@register("from_unixtime")
+def _from_unixtime(ret, a):
+    # seconds (possibly fractional) -> TIMESTAMP micros
+    us = (_f64(a) * 1e6)
+    return _col(ret, jnp.round(us).astype(jnp.int64), a)
+
+
+@register("to_unixtime")
+def _to_unixtime(ret, a):
+    return _col(ret, a.values.astype(jnp.float64) / 1e6, a)
+
+
+@register("ends_with")
+def _ends_with(ret, a: StringColumn, b: StringColumn):
+    # gather each row's suffix window of b.max_len chars, compare to b;
+    # pad the haystack when the needle BATCH is wider (a short needle in
+    # a wide column must still match -- same padding as starts_with)
+    chars = a.chars
+    L = b.max_len
+    if L == 0:
+        return _col(ret, b.lengths == 0, a, b)
+    if L > chars.shape[1]:
+        chars = jnp.pad(chars, ((0, 0), (0, L - chars.shape[1])))
+    w = chars.shape[1]
+    starts = jnp.clip(a.lengths - b.lengths, 0, w - 1)
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    idx = jnp.clip(starts[:, None] + pos, 0, w - 1)
+    window = jnp.take_along_axis(chars, idx, axis=1)
+    cmp = (window == b.chars[:, :L]) | (pos >= b.lengths[:, None])
+    v = jnp.all(cmp, axis=1) & (b.lengths <= a.lengths)
+    return _col(ret, v, a, b)
+
+
+@register("array_position")
+def _array_position(ret, a, x: Column):
+    """1-based index of the first element equal to x; 0 if absent."""
+    from ..block import ArrayColumn
+    assert isinstance(a, ArrayColumn)
+    lanes = jnp.arange(a.max_cardinality, dtype=jnp.int64)[None, :]
+    in_range = lanes < a.lengths[:, None]
+    hit = in_range & ~a.elem_nulls & (a.elements == x.values[:, None])
+    has = jnp.any(hit, axis=1)
+    first = jnp.argmax(hit, axis=1).astype(jnp.int64)
+    return _col(ret, jnp.where(has, first + 1, 0), a, x)
+
+
+@register("array_sum")
+def _array_sum(ret, a):
+    from ..block import ArrayColumn
+    assert isinstance(a, ArrayColumn)
+    lanes = jnp.arange(a.max_cardinality, dtype=jnp.int64)[None, :]
+    live = (lanes < a.lengths[:, None]) & ~a.elem_nulls
+    dt = jnp.float64 if ret.is_floating else jnp.int64
+    s = jnp.sum(jnp.where(live, a.elements.astype(dt), dt(0)), axis=1)
+    return _col(ret, s, a)

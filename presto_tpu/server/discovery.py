@@ -84,15 +84,22 @@ class _Handler(BaseHTTPRequestHandler):
 
 class DiscoveryServer:
     def __init__(self, port: int = 0,
-                 shared_secret: Optional[str] = None):
+                 shared_secret: Optional[str] = None,
+                 tls: Optional[tuple] = None):
         from .auth import make_authenticator
         handler = type("BoundDiscovery", (_Handler,),
                        {"nodes": {}, "lock": threading.Lock(),
                         "authenticator": make_authenticator(
                             shared_secret, "discovery")})
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        scheme = "http"
+        if tls is not None:
+            from .tls import server_context
+            self.httpd.socket = server_context(*tls).wrap_socket(
+                self.httpd.socket, server_side=True)
+            scheme = "https"
         self.port = self.httpd.server_address[1]
-        self.url = f"http://127.0.0.1:{self.port}"
+        self.url = f"{scheme}://127.0.0.1:{self.port}"
 
     def start(self):
         threading.Thread(target=self.httpd.serve_forever, daemon=True).start()

@@ -121,7 +121,8 @@ class StatementServer:
                  dispatcher: Optional[Dispatcher] = None,
                  executor=None, page_rows: int = 1024,
                  queue_poll_s: float = 1.0,
-                 query_ttl_s: float = 600.0):
+                 query_ttl_s: float = 600.0,
+                 tls: Optional[tuple] = None):
         self.sf = sf
         self.page_rows = page_rows
         self.queue_poll_s = queue_poll_s
@@ -133,8 +134,14 @@ class StatementServer:
         self._qlock = threading.Lock()
         handler = _make_handler(self)
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        scheme = "http"
+        if tls is not None:
+            from .tls import server_context
+            self._httpd.socket = server_context(*tls).wrap_socket(
+                self._httpd.socket, server_side=True)
+            scheme = "https"
         self.port = self._httpd.server_address[1]
-        self.url = f"http://127.0.0.1:{self.port}"
+        self.url = f"{scheme}://127.0.0.1:{self.port}"
         self._thread: Optional[threading.Thread] = None
 
     # -- lifecycle ------------------------------------------------------
@@ -383,6 +390,43 @@ class StatementServer:
         return [self.admin_doc(i) for i in ids]
 
 
+def _render_ui(server: "StatementServer", parts: List[str]) -> str:
+    """Minimal coordinator UI (presto-ui's QueryList/QueryDetail pages,
+    server-rendered): /ui lists queries, /ui/query/<id> shows one."""
+    import html as H
+
+    style = ("<style>body{font-family:monospace;margin:2em}"
+             "table{border-collapse:collapse}"
+             "td,th{border:1px solid #999;padding:4px 8px;text-align:left}"
+             "th{background:#eee}.FINISHED{color:#080}"
+             ".FAILED{color:#b00}.RUNNING{color:#06c}</style>")
+    if len(parts) == 2 and parts[0] == "query":
+        doc = server.admin_doc(parts[1])
+        if doc is None:
+            return f"{style}<h2>query {H.escape(parts[1])} not found</h2>"
+        rows = "".join(
+            f"<tr><th>{H.escape(str(k))}</th>"
+            f"<td><pre>{H.escape(json.dumps(v, indent=1, default=str))}"
+            f"</pre></td></tr>" for k, v in doc.items())
+        return (f"{style}<h2>query {H.escape(parts[1])}</h2>"
+                f"<p><a href='/ui'>&larr; queries</a></p>"
+                f"<table>{rows}</table>")
+    docs = sorted(server.queries_doc(),
+                  key=lambda d: d.get("timings", {}).get("QUEUED", 0),
+                  reverse=True)
+    rows = "".join(
+        f"<tr><td><a href='/ui/query/{H.escape(d['queryId'])}'>"
+        f"{H.escape(d['queryId'])}</a></td>"
+        f"<td class='{H.escape(d['state'])}'>{H.escape(d['state'])}</td>"
+        f"<td>{H.escape(d['user'])}</td>"
+        f"<td>{d.get('elapsedTimeMillis', 0)} ms</td>"
+        f"<td>{H.escape(d['query'][:120])}</td></tr>" for d in docs)
+    return (f"{style}<h2>presto-tpu coordinator</h2>"
+            f"<p>{len(docs)} queries (TTL {server.query_ttl_s:.0f}s)</p>"
+            f"<table><tr><th>query</th><th>state</th><th>user</th>"
+            f"<th>elapsed</th><th>sql</th></tr>{rows}</table>")
+
+
 def _parse_session_header(value: str) -> Dict[str, str]:
     out = {}
     for part in value.split(","):
@@ -472,7 +516,18 @@ def _make_handler(server: StatementServer):
                             "coordinator": True, "starting": False,
                             "uptime": "0m"})
                 return
+            if parts[:1] == ["ui"]:
+                self._send_html(_render_ui(server, parts[1:]))
+                return
             self._send({"error": "not found"}, 404)
+
+        def _send_html(self, html: str, code: int = 200):
+            body = html.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
 
         def do_DELETE(self):  # noqa: N802
             parts = [p for p in self.path.split("/") if p]

@@ -701,7 +701,8 @@ class TpuWorkerServer:
                  discovery_url: Optional[str] = None,
                  announce_interval_s: float = 1.0,
                  shared_secret: Optional[str] = None,
-                 task_concurrency: int = 4):
+                 task_concurrency: int = 4,
+                 tls: Optional[tuple] = None):
         from .auth import make_authenticator
         self.manager = TaskManager(sf=sf, mesh=mesh,
                                    task_concurrency=task_concurrency)
@@ -711,14 +712,22 @@ class TpuWorkerServer:
             "manager": self.manager, "node_id": self.node_id,
             "started_at": time.time(), "authenticator": auth})
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        scheme = "http"
+        if tls is not None:
+            # https internal transport (internal-communication.https
+            # mode; the JWT layer still authenticates peers)
+            from .tls import server_context
+            self.httpd.socket = server_context(*tls).wrap_socket(
+                self.httpd.socket, server_side=True)
+            scheme = "https"
         self.port = self.httpd.server_address[1]
+        self.url = f"{scheme}://127.0.0.1:{self.port}"
         self._thread: Optional[threading.Thread] = None
         self._announcer = None
         if discovery_url:
             from .discovery import Announcer
             self._announcer = Announcer(
-                discovery_url, self.node_id,
-                f"http://127.0.0.1:{self.port}",
+                discovery_url, self.node_id, self.url,
                 interval_s=announce_interval_s,
                 shared_secret=shared_secret)
 

@@ -251,8 +251,25 @@ class _Analyzer:
             width = sum(a.type.max_length if a.type.is_string else 8
                         for a in args)
             return T.varchar(width)
-        if name in ("sqrt", "exp", "ln", "log10", "power", "pow"):
+        if name in ("sqrt", "exp", "ln", "log10", "power", "pow",
+                    "sin", "cos", "tan", "asin", "acos", "atan", "atan2",
+                    "sinh", "cosh", "tanh", "cbrt", "log2", "log",
+                    "degrees", "radians", "to_unixtime"):
             return T.DOUBLE
+        if name in ("is_nan", "is_finite", "is_infinite", "ends_with"):
+            return T.BOOLEAN
+        if name in ("bitwise_and", "bitwise_or", "bitwise_xor",
+                    "bitwise_not", "bitwise_left_shift",
+                    "bitwise_right_shift", "bitwise_right_shift_arithmetic",
+                    "bit_count", "array_position"):
+            return T.BIGINT
+        if name == "array_sum":
+            ety = args[0].type.element_type
+            return T.DOUBLE if ety.is_floating else T.BIGINT
+        if name == "mod":
+            return args[0].type
+        if name == "from_unixtime":
+            return T.TIMESTAMP
         if name in ("abs", "negate", "floor", "ceil", "ceiling", "round",
                     "truncate", "greatest", "least"):
             return args[0].type

@@ -1,0 +1,79 @@
+"""Query router: weighted scheduling + plan-checker fallback routing
+(presto-router / plan-checker-router-plugin analogs)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from presto_tpu.client import QueryError, execute
+from presto_tpu.server.router import RouterServer
+from presto_tpu.server.statement import StatementServer
+
+SF = 0.01
+
+
+def test_round_robin_across_clusters():
+    with StatementServer(sf=SF) as a, StatementServer(sf=SF) as b:
+        with RouterServer([{"url": a.url}, {"url": b.url}]) as r:
+            for _ in range(4):
+                c = execute(r.url, "SELECT count(*) AS n FROM region",
+                            session={"sf": str(SF)})
+                assert c.data == [[5]]
+            # both clusters served some statements
+            served_a = len(a.queries_doc())
+            served_b = len(b.queries_doc())
+            assert served_a >= 1 and served_b >= 1
+            assert served_a + served_b == 4
+
+
+def test_plan_checker_routes_to_fallback():
+    """A statement the TPU engine cannot plan goes to the fallback
+    cluster; plannable statements go to the primary."""
+    with StatementServer(sf=SF) as primary, \
+            StatementServer(sf=SF) as fallback:
+        # the fallback 'row engine' here is just another server whose
+        # executor answers anything (test double for a Java cluster)
+        def always_ok(text, sess, qid, tid):
+            from presto_tpu.sql import sql
+            return sql("SELECT count(*) AS n FROM region", sf=SF)
+
+        fallback._executor = always_ok
+        with RouterServer([{"url": primary.url},
+                           {"url": fallback.url,
+                            "kind": "fallback"}]) as r:
+            execute(r.url, "SELECT count(*) AS n FROM nation",
+                    session={"sf": str(SF)})
+            assert len(primary.queries_doc()) == 1
+            assert len(fallback.queries_doc()) == 0
+            # MERGE is not in the engine's SQL surface: planner dry-run
+            # fails -> fallback cluster takes it
+            execute(r.url, "MERGE INTO t USING u ON t.x = u.x "
+                           "WHEN MATCHED THEN DELETE")
+            assert len(primary.queries_doc()) == 1
+            assert len(fallback.queries_doc()) == 1
+
+
+def test_unhealthy_cluster_excluded():
+    with StatementServer(sf=SF) as a:
+        clusters = [{"url": a.url},
+                    {"url": "http://127.0.0.1:1"}]  # nothing listens
+        with RouterServer(clusters, health_ttl_s=0.0) as r:
+            for _ in range(3):
+                c = execute(r.url, "SELECT count(*) AS n FROM region",
+                            session={"sf": str(SF)})
+                assert c.data == [[5]]
+            assert len(a.queries_doc()) == 3
+            with urllib.request.urlopen(f"{r.url}/v1/info") as resp:
+                info = json.loads(resp.read())
+            health = {c["url"]: c["healthy"] for c in info["clusters"]}
+            assert health[a.url] is True
+            assert health["http://127.0.0.1:1"] is False
+
+
+def test_no_cluster_available():
+    with RouterServer([{"url": "http://127.0.0.1:1"}],
+                      health_ttl_s=0.0) as r:
+        with pytest.raises(QueryError) as ei:
+            execute(r.url, "SELECT count(*) AS n FROM region")
+        assert ei.value.error_name == "NO_CLUSTER_AVAILABLE"

@@ -125,3 +125,94 @@ def test_explain_renders():
     assert "TableScan[tpch.lineitem" in text and "RemoteExchange[GATHER]" in text
     dist = explain_distributed(root)
     assert "Fragment 0" in dist and "Fragment 1" in dist
+
+
+def test_round4_math_and_bitwise():
+    import math
+    from presto_tpu.sql import sql
+    r = sql("SELECT sin(1.0) AS s, log2(8.0) AS l, cbrt(27.0) AS c, "
+            "degrees(3.141592653589793) AS d, atan2(1.0, 1.0) AS a, "
+            "log(3.0, 81.0) AS lg, is_nan(0.0) AS nn "
+            "FROM region LIMIT 1").rows()[0]
+    assert abs(r[0] - math.sin(1.0)) < 1e-12
+    assert r[1] == 3.0 and abs(r[2] - 3.0) < 1e-12
+    assert abs(r[3] - 180.0) < 1e-9
+    assert abs(r[4] - math.atan2(1, 1)) < 1e-12
+    assert abs(r[5] - 4.0) < 1e-12
+    assert not r[6]  # numpy bool
+
+    b = sql("SELECT bitwise_and(regionkey, 1) AS a, "
+            "bitwise_or(regionkey, 8) AS o, "
+            "bitwise_left_shift(regionkey, 2) AS sh, "
+            "bit_count(regionkey) AS bc "
+            "FROM region ORDER BY regionkey").rows()
+    assert [x[0] for x in b] == [0, 1, 0, 1, 0]
+    assert [x[1] for x in b] == [8, 9, 10, 11, 12]
+    assert [x[2] for x in b] == [0, 4, 8, 12, 16]
+    assert [x[3] for x in b] == [0, 1, 1, 2, 1]
+
+
+def test_round4_ends_with_and_unixtime():
+    from presto_tpu.sql import sql
+    r = sql("SELECT count(*) AS n FROM region "
+            "WHERE ends_with(name, 'ICA')").rows()
+    assert r[0][0] == 2  # AMERICA, AFRICA
+    t = sql("SELECT to_unixtime(from_unixtime(1500000000)) AS u "
+            "FROM region LIMIT 1").rows()[0][0]
+    assert abs(t - 1500000000.0) < 1e-6
+
+
+def test_round4_array_functions():
+    import numpy as np
+    from presto_tpu import types as T
+    from presto_tpu.block import Batch, from_numpy, to_numpy
+    from presto_tpu.expr import call, compile_projections, const, input_ref
+    import jax.numpy as jnp
+    ARR = T.array_of(T.BIGINT)
+    col = from_numpy(ARR, np.array([[10, 20, 30], [5, None], []],
+                                   dtype=object))
+    b = Batch((col,), jnp.ones(3, dtype=bool))
+    x = input_ref(0, ARR)
+    proj = compile_projections([
+        call("array_position", T.BIGINT, x, const(20, T.BIGINT)),
+        call("array_sum", T.BIGINT, x)])
+    out = proj(b)
+    pos, _ = to_numpy(out.column(0))
+    s, _ = to_numpy(out.column(1))
+    assert list(pos) == [2, 0, 0]
+    assert list(s) == [60, 5, 0]
+
+
+def test_round4_review_regressions():
+    """Shift-mod-64 Java semantics, wide-needle ends_with, float
+    array_sum."""
+    import numpy as np
+    import jax.numpy as jnp
+    from presto_tpu.sql import sql
+    from presto_tpu.block import Batch, from_numpy, to_numpy
+    from presto_tpu.expr import call, compile_projections, input_ref
+
+    r = sql("SELECT bitwise_left_shift(regionkey + 1, 64) AS a, "
+            "bitwise_left_shift(regionkey + 1, 65) AS b "
+            "FROM region ORDER BY regionkey LIMIT 1").rows()[0]
+    assert r == (1, 2)  # Java masks shift & 63
+
+    # needle column wider than haystack column
+    a = from_numpy(T.varchar(4), np.array(["ABX", "ZZZZ", "X"],
+                                          dtype=object))
+    b = from_numpy(T.varchar(10), np.array(["X", "ZZZZZZZZZ", "X"],
+                                           dtype=object))
+    bt = Batch((a, b), jnp.ones(3, dtype=bool))
+    out = compile_projections([call("ends_with", T.BOOLEAN,
+                                    input_ref(0, T.varchar(4)),
+                                    input_ref(1, T.varchar(10)))])(bt)
+    v, _ = to_numpy(out.column(0))
+    assert list(v) == [True, False, True]
+
+    ARRD = T.array_of(T.DOUBLE)
+    col = from_numpy(ARRD, np.array([[1.5, 2.5]], dtype=object))
+    bt2 = Batch((col,), jnp.ones(1, dtype=bool))
+    out2 = compile_projections([call("array_sum", T.DOUBLE,
+                                     input_ref(0, ARRD))])(bt2)
+    s, _ = to_numpy(out2.column(0))
+    assert s[0] == 4.0

@@ -182,3 +182,16 @@ def test_remote_explain(server):
     assert client.columns == [{"name": "Query Plan", "type": "varchar"}]
     text = "\n".join(r[0] for r in client.data)
     assert "Aggregate" in text or "TableScan" in text
+
+
+def test_web_ui_pages(server):
+    client = execute(server.url, "SELECT count(*) AS n FROM region",
+                     session={"sf": str(SF)})
+    with urllib.request.urlopen(f"{server.url}/ui") as r:
+        page = r.read().decode()
+    assert "presto-tpu coordinator" in page
+    assert client.query_id in page
+    with urllib.request.urlopen(
+            f"{server.url}/ui/query/{client.query_id}") as r:
+        detail = r.read().decode()
+    assert "FINISHED" in detail and "region" in detail

@@ -1,0 +1,72 @@
+"""TLS on internal communication (internal-communication.https mode):
+every server socket wraps in TLS, clients verify against the cluster
+CA, and the JWT layer keeps authenticating on top."""
+
+import urllib.error
+import urllib.request
+
+import pytest
+
+from presto_tpu.server import Coordinator, TpuWorkerServer, WorkerClient
+from presto_tpu.server.discovery import DiscoveryServer, alive_nodes
+from presto_tpu.server.statement import StatementServer
+from presto_tpu.server import tls as tlsmod
+from presto_tpu.sql import plan_sql
+
+SECRET = "tls-test-secret"
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("certs")
+    cert, key = tlsmod.generate_self_signed(str(d))
+    tlsmod.trust(cert)
+    yield cert, key
+    tlsmod.clear_trust()
+
+
+def test_tls_cluster_end_to_end(certs):
+    disc = DiscoveryServer(shared_secret=SECRET, tls=certs).start()
+    w = TpuWorkerServer(sf=0.01, discovery_url=disc.url,
+                        shared_secret=SECRET, tls=certs).start()
+    try:
+        assert disc.url.startswith("https://")
+        assert w.url.startswith("https://")
+        import time
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if alive_nodes(disc.url, shared_secret=SECRET):
+                break
+            time.sleep(0.1)
+        nodes = alive_nodes(disc.url, shared_secret=SECRET)
+        assert nodes and nodes[0]["uri"].startswith("https://")
+
+        # run a task over https through the coordinator
+        from presto_tpu.server.auth import set_shared_secret
+        set_shared_secret(SECRET)
+        try:
+            coord = Coordinator(discovery_url=disc.url)
+            plan = plan_sql("SELECT count(*) AS n FROM nation")
+            cols, _ = coord.execute(plan, sf=0.01, timeout=30.0)
+            assert int(cols[0][0][0]) == 25
+        finally:
+            set_shared_secret(None)
+    finally:
+        w.stop()
+        disc.stop()
+
+
+def test_tls_statement_protocol(certs):
+    from presto_tpu.client import execute
+    with StatementServer(sf=0.01, tls=certs) as s:
+        assert s.url.startswith("https://")
+        c = execute(s.url, "SELECT count(*) AS n FROM region",
+                    session={"sf": "0.01"})
+        assert c.data == [[5]]
+
+
+def test_plain_http_rejected_by_tls_server(certs):
+    with StatementServer(sf=0.01, tls=certs) as s:
+        plain = s.url.replace("https://", "http://")
+        with pytest.raises(Exception):
+            urllib.request.urlopen(f"{plain}/v1/info", timeout=5)
