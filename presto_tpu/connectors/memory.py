@@ -242,6 +242,8 @@ def append(handle: str, columns: Sequence[np.ndarray],
 def finish_insert(handle: str) -> int:
     """Atomic publish of every staged chunk; returns rows written."""
     with _lock:
+        table = _pending[handle]["table"]
+    with write_lock(table), _lock:
         st = _pending.pop(handle)
         t = _tables[st["table"]]
         rows = 0
@@ -278,3 +280,39 @@ def abort_insert(handle: str) -> None:
 def data_version(table: str) -> int:
     """Fragment-result-cache seam (alias of table_version)."""
     return table_version(table)
+
+
+def replace_table(name: str, columns: Sequence[np.ndarray],
+                  nulls: Sequence[np.ndarray]) -> int:
+    """Atomically swap a table's contents (DELETE/UPDATE rewrite sink).
+    Returns the OLD row count."""
+    with _lock:
+        t = _tables[name]
+        if len(columns) != len(t.columns):
+            raise ValueError(
+                f"rewrite arity {len(columns)} != table arity "
+                f"{len(t.columns)}")
+        old = t.row_count
+        for i in range(len(t.columns)):
+            if t.values[i].dtype == object:
+                t.values[i] = _to_object(columns[i])
+            else:
+                t.values[i] = np.asarray(columns[i],
+                                         dtype=t.values[i].dtype)
+            t.nulls[i] = np.asarray(nulls[i], dtype=bool)
+        _bump_version(name)
+        return old
+
+
+_write_locks: Dict[str, threading.Lock] = {}
+
+
+def write_lock(name: str) -> threading.Lock:
+    """Per-table writer mutex: DML rewrites hold it across their whole
+    read-compute-swap so committed concurrent inserts can't vanish
+    under the replace; inserts take it around their publish."""
+    with _lock:
+        lk = _write_locks.get(name)
+        if lk is None:
+            lk = _write_locks[name] = threading.Lock()
+        return lk

@@ -113,7 +113,9 @@ def compile_plan(root: N.PlanNode, mesh=None,
             return compile_projections(node.expressions)(lower(node.source, inputs))
         if isinstance(node, N.AggregationNode):
             src = lower(node.source, inputs)
-            if node.step == "FINAL":
+            if node.step in ("FINAL", "INTERMEDIATE"):
+                # both consume state tables; INTERMEDIATE re-emits
+                # merged states (no finalization) for a further merge
                 r = merge_partials(src, len(node.group_channels),
                                    node.aggregates, node.max_groups)
             else:  # SINGLE and PARTIAL share the kernel

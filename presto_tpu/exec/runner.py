@@ -123,7 +123,7 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     # host effect, fed by one DMA-out of the computed rows)
     inner_root = root.source if isinstance(root, N.OutputNode) else root
     if isinstance(inner_root, (N.DdlNode, N.TableFinishNode,
-                               N.TableWriterNode)):
+                               N.TableWriterNode, N.TableRewriteNode)):
         return _run_write_root(
             inner_root, sf=sf, mesh=mesh, capacity_hints=capacity_hints,
             default_join_capacity=default_join_capacity,
@@ -403,6 +403,28 @@ def _run_write_root(node: N.PlanNode, **kw) -> QueryResult:
         res = QueryResult([np.array([True])], [np.array([False])],
                           ["result"], 1, types=[T.BOOLEAN])
         return res
+
+    if isinstance(node, N.TableRewriteNode):
+        # DELETE/UPDATE: compute new contents + `changed` flags on
+        # device, swap the table host-side, report affected rows. The
+        # whole read-compute-swap holds the table's writer lock so a
+        # concurrent committed INSERT cannot vanish under the swap.
+        mod = catalog(node.connector)
+        with mod.write_lock(node.table):
+            res = run_query(N.OutputNode(node.source, []), **kw)
+            ncols = len(res.columns) - 1
+            changed = np.asarray(res.columns[-1]).astype(bool) & \
+                ~np.asarray(res.nulls[-1], dtype=bool)
+            affected = int(changed.sum())
+            if node.kind == "delete":
+                keep = ~changed
+                cols = [c[keep] for c in res.columns[:ncols]]
+                nulls = [n[keep] for n in res.nulls[:ncols]]
+            else:
+                cols = list(res.columns[:ncols])
+                nulls = list(res.nulls[:ncols])
+            mod.replace_table(node.table, cols, nulls)
+        return _count_result(affected)
 
     if isinstance(node, N.TableWriterNode):
         res = run_query(N.OutputNode(node.source, node.column_names), **kw)

@@ -31,7 +31,8 @@ __all__ = ["PlanNode", "TableScanNode", "ValuesNode", "FilterNode",
            "ProjectNode", "AggregationNode", "JoinNode", "SemiJoinNode",
            "SortNode", "TopNNode", "LimitNode", "DistinctNode",
            "ExchangeNode", "OutputNode", "TableWriterNode",
-           "TableFinishNode", "DdlNode", "to_json", "from_json"]
+           "TableFinishNode", "TableRewriteNode", "DdlNode",
+           "to_json", "from_json"]
 
 
 _next_id = [0]
@@ -128,6 +129,11 @@ class AggregationNode(PlanNode):
 
     def output_types(self):
         src = self.source.output_types()
+        if self.step == "INTERMEDIATE":
+            # merge of state tables re-emits the SAME state layout (the
+            # source is already keys + states; input_channel indexes the
+            # raw-row world and must not be consulted here)
+            return list(src)
         out = [src[c] for c in self.group_channels]
         if self.step in ("SINGLE", "FINAL"):
             # finalized steps emit exactly one column per aggregate
@@ -424,6 +430,27 @@ class DdlNode(PlanNode):
 
 
 @dataclasses.dataclass
+class TableRewriteNode(PlanNode):
+    """DELETE/UPDATE as a table rewrite (spi/plan DeleteNode/UpdateNode
+    analog for in-memory storage): `source` yields the table's columns
+    plus a trailing BOOLEAN `changed` column; delete drops changed rows,
+    update keeps every row (with changed rows already projected to their
+    new values). Executes host-side like the other write roots; output
+    is one BIGINT -- affected rows."""
+    source: PlanNode
+    connector: str
+    table: str
+    kind: str = "delete"  # delete | update
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_types(self):
+        return [T.BIGINT]
+
+
+@dataclasses.dataclass
 class TableWriterNode(PlanNode):
     """Write source rows into a connector table
     (spi/plan/TableWriterNode + operator/TableWriterOperator.java:76
@@ -614,6 +641,9 @@ def to_json(n: PlanNode) -> dict:
         return {**base, "@type": "ddl", "op": n.op,
                 "connector": n.connector, "table": n.table,
                 "ifExists": n.if_exists}
+    if isinstance(n, TableRewriteNode):
+        return {**base, "@type": "tablerewrite", "source": to_json(n.source),
+                "connector": n.connector, "table": n.table, "kind": n.kind}
     if isinstance(n, TableWriterNode):
         return {**base, "@type": "tablewriter", "source": to_json(n.source),
                 "connector": n.connector, "table": n.table,
@@ -704,6 +734,9 @@ def from_json(j: dict) -> PlanNode:
     if t == "ddl":
         return DdlNode(j["op"], j["connector"], j["table"],
                        j.get("ifExists", False), **kw)
+    if t == "tablerewrite":
+        return TableRewriteNode(from_json(j["source"]), j["connector"],
+                                j["table"], j["kind"], **kw)
     if t == "tablewriter":
         return TableWriterNode(from_json(j["source"]), j["connector"],
                                j["table"], j["columnNames"],

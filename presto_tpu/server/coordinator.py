@@ -404,7 +404,8 @@ def _contains_global_agg(node: N.PlanNode) -> bool:
 
 
 def _contains_commit(node: N.PlanNode) -> bool:
-    if isinstance(node, (N.TableFinishNode, N.DdlNode)):
+    if isinstance(node, (N.TableFinishNode, N.DdlNode,
+                         N.TableRewriteNode)):
         return True
     return any(_contains_commit(s) for s in node.sources)
 

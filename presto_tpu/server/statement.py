@@ -240,19 +240,25 @@ class StatementServer:
         q.machine.to_running()
         if q.txn_id is not None:
             self.transactions.get(q.txn_id)  # validates + touches
+            if re.match(r"\s*(insert|create\s+table|drop\s+table|delete|"
+                        r"update)\b", q.text, re.IGNORECASE):
+                # checkConnectorWrite: writes refuse READ ONLY txns
+                self.transactions.access_check_write(q.txn_id, "memory")
             res = self._executor(q.text, q.session_values, q.id, q.txn_id)
         else:
             res = self.transactions.run_autocommit(
                 lambda tid: self._executor(q.text, q.session_values, q.id,
                                            tid))
         q.machine.to_finishing()
-        wm = re.match(r"\s*(insert|create\s+table|drop\s+table)\b",
-                      q.text, re.IGNORECASE)
+        wm = re.match(r"\s*(insert|create\s+table|drop\s+table|delete|"
+                      r"update)\b", q.text, re.IGNORECASE)
         if wm:
             kind = " ".join(wm.group(1).upper().split())
             q.update_type = {"INSERT": "INSERT",
                              "CREATE TABLE": "CREATE TABLE AS",
-                             "DROP TABLE": "DROP TABLE"}[kind]
+                             "DROP TABLE": "DROP TABLE",
+                             "DELETE": "DELETE",
+                             "UPDATE": "UPDATE"}[kind]
             if res.types and res.types[0].base == "bigint" and \
                     res.row_count == 1:
                 q.update_count = int(res.columns[0][0])

@@ -100,7 +100,8 @@ class FragmentResultCache:
 
         def walk(n):
             if isinstance(n, (N.RemoteSourceNode, N.TableWriterNode,
-                              N.TableFinishNode, N.DdlNode)):
+                              N.TableFinishNode, N.TableRewriteNode,
+                              N.DdlNode)):
                 # remote inputs aren't pure; writes/DDL are SIDE EFFECTS
                 # a replayed page must never skip
                 scans.append(None)

@@ -223,6 +223,22 @@ class DropTable:
 
 
 @dataclasses.dataclass
+class Delete:
+    """DELETE FROM t [WHERE p]."""
+    table: str
+    where: object = None
+
+
+@dataclasses.dataclass
+class Update:
+    """UPDATE t SET c = e [, ...] [WHERE p]."""
+    table: str
+    assignments: List[Tuple[str, object]] = dataclasses.field(
+        default_factory=list)
+    where: object = None
+
+
+@dataclasses.dataclass
 class SetQuery:
     """UNION / INTERSECT / EXCEPT of two query terms."""
     op: str                 # "union" | "intersect" | "except"
@@ -800,7 +816,8 @@ class _Parser:
 def parse_sql(text: str):
     p = _Parser(_tokenize(text))
     k, v = p.peek()
-    if k == "ident" and v.lower() in ("insert", "create", "drop"):
+    if k == "ident" and v.lower() in ("insert", "create", "drop",
+                                      "delete", "update"):
         return _parse_dml(p, v.lower())
     ctes = {}
     if p.accept_kw("with"):
@@ -893,6 +910,33 @@ def _parse_dml(p: "_Parser", first: str):
         if k != "eof":
             raise ValueError(f"trailing tokens at {p.peek()}")
         return CreateTableAs(table, q, if_not_exists)
+    if first == "delete":
+        p.expect_kw("from")
+        table = qualified_name()
+        where = None
+        if p.accept_kw("where"):
+            where = p.expr()
+        k, _ = p.peek()
+        if k != "eof":
+            raise ValueError(f"trailing tokens at {p.peek()}")
+        return Delete(table, where)
+    if first == "update":
+        table = qualified_name()
+        expect_ctx("set")
+        assignments = []
+        while True:
+            col = p.expect_ident().lower()
+            p.expect_op("=")
+            assignments.append((col, p.expr()))
+            if not p.accept_op(","):
+                break
+        where = None
+        if p.accept_kw("where"):
+            where = p.expr()
+        k, _ = p.peek()
+        if k != "eof":
+            raise ValueError(f"trailing tokens at {p.peek()}")
+        return Update(table, assignments, where)
     # DROP TABLE [IF EXISTS] t
     expect_ctx("table")
     if_exists = False

@@ -396,7 +396,8 @@ def plan_sql(query_text: str, max_groups: int = 1 << 16,
         token = _SEARCH_PATH.set(path)
     cache_token = _SUBPLAN_CACHE.set({})
     try:
-        if isinstance(ast, (P.Insert, P.CreateTableAs, P.DropTable)):
+        if isinstance(ast, (P.Insert, P.CreateTableAs, P.DropTable,
+                            P.Delete, P.Update)):
             return _plan_write(ast, max_groups, join_capacity)
         node, names = _plan_any(ast, max_groups, join_capacity)
     finally:
@@ -432,6 +433,58 @@ def _plan_write(ast, max_groups: int, join_capacity):
         conn, table = _writable_target(ast.table)
         return N.OutputNode(N.DdlNode("drop_table", conn, table,
                                       ast.if_exists), ["result"])
+
+    if isinstance(ast, (P.Delete, P.Update)):
+        # DELETE/UPDATE as table rewrites: the source computes the
+        # table's columns + a trailing BOOLEAN `changed`
+        # (NULL predicate = not changed, SQL's WHERE semantics)
+        conn, table = _writable_target(ast.table)
+        try:
+            schema = get_catalog(conn).SCHEMA[table]
+        except KeyError:
+            raise KeyError(f"memory table {table!r} does not exist") \
+                from None
+        cols = list(schema)
+        tys = [schema[c] for c in cols]
+        scan = N.TableScanNode(conn, table, cols, tys)
+        bare = table
+        chans = {}
+        for i, c in enumerate(cols):
+            chans[c] = i
+            chans[f"{bare}.{c}"] = i
+            chans[f"{conn}.{bare}.{c}"] = i
+        scope = _Scope(chans, tys)
+        an = _Analyzer(None)
+        if ast.where is None:
+            changed = E.const(True, T.BOOLEAN)
+        else:
+            p = an.lower(ast.where, scope)
+            changed = E.special("COALESCE", T.BOOLEAN, p,
+                                E.const(False, T.BOOLEAN))
+        if isinstance(ast, P.Delete):
+            exprs = [E.input_ref(i, tys[i]) for i in range(len(cols))]
+        else:
+            assigns = {}
+            for c, e in ast.assignments:
+                if c not in schema:
+                    raise KeyError(f"column {c!r} not in table {table!r}")
+                ne = an.lower(e, scope)
+                if ne.type != schema[c]:
+                    ne = E.call("cast", schema[c], ne)
+                assigns[c] = ne
+            exprs = []
+            for i, c in enumerate(cols):
+                old = E.input_ref(i, tys[i])
+                if c in assigns:
+                    exprs.append(E.special("IF", tys[i], changed,
+                                           assigns[c], old))
+                else:
+                    exprs.append(old)
+        proj = N.ProjectNode(scan, exprs + [changed])
+        node = N.TableRewriteNode(
+            proj, conn, table,
+            "delete" if isinstance(ast, P.Delete) else "update")
+        return N.OutputNode(node, ["rows"])
 
     if isinstance(ast, P.CreateTableAs):
         conn, table = _writable_target(ast.table)

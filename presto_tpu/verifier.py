@@ -162,3 +162,19 @@ TPCDS_CORPUS = [
     "GROUP BY dt.d_year, item.i_brand_id "
     "ORDER BY dt.d_year, s DESC, item.i_brand_id",
 ]
+
+
+def check_plan_determinism(corpus: Sequence[str], repeats: int = 3
+                           ) -> List[str]:
+    """PlanDeterminismChecker analog: plan each query `repeats` times
+    and diff the structural fingerprints (node ids excluded). Returns
+    the queries whose plans drifted -- an empty list is the pass."""
+    from .exec.plan_cache import plan_fingerprint
+    from .sql import plan_sql
+
+    drifted = []
+    for q in corpus:
+        fps = {plan_fingerprint(plan_sql(q)) for _ in range(repeats)}
+        if len(fps) != 1:
+            drifted.append(q)
+    return drifted

@@ -25,3 +25,44 @@ def test_corpus_consistent_on_http_cluster():
     finally:
         for w in workers:
             w.stop()
+
+
+def test_plan_determinism_over_corpus():
+    """PlanDeterminismChecker analog: the whole default corpus plans to
+    the same structural fingerprint every time."""
+    from presto_tpu.verifier import DEFAULT_CORPUS, check_plan_determinism
+    drifted = check_plan_determinism(DEFAULT_CORPUS, repeats=3)
+    assert drifted == []
+
+
+def test_intermediate_aggregation_step():
+    """PARTIAL -> INTERMEDIATE -> FINAL three-level aggregation merges
+    states exactly (AggregationNode.Step.INTERMEDIATE)."""
+    import numpy as np
+    from presto_tpu import types as T
+    from presto_tpu.block import concat_batches
+    from presto_tpu.connectors import tpch
+    from presto_tpu.exec import run_query
+    from presto_tpu.ops.aggregation import AggSpec
+    from presto_tpu.plan import nodes as N
+
+    cols = ["custkey", "totalprice"]
+    scan = N.TableScanNode("tpch", "orders", cols,
+                           [tpch.column_type("orders", c) for c in cols])
+    spec = [AggSpec("sum", 1, T.decimal(38, 2)),
+            AggSpec("avg", 1, T.decimal(38, 2)),
+            AggSpec("count_star", None, T.BIGINT)]
+    part = N.AggregationNode(scan, [0], spec, step="PARTIAL",
+                             max_groups=1 << 11)
+    inter = N.AggregationNode(part, [0], spec, step="INTERMEDIATE",
+                              max_groups=1 << 11)
+    fin = N.AggregationNode(inter, [0], spec, step="FINAL",
+                            max_groups=1 << 11)
+    got = run_query(N.OutputNode(fin, ["k", "s", "a", "c"]), sf=0.01)
+
+    single = N.AggregationNode(
+        N.TableScanNode("tpch", "orders", cols,
+                        [tpch.column_type("orders", c) for c in cols]),
+        [0], spec, step="SINGLE", max_groups=1 << 11)
+    want = run_query(N.OutputNode(single, ["k", "s", "a", "c"]), sf=0.01)
+    assert sorted(got.rows()) == sorted(want.rows())

@@ -145,3 +145,62 @@ def test_statement_protocol_insert():
         assert cur.fetchall() == [(1, "a"), (2, "b")]
         conn.close()
     assert memory.table_row_count("s") == 2
+
+
+def test_delete_where():
+    memory.create_table("dl", ["x", "y"], [T.BIGINT, T.varchar(4)])
+    sql("INSERT INTO memory.dl VALUES (1,'a'), (2,'b'), (3,'c'), (4,'d')")
+    res = sql("DELETE FROM memory.dl WHERE x > 2")
+    assert res.rows() == [(2,)]
+    left = sql("SELECT x, y FROM dl ORDER BY x", catalog="memory")
+    assert left.rows() == [(1, "a"), (2, "b")]
+    # NULL predicate rows are NOT deleted (WHERE semantics)
+    sql("INSERT INTO memory.dl (x) VALUES (9)")
+    res2 = sql("DELETE FROM memory.dl WHERE y = 'a'")
+    assert res2.rows() == [(1,)]
+    assert sql("SELECT count(*) AS n FROM dl",
+               catalog="memory").rows() == [(2,)]
+
+
+def test_delete_all_and_update():
+    memory.create_table("up", ["k", "v"], [T.BIGINT, T.BIGINT])
+    sql("INSERT INTO memory.up VALUES (1,10), (2,20), (3,30)")
+    res = sql("UPDATE memory.up SET v = v + 100 WHERE k >= 2")
+    assert res.rows() == [(2,)]
+    assert sql("SELECT k, v FROM up ORDER BY k",
+               catalog="memory").rows() == [(1, 10), (2, 120), (3, 130)]
+    res2 = sql("UPDATE memory.up SET v = 0")
+    assert res2.rows() == [(3,)]
+    res3 = sql("DELETE FROM memory.up")
+    assert res3.rows() == [(3,)]
+    assert memory.table_row_count("up") == 0
+
+
+def test_read_only_transaction_rejects_writes():
+    from presto_tpu.client import QueryError, execute
+    from presto_tpu.server.statement import StatementServer
+    memory.create_table("ro", ["x"], [T.BIGINT])
+    with StatementServer(sf=SF) as srv:
+        c = execute(srv.url, "START TRANSACTION READ ONLY")
+        tid = c.started_transaction_id
+        with pytest.raises(QueryError) as ei:
+            execute(srv.url, "INSERT INTO memory.ro VALUES (1)",
+                    transaction_id=tid)
+        assert "read-only" in str(ei.value)
+        with pytest.raises(QueryError):
+            execute(srv.url, "DELETE FROM memory.ro",
+                    transaction_id=tid)
+        execute(srv.url, "ROLLBACK", transaction_id=tid)
+    assert memory.table_row_count("ro") == 0
+
+
+def test_delete_update_update_type_on_wire():
+    from presto_tpu.client import execute
+    from presto_tpu.server.statement import StatementServer
+    memory.create_table("ut", ["x"], [T.BIGINT])
+    with StatementServer(sf=SF) as srv:
+        execute(srv.url, "INSERT INTO memory.ut VALUES (1), (2)")
+        c = execute(srv.url, "DELETE FROM memory.ut WHERE x = 1")
+        assert c.update_type == "DELETE"
+        c2 = execute(srv.url, "UPDATE memory.ut SET x = 9")
+        assert c2.update_type == "UPDATE"
