@@ -64,7 +64,7 @@ class WindowSpec:
         if self.name == "nth_value":
             assert self.offset >= 1, "nth_value's n must be at least 1"
         if isinstance(self.frame, (tuple, list)):
-            assert self.frame[0] == "rows", self.frame
+            assert self.frame[0] in ("rows", "range"), self.frame
 
 
 def _seg_positions(words: List[jnp.ndarray]) -> jnp.ndarray:
@@ -338,11 +338,49 @@ def window(batch: Batch, partition_channels: Sequence[int],
     return Batch(tuple(out_cols), batch.active)
 
 
-def _frame_bounds(frame, spos, part_start, part_end, run_end):
+def _seg_search(vals, targets, seg_lo, seg_hi_excl, side: str):
+    """Vectorized per-row binary search: insertion point of targets[i]
+    within the sorted slice vals[seg_lo[i]:seg_hi_excl[i]] ('left' or
+    'right' side). O(log n) unrolled where-steps, no gather loops."""
+    n = vals.shape[0]
+    lo = seg_lo.astype(jnp.int64)
+    hi = seg_hi_excl.astype(jnp.int64)
+    steps = max(1, int(np.ceil(np.log2(max(n, 2)))) + 1)
+    for _ in range(steps):
+        mid = (lo + hi) // 2
+        v = vals[jnp.clip(mid, 0, n - 1)]
+        go_right = (v < targets) if side == "left" else (v <= targets)
+        active = lo < hi
+        lo = jnp.where(active & go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+    return lo
+
+
+def _frame_bounds(frame, spos, part_start, part_end, run_end,
+                  order_vals=None, order_nulls=None, run_start=None):
     """Inclusive [lo, hi] sorted-position bounds of each row's frame.
     "range_current" = RANGE UNBOUNDED PRECEDING..CURRENT ROW (peer-
     inclusive via run_end); "full" = whole partition; ("rows", s, e) =
-    signed row offsets (None = unbounded on that side)."""
+    signed row offsets; ("range", s, e) = ORDER-KEY VALUE offsets (both:
+    None = unbounded on that side). Value frames search the partition's
+    sorted order values; rows whose order key is NULL frame over their
+    null-peer run (the SQL null-peers rule)."""
+    if isinstance(frame, (tuple, list)) and frame[0] == "range":
+        _mode, s, e = frame
+        v = order_vals
+        if s is None:
+            lo = part_start
+        else:
+            lo = _seg_search(v, v + s, part_start, part_end + 1, "left")
+        if e is None:
+            hi = part_end
+        else:
+            hi = _seg_search(v, v + e, part_start, part_end + 1,
+                             "right") - 1
+        if order_nulls is not None:
+            lo = jnp.where(order_nulls, run_start, lo)
+            hi = jnp.where(order_nulls, run_end, hi)
+        return lo, hi
     if isinstance(frame, (tuple, list)):
         _mode, s, e = frame
         lo = part_start if s is None else jnp.maximum(part_start, spos + s)

@@ -770,7 +770,7 @@ class _Parser:
                 return 0
             k, v = self.next()
             assert k == "number", f"expected frame bound, got {(k, v)}"
-            n = int(v)
+            n = float(v) if "." in v else int(v)  # RANGE takes decimals
             which = self.next()[1].lower()
             assert which in ("preceding", "following"), which
             return -n if which == "preceding" else n

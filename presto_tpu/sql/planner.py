@@ -1444,8 +1444,10 @@ def _collect_windows(e, out: list):
                 _collect_windows(x, out)
 
 
-def _frame_of(w) -> object:
-    """WindowExpr.frame (parser form) -> the kernel's frame spec."""
+def _frame_of(w, order_keys=None, pre_exprs=None) -> object:
+    """WindowExpr.frame (parser form) -> the kernel's frame spec.
+    Value RANGE frames scale their offsets into the single ascending
+    numeric order key's representation (scaled decimals, day numbers)."""
     fr = getattr(w, "frame", None)
     if fr is None:
         return "range_current"
@@ -1455,10 +1457,36 @@ def _frame_of(w) -> object:
             return "range_current"
         if s is None and e is None:
             return "full"
-        raise NotImplementedError(
-            "unsupported RANGE frame shape: only UNBOUNDED PRECEDING .. "
-            "CURRENT ROW / UNBOUNDED FOLLOWING are supported (any ROWS "
-            "frame works)")
+        # value-offset RANGE frame: needs exactly one ASC order key of
+        # a numeric/temporal type (the SQL rule)
+        if not order_keys or len(order_keys) != 1:
+            raise NotImplementedError(
+                "RANGE value frames require exactly one ORDER BY key")
+        ch, desc, _nl = order_keys[0]
+        if desc:
+            raise NotImplementedError(
+                "RANGE value frames over DESC order keys")
+        ty = pre_exprs[ch].type
+        if not (ty.is_numeric or ty.base in ("date", "timestamp")):
+            raise NotImplementedError(
+                f"RANGE value frame over {ty} order key")
+
+        def conv(x):
+            if x is None or x == 0:
+                return 0 if x == 0 else None
+            if ty.is_decimal:
+                return int(round(x * 10 ** ty.scale))
+            if ty.is_floating:
+                return float(x)
+            if x != int(x):
+                raise ValueError(
+                    f"RANGE offset {x} is fractional but the order key "
+                    f"is {ty}")
+            return int(x)
+        return ("range", conv(s), conv(e))
+    for b in (s, e):
+        if b is not None and b != int(b):
+            raise ValueError("ROWS frame offsets must be integers")
     if s is None and e is None:
         return "full"  # whole partition: cheaper non-tuple kernel path
     return ("rows", s, e)
@@ -1537,7 +1565,7 @@ def _plan_window_stage(node, win_list, lower_expr, base_types):
                 buckets = 1
         elif f.args and not isinstance(f.args[0], P.Star):
             in_ch = chan_of(f.args[0])
-        frame = _frame_of(w)
+        frame = _frame_of(w, order_keys, pre_exprs)
         if name in ("lag", "lead", "nth_value"):
             oty = pre_exprs[in_ch].type
         elif name in _WINDOW_FN_TYPES and not (name == "count" and in_ch is not None):
