@@ -357,6 +357,11 @@ def _bench_large_g(platform, iters):
                    "scoring": not platform.startswith("cpu")}}))
 
 
+def _smallg_scatter_free() -> bool:
+    from presto_tpu.ops.aggregation import _scatter_free
+    return _scatter_free()
+
+
 def main():
     sf = float(os.environ.get("BENCH_SF", "1"))
     iters = int(os.environ.get("BENCH_ITERS", "5"))
@@ -432,6 +437,10 @@ def main():
             "platform": platform,
             "scoring": scoring,
             "iters": iters,
+            # which small-G group-by form compiled (backend-dependent;
+            # PERF.md round 5 -- makes kernel A/Bs visible in artifacts)
+            "smallg_form": "einsum-MXU" if _smallg_scatter_free()
+                           else "scatter",
         },
     }
     print(json.dumps(result))

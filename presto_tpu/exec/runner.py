@@ -142,6 +142,19 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     if _session_on("iterative_optimizer"):
         from ..plan.rules import optimize_plan
         root = optimize_plan(root)
+    # cost-based join reordering (ReorderJoins analog): largest
+    # relation stays the streaming probe, smallest builds join first.
+    # Runs BEFORE channel pruning of the rebuilt chain would matter --
+    # the trailing optimize_plan sweep re-prunes the widened
+    # intermediates reorder introduces
+    if session_value(session, "join_reordering_strategy",
+                     "AUTOMATIC") != "NONE":
+        from ..plan.reorder import reorder_joins
+        rr = reorder_joins(root, sf)
+        if rr is not root and _session_on("iterative_optimizer"):
+            from ..plan.rules import optimize_plan
+            rr = optimize_plan(rr)
+        root = rr
     # capacity refinement (CBO stats): shrink group tables to the
     # connector-proven NDV bound so group-by rides the scatter-free
     # small-table kernels wherever statistics allow

@@ -155,6 +155,23 @@ def _hash_words(words) -> jnp.ndarray:
 _SMALL_G = 64  # crossover below which the scatter-free kernels win
 
 
+def _scatter_free() -> bool:
+    """Whether the small-table kernels should avoid scatters. On TPU,
+    XLA serializes large scatters (436ms for ONE 6M->16 scatter-add on
+    v5e) so the MXU limb-einsum / masked-reduction forms win ~100x; on
+    CPU it is the exact reverse (one 600k-row limb einsum = 83ms vs
+    0.8ms for the scatter-add -- scripts/bench_bisect.py, the r01->r04
+    CPU-fallback q1 'regression' root cause). Trace-time static, so
+    each backend compiles its winning form. Override:
+    PRESTO_TPU_SMALLG=einsum|scatter."""
+    mode = _os.environ.get("PRESTO_TPU_SMALLG", "auto")
+    if mode == "einsum":
+        return True
+    if mode == "scatter":
+        return False
+    return jax.default_backend() == "tpu"
+
+
 def _group_ids(key_cols: Sequence[Block], active: jnp.ndarray, max_groups: int):
     """Dense group ids per row (exact). Returns (ids, perm_first,
     num_groups, overflow) where perm_first[g] is the row index of a
@@ -235,7 +252,7 @@ def _seg_add(ids, contrib, max_groups: int) -> jnp.ndarray:
     """Per-group sum of `contrib` (already masked: dead rows contribute
     the dtype's zero). Small tables avoid TPU scatter: exact limb
     matmuls for integers, per-group masked reductions for floats."""
-    if max_groups <= _SMALL_G:
+    if max_groups <= _SMALL_G and _scatter_free():
         if contrib.dtype in (jnp.int64, jnp.int32):
             return _limb_matmul_sum(ids, contrib, max_groups)
         zero = jnp.zeros((), dtype=contrib.dtype)
@@ -246,7 +263,7 @@ def _seg_add(ids, contrib, max_groups: int) -> jnp.ndarray:
 
 def _seg_count(ids, flags, max_groups: int) -> jnp.ndarray:
     """Per-group count of True flags (int64)."""
-    if max_groups <= _SMALL_G:
+    if max_groups <= _SMALL_G and _scatter_free():
         return _limb_matmul_sum(ids, flags.astype(jnp.int64), max_groups,
                                 nlimbs=1)
     return jnp.zeros(max_groups, dtype=jnp.int64).at[ids].add(
@@ -255,14 +272,14 @@ def _seg_count(ids, flags, max_groups: int) -> jnp.ndarray:
 
 def _seg_min(ids, contrib, max_groups: int, ident) -> jnp.ndarray:
     """Per-group min of `contrib` (dead rows pre-masked to `ident`)."""
-    if max_groups <= _SMALL_G:
+    if max_groups <= _SMALL_G and _scatter_free():
         return jnp.stack([jnp.min(jnp.where(ids == g, contrib, ident))
                           for g in range(max_groups)])
     return jnp.full(max_groups, ident, dtype=contrib.dtype).at[ids].min(contrib)
 
 
 def _seg_max(ids, contrib, max_groups: int, ident) -> jnp.ndarray:
-    if max_groups <= _SMALL_G:
+    if max_groups <= _SMALL_G and _scatter_free():
         return jnp.stack([jnp.max(jnp.where(ids == g, contrib, ident))
                           for g in range(max_groups)])
     return jnp.full(max_groups, ident, dtype=contrib.dtype).at[ids].max(contrib)
@@ -909,7 +926,7 @@ def _argbest(order_words: List[jnp.ndarray], ids, live, g, minimize: bool):
     """Row index of the min (or max) order-key per group; ties -> lowest
     row. Returns g-length int array; n (out of range) when group empty."""
     n = live.shape[0]
-    if g <= _SMALL_G:
+    if g <= _SMALL_G and _scatter_free():
         # per-group masked lexicographic reduction (no scatters)
         full = jnp.uint64(0xFFFFFFFFFFFFFFFF)
         rows = jnp.arange(n, dtype=jnp.int64)

@@ -131,6 +131,35 @@ def window(batch: Batch, partition_channels: Sequence[int],
     out_cols: List[Block] = list(batch.columns)
     inv = jnp.zeros(n, dtype=jnp.int64).at[perm].set(spos)
 
+    # RANGE value-offset frames search the (single, ASC) order key's
+    # values within each partition; null-order-key rows are overridden
+    # to their peer run by _frame_bounds, and the sentinel keeps the
+    # binary search from wandering into the null zone.
+    o_vals_sorted = o_nulls_sorted = None
+    if any(isinstance(s.frame, (tuple, list)) and s.frame[0] == "range"
+           for s in specs):
+        assert len(order_keys) == 1, \
+            "RANGE value frames require exactly one ORDER BY key"
+        ch, desc, nulls_last = order_keys[0]
+        assert not desc, "RANGE value frames over DESC order keys"
+        ocol = batch.column(ch)
+        if isinstance(ocol, DictionaryColumn):
+            ocol = ocol.decode()
+        assert not isinstance(ocol, (StringColumn, Int128Column)), \
+            "RANGE value frame over unsupported order-key column"
+        o_nulls_sorted = (ocol.nulls | ~batch.active)[perm]
+        ov = ocol.values[perm]
+        if ocol.type.is_floating:
+            sent = jnp.inf if nulls_last else -jnp.inf
+        else:
+            info = jnp.iinfo(ov.dtype)
+            sent = info.max if nulls_last else info.min
+        o_vals_sorted = jnp.where(o_nulls_sorted, sent, ov)
+
+    def frame_bounds(frame):
+        return _frame_bounds(frame, spos, part_start, part_end, run_end,
+                             o_vals_sorted, o_nulls_sorted, run_start)
+
     for spec in specs:
         name = spec.name
         if name == "row_number":
@@ -173,8 +202,7 @@ def window(batch: Batch, partition_channels: Sequence[int],
             nulls_sorted = jnp.where(ok, n_sorted[src], True) | ~s_active
         elif name == "count" and spec.input_channel is None:
             # count(*) over frame: rows (not non-null values)
-            f_lo, f_hi = _frame_bounds(spec.frame, spos, part_start,
-                                       part_end, run_end)
+            f_lo, f_hi = frame_bounds(spec.frame)
             vals_sorted = jnp.maximum(f_hi - f_lo + 1, 0)
             nulls_sorted = ~s_active
         elif name in ("sum", "count", "avg", "min", "max", "first_value",
@@ -184,8 +212,7 @@ def window(batch: Batch, partition_channels: Sequence[int],
                 col = col.decode()
             assert not isinstance(col, StringColumn), \
                 f"window {name} over strings is not yet supported"
-            f_lo, f_hi = _frame_bounds(spec.frame, spos, part_start,
-                                       part_end, run_end)
+            f_lo, f_hi = frame_bounds(spec.frame)
             f_hi_c = jnp.clip(f_hi, 0, n - 1)
             f_lo_c = jnp.clip(f_lo, 0, n - 1)
             empty_frame = f_hi < f_lo
@@ -295,11 +322,14 @@ def window(batch: Batch, partition_channels: Sequence[int],
                 bounded_start = isinstance(spec.frame, (tuple, list)) \
                     and spec.frame[1] is not None
                 if bounded_start:
-                    # general ROWS frame: sparse-table range extreme.
-                    # With a bounded end too, the static offsets cap the
-                    # frame length, so only log2(w) levels are built.
+                    # general bounded-start frame: sparse-table range
+                    # extreme. For ROWS frames with a bounded end the
+                    # static offsets cap the frame length, so only
+                    # log2(w) levels are built; RANGE value offsets say
+                    # nothing about row counts, so no cap applies.
                     _s, _e = spec.frame[1], spec.frame[2]
-                    cap = (_e - _s + 1) if _e is not None else None
+                    cap = (_e - _s + 1) if (_e is not None and
+                                            spec.frame[0] == "rows") else None
                     vals_sorted = _range_extreme(sv, f_lo_c, f_hi_c,
                                                  ident, minimize,
                                                  max_len=cap)
@@ -378,8 +408,14 @@ def _frame_bounds(frame, spos, part_start, part_end, run_end,
             hi = _seg_search(v, v + e, part_start, part_end + 1,
                              "right") - 1
         if order_nulls is not None:
-            lo = jnp.where(order_nulls, run_start, lo)
-            hi = jnp.where(order_nulls, run_end, hi)
+            # null-order-key rows treat all null rows as peers, but ONLY
+            # on offset-bounded sides: an UNBOUNDED side still reaches
+            # the partition edge for them (Presto/Postgres null-peers
+            # semantics)
+            if s is not None:
+                lo = jnp.where(order_nulls, run_start, lo)
+            if e is not None:
+                hi = jnp.where(order_nulls, run_end, hi)
         return lo, hi
     if isinstance(frame, (tuple, list)):
         _mode, s, e = frame

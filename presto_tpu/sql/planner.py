@@ -1470,6 +1470,9 @@ def _frame_of(w, order_keys=None, pre_exprs=None) -> object:
         if not (ty.is_numeric or ty.base in ("date", "timestamp")):
             raise NotImplementedError(
                 f"RANGE value frame over {ty} order key")
+        if ty.is_decimal and not ty.is_short_decimal:
+            raise NotImplementedError(
+                "RANGE value frame over long-decimal order key")
 
         def conv(x):
             if x is None or x == 0:

@@ -133,6 +133,9 @@ SESSION_PROPERTIES = (
     .add("join_distribution_type", "str", "AUTOMATIC",
          "PARTITIONED | BROADCAST | AUTOMATIC "
          "(DetermineJoinDistributionType analog)")
+    .add("join_reordering_strategy", "str", "AUTOMATIC",
+         "NONE | AUTOMATIC: statistics-driven left-deep reorder of "
+         "inner-join chains (ReorderJoins analog, plan/reorder.py)")
     .add("hash_partition_count", "int", 8,
          "workers per partitioned exchange (FIXED_HASH distribution width)")
     .add("task_concurrency", "int", 1,

@@ -158,3 +158,38 @@ def test_arbitrary():
     r = group_by(b, [0], [AggSpec("arbitrary", 1, T.BIGINT)], max_groups=4)
     got = table(r, 1)
     assert got[1][0] in (10, 20) and got[2][0] == 30
+
+
+def test_smallg_scatter_and_einsum_forms_agree(monkeypatch):
+    """The small-table kernel has two backend-optimal forms (MXU limb
+    einsum on TPU, scatter on CPU -- _scatter_free()); both must produce
+    identical exact results on the same inputs, including int128 sums."""
+    from presto_tpu.ops import aggregation as agg_mod
+
+    rng = np.random.default_rng(7)
+    n = 5000
+    keys = rng.integers(0, 13, n).astype(np.int64)
+    ints = rng.integers(-10**12, 10**12, n).astype(np.int64)
+    flts = rng.normal(size=n)
+    b = batch_from_numpy([T.BIGINT, T.BIGINT, T.DOUBLE],
+                         [keys, ints, flts], capacity=n + 24)
+    specs = [AggSpec("sum", 1, T.decimal(38, 0)),
+             AggSpec("sum", 2, T.DOUBLE),
+             AggSpec("min", 1, T.BIGINT), AggSpec("max", 1, T.BIGINT),
+             AggSpec("avg", 1, T.DOUBLE),
+             AggSpec("count_star", None, T.BIGINT),
+             # _argbest-backed forms diverge per backend too
+             AggSpec("min_by", 1, T.BIGINT, second_channel=2,
+                     second_type=T.DOUBLE),
+             AggSpec("max_by", 2, T.DOUBLE, second_channel=1,
+                     second_type=T.BIGINT)]
+    out = {}
+    for mode in ("scatter", "einsum"):
+        monkeypatch.setenv("PRESTO_TPU_SMALLG", mode)
+        r = group_by(b, [0], specs, max_groups=16)
+        out[mode] = table(r, len(specs))
+    assert set(out["scatter"]) == set(out["einsum"])
+    for k in out["scatter"]:
+        a, bb = out["scatter"][k], out["einsum"][k]
+        for x, y in zip(a, bb):
+            assert x == pytest.approx(y, rel=1e-12), (k, a, bb)

@@ -80,9 +80,35 @@ def test_first_last_value_honor_rows_frames():
             assert row[4] == qs[min(len(qs) - 1, i + 1)]
 
 
-def test_range_offset_frames_rejected():
-    with pytest.raises(NotImplementedError, match="RANGE frame shape"):
-        sql("SELECT sum(quantity) OVER (ORDER BY linenumber "
+def test_range_value_frames_against_oracle():
+    """RANGE value-offset frames: the frame is every peer whose ORDER BY
+    value lies within [v-s, v+e] — ties share one frame result, and rows
+    outside the value window are excluded regardless of row distance."""
+    q = ("SELECT orderkey, quantity, "
+         "sum(quantity) OVER (PARTITION BY orderkey ORDER BY quantity "
+         "  RANGE BETWEEN 5 PRECEDING AND CURRENT ROW) rsum, "
+         "count(*) OVER (PARTITION BY orderkey ORDER BY quantity "
+         "  RANGE BETWEEN CURRENT ROW AND 10 FOLLOWING) rcnt, "
+         "min(quantity) OVER (PARTITION BY orderkey ORDER BY quantity "
+         "  RANGE BETWEEN 3 PRECEDING AND 3 FOLLOWING) rmin "
+         "FROM lineitem WHERE orderkey <= 100 ORDER BY orderkey, quantity")
+    # quantity is decimal(12,2): rows() surfaces the scaled-int lanes,
+    # so the SQL value offsets (5, 10, 3) are 500/1000/300 in oracle units
+    checked = 0
+    for ok, rws in _partitions(sql(q, sf=0.01).rows()).items():
+        qs = [x[1] for x in rws]
+        for row in rws:
+            v = row[1]
+            assert row[2] == sum(x for x in qs if v - 500 <= x <= v)
+            assert row[3] == sum(1 for x in qs if v <= x <= v + 1000)
+            assert row[4] == min(x for x in qs if v - 300 <= x <= v + 300)
+            checked += 1
+    assert checked == 400
+
+
+def test_range_value_frame_desc_rejected():
+    with pytest.raises(NotImplementedError, match="DESC"):
+        sql("SELECT sum(quantity) OVER (ORDER BY linenumber DESC "
             "RANGE BETWEEN 5 PRECEDING AND CURRENT ROW) "
             "FROM lineitem WHERE orderkey <= 10", sf=0.01)
 
@@ -144,6 +170,32 @@ def test_nth_value_beyond_frame_is_null_on_fully_active_batch():
     assert bool(nv.nulls.all()), (nv.values, nv.nulls)
 
 
+def test_range_value_frame_null_order_keys_frame_over_peers():
+    """Rows whose ORDER BY key is NULL frame over their null-peer run
+    (the SQL null-peers rule), not over the searched value window."""
+    import jax.numpy as jnp
+    from presto_tpu.block import Batch, Column
+    from presto_tpu import types as T
+    from presto_tpu.ops.window import WindowSpec, window
+    from presto_tpu.ops.sort import SortKey
+
+    part = jnp.zeros(6, dtype=jnp.int64)
+    order = jnp.array([1, 3, 10, 0, 0, 20], dtype=jnp.int64)
+    onull = jnp.array([False, False, False, True, True, False])
+    val = jnp.array([100, 200, 300, 400, 500, 600], dtype=jnp.int64)
+    batch = Batch((Column(part, jnp.zeros(6, bool), T.BIGINT),
+                   Column(order, onull, T.BIGINT),
+                   Column(val, jnp.zeros(6, bool), T.BIGINT)),
+                  jnp.ones(6, dtype=bool))
+    out = window(batch, [0], [SortKey(1)],
+                 [WindowSpec("sum", 2, T.BIGINT, frame=("range", -2, 0))])
+    got = [None if bool(nl) else int(v)
+           for v, nl in zip(out.column(3).values, out.column(3).nulls)]
+    # non-null rows: sum of vals whose order key in [k-2, k];
+    # null rows (order 0s at slots 3,4): sum over the null-peer run
+    assert got == [100, 300, 300, 900, 900, 600]
+
+
 def test_range_extreme_sparse_table_randomized():
     """min/max over random inclusive ranges vs a numpy oracle, with
     lengths crossing power-of-two boundaries (the f32-log2 corner)."""
@@ -170,3 +222,31 @@ def test_range_extreme_sparse_table_randomized():
         seg = sv[lo[i]:hi[i] + 1]
         assert got_min[i] == seg.min(), (lo[i], hi[i])
         assert got_max[i] == seg.max(), (lo[i], hi[i])
+
+
+def test_range_value_frame_null_rows_keep_unbounded_sides():
+    """A null-order-key row's frame only collapses to the null-peer run
+    on OFFSET-bounded sides; an UNBOUNDED PRECEDING side still reaches
+    the partition start for it."""
+    import jax.numpy as jnp
+    from presto_tpu.block import Batch, Column
+    from presto_tpu import types as T
+    from presto_tpu.ops.window import WindowSpec, window
+    from presto_tpu.ops.sort import SortKey
+
+    part = jnp.zeros(5, dtype=jnp.int64)
+    order = jnp.array([1, 4, 0, 0, 9], dtype=jnp.int64)
+    onull = jnp.array([False, False, True, True, False])
+    val = jnp.array([10, 20, 30, 40, 50], dtype=jnp.int64)
+    batch = Batch((Column(part, jnp.zeros(5, bool), T.BIGINT),
+                   Column(order, onull, T.BIGINT),
+                   Column(val, jnp.zeros(5, bool), T.BIGINT)),
+                  jnp.ones(5, dtype=bool))
+    out = window(batch, [0], [SortKey(1)],
+                 [WindowSpec("sum", 2, T.BIGINT,
+                             frame=("range", None, 1))])
+    got = [int(v) for v in out.column(3).values]
+    # sorted order (NULLS LAST): 1,4,9,N,N. For k=1: [start..k+1]=10;
+    # k=4: 10+20; k=9: 10+20+50; null rows: partition start .. end of
+    # null run = everything = 150
+    assert got == [10, 30, 150, 150, 80]
