@@ -8,9 +8,9 @@ column_type.
 """
 
 def _load():
-    from . import memory, system, tpch, tpcds
+    from . import information_schema, memory, system, tpch, tpcds
     cats = {"tpch": tpch, "tpcds": tpcds, "memory": memory,
-            "system": system}
+            "system": system, "information_schema": information_schema}
     try:
         import pyarrow  # noqa: F401  (parquet.py imports it lazily)
         from . import parquet

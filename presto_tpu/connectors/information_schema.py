@@ -1,0 +1,115 @@
+"""information_schema connector: the standard metadata catalog.
+
+Reference surface: presto-main-base/.../connector/informationSchema/
+(InformationSchemaMetadata / InformationSchemaPageSourceProvider --
+the tables BI tools introspect) serving `tables`, `columns`,
+`schemata`. Rows snapshot the connector registry host-side (pure
+control-plane reads, no device work), the same serving shape as the
+system connector. SHOW TABLES / SHOW COLUMNS / DESCRIBE rewrite onto
+these tables (sql/statements.py), exactly as the reference's
+ShowQueriesRewrite does."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import types as T
+from ..block import batch_from_numpy
+
+__all__ = ["SCHEMA", "table_row_count", "generate_columns",
+           "generate_nulls", "generate_batch", "column_type"]
+
+_V = T.varchar(256)
+SCHEMA = {
+    "schemata": {"catalog_name": _V, "schema_name": _V},
+    "tables": {"table_catalog": _V, "table_schema": _V, "table_name": _V,
+               "table_type": _V},
+    "columns": {"table_catalog": _V, "table_schema": _V, "table_name": _V,
+                "column_name": _V, "ordinal_position": T.BIGINT,
+                "data_type": _V, "is_nullable": _V},
+}
+
+
+def _schema_dict(cat: str, mod) -> dict:
+    sch = getattr(mod, "SCHEMA", None) or {}
+    # tpch/tpcds expose list-of-(name, type) per table; memory/system
+    # expose dicts -- normalize
+    out = {}
+    for t, cols in sch.items():
+        if isinstance(cols, dict):
+            out[t] = dict(cols)
+        else:
+            out[t] = dict(cols)
+    return out
+
+
+def _rows_of(table: str) -> List[tuple]:
+    from . import catalogs
+    cats = sorted(catalogs().items())
+    if table == "schemata":
+        out = []
+        for cat, _ in cats:
+            out.append((cat, "default"))
+            out.append((cat, "information_schema"))
+        return out
+    if table == "tables":
+        out = []
+        for cat, mod in cats:
+            for t in sorted(_schema_dict(cat, mod)):
+                out.append((cat, "default", t, "BASE TABLE"))
+        return out
+    if table == "columns":
+        out = []
+        for cat, mod in cats:
+            sch = _schema_dict(cat, mod)
+            for t in sorted(sch):
+                for pos, (c, ty) in enumerate(sch[t].items(), start=1):
+                    out.append((cat, "default", t, c, pos, str(ty), "YES"))
+        return out
+    raise KeyError(f"no information_schema table {table!r}")
+
+
+def column_type(table: str, column: str) -> T.Type:
+    return SCHEMA[table][column]
+
+
+def table_row_count(table: str, sf: float = 0.0) -> int:
+    return len(_rows_of(table))
+
+
+def generate_columns(table: str, sf: float, columns: Sequence[str],
+                     start: int = 0, count: Optional[int] = None
+                     ) -> Dict[str, np.ndarray]:
+    rows = _rows_of(table)
+    count = len(rows) - start if count is None else count
+    rows = rows[start:start + count]
+    names = list(SCHEMA[table])
+    out = {}
+    for c in columns:
+        i = names.index(c)
+        ty = SCHEMA[table][c]
+        vals = [r[i] for r in rows]
+        if ty.is_string:
+            out[c] = np.array([str(v) for v in vals], dtype=object)
+        else:
+            out[c] = np.array(vals, dtype=ty.to_dtype())
+    return out
+
+
+def generate_nulls(table: str, columns: Sequence[str], start: int = 0,
+                   count: Optional[int] = None) -> Dict[str, np.ndarray]:
+    n = table_row_count(table) - start if count is None else count
+    return {c: np.zeros(max(n, 0), dtype=bool) for c in columns}
+
+
+def generate_batch(table: str, sf: float, columns: Sequence[str],
+                   start: int = 0, count: Optional[int] = None,
+                   capacity: Optional[int] = None):
+    data = generate_columns(table, sf, columns, start, count)
+    vals = [data[c] for c in columns]
+    types = [SCHEMA[table][c] for c in columns]
+    n = len(vals[0]) if vals else 0
+    cap = capacity or max(n, 1)
+    return batch_from_numpy(types, vals, capacity=cap)

@@ -86,6 +86,9 @@ SCHEMA = {
                "column_count": T.BIGINT},
     "plan_cache": {"entries": T.BIGINT, "hits": T.BIGINT,
                    "misses": T.BIGINT},
+    "session_properties": {"name": _V, "default_value": _V, "type": _V,
+                           "description": _V},
+    "functions": {"function_name": _V, "kind": _V},
 }
 
 
@@ -142,6 +145,22 @@ def _rows_of(table: str) -> List[tuple]:
                     out.append((cat, t, len(sch[t])))
                 except Exception:  # noqa: BLE001 - live schemas may churn
                     pass
+        return out
+    if table == "session_properties":
+        from ..utils.config import SESSION_PROPERTIES
+        out = []
+        for name, prop in sorted(SESSION_PROPERTIES.properties.items()):
+            out.append((name, str(prop.default), prop.kind,
+                        prop.description))
+        return out
+    if table == "functions":
+        from ..expr.functions import REGISTRY
+        from ..ops.aggregation import _AGGS
+        out = [(n, "scalar") for n in sorted(REGISTRY)
+               if not n.startswith("$")]
+        out += [(n, "aggregate") for n in sorted(_AGGS)]
+        from ..ops.window import _FUNCS as _WIN
+        out += [(n, "window") for n in sorted(_WIN)]
         return out
     if table == "plan_cache":
         from ..exec.plan_cache import cache_stats

@@ -67,6 +67,12 @@ def _scan_batch(node: N.PlanNode, sf: float, capacity_hint: Optional[int],
                                        dtype=ty.to_dtype()))
             null_masks.append(nulls)
         cap = capacity_hint or -(-len(node.rows) // pad_multiple) * pad_multiple
+        if not node.types:
+            # zero-column VALUES (FROM-less SELECT): rows are all mask
+            import jax.numpy as jnp
+            active = np.zeros(cap, dtype=bool)
+            active[:len(node.rows)] = True
+            return Batch((), jnp.asarray(active))
         return batch_from_numpy(node.types, arrays, nulls=null_masks,
                                 capacity=cap)
     assert isinstance(node, N.TableScanNode)

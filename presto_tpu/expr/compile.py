@@ -32,7 +32,8 @@ import numpy as np
 from .. import types as T
 from ..block import Batch, Column, DictionaryColumn, StringColumn
 from . import functions as F
-from .ir import Call, Constant, InputReference, RowExpression, SpecialForm
+from .ir import (Call, Constant, InputReference, Lambda, LambdaVariable,
+                 RowExpression, SpecialForm)
 
 Block = Union[Column, StringColumn]
 
@@ -192,6 +193,79 @@ def evaluate(expr: RowExpression, batch: Batch) -> Block:
             table, accepting = compile_dfa(str(pat.value))
             v = regexp_like_kernel(a.chars, a.lengths, table, accepting)
             return Column(v, a.nulls, expr.type)
+        if name in ("transform", "filter", "any_match", "all_match",
+                    "none_match", "reduce") and \
+                any(isinstance(a, Lambda) for a in expr.arguments):
+            return _eval_array_lambda(expr, batch)
+        if name == "array_constructor":
+            from ..block import ArrayColumn
+            elems = [evaluate(a, batch) for a in expr.arguments]
+            k = max(len(elems), 1)
+            ety = expr.type.element_type
+            if not elems:
+                z = jnp.zeros((cap, 1), dtype=ety.to_dtype()
+                              if ety != T.UNKNOWN else jnp.int64)
+                return ArrayColumn(z, jnp.ones((cap, 1), bool),
+                                   jnp.zeros(cap, dtype=jnp.int32),
+                                   jnp.zeros(cap, bool), expr.type)
+            assert all(not isinstance(e, StringColumn) for e in elems), \
+                "ARRAY[] of strings is not yet supported"
+            vals = jnp.stack([e.values.astype(ety.to_dtype())
+                              for e in elems], axis=1)
+            nls = jnp.stack([e.nulls for e in elems], axis=1)
+            return ArrayColumn(vals, nls,
+                               jnp.full(cap, k, dtype=jnp.int32),
+                               jnp.zeros(cap, bool), expr.type)
+        if name == "sequence":
+            a0, a1 = expr.arguments[0], expr.arguments[1]
+            assert isinstance(a0, Constant) and isinstance(a1, Constant), \
+                "sequence bounds must be constant"
+            from ..block import ArrayColumn
+            lo, hi = int(a0.value), int(a1.value)
+            step = int(expr.arguments[2].value) \
+                if len(expr.arguments) > 2 else (1 if hi >= lo else -1)
+            seq = np.arange(lo, hi + (1 if step > 0 else -1), step,
+                            dtype=np.int64)
+            k = max(len(seq), 1)
+            vals = jnp.tile(jnp.asarray(seq.reshape(1, -1)
+                                        if len(seq) else
+                                        np.zeros((1, 1), np.int64)),
+                            (cap, 1))
+            return ArrayColumn(vals, jnp.zeros((cap, k), bool),
+                               jnp.full(cap, len(seq), dtype=jnp.int32),
+                               jnp.zeros(cap, bool), expr.type)
+        if name == "at_timezone":
+            # zone is plan structure: resolve the key at trace time
+            a = evaluate(expr.arguments[0], batch)
+            zc = expr.arguments[1]
+            assert isinstance(zc, Constant), \
+                "AT TIME ZONE zone must be constant"
+            from ..tz import zone_key
+            key = zone_key(str(zc.value))
+            if a.type.base == "timestamp with time zone":
+                inst = a.values >> 12
+            else:  # naive timestamp = UTC instant (session zone)
+                inst = a.values
+            return Column((inst << 12) | jnp.int64(key), a.nulls, expr.type)
+        if name == "regexp_replace":
+            # constant pattern+replacement give the static output width:
+            # at most len+1 insertions of the replacement text
+            a = evaluate(expr.arguments[0], batch)
+            pat = expr.arguments[1]
+            rep = expr.arguments[2] if len(expr.arguments) > 2 else None
+            assert isinstance(pat, Constant) and \
+                (rep is None or isinstance(rep, Constant)), \
+                "regexp_replace pattern/replacement must be constant"
+            import re as _re
+            p = str(pat.value)
+            r = "" if rep is None else str(rep.value)
+            w = a.chars.shape[1]
+            width = max(w + (w + 1) * len(r.encode("utf-8")), 1)
+            # Presto spells group references $g; python re.sub uses \g
+            py_rep = _re.sub(r"\$(\d+)", r"\\\1", r)
+            return F.host_string_kernel(
+                lambda s: _re.sub(p, py_rep, s.decode("utf-8")),
+                expr.type, width, a)
         if name == "date_format":
             d = evaluate(expr.arguments[0], batch)
             fmt = expr.arguments[1]
@@ -288,6 +362,8 @@ def evaluate(expr: RowExpression, batch: Batch) -> Block:
         out = sf.fn(expr.type, *args)
         if sf.null_fn is not None:
             nulls = sf.null_fn(expr.type, *args)
+            if nulls is None:
+                return out  # kernel computed its own mask (host kernels)
             if isinstance(out, StringColumn):
                 out = StringColumn(out.chars, out.lengths, nulls, out.type)
             else:
@@ -412,6 +488,95 @@ def _eval_special(expr: SpecialForm, batch: Batch) -> Block:
         return out
 
     raise NotImplementedError(f"special form {form}")
+
+
+def _bind_lambda(lam: Lambda, batch: Batch, param_blocks) -> Block:
+    """Evaluate a lambda body over `batch` with its parameters bound to
+    `param_blocks` (appended as extra channels; LambdaVariables become
+    InputReferences into the extended space)."""
+    from .logical import rewrite_bottom_up
+    nc = len(batch.columns)
+    mapping = {p: nc + i for i, p in enumerate(lam.parameters)}
+
+    def sub(x):
+        if isinstance(x, LambdaVariable) and x.name in mapping:
+            return InputReference(x.type, mapping[x.name])
+        return x
+
+    body = rewrite_bottom_up(lam.body, sub)
+    pseudo = Batch(tuple(batch.columns) + tuple(param_blocks), batch.active)
+    return evaluate(body, pseudo)
+
+
+def _eval_array_lambda(expr: Call, batch: Batch) -> Block:
+    """Array higher-order functions (ArrayTransformFunction family).
+    The element axis is materialized: the lambda body evaluates ONCE
+    over the flattened (N*K,) element lanes with every outer column
+    repeated K times -- XLA sees one wide fused elementwise program, no
+    per-row loops (reduce iterates K static steps)."""
+    from ..block import ArrayColumn, gather_block
+    name = expr.name.lower()
+    arr = evaluate(expr.arguments[0], batch)
+    if isinstance(arr, DictionaryColumn):
+        arr = arr.decode()
+    assert isinstance(arr, ArrayColumn), f"{name} over {type(arr)}"
+    n, k = arr.elements.shape
+    ety = expr.arguments[0].type.element_type
+    lanes = jnp.arange(k, dtype=jnp.int32)[None, :]
+    in_range = lanes < arr.lengths[:, None]
+
+    if name == "reduce":
+        init = evaluate(expr.arguments[1], batch)
+        comb, out_lam = expr.arguments[2], expr.arguments[3]
+        state = init
+        for j in range(k):
+            elem = Column(arr.elements[:, j],
+                          arr.elem_nulls[:, j] | arr.nulls, ety)
+            new_state = _bind_lambda(comb, batch, [state, elem])
+            live = (arr.lengths > j) & ~arr.nulls
+            state = _select(live, new_state, state, new_state.type)
+        res = _bind_lambda(out_lam, batch, [state])
+        # a NULL array reduces to NULL
+        if isinstance(res, StringColumn):
+            return StringColumn(res.chars, res.lengths,
+                                res.nulls | arr.nulls, expr.type)
+        return Column(res.values, res.nulls | arr.nulls, expr.type)
+
+    lam = expr.arguments[1]
+    rep_idx = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k)
+    flat_elem = Column(arr.elements.reshape(-1),
+                       (arr.elem_nulls | ~in_range).reshape(-1), ety)
+    rep_cols = tuple(gather_block(c, rep_idx) for c in batch.columns)
+    rep_batch = Batch(rep_cols, (batch.active[:, None]
+                                 & in_range).reshape(-1))
+    out = _bind_lambda(lam, rep_batch, [flat_elem])
+
+    if name == "transform":
+        assert not isinstance(out, StringColumn),             "transform to string elements is not yet supported"
+        return ArrayColumn(out.values.reshape(n, k),
+                           out.nulls.reshape(n, k) | ~in_range,
+                           arr.lengths, arr.nulls, expr.type)
+    pv = (out.values & ~out.nulls).reshape(n, k) & in_range
+    pn = out.nulls.reshape(n, k) & in_range
+    if name == "filter":
+        keep = pv
+        order = jnp.argsort(~keep, axis=1, stable=True)
+        return ArrayColumn(jnp.take_along_axis(arr.elements, order, axis=1),
+                           jnp.take_along_axis(arr.elem_nulls, order, axis=1),
+                           jnp.sum(keep, axis=1).astype(arr.lengths.dtype),
+                           arr.nulls, expr.type)
+    any_true = jnp.any(pv, axis=1)
+    any_null = jnp.any(pn, axis=1)
+    if name == "all_match":
+        any_false = jnp.any((~(out.values | out.nulls)).reshape(n, k)
+                            & in_range, axis=1)
+        nulls = ~any_false & any_null | arr.nulls
+        return Column(~any_false & ~nulls, nulls, expr.type)
+    v = any_true
+    if name == "none_match":
+        v = ~any_true
+    nulls = ~any_true & any_null | arr.nulls
+    return Column(v & ~nulls, nulls, expr.type)
 
 
 def _select(take_a, a: Block, b: Block, ty: T.Type) -> Block:

@@ -345,6 +345,18 @@ def _cmp_values(a: Block, b: Block):
         if b.type.is_decimal:
             vb = vb / _POW10[sb]
         return va, vb
+    tz = "timestamp with time zone"
+    bases = (a.type.base, b.type.base)
+    if tz in bases or ("date" in bases and "timestamp" in bases):
+        # mixed datetime comparison: align everything to UTC micros
+        # (tz values unpack their zone key; dates scale from days)
+        def inst(x):
+            if x.type.base == tz:
+                return x.values >> 12
+            if x.type.base == "date":
+                return x.values.astype(jnp.int64) * 86_400_000_000
+            return x.values
+        return inst(a), inst(b)
     return a.values, b.values
 
 
@@ -1109,8 +1121,27 @@ def _cast(ret, a):
         return _col(ret, a.values.astype(ret.to_dtype()), a)
     if ft.base == "date" and ret.base == "timestamp":
         return _col(ret, a.values.astype(jnp.int64) * 86_400_000_000, a)
+    tzb = "timestamp with time zone"
+    if ft.base == tzb and ret.base == "timestamp":
+        # the value's local datetime (reference cast semantics)
+        return _col(ret, _as_local_micros(a), a)
+    if ft.base == tzb and ret.base == "date":
+        return _col(ret, (_as_local_micros(a) // 86_400_000_000
+                          ).astype(ret.to_dtype()), a)
+    if ft.base == tzb and ret.base == "time":
+        return _col(ret, _as_local_micros(a) % 86_400_000_000, a)
+    if ft.base in ("timestamp", "date") and ret.base == tzb:
+        # a naive timestamp is a UTC instant in this engine (session
+        # zone = UTC); pack with the UTC key
+        from ..tz import UTC_KEY
+        us = a.values.astype(jnp.int64) * (86_400_000_000
+                                           if ft.base == "date" else 1)
+        return _col(ret, (us << 12) | jnp.int64(UTC_KEY), a)
+    if ft.base == "timestamp" and ret.base == "time":
+        return _col(ret, a.values % 86_400_000_000, a)
     if ft.base == "timestamp" and ret.base == "date":
-        return _col(ret, (a.values // 86_400_000_000).astype(jnp.int32), a)
+        return _col(ret, (a.values // 86_400_000_000).astype(ret.to_dtype()),
+                    a)
     # plain numeric widening/narrowing
     return _col(ret, a.values.astype(ret.to_dtype()), a)
 
@@ -1451,3 +1482,556 @@ def _array_sum(ret, a):
     dt = jnp.float64 if ret.is_floating else jnp.int64
     s = jnp.sum(jnp.where(live, a.elements.astype(dt), dt(0)), axis=1)
     return _col(ret, s, a)
+
+
+# ---------------------------------------------------------------------------
+# zoned timestamps, TIME, intervals (types TIMESTAMP_TZ / TIME /
+# INTERVAL_YM / INTERVAL_DS; packing in tz.py)
+#
+# Reference surface: presto-main-base/.../operator/scalar/DateTimeFunctions.java
+# and presto-common/.../type/TimestampWithTimeZoneType.java. Field
+# extraction and calendar arithmetic operate on the value's own wall
+# clock (local micros); comparisons/keys use the instant (keys.py).
+# ---------------------------------------------------------------------------
+
+_DAY_US = 86_400_000_000
+_TZ_BASE = "timestamp with time zone"
+
+
+def _as_local_micros(a: Column):
+    """Wall-clock micros of a date/time/timestamp/timestamptz block."""
+    base = a.type.base
+    if base == _TZ_BASE:
+        from ..tz import local_micros
+        return local_micros(a.values)
+    if base == "date":
+        return a.values.astype(jnp.int64) * _DAY_US
+    return a.values.astype(jnp.int64)  # timestamp (epoch) / time (midnight)
+
+
+def _instant_micros(a: Column):
+    base = a.type.base
+    if base == _TZ_BASE:
+        return a.values >> 12
+    if base == "date":
+        return a.values.astype(jnp.int64) * _DAY_US
+    return a.values.astype(jnp.int64)
+
+
+def _register_tod_field(name, divisor, modulus):
+    @register(name)
+    def _field(ret, a, _d=divisor, _m=modulus):
+        us = _as_local_micros(a) % _DAY_US
+        return _col(ret, ((us // _d) % _m).astype(ret.to_dtype()), a)
+    return _field
+
+
+_register_tod_field("hour", 3_600_000_000, 24)
+_register_tod_field("minute", 60_000_000, 60)
+_register_tod_field("second", 1_000_000, 60)
+_register_tod_field("millisecond", 1_000, 1000)
+
+
+@register("timezone_hour")
+def _timezone_hour(ret, a):
+    from ..tz import UTC_KEY
+    minutes = (a.values & jnp.int64(0xFFF)) - UTC_KEY
+    h = jnp.sign(minutes) * (jnp.abs(minutes) // 60)  # truncate to zero
+    return _col(ret, h.astype(ret.to_dtype()), a)
+
+
+@register("timezone_minute")
+def _timezone_minute(ret, a):
+    from ..tz import UTC_KEY
+    minutes = (a.values & jnp.int64(0xFFF)) - UTC_KEY
+    return _col(ret, jnp.sign(minutes) * (jnp.abs(minutes) % 60), a)
+
+
+def _month_add(days, months):
+    """Calendar month arithmetic with end-of-month clamping (the
+    date_add month-path rule, shared here with interval arithmetic)."""
+    y, m, d = _civil(days)
+    tot = (y * 12 + (m - 1)) + months
+    ny, nm = tot // 12, tot % 12 + 1
+    nd = jnp.minimum(d, last_day_kernel(ny, nm))
+    return _days_from_civil(ny, nm, nd)
+
+
+@register("datetime_interval_add")
+def _datetime_interval_add(ret, a, b):
+    """datetime-typed a + interval-typed b (subtraction negates b in
+    the planner). DS intervals shift the instant; YM intervals do
+    calendar month math on the value's wall clock."""
+    base = a.type.base
+    if b.type.base == "interval day to second":
+        if base == _TZ_BASE:
+            v = (((a.values >> 12) + b.values) << 12) | \
+                (a.values & jnp.int64(0xFFF))
+        elif base == "date":
+            v = a.values.astype(jnp.int64) * _DAY_US + b.values
+            if ret.base == "date":
+                v = v // _DAY_US
+        elif base == "time":
+            v = (a.values + b.values) % _DAY_US
+        else:
+            v = a.values + b.values
+        return _col(ret, v.astype(ret.to_dtype()), a, b)
+    months = b.values
+    if base == "date":
+        v = _month_add(a.values.astype(jnp.int64), months)
+    elif base == "timestamp":
+        days, tod = a.values // _DAY_US, a.values % _DAY_US
+        v = _month_add(days, months) * _DAY_US + tod
+    elif base == _TZ_BASE:
+        from ..tz import MICROS_PER_MINUTE, UTC_KEY
+        key = a.values & jnp.int64(0xFFF)
+        off = (key - UTC_KEY) * MICROS_PER_MINUTE
+        local = (a.values >> 12) + off
+        days, tod = local // _DAY_US, local % _DAY_US
+        nlocal = _month_add(days, months) * _DAY_US + tod
+        v = ((nlocal - off) << 12) | key
+    else:
+        raise NotImplementedError(f"{base} + year-month interval")
+    return _col(ret, v.astype(ret.to_dtype()), a, b)
+
+
+@register("datetime_diff_micros")
+def _datetime_diff_micros(ret, a, b):
+    """a - b as INTERVAL DAY TO SECOND (micros), instants compared."""
+    return _col(ret, _instant_micros(a) - _instant_micros(b), a, b)
+
+
+# ---------------------------------------------------------------------------
+# VARBINARY (uint8 rows in the string layout)
+# Reference: operator/scalar/VarbinaryFunctions.java
+# ---------------------------------------------------------------------------
+
+def _hex_digit(d):
+    return jnp.where(d < 10, d + ord("0"), d - 10 + ord("A")).astype(jnp.uint8)
+
+
+@register("to_hex")
+def _to_hex(ret, a: StringColumn):
+    n, w = a.chars.shape
+    chars = jnp.stack([_hex_digit(a.chars >> 4), _hex_digit(a.chars & 0xF)],
+                      axis=2).reshape(n, 2 * w)
+    return StringColumn(chars, a.lengths * 2, a.nulls, ret)
+
+
+@register("from_hex")
+def _from_hex(ret, a: StringColumn):
+    n, w = a.chars.shape
+    chars = jnp.pad(a.chars, ((0, 0), (0, w % 2)))
+    c = chars.astype(jnp.int32)
+    digit = jnp.where(c >= ord("a"), c - ord("a") + 10,
+                      jnp.where(c >= ord("A"), c - ord("A") + 10,
+                                c - ord("0")))
+    pairs = digit.reshape(n, -1, 2)
+    vals = (pairs[:, :, 0] * 16 + pairs[:, :, 1]).astype(jnp.uint8)
+    return StringColumn(vals, a.lengths // 2, a.nulls, ret)
+
+
+@register("to_utf8")
+def _to_utf8(ret, a: StringColumn):
+    return StringColumn(a.chars, a.lengths, a.nulls, ret)
+
+
+@register("from_utf8")
+def _from_utf8(ret, a: StringColumn):
+    return StringColumn(a.chars, a.lengths, a.nulls, ret)
+
+
+# ---------------------------------------------------------------------------
+# host-row kernels: irregular-grammar functions (JSON, regex capture,
+# cryptographic digests) run per-row on the HOST via jax.pure_callback
+# with static output shapes -- the same work the reference does row-wise
+# in Java (JsonFunctions.java, RegexpFunctions re2, VarbinaryFunctions
+# digests). The device pipeline stays jit'd; these lanes round-trip
+# through host DRAM. A Pallas JSON scanner is the planned upgrade for
+# the hot paths.
+# ---------------------------------------------------------------------------
+
+def _rows_of(block):
+    """Host-side decode plan for one block: returns (operands, reader)
+    where reader(row_index, *host_arrays) -> python value or None."""
+    if isinstance(block, StringColumn):
+        ops = (block.chars, block.lengths, block.nulls)
+
+        def read(i, chars, lengths, nulls):
+            if nulls[i]:
+                return None
+            return bytes(chars[i, :lengths[i]])
+        return ops, read
+    ops = (block.values, block.nulls)
+
+    def read(i, values, nulls):
+        return None if nulls[i] else values[i].item()
+    return ops, read
+
+
+def host_string_kernel(py_fn, ret: T.Type, out_width: int, *blocks):
+    """Apply py_fn(*row_values) -> bytes|str|None per row, returning a
+    StringColumn of static width `out_width` (overlong results are an
+    engine limit: raised, not truncated)."""
+    n = len(blocks[0])
+    out_width = max(int(out_width), 1)
+    plans = [_rows_of(b) for b in blocks]
+    counts = [len(p[0]) for p in plans]
+
+    def host(*arrs):
+        chars = np.zeros((n, out_width), dtype=np.uint8)
+        lengths = np.zeros(n, dtype=np.int32)
+        nulls = np.ones(n, dtype=bool)
+        split = []
+        k = 0
+        for c in counts:
+            split.append(arrs[k:k + c])
+            k += c
+        for i in range(n):
+            vals = [p[1](i, *s) for p, s in zip(plans, split)]
+            if any(v is None for v in vals):
+                continue
+            try:
+                r = py_fn(*vals)
+            except Exception:  # noqa: BLE001 - row error -> SQL NULL
+                continue
+            if r is None:
+                continue
+            if isinstance(r, str):
+                r = r.encode("utf-8")
+            if len(r) > out_width:
+                raise ValueError(
+                    f"host kernel result exceeds static width {out_width}")
+            chars[i, :len(r)] = np.frombuffer(r, dtype=np.uint8)
+            lengths[i] = len(r)
+            nulls[i] = False
+        return chars, lengths, nulls
+
+    shapes = (jax.ShapeDtypeStruct((n, out_width), np.uint8),
+              jax.ShapeDtypeStruct((n,), np.int32),
+              jax.ShapeDtypeStruct((n,), np.bool_))
+    ops = [x for p in plans for x in p[0]]
+    chars, lengths, nulls = jax.pure_callback(host, shapes, *ops)
+    return StringColumn(chars, lengths, nulls, ret)
+
+
+def host_scalar_kernel(py_fn, ret: T.Type, *blocks):
+    """Apply py_fn(*row_values) -> int|float|bool|None per row,
+    returning a fixed-width Column."""
+    n = len(blocks[0])
+    dt = ret.to_dtype()
+    plans = [_rows_of(b) for b in blocks]
+    counts = [len(p[0]) for p in plans]
+
+    def host(*arrs):
+        values = np.zeros(n, dtype=dt)
+        nulls = np.ones(n, dtype=bool)
+        split = []
+        k = 0
+        for c in counts:
+            split.append(arrs[k:k + c])
+            k += c
+        for i in range(n):
+            vals = [p[1](i, *s) for p, s in zip(plans, split)]
+            if any(v is None for v in vals):
+                continue
+            try:
+                r = py_fn(*vals)
+            except Exception:  # noqa: BLE001
+                continue
+            if r is None:
+                continue
+            values[i] = r
+            nulls[i] = False
+        return values, nulls
+
+    shapes = (jax.ShapeDtypeStruct((n,), dt),
+              jax.ShapeDtypeStruct((n,), np.bool_))
+    ops = [x for p in plans for x in p[0]]
+    values, nulls = jax.pure_callback(host, shapes, *ops)
+    return Column(values, nulls, ret)
+
+
+def _host_nulls(ret, *blocks):
+    """null_fn for host kernels: the kernel computes its own null mask
+    (row errors and absent paths are NULL, not just null inputs)."""
+    return None
+
+
+# -- JSON ------------------------------------------------------------------
+
+def _json_loads(doc: bytes):
+    import json as _json
+    return _json.loads(doc.decode("utf-8"))
+
+
+def _json_dumps(v) -> str:
+    import json as _json
+    return _json.dumps(v, separators=(",", ":"), ensure_ascii=False)
+
+
+def _json_path_get(v, path: bytes):
+    """Tiny JsonPath subset: $, $.key, $["key"], $[idx], chained."""
+    import re as _re
+    p = path.decode("utf-8").strip()
+    if not p.startswith("$"):
+        raise ValueError(f"bad json path {p!r}")
+    pos = 1
+    steps = []
+    token = _re.compile(
+        r"\.(\*|[A-Za-z_][A-Za-z_0-9]*)|\[\s*(\d+)\s*\]|\[\s*\"([^\"]*)\"\s*\]")
+    while pos < len(p):
+        m = token.match(p, pos)
+        if m is None:
+            raise ValueError(f"bad json path {p!r}")
+        if m.group(1) is not None:
+            steps.append(("key", m.group(1)))
+        elif m.group(2) is not None:
+            steps.append(("idx", int(m.group(2))))
+        else:
+            steps.append(("key", m.group(3)))
+        pos = m.end()
+    for kind, s in steps:
+        if kind == "key":
+            if not isinstance(v, dict) or s not in v:
+                return None, False
+            v = v[s]
+        else:
+            if not isinstance(v, list) or s >= len(v):
+                return None, False
+            v = v[s]
+    return v, True
+
+
+def _json_width(blocks) -> int:
+    return max(int(b.chars.shape[1]) for b in blocks
+               if isinstance(b, StringColumn))
+
+
+# canonicalization can LENGTHEN text (e.g. '1e2' -> '100.0', escapes
+# expanding): budget 6x input + slack, measured against repr() float
+# expansion worst cases
+def _json_out_width(a: StringColumn) -> int:
+    return 6 * int(a.chars.shape[1]) + 16
+
+
+@register("json_parse", null_fn=_host_nulls)
+def _json_parse(ret, a: StringColumn):
+    return host_string_kernel(lambda d: _json_dumps(_json_loads(d)), ret,
+                              _json_out_width(a), a)
+
+
+@register("json_format", null_fn=_host_nulls)
+def _json_format(ret, a: StringColumn):
+    return host_string_kernel(lambda d: d, ret, a.chars.shape[1], a)
+
+
+@register("json_extract", null_fn=_host_nulls)
+def _json_extract(ret, a: StringColumn, p: StringColumn):
+    def fn(doc, path):
+        v, ok = _json_path_get(_json_loads(doc), path)
+        return _json_dumps(v) if ok else None
+    return host_string_kernel(fn, ret, _json_out_width(a), a, p)
+
+
+@register("json_extract_scalar", null_fn=_host_nulls)
+def _json_extract_scalar(ret, a: StringColumn, p: StringColumn):
+    def fn(doc, path):
+        v, ok = _json_path_get(_json_loads(doc), path)
+        if not ok or isinstance(v, (dict, list)) or v is None:
+            return None
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        if isinstance(v, float) and v == int(v):
+            return _json_dumps(v)
+        return str(v)
+    return host_string_kernel(fn, ret, _json_out_width(a), a, p)
+
+
+@register("json_array_length", null_fn=_host_nulls)
+def _json_array_length(ret, a: StringColumn):
+    def fn(doc):
+        v = _json_loads(doc)
+        return len(v) if isinstance(v, list) else None
+    return host_scalar_kernel(fn, ret, a)
+
+
+@register("json_size", null_fn=_host_nulls)
+def _json_size(ret, a: StringColumn, p: StringColumn):
+    def fn(doc, path):
+        v, ok = _json_path_get(_json_loads(doc), path)
+        if not ok:
+            return None
+        return len(v) if isinstance(v, (dict, list)) else 0
+    return host_scalar_kernel(fn, ret, a, p)
+
+
+@register("json_array_contains", null_fn=_host_nulls)
+def _json_array_contains(ret, a: StringColumn, x):
+    def fn(doc, needle):
+        v = _json_loads(doc)
+        if not isinstance(v, list):
+            return None
+        if isinstance(needle, bytes):
+            return needle.decode("utf-8") in \
+                [x_ for x_ in v if isinstance(x_, str)]
+        if isinstance(needle, bool) or isinstance(needle, np.bool_):
+            return any(x_ is bool(needle) for x_ in v)
+        # numeric needle matches JSON numbers only (never booleans)
+        return any(x_ == needle for x_ in v
+                   if isinstance(x_, (int, float))
+                   and not isinstance(x_, bool))
+    return host_scalar_kernel(fn, ret, a, x)
+
+
+@register("is_json_scalar", null_fn=_host_nulls)
+def _is_json_scalar(ret, a: StringColumn):
+    def fn(doc):
+        return not isinstance(_json_loads(doc), (dict, list))
+    return host_scalar_kernel(fn, ret, a)
+
+
+# -- regex capture / replace (host; regexp_like has the on-device DFA) ----
+
+@register("regexp_extract", null_fn=_host_nulls)
+def _regexp_extract(ret, a: StringColumn, p: StringColumn, *group):
+    import re as _re
+
+    def fn(s, pat, g=1 if group else 0):
+        m = _re.search(pat.decode("utf-8"), s.decode("utf-8"))
+        if m is None:
+            return None
+        return m.group(g)
+    if group:
+        def fn(s, pat, g):  # noqa: F811 - group-index overload
+            m = _re.search(pat.decode("utf-8"), s.decode("utf-8"))
+            return None if m is None else m.group(int(g))
+        return host_string_kernel(fn, ret, a.chars.shape[1], a, p, group[0])
+    return host_string_kernel(fn, ret, a.chars.shape[1], a, p)
+
+
+@register("regexp_position", null_fn=_host_nulls)
+def _regexp_position(ret, a: StringColumn, p: StringColumn):
+    import re as _re
+
+    def fn(s, pat):
+        m = _re.search(pat.decode("utf-8"), s.decode("utf-8"))
+        return -1 if m is None else m.start() + 1
+    return host_scalar_kernel(fn, ret, a, p)
+
+
+@register("regexp_count", null_fn=_host_nulls)
+def _regexp_count(ret, a: StringColumn, p: StringColumn):
+    import re as _re
+
+    def fn(s, pat):
+        return sum(1 for _ in _re.finditer(pat.decode("utf-8"),
+                                           s.decode("utf-8")))
+    return host_scalar_kernel(fn, ret, a, p)
+
+
+# -- digests ---------------------------------------------------------------
+
+def _register_digest(name, width):
+    @register(name, null_fn=_host_nulls)
+    def _digest(ret, a: StringColumn, _n=name):
+        import hashlib
+
+        def fn(data):
+            return getattr(hashlib, _n)(data).digest()
+        return host_string_kernel(fn, ret, width, a)
+    return _digest
+
+
+_register_digest("md5", 16)
+_register_digest("sha1", 20)
+_register_digest("sha256", 32)
+_register_digest("sha512", 64)
+
+
+@register("crc32")
+def _crc32(ret, a: StringColumn):
+    import zlib
+
+    def fn(data):
+        return zlib.crc32(data)
+    return host_scalar_kernel(fn, ret, a)
+
+
+# ---------------------------------------------------------------------------
+# array algebra (ArrayDistinctFunction / ArraySortFunction / ArraySliceFunction)
+# ---------------------------------------------------------------------------
+
+def _arr_in_range(a):
+    lanes = jnp.arange(a.max_cardinality, dtype=jnp.int32)[None, :]
+    return lanes < a.lengths[:, None]
+
+
+@register("array_sort")
+def _array_sort(ret, a):
+    """Per-row ascending sort, NULL elements last (reference default)."""
+    from ..block import ArrayColumn
+    assert isinstance(a, ArrayColumn)
+    in_range = _arr_in_range(a)
+    dead = ~in_range | a.elem_nulls
+    v = a.elements
+    if v.dtype in (jnp.float32, jnp.float64):
+        key = jnp.where(dead, jnp.inf, v)
+    else:
+        key = jnp.where(dead, jnp.iinfo(v.dtype).max, v)
+    # two-key sort (lane class, then value) via two stable argsort
+    # passes: class 0 = live value, 1 = NULL element, 2 = padding --
+    # values ascend, nulls follow, padding stays at the tail
+    cls = jnp.where(in_range & ~a.elem_nulls, 0,
+                    jnp.where(in_range, 1, 2))
+    o1 = jnp.argsort(key, axis=1, stable=True)
+    o2 = jnp.argsort(jnp.take_along_axis(cls, o1, axis=1), axis=1,
+                     stable=True)
+    order = jnp.take_along_axis(o1, o2, axis=1)
+    return ArrayColumn(jnp.take_along_axis(a.elements, order, axis=1),
+                       jnp.take_along_axis(a.elem_nulls, order, axis=1),
+                       a.lengths, a.nulls, ret)
+
+
+@register("array_distinct")
+def _array_distinct(ret, a):
+    """First occurrence of each distinct element (NULL counts once)."""
+    from ..block import ArrayColumn
+    assert isinstance(a, ArrayColumn)
+    in_range = _arr_in_range(a)
+    v = a.elements
+    eq = (v[:, :, None] == v[:, None, :]) & \
+        ~a.elem_nulls[:, :, None] & ~a.elem_nulls[:, None, :]
+    both_null = a.elem_nulls[:, :, None] & a.elem_nulls[:, None, :]
+    same = (eq | both_null) & in_range[:, :, None] & in_range[:, None, :]
+    k = a.max_cardinality
+    earlier = jnp.tril(jnp.ones((k, k), dtype=bool), k=-1)[None, :, :]
+    dup = jnp.any(same & earlier, axis=2)  # dup[j] = any l<j equal
+    keep = in_range & ~dup
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    return ArrayColumn(jnp.take_along_axis(v, order, axis=1),
+                       jnp.take_along_axis(a.elem_nulls, order, axis=1),
+                       jnp.sum(keep, axis=1).astype(a.lengths.dtype),
+                       a.nulls, ret)
+
+
+@register("slice")
+def _array_slice(ret, a, start: Column, length: Column):
+    """slice(arr, start, length): 1-based start; negative counts from
+    the end (reference ArraySliceFunction)."""
+    from ..block import ArrayColumn
+    assert isinstance(a, ArrayColumn)
+    k = a.max_cardinality
+    lens = a.lengths.astype(jnp.int64)
+    s = start.values.astype(jnp.int64)
+    s0 = jnp.where(s > 0, s - 1, lens + s)  # 0-based start
+    cnt = jnp.clip(length.values.astype(jnp.int64), 0, None)
+    new_len = jnp.clip(jnp.minimum(cnt, lens - s0), 0, None)
+    lanes = jnp.arange(k, dtype=jnp.int64)[None, :]
+    idx = jnp.clip(s0[:, None] + lanes, 0, k - 1).astype(jnp.int32)
+    # start index 0 is invalid (SQL arrays are 1-based; the reference
+    # raises) -- total kernels surface it as NULL
+    nulls = _default_nulls(a, start, length) | (s == 0)
+    return ArrayColumn(jnp.take_along_axis(a.elements, idx, axis=1),
+                       jnp.take_along_axis(a.elem_nulls, idx, axis=1),
+                       new_len.astype(a.lengths.dtype), nulls, ret)

@@ -78,6 +78,33 @@ class Call(RowExpression):
         return f"{self.name}({', '.join(map(str, self.arguments))})"
 
 
+@dataclasses.dataclass(frozen=True)
+class LambdaVariable(RowExpression):
+    """A lambda parameter occurrence inside a Lambda body
+    (spi/relation/VariableReferenceExpression in lambda scope). Not an
+    InputReference: channel pruning/remapping must never touch it."""
+    name: str = ""
+
+    def __str__(self):
+        return f"{self.name}:{self.type}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Lambda(RowExpression):
+    """LambdaDefinitionExpression analog: `parameters -> body`. `type`
+    is the BODY's result type; InputReferences inside the body are
+    captures in the enclosing channel space (walked/remapped like any
+    other reference), LambdaVariables are the parameters."""
+    parameters: Tuple[str, ...] = ()
+    body: RowExpression = None
+
+    def children(self):
+        return (self.body,)
+
+    def __str__(self):
+        return f"({', '.join(self.parameters)}) -> {self.body}"
+
+
 # Forms mirror SpecialFormExpression.Form
 FORMS = ("IF", "NULL_IF", "SWITCH", "WHEN", "IS_NULL", "COALESCE", "IN",
          "AND", "OR", "DEREFERENCE", "ROW_CONSTRUCTOR", "BIND", "BETWEEN")
@@ -132,6 +159,11 @@ def to_json(e: RowExpression) -> dict:
     if isinstance(e, SpecialForm):
         return {"@type": "special", "form": e.form, "returnType": str(e.type),
                 "arguments": [to_json(a) for a in e.arguments]}
+    if isinstance(e, Lambda):
+        return {"@type": "lambda", "returnType": str(e.type),
+                "parameters": list(e.parameters), "body": to_json(e.body)}
+    if isinstance(e, LambdaVariable):
+        return {"@type": "lambdavar", "name": e.name, "type": str(e.type)}
     raise TypeError(type(e))
 
 
@@ -147,4 +179,9 @@ def from_json(j: dict) -> RowExpression:
     if t == "special":
         return SpecialForm(T.parse_type(j["returnType"]), j["form"],
                            tuple(from_json(a) for a in j["arguments"]))
+    if t == "lambda":
+        return Lambda(T.parse_type(j["returnType"]),
+                      tuple(j["parameters"]), from_json(j["body"]))
+    if t == "lambdavar":
+        return LambdaVariable(T.parse_type(j["type"]), j["name"])
     raise ValueError(f"unknown RowExpression kind {t!r}")

@@ -180,6 +180,10 @@ def rewrite_bottom_up(e: E.RowExpression,
         args = tuple(rewrite_bottom_up(a, fn) for a in e.arguments)
         if args != e.arguments:
             e = E.SpecialForm(e.type, e.form, args)
+    elif isinstance(e, E.Lambda):
+        body = rewrite_bottom_up(e.body, fn)
+        if body is not e.body:
+            e = E.Lambda(e.type, e.parameters, body)
     return fn(e)
 
 

@@ -40,6 +40,10 @@ __all__ = ["key_words", "num_key_words"]
 
 def _fixed_words(col: Column) -> List[jnp.ndarray]:
     v = col.values
+    if col.type.base == "timestamp with time zone":
+        # order/equality on the INSTANT: same micros in different zones
+        # are the same SQL value (TimestampWithTimeZoneType semantics)
+        v = v >> 12
     if v.dtype == jnp.bool_:
         return [v.astype(jnp.uint64)]
     if v.dtype in (jnp.float32, jnp.float64):

@@ -283,7 +283,9 @@ def _prune(nd: N.PlanNode, needed: Set[int]
             {c: i for i, c in enumerate(keep)})
 
     if isinstance(nd, N.ValuesNode):
-        keep = sorted(needed) or [0]
+        # a zero-column VALUES (the FROM-less SELECT dual row) stays
+        # zero-column; it still carries the row count
+        keep = sorted(needed) or ([0] if nd.types else [])
         if len(keep) == len(nd.types):
             return nd, _ident(width)
         return (dataclasses.replace(

@@ -20,7 +20,11 @@ from . import nodes as N
 __all__ = ["validate_plan"]
 
 _SPECIAL_INTERCEPTED = {"like", "date_add", "date_trunc", "date_diff",
-                        "split_part", "cast", "regexp_like", "date_format"}
+                        "split_part", "cast", "regexp_like", "date_format",
+                        "at_timezone", "regexp_replace", "row_field",
+                        "transform", "filter", "any_match", "all_match",
+                        "none_match", "reduce", "array_constructor",
+                        "sequence"}
 _DATE_UNITS = {"date_add": {"day", "week", "month", "year"},
                "date_trunc": {"day", "week", "month", "quarter", "year"},
                "date_diff": {"day", "week", "month", "quarter", "year"}}

@@ -124,6 +124,8 @@ class StatementServer:
                  query_ttl_s: float = 600.0,
                  tls: Optional[tuple] = None):
         self.sf = sf
+        from ..sql.statements import PreparedStatements
+        self._prepared = PreparedStatements()
         self.page_rows = page_rows
         self.queue_poll_s = queue_poll_s
         self.query_ttl_s = query_ttl_s
@@ -169,13 +171,21 @@ class StatementServer:
     def _default_executor(self, text: str, session_values: Dict,
                           query_id: str, txn_id: Optional[str]):
         from ..sql import sql as run_sql
+        from ..sql.statements import preprocess
         sf = float(session_values.get("sf", self.sf))
         kwargs = {}
         if "max_groups" in session_values:
             kwargs["max_groups"] = int(session_values["max_groups"])
         if "join_capacity" in session_values:
             kwargs["join_capacity"] = int(session_values["join_capacity"])
-        return run_sql(text, sf=sf, **kwargs)
+        # SHOW/DESCRIBE rewrites + per-server prepared statements (the
+        # coordinator session analog of X-Presto-Prepared-Statement)
+        pre = preprocess(text, catalog=session_values.get("catalog", "tpch"),
+                         prepared=self._prepared)
+        if pre.ack is not None:
+            from ..exec.runner import QueryResult
+            return QueryResult([], [], [pre.ack], 0)
+        return run_sql(pre.text, sf=sf, **kwargs)
 
     def _reap_locked(self) -> None:
         """Drop terminal queries (and their materialized result rows)

@@ -60,6 +60,13 @@ class BinOp:
 
 
 @dataclasses.dataclass
+class Lambda:
+    """x -> body or (x, y) -> body (array/map higher-order args)."""
+    params: List[str]
+    body: object
+
+
+@dataclasses.dataclass
 class NotOp:
     arg: object
 
@@ -256,7 +263,7 @@ _TOKEN_RE = re.compile(r"""
       (?P<number>\d+(?:\.\d+)?)
     | (?P<string>'(?:[^']|'')*')
     | (?P<ident>[A-Za-z_][A-Za-z_0-9]*)
-    | (?P<op><>|!=|>=|<=|=|<|>|\+|-|\*|/|%|\(|\)|,|\.)
+    | (?P<op><>|!=|>=|<=|->|=|<|>|\+|-|\*|/|%|\(|\)|,|\.|\[|\])
     )""", re.VERBOSE)
 
 _KEYWORDS = {
@@ -314,6 +321,15 @@ class _Parser:
         if k == "kw" and v in words:
             self.next()
             return v
+        return None
+
+    def accept_ident(self, *words) -> Optional[str]:
+        """Soft keywords: contextual words (AT TIME ZONE, ...) that stay
+        usable as column names elsewhere."""
+        k, v = self.peek()
+        if k == "ident" and v.lower() in words:
+            self.next()
+            return v.lower()
         return None
 
     def expect_kw(self, word: str):
@@ -385,6 +401,26 @@ class _Parser:
     # -- expressions --------------------------------------------------------
 
     def expr(self):
+        # lambda arguments: x -> body  |  (x, y) -> body
+        k, v = self.peek()
+        if k == "ident" and self.toks[self.i + 1] == ("op", "->"):
+            self.next()
+            self.next()
+            return Lambda([v.lower()], self.expr())
+        if (k, v) == ("op", "("):
+            j = self.i + 1
+            params = []
+            while self.toks[j][0] == "ident":
+                params.append(self.toks[j][1].lower())
+                j += 1
+                if self.toks[j] == ("op", ","):
+                    j += 1
+                    continue
+                break
+            if params and self.toks[j] == ("op", ")") \
+                    and self.toks[j + 1] == ("op", "->"):
+                self.i = j + 2
+                return Lambda(params, self.expr())
         return self.or_expr()
 
     def or_expr(self):
@@ -456,7 +492,26 @@ class _Parser:
     def unary(self):
         if self.accept_op("-"):
             return Func("negate", [self.unary()])
-        return self.primary()
+        e = self.primary()
+        # postfix subscript a[i] (1-based; element_at semantics) and
+        # AT TIME ZONE 'zone' -- both bind tighter than arithmetic
+        while True:
+            if self.accept_op("["):
+                idx = self.expr()
+                k2, v2 = self.next()
+                assert (k2, v2) == ("op", "]"), "expected ] after subscript"
+                e = Func("element_at", [e, idx])
+                continue
+            mark = self.i
+            if self.accept_ident("at"):
+                if self.accept_ident("time") and self.accept_ident("zone"):
+                    k, v = self.next()
+                    assert k == "string", "AT TIME ZONE needs a zone string"
+                    e = Func("at_timezone", [e, Literal(v, "string")])
+                    continue
+                self.i = mark  # a column actually named "at"
+            break
+        return e
 
     def primary(self):
         k, v = self.peek()
@@ -480,6 +535,11 @@ class _Parser:
             kk, vv = self.next()
             assert kk == "string"
             return Literal(vv, "date")
+        if k == "ident" and v.lower() in ("timestamp", "time") \
+                and self.toks[self.i + 1][0] == "string":
+            self.next()
+            _, vv = self.next()
+            return Literal(vv, v.lower())
         if k == "kw" and v == "interval":
             self.next()
             kk, vv = self.next()
@@ -522,6 +582,23 @@ class _Parser:
         if k == "op" and v == "*":
             self.next()
             return Star()
+        if k == "ident" and v.lower() == "array" \
+                and self.toks[self.i + 1] == ("op", "["):
+            self.next()
+            self.next()
+            items = []
+            if self.peek() != ("op", "]"):
+                items.append(self.expr())
+                while self.accept_op(","):
+                    items.append(self.expr())
+            k2, v2 = self.next()
+            assert (k2, v2) == ("op", "]"), "expected ] in ARRAY literal"
+            return Func("array_constructor", items)
+        if k == "ident" and v.lower() in ("current_timestamp",
+                                          "current_date", "localtimestamp") \
+                and self.toks[self.i + 1] != ("op", "("):
+            self.next()
+            return Func(v.lower(), [])
         if k in ("ident", "kw"):
             self.next()
             if self.peek() == ("op", "("):
@@ -560,6 +637,23 @@ class _Parser:
 
     def _type_name(self) -> str:
         name = self.expect_ident()
+        # multiword type names: TIMESTAMP WITH TIME ZONE,
+        # INTERVAL YEAR TO MONTH / DAY TO SECOND, DOUBLE PRECISION
+        low = name.lower()
+        if low == "timestamp" and self.peek() == ("kw", "with"):
+            self.next()
+            for w in ("time", "zone"):
+                t = self.next()[1].lower()
+                assert t == w, f"expected {w!r} in type name, got {t!r}"
+            name = "timestamp with time zone"
+        elif low == "interval":
+            a = self.next()[1].lower()
+            self.expect_ident()  # TO
+            b = self.next()[1].lower()
+            name = f"interval {a} to {b}"
+        elif low == "double" and self.peek()[1] == "precision":
+            self.next()
+            name = "double"
         if self.accept_op("("):
             params = [self.next()[1]]
             while self.accept_op(","):
@@ -615,7 +709,12 @@ class _Parser:
         items = [self._select_item()]
         while self.accept_op(","):
             items.append(self._select_item())
-        self.expect_kw("from")
+        if not self.accept_kw("from"):
+            # FROM-less SELECT: one synthetic single-row source (the
+            # reference plans these over a one-row ValuesNode)
+            return Query(Select(items, distinct),
+                         TableRef("$dual", None), [], None, [], None,
+                         [], None)
         table = self._table_ref()
         joins = []
         while True:

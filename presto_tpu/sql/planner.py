@@ -78,6 +78,11 @@ class _Analyzer:
         if isinstance(node, P.Literal):
             return self._literal(node)
         if isinstance(node, P.Name):
+            lvars = getattr(scope, "lambda_vars", None)
+            if lvars and len(node.parts) == 1 \
+                    and node.parts[0].lower() in lvars:
+                nm = node.parts[0].lower()
+                return E.LambdaVariable(lvars[nm], nm)
             ch, ty = scope.resolve(node.parts)
             return E.input_ref(ch, ty)
         if isinstance(node, P.BinOp):
@@ -146,7 +151,23 @@ class _Analyzer:
             return E.const(_days(lit.value), T.DATE)
         if lit.kind == "interval":
             n, unit = lit.value
-            return E.const((n, unit), Type_INTERVAL)
+            unit = unit.lower()
+            if unit in ("year", "month"):
+                months = n * 12 if unit == "year" else n
+                return E.const(months, T.INTERVAL_YM)
+            us = {"week": 7 * 86_400_000_000, "day": 86_400_000_000,
+                  "hour": 3_600_000_000, "minute": 60_000_000,
+                  "second": 1_000_000, "millisecond": 1_000}.get(unit)
+            if us is None:
+                raise NotImplementedError(f"interval unit {unit!r}")
+            return E.const(n * us, T.INTERVAL_DS)
+        if lit.kind == "timestamp":
+            micros, key = _parse_ts_literal(lit.value)
+            if key is None:
+                return E.const(micros, T.TIMESTAMP)
+            return E.const((micros << 12) | key, T.TIMESTAMP_TZ)
+        if lit.kind == "time":
+            return E.const(_parse_time_literal(lit.value), T.TIME)
         raise NotImplementedError(lit.kind)
 
     def _coerce_pair(self, a: E.RowExpression, b: E.RowExpression):
@@ -166,14 +187,32 @@ class _Analyzer:
             name = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt",
                     "<=": "le", ">": "gt", ">=": "ge"}[op]
             return E.call(name, T.BOOLEAN, a, b)
-        # date +/- interval
-        if a.type.base == "date" and isinstance(b, E.Constant) and \
-                b.type is Type_INTERVAL:
-            n, unit = b.value
-            if op == "-":
-                n = -n
-            return E.call("date_add", T.DATE, E.const(unit, T.varchar(7)),
-                          E.const(n, T.BIGINT), a)
+        # datetime +/- interval, interval +/- interval,
+        # datetime - datetime -> INTERVAL DAY TO SECOND
+        _DT = ("date", "time", "timestamp", "timestamp with time zone")
+        _IV = ("interval year to month", "interval day to second")
+        if op in ("+", "-"):
+            if a.type.base in _DT and b.type.base in _IV:
+                if a.type.base == "date" \
+                        and b.type.base == "interval day to second" \
+                        and isinstance(b, E.Constant) \
+                        and b.value is not None \
+                        and b.value % 86_400_000_000 != 0:
+                    raise ValueError(
+                        "Cannot add hour, minutes or seconds to a date")
+                rhs = E.call("negate", b.type, b) if op == "-" else b
+                return E.call("datetime_interval_add",
+                              _dt_plus_interval_type(a.type, b.type),
+                              a, rhs)
+            if op == "+" and a.type.base in _IV and b.type.base in _DT:
+                return E.call("datetime_interval_add",
+                              _dt_plus_interval_type(b.type, a.type), b, a)
+            if a.type.base in _IV and b.type.base == a.type.base:
+                return E.call("add" if op == "+" else "subtract",
+                              a.type, a, b)
+            if op == "-" and a.type.base in _DT and b.type.base in _DT \
+                    and "time" not in (a.type.base, b.type.base):
+                return E.call("datetime_diff_micros", T.INTERVAL_DS, a, b)
         name = {"+": "add", "-": "subtract", "*": "multiply", "/": "divide",
                 "%": "modulus"}[op]
         rty = self._arith_type(name, a.type, b.type)
@@ -206,6 +245,8 @@ class _Analyzer:
 
     def _func(self, node: P.Func, scope: _Scope) -> E.RowExpression:
         name = node.name
+        if any(isinstance(a, P.Lambda) for a in node.args):
+            return self._lambda_func(node, scope)
         args = [self.lower(a, scope) for a in node.args
                 if not isinstance(a, P.Star)]
         # special forms spelled as functions (branch types align to the
@@ -228,14 +269,85 @@ class _Analyzer:
             # kernels are total (errors produce NULL lanes, never raise),
             # so TRY is the identity on this engine
             return args[0]
+        if name in ("now", "current_timestamp"):
+            from .. import tz as _tz
+            return E.const(_statement_now_us() << 12 | _tz.UTC_KEY,
+                           T.TIMESTAMP_TZ)
+        if name == "current_date":
+            return E.const(_statement_now_us() // 86_400_000_000, T.DATE)
+        if name == "localtimestamp":
+            return E.const(_statement_now_us(), T.TIMESTAMP)
         rty = self._func_type(name, args)
         return E.call(name, rty, *args)
+
+    def _lambda_func(self, node: P.Func, scope: _Scope) -> E.RowExpression:
+        """Array/map higher-order functions (ArrayTransformFunction.java
+        family): lambda bodies lower with parameters as LambdaVariables;
+        captures stay plain InputReferences of the enclosing scope."""
+        name = node.name
+
+        def lower_lambda(lam: P.Lambda, param_types) -> E.Lambda:
+            assert len(lam.params) == len(param_types), \
+                f"{name} lambda takes {len(param_types)} parameter(s)"
+            import copy
+            ls = _Scope(dict(scope.channels), list(scope.types))
+            ls.lambda_vars = {**(getattr(scope, "lambda_vars", None) or {}),
+                              **dict(zip(lam.params, param_types))}
+            body = self.lower(lam.body, ls)
+            return E.Lambda(body.type, tuple(lam.params), body)
+
+        arr = self.lower(node.args[0], scope)
+        if arr.type.base != "array":
+            raise NotImplementedError(f"{name} over {arr.type}")
+        ety = arr.type.element_type
+        if name == "transform":
+            lam = lower_lambda(node.args[1], [ety])
+            return E.call("transform", T.array_of(lam.type), arr, lam)
+        if name == "filter":
+            lam = lower_lambda(node.args[1], [ety])
+            return E.call("filter", arr.type, arr, lam)
+        if name in ("any_match", "all_match", "none_match"):
+            lam = lower_lambda(node.args[1], [ety])
+            return E.call(name, T.BOOLEAN, arr, lam)
+        if name == "reduce":
+            init = self.lower(node.args[1], scope)
+            comb = lower_lambda(node.args[2], [init.type, ety])
+            if comb.type != init.type:
+                raise NotImplementedError(
+                    "reduce state type must stay fixed "
+                    f"({init.type} vs {comb.type})")
+            out = lower_lambda(node.args[3], [init.type])
+            return E.call("reduce", out.type, arr, init, comb, out)
+        raise NotImplementedError(f"lambda function {name!r}")
 
     def _func_type(self, name: str, args: List[E.RowExpression]) -> T.Type:
         if name in ("year", "month", "day", "quarter", "length", "strpos",
                     "position", "codepoint", "day_of_week", "day_of_year",
-                    "date_diff", "sign"):
+                    "date_diff", "sign", "hour", "minute", "second",
+                    "millisecond", "timezone_hour", "timezone_minute",
+                    "json_array_length", "json_size", "crc32",
+                    "regexp_position", "regexp_count"):
             return T.BIGINT
+        if name == "at_timezone":
+            return T.TIMESTAMP_TZ
+        if name in ("json_parse", "json_extract"):
+            return T.JSON
+        if name == "json_format":
+            return T.varchar(args[0].type.max_length)
+        if name == "json_extract_scalar":
+            return T.varchar(args[0].type.max_length)
+        if name in ("json_array_contains", "is_json_scalar"):
+            return T.BOOLEAN
+        if name in ("regexp_extract", "regexp_replace"):
+            return T.varchar()
+        if name == "to_hex":
+            w = args[0].type.max_length
+            return T.varchar(2 * w if w < T.UNBOUNDED_LENGTH else w)
+        if name in ("from_hex", "to_utf8", "md5", "sha1", "sha256",
+                    "sha512"):
+            return T.VARBINARY
+        if name == "from_utf8":
+            return T.varchar(args[0].type.max_length)
         if name in ("upper", "lower", "trim", "ltrim", "rtrim", "reverse",
                     "substr", "split_part"):
             return args[0].type
@@ -283,6 +395,13 @@ class _Analyzer:
             return args[0].type
         if name == "cardinality":
             return T.BIGINT
+        if name == "array_constructor":
+            ety = _case_result_type(args) if args else T.UNKNOWN
+            return T.array_of(ety)
+        if name == "sequence":
+            return T.array_of(T.BIGINT)
+        if name in ("array_distinct", "array_sort", "slice"):
+            return args[0].type
         if name == "element_at":
             t0 = args[0].type
             if t0.base == "map":
@@ -343,7 +462,50 @@ class _Analyzer:
         return out
 
 
-Type_INTERVAL = T.Type("interval")
+def _dt_plus_interval_type(dt: T.Type, iv: T.Type) -> T.Type:
+    """Result type of datetime + interval: every datetime keeps its
+    type (DateTimeOperators.java -- date + interval day-to-second stays
+    DATE; sub-day components are rejected at plan time in _binop, the
+    'Cannot add hour, minutes or seconds to a date' rule)."""
+    return dt
+
+
+def _parse_ts_literal(s: str):
+    """TIMESTAMP 'YYYY-MM-DD hh:mm:ss[.fff][ zone]' -> (utc_micros,
+    zone_key or None)."""
+    import datetime as _dt
+    import re as _re
+    from .. import tz as _tz
+    s = s.strip()
+    key = None
+    m = _re.match(r"^(.*?)(?:\s+([A-Za-z_/]+(?:/[A-Za-z_]+)?)|"
+                  r"\s*([+-]\d{2}:?\d{2}))$", s)
+    body = s
+    if m and (m.group(2) or m.group(3)):
+        try:
+            key = _tz.zone_key(m.group(2) or m.group(3))
+            body = m.group(1).strip()
+        except ValueError:
+            key = None  # not a zone suffix after all
+    if " " not in body and "T" not in body:
+        body += " 00:00:00"
+    d = _dt.datetime.fromisoformat(body)
+    micros = (int(_dt.datetime(d.year, d.month, d.day,
+                               tzinfo=_dt.timezone.utc).timestamp())
+              * 1_000_000
+              + (d.hour * 3600 + d.minute * 60 + d.second) * 1_000_000
+              + d.microsecond)
+    if key is not None:
+        # wall clock in `zone` -> UTC instant
+        micros -= (key - _tz.UTC_KEY) * 60_000_000
+    return micros, key
+
+
+def _parse_time_literal(s: str) -> int:
+    import datetime as _dt
+    t = _dt.time.fromisoformat(s.strip())
+    return ((t.hour * 3600 + t.minute * 60 + t.second) * 1_000_000
+            + t.microsecond)
 
 
 def _agg_output_type(name: str, input_type: Optional[T.Type]) -> T.Type:
@@ -379,6 +541,21 @@ _SEARCH_PATH: contextvars.ContextVar = contextvars.ContextVar(
 # once (exec/planner memoizes by node identity), so a CTE referenced k
 # times is scanned and computed once -- the LogicalCteOptimizer analog,
 # realized by compiler-level sharing instead of materialization.
+# one clock read per statement: every now()/current_* occurrence in a
+# statement sees the SAME instant (the reference fixes the session start
+# time per query)
+_STMT_NOW_US: contextvars.ContextVar = contextvars.ContextVar(
+    "stmt_now_us", default=None)
+
+
+def _statement_now_us() -> int:
+    v = _STMT_NOW_US.get()
+    if v is None:
+        import time
+        v = time.time_ns() // 1000
+    return v
+
+
 _SUBPLAN_CACHE: contextvars.ContextVar = contextvars.ContextVar(
     "subplan_cache", default=None)
 
@@ -395,6 +572,8 @@ def plan_sql(query_text: str, max_groups: int = 1 << 16,
                                   if c != catalog)
         token = _SEARCH_PATH.set(path)
     cache_token = _SUBPLAN_CACHE.set({})
+    import time as _time
+    now_token = _STMT_NOW_US.set(_time.time_ns() // 1000)
     try:
         if isinstance(ast, (P.Insert, P.CreateTableAs, P.DropTable,
                             P.Delete, P.Update)):
@@ -402,6 +581,7 @@ def plan_sql(query_text: str, max_groups: int = 1 << 16,
         node, names = _plan_any(ast, max_groups, join_capacity)
     finally:
         _SUBPLAN_CACHE.reset(cache_token)
+        _STMT_NOW_US.reset(now_token)
         if token is not None:
             _SEARCH_PATH.reset(token)
     if isinstance(node, N.OutputNode):
@@ -763,6 +943,12 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
                                      zip(sub_names, sub_types)}
             derived_plans[t.name] = (sub_node,
                                      [n.lower() for n in sub_names])
+        elif t.name == "$dual":
+            # FROM-less SELECT: a one-row zero-column source (the
+            # reference's single-row ValuesNode for SELECT <exprs>)
+            table_catalog[t.name] = None
+            table_schemas[t.name] = {}
+            derived_plans[t.name] = (N.ValuesNode([], [[]]), [])
         else:
             cat, bare, sch = find_table(t.name)
             table_catalog[t.name] = (cat, bare)
@@ -789,9 +975,14 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
         if col not in referenced[hits[0].name]:
             referenced[hits[0].name].append(col)
 
-    def collect_names(n):
+    def collect_names(n, shadowed=frozenset()):
         if isinstance(n, P.Name):
+            if len(n.parts) == 1 and n.parts[0].lower() in shadowed:
+                return  # a lambda parameter, not a column
             note_name(n.parts)
+        elif isinstance(n, P.Lambda):
+            collect_names(n.body,
+                          shadowed | {p.lower() for p in n.params})
         elif isinstance(n, P.InSubquery):
             collect_names(n.value)  # the subquery has its own table scope
         elif isinstance(n, P.ScalarSubquery):
@@ -803,15 +994,15 @@ def _plan_query(q: P.Query, max_groups: int = 1 << 16,
             for f in dataclasses.fields(n):
                 v = getattr(n, f.name)
                 if dataclasses.is_dataclass(v):
-                    collect_names(v)
+                    collect_names(v, shadowed)
                 elif isinstance(v, (list, tuple)):
                     for x in v:
                         if dataclasses.is_dataclass(x):
-                            collect_names(x)
+                            collect_names(x, shadowed)
                         elif isinstance(x, tuple):
                             for y in x:
                                 if dataclasses.is_dataclass(y):
-                                    collect_names(y)
+                                    collect_names(y, shadowed)
 
     for item in q.select.items:
         collect_names(item.expr)
@@ -2313,6 +2504,13 @@ def sql(query_text: str, sf: float = 0.01, mesh=None,
     """One-call SQL execution over the session catalogs: the query-runner
     front door (DistributedQueryRunner.execute analog)."""
     from ..exec import run_query
+    from .statements import _DEFAULT_PREPARED, preprocess
+    pre = preprocess(query_text, catalog=catalog or "tpch",
+                     prepared=_DEFAULT_PREPARED)
+    if pre.ack is not None:
+        from ..exec.runner import QueryResult
+        return QueryResult([], [], [pre.ack], 0)
+    query_text = pre.text
     root = plan_sql(query_text, max_groups=max_groups,
                     join_capacity=join_capacity, catalog=catalog)
     if join_capacity is not None:

@@ -34,7 +34,8 @@ import numpy as np
 __all__ = [
     "Type",
     "BOOLEAN", "TINYINT", "SMALLINT", "INTEGER", "BIGINT",
-    "REAL", "DOUBLE", "DATE", "TIMESTAMP", "UNKNOWN",
+    "REAL", "DOUBLE", "DATE", "TIME", "TIMESTAMP", "TIMESTAMP_TZ",
+    "VARBINARY", "JSON", "INTERVAL_YM", "INTERVAL_DS", "UNKNOWN",
     "varchar", "char", "decimal", "array_of", "map_of", "row_of",
     "parse_type",
 ]
@@ -64,7 +65,10 @@ class Type:
 
     @property
     def is_string(self) -> bool:
-        return self.base in ("varchar", "char")
+        """Types stored as (padded uint8 char matrix, lengths): text,
+        raw bytes (VARBINARY) and canonical JSON text share the layout;
+        semantic distinctions live in the function layer."""
+        return self.base in ("varchar", "char", "varbinary", "json")
 
     @property
     def is_numeric(self) -> bool:
@@ -164,7 +168,14 @@ _DTYPES = {
     "real": np.float32,
     "double": np.float64,
     "date": np.int32,
-    "timestamp": np.int64,
+    "time": np.int64,                     # micros since midnight
+    "timestamp": np.int64,                # micros since epoch
+    # packed (utc_micros << 12) | zone_key -- the reference's
+    # TimestampWithTimeZoneType packing (millis<<12|key) adapted to this
+    # engine's micros; comparisons/keys unpack to the instant
+    "timestamp with time zone": np.int64,
+    "interval year to month": np.int64,   # months
+    "interval day to second": np.int64,   # micros
     "unknown": np.bool_,
 }
 
@@ -176,7 +187,13 @@ BIGINT = Type("bigint")
 REAL = Type("real")
 DOUBLE = Type("double")
 DATE = Type("date")
+TIME = Type("time")
 TIMESTAMP = Type("timestamp")
+TIMESTAMP_TZ = Type("timestamp with time zone")
+VARBINARY = Type("varbinary")
+JSON = Type("json")
+INTERVAL_YM = Type("interval year to month")
+INTERVAL_DS = Type("interval day to second")
 UNKNOWN = Type("unknown")  # the NULL literal's type
 
 
@@ -213,9 +230,20 @@ def row_of(*fields) -> Type:
 
 _TOKEN = re.compile(r"\s*([(),]|[^\s(),]+)")
 
+# multiword base names fold to one token for the parser, then unfold
+_MULTIWORD = {
+    "timestamp with time zone": "timestamp_with_time_zone",
+    "interval year to month": "interval_year_to_month",
+    "interval day to second": "interval_day_to_second",
+}
+_UNFOLD = {v: k for k, v in _MULTIWORD.items()}
+
 
 def parse_type(signature: str) -> Type:
-    tokens = _TOKEN.findall(signature)
+    for phrase, folded in _MULTIWORD.items():
+        signature = re.sub(re.escape(phrase), folded, signature,
+                           flags=re.IGNORECASE)
+    tokens = [_UNFOLD.get(t.lower(), t) for t in _TOKEN.findall(signature)]
     ty, rest = _parse(tokens)
     if rest:
         raise ValueError(f"trailing tokens in type signature {signature!r}: {rest}")

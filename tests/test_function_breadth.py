@@ -1,0 +1,239 @@
+"""Round-5 function/type breadth vs Python oracles.
+
+Reference surface: operator/scalar/DateTimeFunctions.java (zoned
+timestamps, intervals), JsonFunctions.java, ArrayTransformFunction.java
+and friends (lambdas), VarbinaryFunctions.java (hex/digests),
+TimestampWithTimeZoneType.java (instant comparison semantics)."""
+
+import hashlib
+import json
+import re
+
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.sql import sql
+
+
+def one(q, **kw):
+    return sql(f"SELECT {q} FROM region LIMIT 1", sf=0.01, **kw).rows()[0][0]
+
+
+# ---- types ---------------------------------------------------------------
+
+def test_new_type_signatures_parse():
+    assert T.parse_type("timestamp with time zone") == T.TIMESTAMP_TZ
+    assert T.parse_type("TIMESTAMP WITH TIME ZONE") == T.TIMESTAMP_TZ
+    assert T.parse_type("interval day to second") == T.INTERVAL_DS
+    assert T.parse_type("interval year to month") == T.INTERVAL_YM
+    assert T.parse_type("varbinary") == T.VARBINARY
+    assert T.parse_type("json") == T.JSON
+    assert T.parse_type("time") == T.TIME
+    assert T.parse_type("array(json)").element_type == T.JSON
+
+
+def test_varbinary_and_json_share_string_layout():
+    assert T.VARBINARY.is_string and T.JSON.is_string
+    assert T.VARBINARY.to_dtype().name == "uint8"
+
+
+# ---- zoned timestamps ----------------------------------------------------
+
+def test_timestamp_literal_and_fields():
+    # 2020-03-01 12:30:45 UTC
+    us = one("cast(timestamp '2020-03-01 12:30:45' as bigint)")
+    assert us == 1583065845000000
+    assert one("hour(timestamp '2020-03-01 12:30:45')") == 12
+    assert one("minute(timestamp '2020-03-01 12:30:45')") == 30
+    assert one("second(timestamp '2020-03-01 12:30:45')") == 45
+
+
+def test_at_time_zone_changes_wall_clock_not_instant():
+    base = "timestamp '2020-03-01 12:30:45'"
+    assert one(f"hour({base} AT TIME ZONE '+05:30')") == 18
+    assert one(f"minute({base} AT TIME ZONE '+05:30')") == 0
+    assert one(f"timezone_hour({base} AT TIME ZONE '-08:00')") == -8
+    # the instant is unchanged: equality is by instant
+    assert bool(one(f"{base} AT TIME ZONE '+05:30' = "
+                    f"{base} AT TIME ZONE 'UTC'"))
+
+
+def test_timestamptz_comparison_across_zones():
+    # 13:00 +01:00 == 12:00 UTC as instants
+    a = "timestamp '2020-01-01 13:00:00 +01:00'"
+    b = "timestamp '2020-01-01 12:00:00 UTC'"
+    assert bool(one(f"{a} = {b}"))
+    assert bool(one(f"{a} < timestamp '2020-01-01 12:00:01 UTC'"))
+
+
+def test_cast_timestamptz_to_timestamp_is_local():
+    v = one("cast(cast(timestamp '2020-01-01 12:00:00' AT TIME ZONE "
+            "'+02:00' as timestamp) as bigint)")
+    # 12:00 UTC (= 1577880000000000 us) viewed at +02:00 is 14:00 local
+    assert v == 1577880000000000 + 2 * 3600 * 1_000_000
+
+
+# ---- intervals -----------------------------------------------------------
+
+def test_interval_day_second_arithmetic():
+    assert one("cast(cast(date '2020-01-01' as timestamp) + "
+               "interval '36' hour as bigint)") \
+        == (18262 * 86400 + 36 * 3600) * 1_000_000
+    assert one("cast(timestamp '2020-01-01 00:00:00' - interval '90' minute "
+               "as bigint)") == 1577836800000000 - 90 * 60 * 1_000_000
+    # whole-day interval keeps DATE (the q1 idiom); sub-day is rejected
+    assert bool(one("date '1998-12-01' - interval '90' day = "
+                    "date '1998-09-02'"))
+    with pytest.raises(ValueError, match="to a date"):
+        one("date '2020-01-01' + interval '1' hour")
+
+
+def test_interval_month_clamps_end_of_month():
+    # Jan 31 + 1 month -> Feb 29 (2020 is a leap year)
+    v = one("cast(timestamp '2020-01-31 10:00:00' + interval '1' month "
+            "as bigint)")
+    assert v == 1582970400000000  # 2020-02-29 10:00:00 UTC
+    # date stays a date under year-month intervals
+    assert one("date '2020-03-31' + interval '1' month = date '2020-04-30'")
+
+
+def test_timestamp_minus_timestamp_is_interval():
+    us = one("cast(timestamp '2020-01-02 00:00:00' - "
+             "timestamp '2020-01-01 12:00:00' as bigint)")
+    assert us == 12 * 3600 * 1_000_000
+
+
+def test_time_literal():
+    assert one("cast(time '12:34:56' as bigint)") == \
+        (12 * 3600 + 34 * 60 + 56) * 1_000_000
+
+
+# ---- JSON ----------------------------------------------------------------
+
+def test_json_family_oracle():
+    doc = '{"a": {"b": [1, 42, 7]}, "s": "x"}'
+    assert one(f"json_extract_scalar('{doc}', '$.a.b[1]')") == "42"
+    assert one(f"json_extract('{doc}', '$.a.b')") == "[1,42,7]"
+    assert one(f"json_extract_scalar('{doc}', '$.s')") == "x"
+    assert one(f"json_extract_scalar('{doc}', '$.missing')") is None
+    assert one(f"json_size('{doc}', '$.a')") == 1
+    assert one(f"json_size('{doc}', '$.a.b')") == 3
+    assert one("json_array_length(json_parse('[1, 2, 3]'))") == 3
+    assert bool(one("json_array_contains('[1, 2, 3]', 2)"))
+    assert not bool(one("json_array_contains('[1, 2, 3]', 9)"))
+    assert bool(one("json_array_contains('[\"a\", \"b\"]', 'b')"))
+    assert bool(one("is_json_scalar('42')"))
+    assert not bool(one("is_json_scalar('[1]')"))
+    # malformed JSON -> NULL, not an error
+    assert one("json_array_length('{nope')") is None
+
+
+# ---- regex ---------------------------------------------------------------
+
+def test_regexp_extract_family_matches_python_re():
+    assert one(r"regexp_extract('presto-tpu-42', '(\d+)')") == "42"
+    assert one(r"regexp_extract('a1b22', '([a-z])(\d+)', 2)") == "1"
+    assert one(r"regexp_extract('abc', '(\d+)')") is None
+    assert one(r"regexp_replace('a1b22c', '\d+', 'X')") == "aXbXc"
+    assert one(r"regexp_replace('x=1,y=2', '(\w)=(\d)', '$2')") == "1,2"
+    assert one(r"regexp_position('hello world', 'wor')") == 7
+    assert one(r"regexp_count('a1b22c333', '\d+')") == 3
+
+
+# ---- varbinary -----------------------------------------------------------
+
+def test_hex_utf8_digests():
+    assert one("to_hex(to_utf8('AB'))") == "4142"
+    assert one("from_utf8(from_hex('4142'))") == "AB"
+    assert one("length(to_utf8('abc'))") == 3
+    md5 = hashlib.md5(b"abc").hexdigest().upper()
+    assert one("to_hex(md5(to_utf8('abc')))") == md5
+    sha = hashlib.sha256(b"abc").hexdigest().upper()
+    assert one("to_hex(sha256(to_utf8('abc')))") == sha
+    import zlib
+    assert one("crc32(to_utf8('abc'))") == zlib.crc32(b"abc")
+
+
+# ---- lambdas -------------------------------------------------------------
+
+def test_array_lambdas_oracle():
+    assert one("transform(sequence(1, 4), x -> x * 10)") == [10, 20, 30, 40]
+    assert one("filter(sequence(1, 6), x -> x % 2 = 0)") == [2, 4, 6]
+    assert one("reduce(sequence(1, 5), 0, (s, x) -> s + x, s -> s)") == 15
+    assert one("reduce(sequence(1, 5), 1, (s, x) -> s * x, s -> s)") == 120
+    assert bool(one("any_match(sequence(1, 5), x -> x > 4)"))
+    assert not bool(one("any_match(sequence(1, 5), x -> x > 5)"))
+    assert bool(one("all_match(sequence(1, 5), x -> x > 0)"))
+    assert bool(one("none_match(sequence(1, 5), x -> x > 9)"))
+
+
+def test_lambda_captures_outer_columns():
+    rows = sql("SELECT regionkey, "
+               "transform(sequence(1, 3), x -> x + regionkey) t, "
+               "filter(sequence(1, 4), x -> x <= regionkey) f "
+               "FROM region ORDER BY regionkey", sf=0.01).rows()
+    for rk, t, f in rows:
+        assert t == [1 + rk, 2 + rk, 3 + rk]
+        assert f == [x for x in (1, 2, 3, 4) if x <= rk]
+
+
+def test_nested_transform_in_aggregation_query():
+    got = sql("SELECT sum(reduce(sequence(1, 3), 0, (s, x) -> s + x * "
+              "regionkey, s -> s)) FROM region", sf=0.01).rows()[0][0]
+    # sum over regionkey 0..4 of 6*rk
+    assert got == 6 * (0 + 1 + 2 + 3 + 4)
+
+
+# ---- array algebra -------------------------------------------------------
+
+def test_array_constructor_subscript_sort_distinct_slice():
+    assert one("ARRAY[3, 1, 2]") == [3, 1, 2]
+    assert one("ARRAY[3, 1, 2][2]") == 1
+    assert one("array_sort(ARRAY[3, 1, 2])") == [1, 2, 3]
+    assert one("array_distinct(ARRAY[3, 1, 3, 2, 1])") == [3, 1, 2]
+    assert one("slice(ARRAY[1, 2, 3, 4], 2, 2)") == [2, 3]
+    assert one("slice(ARRAY[1, 2, 3, 4], -2, 2)") == [3, 4]
+    assert one("cardinality(filter(ARRAY[1, 2, 3], x -> x > 1))") == 2
+
+
+# ---- current_* -----------------------------------------------------------
+
+def test_current_timestamp_is_sane():
+    import time
+    v = one("cast(cast(current_timestamp as timestamp) as bigint)")
+    now_us = time.time() * 1e6
+    assert abs(v - now_us) < 3600 * 1e6  # within an hour of host clock
+    d = one("current_date")
+    assert abs(d - int(time.time() // 86400)) <= 1
+
+
+def test_now_is_fixed_per_statement():
+    assert bool(one("now() = now()"))
+
+
+def test_lambda_plans_survive_json_round_trip():
+    from presto_tpu.expr import ir as E
+    from presto_tpu import types as T
+    lam = E.Lambda(T.BIGINT, ("x",),
+                   E.call("add", T.BIGINT,
+                          E.LambdaVariable(T.BIGINT, "x"),
+                          E.input_ref(0, T.BIGINT)))
+    c = E.call("transform", T.array_of(T.BIGINT),
+               E.input_ref(1, T.array_of(T.BIGINT)), lam)
+    assert E.from_json(E.to_json(c)) == c
+
+
+def test_json_parse_canonicalization_longer_than_input():
+    # '1e2' canonicalizes to '100.0' -- longer than the input text
+    assert one("json_parse('[1e2,1e2,1e2,1e2,1e2]')") == \
+        "[100.0,100.0,100.0,100.0,100.0]"
+
+
+def test_json_array_contains_boolean_vs_number():
+    assert not bool(one("json_array_contains('[1, 2]', true)"))
+    assert bool(one("json_array_contains('[true]', true)"))
+    assert not bool(one("json_array_contains('[true]', 1)"))
+
+
+def test_slice_start_zero_is_null():
+    assert one("slice(ARRAY[1, 2, 3], 0, 2)") is None

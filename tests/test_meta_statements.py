@@ -1,0 +1,100 @@
+"""SHOW / DESCRIBE / information_schema / prepared statements.
+
+Reference behavior: ShowQueriesRewrite.java (SHOW X -> information_
+schema SELECTs), connector/informationSchema/ (the metadata tables BI
+tools introspect), and the PREPARE/EXECUTE/DEALLOCATE statement path."""
+
+import pytest
+
+from presto_tpu.sql import sql
+from presto_tpu.sql.statements import (PreparedStatements, preprocess)
+
+
+def test_show_catalogs_lists_registry():
+    cats = [r[0] for r in sql("SHOW CATALOGS", sf=0.01).rows()]
+    for expected in ("tpch", "tpcds", "memory", "system",
+                     "information_schema"):
+        assert expected in cats
+
+
+def test_show_tables_and_columns():
+    tabs = [r[0] for r in sql("SHOW TABLES FROM tpch", sf=0.01).rows()]
+    assert tabs == sorted(tabs)
+    assert {"lineitem", "orders", "region"} <= set(tabs)
+    cols = sql("SHOW COLUMNS FROM region", sf=0.01).rows()
+    assert [c[0] for c in cols] == ["regionkey", "name", "comment"]
+    assert cols[0][1] == "bigint"
+
+
+def test_describe_matches_show_columns():
+    a = sql("DESCRIBE tpch.nation", sf=0.01).rows()
+    b = sql("SHOW COLUMNS FROM tpch.nation", sf=0.01).rows()
+    assert a == b and len(a) == 4
+
+
+def test_information_schema_directly_queryable():
+    n = sql("SELECT count(*) FROM information_schema.columns "
+            "WHERE table_catalog = 'tpch'", sf=0.01).rows()[0][0]
+    assert n == 61  # 8 TPC-H tables' column count
+
+
+def test_show_session_and_functions():
+    rows = sql("SHOW SESSION", sf=0.01).rows()
+    names = [r[0] for r in rows]
+    assert "join_distribution_type" in names
+    assert "join_reordering_strategy" in names
+    fns = sql("SHOW FUNCTIONS", sf=0.01).rows()
+    kinds = {r[1] for r in fns}
+    assert kinds == {"scalar", "aggregate", "window"}
+    assert ("json_extract", "scalar") in [tuple(r) for r in fns]
+
+
+def test_prepare_execute_deallocate_cycle():
+    prep = PreparedStatements()
+    p = preprocess("PREPARE s FROM SELECT ? + ?", prepared=prep)
+    assert p.ack == "PREPARE" and "s" in prep
+    p = preprocess("EXECUTE s USING 2, 3", prepared=prep)
+    assert p.text == "SELECT (2) + (3)"
+    p = preprocess("DEALLOCATE PREPARE s", prepared=prep)
+    assert p.ack == "DEALLOCATE" and "s" not in prep
+    with pytest.raises(KeyError):
+        preprocess("EXECUTE s", prepared=prep)
+
+
+def test_prepared_parameters_respect_strings_and_arity():
+    prep = PreparedStatements()
+    preprocess("PREPARE s FROM SELECT * FROM t WHERE a = ? AND b = '?'",
+               prepared=prep)
+    p = preprocess("EXECUTE s USING 'x,y'", prepared=prep)
+    # the ? inside the string literal is NOT a parameter
+    assert p.text == "SELECT * FROM t WHERE a = ('x,y') AND b = '?'"
+    with pytest.raises(ValueError):
+        preprocess("EXECUTE s USING 1, 2", prepared=prep)
+
+
+def test_prepare_execute_end_to_end():
+    sql("PREPARE pq FROM SELECT count(*) FROM lineitem "
+        "WHERE quantity < ?", sf=0.01)
+    n10 = sql("EXECUTE pq USING 10", sf=0.01).rows()[0][0]
+    n50 = sql("EXECUTE pq USING 50", sf=0.01).rows()[0][0]
+    assert 0 < n10 < n50
+    sql("DEALLOCATE PREPARE pq", sf=0.01)
+
+
+def test_statement_server_serves_show_and_prepare():
+    from presto_tpu.client import execute
+    from presto_tpu.server.statement import StatementServer
+    with StatementServer(sf=0.01) as srv:
+        rows = execute(srv.url, "SHOW TABLES FROM tpch").data
+        assert ["region"] in [list(r) for r in rows]
+        execute(srv.url, "PREPARE sq FROM SELECT 3 * ?")
+        got = execute(srv.url, "EXECUTE sq USING 14").data
+        assert got == [[42]]
+
+
+def test_show_tables_like_filters():
+    tabs = [r[0] for r in sql("SHOW TABLES FROM tpch LIKE 'p%'",
+                              sf=0.01).rows()]
+    assert tabs == ["part", "partsupp"]
+    with pytest.raises(ValueError, match="SHOW clause tail"):
+        sql("SHOW TABLES WHERE x", sf=0.01)
