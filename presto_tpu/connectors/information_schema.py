@@ -34,15 +34,9 @@ SCHEMA = {
 
 def _schema_dict(cat: str, mod) -> dict:
     sch = getattr(mod, "SCHEMA", None) or {}
-    # tpch/tpcds expose list-of-(name, type) per table; memory/system
-    # expose dicts -- normalize
-    out = {}
-    for t, cols in sch.items():
-        if isinstance(cols, dict):
-            out[t] = dict(cols)
-        else:
-            out[t] = dict(cols)
-    return out
+    # dict() normalizes both connector schema shapes: tpch/tpcds expose
+    # list-of-(name, type) per table, memory/system expose dicts
+    return {t: dict(cols) for t, cols in sch.items()}
 
 
 def _rows_of(table: str) -> List[tuple]:

@@ -153,15 +153,33 @@ def row_groups_matching(table: str,
         return list(range(md.num_row_groups))
     col, lo, hi = predicate
     ci = schema.get_field_index(col)
+
+    def _engine_repr(v):
+        """Parquet stat value -> this engine's lane representation
+        (dates = epoch days, timestamps = micros, decimals = scaled)."""
+        import datetime
+        import decimal
+        if isinstance(v, datetime.datetime):
+            return int(v.replace(tzinfo=datetime.timezone.utc)
+                       .timestamp() * 1_000_000)
+        if isinstance(v, datetime.date):
+            return (v - datetime.date(1970, 1, 1)).days
+        if isinstance(v, decimal.Decimal):
+            exp = -v.as_tuple().exponent
+            return int(v.scaleb(exp))
+        return v
+
     out = []
     for g in range(md.num_row_groups):
         st = md.row_group(g).column(ci).statistics
         if st is None or not st.has_min_max:
             out.append(g)
             continue
-        if lo is not None and st.max is not None and st.max < lo:
+        smax = _engine_repr(st.max) if st.max is not None else None
+        smin = _engine_repr(st.min) if st.min is not None else None
+        if lo is not None and smax is not None and smax < lo:
             continue
-        if hi is not None and st.min is not None and st.min > hi:
+        if hi is not None and smin is not None and smin > hi:
             continue
         out.append(g)
     return out
@@ -214,6 +232,8 @@ def _read(table: str, columns: Sequence[str], start: int, count: int,
         schema = _tables[table]["schema"]
     groups = row_groups_matching(table, predicate)
     md = pf.metadata
+    read_stats["groups_total"] += md.num_row_groups
+    read_stats["groups_read"] += len(groups)
     out_tables = []
     seen = 0
     for g in range(md.num_row_groups):
@@ -318,3 +338,163 @@ def data_version(table: str) -> float:
     (what the pinned reader handle actually serves)."""
     with _lock:
         return _tables[table]["mtime"]
+
+
+# ---------------------------------------------------------------------------
+# Read statistics (pruning evidence) + the writer sink
+# (ConnectorPageSink analog: INSERT/CTAS land as parquet files with
+# staged-then-atomic-replace commit semantics; presto-parquet writer +
+# presto-spi ConnectorPageSink.java)
+# ---------------------------------------------------------------------------
+
+read_stats = {"groups_total": 0, "groups_read": 0}
+
+
+def _warehouse_dir() -> str:
+    import os
+    import tempfile
+    d = _config.get("warehouse") or os.path.join(
+        tempfile.gettempdir(), "presto_tpu_warehouse")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+_config: Dict[str, object] = {"warehouse": None}
+_write_locks: Dict[str, threading.Lock] = {}
+_pending: Dict[str, dict] = {}
+
+
+def set_warehouse(path: Optional[str]) -> None:
+    """Directory where CTAS-created tables land (None = tempdir)."""
+    _config["warehouse"] = path
+
+
+def write_lock(table: str):
+    with _lock:
+        lk = _write_locks.setdefault(table, threading.Lock())
+    return lk
+
+
+def create_table(name: str, columns: Sequence[str],
+                 types: Sequence[T.Type],
+                 if_not_exists: bool = False) -> None:
+    import os
+    with _lock:
+        if name in _tables:
+            if if_not_exists:
+                return
+            raise KeyError(f"parquet table {name!r} already exists")
+    path = os.path.join(_warehouse_dir(), f"{name}.parquet")
+    write_table(path, {c: np.array([], dtype=object) for c in columns},
+                dict(zip(columns, types)))
+    register_table(name, path)
+
+
+def drop_table(name: str, if_exists: bool = False) -> None:
+    import os
+    with _lock:
+        ent = _tables.pop(name, None)
+    if ent is None:
+        if if_exists:
+            return
+        raise KeyError(f"no parquet table {name!r}")
+    # only reclaim files this connector owns (warehouse CTAS output);
+    # externally registered files are the user's
+    if ent["path"].startswith(_warehouse_dir()):
+        try:
+            os.remove(ent["path"])
+        except OSError:
+            pass
+
+
+def begin_insert(table: str,
+                 create_columns: Optional[Sequence[str]] = None,
+                 create_types: Optional[Sequence[T.Type]] = None) -> str:
+    import uuid
+    created = False
+    if create_columns is not None:
+        create_table(table, create_columns, create_types)
+        created = True
+    with _lock:
+        if table not in _tables:
+            raise KeyError(f"no parquet table {table!r}")
+        schema = _tables[table]["schema"]
+    h = f"pins_{uuid.uuid4().hex[:12]}"
+    _pending[h] = {"table": table, "created": created,
+                   "columns": list(schema),
+                   "values": [[] for _ in schema],
+                   "nulls": [[] for _ in schema]}
+    return h
+
+
+def append(handle: str, columns: Sequence[np.ndarray],
+           nulls: Optional[Sequence[np.ndarray]] = None) -> int:
+    st = _pending[handle]
+    if len(columns) != len(st["columns"]):
+        raise ValueError(f"insert arity {len(columns)} != table arity "
+                         f"{len(st['columns'])}")
+    n = len(columns[0]) if len(columns) else 0
+    for i, col in enumerate(columns):
+        st["values"][i].append(np.asarray(col))
+        st["nulls"][i].append(np.asarray(nulls[i], dtype=bool)
+                              if nulls is not None
+                              else np.zeros(n, dtype=bool))
+    return n
+
+
+def finish_insert(handle: str) -> int:
+    """Commit: existing rows + staged rows -> a NEW file, atomically
+    os.replace'd over the old one; the reader handle re-registers so
+    data_version advances (the fragment-cache invalidation seam)."""
+    import os
+    st = _pending.pop(handle)
+    table = st["table"]
+    with write_lock(table):
+        with _lock:
+            path = _tables[table]["path"]
+            schema = dict(_tables[table]["schema"])
+        cols = list(schema)
+        old = _read(table, cols, 0, table_row_count(table))[0] \
+            if table_row_count(table) else {c: (np.array([], dtype=object),
+                                                np.array([], dtype=bool))
+                                            for c in cols}
+        merged, merged_nulls, rows = {}, {}, 0
+        for i, c in enumerate(cols):
+            chunks = [np.asarray(x, dtype=object)
+                      for x in ([old[c][0]] + st["values"][i])]
+            nl = [np.asarray(x, dtype=bool)
+                  for x in ([old[c][1]] + st["nulls"][i])]
+            merged[c] = np.concatenate(chunks) if chunks else \
+                np.array([], dtype=object)
+            merged_nulls[c] = np.concatenate(nl) if nl else \
+                np.array([], dtype=bool)
+        rows = sum(len(x) for x in st["values"][0]) if st["values"] else 0
+        tmp = path + ".staged"
+        write_table(tmp, merged, schema, nulls=merged_nulls)
+        os.replace(tmp, path)
+        register_table(table, path)  # refresh handle + data_version
+    return rows
+
+
+def abort_insert(handle: str) -> None:
+    st = _pending.pop(handle, None)
+    if st and st["created"]:
+        drop_table(st["table"], if_exists=True)
+
+
+def replace_table(table: str, columns: Sequence[np.ndarray],
+                  nulls: Sequence[np.ndarray]) -> None:
+    """DELETE/UPDATE commit: the rewritten contents become the file."""
+    import os
+    with _lock:
+        path = _tables[table]["path"]
+        schema = dict(_tables[table]["schema"])
+    cols = list(schema)
+    merged = {c: np.asarray(v, dtype=object)
+              for c, v in zip(cols, columns)}
+    merged_nulls = {c: np.asarray(n, dtype=bool)
+                    for c, n in zip(cols, nulls)}
+    tmp = path + ".staged"
+    write_table(tmp, merged, schema, nulls=merged_nulls)
+    os.replace(tmp, path)
+    register_table(table, path)

@@ -106,6 +106,13 @@ def _scan_batch(node: N.PlanNode, sf: float, capacity_hint: Optional[int],
         return batch_from_numpy(tys, arrays, capacity=cap, nulls=nulls)
     cap = capacity_hint or max(-(-count // pad_multiple) * pad_multiple,
                                pad_multiple)
+    if node.pushdown is not None and scan_range is None \
+            and hasattr(conn, "row_groups_matching"):
+        # connector statistics pruning: skip row groups the pushed-down
+        # range provably excludes (the exact Filter still runs above)
+        return conn.generate_batch(node.table, sf, node.columns,
+                                   start=start, count=count, capacity=cap,
+                                   predicate=tuple(node.pushdown))
     return conn.generate_batch(node.table, sf, node.columns, start=start,
                                count=count, capacity=cap)
 
@@ -130,6 +137,10 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     inner_root = root.source if isinstance(root, N.OutputNode) else root
     if isinstance(inner_root, (N.DdlNode, N.TableFinishNode,
                                N.TableWriterNode, N.TableRewriteNode)):
+        from ..server.access import get_access_control
+        acl = get_access_control()
+        if acl is not None:
+            acl.check_plan(root, (session or {}).get("user", ""))
         return _run_write_root(
             inner_root, sf=sf, mesh=mesh, capacity_hints=capacity_hints,
             default_join_capacity=default_join_capacity,
@@ -161,6 +172,11 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
             from ..plan.rules import optimize_plan
             rr = optimize_plan(rr)
         root = rr
+    # connector predicate pushdown: range conjuncts above pushdown-
+    # capable scans (parquet row-group statistics) annotate the scan
+    if _session_on("scan_predicate_pushdown"):
+        from ..plan.pushdown import push_scan_predicates
+        root = push_scan_predicates(root)
     # capacity refinement (CBO stats): shrink group tables to the
     # connector-proven NDV bound so group-by rides the scatter-free
     # small-table kernels wherever statistics allow
@@ -189,6 +205,13 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     if violations:
         raise ValueError("plan not executable by the TPU engine "
                          f"(PlanChecker): {violations}")
+    # access control: the analysis-time boundary (AccessControlManager
+    # checkCanSelectFromColumns / write checks) -- enforced on the plan
+    # before anything touches data
+    from ..server.access import get_access_control
+    acl = get_access_control()
+    if acl is not None:
+        acl.check_plan(root, (session or {}).get("user", ""))
     stats = RuntimeStats()
     hbm_budget = hbm_budget_bytes
     if hbm_budget is None and session is not None:

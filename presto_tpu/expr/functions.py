@@ -1535,6 +1535,8 @@ _register_tod_field("millisecond", 1_000, 1000)
 @register("timezone_hour")
 def _timezone_hour(ret, a):
     from ..tz import UTC_KEY
+    assert a.type.base == _TZ_BASE, \
+        f"timezone_hour needs timestamp with time zone, got {a.type}"
     minutes = (a.values & jnp.int64(0xFFF)) - UTC_KEY
     h = jnp.sign(minutes) * (jnp.abs(minutes) // 60)  # truncate to zero
     return _col(ret, h.astype(ret.to_dtype()), a)
@@ -1543,6 +1545,8 @@ def _timezone_hour(ret, a):
 @register("timezone_minute")
 def _timezone_minute(ret, a):
     from ..tz import UTC_KEY
+    assert a.type.base == _TZ_BASE, \
+        f"timezone_minute needs timestamp with time zone, got {a.type}"
     minutes = (a.values & jnp.int64(0xFFF)) - UTC_KEY
     return _col(ret, jnp.sign(minutes) * (jnp.abs(minutes) % 60), a)
 
@@ -1618,7 +1622,7 @@ def _to_hex(ret, a: StringColumn):
     return StringColumn(chars, a.lengths * 2, a.nulls, ret)
 
 
-@register("from_hex")
+@register("from_hex", null_fn=lambda ret, *b: None)
 def _from_hex(ret, a: StringColumn):
     n, w = a.chars.shape
     chars = jnp.pad(a.chars, ((0, 0), (0, w % 2)))
@@ -1626,9 +1630,16 @@ def _from_hex(ret, a: StringColumn):
     digit = jnp.where(c >= ord("a"), c - ord("a") + 10,
                       jnp.where(c >= ord("A"), c - ord("A") + 10,
                                 c - ord("0")))
+    lanes = jnp.arange(chars.shape[1], dtype=jnp.int32)[None, :]
+    in_len = lanes < a.lengths[:, None]
+    ok_digit = (digit >= 0) & (digit <= 15) | ~in_len
+    # invalid hex (odd length, non-hex chars) -> NULL ("errors produce
+    # NULL lanes" -- the engine's total-kernel contract; Presto raises)
+    invalid = (a.lengths % 2 != 0) | ~jnp.all(ok_digit, axis=1)
     pairs = digit.reshape(n, -1, 2)
     vals = (pairs[:, :, 0] * 16 + pairs[:, :, 1]).astype(jnp.uint8)
-    return StringColumn(vals, a.lengths // 2, a.nulls, ret)
+    return StringColumn(vals, jnp.where(invalid, 0, a.lengths // 2),
+                        a.nulls | invalid, ret)
 
 
 @register("to_utf8")
@@ -2026,9 +2037,11 @@ def _array_slice(ret, a, start: Column, length: Column):
     s = start.values.astype(jnp.int64)
     s0 = jnp.where(s > 0, s - 1, lens + s)  # 0-based start
     cnt = jnp.clip(length.values.astype(jnp.int64), 0, None)
-    new_len = jnp.clip(jnp.minimum(cnt, lens - s0), 0, None)
+    s0c = jnp.clip(s0, 0, k)
+    new_len = jnp.where(s0 < 0, 0,  # |negative start| > length: empty
+                        jnp.clip(jnp.minimum(cnt, lens - s0c), 0, None))
     lanes = jnp.arange(k, dtype=jnp.int64)[None, :]
-    idx = jnp.clip(s0[:, None] + lanes, 0, k - 1).astype(jnp.int32)
+    idx = jnp.clip(s0c[:, None] + lanes, 0, k - 1).astype(jnp.int32)
     # start index 0 is invalid (SQL arrays are 1-based; the reference
     # raises) -- total kernels surface it as NULL
     nulls = _default_nulls(a, start, length) | (s == 0)

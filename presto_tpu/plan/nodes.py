@@ -61,6 +61,11 @@ class TableScanNode(PlanNode):
     table: str
     columns: List[str]
     column_types: List[T.Type]
+    # connector predicate pushdown (PushdownSubfields / the selective
+    # ORC/parquet reader seam): (column, lo, hi) range the connector may
+    # use to prune row groups/pages. PRUNING ONLY -- the Filter above
+    # still applies exactly; None bound = unbounded on that side
+    pushdown: object = None
 
     def output_types(self):
         return list(self.column_types)
@@ -554,9 +559,12 @@ def _agg_from_json(j: dict) -> AggSpec:
 def to_json(n: PlanNode) -> dict:
     base = {"id": n.id}
     if isinstance(n, TableScanNode):
-        return {**base, "@type": "tablescan", "connector": n.connector,
-                "table": n.table, "columns": n.columns,
-                "columnTypes": [str(t) for t in n.column_types]}
+        j = {**base, "@type": "tablescan", "connector": n.connector,
+             "table": n.table, "columns": n.columns,
+             "columnTypes": [str(t) for t in n.column_types]}
+        if n.pushdown is not None:
+            j["pushdown"] = list(n.pushdown)
+        return j
     if isinstance(n, RemoteSourceNode):
         return {**base, "@type": "remotesource",
                 "types": [str(t) for t in n.types],
@@ -665,8 +673,10 @@ def from_json(j: dict) -> PlanNode:
     nid = j.get("id", None)
     kw = {"id": nid} if nid else {}
     if t == "tablescan":
+        pd = j.get("pushdown")
         return TableScanNode(j["connector"], j["table"], j["columns"],
-                             [T.parse_type(s) for s in j["columnTypes"]], **kw)
+                             [T.parse_type(s) for s in j["columnTypes"]],
+                             pushdown=tuple(pd) if pd else None, **kw)
     if t == "remotesource":
         return RemoteSourceNode([T.parse_type(s) for s in j["types"]],
                                 j["fragmentId"], **kw)

@@ -1,0 +1,160 @@
+"""Access control: who may read/write which catalog/table/column.
+
+Reference surface: presto-main-base/.../security/AccessControlManager.java
+(checkCanSelectFromColumns / checkCanInsertIntoTable / ... called at
+analysis time) and the file-based system access control
+(presto-spi/.../security/SystemAccessControl.java + the rules-file
+plugin). This engine checks at PLAN time -- the runner walks the plan's
+scans and write targets before anything executes, the same boundary the
+reference's analyzer checks sit on.
+
+Rules evaluate top-down, FIRST MATCH wins (the reference's file rules
+semantics); with no rules configured everything is allowed. A rule:
+
+    {"user": "bob|analyst_.*",       # regex, default ".*"
+     "catalog": "tpch",              # regex, default ".*"
+     "table": "lineitem|orders",     # regex, default ".*"
+     "columns": ["comment"],         # optional: restrict to these
+     "privileges": ["SELECT"]}       # subset of SELECT/INSERT/DELETE/
+                                     # UPDATE/CREATE/DROP; [] = deny
+
+The manager is process-global (set_access_control) so every front door
+(sql(), statement server, worker) enforces the same policy; servers may
+also scope their own instance.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["AccessDeniedException", "AccessControlManager",
+           "set_access_control", "get_access_control"]
+
+_PRIVILEGES = ("SELECT", "INSERT", "DELETE", "UPDATE", "CREATE", "DROP")
+
+
+class AccessDeniedException(PermissionError):
+    """The reference's ACCESS_DENIED error class."""
+
+
+class AccessControlManager:
+    def __init__(self, rules: Optional[List[Dict]] = None):
+        self.rules = []
+        for r in rules or []:
+            self.rules.append({
+                "user": re.compile(r.get("user", ".*") + r"\Z"),
+                "catalog": re.compile(r.get("catalog", ".*") + r"\Z"),
+                "table": re.compile(r.get("table", ".*") + r"\Z"),
+                "columns": r.get("columns"),
+                "privileges": {p.upper() for p in r.get("privileges", [])},
+            })
+
+    # -- rule evaluation ---------------------------------------------------
+
+    def _allowed(self, user: str, catalog: str, table: str,
+                 privilege: str, column: Optional[str] = None) -> bool:
+        if not self.rules:
+            return True
+        for r in self.rules:
+            if not r["user"].match(user or ""):
+                continue
+            if not r["catalog"].match(catalog):
+                continue
+            if not r["table"].match(table):
+                continue
+            # the first (user, catalog, table) match DECIDES: a rule's
+            # column list restricts within that rule, it does not fall
+            # through to later rules (file-rules semantics)
+            if privilege not in r["privileges"]:
+                return False
+            if column is not None and r["columns"] is not None:
+                return column in r["columns"]
+            return True
+        return False  # rules configured but none matched: deny
+
+    def _check(self, user, catalog, table, privilege, columns=()):
+        if not self._allowed(user, catalog, table, privilege):
+            raise AccessDeniedException(
+                f"Access Denied: Cannot {privilege.lower()} "
+                f"{catalog}.{table} (user {user!r})")
+        for c in columns or ():
+            if not self._allowed(user, catalog, table, privilege, c):
+                raise AccessDeniedException(
+                    f"Access Denied: Cannot {privilege.lower()} column "
+                    f"{c!r} of {catalog}.{table} (user {user!r})")
+
+    # -- the analysis-time checks (AccessControl SPI names) ---------------
+
+    def check_can_select_from_columns(self, user, catalog, table, columns):
+        self._check(user, catalog, table, "SELECT", columns)
+
+    def check_can_insert_into_table(self, user, catalog, table):
+        self._check(user, catalog, table, "INSERT")
+
+    def check_can_delete_from_table(self, user, catalog, table):
+        self._check(user, catalog, table, "DELETE")
+
+    def check_can_update_table(self, user, catalog, table):
+        self._check(user, catalog, table, "UPDATE")
+
+    def check_can_create_table(self, user, catalog, table):
+        self._check(user, catalog, table, "CREATE")
+
+    def check_can_drop_table(self, user, catalog, table):
+        self._check(user, catalog, table, "DROP")
+
+    # -- plan-walk enforcement --------------------------------------------
+
+    def check_plan(self, root, user: str) -> None:
+        """Walk a plan tree; every TableScanNode must pass the SELECT
+        check with its referenced columns, every write node its write
+        check (the runner calls this before execution)."""
+        from ..plan import nodes as N
+        seen = set()
+
+        def walk(n):
+            if id(n) in seen:
+                return
+            seen.add(id(n))
+            if isinstance(n, N.TableScanNode):
+                self.check_can_select_from_columns(
+                    user, n.connector, n.table, n.columns)
+            elif isinstance(n, N.TableFinishNode):
+                if n.create:
+                    self.check_can_create_table(user, n.connector, n.table)
+                else:
+                    self.check_can_insert_into_table(user, n.connector,
+                                                     n.table)
+            elif isinstance(n, N.TableRewriteNode):
+                if n.kind == "delete":
+                    self.check_can_delete_from_table(user, n.connector,
+                                                     n.table)
+                else:
+                    self.check_can_update_table(user, n.connector, n.table)
+            elif isinstance(n, N.DdlNode) and n.op == "drop_table":
+                self.check_can_drop_table(user, n.connector, n.table)
+            for s in n.sources:
+                walk(s)
+
+        walk(root)
+
+
+_lock = threading.Lock()
+_manager: Optional[AccessControlManager] = None
+
+
+def set_access_control(rules_or_manager) -> None:
+    """Install the process-global policy (None clears it = allow all)."""
+    global _manager
+    with _lock:
+        if rules_or_manager is None or \
+                isinstance(rules_or_manager, AccessControlManager):
+            _manager = rules_or_manager
+        else:
+            _manager = AccessControlManager(rules_or_manager)
+
+
+def get_access_control() -> Optional[AccessControlManager]:
+    return _manager

@@ -1,0 +1,124 @@
+"""Output buffers: in-memory pages with a disk spooling tier.
+
+Reference surface: execution/buffer/SpoolingOutputBuffer.java -- when a
+task's finished result pages outgrow the memory budget, the tail
+offloads to TempStorage so slow/absent consumers cannot wedge worker
+memory; readers stream pages back transparently. Here: pages beyond
+`memory_threshold_bytes` append to one spool file per buffer
+(sequential write, seek+read on demand). The file is append-only and
+reclaimed when the buffer clears (task end) -- acked pages release
+MEMORY immediately, disk space at task end, matching the reference's
+file-per-buffer lifecycle.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["SpoolingOutputBuffer"]
+
+
+class SpoolingOutputBuffer:
+    """List-of-pages facade; entries beyond the memory budget live in
+    the spool file. NOT thread-safe by itself -- callers hold the task
+    lock, as they did for the plain list."""
+
+    def __init__(self, memory_threshold_bytes: int = 64 << 20,
+                 spool_dir: Optional[str] = None):
+        self.memory_threshold = memory_threshold_bytes
+        self.spool_dir = spool_dir
+        # entry: bytes (in memory) or (offset, length) in the spool file
+        self._entries: List[object] = []
+        self._mem_bytes = 0
+        self._spooled_bytes = 0
+        self._file = None
+        self._file_path: Optional[str] = None
+
+    # -- stats -------------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def memory_bytes(self) -> int:
+        return self._mem_bytes
+
+    @property
+    def spooled_bytes(self) -> int:
+        return self._spooled_bytes
+
+    # -- writes ------------------------------------------------------------
+
+    def _spool_file(self):
+        if self._file is None:
+            fd, self._file_path = tempfile.mkstemp(
+                prefix="presto-tpu-spool-", suffix=".pages",
+                dir=self.spool_dir)
+            self._file = os.fdopen(fd, "wb+")
+        return self._file
+
+    def append(self, page: bytes) -> None:
+        if self._mem_bytes + len(page) > self.memory_threshold:
+            f = self._spool_file()
+            f.seek(0, os.SEEK_END)
+            off = f.tell()
+            f.write(page)
+            f.flush()
+            self._entries.append((off, len(page)))
+            self._spooled_bytes += len(page)
+        else:
+            self._entries.append(page)
+            self._mem_bytes += len(page)
+
+    def extend(self, pages) -> None:
+        for p in pages:
+            self.append(p)
+
+    # -- reads -------------------------------------------------------------
+
+    def get(self, idx: int) -> bytes:
+        e = self._entries[idx]
+        if isinstance(e, tuple):
+            off, length = e
+            self._file.seek(off)
+            return self._file.read(length)
+        return e
+
+    def snapshot(self) -> List[bytes]:
+        """All pages as bytes (fragment-result-cache capture)."""
+        return [self.get(i) for i in range(len(self._entries))]
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def drop_prefix(self, n: int) -> None:
+        """Release the first n pages (consumer acked them). Memory frees
+        now; spool-file space frees at clear()."""
+        for e in self._entries[:n]:
+            if isinstance(e, bytes):
+                self._mem_bytes -= len(e)
+            else:
+                self._spooled_bytes -= e[1]  # live-page stat only;
+                # file space reclaims at clear()
+        del self._entries[:n]
+
+    def clear(self) -> None:
+        self._entries = []
+        self._mem_bytes = 0
+        self._spooled_bytes = 0
+        if self._file is not None:
+            try:
+                self._file.close()
+                os.unlink(self._file_path)
+            except OSError:
+                pass
+            self._file = None
+            self._file_path = None
+
+    def __del__(self):  # best-effort spool reclamation
+        try:
+            self.clear()
+        except Exception:  # noqa: BLE001
+            pass

@@ -8,8 +8,12 @@ client suitable for tests and cross-slice fetches.
 
 from __future__ import annotations
 
+import http.client
 import json
+import threading
 import time
+import urllib.error
+import urllib.parse
 import urllib.request
 from typing import List, Optional, Sequence, Tuple
 
@@ -22,23 +26,82 @@ from ..serde import PageCodec, deserialize_page
 __all__ = ["WorkerClient"]
 
 
+class _HttpStatusError(urllib.error.HTTPError):
+    """Status-code error with urllib's .code surface, so existing
+    callers (410-token checks, 401 auth tests) keep one catch type."""
+
+    def __init__(self, status: int, data: bytes, path: str):
+        import io
+        super().__init__(path, status,
+                         data.decode("utf-8", "replace")[:500], None,
+                         io.BytesIO(data))
+
+
 class WorkerClient:
+    """Persistent-connection client: one keep-alive HTTP/1.1 connection
+    per (client, thread), reused across the token/ack pull loop and task
+    polls (the reference's pooled PageBufferClient/Netty channel; the
+    round-4 per-request urllib connections cost a TCP handshake per
+    page). Stale keep-alive sockets (server-side idle close) retry once
+    on a fresh connection."""
+
     def __init__(self, base_url: str, timeout: float = 30.0,
                  shared_secret: Optional[str] = None):
         from .auth import make_authenticator
         self.base = base_url.rstrip("/")
         self.timeout = timeout
         self._auth = make_authenticator(shared_secret, "client")
+        u = urllib.parse.urlsplit(self.base)
+        self._scheme = u.scheme or "http"
+        self._host, self._port = u.hostname, u.port
+        self._prefix = u.path.rstrip("/")
+        self._local = threading.local()
+
+    def _connect(self) -> http.client.HTTPConnection:
+        if self._scheme == "https":
+            from .tls import client_ssl_context
+            return http.client.HTTPSConnection(
+                self._host, self._port, timeout=self.timeout,
+                context=client_ssl_context())
+        return http.client.HTTPConnection(self._host, self._port,
+                                          timeout=self.timeout)
 
     def _request(self, method: str, path: str, body: Optional[bytes] = None):
         from .auth import bearer_headers
-        req = urllib.request.Request(self.base + path, data=body, method=method)
+        headers = dict(bearer_headers(self._auth))
         if body is not None:
-            req.add_header("Content-Type", "application/json")
-        for k, v in bearer_headers(self._auth).items():
-            req.add_header(k, v)
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            return resp.read(), dict(resp.headers)
+            headers["Content-Type"] = "application/json"
+        last_err = None
+        for attempt in (0, 1):
+            conn = getattr(self._local, "conn", None)
+            if conn is None:
+                conn = self._connect()
+                self._local.conn = conn
+            try:
+                conn.request(method, self._prefix + path, body=body,
+                             headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+                if resp.status >= 400:
+                    self._raise_http(resp.status, data, path)
+                return data, dict(resp.getheaders())
+            except (http.client.HTTPException, ConnectionError,
+                    BrokenPipeError, TimeoutError) as e:
+                if isinstance(e, _HttpStatusError):
+                    raise
+                self._local.conn = None
+                try:
+                    conn.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                last_err = e
+                if attempt == 1:
+                    raise
+        raise last_err  # unreachable
+
+    @staticmethod
+    def _raise_http(status: int, data: bytes, path: str):
+        raise _HttpStatusError(status, data, path)
 
     def info(self) -> dict:
         data, _ = self._request("GET", "/v1/info")

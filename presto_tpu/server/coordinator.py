@@ -44,15 +44,22 @@ class SchedulerGap(NotImplementedError):
 class Coordinator:
     def __init__(self, worker_urls: Optional[Sequence[str]] = None,
                  discovery_url: Optional[str] = None,
-                 prober=None):
+                 prober=None,
+                 writer_min_rows_per_task: int = 1 << 20):
         """`prober`: an optional discovery.HeartbeatProber; when set,
         workers the prober has marked failed are excluded from
         scheduling AND from retry targets (HeartbeatFailureDetector ->
-        NodeScheduler exclusion, the reference wiring)."""
+        NodeScheduler exclusion, the reference wiring).
+        `writer_min_rows_per_task`: scaled-writers knob -- a writer
+        fragment gets ceil(estimated_rows / this) tasks, capped by the
+        cluster (ScaledWriterScheduler's grow-by-volume policy, sized
+        from connector statistics up front instead of at runtime; small
+        INSERTs stay one writer and avoid the small-file explosion)."""
         assert worker_urls or discovery_url
         self._urls = list(worker_urls) if worker_urls else None
         self.discovery_url = discovery_url
         self.prober = prober
+        self.writer_min_rows_per_task = max(1, writer_min_rows_per_task)
 
     def workers(self) -> List[str]:
         if self._urls:
@@ -85,7 +92,8 @@ class Coordinator:
             f"task {task_id} could not be submitted anywhere: {last_err}")
 
     def _await_or_retry(self, urls: List[str], pending, body_of,
-                        timeout: float, submitted=None):
+                        timeout: float, submitted=None, recover=None,
+                        register=None):
         """Wait for submitted tasks (all executing concurrently); on an
         execution failure, resubmit that task elsewhere (deterministic
         splits make any attempt re-runnable -- the recoverable-execution
@@ -117,12 +125,25 @@ class Coordinator:
                     raise RuntimeError(
                         f"task {tid} failed everywhere: {last_err}")
                 retries_left -= 1
+                # a consumer often fails because a FINISHED upstream's
+                # buffered pages died with their worker: re-run those
+                # producers on survivors and rewire the body before the
+                # consumer retries (recoverable-execution re-scheduling,
+                # the SqlStageExecution task-attempt analog)
+                body = body_of(key)
+                if recover is not None:
+                    try:
+                        recover(body)
+                    except Exception as e:  # noqa: BLE001
+                        last_err = f"upstream recovery: "                                    f"{type(e).__name__}: {e}"
                 # re-derive the candidate set: the prober/discovery view
                 # may have excluded the dead worker by now
                 retry_urls = self._retry_urls(urls)
                 url, tid, _ = self._submit(
                     retry_urls, preferred + (len(urls) - retries_left),
-                    f"{tid}.r", body_of(key), timeout)
+                    f"{tid}.r", body, timeout)
+                if register is not None:
+                    register(tid, key)
                 if submitted is not None:
                     submitted.append((url, tid))
         return done
@@ -233,6 +254,61 @@ class Coordinator:
                 ntasks_of[frag.id] = 1
             else:
                 ntasks_of[frag.id] = len(workers) if (scans or hash_ups) else 1
+            if _contains_writer(frag.root) and \
+                    not _contains_commit(frag.root):
+                # scaled writers: task count follows the data volume
+                from ..plan.stats import estimate_rows
+                est = estimate_rows(frag.root, sf)
+                if est is not None:
+                    scale = -(-int(est) // self.writer_min_rows_per_task)
+                    ntasks_of[frag.id] = max(
+                        1, min(ntasks_of[frag.id], scale))
+
+        # recovery bookkeeping: every submitted task's (fragment, index)
+        # and body, so a dead FINISHED producer can be re-run on demand
+        bodies_by_frag: Dict[int, Dict[int, dict]] = {}
+        origin: Dict[str, Tuple[int, int]] = {}
+
+        def recover_upstreams(body: dict) -> None:
+            """Re-run unreachable/failed upstream producers referenced by
+            `body` and rewire its remoteSources in place (recursive:
+            a producer's own dead upstreams re-run first)."""
+            for entry in (body.get("remoteSources") or {}).values():
+                srcs = entry.get("sources", [])
+                tids = entry.get("taskIds", [])
+                for i, (src, tid) in enumerate(zip(list(srcs), list(tids))):
+                    try:
+                        info = WorkerClient(src, min(timeout, 5.0)
+                                            ).task_info(tid)
+                        if info.get("state") == "FINISHED":
+                            continue  # alive and done: pages readable
+                        if info.get("state") in ("PLANNED", "RUNNING"):
+                            continue  # still producing: consumer waits
+                    except Exception:  # noqa: BLE001 - dead worker
+                        pass
+                    fid_w = origin.get(tid)
+                    if fid_w is None:
+                        continue  # not ours to re-run
+                    fid, w = fid_w
+                    ubody = bodies_by_frag.get(fid, {}).get(w)
+                    if ubody is None:
+                        continue
+                    recover_upstreams(ubody)
+                    rurls = [u for u in self._retry_urls(workers)
+                             if u != src] or self._retry_urls(workers)
+                    uurl, utid, _ = self._submit(rurls, w, f"{tid}.u",
+                                                 ubody, timeout)
+                    origin[utid] = (fid, w)
+                    submitted.append((uurl, utid))
+                    uinfo = WorkerClient(uurl, timeout).wait(utid, timeout)
+                    if uinfo["state"] != "FINISHED":
+                        raise RuntimeError(
+                            f"re-run upstream {utid} at {uurl} is "
+                            f"{uinfo['state']}: {uinfo.get('error')}")
+                    entry["sources"][i] = uurl
+                    entry["taskIds"][i] = utid
+                    if fid in produced and w < len(produced[fid]):
+                        produced[fid][w] = (uurl, utid)
 
         all_pending = []  # all_at_once: awaited together at the end
         if policy == "all_at_once":
@@ -334,13 +410,17 @@ class Coordinator:
                 url, tid, _ = self._submit(workers, w,
                                            f"{qid}.f{frag.id}.w{w}",
                                            body, timeout)
+                origin[tid] = (frag.id, w)
                 submitted.append((url, tid))
                 pending.append((w, url, tid, w))
+            bodies_by_frag[frag.id] = bodies
             if policy == "all_at_once":
                 continue  # awaited together after every stage launched
-            done = self._await_or_retry(workers, pending,
-                                        lambda k: bodies[k], timeout,
-                                        submitted)
+            done = self._await_or_retry(
+                workers, pending, lambda k: bodies[k], timeout, submitted,
+                recover=recover_upstreams,
+                register=lambda tid, k, f=frag.id: origin.__setitem__(
+                    tid, (f, k)))
             produced[frag.id] = [done[w] for w in sorted(done)]
 
         for url, tid in all_pending:
@@ -367,12 +447,14 @@ class Coordinator:
                 # fails -- the reference's behavior without recoverable
                 # grouped execution)
                 retry = self._retry_urls(workers)
+                recover_upstreams(final_bodies[w])
                 url, tid, _ = self._submit(retry, w + 1, f"{tid}.rf",
                                            final_bodies[w], timeout)
                 submitted.append((url, tid))
                 done = self._await_or_retry(
                     retry, [(w, url, tid, w + 1)],
-                    lambda k: final_bodies[k], timeout, submitted)
+                    lambda k: final_bodies[k], timeout, submitted,
+                    recover=recover_upstreams)
                 url, tid = done[w]
                 cols = WorkerClient(url, timeout).fetch_results(tid, types)
             for c in range(len(types)):
@@ -401,6 +483,12 @@ def _contains_global_agg(node: N.PlanNode) -> bool:
             and node.step in ("FINAL", "SINGLE"):
         return True
     return any(_contains_global_agg(s) for s in node.sources)
+
+
+def _contains_writer(node: N.PlanNode) -> bool:
+    if isinstance(node, N.TableWriterNode):
+        return True
+    return any(_contains_writer(s) for s in node.sources)
 
 
 def _contains_commit(node: N.PlanNode) -> bool:

@@ -32,33 +32,130 @@ class QueryRejected(RuntimeError):
 
 @dataclasses.dataclass
 class ResourceGroup:
-    """InternalResourceGroup analog (flat; hierarchy composes by
-    name prefixes in the selector)."""
+    """InternalResourceGroup analog, now HIERARCHICAL: a query admitted
+    into a leaf holds one concurrency slot (and its memory budget) in
+    the leaf AND every ancestor, so parent limits cap whole subtrees
+    (InternalResourceGroup.java's canRunMore chain). Admission among
+    competing queued leaves under a constrained ancestor is
+    weighted-fair: the eligible leaf with the LOWEST running/weight
+    ratio goes first (ties FIFO), the reference's WEIGHTED_FAIR
+    scheduling policy."""
     name: str
     hard_concurrency_limit: int = 4
     max_queued: int = 16
+    soft_memory_limit_bytes: Optional[int] = None
+    scheduling_weight: int = 1
 
     def __post_init__(self):
         self._running = 0
         self._queued = 0
+        self._mem_used = 0
+        self.parent: Optional["ResourceGroup"] = None
+        self.children: Dict[str, "ResourceGroup"] = {}
+        # one condition per TREE (the root's); shared by add_child
         self._cv = threading.Condition()
+        self._waiters: List[tuple] = []  # (ticket, leaf) FIFO registry
+        self._ticket = 0
+
+    # -- tree construction -------------------------------------------------
+
+    def add_child(self, child: "ResourceGroup") -> "ResourceGroup":
+        child.parent = self
+        root = self._root()
+        child._cv = root._cv
+        for g in child._subtree():
+            g._cv = root._cv
+        self.children[child.name] = child
+        return child
+
+    def _root(self) -> "ResourceGroup":
+        g = self
+        while g.parent is not None:
+            g = g.parent
+        return g
+
+    def _subtree(self):
+        yield self
+        for c in self.children.values():
+            yield from c._subtree()
+
+    def _chain(self):
+        g = self
+        while g is not None:
+            yield g
+            g = g.parent
+
+    def find(self, dotted: str) -> Optional["ResourceGroup"]:
+        """Resolve "etl.nightly" relative to this group."""
+        g = self
+        for part in dotted.split("."):
+            if part == g.name and g is self:
+                continue
+            nxt = g.children.get(part)
+            if nxt is None:
+                return None
+            g = nxt
+        return g
 
     def stats(self) -> Dict[str, int]:
         with self._cv:
-            return {"running": self._running, "queued": self._queued,
-                    "hardConcurrencyLimit": self.hard_concurrency_limit,
-                    "maxQueued": self.max_queued}
+            out = {"running": self._running, "queued": self._queued,
+                   "hardConcurrencyLimit": self.hard_concurrency_limit,
+                   "maxQueued": self.max_queued,
+                   "schedulingWeight": self.scheduling_weight,
+                   "memoryUsedBytes": self._mem_used}
+            if self.soft_memory_limit_bytes is not None:
+                out["softMemoryLimitBytes"] = self.soft_memory_limit_bytes
+            return out
 
-    def acquire(self, timeout: Optional[float] = None):
+    # -- admission ---------------------------------------------------------
+
+    def _capacity_now(self, mem: int) -> bool:
+        for g in self._chain():
+            if g._running >= g.hard_concurrency_limit:
+                return False
+            if g.soft_memory_limit_bytes is not None and \
+                    g._mem_used + mem > g.soft_memory_limit_bytes:
+                return False
+        return True
+
+    def acquire(self, timeout: Optional[float] = None, mem: int = 0):
+        root = self._root()
         with self._cv:
-            if self._queued >= self.max_queued:
-                raise QueryRejected(
-                    f"resource group {self.name!r} queue is full "
-                    f"({self.max_queued})")
-            self._queued += 1
+            for g in self._chain():
+                if g.soft_memory_limit_bytes is not None and \
+                        mem > g.soft_memory_limit_bytes:
+                    raise QueryRejected(
+                        f"query memory {mem} exceeds group "
+                        f"{g.name!r} limit {g.soft_memory_limit_bytes}")
+                if g._queued >= g.max_queued:
+                    raise QueryRejected(
+                        f"resource group {g.name!r} queue is full "
+                        f"({g.max_queued})")
+            for g in self._chain():
+                g._queued += 1
+            root._ticket += 1
+            me = (root._ticket, self, mem)
+            root._waiters.append(me)
             deadline = None if timeout is None else time.time() + timeout
+
+            def my_turn() -> bool:
+                if not self._capacity_now(mem):
+                    return False
+                # weighted-fair: among capacity-eligible waiters, the
+                # best (lowest running/weight, then FIFO ticket) goes
+                best = None
+                for tkt, leaf, wmem in root._waiters:
+                    if not leaf._capacity_now(wmem):
+                        continue
+                    key = (leaf._running / max(leaf.scheduling_weight, 1),
+                           tkt)
+                    if best is None or key < best[0]:
+                        best = (key, tkt, leaf)
+                return best is not None and best[1] == me[0]
+
             try:
-                while self._running >= self.hard_concurrency_limit:
+                while not my_turn():
                     remaining = None if deadline is None \
                         else deadline - time.time()
                     if remaining is not None and remaining <= 0:
@@ -67,12 +164,21 @@ class ResourceGroup:
                             f"{timeout}s")
                     self._cv.wait(remaining)
             finally:
-                self._queued -= 1
-            self._running += 1
+                root._waiters.remove(me)
+                for g in self._chain():
+                    g._queued -= 1
+                # our departure (admitted OR timed out) can unblock a
+                # differently-shaped waiter
+                self._cv.notify_all()
+            for g in self._chain():
+                g._running += 1
+                g._mem_used += mem
 
-    def release(self):
+    def release(self, mem: int = 0):
         with self._cv:
-            self._running -= 1
+            for g in self._chain():
+                g._running -= 1
+                g._mem_used -= mem
             # notify_all, not notify: a waiter that times out may have
             # just consumed the single notify without taking the slot,
             # which would leave another queued waiter blocked forever.
@@ -88,12 +194,22 @@ class Dispatcher:
 
     def __init__(self, groups: Optional[List[ResourceGroup]] = None,
                  selector: Optional[Callable[[Dict], str]] = None):
-        self.groups = {g.name: g for g in (groups or
-                                           [ResourceGroup("global")])}
+        # register every group in each tree under its dotted path, so
+        # selectors can target leaves ("etl.nightly") or roots ("etl")
+        self.groups: Dict[str, ResourceGroup] = {}
+        for root in (groups or [ResourceGroup("global")]):
+            self._register(root, root.name)
         self._selector = selector or (lambda session: "global")
 
+    def _register(self, g: ResourceGroup, path: str):
+        self.groups[path] = g
+        self.groups.setdefault(g.name, g)
+        for c in g.children.values():
+            self._register(c, f"{path}.{c.name}")
+
     def group_stats(self) -> Dict[str, Dict[str, int]]:
-        return {name: g.stats() for name, g in self.groups.items()}
+        return {name: g.stats() for name, g in self.groups.items()
+                if "." in name or not g.parent}
 
     def submit(self, executor: Callable[[str], object],
                session: Optional[Dict] = None,
@@ -114,7 +230,11 @@ class Dispatcher:
         events = event_listeners()
         events.query_created(query_id, query_text,
                              session.get("user", ""))
-        group.acquire(queue_timeout)
+        mem = 0
+        if "query_max_memory" in session:
+            from ..utils.config import parse_size
+            mem = parse_size(session["query_max_memory"])
+        group.acquire(queue_timeout, mem=mem)
         t0 = time.time()
         try:
             result = executor(query_id)
@@ -123,7 +243,7 @@ class Dispatcher:
                                    wall_s=time.time() - t0, error=str(e))
             raise
         finally:
-            group.release()
+            group.release(mem=mem)
         rows = getattr(result, "row_count", 0)
         events.query_completed(query_id, "FINISHED", rows=rows,
                                wall_s=time.time() - t0)

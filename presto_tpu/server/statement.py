@@ -125,7 +125,9 @@ class StatementServer:
                  tls: Optional[tuple] = None):
         self.sf = sf
         from ..sql.statements import PreparedStatements
-        self._prepared = PreparedStatements()
+        # per-user registries (the reference scopes prepared statements
+        # per session via X-Presto-Prepared-Statement headers)
+        self._prepared: Dict[str, PreparedStatements] = {}
         self.page_rows = page_rows
         self.queue_poll_s = queue_poll_s
         self.query_ttl_s = query_ttl_s
@@ -180,12 +182,22 @@ class StatementServer:
             kwargs["join_capacity"] = int(session_values["join_capacity"])
         # SHOW/DESCRIBE rewrites + per-server prepared statements (the
         # coordinator session analog of X-Presto-Prepared-Statement)
+        from ..sql.statements import PreparedStatements
+        user = self._user_of(query_id)
         pre = preprocess(text, catalog=session_values.get("catalog", "tpch"),
-                         prepared=self._prepared)
+                         prepared=self._prepared.setdefault(
+                             user, PreparedStatements()))
         if pre.ack is not None:
             from ..exec.runner import QueryResult
             return QueryResult([], [], [pre.ack], 0)
+        kwargs["session"] = dict(session_values)
+        kwargs["session"].setdefault("user", user)
         return run_sql(pre.text, sf=sf, **kwargs)
+
+    def _user_of(self, query_id: str) -> str:
+        with self._qlock:
+            q = self._queries.get(query_id)
+        return q.user if q is not None else ""
 
     def _reap_locked(self) -> None:
         """Drop terminal queries (and their materialized result rows)

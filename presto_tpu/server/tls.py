@@ -24,7 +24,7 @@ import ssl
 import urllib.request
 from typing import Optional, Tuple
 
-__all__ = ["generate_self_signed", "server_context", "trust",
+__all__ = ["generate_self_signed", "server_context", "trust", "client_ssl_context",
            "clear_trust"]
 
 
@@ -84,10 +84,15 @@ def server_context(certfile: str, keyfile: str) -> ssl.SSLContext:
 _opener_installed = False
 
 
+_client_context = None
+
+
 def trust(ca_file: str) -> None:
     """Install a process-wide https opener that verifies peers against
-    the cluster CA -- every internal urllib client picks it up."""
-    global _opener_installed
+    the cluster CA -- every internal urllib client picks it up (the
+    pooled WorkerClient reads the same context via
+    client_ssl_context)."""
+    global _opener_installed, _client_context
     ctx = ssl.create_default_context(cafile=ca_file)
     # internal certs name the cluster, not each ephemeral host:port;
     # peer identity is the CA signature + the JWT layer
@@ -95,11 +100,18 @@ def trust(ca_file: str) -> None:
     opener = urllib.request.build_opener(
         urllib.request.HTTPSHandler(context=ctx))
     urllib.request.install_opener(opener)
+    _client_context = ctx
     _opener_installed = True
 
 
+def client_ssl_context():
+    """The trusted cluster context (None = stdlib default verify)."""
+    return _client_context
+
+
 def clear_trust() -> None:
-    global _opener_installed
+    global _opener_installed, _client_context
     urllib.request.install_opener(
         urllib.request.build_opener())
+    _client_context = None
     _opener_installed = False

@@ -43,6 +43,7 @@ import numpy as np
 from ..plan import nodes as N
 from ..serde import PageCodec, serialize_page
 from ..utils.config import Session
+from .buffers import SpoolingOutputBuffer
 
 __all__ = ["TpuWorkerServer", "TaskManager"]
 
@@ -159,19 +160,27 @@ class FragmentResultCache:
 
 
 class _Task:
-    def __init__(self, task_id: str):
+    def __init__(self, task_id: str, spool_threshold: int = 64 << 20,
+                 spool_dir: Optional[str] = None):
         self.task_id = task_id
         self.state = "PLANNED"  # PLANNED -> RUNNING -> FINISHED/FAILED/ABORTED
         self.error: Optional[str] = None
+        self._spool_threshold = spool_threshold
+        self._spool_dir = spool_dir
         # partition-addressed output buffers (OutputBufferId -> pages);
-        # unpartitioned results live in buffer 0
-        self.buffers: Dict[int, List[bytes]] = {0: []}
+        # unpartitioned results live in buffer 0. Pages past the memory
+        # budget spool to disk (SpoolingOutputBuffer.java analog)
+        self.buffers: Dict[int, SpoolingOutputBuffer] = {
+            0: self._new_buffer()}
         self.first_token: Dict[int, int] = {}  # per-buffer acked prefix
         self.no_more_pages = False
         self.created_at = time.time()
         self.finished_at: Optional[float] = None
         self.stats: Dict[str, float] = {}
         self.lock = threading.Lock()
+
+    def _new_buffer(self) -> SpoolingOutputBuffer:
+        return SpoolingOutputBuffer(self._spool_threshold, self._spool_dir)
 
     def info(self) -> dict:
         with self.lock:
@@ -180,6 +189,8 @@ class _Task:
                 "state": self.state,
                 "error": self.error,
                 "bufferedPages": sum(len(p) for p in self.buffers.values()),
+                "spooledBytes": sum(b.spooled_bytes
+                                    for b in self.buffers.values()),
                 "noMorePages": self.no_more_pages,
                 "stats": dict(self.stats),
                 "elapsedSeconds": round(time.time() - self.created_at, 3),
@@ -200,7 +211,9 @@ class TaskManager:
     def __init__(self, sf: float = 0.01, mesh=None,
                  memory_bytes: int = 12 << 30,
                  task_ttl_s: float = 600.0,
-                 task_concurrency: int = 4):
+                 task_concurrency: int = 4,
+                 output_spool_threshold_bytes: int = 64 << 20,
+                 output_spool_dir: Optional[str] = None):
         from ..exec.memory import MemoryPool
         self.sf = sf
         self.mesh = mesh
@@ -212,6 +225,8 @@ class TaskManager:
         self.draining = False  # GracefulShutdownHandler state
         self.task_ttl_s = task_ttl_s
         self.task_concurrency = max(1, int(task_concurrency))
+        self.output_spool_threshold_bytes = output_spool_threshold_bytes
+        self.output_spool_dir = output_spool_dir
         self._exec_slots = threading.BoundedSemaphore(self.task_concurrency)
         self._tasks_lock = threading.Lock()
         self.fragment_cache = FragmentResultCache()
@@ -250,7 +265,8 @@ class TaskManager:
                 if self.draining:
                     raise RuntimeError(
                         "worker is SHUTTING_DOWN: not accepting tasks")
-                task = _Task(task_id)
+                task = _Task(task_id, self.output_spool_threshold_bytes,
+                             self.output_spool_dir)
                 self.tasks[task_id] = task
                 self._count("tasks_created")
                 threading.Thread(target=self._run, args=(task, body),
@@ -328,7 +344,8 @@ class TaskManager:
                         if task.state == "ABORTED":
                             return
                         for pid, pages in hit["buffers"].items():
-                            task.buffers.setdefault(pid, []).extend(pages)
+                            task.buffers.setdefault(
+                                pid, task._new_buffer()).extend(pages)
                         task.no_more_pages = True
                         task.stats = {**hit["stats"],
                                       "fragmentCacheHit": 1}
@@ -378,7 +395,8 @@ class TaskManager:
                     if task.state == "ABORTED":
                         return
                     for pid, page in enumerate(pages):
-                        task.buffers.setdefault(pid, []).append(page)
+                        task.buffers.setdefault(
+                            pid, task._new_buffer()).append(page)
                 built = {pid: [page] for pid, page in enumerate(pages)}
             else:
                 cols = [(types[i], res.columns[i], res.nulls[i])
@@ -437,7 +455,8 @@ class TaskManager:
         if task is None:
             raise KeyError(task_id)
         with task.lock:
-            pages = task.buffers.get(buffer_id, [])
+            pages = task.buffers.get(buffer_id)
+            npages = 0 if pages is None else len(pages)
             first = task.first_token.get(buffer_id, 0)
             if token < first:
                 # a prior consumer attempt acked past this token and the
@@ -447,10 +466,10 @@ class TaskManager:
                     f"token {token} below acked prefix {first} of "
                     f"{task_id}/{buffer_id}")
             idx = token - first
-            if idx < len(pages):
-                return pages[idx], token + 1, False
+            if idx < npages:
+                return pages.get(idx), token + 1, False
             done = task.no_more_pages or task.state in ("FAILED", "ABORTED")
-            return None, token, done and idx >= len(pages)
+            return None, token, done and idx >= npages
 
     def acknowledge(self, task_id: str, token: int, buffer_id: int = 0):
         task = self.get(task_id)
@@ -459,9 +478,9 @@ class TaskManager:
         with task.lock:
             first = task.first_token.get(buffer_id, 0)
             drop = token - first
-            pages = task.buffers.get(buffer_id, [])
-            if drop > 0:
-                task.buffers[buffer_id] = pages[drop:]
+            pages = task.buffers.get(buffer_id)
+            if drop > 0 and pages is not None:
+                pages.drop_prefix(drop)
                 task.first_token[buffer_id] = token
 
     def abort(self, task_id: str):
@@ -470,7 +489,9 @@ class TaskManager:
             with task.lock:
                 if task.state not in ("FINISHED", "FAILED"):
                     task.state = "ABORTED"
-                task.buffers = {0: []}
+                for b in task.buffers.values():
+                    b.clear()
+                task.buffers = {0: task._new_buffer()}
                 task.first_token = {}
                 if task.finished_at is None:
                     task.finished_at = time.time()

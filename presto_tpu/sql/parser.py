@@ -709,13 +709,13 @@ class _Parser:
         items = [self._select_item()]
         while self.accept_op(","):
             items.append(self._select_item())
-        if not self.accept_kw("from"):
+        if self.accept_kw("from"):
+            table = self._table_ref()
+        else:
             # FROM-less SELECT: one synthetic single-row source (the
-            # reference plans these over a one-row ValuesNode)
-            return Query(Select(items, distinct),
-                         TableRef("$dual", None), [], None, [], None,
-                         [], None)
-        table = self._table_ref()
+            # reference plans these over a one-row ValuesNode); the
+            # normal WHERE/ORDER BY/LIMIT clause loop still applies
+            table = TableRef("$dual", None)
         joins = []
         while True:
             # comma-separated FROM items / CROSS JOIN: a join with no ON

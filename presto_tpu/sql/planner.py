@@ -321,12 +321,17 @@ class _Analyzer:
         raise NotImplementedError(f"lambda function {name!r}")
 
     def _func_type(self, name: str, args: List[E.RowExpression]) -> T.Type:
+        if name in ("timezone_hour", "timezone_minute"):
+            if args[0].type.base != "timestamp with time zone":
+                raise NotImplementedError(
+                    f"{name} needs TIMESTAMP WITH TIME ZONE, "
+                    f"got {args[0].type}")
+            return T.BIGINT
         if name in ("year", "month", "day", "quarter", "length", "strpos",
                     "position", "codepoint", "day_of_week", "day_of_year",
                     "date_diff", "sign", "hour", "minute", "second",
-                    "millisecond", "timezone_hour", "timezone_minute",
-                    "json_array_length", "json_size", "crc32",
-                    "regexp_position", "regexp_count"):
+                    "millisecond", "json_array_length", "json_size",
+                    "crc32", "regexp_position", "regexp_count"):
             return T.BIGINT
         if name == "at_timezone":
             return T.TIMESTAMP_TZ
@@ -590,17 +595,23 @@ def plan_sql(query_text: str, max_groups: int = 1 << 16,
 
 
 def _writable_target(name: str):
-    """'memory.t' or bare 't' -> (connector, table); only the memory
-    catalog is writable (the engine's generator connectors are
-    read-only, like the reference's tpch/tpcds connectors)."""
+    """'memory.t' or bare 't' -> (connector, table). Writable catalogs
+    expose the sink contract (begin_insert/...; ConnectorPageSink
+    analog): memory and parquet; the generator connectors stay
+    read-only, like the reference's tpch/tpcds connectors."""
     if "." in name:
         conn, table = name.split(".", 1)
     else:
         conn, table = "memory", name
-    if conn != "memory":
+    from ..connectors import catalog as get_cat
+    try:
+        writable = hasattr(get_cat(conn), "begin_insert")
+    except KeyError:
+        writable = False
+    if not writable:
         raise NotImplementedError(
             f"catalog {conn!r} is read-only; writes go to the memory "
-            "connector")
+            "or parquet connectors")
     return conn, table
 
 

@@ -18,7 +18,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable, Dict, Optional
 
-__all__ = ["ConfigSpec", "Config", "SESSION_PROPERTIES", "Session",
+__all__ = ["ConfigSpec", "Config", "SESSION_PROPERTIES", "Session", "parse_size",
            "SessionProperty"]
 
 
@@ -150,6 +150,9 @@ SESSION_PROPERTIES = (
          "run the rule-based simplification + channel-pruning passes "
          "(plan.rules; IterativeOptimizer/PruneUnreferencedOutputs "
          "analog) before capacity refinement and distribution")
+    .add("scan_predicate_pushdown", "bool", True,
+         "push filter range conjuncts into pushdown-capable connectors "
+         "(parquet row-group statistics pruning; plan/pushdown.py)")
     .add("dynamic_filtering", "bool", True,
          "run small dimension build sides first and prune fact scans "
          "by their join-key domains at staging time (exec/dynfilter.py)")
@@ -188,6 +191,11 @@ class Session(Config):
         super().__init__(SESSION_PROPERTIES, values)
         self.user = user
         self.query_id = query_id or "q_0"
+
+
+def parse_size(v) -> int:
+    """Public size parser ("4GB" -> bytes; ints pass through)."""
+    return _parse_size(v)
 
 
 def session_flag(session, name: str, default: bool = True) -> bool:

@@ -1,0 +1,192 @@
+"""Access control + hierarchical resource groups.
+
+Reference behavior: security/AccessControlManager.java (analysis-time
+checkCanSelectFromColumns / write checks, file-based rules, first match
+wins) and execution/resourceGroups/InternalResourceGroup.java
+(hierarchical concurrency/memory admission, weighted-fair pick)."""
+
+import threading
+import time
+
+import pytest
+
+from presto_tpu.server.access import (AccessControlManager,
+                                      AccessDeniedException,
+                                      set_access_control)
+from presto_tpu.server.dispatcher import (Dispatcher, QueryRejected,
+                                          ResourceGroup)
+from presto_tpu.sql import sql
+
+
+@pytest.fixture(autouse=True)
+def _clear_acl():
+    yield
+    set_access_control(None)
+
+
+RULES = [
+    {"user": "bob", "catalog": "tpch", "table": "region|nation",
+     "privileges": ["SELECT"]},
+    {"user": "bob", "privileges": []},              # bob: nothing else
+    {"user": "eve", "catalog": "tpch", "table": "lineitem",
+     "columns": ["orderkey", "quantity"], "privileges": ["SELECT"]},
+    {"user": ".*", "privileges": ["SELECT", "INSERT", "DELETE", "UPDATE",
+                                  "CREATE", "DROP"]},
+]
+
+
+def test_first_match_wins_and_denies():
+    m = AccessControlManager(RULES)
+    m.check_can_select_from_columns("bob", "tpch", "region", ["name"])
+    with pytest.raises(AccessDeniedException):
+        m.check_can_select_from_columns("bob", "tpch", "lineitem", ["tax"])
+    with pytest.raises(AccessDeniedException):
+        m.check_can_insert_into_table("bob", "memory", "t")
+    # other users fall through to the allow-all rule
+    m.check_can_insert_into_table("alice", "memory", "t")
+
+
+def test_column_level_rules():
+    m = AccessControlManager(RULES)
+    m.check_can_select_from_columns("eve", "tpch", "lineitem",
+                                    ["orderkey", "quantity"])
+    with pytest.raises(AccessDeniedException, match="column"):
+        m.check_can_select_from_columns("eve", "tpch", "lineitem",
+                                        ["orderkey", "extendedprice"])
+
+
+def test_no_rules_allows_everything():
+    m = AccessControlManager()
+    m.check_can_drop_table("anyone", "any", "thing")
+
+
+def test_enforced_through_the_sql_front_door():
+    set_access_control(RULES)
+    # bob can read region
+    assert len(sql("SELECT * FROM region", sf=0.01,
+                   session={"user": "bob"}).rows()) == 5
+    # but not lineitem -- denied at plan time, before execution
+    with pytest.raises(AccessDeniedException):
+        sql("SELECT count(*) FROM lineitem", sf=0.01,
+            session={"user": "bob"})
+    # and a join sneaking lineitem in is denied too
+    with pytest.raises(AccessDeniedException):
+        sql("SELECT count(*) FROM region r JOIN lineitem l "
+            "ON l.orderkey = r.regionkey", sf=0.01,
+            session={"user": "bob"})
+
+
+def test_write_checks_enforced():
+    set_access_control([
+        {"user": "reader", "privileges": ["SELECT"]},
+        {"user": ".*", "privileges": ["SELECT", "INSERT", "CREATE",
+                                      "DELETE", "UPDATE", "DROP"]},
+    ])
+    from presto_tpu.connectors import memory as mem
+    sql("CREATE TABLE memory.acl_t AS SELECT 1 AS x", sf=0.01,
+        session={"user": "writer"})
+    with pytest.raises(AccessDeniedException):
+        sql("INSERT INTO memory.acl_t VALUES (2)", sf=0.01,
+            session={"user": "reader"})
+    with pytest.raises(AccessDeniedException):
+        sql("DROP TABLE memory.acl_t", sf=0.01,
+            session={"user": "reader"})
+    sql("DROP TABLE memory.acl_t", sf=0.01, session={"user": "writer"})
+
+
+# ---- hierarchical resource groups ---------------------------------------
+
+
+def test_parent_limit_caps_children():
+    root = ResourceGroup("root", hard_concurrency_limit=2, max_queued=10)
+    a = root.add_child(ResourceGroup("a", hard_concurrency_limit=2))
+    b = root.add_child(ResourceGroup("b", hard_concurrency_limit=2))
+    a.acquire(mem=0)
+    b.acquire(mem=0)
+    # both children have own capacity left, but the PARENT is full
+    with pytest.raises(QueryRejected):
+        a.acquire(timeout=0.05)
+    b.release()
+    a.acquire(timeout=1.0)
+    assert root.stats()["running"] == 2
+    a.release()
+    a.release()
+    assert root.stats()["running"] == 0
+
+
+def test_memory_cap_blocks_admission():
+    g = ResourceGroup("m", hard_concurrency_limit=8,
+                      soft_memory_limit_bytes=1000)
+    g.acquire(mem=800)
+    with pytest.raises(QueryRejected):
+        g.acquire(timeout=0.05, mem=300)
+    with pytest.raises(QueryRejected, match="exceeds group"):
+        g.acquire(mem=2000)  # can never fit: immediate rejection
+    g.release(mem=800)
+    g.acquire(mem=900)
+    g.release(mem=900)
+
+
+def test_weighted_fair_prefers_underweighted_leaf():
+    root = ResourceGroup("root", hard_concurrency_limit=2, max_queued=10)
+    heavy = root.add_child(ResourceGroup("heavy", hard_concurrency_limit=8,
+                                         scheduling_weight=4))
+    light = root.add_child(ResourceGroup("light", hard_concurrency_limit=8,
+                                         scheduling_weight=1))
+    heavy.acquire()  # root 2/2 occupied, both by heavy
+    heavy.acquire()
+    order = []
+    done = threading.Event()
+
+    def wait_on(g, tag):
+        g.acquire(timeout=5.0)
+        order.append(tag)
+        time.sleep(0.02)
+        g.release()
+        if len(order) == 2:
+            done.set()
+
+    # after one release: heavy has 1 running / weight 4 = 0.25;
+    # light has 0 / 1 = 0 -> light goes first despite arriving second
+    t1 = threading.Thread(target=wait_on, args=(heavy, "heavy"))
+    t2 = threading.Thread(target=wait_on, args=(light, "light"))
+    t1.start()
+    time.sleep(0.05)
+    t2.start()
+    time.sleep(0.05)
+    heavy.release()
+    done.wait(5.0)
+    heavy.release()
+    t1.join(5.0)
+    t2.join(5.0)
+    assert order[0] == "light"
+
+
+def test_dispatcher_resolves_dotted_groups_and_queue_caps():
+    root = ResourceGroup("root", hard_concurrency_limit=1, max_queued=1)
+    root.add_child(ResourceGroup("etl", hard_concurrency_limit=1,
+                                 max_queued=1))
+    d = Dispatcher([root], selector=lambda s: s.get("group", "root.etl"))
+    assert d.groups["root.etl"].name == "etl"
+    out = d.submit(lambda qid: "ok", session={"group": "root.etl"})
+    assert out == "ok"
+    stats = d.group_stats()
+    assert stats["root.etl"]["running"] == 0
+
+
+def test_statement_server_enforces_user_acl():
+    from presto_tpu.client import QueryError, execute
+    from presto_tpu.server.statement import StatementServer
+    set_access_control(RULES)
+    try:
+        with StatementServer(sf=0.01) as srv:
+            ok = execute(srv.url, "SELECT count(*) FROM region",
+                         user="bob").data
+            assert ok == [[5]]
+            with pytest.raises(QueryError, match="Access Denied"):
+                execute(srv.url, "SELECT count(*) FROM lineitem",
+                        user="bob")
+            # alice falls through to the allow-all rule
+            execute(srv.url, "SELECT count(*) FROM lineitem", user="alice")
+    finally:
+        set_access_control(None)

