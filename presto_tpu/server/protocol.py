@@ -742,9 +742,16 @@ def translate_node(j: dict) -> Tuple[N.PlanNode, List[Tuple[str, T.Type]]]:
 def translate_fragment(j: dict) -> Tuple[N.PlanNode, dict]:
     """PlanFragment JSON -> (engine plan root, fragment info). Accepts
     the fragment object directly or its base64-encoded bytes (the
-    TaskUpdateRequest wire form)."""
+    TaskUpdateRequest wire form). The envelope validates through the
+    GENERATED PlanFragment mirror (protocol_structs.py) before node
+    translation."""
     if isinstance(j, str):
         j = json.loads(base64.b64decode(j))
+    from .protocol_structs import PlanFragment as _PF
+    frag = _PF.from_dict(j)
+    if not isinstance(frag.tableScanSchedulingOrder, list):
+        raise ProtocolUnsupported(
+            "PlanFragment.tableScanSchedulingOrder must be a list")
     root, _out = translate_node(j["root"])
     info = {
         "id": j.get("id"),
@@ -777,33 +784,45 @@ def _find_scale(j):
 
 def parse_task_update_request(j: dict) -> dict:
     """TaskUpdateRequest JSON (server/TaskUpdateRequest.java:50-55) ->
-    {plan, fragmentInfo, splits, outputBuffers, session}. Raises
+    {plan, fragmentInfo, splits, outputBuffers, session}. The envelope
+    parses through the GENERATED struct mirrors (protocol_structs.py,
+    from protocol_vocab.json -- the presto_protocol_core.yml codegen
+    approach); plan-node translation stays in this module. Raises
     ProtocolUnsupported outside the slice."""
+    from .protocol_structs import Split as _Split
+    from .protocol_structs import TaskUpdateRequest as _TUR
+    req = _TUR.from_dict(j)
     out: dict = {"plan": None, "fragmentInfo": None}
-    if j.get("fragment") is not None:
-        out["plan"], out["fragmentInfo"] = translate_fragment(j["fragment"])
+    if req.fragment is not None:
+        out["plan"], out["fragmentInfo"] = translate_fragment(req.fragment)
     splits = []
-    for src in j.get("sources", []):
-        for sched in src.get("splits", []):
-            s = sched.get("split", sched)
+    raw_sources = j.get("sources") or []
+    for src, raw_src in zip(req.sources, raw_sources):
+        raw_splits = raw_src.get("splits") or []
+        for sched, raw_sched in zip(src.splits, raw_splits):
+            s = sched.split
+            if s is None:
+                # the flat wire form: split fields inline on the
+                # ScheduledSplit entry
+                s = _Split.from_dict(raw_sched)
             splits.append({
-                "planNodeId": src.get("planNodeId"),
-                "sequenceId": sched.get("sequenceId"),
-                "connectorId": s.get("connectorId"),
-                "connectorSplit": s.get("connectorSplit"),
+                "planNodeId": src.planNodeId,
+                "sequenceId": sched.sequenceId,
+                "connectorId": s.connectorId,
+                "connectorSplit": s.connectorSplit,
             })
     out["splits"] = splits
-    buffers = j.get("outputIds", {})
+    b = req.outputIds
     out["outputBuffers"] = {
-        "type": buffers.get("type"),
-        "buffers": buffers.get("buffers", {}),
-        "noMoreBufferIds": buffers.get("noMoreBufferIds", False),
+        "type": None if b is None else b.type,
+        "buffers": {} if b is None else (b.buffers or {}),
+        "noMoreBufferIds": False if b is None else b.noMoreBufferIds,
     }
-    sess = j.get("session", {})
     out["session"] = {
-        "queryId": sess.get("queryId"),
-        "user": sess.get("user"),
-        "systemProperties": sess.get("systemProperties", {}),
+        "queryId": req.session.queryId if req.session else None,
+        "user": req.session.user if req.session else None,
+        "systemProperties": (req.session.systemProperties or {})
+        if req.session else {},
     }
     return out
 
