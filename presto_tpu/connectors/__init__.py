@@ -13,10 +13,11 @@ def _load():
             "system": system, "information_schema": information_schema}
     try:
         import pyarrow  # noqa: F401  (parquet.py imports it lazily)
-        from . import parquet
+        from . import orc, parquet
         cats["parquet"] = parquet
+        cats["orc"] = orc
     except ImportError:
-        pass  # pyarrow absent: the parquet catalog is gated off
+        pass  # pyarrow absent: the parquet/orc catalogs are gated off
     return cats
 
 

@@ -1,0 +1,176 @@
+"""Shared lake-connector writer sink (ConnectorPageSink analog).
+
+One implementation of the staged-insert state machine — create/drop,
+begin_insert/append/finish_insert/abort_insert, replace_table,
+warehouse management — parameterized by the format module's primitives
+(write_table / register_table / row counts / full reads). The parquet
+and ORC connectors bind a `LakeSink` instance to module-level
+functions, so the commit semantics (staged file + atomic os.replace +
+re-registration advancing data_version) cannot drift between formats.
+Reference: presto-spi/.../spi/ConnectorPageSink.java plus the
+hive-style staged-commit pattern (finishInsert/finishCreateTable)."""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import uuid
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["LakeSink"]
+
+
+class LakeSink:
+    def __init__(self, kind: str, extension: str,
+                 tables: Dict[str, dict], lock,
+                 write_table: Callable,
+                 register_table: Callable,
+                 table_row_count: Callable,
+                 read_all: Callable):
+        """`read_all(table, columns)` -> {col: (values, nulls)} over the
+        whole table (used to merge the existing rows into a commit)."""
+        self.kind = kind
+        self.extension = extension
+        self._tables = tables
+        self._lock = lock
+        self._write_table = write_table
+        self._register_table = register_table
+        self._table_row_count = table_row_count
+        self._read_all = read_all
+        self._config: Dict[str, Optional[str]] = {"warehouse": None}
+        self._write_locks: Dict[str, threading.Lock] = {}
+        self._pending: Dict[str, dict] = {}
+
+    # -- warehouse ---------------------------------------------------------
+
+    def warehouse_dir(self) -> str:
+        d = self._config.get("warehouse") or os.path.join(
+            tempfile.gettempdir(), "presto_tpu_warehouse")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def set_warehouse(self, path: Optional[str]) -> None:
+        self._config["warehouse"] = path
+
+    def write_lock(self, table: str):
+        with self._lock:
+            return self._write_locks.setdefault(table, threading.Lock())
+
+    # -- DDL ---------------------------------------------------------------
+
+    def create_table(self, name: str, columns: Sequence[str], types,
+                     if_not_exists: bool = False) -> None:
+        with self._lock:
+            if name in self._tables:
+                if if_not_exists:
+                    return
+                raise KeyError(f"{self.kind} table {name!r} already exists")
+        path = os.path.join(self.warehouse_dir(),
+                            f"{name}{self.extension}")
+        self._write_table(path,
+                          {c: np.array([], dtype=object) for c in columns},
+                          dict(zip(columns, types)))
+        self._register_table(name, path)
+
+    def drop_table(self, name: str, if_exists: bool = False) -> None:
+        with self._lock:
+            ent = self._tables.pop(name, None)
+        if ent is None:
+            if if_exists:
+                return
+            raise KeyError(f"no {self.kind} table {name!r}")
+        # only reclaim files this connector owns (warehouse output);
+        # externally registered files are the user's
+        if ent["path"].startswith(self.warehouse_dir()):
+            try:
+                os.remove(ent["path"])
+            except OSError:
+                pass
+
+    # -- staged insert -----------------------------------------------------
+
+    def begin_insert(self, table: str,
+                     create_columns: Optional[Sequence[str]] = None,
+                     create_types=None) -> str:
+        created = False
+        if create_columns is not None:
+            self.create_table(table, create_columns, create_types)
+            created = True
+        with self._lock:
+            if table not in self._tables:
+                raise KeyError(f"no {self.kind} table {table!r}")
+            schema = self._tables[table]["schema"]
+        h = f"{self.kind}_ins_{uuid.uuid4().hex[:12]}"
+        self._pending[h] = {"table": table, "created": created,
+                            "columns": list(schema),
+                            "values": [[] for _ in schema],
+                            "nulls": [[] for _ in schema]}
+        return h
+
+    def append(self, handle: str, columns, nulls=None) -> int:
+        st = self._pending[handle]
+        if len(columns) != len(st["columns"]):
+            raise ValueError(
+                f"insert arity {len(columns)} != table arity "
+                f"{len(st['columns'])}")
+        n = len(columns[0]) if len(columns) else 0
+        for i, col in enumerate(columns):
+            st["values"][i].append(np.asarray(col))
+            st["nulls"][i].append(np.asarray(nulls[i], dtype=bool)
+                                  if nulls is not None
+                                  else np.zeros(n, dtype=bool))
+        return n
+
+    def finish_insert(self, handle: str) -> int:
+        """Commit: existing + staged rows -> a NEW file, atomically
+        os.replace'd; re-registration advances data_version (the
+        fragment-cache invalidation seam)."""
+        st = self._pending.pop(handle)
+        table = st["table"]
+        with self.write_lock(table):
+            with self._lock:
+                path = self._tables[table]["path"]
+                schema = dict(self._tables[table]["schema"])
+            cols = list(schema)
+            nrows = self._table_row_count(table)
+            old = self._read_all(table, cols) if nrows else \
+                {c: (np.array([], dtype=object),
+                     np.array([], dtype=bool)) for c in cols}
+            merged, merged_nulls = {}, {}
+            for i, c in enumerate(cols):
+                chunks = [np.asarray(x, dtype=object)
+                          for x in ([old[c][0]] + st["values"][i])]
+                nl = [np.asarray(x, dtype=bool)
+                      for x in ([old[c][1]] + st["nulls"][i])]
+                merged[c] = np.concatenate(chunks)
+                merged_nulls[c] = np.concatenate(nl)
+            rows = sum(len(x) for x in st["values"][0]) \
+                if st["values"] else 0
+            tmp = path + ".staged"
+            self._write_table(tmp, merged, schema, nulls=merged_nulls)
+            os.replace(tmp, path)
+            self._register_table(table, path)
+        return rows
+
+    def abort_insert(self, handle: str) -> None:
+        st = self._pending.pop(handle, None)
+        if st and st["created"]:
+            self.drop_table(st["table"], if_exists=True)
+
+    def replace_table(self, table: str, columns, nulls) -> None:
+        """DELETE/UPDATE commit: rewritten contents become the file."""
+        with self._lock:
+            path = self._tables[table]["path"]
+            schema = dict(self._tables[table]["schema"])
+        cols = list(schema)
+        merged = {c: np.asarray(v, dtype=object)
+                  for c, v in zip(cols, columns)}
+        merged_nulls = {c: np.asarray(n, dtype=bool)
+                        for c, n in zip(cols, nulls)}
+        tmp = path + ".staged"
+        self._write_table(tmp, merged, schema, nulls=merged_nulls)
+        os.replace(tmp, path)
+        self._register_table(table, path)

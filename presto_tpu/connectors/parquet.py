@@ -287,17 +287,14 @@ def generate_batch(table: str, sf: float, columns: Sequence[str],
     return batch_from_numpy(types, vals, capacity=cap, nulls=nulls)
 
 
-def write_table(path: str, columns: Dict[str, np.ndarray],
-                types: Dict[str, T.Type],
-                nulls: Optional[Dict[str, np.ndarray]] = None,
-                row_group_size: Optional[int] = None) -> None:
-    """Write engine-representation columns to a parquet file (the
-    test/benchmark fixture writer; a TableWriter parquet sink rides the
-    same conversion)."""
+def engine_to_arrow(columns: Dict[str, np.ndarray],
+                    types: Dict[str, T.Type],
+                    nulls: Optional[Dict[str, np.ndarray]] = None):
+    """Engine-representation columns -> a pyarrow Table (shared by the
+    parquet and ORC sinks)."""
     import decimal
 
     import pyarrow as pa
-    import pyarrow.parquet as pq
     arrays, fields = [], []
     for name, vals in columns.items():
         ty = types[name]
@@ -329,7 +326,17 @@ def write_table(path: str, columns: Dict[str, np.ndarray],
             pa_t = pa.from_numpy_dtype(ty.to_dtype())
             arrays.append(pa.array(masked(list(vals)), type=pa_t))
         fields.append(pa.field(name, arrays[-1].type))
-    tbl = pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+    return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+
+
+def write_table(path: str, columns: Dict[str, np.ndarray],
+                types: Dict[str, T.Type],
+                nulls: Optional[Dict[str, np.ndarray]] = None,
+                row_group_size: Optional[int] = None) -> None:
+    """Write engine-representation columns to a parquet file (the
+    TableWriter parquet sink and the fixture writer)."""
+    import pyarrow.parquet as pq
+    tbl = engine_to_arrow(columns, types, nulls)
     pq.write_table(tbl, path, row_group_size=row_group_size)
 
 
@@ -341,160 +348,28 @@ def data_version(table: str) -> float:
 
 
 # ---------------------------------------------------------------------------
-# Read statistics (pruning evidence) + the writer sink
-# (ConnectorPageSink analog: INSERT/CTAS land as parquet files with
-# staged-then-atomic-replace commit semantics; presto-parquet writer +
-# presto-spi ConnectorPageSink.java)
+# Read statistics (pruning evidence) + the writer sink: the staged
+# commit state machine is the SHARED LakeSink (lake_sink.py,
+# ConnectorPageSink analog), bound to this module's primitives
 # ---------------------------------------------------------------------------
 
 read_stats = {"groups_total": 0, "groups_read": 0}
 
 
-def _warehouse_dir() -> str:
-    import os
-    import tempfile
-    d = _config.get("warehouse") or os.path.join(
-        tempfile.gettempdir(), "presto_tpu_warehouse")
-    os.makedirs(d, exist_ok=True)
-    return d
+def _read_all(table: str, columns):
+    return _read(table, columns, 0, table_row_count(table))[0]
 
 
-_config: Dict[str, object] = {"warehouse": None}
-_write_locks: Dict[str, threading.Lock] = {}
-_pending: Dict[str, dict] = {}
+from .lake_sink import LakeSink  # noqa: E402
 
-
-def set_warehouse(path: Optional[str]) -> None:
-    """Directory where CTAS-created tables land (None = tempdir)."""
-    _config["warehouse"] = path
-
-
-def write_lock(table: str):
-    with _lock:
-        lk = _write_locks.setdefault(table, threading.Lock())
-    return lk
-
-
-def create_table(name: str, columns: Sequence[str],
-                 types: Sequence[T.Type],
-                 if_not_exists: bool = False) -> None:
-    import os
-    with _lock:
-        if name in _tables:
-            if if_not_exists:
-                return
-            raise KeyError(f"parquet table {name!r} already exists")
-    path = os.path.join(_warehouse_dir(), f"{name}.parquet")
-    write_table(path, {c: np.array([], dtype=object) for c in columns},
-                dict(zip(columns, types)))
-    register_table(name, path)
-
-
-def drop_table(name: str, if_exists: bool = False) -> None:
-    import os
-    with _lock:
-        ent = _tables.pop(name, None)
-    if ent is None:
-        if if_exists:
-            return
-        raise KeyError(f"no parquet table {name!r}")
-    # only reclaim files this connector owns (warehouse CTAS output);
-    # externally registered files are the user's
-    if ent["path"].startswith(_warehouse_dir()):
-        try:
-            os.remove(ent["path"])
-        except OSError:
-            pass
-
-
-def begin_insert(table: str,
-                 create_columns: Optional[Sequence[str]] = None,
-                 create_types: Optional[Sequence[T.Type]] = None) -> str:
-    import uuid
-    created = False
-    if create_columns is not None:
-        create_table(table, create_columns, create_types)
-        created = True
-    with _lock:
-        if table not in _tables:
-            raise KeyError(f"no parquet table {table!r}")
-        schema = _tables[table]["schema"]
-    h = f"pins_{uuid.uuid4().hex[:12]}"
-    _pending[h] = {"table": table, "created": created,
-                   "columns": list(schema),
-                   "values": [[] for _ in schema],
-                   "nulls": [[] for _ in schema]}
-    return h
-
-
-def append(handle: str, columns: Sequence[np.ndarray],
-           nulls: Optional[Sequence[np.ndarray]] = None) -> int:
-    st = _pending[handle]
-    if len(columns) != len(st["columns"]):
-        raise ValueError(f"insert arity {len(columns)} != table arity "
-                         f"{len(st['columns'])}")
-    n = len(columns[0]) if len(columns) else 0
-    for i, col in enumerate(columns):
-        st["values"][i].append(np.asarray(col))
-        st["nulls"][i].append(np.asarray(nulls[i], dtype=bool)
-                              if nulls is not None
-                              else np.zeros(n, dtype=bool))
-    return n
-
-
-def finish_insert(handle: str) -> int:
-    """Commit: existing rows + staged rows -> a NEW file, atomically
-    os.replace'd over the old one; the reader handle re-registers so
-    data_version advances (the fragment-cache invalidation seam)."""
-    import os
-    st = _pending.pop(handle)
-    table = st["table"]
-    with write_lock(table):
-        with _lock:
-            path = _tables[table]["path"]
-            schema = dict(_tables[table]["schema"])
-        cols = list(schema)
-        old = _read(table, cols, 0, table_row_count(table))[0] \
-            if table_row_count(table) else {c: (np.array([], dtype=object),
-                                                np.array([], dtype=bool))
-                                            for c in cols}
-        merged, merged_nulls, rows = {}, {}, 0
-        for i, c in enumerate(cols):
-            chunks = [np.asarray(x, dtype=object)
-                      for x in ([old[c][0]] + st["values"][i])]
-            nl = [np.asarray(x, dtype=bool)
-                  for x in ([old[c][1]] + st["nulls"][i])]
-            merged[c] = np.concatenate(chunks) if chunks else \
-                np.array([], dtype=object)
-            merged_nulls[c] = np.concatenate(nl) if nl else \
-                np.array([], dtype=bool)
-        rows = sum(len(x) for x in st["values"][0]) if st["values"] else 0
-        tmp = path + ".staged"
-        write_table(tmp, merged, schema, nulls=merged_nulls)
-        os.replace(tmp, path)
-        register_table(table, path)  # refresh handle + data_version
-    return rows
-
-
-def abort_insert(handle: str) -> None:
-    st = _pending.pop(handle, None)
-    if st and st["created"]:
-        drop_table(st["table"], if_exists=True)
-
-
-def replace_table(table: str, columns: Sequence[np.ndarray],
-                  nulls: Sequence[np.ndarray]) -> None:
-    """DELETE/UPDATE commit: the rewritten contents become the file."""
-    import os
-    with _lock:
-        path = _tables[table]["path"]
-        schema = dict(_tables[table]["schema"])
-    cols = list(schema)
-    merged = {c: np.asarray(v, dtype=object)
-              for c, v in zip(cols, columns)}
-    merged_nulls = {c: np.asarray(n, dtype=bool)
-                    for c, n in zip(cols, nulls)}
-    tmp = path + ".staged"
-    write_table(tmp, merged, schema, nulls=merged_nulls)
-    os.replace(tmp, path)
-    register_table(table, path)
+_sink = LakeSink("parquet", ".parquet", _tables, _lock, write_table,
+                 register_table, table_row_count, _read_all)
+set_warehouse = _sink.set_warehouse
+write_lock = _sink.write_lock
+create_table = _sink.create_table
+drop_table = _sink.drop_table
+begin_insert = _sink.begin_insert
+append = _sink.append
+finish_insert = _sink.finish_insert
+abort_insert = _sink.abort_insert
+replace_table = _sink.replace_table

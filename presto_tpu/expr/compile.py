@@ -197,6 +197,8 @@ def evaluate(expr: RowExpression, batch: Batch) -> Block:
                     "none_match", "reduce") and \
                 any(isinstance(a, Lambda) for a in expr.arguments):
             return _eval_array_lambda(expr, batch)
+        if name in ("transform_values", "transform_keys", "map_filter"):
+            return _eval_map_lambda(expr, batch)
         if name == "array_constructor":
             from ..block import ArrayColumn
             elems = [evaluate(a, batch) for a in expr.arguments]
@@ -577,6 +579,56 @@ def _eval_array_lambda(expr: Call, batch: Batch) -> Block:
         v = ~any_true
     nulls = ~any_true & any_null | arr.nulls
     return Column(v & ~nulls, nulls, expr.type)
+
+
+def _eval_map_lambda(expr: Call, batch: Batch) -> Block:
+    """Map higher-order functions (MapTransformValuesFunction family):
+    the (key, value) lambda evaluates once over flattened (N*K,) entry
+    lanes, outer columns repeated -- same shape as the array path."""
+    from ..block import MapColumn, gather_block
+    name = expr.name.lower()
+    m = evaluate(expr.arguments[0], batch)
+    assert isinstance(m, MapColumn), f"{name} over {type(m)}"
+    lam = expr.arguments[1]
+    n, k = m.keys.shape
+    kty = expr.arguments[0].type.key_type
+    vty = expr.arguments[0].type.value_type
+    lanes = jnp.arange(k, dtype=jnp.int32)[None, :]
+    in_range = lanes < m.lengths[:, None]
+    flat_k = Column(m.keys.reshape(-1), (~in_range).reshape(-1), kty)
+    flat_v = Column(m.values.reshape(-1),
+                    (m.value_nulls | ~in_range).reshape(-1), vty)
+    rep_idx = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k)
+    rep_cols = tuple(gather_block(c, rep_idx) for c in batch.columns)
+    rep_batch = Batch(rep_cols, (batch.active[:, None]
+                                 & in_range).reshape(-1))
+    out = _bind_lambda(lam, rep_batch, [flat_k, flat_v])
+    assert not isinstance(out, StringColumn), \
+        f"{name} to string lanes is not yet supported"
+    if name == "transform_values":
+        return MapColumn(m.keys, out.values.reshape(n, k),
+                         out.nulls.reshape(n, k) | ~in_range,
+                         m.lengths, m.nulls, expr.type)
+    if name == "transform_keys":
+        # SQL contract: keys are non-null AND distinct; a lambda
+        # producing a NULL or duplicate key is a per-row error (the
+        # reference raises "Duplicate map keys are not allowed") --
+        # total kernels surface it as a NULL map
+        nk = out.values.reshape(n, k)
+        bad = jnp.any(out.nulls.reshape(n, k) & in_range, axis=1)
+        both = in_range[:, :, None] & in_range[:, None, :]
+        eq = (nk[:, :, None] == nk[:, None, :]) & both
+        dup = jnp.any(eq & ~jnp.eye(k, dtype=bool)[None], axis=(1, 2))
+        return MapColumn(nk, m.values, m.value_nulls, m.lengths,
+                         m.nulls | bad | dup, expr.type)
+    # map_filter: keep entries whose predicate is TRUE
+    keep = (out.values & ~out.nulls).reshape(n, k) & in_range
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    return MapColumn(jnp.take_along_axis(m.keys, order, axis=1),
+                     jnp.take_along_axis(m.values, order, axis=1),
+                     jnp.take_along_axis(m.value_nulls, order, axis=1),
+                     jnp.sum(keep, axis=1).astype(m.lengths.dtype),
+                     m.nulls, expr.type)
 
 
 def _select(take_a, a: Block, b: Block, ty: T.Type) -> Block:

@@ -24,6 +24,7 @@ _SPECIAL_INTERCEPTED = {"like", "date_add", "date_trunc", "date_diff",
                         "at_timezone", "regexp_replace", "row_field",
                         "transform", "filter", "any_match", "all_match",
                         "none_match", "reduce", "array_constructor",
+                        "transform_values", "transform_keys", "map_filter",
                         "sequence"}
 _DATE_UNITS = {"date_add": {"day", "week", "month", "year"},
                "date_trunc": {"day", "week", "month", "quarter", "year"},

@@ -45,7 +45,8 @@ class Coordinator:
     def __init__(self, worker_urls: Optional[Sequence[str]] = None,
                  discovery_url: Optional[str] = None,
                  prober=None,
-                 writer_min_rows_per_task: int = 1 << 20):
+                 writer_min_rows_per_task: int = 1 << 20,
+                 ttl_horizon_s: float = 60.0):
         """`prober`: an optional discovery.HeartbeatProber; when set,
         workers the prober has marked failed are excluded from
         scheduling AND from retry targets (HeartbeatFailureDetector ->
@@ -60,6 +61,11 @@ class Coordinator:
         self.discovery_url = discovery_url
         self.prober = prober
         self.writer_min_rows_per_task = max(1, writer_min_rows_per_task)
+        # TTL-aware scheduling (ttl/ + presto-node-ttl-fetchers analog):
+        # nodes announcing a ttlEpochSeconds within this horizon are
+        # excluded from NEW task placement (long queries would die with
+        # the node); 0 disables the filter
+        self.ttl_horizon_s = ttl_horizon_s
 
     def workers(self) -> List[str]:
         if self._urls:
@@ -67,6 +73,17 @@ class Coordinator:
         else:
             nodes = alive_nodes(self.discovery_url)
             assert nodes, "no alive workers in discovery"
+            if self.ttl_horizon_s:
+                # TTL-aware placement: avoid nodes leaving within the
+                # horizon (they'd take running tasks down with them);
+                # never filter down to an empty cluster
+                import time as _time
+                cutoff = _time.time() + self.ttl_horizon_s
+                fresh = [n for n in nodes
+                         if n.get("ttlEpochSeconds") is None
+                         or float(n["ttlEpochSeconds"]) > cutoff]
+                if fresh:
+                    nodes = fresh
             urls = [n["uri"] for n in nodes]
         if self.prober is not None:
             healthy = set(self.prober.healthy())  # normalized (no /)

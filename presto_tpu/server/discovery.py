@@ -115,13 +115,18 @@ class Announcer:
 
     def __init__(self, discovery_url: str, node_id: str, worker_url: str,
                  interval_s: float = 1.0, environment: str = "tpu",
-                 shared_secret: Optional[str] = None):
+                 shared_secret: Optional[str] = None,
+                 ttl_epoch_s: Optional[float] = None):
         from .auth import make_authenticator
         self.discovery_url = discovery_url.rstrip("/")
         self.node_id = node_id
-        self.body = json.dumps({"uri": worker_url,
-                                "environment": environment,
-                                "coordinator": False}).encode()
+        body = {"uri": worker_url, "environment": environment,
+                "coordinator": False}
+        if ttl_epoch_s is not None:
+            # TTL-based scheduling hint (NodeTtlFetcher analog): the
+            # instant this node expects to leave the cluster
+            body["ttlEpochSeconds"] = float(ttl_epoch_s)
+        self.body = json.dumps(body).encode()
         self.interval = interval_s
         self._auth = make_authenticator(shared_secret, node_id)
         self._stop = threading.Event()

@@ -199,6 +199,21 @@ class StatementServer:
             q = self._queries.get(query_id)
         return q.user if q is not None else ""
 
+    def _emit_trace(self, q: "_Query") -> None:
+        """Terminal-state hook: per-state spans to the process tracer
+        (QueryStateTracingListener analog; no-op without a tracer)."""
+        from .tracing import get_tracer, spans_from_state_timings
+        if get_tracer() is None:
+            return
+        try:
+            spans_from_state_timings(
+                q.id, q.machine.timings(),
+                ["QUEUED", "PLANNING", "RUNNING", "FINISHING",
+                 "FINISHED", "FAILED"],
+                {"user": q.user, "query": q.text[:200]})
+        except Exception:  # noqa: BLE001 - tracing must never fail a query
+            pass
+
     def _reap_locked(self) -> None:
         """Drop terminal queries (and their materialized result rows)
         older than query_ttl_s -- QueryTracker's expiration (the worker
@@ -212,6 +227,14 @@ class StatementServer:
 
     def create_query(self, text: str, user: str,
                      session_values: Dict, txn_id: Optional[str]) -> _Query:
+        # rule-based session defaults (SessionPropertyConfigurationManager
+        # analog): manager defaults under, client values over
+        from .session_properties import get_session_property_manager
+        mgr = get_session_property_manager()
+        if mgr is not None:
+            session_values = {**mgr.defaults_for(
+                user, session_values.get("source", ""),
+                session_values.get("clientTags")), **session_values}
         q = _Query(f"20260730_{uuid.uuid4().hex[:12]}",
                    uuid.uuid4().hex[:12], text, session_values, user,
                    txn_id)
@@ -222,6 +245,13 @@ class StatementServer:
         return q
 
     def _run(self, q: _Query):
+        try:
+            self._run_inner(q)
+        finally:
+            if q.machine.is_done():
+                self._emit_trace(q)
+
+    def _run_inner(self, q: _Query):
         m = _SESSION_STMT.match(q.text)
         try:
             if m:
@@ -495,6 +525,14 @@ def _make_handler(server: StatementServer):
             user = self.headers.get("X-Presto-User", "anonymous")
             session_values = _parse_session_header(
                 self.headers.get("X-Presto-Session", ""))
+            src = self.headers.get("X-Presto-Source")
+            if src:
+                session_values.setdefault("source", src)
+            tags = self.headers.get("X-Presto-Client-Tags")
+            if tags:
+                session_values.setdefault(
+                    "clientTags", [t.strip() for t in tags.split(",")
+                                   if t.strip()])
             txn = self.headers.get("X-Presto-Transaction-Id")
             if txn in (None, "", "NONE"):
                 txn = None

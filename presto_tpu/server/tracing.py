@@ -1,0 +1,78 @@
+"""Tracer SPI: per-query spans, pluggable exporters.
+
+Reference surface: presto-spi/.../spi/tracing/Tracer.java +
+TracerProviderManager (default SimpleTracer) and the OpenTelemetry
+plugin (spans at query state transitions,
+tracing/QueryStateTracingListener.java). This engine's spans derive
+from the places time is actually spent -- the statement server's query
+state machine and the runner's RuntimeStats -- and export as plain
+dicts (OTel-shaped: name, start/end micros, attributes), so any
+exporter (file, collector client) can consume them.
+
+    set_tracer(RecordingTracer())      # or any object with span()
+    ... run queries ...
+    get_tracer().traces["20260730_..."]  # [{name, startUs, endUs, ...}]
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["RecordingTracer", "set_tracer", "get_tracer",
+           "spans_from_state_timings"]
+
+
+class RecordingTracer:
+    """SimpleTracer analog: keeps spans per trace id in memory."""
+
+    def __init__(self, max_traces: int = 256):
+        self.traces: Dict[str, List[dict]] = {}
+        self.max_traces = max_traces
+        self._lock = threading.Lock()
+
+    def span(self, trace_id: str, name: str, start_s: float, end_s: float,
+             attributes: Optional[dict] = None) -> None:
+        doc = {"name": name,
+               "startUs": int(start_s * 1_000_000),
+               "endUs": int(end_s * 1_000_000),
+               "attributes": dict(attributes or {})}
+        with self._lock:
+            if trace_id not in self.traces and \
+                    len(self.traces) >= self.max_traces:
+                self.traces.pop(next(iter(self.traces)))
+            self.traces.setdefault(trace_id, []).append(doc)
+
+    def spans(self, trace_id: str) -> List[dict]:
+        with self._lock:
+            return list(self.traces.get(trace_id, []))
+
+
+_tracer: Optional[RecordingTracer] = None
+
+
+def set_tracer(tracer) -> None:
+    """Install the process tracer (None disables tracing)."""
+    global _tracer
+    _tracer = tracer
+
+
+def get_tracer():
+    return _tracer
+
+
+def spans_from_state_timings(trace_id: str, timings: Dict[str, float],
+                             order: List[str],
+                             attributes: Optional[dict] = None) -> None:
+    """State-machine enter-times -> one span per state (the
+    QueryStateTracingListener shape): each state's span runs from its
+    enter time to the next entered state's (or now)."""
+    t = get_tracer()
+    if t is None:
+        return
+    entered = [(s, timings[s]) for s in order if s in timings]
+    entered.sort(key=lambda x: x[1])
+    for i, (state, start) in enumerate(entered):
+        end = entered[i + 1][1] if i + 1 < len(entered) else time.time()
+        t.span(trace_id, f"query.{state.lower()}", start, end, attributes)

@@ -526,7 +526,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _send_bytes(self, body: bytes, headers: Dict[str, str], code=200):
         self.send_response(code)
-        self.send_header("Content-Type", "application/x-presto-pages")
+        if "Content-Type" not in headers:
+            self.send_header("Content-Type", "application/x-presto-pages")
         for k, v in headers.items():
             self.send_header(k, v)
         self.send_header("Content-Length", str(len(body)))
@@ -612,10 +613,19 @@ class _Handler(BaseHTTPRequestHandler):
             if task is None:
                 return self._send_json({"error": "no such task"}, 404)
             from .protocol import task_status_json
-            return self._send_json(task_status_json(
+            doc = task_status_json(
                 parts[2], task.state, f"http://{self.node_id}",
                 failures=[task.error] if getattr(task, "error", None)
-                else None))
+                else None)
+            if "application/x-thrift" in self.headers.get("Accept", ""):
+                # the reference's optional thrift transport for the hot
+                # status poll (ThriftTaskClient; JSON parse dominates at
+                # cluster scale)
+                from ..serde.thrift import encode_task_status
+                return self._send_bytes(
+                    encode_task_status(doc, parts[2]),
+                    {"Content-Type": "application/x-thrift"})
+            return self._send_json(doc)
         if len(parts) == 7 and parts[:2] == ["v1", "task"] and \
                 parts[3] == "results" and parts[6] == "acknowledge":
             self.manager.acknowledge(parts[2], int(parts[5]), int(parts[4]))

@@ -297,6 +297,20 @@ class _Analyzer:
             return E.Lambda(body.type, tuple(lam.params), body)
 
         arr = self.lower(node.args[0], scope)
+        if arr.type.base == "map":
+            kty, vty = arr.type.key_type, arr.type.value_type
+            if name == "transform_values":
+                lam = lower_lambda(node.args[1], [kty, vty])
+                return E.call("transform_values", T.map_of(kty, lam.type),
+                              arr, lam)
+            if name == "transform_keys":
+                lam = lower_lambda(node.args[1], [kty, vty])
+                return E.call("transform_keys", T.map_of(lam.type, vty),
+                              arr, lam)
+            if name == "map_filter":
+                lam = lower_lambda(node.args[1], [kty, vty])
+                return E.call("map_filter", arr.type, arr, lam)
+            raise NotImplementedError(f"lambda function {name!r} over map")
         if arr.type.base != "array":
             raise NotImplementedError(f"{name} over {arr.type}")
         ety = arr.type.element_type

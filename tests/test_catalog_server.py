@@ -1,0 +1,51 @@
+"""Catalog server: remote metadata for coordinators.
+
+Reference behavior: presto-main-base/.../catalogserver/ +
+RemoteMetadataManager -- catalog metadata (schemas, tables, stats)
+resolves through a separate service; data scanning stays with the
+data-bearing connectors on workers."""
+
+import pytest
+
+from presto_tpu import types as T
+from presto_tpu.server.catalog_server import (CatalogServer,
+                                              register_remote_catalog,
+                                              unregister_remote_catalog)
+from presto_tpu.sql import sql
+
+
+@pytest.fixture
+def remote_tpch():
+    with CatalogServer() as srv:
+        proxy = register_remote_catalog("rtpch", srv.url, "tpch")
+        yield proxy
+        unregister_remote_catalog("rtpch")
+
+
+def test_remote_metadata_matches_local(remote_tpch):
+    from presto_tpu.connectors import tpch
+    assert set(remote_tpch.SCHEMA.keys()) == set(tpch.TPCH_SCHEMA)
+    local = dict(tpch.TPCH_SCHEMA["region"])
+    assert remote_tpch.SCHEMA["region"] == local
+    assert remote_tpch.table_row_count("nation", 0.01) == 25
+    assert remote_tpch.column_distinct_count("nation", "regionkey", 0.01) \
+        == tpch.column_distinct_count("nation", "regionkey", 0.01)
+
+
+def test_show_and_describe_work_against_remote_catalog(remote_tpch):
+    tabs = [r[0] for r in sql("SHOW TABLES FROM rtpch", sf=0.01).rows()]
+    assert "lineitem" in tabs
+    cols = sql("DESCRIBE rtpch.region", sf=0.01).rows()
+    assert [c[0] for c in cols] == ["regionkey", "name", "comment"]
+
+
+def test_remote_scan_is_rejected_with_catalogserver_semantics(remote_tpch):
+    with pytest.raises(Exception, match="METADATA|not executable"):
+        sql("SELECT count(*) FROM rtpch.region", sf=0.01)
+
+
+def test_planner_stats_flow_through_remote_catalog(remote_tpch):
+    from presto_tpu.plan.stats import estimate_rows
+    from presto_tpu.plan import nodes as N
+    scan = N.TableScanNode("rtpch", "orders", ["orderkey"], [T.BIGINT])
+    assert estimate_rows(scan, 0.01) == 15000.0

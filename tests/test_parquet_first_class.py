@@ -103,3 +103,43 @@ def test_delete_update_on_parquet(tmp_path):
         sql("DROP TABLE parquet.du", sf=0.01)
     finally:
         pq_conn.set_warehouse(None)
+
+
+# ---- ORC (the reference's other first-class lake format) -----------------
+
+
+def test_orc_roundtrip_and_query(tmp_path):
+    from presto_tpu.connectors import orc as orc_conn
+    cols = tpch.generate_columns(
+        "lineitem", 0.01, ["orderkey", "quantity", "shipdate"])
+    schema = dict(tpch.TPCH_SCHEMA["lineitem"])
+    path = str(tmp_path / "li.orc")
+    orc_conn.write_table(path, {c: cols[c] for c in cols},
+                         {c: schema[c] for c in cols})
+    orc_conn.register_table("orc_li", path)
+    try:
+        q = ("SELECT count(*), sum(quantity) FROM {t} "
+             "WHERE shipdate < date '1995-01-01'")
+        got = sql(q.format(t="orc.orc_li"), sf=0.01).rows()
+        want = sql(q.format(t="lineitem"), sf=0.01).rows()
+        assert got == want
+    finally:
+        orc_conn.unregister_table("orc_li")
+
+
+def test_orc_ctas_insert_delete(tmp_path):
+    from presto_tpu.connectors import orc as orc_conn
+    orc_conn.set_warehouse(str(tmp_path))
+    try:
+        sql("CREATE TABLE orc.t AS SELECT nationkey, regionkey "
+            "FROM nation", sf=0.01)
+        assert sql("SELECT count(*) FROM orc.t", sf=0.01).rows() == [(25,)]
+        sql("INSERT INTO orc.t SELECT nationkey + 100, regionkey "
+            "FROM nation WHERE nationkey < 3", sf=0.01)
+        assert sql("SELECT count(*) FROM orc.t", sf=0.01).rows() == [(28,)]
+        sql("DELETE FROM orc.t WHERE nationkey >= 100", sf=0.01)
+        assert sql("SELECT count(*) FROM orc.t", sf=0.01).rows() == [(25,)]
+        sql("DROP TABLE orc.t", sf=0.01)
+        assert "t" not in orc_conn.SCHEMA
+    finally:
+        orc_conn.set_warehouse(None)

@@ -1,0 +1,69 @@
+"""Thrift binary transport for the TaskStatus poll.
+
+Reference behavior: server/thrift/ThriftTaskClient.java + the native
+worker's presto_thrift.thrift -- an optional binary transport for the
+hot status structs, negotiated per request; JSON stays the default."""
+
+import json
+
+import pytest
+
+from presto_tpu.serde.thrift import (TASK_STATUS_SCHEMA, decode_struct,
+                                     decode_task_status, encode_struct,
+                                     encode_task_status)
+
+
+def test_round_trip_all_field_kinds():
+    doc = {"taskId": "t1", "state": "RUNNING", "self": "http://n1/v1/task/t1",
+           "version": 7, "memoryReservationInBytes": 123456789,
+           "outputBufferUtilization": 0.25,
+           "outputBufferOverutilized": True,
+           "runningPartitionedDrivers": 2, "queuedPartitionedDrivers": 0,
+           "failureMessages": ["boom", "again"], "taskAgeInMillis": 42}
+    out = decode_struct(encode_struct(doc, TASK_STATUS_SCHEMA),
+                        TASK_STATUS_SCHEMA)
+    assert out == doc
+
+
+def test_unknown_fields_skip_forward_compatibly():
+    schema_v2 = dict(TASK_STATUS_SCHEMA)
+    schema_v2["futureField"] = (99, 10)  # a field this build predates
+    data = encode_struct({"taskId": "x", "futureField": 5}, schema_v2)
+    out = decode_struct(data, TASK_STATUS_SCHEMA)
+    assert out == {"taskId": "x"}
+
+
+def test_worker_negotiates_thrift_status():
+    import http.client
+
+    from presto_tpu.plan import nodes as N
+    from presto_tpu import types as T
+    from presto_tpu.server.client import WorkerClient
+    from presto_tpu.server.worker import TpuWorkerServer
+
+    srv = TpuWorkerServer(sf=0.001).start()
+    try:
+        plan = N.OutputNode(
+            N.TableScanNode("tpch", "region", ["regionkey"], [T.BIGINT]),
+            ["regionkey"])
+        c = WorkerClient(f"http://127.0.0.1:{srv.port}")
+        c.submit("th-1", plan, sf=0.001)
+        c.wait("th-1")
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+        # JSON by default
+        conn.request("GET", "/v1/task/th-1/status")
+        r = conn.getresponse()
+        assert r.getheader("Content-Type").startswith("application/json")
+        jdoc = json.loads(r.read())
+        # thrift when asked
+        conn.request("GET", "/v1/task/th-1/status",
+                     headers={"Accept": "application/x-thrift"})
+        r = conn.getresponse()
+        assert r.getheader("Content-Type") == "application/x-thrift"
+        tdoc = decode_task_status(r.read())
+        assert tdoc["taskId"] == "th-1"
+        assert tdoc["state"] == jdoc["state"] == "FINISHED"
+        assert tdoc["self"] == jdoc["self"]
+        conn.close()
+    finally:
+        srv.stop()

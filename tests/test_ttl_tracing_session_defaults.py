@@ -1,0 +1,103 @@
+"""TTL-aware scheduling, tracer SPI, session-property manager.
+
+Reference behavior: the node-TTL subsystem (ttl/ +
+presto-node-ttl-fetchers: the scheduler avoids nodes expiring
+mid-query), the Tracer SPI (spi/tracing + QueryStateTracingListener
+span-per-state), and SessionPropertyConfigurationManager (rule-based
+per-user/source session defaults; client values win)."""
+
+import time
+
+import pytest
+
+from presto_tpu.server.session_properties import (
+    SessionPropertyManager, set_session_property_manager)
+from presto_tpu.server.tracing import RecordingTracer, set_tracer
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    set_tracer(None)
+    set_session_property_manager(None)
+
+
+def test_ttl_expiring_nodes_excluded_from_placement():
+    from presto_tpu.server.coordinator import Coordinator
+    from presto_tpu.server.discovery import Announcer, DiscoveryServer
+
+    d = DiscoveryServer().start()
+    try:
+        url = f"http://127.0.0.1:{d.port}"
+        fresh = Announcer(url, "n-fresh", "http://w-fresh",
+                          ttl_epoch_s=time.time() + 3600)
+        dying = Announcer(url, "n-dying", "http://w-dying",
+                          ttl_epoch_s=time.time() + 5)
+        fresh.announce_once()
+        dying.announce_once()
+        c = Coordinator(discovery_url=url, ttl_horizon_s=60.0)
+        assert c.workers() == ["http://w-fresh"]
+        # horizon off: both nodes schedulable
+        c2 = Coordinator(discovery_url=url, ttl_horizon_s=0.0)
+        assert sorted(c2.workers()) == ["http://w-dying", "http://w-fresh"]
+        # never filter to an empty cluster: if EVERY node is expiring,
+        # keep them all rather than refuse to schedule
+        fresh.stop(unannounce=True)
+        c3 = Coordinator(discovery_url=url, ttl_horizon_s=60.0)
+        assert c3.workers() == ["http://w-dying"]
+    finally:
+        d.stop()
+
+
+def test_tracer_records_query_state_spans():
+    from presto_tpu.client import execute
+    from presto_tpu.server.statement import StatementServer
+
+    tracer = RecordingTracer()
+    set_tracer(tracer)
+    with StatementServer(sf=0.01) as srv:
+        r = execute(srv.url, "SELECT count(*) FROM region")
+        assert r.data == [[5]]
+    traces = list(tracer.traces.values())
+    assert traces, "no spans recorded"
+    names = {s["name"] for s in traces[-1]}
+    assert "query.running" in names
+    for s in traces[-1]:
+        assert s["endUs"] >= s["startUs"]
+        assert s["attributes"]["user"]
+
+
+def test_session_property_manager_defaults_and_precedence():
+    mgr = SessionPropertyManager([
+        {"user": "etl_.*", "properties": {"join_distribution_type":
+                                          "PARTITIONED"}},
+        {"source": "dash.*", "properties": {"sf": "0.001"}},
+    ])
+    assert mgr.defaults_for("etl_nightly") == \
+        {"join_distribution_type": "PARTITIONED"}
+    assert mgr.defaults_for("bob", "dashboard") == {"sf": "0.001"}
+    assert mgr.defaults_for("etl_x", "dash1") == \
+        {"join_distribution_type": "PARTITIONED", "sf": "0.001"}
+    assert mgr.defaults_for("bob") == {}
+
+
+def test_session_defaults_applied_at_server_client_wins():
+    from presto_tpu.client import execute
+    from presto_tpu.server.statement import StatementServer
+
+    set_session_property_manager([
+        {"user": "small", "properties": {"sf": "0.001"}},
+    ])
+    with StatementServer(sf=0.01) as srv:
+        # default applies: sf 0.001 -> nation has 25 rows either way,
+        # lineitem row count differs by sf
+        n_small = execute(srv.url, "SELECT count(*) FROM lineitem",
+                          user="small").data[0][0]
+        n_default = execute(srv.url, "SELECT count(*) FROM lineitem",
+                            user="other").data[0][0]
+        assert n_small < n_default
+        # explicit client session value beats the manager default
+        n_override = execute(srv.url, "SELECT count(*) FROM lineitem",
+                             user="small",
+                             session={"sf": "0.01"}).data[0][0]
+        assert n_override == n_default
