@@ -161,6 +161,9 @@ def _rows_of(table: str) -> List[tuple]:
         out += [(n, "aggregate") for n in sorted(_AGGS)]
         from ..ops.window import _FUNCS as _WIN
         out += [(n, "window") for n in sorted(_WIN)]
+        from ..sql.udf import get_function_namespace_manager
+        out += [(f.qualified_name, "sql-invoked")
+                for f in get_function_namespace_manager().list_functions()]
         return out
     if table == "plan_cache":
         from ..exec.plan_cache import cache_stats

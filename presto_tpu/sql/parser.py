@@ -632,6 +632,17 @@ class _Parser:
             parts = [v]
             while self.accept_op("."):
                 parts.append(self.expect_ident())
+            if len(parts) > 1 and self.peek() == ("op", "("):
+                # qualified function call (namespace-managed UDFs:
+                # catalog.schema.fn(...))
+                self.next()
+                args: List[object] = []
+                if self.peek() != ("op", ")"):
+                    args.append(self.expr())
+                    while self.accept_op(","):
+                        args.append(self.expr())
+                self.expect_op(")")
+                return Func(".".join(p.lower() for p in parts), args)
             return Name(tuple(parts))
         raise ValueError(f"unexpected token {(k, v)}")
 
@@ -910,6 +921,16 @@ class _Parser:
             else:
                 self.expect_kw("last")
         return OrderItem(e, desc, nulls_last)
+
+
+def parse_expression(text: str):
+    """Parse ONE scalar expression (SQL-invoked function bodies)."""
+    p = _Parser(_tokenize(text))
+    e = p.expr()
+    k, v = p.peek()
+    if k != "eof":
+        raise ValueError(f"trailing tokens in expression at {(k, v)}")
+    return e
 
 
 def parse_sql(text: str):

@@ -269,6 +269,14 @@ class _Analyzer:
             # kernels are total (errors produce NULL lanes, never raise),
             # so TRY is the identity on this engine
             return args[0]
+        udf_hit = None
+        if "." in name:
+            from .udf import get_function_namespace_manager
+            udf_hit = get_function_namespace_manager().lookup(name)
+            if udf_hit is None:
+                raise NotImplementedError(f"no function {name!r}")
+        if udf_hit is not None:
+            return self._expand_udf(udf_hit, args)
         if name in ("now", "current_timestamp"):
             from .. import tz as _tz
             return E.const(_statement_now_us() << 12 | _tz.UTC_KEY,
@@ -277,8 +285,76 @@ class _Analyzer:
             return E.const(_statement_now_us() // 86_400_000_000, T.DATE)
         if name == "localtimestamp":
             return E.const(_statement_now_us(), T.TIMESTAMP)
-        rty = self._func_type(name, args)
+        try:
+            rty = self._func_type(name, args)
+        except NotImplementedError:
+            # unqualified SQL-invoked functions resolve AFTER builtins
+            # (presto.default namespace; the reference's resolution
+            # order)
+            from .udf import get_function_namespace_manager
+            udf = get_function_namespace_manager().lookup(name)
+            if udf is None:
+                raise
+            return self._expand_udf(udf, args)
         return E.call(name, rty, *args)
+
+    def _expand_udf(self, udf, args: List[E.RowExpression]
+                    ) -> E.RowExpression:
+        """SQL-invoked function: inline the body with parameters bound
+        to the lowered argument expressions (a typed macro -- the UDF
+        dissolves before XLA sees the plan). Arguments coerce to the
+        declared parameter types (mismatches are plan-time errors);
+        substitution is scope-aware (lambda parameters shadowing a UDF
+        parameter are NOT captured); recursion is rejected."""
+        from .udf import body_ast as _body_ast
+        if len(args) != len(udf.parameters):
+            raise ValueError(
+                f"{udf.qualified_name} takes {len(udf.parameters)} "
+                f"argument(s), got {len(args)}")
+        in_progress = _UDF_EXPANDING.get()
+        if udf.qualified_name in in_progress:
+            raise ValueError(
+                f"recursive SQL function {udf.qualified_name!r}")
+        token = _UDF_EXPANDING.set(in_progress | {udf.qualified_name})
+        try:
+            ls = _Scope({}, [])
+            ls.lambda_vars = {p: ty for p, ty in udf.parameters}
+            body = self.lower(_body_ast(udf), ls)
+        finally:
+            _UDF_EXPANDING.reset(token)
+        binding = {}
+        for (pname, pty), a in zip(udf.parameters, args):
+            if a.type != pty:
+                compatible = (a.type.is_numeric and pty.is_numeric) or                     (a.type.is_string and pty.is_string) or                     a.type == T.UNKNOWN
+                if not compatible:
+                    raise ValueError(
+                        f"{udf.qualified_name} parameter {pname!r} is "
+                        f"{pty}, got {a.type}")
+                a = E.call("cast", pty, a)
+            binding[pname] = a
+
+        def substitute(e, bnd):
+            if isinstance(e, E.LambdaVariable) and e.name in bnd:
+                return bnd[e.name]
+            if isinstance(e, E.Lambda):
+                # a lambda parameter shadowing a UDF parameter binds
+                # tighter: do not capture it
+                inner = {k: v for k, v in bnd.items()
+                         if k not in e.parameters}
+                nb = substitute(e.body, inner)
+                return e if nb is e.body else                     E.Lambda(e.type, e.parameters, nb)
+            if isinstance(e, E.Call):
+                na = tuple(substitute(x, bnd) for x in e.arguments)
+                return e if na == e.arguments else                     E.Call(e.type, e.name, na)
+            if isinstance(e, E.SpecialForm):
+                na = tuple(substitute(x, bnd) for x in e.arguments)
+                return e if na == e.arguments else                     E.SpecialForm(e.type, e.form, na)
+            return e
+
+        body = substitute(body, binding)
+        if body.type != udf.return_type:
+            body = E.call("cast", udf.return_type, body)
+        return body
 
     def _lambda_func(self, node: P.Func, scope: _Scope) -> E.RowExpression:
         """Array/map higher-order functions (ArrayTransformFunction.java
@@ -479,6 +555,11 @@ class _Analyzer:
         if dataclasses.is_dataclass(node):
             walk(node)
         return out
+
+
+# UDF names whose expansion is in progress (recursion detection)
+_UDF_EXPANDING: contextvars.ContextVar = contextvars.ContextVar(
+    "udf_expanding", default=frozenset())
 
 
 def _dt_plus_interval_type(dt: T.Type, iv: T.Type) -> T.Type:

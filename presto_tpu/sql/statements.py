@@ -146,6 +146,18 @@ def _from_and_like(rest: str, default_catalog: str):
 def preprocess(text: str, catalog: str = "tpch",
                prepared: Optional[PreparedStatements] = None
                ) -> Preprocessed:
+    from .udf import (get_function_namespace_manager,
+                      parse_create_function, parse_drop_function)
+    cf = parse_create_function(text)
+    if cf is not None:
+        fn, replace = cf
+        get_function_namespace_manager().register(fn, replace=replace)
+        return Preprocessed(ack="CREATE FUNCTION")
+    df = parse_drop_function(text)
+    if df is not None:
+        name, if_exists = df
+        get_function_namespace_manager().drop(name, if_exists=if_exists)
+        return Preprocessed(ack="DROP FUNCTION")
     m = _PREPARE_RE.match(text)
     if m:
         if prepared is None:

@@ -1,0 +1,113 @@
+"""SQL-invoked functions + function namespace manager.
+
+Reference behavior: presto-function-namespace-managers (functions keyed
+catalog.schema.name) and CREATE FUNCTION ... RETURN <expr> SQL UDFs,
+inlined before execution."""
+
+import pytest
+
+from presto_tpu.sql import sql
+from presto_tpu.sql.udf import reset_functions
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    reset_functions()
+
+
+def test_create_call_drop_cycle():
+    sql("CREATE FUNCTION double_it(x bigint) RETURNS bigint RETURN x * 2",
+        sf=0.01)
+    got = sql("SELECT double_it(nationkey) FROM nation "
+              "WHERE nationkey < 3 ORDER BY 1", sf=0.01).rows()
+    assert [r[0] for r in got] == [0, 2, 4]
+    # composition and nesting inline cleanly
+    assert sql("SELECT double_it(double_it(5))", sf=0.01).rows() == [(20,)]
+    sql("DROP FUNCTION double_it", sf=0.01)
+    with pytest.raises(NotImplementedError):
+        sql("SELECT double_it(1)", sf=0.01)
+
+
+def test_qualified_namespace_and_show_functions():
+    sql("CREATE FUNCTION my.math.hyp(a double, b double) RETURNS double "
+        "RETURN sqrt(a * a + b * b)", sf=0.01)
+    assert sql("SELECT my.math.hyp(3.0, 4.0)", sf=0.01).rows() == [(5.0,)]
+    fns = {tuple(r) for r in sql("SHOW FUNCTIONS", sf=0.01).rows()}
+    assert ("my.math.hyp", "sql-invoked") in fns
+    sql("DROP FUNCTION my.math.hyp", sf=0.01)
+
+
+def test_or_replace_and_arity_checks():
+    sql("CREATE FUNCTION f1(x bigint) RETURNS bigint RETURN x + 1", sf=0.01)
+    with pytest.raises(KeyError, match="already exists"):
+        sql("CREATE FUNCTION f1(x bigint) RETURNS bigint RETURN x", sf=0.01)
+    sql("CREATE OR REPLACE FUNCTION f1(x bigint) RETURNS bigint "
+        "RETURN x + 10", sf=0.01)
+    assert sql("SELECT f1(1)", sf=0.01).rows() == [(11,)]
+    with pytest.raises(ValueError, match="argument"):
+        sql("SELECT f1(1, 2)", sf=0.01)
+    sql("DROP FUNCTION f1", sf=0.01)
+    sql("DROP FUNCTION IF EXISTS f1", sf=0.01)  # idempotent
+
+
+def test_return_type_cast_and_builtin_precedence():
+    # bigint/bigint stays integer division (Presto semantics); the
+    # declared RETURNS double casts the RESULT
+    sql("CREATE FUNCTION halve(x bigint) RETURNS double RETURN x / 2",
+        sf=0.01)
+    assert sql("SELECT halve(5)", sf=0.01).rows() == [(2.0,)]
+    # a UDF named like a builtin does NOT shadow it (builtins first)
+    sql("CREATE FUNCTION abs(x bigint) RETURNS bigint RETURN x * 100",
+        sf=0.01)
+    assert sql("SELECT abs(-3)", sf=0.01).rows() == [(3,)]
+    sql("DROP FUNCTION halve", sf=0.01)
+    sql("DROP FUNCTION abs", sf=0.01)
+
+
+def test_udf_over_table_data_through_server():
+    from presto_tpu.client import execute
+    from presto_tpu.server.statement import StatementServer
+    with StatementServer(sf=0.01) as srv:
+        execute(srv.url, "CREATE FUNCTION keymod(p bigint) RETURNS bigint "
+                         "RETURN p * 7 % 100")
+        got = execute(srv.url, "SELECT sum(keymod(orderkey)) FROM lineitem "
+                               "WHERE orderkey < 10").data
+        want = execute(srv.url, "SELECT sum(orderkey * 7 % 100) "
+                                "FROM lineitem WHERE orderkey < 10").data
+        assert got == want
+
+
+def test_lambda_shadowing_is_not_captured():
+    sql("CREATE FUNCTION cap2(x bigint) RETURNS array(bigint) "
+        "RETURN transform(ARRAY[1, 2, 3], x -> x * 10)", sf=0.01)
+    assert sql("SELECT cap2(7)", sf=0.01).rows() == [([10, 20, 30],)]
+    sql("CREATE FUNCTION usecap(x bigint) RETURNS array(bigint) "
+        "RETURN transform(ARRAY[1, 2, 3], y -> y + x)", sf=0.01)
+    assert sql("SELECT usecap(7)", sf=0.01).rows() == [([8, 9, 10],)]
+
+
+def test_argument_types_checked_and_coerced():
+    sql("CREATE FUNCTION dbl(x bigint) RETURNS bigint RETURN x * 2",
+        sf=0.01)
+    with pytest.raises(ValueError, match="parameter"):
+        sql("SELECT dbl('7')", sf=0.01)
+    # numeric arguments coerce to the declared type (2.5 -> bigint)
+    got = sql("SELECT dbl(2.5)", sf=0.01).rows()[0][0]
+    assert got in (4, 6)  # round vs truncate on cast; never 5
+
+
+def test_recursive_function_rejected_cleanly():
+    sql("CREATE FUNCTION rec(x bigint) RETURNS bigint RETURN rec(x)",
+        sf=0.01)
+    with pytest.raises(ValueError, match="recursive"):
+        sql("SELECT rec(1)", sf=0.01)
+
+
+def test_whitespace_and_syntax_errors_surface_at_create():
+    sql("CREATE FUNCTION wsfn(a\tbigint,\n  b bigint) RETURNS bigint "
+        "RETURN a + b", sf=0.01)
+    assert sql("SELECT wsfn(2, 3)", sf=0.01).rows() == [(5,)]
+    with pytest.raises(Exception):
+        sql("CREATE FUNCTION badfn(x bigint) RETURNS bigint "
+            "RETURN x +", sf=0.01)
