@@ -193,19 +193,72 @@ class Dispatcher:
     admission and lifecycle events."""
 
     def __init__(self, groups: Optional[List[ResourceGroup]] = None,
-                 selector: Optional[Callable[[Dict], str]] = None):
+                 selector: Optional[Callable[[Dict], str]] = None,
+                 resource_manager_url: Optional[str] = None,
+                 coordinator_id: Optional[str] = None,
+                 cluster_limits: Optional[Dict[str, int]] = None):
+        """`resource_manager_url` + `cluster_limits` ({group path:
+        cluster-wide hard concurrency}) enforce limits ACROSS
+        coordinators: admission consults the resource manager's
+        aggregated view and waits while other coordinators hold the
+        cluster's slots (resourcemanager/ multi-coordinator
+        arbitration)."""
         # register every group in each tree under its dotted path, so
         # selectors can target leaves ("etl.nightly") or roots ("etl")
         self.groups: Dict[str, ResourceGroup] = {}
         for root in (groups or [ResourceGroup("global")]):
             self._register(root, root.name)
         self._selector = selector or (lambda session: "global")
+        self.resource_manager_url = resource_manager_url
+        self.coordinator_id = coordinator_id or f"coord-{id(self):x}"
+        self.cluster_limits = dict(cluster_limits or {})
 
     def _register(self, g: ResourceGroup, path: str):
         self.groups[path] = g
         self.groups.setdefault(g.name, g)
         for c in g.children.values():
             self._register(c, f"{path}.{c.name}")
+
+    def _await_cluster_slot(self, group_name: str, group: ResourceGroup,
+                            deadline: Optional[float]) -> None:
+        """Cluster-wide admission gate: while OTHER coordinators'
+        running queries leave no room under a cluster limit configured
+        on the selected group OR ANY ANCESTOR path (local admission
+        enforces the whole chain; so does this gate), wait (bounded
+        poll; the reference long-polls the RM the same way). RM
+        unreachable = fail open to local-only admission (availability
+        over global strictness, the reference's degraded mode)."""
+        if self.resource_manager_url is None:
+            return
+        parts = group_name.split(".")
+        gates = []
+        for i in range(len(parts)):
+            prefix = ".".join(parts[:i + 1])
+            limit = self.cluster_limits.get(prefix)
+            if limit is not None and prefix in self.groups:
+                gates.append((prefix, limit, self.groups[prefix]))
+        if not gates:
+            return
+        from .resource_manager import remote_group_load
+        while True:
+            try:
+                blocked = None
+                for prefix, limit, g in gates:
+                    remote = remote_group_load(self.resource_manager_url,
+                                               prefix,
+                                               self.coordinator_id)
+                    if remote + g.stats()["running"] >= limit:
+                        blocked = (prefix, limit)
+                        break
+            except Exception:  # noqa: BLE001 - RM down: local-only
+                return
+            if blocked is None:
+                return
+            if deadline is not None and time.time() >= deadline:
+                raise QueryRejected(
+                    f"cluster limit {blocked[1]} for group "
+                    f"{blocked[0]!r} held by other coordinators")
+            time.sleep(0.05)
 
     def group_stats(self) -> Dict[str, Dict[str, int]]:
         return {name: g.stats() for name, g in self.groups.items()
@@ -234,7 +287,14 @@ class Dispatcher:
         if "query_max_memory" in session:
             from ..utils.config import parse_size
             mem = parse_size(session["query_max_memory"])
-        group.acquire(queue_timeout, mem=mem)
+        # ONE admission deadline covers the cluster gate AND the local
+        # queue wait (the caller's bound, not 2x it)
+        deadline = None if queue_timeout is None \
+            else time.time() + queue_timeout
+        self._await_cluster_slot(group_name, group, deadline)
+        remaining = None if deadline is None \
+            else max(deadline - time.time(), 0.001)
+        group.acquire(remaining, mem=mem)
         t0 = time.time()
         try:
             result = executor(query_id)

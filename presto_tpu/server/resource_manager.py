@@ -1,0 +1,189 @@
+"""Resource manager: shared cluster state for multiple coordinators.
+
+Reference surface: presto-main-base/.../resourcemanager/ --
+ResourceManagerClusterStateProvider aggregates per-coordinator
+heartbeats (running/queued queries, resource-group state, memory) so N
+coordinators can enforce CLUSTER-WIDE resource-group limits instead of
+N independent local ones; coordinators send state via
+ClusterStatusSender and consult the aggregated view at admission.
+(The reference adds Raft for RM redundancy; a single RM process with
+heartbeat TTLs is this slice -- redundancy is deployment, not
+architecture.)
+
+Pieces:
+  * ResourceManager        -- the HTTP service (heartbeats in,
+                              aggregated cluster view out)
+  * ClusterStateSender     -- coordinator-side periodic POST of its
+                              dispatcher's group stats
+  * remote_group_load      -- admission-side helper: running count for
+                              a group across OTHER coordinators
+  * Dispatcher integration -- `cluster_limits` + a resource-manager
+                              url gate queries on the CLUSTER-wide
+                              running count before local admission
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+__all__ = ["ResourceManager", "ClusterStateSender", "remote_group_load"]
+
+
+class _State:
+    def __init__(self, heartbeat_ttl_s: float):
+        self.lock = threading.Lock()
+        self.ttl = heartbeat_ttl_s
+        # coordinator_id -> {"at": ts, "groups": {name: stats}}
+        self.coordinators: Dict[str, dict] = {}
+
+    def heartbeat(self, cid: str, doc: dict) -> None:
+        with self.lock:
+            self.coordinators[cid] = {"at": time.time(),
+                                      "groups": doc.get("groups", {}),
+                                      "queries": doc.get("queries", {})}
+
+    def view(self) -> dict:
+        now = time.time()
+        with self.lock:
+            live = {cid: st for cid, st in self.coordinators.items()
+                    if now - st["at"] <= self.ttl}
+            totals: Dict[str, dict] = {}
+            for st in live.values():
+                for g, gs in st["groups"].items():
+                    agg = totals.setdefault(
+                        g, {"running": 0, "queued": 0,
+                            "memoryUsedBytes": 0})
+                    agg["running"] += int(gs.get("running", 0))
+                    agg["queued"] += int(gs.get("queued", 0))
+                    agg["memoryUsedBytes"] += int(
+                        gs.get("memoryUsedBytes", 0))
+            return {"coordinators": {
+                        cid: {"ageSeconds": round(now - st["at"], 3),
+                              "groups": st["groups"],
+                              "queries": st.get("queries", {})}
+                        for cid, st in live.items()},
+                    "groupTotals": totals}
+
+
+def _make_handler(state: _State):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            pass
+
+        def _send(self, obj, code=200):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_PUT(self):  # noqa: N802
+            parts = [p for p in self.path.split("/") if p]
+            if len(parts) == 3 and \
+                    parts[:2] == ["v1", "resourcemanager"]:
+                n = int(self.headers.get("Content-Length", "0") or 0)
+                doc = json.loads(self.rfile.read(n) or b"{}")
+                state.heartbeat(parts[2], doc)
+                return self._send({"ok": True})
+            return self._send({"error": "not found"}, 404)
+
+        do_POST = do_PUT  # noqa: N815 - either verb heartbeats
+
+        def do_GET(self):  # noqa: N802
+            if self.path.rstrip("/") == "/v1/resourcemanager":
+                return self._send(state.view())
+            return self._send({"error": "not found"}, 404)
+
+    return Handler
+
+
+class ResourceManager:
+    def __init__(self, port: int = 0, heartbeat_ttl_s: float = 10.0):
+        self._state = _State(heartbeat_ttl_s)
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port),
+                                          _make_handler(self._state))
+        self.port = self._httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ResourceManager":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class ClusterStateSender:
+    """Coordinator-side periodic heartbeat of dispatcher group stats
+    (ClusterStatusSender analog)."""
+
+    def __init__(self, rm_url: str, coordinator_id: str, dispatcher,
+                 interval_s: float = 0.5, timeout: float = 5.0):
+        self.rm_url = rm_url.rstrip("/")
+        self.coordinator_id = coordinator_id
+        self.dispatcher = dispatcher
+        self.interval = interval_s
+        self.timeout = timeout
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def send_once(self) -> None:
+        doc = {"groups": self.dispatcher.group_stats()}
+        req = urllib.request.Request(
+            f"{self.rm_url}/v1/resourcemanager/{self.coordinator_id}",
+            data=json.dumps(doc).encode(), method="PUT",
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=self.timeout).read()
+
+    def start(self) -> "ClusterStateSender":
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.send_once()
+                except Exception:  # noqa: BLE001 - RM outage: keep trying
+                    pass
+                self._stop.wait(self.interval)
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(self.timeout + 1)
+
+
+def remote_group_load(rm_url: str, group: str,
+                      exclude_coordinator: Optional[str] = None,
+                      timeout: float = 5.0) -> int:
+    """Cluster-wide RUNNING count for `group` across coordinators
+    (excluding the caller's own, which it accounts locally)."""
+    with urllib.request.urlopen(f"{rm_url.rstrip('/')}/v1/resourcemanager",
+                                timeout=timeout) as r:
+        view = json.loads(r.read())
+    total = 0
+    for cid, st in view["coordinators"].items():
+        if cid == exclude_coordinator:
+            continue
+        gs = st["groups"].get(group)
+        if gs:
+            total += int(gs.get("running", 0))
+    return total

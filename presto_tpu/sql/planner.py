@@ -333,25 +333,7 @@ class _Analyzer:
                 a = E.call("cast", pty, a)
             binding[pname] = a
 
-        def substitute(e, bnd):
-            if isinstance(e, E.LambdaVariable) and e.name in bnd:
-                return bnd[e.name]
-            if isinstance(e, E.Lambda):
-                # a lambda parameter shadowing a UDF parameter binds
-                # tighter: do not capture it
-                inner = {k: v for k, v in bnd.items()
-                         if k not in e.parameters}
-                nb = substitute(e.body, inner)
-                return e if nb is e.body else                     E.Lambda(e.type, e.parameters, nb)
-            if isinstance(e, E.Call):
-                na = tuple(substitute(x, bnd) for x in e.arguments)
-                return e if na == e.arguments else                     E.Call(e.type, e.name, na)
-            if isinstance(e, E.SpecialForm):
-                na = tuple(substitute(x, bnd) for x in e.arguments)
-                return e if na == e.arguments else                     E.SpecialForm(e.type, e.form, na)
-            return e
-
-        body = substitute(body, binding)
+        body = _substitute_capture_free(body, binding)
         if body.type != udf.return_type:
             body = E.call("cast", udf.return_type, body)
         return body
@@ -560,6 +542,80 @@ class _Analyzer:
 # UDF names whose expansion is in progress (recursion detection)
 _UDF_EXPANDING: contextvars.ContextVar = contextvars.ContextVar(
     "udf_expanding", default=frozenset())
+
+_FRESH = [0]
+
+
+def _free_lambda_vars(e) -> set:
+    """Names of LambdaVariables FREE in `e` (not bound by a Lambda
+    inside `e`)."""
+    if isinstance(e, E.LambdaVariable):
+        return {e.name}
+    if isinstance(e, E.Lambda):
+        return _free_lambda_vars(e.body) - set(e.parameters)
+    out = set()
+    for c in e.children():
+        out |= _free_lambda_vars(c)
+    return out
+
+
+def _rename_lambda_vars(e, mapping: dict):
+    """Alpha-rename: LambdaVariable occurrences of `mapping` keys take
+    the new names; inner lambdas rebinding a key shadow it."""
+    if isinstance(e, E.LambdaVariable):
+        if e.name in mapping:
+            return E.LambdaVariable(e.type, mapping[e.name])
+        return e
+    if isinstance(e, E.Lambda):
+        inner = {k: v for k, v in mapping.items()
+                 if k not in e.parameters}
+        nb = _rename_lambda_vars(e.body, inner) if inner else e.body
+        return e if nb is e.body else E.Lambda(e.type, e.parameters, nb)
+    if isinstance(e, E.Call):
+        na = tuple(_rename_lambda_vars(x, mapping) for x in e.arguments)
+        return e if na == e.arguments else E.Call(e.type, e.name, na)
+    if isinstance(e, E.SpecialForm):
+        na = tuple(_rename_lambda_vars(x, mapping) for x in e.arguments)
+        return e if na == e.arguments else \
+            E.SpecialForm(e.type, e.form, na)
+    return e
+
+
+def _substitute_capture_free(e, bnd: dict):
+    """Capture-avoiding substitution of LambdaVariables: (a) lambda
+    parameters shadowing a binding key bind tighter (the key is not
+    substituted inside), and (b) lambda parameters colliding with a
+    FREE variable of a substituted value are alpha-renamed first, so a
+    caller's lambda variable is never captured by a UDF body lambda."""
+    if isinstance(e, E.LambdaVariable):
+        return bnd.get(e.name, e)
+    if isinstance(e, E.Lambda):
+        inner = {k: v for k, v in bnd.items() if k not in e.parameters}
+        if not inner:
+            return e
+        free = set()
+        for v in inner.values():
+            free |= _free_lambda_vars(v)
+        ren = {}
+        params = list(e.parameters)
+        for i, pname in enumerate(params):
+            if pname in free:
+                _FRESH[0] += 1
+                ren[pname] = f"{pname}__a{_FRESH[0]}"
+                params[i] = ren[pname]
+        body = _rename_lambda_vars(e.body, ren) if ren else e.body
+        nb = _substitute_capture_free(body, inner)
+        if nb is e.body and not ren:
+            return e
+        return E.Lambda(e.type, tuple(params), nb)
+    if isinstance(e, E.Call):
+        na = tuple(_substitute_capture_free(x, bnd) for x in e.arguments)
+        return e if na == e.arguments else E.Call(e.type, e.name, na)
+    if isinstance(e, E.SpecialForm):
+        na = tuple(_substitute_capture_free(x, bnd) for x in e.arguments)
+        return e if na == e.arguments else \
+            E.SpecialForm(e.type, e.form, na)
+    return e
 
 
 def _dt_plus_interval_type(dt: T.Type, iv: T.Type) -> T.Type:

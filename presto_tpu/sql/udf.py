@@ -51,16 +51,21 @@ class FunctionNamespaceManager:
 
     def register(self, fn: SqlFunction, replace: bool = False) -> None:
         with self._lock:
-            if not replace and fn.qualified_name in self._fns:
+            old = self._fns.get(fn.qualified_name)
+            if old is not None and not replace:
                 raise KeyError(
                     f"function {fn.qualified_name!r} already exists")
+            if old is not None:
+                _evict_ast(old)
             self._fns[fn.qualified_name] = fn
 
     def drop(self, qualified_name: str, if_exists: bool = False) -> None:
         with self._lock:
-            if self._fns.pop(self._resolve_key(qualified_name),
-                             None) is None and not if_exists:
+            old = self._fns.pop(self._resolve_key(qualified_name), None)
+            if old is None and not if_exists:
                 raise KeyError(f"no function {qualified_name!r}")
+            if old is not None:
+                _evict_ast(old)
 
     def _resolve_key(self, name: str) -> str:
         if "." not in name:
@@ -83,6 +88,10 @@ _manager = FunctionNamespaceManager()
 # surfaces syntax errors at CREATE FUNCTION time, and on first lookup
 # after an engine restart)
 _AST_CACHE: Dict[str, object] = {}
+
+
+def _evict_ast(fn: SqlFunction) -> None:
+    _AST_CACHE.pop(f"{fn.qualified_name}\x00{fn.body_sql}", None)
 
 
 def body_ast(fn: SqlFunction):

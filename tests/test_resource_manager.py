@@ -1,0 +1,130 @@
+"""Multi-coordinator resource manager: shared cluster state.
+
+Reference behavior: presto-main-base/.../resourcemanager/ --
+coordinators heartbeat their resource-group state to the RM
+(ClusterStatusSender), and admission enforces CLUSTER-wide group
+limits from the aggregated view instead of per-coordinator ones."""
+
+import threading
+import time
+
+import pytest
+
+from presto_tpu.server.dispatcher import (Dispatcher, QueryRejected,
+                                          ResourceGroup)
+from presto_tpu.server.resource_manager import (ClusterStateSender,
+                                                ResourceManager,
+                                                remote_group_load)
+
+
+def test_heartbeats_aggregate_and_expire():
+    with ResourceManager(heartbeat_ttl_s=0.3) as rm:
+        d1 = Dispatcher([ResourceGroup("g", hard_concurrency_limit=4)],
+                        selector=lambda s: "g")
+        s1 = ClusterStateSender(rm.url, "c1", d1)
+        s1.send_once()
+        view_load = remote_group_load(rm.url, "g",
+                                      exclude_coordinator="other")
+        assert view_load == 0  # nothing running yet
+        # a running query shows up in the aggregated view
+        release = threading.Event()
+
+        def hold(qid):
+            s1.send_once()
+            release.wait(5)
+            return "ok"
+
+        t = threading.Thread(target=lambda: d1.submit(hold))
+        t.start()
+        time.sleep(0.15)
+        s1.send_once()
+        assert remote_group_load(rm.url, "g",
+                                 exclude_coordinator="other") == 1
+        release.set()
+        t.join(5)
+        # heartbeats expire after the TTL: a dead coordinator's load
+        # stops counting against the cluster
+        time.sleep(0.4)
+        assert remote_group_load(rm.url, "g",
+                                 exclude_coordinator="other") == 0
+
+
+def test_cluster_limit_enforced_across_coordinators():
+    with ResourceManager() as rm:
+        d1 = Dispatcher([ResourceGroup("g", hard_concurrency_limit=4)],
+                        selector=lambda s: "g",
+                        resource_manager_url=rm.url, coordinator_id="c1",
+                        cluster_limits={"g": 1})
+        d2 = Dispatcher([ResourceGroup("g", hard_concurrency_limit=4)],
+                        selector=lambda s: "g",
+                        resource_manager_url=rm.url, coordinator_id="c2",
+                        cluster_limits={"g": 1})
+        s1 = ClusterStateSender(rm.url, "c1", d1, interval_s=0.05).start()
+        s2 = ClusterStateSender(rm.url, "c2", d2, interval_s=0.05).start()
+        try:
+            release = threading.Event()
+            started = threading.Event()
+
+            def hold(qid):
+                started.set()
+                release.wait(10)
+                return "held"
+
+            t = threading.Thread(target=lambda: d1.submit(hold))
+            t.start()
+            started.wait(5)
+            time.sleep(0.2)  # let c1's heartbeat carry running=1
+            # coordinator 2 has LOCAL capacity but the CLUSTER slot is
+            # held by c1: admission times out with a named rejection
+            with pytest.raises(QueryRejected, match="cluster limit"):
+                d2.submit(lambda qid: "nope", queue_timeout=0.3)
+            release.set()
+            t.join(5)
+            time.sleep(0.2)  # c1's heartbeat drops to running=0
+            assert d2.submit(lambda qid: "now", queue_timeout=5.0) == "now"
+        finally:
+            s1.stop()
+            s2.stop()
+
+
+def test_rm_outage_fails_open_to_local_admission():
+    d = Dispatcher([ResourceGroup("g")], selector=lambda s: "g",
+                   resource_manager_url="http://127.0.0.1:1",  # nothing there
+                   coordinator_id="c1", cluster_limits={"g": 1})
+    assert d.submit(lambda qid: "ok", queue_timeout=2.0) == "ok"
+
+
+def test_cluster_limit_on_ancestor_path_enforced():
+    with ResourceManager() as rm:
+        def tree():
+            root = ResourceGroup("etl", hard_concurrency_limit=4)
+            root.add_child(ResourceGroup("nightly",
+                                         hard_concurrency_limit=4))
+            return root
+        d1 = Dispatcher([tree()], selector=lambda s: "etl.nightly",
+                        resource_manager_url=rm.url, coordinator_id="c1",
+                        cluster_limits={"etl": 1})
+        d2 = Dispatcher([tree()], selector=lambda s: "etl.nightly",
+                        resource_manager_url=rm.url, coordinator_id="c2",
+                        cluster_limits={"etl": 1})
+        s1 = ClusterStateSender(rm.url, "c1", d1, interval_s=0.05).start()
+        try:
+            release = threading.Event()
+            started = threading.Event()
+
+            def hold(qid):
+                started.set()
+                release.wait(10)
+                return "held"
+
+            t = threading.Thread(target=lambda: d1.submit(hold))
+            t.start()
+            started.wait(5)
+            time.sleep(0.2)
+            # the ANCESTOR limit (etl) gates the leaf path on c2
+            with pytest.raises(QueryRejected, match="cluster limit"):
+                d2.submit(lambda qid: "no", queue_timeout=0.3)
+            release.set()
+            t.join(5)
+        finally:
+            s1.stop()

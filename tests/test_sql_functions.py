@@ -111,3 +111,13 @@ def test_whitespace_and_syntax_errors_surface_at_create():
     with pytest.raises(Exception):
         sql("CREATE FUNCTION badfn(x bigint) RETURNS bigint "
             "RETURN x +", sf=0.01)
+
+
+def test_caller_lambda_variable_not_captured_by_body_lambda():
+    # the UDF body's own `e ->` lambda must NOT capture a caller's
+    # free lambda variable also named e (alpha-renaming)
+    sql("CREATE FUNCTION addy(x bigint) RETURNS array(bigint) "
+        "RETURN transform(ARRAY[1, 2], e -> e + x)", sf=0.01)
+    got = sql("SELECT transform(ARRAY[100, 200], e -> addy(e)[1])",
+              sf=0.01).rows()
+    assert got == [([101, 201],)]
