@@ -229,20 +229,34 @@ def _limb_matmul_sum(ids, v, max_groups: int, nlimbs: int = 5,
     limbs (top limb signed), one-hot(ids) @ limbs in f32 over
     `chunk`-row blocks -- every block-level f32 sum is < 2^24 so f32
     accumulation is exact -- then combine block partials in int64.
-    `nlimbs=1` covers 0/1 count flags."""
-    n = v.shape[0]
-    c = -(-n // chunk)
-    pad = c * chunk - n
-    i = jnp.pad(ids, (0, pad), constant_values=max_groups)
-    x = jnp.pad(v.astype(jnp.int64), (0, pad))
+    `nlimbs=1` covers 0/1 count flags. On TPU the one-hot + matmul runs
+    as a FUSED Pallas kernel (the one-hot never stages through HBM;
+    pallas_kernels.limb_partial_sums, same numerics); override
+    PRESTO_TPU_SMALLG_PALLAS=0 for the XLA einsum form."""
     from ..int128 import limbs13_of_i64
-    limbs = [l.astype(jnp.float32) for l in limbs13_of_i64(x, nlimbs)]
-    lm = jnp.stack(limbs, axis=1).reshape(c, chunk, nlimbs)
-    oh = (i.reshape(c, chunk)[:, :, None]
-          == jnp.arange(max_groups, dtype=jnp.int32)).astype(jnp.float32)
-    part = jnp.einsum("ckg,ckl->cgl", oh, lm,
-                      precision=jax.lax.Precision.HIGHEST,
-                      preferred_element_type=jnp.float32)
+    n = v.shape[0]
+    x = v.astype(jnp.int64)
+    if _os.environ.get("PRESTO_TPU_SMALLG_PALLAS", "1") != "0" \
+            and jax.default_backend() == "tpu":
+        from .pallas_kernels import limb_partial_sums
+        lm = jnp.stack([l.astype(jnp.float32)
+                        for l in limbs13_of_i64(x, nlimbs)], axis=1)
+        part = limb_partial_sums(ids.astype(jnp.int32), lm,
+                                 max_groups)  # (tiles, G, L)
+    else:
+        c = -(-n // chunk)
+        pad = c * chunk - n
+        i = jnp.pad(ids, (0, pad), constant_values=max_groups)
+        xp = jnp.pad(x, (0, pad))
+        limbs = [l.astype(jnp.float32) for l in limbs13_of_i64(xp, nlimbs)]
+        lm = jnp.stack(limbs, axis=1).reshape(c, chunk, nlimbs)
+        oh = (i.reshape(c, chunk)[:, :, None]
+              == jnp.arange(max_groups, dtype=jnp.int32)).astype(jnp.float32)
+        part = jnp.einsum("ckg,ckl->cgl", oh, lm,
+                          precision=jax.lax.Precision.HIGHEST,
+                          preferred_element_type=jnp.float32)
+    # ONE numerics-critical combine for both forms: per-chunk f32
+    # partials (each < 2^24, exact) recombine in int64
     tot = jnp.sum(part.astype(jnp.int64), axis=0)  # (G, L)
     scale = jnp.int64(1) << (13 * jnp.arange(nlimbs, dtype=jnp.int64))
     return jnp.sum(tot * scale[None, :], axis=1)

@@ -101,3 +101,53 @@ def contains_bytes(chars: jax.Array, lengths: jax.Array, needle: bytes,
         interpret=interpret,
     )(chars, lengths.astype(jnp.int32))
     return out[:n]
+
+
+# ---------------------------------------------------------------------------
+# Fused limb-sum group-by partials (the small-table aggregation hot op)
+# ---------------------------------------------------------------------------
+
+_SUM_TILE = 1024
+
+
+def _limb_sum_kernel(ids_ref, limbs_ref, out_ref, *, groups: int):
+    """One row tile: build the one-hot(ids) in VMEM and ride the MXU
+    for (G, L) partial sums -- the fused form of the XLA path's
+    one_hot-materialize + einsum (which stages an (n, G) f32 one-hot
+    through HBM). Each tile's f32 sums stay < 2^24 (exact); tiles
+    combine in int64 OUTSIDE the kernel, identical numerics to
+    aggregation._limb_matmul_sum."""
+    ids = ids_ref[:]
+    gidx = jax.lax.broadcasted_iota(jnp.int32, (ids.shape[0], groups), 1)
+    onehot = (ids[:, None] == gidx).astype(jnp.float32)
+    # precision=HIGHEST: default-precision f32 dot lowers to bf16
+    # passes on TPU, which cannot hold 13-bit limbs exactly
+    out_ref[0] = jnp.dot(onehot.T, limbs_ref[:],
+                         precision=jax.lax.Precision.HIGHEST,
+                         preferred_element_type=jnp.float32)
+
+
+def limb_partial_sums(ids: jax.Array, limbs: jax.Array, groups: int,
+                      interpret: bool | None = None) -> jax.Array:
+    """(tiles, G, L) f32 per-tile partial sums of `limbs` grouped by
+    `ids` (int32; out-of-range ids contribute nothing). Rows pad to the
+    tile size with ids == groups (dropped by the one-hot compare)."""
+    if interpret is None:
+        interpret = not pallas_supported()
+    n, L = limbs.shape
+    pad = (-n) % _SUM_TILE
+    if pad:
+        ids = jnp.pad(ids, (0, pad), constant_values=groups)
+        limbs = jnp.pad(limbs, ((0, pad), (0, 0)))
+    total = ids.shape[0]
+    tiles = total // _SUM_TILE
+    kernel = functools.partial(_limb_sum_kernel, groups=groups)
+    return pl.pallas_call(
+        kernel,
+        grid=(tiles,),
+        in_specs=[pl.BlockSpec((_SUM_TILE,), lambda i: (i,)),
+                  pl.BlockSpec((_SUM_TILE, L), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((1, groups, L), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((tiles, groups, L), jnp.float32),
+        interpret=interpret,
+    )(ids.astype(jnp.int32), limbs.astype(jnp.float32))

@@ -190,3 +190,55 @@ def test_statement_server_enforces_user_acl():
             execute(srv.url, "SELECT count(*) FROM lineitem", user="alice")
     finally:
         set_access_control(None)
+
+
+def test_group_admission_stress_no_lost_wakeups():
+    """Hammer a small hierarchy from many threads with mixed timeouts
+    and memory budgets: no deadlock, no lost wakeup (every thread
+    terminates), limits never exceeded, and all counters return to
+    zero (the round-3 lost-wakeup fix under real contention)."""
+    root = ResourceGroup("root", hard_concurrency_limit=3, max_queued=64,
+                         soft_memory_limit_bytes=1000)
+    a = root.add_child(ResourceGroup("a", hard_concurrency_limit=2,
+                                     max_queued=64, scheduling_weight=2))
+    b = root.add_child(ResourceGroup("b", hard_concurrency_limit=2,
+                                     max_queued=64))
+    peak = {"root": 0}
+    peak_lock = threading.Lock()
+    errors = []
+    done = []
+
+    def worker(i):
+        g = a if i % 2 else b
+        mem = (i % 3) * 100
+        try:
+            g.acquire(timeout=10.0, mem=mem)
+        except QueryRejected:
+            done.append(i)
+            return
+        try:
+            with peak_lock:
+                r = root.stats()["running"]
+                peak["root"] = max(peak["root"], r)
+                if r > 3:
+                    errors.append(f"root over limit: {r}")
+                if root.stats()["memoryUsedBytes"] > 1000:
+                    errors.append("memory over limit")
+            time.sleep(0.002)
+        finally:
+            g.release(mem=mem)
+            done.append(i)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(60)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+    assert not errors, errors[:3]
+    assert len(done) == 60, f"lost wakeup: only {len(done)}/60 finished"
+    assert peak["root"] >= 2  # contention actually happened
+    for g in (root, a, b):
+        st = g.stats()
+        assert st["running"] == 0 and st["queued"] == 0
+        assert st["memoryUsedBytes"] == 0
