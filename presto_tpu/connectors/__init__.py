@@ -8,9 +8,10 @@ column_type.
 """
 
 def _load():
-    from . import information_schema, memory, system, tpch, tpcds
+    from . import information_schema, localfile, memory, system, tpch, tpcds
     cats = {"tpch": tpch, "tpcds": tpcds, "memory": memory,
-            "system": system, "information_schema": information_schema}
+            "system": system, "information_schema": information_schema,
+            "localfile": localfile}
     try:
         import pyarrow  # noqa: F401  (parquet.py imports it lazily)
         from . import orc, parquet

@@ -138,6 +138,19 @@ DEFAULT_CORPUS = [
     # MERGE exchange: root-observable global order, no gather
     "SELECT orderkey, totalprice FROM orders "
     "WHERE totalprice > 400000.00 ORDER BY totalprice DESC, orderkey",
+    # round-5 surface: RANGE value frames over the mesh repartition
+    "SELECT orderkey, quantity, sum(quantity) OVER (PARTITION BY orderkey "
+    "ORDER BY quantity RANGE BETWEEN 5 PRECEDING AND CURRENT ROW) "
+    "FROM lineitem WHERE orderkey <= 20",
+    # round-5 surface: array lambdas capture grouped columns (pure-JAX
+    # lanes: safe under shard_map; host-callback fns stay off the mesh)
+    "SELECT regionkey, sum(reduce(sequence(1, 4), 0, (s, x) -> s + x * "
+    "regionkey, s -> s)) FROM nation GROUP BY regionkey",
+    # round-5 surface: interval arithmetic + date filters (a 180-day
+    # window lands INSIDE the data range -- ~360 rows at sf 0.01 -- so
+    # wrong interval math is observable, not a trivially-empty result)
+    "SELECT count(*) FROM orders WHERE orderdate >= "
+    "date '1998-12-01' - interval '180' day",
     # RIGHT/FULL OUTER: unmatched-build emission under partitioned
     # distribution
     "SELECT r.name, count(n.nationkey) FROM nation n "
