@@ -63,8 +63,11 @@ def _decode_cell(raw, ty: T.Type):
                     - datetime.date(1970, 1, 1)).days
         if ty.base == "timestamp":
             d = datetime.datetime.fromisoformat(str(raw))
-            return int(d.replace(tzinfo=datetime.timezone.utc)
-                       .timestamp() * 1_000_000)
+            if d.tzinfo is None:
+                # a bare wall clock is a UTC instant (session zone)
+                d = d.replace(tzinfo=datetime.timezone.utc)
+            # explicit offsets CONVERT the instant (not reinterpret)
+            return int(d.timestamp() * 1_000_000)
     except (ValueError, ArithmeticError):
         return None
     return None
@@ -112,15 +115,22 @@ def register_table(name: str, path: str, fmt: Optional[str] = None,
                     if r.get(c) not in (None, "")]
             ty = T.varchar(max((len(str(v)) for v in vals), default=1))
             if vals:
-                try:
-                    [int(v) for v in vals]
-                    ty = T.BIGINT
-                except (ValueError, TypeError):
+                if all(isinstance(v, bool) for v in vals):
+                    ty = T.BOOLEAN
+                elif any(isinstance(v, float) for v in vals):
+                    # native JSON floats (int(1.5) would silently
+                    # truncate -- isinstance, not the int() probe)
+                    ty = T.DOUBLE
+                elif not any(isinstance(v, bool) for v in vals):
                     try:
-                        [float(v) for v in vals]
-                        ty = T.DOUBLE
+                        [int(v) for v in vals]
+                        ty = T.BIGINT
                     except (ValueError, TypeError):
-                        pass
+                        try:
+                            [float(v) for v in vals]
+                            ty = T.DOUBLE
+                        except (ValueError, TypeError):
+                            pass
             schema[c] = ty
     decoded = {c: [_decode_cell(r.get(c), ty) for r in rows]
                for c, ty in schema.items()}

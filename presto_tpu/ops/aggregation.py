@@ -79,7 +79,13 @@ _AGGS = ("sum", "count", "count_star", "min", "max", "avg",
          "var_samp", "var_pop", "stddev_samp", "stddev_pop", "stddev",
          "variance", "bool_and", "bool_or", "every", "min_by", "max_by",
          "count_distinct", "approx_distinct", "arbitrary", "any_value",
-         "approx_percentile")
+         "approx_percentile", "corr", "covar_samp", "covar_pop",
+         "regr_slope", "regr_intercept", "geometric_mean", "checksum")
+
+# two-input statistics over (y, x) pairs: six shared f64 moments
+# (operator/aggregation/Central/CovarianceAggregation analog)
+_PAIR_MOMENT_AGGS = ("corr", "covar_samp", "covar_pop", "regr_slope",
+                     "regr_intercept")
 
 # canonical name -> implementation family
 _ALIAS = {"stddev": "stddev_samp", "variance": "var_samp",
@@ -488,6 +494,8 @@ def _sorted_capable(batch: Batch, key_channels, aggs) -> bool:
         c = s.canonical
         if c in ("min_by", "max_by"):
             return False
+        if c in _PAIR_MOMENT_AGGS or c in ("geometric_mean", "checksum"):
+            return False  # hash path carries these (6-moment states)
         if s.input_channel is None:
             continue
         col = batch.column(s.input_channel)
@@ -811,6 +819,20 @@ def _acc_columns(spec: AggSpec, col: Optional[Block], ids, active, max_groups: i
         regs = _hll_registers_merge(col, live, ids, g)
         return [("hll", _hll_state_column(regs))]
 
+    if name == "checksum":
+        # order-independent 64-bit checksum: wrapping int64 sum of
+        # per-row value hashes (hash64_block handles string/int128/
+        # fixed-width blocks alike); NULL rows contribute a constant
+        from ..expr.functions import hash64_block
+        h = hash64_block(col).astype(jnp.int64)
+        # the golden-ratio constant as SIGNED int64 (wrapping sum domain)
+        h = jnp.where(col.nulls & active,
+                      jnp.int64(-7046029254386353131),
+                      jnp.where(live, h, 0))
+        cnt_all = _seg_count(ids, active, g)
+        return [("checksum", Column(_seg_add(ids, h, g), cnt_all == 0,
+                                    T.BIGINT))]
+
     if isinstance(col, StringColumn):
         if name in ("min", "max"):
             return _minmax_string(col, ids, live, g, spec)
@@ -881,6 +903,51 @@ def _acc_columns(spec: AggSpec, col: Optional[Block], ids, active, max_groups: i
         return [("count", Column(nn, jnp.zeros(g, dtype=bool), T.BIGINT)),
                 ("sum", Column(s, no_input, T.DOUBLE)),
                 ("sumsq", Column(s2, no_input, T.DOUBLE))]
+    if name in _PAIR_MOMENT_AGGS:
+        # six moments over rows where BOTH inputs are non-null
+        assert batch is not None and spec.second_channel is not None
+        ycol = col
+        xcol = batch.column(spec.second_channel)
+        if isinstance(xcol, DictionaryColumn):
+            xcol = xcol.decode()
+        pair_live = active & ~ycol.nulls & ~xcol.nulls
+
+        def _f64(c):
+            f = c.values.astype(jnp.float64)
+            if c.type.is_decimal:
+                from ..expr.functions import _POW10
+                f = f / _POW10[c.type.scale]
+            return f
+
+        y = _f64(ycol)
+        x = _f64(xcol)
+        npair = _seg_count(ids, pair_live, g)
+        z = jnp.float64(0.0)
+        states = [
+            ("count", Column(npair, jnp.zeros(g, dtype=bool), T.BIGINT)),
+            ("sy", Column(_seg_add(ids, jnp.where(pair_live, y, z), g),
+                          npair == 0, T.DOUBLE)),
+            ("sx", Column(_seg_add(ids, jnp.where(pair_live, x, z), g),
+                          npair == 0, T.DOUBLE)),
+            ("syy", Column(_seg_add(ids, jnp.where(pair_live, y * y, z), g),
+                           npair == 0, T.DOUBLE)),
+            ("sxx", Column(_seg_add(ids, jnp.where(pair_live, x * x, z), g),
+                           npair == 0, T.DOUBLE)),
+            ("sxy", Column(_seg_add(ids, jnp.where(pair_live, y * x, z), g),
+                           npair == 0, T.DOUBLE)),
+        ]
+        return states
+    if name == "geometric_mean":
+        # (count, sum of ln x); nonpositive inputs poison the group to
+        # NaN exactly like ln() would (reference behavior)
+        f = v.astype(jnp.float64)
+        if col.type.is_decimal:
+            from ..expr.functions import _POW10
+            f = f / _POW10[col.type.scale]
+        logs = jnp.log(jnp.where(live, f, 1.0))
+        return [("count", Column(nn, jnp.zeros(g, dtype=bool), T.BIGINT)),
+                ("slog", Column(_seg_add(ids, jnp.where(live, logs, 0.0), g),
+                                no_input, T.DOUBLE))]
     if name == "arbitrary":
         row_best = _argbest([jnp.zeros(len(col), dtype=jnp.uint64)], ids,
                             live, g, minimize=True)
@@ -1057,6 +1124,10 @@ def state_width(spec: AggSpec) -> int:
         return 3
     if c in ("min_by", "max_by"):
         return 2
+    if c in _PAIR_MOMENT_AGGS:
+        return 6
+    if c == "geometric_mean":
+        return 2
     return 1
 
 
@@ -1087,6 +1158,15 @@ def merge_spec(spec: AggSpec, state_channel: int) -> List[AggSpec]:
         return [AggSpec("sum", state_channel, T.BIGINT),
                 AggSpec("sum", state_channel + 1, T.DOUBLE),
                 AggSpec("sum", state_channel + 2, T.DOUBLE)]
+    if c in _PAIR_MOMENT_AGGS:
+        return [AggSpec("sum", state_channel, T.BIGINT)] + \
+            [AggSpec("sum", state_channel + i, T.DOUBLE)
+             for i in range(1, 6)]
+    if c == "geometric_mean":
+        return [AggSpec("sum", state_channel, T.BIGINT),
+                AggSpec("sum", state_channel + 1, T.DOUBLE)]
+    if c == "checksum":
+        return [AggSpec("sum", state_channel, T.BIGINT)]
     if c in ("min_by", "max_by"):
         # min_by over the (value, order) state re-emits BOTH columns
         # (value + winning order), keeping state_width stable at 2
@@ -1106,6 +1186,34 @@ def merge_spec(spec: AggSpec, state_channel: int) -> List[AggSpec]:
             "then aggregate in one step (the standard mark_distinct plan "
             "shape; sketch states arrive with the KLL/HLL library)")
     raise NotImplementedError(spec.name)
+
+
+def finalize_pair_moments(c: str, n, sy, sx, syy, sxx, sxy):
+    """(n, sy, sx, syy, sxx, sxy) -> (value, nulls) for the two-input
+    statistics family. Population co-moments: cxy = sxy - sx*sy/n."""
+    nf = n.astype(jnp.float64)
+    safe_n = jnp.maximum(nf, 1.0)
+    cxy = sxy - sx * sy / safe_n
+    cxx = jnp.maximum(sxx - sx * sx / safe_n, 0.0)
+    cyy = jnp.maximum(syy - sy * sy / safe_n, 0.0)
+    if c == "covar_pop":
+        v = cxy / safe_n
+        nulls = n < 1
+    elif c == "covar_samp":
+        v = cxy / jnp.maximum(nf - 1.0, 1.0)
+        nulls = n < 2
+    elif c == "corr":
+        denom = jnp.sqrt(cxx * cyy)
+        v = jnp.where(denom > 0, cxy / jnp.maximum(denom, 1e-300), 0.0)
+        nulls = (n < 2) | (denom <= 0)
+    elif c == "regr_slope":
+        v = jnp.where(cxx > 0, cxy / jnp.maximum(cxx, 1e-300), 0.0)
+        nulls = (n < 2) | (cxx <= 0)
+    else:  # regr_intercept
+        slope = jnp.where(cxx > 0, cxy / jnp.maximum(cxx, 1e-300), 0.0)
+        v = (sy - slope * sx) / safe_n
+        nulls = (n < 2) | (cxx <= 0)
+    return v, nulls
 
 
 def finalize_variance(spec: AggSpec, count: jnp.ndarray, s: jnp.ndarray,
@@ -1151,6 +1259,17 @@ def finalize_states(table: Batch, num_keys: int, aggs: Sequence[AggSpec]
             cnt, s, s2 = states
             v, nulls = finalize_variance(spec, cnt.values, s.values, s2.values)
             cols.append(Column(v, nulls, T.DOUBLE))
+        elif c in _PAIR_MOMENT_AGGS:
+            cnt, sy, sx, syy, sxx, sxy = states
+            v, nulls = finalize_pair_moments(
+                c, cnt.values, sy.values, sx.values, syy.values,
+                sxx.values, sxy.values)
+            cols.append(Column(v, nulls, T.DOUBLE))
+        elif c == "geometric_mean":
+            cnt, slog = states
+            n = jnp.maximum(cnt.values.astype(jnp.float64), 1.0)
+            cols.append(Column(jnp.exp(slog.values / n),
+                               cnt.values == 0, T.DOUBLE))
         elif c == "approx_distinct":
             est = hll_estimate(states[0].elements)
             cols.append(Column(est, jnp.zeros(len(est), dtype=bool),

@@ -146,7 +146,8 @@ class AggregationNode(PlanNode):
             # ships raw state columns over exchanges
             out.extend(a.output_type for a in self.aggregates)
             return out
-        from ..ops.aggregation import _sum_type, hll_state_type
+        from ..ops.aggregation import (_PAIR_MOMENT_AGGS, _sum_type,
+                                       hll_state_type)
         for a in self.aggregates:
             c = a.canonical
             if c == "approx_distinct":
@@ -156,6 +157,11 @@ class AggregationNode(PlanNode):
             elif c in ("var_samp", "var_pop", "stddev_samp", "stddev_pop"):
                 # raw (count, sum, sumsq) moments
                 out.extend([T.BIGINT, T.DOUBLE, T.DOUBLE])
+            elif c in _PAIR_MOMENT_AGGS:
+                # (n, sy, sx, syy, sxx, sxy) moments
+                out.extend([T.BIGINT] + [T.DOUBLE] * 5)
+            elif c == "geometric_mean":
+                out.extend([T.BIGINT, T.DOUBLE])
             elif c in ("min_by", "max_by"):
                 out.extend([a.output_type, a.second_type or T.BIGINT])
             else:

@@ -31,7 +31,13 @@ __all__ = ["plan_sql", "sql"]
 _AGG_NAMES = {"sum", "count", "min", "max", "avg", "approx_distinct",
               "bool_and", "bool_or", "arbitrary", "every", "any_value",
               "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp",
-              "var_pop"}
+              "var_pop", "corr", "covar_samp", "covar_pop", "regr_slope",
+              "regr_intercept", "geometric_mean", "checksum", "min_by",
+              "max_by"}
+
+# aggregates taking a second input column (value, order) / (y, x)
+_TWO_ARG_AGGS = {"min_by", "max_by", "corr", "covar_samp", "covar_pop",
+                 "regr_slope", "regr_intercept"}
 
 
 @dataclasses.dataclass
@@ -670,8 +676,11 @@ def _agg_output_type(name: str, input_type: Optional[T.Type]) -> T.Type:
     if name in ("bool_and", "bool_or", "every"):
         return T.BOOLEAN
     if name in ("stddev", "stddev_samp", "stddev_pop", "variance", "var_samp",
-                "var_pop"):
+                "var_pop", "corr", "covar_samp", "covar_pop", "regr_slope",
+                "regr_intercept", "geometric_mean"):
         return T.DOUBLE
+    if name == "checksum":
+        return T.BIGINT
     if name == "sum":
         if input_type.is_decimal:
             return T.decimal(38, input_type.scale)
@@ -2489,7 +2498,18 @@ def _plan_aggregation(an, node, scope, q, all_aggs, max_groups,
             aname = name
             if name == "count" and f.distinct:
                 aname = "count_distinct"
-            spec = AggSpec(aname, in_ch, _agg_output_type(name, arg.type))
+            if name in _TWO_ARG_AGGS:
+                if len(f.args) != 2:
+                    raise ValueError(f"{name} takes two arguments")
+                arg2 = an.lower(f.args[1], scope)
+                ch2 = len(pre_exprs)
+                pre_exprs.append(arg2)
+                spec = AggSpec(aname, in_ch,
+                               _agg_output_type(name, arg.type),
+                               second_channel=ch2, second_type=arg2.type)
+            else:
+                spec = AggSpec(aname, in_ch,
+                               _agg_output_type(name, arg.type))
         specs.append(spec)
         agg_map[id(f)] = (state_ch, spec)
         seen_asts.append((f, state_ch, spec))

@@ -1,0 +1,106 @@
+"""Two-input statistics aggregates + geometric_mean + checksum.
+
+Reference behavior: operator/aggregation CovarianceAggregation /
+CorrelationAggregation / RegressionAggregation (shared six-moment
+states, mergeable across partials), GeometricMeanAggregations, and
+the order-independent ChecksumAggregationFunction."""
+
+import numpy as np
+import pytest
+
+from presto_tpu.connectors import tpch
+from presto_tpu.sql import sql
+
+
+def _oracle_cols():
+    c = tpch.generate_columns(
+        "lineitem", 0.01, ["returnflag", "quantity", "extendedprice"])
+    return c
+
+
+def test_corr_covar_regr_match_numpy():
+    rows = sql(
+        "SELECT returnflag, corr(quantity, extendedprice) c, "
+        "covar_pop(quantity, extendedprice) cp, "
+        "covar_samp(quantity, extendedprice) cs, "
+        "regr_slope(quantity, extendedprice) sl, "
+        "regr_intercept(quantity, extendedprice) ic "
+        "FROM lineitem GROUP BY returnflag ORDER BY returnflag",
+        sf=0.01).rows()
+    c = _oracle_cols()
+    for flag, corr, cp, cs, sl, ic in rows:
+        m = np.array([f == flag for f in c["returnflag"]])
+        q = c["quantity"][m] / 100.0
+        p = c["extendedprice"][m] / 100.0
+        assert corr == pytest.approx(np.corrcoef(q, p)[0, 1], rel=1e-9)
+        assert cp == pytest.approx(np.cov(q, p, bias=True)[0, 1], rel=1e-9)
+        assert cs == pytest.approx(np.cov(q, p, bias=False)[0, 1], rel=1e-9)
+        # regr_slope(y, x) regresses y on x
+        want_sl = np.cov(q, p, bias=True)[0, 1] / np.var(p)
+        assert sl == pytest.approx(want_sl, rel=1e-9)
+        assert ic == pytest.approx(np.mean(q) - want_sl * np.mean(p),
+                                   rel=1e-9)
+
+
+def test_geometric_mean_and_checksum():
+    rows = sql("SELECT returnflag, geometric_mean(quantity), "
+               "checksum(orderkey) FROM lineitem "
+               "GROUP BY returnflag ORDER BY returnflag", sf=0.01).rows()
+    c = tpch.generate_columns("lineitem", 0.01,
+                              ["returnflag", "quantity", "orderkey"])
+    sums = {}
+    for flag, gm, cks in rows:
+        m = np.array([f == flag for f in c["returnflag"]])
+        q = c["quantity"][m] / 100.0
+        assert gm == pytest.approx(np.exp(np.mean(np.log(q))), rel=1e-9)
+        assert cks is not None
+        sums[flag] = cks
+    # checksum is order-independent but value-sensitive: different
+    # groups' checksums differ
+    assert len(set(sums.values())) == len(sums)
+    # stable across runs (deterministic)
+    again = {r[0]: r[2] for r in sql(
+        "SELECT returnflag, geometric_mean(quantity), checksum(orderkey) "
+        "FROM lineitem GROUP BY returnflag ORDER BY returnflag",
+        sf=0.01).rows()}
+    assert again == sums
+
+
+def test_two_stage_merge_of_pair_moments():
+    """PARTIAL -> exchange -> FINAL across the mesh must agree with the
+    single-chip run (the six-moment states are plain mergeable sums).
+    f64 moments reduce in a different order per shard, so the match is
+    float-tolerance, not the verifier's bit-exact contract."""
+    from presto_tpu.parallel.mesh import make_mesh
+    q = ("SELECT returnflag, corr(quantity, extendedprice) c, "
+         "covar_pop(quantity, extendedprice) cp, "
+         "geometric_mean(quantity) g "
+         "FROM lineitem GROUP BY returnflag ORDER BY returnflag")
+    local = sql(q, sf=0.01).rows()
+    mesh = sql(q, sf=0.01, mesh=make_mesh()).rows()
+    assert len(local) == len(mesh) == 3
+    for lr, mr in zip(local, mesh):
+        assert lr[0] == mr[0]
+        for a, b in zip(lr[1:], mr[1:]):
+            assert a == pytest.approx(b, rel=1e-9)
+
+
+def test_min_by_max_by_sql_surface():
+    rows = sql("SELECT min_by(nationkey, regionkey), "
+               "max_by(nationkey, regionkey) FROM nation",
+               sf=0.01).rows()
+    # regionkey 0's lowest nation is 0; regionkey 4's nations end at 24
+    lo, hi = rows[0]
+    assert lo in range(0, 25) and hi in range(0, 25)
+    c = tpch.generate_columns("nation", 0.01, ["nationkey", "regionkey"])
+    rk = c["regionkey"]
+    assert rk[lo] == rk.min() and rk[hi] == rk.max()
+
+
+def test_checksum_over_strings_and_decimals():
+    rows = sql("SELECT checksum(name), checksum(acctbal) FROM customer",
+               sf=0.01).rows()
+    assert rows[0][0] is not None and rows[0][1] is not None
+    again = sql("SELECT checksum(name), checksum(acctbal) FROM customer",
+                sf=0.01).rows()
+    assert rows == again

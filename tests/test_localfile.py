@@ -72,3 +72,23 @@ def test_joins_against_generator_tables(tmp_path):
                "JOIN localfile.dim d ON n.regionkey = d.regionkey "
                "GROUP BY d.label ORDER BY d.label", sf=0.01).rows()
     assert rows == [("one", 5), ("two", 5), ("zero", 5)]
+
+
+def test_jsonl_inference_keeps_floats_and_bools(tmp_path):
+    p = tmp_path / "f.jsonl"
+    p.write_text('{"f": 1.5, "b": true, "i": 2}\n'
+                 '{"f": 2.5, "b": false, "i": 3}\n')
+    schema = lf.register_table("f", str(p))
+    assert schema["f"] == T.DOUBLE     # NOT silently truncated to int
+    assert schema["b"] == T.BOOLEAN
+    assert schema["i"] == T.BIGINT
+    assert sql("SELECT sum(f) FROM localfile.f", sf=0.01).rows() == [(4.0,)]
+
+
+def test_timestamp_offsets_convert_the_instant(tmp_path):
+    p = tmp_path / "z.csv"
+    p.write_text("ts\n2024-01-01T10:00:00+02:00\n2024-01-01T08:00:00\n")
+    lf.register_table("z", str(p), schema={"ts": T.TIMESTAMP})
+    rows = sql("SELECT count(DISTINCT ts) FROM localfile.z",
+               sf=0.01).rows()
+    assert rows == [(1,)]  # both cells name the SAME instant (08:00 UTC)
