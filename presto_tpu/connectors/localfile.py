@@ -115,16 +115,29 @@ def register_table(name: str, path: str, fmt: Optional[str] = None,
                     if r.get(c) not in (None, "")]
             ty = T.varchar(max((len(str(v)) for v in vals), default=1))
             if vals:
+                def _is_num(v):
+                    return isinstance(v, (int, float)) \
+                        and not isinstance(v, bool)
                 if all(isinstance(v, bool) for v in vals):
                     ty = T.BOOLEAN
-                elif any(isinstance(v, float) for v in vals):
-                    # native JSON floats (int(1.5) would silently
-                    # truncate -- isinstance, not the int() probe)
-                    ty = T.DOUBLE
-                elif not any(isinstance(v, bool) for v in vals):
+                elif all(_is_num(v) or isinstance(v, bool)
+                         for v in vals):
+                    # uniformly numeric (bools count as 0/1): any float
+                    # -> DOUBLE (int(1.5) would silently truncate),
+                    # else BIGINT
+                    ty = T.DOUBLE if any(isinstance(v, float)
+                                         for v in vals) else T.BIGINT
+                else:
+                    # CSV strings (or mixed strings + numbers): probe
+                    # full parses; a single unparseable cell keeps the
+                    # column varchar so no value silently nulls out
                     try:
-                        [int(v) for v in vals]
-                        ty = T.BIGINT
+                        [int(v) for v in vals
+                         if not isinstance(v, bool)]
+                        if not any(isinstance(v, float) for v in vals):
+                            ty = T.BIGINT
+                        else:
+                            raise ValueError
                     except (ValueError, TypeError):
                         try:
                             [float(v) for v in vals]

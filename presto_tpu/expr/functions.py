@@ -2048,3 +2048,106 @@ def _array_slice(ret, a, start: Column, length: Column):
     return ArrayColumn(jnp.take_along_axis(a.elements, idx, axis=1),
                        jnp.take_along_axis(a.elem_nulls, idx, axis=1),
                        new_len.astype(a.lengths.dtype), nulls, ret)
+
+
+# ---------------------------------------------------------------------------
+# geospatial scalars (the coordinate-native slice of presto-geospatial:
+# GeoFunctions.great_circle_distance + BingTileFunctions.bing_tile_at /
+# bing_tile_quadkey. Geometry-typed functions (WKT parsing, spatial
+# joins, R-trees) are outside this engine's current type surface --
+# these are the functions whose inputs are plain doubles, which
+# vectorize onto the VPU directly.)
+# ---------------------------------------------------------------------------
+
+_EARTH_RADIUS_KM = 6371.01
+
+
+def decimal_to_f64(b):
+    """Any numeric block's lanes as float64 (decimals unscale) -- the
+    ONE home of the scaled-int conversion (aggregation's moment
+    kernels and the geo functions share it)."""
+    f = b.values.astype(jnp.float64)
+    if b.type.is_decimal:
+        f = f / _POW10[b.type.scale]
+    return f
+
+
+_geo_f64 = decimal_to_f64  # coordinate lanes in degrees
+
+
+@register("great_circle_distance")
+def _great_circle_distance(ret, lat1, lon1, lat2, lon2):
+    """Haversine distance in KILOMETERS between two (lat, lon) points
+    in degrees (GeoFunctions.stDistance's spherical sibling; same
+    radius constant as the reference)."""
+    to_rad = jnp.pi / 180.0
+    p1 = _geo_f64(lat1) * to_rad
+    p2 = _geo_f64(lat2) * to_rad
+    dphi = p2 - p1
+    dlam = (_geo_f64(lon2) - _geo_f64(lon1)) * to_rad
+    a = jnp.sin(dphi / 2.0) ** 2 + \
+        jnp.cos(p1) * jnp.cos(p2) * jnp.sin(dlam / 2.0) ** 2
+    d = 2.0 * _EARTH_RADIUS_KM * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
+    return _col(ret, d, lat1, lon1, lat2, lon2)
+
+
+def _bing_xy(lat, lon, zoom):
+    """(lat, lon, zoom) -> integer tile (x, y) lanes (the Bing tile
+    system's Mercator mapping; BingTileUtils.latitudeLongitudeToTile)."""
+    lat = jnp.clip(lat.astype(jnp.float64), -85.05112878, 85.05112878)
+    lon = jnp.clip(lon.astype(jnp.float64), -180.0, 180.0)
+    sin_lat = jnp.sin(lat * jnp.pi / 180.0)
+    x_frac = (lon + 180.0) / 360.0
+    y_frac = 0.5 - jnp.log((1.0 + sin_lat) / (1.0 - sin_lat)) \
+        / (4.0 * jnp.pi)
+    size = (jnp.int64(1) << zoom.astype(jnp.int64)).astype(jnp.float64)
+    tx = jnp.clip(jnp.floor(x_frac * size), 0, size - 1).astype(jnp.int64)
+    ty = jnp.clip(jnp.floor(y_frac * size), 0, size - 1).astype(jnp.int64)
+    return tx, ty
+
+
+def _zoom_ok(zoom):
+    """The Bing system's zoom domain is 0..23 (BingTileUtils raises
+    outside it; total kernels surface NULL instead)."""
+    z = zoom.values.astype(jnp.int64)
+    return (z >= 0) & (z <= 23)
+
+
+@register("bing_tile_x", null_fn=lambda ret, *b: None)
+def _bing_tile_x(ret, lat, lon, zoom):
+    zc = jnp.clip(zoom.values.astype(jnp.int64), 0, 23)
+    tx, _ = _bing_xy(_geo_f64(lat), _geo_f64(lon), zc)
+    return Column(tx, _default_nulls(lat, lon, zoom) | ~_zoom_ok(zoom),
+                  ret)
+
+
+@register("bing_tile_y", null_fn=lambda ret, *b: None)
+def _bing_tile_y(ret, lat, lon, zoom):
+    zc = jnp.clip(zoom.values.astype(jnp.int64), 0, 23)
+    _, ty = _bing_xy(_geo_f64(lat), _geo_f64(lon), zc)
+    return Column(ty, _default_nulls(lat, lon, zoom) | ~_zoom_ok(zoom),
+                  ret)
+
+
+@register("bing_tile_quadkey_at", null_fn=lambda ret, *b: None)
+def _bing_tile_quadkey_at(ret, lat, lon, zoom):
+    """Quadkey string of the tile containing (lat, lon) at `zoom`
+    (bing_tile_quadkey(bing_tile_at(...)) fused -- the tile OBJECT type
+    is not surfaced; the quadkey digits build as vector lanes)."""
+    z = jnp.clip(zoom.values.astype(jnp.int64), 0, 23)
+    tx, ty = _bing_xy(_geo_f64(lat), _geo_f64(lon), z)
+    n = len(lat)
+    maxz = 23  # the Bing system's max zoom (BingTileUtils.MAX_ZOOM_LEVEL)
+    chars = jnp.zeros((n, maxz), dtype=jnp.uint8)
+    for i in range(maxz):
+        # digit i of the quadkey reads bit (z-1-i) of x and y
+        bit = z - 1 - i
+        valid = bit >= 0
+        b = jnp.clip(bit, 0, 62)
+        digit = ((tx >> b) & 1) | (((ty >> b) & 1) << 1)
+        chars = chars.at[:, i].set(
+            jnp.where(valid, digit + ord("0"), 0).astype(jnp.uint8))
+    lengths = jnp.clip(z, 0, maxz).astype(jnp.int32)
+    return StringColumn(chars, lengths,
+                        _default_nulls(lat, lon, zoom)
+                        | ~_zoom_ok(zoom), ret)

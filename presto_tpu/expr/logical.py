@@ -209,3 +209,84 @@ def input_channels(e: E.RowExpression) -> Set[int]:
             walk(c)
     walk(e)
     return out
+
+
+def fold_constants(e: E.RowExpression) -> E.RowExpression:
+    """Evaluate constant subtrees at plan time (the sidecar
+    expression-optimizer analog: NativeSidecarExpressionInterpreter /
+    ExpressionOptimizer.cpp constant-fold REAL kernel semantics --
+    folding runs the SAME registered kernels over a one-row batch, so
+    plan-time and run-time values cannot diverge). Subtrees containing
+    input references, lambdas, or non-scalar/long-decimal results are
+    left alone."""
+    import numpy as np
+
+    def foldable(x: E.RowExpression) -> bool:
+        if isinstance(x, E.Constant):
+            return True
+        if isinstance(x, (E.InputReference, E.Lambda, E.LambdaVariable)):
+            return False
+        if not isinstance(x, (E.Call, E.SpecialForm)):
+            return False
+        ty = x.type
+        if not (ty.is_fixed_width or ty.is_string):
+            return False  # arrays/maps/rows stay symbolic
+        if ty.is_decimal and not ty.is_short_decimal:
+            return False  # int128 lanes have no scalar Constant lane
+        if isinstance(x, E.Call) and x.name.lower() in _UNFOLDABLE:
+            return False
+        return all(foldable(c) for c in x.children())
+
+    def fold_one(x: E.RowExpression) -> E.RowExpression:
+        """Evaluate ONE maximal foldable subtree (a single kernel run
+        per subtree, not per interior node)."""
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            from ..block import Batch, StringColumn
+            from .compile import evaluate
+            # evaluate UNDER jit: eager op-by-op dispatch can differ
+            # from the fused runtime by 1 ULP on transcendentals
+            # (log2(8.0): 2.9999... eager vs 3.0 jitted); folding with
+            # the same compiler keeps plan-time == run-time bits
+            blk = jax.jit(lambda: evaluate(
+                x, Batch((), jnp.ones(1, dtype=bool))))()
+            if bool(np.asarray(blk.nulls)[0]):
+                return E.const(None, x.type)
+            if isinstance(blk, StringColumn):
+                ln = int(np.asarray(blk.lengths)[0])
+                raw = bytes(np.asarray(blk.chars)[0, :ln])
+                # Constant string lanes round-trip through UTF-8; a
+                # kernel emitting non-UTF-8 bytes (byte-indexed substr
+                # of a multibyte char) must NOT fold, or the folded
+                # value would diverge from the runtime bytes
+                v = raw.decode("utf-8")
+            else:
+                v = np.asarray(blk.values)[0].item()
+            return E.const(v, x.type)
+        except Exception:  # noqa: BLE001 - unfoldable at plan time
+            return x
+
+    def walk(x: E.RowExpression) -> E.RowExpression:
+        if isinstance(x, (E.Call, E.SpecialForm)) and foldable(x):
+            return fold_one(x)  # maximal subtree: one evaluation
+        if isinstance(x, E.Call):
+            na = tuple(walk(a) for a in x.arguments)
+            return x if na == x.arguments else E.Call(x.type, x.name, na)
+        if isinstance(x, E.SpecialForm):
+            na = tuple(walk(a) for a in x.arguments)
+            return x if na == x.arguments else \
+                E.SpecialForm(x.type, x.form, na)
+        if isinstance(x, E.Lambda):
+            nb = walk(x.body)
+            return x if nb is x.body else \
+                E.Lambda(x.type, x.parameters, nb)
+        return x
+
+    return walk(e)
+
+
+# functions whose fold would be wasteful or unsound at plan time (host
+# callbacks are pure but row-wise slow; interceptions need batch state)
+_UNFOLDABLE = {"row_field"}

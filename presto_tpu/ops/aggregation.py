@@ -911,16 +911,9 @@ def _acc_columns(spec: AggSpec, col: Optional[Block], ids, active, max_groups: i
         if isinstance(xcol, DictionaryColumn):
             xcol = xcol.decode()
         pair_live = active & ~ycol.nulls & ~xcol.nulls
-
-        def _f64(c):
-            f = c.values.astype(jnp.float64)
-            if c.type.is_decimal:
-                from ..expr.functions import _POW10
-                f = f / _POW10[c.type.scale]
-            return f
-
-        y = _f64(ycol)
-        x = _f64(xcol)
+        from ..expr.functions import decimal_to_f64
+        y = decimal_to_f64(ycol)
+        x = decimal_to_f64(xcol)
         npair = _seg_count(ids, pair_live, g)
         z = jnp.float64(0.0)
         states = [
@@ -940,11 +933,8 @@ def _acc_columns(spec: AggSpec, col: Optional[Block], ids, active, max_groups: i
     if name == "geometric_mean":
         # (count, sum of ln x); nonpositive inputs poison the group to
         # NaN exactly like ln() would (reference behavior)
-        f = v.astype(jnp.float64)
-        if col.type.is_decimal:
-            from ..expr.functions import _POW10
-            f = f / _POW10[col.type.scale]
-        logs = jnp.log(jnp.where(live, f, 1.0))
+        from ..expr.functions import decimal_to_f64
+        logs = jnp.log(jnp.where(live, decimal_to_f64(col), 1.0))
         return [("count", Column(nn, jnp.zeros(g, dtype=bool), T.BIGINT)),
                 ("slog", Column(_seg_add(ids, jnp.where(live, logs, 0.0), g),
                                 no_input, T.DOUBLE))]

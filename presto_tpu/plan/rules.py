@@ -482,12 +482,48 @@ def _prune(nd: N.PlanNode, needed: Set[int]
 # Entry point
 # ---------------------------------------------------------------------------
 
+def fold_plan_constants(root: N.PlanNode) -> N.PlanNode:
+    """Constant-fold every expression in the plan (the sidecar
+    expression-optimization seam; identity-memoized for CTE DAGs)."""
+    from ..expr.logical import fold_constants
+    memo: dict = {}
+
+    def walk(n: N.PlanNode) -> N.PlanNode:
+        if id(n) in memo:
+            return memo[id(n)]
+        changes = {}
+        for f in dataclasses.fields(n):
+            v = getattr(n, f.name)
+            if isinstance(v, N.PlanNode):
+                w = walk(v)
+                if w is not v:
+                    changes[f.name] = w
+            elif isinstance(v, list) and v and isinstance(v[0], N.PlanNode):
+                w = [walk(x) for x in v]
+                if any(a is not b for a, b in zip(w, v)):
+                    changes[f.name] = w
+        if isinstance(n, N.FilterNode):
+            p = fold_constants(n.predicate)
+            if p is not n.predicate:
+                changes["predicate"] = p
+        elif isinstance(n, N.ProjectNode):
+            ex = [fold_constants(e) for e in n.expressions]
+            if any(a is not b for a, b in zip(ex, n.expressions)):
+                changes["expressions"] = ex
+        out = dataclasses.replace(n, **changes) if changes else n
+        memo[id(n)] = out
+        return out
+
+    return walk(root)
+
+
 def optimize_plan(root: N.PlanNode, rules: Sequence[Rule] = None,
                   prune: bool = True) -> N.PlanNode:
     """The PlanOptimizers pipeline analog for logical (pre-exchange)
-    plans: iterative simplification rules to fixpoint, then one
-    channel-pruning pass, then a final rule sweep (pruning can expose
-    identity projections)."""
+    plans: constant folding, iterative simplification rules to
+    fixpoint, then one channel-pruning pass, then a final rule sweep
+    (pruning can expose identity projections)."""
+    root = fold_plan_constants(root)
     opt = IterativeOptimizer(DEFAULT_RULES if rules is None else rules)
     root = opt.optimize(root)
     if prune:

@@ -446,6 +446,12 @@ class _Analyzer:
             width = sum(a.type.max_length if a.type.is_string else 8
                         for a in args)
             return T.varchar(width)
+        if name == "great_circle_distance":
+            return T.DOUBLE
+        if name in ("bing_tile_x", "bing_tile_y"):
+            return T.BIGINT
+        if name == "bing_tile_quadkey_at":
+            return T.varchar(23)
         if name in ("sqrt", "exp", "ln", "log10", "power", "pow",
                     "sin", "cos", "tan", "asin", "acos", "atan", "atan2",
                     "sinh", "cosh", "tanh", "cbrt", "log2", "log",

@@ -1,0 +1,68 @@
+"""Geospatial scalar slice: great-circle distance + Bing tiles.
+
+Reference behavior: geospatial/GeoFunctions.java
+(great_circle_distance, radius 6371.01 km) and BingTileFunctions /
+BingTileUtils (Mercator tile mapping, quadkey digits)."""
+
+import math
+
+import pytest
+
+from presto_tpu.sql import sql
+
+
+def one(q):
+    return sql(f"SELECT {q} FROM region LIMIT 1", sf=0.01).rows()[0][0]
+
+
+def _haversine(lat1, lon1, lat2, lon2):
+    p1, p2 = math.radians(lat1), math.radians(lat2)
+    dphi = p2 - p1
+    dlam = math.radians(lon2 - lon1)
+    a = math.sin(dphi / 2) ** 2 + \
+        math.cos(p1) * math.cos(p2) * math.sin(dlam / 2) ** 2
+    return 2 * 6371.01 * math.asin(math.sqrt(a))
+
+
+def test_great_circle_distance_known_routes():
+    cases = [(37.6213, -122.3790, 40.6413, -73.7781),   # SFO-JFK
+             (51.4700, -0.4543, 35.5494, 139.7798),     # LHR-HND
+             (0.0, 0.0, 0.0, 90.0)]                     # quarter equator
+    for lat1, lon1, lat2, lon2 in cases:
+        got = one(f"great_circle_distance({lat1}, {lon1}, {lat2}, {lon2})")
+        assert got == pytest.approx(_haversine(lat1, lon1, lat2, lon2),
+                                    rel=1e-9)
+    assert one("great_circle_distance(10.0, 20.0, 10.0, 20.0)") == 0.0
+
+
+def test_bing_tiles_match_published_mapping():
+    # Seattle at zoom 10 is tile (164, 357), quadkey 0212300302 (the
+    # Bing tile system's own documented example point)
+    assert one("bing_tile_x(47.61, -122.33, 10)") == 164
+    assert one("bing_tile_y(47.61, -122.33, 10)") == 357
+    assert one("bing_tile_quadkey_at(47.61, -122.33, 10)") == "0212300302"
+    # zoom 1 quadrants
+    assert one("bing_tile_quadkey_at(45.0, -90.0, 1)") == "0"
+    assert one("bing_tile_quadkey_at(45.0, 90.0, 1)") == "1"
+    assert one("bing_tile_quadkey_at(-45.0, -90.0, 1)") == "2"
+    assert one("bing_tile_quadkey_at(-45.0, 90.0, 1)") == "3"
+
+
+def test_bing_tile_latitude_clamped():
+    # beyond the Mercator clamp the poles collapse to the edge tiles
+    assert one("bing_tile_y(89.9, 0.0, 4)") == 0
+    assert one("bing_tile_y(-89.9, 0.0, 4)") == 15
+
+
+def test_vectorized_over_table_rows():
+    rows = sql("SELECT regionkey, great_circle_distance("
+               "cast(regionkey as double) * 10.0, 0.0, 0.0, 0.0) "
+               "FROM region ORDER BY regionkey", sf=0.01).rows()
+    for rk, d in rows:
+        assert d == pytest.approx(_haversine(rk * 10.0, 0, 0, 0), rel=1e-9)
+
+
+def test_bing_zoom_out_of_range_is_null():
+    assert one("bing_tile_x(47.61, -122.33, 30)") is None
+    assert one("bing_tile_quadkey_at(47.61, -122.33, -1)") is None
+    assert one("bing_tile_y(47.61, -122.33, 64)") is None

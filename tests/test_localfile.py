@@ -92,3 +92,20 @@ def test_timestamp_offsets_convert_the_instant(tmp_path):
     rows = sql("SELECT count(DISTINCT ts) FROM localfile.z",
                sf=0.01).rows()
     assert rows == [(1,)]  # both cells name the SAME instant (08:00 UTC)
+
+
+def test_mixed_type_columns_never_silently_null(tmp_path):
+    # a single float plus a non-numeric string must stay varchar (the
+    # old behavior): no value silently decodes to NULL
+    p = tmp_path / "m.jsonl"
+    p.write_text('{"x": 1.5}\n{"x": "n/a"}\n')
+    schema = lf.register_table("m", str(p))
+    assert schema["x"].is_string
+    rows = sql("SELECT x FROM localfile.m ORDER BY x", sf=0.01).rows()
+    assert rows == [("1.5",), ("n/a",)]
+    # mixed bool + int is uniformly numeric: bools count as 0/1
+    p2 = tmp_path / "m2.jsonl"
+    p2.write_text('{"y": true}\n{"y": 1}\n{"y": 3}\n')
+    schema2 = lf.register_table("m2", str(p2))
+    assert schema2["y"] == T.BIGINT
+    assert sql("SELECT sum(y) FROM localfile.m2", sf=0.01).rows() == [(5,)]

@@ -231,3 +231,46 @@ def test_optimize_plan_preserves_tpch_q1_via_runner():
     from presto_tpu.sql import sql
     r = sql("SELECT count(*) c, sum(quantity) s FROM lineitem", sf=0.01)
     assert r.row_count == 1
+
+
+def test_constant_folding_uses_real_kernels():
+    """Plan-time folding evaluates the SAME registered kernels (the
+    sidecar expression-optimizer analog), so folded constants cannot
+    diverge from runtime values."""
+    from presto_tpu.plan.explain import explain
+    from presto_tpu.plan.rules import optimize_plan
+    from presto_tpu.sql import sql
+    from presto_tpu.sql.planner import plan_sql
+
+    p = optimize_plan(plan_sql(
+        "SELECT 1 + 2 * 3 AS x, upper('abc') AS s, "
+        "nationkey + (10 - 3) AS k FROM nation"))
+    txt = explain(p)
+    assert "7:bigint" in txt            # arithmetic folded
+    assert "'ABC':varchar(3)" in txt    # string kernel folded
+    assert "add($in0:bigint, 7:bigint)" in txt  # input-ref side kept
+    # results unchanged end to end
+    rows = sql("SELECT 1 + 2 * 3, upper('abc'), nationkey + (10 - 3) "
+               "FROM nation WHERE nationkey = 1", sf=0.01).rows()
+    assert rows == [(7, "ABC", 8)]
+
+
+def test_constant_folding_leaves_nonfoldable_alone():
+    from presto_tpu.expr import ir as E
+    from presto_tpu import types as T
+    from presto_tpu.expr.logical import fold_constants
+
+    # input references block folding
+    e = E.call("add", T.BIGINT, E.input_ref(0, T.BIGINT),
+               E.const(1, T.BIGINT))
+    assert fold_constants(e) is e
+    # NULL-producing folds become typed NULL constants
+    e2 = E.call("add", T.BIGINT, E.const(None, T.BIGINT),
+                E.const(1, T.BIGINT))
+    out = fold_constants(e2)
+    assert isinstance(out, E.Constant) and out.value is None
+    # long-decimal results stay symbolic (no int128 constant lane)
+    e3 = E.call("multiply", T.decimal(38, 4),
+                E.const(10**15, T.decimal(20, 2)),
+                E.const(10**15, T.decimal(20, 2)))
+    assert isinstance(fold_constants(e3), E.Call)
