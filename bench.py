@@ -299,16 +299,35 @@ WHERE l.shipdate >= date '1995-09-01' AND l.shipdate < date '1995-10-01'
 """
 
 
+def _query_telemetry(res):
+    """QueryStats -> the compile/execute split the BENCH json records
+    (exec/stats.py structured telemetry; None when stats are absent)."""
+    qs = getattr(res, "query_stats", None)
+    if qs is None:
+        return None
+    out = {"compile_s": round(qs.compile_us / 1e6, 3),
+           "execute_s": round(qs.stage_us("execute") / 1e6, 5),
+           "staging_s": round(qs.stage_us("staging") / 1e6, 5),
+           "rows": qs.output_rows,
+           "peak_memory_bytes": qs.peak_memory_bytes}
+    comp = qs.stages.get("compile")
+    if comp is not None and comp.flops:
+        out["flops"] = comp.flops
+        out["bytes_accessed"] = comp.bytes_accessed
+    return out
+
+
 def _bench_sql_join(name, sql_text, sf, platform, **hints):
     """End-to-end wall time of a join config through the SQL front door
     (plan + NDV refine + stage + execute; second run reuses the XLA
-    compile cache, so run2 - run1 separates compile from execute)."""
+    compile cache, so run2 - run1 separates compile from execute --
+    and the engine's own QueryStats now report the split directly)."""
     from presto_tpu.connectors import tpch
     from presto_tpu.sql import sql as run_sql
 
     n = tpch.table_row_count("lineitem", sf)
     t0 = time.time()
-    run_sql(sql_text, sf=sf, **hints)
+    res_cold = run_sql(sql_text, sf=sf, **hints)
     cold_s = time.time() - t0
     t0 = time.time()
     res = run_sql(sql_text, sf=sf, **hints)
@@ -320,6 +339,8 @@ def _bench_sql_join(name, sql_text, sf, platform, **hints):
                    "cold_wall_s": round(cold_s, 3),
                    "warm_wall_s": round(warm_s, 3),
                    "rows": n, "row_count": res.row_count,
+                   "telemetry_cold": _query_telemetry(res_cold),
+                   "telemetry_warm": _query_telemetry(res),
                    "platform": platform,
                    "scoring": not platform.startswith("cpu")}}))
 
@@ -414,6 +435,14 @@ def main():
     dt_hand, staged_bytes = _stage_and_time(host_cols, Q1_COLUMNS, capacity,
                                             q1_local(), iters)
 
+    # fast telemetry smoke: one run_sql at sf=0.01 through the full
+    # engine so every BENCH artifact carries the compile/execute split
+    # (and XLA cost_analysis FLOPs) the QueryStats pipeline measures;
+    # cheap and independent of the timed windows above
+    from presto_tpu.sql import sql as run_sql
+    telemetry_smoke = _query_telemetry(run_sql(
+        TPCH_Q1, sf=0.01, session={"query_cost_analysis": True}))
+
     rows_per_sec = n / dt_sql
     baseline_rows_per_sec = n / numpy_s
     result = {
@@ -434,6 +463,7 @@ def main():
             "achieved_gb_per_s": round(sql_staged_bytes / dt_sql / 1e9, 1),
             "hand_built_staged_mb": round(staged_bytes / 1e6, 1),
             "timing_fallback": sql_fallback or _TIMING_FALLBACK,
+            "telemetry_smoke_sf001": telemetry_smoke,
             "platform": platform,
             "scoring": scoring,
             "iters": iters,

@@ -50,7 +50,8 @@ def _format_table(names, rows, types=None, max_rows=50):
     return "\n".join(out)
 
 
-def run_one(query: str, sf: float, explain_only: bool = False) -> int:
+def run_one(query: str, sf: float, explain_only: bool = False,
+            stats: bool = False) -> int:
     from presto_tpu.plan import explain as explain_plan
     from presto_tpu.sql import plan_sql, sql
 
@@ -65,15 +66,22 @@ def run_one(query: str, sf: float, explain_only: bool = False) -> int:
         print(explain_plan(plan_sql(q)))
         return 0
     t0 = time.time()
-    res = sql(query, sf=sf)
+    import uuid
+    kwargs = {"query_id": f"cli_{uuid.uuid4().hex[:8]}"}
+    if stats:
+        # --stats pays the one extra trace for FLOPs/bytes-accessed
+        kwargs["session"] = {"query_cost_analysis": True}
+    res = sql(query, sf=sf, **kwargs)
     dt = time.time() - t0
     print(_format_table(res.names, res.rows(), res.types))
     print(f"({res.row_count} rows in {dt:.2f}s)")
+    if stats and res.query_stats is not None:
+        print(f"stats: {res.query_stats.summary()}")
     return 0
 
 
 def run_one_remote(query: str, server: str, user: str = "presto",
-                   session=None) -> int:
+                   session=None, stats: bool = False) -> int:
     """Run one statement over the client statement protocol (the
     presto-cli-to-coordinator path: POST /v1/statement + nextUri)."""
     from presto_tpu.client import QueryError, execute
@@ -91,6 +99,19 @@ def run_one_remote(query: str, server: str, user: str = "presto",
     print(_format_table(names, rows))
     extra = f", {client.update_type}" if client.update_type else ""
     print(f"({len(rows)} rows in {dt:.2f}s via {client.query_id}{extra})")
+    if stats and client.stats:
+        # the server populated these from its QueryStats (statement.py)
+        s = client.stats
+        parts = [f"wall {s.get('elapsedTimeMillis', 0) / 1e3:.3f}s"]
+        if "compileTimeMicros" in s:
+            parts.append(f"compile {s['compileTimeMicros'] / 1e6:.3f}s")
+        if "executeTimeMicros" in s:
+            parts.append(f"execute {s['executeTimeMicros'] / 1e6:.3f}s")
+        parts.append(f"rows {s.get('processedRows', len(rows))}")
+        parts.append(f"bytes {s.get('processedBytes', 0)}")
+        if s.get("peakMemoryBytes"):
+            parts.append(f"peak mem {s['peakMemoryBytes'] >> 20}MB")
+        print("stats: " + ", ".join(parts))
     return 0
 
 
@@ -100,6 +121,9 @@ def main(argv=None) -> int:
     ap.add_argument("--sf", type=float, default=0.01,
                     help="tpch/tpcds scale factor (default 0.01)")
     ap.add_argument("--explain", action="store_true")
+    ap.add_argument("--stats", action="store_true",
+                    help="print the QueryStats summary (wall/compile/"
+                         "execute, rows, bytes) after each query")
     ap.add_argument("--server", default=None,
                     help="coordinator URL; statements ride the client "
                          "protocol instead of the embedded engine")
@@ -113,8 +137,8 @@ def main(argv=None) -> int:
                                              re.IGNORECASE):
                 query = f"EXPLAIN {query}"  # server-side EXPLAIN
             return run_one_remote(query, args.server, args.user,
-                                  {"sf": str(args.sf)})
-        return run_one(args.query, args.sf, args.explain)
+                                  {"sf": str(args.sf)}, stats=args.stats)
+        return run_one(args.query, args.sf, args.explain, args.stats)
 
     print("presto-tpu> (end statements with ';', \\q to quit)")
     buf = []
@@ -135,9 +159,10 @@ def main(argv=None) -> int:
                                                      re.IGNORECASE):
                         stmt = f"EXPLAIN {stmt}"
                     run_one_remote(stmt, args.server, args.user,
-                                   {"sf": str(args.sf)})
+                                   {"sf": str(args.sf)},
+                                   stats=args.stats)
                 else:
-                    run_one(stmt, args.sf, args.explain)
+                    run_one(stmt, args.sf, args.explain, args.stats)
             except Exception as e:  # noqa: BLE001 - REPL reports and continues
                 print(f"error: {type(e).__name__}: {e}", file=sys.stderr)
     return 0

@@ -76,9 +76,16 @@ def reset() -> None:
 _V = T.varchar(256)
 SCHEMA = {
     "queries": {"query_id": _V, "state": _V, "user": _V, "query": _V,
-                "elapsed_ms": T.BIGINT},
+                "elapsed_ms": T.BIGINT,
+                # structured-telemetry columns (QueryStats): result
+                # bytes, high-water memory, XLA compile micros
+                "cumulative_bytes": T.BIGINT,
+                "peak_memory_bytes": T.BIGINT,
+                "compile_us": T.BIGINT},
     "tasks": {"task_id": _V, "state": _V, "rows": T.BIGINT,
-              "buffered_pages": T.BIGINT, "elapsed_s": T.DOUBLE},
+              "buffered_pages": T.BIGINT, "elapsed_s": T.DOUBLE,
+              "output_bytes": T.BIGINT, "peak_memory_bytes": T.BIGINT,
+              "compile_us": T.BIGINT},
     "nodes": {"node_id": _V, "uri": _V, "coordinator": T.BOOLEAN,
               "age_seconds": T.DOUBLE},
     "catalogs": {"catalog_name": _V, "connector_id": _V},
@@ -92,6 +99,12 @@ SCHEMA = {
 }
 
 
+def _compile_us_of(query_stats_doc: dict) -> int:
+    """Summed compile micros across a QueryStats json document's stages."""
+    return sum(int(s.get("compile_us", 0))
+               for s in (query_stats_doc.get("stages") or {}).values())
+
+
 def _rows_of(table: str) -> List[tuple]:
     if table == "queries":
         out = []
@@ -99,9 +112,13 @@ def _rows_of(table: str) -> List[tuple]:
             servers = _live(_statement_servers)
         for s in servers:
             for doc in s.queries_doc():
+                qs = doc.get("queryStats") or {}
                 out.append((doc["queryId"], doc["state"], doc["user"],
                             doc["query"],
-                            int(doc.get("elapsedTimeMillis", 0))))
+                            int(doc.get("elapsedTimeMillis", 0)),
+                            int(qs.get("outputBytes", 0)),
+                            int(qs.get("peakMemoryBytes", 0)),
+                            _compile_us_of(qs)))
         return out
     if table == "tasks":
         out = []
@@ -111,9 +128,14 @@ def _rows_of(table: str) -> List[tuple]:
             with m._tasks_lock:
                 infos = [t.info() for t in m.tasks.values()]
             for i in infos:
+                st = i.get("stats", {}) or {}
+                qs = st.get("queryStats") or {}
                 out.append((i["taskId"], i["state"],
-                            int(i.get("stats", {}).get("outputRows", 0)),
-                            i["bufferedPages"], i["elapsedSeconds"]))
+                            int(st.get("outputRows", 0)),
+                            i["bufferedPages"], i["elapsedSeconds"],
+                            int(st.get("outputBytes", 0)),
+                            int(qs.get("peakMemoryBytes", 0)),
+                            _compile_us_of(qs)))
         return out
     if table == "nodes":
         from ..server.discovery import alive_nodes

@@ -72,6 +72,9 @@ class MemoryPool:
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self.revoked_bytes = 0  # counter: surfaced in stats/EXPLAIN
+        # high-water marks (telemetry: /v1/metrics + QueryStats.peak)
+        self.peak_bytes = 0
+        self._query_peak: Dict[str, int] = {}
 
     @property
     def reserved_bytes(self) -> int:
@@ -138,8 +141,11 @@ class MemoryPool:
             with self._cv:
                 total = sum(self._reserved.values()) + bytes_
                 if total <= self.capacity:
-                    self._reserved[query_id] = \
-                        self._reserved.get(query_id, 0) + bytes_
+                    mine = self._reserved.get(query_id, 0) + bytes_
+                    self._reserved[query_id] = mine
+                    self.peak_bytes = max(self.peak_bytes, total)
+                    self._query_peak[query_id] = max(
+                        self._query_peak.get(query_id, 0), mine)
                     return
                 shortfall = total - self.capacity
                 can_revoke = bool(self._revocables) and not revoke_tried
@@ -176,6 +182,15 @@ class MemoryPool:
     def query_bytes(self, query_id: str) -> int:
         with self._lock:
             return self._reserved.get(query_id, 0)
+
+    def query_peak_bytes(self, query_id: str, pop: bool = False) -> int:
+        """High-water reservation of one query (QueryStats.peak memory).
+        ``pop=True`` also forgets it (called once the query is done, so
+        the map stays bounded by in-flight queries)."""
+        with self._lock:
+            if pop:
+                return self._query_peak.pop(query_id, 0)
+            return self._query_peak.get(query_id, 0)
 
 
 @dataclasses.dataclass

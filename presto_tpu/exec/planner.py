@@ -295,6 +295,8 @@ def compile_plan(root: N.PlanNode, mesh=None,
                     node.slot_capacity
                     or max(4 * inner.capacity // max(n_workers, 1), 64),
                     inner.capacity)
+                from ..parallel.stages import _note_exchange
+                _note_exchange("range", axis)
                 out, ovf = exchange_by_range(inner, node.sort_keys, axis,
                                              slot)
                 _note_overflow(ovf, scalable=True)
@@ -302,19 +304,23 @@ def compile_plan(root: N.PlanNode, mesh=None,
             src = lower(node.source, inputs)
             if node.scope == "LOCAL" or not dist:
                 return src
+            from ..parallel.stages import _note_exchange
             if node.kind == "REPARTITION":
                 slot = _scaled_slot(
                     node.slot_capacity or max(src.capacity, 1),
                     src.capacity)
+                _note_exchange("hash", axis)
                 out, ovf = exchange_by_hash(src, node.partition_channels,
                                             axis, slot)
                 _note_overflow(ovf, scalable=True)
                 return out
             if node.kind == "REPLICATE":
+                _note_exchange("broadcast", axis)
                 return broadcast_build(src, axis)
             if node.kind == "GATHER":
                 # every worker receives all rows; only worker 0 keeps them
                 # active so the global (concatenated) view has one copy
+                _note_exchange("gather", axis)
                 g = gather_to_root(src, axis)
                 is_root = jax.lax.axis_index(axis) == 0
                 return g.with_active(g.active & is_root)

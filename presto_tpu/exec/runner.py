@@ -14,7 +14,10 @@ without changing lowered kernels.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -24,9 +27,9 @@ from .. import types as T
 from ..block import Batch, batch_from_numpy, to_numpy
 from ..plan import nodes as N
 from .planner import compile_plan
-from .stats import RuntimeStats
+from .stats import QueryStats, RuntimeStats, StatsCollector, collecting
 
-__all__ = ["run_query", "QueryResult"]
+__all__ = ["run_query", "prepare_plan", "QueryResult"]
 
 
 @dataclasses.dataclass
@@ -37,6 +40,9 @@ class QueryResult:
     row_count: int
     stats: Dict[str, Dict[str, float]] = dataclasses.field(default_factory=dict)
     types: List[T.Type] = dataclasses.field(default_factory=list)
+    # structured telemetry (stages/operators/counters with a merge law);
+    # `stats` above stays the flat named-counter snapshot
+    query_stats: Optional[QueryStats] = None
 
     def rows(self) -> List[tuple]:
         out = []
@@ -117,45 +123,29 @@ def _scan_batch(node: N.PlanNode, sf: float, capacity_hint: Optional[int],
                                count=count, capacity=cap)
 
 
-def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
-              capacity_hints: Optional[Dict[str, int]] = None,
-              default_join_capacity: int = 1 << 16,
-              split_rows: Optional[int] = None,
-              scan_ranges: Optional[Dict[str, Tuple[int, int]]] = None,
-              remote_sources: Optional[Dict[str, Batch]] = None,
-              memory_pool=None, query_id: str = "query",
-              session=None,
-              hbm_budget_bytes: Optional[int] = None) -> QueryResult:
-    """Plan -> results, end to end (DistributedQueryRunner analog for
-    programmatic plans). With a mesh, scan batches are padded to a
-    multiple of the mesh size and the plan runs SPMD. With `split_rows`,
-    streamable aggregation plans execute split-by-split with bounded
-    HBM (exec/streaming.py)."""
-    # write/DDL roots execute their source on device, then write
-    # host-side (TableWriterOperator.java:76 analog -- the sink is a
-    # host effect, fed by one DMA-out of the computed rows)
+def prepare_plan(root: N.PlanNode, sf: float = 0.01, mesh=None,
+                 session=None) -> N.PlanNode:
+    """The plan-shaping pipeline run_query applies before lowering:
+    rule-based simplification + channel pruning, cost-based join
+    reordering, connector predicate pushdown, NDV capacity refinement,
+    AddExchanges (mesh), PlanChecker validation. Exposed so EXPLAIN
+    ANALYZE can annotate exactly the tree that executes (pass the
+    result back with ``prepared=True``). Write/DDL roots pass through
+    untouched -- their inner SELECTs are shaped when the writer
+    re-enters run_query."""
+    from ..utils.config import session_flag, session_value
+
     inner_root = root.source if isinstance(root, N.OutputNode) else root
     if isinstance(inner_root, (N.DdlNode, N.TableFinishNode,
                                N.TableWriterNode, N.TableRewriteNode)):
-        from ..server.access import get_access_control
-        acl = get_access_control()
-        if acl is not None:
-            acl.check_plan(root, (session or {}).get("user", ""))
-        return _run_write_root(
-            inner_root, sf=sf, mesh=mesh, capacity_hints=capacity_hints,
-            default_join_capacity=default_join_capacity,
-            split_rows=split_rows, scan_ranges=scan_ranges,
-            remote_sources=remote_sources, memory_pool=memory_pool,
-            query_id=query_id, session=session,
-            hbm_budget_bytes=hbm_budget_bytes)
-    # rule-based simplification + channel pruning (IterativeOptimizer /
-    # PruneUnreferencedOutputs analog): narrows intermediates before
-    # stats and distribution decide capacities and exchange widths
-    from ..utils.config import session_flag, session_value
+        return root
 
     def _session_on(name: str) -> bool:
         return session_flag(session, name, True)
 
+    # rule-based simplification + channel pruning (IterativeOptimizer /
+    # PruneUnreferencedOutputs analog): narrows intermediates before
+    # stats and distribution decide capacities and exchange widths
     if _session_on("iterative_optimizer"):
         from ..plan.rules import optimize_plan
         root = optimize_plan(root)
@@ -180,8 +170,7 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     # capacity refinement (CBO stats): shrink group tables to the
     # connector-proven NDV bound so group-by rides the scatter-free
     # small-table kernels wherever statistics allow
-    refine = _session_on("stats_capacity_refinement")
-    if refine:
+    if _session_on("stats_capacity_refinement"):
         from ..plan.stats import refine_capacities
         root = refine_capacities(root, sf)
     if mesh is not None:
@@ -205,6 +194,46 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     if violations:
         raise ValueError("plan not executable by the TPU engine "
                          f"(PlanChecker): {violations}")
+    return root
+
+
+def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
+              capacity_hints: Optional[Dict[str, int]] = None,
+              default_join_capacity: int = 1 << 16,
+              split_rows: Optional[int] = None,
+              scan_ranges: Optional[Dict[str, Tuple[int, int]]] = None,
+              remote_sources: Optional[Dict[str, Batch]] = None,
+              memory_pool=None, query_id: str = "query",
+              session=None,
+              hbm_budget_bytes: Optional[int] = None,
+              prepared: bool = False,
+              trace_id: Optional[str] = None) -> QueryResult:
+    """Plan -> results, end to end (DistributedQueryRunner analog for
+    programmatic plans). With a mesh, scan batches are padded to a
+    multiple of the mesh size and the plan runs SPMD. With `split_rows`,
+    streamable aggregation plans execute split-by-split with bounded
+    HBM (exec/streaming.py)."""
+    # write/DDL roots execute their source on device, then write
+    # host-side (TableWriterOperator.java:76 analog -- the sink is a
+    # host effect, fed by one DMA-out of the computed rows)
+    inner_root = root.source if isinstance(root, N.OutputNode) else root
+    if isinstance(inner_root, (N.DdlNode, N.TableFinishNode,
+                               N.TableWriterNode, N.TableRewriteNode)):
+        from ..server.access import get_access_control
+        acl = get_access_control()
+        if acl is not None:
+            acl.check_plan(root, (session or {}).get("user", ""))
+        return _run_write_root(
+            inner_root, sf=sf, mesh=mesh, capacity_hints=capacity_hints,
+            default_join_capacity=default_join_capacity,
+            split_rows=split_rows, scan_ranges=scan_ranges,
+            remote_sources=remote_sources, memory_pool=memory_pool,
+            query_id=query_id, session=session,
+            hbm_budget_bytes=hbm_budget_bytes)
+    if not prepared:
+        root = prepare_plan(root, sf=sf, mesh=mesh, session=session)
+    from ..utils.config import session_flag, session_value
+    refine = session_flag(session, "stats_capacity_refinement", True)
     # access control: the analysis-time boundary (AccessControlManager
     # checkCanSelectFromColumns / write checks) -- enforced on the plan
     # before anything touches data
@@ -213,6 +242,8 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     if acl is not None:
         acl.check_plan(root, (session or {}).get("user", ""))
     stats = RuntimeStats()
+    collector = StatsCollector(query_id)
+    t_query0 = time.time()
     hbm_budget = hbm_budget_bytes
     if hbm_budget is None and session is not None:
         hbm_budget = session.get("hbm_budget_bytes")
@@ -230,15 +261,20 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
                     # the full state table cannot fit the budget: grouped
                     # execution with per-bucket host offload (the
                     # SpillableHashAggregationBuilder path)
-                    with stats.timed("spilled_exec_s"):
+                    with stats.timed("spilled_exec_s"), \
+                            collecting(collector), \
+                            collector.stage("execute"):
                         out_b = run_spilled_agg(
                             root, sf, split_rows, hbm_budget, stats,
                             spill_dir=spill_dir,
                             spill_file_threshold=spill_thresh)
                     res = _batch_to_result(out_b, root)
                     res.stats = stats.snapshot()
+                    _finalize_query_stats(collector, res, t_query0, 0,
+                                          root, trace_id)
                     return res
-            with stats.timed("streaming_exec_s"):
+            with stats.timed("streaming_exec_s"), collecting(collector), \
+                    collector.stage("execute"):
                 r = run_streaming_agg(root, sf, split_rows)
             if bool(np.asarray(r.overflow)):
                 raise RuntimeError("streaming aggregation overflowed "
@@ -250,6 +286,8 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
                                     agg_node.aggregates)
             res = _batch_to_result(out_b, root)
             res.stats = stats.snapshot()
+            _finalize_query_stats(collector, res, t_query0, 0, root,
+                                  trace_id)
             return res
     pad = (mesh.devices.size if mesh is not None else 1) * 8
     hints = capacity_hints or {}
@@ -308,9 +346,10 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
         memory_pool.reserve(query_id, reserved)
         stats.add("reserved_bytes", reserved)
     try:
-        with stats.timed("scan_stage_s"):
+        with stats.timed("scan_stage_s"), collector.stage("staging"):
             batches = []
-            for s in plan.scan_nodes:
+            for si, s in enumerate(plan.scan_nodes):
+                t_scan0 = time.time()
                 if isinstance(s, N.RemoteSourceNode):
                     assert s.id in remote_sources, \
                         f"no remote source batch supplied for node {s.id}"
@@ -319,14 +358,28 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
                     batches.append(_scan_batch(
                         s, sf, hints.get(s.id), pad, scan_ranges.get(s.id),
                         dyn_filters=dyn_filters.get(s.id), stats=stats))
+                collector.operator(
+                    _scan_key(si, s), _scan_label(s),
+                    wall_us=int((time.time() - t_scan0) * 1e6))
     except Exception:
         if memory_pool is not None:
             memory_pool.free(query_id, reserved)
+            memory_pool.query_peak_bytes(query_id, pop=True)
         raise
-    for b in batches:
-        stats.add("scan_rows", int(np.asarray(b.active).sum()))
+    from .memory import batch_bytes
+    staged_rows = staged_bytes = 0
+    for si, (s, b) in enumerate(zip(plan.scan_nodes, batches)):
+        rows = int(np.asarray(b.active).sum())
+        nbytes = batch_bytes(b)
+        staged_rows += rows
+        staged_bytes += nbytes
+        stats.add("scan_rows", rows)
+        collector.operator(_scan_key(si, s), output_rows=rows,
+                           output_bytes=nbytes)
+    collector.bump_stage("staging", rows=staged_rows, bytes=staged_bytes)
     try:
-        with stats.timed("execute_s"):
+        with stats.timed("execute_s"), collecting(collector), \
+                collector.stage("execute"):
             # exchange-slot overflow (flag bit1) -> rerun with
             # geometrically larger slots; slots clamp at the sender
             # capacity, where overflow is impossible, so this converges.
@@ -349,8 +402,10 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
             while True:
                 if jfn is None:
                     fn = jax.jit(plan.fn)
+                    dispatch_fn = fn
                     out, overflow = fn(tuple(batches))
                 else:
+                    dispatch_fn = jfn
                     with call_lock:  # serialize trace-time closure state
                         out, overflow = jfn(tuple(batches))
                 jax.block_until_ready(out)
@@ -392,13 +447,48 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
                 plan, jfn, call_lock = _compile_any(
                     exec_root if exec_root is not None else root, mesh,
                     default_join_capacity * cap_scale, scale, use_cache)
-        with stats.timed("fetch_s"):
+        # XLA compile cost (compile-time captured via jax.monitoring; a
+        # plan-cache hit naturally reports zero) + the program's
+        # FLOPs / bytes-accessed from cost_analysis, memoized per plan.
+        # Clamped to the execute wall that contains it (nested-jit
+        # lowering events can overlap), anchored at execute start so
+        # trace timelines render the compile where it happened.
+        compile_us = collector.take_compile_us()
+        exec_stage = collector.stats.stages.get("execute")
+        if exec_stage is not None and exec_stage.wall_us:
+            compile_us = min(compile_us, exec_stage.wall_us)
+        if compile_us:
+            anchor = collector.stage_span_start("execute") or t_query0
+            collector.record_stage(
+                "compile", anchor, anchor + compile_us / 1e6,
+                compile_us=compile_us)
+            stats.add("compile_s", compile_us / 1e6)
+        if session_flag(session, "query_cost_analysis", False):
+            if fp is None:
+                from .plan_cache import plan_fingerprint
+                fp_cost = plan_fingerprint(root)
+            else:
+                fp_cost = fp
+            # cap_scale distinguishes the scaled rerun's program from
+            # the unscaled one (same fingerprint + shapes otherwise)
+            cost = _stage_cost(dispatch_fn, batches,
+                               (fp_cost, cap_scale, scale), call_lock)
+            if cost:
+                collector.bump_stage("compile", **cost)
+                stats.add("xla_flops", cost["flops"])
+        with stats.timed("fetch_s"), collector.stage("fetch"):
             res = _batch_to_result(out, root)
     finally:
+        # always drain the per-query peak (success AND failure paths):
+        # the pool's map must stay bounded by in-flight queries
+        peak_reserved = 0
         if memory_pool is not None:
             memory_pool.free(query_id, reserved)
+            peak_reserved = memory_pool.query_peak_bytes(query_id, pop=True)
     stats.add("output_rows", res.row_count)
     res.stats = stats.snapshot()
+    _finalize_query_stats(collector, res, t_query0, peak_reserved, root,
+                          trace_id)
     return res
 
 
@@ -408,6 +498,103 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
 # submissions start at the known-good size instead of re-laddering.
 _CAPACITY_FEEDBACK: Dict[str, int] = {}
 _MAX_CAPACITY_SCALE = 1 << 10
+
+
+def _scan_key(index: int, node: N.PlanNode) -> str:
+    """Structural operator key for the index-th scan leaf (DFS order).
+    Structural (not node-id) keys survive plan-cache canonicalization
+    AND line up across workers running the same fragment, so per-node
+    rows merge cross-worker by plain key equality. The label is part of
+    the key so a leaf fragment's TableScan and a consumer fragment's
+    RemoteSource at the same index never fold together."""
+    return f"scan[{index}]:{_scan_label(node)}"
+
+
+def _scan_label(node: N.PlanNode) -> str:
+    if isinstance(node, N.TableScanNode):
+        return f"TableScan[{node.connector}.{node.table}]"
+    if isinstance(node, N.RemoteSourceNode):
+        return "RemoteSource"
+    return type(node).__name__
+
+
+# cost_analysis memo: (plan fingerprint+scales, batch shapes) ->
+# {flops, bytes_accessed}. lower() re-traces the program, so the
+# analysis is paid once per distinct (program, shape) and amortized
+# across repeats; LRU-evicted so a long-lived server keeps caching.
+_COST_MEMO: "collections.OrderedDict[tuple, Optional[dict]]" = \
+    collections.OrderedDict()
+_COST_MEMO_MAX = 256
+_COST_MEMO_LOCK = threading.Lock()
+
+
+def _stage_cost(dispatch_fn, batches, fingerprint,
+                call_lock=None) -> Optional[dict]:
+    import contextlib
+    key = (fingerprint,
+           tuple((b.capacity, b.num_columns) for b in batches))
+    with _COST_MEMO_LOCK:
+        if key in _COST_MEMO:
+            _COST_MEMO.move_to_end(key)
+            return _COST_MEMO[key]
+    try:
+        # lower() re-traces: hold the cached entry's dispatch lock so a
+        # concurrent first dispatch's trace-time closure state can't tear
+        with call_lock or contextlib.nullcontext():
+            lowered = dispatch_fn.lower(tuple(batches))
+        analysis = lowered.cost_analysis()
+        cost = {"flops": max(float(analysis.get("flops", 0.0)), 0.0),
+                "bytes_accessed":
+                    max(float(analysis.get("bytes accessed", 0.0)), 0.0)}
+    except Exception:  # noqa: BLE001 - cost analysis is best-effort
+        cost = None
+    with _COST_MEMO_LOCK:
+        _COST_MEMO[key] = cost
+        while len(_COST_MEMO) > _COST_MEMO_MAX:
+            _COST_MEMO.popitem(last=False)
+    return cost
+
+
+def _result_bytes(res: "QueryResult") -> int:
+    total = 0
+    for vals, nulls in zip(res.columns, res.nulls):
+        total += getattr(vals, "nbytes", 0) + getattr(nulls, "nbytes", 0)
+    return total
+
+
+def _finalize_query_stats(collector: StatsCollector, res: "QueryResult",
+                          t0: float, peak_reserved_bytes: int,
+                          root: Optional[N.PlanNode],
+                          trace_id: Optional[str] = None) -> None:
+    """Close out the structured stats for one run_query invocation and
+    emit one tracer span per collected stage. `peak_reserved_bytes` is
+    the pool high-water mark the caller already drained."""
+    qs = collector.stats
+    # drain any compile time not yet attributed (the streaming/spill
+    # early-return paths compile inside their execute stage and never
+    # reach the main path's drain); same clamp + anchor as there
+    leftover_us = collector.take_compile_us()
+    exec_stage = qs.stages.get("execute")
+    if exec_stage is not None and exec_stage.wall_us:
+        leftover_us = min(leftover_us, exec_stage.wall_us)
+    if leftover_us:
+        anchor = collector.stage_span_start("execute") or t0
+        collector.record_stage("compile", anchor,
+                               anchor + leftover_us / 1e6,
+                               compile_us=leftover_us)
+    qs.wall_us = int((time.time() - t0) * 1e6)
+    qs.output_rows = res.row_count
+    qs.output_bytes = _result_bytes(res)
+    staging = qs.stages.get("staging")
+    peak = max(staging.bytes if staging else 0, peak_reserved_bytes)
+    qs.peak_memory_bytes = max(qs.peak_memory_bytes, peak)
+    if root is not None:
+        collector.operator("output", type(root).__name__,
+                           output_rows=res.row_count,
+                           output_bytes=qs.output_bytes,
+                           wall_us=qs.stage_us("fetch"))
+    res.query_stats = qs
+    collector.emit_spans(trace_id or collector.query_id)
 
 
 def _compile_any(root: N.PlanNode, mesh, default_join_capacity: int,

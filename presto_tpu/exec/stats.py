@@ -1,12 +1,39 @@
-"""RuntimeStats: named counters threaded through query execution.
+"""RuntimeStats + structured query telemetry (OperatorStats/StageStats/
+QueryStats).
 
 Reference surface: presto-common's RuntimeStats (named add/merge
-counters recorded anywhere and returned to clients in QueryStats) and
-the per-operator OperatorStats wall/cpu/rows plumbing
-(OperatorContext). Device-side per-operator timing inside one fused XLA
-program is not observable (that's the point of fusion); stats here are
-the host-visible boundaries: staging, compile, execute, rows/bytes --
-the numbers EXPLAIN ANALYZE and the UI surface.
+counters recorded anywhere and returned to clients in QueryStats), the
+per-operator OperatorStats wall/cpu/rows plumbing (OperatorContext ->
+TaskStats -> QueryStats merge chain), and the cross-worker merge the
+coordinator performs when assembling QueryStats from TaskStatus.
+Device-side per-operator timing inside one fused XLA program is not
+observable (that's the point of fusion); stats here are the
+host-visible boundaries: staging, XLA compile, device execute,
+exchange pack/unpack, result fetch, rows/bytes -- the numbers EXPLAIN
+ANALYZE, /v1/metrics, and the UI surface.
+
+Structure:
+
+  * ``RuntimeStats`` -- free-form named counters (unchanged API).
+  * ``OperatorStats`` -- per plan node, where host-visible (scans,
+    exchanges, the output root); fused interior nodes carry only
+    rows when derivable.
+  * ``StageStats`` -- one per host-visible stage boundary: ``staging``,
+    ``compile`` (with FLOPs / bytes-accessed from XLA's
+    ``cost_analysis``), ``execute``, ``exchange``, ``fetch``.
+  * ``QueryStats`` -- the merge root shipped worker -> coordinator in
+    TaskStatus and surfaced on the client protocol's ``stats`` field.
+
+The merge law (``QueryStats.merge``) is associative AND commutative:
+counters/sums add, ``max`` fields take max, stages/operators merge by
+key. That is what lets per-task stats from any number of workers fold
+in any order into one query-level document (the reference's
+QueryStateMachine::updateQueryInfo aggregation contract).
+
+Compile-time capture rides ``jax.monitoring``: a process-level listener
+forwards ``/jax/core/compile/*`` event durations into the ambient
+thread-local collector, so cache-hit dispatches naturally report zero
+compile micros without instrumenting jit call sites.
 """
 
 from __future__ import annotations
@@ -14,9 +41,11 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Dict
+from typing import Dict, List, Optional
 
-__all__ = ["RuntimeStats", "timed"]
+__all__ = ["RuntimeStats", "timed", "OperatorStats", "StageStats",
+           "QueryStats", "StatsCollector", "current_collector",
+           "collecting"]
 
 
 @dataclasses.dataclass
@@ -73,3 +102,362 @@ class timed:
     def __exit__(self, *exc):
         self.stats.add(self.name, time.time() - self.t0)
         return False
+
+
+# ---------------------------------------------------------------------------
+# structured telemetry: OperatorStats / StageStats / QueryStats
+# ---------------------------------------------------------------------------
+
+
+def _us(seconds: float) -> int:
+    return int(round(seconds * 1_000_000))
+
+
+@dataclasses.dataclass
+class OperatorStats:
+    """Per-plan-node stats at the host-visible granularity (the
+    OperatorStats analog; interior fused nodes carry rows only when the
+    planner can derive them)."""
+    node_id: str
+    node_type: str = ""
+    output_rows: int = 0
+    output_bytes: int = 0
+    wall_us: int = 0
+    task_count: int = 1
+
+    def merge(self, other: "OperatorStats") -> "OperatorStats":
+        assert self.node_id == other.node_id, \
+            f"merging operators {self.node_id} != {other.node_id}"
+        return OperatorStats(
+            node_id=self.node_id,
+            node_type=self.node_type or other.node_type,
+            output_rows=self.output_rows + other.output_rows,
+            output_bytes=self.output_bytes + other.output_bytes,
+            wall_us=self.wall_us + other.wall_us,
+            task_count=self.task_count + other.task_count)
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "OperatorStats":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in doc.items() if k in known})
+
+
+@dataclasses.dataclass
+class StageStats:
+    """One host-visible stage boundary: staging, compile, execute,
+    exchange pack/unpack, fetch. ``flops``/``bytes_accessed`` come from
+    XLA's ``cost_analysis`` of the jitted program (compile stage)."""
+    name: str
+    wall_us: int = 0
+    compile_us: int = 0
+    invocations: int = 0
+    rows: int = 0
+    bytes: int = 0
+    flops: float = 0.0
+    bytes_accessed: float = 0.0
+    max_wall_us: int = 0
+
+    def merge(self, other: "StageStats") -> "StageStats":
+        assert self.name == other.name, \
+            f"merging stages {self.name} != {other.name}"
+        return StageStats(
+            name=self.name,
+            wall_us=self.wall_us + other.wall_us,
+            compile_us=self.compile_us + other.compile_us,
+            invocations=self.invocations + other.invocations,
+            rows=self.rows + other.rows,
+            bytes=self.bytes + other.bytes,
+            flops=self.flops + other.flops,
+            bytes_accessed=self.bytes_accessed + other.bytes_accessed,
+            max_wall_us=max(self.max_wall_us, other.max_wall_us))
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "StageStats":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in doc.items() if k in known})
+
+
+@dataclasses.dataclass
+class QueryStats:
+    """The merge root: per-task stats fold into per-query stats through
+    ``merge()`` (associative + commutative), shipped worker ->
+    coordinator through the task status path and surfaced on the client
+    protocol's ``stats`` field."""
+    wall_us: int = 0
+    output_rows: int = 0
+    output_bytes: int = 0
+    peak_memory_bytes: int = 0
+    task_count: int = 1
+    stages: Dict[str, StageStats] = dataclasses.field(default_factory=dict)
+    operators: Dict[str, OperatorStats] = \
+        dataclasses.field(default_factory=dict)
+    # free-form summed counters (exchange collective counts noted at
+    # trace time, cache hits, ...); merged by addition
+    counters: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+    # -- convenience accessors (the EXPLAIN ANALYZE / CLI summary view) --
+
+    def stage_us(self, name: str) -> int:
+        s = self.stages.get(name)
+        return s.wall_us if s else 0
+
+    @property
+    def compile_us(self) -> int:
+        return sum(s.compile_us for s in self.stages.values())
+
+    @property
+    def execute_us(self) -> int:
+        return self.stage_us("execute")
+
+    def merge(self, other: "QueryStats") -> "QueryStats":
+        stages = dict(self.stages)
+        for k, s in other.stages.items():
+            stages[k] = stages[k].merge(s) if k in stages else s
+        operators = dict(self.operators)
+        for k, o in other.operators.items():
+            operators[k] = operators[k].merge(o) if k in operators else o
+        counters = dict(self.counters)
+        for k, v in other.counters.items():
+            counters[k] = counters.get(k, 0) + v
+        return QueryStats(
+            wall_us=self.wall_us + other.wall_us,
+            output_rows=self.output_rows + other.output_rows,
+            output_bytes=self.output_bytes + other.output_bytes,
+            peak_memory_bytes=max(self.peak_memory_bytes,
+                                  other.peak_memory_bytes),
+            task_count=self.task_count + other.task_count,
+            stages=stages, operators=operators, counters=counters)
+
+    def to_json(self) -> dict:
+        return {"wallUs": self.wall_us,
+                "outputRows": self.output_rows,
+                "outputBytes": self.output_bytes,
+                "peakMemoryBytes": self.peak_memory_bytes,
+                "taskCount": self.task_count,
+                "stages": {k: s.to_json() for k, s in self.stages.items()},
+                "operators": {k: o.to_json()
+                              for k, o in self.operators.items()},
+                "counters": dict(self.counters)}
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "QueryStats":
+        return cls(
+            wall_us=int(doc.get("wallUs", 0)),
+            output_rows=int(doc.get("outputRows", 0)),
+            output_bytes=int(doc.get("outputBytes", 0)),
+            peak_memory_bytes=int(doc.get("peakMemoryBytes", 0)),
+            task_count=int(doc.get("taskCount", 1)),
+            stages={k: StageStats.from_json(s)
+                    for k, s in doc.get("stages", {}).items()},
+            operators={k: OperatorStats.from_json(o)
+                       for k, o in doc.get("operators", {}).items()},
+            counters={k: int(v)
+                      for k, v in doc.get("counters", {}).items()})
+
+    def summary(self) -> str:
+        """One-paragraph human summary (the CLI --stats shape)."""
+        parts = [f"wall {self.wall_us / 1e6:.3f}s"]
+        for name in ("staging", "compile", "execute", "exchange", "fetch"):
+            us = self.stage_us(name)
+            if us or name in self.stages:
+                parts.append(f"{name} {us / 1e6:.3f}s")
+        cu = self.compile_us
+        if cu:
+            parts.append(f"(xla compile {cu / 1e6:.3f}s)")
+        parts.append(f"rows {self.output_rows}")
+        parts.append(f"bytes {self.output_bytes}")
+        if self.peak_memory_bytes:
+            parts.append(f"peak mem {self.peak_memory_bytes >> 20}MB")
+        if self.task_count > 1:
+            parts.append(f"tasks {self.task_count}")
+        return ", ".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# ambient collector: stage spans + jax compile-time capture
+# ---------------------------------------------------------------------------
+
+
+class StatsCollector:
+    """Per-query collection context. Stage timings are recorded as
+    (start, end) spans so the tracer can render one span per stage;
+    compile durations from jax.monitoring land on whichever stage is
+    open when XLA compiles (the execute dispatch), attributed to the
+    ``compile`` stage."""
+
+    def __init__(self, query_id: str = "query"):
+        self.query_id = query_id
+        self.stats = QueryStats()
+        self.spans: List[tuple] = []  # (stage, start_s, end_s, attrs)
+        self._compile_s = 0.0
+        self._lock = threading.Lock()
+
+    # -- stage spans ----------------------------------------------------
+
+    def stage(self, name: str, **fields):
+        return _StageTimer(self, name, fields)
+
+    def record_stage(self, name: str, start_s: float, end_s: float,
+                     **fields) -> None:
+        wall = _us(end_s - start_s)
+        with self._lock:
+            st = self.stats.stages.get(name)
+            if st is None:
+                st = self.stats.stages[name] = StageStats(name)
+            st.wall_us += wall
+            st.max_wall_us = max(st.max_wall_us, wall)
+            st.invocations += 1
+            for k, v in fields.items():
+                setattr(st, k, getattr(st, k) + v)
+            self.spans.append((name, start_s, end_s, dict(fields)))
+
+    def bump_stage(self, name: str, **fields) -> None:
+        """Add to a stage's summed fields without opening a timing span
+        (rows/bytes learned after the span closed)."""
+        with self._lock:
+            st = self.stats.stages.get(name)
+            if st is None:
+                st = self.stats.stages[name] = StageStats(name)
+            for k, v in fields.items():
+                setattr(st, k, getattr(st, k) + v)
+
+    def add_compile_seconds(self, seconds: float) -> None:
+        with self._lock:
+            self._compile_s += seconds
+
+    def take_compile_us(self) -> int:
+        """Drain accumulated jax compile time (monitoring events)."""
+        with self._lock:
+            us = _us(self._compile_s)
+            self._compile_s = 0.0
+            return us
+
+    def stage_span_start(self, name: str) -> Optional[float]:
+        """Start time of the most recent recorded span for `name`
+        (anchors the synthetic compile span inside the execute window
+        it actually happened in)."""
+        with self._lock:
+            for sname, start_s, _end, _attrs in reversed(self.spans):
+                if sname == name:
+                    return start_s
+        return None
+
+    def operator(self, node_id: str, node_type: str = "", **fields) -> None:
+        with self._lock:
+            op = self.stats.operators.get(node_id)
+            if op is None:
+                op = self.stats.operators[node_id] = \
+                    OperatorStats(node_id, node_type)
+            elif node_type and not op.node_type:
+                op.node_type = node_type
+            for k, v in fields.items():
+                setattr(op, k, getattr(op, k) + v)
+
+    def note(self, name: str, delta: int = 1) -> None:
+        """Bump a free-form summed counter (QueryStats.counters)."""
+        with self._lock:
+            self.stats.counters[name] = \
+                self.stats.counters.get(name, 0) + delta
+
+    def emit_spans(self, trace_id: Optional[str] = None) -> None:
+        """Ship collected stage spans to the process tracer (one span
+        per stage boundary; no-op without a tracer installed)."""
+        from ..server.tracing import get_tracer
+        t = get_tracer()
+        if t is None:
+            return
+        tid = trace_id or self.query_id
+        for name, start_s, end_s, attrs in self.spans:
+            try:
+                t.span(tid, f"stage.{name}", start_s, end_s,
+                       {k: v for k, v in attrs.items()})
+            except Exception:  # noqa: BLE001 - tracing never fails a query
+                pass
+
+
+class _StageTimer:
+    def __init__(self, collector: StatsCollector, name: str, fields: dict):
+        self.c = collector
+        self.name = name
+        self.fields = fields
+
+    def __enter__(self):
+        self.t0 = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        self.c.record_stage(self.name, self.t0, time.time(), **self.fields)
+        return False
+
+
+_tls = threading.local()
+
+
+def current_collector() -> Optional[StatsCollector]:
+    return getattr(_tls, "collector", None)
+
+
+class collecting:
+    """Install `collector` as the ambient collector for this thread."""
+
+    def __init__(self, collector: StatsCollector):
+        self.collector = collector
+
+    def __enter__(self):
+        self.prev = current_collector()
+        _tls.collector = self.collector
+        _ensure_compile_listener()
+        return self.collector
+
+    def __exit__(self, *exc):
+        _tls.collector = self.prev
+        return False
+
+
+_listener_installed = False
+_listener_lock = threading.Lock()
+
+# jax.monitoring duration events counted as XLA compilation work.
+# Deliberately NOT a "/jax/core/compile/" prefix match: the
+# jaxpr_trace_duration events fire NESTED inside MLIR lowering (inner
+# jits trace while the outer lowers), so summing every event
+# double-counts and compile_us can exceed the dispatch wall that
+# contains it. MLIR module conversion + backend compile are the two
+# sequential top-level phases; the runner additionally clamps the sum
+# to the enclosing execute wall as a backstop against nested-jit
+# lowering overlap.
+_COMPILE_EVENTS = frozenset([
+    "/jax/core/compile/jaxpr_to_mlir_module_duration",
+    "/jax/core/compile/backend_compile_duration",
+])
+
+
+def _ensure_compile_listener() -> None:
+    """Register the process-wide jax.monitoring listener exactly once.
+    Durations route to the calling thread's ambient collector (jit
+    compiles on the dispatching thread), so concurrent queries don't
+    cross-attribute."""
+    global _listener_installed
+    with _listener_lock:
+        if _listener_installed:
+            return
+        try:
+            import jax.monitoring as _mon
+
+            def _on_duration(name, seconds, **_kw):
+                if name not in _COMPILE_EVENTS:
+                    return
+                c = current_collector()
+                if c is not None:
+                    c.add_compile_seconds(float(seconds))
+
+            _mon.register_event_duration_secs_listener(_on_duration)
+        except Exception:  # noqa: BLE001 - telemetry must never break exec
+            pass
+        _listener_installed = True

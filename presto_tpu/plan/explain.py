@@ -1,8 +1,20 @@
-"""EXPLAIN: textual plan rendering.
+"""EXPLAIN: textual plan rendering + EXPLAIN ANALYZE annotation.
 
 Reference surface: the EXPLAIN/EXPLAIN (TYPE DISTRIBUTED) plan printer
 (sql/planner/planPrinter/ in presto-main-base) that renders the plan
-tree with per-node details and fragment boundaries.
+tree with per-node details and fragment boundaries, and PlanPrinter's
+textDistributedPlan-with-stats mode (ExplainAnalyzeOperator) that
+annotates each node with observed rows/bytes/wall.
+
+EXPLAIN ANALYZE here executes the SHAPED plan (exec.runner.prepare_plan
+-- the exact tree that lowers to XLA, exchanges included) and annotates
+from the collected QueryStats: host-visible nodes (scans, the output
+root) carry measured rows/bytes/wall micros; interior nodes are fused
+into one XLA program by design, so they carry the optimizer's row
+estimate and a `fused` marker instead. A stage table (staging / compile
+/ execute / exchange / fetch wall+compile micros, FLOPs and bytes from
+XLA cost_analysis) and the exchange-collective counters follow the
+tree.
 """
 
 from __future__ import annotations
@@ -12,7 +24,7 @@ from typing import List
 from . import nodes as N
 from .fragment import fragment_plan
 
-__all__ = ["explain", "explain_distributed"]
+__all__ = ["explain", "explain_analyze", "explain_distributed"]
 
 
 def _node_line(n: N.PlanNode) -> str:
@@ -70,19 +82,125 @@ def explain(root: N.PlanNode) -> str:
     return "\n".join(lines)
 
 
-def explain_analyze(root: N.PlanNode, sf: float = 0.01, **kwargs) -> str:
-    """EXPLAIN ANALYZE: execute the plan and annotate the tree with the
-    observed stats (ExplainAnalyzeOperator analog -- stats are the
-    host-visible boundaries; in-program per-operator timing is fused
-    away by XLA, by design)."""
-    from ..exec import run_query
+def _fmt_bytes(n: int) -> str:
+    if n >= 1 << 30:
+        return f"{n / (1 << 30):.2f}GB"
+    if n >= 1 << 20:
+        return f"{n / (1 << 20):.2f}MB"
+    if n >= 1 << 10:
+        return f"{n / (1 << 10):.1f}KB"
+    return f"{n}B"
 
-    res = run_query(root, sf=sf, **kwargs)
-    lines = [explain(root), "", "-- runtime --"]
-    for name, s in sorted(res.stats.items()):
-        lines.append(f"{name}: total={s['total']} count={s['count']} "
-                     f"max={s['max']}")
-    lines.append(f"output rows: {res.row_count}")
+
+def _collect_scan_leaves(root: N.PlanNode) -> List[N.PlanNode]:
+    """Scan leaves in the planner's staging order (exec.planner
+    _collect_scans: DFS, identity-deduped) so annotation keys scan[i]
+    line up with the runner's OperatorStats keys."""
+    from ..exec.planner import _collect_scans
+    out: List[N.PlanNode] = []
+    _collect_scans(root, out)
+    return out
+
+
+def _annotated_tree(root: N.PlanNode, qs, sf: float) -> str:
+    from .stats import estimate_rows
+
+    scan_index = {id(n): i for i, n in enumerate(_collect_scan_leaves(root))}
+    ops = qs.operators if qs is not None else {}
+    lines: List[str] = []
+    seen = set()
+
+    def annotate(n: N.PlanNode, is_root: bool) -> str:
+        from ..exec.runner import _scan_key
+        op = None
+        if id(n) in scan_index:
+            op = ops.get(_scan_key(scan_index[id(n)], n))
+        elif is_root:
+            op = ops.get("output")
+        if op is not None:
+            return (f"  [rows={op.output_rows} "
+                    f"bytes={_fmt_bytes(op.output_bytes)} "
+                    f"wall={op.wall_us}us"
+                    + (f" tasks={op.task_count}" if op.task_count > 1
+                       else "") + "]")
+        if isinstance(n, N.ExchangeNode) and n.scope == "REMOTE":
+            return "  [collective: fused into execute stage]"
+        est = None
+        try:
+            est = estimate_rows(n, sf)
+        except Exception:  # noqa: BLE001 - estimates are best-effort
+            est = None
+        if est is not None:
+            return f"  [est. {int(est)} rows, fused]"
+        return "  [fused]"
+
+    def walk(n: N.PlanNode, depth: int, is_root: bool):
+        line = "    " * depth + "- " + _node_line(n)
+        if id(n) in seen:
+            lines.append(line + "  [shared subtree]")
+            return
+        seen.add(id(n))
+        lines.append(line + annotate(n, is_root))
+        for s in n.sources:
+            walk(s, depth + 1, False)
+
+    walk(root, 0, True)
+    return "\n".join(lines)
+
+
+def explain_analyze(root: N.PlanNode, sf: float = 0.01, **kwargs) -> str:
+    """EXPLAIN ANALYZE: shape the plan exactly as execution will
+    (prepare_plan), run it, and annotate the executed tree with the
+    collected QueryStats (ExplainAnalyzeOperator analog -- per-node
+    rows/bytes/wall where host-visible, per-stage wall/compile micros
+    with XLA cost_analysis FLOPs, exchange-collective counts). Stats
+    inside one fused XLA program are not separable by design; fused
+    nodes carry optimizer row estimates instead."""
+    from ..exec.runner import prepare_plan, run_query
+
+    session = dict(kwargs.pop("session", None) or {})
+    # EXPLAIN ANALYZE always pays the one extra trace for FLOPs/bytes
+    session.setdefault("query_cost_analysis", True)
+    mesh = kwargs.get("mesh")
+    executed = prepare_plan(root, sf=sf, mesh=mesh, session=session)
+    res = run_query(executed, sf=sf, session=session, prepared=True,
+                    **kwargs)
+    qs = res.query_stats
+    lines = [_annotated_tree(executed, qs, sf)]
+    if qs is not None:
+        lines += ["", "-- stages --"]
+        for name in ("staging", "compile", "execute", "exchange", "fetch"):
+            st = qs.stages.get(name)
+            if st is None:
+                continue
+            extra = ""
+            if st.compile_us:
+                extra += f" compile={st.compile_us}us"
+            if st.flops:
+                extra += f" flops={st.flops:.3g}"
+            if st.bytes_accessed:
+                extra += f" bytesAccessed={st.bytes_accessed:.3g}"
+            if st.rows:
+                extra += f" rows={st.rows}"
+            if st.bytes:
+                extra += f" bytes={_fmt_bytes(st.bytes)}"
+            lines.append(f"{name}: wall={st.wall_us}us{extra}")
+        if qs.counters:
+            lines += ["", "-- collectives --"]
+            for k in sorted(qs.counters):
+                lines.append(f"{k}: {qs.counters[k]}")
+        lines.append("")
+        lines.append(f"output rows: {res.row_count}, "
+                     f"peak memory: {_fmt_bytes(qs.peak_memory_bytes)}, "
+                     f"wall: {qs.wall_us}us")
+    else:
+        lines += ["", f"output rows: {res.row_count}"]
+    # the flat named counters keep their historical tail section
+    if res.stats:
+        lines += ["", "-- runtime counters --"]
+        for name, s in sorted(res.stats.items()):
+            lines.append(f"{name}: total={s['total']} count={s['count']} "
+                         f"max={s['max']}")
     return "\n".join(lines)
 
 

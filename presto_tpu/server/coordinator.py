@@ -21,6 +21,7 @@ within a slice; this scheduler is the cross-worker/DCN tier above it.
 
 from __future__ import annotations
 
+import threading
 import uuid
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -61,11 +62,21 @@ class Coordinator:
         self.discovery_url = discovery_url
         self.prober = prober
         self.writer_min_rows_per_task = max(1, writer_min_rows_per_task)
+        # cross-worker merged QueryStats of THIS THREAD's most recent
+        # execute() (the coordinator's QueryStats assembly from
+        # TaskStatus docs); thread-local so concurrent queries on a
+        # shared Coordinator never read each other's document. None
+        # when no task shipped structured stats.
+        self._stats_tls = threading.local()
         # TTL-aware scheduling (ttl/ + presto-node-ttl-fetchers analog):
         # nodes announcing a ttlEpochSeconds within this horizon are
         # excluded from NEW task placement (long queries would die with
         # the node); 0 disables the filter
         self.ttl_horizon_s = ttl_horizon_s
+
+    @property
+    def last_query_stats(self):
+        return getattr(self._stats_tls, "stats", None)
 
     def workers(self) -> List[str]:
         if self._urls:
@@ -200,10 +211,14 @@ class Coordinator:
         # attempts of fragments that never completed) -- appended at
         # submit time so error paths leak nothing
         submitted: List[Tuple[str, str]] = []
+        self._stats_tls.stats = None
         try:
-            return self._execute_fragments(
+            result = self._execute_fragments(
                 workers, fragments, produced, submitted, qid, sf, timeout,
                 policy)
+            self._stats_tls.stats = self._merge_task_stats(produced,
+                                                           timeout)
+            return result
         finally:
             # release worker-side state: every scheduled task (and its
             # buffered pages) is destroyed once the query is done, the
@@ -215,6 +230,47 @@ class Coordinator:
                     WorkerClient(url, min(timeout, 5.0)).abort(tid)
                 except Exception:  # noqa: BLE001 - best-effort cleanup
                     pass
+
+    def _merge_task_stats(self, produced, timeout: float):
+        """Fold every produced task's shipped QueryStats into one
+        query-level document (order-independent by the merge law, so
+        pull order doesn't matter). Best-effort telemetry with a
+        bounded cost: pulls fan out on a small thread pool grouped per
+        worker (one connection's latency is paid once per worker, not
+        once per task), a short per-pull timeout, and a worker that
+        fails ONE pull is skipped for its remaining tasks -- stats
+        assembly must never fail or stall a finished query."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ..exec.stats import QueryStats
+        by_url: Dict[str, List[str]] = {}
+        for tasks in produced.values():
+            for url, tid in tasks:
+                by_url.setdefault(url, []).append(tid)
+
+        def pull_worker(url: str, tids: List[str]):
+            docs = []
+            client = WorkerClient(url, min(timeout, 2.0))  # keep-alive
+            for tid in tids:
+                try:
+                    info = client.task_info(tid)
+                except Exception:  # noqa: BLE001 - best-effort telemetry
+                    return docs  # worker gone: skip its remaining tasks
+                doc = (info.get("stats") or {}).get("queryStats")
+                if doc:
+                    docs.append(doc)
+            return docs
+
+        merged = None
+        if not by_url:
+            return merged
+        with ThreadPoolExecutor(max_workers=min(8, len(by_url))) as pool:
+            for docs in pool.map(lambda kv: pull_worker(*kv),
+                                 by_url.items()):
+                for doc in docs:
+                    qs = QueryStats.from_json(doc)
+                    merged = qs if merged is None else merged.merge(qs)
+        return merged
 
     def _execute_fragments(self, workers, fragments, produced, submitted,
                            qid, sf, timeout, policy="phased"):
@@ -368,7 +424,11 @@ class Coordinator:
             bodies = {}
             pending = []
             for w in range(ntasks):
-                body = {"plan": N.to_json(frag_plan), "sf": sf}
+                # one trace id for the whole distributed query: every
+                # task's spans (task.run + its stage spans) group under
+                # it in the tracer
+                body = {"plan": N.to_json(frag_plan), "sf": sf,
+                        "traceId": f"query.{qid}"}
                 if out_part:
                     body["outputPartitions"] = out_part
                 if scans:

@@ -1,0 +1,141 @@
+"""Prometheus text-format metrics: the one emitter both tiers share.
+
+Reference surface: the native worker's PrometheusStatsReporter
+(presto_cpp/main/PrometheusStatsReporter.cpp) and PrestoServer's
+registerHttpEndpoints wiring a scrapeable endpoint; on the Java side
+the JMX connector exports the same counters. Both the coordinator
+(statement server) and the worker serve ``GET /v1/metrics`` rendering
+through this module, so scrape format and naming conventions cannot
+drift between tiers.
+
+Format is the Prometheus exposition text format v0.0.4: per family a
+``# HELP`` line, a ``# TYPE`` line (counter | gauge), then one sample
+per label set. Labels are rendered sorted for deterministic scrapes
+(scripts/scrape_metrics.py diffs two scrapes textually-parsed).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple, Union
+
+__all__ = ["MetricFamily", "render_prometheus", "parse_prometheus",
+           "plan_cache_families", "uptime_family", "CONTENT_TYPE"]
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_LabelSample = Tuple[Dict[str, str], Union[int, float]]
+
+
+class MetricFamily:
+    """One metric family: name, type, help, and samples (optionally
+    labelled)."""
+
+    def __init__(self, name: str, mtype: str, help_: str):
+        assert mtype in ("counter", "gauge"), mtype
+        self.name = name
+        self.mtype = mtype
+        self.help = help_
+        self.samples: List[_LabelSample] = []
+
+    def add(self, value: Union[int, float],
+            labels: Optional[Dict[str, str]] = None) -> "MetricFamily":
+        self.samples.append((dict(labels or {}), value))
+        return self
+
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.mtype}"]
+        for labels, value in self.samples:
+            if labels:
+                lab = ",".join(
+                    f'{k}="{_escape(v)}"'
+                    for k, v in sorted(labels.items()))
+                lines.append(f"{self.name}{{{lab}}} {_num(value)}")
+            else:
+                lines.append(f"{self.name} {_num(value)}")
+        return lines
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"').replace(
+        "\n", r"\n")
+
+
+def _num(v: Union[int, float]) -> str:
+    if isinstance(v, bool):
+        return str(int(v))
+    if isinstance(v, int):
+        return str(v)
+    return repr(round(float(v), 6))
+
+
+def plan_cache_families() -> List[MetricFamily]:
+    """The compiled-plan cache families both tiers export -- ONE
+    builder so the names cannot drift between coordinator and worker."""
+    from ..exec.plan_cache import cache_stats
+    st = cache_stats()
+    return [
+        MetricFamily("presto_tpu_plan_cache_entries", "gauge",
+                     "compiled-plan cache entries").add(st["entries"]),
+        MetricFamily("presto_tpu_plan_cache_hits_total", "counter",
+                     "compiled-plan cache hits").add(st["hits"]),
+        MetricFamily("presto_tpu_plan_cache_misses_total", "counter",
+                     "compiled-plan cache misses").add(st["misses"]),
+    ]
+
+
+def uptime_family(started_at: float, role: str) -> MetricFamily:
+    import time
+    return MetricFamily("presto_tpu_uptime_seconds", "gauge",
+                        f"{role} uptime").add(
+                            round(time.time() - started_at, 1))
+
+
+def render_prometheus(families: List[MetricFamily]) -> bytes:
+    lines: List[str] = []
+    for f in families:
+        lines.extend(f.render())
+    return ("\n".join(lines) + "\n").encode()
+
+
+def parse_prometheus(text: str) -> Dict[str, Dict[str, float]]:
+    """Exposition text -> {family: {sample_key: value}} where
+    sample_key is '' for unlabelled samples or the rendered label set.
+    Used by scripts/scrape_metrics.py and the test suite; raises
+    ValueError on lines that are neither comments nor samples (the
+    'valid Prometheus text' check)."""
+    out: Dict[str, Dict[str, float]] = {}
+    typed: Dict[str, str] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] == "TYPE":
+                mtype = parts[3] if len(parts) > 3 else "untyped"
+                if mtype not in ("counter", "gauge", "histogram",
+                                 "summary", "untyped"):
+                    raise ValueError(f"bad TYPE line: {raw!r}")
+                typed[parts[2]] = mtype
+            continue
+        name, _, rest = line.partition("{")
+        if rest:  # labelled sample
+            labels, _, valpart = rest.rpartition("}")
+            value = valpart.strip()
+            key = "{" + labels + "}"
+        else:
+            fields = line.split()
+            if len(fields) not in (2, 3):  # optional timestamp
+                raise ValueError(f"bad sample line: {raw!r}")
+            name, value = fields[0], fields[1]
+            key = ""
+        fam = name
+        try:
+            fval = float(value)
+        except ValueError as e:
+            raise ValueError(f"bad value in line: {raw!r}") from e
+        if fam not in typed:
+            raise ValueError(f"sample {fam!r} before its # TYPE line")
+        out.setdefault(fam, {})[key] = fval
+    return out

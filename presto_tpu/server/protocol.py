@@ -870,7 +870,18 @@ def task_info_json(task_id: str, state: str, worker_uri: str,
                    node_id: str, last_heartbeat_ms: int,
                    rows: int = 0, version: int = 1,
                    memory_bytes: int = 0,
-                   failures: Optional[List[str]] = None) -> dict:
+                   failures: Optional[List[str]] = None,
+                   query_stats: Optional[dict] = None) -> dict:
+    """`query_stats`: a QueryStats.to_json() document from the task's
+    execution; its wall/peak-memory/input-rows map onto the spec's
+    TaskStats field names so a reference coordinator reads real numbers
+    (elapsed nanos, memory reservation, raw input positions)."""
+    qs = query_stats or {}
+    staging = (qs.get("stages") or {}).get("staging") or {}
+    # a staged 0 is a real measurement (empty split), not "missing"
+    input_rows = int(staging["rows"]) if "rows" in staging else rows
+    elapsed_ns = int(qs.get("wallUs", 0)) * 1000
+    mem = int(qs.get("peakMemoryBytes", memory_bytes) or memory_bytes)
     done = state in ("FINISHED", "FAILED", "ABORTED", "CANCELED")
     return {
         "taskId": task_id,
@@ -891,7 +902,7 @@ def task_info_json(task_id: str, state: str, worker_uri: str,
         "noMoreSplits": [],
         "stats": {
             "createTimeInMillis": last_heartbeat_ms,
-            "elapsedTimeInNanos": 0,
+            "elapsedTimeInNanos": elapsed_ns,
             "queuedTimeInNanos": 0,
             "totalDrivers": 1,
             "queuedDrivers": 0,
@@ -903,11 +914,11 @@ def task_info_json(task_id: str, state: str, worker_uri: str,
             "runningSplits": 0 if done else 1,
             "completedSplits": 1 if done else 0,
             "cumulativeUserMemory": 0.0,
-            "userMemoryReservationInBytes": memory_bytes,
+            "userMemoryReservationInBytes": mem,
             "revocableMemoryReservationInBytes": 0,
             "systemMemoryReservationInBytes": 0,
-            "rawInputPositions": rows,
-            "processedInputPositions": rows,
+            "rawInputPositions": input_rows,
+            "processedInputPositions": input_rows,
             "outputPositions": rows,
         },
         "needsPlan": False,

@@ -25,6 +25,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
@@ -34,7 +35,7 @@ import numpy as np
 from .. import types as T
 from ..transaction import TransactionManager
 from .dispatcher import Dispatcher, QueryRejected
-from .query_state import QueryState, QueryStateMachine
+from .query_state import QueryState, QueryStateMachine, TERMINAL_STATES
 
 __all__ = ["StatementServer", "render_value"]
 
@@ -100,6 +101,8 @@ class _Query:
         self.rows: List[list] = []
         self.update_type: Optional[str] = None
         self.update_count: Optional[int] = None
+        # structured execution stats (QueryStats) once the engine ran
+        self.result_stats = None
         # response-header mutations for the client to apply
         self.set_session: Dict[str, str] = {}
         self.started_txn: Optional[str] = None
@@ -136,6 +139,14 @@ class StatementServer:
         self._executor = executor or self._default_executor
         self._queries: Dict[str, _Query] = {}
         self._qlock = threading.Lock()
+        self._started_at = time.time()
+        # lifetime roll-ups for /v1/metrics (terminal queries only;
+        # accounted exactly once per query in _run's finally)
+        self._metrics_lock = threading.Lock()
+        self._queries_by_state: Dict[str, int] = {}
+        self._totals = {"rows": 0, "bytes": 0, "wall_us": 0,
+                        "compile_us": 0, "execute_us": 0,
+                        "peak_memory_bytes": 0}
         handler = _make_handler(self)
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         scheme = "http"
@@ -192,6 +203,10 @@ class StatementServer:
             return QueryResult([], [], [pre.ack], 0)
         kwargs["session"] = dict(session_values)
         kwargs["session"].setdefault("user", user)
+        # the engine's stage spans must land under THIS query's trace
+        # (same id _emit_trace uses for the state spans -> one trace
+        # per query, and no shared default-"query" trace growing forever)
+        kwargs["query_id"] = query_id
         return run_sql(pre.text, sf=sf, **kwargs)
 
     def _user_of(self, query_id: str) -> str:
@@ -250,6 +265,25 @@ class StatementServer:
         finally:
             if q.machine.is_done():
                 self._emit_trace(q)
+                self._account_query(q)
+
+    def _account_query(self, q: _Query) -> None:
+        """Roll a terminal query into the /v1/metrics lifetime totals
+        (exactly once: _run's finally is the single terminal seam)."""
+        qs = q.result_stats
+        with self._metrics_lock:
+            st = q.machine.state
+            self._queries_by_state[st] = \
+                self._queries_by_state.get(st, 0) + 1
+            self._totals["rows"] += len(q.rows)
+            self._totals["wall_us"] += q.machine.elapsed_ms() * 1000
+            if qs is not None:
+                self._totals["bytes"] += qs.output_bytes
+                self._totals["compile_us"] += qs.compile_us
+                self._totals["execute_us"] += qs.stage_us("execute")
+                self._totals["peak_memory_bytes"] = max(
+                    self._totals["peak_memory_bytes"],
+                    qs.peak_memory_bytes)
 
     def _run_inner(self, q: _Query):
         m = _SESSION_STMT.match(q.text)
@@ -314,6 +348,7 @@ class StatementServer:
             if res.types and res.types[0].base == "bigint" and \
                     res.row_count == 1:
                 q.update_count = int(res.columns[0][0])
+        q.result_stats = getattr(res, "query_stats", None)
         q.columns = [{"name": n, "type": str(t)}
                      for n, t in zip(res.names, res.types)]
         rendered = []
@@ -412,7 +447,7 @@ class StatementServer:
 
     def _base_doc(self, q: _Query, state: str) -> dict:
         queued = state == QueryState.QUEUED
-        return {
+        doc = {
             "id": q.id,
             "infoUri": f"{self.url}/v1/query/{q.id}",
             "stats": {
@@ -426,6 +461,17 @@ class StatementServer:
                 "peakMemoryBytes": 0,
             },
         }
+        qs = q.result_stats
+        if qs is not None:
+            # the engine's structured stats populate the client
+            # protocol's stats field (StatementStats analog), with the
+            # full stage/operator document alongside for rich clients
+            doc["stats"]["processedBytes"] = qs.output_bytes
+            doc["stats"]["peakMemoryBytes"] = qs.peak_memory_bytes
+            doc["stats"]["compileTimeMicros"] = qs.compile_us
+            doc["stats"]["executeTimeMicros"] = qs.stage_us("execute")
+            doc["stats"]["queryStats"] = qs.to_json()
+        return doc
 
     def cancel(self, q: _Query) -> None:
         q.machine.to_canceled()
@@ -440,12 +486,61 @@ class StatementServer:
                 "sessionProperties": q.session_values,
                 "timings": q.machine.timings(),
                 "elapsedTimeMillis": q.machine.elapsed_ms(),
-                "errorInfo": q.machine.error}
+                "errorInfo": q.machine.error,
+                "queryStats": q.result_stats.to_json()
+                if q.result_stats is not None else None}
 
     def queries_doc(self) -> List[dict]:
         with self._qlock:
             ids = list(self._queries)
         return [self.admin_doc(i) for i in ids]
+
+    def metric_families(self):
+        """Coordinator-side /v1/metrics families (shared emitter:
+        metrics.py; the worker serves its own set through the same
+        module so format/naming cannot drift)."""
+        from .metrics import MetricFamily as MF
+        with self._qlock:
+            live = [q.machine.state for q in self._queries.values()]
+        queued = sum(1 for s in live if s == QueryState.QUEUED)
+        running = sum(1 for s in live
+                      if s not in (QueryState.QUEUED, *TERMINAL_STATES))
+        with self._metrics_lock:
+            by_state = dict(self._queries_by_state)
+            totals = dict(self._totals)
+        fam_q = MF("presto_tpu_queries_total", "counter",
+                   "terminal queries by final state")
+        for st in sorted(by_state):
+            fam_q.add(by_state[st], {"state": st})
+        if not by_state:
+            fam_q.add(0, {"state": "FINISHED"})
+        fams = [
+            fam_q,
+            MF("presto_tpu_queries_queued", "gauge",
+               "queries currently QUEUED").add(queued),
+            MF("presto_tpu_queries_running", "gauge",
+               "queries currently executing").add(running),
+            MF("presto_tpu_query_rows_total", "counter",
+               "result rows returned to clients").add(totals["rows"]),
+            MF("presto_tpu_query_bytes_total", "counter",
+               "result bytes produced").add(totals["bytes"]),
+            MF("presto_tpu_query_wall_seconds_total", "counter",
+               "wall time of terminal queries").add(
+                   totals["wall_us"] / 1e6),
+            MF("presto_tpu_query_compile_seconds_total", "counter",
+               "XLA compile time across queries").add(
+                   totals["compile_us"] / 1e6),
+            MF("presto_tpu_query_execute_seconds_total", "counter",
+               "device execute time across queries").add(
+                   totals["execute_us"] / 1e6),
+            MF("presto_tpu_query_peak_memory_bytes", "gauge",
+               "largest per-query peak memory seen").add(
+                   totals["peak_memory_bytes"]),
+        ]
+        from .metrics import plan_cache_families, uptime_family
+        fams.append(uptime_family(self._started_at, "coordinator"))
+        fams.extend(plan_cache_families())
+        return fams
 
 
 def _render_ui(server: "StatementServer", parts: List[str]) -> str:
@@ -581,6 +676,15 @@ def _make_handler(server: StatementServer):
                 self._send({"nodeVersion": {"version": "presto-tpu-0.4"},
                             "coordinator": True, "starting": False,
                             "uptime": "0m"})
+                return
+            if parts == ["v1", "metrics"]:
+                from .metrics import CONTENT_TYPE, render_prometheus
+                body = render_prometheus(server.metric_families())
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
                 return
             if parts[:1] == ["ui"]:
                 self._send_html(_render_ui(server, parts[1:]))

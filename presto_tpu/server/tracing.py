@@ -16,6 +16,8 @@ exporter (file, collector client) can consume them.
 
 from __future__ import annotations
 
+import collections
+import json
 import threading
 import time
 from typing import Dict, List, Optional
@@ -25,10 +27,17 @@ __all__ = ["RecordingTracer", "set_tracer", "get_tracer",
 
 
 class RecordingTracer:
-    """SimpleTracer analog: keeps spans per trace id in memory."""
+    """SimpleTracer analog: keeps spans per trace id in memory.
+
+    Eviction is least-recently-UPDATED: a trace still receiving spans
+    (a long distributed query whose tasks trickle in) is refreshed on
+    every span, so the trace dropped at capacity is deterministically
+    the one idle longest -- not whichever dict order happened to yield
+    (a trace created early but still active used to be evictable)."""
 
     def __init__(self, max_traces: int = 256):
-        self.traces: Dict[str, List[dict]] = {}
+        self.traces: "collections.OrderedDict[str, List[dict]]" = \
+            collections.OrderedDict()
         self.max_traces = max_traces
         self._lock = threading.Lock()
 
@@ -39,14 +48,31 @@ class RecordingTracer:
                "endUs": int(end_s * 1_000_000),
                "attributes": dict(attributes or {})}
         with self._lock:
-            if trace_id not in self.traces and \
-                    len(self.traces) >= self.max_traces:
-                self.traces.pop(next(iter(self.traces)))
+            if trace_id in self.traces:
+                self.traces.move_to_end(trace_id)
+            elif len(self.traces) >= self.max_traces:
+                self.traces.popitem(last=False)  # oldest-updated out
             self.traces.setdefault(trace_id, []).append(doc)
 
     def spans(self, trace_id: str) -> List[dict]:
         with self._lock:
             return list(self.traces.get(trace_id, []))
+
+    def export_jsonl(self, path: str) -> int:
+        """Write every retained span as one JSON line ({traceId, name,
+        startUs, endUs, attributes}) for offline inspection (OTel
+        file-exporter shape); returns the span count written."""
+        with self._lock:
+            snapshot = [(tid, list(spans))
+                        for tid, spans in self.traces.items()]
+        n = 0
+        with open(path, "w") as f:
+            for tid, spans in snapshot:
+                for doc in spans:
+                    f.write(json.dumps({"traceId": tid, **doc},
+                                       default=str) + "\n")
+                    n += 1
+        return n
 
 
 _tracer: Optional[RecordingTracer] = None

@@ -232,17 +232,20 @@ class TaskManager:
         self.fragment_cache = FragmentResultCache()
         from ..connectors.system import register_task_manager
         register_task_manager(self)  # system.tasks introspection
-        # lifetime counters for /v1/info/metrics (Prometheus)
+        # lifetime counters for /v1/metrics (Prometheus)
         self.counters: Dict[str, int] = {"tasks_created": 0,
                                          "tasks_finished": 0,
                                          "tasks_failed": 0,
                                          "tasks_aborted": 0,
-                                         "rows_produced": 0}
+                                         "rows_produced": 0,
+                                         "exchange_bytes": 0,
+                                         "compile_us": 0,
+                                         "execute_us": 0}
         self._counters_lock = threading.Lock()
 
     def _count(self, name: str, delta: int = 1):
         with self._counters_lock:
-            self.counters[name] += delta
+            self.counters[name] = self.counters.get(name, 0) + delta
 
     def _prune_locked(self):
         """Drop terminal tasks (and their buffered pages) older than the
@@ -314,10 +317,15 @@ class TaskManager:
                            body.get("scanRanges", {}).items()}
             remote_sources = {}
             pad = (self.mesh.devices.size if self.mesh is not None else 1) * 8
+            exchange_unpack_s = 0.0
+            exchange_in_rows = 0
             for node_id, spec in body.get("remoteSources", {}).items():
-                # pull upstream pages peer-to-peer (PrestoExchangeSource)
+                # pull upstream pages peer-to-peer (PrestoExchangeSource);
+                # the pull + page decode is the host-visible exchange
+                # *unpack* boundary -- timed into the task's QueryStats
                 from ..types import parse_type
                 from .http_exchange import fetch_remote_batch
+                t_ex0 = time.time()
                 remote_sources[node_id] = fetch_remote_batch(
                     spec["sources"], spec["taskIds"],
                     [parse_type(t) for t in spec["types"]],
@@ -326,6 +334,9 @@ class TaskManager:
                     ack=bool(spec.get("ack", True)),
                     merge_keys=spec.get("mergeKeys"),
                     timeout=float(spec.get("timeoutS", 60.0)))
+                exchange_unpack_s += time.time() - t_ex0
+                exchange_in_rows += int(
+                    np.asarray(remote_sources[node_id].active).sum())
             from ..exec.runner import run_query
             # fragment result cache: identical leaf fragments (same
             # canonical plan, splits, data versions) replay their
@@ -340,6 +351,19 @@ class TaskManager:
             if ckey is not None:
                 hit = self.fragment_cache.get(ckey)
                 if hit is not None:
+                    # a replay produced rows without touching the chip:
+                    # re-shipping the ORIGINAL run's compile/execute
+                    # micros would attribute device time to a query
+                    # that did none -- keep rows/bytes, mark the replay
+                    replay_stats = {k: v for k, v in hit["stats"].items()
+                                    if k != "queryStats"}
+                    orig_qs = hit["stats"].get("queryStats") or {}
+                    replay_stats["queryStats"] = {
+                        "wallUs": 0,
+                        "outputRows": int(orig_qs.get("outputRows", 0)),
+                        "outputBytes": int(orig_qs.get("outputBytes", 0)),
+                        "taskCount": 1,
+                        "counters": {"fragment_cache_replay": 1}}
                     with task.lock:
                         if task.state == "ABORTED":
                             return
@@ -347,7 +371,7 @@ class TaskManager:
                             task.buffers.setdefault(
                                 pid, task._new_buffer()).extend(pages)
                         task.no_more_pages = True
-                        task.stats = {**hit["stats"],
+                        task.stats = {**replay_stats,
                                       "fragmentCacheHit": 1}
                         task.state = "FINISHED"
                         task.finished_at = time.time()
@@ -361,11 +385,14 @@ class TaskManager:
                     return
             t0 = time.time()
             with self._exec_slots:
+                # trace id: the coordinator propagates one per query so
+                # every task's stage spans group into ONE trace
                 res = run_query(plan, sf=sf, mesh=self.mesh,
                                 scan_ranges=scan_ranges,
                                 remote_sources=remote_sources,
                                 memory_pool=self.memory_pool,
-                                query_id=task.task_id)
+                                query_id=task.task_id,
+                                trace_id=body.get("traceId"))
             wall = time.time() - t0
             with task.lock:
                 if task.state == "ABORTED":
@@ -374,6 +401,7 @@ class TaskManager:
             out_part = body.get("outputPartitions")
             total_bytes = 0
             built: Dict[int, List[bytes]] = {}
+            t_pack0 = time.time()
             if out_part:
                 # PartitionedOutputBuffer analog: rows hash to one page
                 # per destination partition (same hash as the engine's
@@ -408,6 +436,23 @@ class TaskManager:
                         return
                     task.buffers[0].append(page)
                 built = {0: [page]}
+            pack_s = time.time() - t_pack0
+            # exchange boundaries are host-visible on the HTTP tier:
+            # fold the pack (serialize) and unpack (remote pull) sides
+            # into the task's structured stats before they ship to the
+            # coordinator via the task status path
+            qs = getattr(res, "query_stats", None)
+            if qs is not None:
+                from ..exec.stats import StageStats
+                ex = StageStats("exchange",
+                                wall_us=int((pack_s + exchange_unpack_s)
+                                            * 1e6),
+                                invocations=1 + len(remote_sources),
+                                rows=exchange_in_rows,
+                                bytes=total_bytes)
+                qs.stages["exchange"] = ex.merge(qs.stages["exchange"]) \
+                    if "exchange" in qs.stages else ex
+                qs.output_bytes = max(qs.output_bytes, total_bytes)
             with task.lock:
                 if task.state == "ABORTED":
                     return
@@ -415,11 +460,26 @@ class TaskManager:
                 task.stats = {"wallSeconds": round(wall, 4),
                               "outputRows": res.row_count,
                               "outputBytes": total_bytes}
+                if qs is not None:
+                    task.stats["queryStats"] = qs.to_json()
                 task.state = "FINISHED"
                 task.finished_at = time.time()
             task._accounted = True
             self._count("tasks_finished")
             self._count("rows_produced", res.row_count)
+            self._count("exchange_bytes", total_bytes)
+            if qs is not None:
+                self._count("compile_us", qs.compile_us)
+                self._count("execute_us", qs.stage_us("execute"))
+            # one span per worker task; under the coordinator-propagated
+            # trace id the whole distributed query renders as ONE trace
+            from .tracing import get_tracer
+            tr = get_tracer()
+            if tr is not None:
+                tr.span(body.get("traceId") or task.task_id,
+                        f"task.{task.task_id}", t0, time.time(),
+                        {"rows": res.row_count, "bytes": total_bytes,
+                         "wallSeconds": round(wall, 4)})
             if ckey is not None:
                 self.fragment_cache.put(ckey, built, res.row_count,
                                         task.stats)
@@ -534,6 +594,46 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _metric_families(self):
+        """Worker-side metric families (shared emitter: metrics.py)."""
+        from .metrics import (MetricFamily as MF, plan_cache_families,
+                              uptime_family)
+        m = self.manager
+        fams = [
+            MF("presto_tpu_active_tasks", "gauge",
+               "tasks in PLANNED/RUNNING state").add(m.active_task_count()),
+            MF("presto_tpu_memory_reserved_bytes", "gauge",
+               "admission pool reserved").add(m.memory_pool.reserved_bytes),
+            MF("presto_tpu_memory_capacity_bytes", "gauge",
+               "admission pool capacity").add(m.memory_pool.capacity),
+            MF("presto_tpu_memory_revoked_bytes", "gauge",
+               "bytes freed by spill revocation").add(
+                   m.memory_pool.revoked_bytes),
+            MF("presto_tpu_memory_peak_bytes", "gauge",
+               "admission pool high-water mark").add(
+                   m.memory_pool.peak_bytes),
+            uptime_family(self.started_at, "worker"),
+            MF("presto_tpu_fragment_cache_hits_total", "counter",
+               "fragment result cache hits").add(m.fragment_cache.hits),
+            MF("presto_tpu_fragment_cache_misses_total", "counter",
+               "fragment result cache misses").add(m.fragment_cache.misses),
+        ]
+        with m._counters_lock:
+            counters = dict(m.counters)
+        for k in sorted(counters):
+            if k in ("compile_us", "execute_us"):
+                # export in seconds, matching the coordinator's
+                # *_seconds_total families (one unit across tiers)
+                fams.append(MF(
+                    f"presto_tpu_{k[:-3]}_seconds_total", "counter",
+                    f"lifetime task {k[:-3]} time").add(
+                        counters[k] / 1e6))
+                continue
+            fams.append(MF(f"presto_tpu_{k}_total", "counter",
+                           f"lifetime {k}").add(counters[k]))
+        fams.extend(plan_cache_families())
+        return fams
+
     def do_GET(self):  # noqa: N802
         if not self._authorized():
             return
@@ -544,40 +644,14 @@ class _Handler(BaseHTTPRequestHandler):
                 "environment": "tpu", "coordinator": False,
                 "uptime": round(time.time() - self.started_at, 1),
                 "state": "ACTIVE"})
-        if parts == ["v1", "info", "metrics"]:
+        if parts in (["v1", "metrics"], ["v1", "info", "metrics"]):
             # Prometheus text format (PrometheusStatsReporter.cpp /
-            # PrestoServer.cpp:562 registerHttpEndpoints analog)
-            m = self.manager
-            lines = []
-
-            def emit(name, value, help_, mtype):
-                lines.append(f"# HELP {name} {help_}")
-                lines.append(f"# TYPE {name} {mtype}")
-                lines.append(f"{name} {value}")
-
-            def gauge(name, value, help_):
-                emit(name, value, help_, "gauge")
-
-            def counter(name, value, help_):
-                emit(name, value, help_, "counter")
-
-            gauge("presto_tpu_active_tasks", m.active_task_count(),
-                  "tasks in PLANNED/RUNNING state")
-            gauge("presto_tpu_memory_reserved_bytes",
-                  m.memory_pool.reserved_bytes, "admission pool reserved")
-            gauge("presto_tpu_memory_capacity_bytes",
-                  m.memory_pool.capacity, "admission pool capacity")
-            gauge("presto_tpu_memory_revoked_bytes",
-                  m.memory_pool.revoked_bytes,
-                  "bytes freed by spill revocation")
-            gauge("presto_tpu_uptime_seconds",
-                  round(time.time() - self.started_at, 1), "worker uptime")
-            for k, v in m.counters.items():
-                counter(f"presto_tpu_{k}_total", v, f"lifetime {k}")
-            body = ("\n".join(lines) + "\n").encode()
+            # PrestoServer.cpp:562 registerHttpEndpoints analog);
+            # /v1/info/metrics is the legacy alias
+            from .metrics import CONTENT_TYPE, render_prometheus
+            body = render_prometheus(self._metric_families())
             self.send_response(200)
-            self.send_header("Content-Type",
-                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Type", CONTENT_TYPE)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -598,12 +672,13 @@ class _Handler(BaseHTTPRequestHandler):
             if "format=spec" in query:
                 # spec-shaped TaskInfo (main/tests/data/TaskInfo.json)
                 from .protocol import task_info_json
+                tstats = task.stats if isinstance(
+                    getattr(task, "stats", None), dict) else {}
                 return self._send_json(task_info_json(
                     tid, task.state, f"http://{self.node_id}",
                     self.node_id, int(time.time() * 1000),
-                    rows=task.stats.get("outputRows", 0)
-                    if isinstance(getattr(task, "stats", None), dict)
-                    else 0))
+                    rows=tstats.get("outputRows", 0),
+                    query_stats=tstats.get("queryStats")))
             return self._send_json(task.info())
         if len(parts) == 4 and parts[:2] == ["v1", "task"] and \
                 parts[3] == "status":

@@ -176,6 +176,11 @@ SESSION_PROPERTIES = (
     .add("spill_file_threshold_bytes", "int", 256 << 20,
          "host-DRAM bytes a spill staging area may hold before "
          "flushing a run file to spill_path")
+    .add("query_cost_analysis", "bool", False,
+         "annotate QueryStats' compile stage with XLA cost_analysis "
+         "FLOPs / bytes-accessed (costs one extra program trace per "
+         "distinct plan+shape, memoized; EXPLAIN ANALYZE, the CLI "
+         "--stats flag and bench.py's telemetry smoke turn it on)")
 )
 
 

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Scrape /v1/metrics and diff counters between two scrapes.
+
+The ops loop the metrics endpoint exists for, in script form: point it
+at a coordinator or worker, and it reports counter DELTAS over the
+interval (queries finished, rows/bytes produced, compile vs execute
+seconds, cache hits) plus current gauge values -- the numbers a
+before/after perf comparison cites.
+
+  python scripts/scrape_metrics.py http://127.0.0.1:8080 [--interval 5]
+  python scripts/scrape_metrics.py URL --once          # one scrape, dump
+  python scripts/scrape_metrics.py URL --count 3       # N diff windows
+
+Exit codes: 0 on success, 2 when the endpoint is unreachable.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+# repo root importable regardless of invocation directory
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+from presto_tpu.server.metrics import parse_prometheus  # noqa: E402
+
+
+def scrape(url: str, timeout: float = 10.0):
+    with urllib.request.urlopen(f"{url.rstrip('/')}/v1/metrics",
+                                timeout=timeout) as r:
+        return parse_prometheus(r.read().decode())
+
+
+def diff(before: dict, after: dict) -> dict:
+    """Counter deltas + gauge currents between two parsed scrapes."""
+    out = {"counters": {}, "gauges": {}}
+    for fam, samples in after.items():
+        is_counter = fam.endswith("_total")
+        for key, val in samples.items():
+            label = fam + key
+            if is_counter:
+                prev = before.get(fam, {}).get(key, 0.0)
+                delta = val - prev
+                if delta:
+                    out["counters"][label] = round(delta, 6)
+            else:
+                out["gauges"][label] = round(val, 6)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="scrape_metrics")
+    ap.add_argument("url", help="coordinator or worker base URL")
+    ap.add_argument("--interval", type=float, default=5.0,
+                    help="seconds between the two scrapes (default 5)")
+    ap.add_argument("--count", type=int, default=1,
+                    help="number of diff windows to report")
+    ap.add_argument("--once", action="store_true",
+                    help="single scrape: dump all families, no diff")
+    args = ap.parse_args(argv)
+
+    try:
+        before = scrape(args.url)
+    except Exception as e:  # noqa: BLE001 - endpoint down is the signal
+        print(f"error: cannot scrape {args.url}/v1/metrics: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    if args.once:
+        print(json.dumps(before, indent=1, sort_keys=True))
+        return 0
+    for _ in range(args.count):
+        time.sleep(args.interval)
+        try:
+            after = scrape(args.url)
+        except Exception as e:  # noqa: BLE001
+            print(f"error: scrape lost: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 2
+        print(json.dumps({"intervalSeconds": args.interval,
+                          **diff(before, after)}, sort_keys=True))
+        before = after
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,245 @@
+"""End-to-end query telemetry: OperatorStats/StageStats/QueryStats
+merge law, cross-worker shipping, Prometheus /v1/metrics on both tiers,
+annotated EXPLAIN ANALYZE on a distributed (mesh) TPC-H query, and one
+tracer span per stage.
+
+Reference behavior: OperatorStats -> TaskStats -> QueryStats
+aggregation (the coordinator folds TaskStatus stats from every worker
+into one QueryStats), PrometheusStatsReporter's scrape endpoint, and
+PlanPrinter's EXPLAIN ANALYZE annotation."""
+
+import json
+import re
+import urllib.request
+
+import pytest
+
+from presto_tpu.exec.stats import OperatorStats, QueryStats, StageStats
+from presto_tpu.server.metrics import parse_prometheus
+from presto_tpu.server.tracing import RecordingTracer, set_tracer
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    yield
+    set_tracer(None)
+
+
+def _task_stats(rows, bytes_, wall_us, compile_us=0, peak=0):
+    return QueryStats(
+        wall_us=wall_us, output_rows=rows, output_bytes=bytes_,
+        peak_memory_bytes=peak, task_count=1,
+        stages={"execute": StageStats("execute", wall_us=wall_us,
+                                      invocations=1,
+                                      max_wall_us=wall_us),
+                "compile": StageStats("compile", wall_us=compile_us,
+                                      compile_us=compile_us)},
+        operators={"scan[0]": OperatorStats("scan[0]", "TableScan[t]",
+                                            output_rows=rows,
+                                            output_bytes=bytes_)},
+        counters={"exchanges": 1})
+
+
+def test_merge_is_associative_and_commutative_across_workers():
+    a = _task_stats(10, 100, 1000, compile_us=500, peak=64)
+    b = _task_stats(20, 200, 3000, peak=256)
+    c = _task_stats(30, 300, 2000, compile_us=100, peak=128)
+    left = a.merge(b).merge(c)
+    right = a.merge(b.merge(c))
+    assert left.to_json() == right.to_json()  # associative
+    assert a.merge(b).to_json()["outputRows"] == \
+        b.merge(a).to_json()["outputRows"]  # commutative
+    # the merge law itself: sums, maxes, per-key folds
+    assert left.output_rows == 60
+    assert left.task_count == 3
+    assert left.peak_memory_bytes == 256      # max, not sum
+    assert left.stages["execute"].wall_us == 6000
+    assert left.stages["execute"].max_wall_us == 3000
+    assert left.stages["compile"].compile_us == 600
+    assert left.operators["scan[0]"].output_rows == 60
+    assert left.operators["scan[0]"].task_count == 3
+    assert left.counters["exchanges"] == 3
+    # json round trip preserves the document
+    rt = QueryStats.from_json(json.loads(json.dumps(left.to_json())))
+    assert rt.to_json() == left.to_json()
+
+
+def test_run_query_collects_stage_and_operator_stats():
+    from presto_tpu.sql import sql
+    res = sql("SELECT regionkey, count(*) AS c FROM nation "
+              "GROUP BY regionkey", sf=0.01)
+    qs = res.query_stats
+    assert qs is not None
+    assert qs.output_rows == res.row_count == 5
+    assert {"staging", "execute", "fetch"} <= set(qs.stages)
+    assert qs.stages["staging"].rows == 25          # nation staged rows
+    assert qs.stages["staging"].bytes > 0
+    assert qs.wall_us >= qs.stages["execute"].wall_us
+    scan = qs.operators["scan[0]:TableScan[tpch.nation]"]
+    assert scan.output_rows == 25
+    assert "nation" in scan.node_type
+    assert qs.operators["output"].output_rows == 5
+    assert qs.peak_memory_bytes > 0
+    # summary is the CLI --stats line; it must mention the basics
+    s = qs.summary()
+    assert "rows 5" in s and "execute" in s
+
+
+def test_cost_analysis_flops_when_enabled():
+    from presto_tpu.sql import sql
+    res = sql("SELECT sum(quantity) FROM lineitem", sf=0.001,
+              session={"query_cost_analysis": True})
+    qs = res.query_stats
+    assert qs.stages["compile"].flops > 0
+    assert qs.stages["compile"].bytes_accessed > 0
+
+
+def test_worker_ships_query_stats_and_coordinator_merges():
+    from presto_tpu.plan.distribute import add_exchanges
+    from presto_tpu.server import Coordinator, TpuWorkerServer
+    from presto_tpu.sql import plan_sql
+
+    tracer = RecordingTracer()
+    set_tracer(tracer)
+    ws = [TpuWorkerServer(sf=0.01).start() for _ in range(2)]
+    try:
+        coord = Coordinator([f"http://127.0.0.1:{w.port}" for w in ws])
+        dist = add_exchanges(plan_sql(
+            "SELECT custkey, count(*) AS c FROM orders GROUP BY custkey",
+            max_groups=1 << 14))
+        cols, _ = coord.execute(dist, sf=0.01)
+        qs = coord.last_query_stats
+        assert qs is not None
+        assert qs.task_count >= 3          # leaf tasks + consumer tasks
+        # per-node rows merged across workers: both leaf tasks staged
+        # disjoint splits of orders; the merged scan covers every row
+        from presto_tpu.connectors import tpch
+        total = tpch.table_row_count("orders", 0.01)
+        leaf_rows = sum(o.output_rows for k, o in qs.operators.items()
+                        if k.startswith("scan[") and "orders" in o.node_type)
+        assert leaf_rows == total
+        assert "exchange" in qs.stages     # pack/unpack boundary timed
+        assert qs.stages["exchange"].bytes > 0
+        assert qs.peak_memory_bytes > 0
+        # the whole distributed query renders as ONE trace: every
+        # worker task's span AND its per-stage spans land under the
+        # coordinator's propagated trace id
+        qtraces = [tid for tid in tracer.traces if tid.startswith("query.")]
+        assert len(qtraces) == 1
+        names = [s["name"] for s in tracer.spans(qtraces[0])]
+        assert sum(1 for n in names if n.startswith("task.")) >= 3
+        assert sum(1 for n in names if n == "stage.execute") >= 3
+        assert all(n.startswith(("task.", "stage.")) for n in names)
+    finally:
+        for w in ws:
+            w.stop()
+
+
+def test_worker_metrics_endpoint_prometheus_valid():
+    from presto_tpu.server import TpuWorkerServer
+    w = TpuWorkerServer(sf=0.01).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{w.port}/v1/metrics") as r:
+            assert "text/plain" in r.headers["Content-Type"]
+            text = r.read().decode()
+        fams = parse_prometheus(text)   # raises on invalid lines
+        assert len(fams) >= 10
+        assert "presto_tpu_active_tasks" in fams
+        assert "presto_tpu_tasks_created_total" in fams
+        assert "presto_tpu_memory_peak_bytes" in fams
+    finally:
+        w.stop()
+
+
+def test_coordinator_metrics_endpoint_ten_families():
+    from presto_tpu.client import execute
+    from presto_tpu.server.statement import StatementServer
+
+    with StatementServer(sf=0.01) as srv:
+        r = execute(srv.url, "SELECT count(*) FROM region")
+        assert r.data == [[5]]
+        # client protocol stats populated from the engine's QueryStats
+        assert r.stats["processedBytes"] > 0
+        assert r.stats["peakMemoryBytes"] > 0
+        assert "queryStats" in r.stats
+        with urllib.request.urlopen(f"{srv.url}/v1/metrics") as resp:
+            assert "text/plain" in resp.headers["Content-Type"]
+            text = resp.read().decode()
+        fams = parse_prometheus(text)   # valid Prometheus text format
+        assert len(fams) >= 10
+        assert any(k.startswith('{state="FINISHED"}')
+                   for k in fams["presto_tpu_queries_total"])
+        assert fams["presto_tpu_query_rows_total"][""] >= 1
+        # every family carries HELP/TYPE lines (exposition format)
+        assert text.count("# HELP") == len(fams)
+        assert text.count("# TYPE") == len(fams)
+
+
+def test_explain_analyze_mesh_tpch_annotations(mesh8):
+    from presto_tpu.plan import explain_analyze
+    from presto_tpu.sql import plan_sql
+
+    out = explain_analyze(plan_sql(
+        "SELECT returnflag, linestatus, sum(quantity) AS q, count(*) AS c "
+        "FROM lineitem WHERE shipdate <= date '1998-09-02' "
+        "GROUP BY returnflag, linestatus"), sf=0.01, mesh=mesh8)
+    # per-node rows on host-visible nodes
+    scan_line = next(l for l in out.splitlines() if "TableScan" in l)
+    m = re.search(r"rows=(\d+)", scan_line)
+    assert m and int(m.group(1)) > 0
+    out_line = next(l for l in out.splitlines() if l.startswith("- Output"))
+    assert "rows=4" in out_line
+    # per-stage wall/compile micros + cost analysis
+    assert re.search(r"staging: wall=\d+us", out)
+    assert re.search(r"execute: wall=\d+us", out)
+    assert re.search(r"compile: wall=\d+us compile=\d+us", out)
+    assert "flops=" in out
+    # the SPMD program's collectives were counted at trace time
+    assert "exchange.hash: " in out
+    assert "peak memory:" in out
+
+
+def test_tracer_one_span_per_stage_and_jsonl_export(tmp_path):
+    from presto_tpu.sql import sql
+
+    tracer = RecordingTracer()
+    set_tracer(tracer)
+    sql("SELECT count(*) FROM region", sf=0.01, query_id="trace-me")
+    spans = tracer.spans("trace-me")
+    names = [s["name"] for s in spans]
+    for stage in ("stage.staging", "stage.execute", "stage.fetch"):
+        assert names.count(stage) == 1, names
+    for s in spans:
+        assert s["endUs"] >= s["startUs"]
+    path = tmp_path / "spans.jsonl"
+    n = tracer.export_jsonl(str(path))
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert n == len(lines) >= len(spans)
+    assert any(d["traceId"] == "trace-me" for d in lines)
+
+
+def test_tracer_evicts_least_recently_updated():
+    t = RecordingTracer(max_traces=2)
+    t.span("a", "x", 0.0, 1.0)
+    t.span("b", "x", 0.0, 1.0)
+    t.span("a", "y", 1.0, 2.0)   # refresh a: b is now oldest-updated
+    t.span("c", "x", 0.0, 1.0)   # evicts b, not a
+    assert set(t.traces) == {"a", "c"}
+    assert len(t.spans("a")) == 2
+
+
+def test_system_tables_carry_new_columns():
+    from presto_tpu.client import execute
+    from presto_tpu.server.statement import StatementServer
+    from presto_tpu.sql import sql
+
+    with StatementServer(sf=0.01) as srv:
+        execute(srv.url, "SELECT count(*) FROM nation")
+        res = sql("SELECT query_id, cumulative_bytes, peak_memory_bytes, "
+                  "compile_us FROM system.queries", sf=0.01)
+        rows = res.rows()
+        assert rows, "no queries visible in system.queries"
+        done = [r for r in rows if r[1] is not None and int(r[1]) > 0]
+        assert done, f"no query reported cumulative bytes: {rows}"
+        assert any(int(r[2]) > 0 for r in done)   # peak memory

@@ -62,8 +62,15 @@ def test_tracer_records_query_state_spans():
     assert traces, "no spans recorded"
     names = {s["name"] for s in traces[-1]}
     assert "query.running" in names
+    # the query's trace now ALSO carries the engine's per-stage spans
+    # (stage.staging/execute/...) under the same trace id
+    assert any(n.startswith("stage.") for n in names)
     for s in traces[-1]:
         assert s["endUs"] >= s["startUs"]
+    state_spans = [s for s in traces[-1]
+                   if s["name"].startswith("query.")]
+    assert state_spans
+    for s in state_spans:
         assert s["attributes"]["user"]
 
 
