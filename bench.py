@@ -387,6 +387,14 @@ def main():
     sf = float(os.environ.get("BENCH_SF", "1"))
     iters = int(os.environ.get("BENCH_ITERS", "5"))
     query = os.environ.get("BENCH_QUERY", "q1")  # q1 | q6
+    # the headline runs the documented scatter-free small-table form by
+    # default (the round-2 win; with the narrow bf16 fused pool it is
+    # one MXU pass for all accumulators). BENCH_SMALLG=auto restores
+    # per-backend auto-selection, =scatter forces the scatter form.
+    requested_form = os.environ.get("BENCH_SMALLG", "einsum")
+    if requested_form != "auto":
+        os.environ.setdefault("PRESTO_TPU_SMALLG", requested_form)
+    narrow_on = os.environ.get("PRESTO_TPU_NARROW", "1") != "0"
 
     import jax
 
@@ -419,21 +427,36 @@ def main():
     t_plan = time.time()
     from presto_tpu.exec.planner import compile_plan
     from presto_tpu.plan.stats import refine_capacities
+    from presto_tpu.plan.widths import annotate_widths
     from presto_tpu.sql.planner import plan_sql
     plan = refine_capacities(plan_sql(TPCH_Q1), sf)
+    if narrow_on:
+        # width inference (plan/widths.py): stage range-proven columns
+        # at narrowed lanes -- the staged-MB delta below is the A/B
+        # (PRESTO_TPU_NARROW=0 reverts)
+        plan = annotate_widths(plan, sf)
     cp = compile_plan(plan)
     plan_s = time.time() - t_plan
     assert len(cp.scan_nodes) == 1
     scan_cols = cp.scan_nodes[0].columns
+    sql_phys = cp.scan_nodes[0].physical_dtypes
     sql_host = tpch.generate_columns("lineitem", sf, scan_cols)
     dt_sql, sql_staged_bytes = _stage_and_time(sql_host, scan_cols, capacity,
-                                               cp.fn, iters, wrap_seq=True)
+                                               cp.fn, iters, wrap_seq=True,
+                                               physical_dtypes=sql_phys)
     sql_fallback = _TIMING_FALLBACK
 
     # --- hand-built plan (HandTpchQuery1 analog), for engine-overhead
-    # comparison
+    # comparison -- staged with the same width inference
+    hand_phys = None
+    if narrow_on:
+        from presto_tpu.plan.widths import infer_table_widths
+        hand_phys = infer_table_widths(
+            "tpch", "lineitem", Q1_COLUMNS,
+            [tpch.column_type("lineitem", c) for c in Q1_COLUMNS], sf)
     dt_hand, staged_bytes = _stage_and_time(host_cols, Q1_COLUMNS, capacity,
-                                            q1_local(), iters)
+                                            q1_local(), iters,
+                                            physical_dtypes=hand_phys)
 
     # fast telemetry smoke: one run_sql at sf=0.01 through the full
     # engine so every BENCH artifact carries the compile/execute split
@@ -467,17 +490,27 @@ def main():
             "platform": platform,
             "scoring": scoring,
             "iters": iters,
-            # which small-G group-by form compiled (backend-dependent;
-            # PERF.md round 5 -- makes kernel A/Bs visible in artifacts)
-            "smallg_form": "einsum-MXU" if _smallg_scatter_free()
-                           else "scatter",
+            # which small-G group-by form ACTUALLY COMPILED for the
+            # timed runs (recorded at trace time by ops/aggregation;
+            # makes kernel A/Bs visible in artifacts) + what was asked
+            "smallg_form": _executed_smallg_form(),
+            "smallg_form_requested": requested_form,
+            # narrow-width execution A/B (PRESTO_TPU_NARROW): staged_mb
+            # above reflects the narrowed lanes when on
+            "narrow_width_execution": narrow_on,
         },
     }
     print(json.dumps(result))
 
 
+def _executed_smallg_form():
+    from presto_tpu.ops.aggregation import last_smallg_form
+    return last_smallg_form() or (
+        "einsum-MXU" if _smallg_scatter_free() else "scatter")
+
+
 def _stage_and_time(host_cols, columns, capacity, pipeline_fn, iters,
-                    wrap_seq=False):
+                    wrap_seq=False, physical_dtypes=None):
     """The one staging/warmup/timing harness both benchmarks share.
 
     Timing is done by *differencing* two windows -- ``iters`` and
@@ -502,7 +535,8 @@ def _stage_and_time(host_cols, columns, capacity, pipeline_fn, iters,
     types = [tpch.column_type("lineitem", c) for c in columns]
     batch = jax.block_until_ready(jax.device_put(
         batch_from_numpy(types, [host_cols[c] for c in columns],
-                         capacity=capacity)))
+                         capacity=capacity,
+                         physical_dtypes=physical_dtypes)))
     fn = (lambda b: pipeline_fn([b])) if wrap_seq else pipeline_fn
     run = jax.jit(fn)
     warm = jax.device_get(run(batch))  # warm-up / compile + round trip

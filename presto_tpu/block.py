@@ -288,11 +288,18 @@ def _pad(arr: np.ndarray, capacity: int, fill=0) -> np.ndarray:
 
 
 def from_numpy(ty: T.Type, values: np.ndarray, nulls: Optional[np.ndarray] = None,
-               capacity: Optional[int] = None) -> Block:
+               capacity: Optional[int] = None,
+               physical_dtype=None) -> Block:
     """Stage a host column to a device Block. For string types `values`
     must be an object/str numpy array or a (N, L) uint8 matrix; for
     array types, an object array of Python lists (None elements = null,
-    None rows = null array)."""
+    None rows = null array).
+
+    `physical_dtype` (narrow-width execution, plan/widths.py) overrides
+    the staged lane dtype for fixed-width columns whose value range the
+    planner proved fits a narrower lane -- host->device transfer and
+    HBM residency shrink accordingly; the logical `ty` is unchanged and
+    compute sites widen before arithmetic."""
     if ty.base == "array":
         ety = ty.element_type
         rows = list(values)
@@ -414,17 +421,23 @@ def from_numpy(ty: T.Type, values: np.ndarray, nulls: Optional[np.ndarray] = Non
         return Int128Column(jnp.asarray(_pad(hi, capacity)),
                             jnp.asarray(_pad(lo, capacity)),
                             jnp.asarray(nulls), ty)
-    values = _pad(np.asarray(values, dtype=ty.to_dtype()), capacity)
+    dt = np.dtype(physical_dtype) if physical_dtype is not None \
+        else ty.to_dtype()
+    values = _pad(np.asarray(values, dtype=dt), capacity)
     return Column(jnp.asarray(values), jnp.asarray(nulls), ty)
 
 
 def batch_from_numpy(types: Sequence[T.Type], arrays: Sequence[np.ndarray],
                      nulls: Optional[Sequence[Optional[np.ndarray]]] = None,
-                     capacity: Optional[int] = None) -> Batch:
+                     capacity: Optional[int] = None,
+                     physical_dtypes=None) -> Batch:
     n = arrays[0].shape[0]
     capacity = capacity or n
     nulls = nulls or [None] * len(arrays)
-    cols = tuple(from_numpy(t, a, m, capacity) for t, a, m in zip(types, arrays, nulls))
+    physical_dtypes = physical_dtypes or [None] * len(arrays)
+    cols = tuple(from_numpy(t, a, m, capacity, physical_dtype=p)
+                 for t, a, m, p in zip(types, arrays, nulls,
+                                       physical_dtypes))
     active = np.zeros(capacity, dtype=bool)
     active[:n] = True
     return Batch(cols, jnp.asarray(active))

@@ -167,6 +167,27 @@ def generate_columns(table: str, sf: float, columns: Sequence[str],
         return out
 
 
+def column_range(table: str, column: str, sf: float = 0.0):
+    """Exact (lo, hi) over the stored NON-NULL values (narrow-width
+    execution stats). None for empty/all-null/non-integer columns --
+    width inference then refuses to narrow. Exact at plan time; the
+    staging-time guard (plan/widths.checked_physical_dtypes) covers
+    any write racing plan and execution."""
+    with _lock:
+        t = _tables.get(table)
+        if t is None:
+            raise KeyError(f"no memory table {table!r}")
+        i = t.columns.index(column)
+        vals = t.values[i]
+        nulls = t.nulls[i]
+    if vals.dtype == object or vals.dtype.kind not in "iu":
+        return None
+    live = vals[~nulls]
+    if not len(live):
+        return None
+    return (int(live.min()), int(live.max()))
+
+
 def generate_nulls(table: str, columns: Sequence[str], start: int = 0,
                    count: Optional[int] = None) -> Dict[str, np.ndarray]:
     with _lock:

@@ -1,9 +1,10 @@
 from .generator import (TPCH_SCHEMA, table_row_count, generate_columns,
                         generate_batch, column_type)
-from .stats import column_distinct_count
+from .stats import column_distinct_count, column_range
 
 __all__ = ["TPCH_SCHEMA", "table_row_count", "generate_columns",
-           "generate_batch", "column_type", "column_distinct_count"]
+           "generate_batch", "column_type", "column_distinct_count",
+           "column_range"]
 
 SCHEMA = TPCH_SCHEMA  # uniform connector-registry surface
 __all__ = __all__ + ["SCHEMA"]

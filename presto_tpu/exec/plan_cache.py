@@ -100,6 +100,20 @@ def _mesh_key(mesh) -> Optional[tuple]:
             tuple(d.id for d in mesh.devices.flat))
 
 
+def _kernel_mode() -> str:
+    """Trace-time env knobs that change the lowered program WITHOUT
+    changing the plan fingerprint (kernel form A/Bs: small-G scatter vs
+    einsum, Pallas on/off, narrow bf16 forms, large-G sort vs hash).
+    Part of the cache key so an A/B toggle never serves a stale
+    executable compiled under the other mode."""
+    import os
+    return "|".join((os.environ.get("PRESTO_TPU_SMALLG", "auto"),
+                     os.environ.get("PRESTO_TPU_SMALLG_PALLAS", "1"),
+                     os.environ.get("PRESTO_TPU_NARROW", "1"),
+                     os.environ.get("PRESTO_TPU_BF16", "auto"),
+                     os.environ.get("PRESTO_TPU_GROUPBY", "sort")))
+
+
 def cached_compile(root: N.PlanNode, mesh, default_join_capacity: int,
                    exchange_slot_scale: int = 1
                    ) -> Tuple[CompiledPlan, object, threading.Lock]:
@@ -107,7 +121,7 @@ def cached_compile(root: N.PlanNode, mesh, default_join_capacity: int,
     compiling at most once per (structure, mesh, capacities, scale)."""
     global _hits, _misses
     key = (plan_fingerprint(root), _mesh_key(mesh), default_join_capacity,
-           exchange_slot_scale)
+           exchange_slot_scale, _kernel_mode())
     with _lock:
         entry = _cache.get(key)
         if entry is not None:

@@ -52,6 +52,33 @@ class QueryResult:
         return out
 
 
+def stage_scan_split(conn, node: "N.TableScanNode", sf: float, start: int,
+                     count: int, capacity: int) -> Batch:
+    """Stage one scan split honoring the node's narrow-width annotation
+    (plan/widths.py): host columns generate, the staging-time range
+    guard re-proves each narrowed lane against the actual values, and
+    the batch stages at the narrowed physical dtypes -- the shared
+    staging path of the runner and the streaming executor. Falls back
+    to the connector's own generate_batch when the node carries no
+    width annotation (or the connector can't produce host columns)."""
+    phys = getattr(node, "physical_dtypes", None)
+    if not phys or not any(phys) or not hasattr(conn, "generate_columns"):
+        return conn.generate_batch(node.table, sf, node.columns,
+                                   start=start, count=count,
+                                   capacity=capacity)
+    from ..plan.widths import checked_physical_dtypes
+    data = conn.generate_columns(node.table, sf, node.columns, start, count)
+    arrays = [data[c] for c in node.columns]
+    nulls = None
+    if hasattr(conn, "generate_nulls"):
+        nmap = conn.generate_nulls(node.table, node.columns, start, count)
+        nulls = [nmap[c] for c in node.columns]
+    checked = checked_physical_dtypes(phys, node.column_types, arrays,
+                                      nulls=nulls)
+    return batch_from_numpy(node.column_types, arrays, nulls=nulls,
+                            capacity=capacity, physical_dtypes=checked)
+
+
 def _scan_batch(node: N.PlanNode, sf: float, capacity_hint: Optional[int],
                 pad_multiple: int,
                 scan_range: Optional[Tuple[int, int]] = None,
@@ -109,7 +136,12 @@ def _scan_batch(node: N.PlanNode, sf: float, capacity_hint: Optional[int],
             nmap = conn.generate_nulls(node.table, node.columns,
                                        start, count)
             nulls = [nmap[c][keep] for c in node.columns]
-        return batch_from_numpy(tys, arrays, capacity=cap, nulls=nulls)
+        phys = getattr(node, "physical_dtypes", None)
+        if phys and any(phys):
+            from ..plan.widths import checked_physical_dtypes
+            phys = checked_physical_dtypes(phys, tys, arrays, nulls=nulls)
+        return batch_from_numpy(tys, arrays, capacity=cap, nulls=nulls,
+                                physical_dtypes=phys or None)
     cap = capacity_hint or max(-(-count // pad_multiple) * pad_multiple,
                                pad_multiple)
     if node.pushdown is not None and scan_range is None \
@@ -119,8 +151,7 @@ def _scan_batch(node: N.PlanNode, sf: float, capacity_hint: Optional[int],
         return conn.generate_batch(node.table, sf, node.columns,
                                    start=start, count=count, capacity=cap,
                                    predicate=tuple(node.pushdown))
-    return conn.generate_batch(node.table, sf, node.columns, start=start,
-                               count=count, capacity=cap)
+    return stage_scan_split(conn, node, sf, start, count, cap)
 
 
 def prepare_plan(root: N.PlanNode, sf: float = 0.01, mesh=None,
@@ -173,6 +204,15 @@ def prepare_plan(root: N.PlanNode, sf: float = 0.01, mesh=None,
     if _session_on("stats_capacity_refinement"):
         from ..plan.stats import refine_capacities
         root = refine_capacities(root, sf)
+    # narrow-width execution (plan/widths.py): annotate every scan whose
+    # column ranges the connector proves with the narrowest safe
+    # physical lanes; staging honors them (halved host->HBM bytes for
+    # narrowed columns), compute sites widen before arithmetic.
+    # PRESTO_TPU_NARROW=0 / session narrow_width_execution=false = wide A/B
+    from ..plan.widths import narrow_enabled
+    if narrow_enabled(session):
+        from ..plan.widths import annotate_widths
+        root = annotate_widths(root, sf)
     if mesh is not None:
         # make the plan SPMD-correct: single-node operators get the
         # exchanges they need (AddExchanges; idempotent for plans that
@@ -367,7 +407,9 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
             memory_pool.query_peak_bytes(query_id, pop=True)
         raise
     from .memory import batch_bytes
+    from ..plan.widths import batch_narrowed_bytes_saved, note_narrowed
     staged_rows = staged_bytes = 0
+    narrowed_cols = narrowed_saved = 0
     for si, (s, b) in enumerate(zip(plan.scan_nodes, batches)):
         rows = int(np.asarray(b.active).sum())
         nbytes = batch_bytes(b)
@@ -376,7 +418,19 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
         stats.add("scan_rows", rows)
         collector.operator(_scan_key(si, s), output_rows=rows,
                            output_bytes=nbytes)
+        if getattr(s, "physical_dtypes", None):
+            nc, nb = batch_narrowed_bytes_saved(b)
+            narrowed_cols += nc
+            narrowed_saved += nb
     collector.bump_stage("staging", rows=staged_rows, bytes=staged_bytes)
+    if narrowed_saved:
+        # staged bytes saved vs logical lanes: the QueryStats counter the
+        # acceptance criteria name, plus the process-lifetime /v1/metrics
+        # totals (server/metrics.narrowing_families)
+        stats.add("narrowed_bytes_saved", narrowed_saved)
+        collector.note("narrowed_bytes_saved", narrowed_saved)
+        collector.note("narrowed_columns", narrowed_cols)
+        note_narrowed(narrowed_cols, narrowed_saved)
     try:
         with stats.timed("execute_s"), collecting(collector), \
                 collector.stage("execute"):
@@ -733,9 +787,18 @@ def _batch_to_result(out: Batch, root: N.PlanNode) -> QueryResult:
     cols, nulls, types = [], [], []
     for c in range(out.num_columns):
         v, n = to_numpy(out.column(c))
-        cols.append(v[idx])
+        ty = out.column(c).type
+        v = v[idx]
+        if v.dtype != object and v.dtype.kind in "iu" and ty.is_fixed_width:
+            # narrow-width lanes widen back to the logical dtype at the
+            # result boundary (device->host already moved narrow bytes;
+            # clients/serde see the declared type's width)
+            ld = np.dtype(ty.to_dtype())
+            if ld.kind in "iu" and v.dtype != ld:
+                v = v.astype(ld)
+        cols.append(v)
         nulls.append(n[idx])
-        types.append(out.column(c).type)
+        types.append(ty)
     names = root.names if isinstance(root, N.OutputNode) else \
         [f"col{i}" for i in range(out.num_columns)]
     return QueryResult(cols, nulls, names, len(idx), types=types)

@@ -308,11 +308,12 @@ def run_spilled_join(join: N.JoinNode, sf: float, split_rows: int,
         tys = node.output_types()
         buckets = [_HostRows(tys) for _ in range(n_buckets)]
         host_buckets.append(buckets)
+        from .runner import stage_scan_split
         for start in range(0, max(total, 1), split_rows):
             count = min(split_rows, max(total - start, 0))
-            batch = conn.generate_batch(scan.table, sf, scan.columns,
-                                        start=start, count=count,
-                                        capacity=split_rows)
+            # shared narrow-width staging (honors physical_dtypes)
+            batch = stage_scan_split(conn, scan, sf, start, count,
+                                     split_rows)
             out, _ovf = pipeline.fn((batch,))
             bid = _bucket_of(out, tuple(keys))
             for b in range(n_buckets):

@@ -103,11 +103,14 @@ def _make_agg_executor(root: N.PlanNode, sf: float, split_rows: int,
         overflow = jnp.zeros((), dtype=bool)  # accumulates on device: no
         # per-split host sync, so split generation overlaps device compute
         bucket_arr = jnp.asarray(bucket, dtype=jnp.int32)
+        from .runner import stage_scan_split
         for start in starts:
             count = min(split_rows, max(total - start, 0))
-            batch = conn.generate_batch(scan.table, sf, scan.columns,
-                                        start=start, count=count,
-                                        capacity=split_rows)
+            # shared narrow-width staging path: each split honors the
+            # scan's physical_dtypes annotation (plan/widths.py), so the
+            # per-split program reads narrowed lanes end to end
+            batch = stage_scan_split(conn, scan, sf, start, count,
+                                     split_rows)
             part, ovf1 = split_step(batch, bucket_arr)
             overflow = overflow | ovf1
             if running is None:
@@ -156,11 +159,10 @@ def run_spilled_sort(root: N.PlanNode, sf: float, split_rows: int):
     total = conn.table_row_count(scan.table, sf)
     runs: List[List[np.ndarray]] = []   # per run: one array per column
     run_nulls: List[List[np.ndarray]] = []
+    from .runner import stage_scan_split
     for start in range(0, max(total, 1), split_rows):
         count = min(split_rows, max(total - start, 0))
-        batch = conn.generate_batch(scan.table, sf, scan.columns,
-                                    start=start, count=count,
-                                    capacity=split_rows)
+        batch = stage_scan_split(conn, scan, sf, start, count, split_rows)
         sorted_b, _ = split_step(batch)
         act = np.asarray(sorted_b.active)
         sel = np.nonzero(act)[0]

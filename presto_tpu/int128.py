@@ -20,7 +20,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["add128", "shl128_const", "from_int64", "neg128",
-           "combine_limb_totals_128", "limbs13_of_128", "div128_by_count",
+           "combine_limb_totals_128", "limbs_of_i64", "limbs13_of_128",
+           "div128_by_count",
            "mulu64_wide", "mul_i64_i64_128", "mul128_by_u64",
            "rescale128_up", "cmp128",
            "int128_to_python", "python_to_int128", "INT64_MIN", "INT64_MAX"]
@@ -79,18 +80,29 @@ def combine_limb_totals_128(totals, limb_bits: int = 13):
     return hi, lo
 
 
-def limbs13_of_i64(v, nlimbs: int = 5):
-    """Split int64 values into `nlimbs` 13-bit limbs (low first; last
-    limb is the signed remainder). The one shared decomposition behind
-    the exact-sum kernels (limb matmuls, segmented limb cumsums) --
-    limb width must match combine_limb_totals_128's limb_bits=13."""
+def limbs_of_i64(v, limb_bits: int, nlimbs: int):
+    """Split int64 values into `nlimbs` limbs of `limb_bits` bits (low
+    limbs unsigned, last limb the signed arithmetic-shift remainder).
+    The one shared decomposition behind the exact-sum kernels: 13-bit
+    limbs ride the wide f32-HIGHEST matmuls, 8-bit limbs the bf16 MXU
+    form (every value in [-128, 255] is exact in bf16's 8-bit
+    mantissa). `limb_bits` must match the recombination's
+    (combine_limb_totals_128 / the weighted int64 fold) limb width."""
+    mask = _I64((1 << limb_bits) - 1)
     out = []
     rem = v.astype(_I64)
     for _ in range(nlimbs - 1):
-        out.append(rem & _I64(0x1FFF))
-        rem = rem >> _I64(13)
+        out.append(rem & mask)
+        rem = rem >> _I64(limb_bits)
     out.append(rem)  # signed top
     return out
+
+
+def limbs13_of_i64(v, nlimbs: int = 5):
+    """Split int64 values into `nlimbs` 13-bit limbs (low first; last
+    limb is the signed remainder) -- limb width must match
+    combine_limb_totals_128's limb_bits=13."""
+    return limbs_of_i64(v, 13, nlimbs)
 
 
 def limbs13_of_128(hi, lo, nlimbs: int = 10):

@@ -67,7 +67,7 @@ from ..block import (Batch, Block, Column, DictionaryColumn, Int128Column,
 from .keys import key_words
 
 __all__ = ["AggSpec", "GroupByResult", "group_by", "grouped_aggregate",
-           "merge_partials", "finalize_states"]
+           "merge_partials", "finalize_states", "last_smallg_form"]
 
 
 # aggregate function names supported round 1 (reference: the ~250-file
@@ -200,9 +200,16 @@ def _group_ids_small(words, active: jnp.ndarray, max_groups: int):
     one whole group -- find the first unresolved row, broadcast its key
     words, match all equal rows. At most max_groups rounds; leftover
     unresolved active rows mean >max_groups distinct keys -> overflow
-    (parked in the last slot, invalidated by the rerun)."""
+    (parked in the last slot, invalidated by the rerun).
+
+    Narrow-width execution: the (n,)-sized id payload is int16 when the
+    table provably fits (G < 2^15) -- every consumer compares or
+    indexes, both exact under the downcast -- halving the id lanes'
+    HBM traffic through the aggregate pipeline."""
     n = active.shape[0]
     rows = jnp.arange(n, dtype=jnp.int32)
+    id_dt = jnp.int16 if (_narrow_kernels() and max_groups < (1 << 15)) \
+        else jnp.int32
 
     def cond(state):
         g, ids, _ = state
@@ -216,76 +223,236 @@ def _group_ids_small(words, active: jnp.ndarray, max_groups: int):
         match = unres
         for w in words:
             match = match & (w == w[i_safe])
-        ids = jnp.where(match, g, ids)
+        ids = jnp.where(match, g.astype(id_dt), ids)
         first = first.at[g].set(i_safe)  # single-element scatter: cheap
         return g + jnp.int32(1), ids, first
 
     num_groups, ids, perm_first = jax.lax.while_loop(
-        cond, body, (jnp.int32(0), jnp.full(n, -1, dtype=jnp.int32),
+        cond, body, (jnp.int32(0), jnp.full(n, -1, dtype=id_dt),
                      jnp.zeros(max_groups, dtype=jnp.int32)))
     overflow = jnp.any(active & (ids < 0))
-    ids = jnp.where(active & (ids >= 0), ids, max_groups - 1) \
-        .astype(jnp.int32)
+    ids = jnp.where(active & (ids >= 0), ids,
+                    jnp.asarray(max_groups - 1, dtype=id_dt)).astype(id_dt)
     return ids, perm_first, num_groups, overflow
 
 
-def _limb_matmul_sum(ids, v, max_groups: int, nlimbs: int = 5,
-                     chunk: int = 2048) -> jnp.ndarray:
-    """Exact int64 per-group sums on the MXU: split values into 13-bit
-    limbs (top limb signed), one-hot(ids) @ limbs in f32 over
-    `chunk`-row blocks -- every block-level f32 sum is < 2^24 so f32
-    accumulation is exact -- then combine block partials in int64.
-    `nlimbs=1` covers 0/1 count flags. On TPU the one-hot + matmul runs
-    as a FUSED Pallas kernel (the one-hot never stages through HBM;
-    pallas_kernels.limb_partial_sums, same numerics); override
-    PRESTO_TPU_SMALLG_PALLAS=0 for the XLA einsum form."""
-    from ..int128 import limbs13_of_i64
-    n = v.shape[0]
-    x = v.astype(jnp.int64)
+def _narrow_kernels() -> bool:
+    """Trace-time gate for the narrow kernel forms (the fused
+    cross-aggregate limb pool; bf16 operands where the MXU exists).
+    PRESTO_TPU_NARROW=0 reverts every form to the round-5 wide kernels
+    for A/B. ONE shared gate with the plan layer (plan/widths.py)."""
+    from ..plan.widths import kernel_narrow_enabled
+    return kernel_narrow_enabled()
+
+
+def _mxu_bf16() -> bool:
+    """bf16 one-hot/limb operands with 8-bit limbs: ONE MXU pass vs
+    f32-HIGHEST's six. TPU-only by default -- a CPU backend has no bf16
+    units (XLA emulates, measured ~2x slower than its native f32 dot),
+    and CPU f32 dots are true f32 so the 13-bit f32 form is already
+    exact there. PRESTO_TPU_BF16=1|0 overrides for exactness tests /
+    chip A/Bs."""
+    mode = _os.environ.get("PRESTO_TPU_BF16", "auto")
+    if mode == "1":
+        return _narrow_kernels()
+    if mode == "0":
+        return False
+    return _narrow_kernels() and jax.default_backend() == "tpu"
+
+
+# which small-G sum form the last trace actually emitted (trace-time
+# static, like the form choice itself) -- bench.py reports this instead
+# of re-deriving the decision, so artifacts name the executed kernel
+_LAST_SMALLG_FORM = [None]
+
+
+def _note_form(form: str) -> None:
+    _LAST_SMALLG_FORM[0] = form
+
+
+def last_smallg_form():
+    return _LAST_SMALLG_FORM[0]
+
+
+def _fused_limb_sums(ids, requests, max_groups: int,
+                     chunk: int = 2048):
+    """ONE one-hot matmul for every integer seg-sum in `requests`
+    (list of (contrib lanes, value_bits)) -> list of (G,) exact int64
+    totals. This is the fused single-pass form of the scan-side
+    aggregation: the one-hot is built (and ids read) once for ALL
+    aggregates instead of once per accumulator.
+
+    Narrow form (PRESTO_TPU_NARROW, default on): 8-bit limbs staged as
+    int16 lanes, one-hot AND limbs as bf16 MXU operands with f32
+    accumulation -- ONE MXU pass, exact because one-hot entries are 0/1,
+    every limb value lies in [-128, 255] (integers bf16 holds exactly),
+    and per-chunk f32 sums stay < 2^19 << 2^24. Wide form: 13-bit limbs
+    as f32 with precision=HIGHEST (six bf16 passes), the round-2
+    numerics, bit-identical results.
+
+    On TPU the one-hot+matmul runs as a fused Pallas kernel (the
+    one-hot never stages through HBM); PRESTO_TPU_SMALLG_PALLAS=0
+    selects the XLA einsum form."""
+    from ..int128 import limbs_of_i64
+    narrow = _mxu_bf16()
+    limb_bits = 8 if narrow else 13
+    stage_dt = jnp.int16 if narrow else jnp.float32
+    limb_cols = []
+    spans = []
+    for contrib, value_bits in requests:
+        nl = max(-(-int(value_bits) // limb_bits), 1)
+        x = contrib.astype(jnp.int64)
+        limbs = limbs_of_i64(x, limb_bits, nl) if nl > 1 else [x]
+        spans.append((len(limb_cols), nl))
+        limb_cols.extend(limbs)
+    n = ids.shape[0]
+    L = len(limb_cols)
+    lm = jnp.stack([l.astype(stage_dt) for l in limb_cols], axis=1)
     if _os.environ.get("PRESTO_TPU_SMALLG_PALLAS", "1") != "0" \
             and jax.default_backend() == "tpu":
         from .pallas_kernels import limb_partial_sums
-        lm = jnp.stack([l.astype(jnp.float32)
-                        for l in limbs13_of_i64(x, nlimbs)], axis=1)
-        part = limb_partial_sums(ids.astype(jnp.int32), lm,
-                                 max_groups)  # (tiles, G, L)
+        _note_form("pallas-bf16" if narrow else "pallas")
+        part = limb_partial_sums(
+            ids.astype(jnp.int32), lm, max_groups,
+            compute_dtype=jnp.bfloat16 if narrow else jnp.float32)
     else:
         c = -(-n // chunk)
         pad = c * chunk - n
-        i = jnp.pad(ids, (0, pad), constant_values=max_groups)
-        xp = jnp.pad(x, (0, pad))
-        limbs = [l.astype(jnp.float32) for l in limbs13_of_i64(xp, nlimbs)]
-        lm = jnp.stack(limbs, axis=1).reshape(c, chunk, nlimbs)
-        oh = (i.reshape(c, chunk)[:, :, None]
-              == jnp.arange(max_groups, dtype=jnp.int32)).astype(jnp.float32)
-        part = jnp.einsum("ckg,ckl->cgl", oh, lm,
-                          precision=jax.lax.Precision.HIGHEST,
-                          preferred_element_type=jnp.float32)
-    # ONE numerics-critical combine for both forms: per-chunk f32
-    # partials (each < 2^24, exact) recombine in int64
+        i = jnp.pad(ids.astype(jnp.int32), (0, pad),
+                    constant_values=max_groups)
+        lmp = jnp.pad(lm, ((0, pad), (0, 0))).reshape(c, chunk, L)
+        ohb = (i.reshape(c, chunk)[:, :, None]
+               == jnp.arange(max_groups, dtype=jnp.int32))
+        if narrow:
+            _note_form("einsum-MXU-bf16")
+            part = jnp.einsum("ckg,ckl->cgl", ohb.astype(jnp.bfloat16),
+                              lmp.astype(jnp.bfloat16),
+                              preferred_element_type=jnp.float32)
+        else:
+            _note_form("einsum-MXU")
+            part = jnp.einsum("ckg,ckl->cgl", ohb.astype(jnp.float32),
+                              lmp.astype(jnp.float32),
+                              precision=jax.lax.Precision.HIGHEST,
+                              preferred_element_type=jnp.float32)
+    # ONE numerics-critical combine for all forms: per-chunk/tile f32
+    # partials (each exact) recombine in int64
     tot = jnp.sum(part.astype(jnp.int64), axis=0)  # (G, L)
-    scale = jnp.int64(1) << (13 * jnp.arange(nlimbs, dtype=jnp.int64))
-    return jnp.sum(tot * scale[None, :], axis=1)
+    out = []
+    for start, nl in spans:
+        t = tot[:, start:start + nl]
+        scale = jnp.int64(1) << (limb_bits
+                                 * jnp.arange(nl, dtype=jnp.int64))
+        out.append(jnp.sum(t * scale[None, :], axis=1))
+    return out
 
 
-def _seg_add(ids, contrib, max_groups: int) -> jnp.ndarray:
+def _limb_matmul_sum(ids, v, max_groups: int, value_bits: int = 64,
+                     chunk: int = 2048) -> jnp.ndarray:
+    """Exact int64 per-group sums on the MXU (single-request form of
+    _fused_limb_sums; `value_bits=1` covers 0/1 count flags)."""
+    return _fused_limb_sums(ids, [(v, value_bits)], max_groups,
+                            chunk=chunk)[0]
+
+
+# ambient fused-sum pool: group_by's small-table path installs one so
+# every integer accumulator across ALL aggregates lands in a single
+# one-hot matmul (a collect pass discovers the requests, the serve pass
+# reads the batched results -- see _SegSumPool)
+import threading as _threading
+
+_pool_tls = _threading.local()
+
+
+def _seg_pool():
+    return getattr(_pool_tls, "pool", None)
+
+
+class _SegSumPool:
+    """Two-phase cross-aggregate seg-sum batcher. Collect: _seg_add /
+    _seg_count enqueue (contrib, value_bits) and hand back int64
+    placeholders (the collect pass's outputs are discarded, so
+    everything not feeding a request is dead code XLA eliminates).
+    Compute: ONE _fused_limb_sums call over every request. Serve: the
+    same call sites replay in the same order and receive the batched
+    totals. Both passes run the identical spec walk, so the request
+    sequence is deterministic by construction; `check_served` guards
+    the invariant."""
+
+    def __init__(self, ids, max_groups: int):
+        self.ids = ids
+        self.g = max_groups
+        self.collecting = True
+        self.requests = []
+        self.results = []
+        self._i = 0
+
+    def add(self, contrib, value_bits: int):
+        if self.collecting:
+            self.requests.append((contrib, value_bits))
+            return jnp.zeros(self.g, dtype=jnp.int64)
+        out = self.results[self._i]
+        self._i += 1
+        return out
+
+    def compute(self):
+        if self.requests:
+            self.results = _fused_limb_sums(self.ids, self.requests,
+                                            self.g)
+        self.collecting = False
+
+    def check_served(self):
+        assert self._i == len(self.results), \
+            (f"fused-sum pool drift: collected {len(self.results)} "
+             f"requests, served {self._i}")
+
+
+class _pooled:
+    def __init__(self, pool):
+        self.pool = pool
+
+    def __enter__(self):
+        self.prev = _seg_pool()
+        _pool_tls.pool = self.pool
+        return self.pool
+
+    def __exit__(self, *exc):
+        _pool_tls.pool = self.prev
+        return False
+
+
+def _seg_add(ids, contrib, max_groups: int,
+             value_bits: int = 64) -> jnp.ndarray:
     """Per-group sum of `contrib` (already masked: dead rows contribute
     the dtype's zero). Small tables avoid TPU scatter: exact limb
-    matmuls for integers, per-group masked reductions for floats."""
+    matmuls for integers (batched across aggregates through the ambient
+    pool when one is installed), per-group masked reductions for
+    floats."""
     if max_groups <= _SMALL_G and _scatter_free():
         if contrib.dtype in (jnp.int64, jnp.int32):
-            return _limb_matmul_sum(ids, contrib, max_groups)
+            pool = _seg_pool()
+            # the pool batches by ITS captured ids: a caller grouping by
+            # a transformed id array must not fold into it (identity
+            # check is deterministic across the collect/serve walks)
+            if pool is not None and ids is pool.ids:
+                return pool.add(contrib.astype(jnp.int64), value_bits)
+            return _limb_matmul_sum(ids, contrib, max_groups,
+                                    value_bits=value_bits)
         zero = jnp.zeros((), dtype=contrib.dtype)
         return jnp.stack([jnp.sum(jnp.where(ids == g, contrib, zero))
                           for g in range(max_groups)])
+    _note_form("scatter")
     return jnp.zeros(max_groups, dtype=contrib.dtype).at[ids].add(contrib)
 
 
 def _seg_count(ids, flags, max_groups: int) -> jnp.ndarray:
     """Per-group count of True flags (int64)."""
     if max_groups <= _SMALL_G and _scatter_free():
+        pool = _seg_pool()
+        if pool is not None and ids is pool.ids:
+            return pool.add(flags.astype(jnp.int64), 1)
         return _limb_matmul_sum(ids, flags.astype(jnp.int64), max_groups,
-                                nlimbs=1)
+                                value_bits=1)
+    _note_form("scatter")
     return jnp.zeros(max_groups, dtype=jnp.int64).at[ids].add(
         flags.astype(jnp.int64))
 
@@ -318,7 +485,10 @@ def _sum128(ids, col, live, max_groups: int):
         limbs = limbs13_of_128(col.hi, col.lo)  # 10 x int64
     else:
         limbs = limbs13_of_i64(col.values)  # 5 x int64
-    totals = [_seg_add(ids, jnp.where(live, l, 0), max_groups)
+    # each 13-bit limb (signed top) fits 14 bits -- the pooled/matmul
+    # forms split no wider than needed at accumulation time
+    totals = [_seg_add(ids, jnp.where(live, l, 0), max_groups,
+                       value_bits=14)
               for l in limbs]
     return combine_limb_totals_128(jnp.stack(totals, axis=-1))
 
@@ -1088,12 +1258,37 @@ def group_by(batch: Batch, key_channels: Sequence[int], aggs: Sequence[AggSpec],
     sub_overflow: List = []
     for k in keys:
         out_cols.append(_gather_block(k, perm_first, slot_active))
-    for spec in aggs:
-        col = None if spec.input_channel is None else batch.column(spec.input_channel)
-        for _, state in _acc_columns(spec, col, ids,
-                                     _masked_active(batch, spec), max_groups,
-                                     batch, overflow_out=sub_overflow):
-            out_cols.append(state)
+    # fused single-pass accumulation (narrow-width execution): a collect
+    # pass walks the spec list once to discover every integer seg-sum,
+    # ONE one-hot matmul computes them all, then the real walk serves
+    # the batched totals -- the columns and ids are read once for the
+    # whole aggregate list instead of once per accumulator. The collect
+    # pass's other outputs are discarded (XLA dead-code-eliminates
+    # them); count_distinct is excluded because its mark-distinct
+    # while-loop feeds a pooled contrib and would trace live twice.
+    pool = None
+    if (max_groups <= _SMALL_G and _scatter_free() and _narrow_kernels()
+            and aggs and not any(s.canonical == "count_distinct"
+                                 for s in aggs)):
+        pool = _SegSumPool(ids, max_groups)
+        with _pooled(pool):
+            for spec in aggs:
+                col = None if spec.input_channel is None \
+                    else batch.column(spec.input_channel)
+                _acc_columns(spec, col, ids, _masked_active(batch, spec),
+                             max_groups, batch, overflow_out=None)
+        pool.compute()
+    with _pooled(pool):
+        for spec in aggs:
+            col = None if spec.input_channel is None \
+                else batch.column(spec.input_channel)
+            for _, state in _acc_columns(spec, col, ids,
+                                         _masked_active(batch, spec),
+                                         max_groups, batch,
+                                         overflow_out=sub_overflow):
+                out_cols.append(state)
+    if pool is not None:
+        pool.check_served()
     for f in sub_overflow:
         overflow = overflow | f
     out = Batch(tuple(out_cols), slot_active)

@@ -110,28 +110,43 @@ def contains_bytes(chars: jax.Array, lengths: jax.Array, needle: bytes,
 _SUM_TILE = 1024
 
 
-def _limb_sum_kernel(ids_ref, limbs_ref, out_ref, *, groups: int):
+def _limb_sum_kernel(ids_ref, limbs_ref, out_ref, *, groups: int,
+                     compute_dtype):
     """One row tile: build the one-hot(ids) in VMEM and ride the MXU
     for (G, L) partial sums -- the fused form of the XLA path's
-    one_hot-materialize + einsum (which stages an (n, G) f32 one-hot
+    one_hot-materialize + einsum (which stages an (n, G) one-hot
     through HBM). Each tile's f32 sums stay < 2^24 (exact); tiles
     combine in int64 OUTSIDE the kernel, identical numerics to
-    aggregation._limb_matmul_sum."""
+    aggregation._limb_matmul_sum.
+
+    compute_dtype=bfloat16 (narrow-width execution): one MXU pass --
+    exact because one-hot entries are 0/1 and 8-bit limbs (|v| <= 255,
+    every integer representable in bf16's 8-bit mantissa) accumulate in
+    f32. compute_dtype=float32 keeps the wide form, where
+    precision=HIGHEST is required: default-precision f32 dot lowers to
+    bf16 passes on TPU, which cannot hold 13-bit limbs exactly."""
     ids = ids_ref[:]
     gidx = jax.lax.broadcasted_iota(jnp.int32, (ids.shape[0], groups), 1)
-    onehot = (ids[:, None] == gidx).astype(jnp.float32)
-    # precision=HIGHEST: default-precision f32 dot lowers to bf16
-    # passes on TPU, which cannot hold 13-bit limbs exactly
-    out_ref[0] = jnp.dot(onehot.T, limbs_ref[:],
-                         precision=jax.lax.Precision.HIGHEST,
-                         preferred_element_type=jnp.float32)
+    onehot = (ids[:, None] == gidx).astype(compute_dtype)
+    limbs = limbs_ref[:].astype(compute_dtype)
+    if compute_dtype == jnp.bfloat16:
+        out_ref[0] = jnp.dot(onehot.T, limbs,
+                             preferred_element_type=jnp.float32)
+    else:
+        out_ref[0] = jnp.dot(onehot.T, limbs,
+                             precision=jax.lax.Precision.HIGHEST,
+                             preferred_element_type=jnp.float32)
 
 
 def limb_partial_sums(ids: jax.Array, limbs: jax.Array, groups: int,
-                      interpret: bool | None = None) -> jax.Array:
+                      interpret: bool | None = None,
+                      compute_dtype=jnp.float32) -> jax.Array:
     """(tiles, G, L) f32 per-tile partial sums of `limbs` grouped by
     `ids` (int32; out-of-range ids contribute nothing). Rows pad to the
-    tile size with ids == groups (dropped by the one-hot compare)."""
+    tile size with ids == groups (dropped by the one-hot compare).
+    `limbs` may arrive at any integer/float lane dtype whose values the
+    MXU operand dtype holds exactly (int16 8-bit limbs for the bf16
+    narrow form, f32 13-bit limbs for the wide form)."""
     if interpret is None:
         interpret = not pallas_supported()
     n, L = limbs.shape
@@ -141,7 +156,10 @@ def limb_partial_sums(ids: jax.Array, limbs: jax.Array, groups: int,
         limbs = jnp.pad(limbs, ((0, pad), (0, 0)))
     total = ids.shape[0]
     tiles = total // _SUM_TILE
-    kernel = functools.partial(_limb_sum_kernel, groups=groups)
+    kernel = functools.partial(_limb_sum_kernel, groups=groups,
+                               compute_dtype=compute_dtype)
+    if limbs.dtype not in (jnp.int16, jnp.bfloat16):
+        limbs = limbs.astype(jnp.float32)
     return pl.pallas_call(
         kernel,
         grid=(tiles,),
@@ -150,4 +168,4 @@ def limb_partial_sums(ids: jax.Array, limbs: jax.Array, groups: int,
         out_specs=pl.BlockSpec((1, groups, L), lambda i: (i, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((tiles, groups, L), jnp.float32),
         interpret=interpret,
-    )(ids.astype(jnp.int32), limbs.astype(jnp.float32))
+    )(ids.astype(jnp.int32), limbs)

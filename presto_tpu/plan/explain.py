@@ -29,7 +29,14 @@ __all__ = ["explain", "explain_analyze", "explain_distributed"]
 
 def _node_line(n: N.PlanNode) -> str:
     if isinstance(n, N.TableScanNode):
-        return f"TableScan[{n.connector}.{n.table} columns={n.columns}]"
+        extra = ""
+        if n.physical_dtypes:
+            from .widths import widths_summary
+            w = widths_summary(n)
+            if w:
+                extra = f" widths={{{w}}}"
+        return (f"TableScan[{n.connector}.{n.table} "
+                f"columns={n.columns}{extra}]")
     if isinstance(n, N.ValuesNode):
         return f"Values[{len(n.rows)} rows]"
     if isinstance(n, N.FilterNode):

@@ -66,6 +66,11 @@ class TableScanNode(PlanNode):
     # use to prune row groups/pages. PRUNING ONLY -- the Filter above
     # still applies exactly; None bound = unbounded on that side
     pushdown: object = None
+    # narrow-width execution (plan/widths.py): per-column physical lane
+    # dtype names ("int16", ...; None = logical width), proven safe by
+    # connector range statistics. Staging honors these; every compute
+    # site widens before arithmetic, so results stay bit-exact
+    physical_dtypes: object = None
 
     def output_types(self):
         return list(self.column_types)
@@ -570,6 +575,8 @@ def to_json(n: PlanNode) -> dict:
              "columnTypes": [str(t) for t in n.column_types]}
         if n.pushdown is not None:
             j["pushdown"] = list(n.pushdown)
+        if n.physical_dtypes is not None:
+            j["physicalDtypes"] = list(n.physical_dtypes)
         return j
     if isinstance(n, RemoteSourceNode):
         return {**base, "@type": "remotesource",
@@ -680,9 +687,12 @@ def from_json(j: dict) -> PlanNode:
     kw = {"id": nid} if nid else {}
     if t == "tablescan":
         pd = j.get("pushdown")
+        phys = j.get("physicalDtypes")
         return TableScanNode(j["connector"], j["table"], j["columns"],
                              [T.parse_type(s) for s in j["columnTypes"]],
-                             pushdown=tuple(pd) if pd else None, **kw)
+                             pushdown=tuple(pd) if pd else None,
+                             physical_dtypes=tuple(phys) if phys else None,
+                             **kw)
     if t == "remotesource":
         return RemoteSourceNode([T.parse_type(s) for s in j["types"]],
                                 j["fragmentId"], **kw)

@@ -19,7 +19,8 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Tuple, Union
 
 __all__ = ["MetricFamily", "render_prometheus", "parse_prometheus",
-           "plan_cache_families", "uptime_family", "CONTENT_TYPE"]
+           "plan_cache_families", "narrowing_families", "uptime_family",
+           "CONTENT_TYPE"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -81,6 +82,22 @@ def plan_cache_families() -> List[MetricFamily]:
                      "compiled-plan cache hits").add(st["hits"]),
         MetricFamily("presto_tpu_plan_cache_misses_total", "counter",
                      "compiled-plan cache misses").add(st["misses"]),
+    ]
+
+
+def narrowing_families() -> List[MetricFamily]:
+    """Narrow-width execution lifetime totals (plan/widths.py), exported
+    by both tiers next to the plan-cache hit/miss counters so staging
+    savings and compile savings read off one scrape."""
+    from ..plan.widths import narrowing_totals
+    t = narrowing_totals()
+    return [
+        MetricFamily("presto_tpu_narrowed_bytes_saved_total", "counter",
+                     "host->HBM staging bytes saved by narrow-width "
+                     "execution").add(t["bytes_saved"]),
+        MetricFamily("presto_tpu_narrowed_columns_total", "counter",
+                     "scan columns staged at a narrowed physical "
+                     "lane").add(t["columns"]),
     ]
 
 

@@ -537,9 +537,11 @@ class StatementServer:
                "largest per-query peak memory seen").add(
                    totals["peak_memory_bytes"]),
         ]
-        from .metrics import plan_cache_families, uptime_family
+        from .metrics import (narrowing_families, plan_cache_families,
+                              uptime_family)
         fams.append(uptime_family(self._started_at, "coordinator"))
         fams.extend(plan_cache_families())
+        fams.extend(narrowing_families())
         return fams
 
 

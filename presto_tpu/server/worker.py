@@ -392,6 +392,7 @@ class TaskManager:
                                 remote_sources=remote_sources,
                                 memory_pool=self.memory_pool,
                                 query_id=task.task_id,
+                                session=session,
                                 trace_id=body.get("traceId"))
             wall = time.time() - t0
             with task.lock:
@@ -596,8 +597,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _metric_families(self):
         """Worker-side metric families (shared emitter: metrics.py)."""
-        from .metrics import (MetricFamily as MF, plan_cache_families,
-                              uptime_family)
+        from .metrics import (MetricFamily as MF, narrowing_families,
+                              plan_cache_families, uptime_family)
         m = self.manager
         fams = [
             MF("presto_tpu_active_tasks", "gauge",
@@ -632,6 +633,7 @@ class _Handler(BaseHTTPRequestHandler):
             fams.append(MF(f"presto_tpu_{k}_total", "counter",
                            f"lifetime {k}").add(counters[k]))
         fams.extend(plan_cache_families())
+        fams.extend(narrowing_families())
         return fams
 
     def do_GET(self):  # noqa: N802

@@ -176,6 +176,13 @@ SESSION_PROPERTIES = (
     .add("spill_file_threshold_bytes", "int", 256 << 20,
          "host-DRAM bytes a spill staging area may hold before "
          "flushing a run file to spill_path")
+    .add("narrow_width_execution", "bool", True,
+         "stage scan columns at the narrowest physical lane the "
+         "connector's range statistics prove safe (plan/widths.py; "
+         "dates as epoch-day int16/int32, range-proven int64 as "
+         "int32/int16/int8) -- bit-exact, every compute site widens "
+         "before arithmetic; env PRESTO_TPU_NARROW=0 disables globally "
+         "including the bf16/fused kernel forms")
     .add("query_cost_analysis", "bool", False,
          "annotate QueryStats' compile stage with XLA cost_analysis "
          "FLOPs / bytes-accessed (costs one extra program trace per "

@@ -17,9 +17,9 @@ import numpy as np
 
 import presto_tpu  # noqa: F401  (x64 on, before any array is created)
 
-N = 6_000_000
-G = 16
-ITERS = 5
+N = int(os.environ.get("MB_ROWS", 6_000_000))
+G = int(os.environ.get("MB_GROUPS", 16))
+ITERS = int(os.environ.get("MB_ITERS", 5))
 
 
 def timeit(name, fn, *args):
@@ -164,5 +164,110 @@ def main():
            lambda ww, a: first_occurrence_ids([ww], a), ids16, active)
 
 
+def narrow_ab():
+    """`--narrow-ab`: narrow-vs-wide A/B per primitive -- staged bytes
+    and wall for each (staged lane dtype x kernel form) cell, so
+    chip-day measurements slot straight into PERF.md. Toggles
+    PRESTO_TPU_NARROW around each trace (the kernel forms are
+    trace-time static) and stages the value column at int64/int32/int16
+    physical lanes. All forms are exact; equality is asserted against a
+    numpy oracle every cell."""
+    from presto_tpu.ops.aggregation import (_limb_matmul_sum,
+                                            last_smallg_form)
+
+    rng = np.random.default_rng(0)
+    ids_np = rng.integers(0, G, N).astype(np.int32)
+    # int16-safe domain so every staged lane width is value-preserving
+    v_np = rng.integers(-(2 ** 14), 2 ** 14, N).astype(np.int64)
+    oracle = np.zeros(G, dtype=np.int64)
+    np.add.at(oracle, ids_np, v_np)
+    ids = jax.device_put(jnp.asarray(ids_np))
+
+    print(f"platform={jax.devices()[0].platform} n={N} G={G} "
+          f"(narrow-vs-wide A/B; oracle-checked)")
+    print(f"{'cell':42s} {'staged':>10s} {'wall':>10s}")
+
+    def cell(name, narrow, fn, *args):
+        os.environ["PRESTO_TPU_NARROW"] = "1" if narrow else "0"
+        # force the bf16 form for the narrow cells so the A/B is
+        # kernel-vs-kernel even off-TPU (where bf16 is emulated; the
+        # chip numbers are the ones PERF.md wants)
+        os.environ["PRESTO_TPU_BF16"] = "1" if narrow else "0"
+        from presto_tpu.ops import aggregation as _agg
+        _agg._LAST_SMALLG_FORM[0] = None  # tag only THIS cell's trace
+        try:
+            staged = sum(int(np.asarray(a).nbytes) for a in args)
+            r = np.asarray(jax.jit(fn)(*args))
+            assert np.array_equal(r, oracle), name
+            fn_j = jax.jit(fn)
+            jax.device_get(fn_j(*args))
+
+            def window(k):
+                t0 = time.time()
+                out = None
+                for _ in range(k):
+                    out = fn_j(*args)
+                jax.device_get(out)
+                return time.time() - t0
+
+            t1, t2 = window(ITERS), window(2 * ITERS)
+            dt = (t2 - t1) / ITERS
+            if dt <= 0:
+                dt = t2 / (2 * ITERS)
+            print(f"{name:42s} {staged / 1e6:8.1f}MB {dt * 1e3:8.2f}ms"
+                  f"  [{last_smallg_form()}]")
+        finally:
+            os.environ.pop("PRESTO_TPU_NARROW", None)
+            os.environ.pop("PRESTO_TPU_BF16", None)
+
+    for dt_name in ("int64", "int32", "int16"):
+        v = jax.device_put(jnp.asarray(v_np.astype(dt_name)))
+        vb = {"int64": 64, "int32": 32, "int16": 16}[dt_name]
+
+        def scatter(i, x):
+            return jnp.zeros(G, dtype=jnp.int64).at[i].add(
+                x.astype(jnp.int64))
+
+        cell(f"scatter-add ({dt_name} lanes)", False, scatter, ids, v)
+        cell(f"limb matmul wide f32-HIGHEST ({dt_name})", False,
+             lambda i, x: _limb_matmul_sum(i, x, G, value_bits=vb), ids, v)
+        cell(f"limb matmul narrow bf16 ({dt_name})", True,
+             lambda i, x: _limb_matmul_sum(i, x, G, value_bits=vb), ids, v)
+
+    # fused cross-aggregate pool: 8 accumulators in ONE matmul vs 8
+    from presto_tpu.ops.aggregation import _fused_limb_sums
+    v64 = jax.device_put(jnp.asarray(v_np))
+
+    def fused(i, x):
+        return jnp.stack(_fused_limb_sums(i, [(x, 16)] * 8, G))
+
+    def unfused(i, x):
+        return jnp.stack([_limb_matmul_sum(i, x, G, value_bits=16)
+                          for _ in range(8)])
+
+    for narrow in (True, False):
+        tag = "narrow-bf16" if narrow else "wide-f32"
+        # force both gates so the A/B is kernel-vs-kernel off-TPU too
+        # (same as cell(); on CPU bf16 is emulated -- chip numbers are
+        # the ones PERF.md wants)
+        os.environ["PRESTO_TPU_NARROW"] = "1" if narrow else "0"
+        os.environ["PRESTO_TPU_BF16"] = "1" if narrow else "0"
+        oracle8 = np.tile(oracle, (8, 1))
+
+        def chk(fn, name):
+            r = np.asarray(jax.jit(fn)(ids, v64))
+            assert np.array_equal(r, oracle8), name
+
+        chk(fused, "fused")
+        chk(unfused, "unfused")
+        timeit(f"8-accumulator FUSED pool ({tag})", fused, ids, v64)
+        timeit(f"8-accumulator unfused ({tag})", unfused, ids, v64)
+    os.environ.pop("PRESTO_TPU_NARROW", None)
+    os.environ.pop("PRESTO_TPU_BF16", None)
+
+
 if __name__ == "__main__":
-    main()
+    if "--narrow-ab" in sys.argv:
+        narrow_ab()
+    else:
+        main()

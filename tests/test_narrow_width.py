@@ -1,0 +1,420 @@
+"""Narrow-width execution (plan/widths.py + the narrowed staging path +
+the bf16/fused aggregation forms).
+
+The contract under test: with PRESTO_TPU_NARROW=1 (the default) every
+query result is BIT-EXACT against the wide execution, width inference
+only narrows when connector statistics PROVE the range, and the
+staging-time guard refuses stale proofs. Property-style loops cover
+int64 edge values around the +/-2^31 and +/-2^15 lane boundaries and
+NULL masks."""
+
+import os
+
+import numpy as np
+import pytest
+
+import presto_tpu  # noqa: F401  (x64 on)
+from presto_tpu import types as T
+from presto_tpu.block import batch_from_numpy, to_numpy
+from presto_tpu.connectors import memory
+from presto_tpu.exec.plan_cache import clear_plan_cache
+from presto_tpu.plan import widths as W
+from presto_tpu.sql import sql
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    memory.reset()
+    clear_plan_cache()
+    monkeypatch.delenv("PRESTO_TPU_NARROW", raising=False)
+    yield
+    memory.reset()
+    clear_plan_cache()
+
+
+def _mem_table(name, cols, types, arrays, nulls=None):
+    memory.create_table(name, cols, types)
+    n = len(arrays[0])
+    nulls = nulls or [np.zeros(n, dtype=bool) for _ in arrays]
+    memory.replace_table(name, [np.asarray(a) for a in arrays],
+                         [np.asarray(m, dtype=bool) for m in nulls])
+
+
+# ---------------------------------------------------------------------------
+# width inference
+# ---------------------------------------------------------------------------
+
+def test_tpch_q1_columns_narrow_as_documented():
+    cols = ["quantity", "extendedprice", "discount", "tax", "shipdate",
+            "returnflag"]
+    tys = [T.decimal(12, 2), T.decimal(12, 2), T.decimal(12, 2),
+           T.decimal(12, 2), T.DATE, T.char(1)]
+    w = W.infer_table_widths("tpch", "lineitem", cols, tys, 1.0)
+    assert w is not None
+    got = dict(zip(cols, w))
+    assert got["quantity"] == "int16"        # 100..5000
+    assert got["extendedprice"] == "int32"   # < 2^31
+    assert got["discount"] == "int8"         # 0..10
+    assert got["tax"] == "int8"              # 0..8
+    assert got["shipdate"] == "int16"        # epoch-days ~8k..10.7k
+    assert got["returnflag"] is None         # strings never narrow
+
+
+def test_inference_refuses_without_stats():
+    # comment has no range statistics -> must stay at the logical lane
+    w = W.infer_table_widths("tpch", "lineitem", ["comment", "orderkey"],
+                             [T.varchar(44), T.BIGINT], 1.0)
+    assert w is not None and w[0] is None and w[1] == "int32"
+
+
+@pytest.mark.parametrize("lo,hi,expect", [
+    (0, (1 << 15) - 1, "int16"),
+    (0, 1 << 15, "int32"),                     # one past int16
+    (-(1 << 31), (1 << 31) - 1, "int32"),      # exactly int32
+    (-(1 << 31) - 1, 0, None),                 # one past int32: refuse
+    (0, 1 << 31, None),
+    (-128, 127, "int8"),
+    (-129, 127, "int16"),
+])
+def test_boundary_widths(lo, hi, expect):
+    assert W.infer_column_width(T.BIGINT, lo, hi) == expect
+
+
+def test_never_narrows_floats_strings_bools():
+    assert W.infer_column_width(T.DOUBLE, 0, 1) is None
+    assert W.infer_column_width(T.REAL, 0, 1) is None
+    assert W.infer_column_width(T.BOOLEAN, 0, 1) is None
+    assert W.infer_column_width(T.varchar(4), 0, 1) is None
+    # long decimals ride int128 lanes: no narrowing
+    assert W.infer_column_width(T.decimal(38, 2), 0, 100) is None
+
+
+def test_memory_connector_ranges_from_data():
+    _mem_table("r", ["a", "b"], [T.BIGINT, T.BIGINT],
+               [np.array([5, -3, 100], dtype=np.int64),
+                np.array([2 ** 40, 1, 2], dtype=np.int64)])
+    assert memory.column_range("r", "a") == (-3, 100)
+    assert memory.column_range("r", "b") == (1, 2 ** 40)
+    assert W.infer_column_width(T.BIGINT, *memory.column_range("r", "a")) \
+        == "int8"
+    # 2^40 exceeds every narrow candidate
+    assert W.infer_column_width(T.BIGINT, *memory.column_range("r", "b")) \
+        is None
+
+
+def test_guard_ignores_null_payloads_and_narrowing_survives():
+    """NULL slots may carry arbitrary stored payloads (identity fills,
+    writer leftovers); the staging guard must range-check live values
+    only -- mirroring column_range -- so a huge null payload neither
+    blocks narrowing nor corrupts results (null lanes are masked by
+    every kernel)."""
+    n = 64
+    vals = np.arange(n, dtype=np.int64)
+    nulls = np.zeros(n, dtype=bool)
+    vals[3] = np.iinfo(np.int64).max  # stored under a NULL
+    nulls[3] = True
+    checked = W.checked_physical_dtypes(
+        ("int16",), [T.BIGINT], [vals], nulls=[nulls])
+    assert checked == ("int16",)
+    _mem_table("t", ["v"], [T.BIGINT], [vals], [nulls])
+    assert memory.column_range("t", "v")[1] < (1 << 15)
+    narrow, wide = _run_both("SELECT sum(v) AS s, count(v) AS c FROM t")
+    assert narrow == wide
+    assert narrow[0][1] == n - 1
+
+
+def test_staging_guard_refuses_stale_proofs():
+    tys = [T.BIGINT]
+    arrays = [np.array([1, 2, 1 << 40], dtype=np.int64)]
+    # a (stale) int16 proof must be dropped, not wrapped
+    checked = W.checked_physical_dtypes(("int16",), tys, arrays)
+    assert checked == (None,)
+    ok = W.checked_physical_dtypes(
+        ("int16",), tys, [np.array([1, 2, 3], dtype=np.int64)])
+    assert ok == ("int16",)
+
+
+# ---------------------------------------------------------------------------
+# bit-exactness: narrowed vs wide execution
+# ---------------------------------------------------------------------------
+
+_EDGES = np.array([
+    0, 1, -1, 127, -128, 128, -129,
+    (1 << 15) - 1, -(1 << 15), 1 << 15,
+    (1 << 31) - 1, -(1 << 31), (1 << 31), -(1 << 31) - 1,
+], dtype=np.int64)
+
+
+def _run_both(query, **kw):
+    """rows() under narrow and wide execution; plans fingerprint
+    differently (physical_dtypes is a node field) and the kernel-mode
+    env rides the plan-cache key, so no stale executables cross over."""
+    out = {}
+    for mode in ("1", "0"):
+        os.environ["PRESTO_TPU_NARROW"] = mode
+        try:
+            out[mode] = sql(query, catalog="memory", **kw).rows()
+        finally:
+            os.environ.pop("PRESTO_TPU_NARROW", None)
+    return out["1"], out["0"]
+
+
+def test_narrowed_sql_is_bit_exact_across_edge_values():
+    rng = np.random.default_rng(11)
+    for seed in range(4):  # hypothesis-style property loop
+        memory.reset()
+        clear_plan_cache()
+        n = 400
+        keys = rng.integers(0, 7, n).astype(np.int64)
+        # values clustered around the int32/int16 boundaries, plus the
+        # full edge list itself
+        vals = rng.choice(
+            np.concatenate([_EDGES,
+                            rng.integers(-(1 << 33), 1 << 33, 64)]),
+            n).astype(np.int64)
+        nulls = rng.random(n) < 0.15
+        _mem_table("t", ["k", "v"], [T.BIGINT, T.BIGINT],
+                   [keys, vals], [np.zeros(n, bool), nulls])
+        narrow, wide = _run_both(
+            "SELECT k, sum(v) AS s, min(v) AS lo, max(v) AS hi, "
+            "count(v) AS c, count(DISTINCT v) AS d "
+            "FROM t GROUP BY k ORDER BY k")
+        assert narrow == wide, f"seed {seed}"
+
+
+def test_narrowed_sql_small_domain_group_keys_and_filter():
+    n = 500
+    rng = np.random.default_rng(3)
+    k = rng.integers(-2, 3, n).astype(np.int64)           # int8-able
+    d = (8000 + rng.integers(0, 2000, n)).astype(np.int32)  # date-ish
+    v = rng.integers(-(1 << 14), 1 << 14, n).astype(np.int64)
+    _mem_table("t", ["k", "d", "v"], [T.BIGINT, T.DATE, T.BIGINT],
+               [k, d, v])
+    narrow, wide = _run_both(
+        "SELECT k, count(*) AS c, sum(v) AS s, avg(v) AS a "
+        "FROM t WHERE d <= date '1997-01-01' GROUP BY k ORDER BY k")
+    assert narrow == wide
+    assert len(narrow) == 5
+
+
+def test_narrowing_refused_values_stay_wide_and_exact():
+    # values straddling int32: inference must keep the wide lane and
+    # the result must still match wide execution trivially
+    vals = np.array([(1 << 31) + 5, -(1 << 31) - 7, 3], dtype=np.int64)
+    _mem_table("t", ["v"], [T.BIGINT], [vals])
+    from presto_tpu.sql.planner import plan_sql
+    from presto_tpu.exec.runner import prepare_plan
+    os.environ["PRESTO_TPU_NARROW"] = "1"
+    try:
+        p = prepare_plan(plan_sql("SELECT v FROM t", catalog="memory"),
+                         sf=0.0)
+        scans = []
+        from presto_tpu.exec.planner import _collect_scans
+        _collect_scans(p, scans)
+        assert all(not getattr(s, "physical_dtypes", None) for s in scans)
+    finally:
+        os.environ.pop("PRESTO_TPU_NARROW", None)
+    narrow, wide = _run_both("SELECT sum(v) AS s, min(v) AS m FROM t")
+    assert narrow == wide == [(sum(int(x) for x in vals),
+                               min(int(x) for x in vals))]
+
+
+# ---------------------------------------------------------------------------
+# kernel forms: fused pool + bf16 one-hot exactness
+# ---------------------------------------------------------------------------
+
+def _group_table(r, nstates):
+    act = np.asarray(r.batch.active)
+    out = {}
+    for i in np.nonzero(act)[0]:
+        vals = []
+        for c in range(r.batch.num_columns):
+            v, nl = to_numpy(r.batch.column(c))
+            vals.append(None if nl[i] else v[i])
+        out[int(vals[0])] = tuple(vals[1:])
+    return out
+
+
+def test_fused_pool_matches_unfused_and_scatter_bit_exact(monkeypatch):
+    """The cross-aggregate fused matmul (one one-hot pass for every
+    integer accumulator) must equal the unfused einsum form AND the
+    scatter form bit-for-bit on integer states, across int64 extremes
+    and NULLs."""
+    from presto_tpu.ops.aggregation import AggSpec, group_by
+
+    rng = np.random.default_rng(0)
+    n = 3000
+    keys = rng.integers(0, 11, n).astype(np.int64)
+    ints = rng.choice(np.concatenate([
+        _EDGES, np.array([np.iinfo(np.int64).max // 2,
+                          np.iinfo(np.int64).min // 2]),
+        rng.integers(-(10 ** 12), 10 ** 12, 64)]), n).astype(np.int64)
+    nulls = rng.random(n) < 0.1
+    b = batch_from_numpy([T.BIGINT, T.BIGINT, T.decimal(12, 2)],
+                         [keys, ints,
+                          rng.integers(0, 10 ** 6, n).astype(np.int64)],
+                         nulls=[np.zeros(n, bool), nulls,
+                                np.zeros(n, bool)],
+                         capacity=n + 8)
+    specs = [AggSpec("sum", 1, T.BIGINT),
+             AggSpec("sum", 2, T.decimal(38, 2)),   # int128 limb path
+             AggSpec("avg", 2, T.decimal(12, 2)),
+             AggSpec("min", 1, T.BIGINT), AggSpec("max", 1, T.BIGINT),
+             AggSpec("count", 1, T.BIGINT),
+             AggSpec("count_star", None, T.BIGINT)]
+    out = {}
+    monkeypatch.setenv("PRESTO_TPU_SMALLG", "einsum")
+    for name, env in [("fused-bf16", {"PRESTO_TPU_NARROW": "1",
+                                      "PRESTO_TPU_BF16": "1"}),
+                      ("fused-f32", {"PRESTO_TPU_NARROW": "1",
+                                     "PRESTO_TPU_BF16": "0"}),
+                      ("wide", {"PRESTO_TPU_NARROW": "0"})]:
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        out[name] = _group_table(group_by(b, [0], specs, 16), len(specs))
+        for k in env:
+            monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("PRESTO_TPU_SMALLG", "scatter")
+    monkeypatch.setenv("PRESTO_TPU_NARROW", "0")
+    out["scatter"] = _group_table(group_by(b, [0], specs, 16), len(specs))
+    base = out["scatter"]
+    for name in ("fused-bf16", "fused-f32", "wide"):
+        assert out[name] == base, name
+
+
+def test_bf16_limb_matmul_exact_at_int64_extremes(monkeypatch):
+    from presto_tpu.ops.aggregation import _limb_matmul_sum
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("PRESTO_TPU_NARROW", "1")
+    monkeypatch.setenv("PRESTO_TPU_BF16", "1")  # force bf16 off-TPU
+    rng = np.random.default_rng(5)
+    n, g = 4096, 16
+    ids = rng.integers(0, g, n).astype(np.int32)
+    vals = rng.choice(np.array(
+        [np.iinfo(np.int64).max, np.iinfo(np.int64).min, -1, 0, 1,
+         (1 << 62) - 3]), n).astype(np.int64)
+    got = np.asarray(_limb_matmul_sum(jnp.asarray(ids), jnp.asarray(vals),
+                                      g))
+    want = np.zeros(g, dtype=np.int64)
+    with np.errstate(over="ignore"):
+        np.add.at(want, ids, vals)  # wraps mod 2^64, like int64 lanes
+    assert np.array_equal(got, want)
+
+
+def test_pool_serves_in_collect_order(monkeypatch):
+    """Drift guard: the serve pass must consume exactly the collected
+    requests (check_served)."""
+    from presto_tpu.ops.aggregation import AggSpec, group_by
+
+    monkeypatch.setenv("PRESTO_TPU_SMALLG", "einsum")
+    monkeypatch.setenv("PRESTO_TPU_NARROW", "1")
+    n = 200
+    rng = np.random.default_rng(1)
+    b = batch_from_numpy(
+        [T.BIGINT, T.BIGINT, T.DOUBLE],
+        [rng.integers(0, 5, n).astype(np.int64),
+         rng.integers(-100, 100, n).astype(np.int64),
+         rng.normal(size=n)], capacity=n)
+    specs = [AggSpec("sum", 1, T.BIGINT), AggSpec("avg", 2, T.DOUBLE),
+             AggSpec("var_samp", 2, T.DOUBLE),
+             AggSpec("bool_and", 1, T.BOOLEAN),
+             AggSpec("count_star", None, T.BIGINT)]
+    r = group_by(b, [0], specs, 8)  # raises on pool drift
+    assert int(np.asarray(r.num_groups)) == 5
+
+
+# ---------------------------------------------------------------------------
+# telemetry / surfaces
+# ---------------------------------------------------------------------------
+
+def test_query_stats_carry_narrowed_bytes_saved():
+    n = 256
+    _mem_table("t", ["k", "v"], [T.BIGINT, T.BIGINT],
+               [np.arange(n, dtype=np.int64) % 5,
+                np.arange(n, dtype=np.int64)])
+    os.environ["PRESTO_TPU_NARROW"] = "1"
+    try:
+        res = sql("SELECT k, sum(v) AS s FROM t GROUP BY k ORDER BY k",
+                  catalog="memory")
+    finally:
+        os.environ.pop("PRESTO_TPU_NARROW", None)
+    qs = res.query_stats
+    assert qs is not None
+    assert qs.counters.get("narrowed_bytes_saved", 0) > 0
+    assert qs.counters.get("narrowed_columns", 0) >= 2
+    # and the flat runtime counters carry it too
+    assert "narrowed_bytes_saved" in res.stats
+
+
+def test_explain_analyze_shows_widths_and_counter():
+    n = 128
+    _mem_table("t", ["k", "v"], [T.BIGINT, T.BIGINT],
+               [np.arange(n, dtype=np.int64) % 3,
+                np.arange(n, dtype=np.int64) % 1000])
+    from presto_tpu.plan.explain import explain_analyze
+    from presto_tpu.sql.planner import plan_sql
+    os.environ["PRESTO_TPU_NARROW"] = "1"
+    try:
+        txt = explain_analyze(
+            plan_sql("SELECT k, sum(v) AS s FROM t GROUP BY k",
+                     catalog="memory"), sf=0.0)
+    finally:
+        os.environ.pop("PRESTO_TPU_NARROW", None)
+    assert "widths={" in txt
+    assert "narrowed_bytes_saved" in txt
+
+
+def test_narrowing_metric_families_render_and_parse():
+    from presto_tpu.server.metrics import (narrowing_families,
+                                           parse_prometheus,
+                                           plan_cache_families,
+                                           render_prometheus)
+    text = render_prometheus(plan_cache_families()
+                             + narrowing_families()).decode()
+    doc = parse_prometheus(text)
+    # compile savings (plan cache) and staging savings (narrowing) are
+    # visible side by side on one scrape
+    assert "presto_tpu_plan_cache_hits_total" in doc
+    assert "presto_tpu_plan_cache_misses_total" in doc
+    assert "presto_tpu_narrowed_bytes_saved_total" in doc
+    assert "presto_tpu_narrowed_columns_total" in doc
+
+
+def test_session_property_disables_narrowing():
+    n = 64
+    _mem_table("t", ["v"], [T.BIGINT], [np.arange(n, dtype=np.int64)])
+    res = sql("SELECT sum(v) AS s FROM t", catalog="memory",
+              session={"narrow_width_execution": False})
+    qs = res.query_stats
+    assert qs is not None
+    assert qs.counters.get("narrowed_bytes_saved", 0) == 0
+    assert res.rows() == [(n * (n - 1) // 2,)]
+
+
+def test_plan_json_roundtrips_physical_dtypes():
+    from presto_tpu.plan import nodes as N
+    scan = N.TableScanNode("tpch", "lineitem", ["quantity"],
+                           [T.decimal(12, 2)],
+                           physical_dtypes=("int16",))
+    j = N.to_json(scan)
+    back = N.from_json(j)
+    assert back.physical_dtypes == ("int16",)
+
+
+def test_streaming_split_path_stages_narrow(monkeypatch):
+    """The per-split streaming program reads the same narrowed lanes
+    (exec/streaming.py routes through stage_scan_split)."""
+    from presto_tpu.exec.runner import run_query
+    from presto_tpu.exec.runner import prepare_plan
+    from presto_tpu.sql.planner import plan_sql
+
+    monkeypatch.setenv("PRESTO_TPU_NARROW", "1")
+    q = ("SELECT returnflag, sum(quantity) AS s FROM lineitem "
+         "GROUP BY returnflag ORDER BY returnflag")
+    root = prepare_plan(plan_sql(q), sf=0.01)
+    streamed = run_query(root, sf=0.01, split_rows=16384, prepared=True)
+    monkeypatch.setenv("PRESTO_TPU_NARROW", "0")
+    clear_plan_cache()
+    wide = sql(q, sf=0.01)
+    assert streamed.rows() == wide.rows()

@@ -1,0 +1,29 @@
+"""Tier-1 static check: hot-path kernel modules never construct
+implicit int64 arrays outside the whitelisted limb-widening sites
+(scripts/check_no_wide_lanes.py; narrow-width execution discipline)."""
+
+import os
+import sys
+
+_SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts")
+sys.path.insert(0, _SCRIPTS)
+
+
+def test_hot_path_modules_have_no_wide_lane_violations():
+    import check_no_wide_lanes as c
+    violations = c.check_all()
+    assert violations == [], "\n".join(violations)
+
+
+def test_checker_detects_wide_lanes_when_whitelist_empty():
+    """Sensitivity: the detector is not vacuous -- emptying the
+    whitelist must surface the real (deliberate) int64 accumulator
+    sites."""
+    import check_no_wide_lanes as c
+    orig = c.WIDE_OK_FUNCS
+    try:
+        c.WIDE_OK_FUNCS = {k: set() for k in orig}
+        assert len(c.check_all()) >= 10
+    finally:
+        c.WIDE_OK_FUNCS = orig
