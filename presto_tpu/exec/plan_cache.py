@@ -39,7 +39,7 @@ from ..plan import nodes as N
 from .planner import CompiledPlan, compile_plan
 
 __all__ = ["plan_fingerprint", "cached_compile", "cache_stats",
-           "clear_plan_cache"]
+           "clear_plan_cache", "KERNEL_MODE_ENVS"]
 
 _MAX_ENTRIES = 64
 
@@ -100,18 +100,25 @@ def _mesh_key(mesh) -> Optional[tuple]:
             tuple(d.id for d in mesh.devices.flat))
 
 
+# Trace-time env knobs that change the lowered program WITHOUT changing
+# the plan fingerprint (kernel form A/Bs: small-G scatter vs einsum,
+# Pallas on/off, narrow bf16 forms, large-G sort vs hash). Every entry
+# is part of the cache key; tpulint's R001 pass rejects any OTHER env
+# read in ops/ or exec/ (an unregistered knob would serve stale
+# executables compiled under the other mode).
+KERNEL_MODE_ENVS = (("PRESTO_TPU_SMALLG", "auto"),
+                    ("PRESTO_TPU_SMALLG_PALLAS", "1"),
+                    ("PRESTO_TPU_NARROW", "1"),
+                    ("PRESTO_TPU_BF16", "auto"),
+                    ("PRESTO_TPU_GROUPBY", "sort"))
+
+
 def _kernel_mode() -> str:
-    """Trace-time env knobs that change the lowered program WITHOUT
-    changing the plan fingerprint (kernel form A/Bs: small-G scatter vs
-    einsum, Pallas on/off, narrow bf16 forms, large-G sort vs hash).
-    Part of the cache key so an A/B toggle never serves a stale
-    executable compiled under the other mode."""
+    """The cache-key component built from KERNEL_MODE_ENVS."""
     import os
-    return "|".join((os.environ.get("PRESTO_TPU_SMALLG", "auto"),
-                     os.environ.get("PRESTO_TPU_SMALLG_PALLAS", "1"),
-                     os.environ.get("PRESTO_TPU_NARROW", "1"),
-                     os.environ.get("PRESTO_TPU_BF16", "auto"),
-                     os.environ.get("PRESTO_TPU_GROUPBY", "sort")))
+    # this IS the cache key: the one sanctioned ambient read
+    return "|".join(os.environ.get(name, default)  # tpulint: disable=R001
+                    for name, default in KERNEL_MODE_ENVS)
 
 
 def cached_compile(root: N.PlanNode, mesh, default_join_capacity: int,

@@ -1,0 +1,28 @@
+"""tpulint: pluggable AST static analysis for TPU hot-path and
+server-tier discipline.
+
+Public surface:
+
+  * ``run_passes`` / ``all_passes`` / ``get_pass`` -- the engine
+    (core.py); importing ``presto_tpu.lint.passes`` registers the
+    built-in passes (W001 wide-lanes, H001 host-sync, R001
+    retrace-risk, C001 lock-discipline, S001 swallowed-errors).
+  * ``load_baseline`` / ``apply_baseline`` -- grandfathered findings
+    (baseline.py, committed as ``tpulint_baseline.json``).
+  * ``cli.main`` -- what ``scripts/tpulint.py`` invokes.
+
+The passes themselves only touch ``ast`` (R001's plan-cache env list
+loads lazily, with a pinned fallback), but reaching this package runs
+``presto_tpu/__init__.py`` -- which imports jax -- so the CLI pays a
+few seconds of interpreter+jax startup, not the analysis. See
+DESIGN.md ("tpulint") for the pass-author guide and the
+suppression/baseline policy.
+"""
+
+from .baseline import apply_baseline, build_baseline, load_baseline  # noqa: F401
+from .core import (Finding, LintPass, LintResult, ModuleSource,  # noqa: F401
+                   all_passes, get_pass, register, run_passes)
+
+__all__ = ["Finding", "LintPass", "LintResult", "ModuleSource",
+           "all_passes", "get_pass", "register", "run_passes",
+           "load_baseline", "apply_baseline", "build_baseline"]

@@ -1,0 +1,114 @@
+"""tpulint baseline: grandfathered findings with reasons, committed.
+
+A baseline entry says "this finding is known, accepted, and here is
+why" -- the CI gate stays green while the debt stays visible. The file
+(``tpulint_baseline.json`` at the repo root) maps fingerprints (line-
+independent, see core.Finding.fingerprint) to ``{count, reason, ...}``.
+
+Semantics:
+
+  * A current finding whose fingerprint has baseline budget left is
+    *baselined* (not reported, counted separately).
+  * More current findings than the baselined count -> the EXCESS are
+    reported as new (a second copy of a grandfathered bug is still a
+    new bug).
+  * Fewer current findings than the baselined count -> the entry is
+    *stale* and reported (exit non-zero): the debt was paid, so the
+    baseline must shrink with it. ``--update-baseline`` rewrites the
+    file to match reality, preserving reasons for surviving entries.
+
+This expiry-on-improvement rule is what keeps a baseline from becoming
+a permanent bypass: entries only ever ratchet toward zero.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from .core import REPO, Finding
+
+__all__ = ["DEFAULT_BASELINE", "load_baseline", "save_baseline",
+           "apply_baseline", "build_baseline"]
+
+DEFAULT_BASELINE = os.path.join(REPO, "tpulint_baseline.json")
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: Optional[str] = None) -> Dict[str, dict]:
+    """-> {fingerprint: {code, path, context, message, count, reason}}.
+    A missing file is an empty baseline."""
+    path = path or DEFAULT_BASELINE
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if doc.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path}: unsupported version {doc.get('version')!r}")
+    return dict(doc.get("entries", {}))
+
+
+def save_baseline(entries: Dict[str, dict],
+                  path: Optional[str] = None) -> None:
+    path = path or DEFAULT_BASELINE
+    doc = {"version": BASELINE_VERSION,
+           "entries": {fp: entries[fp] for fp in sorted(entries)}}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def apply_baseline(findings: List[Finding], entries: Dict[str, dict]
+                   ) -> Tuple[List[Finding], int, List[dict]]:
+    """-> (new_findings, baselined_count, stale_entries).
+
+    stale_entries carry ``countExpected``/``countFound`` so the report
+    can say exactly how much debt was paid off."""
+    by_fp: Dict[str, List[Finding]] = {}
+    for f in findings:
+        by_fp.setdefault(f.fingerprint, []).append(f)
+
+    new: List[Finding] = []
+    baselined = 0
+    for fp, group in by_fp.items():
+        budget = int(entries.get(fp, {}).get("count", 0))
+        baselined += min(budget, len(group))
+        new.extend(group[budget:])
+
+    stale: List[dict] = []
+    for fp, e in entries.items():
+        found = len(by_fp.get(fp, ()))
+        if found < int(e.get("count", 0)):
+            stale.append({"fingerprint": fp, "code": e.get("code"),
+                          "path": e.get("path"),
+                          "message": e.get("message"),
+                          "reason": e.get("reason", ""),
+                          "countExpected": int(e.get("count", 0)),
+                          "countFound": found})
+    new.sort(key=Finding.sort_key)
+    stale.sort(key=lambda s: (s.get("path") or "", s["fingerprint"]))
+    return new, baselined, stale
+
+
+def build_baseline(findings: List[Finding],
+                   old_entries: Optional[Dict[str, dict]] = None,
+                   default_reason: str = "grandfathered"
+                   ) -> Dict[str, dict]:
+    """Baseline matching exactly the given findings; reasons carry over
+    from ``old_entries`` where the fingerprint survives."""
+    old_entries = old_entries or {}
+    out: Dict[str, dict] = {}
+    for f in findings:
+        e = out.get(f.fingerprint)
+        if e is not None:
+            e["count"] += 1
+            continue
+        out[f.fingerprint] = {
+            "code": f.code, "path": f.path, "context": f.context,
+            "message": f.message, "count": 1,
+            "reason": old_entries.get(f.fingerprint, {}).get(
+                "reason", default_reason)}
+    return out

@@ -1,0 +1,155 @@
+"""tpulint CLI: human and ``--json`` reporting, baseline management,
+and the CI exit-code contract.
+
+Exit codes (stable, relied on by tests/test_tpulint.py and CI):
+
+  0  clean -- no new findings, no stale baseline entries
+  1  new findings and/or stale baseline entries
+  2  internal error (unreadable file, bad baseline version, bad args)
+
+``--json`` emits one schema-versioned document on stdout (see
+``JSON_SCHEMA_VERSION``; tests pin the key set so downstream tooling
+can rely on it). Typical invocations::
+
+    python scripts/tpulint.py                    # whole repo, baseline
+    python scripts/tpulint.py --json             # machine-readable
+    python scripts/tpulint.py --select H001 ops/window.py
+    python scripts/tpulint.py --update-baseline  # accept current debt
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from .baseline import (DEFAULT_BASELINE, apply_baseline, build_baseline,
+                       load_baseline, save_baseline)
+from .core import all_passes, run_passes
+
+__all__ = ["main", "JSON_SCHEMA_VERSION"]
+
+JSON_SCHEMA_VERSION = 1
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpulint",
+        description="presto-tpu static analysis (hot-path + server-tier "
+                    "discipline)")
+    p.add_argument("paths", nargs="*",
+                   help="explicit files to lint (default: every pass's "
+                        "own target modules)")
+    p.add_argument("--select", metavar="CODES",
+                   help="comma-separated pass codes to run "
+                        "(e.g. W001,H001)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output (schema-versioned)")
+    p.add_argument("--baseline", metavar="PATH", default=None,
+                   help=f"baseline file (default {DEFAULT_BASELINE})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignore the baseline")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline to match current findings "
+                        "(preserves reasons for surviving entries)")
+    p.add_argument("--list-passes", action="store_true",
+                   help="list registered passes and exit")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _parser().parse_args(argv)
+
+    if args.list_passes:
+        for p in all_passes():
+            print(f"{p.code}  {p.name:18s} {p.description}")
+        return 0
+
+    codes = None
+    if args.select:
+        codes = [c.strip().upper() for c in args.select.split(",")
+                 if c.strip()]
+        known = {p.code for p in all_passes()}
+        unknown = [c for c in codes if c not in known]
+        if unknown:
+            print(f"tpulint: unknown pass code(s): {', '.join(unknown)} "
+                  f"(known: {', '.join(sorted(known))})", file=sys.stderr)
+            return 2
+
+    try:
+        result = run_passes(codes=codes, paths=args.paths or None)
+    except (OSError, SyntaxError) as e:
+        print(f"tpulint: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+
+    # A partial run (explicit paths and/or --select) scans a subset of
+    # the baseline's universe: entries for unscanned files/passes
+    # produce zero findings WITHOUT the debt being paid. Stale
+    # detection and --update-baseline therefore operate only on
+    # IN-SCOPE entries (scanned file x selected pass); everything else
+    # is preserved untouched.
+    partial = bool(args.paths) or bool(args.select)
+
+    def in_scope(entry: dict) -> bool:
+        if not partial:
+            return True
+        # a --select-only run still scans only the SELECTED passes'
+        # target files, so the file membership check applies to every
+        # partial run, not just explicit-path ones
+        return entry.get("code") in result.pass_codes and \
+            entry.get("path") in result.files
+
+    baselined = 0
+    stale: List[dict] = []
+    new = result.findings
+    if not args.no_baseline:
+        try:
+            entries = load_baseline(args.baseline)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"tpulint: bad baseline: {e}", file=sys.stderr)
+            return 2
+        if args.update_baseline:
+            kept = {fp: e for fp, e in entries.items()
+                    if not in_scope(e)} if partial else {}
+            rebuilt = build_baseline(result.findings, entries)
+            rebuilt.update(kept)  # fingerprints encode code+path, so
+            # out-of-scope entries cannot collide with rebuilt ones
+            save_baseline(rebuilt, args.baseline)
+            entries = rebuilt
+        new, baselined, stale = apply_baseline(result.findings, entries)
+        if partial:
+            stale = [s for s in stale
+                     if in_scope(entries.get(s["fingerprint"], {}))]
+
+    if args.as_json:
+        doc = {
+            "version": JSON_SCHEMA_VERSION,
+            "passes": result.pass_codes,
+            "filesScanned": result.files_scanned,
+            "findings": [f.to_json() for f in new],
+            "baselined": baselined,
+            "suppressed": result.suppressed,
+            "staleBaseline": stale,
+        }
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        for f in new:
+            print(f.render())
+        for s in stale:
+            print(f"stale baseline entry {s['fingerprint']} "
+                  f"({s['code']} {s['path']}): expected "
+                  f"{s['countExpected']}, found {s['countFound']} -- "
+                  f"debt paid, run --update-baseline")
+        summary = (f"{len(new)} finding(s), {baselined} baselined, "
+                   f"{result.suppressed} suppressed, {len(stale)} stale "
+                   f"baseline entr(ies) across "
+                   f"{result.files_scanned} file(s) "
+                   f"[{','.join(result.pass_codes)}]")
+        print(("FAIL " if (new or stale) else "ok ") + summary)
+
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,279 @@
+"""tpulint core: the pass registry, finding model, and run engine.
+
+presto-tpu's correctness and performance contracts are mostly invisible
+to the type system: an implicit int64 lane doubles HBM traffic on v5e,
+a stray ``.item()`` on a traced value inserts a silent device->host
+sync into a jit'd pipeline, and a shared coordinator/worker field
+mutated outside its lock is a data race waiting for load. tpulint
+encodes each such contract as an AST pass over the exact modules where
+it is load-bearing.
+
+Architecture (one screen):
+
+  * ``Finding`` -- one diagnostic: pass code, file, line, enclosing
+    context (dotted function path), message. Its ``fingerprint`` hashes
+    everything EXCEPT the line number, so a committed baseline survives
+    unrelated edits above a grandfathered site.
+  * ``LintPass`` -- subclass per rule family. Declares ``code``
+    (``W001``...), ``TARGETS`` (repo-relative globs it runs over by
+    default), and implements ``run(module) -> [Finding]``. Register
+    with the ``@register`` decorator; ``presto_tpu.lint.passes``
+    imports every pass module so importing the package populates the
+    registry.
+  * ``ModuleSource`` -- one parsed file, shared across passes (parse
+    once, lint five times) with per-line suppressions pre-extracted.
+  * ``run_passes`` -- the engine: map passes over files, drop findings
+    the source suppressed inline, return a ``LintResult``.
+
+Suppressions: ``# tpulint: disable=H001`` (or ``disable=H001,W001``,
+or ``disable=all``) on the finding's own line. Baselines (grandfathered
+findings with a reason) live one layer up in ``baseline.py`` -- the
+engine knows nothing about them.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import glob as _glob
+import hashlib
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+__all__ = ["REPO", "Finding", "ModuleSource", "LintPass", "register",
+           "all_passes", "get_pass", "LintResult", "run_passes",
+           "dotted_context", "has_jit_decorator"]
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*disable=([A-Za-z0-9_,\s]+|all)")
+
+
+def dotted_context(stack: Sequence[str]) -> str:
+    """Human context for a class/function name stack: the last two
+    segments dotted (``Cls.method``), or ``<module>`` at top level.
+    Shared by every pass so finding contexts (and so baseline
+    fingerprints) render identically across them."""
+    if len(stack) > 1:
+        return ".".join(stack[-2:])
+    return stack[0] if stack else "<module>"
+
+
+def has_jit_decorator(node: ast.AST) -> bool:
+    """True when a function carries a jit decorator in any spelling the
+    codebase uses: ``@jax.jit``, ``@jit``, ``@partial(jax.jit, ...)``.
+    One copy here so H001/R001 (and future passes) cannot diverge on
+    what counts as a traced function."""
+    for dec in getattr(node, "decorator_list", ()):
+        for sub in ast.walk(dec):
+            if isinstance(sub, ast.Attribute) and sub.attr == "jit":
+                return True
+            if isinstance(sub, ast.Name) and sub.id == "jit":
+                return True
+    return False
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic. ``path`` is repo-relative with forward slashes
+    (stable across checkouts); ``context`` is the dotted enclosing
+    function/class path (``<module>`` at top level)."""
+
+    code: str
+    path: str
+    line: int
+    col: int
+    context: str
+    message: str
+
+    @property
+    def fingerprint(self) -> str:
+        """Line-independent identity: survives edits that only move a
+        grandfathered site. Two identical violations in the same
+        function share a fingerprint -- the baseline stores a count."""
+        raw = f"{self.code}|{self.path}|{self.context}|{self.message}"
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.code, self.message)
+
+    def to_json(self) -> dict:
+        return {"code": self.code, "path": self.path, "line": self.line,
+                "col": self.col, "context": self.context,
+                "message": self.message, "fingerprint": self.fingerprint}
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.code} "
+                f"[{self.context}] {self.message}")
+
+
+class ModuleSource:
+    """One parsed source file, shared by every pass that targets it."""
+
+    def __init__(self, rel_path: str, repo: str = REPO,
+                 text: Optional[str] = None):
+        self.rel_path = rel_path.replace(os.sep, "/")
+        self.abs_path = os.path.join(repo, rel_path)
+        if text is None:
+            with open(self.abs_path, encoding="utf-8") as f:
+                text = f.read()
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=self.rel_path)
+        self._suppressions = self._parse_suppressions()
+
+    @property
+    def basename(self) -> str:
+        return os.path.basename(self.rel_path)
+
+    def _parse_suppressions(self) -> Dict[int, Set[str]]:
+        out: Dict[int, Set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            if "tpulint" not in line:
+                continue
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+            out[i] = codes
+        return out
+
+    def suppressed(self, code: str, line: int) -> bool:
+        codes = self._suppressions.get(line)
+        return bool(codes) and (code in codes or "all" in codes)
+
+    def finding(self, code: str, node: ast.AST, context: str,
+                message: str) -> Finding:
+        return Finding(code=code, path=self.rel_path,
+                       line=getattr(node, "lineno", 0),
+                       col=getattr(node, "col_offset", 0),
+                       context=context, message=message)
+
+
+class LintPass:
+    """Base class: subclass, set the class attributes, implement run().
+
+    ``TARGETS`` are repo-relative paths or globs the pass scans when the
+    CLI is invoked with no explicit files. Explicit files on the command
+    line run through EVERY selected pass regardless of targets (that is
+    how the fixture corpus exercises each pass)."""
+
+    code: str = "X000"
+    name: str = "unnamed"
+    description: str = ""
+    TARGETS: Sequence[str] = ()
+
+    def target_files(self, repo: str = REPO) -> List[str]:
+        files: List[str] = []
+        for pat in self.TARGETS:
+            matches = sorted(_glob.glob(os.path.join(repo, pat)))
+            files.extend(os.path.relpath(m, repo).replace(os.sep, "/")
+                         for m in matches if m.endswith(".py"))
+        return files
+
+    def run(self, module: ModuleSource) -> List[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, LintPass] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and index the pass by its code."""
+    inst = cls()
+    assert inst.code not in _REGISTRY or \
+        type(_REGISTRY[inst.code]) is cls, \
+        f"duplicate pass code {inst.code}"
+    _REGISTRY[inst.code] = inst
+    return cls
+
+
+def all_passes() -> List[LintPass]:
+    _load_builtin_passes()
+    return [_REGISTRY[c] for c in sorted(_REGISTRY)]
+
+
+def get_pass(code: str) -> LintPass:
+    _load_builtin_passes()
+    return _REGISTRY[code]
+
+
+def _load_builtin_passes() -> None:
+    # importing the package registers every built-in pass exactly once
+    from . import passes  # noqa: F401
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]
+    suppressed: int
+    files: List[str]  # repo-relative paths actually scanned
+    pass_codes: List[str]
+
+    @property
+    def files_scanned(self) -> int:
+        return len(self.files)
+
+
+def run_passes(codes: Optional[Iterable[str]] = None,
+               paths: Optional[Sequence[str]] = None,
+               repo: str = REPO) -> LintResult:
+    """Run the selected passes (all registered, by default) over their
+    default targets -- or over ``paths`` when given (repo-relative or
+    absolute). Inline suppressions are applied here; baselining is the
+    caller's concern (see baseline.py)."""
+    _load_builtin_passes()
+    selected = [get_pass(c) for c in sorted(codes)] if codes else \
+        all_passes()
+    sources: Dict[str, ModuleSource] = {}
+
+    def source_of(rel: str) -> ModuleSource:
+        # an unreadable or unparseable target is an ERROR (propagated;
+        # the CLI exits 2) -- silently skipping it would let a typo'd
+        # path or a broken module turn the whole gate green
+        if rel not in sources:
+            sources[rel] = ModuleSource(rel, repo)
+        return sources[rel]
+
+    explicit: Optional[List[str]] = None
+    if paths is not None:
+        explicit = []
+        for p in paths:
+            ap = p if os.path.isabs(p) else os.path.join(os.getcwd(), p)
+            explicit.append(
+                os.path.relpath(ap, repo).replace(os.sep, "/"))
+
+    # Explicit paths honor pass targeting: a file inside SOME selected
+    # pass's targets is only scanned by the passes that own it (so
+    # `tpulint presto_tpu/server/worker.py` doesn't fire hot-path-only
+    # rules on server code and poison the baseline), while a file
+    # outside EVERY selected pass's targets (fixtures, scratch files)
+    # runs through all of them -- explicit wins when nothing claims it.
+    target_sets: Dict[str, Set[str]] = {}
+    union: Set[str] = set()
+    if explicit is not None:
+        for p in selected:
+            target_sets[p.code] = set(p.target_files(repo))
+            union |= target_sets[p.code]
+
+    findings: List[Finding] = []
+    suppressed = 0
+    for p in selected:
+        if explicit is not None:
+            files = [f for f in explicit
+                     if f in target_sets[p.code] or f not in union]
+        else:
+            files = p.target_files(repo)
+        for rel in files:
+            ms = source_of(rel)
+            for f in p.run(ms):
+                if ms.suppressed(f.code, f.line):
+                    suppressed += 1
+                else:
+                    findings.append(f)
+    findings.sort(key=Finding.sort_key)
+    return LintResult(findings=findings, suppressed=suppressed,
+                      files=sorted(sources),
+                      pass_codes=[p.code for p in selected])

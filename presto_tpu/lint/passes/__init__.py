@@ -1,0 +1,5 @@
+"""Built-in tpulint passes. Importing this package registers every
+pass with the core registry (core.register decorator side effect); add
+a new pass by dropping a module here and importing it below."""
+
+from . import host_sync, locks, retrace, swallowed, wide_lanes  # noqa: F401

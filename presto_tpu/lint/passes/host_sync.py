@@ -1,0 +1,140 @@
+"""H001: no host round-trips in trace-reachable hot-path code.
+
+The whole point of compiling a query fragment to one XLA program is
+that the device never waits on the host mid-pipeline (Flare makes the
+same argument for native query compilation: the compiled region lives
+or dies by staying free of interpreter round-trips). A stray
+``.item()``, ``np.asarray``, or ``float()`` on a traced value either
+fails tracing outright or -- worse -- silently splits the program and
+serializes device->host->device on every batch.
+
+Scope is path-dependent:
+
+  * ``presto_tpu/ops/`` -- kernel tier: the WHOLE module is treated as
+    trace-reachable, except functions whitelisted in HOST_OK_FUNCS
+    (plan-time table builders and similar host-side constructors).
+  * ``presto_tpu/exec/`` -- orchestration tier: only code lexically
+    inside ``@jax.jit``-decorated functions (and their nested defs) is
+    checked; everything else in exec/ is the host-side driver where
+    syncs are the job, not a bug.
+  * anything else (fixtures, explicit CLI paths): whole module.
+
+Flagged constructs: ``.item()``, ``np.asarray(...)``,
+``jnp.asarray(...)`` WITHOUT a dtype (with an explicit dtype it reads
+as deliberate staging of host data; without one it is either a no-op
+wrapper or a disguised transfer), ``jax.device_get``,
+``(jax.)block_until_ready``, and ``int()/float()/bool()`` applied to a
+``jnp``-rooted expression.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from ..core import (Finding, LintPass, ModuleSource, dotted_context,
+                    has_jit_decorator, register)
+
+__all__ = ["HostSyncPass"]
+
+# host-side helpers living inside ops/ modules: plan-time constant
+# construction, not per-batch traced code
+HOST_OK_FUNCS: Dict[str, Set[str]] = {
+    # DFA construction runs once per pattern at plan time; the tables
+    # it builds are numpy constants the kernel closes over
+    "regex.py": {"compile_dfa"},
+}
+
+_SYNC_METHODS = {"item": ".item() forces a device->host sync",
+                 "block_until_ready": ".block_until_ready() stalls the "
+                                      "pipeline on device completion"}
+
+_COERCIONS = {"int", "float", "bool"}
+
+
+def _contains_jnp(node: ast.AST) -> bool:
+    return any(isinstance(sub, ast.Name) and sub.id == "jnp"
+               for sub in ast.walk(node))
+
+
+@register
+class HostSyncPass(LintPass):
+    code = "H001"
+    name = "host-sync"
+    description = ("host round-trips (.item/np.asarray/device_get/"
+                   "block_until_ready) in trace-reachable hot-path code")
+    TARGETS = ("presto_tpu/ops/*.py", "presto_tpu/exec/*.py")
+
+    def run(self, ms: ModuleSource) -> List[Finding]:
+        jit_only = ms.rel_path.startswith("presto_tpu/exec/")
+        host_ok = HOST_OK_FUNCS.get(ms.basename, set())
+        findings: List[Finding] = []
+        stack: List[str] = []
+        jit_depth = 0  # > 0 while inside a jit-decorated function
+
+        def context() -> str:
+            return dotted_context(stack)
+
+        def active() -> bool:
+            if jit_only:
+                return jit_depth > 0
+            return not (stack and stack[0] in host_ok)
+
+        def emit(node: ast.AST, message: str) -> None:
+            findings.append(ms.finding("H001", node, context(), message))
+
+        class V(ast.NodeVisitor):
+            def visit_FunctionDef(self, node):
+                nonlocal jit_depth
+                jitted = has_jit_decorator(node)
+                stack.append(node.name)
+                jit_depth += 1 if jitted else 0
+                self.generic_visit(node)
+                jit_depth -= 1 if jitted else 0
+                stack.pop()
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_ClassDef(self, node):
+                stack.append(node.name)
+                self.generic_visit(node)
+                stack.pop()
+
+            def visit_Call(self, node):
+                if active():
+                    self._check_call(node)
+                self.generic_visit(node)
+
+            def _check_call(self, node):
+                fn = node.func
+                if isinstance(fn, ast.Attribute):
+                    if fn.attr in _SYNC_METHODS and not node.args:
+                        emit(node, _SYNC_METHODS[fn.attr])
+                    elif isinstance(fn.value, ast.Name):
+                        root, attr = fn.value.id, fn.attr
+                        if root == "np" and attr == "asarray":
+                            emit(node, "np.asarray(...) copies device "
+                                       "data to host mid-pipeline")
+                        elif root == "jnp" and attr == "asarray" and \
+                                not any(k.arg == "dtype"
+                                        for k in node.keywords):
+                            emit(node,
+                                 "jnp.asarray(...) without a dtype: "
+                                 "either a redundant wrapper on a "
+                                 "traced value or a disguised host "
+                                 "transfer -- drop it or stage "
+                                 "explicitly with dtype=")
+                        elif root == "jax" and attr == "device_get":
+                            emit(node, "jax.device_get(...) forces a "
+                                       "device->host sync")
+                        elif root == "jax" and attr == "block_until_ready":
+                            emit(node, _SYNC_METHODS["block_until_ready"])
+                elif isinstance(fn, ast.Name) and fn.id in _COERCIONS \
+                        and len(node.args) == 1 \
+                        and _contains_jnp(node.args[0]):
+                    emit(node, f"{fn.id}(...) on a jnp expression "
+                               f"forces a device->host sync (and fails "
+                               f"under tracing)")
+
+        V().visit(ms.tree)
+        return findings

@@ -1,0 +1,190 @@
+"""C001: lock discipline on shared server-tier state.
+
+The coordinator/worker tier shares registries (tasks, queries, nodes,
+rates, counters) across request-handler threads. Python's GIL makes
+single-opcode races rare enough that the bug ships and only fires
+under load -- so the discipline is declared, then enforced statically.
+
+Declaration convention: a class lists its guarded attributes in a
+class-level ``_GUARDED_BY`` dict literal::
+
+    class TaskManager:
+        _GUARDED_BY = {"_tasks_lock": ("tasks", "draining"),
+                       "_counters_lock": ("counters",)}
+
+The pass then requires every WRITE (assign / augmented assign /
+``del``, including subscript writes like ``self.tasks[k] = v``) to a
+guarded attribute to sit lexically inside ``with <recv>.<lock>:``
+where ``<recv>`` is the same receiver the write uses (``self._state``
+under ``with self._lock``, ``task.state`` under ``with task.lock``).
+Receiver matching is by attribute NAME module-wide, so helper code in
+the same module that mutates another object's guarded field is checked
+too (the TaskManager methods mutating ``_Task`` fields).
+
+Escape hatches, all visible in the code:
+
+  * ``__init__`` / ``__del__`` writes through ``self`` are exempt (the
+    object is not yet / no longer shared).
+  * functions whose name ends in ``_locked`` are exempt -- the
+    caller-holds-the-lock convention (document it in the docstring).
+  * reads, and mutation through method calls (``d.pop(k)``,
+    ``l.append(x)``), are out of scope: the pass is a write-barrier
+    checker, not an escape analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..core import (Finding, LintPass, ModuleSource, dotted_context,
+                    register)
+
+__all__ = ["LockDisciplinePass", "GUARDED_BY_ATTR"]
+
+GUARDED_BY_ATTR = "_GUARDED_BY"
+
+
+def _guarded_map(tree: ast.Module) -> Dict[str, Tuple[str, str]]:
+    """module-wide {guarded_attr: (class_name, lock_attr)} from every
+    class-level _GUARDED_BY dict literal."""
+    out: Dict[str, Tuple[str, str]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for stmt in node.body:
+            if not (isinstance(stmt, ast.Assign) and
+                    len(stmt.targets) == 1 and
+                    isinstance(stmt.targets[0], ast.Name) and
+                    stmt.targets[0].id == GUARDED_BY_ATTR and
+                    isinstance(stmt.value, ast.Dict)):
+                continue
+            for k, v in zip(stmt.value.keys, stmt.value.values):
+                if not (isinstance(k, ast.Constant) and
+                        isinstance(k.value, str)):
+                    continue
+                attrs = []
+                if isinstance(v, (ast.Tuple, ast.List)):
+                    attrs = [e.value for e in v.elts
+                             if isinstance(e, ast.Constant) and
+                             isinstance(e.value, str)]
+                elif isinstance(v, ast.Constant) and \
+                        isinstance(v.value, str):
+                    attrs = [v.value]
+                for a in attrs:
+                    out[a] = (node.name, k.value)
+    return out
+
+
+def _attr_write_target(node: ast.AST) -> Optional[Tuple[str, str]]:
+    """(receiver_name, attr) when ``node`` is ``<name>.<attr>`` or a
+    subscript chain rooted there (``<name>.<attr>[k]``...)."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name):
+        return node.value.id, node.attr
+    return None
+
+
+@register
+class LockDisciplinePass(LintPass):
+    code = "C001"
+    name = "lock-discipline"
+    description = ("writes to _GUARDED_BY-declared attributes outside "
+                   "their `with <lock>:` block")
+    TARGETS = ("presto_tpu/server/*.py",)
+
+    def run(self, ms: ModuleSource) -> List[Finding]:
+        guarded = _guarded_map(ms.tree)
+        if not guarded:
+            return []
+        findings: List[Finding] = []
+        stack: List[str] = []            # class/function names
+        held: List[Tuple[str, str]] = []  # (receiver, lock_attr) stack
+        # exemption is a property of the INNERMOST enclosing def only:
+        # a closure defined inside __init__/__del__/*_locked runs later
+        # (thread target, callback) when the object IS shared / the
+        # lock is NOT held, so it must not inherit the exemption
+        exempt_stack: List[bool] = []
+
+        def context() -> str:
+            return dotted_context(stack)
+
+        def exempt_scope() -> bool:
+            return bool(exempt_stack) and exempt_stack[-1]
+
+        def check_target(t: ast.AST, stmt: ast.AST) -> None:
+            rt = _attr_write_target(t)
+            if rt is None:
+                return
+            recv, attr = rt
+            if attr not in guarded:
+                return
+            cls, lock = guarded[attr]
+            if exempt_scope():
+                return
+            if (recv, lock) in held:
+                return
+            findings.append(ms.finding(
+                "C001", stmt, context(),
+                f"write to {attr!r} (guarded by {cls}.{lock}) outside "
+                f"`with {recv}.{lock}:`"))
+
+        class V(ast.NodeVisitor):
+            def visit_FunctionDef(self, node):
+                stack.append(node.name)
+                exempt_stack.append(
+                    node.name in ("__init__", "__del__") or
+                    node.name.endswith("_locked"))
+                # a nested def's body runs LATER (callback, thread
+                # target): locks held at the def site are not held at
+                # call time, so the held stack must not leak in
+                saved = held[:]
+                del held[:]
+                self.generic_visit(node)
+                held[:] = saved
+                exempt_stack.pop()
+                stack.pop()
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_ClassDef(self, node):
+                stack.append(node.name)
+                self.generic_visit(node)
+                stack.pop()
+
+            def visit_With(self, node):
+                pushed = 0
+                for item in node.items:
+                    ce = item.context_expr
+                    if isinstance(ce, ast.Attribute) and \
+                            isinstance(ce.value, ast.Name):
+                        held.append((ce.value.id, ce.attr))
+                        pushed += 1
+                self.generic_visit(node)
+                del held[len(held) - pushed:]
+
+            def visit_Assign(self, node):
+                for t in node.targets:
+                    for sub in ([t.elts] if isinstance(
+                            t, (ast.Tuple, ast.List)) else [[t]])[0]:
+                        check_target(sub, node)
+                self.generic_visit(node)
+
+            def visit_AugAssign(self, node):
+                check_target(node.target, node)
+                self.generic_visit(node)
+
+            def visit_AnnAssign(self, node):
+                if node.value is not None:
+                    check_target(node.target, node)
+                self.generic_visit(node)
+
+            def visit_Delete(self, node):
+                for t in node.targets:
+                    check_target(t, node)
+                self.generic_visit(node)
+
+        V().visit(ms.tree)
+        return findings

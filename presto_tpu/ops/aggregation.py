@@ -189,7 +189,7 @@ def _group_ids(key_cols: Sequence[Block], active: jnp.ndarray, max_groups: int):
         ids = jnp.zeros(n, dtype=jnp.int32)
         perm_first = jnp.zeros(max_groups, dtype=jnp.int32)
         num_groups = jnp.any(active).astype(jnp.int32)
-        return ids, perm_first, num_groups, jnp.asarray(False)
+        return ids, perm_first, num_groups, jnp.zeros((), dtype=bool)
     if max_groups <= _SMALL_G:
         return _group_ids_small(words, active, max_groups)
     return _group_ids_hash(words, active, max_groups)

@@ -335,8 +335,9 @@ def regexp_like_kernel(chars: jnp.ndarray, lengths: jnp.ndarray,
                        ) -> jnp.ndarray:
     """Row-vectorized DFA search over a (n, w) char matrix."""
     n, w = chars.shape
-    tbl = jnp.asarray(table)
-    acc = jnp.asarray(accepting)
+    # plan-time numpy constants staged to device with explicit lanes
+    tbl = jnp.asarray(table, dtype=jnp.uint8)
+    acc = jnp.asarray(accepting, dtype=bool)
 
     state = tbl[jnp.zeros(n, dtype=jnp.int32), 256]  # consume BOL
     matched = acc[state]

@@ -244,7 +244,7 @@ def window(batch: Batch, partition_channels: Sequence[int],
                         (f_lo + (spec.offset - 1 if name == "nth_value"
                                  else 0) <= f_hi)
                     nl = (col.nulls | ~batch.active)[perm]
-                    nulls = jnp.asarray(nl[idx] | ~in_frame | ~s_active)
+                    nulls = nl[idx] | ~in_frame | ~s_active
                     out_cols.append(Int128Column(
                         col.hi[perm][idx][inv], col.lo[perm][idx][inv],
                         nulls[inv], spec.output_type))
@@ -268,7 +268,7 @@ def window(batch: Batch, partition_channels: Sequence[int],
                     empty = (wcnt == 0) | empty_frame | ~s_active
                     out_cols.append(Int128Column(
                         sh[f_hi_c][inv], sl[f_hi_c][inv],
-                        jnp.asarray(empty)[inv], spec.output_type))
+                        empty[inv], spec.output_type))
                     continue
                 if name not in ("sum", "avg", "count"):
                     raise NotImplementedError(
@@ -276,7 +276,7 @@ def window(batch: Batch, partition_channels: Sequence[int],
                 wcnt = frame_total(nn_sorted.astype(jnp.int64))
                 if name == "count":
                     out_cols.append(Column(wcnt[inv],
-                                           jnp.asarray(~s_active)[inv],
+                                           (~s_active)[inv],
                                            spec.output_type))
                     continue
                 totals = [frame_total(jnp.where(nn_sorted, l[perm], 0))
@@ -289,7 +289,7 @@ def window(batch: Batch, partition_channels: Sequence[int],
                     hi = (qv >> 63).astype(hi.dtype)
                     lo = qv.astype(jnp.uint64)
                 out_cols.append(Int128Column(hi[inv], lo[inv],
-                                             jnp.asarray(empty)[inv],
+                                             empty[inv],
                                              spec.output_type))
                 continue
             v_sorted = col.values[perm]
@@ -359,8 +359,10 @@ def window(batch: Batch, partition_channels: Sequence[int],
         else:
             raise NotImplementedError(name)
 
-        vals = jnp.asarray(vals_sorted)[inv]
-        nulls = jnp.asarray(nulls_sorted)[inv]
+        # every branch above produces traced jnp arrays; indexing them
+        # directly keeps the jit region wrapper-free (tpulint H001)
+        vals = vals_sorted[inv]
+        nulls = nulls_sorted[inv]
         dt = spec.output_type.to_dtype()
         vals = vals.astype(dt)
         out_cols.append(Column(vals, nulls, spec.output_type))

@@ -120,5 +120,6 @@ class SpoolingOutputBuffer:
     def __del__(self):  # best-effort spool reclamation
         try:
             self.clear()
-        except Exception:  # noqa: BLE001
+        except Exception:  # tpulint: disable=S001 - interpreter
+            # teardown: logging/metrics modules may already be gone
             pass

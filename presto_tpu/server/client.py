@@ -92,8 +92,11 @@ class WorkerClient:
                 self._local.conn = None
                 try:
                     conn.close()
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as ce:  # noqa: BLE001 - already
+                    # failing; `ce` not `e`: an inner `as e` would
+                    # delete the outer binding on handler exit
+                    from .metrics import record_suppressed
+                    record_suppressed("worker_client", "conn_close", ce)
                 last_err = e
                 if attempt == 1:
                     raise

@@ -31,6 +31,7 @@ from ..connectors import catalog
 from ..plan import fragment_plan, nodes as N
 from .client import WorkerClient
 from .discovery import alive_nodes
+from .metrics import record_suppressed
 
 __all__ = ["Coordinator", "SchedulerGap"]
 
@@ -147,8 +148,8 @@ class Coordinator:
                 # still-running task stops buffering pages
                 try:
                     WorkerClient(url, timeout).abort(tid)
-                except Exception:  # noqa: BLE001 - worker may be dead
-                    pass
+                except Exception as e:  # noqa: BLE001 - worker may be dead
+                    record_suppressed("coordinator", "abort_attempt", e)
                 if retries_left <= 0:
                     raise RuntimeError(
                         f"task {tid} failed everywhere: {last_err}")
@@ -228,8 +229,8 @@ class Coordinator:
             for url, tid in submitted:
                 try:
                     WorkerClient(url, min(timeout, 5.0)).abort(tid)
-                except Exception:  # noqa: BLE001 - best-effort cleanup
-                    pass
+                except Exception as e:  # noqa: BLE001 - best-effort cleanup
+                    record_suppressed("coordinator", "task_cleanup", e)
 
     def _merge_task_stats(self, produced, timeout: float):
         """Fold every produced task's shipped QueryStats into one
@@ -357,8 +358,10 @@ class Coordinator:
                             continue  # alive and done: pages readable
                         if info.get("state") in ("PLANNED", "RUNNING"):
                             continue  # still producing: consumer waits
-                    except Exception:  # noqa: BLE001 - dead worker
-                        pass
+                    except Exception as e:  # noqa: BLE001 - dead worker:
+                        # fall through to re-running the producer below
+                        record_suppressed("coordinator",
+                                          "probe_upstream", e)
                     fid_w = origin.get(tid)
                     if fid_w is None:
                         continue  # not ours to re-run

@@ -27,6 +27,7 @@ __all__ = ["DiscoveryServer", "Announcer", "alive_nodes",
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    _GUARDED_BY = {"lock": ("nodes",)}  # tpulint C001
     nodes: Dict[str, dict] = {}
     lock = threading.Lock()
     authenticator = None  # InternalAuthenticator when a secret is set
@@ -145,11 +146,15 @@ class Announcer:
 
     def start(self):
         def loop():
+            from .metrics import record_suppressed
             while not self._stop.is_set():
                 try:
                     self.announce_once()
-                except Exception:
-                    pass  # discovery outage: keep trying (airlift behavior)
+                except Exception as e:  # noqa: BLE001
+                    # discovery outage: keep trying (airlift behavior),
+                    # but leave a trace -- a worker that never manages
+                    # to announce is otherwise invisible
+                    record_suppressed("announcer", "announce", e)
                 self._stop.wait(self.interval)
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()
@@ -169,8 +174,9 @@ class Announcer:
                     method="DELETE",
                     headers=dict(self._headers()))
                 urllib.request.urlopen(req, timeout=5).read()
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 - best-effort goodbye
+                from .metrics import record_suppressed
+                record_suppressed("announcer", "unannounce", e)
 
 
 class HeartbeatProber:
@@ -180,6 +186,8 @@ class HeartbeatProber:
     subset. Unlike the announcement-age detector (alive_nodes), this
     notices a wedged-but-announcing worker and recovers a node as soon
     as probes succeed again."""
+
+    _GUARDED_BY = {"_lock": ("_rates",)}  # tpulint C001
 
     def __init__(self, urls_fn, interval_s: float = 0.5,
                  decay: float = 0.7, threshold: float = 0.5,

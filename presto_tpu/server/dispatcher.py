@@ -250,7 +250,11 @@ class Dispatcher:
                     if remote + g.stats()["running"] >= limit:
                         blocked = (prefix, limit)
                         break
-            except Exception:  # noqa: BLE001 - RM down: local-only
+            except Exception as e:  # noqa: BLE001 - RM down: degrade
+                # to local-only admission, but count it -- a flapping
+                # RM silently disabling cluster limits is an outage
+                from .metrics import record_suppressed
+                record_suppressed("dispatcher", "rm_gate", e)
                 return
             if blocked is None:
                 return

@@ -47,8 +47,10 @@ class EventListenerManager:
         for cb in listeners:
             try:
                 cb(name, payload)
-            except Exception:  # noqa: BLE001 - observers never fail queries
-                pass
+            except Exception as e:  # noqa: BLE001 - observers never
+                # fail queries; a broken listener is still worth a count
+                from .metrics import record_suppressed
+                record_suppressed("events", "listener", e)
 
     def query_created(self, query_id: str, text: str = "", user: str = ""):
         self.fire("QueryCreated", {"queryId": query_id, "query": text,

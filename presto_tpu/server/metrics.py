@@ -16,11 +16,14 @@ per label set. Labels are rendered sorted for deterministic scrapes
 
 from __future__ import annotations
 
+import logging
+import threading
 from typing import Dict, List, Optional, Tuple, Union
 
 __all__ = ["MetricFamily", "render_prometheus", "parse_prometheus",
            "plan_cache_families", "narrowing_families", "uptime_family",
-           "CONTENT_TYPE"]
+           "record_suppressed", "suppressed_error_families",
+           "suppressed_error_totals", "CONTENT_TYPE"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -99,6 +102,56 @@ def narrowing_families() -> List[MetricFamily]:
                      "scan columns staged at a narrowed physical "
                      "lane").add(t["columns"]),
     ]
+
+
+# -- suppressed handler errors ------------------------------------------
+#
+# Server-tier contract (enforced statically by tpulint's S001 pass): a
+# request handler/background loop that intentionally survives an
+# exception must still LEAVE A TRACE -- one debug log line plus a
+# lifetime counter labelled by (component, site), exported on
+# /v1/metrics by both tiers. "Swallowed but counted" is observable;
+# "swallowed" is a silent outage.
+
+_SUPPRESSED_LOCK = threading.Lock()
+_SUPPRESSED: Dict[Tuple[str, str], int] = {}
+_log = logging.getLogger("presto_tpu.server")
+
+
+def record_suppressed(component: str, site: str,
+                      exc: Optional[BaseException] = None) -> None:
+    """Count (and debug-log) an intentionally survived exception.
+    Never raises: this runs inside except blocks on cleanup paths."""
+    with _SUPPRESSED_LOCK:
+        key = (component, site)
+        _SUPPRESSED[key] = _SUPPRESSED.get(key, 0) + 1
+    if exc is not None:
+        try:
+            _log.debug("suppressed error in %s.%s: %s: %s",
+                       component, site, type(exc).__name__, exc)
+        except Exception:  # tpulint: disable=S001 - logging teardown
+            pass
+
+
+def suppressed_error_totals() -> Dict[Tuple[str, str], int]:
+    with _SUPPRESSED_LOCK:
+        return dict(_SUPPRESSED)
+
+
+def suppressed_error_families() -> List[MetricFamily]:
+    """One counter family, (component, site)-labelled, shared by the
+    coordinator and worker scrape endpoints."""
+    fam = MetricFamily(
+        "presto_tpu_suppressed_errors_total", "counter",
+        "handler/background-loop exceptions intentionally survived "
+        "(logged + counted; see tpulint S001)")
+    totals = suppressed_error_totals()
+    for (component, site), n in sorted(totals.items()):
+        fam.add(n, {"component": component, "site": site})
+    if not totals:  # families always carry >= 1 sample (scrape shape
+        # is stable from the first request on)
+        fam.add(0, {"component": "none", "site": "none"})
+    return [fam]
 
 
 def uptime_family(started_at: float, role: str) -> MetricFamily:

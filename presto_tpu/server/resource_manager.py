@@ -35,6 +35,8 @@ __all__ = ["ResourceManager", "ClusterStateSender", "remote_group_load"]
 
 
 class _State:
+    _GUARDED_BY = {"lock": ("coordinators",)}  # tpulint C001
+
     def __init__(self, heartbeat_ttl_s: float):
         self.lock = threading.Lock()
         self.ttl = heartbeat_ttl_s
@@ -155,11 +157,13 @@ class ClusterStateSender:
 
     def start(self) -> "ClusterStateSender":
         def loop():
+            from .metrics import record_suppressed
             while not self._stop.is_set():
                 try:
                     self.send_once()
-                except Exception:  # noqa: BLE001 - RM outage: keep trying
-                    pass
+                except Exception as e:  # noqa: BLE001 - RM outage:
+                    # keep trying; counted so a flapping RM is visible
+                    record_suppressed("resource_manager", "heartbeat", e)
                 self._stop.wait(self.interval)
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()

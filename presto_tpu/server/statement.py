@@ -120,6 +120,11 @@ class StatementServer:
     txn_id)` returns an object with .rows()/.names/.types (QueryResult);
     default executes through the SQL front door."""
 
+    # request-handler threads share the query registry and the metrics
+    # roll-ups; writes go through these locks (tpulint C001)
+    _GUARDED_BY = {"_qlock": ("_queries",),
+                   "_metrics_lock": ("_queries_by_state", "_totals")}
+
     def __init__(self, port: int = 0, sf: float = 0.01,
                  dispatcher: Optional[Dispatcher] = None,
                  executor=None, page_rows: int = 1024,
@@ -226,8 +231,11 @@ class StatementServer:
                 ["QUEUED", "PLANNING", "RUNNING", "FINISHING",
                  "FINISHED", "FAILED"],
                 {"user": q.user, "query": q.text[:200]})
-        except Exception:  # noqa: BLE001 - tracing must never fail a query
-            pass
+        except Exception as e:  # noqa: BLE001 - tracing must never
+            # fail a query, but a tracer that stops shipping spans
+            # should show on /v1/metrics
+            from .metrics import record_suppressed
+            record_suppressed("statement", "trace_spans", e)
 
     def _reap_locked(self) -> None:
         """Drop terminal queries (and their materialized result rows)
@@ -538,10 +546,11 @@ class StatementServer:
                    totals["peak_memory_bytes"]),
         ]
         from .metrics import (narrowing_families, plan_cache_families,
-                              uptime_family)
+                              suppressed_error_families, uptime_family)
         fams.append(uptime_family(self._started_at, "coordinator"))
         fams.extend(plan_cache_families())
         fams.extend(narrowing_families())
+        fams.extend(suppressed_error_families())
         return fams
 
 

@@ -84,6 +84,9 @@ class FragmentResultCache:
     tables key on their mutation counters; parquet on file mtimes;
     volatile catalogs (system) are uncacheable. Bounded LRU by bytes."""
 
+    # write-barrier contract, enforced statically (tpulint C001)
+    _GUARDED_BY = {"_lock": ("_entries", "_bytes", "hits", "misses")}
+
     def __init__(self, max_bytes: int = 256 << 20):
         import collections
         self.max_bytes = max_bytes
@@ -160,6 +163,12 @@ class FragmentResultCache:
 
 
 class _Task:
+    # every field the HTTP threads and the execution thread share is
+    # written under the task lock (tpulint C001 enforces this, module-
+    # wide: TaskManager's writes through `task.` are checked too)
+    _GUARDED_BY = {"lock": ("state", "error", "buffers", "first_token",
+                            "no_more_pages", "stats", "finished_at")}
+
     def __init__(self, task_id: str, spool_threshold: int = 64 << 20,
                  spool_dir: Optional[str] = None):
         self.task_id = task_id
@@ -208,6 +217,12 @@ class TaskManager:
     their host-side staging, serde, and compile phases, which dominate
     short-task latency."""
 
+    # `draining` rides the tasks lock: create_or_update reads it under
+    # _tasks_lock to make the refuse-new-tasks decision atomic with
+    # task creation (write path: drain())
+    _GUARDED_BY = {"_tasks_lock": ("tasks", "draining"),
+                   "_counters_lock": ("counters",)}
+
     def __init__(self, sf: float = 0.01, mesh=None,
                  memory_bytes: int = 12 << 30,
                  task_ttl_s: float = 600.0,
@@ -246,6 +261,13 @@ class TaskManager:
     def _count(self, name: str, delta: int = 1):
         with self._counters_lock:
             self.counters[name] = self.counters.get(name, 0) + delta
+
+    def drain(self) -> None:
+        """Enter SHUTTING_DOWN (GracefulShutdownHandler): stop accepting
+        NEW tasks, let running ones finish. Under the tasks lock so the
+        flag flip is atomic with in-flight create_or_update decisions."""
+        with self._tasks_lock:
+            self.draining = True
 
     def _prune_locked(self):
         """Drop terminal tasks (and their buffered pages) older than the
@@ -634,6 +656,8 @@ class _Handler(BaseHTTPRequestHandler):
                            f"lifetime {k}").add(counters[k]))
         fams.extend(plan_cache_families())
         fams.extend(narrowing_families())
+        from .metrics import suppressed_error_families
+        fams.extend(suppressed_error_families())
         return fams
 
     def do_GET(self):  # noqa: N802
@@ -785,7 +809,7 @@ class _Handler(BaseHTTPRequestHandler):
             body = json.loads(self.rfile.read(length) or b'""')
             if str(body).upper().replace('"', "") == "SHUTTING_DOWN":
                 # GracefulShutdownHandler: stop accepting, finish running
-                self.manager.draining = True
+                self.manager.drain()
                 return self._send_json({"state": "SHUTTING_DOWN"})
             return self._send_json({"error": f"unknown state {body}"}, 400)
         return self._send_json({"error": f"unknown path {self.path}"}, 404)

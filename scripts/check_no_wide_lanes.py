@@ -1,151 +1,64 @@
 #!/usr/bin/env python
 """Static check: hot-path kernel modules stay narrow-lane disciplined.
 
-Narrow-width execution (plan/widths.py, PERF.md roofline) depends on
-the hot-path kernels never silently re-widening lanes: on v5e an int64
-lane is emulated as an i32 pair, so one accidental wide array doubles
-the HBM traffic the whole PR exists to remove. Two rules over
-`ops/aggregation.py` and `ops/keys.py`:
+THIN SHIM over tpulint's W001 pass (presto_tpu/lint/passes/
+wide_lanes.py) -- the check that started as this standalone script in
+PR 2 now lives in the pluggable framework, with coverage extended to
+join.py/sort.py/window.py. This entry point keeps the original
+contract for existing callers and tests/test_no_wide_lanes.py:
 
-  1. IMPLICIT-DTYPE array creation is banned everywhere: under jax x64
-     (this engine enables it) `jnp.arange(n)` silently makes int64
-     lanes and `jnp.zeros(n)` float64 lanes. Every zeros/ones/full/
-     empty/arange/iota call must name its dtype.
-  2. EXPLICIT int64 construction (`dtype=jnp.int64` / `.astype(
-     jnp.int64)` / `jnp.int64(...)`) is allowed only inside the
-     whitelisted limb-widening/accumulator functions -- the sites where
-     64-bit math is the exactness contract, not an accident.
+  * ``HOT_MODULES`` / ``WIDE_OK_FUNCS`` module globals (mutable -- the
+    sensitivity test empties the whitelist);
+  * ``check_file(path) -> [(lineno, message)]``;
+  * ``check_all() -> [\"rel:line: message\"]`` sorted;
+  * ``main()`` exits 1 with a report on violation.
 
-Run directly (exit 1 + report on violation) or through the tier-1
-suite (tests/test_no_wide_lanes.py).
+Prefer ``python scripts/tpulint.py`` (runs W001 over the full module
+set plus the other passes) for anything new.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 from typing import List, Tuple
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
+from presto_tpu.lint.core import ModuleSource  # noqa: E402
+from presto_tpu.lint.passes import wide_lanes as _w  # noqa: E402
+
+# original (PR 2) coverage; tpulint's W001 additionally covers
+# join.py/sort.py/window.py
 HOT_MODULES = (
     os.path.join("presto_tpu", "ops", "aggregation.py"),
     os.path.join("presto_tpu", "ops", "keys.py"),
 )
 
-# array constructors that default to wide lanes under jax x64
-_CREATORS = {"zeros", "ones", "full", "empty", "arange",
-             "broadcasted_iota", "iota"}
-
-# functions where 64-bit lanes are the exactness contract: limb
-# widening at accumulation, int64/int128 state tables, order-word
-# reductions. New int64 in any OTHER hot-path function fails the check.
+# live view of the framework's whitelist for the shim's modules;
+# reassigning this module global changes what check_file/check_all use
+# (the sensitivity test relies on that)
 WIDE_OK_FUNCS = {
-    "aggregation.py": {
-        # limb-widening / exact-accumulation sites
-        "_fused_limb_sums", "_limb_matmul_sum", "_seg_add", "_seg_count",
-        "_sum128", "_SegSumPool.add", "_seg_total", "_padded_cumsum",
-        # int64 state tables / finalizers (G-sized, not row-sized)
-        "_acc_columns", "_sorted_states", "finalize_states",
-        "finalize_variance", "hll_estimate", "_group_by_sorted",
-        # order-word / argbest reductions (uint64 words, int64 row ids)
-        "_argbest", "_hll_registers_from_values", "_seg_scan_extreme",
-        "_seg_extreme_at",
-        # planner-facing glue
-        "group_by", "merge_partials",
-    },
-    # keys.py widens VALUES to uint64 order words by design; int64
-    # appears only as the cast-through in _fixed_words
-    "keys.py": {"_fixed_words", "key_words", "_string_words"},
+    "aggregation.py": set(_w.WIDE_OK_FUNCS["aggregation.py"]),
+    "keys.py": set(_w.WIDE_OK_FUNCS["keys.py"]),
 }
 
 
-def _func_name(stack: List[str]) -> str:
-    return ".".join(stack[-2:]) if len(stack) > 1 else \
-        (stack[0] if stack else "<module>")
-
-
-def _is_int64_attr(node: ast.AST) -> bool:
-    return isinstance(node, ast.Attribute) and node.attr in ("int64",)
-
-
 def check_file(path: str) -> List[Tuple[int, str]]:
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    base = os.path.basename(path)
-    allowed = WIDE_OK_FUNCS.get(base, set())
-    violations: List[Tuple[int, str]] = []
-    stack: List[str] = []
-
-    class V(ast.NodeVisitor):
-        def _in_allowed(self) -> bool:
-            name = _func_name(stack)
-            return name in allowed or (stack and stack[0] in allowed)
-
-        def visit_FunctionDef(self, node):
-            stack.append(node.name)
-            self.generic_visit(node)
-            stack.pop()
-
-        visit_AsyncFunctionDef = visit_FunctionDef
-
-        def visit_ClassDef(self, node):
-            stack.append(node.name)
-            self.generic_visit(node)
-            stack.pop()
-
-        def visit_Call(self, node):
-            fn = node.func
-            # rule 1: jnp/np array creators must name a dtype
-            if isinstance(fn, ast.Attribute) and fn.attr in _CREATORS \
-                    and isinstance(fn.value, ast.Name) \
-                    and fn.value.id in ("jnp", "np"):
-                has_dtype = any(k.arg == "dtype" for k in node.keywords)
-                # zeros/ones/full/empty: dtype may ride positionally
-                # (full(shape, fill, dtype); arange(n, dtype=...))
-                if not has_dtype and fn.attr == "full" \
-                        and len(node.args) >= 3:
-                    has_dtype = True
-                if not has_dtype:
-                    violations.append(
-                        (node.lineno,
-                         f"{_func_name(stack)}: jnp.{fn.attr}() without "
-                         f"an explicit dtype (implicit wide lanes under "
-                         f"x64)"))
-            # rule 2: explicit int64 outside the whitelist
-            if _is_int64_attr(fn) and not self._in_allowed():
-                violations.append(
-                    (node.lineno,
-                     f"{_func_name(stack)}: jnp.int64(...) outside the "
-                     f"whitelisted limb-widening sites"))
-            if isinstance(fn, ast.Attribute) and fn.attr == "astype" \
-                    and node.args and _is_int64_attr(node.args[0]) \
-                    and not self._in_allowed():
-                violations.append(
-                    (node.lineno,
-                     f"{_func_name(stack)}: .astype(int64) outside the "
-                     f"whitelisted limb-widening sites"))
-            self.generic_visit(node)
-
-        def visit_keyword(self, node):
-            if node.arg == "dtype" and _is_int64_attr(node.value) \
-                    and not self._in_allowed():
-                violations.append(
-                    (getattr(node.value, "lineno", 0),
-                     f"{_func_name(stack)}: dtype=int64 outside the "
-                     f"whitelisted limb-widening sites"))
-            self.generic_visit(node)
-
-    V().visit(tree)
-    return violations
+    rel = os.path.relpath(os.path.join(REPO, path), REPO) \
+        if not os.path.isabs(path) else os.path.relpath(path, REPO)
+    ms = ModuleSource(rel, repo=REPO)
+    allowed = WIDE_OK_FUNCS.get(ms.basename, set())
+    return [(f.line, f"{f.context}: {f.message}")
+            for f in _w.scan_module(ms, whitelist=allowed)]
 
 
 def check_all() -> List[str]:
     out: List[str] = []
     for rel in HOT_MODULES:
-        path = os.path.join(REPO, rel)
-        for lineno, msg in check_file(path):
+        for lineno, msg in check_file(rel):
             out.append(f"{rel}:{lineno}: {msg}")
     return sorted(out)
 

@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""tpulint: presto-tpu's static-analysis gate. Run before sending a PR.
+
+Thin launcher over ``presto_tpu.lint.cli`` -- see that module for the
+exit-code contract and DESIGN.md ("tpulint") for the pass catalog,
+suppression syntax (``# tpulint: disable=H001``), and baseline policy
+(``tpulint_baseline.json``).
+
+    python scripts/tpulint.py                 # repo gate (CI runs this)
+    python scripts/tpulint.py --json          # stable machine output
+    python scripts/tpulint.py --list-passes
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from presto_tpu.lint.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,60 @@
+"""tpulint C001 fixture: seeded lock-discipline violations. NOT part
+of the engine -- linted by tests/test_tpulint.py."""
+
+import threading
+
+
+class Registry:
+    _GUARDED_BY = {"_lock": ("_entries", "_count")}
+
+    def __init__(self, pool=None):
+        self._lock = threading.Lock()
+        self._entries = {}   # init writes are exempt (not yet shared)
+        self._count = 0
+        if pool is not None:
+            def warm():
+                # BAD: the closure runs later on a pool thread when the
+                # object IS shared -- __init__'s exemption must not
+                # leak into it
+                self._count = 1
+            pool.submit(warm)
+
+    def put_good(self, k, v):
+        with self._lock:
+            self._entries[k] = v
+            self._count += 1
+
+    def put_bad(self, k, v):
+        self._entries[k] = v        # BAD: write outside the lock
+        self._count += 1            # BAD: augmented write outside the lock
+
+    def drop_bad(self, k):
+        del self._entries[k]        # BAD: del outside the lock
+
+    def _reset_locked(self):
+        self._count = 0  # ok: caller-holds-the-lock convention
+
+    def wrong_lock(self, other):
+        with other._lock:
+            self._count = 99        # BAD: held lock is other's, not self's
+
+
+    def deferred_bad(self, pool):
+        with self._lock:
+            def cb():
+                # BAD: runs LATER on another thread -- the lock held at
+                # the def site is NOT held at call time
+                self._count = 7
+            pool.submit(cb)
+
+
+def helper_bad(reg):
+    reg._count = 0                  # BAD: receiver-agnostic check
+
+def helper_good(reg):
+    with reg._lock:
+        reg._count = 0
+
+
+def suppressed_site(reg):
+    reg._count = -1  # tpulint: disable=C001

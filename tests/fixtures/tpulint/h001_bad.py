@@ -1,0 +1,26 @@
+"""tpulint H001 fixture: seeded host-sync violations in would-be
+kernel code. NOT part of the engine -- linted by tests/test_tpulint.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def kernel(x):
+    total = float(jnp.sum(x))       # BAD: host coercion of traced value
+    host = np.asarray(x)            # BAD: device->host copy
+    back = jnp.asarray(host)        # BAD: asarray without dtype
+    x.block_until_ready()           # BAD: pipeline stall
+    jax.device_get(x)               # BAD: explicit device->host
+    last = x.sum().item()           # BAD: .item() sync
+    return total, back, last
+
+
+def known_good(rows):
+    staged = jnp.asarray(rows, dtype=jnp.int32)  # explicit staging cast
+    n = int(np.ceil(np.log2(max(len(rows), 2))))  # host math on shapes
+    return staged, n
+
+
+def suppressed_site(x):
+    return x.sum().item()  # tpulint: disable=H001

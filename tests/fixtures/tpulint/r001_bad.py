@@ -1,0 +1,35 @@
+"""tpulint R001 fixture: seeded retrace/cache-key hazards. NOT part of
+the engine -- linted by tests/test_tpulint.py."""
+
+import os
+import random
+import time
+
+import jax
+
+KERNEL_TWEAKS = {"mode": "fast"}                 # mutable module global
+
+MODE = os.environ.get("SOME_UNKEYED_KNOB", "x")  # BAD: unkeyed env read
+NARROW = os.environ.get("PRESTO_TPU_NARROW", "1")  # ok: cache-keyed
+
+
+@jax.jit
+def kernel(x):
+    if KERNEL_TWEAKS["mode"] == "fast":          # BAD: mutable-global capture
+        x = x + time.time()                      # BAD: clock under jit
+    return x * random.random()                   # BAD: randomness under jit
+
+
+@jax.jit
+def known_good(x, scale):
+    local = {"mode": "fast"}  # function-local: rebuilt per trace
+    return x * scale if local["mode"] == "fast" else x
+
+
+def host_driver():
+    t0 = time.time()  # fine: not traced
+    return time.time() - t0
+
+
+def suppressed_site():
+    return os.environ.get("ANOTHER_KNOB", "")  # tpulint: disable=R001

@@ -1,0 +1,52 @@
+"""tpulint S001 fixture: seeded swallowed-error handlers. NOT part of
+the engine -- linted by tests/test_tpulint.py."""
+
+
+def handler_swallows(req):
+    try:
+        req.process()
+    except Exception:
+        pass                        # BAD: no log, no count, no trace
+
+
+def handler_bare(req):
+    try:
+        req.process()
+    except:                         # BAD: bare except (KeyboardInterrupt too)
+        pass
+
+
+def handler_base_exception(req):
+    try:
+        req.process()
+    except BaseException:           # BAD: same as bare
+        req.noted = True
+
+
+def handler_bare_return(req):
+    try:
+        req.process()
+    except Exception:
+        return                      # BAD: indistinguishable from success
+
+
+def handler_counts(req, metrics):
+    try:
+        req.process()
+    except Exception as e:          # ok: counted + logged
+        metrics.record_suppressed("fixture", "process", e)
+
+
+def handler_returns(req):
+    try:
+        req.process()
+        return True
+    except Exception:               # ok: caller observes the outcome
+        return False
+
+
+def suppressed_site(req):
+    try:
+        req.process()
+    except Exception:  # tpulint: disable=S001
+        pass

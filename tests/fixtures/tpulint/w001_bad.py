@@ -1,0 +1,26 @@
+"""tpulint W001 fixture: seeded wide-lane violations (and known-good
+forms). NOT part of the engine -- linted by tests/test_tpulint.py."""
+
+import jax.numpy as jnp
+
+
+def make_ids(n):
+    ids = jnp.arange(n)                      # BAD: implicit dtype (x64 -> int64)
+    pad = jnp.zeros(n)                       # BAD: implicit dtype (x64 -> float64)
+    wide = ids.astype(jnp.int64)             # BAD: int64 outside any whitelist
+    table = jnp.full(n, 0, dtype=jnp.int64)  # BAD: dtype=int64
+    pos = jnp.full(n, 0, jnp.int64)          # BAD: positional int64 dtype
+    lit = jnp.array([1, 2, 3])               # BAD: implicit dtype (x64 -> int64)
+    s = ids.astype("int64")                  # BAD: string int64 spelling
+    return ids, pad, wide, table, pos, lit, s
+
+
+def known_good(n):
+    a = jnp.arange(n, dtype=jnp.int32)
+    b = jnp.zeros(n, dtype=jnp.float32)
+    c = jnp.full((n,), 7, jnp.int32)  # positional dtype
+    return a, b, c
+
+
+def suppressed_site(n):
+    return jnp.arange(n)  # tpulint: disable=W001

@@ -1,0 +1,399 @@
+"""Tier-1 gate + unit tests for tpulint (presto_tpu/lint/).
+
+Two contracts ride tier-1:
+
+  1. the repo itself is lint-clean modulo the committed baseline
+     (``python scripts/tpulint.py`` exits 0) -- a hot-path host sync,
+     wide lane, unkeyed env knob, unlocked shared-field write, or
+     swallowed server error fails the suite;
+  2. the detectors are not vacuous: every shipped pass fires on its
+     seeded fixture file (tests/fixtures/tpulint/*_bad.py) and the CLI
+     exits non-zero on it.
+
+Plus framework mechanics: inline suppressions, baseline add/expire,
+``--json`` schema stability, and the check_no_wide_lanes.py shim.
+"""
+
+import io
+import json
+import os
+import sys
+from contextlib import redirect_stdout
+
+import pytest
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join("tests", "fixtures", "tpulint")
+
+from presto_tpu.lint import (apply_baseline, build_baseline,  # noqa: E402
+                             all_passes, run_passes)
+from presto_tpu.lint.baseline import load_baseline, save_baseline  # noqa: E402
+from presto_tpu.lint.cli import main as tpulint_main  # noqa: E402
+from presto_tpu.lint.core import ModuleSource  # noqa: E402
+
+ALL_CODES = ("W001", "H001", "R001", "C001", "S001")
+
+
+def _cli(args):
+    """(exit_code, stdout_text) of one CLI invocation."""
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = tpulint_main(list(args))
+    return rc, buf.getvalue()
+
+
+# -- tier-1 gates -------------------------------------------------------
+
+
+def test_repo_is_clean_modulo_baseline():
+    """The acceptance gate: `python scripts/tpulint.py` exits 0."""
+    rc, out = _cli([])
+    assert rc == 0, f"tpulint found violations:\n{out}"
+
+
+def test_registry_ships_all_five_passes():
+    codes = {p.code for p in all_passes()}
+    assert set(ALL_CODES) <= codes
+
+
+@pytest.mark.parametrize("code", ALL_CODES)
+def test_pass_detects_seeded_fixture(code):
+    """Sensitivity: each pass fires on its fixture and the CLI exits
+    non-zero (the detectors are not vacuous)."""
+    fixture = os.path.join(FIXTURES, f"{code.lower()}_bad.py")
+    rc, out = _cli(["--select", code, "--no-baseline", "--json", fixture])
+    assert rc == 1
+    doc = json.loads(out)
+    found = {f["code"] for f in doc["findings"]}
+    assert found == {code}
+    assert len(doc["findings"]) >= 3
+    # every fixture carries exactly one inline-suppressed site
+    assert doc["suppressed"] == 1
+
+
+def test_fixture_known_good_sections_stay_clean():
+    """The ok/known_good functions in the fixtures produce no findings
+    (precision: the passes don't flag the sanctioned forms)."""
+    for code in ALL_CODES:
+        fixture = os.path.join(FIXTURES, f"{code.lower()}_bad.py")
+        result = run_passes(codes=[code], paths=[fixture])
+        for f in result.findings:
+            assert "good" not in f.context and "ok" not in f.context, \
+                f"{code} false positive in {f.context}: {f.message}"
+
+
+# -- suppression mechanics ---------------------------------------------
+
+
+def test_inline_suppression_drops_finding(tmp_path):
+    src_bad = "import jax.numpy as jnp\n\ndef f(n):\n    return jnp.arange(n)\n"
+    src_ok = src_bad.replace("jnp.arange(n)",
+                             "jnp.arange(n)  # tpulint: disable=W001")
+    p = tmp_path / "mod.py"
+    p.write_text(src_bad)
+    r1 = run_passes(codes=["W001"], paths=[str(p)])
+    assert len(r1.findings) == 1 and r1.suppressed == 0
+    p.write_text(src_ok)
+    r2 = run_passes(codes=["W001"], paths=[str(p)])
+    assert r2.findings == [] and r2.suppressed == 1
+
+
+def test_disable_all_suppresses_every_pass(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text("import jax.numpy as jnp\n\ndef f(n):\n"
+                 "    return jnp.arange(n)  # tpulint: disable=all\n")
+    r = run_passes(codes=["W001"], paths=[str(p)])
+    assert r.findings == [] and r.suppressed == 1
+
+
+# -- baseline add / expire ---------------------------------------------
+
+
+def test_baseline_add_then_expire(tmp_path):
+    """Grandfather a finding, verify it stays green, pay the debt,
+    verify the stale entry forces a baseline update (the ratchet)."""
+    mod = tmp_path / "mod.py"
+    bl = str(tmp_path / "baseline.json")
+    mod.write_text("import jax.numpy as jnp\n\ndef f(n):\n"
+                   "    return jnp.arange(n)\n")
+
+    # violation with no baseline: red
+    rc, _ = _cli(["--select", "W001", "--baseline", bl, str(mod)])
+    assert rc == 1
+    # accept the debt: green, entry written
+    rc, _ = _cli(["--select", "W001", "--baseline", bl,
+                  "--update-baseline", str(mod)])
+    assert rc == 0
+    entries = load_baseline(bl)
+    assert len(entries) == 1
+    (entry,) = entries.values()
+    assert entry["code"] == "W001" and entry["count"] == 1
+    # still green on re-run, finding counted as baselined
+    rc, out = _cli(["--select", "W001", "--baseline", bl, "--json",
+                    str(mod)])
+    assert rc == 0
+    assert json.loads(out)["baselined"] == 1
+    # pay the debt: the stale entry turns the run red until updated
+    mod.write_text("import jax.numpy as jnp\n\ndef f(n):\n"
+                   "    return jnp.arange(n, dtype=jnp.int32)\n")
+    rc, out = _cli(["--select", "W001", "--baseline", bl, "--json",
+                    str(mod)])
+    assert rc == 1
+    doc = json.loads(out)
+    assert doc["findings"] == [] and len(doc["staleBaseline"]) == 1
+    assert doc["staleBaseline"][0]["countFound"] == 0
+    rc, _ = _cli(["--select", "W001", "--baseline", bl,
+                  "--update-baseline", str(mod)])
+    assert rc == 0
+    assert load_baseline(bl) == {}
+
+
+def test_baseline_excess_copies_are_new_findings(tmp_path):
+    """A second copy of a grandfathered violation in the same function
+    is reported: budgets are counts, not blanket waivers."""
+    mod = tmp_path / "mod.py"
+    one = ("import jax.numpy as jnp\n\ndef f(n):\n"
+           "    return jnp.arange(n)\n")
+    mod.write_text(one)
+    findings = run_passes(codes=["W001"], paths=[str(mod)]).findings
+    entries = build_baseline(findings)
+    mod.write_text(one.replace(
+        "    return jnp.arange(n)\n",
+        "    a = jnp.arange(n)\n    return a + jnp.arange(n)\n"))
+    findings2 = run_passes(codes=["W001"], paths=[str(mod)]).findings
+    assert len(findings2) == 2
+    new, baselined, stale = apply_baseline(findings2, entries)
+    assert baselined == 1 and len(new) == 1 and stale == []
+
+
+def test_nonexistent_path_is_an_error_not_clean():
+    """A typo'd path must exit 2, never 'ok across 0 files'."""
+    rc, _ = _cli(["--no-baseline", "no/such/file.py"])
+    assert rc == 2
+
+
+def test_unparseable_file_is_an_error(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    rc, _ = _cli(["--no-baseline", str(p)])
+    assert rc == 2
+
+
+def test_partial_run_preserves_out_of_scope_baseline(tmp_path):
+    """Stale detection and --update-baseline only touch entries inside
+    the scanned (pass x file) scope; a scoped run neither reports nor
+    deletes debt belonging to unscanned files/passes."""
+    bl = str(tmp_path / "baseline.json")
+    mod_a = tmp_path / "a.py"
+    mod_b = tmp_path / "b.py"
+    src = ("import jax.numpy as jnp\n\ndef f(n):\n"
+           "    return jnp.arange(n)\n")
+    mod_a.write_text(src)
+    mod_b.write_text(src)
+    # grandfather BOTH files' findings (full scope for this pair)
+    rc, _ = _cli(["--select", "W001", "--baseline", bl,
+                  "--update-baseline", str(mod_a), str(mod_b)])
+    assert rc == 0 and len(load_baseline(bl)) == 2
+    # pay a's debt; a scoped run over b alone must stay green and
+    # must not report a's now-stale entry
+    mod_a.write_text(src.replace("jnp.arange(n)",
+                                 "jnp.arange(n, dtype=jnp.int32)"))
+    rc, out = _cli(["--select", "W001", "--baseline", bl, "--json",
+                    str(mod_b)])
+    assert rc == 0 and json.loads(out)["staleBaseline"] == []
+    # a scoped --update-baseline over b preserves a's entry untouched
+    rc, _ = _cli(["--select", "W001", "--baseline", bl,
+                  "--update-baseline", str(mod_b)])
+    assert rc == 0
+    remaining = load_baseline(bl)
+    assert len(remaining) == 2  # b's entry rebuilt + a's preserved
+    # the full-scope run over both files DOES surface a's paid debt
+    rc, out = _cli(["--select", "W001", "--baseline", bl, "--json",
+                    str(mod_a), str(mod_b)])
+    assert rc == 1 and len(json.loads(out)["staleBaseline"]) == 1
+
+
+def test_baseline_reasons_survive_update(tmp_path):
+    mod = tmp_path / "mod.py"
+    bl = str(tmp_path / "baseline.json")
+    mod.write_text("import jax.numpy as jnp\n\ndef f(n):\n"
+                   "    return jnp.arange(n)\n")
+    findings = run_passes(codes=["W001"], paths=[str(mod)]).findings
+    entries = build_baseline(findings)
+    (fp,) = entries
+    entries[fp]["reason"] = "tracked in ISSUE-42"
+    save_baseline(entries, bl)
+    rc, _ = _cli(["--select", "W001", "--baseline", bl,
+                  "--update-baseline", str(mod)])
+    assert rc == 0
+    assert load_baseline(bl)[fp]["reason"] == "tracked in ISSUE-42"
+
+
+# -- --json schema stability -------------------------------------------
+
+
+def test_json_schema_is_stable():
+    fixture = os.path.join(FIXTURES, "s001_bad.py")
+    rc, out = _cli(["--select", "S001", "--no-baseline", "--json",
+                    fixture])
+    assert rc == 1
+    doc = json.loads(out)
+    assert set(doc) == {"version", "passes", "filesScanned", "findings",
+                        "baselined", "suppressed", "staleBaseline"}
+    assert doc["version"] == 1
+    for f in doc["findings"]:
+        assert set(f) == {"code", "path", "line", "col", "context",
+                          "message", "fingerprint"}
+    # deterministic: same invocation, same document
+    _, out2 = _cli(["--select", "S001", "--no-baseline", "--json",
+                    fixture])
+    assert out == out2
+
+
+def test_fingerprint_is_line_independent():
+    fixture = os.path.join(FIXTURES, "w001_bad.py")
+    with open(os.path.join(REPO, fixture)) as f:
+        src = f.read()
+    a = run_passes(codes=["W001"], paths=[fixture]).findings
+    shifted = ModuleSource(fixture, repo=REPO, text="# pad\n\n" + src)
+    from presto_tpu.lint.passes.wide_lanes import scan_module
+    b = [x for x in scan_module(shifted)
+         if not shifted.suppressed("W001", x.line)]
+    assert [f.fingerprint for f in a] == [f.fingerprint for f in b]
+    assert [f.line for f in a] != [f.line for f in b]
+
+
+# -- pass-specific pins -------------------------------------------------
+
+
+def test_r001_keyed_envs_match_plan_cache():
+    """The linter's notion of cache-keyed env knobs IS the plan cache's
+    (single source of truth; the fallback list cannot drift)."""
+    from presto_tpu.exec.plan_cache import KERNEL_MODE_ENVS
+    from presto_tpu.lint.passes.retrace import (_KNOWN_KEYED_ENVS,
+                                                kernel_mode_envs)
+    assert set(kernel_mode_envs()) == {n for n, _ in KERNEL_MODE_ENVS}
+    assert set(_KNOWN_KEYED_ENVS) == {n for n, _ in KERNEL_MODE_ENVS}
+
+
+def test_c001_respects_locked_suffix_and_init():
+    fixture = os.path.join(FIXTURES, "c001_bad.py")
+    result = run_passes(codes=["C001"], paths=[fixture])
+    contexts = {f.context for f in result.findings}
+    assert "Registry.__init__" not in contexts
+    assert "Registry._reset_locked" not in contexts
+    assert "Registry.wrong_lock" in contexts   # wrong receiver's lock
+    assert "helper_bad" in contexts            # receiver-agnostic
+    assert "deferred_bad.cb" in contexts       # closure under `with`
+    # runs later without the lock
+    assert "__init__.warm" in contexts         # closure under __init__
+    # doesn't inherit the init exemption
+
+
+def test_w001_positional_and_string_int64_spellings():
+    fixture = os.path.join(FIXTURES, "w001_bad.py")
+    msgs = [f.message for f in
+            run_passes(codes=["W001"], paths=[fixture]).findings]
+    assert any("positional dtype" in m for m in msgs)
+    assert any(".astype(int64)" in m for m in msgs)
+    assert sum("without an explicit dtype" in m for m in msgs) >= 3
+
+
+def test_s001_flags_bare_return_not_value_return():
+    fixture = os.path.join(FIXTURES, "s001_bad.py")
+    contexts = {f.context for f in
+                run_passes(codes=["S001"], paths=[fixture]).findings}
+    assert "handler_bare_return" in contexts   # bare return = silent
+    assert "handler_returns" not in contexts   # return False = observed
+
+
+def test_explicit_path_honors_pass_targets():
+    """`tpulint <file inside some pass's targets>` runs only the passes
+    that own it -- hot-path-only rules must not fire on server code and
+    poison the baseline (the file exits clean today)."""
+    result = run_passes(paths=["presto_tpu/server/worker.py"])
+    assert {f.code for f in result.findings} <= {"C001", "S001"}
+    # and a file outside every pass's targets runs through all passes
+    fixture = os.path.join(FIXTURES, "w001_bad.py")
+    codes = {f.code for f in run_passes(paths=[fixture]).findings}
+    assert "W001" in codes
+
+
+def test_select_only_run_preserves_out_of_target_baseline(tmp_path):
+    """A `--select CODE` run with NO paths scans only that pass's
+    target modules; baseline entries for files outside those targets
+    must be neither reported stale nor deleted on update."""
+    bl = str(tmp_path / "baseline.json")
+    ghost = {"code": "W001", "path": "not/in/any/target.py",
+             "context": "f", "message": "jnp.arange() without an "
+             "explicit dtype (implicit wide lanes under x64)",
+             "count": 1, "reason": "out-of-target debt"}
+    import hashlib
+    fp = hashlib.sha1(
+        f"{ghost['code']}|{ghost['path']}|{ghost['context']}|"
+        f"{ghost['message']}".encode()).hexdigest()[:16]
+    save_baseline({fp: ghost}, bl)
+    rc, out = _cli(["--select", "W001", "--baseline", bl, "--json"])
+    assert rc == 0, out
+    assert json.loads(out)["staleBaseline"] == []
+    rc, _ = _cli(["--select", "W001", "--baseline", bl,
+                  "--update-baseline"])
+    assert rc == 0
+    assert fp in load_baseline(bl)  # preserved, not deleted
+
+
+def test_w001_extended_coverage_includes_join_sort_window():
+    from presto_tpu.lint.core import get_pass
+    files = {os.path.basename(p) for p in
+             get_pass("W001").target_files()}
+    assert {"aggregation.py", "keys.py", "join.py", "sort.py",
+            "window.py"} <= files
+
+
+def test_s001_server_tier_has_no_unlogged_swallows():
+    """Direct pass-level pin of the satellite audit: server/ request
+    handlers either count suppressed errors or carry a reasoned inline
+    disable -- pure `except Exception: pass` is gone."""
+    result = run_passes(codes=["S001"])
+    assert result.findings == [], "\n".join(
+        f.render() for f in result.findings)
+
+
+def test_suppressed_error_counter_exports_on_metrics():
+    """record_suppressed lands in the shared Prometheus family both
+    tiers render (satellite: logged + counted handler errors)."""
+    from presto_tpu.server.metrics import (parse_prometheus,
+                                           record_suppressed,
+                                           render_prometheus,
+                                           suppressed_error_families,
+                                           suppressed_error_totals)
+    record_suppressed("testcomp", "testsite", ValueError("boom"))
+    record_suppressed("testcomp", "testsite")
+    totals = suppressed_error_totals()
+    assert totals[("testcomp", "testsite")] >= 2
+    text = render_prometheus(suppressed_error_families()).decode()
+    parsed = parse_prometheus(text)
+    fam = parsed["presto_tpu_suppressed_errors_total"]
+    key = '{component="testcomp",site="testsite"}'
+    assert fam[key] >= 2
+
+
+# -- the migrated shim --------------------------------------------------
+
+
+def test_shim_check_no_wide_lanes_contract():
+    scripts = os.path.join(REPO, "scripts")
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    import check_no_wide_lanes as c
+    assert c.check_all() == []
+    # sensitivity survives the migration: emptying the whitelist must
+    # surface the deliberate int64 accumulator sites
+    orig = c.WIDE_OK_FUNCS
+    try:
+        c.WIDE_OK_FUNCS = {k: set() for k in orig}
+        assert len(c.check_all()) >= 10
+    finally:
+        c.WIDE_OK_FUNCS = orig
