@@ -50,8 +50,13 @@ def _format_table(names, rows, types=None, max_rows=50):
     return "\n".join(out)
 
 
+def _print_trace(doc) -> None:
+    from presto_tpu.traceview import render_waterfall
+    print(render_waterfall(doc))
+
+
 def run_one(query: str, sf: float, explain_only: bool = False,
-            stats: bool = False) -> int:
+            stats: bool = False, trace: bool = False) -> int:
     from presto_tpu.plan import explain as explain_plan
     from presto_tpu.sql import plan_sql, sql
 
@@ -71,24 +76,49 @@ def run_one(query: str, sf: float, explain_only: bool = False,
     if stats:
         # --stats pays the one extra trace for FLOPs/bytes-accessed
         kwargs["session"] = {"query_cost_analysis": True}
+    if trace:
+        # embedded engine: make sure a tracer exists so the stage spans
+        # land somewhere renderable
+        from presto_tpu.server.tracing import (RecordingTracer,
+                                               get_tracer, set_tracer)
+        if get_tracer() is None:
+            set_tracer(RecordingTracer())
     res = sql(query, sf=sf, **kwargs)
     dt = time.time() - t0
     print(_format_table(res.names, res.rows(), res.types))
     print(f"({res.row_count} rows in {dt:.2f}s)")
     if stats and res.query_stats is not None:
         print(f"stats: {res.query_stats.summary()}")
+    if trace:
+        from presto_tpu.server.tracing import get_tracer, trace_doc_of
+        doc = trace_doc_of(get_tracer(), kwargs["query_id"])
+        if doc is None:
+            print("(no spans recorded for this query)")
+        else:
+            _print_trace(doc)
     return 0
 
 
 def run_one_remote(query: str, server: str, user: str = "presto",
-                   session=None, stats: bool = False) -> int:
+                   session=None, stats: bool = False,
+                   trace: bool = False) -> int:
     """Run one statement over the client statement protocol (the
     presto-cli-to-coordinator path: POST /v1/statement + nextUri)."""
     from presto_tpu.client import QueryError, execute
 
+    extra_headers = None
+    if trace:
+        # mint a client-side trace context: the server's query root
+        # span parents under it, so the served trace is the CLIENT's
+        # trace id and covers the statement end to end
+        from presto_tpu.server.tracing import TRACE_HEADER, TraceContext, \
+            new_span_id, new_trace_id
+        ctx = TraceContext(new_trace_id(), new_span_id())
+        extra_headers = {TRACE_HEADER: ctx.header()}
     t0 = time.time()
     try:
-        client = execute(server, query, user=user, session=session or {})
+        client = execute(server, query, user=user, session=session or {},
+                         extra_headers=extra_headers)
     except QueryError as e:
         print(f"error [{e.error_name}]: {e}", file=sys.stderr)
         return 1
@@ -112,6 +142,19 @@ def run_one_remote(query: str, server: str, user: str = "presto",
         if s.get("peakMemoryBytes"):
             parts.append(f"peak mem {s['peakMemoryBytes'] >> 20}MB")
         print("stats: " + ", ".join(parts))
+    if trace and client.query_id:
+        # pull the stitched one-trace-per-query document back from the
+        # coordinator and render the waterfall
+        from presto_tpu.traceview import fetch_trace
+        try:
+            doc = fetch_trace(server, client.query_id)
+        except Exception as e:  # noqa: BLE001 - trace absence must not
+            # fail a statement that already returned its rows
+            print(f"(no trace for {client.query_id} from {server}: "
+                  f"{type(e).__name__}: {e} -- is a tracer installed "
+                  f"on the coordinator?)", file=sys.stderr)
+            return 0
+        _print_trace(doc)
     return 0
 
 
@@ -124,6 +167,12 @@ def main(argv=None) -> int:
     ap.add_argument("--stats", action="store_true",
                     help="print the QueryStats summary (wall/compile/"
                          "execute, rows, bytes) after each query")
+    ap.add_argument("--trace", action="store_true",
+                    help="render the query's distributed trace as an "
+                         "ASCII waterfall with critical-path "
+                         "attribution (GET /v1/trace/{queryId} in "
+                         "--server mode, the in-process tracer "
+                         "otherwise)")
     ap.add_argument("--server", default=None,
                     help="coordinator URL; statements ride the client "
                          "protocol instead of the embedded engine")
@@ -137,8 +186,10 @@ def main(argv=None) -> int:
                                              re.IGNORECASE):
                 query = f"EXPLAIN {query}"  # server-side EXPLAIN
             return run_one_remote(query, args.server, args.user,
-                                  {"sf": str(args.sf)}, stats=args.stats)
-        return run_one(args.query, args.sf, args.explain, args.stats)
+                                  {"sf": str(args.sf)}, stats=args.stats,
+                                  trace=args.trace)
+        return run_one(args.query, args.sf, args.explain, args.stats,
+                       trace=args.trace)
 
     print("presto-tpu> (end statements with ';', \\q to quit)")
     buf = []
@@ -160,9 +211,10 @@ def main(argv=None) -> int:
                         stmt = f"EXPLAIN {stmt}"
                     run_one_remote(stmt, args.server, args.user,
                                    {"sf": str(args.sf)},
-                                   stats=args.stats)
+                                   stats=args.stats, trace=args.trace)
                 else:
-                    run_one(stmt, args.sf, args.explain, args.stats)
+                    run_one(stmt, args.sf, args.explain, args.stats,
+                            trace=args.trace)
             except Exception as e:  # noqa: BLE001 - REPL reports and continues
                 print(f"error: {type(e).__name__}: {e}", file=sys.stderr)
     return 0

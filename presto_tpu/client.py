@@ -40,7 +40,8 @@ class StatementClient:
     def __init__(self, server_url: str, text: str, user: str = "presto",
                  session: Optional[Dict[str, str]] = None,
                  transaction_id: Optional[str] = None,
-                 timeout: float = 120.0):
+                 timeout: float = 120.0,
+                 extra_headers: Optional[Dict[str, str]] = None):
         self.server_url = server_url.rstrip("/")
         self.timeout = timeout
         self.columns: Optional[List[dict]] = None
@@ -60,6 +61,11 @@ class StatementClient:
                 f"{k}={v}" for k, v in session.items())
         if transaction_id:
             headers["X-Presto-Transaction-Id"] = transaction_id
+        if extra_headers:
+            # e.g. X-Presto-Trace: the caller's W3C-style trace context
+            # joins the server's spans for this statement to the
+            # caller's own trace (server/tracing.py parses it)
+            headers.update(extra_headers)
         doc, _ = self._request(f"{self.server_url}/v1/statement",
                                method="POST", body=text.encode(),
                                headers=headers, follow_307=True)
@@ -144,8 +150,10 @@ class StatementClient:
 def execute(server_url: str, text: str, user: str = "presto",
             session: Optional[Dict[str, str]] = None,
             transaction_id: Optional[str] = None,
-            timeout: float = 120.0) -> StatementClient:
+            timeout: float = 120.0,
+            extra_headers: Optional[Dict[str, str]] = None
+            ) -> StatementClient:
     """POST + drain: returns the finished client (columns/data/stats)."""
     return StatementClient(server_url, text, user=user, session=session,
-                          transaction_id=transaction_id,
-                          timeout=timeout).drain()
+                          transaction_id=transaction_id, timeout=timeout,
+                          extra_headers=extra_headers).drain()

@@ -247,7 +247,7 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
               session=None,
               hbm_budget_bytes: Optional[int] = None,
               prepared: bool = False,
-              trace_id: Optional[str] = None) -> QueryResult:
+              trace_id=None) -> QueryResult:
     """Plan -> results, end to end (DistributedQueryRunner analog for
     programmatic plans). With a mesh, scan batches are padded to a
     multiple of the mesh size and the plan runs SPMD. With `split_rows`,
@@ -269,7 +269,7 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
             split_rows=split_rows, scan_ranges=scan_ranges,
             remote_sources=remote_sources, memory_pool=memory_pool,
             query_id=query_id, session=session,
-            hbm_budget_bytes=hbm_budget_bytes)
+            hbm_budget_bytes=hbm_budget_bytes, trace_id=trace_id)
     if not prepared:
         root = prepare_plan(root, sf=sf, mesh=mesh, session=session)
     from ..utils.config import session_flag, session_value
@@ -431,6 +431,11 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
         collector.note("narrowed_bytes_saved", narrowed_saved)
         collector.note("narrowed_columns", narrowed_cols)
         note_narrowed(narrowed_cols, narrowed_saved)
+        # narrow-width decisions are exactly the kind of silent plan
+        # choice a post-mortem wants on the timeline (flight recorder)
+        from ..server.flight_recorder import record_event
+        record_event("narrow_width", query_id=query_id,
+                     columns=narrowed_cols, bytes_saved=narrowed_saved)
     try:
         with stats.timed("execute_s"), collecting(collector), \
                 collector.stage("execute"):
@@ -619,7 +624,7 @@ def _result_bytes(res: "QueryResult") -> int:
 def _finalize_query_stats(collector: StatsCollector, res: "QueryResult",
                           t0: float, peak_reserved_bytes: int,
                           root: Optional[N.PlanNode],
-                          trace_id: Optional[str] = None) -> None:
+                          trace_id=None) -> None:
     """Close out the structured stats for one run_query invocation and
     emit one tracer span per collected stage. `peak_reserved_bytes` is
     the pool high-water mark the caller already drained."""
@@ -648,7 +653,16 @@ def _finalize_query_stats(collector: StatsCollector, res: "QueryResult",
                            output_bytes=qs.output_bytes,
                            wall_us=qs.stage_us("fetch"))
     res.query_stats = qs
-    collector.emit_spans(trace_id or collector.query_id)
+    # trace_id is either a plain grouping string (legacy) or a
+    # TraceContext carrying (trace id, parent span id): with a context,
+    # stage spans become children of the propagated task/query span so
+    # the distributed trace stitches with valid parent edges
+    from ..server.tracing import TraceContext
+    if isinstance(trace_id, TraceContext):
+        collector.emit_spans(trace_id.trace_id,
+                             parent_id=trace_id.span_id)
+    else:
+        collector.emit_spans(trace_id or collector.query_id)
 
 
 def _compile_any(root: N.PlanNode, mesh, default_join_capacity: int,

@@ -365,20 +365,19 @@ class StatsCollector:
             self.stats.counters[name] = \
                 self.stats.counters.get(name, 0) + delta
 
-    def emit_spans(self, trace_id: Optional[str] = None) -> None:
-        """Ship collected stage spans to the process tracer (one span
-        per stage boundary; no-op without a tracer installed)."""
-        from ..server.tracing import get_tracer
-        t = get_tracer()
-        if t is None:
-            return
+    def emit_spans(self, trace_id: Optional[str] = None,
+                   parent_id: Optional[str] = None) -> None:
+        """Ship collected stage spans through the tracing emission seam
+        (one span per stage boundary, each a child of `parent_id` --
+        the enclosing task/query span). emit_span delivers to the
+        process tracer AND any thread-local SpanBuffer, and never
+        raises (broken tracers are counted, not fatal)."""
+        from ..server.tracing import emit_span
         tid = trace_id or self.query_id
         for name, start_s, end_s, attrs in self.spans:
-            try:
-                t.span(tid, f"stage.{name}", start_s, end_s,
-                       {k: v for k, v in attrs.items()})
-            except Exception:  # noqa: BLE001 - tracing never fails a query
-                pass
+            emit_span(tid, f"stage.{name}", start_s, end_s,
+                      {k: v for k, v in attrs.items()},
+                      parent_id=parent_id)
 
 
 class _StageTimer:

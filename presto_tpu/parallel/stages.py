@@ -41,6 +41,12 @@ def _note_exchange(kind: str, axis_name: str) -> None:
     if c is not None:
         c.note(f"exchange.{kind}")
         c.note("exchanges")
+        # exchange shape is a silent plan decision a post-mortem wants
+        # on the timeline; trace-time only (cache hits skip it), so the
+        # cost is one ring append per lowered collective
+        from ..server.flight_recorder import record_event
+        record_event("exchange_shape", query_id=c.query_id,
+                     shape=kind, axis=axis_name)
 
 
 def distributed_group_by(shard: Batch, key_channels: Sequence[int],

@@ -12,9 +12,9 @@ specification presto-docs/src/main/sphinx/develop/serialized-page.rst
 
 Checksum is CRC32 over [payload, codec, rows, uncompressed_size] per the
 spec. Compression algorithm is out-of-band cluster config in the
-reference (PagesSerdeFactory LZ4/GZIP/ZSTD); this build supports
-zstd (the `zstandard` wheel is in-image) and zlib; LZ4 arrives with the
-native serde kernels.
+reference (PagesSerdeFactory LZ4/GZIP/ZSTD); this build supports zstd
+(degrading to zlib when the `zstandard` wheel is absent) and zlib; LZ4
+arrives with the native serde kernels.
 
 Encodings: BYTE/SHORT/INT/LONG/INT128_ARRAY, VARIABLE_WIDTH, DICTIONARY,
 RLE. Nested ARRAY/MAP/ROW land with nested-type Block support.
@@ -44,6 +44,45 @@ _COMPRESSED = 1
 _ENCRYPTED = 2
 _CHECKSUMMED = 4
 
+_ZSTD_MAGIC = b"\x28\xb5\x2f\xfd"
+
+
+_zstd_mod = None  # unresolved; False once the import failed
+
+
+def _zstd():
+    """The `zstandard` module, or None when the wheel is absent (some
+    images ship without it; PageCodec then degrades to zlib). The
+    probe result is cached: Python does not cache FAILED imports, and
+    this runs per page on the exchange hot path of a wheel-less node."""
+    global _zstd_mod
+    if _zstd_mod is None:
+        try:
+            import zstandard
+            _zstd_mod = zstandard
+        except ImportError:
+            _zstd_mod = False
+    return _zstd_mod or None
+
+def _bounded_zlib(payload: bytes, uncompressed_size: int) -> bytes:
+    """zlib.decompress with the declared-size output bound every codec
+    branch enforces: a corrupt/crafted page that inflates past its page
+    header's uncompressed_size is rejected, never allocated."""
+    d = zlib.decompressobj()
+    out = d.decompress(payload, uncompressed_size + 1)
+    if len(out) > uncompressed_size:
+        raise ValueError(
+            "zlib page inflates past its declared uncompressed size "
+            f"({uncompressed_size} bytes)")
+    if not d.eof:
+        # decompressobj returns partial output where zlib.decompress
+        # raised; keep rejecting truncated/incomplete streams
+        raise ValueError(
+            "truncated zlib page: stream ended before its compressed "
+            "data was complete")
+    return out
+
+
 _FIXED_ENC = {1: b"BYTE_ARRAY", 2: b"SHORT_ARRAY", 4: b"INT_ARRAY",
               8: b"LONG_ARRAY", 16: b"INT128_ARRAY"}
 _ENC_WIDTH = {v: k for k, v in _FIXED_ENC.items()}
@@ -56,8 +95,15 @@ class PageCodec:
 
     def compress(self, payload: bytes) -> bytes:
         if self.compression == "zstd":
-            import zstandard
-            return zstandard.ZstdCompressor().compress(payload)
+            z = _zstd()
+            if z is None:
+                # `zstandard` wheel absent on this image: degrade to the
+                # stdlib codec rather than failing the exchange. Both
+                # directions of a PageCodec degrade together (decompress
+                # detects the zstd magic), so in-cluster pages stay
+                # symmetric; only a true-zstd peer would notice.
+                return zlib.compress(payload)
+            return z.ZstdCompressor().compress(payload)
         if self.compression == "zlib":
             return zlib.compress(payload)
         if self.compression == "lz4":
@@ -66,11 +112,24 @@ class PageCodec:
 
     def decompress(self, payload: bytes, uncompressed_size: int) -> bytes:
         if self.compression == "zstd":
-            import zstandard
-            return zstandard.ZstdDecompressor().decompress(
+            # Sniff the frame magic on BOTH branches: in a mixed-image
+            # cluster a peer without the wheel sends zlib-fallback pages
+            # (0x78 first byte, never the zstd magic), and a zstd-capable
+            # node must still read them.
+            if payload[:4] != _ZSTD_MAGIC:
+                # fallback-compressed; keep the bounded-output guarantee
+                # the zstd branch gets from max_output_size, so a crafted
+                # page cannot inflate past its declared size
+                return _bounded_zlib(payload, uncompressed_size)
+            z = _zstd()
+            if z is None:
+                raise RuntimeError(
+                    "page is zstd-compressed but the `zstandard` "
+                    "module is not installed on this node")
+            return z.ZstdDecompressor().decompress(
                 payload, max_output_size=uncompressed_size)
         if self.compression == "zlib":
-            return zlib.decompress(payload)
+            return _bounded_zlib(payload, uncompressed_size)
         if self.compression == "lz4":
             return nk.lz4_decompress(payload, uncompressed_size)
         raise ValueError(self.compression)

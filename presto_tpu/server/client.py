@@ -68,9 +68,15 @@ class WorkerClient:
 
     def _request(self, method: str, path: str, body: Optional[bytes] = None):
         from .auth import bearer_headers
+        from .tracing import TRACE_HEADER, current_context
         headers = dict(bearer_headers(self._auth))
         if body is not None:
             headers["Content-Type"] = "application/json"
+        ctx = current_context()
+        if ctx is not None:
+            # every hop this thread makes on a query's behalf (task
+            # create/status, exchange-buffer fetch) carries the trace
+            headers[TRACE_HEADER] = ctx.header()
         last_err = None
         for attempt in (0, 1):
             conn = getattr(self._local, "conn", None)
@@ -100,6 +106,11 @@ class WorkerClient:
                 last_err = e
                 if attempt == 1:
                     raise
+                # stale keep-alive retry: on the flight-recorder
+                # timeline so a post-mortem sees flaky transport
+                from .flight_recorder import record_event
+                record_event("http_retry", path=path,
+                             error=f"{type(e).__name__}: {e}")
         raise last_err  # unreachable
 
     @staticmethod

@@ -22,6 +22,7 @@ within a slice; this scheduler is the cross-worker/DCN tier above it.
 from __future__ import annotations
 
 import threading
+import time
 import uuid
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -31,7 +32,9 @@ from ..connectors import catalog
 from ..plan import fragment_plan, nodes as N
 from .client import WorkerClient
 from .discovery import alive_nodes
+from .flight_recorder import record_event
 from .metrics import record_suppressed
+from .tracing import TraceContext, emit_span, new_span_id, trace_context
 
 __all__ = ["Coordinator", "SchedulerGap"]
 
@@ -117,6 +120,11 @@ class Coordinator:
                 return url, tid, attempt + 1
             except Exception as e:  # noqa: BLE001 - dead worker -> next
                 last_err = f"{type(e).__name__}: {e}"
+                # recorded process-wide (task ids share nothing with the
+                # statement query id, so keying by them would hide
+                # failover forensics from the query's flight dump)
+                record_event("retry_submit", task=task_id,
+                             target=url, error=last_err)
         raise RuntimeError(
             f"task {task_id} could not be submitted anywhere: {last_err}")
 
@@ -154,6 +162,9 @@ class Coordinator:
                     raise RuntimeError(
                         f"task {tid} failed everywhere: {last_err}")
                 retries_left -= 1
+                # process-wide, like retry_submit above
+                record_event("retry_task", task=tid, source=url,
+                             error=str(last_err))
                 # a consumer often fails because a FINISHED upstream's
                 # buffered pages died with their worker: re-run those
                 # producers on survivors and rewire the body before the
@@ -187,10 +198,19 @@ class Coordinator:
             return list(fallback)
 
     def execute(self, root: N.PlanNode, sf: float = 0.01,
-                timeout: float = 120.0, policy: str = "phased"):
+                timeout: float = 120.0, policy: str = "phased",
+                trace_ctx: Optional[TraceContext] = None):
         """Run a (possibly multi-fragment) plan. Returns (cols, names)
         where cols is a list of (values, nulls) numpy pairs per output
         column, pulled from the final task.
+
+        `trace_ctx` joins this execution to an existing distributed
+        trace (the statement tier's query span); without one the
+        coordinator roots a fresh ``query.<qid>`` trace. Either way
+        every scheduled task carries a per-fragment child context in
+        its TaskUpdateRequest, workers ship their local spans back on
+        the final task status, and the whole query stitches into ONE
+        trace in the process tracer.
 
         `policy` (ExecutionPolicy analog): "phased" (default) runs
         stages bottom-up, waiting for each -- every task is individually
@@ -205,6 +225,10 @@ class Coordinator:
         workers = self.workers()
         fragments = fragment_plan(root)
         qid = uuid.uuid4().hex[:8]
+        trace_id = trace_ctx.trace_id if trace_ctx is not None \
+            else f"query.{qid}"
+        exec_ctx = TraceContext(trace_id, new_span_id())
+        t_exec0 = time.time()
 
         # producer tasks per fragment id: list of (worker_url, task_id)
         produced: Dict[int, List[Tuple[str, str]]] = {}
@@ -214,13 +238,31 @@ class Coordinator:
         submitted: List[Tuple[str, str]] = []
         self._stats_tls.stats = None
         try:
-            result = self._execute_fragments(
-                workers, fragments, produced, submitted, qid, sf, timeout,
-                policy)
-            self._stats_tls.stats = self._merge_task_stats(produced,
-                                                           timeout)
+            # ambient context: every status poll / result pull this
+            # thread makes rides the trace header too
+            with trace_context(exec_ctx):
+                result = self._execute_fragments(
+                    workers, fragments, produced, submitted, qid, sf,
+                    timeout, policy, exec_ctx)
             return result
         finally:
+            # stitch BEFORE task cleanup destroys worker state, and on
+            # the failure path too: the failed query is the one a
+            # post-mortem needs traced, so whatever spans/stats its
+            # completed tasks pinned must survive the query's death
+            try:
+                with trace_context(exec_ctx):
+                    self._stats_tls.stats = self._merge_task_stats(
+                        produced, timeout, trace_id)
+            except Exception as e:  # noqa: BLE001 - telemetry pull must
+                # never mask the query's own outcome
+                record_suppressed("coordinator", "stats_stitch", e)
+            emit_span(trace_id, "coordinator.execute",
+                      t_exec0, time.time(),
+                      {"fragments": len(fragments), "policy": policy,
+                       "workers": len(workers)},
+                      span_id=exec_ctx.span_id,
+                      parent_id=trace_ctx.span_id if trace_ctx else None)
             # release worker-side state: every scheduled task (and its
             # buffered pages) is destroyed once the query is done, the
             # reference's destroy-buffers-after-consumption contract.
@@ -232,49 +274,80 @@ class Coordinator:
                 except Exception as e:  # noqa: BLE001 - best-effort cleanup
                     record_suppressed("coordinator", "task_cleanup", e)
 
-    def _merge_task_stats(self, produced, timeout: float):
+    def _merge_task_stats(self, produced, timeout: float,
+                          trace_id: Optional[str] = None):
         """Fold every produced task's shipped QueryStats into one
         query-level document (order-independent by the merge law, so
-        pull order doesn't matter). Best-effort telemetry with a
-        bounded cost: pulls fan out on a small thread pool grouped per
-        worker (one connection's latency is paid once per worker, not
-        once per task), a short per-pull timeout, and a worker that
-        fails ONE pull is skipped for its remaining tasks -- stats
-        assembly must never fail or stall a finished query."""
+        pull order doesn't matter), and stitch the spans each worker
+        piggybacked on its final task status into the process tracer
+        under `trace_id` (idempotent: add_spans dedups by spanId, so a
+        worker sharing this process's tracer double-delivers safely).
+        Best-effort telemetry with a bounded cost: pulls fan out on a
+        small thread pool grouped per worker (one connection's latency
+        is paid once per worker, not once per task), a short per-pull
+        timeout, and a worker that fails ONE pull is skipped for its
+        remaining tasks -- stats assembly must never fail or stall a
+        finished query."""
         from concurrent.futures import ThreadPoolExecutor
 
         from ..exec.stats import QueryStats
+        from .tracing import get_tracer
         by_url: Dict[str, List[str]] = {}
         for tasks in produced.values():
             for url, tid in tasks:
                 by_url.setdefault(url, []).append(tid)
 
         def pull_worker(url: str, tids: List[str]):
-            docs = []
+            docs, spans = [], []
             client = WorkerClient(url, min(timeout, 2.0))  # keep-alive
             for tid in tids:
                 try:
                     info = client.task_info(tid)
+                    if not info.get("spans") and \
+                            info.get("state") in ("FINISHED", "FAILED"):
+                        # the worker pins spans onto the task a beat
+                        # AFTER flipping it terminal (the span emit +
+                        # buffer handoff happen in the runner thread's
+                        # epilogue); one short re-poll closes the window
+                        time.sleep(0.05)
+                        info = client.task_info(tid)
                 except Exception:  # noqa: BLE001 - best-effort telemetry
-                    return docs  # worker gone: skip its remaining tasks
+                    return docs, spans  # worker gone: skip its remaining
                 doc = (info.get("stats") or {}).get("queryStats")
                 if doc:
                     docs.append(doc)
-            return docs
+                spans.extend(info.get("spans") or [])
+            return docs, spans
 
         merged = None
         if not by_url:
             return merged
+        tracer = get_tracer()
         with ThreadPoolExecutor(max_workers=min(8, len(by_url))) as pool:
-            for docs in pool.map(lambda kv: pull_worker(*kv),
-                                 by_url.items()):
+            for docs, spans in pool.map(lambda kv: pull_worker(*kv),
+                                        by_url.items()):
                 for doc in docs:
                     qs = QueryStats.from_json(doc)
                     merged = qs if merged is None else merged.merge(qs)
+                if tracer is not None and trace_id and spans:
+                    try:
+                        tracer.add_spans(trace_id, spans)
+                    except Exception as e:  # noqa: BLE001 - stitching is
+                        # telemetry; a malformed shipped span must not
+                        # fail a finished query
+                        record_suppressed("coordinator", "stitch_spans", e)
         return merged
 
     def _execute_fragments(self, workers, fragments, produced, submitted,
-                           qid, sf, timeout, policy="phased"):
+                           qid, sf, timeout, policy="phased",
+                           exec_ctx: Optional[TraceContext] = None):
+        if exec_ctx is None:
+            exec_ctx = TraceContext(f"query.{qid}", new_span_id())
+        trace_id = exec_ctx.trace_id
+        # one span per fragment stage (child of coordinator.execute);
+        # every task of the fragment parents under it via the
+        # traceparent its TaskUpdateRequest carries
+        frag_spans: Dict[int, Tuple[str, float]] = {}
         frag_by_id = {f.id: f for f in fragments}
         parent_of: Dict[int, int] = {}
         for f in fragments:
@@ -424,14 +497,18 @@ class Coordinator:
                           in ("SINGLE", "SORTED")]
             ntasks = ntasks_of[frag.id]
 
+            frag_spans[frag.id] = (new_span_id(), time.time())
             bodies = {}
             pending = []
             for w in range(ntasks):
                 # one trace id for the whole distributed query: every
-                # task's spans (task.run + its stage spans) group under
-                # it in the tracer
+                # task's spans (task span + its stage spans) group
+                # under it, parented on this fragment's span via the
+                # propagated traceparent
                 body = {"plan": N.to_json(frag_plan), "sf": sf,
-                        "traceId": f"query.{qid}"}
+                        "traceId": trace_id,
+                        "traceparent": TraceContext(
+                            trace_id, frag_spans[frag.id][0]).header()}
                 if out_part:
                     body["outputPartitions"] = out_part
                 if scans:
@@ -502,6 +579,11 @@ class Coordinator:
                 register=lambda tid, k, f=frag.id: origin.__setitem__(
                     tid, (f, k)))
             produced[frag.id] = [done[w] for w in sorted(done)]
+            sid, t_f0 = frag_spans[frag.id]
+            emit_span(trace_id, f"fragment.f{frag.id}", t_f0, time.time(),
+                      {"tasks": len(done),
+                       "partitioning": frag.partitioning},
+                      span_id=sid, parent_id=exec_ctx.span_id)
 
         for url, tid in all_pending:
             info = WorkerClient(url, timeout).wait(tid, timeout)
@@ -509,6 +591,16 @@ class Coordinator:
                 raise RuntimeError(
                     f"all_at_once task {tid} at {url} is "
                     f"{info['state']}: {info.get('error')}")
+        if policy == "all_at_once":
+            # stage submission overlapped, so fragment spans close
+            # together once every task has landed
+            for frag in fragments:
+                sid, t_f0 = frag_spans[frag.id]
+                emit_span(trace_id, f"fragment.f{frag.id}", t_f0,
+                          time.time(),
+                          {"tasks": len(produced[frag.id]),
+                           "partitioning": frag.partitioning},
+                          span_id=sid, parent_id=exec_ctx.span_id)
 
         # pull + concatenate every final task's buffer (queries whose
         # root fragment is hash-distributed return disjoint slices);
@@ -516,6 +608,7 @@ class Coordinator:
         types = fragments[-1].root.output_types()
         all_cols: List[List] = [[] for _ in types]
         final_bodies = bodies  # last fragment's task bodies, keyed by w
+        t_pull0 = time.time()
         for w, (url, tid) in enumerate(produced[fragments[-1].id]):
             try:
                 cols = WorkerClient(url, timeout).fetch_results(tid, types)
@@ -553,6 +646,11 @@ class Coordinator:
         names = fragments[-1].root.names \
             if isinstance(fragments[-1].root, N.OutputNode) else \
             [f"c{i}" for i in range(len(types))]
+        emit_span(trace_id, "coordinator.fetch_results",
+                  t_pull0, time.time(),
+                  {"tasks": len(produced[fragments[-1].id]),
+                   "rows": len(merged[0][0]) if merged else 0},
+                  parent_id=exec_ctx.span_id)
         return merged, names
 
 

@@ -23,7 +23,8 @@ from typing import Dict, List, Optional, Tuple, Union
 __all__ = ["MetricFamily", "render_prometheus", "parse_prometheus",
            "plan_cache_families", "narrowing_families", "uptime_family",
            "record_suppressed", "suppressed_error_families",
-           "suppressed_error_totals", "CONTENT_TYPE"]
+           "suppressed_error_totals", "tracing_families",
+           "flight_recorder_families", "CONTENT_TYPE"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -152,6 +153,46 @@ def suppressed_error_families() -> List[MetricFamily]:
         # is stable from the first request on)
         fam.add(0, {"component": "none", "site": "none"})
     return [fam]
+
+
+def tracing_families() -> List[MetricFamily]:
+    """Tracer health, exported by BOTH tiers: spans recorded, traces
+    evicted at capacity, spans dropped by a broken tracer -- the
+    counters that tell an operator whether the trace they are about to
+    pull is complete."""
+    from .tracing import tracing_totals
+    t = tracing_totals()
+    return [
+        MetricFamily("presto_tpu_trace_spans_total", "counter",
+                     "spans recorded by the process tracer").add(
+                         t["spans"]),
+        MetricFamily("presto_tpu_traces_evicted_total", "counter",
+                     "traces evicted at tracer capacity "
+                     "(least-recently-updated out)").add(t["evicted"]),
+        MetricFamily("presto_tpu_trace_spans_dropped_total", "counter",
+                     "spans lost to a tracer that raised "
+                     "(see suppressed_errors{component=tracing})").add(
+                         t["dropped"]),
+    ]
+
+
+def flight_recorder_families() -> List[MetricFamily]:
+    """Flight-recorder health: events recorded and auto-dumps written,
+    labelled by trigger reason (failed | slow)."""
+    from .flight_recorder import flight_recorder_totals
+    t = flight_recorder_totals()
+    fam_d = MetricFamily(
+        "presto_tpu_flight_recorder_dumps_total", "counter",
+        "automatic slow/failed-query JSONL dumps, by trigger reason")
+    dumps = t["dumps"]
+    for reason in sorted(set(dumps) | {"failed", "slow"}):
+        fam_d.add(dumps.get(reason, 0), {"reason": reason})
+    return [
+        MetricFamily("presto_tpu_flight_recorder_events_total", "counter",
+                     "structured events appended to the flight-recorder "
+                     "ring").add(t["events"]),
+        fam_d,
+    ]
 
 
 def uptime_family(started_at: float, role: str) -> MetricFamily:

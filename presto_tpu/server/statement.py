@@ -35,7 +35,9 @@ import numpy as np
 from .. import types as T
 from ..transaction import TransactionManager
 from .dispatcher import Dispatcher, QueryRejected
+from .flight_recorder import get_flight_recorder, record_event
 from .query_state import QueryState, QueryStateMachine, TERMINAL_STATES
+from .tracing import TraceContext, new_span_id
 
 __all__ = ["StatementServer", "render_value"]
 
@@ -89,7 +91,8 @@ class _Query:
     """One statement's server-side lifecycle + result store."""
 
     def __init__(self, query_id: str, slug: str, text: str,
-                 session_values: Dict, user: str, txn_id: Optional[str]):
+                 session_values: Dict, user: str, txn_id: Optional[str],
+                 client_ctx: Optional[TraceContext] = None):
         self.id = query_id
         self.slug = slug
         self.text = text
@@ -97,8 +100,21 @@ class _Query:
         self.user = user
         self.txn_id = txn_id
         self.machine = QueryStateMachine(query_id)
+        # this query's trace identity: the client's propagated trace id
+        # when an X-Presto-Trace header arrived, else the query id
+        # itself (so GET /v1/trace/{queryId} resolves without a lookup
+        # table); span_id is the query ROOT span every other span of
+        # the query ultimately parents to
+        self.trace_ctx = TraceContext(
+            client_ctx.trace_id if client_ctx else query_id,
+            new_span_id())
+        self.client_parent = client_ctx.span_id if client_ctx else None
         self.columns: Optional[List[dict]] = None
         self.rows: List[list] = []
+        # client result-drain window (the trace's "client fetch" leg):
+        # set by the executing resource, read once at final-page serve
+        self.first_fetch_at: Optional[float] = None
+        self.fetch_span_done = False
         self.update_type: Optional[str] = None
         self.update_count: Optional[int] = None
         # structured execution stats (QueryStats) once the engine ran
@@ -212,6 +228,10 @@ class StatementServer:
         # (same id _emit_trace uses for the state spans -> one trace
         # per query, and no shared default-"query" trace growing forever)
         kwargs["query_id"] = query_id
+        ctx = self._trace_ctx_of(query_id)
+        if ctx is not None:
+            # stage spans become children of the query root span
+            kwargs["trace_id"] = ctx
         return run_sql(pre.text, sf=sf, **kwargs)
 
     def _user_of(self, query_id: str) -> str:
@@ -219,18 +239,37 @@ class StatementServer:
             q = self._queries.get(query_id)
         return q.user if q is not None else ""
 
+    def _trace_ctx_of(self, query_id: str) -> Optional[TraceContext]:
+        with self._qlock:
+            q = self._queries.get(query_id)
+        return q.trace_ctx if q is not None else None
+
     def _emit_trace(self, q: "_Query") -> None:
-        """Terminal-state hook: per-state spans to the process tracer
-        (QueryStateTracingListener analog; no-op without a tracer)."""
-        from .tracing import get_tracer, spans_from_state_timings
+        """Terminal-state hook: the query ROOT span (queued->terminal)
+        plus per-state child spans (QueryStateTracingListener analog).
+        Everything the query recorded elsewhere -- engine stage spans,
+        coordinator/worker spans on the distributed tier -- parents
+        into this root, so GET /v1/trace/{queryId} serves ONE tree."""
+        from .tracing import emit_span, get_tracer, \
+            spans_from_state_timings
         if get_tracer() is None:
             return
         try:
+            timings = q.machine.timings()
+            start = timings.get(QueryState.QUEUED, time.time())
+            end = timings.get(q.machine.state, time.time())
+            emit_span(q.trace_ctx.trace_id, "query", start, end,
+                      {"queryId": q.id, "user": q.user,
+                       "state": q.machine.state,
+                       "query": q.text[:200]},
+                      span_id=q.trace_ctx.span_id,
+                      parent_id=q.client_parent)
             spans_from_state_timings(
-                q.id, q.machine.timings(),
+                q.trace_ctx.trace_id, timings,
                 ["QUEUED", "PLANNING", "RUNNING", "FINISHING",
                  "FINISHED", "FAILED"],
-                {"user": q.user, "query": q.text[:200]})
+                {"user": q.user},
+                parent_id=q.trace_ctx.span_id)
         except Exception as e:  # noqa: BLE001 - tracing must never
             # fail a query, but a tracer that stops shipping spans
             # should show on /v1/metrics
@@ -249,7 +288,8 @@ class StatementServer:
             del self._queries[qid]
 
     def create_query(self, text: str, user: str,
-                     session_values: Dict, txn_id: Optional[str]) -> _Query:
+                     session_values: Dict, txn_id: Optional[str],
+                     client_ctx: Optional[TraceContext] = None) -> _Query:
         # rule-based session defaults (SessionPropertyConfigurationManager
         # analog): manager defaults under, client values over
         from .session_properties import get_session_property_manager
@@ -260,7 +300,12 @@ class StatementServer:
                 session_values.get("clientTags")), **session_values}
         q = _Query(f"20260730_{uuid.uuid4().hex[:12]}",
                    uuid.uuid4().hex[:12], text, session_values, user,
-                   txn_id)
+                   txn_id, client_ctx=client_ctx)
+        # every state transition lands on the flight-recorder timeline
+        # (the ring a slow/failed dump replays)
+        q.machine.add_listener(
+            lambda old, new, qid=q.id: record_event(
+                "query_state", query_id=qid, frm=old, to=new))
         with self._qlock:
             self._reap_locked()
             self._queries[q.id] = q
@@ -274,6 +319,45 @@ class StatementServer:
             if q.machine.is_done():
                 self._emit_trace(q)
                 self._account_query(q)
+                self._maybe_flight_dump(q)
+
+    def _slow_threshold_ms(self, q: _Query) -> float:
+        """slow_query_threshold_ms session property, env fallback
+        PRESTO_TPU_SLOW_QUERY_MS; 0 / unset disables slow dumps."""
+        import os
+        raw = q.session_values.get(
+            "slow_query_threshold_ms",
+            os.environ.get("PRESTO_TPU_SLOW_QUERY_MS", "0"))
+        try:
+            return float(raw)
+        except (TypeError, ValueError):
+            return 0.0
+
+    def _maybe_flight_dump(self, q: _Query) -> None:
+        """Auto-dump the flight-recorder ring for a failed or slow
+        query -- exactly once per query (the recorder dedups by key),
+        counted per reason on /v1/metrics. Never fails the query."""
+        try:
+            state = q.machine.state
+            reason = None
+            if state == QueryState.FAILED:
+                reason = "failed"
+            else:
+                thresh = self._slow_threshold_ms(q)
+                if thresh > 0 and q.machine.elapsed_ms() >= thresh:
+                    reason = "slow"
+            if reason is None:
+                return
+            get_flight_recorder().maybe_dump(
+                q.id, reason,
+                extra={"state": state, "user": q.user,
+                       "elapsedMs": q.machine.elapsed_ms(),
+                       "traceId": q.trace_ctx.trace_id,
+                       "query": q.text[:200]})
+        except Exception as e:  # noqa: BLE001 - a dump problem is
+            # telemetry loss, not a query failure; leave a counted trace
+            from .metrics import record_suppressed
+            record_suppressed("statement", "flight_dump", e)
 
     def _account_query(self, q: _Query) -> None:
         """Roll a terminal query into the /v1/metrics lifetime totals
@@ -439,6 +523,8 @@ class StatementServer:
                 f"{self.url}/v1/statement/executing/{q.id}/{q.slug}/{token}"
             return doc
         doc["columns"] = q.columns
+        if q.first_fetch_at is None:
+            q.first_fetch_at = time.time()
         lo = token * self.page_rows
         hi = lo + self.page_rows
         page = q.rows[lo:hi]
@@ -451,6 +537,17 @@ class StatementServer:
         if hi < len(q.rows):
             doc["nextUri"] = \
                 f"{self.url}/v1/statement/executing/{q.id}/{q.slug}/{token + 1}"
+        elif not q.fetch_span_done:
+            # final page served: the client-drain leg of the trace
+            # (first results poll -> last page out the door). The flag
+            # check is best-effort: a concurrent re-drain could emit a
+            # second span, acceptable for telemetry.
+            q.fetch_span_done = True
+            from .tracing import emit_span
+            emit_span(q.trace_ctx.trace_id, "client.fetch",
+                      q.first_fetch_at, time.time(),
+                      {"rows": len(q.rows), "pages": token + 1},
+                      parent_id=q.trace_ctx.span_id)
         return doc
 
     def _base_doc(self, q: _Query, state: str) -> dict:
@@ -503,6 +600,20 @@ class StatementServer:
             ids = list(self._queries)
         return [self.admin_doc(i) for i in ids]
 
+    def trace_doc(self, query_or_trace_id: str) -> Optional[dict]:
+        """The stitched one-trace-per-query document for GET
+        /v1/trace/{queryId}. Accepts a query id (resolved to its trace
+        id) or, for reaped queries, a raw trace id."""
+        from .tracing import get_tracer, trace_doc_of
+        with self._qlock:
+            q = self._queries.get(query_or_trace_id)
+        tid = q.trace_ctx.trace_id if q is not None else query_or_trace_id
+        doc = trace_doc_of(get_tracer(), tid)
+        if doc is not None and q is not None:
+            doc["queryId"] = q.id
+            doc["state"] = q.machine.state
+        return doc
+
     def metric_families(self):
         """Coordinator-side /v1/metrics families (shared emitter:
         metrics.py; the worker serves its own set through the same
@@ -545,12 +656,16 @@ class StatementServer:
                "largest per-query peak memory seen").add(
                    totals["peak_memory_bytes"]),
         ]
-        from .metrics import (narrowing_families, plan_cache_families,
-                              suppressed_error_families, uptime_family)
+        from .metrics import (flight_recorder_families,
+                              narrowing_families, plan_cache_families,
+                              suppressed_error_families,
+                              tracing_families, uptime_family)
         fams.append(uptime_family(self._started_at, "coordinator"))
         fams.extend(plan_cache_families())
         fams.extend(narrowing_families())
         fams.extend(suppressed_error_families())
+        fams.extend(tracing_families())
+        fams.extend(flight_recorder_families())
         return fams
 
 
@@ -642,7 +757,11 @@ def _make_handler(server: StatementServer):
             txn = self.headers.get("X-Presto-Transaction-Id")
             if txn in (None, "", "NONE"):
                 txn = None
-            q = server.create_query(text, user, session_values, txn)
+            from .tracing import TRACE_HEADER, parse_traceparent
+            client_ctx = parse_traceparent(
+                self.headers.get(TRACE_HEADER))
+            q = server.create_query(text, user, session_values, txn,
+                                    client_ctx=client_ctx)
             # give fast statements a beat to leave QUEUED (the reference
             # responds immediately; one poll saves a client round trip)
             q.machine.wait_past_queued(0.05)
@@ -674,6 +793,13 @@ def _make_handler(server: StatementServer):
                         if q.clear_txn:
                             headers["X-Presto-Clear-Transaction-Id"] = "true"
                 self._send(doc, headers=headers)
+                return
+            if len(parts) == 3 and parts[:2] == ["v1", "trace"]:
+                doc = server.trace_doc(parts[2])
+                self._send(doc if doc else
+                           {"error": f"no trace for {parts[2]} (is a "
+                                     f"tracer installed?)"},
+                           200 if doc else 404)
                 return
             if len(parts) == 3 and parts[:2] == ["v1", "query"]:
                 doc = server.admin_doc(parts[2])

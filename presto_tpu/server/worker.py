@@ -167,7 +167,8 @@ class _Task:
     # written under the task lock (tpulint C001 enforces this, module-
     # wide: TaskManager's writes through `task.` are checked too)
     _GUARDED_BY = {"lock": ("state", "error", "buffers", "first_token",
-                            "no_more_pages", "stats", "finished_at")}
+                            "no_more_pages", "stats", "finished_at",
+                            "spans")}
 
     def __init__(self, task_id: str, spool_threshold: int = 64 << 20,
                  spool_dir: Optional[str] = None):
@@ -186,6 +187,10 @@ class _Task:
         self.created_at = time.time()
         self.finished_at: Optional[float] = None
         self.stats: Dict[str, float] = {}
+        # the task's local span docs, set once at terminal state: they
+        # ship to the coordinator piggybacked on the final task status
+        # (the distributed-trace stitch transport)
+        self.spans: List[dict] = []
         self.lock = threading.Lock()
 
     def _new_buffer(self) -> SpoolingOutputBuffer:
@@ -193,7 +198,7 @@ class _Task:
 
     def info(self) -> dict:
         with self.lock:
-            return {
+            doc = {
                 "taskId": self.task_id,
                 "state": self.state,
                 "error": self.error,
@@ -204,6 +209,11 @@ class _Task:
                 "stats": dict(self.stats),
                 "elapsedSeconds": round(time.time() - self.created_at, 3),
             }
+            if self.spans:
+                # populated only at terminal state, so in-flight status
+                # polls stay small and the final poll carries the spans
+                doc["spans"] = list(self.spans)
+            return doc
 
 
 class TaskManager:
@@ -321,9 +331,50 @@ class TaskManager:
                                                      "ABORTED")
 
     def _run_inner(self, task: _Task, body: dict):
+        """Trace plumbing around one task execution: parse the
+        propagated context (body ``traceparent``, with the legacy
+        ``traceId`` as fallback trace grouping), run the task with a
+        thread-local SpanBuffer + ambient context installed (so stage
+        spans AND outbound exchange fetches carry the trace), then emit
+        the task span and pin every locally recorded span onto the task
+        for the final-status piggyback the coordinator stitches."""
+        from .flight_recorder import get_flight_recorder
+        from .tracing import (TraceContext, emit_span, new_span_id,
+                              parse_traceparent, span_buffer,
+                              trace_context)
+        ctx = parse_traceparent(body.get("traceparent"))
+        trace_id = (ctx.trace_id if ctx else None) or \
+            body.get("traceId") or task.task_id
+        task_ctx = TraceContext(trace_id, new_span_id())
+        t_start = time.time()
+        with span_buffer() as buf, trace_context(task_ctx):
+            try:
+                self._run_task(task, body, task_ctx)
+            finally:
+                with task.lock:
+                    state = task.state
+                    tstats = dict(task.stats)
+                emit_span(trace_id, f"task.{task.task_id}",
+                          t_start, time.time(),
+                          {"state": state,
+                           "rows": tstats.get("outputRows", 0),
+                           "bytes": tstats.get("outputBytes", 0)},
+                          span_id=task_ctx.span_id,
+                          parent_id=ctx.span_id if ctx else None)
+        with task.lock:
+            task.spans = buf.spans
+        if state == "FAILED":
+            # task-tier flight dump: the worker's view of a failed task
+            # (the coordinator separately dumps per query)
+            get_flight_recorder().maybe_dump(task.task_id, "failed")
+
+    def _run_task(self, task: _Task, body: dict, task_ctx):
+        from .flight_recorder import record_event
         try:
             with task.lock:
                 task.state = "RUNNING"
+            record_event("task_state", query_id=task.task_id,
+                         state="RUNNING")
             plan = N.from_json(body["plan"])
             session = Session(body.get("session", {}))
             if not session.get("tpu_execution_enabled"):
@@ -347,6 +398,7 @@ class TaskManager:
                 # *unpack* boundary -- timed into the task's QueryStats
                 from ..types import parse_type
                 from .http_exchange import fetch_remote_batch
+                from .tracing import emit_span
                 t_ex0 = time.time()
                 remote_sources[node_id] = fetch_remote_batch(
                     spec["sources"], spec["taskIds"],
@@ -357,8 +409,16 @@ class TaskManager:
                     merge_keys=spec.get("mergeKeys"),
                     timeout=float(spec.get("timeoutS", 60.0)))
                 exchange_unpack_s += time.time() - t_ex0
-                exchange_in_rows += int(
+                rows_in = int(
                     np.asarray(remote_sources[node_id].active).sum())
+                exchange_in_rows += rows_in
+                # the pull+decode is a real hop on the query's critical
+                # path: one child span per remote source under the task
+                emit_span(task_ctx.trace_id, "exchange.fetch",
+                          t_ex0, time.time(),
+                          {"node": node_id, "rows": rows_in,
+                           "upstreams": len(spec.get("taskIds", []))},
+                          parent_id=task_ctx.span_id)
             from ..exec.runner import run_query
             # fragment result cache: identical leaf fragments (same
             # canonical plan, splits, data versions) replay their
@@ -372,6 +432,9 @@ class TaskManager:
                     session.get("exchange_compression"))
             if ckey is not None:
                 hit = self.fragment_cache.get(ckey)
+                record_event("fragment_cache",
+                             query_id=task.task_id,
+                             hit=hit is not None)
                 if hit is not None:
                     # a replay produced rows without touching the chip:
                     # re-shipping the ORIGINAL run's compile/execute
@@ -400,6 +463,8 @@ class TaskManager:
                     task._accounted = True
                     self._count("tasks_finished")
                     self._count("rows_produced", hit["rows"])
+                    record_event("task_state", query_id=task.task_id,
+                                 state="FINISHED", cache_replay=True)
                     from .events import event_listeners
                     event_listeners().task_completed(task.task_id,
                                                      "FINISHED",
@@ -407,15 +472,15 @@ class TaskManager:
                     return
             t0 = time.time()
             with self._exec_slots:
-                # trace id: the coordinator propagates one per query so
-                # every task's stage spans group into ONE trace
+                # trace context: the coordinator propagates one trace
+                # per query; stage spans parent under THIS task's span
                 res = run_query(plan, sf=sf, mesh=self.mesh,
                                 scan_ranges=scan_ranges,
                                 remote_sources=remote_sources,
                                 memory_pool=self.memory_pool,
                                 query_id=task.task_id,
                                 session=session,
-                                trace_id=body.get("traceId"))
+                                trace_id=task_ctx)
             wall = time.time() - t0
             with task.lock:
                 if task.state == "ABORTED":
@@ -494,15 +559,10 @@ class TaskManager:
             if qs is not None:
                 self._count("compile_us", qs.compile_us)
                 self._count("execute_us", qs.stage_us("execute"))
-            # one span per worker task; under the coordinator-propagated
-            # trace id the whole distributed query renders as ONE trace
-            from .tracing import get_tracer
-            tr = get_tracer()
-            if tr is not None:
-                tr.span(body.get("traceId") or task.task_id,
-                        f"task.{task.task_id}", t0, time.time(),
-                        {"rows": res.row_count, "bytes": total_bytes,
-                         "wallSeconds": round(wall, 4)})
+            record_event("task_state", query_id=task.task_id,
+                         state="FINISHED", rows=res.row_count)
+            # (the task span itself is emitted by _run_inner's wrapper,
+            # parented under the coordinator's propagated span)
             if ckey is not None:
                 self.fragment_cache.put(ckey, built, res.row_count,
                                         task.stats)
@@ -520,6 +580,10 @@ class TaskManager:
             # not a task failure -- count/report what the status says
             task._accounted = True
             self._count("tasks_aborted" if aborted else "tasks_failed")
+            record_event("task_state", query_id=task.task_id,
+                         state="ABORTED" if aborted else "FAILED",
+                         error=None if aborted else
+                         f"{type(e).__name__}: {e}")
             from .events import event_listeners
             event_listeners().task_completed(
                 task.task_id, "ABORTED" if aborted else "FAILED")
@@ -572,6 +636,9 @@ class TaskManager:
             with task.lock:
                 if task.state not in ("FINISHED", "FAILED"):
                     task.state = "ABORTED"
+                    from .flight_recorder import record_event
+                    record_event("task_state", query_id=task_id,
+                                 state="ABORTED")
                 for b in task.buffers.values():
                     b.clear()
                 task.buffers = {0: task._new_buffer()}
@@ -656,8 +723,12 @@ class _Handler(BaseHTTPRequestHandler):
                            f"lifetime {k}").add(counters[k]))
         fams.extend(plan_cache_families())
         fams.extend(narrowing_families())
-        from .metrics import suppressed_error_families
+        from .metrics import (flight_recorder_families,
+                              suppressed_error_families,
+                              tracing_families)
         fams.extend(suppressed_error_families())
+        fams.extend(tracing_families())
+        fams.extend(flight_recorder_families())
         return fams
 
     def do_GET(self):  # noqa: N802
@@ -682,6 +753,15 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if len(parts) == 3 and parts[:2] == ["v1", "trace"]:
+            # worker-local slice of a distributed trace (the coordinator
+            # serves the stitched whole; this answers "what did THIS
+            # node record" when a stitch looks incomplete)
+            from .tracing import get_tracer, trace_doc_of
+            doc = trace_doc_of(get_tracer(), parts[2])
+            return self._send_json(
+                doc if doc else {"error": f"no trace {parts[2]}"},
+                200 if doc else 404)
         if parts == ["v1", "status"]:
             return self._send_json({
                 "nodeId": self.node_id,
@@ -759,6 +839,12 @@ class _Handler(BaseHTTPRequestHandler):
         if len(parts) == 3 and parts[:2] == ["v1", "task"]:
             length = int(self.headers.get("Content-Length", "0"))
             body = json.loads(self.rfile.read(length) or b"{}")
+            from .tracing import TRACE_HEADER
+            hdr = self.headers.get(TRACE_HEADER)
+            if hdr and "traceparent" not in body:
+                # header-propagated context (a reference coordinator or
+                # proxy that cannot amend the body still stitches)
+                body["traceparent"] = hdr
             if "outputIds" in body or "extraCredentials" in body:
                 # a REFERENCE-protocol TaskUpdateRequest (the document a
                 # Presto coordinator POSTs): translate its PlanFragment
@@ -789,7 +875,11 @@ class _Handler(BaseHTTPRequestHandler):
                 body = {"plan": _N.to_json(parsed["plan"]),
                         # coordinator session properties flow through
                         "session": parsed["session"].get(
-                            "systemProperties", {})}
+                            "systemProperties", {}),
+                        # keep the propagated trace context (body- or
+                        # header-injected above) across the translation
+                        "traceparent": body.get("traceparent"),
+                        "traceId": body.get("traceId")}
                 sf = parsed["fragmentInfo"].get("scaleFactor")
                 if sf is not None:  # else the worker's configured sf
                     body["sf"] = sf

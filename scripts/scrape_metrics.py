@@ -34,9 +34,22 @@ def scrape(url: str, timeout: float = 10.0):
         return parse_prometheus(r.read().decode())
 
 
+# tracer + flight-recorder health: reported as their own diff section,
+# zeros INCLUDED -- "no spans recorded" and "no dumps written" are
+# answers an operator pulling a trace needs to see, not absence of news
+TRACING_FAMILIES = (
+    "presto_tpu_trace_spans_total",
+    "presto_tpu_traces_evicted_total",
+    "presto_tpu_trace_spans_dropped_total",
+    "presto_tpu_flight_recorder_events_total",
+    "presto_tpu_flight_recorder_dumps_total",
+)
+
+
 def diff(before: dict, after: dict) -> dict:
-    """Counter deltas + gauge currents between two parsed scrapes."""
-    out = {"counters": {}, "gauges": {}}
+    """Counter deltas + gauge currents between two parsed scrapes,
+    plus the always-present tracing/flight-recorder section."""
+    out = {"counters": {}, "gauges": {}, "tracing": {}}
     for fam, samples in after.items():
         is_counter = fam.endswith("_total")
         for key, val in samples.items():
@@ -44,7 +57,9 @@ def diff(before: dict, after: dict) -> dict:
             if is_counter:
                 prev = before.get(fam, {}).get(key, 0.0)
                 delta = val - prev
-                if delta:
+                if fam in TRACING_FAMILIES:
+                    out["tracing"][label] = round(delta, 6)
+                elif delta:
                     out["counters"][label] = round(delta, 6)
             else:
                 out["gauges"][label] = round(val, 6)

@@ -1,0 +1,199 @@
+"""Flight recorder: bounded ring of structured events, exactly-one
+slow/failed-query JSONL dumps, session/env thresholds, and the
+/v1/metrics dump counters on both tiers.
+
+The operational contract under test: always-on and cheap (ring append,
+no lock on the hot path), dumps triggered by query failure or the
+``slow_query_threshold_ms`` session property (env fallback
+``PRESTO_TPU_SLOW_QUERY_MS``), one dump per query id, every dump
+counted by reason."""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from presto_tpu.server.flight_recorder import (
+    FlightRecorder, flight_recorder_totals, record_event,
+    set_flight_recorder)
+
+
+def _wait_for(fn, timeout=5.0):
+    """The dump is written by the query's execution thread AFTER the
+    client sees the terminal state; poll briefly for it."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(0.02)
+    return fn()
+
+
+@pytest.fixture
+def recorder(tmp_path):
+    r = FlightRecorder(capacity=64, dump_dir=str(tmp_path / "flight"))
+    set_flight_recorder(r)
+    yield r
+    set_flight_recorder(None)
+
+
+# -- the ring -----------------------------------------------------------
+
+def test_ring_drops_oldest_at_capacity():
+    r = FlightRecorder(capacity=8)
+    for i in range(20):
+        r.record("tick", seq=i)
+    evts = r.events(kind="tick")
+    assert len(evts) == 8
+    assert [e["seq"] for e in evts] == list(range(12, 20))
+
+
+def test_events_filter_and_coercion(recorder):
+    record_event("query_state", query_id="q1", frm="QUEUED", to="RUNNING")
+    record_event("narrow_width", query_id="q2", columns=3,
+                 bytes_saved=4096, enabled=True)
+    record_event("http_retry", path="/v1/task/t1",
+                 error=ValueError("boom"))          # coerced to str
+    assert len(recorder.events(kind="query_state")) == 1
+    # a query-filtered view includes process-wide events (no queryId):
+    # they are context the post-mortem needs
+    q1 = recorder.events(query_id="q1")
+    assert {e["kind"] for e in q1} == {"query_state", "http_retry"}
+    retry = recorder.events(kind="http_retry")[0]
+    assert retry["error"] == "boom"
+    nw = recorder.events(kind="narrow_width")[0]
+    assert nw["columns"] == 3 and nw["enabled"] is True
+    assert all("tsUs" in e for e in recorder.events())
+
+
+def test_record_is_counted_process_wide(recorder):
+    before = flight_recorder_totals()["events"]
+    record_event("tick")
+    assert flight_recorder_totals()["events"] == before + 1
+
+
+# -- dumps --------------------------------------------------------------
+
+def test_dump_exactly_once_per_key(recorder):
+    record_event("query_state", query_id="q9", to="FAILED")
+    before = flight_recorder_totals()["dumps"].get("failed", 0)
+    path = recorder.maybe_dump("q9", "failed", extra={"state": "FAILED"})
+    assert path is not None and os.path.exists(path)
+    assert recorder.maybe_dump("q9", "failed") is None   # deduped
+    assert recorder.dump_path("q9") == path
+    # counted once, not once per attempt
+    assert flight_recorder_totals()["dumps"]["failed"] == before + 1
+    lines = [json.loads(l) for l in open(path)]
+    assert lines[0]["dump"]["key"] == "q9"
+    assert lines[0]["dump"]["reason"] == "failed"
+    assert lines[0]["dump"]["state"] == "FAILED"
+    assert any(e.get("kind") == "query_state" for e in lines[1:])
+
+
+def test_dump_file_cap_counts_but_skips_write(tmp_path):
+    r = FlightRecorder(capacity=8, dump_dir=str(tmp_path),
+                       max_dump_files=1)
+    assert r.maybe_dump("a", "slow") is not None
+    before = flight_recorder_totals()["dumps"].get("slow", 0)
+    assert r.maybe_dump("b", "slow") is None             # capped
+    assert flight_recorder_totals()["dumps"]["slow"] == before + 1
+    assert len(os.listdir(tmp_path)) == 1
+
+
+def test_dump_write_failure_never_raises(tmp_path):
+    from presto_tpu.server.metrics import suppressed_error_totals
+    blocked = tmp_path / "not-a-dir"
+    blocked.write_text("a file where the dump dir should be")
+    r = FlightRecorder(capacity=8, dump_dir=str(blocked))
+    assert r.maybe_dump("q", "failed") is None            # no raise
+    assert any(k == ("flight_recorder", "dump")
+               for k in suppressed_error_totals())
+
+
+# -- statement-tier auto-dump (the 3am-page contract) -------------------
+
+def test_failed_query_dumps_exactly_once(recorder):
+    from presto_tpu.client import StatementClient
+    from presto_tpu.server.statement import StatementServer
+    with StatementServer(sf=0.01) as srv:
+        c = StatementClient(srv.url, "SELECT broken_fn(1) FROM region")
+        with pytest.raises(Exception):
+            c.drain()
+        qid = c.query_id
+        assert qid is not None
+        path = _wait_for(lambda: recorder.dump_path(qid))
+        assert path is not None and path.endswith(".failed.jsonl")
+        lines = [json.loads(l) for l in open(path)]
+        assert lines[0]["dump"]["reason"] == "failed"
+        assert lines[0]["dump"]["state"] == "FAILED"
+        # the ring replay shows the query's state transitions
+        states = [e for e in lines[1:] if e.get("kind") == "query_state"
+                  and e.get("queryId") == qid]
+        assert any(e.get("to") == "FAILED" for e in states)
+        # /v1/metrics counts it, reason-labelled
+        with urllib.request.urlopen(f"{srv.url}/v1/metrics") as r:
+            text = r.read().decode()
+        from presto_tpu.server.metrics import parse_prometheus
+        fams = parse_prometheus(text)
+        dumps = fams["presto_tpu_flight_recorder_dumps_total"]
+        assert dumps['{reason="failed"}'] >= 1
+
+
+def test_slow_query_threshold_session_property(recorder):
+    from presto_tpu.client import execute
+    from presto_tpu.server.statement import StatementServer
+    with StatementServer(sf=0.01) as srv:
+        # 1ms threshold: any real query exceeds it
+        r = execute(srv.url, "SELECT count(*) FROM region",
+                    session={"slow_query_threshold_ms": "1"})
+        assert r.data == [[5]]
+        path = _wait_for(lambda: recorder.dump_path(r.query_id))
+        assert path is not None and path.endswith(".slow.jsonl")
+        head = json.loads(open(path).readline())["dump"]
+        assert head["reason"] == "slow"
+        assert head["elapsedMs"] >= 1
+        assert head["traceId"]      # dump cross-links to the trace
+        # fast-but-under-threshold queries do NOT dump
+        r2 = execute(srv.url, "SELECT count(*) FROM region",
+                     session={"slow_query_threshold_ms": "600000"})
+        time.sleep(0.1)
+        assert recorder.dump_path(r2.query_id) is None
+
+
+def test_slow_query_threshold_env_fallback(recorder, monkeypatch):
+    from presto_tpu.client import execute
+    from presto_tpu.server.statement import StatementServer
+    monkeypatch.setenv("PRESTO_TPU_SLOW_QUERY_MS", "1")
+    with StatementServer(sf=0.01) as srv:
+        r = execute(srv.url, "SELECT count(*) FROM nation")
+        assert _wait_for(lambda: recorder.dump_path(r.query_id))
+    monkeypatch.setenv("PRESTO_TPU_SLOW_QUERY_MS", "bogus")
+    with StatementServer(sf=0.01) as srv:
+        # unparseable threshold disables slow dumps instead of erroring
+        r = execute(srv.url, "SELECT count(*) FROM nation")
+        time.sleep(0.1)
+        assert recorder.dump_path(r.query_id) is None
+
+
+# -- worker-tier dump on task failure -----------------------------------
+
+def test_failed_task_dumps_on_worker(recorder):
+    from presto_tpu.server import TpuWorkerServer, WorkerClient
+    from presto_tpu.sql import plan_sql
+    w = TpuWorkerServer(sf=0.01).start()
+    try:
+        c = WorkerClient(f"http://127.0.0.1:{w.port}")
+        c.submit("t-fail", plan_sql("SELECT count(*) FROM region"),
+                 session={"tpu_execution_enabled": "false"})
+        info = c.wait("t-fail")
+        assert info["state"] == "FAILED"
+        path = _wait_for(lambda: recorder.dump_path("t-fail"))
+        assert path is not None and path.endswith(".failed.jsonl")
+        events = [json.loads(l) for l in open(path)][1:]
+        assert any(e.get("kind") == "task_state"
+                   and e.get("state") == "FAILED" for e in events)
+    finally:
+        w.stop()

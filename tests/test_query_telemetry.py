@@ -123,13 +123,24 @@ def test_worker_ships_query_stats_and_coordinator_merges():
         assert qs.peak_memory_bytes > 0
         # the whole distributed query renders as ONE trace: every
         # worker task's span AND its per-stage spans land under the
-        # coordinator's propagated trace id
+        # coordinator's propagated trace id, stitched to the
+        # coordinator's own execute/fragment/fetch spans
         qtraces = [tid for tid in tracer.traces if tid.startswith("query.")]
         assert len(qtraces) == 1
-        names = [s["name"] for s in tracer.spans(qtraces[0])]
+        spans = tracer.spans(qtraces[0])
+        names = [s["name"] for s in spans]
         assert sum(1 for n in names if n.startswith("task.")) >= 3
         assert sum(1 for n in names if n == "stage.execute") >= 3
-        assert all(n.startswith(("task.", "stage.")) for n in names)
+        assert "coordinator.execute" in names
+        assert any(n.startswith("fragment.f") for n in names)
+        assert all(n.startswith(("task.", "stage.", "fragment.",
+                                 "coordinator.", "exchange."))
+                   for n in names)
+        # valid stitch: every non-root span's parent is IN the trace
+        ids = {s["spanId"] for s in spans}
+        for s in spans:
+            if s["parentId"] is not None:
+                assert s["parentId"] in ids, s["name"]
     finally:
         for w in ws:
             w.stop()

@@ -73,6 +73,38 @@ def test_compression_zstd_and_zlib():
         np.testing.assert_array_equal(out[0][0], vals)
 
 
+def test_zstd_codec_reads_zlib_fallback_pages():
+    # Mixed-image cluster: a peer without the zstandard wheel degrades
+    # its "zstd" codec to zlib.compress; a zstd-capable node must sniff
+    # the missing frame magic and still decompress the page.
+    import zlib
+    payload = np.arange(1000, dtype=np.int64).tobytes()
+    fallback = zlib.compress(payload)
+    out = PageCodec(compression="zstd").decompress(fallback, len(payload))
+    assert out == payload
+
+
+def test_zlib_fallback_page_bounded_by_declared_size():
+    # The fallback path keeps zstd's max_output_size guarantee: a page
+    # that inflates past its declared size (corruption or a crafted
+    # bomb) is rejected instead of allocated.
+    import zlib
+    import pytest
+    bomb = zlib.compress(b"\x00" * (1 << 20))
+    with pytest.raises(ValueError, match="declared"):
+        PageCodec(compression="zstd").decompress(bomb, 100)
+    # the plain zlib codec enforces the same bound
+    with pytest.raises(ValueError, match="declared"):
+        PageCodec(compression="zlib").decompress(bomb, 100)
+    # ... and truncated streams still fail loudly, not partially
+    data = bytes(i % 251 for i in range(1200))  # incompressible-ish
+    whole = zlib.compress(data)
+    assert len(whole) > 100
+    with pytest.raises(ValueError, match="truncated"):
+        PageCodec(compression="zlib").decompress(
+            whole[:len(whole) // 2], len(data))
+
+
 def test_serialize_batch_compacts_active():
     b = batch_from_numpy([T.BIGINT], [np.arange(5, dtype=np.int64)],
                          capacity=16)

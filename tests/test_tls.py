@@ -7,6 +7,11 @@ import urllib.request
 
 import pytest
 
+# cert minting needs the cryptography wheel; absent on some images --
+# skip instead of erroring at collection (same policy as the zstandard
+# fallback in serde/pages.py)
+pytest.importorskip("cryptography")
+
 from presto_tpu.server import Coordinator, TpuWorkerServer, WorkerClient
 from presto_tpu.server.discovery import DiscoveryServer, alive_nodes
 from presto_tpu.server.statement import StatementServer
