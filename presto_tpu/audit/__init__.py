@@ -1,0 +1,22 @@
+"""kernaudit: jaxpr-level IR auditing of staged kernels.
+
+tpulint (presto_tpu/lint/) guards the engine's contracts at the
+Python-AST level; this package audits the same contracts where they
+are finally true or false -- in the closed jaxpr XLA actually
+compiles. A helper called through three layers of indirection can
+widen a lane to int64 or smuggle a host callback into a staged
+kernel without tripping any AST rule; it cannot hide from the IR.
+
+The framework deliberately reuses tpulint's building blocks: findings
+are ``lint.core.Finding`` objects (line-independent fingerprints), the
+committed ratchet baseline is ``lint.baseline`` applied to
+``kernaudit_baseline.json``, and per-site suppressions are source
+comments (``# kernaudit: disable=K001``) resolved through each eqn's
+provenance. See DESIGN.md ("Kernel IR auditing") for the pass catalog.
+"""
+
+from .core import (AuditPass, AuditResult, KernelIR, all_passes, get_pass,
+                   register, run_audit)
+
+__all__ = ["AuditPass", "AuditResult", "KernelIR", "all_passes",
+           "get_pass", "register", "run_audit"]

@@ -1,0 +1,257 @@
+"""kernaudit CLI: the ratcheted TPC-H corpus gate over staged-kernel IR.
+
+Same contract as tpulint's CLI (tests pin both):
+
+  exit 0  clean -- no new findings, no stale baseline entries
+  exit 1  new findings and/or stale baseline entries
+  exit 2  internal error (a corpus query failed to stage, bad args,
+          unreadable fixture, bad baseline)
+
+``--json`` emits the same schema-v1 document shape as tpulint
+(``filesScanned`` counts audited KERNELS); ``--format github`` emits
+``::error`` annotations pointing at each finding's source site. The
+baseline (``kernaudit_baseline.json``, committed EMPTY -- fix, don't
+baseline) rides tpulint's ratchet machinery unchanged.
+
+With no positional arguments the gate stages and audits the full
+TPC-H q1-q22 corpus (``presto_tpu/queries/tpch_sql.py``) on both the
+local tier and the mesh tier. Positional arguments are seeded-kernel
+fixture modules (tests/fixtures/kernaudit/): python files exposing
+``build() -> (fn, args)`` plus optional ``TRACE_AXES`` (mesh axes to
+bind while tracing), ``MESH_AXES`` (the DECLARED exchange spec K004
+checks against), and ``FOOTPRINT_BUDGET``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from ..lint.cli import emit_report, run_scoped_baseline
+from ..lint.core import REPO
+from .core import KernelIR, all_passes, run_audit
+
+__all__ = ["main", "DEFAULT_BASELINE", "DEFAULT_FOOTPRINT_BUDGET"]
+
+DEFAULT_BASELINE = os.path.join(REPO, "kernaudit_baseline.json")
+
+# corpus-gate default for K005: generous enough that the sf=0.01
+# corpus (peaks ~125MB) never trips it by noise, tight enough that a
+# runaway intermediate (a quadratic blowup) fails the gate
+DEFAULT_FOOTPRINT_BUDGET = 1 << 30
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kernaudit",
+        description="presto-tpu jaxpr-level IR audit (TPC-H corpus gate)")
+    p.add_argument("paths", nargs="*",
+                   help="seeded-kernel fixture modules to audit "
+                        "(default: stage + audit the TPC-H q1-q22 "
+                        "corpus, local and mesh tiers)")
+    p.add_argument("--select", metavar="CODES",
+                   help="comma-separated pass codes (e.g. K001,K004)")
+    p.add_argument("--queries", metavar="NUMS",
+                   help="corpus subset, e.g. 1,5-7,22 (default: 1-22)")
+    p.add_argument("--tier", choices=("both", "local", "mesh"),
+                   default="both",
+                   help="which corpus tiers to stage (default both)")
+    p.add_argument("--sf", type=float, default=0.01,
+                   help="corpus staging scale factor (default 0.01)")
+    p.add_argument("--budget-bytes", type=int,
+                   default=DEFAULT_FOOTPRINT_BUDGET,
+                   help="K005 footprint budget per kernel "
+                        f"(default {DEFAULT_FOOTPRINT_BUDGET})")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output (schema v1, same "
+                        "shape as tpulint --json)")
+    p.add_argument("--format", choices=("text", "github"), default="text",
+                   help="finding rendering: human text (default) or "
+                        "GitHub Actions ::error annotations; --json "
+                        "takes precedence")
+    p.add_argument("--baseline", metavar="PATH", default=None,
+                   help=f"baseline file (default {DEFAULT_BASELINE})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignore the baseline")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline to match current findings "
+                        "(preserves reasons for surviving entries)")
+    p.add_argument("--list-passes", action="store_true",
+                   help="list registered IR passes and exit")
+    return p
+
+
+def _parse_queries(spec: Optional[str]) -> List[int]:
+    from ..queries.tpch_sql import TPCH_QUERIES
+    if not spec:
+        return sorted(TPCH_QUERIES)
+    out: List[int] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "-" in part:
+            lo, hi = part.split("-", 1)
+            out.extend(range(int(lo), int(hi) + 1))
+        else:
+            out.append(int(part))
+    unknown = [n for n in out if n not in TPCH_QUERIES]
+    if unknown:
+        raise ValueError(f"not in the corpus: {unknown}")
+    if not out:
+        # a reversed range ('7-5') or empty spec must not produce a
+        # green gate that audited nothing
+        raise ValueError(f"--queries {spec!r} selects no corpus queries")
+    return sorted(set(out))
+
+
+def _fixture_kernel(path: str, budget: int) -> KernelIR:
+    """Load one fixture module and trace its kernel. Protocol:
+    ``build() -> (fn, args)``; optional ``TRACE_AXES`` binds mesh axes
+    (size-1 each) around the trace, ``MESH_AXES`` is the DECLARED
+    exchange spec (defaults to TRACE_AXES), ``FOOTPRINT_BUDGET``
+    overrides the K005 budget."""
+    import importlib.util
+
+    abs_path = os.path.abspath(path)
+    name = os.path.splitext(os.path.basename(abs_path))[0]
+    spec = importlib.util.spec_from_file_location(f"_kernaudit_{name}",
+                                                 abs_path)
+    if spec is None or spec.loader is None:
+        raise OSError(f"cannot load fixture module {path}")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    fn, args = mod.build()
+    trace_axes = tuple(getattr(mod, "TRACE_AXES", ()))
+    declared = getattr(mod, "MESH_AXES", None)
+    declared = tuple(trace_axes if declared is None else declared)
+    budget = int(getattr(mod, "FOOTPRINT_BUDGET", budget))
+    label = name
+    try:  # repo-relative labels only for files actually INSIDE the
+        # repo (a string-prefix test would misclassify siblings like
+        # /root/repo-backup); same check as KernelIR.site()
+        if os.path.commonpath([abs_path, REPO]) == REPO:
+            label = os.path.relpath(abs_path, REPO).replace(os.sep, "/")
+    except ValueError:
+        pass
+
+    if trace_axes:
+        import numpy as np
+        import jax
+        from jax.sharding import Mesh
+        from jax.sharding import PartitionSpec as P
+        devs = np.array(jax.devices()[:1]).reshape((1,) * len(trace_axes))
+        mesh = Mesh(devs, trace_axes)
+        traced = jax.shard_map(
+            fn, mesh=mesh, in_specs=tuple(P() for _ in args),
+            out_specs=P(), check_vma=False)
+    else:
+        traced = fn
+    return KernelIR.trace(traced, args, label, exchange_axes=declared,
+                          footprint_budget_bytes=budget)
+
+
+def _corpus_kernels(qnums: List[int], sf: float, tier: str,
+                    budget: int) -> List[KernelIR]:
+    from ..parallel.mesh import make_mesh
+    from ..queries.tpch_sql import stage_tpch
+
+    kernels: List[KernelIR] = []
+    meshes = []
+    if tier in ("both", "local"):
+        meshes.append(None)
+    if tier in ("both", "mesh"):
+        meshes.append(make_mesh(1))
+    for mesh in meshes:
+        for n in qnums:
+            staged = stage_tpch(n, sf=sf, mesh=mesh)
+            axes = tuple(mesh.axis_names) if mesh is not None else ()
+            kernels.append(KernelIR.trace(
+                staged.fn, (staged.batches,), staged.label,
+                exchange_axes=axes, footprint_budget_bytes=budget))
+    return kernels
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _parser().parse_args(argv)
+
+    if args.list_passes:
+        for p in all_passes():
+            print(f"{p.code}  {p.name:22s} {p.description}")
+        return 0
+
+    codes = None
+    if args.select:
+        codes = [c.strip().upper() for c in args.select.split(",")
+                 if c.strip()]
+        known = {p.code for p in all_passes()}
+        unknown = [c for c in codes if c not in known]
+        if unknown:
+            print(f"kernaudit: unknown pass code(s): "
+                  f"{', '.join(unknown)} "
+                  f"(known: {', '.join(sorted(known))})", file=sys.stderr)
+            return 2
+
+    try:
+        if args.paths:
+            kernels = [_fixture_kernel(p, args.budget_bytes)
+                       for p in args.paths]
+        else:
+            qnums = _parse_queries(args.queries)
+            kernels = _corpus_kernels(qnums, args.sf, args.tier,
+                                      args.budget_bytes)
+    except Exception as e:  # staging/tracing failures are ERRORS, not
+        # clean runs -- a corpus query that stops planning must turn
+        # the gate red-2, never silently green
+        print(f"kernaudit: {type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+
+    result = run_audit(kernels, codes=codes)
+
+    # partial runs (fixtures / --select / --queries / single tier) only
+    # ratchet baseline entries whose (pass, kernel) was actually
+    # audited -- the same scoped-staleness rule as tpulint's CLI
+    partial = bool(args.paths) or bool(args.select) or \
+        bool(args.queries) or args.tier != "both"
+
+    def in_scope(entry: dict) -> bool:
+        if not partial:
+            return True
+        return entry.get("code") in result.pass_codes and \
+            entry.get("path") in result.kernels
+
+    baselined = 0
+    stale: List[dict] = []
+    new = result.findings
+    if not args.no_baseline:
+        try:
+            new, baselined, stale = run_scoped_baseline(
+                result.findings, args.baseline or DEFAULT_BASELINE,
+                args.update_baseline, partial, in_scope)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"kernaudit: bad baseline: {e}", file=sys.stderr)
+            return 2
+
+    def github_site(f):
+        # whole-kernel findings (K005) carry no source site: anchor
+        # them on the gate's entry point -- GitHub drops annotations
+        # whose file doesn't exist or whose line is 0
+        return (getattr(f, "src_path", "") or "scripts/kernaudit.py",
+                max(f.line, 1))
+
+    emit_report(new, stale, baselined=baselined,
+                suppressed=result.suppressed,
+                pass_codes=result.pass_codes,
+                unit_count=result.kernels_audited, unit_noun="kernel",
+                as_json=args.as_json, fmt=args.format, tool="kernaudit",
+                github_site=github_site,
+                github_title=lambda f: f"kernaudit {f.code} [{f.path}]",
+                stale_github_file=lambda s: "kernaudit_baseline.json")
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

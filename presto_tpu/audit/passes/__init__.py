@@ -1,0 +1,6 @@
+"""Built-in kernaudit IR passes. Importing this package registers
+every pass with the audit registry (core.register side effect); add a
+new pass by dropping a module here and importing it below."""
+
+from . import (collectives, footprint, host_callback,  # noqa: F401
+               wide_lanes, widening)

@@ -1,0 +1,100 @@
+"""K004: collective audit -- every collective runs over a declared
+mesh axis, from inside an exchange boundary.
+
+Stage boundaries are the ONLY place this engine communicates: the
+planner lowers REMOTE exchanges to collectives via parallel/exchange.py
+and parallel/stages.py, gang-scheduled by XLA (stages.py module doc).
+A psum/all_gather/ppermute anywhere else -- an ops/ kernel "helpfully"
+reducing across workers, or an axis name that is not part of the
+kernel's mesh spec -- breaks the SPMD contract in ways that show up as
+wrong results or deadlocks only at multi-chip scale, where they are
+expensive to debug. The audit checks both properties at trace time:
+
+  * every collective's axis must be in the kernel's declared exchange
+    spec (``KernelIR.exchange_axes``, from the mesh the plan compiled
+    against; empty for single-chip kernels, where any collective is a
+    finding);
+  * the collective's provenance must lie in a sanctioned exchange
+    module (the planner's lowering or the parallel/ package) --
+    "collectives outside exchange boundaries" are findings even on the
+    right axis.
+"""
+
+from __future__ import annotations
+
+from typing import List, Set
+
+from ..core import AuditPass, KernelIR, register
+
+__all__ = ["CollectiveAuditPass", "COLLECTIVE_PRIMITIVES",
+           "EXCHANGE_BOUNDARY_FILES"]
+
+COLLECTIVE_PRIMITIVES = frozenset([
+    "psum", "pmax", "pmin", "pmean", "all_gather", "all_to_all",
+    "ppermute", "pbroadcast", "reduce_scatter", "psum_scatter",
+    "pgather", "pshuffle",
+])
+
+# modules sanctioned to lower collectives: the exchange layer, the
+# stage compositions over it, the mesh plumbing, and the planner's
+# exchange/overflow lowering
+EXCHANGE_BOUNDARY_FILES: Set[str] = {
+    "exchange.py", "stages.py", "mesh.py", "planner.py",
+    "tpch_queries.py",  # hand-assembled benchmark pipelines
+}
+
+
+def _axis_names(eqn) -> List[str]:
+    for key in ("axes", "axis_name", "axis"):
+        v = eqn.params.get(key)
+        if v is None:
+            continue
+        if isinstance(v, (tuple, list)):
+            return [str(a) for a in v]
+        return [str(v)]
+    return []
+
+
+@register
+class CollectiveAuditPass(AuditPass):
+    code = "K004"
+    name = "collective-audit"
+    description = ("collectives checked against the kernel's mesh/stage "
+                   "spec: undeclared axis names and collectives outside "
+                   "the exchange boundary are findings")
+
+    def run(self, kernel: KernelIR) -> List:
+        findings = []
+        spec = kernel.exchange_axes
+        for _jx, eqn in kernel.eqns():
+            prim = str(eqn.primitive)
+            if prim not in COLLECTIVE_PRIMITIVES:
+                continue
+            axes = _axis_names(eqn)
+            bad_axes = [a for a in axes if a not in spec]
+            if not spec:
+                findings.append(kernel.finding(
+                    "K004", eqn,
+                    f"`{prim}` over axis {axes or '?'} in a single-chip "
+                    f"kernel (no exchange spec) -- this program must "
+                    f"not communicate"))
+                continue
+            if bad_axes:
+                findings.append(kernel.finding(
+                    "K004", eqn,
+                    f"`{prim}` over undeclared axis "
+                    f"{sorted(bad_axes)} -- the kernel's exchange spec "
+                    f"is {sorted(spec)} (parallel/stages.py mesh "
+                    f"wiring); an unknown axis deadlocks or silently "
+                    f"no-ops at scale"))
+                continue
+            src, _ctx, _line = kernel.site(eqn)
+            base = src.rsplit("/", 1)[-1]
+            if base not in EXCHANGE_BOUNDARY_FILES:
+                findings.append(kernel.finding(
+                    "K004", eqn,
+                    f"`{prim}` over {axes} outside the exchange "
+                    f"boundary (parallel/exchange.py, parallel/"
+                    f"stages.py, plan lowering) -- stage boundaries are "
+                    f"the only sanctioned communication points"))
+        return findings

@@ -1,0 +1,55 @@
+"""K002: host round-trips inside a staged kernel.
+
+The engine's whole performance model rests on one fused XLA program
+per fragment with no host involvement between staging and fetch
+(Flare's native-compilation argument). A ``pure_callback`` /
+``io_callback`` / ``debug_callback`` eqn -- or a mid-program
+``device_put`` -- re-introduces exactly the device->host->device
+round-trip fusion exists to eliminate, and serializes every batch on
+it. tpulint's H001 catches the obvious AST spellings; this pass
+catches whatever actually survived into the IR, however it got there.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core import AuditPass, KernelIR, register
+
+__all__ = ["HostCallbackPass", "HOST_PRIMITIVES"]
+
+HOST_PRIMITIVES = frozenset([
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "host_callback_call", "outside_call", "device_put", "infeed",
+    "outfeed",
+])
+
+_DETAIL = {
+    "device_put": "a mid-program transfer splits the fused program at "
+                  "the host boundary",
+    "infeed": "host infeed stalls the program on the host queue",
+    "outfeed": "host outfeed stalls the program on the host queue",
+}
+
+
+@register
+class HostCallbackPass(AuditPass):
+    code = "K002"
+    name = "host-round-trip"
+    description = ("pure_callback/io_callback/debug_callback/device_put "
+                   "eqns inside a staged kernel (host round-trips that "
+                   "split the fused program)")
+
+    def run(self, kernel: KernelIR) -> List:
+        findings = []
+        for _jx, eqn in kernel.eqns():
+            prim = str(eqn.primitive)
+            if prim not in HOST_PRIMITIVES:
+                continue
+            detail = _DETAIL.get(
+                prim, "the device waits on a host round-trip on every "
+                      "batch")
+            findings.append(kernel.finding(
+                "K002", eqn,
+                f"`{prim}` eqn inside a staged kernel -- {detail}"))
+        return findings

@@ -1,0 +1,86 @@
+"""K003: widening chains -- up-cast-then-down-cast sequences.
+
+A ``convert_element_type`` to a wider dtype whose ONLY consumers
+convert straight back down to (at most) the original width moved every
+element through wide lanes for nothing: on v5e an int64 intermediate
+is an emulated i32 pair, so the chain doubles the HBM traffic of the
+values it touches and produces bits the program immediately throws
+away. These chains are invisible to AST linting (each cast looks
+individually reasonable -- typically a helper widening "to be safe"
+feeding a caller that narrows) and only appear once the helpers
+inline into one jaxpr.
+
+The check is per-jaxpr-level (a var's consumers live in its owning
+jaxpr); call-like consumers (pjit/scan/...) conservatively exempt the
+chain, since the sub-jaxpr may use the wide bits.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core import AuditPass, KernelIR, register
+
+__all__ = ["WideningChainPass"]
+
+
+def _dtype(v):
+    return getattr(getattr(v, "aval", None), "dtype", None)
+
+
+@register
+class WideningChainPass(AuditPass):
+    code = "K003"
+    name = "widening-chain"
+    description = ("convert_element_type up-casts whose only consumers "
+                   "immediately down-cast (wide HBM round-trips for "
+                   "bits the program discards)")
+
+    def run(self, kernel: KernelIR) -> List:
+        findings = []
+        # one var -> consumers map per jaxpr level (keyed by identity):
+        # rescanning jx.eqns per up-cast would be quadratic on fused
+        # TPC-H programs with thousands of eqns
+        consumer_maps: dict = {}
+
+        def consumers_of(jx, var):
+            m = consumer_maps.get(id(jx))
+            if m is None:
+                m = {}
+                for c in jx.eqns:
+                    for v in c.invars:
+                        m.setdefault(id(v), []).append(c)
+                consumer_maps[id(jx)] = m
+            return m.get(id(var), ())
+
+        for jx, eqn in kernel.eqns():
+            if str(eqn.primitive) != "convert_element_type":
+                continue
+            src = _dtype(eqn.invars[0])
+            dst = _dtype(eqn.outvars[0])
+            if src is None or dst is None or \
+                    dst.itemsize <= src.itemsize:
+                continue  # not an up-cast
+            out = eqn.outvars[0]
+            # consumers within the owning jaxpr (incl. being an output)
+            if any(v is out for v in jx.outvars):
+                continue
+            consumers = consumers_of(jx, out)
+            if not consumers:
+                continue
+            chain = all(
+                str(c.primitive) == "convert_element_type"
+                and _dtype(c.outvars[0]) is not None
+                and _dtype(c.outvars[0]).itemsize <= src.itemsize
+                for c in consumers)
+            if not chain:
+                continue
+            downs = ", ".join(sorted({str(_dtype(c.outvars[0]))
+                                      for c in consumers}))
+            findings.append(kernel.finding(
+                "K003", eqn,
+                f"widening chain: {src} up-cast to {dst} is only ever "
+                f"down-cast again (to {downs}) -- the wide intermediate "
+                f"wastes HBM traffic narrow-width execution saved; "
+                f"compute in {src} or fuse the casts"))
+        return findings

@@ -192,6 +192,19 @@ class MemoryPool:
                 return self._query_peak.pop(query_id, 0)
             return self._query_peak.get(query_id, 0)
 
+    def note_audit_estimate(self, query_id: str, bytes_: int) -> bool:
+        """Fold the kernel auditor's K005 planned-peak estimate into the
+        query's high-water accounting (audit/passes/footprint.py). The
+        scan-reservation charge only covers staged INPUTS; the IR
+        estimate also sees the program's intermediates, so the max of
+        the two is the better QueryStats.peak answer. Returns True when
+        the estimate alone exceeds pool capacity -- the caller's cue
+        that this plan cannot fit even an empty pool."""
+        with self._lock:
+            cur = self._query_peak.get(query_id, 0)
+            self._query_peak[query_id] = max(cur, int(bytes_))
+        return int(bytes_) > self.capacity
+
 
 @dataclasses.dataclass
 class MemoryContext:

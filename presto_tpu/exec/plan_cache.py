@@ -110,7 +110,12 @@ KERNEL_MODE_ENVS = (("PRESTO_TPU_SMALLG", "auto"),
                     ("PRESTO_TPU_SMALLG_PALLAS", "1"),
                     ("PRESTO_TPU_NARROW", "1"),
                     ("PRESTO_TPU_BF16", "auto"),
-                    ("PRESTO_TPU_GROUPBY", "sort"))
+                    ("PRESTO_TPU_GROUPBY", "sort"),
+                    # staging-time kernel auditing (audit/staged.py):
+                    # doesn't change the lowered program, but keying it
+                    # keeps audit-memo and executable lifecycles aligned
+                    # and satisfies R001's registered-env contract
+                    ("PRESTO_TPU_KERNEL_AUDIT", "0"))
 
 
 def _kernel_mode() -> str:
@@ -162,3 +167,8 @@ def clear_plan_cache() -> None:
         _cache.clear()
         _hits = 0
         _misses = 0
+    # the kernel-audit memo is keyed by the same (fingerprint, mesh,
+    # kernel-mode) identity as cache entries: clearing one without the
+    # other would serve stale audit reports for freshly traced programs
+    from ..audit.staged import clear_audit_memo
+    clear_audit_memo()

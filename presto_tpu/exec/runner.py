@@ -436,6 +436,21 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
         from ..server.flight_recorder import record_event
         record_event("narrow_width", query_id=query_id,
                      columns=narrowed_cols, bytes_saved=narrowed_saved)
+    # staging-time kernel audit (audit/staged.py): with the
+    # kernel_audit session property (env PRESTO_TPU_KERNEL_AUDIT) on,
+    # trace the fused program once more over the staged batches and run
+    # the IR passes -- findings land in QueryStats counters, the
+    # process /v1/metrics totals, and one flight-recorder event; the
+    # K005 footprint estimate feeds the memory pool. Memoized per
+    # (plan fingerprint, mesh, kernel mode, shapes); never fails the
+    # query.
+    from ..audit.staged import audit_staged_query, kernel_audit_enabled
+    if kernel_audit_enabled(session):
+        with stats.timed("kernel_audit_s"):
+            audit_staged_query(plan, batches, mesh=mesh,
+                               query_id=query_id, session=session,
+                               collector=collector, stats=stats,
+                               memory_pool=memory_pool, plan_fp=fp)
     try:
         with stats.timed("execute_s"), collecting(collector), \
                 collector.stage("execute"):

@@ -26,6 +26,7 @@ from __future__ import annotations
 import functools
 from typing import Callable, Sequence, Union
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -123,7 +124,10 @@ def _like(a: StringColumn, pattern: str) -> jnp.ndarray:
         if si == 0 and anchored_left:
             feasible = feasible & (pos == 0)
         found = jnp.any(feasible, axis=1)
-        first = jnp.argmax(feasible, axis=1).astype(jnp.int32)
+        # lax.argmax with an explicit int32 index dtype: jnp.argmax
+        # materializes int64 indices under x64 and the immediate
+        # .astype(int32) threw the wide lane away (kernaudit K001)
+        first = jax.lax.argmax(feasible, 1, jnp.int32)
         ok = ok & found
         earliest = first + len(seg)
 

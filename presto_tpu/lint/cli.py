@@ -28,9 +28,106 @@ from .baseline import (DEFAULT_BASELINE, apply_baseline, build_baseline,
                        load_baseline, save_baseline)
 from .core import all_passes, run_passes
 
-__all__ = ["main", "JSON_SCHEMA_VERSION"]
+__all__ = ["main", "JSON_SCHEMA_VERSION", "github_annotation",
+           "run_scoped_baseline", "emit_report"]
 
 JSON_SCHEMA_VERSION = 1
+
+
+def _gh_escape(s: str, prop: bool = False) -> str:
+    """GitHub workflow-command data escaping: %, CR, LF everywhere;
+    property values additionally escape ',' and ':'."""
+    out = (str(s).replace("%", "%25").replace("\r", "%0D")
+           .replace("\n", "%0A"))
+    if prop:
+        out = out.replace(",", "%2C").replace(":", "%3A")
+    return out
+
+
+def github_annotation(path: str, line: int, title: str,
+                      message: str) -> str:
+    """One ``::error`` GitHub Actions annotation line -- the
+    ``--format github`` output unit shared by tpulint and kernaudit
+    (tests pin this exact shape)."""
+    return (f"::error file={_gh_escape(path, prop=True)},"
+            f"line={int(line)},"
+            f"title={_gh_escape(title, prop=True)}::{_gh_escape(message)}")
+
+
+def run_scoped_baseline(findings, baseline_path, update: bool,
+                        partial: bool, in_scope):
+    """The shared ratchet sequence both CLIs (tpulint, kernaudit) run:
+    load, optionally rewrite preserving out-of-scope entries, apply,
+    and scope stale detection to what was actually scanned. Raises the
+    underlying OSError/ValueError/JSONDecodeError for the caller's
+    exit-2 path. -> (new, baselined, stale)."""
+    entries = load_baseline(baseline_path)
+    if update:
+        kept = {fp: e for fp, e in entries.items()
+                if not in_scope(e)} if partial else {}
+        rebuilt = build_baseline(findings, entries)
+        rebuilt.update(kept)  # fingerprints encode code+path, so
+        # out-of-scope entries cannot collide with rebuilt ones
+        save_baseline(rebuilt, baseline_path)
+        entries = rebuilt
+    new, baselined, stale = apply_baseline(findings, entries)
+    if partial:
+        stale = [s for s in stale
+                 if in_scope(entries.get(s["fingerprint"], {}))]
+    return new, baselined, stale
+
+
+def emit_report(new, stale, *, baselined: int, suppressed: int,
+                pass_codes, unit_count: int, unit_noun: str,
+                as_json: bool, fmt: str, tool: str,
+                github_site=None, github_title=None,
+                stale_github_file=None) -> None:
+    """Render one gate run in the shared output contract: the schema-v1
+    ``--json`` document, ``--format github`` annotations, or the human
+    text report + summary -- ONE implementation so tpulint and
+    kernaudit cannot drift. ``github_site(f) -> (file, line)`` /
+    ``github_title(f)`` / ``stale_github_file(s)`` customize the
+    annotation anchors (kernaudit findings anchor on source provenance,
+    not the kernel label)."""
+    if as_json:
+        doc = {
+            "version": JSON_SCHEMA_VERSION,
+            "passes": list(pass_codes),
+            "filesScanned": unit_count,
+            "findings": [f.to_json() for f in new],
+            "baselined": baselined,
+            "suppressed": suppressed,
+            "staleBaseline": stale,
+        }
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    elif fmt == "github":
+        for f in new:
+            site = github_site(f) if github_site else (f.path, f.line)
+            title = github_title(f) if github_title else \
+                f"{tool} {f.code}"
+            print(github_annotation(site[0], site[1], title, f.message))
+        for s in stale:
+            anchor = stale_github_file(s) if stale_github_file else \
+                (s.get("path") or f"{tool}_baseline.json")
+            print(github_annotation(
+                anchor, 1, f"{tool} stale-baseline {s['fingerprint']}",
+                f"expected {s['countExpected']}, found "
+                f"{s['countFound']} -- debt paid, run "
+                f"--update-baseline"))
+    else:
+        for f in new:
+            print(f.render())
+        for s in stale:
+            print(f"stale baseline entry {s['fingerprint']} "
+                  f"({s['code']} {s['path']}): expected "
+                  f"{s['countExpected']}, found {s['countFound']} -- "
+                  f"debt paid, run --update-baseline")
+        summary = (f"{len(new)} finding(s), {baselined} baselined, "
+                   f"{suppressed} suppressed, {len(stale)} stale "
+                   f"baseline entr(ies) across "
+                   f"{unit_count} {unit_noun}(s) "
+                   f"[{','.join(pass_codes)}]")
+        print(("FAIL " if (new or stale) else "ok ") + summary)
 
 
 def _parser() -> argparse.ArgumentParser:
@@ -46,6 +143,10 @@ def _parser() -> argparse.ArgumentParser:
                         "(e.g. W001,H001)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable output (schema-versioned)")
+    p.add_argument("--format", choices=("text", "github"), default="text",
+                   help="finding rendering: human text (default) or "
+                        "GitHub Actions ::error annotations (CI); "
+                        "--json takes precedence")
     p.add_argument("--baseline", metavar="PATH", default=None,
                    help=f"baseline file (default {DEFAULT_BASELINE})")
     p.add_argument("--no-baseline", action="store_true",
@@ -105,49 +206,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     new = result.findings
     if not args.no_baseline:
         try:
-            entries = load_baseline(args.baseline)
+            new, baselined, stale = run_scoped_baseline(
+                result.findings, args.baseline, args.update_baseline,
+                partial, in_scope)
         except (OSError, ValueError, json.JSONDecodeError) as e:
             print(f"tpulint: bad baseline: {e}", file=sys.stderr)
             return 2
-        if args.update_baseline:
-            kept = {fp: e for fp, e in entries.items()
-                    if not in_scope(e)} if partial else {}
-            rebuilt = build_baseline(result.findings, entries)
-            rebuilt.update(kept)  # fingerprints encode code+path, so
-            # out-of-scope entries cannot collide with rebuilt ones
-            save_baseline(rebuilt, args.baseline)
-            entries = rebuilt
-        new, baselined, stale = apply_baseline(result.findings, entries)
-        if partial:
-            stale = [s for s in stale
-                     if in_scope(entries.get(s["fingerprint"], {}))]
 
-    if args.as_json:
-        doc = {
-            "version": JSON_SCHEMA_VERSION,
-            "passes": result.pass_codes,
-            "filesScanned": result.files_scanned,
-            "findings": [f.to_json() for f in new],
-            "baselined": baselined,
-            "suppressed": result.suppressed,
-            "staleBaseline": stale,
-        }
-        print(json.dumps(doc, indent=2, sort_keys=True))
-    else:
-        for f in new:
-            print(f.render())
-        for s in stale:
-            print(f"stale baseline entry {s['fingerprint']} "
-                  f"({s['code']} {s['path']}): expected "
-                  f"{s['countExpected']}, found {s['countFound']} -- "
-                  f"debt paid, run --update-baseline")
-        summary = (f"{len(new)} finding(s), {baselined} baselined, "
-                   f"{result.suppressed} suppressed, {len(stale)} stale "
-                   f"baseline entr(ies) across "
-                   f"{result.files_scanned} file(s) "
-                   f"[{','.join(result.pass_codes)}]")
-        print(("FAIL " if (new or stale) else "ok ") + summary)
-
+    emit_report(new, stale, baselined=baselined,
+                suppressed=result.suppressed,
+                pass_codes=result.pass_codes,
+                unit_count=result.files_scanned, unit_noun="file",
+                as_json=args.as_json, fmt=args.format, tool="tpulint")
     return 1 if (new or stale) else 0
 
 

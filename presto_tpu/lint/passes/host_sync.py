@@ -23,8 +23,11 @@ Flagged constructs: ``.item()``, ``np.asarray(...)``,
 ``jnp.asarray(...)`` WITHOUT a dtype (with an explicit dtype it reads
 as deliberate staging of host data; without one it is either a no-op
 wrapper or a disguised transfer), ``jax.device_get``,
-``(jax.)block_until_ready``, and ``int()/float()/bool()`` applied to a
-``jnp``-rooted expression.
+``(jax.)block_until_ready``, and ``int()/float()/bool()`` applied to
+an expression that looks traced -- one naming ``jnp``/``jax`` OR
+calling an array-reduction method (``float(x.sum())``,
+``bool(mask.any())``), the spellings that smuggle the same sync past
+a literal-name check.
 """
 
 from __future__ import annotations
@@ -51,10 +54,27 @@ _SYNC_METHODS = {"item": ".item() forces a device->host sync",
 
 _COERCIONS = {"int", "float", "bool"}
 
+# array-valued methods whose result is traced whenever the receiver is:
+# float(x.sum()) / bool(m.any()) force the same device->host sync as
+# float(jnp.sum(x)) but spell no `jnp` for the literal-name check
+_TRACED_METHODS = {"sum", "mean", "min", "max", "any", "all", "prod",
+                   "argmax", "argmin", "astype", "reshape", "squeeze"}
 
-def _contains_jnp(node: ast.AST) -> bool:
-    return any(isinstance(sub, ast.Name) and sub.id == "jnp"
-               for sub in ast.walk(node))
+
+def _looks_traced(node: ast.AST) -> bool:
+    """True when an expression plausibly evaluates to a traced array:
+    it mentions ``jnp``/``jax`` by name, or calls an array-reduction
+    method (``x.sum()``) whose receiver would be one inside kernel
+    code. Heuristic on purpose -- the IR-level ground truth lives in
+    kernaudit's K002."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in ("jnp", "jax"):
+            return True
+        if isinstance(sub, ast.Call) and \
+                isinstance(sub.func, ast.Attribute) and \
+                sub.func.attr in _TRACED_METHODS:
+            return True
+    return False
 
 
 @register
@@ -131,8 +151,8 @@ class HostSyncPass(LintPass):
                             emit(node, _SYNC_METHODS["block_until_ready"])
                 elif isinstance(fn, ast.Name) and fn.id in _COERCIONS \
                         and len(node.args) == 1 \
-                        and _contains_jnp(node.args[0]):
-                    emit(node, f"{fn.id}(...) on a jnp expression "
+                        and _looks_traced(node.args[0]):
+                    emit(node, f"{fn.id}(...) on a traced expression "
                                f"forces a device->host sync (and fails "
                                f"under tracing)")
 

@@ -1,7 +1,9 @@
 from .tpch_queries import (q1_local, q1_distributed, q6_local, q3_distributed,
                            Q1_COLUMNS, Q6_COLUMNS, Q3_LINEITEM_COLUMNS,
                            Q3_ORDERS_COLUMNS, Q3_CUSTOMER_COLUMNS)
+from .tpch_sql import TPCH_QUERIES, TpchQuery, stage_tpch, tpch_query
 
 __all__ = ["q1_local", "q1_distributed", "q6_local", "q3_distributed",
            "Q1_COLUMNS", "Q6_COLUMNS", "Q3_LINEITEM_COLUMNS",
-           "Q3_ORDERS_COLUMNS", "Q3_CUSTOMER_COLUMNS"]
+           "Q3_ORDERS_COLUMNS", "Q3_CUSTOMER_COLUMNS",
+           "TPCH_QUERIES", "TpchQuery", "stage_tpch", "tpch_query"]

@@ -1,0 +1,314 @@
+"""The TPC-H q1-q22 SQL corpus, in this engine's dialect.
+
+One committed, importable home for the full benchmark suite the test
+files exercise piecemeal: each entry is the query text (the
+engine-dialect adaptation the SQL test suites pin against numpy
+oracles) plus the planning capacities it needs at small scale factors.
+The kernaudit corpus gate (``scripts/kernaudit.py``) stages every
+query here -- local tier and mesh tier -- and audits the traced IR;
+anything else that wants "run all of TPC-H" (benchmarks, soak tests)
+should import this module rather than re-transcribing query text.
+
+``stage_tpch`` is the corpus's staging front door: SQL -> plan ->
+prepare_plan -> compile_plan -> staged scan batches, stopping right
+before dispatch -- exactly the state the staging-time auditor sees.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+__all__ = ["TPCH_QUERIES", "TpchQuery", "tpch_query", "stage_tpch",
+           "StagedQuery"]
+
+
+@dataclasses.dataclass(frozen=True)
+class TpchQuery:
+    number: int
+    text: str
+    max_groups: int = 1 << 16
+    join_capacity: Optional[int] = None
+
+    @property
+    def label(self) -> str:
+        return f"tpch/q{self.number:02d}"
+
+
+TPCH_QUERIES: Dict[int, TpchQuery] = {q.number: q for q in [
+    TpchQuery(1, """
+      SELECT returnflag, linestatus,
+             sum(quantity) AS sum_qty,
+             sum(extendedprice) AS sum_base_price,
+             sum(extendedprice * (1 - discount)) AS sum_disc_price,
+             count(*) AS count_order
+      FROM lineitem
+      WHERE shipdate <= date '1998-12-01' - interval '90' day
+      GROUP BY returnflag, linestatus
+      ORDER BY returnflag, linestatus
+    """, max_groups=16),
+    TpchQuery(2, """
+      SELECT s.acctbal, s.name, p.partkey
+      FROM part p
+      JOIN partsupp ps ON p.partkey = ps.partkey
+      JOIN supplier s ON s.suppkey = ps.suppkey
+      JOIN nation n ON s.nationkey = n.nationkey
+      WHERE p.size = 15 AND n.regionkey = 3
+        AND ps.supplycost = (SELECT min(ps2.supplycost)
+                             FROM partsupp ps2
+                             JOIN supplier s2 ON s2.suppkey = ps2.suppkey
+                             JOIN nation n2 ON s2.nationkey = n2.nationkey
+                             WHERE ps2.partkey = p.partkey
+                               AND n2.regionkey = 3)
+      ORDER BY s.acctbal DESC, p.partkey LIMIT 10
+    """, max_groups=1 << 13, join_capacity=1 << 17),
+    TpchQuery(3, """
+      SELECT l.orderkey, sum(l.extendedprice * (1 - l.discount)) AS revenue,
+             o.orderdate, o.shippriority
+      FROM customer c
+      JOIN orders o ON c.custkey = o.custkey
+      JOIN lineitem l ON l.orderkey = o.orderkey
+      WHERE c.mktsegment = 'BUILDING'
+        AND o.orderdate < date '1995-03-15'
+        AND l.shipdate > date '1995-03-15'
+      GROUP BY l.orderkey, o.orderdate, o.shippriority
+      ORDER BY revenue DESC, o.orderdate
+      LIMIT 10
+    """, max_groups=1 << 14),
+    TpchQuery(4, """
+      SELECT o.orderpriority, count(*) AS order_count
+      FROM orders o
+      WHERE o.orderdate >= date '1993-07-01'
+        AND o.orderdate < date '1993-10-01'
+        AND EXISTS (SELECT l.orderkey FROM lineitem l
+                    WHERE l.orderkey = o.orderkey
+                      AND l.commitdate < l.receiptdate)
+      GROUP BY o.orderpriority ORDER BY o.orderpriority
+    """, max_groups=16, join_capacity=1 << 17),
+    TpchQuery(5, """
+      SELECT n.name, sum(l.extendedprice * (1 - l.discount)) AS revenue
+      FROM customer c
+      JOIN orders o ON c.custkey = o.custkey
+      JOIN lineitem l ON l.orderkey = o.orderkey
+      JOIN nation n ON c.nationkey = n.nationkey
+      JOIN region r ON n.regionkey = r.regionkey
+      WHERE r.name = 'ASIA'
+        AND o.orderdate >= date '1994-01-01'
+        AND o.orderdate < date '1995-01-01'
+      GROUP BY n.name ORDER BY revenue DESC
+    """, max_groups=64, join_capacity=1 << 18),
+    TpchQuery(6, """
+      SELECT sum(extendedprice * discount) AS revenue
+      FROM lineitem
+      WHERE shipdate >= date '1994-01-01'
+        AND shipdate < date '1995-01-01'
+        AND discount BETWEEN 0.05 AND 0.07
+        AND quantity < 24
+    """, max_groups=4),
+    TpchQuery(7, """
+      SELECT n1.name AS supp_nation, n2.name AS cust_nation,
+             sum(l.extendedprice * (1 - l.discount)) AS revenue
+      FROM lineitem l
+      JOIN supplier s ON l.suppkey = s.suppkey
+      JOIN orders o ON l.orderkey = o.orderkey
+      JOIN customer c ON o.custkey = c.custkey
+      JOIN nation n1 ON s.nationkey = n1.nationkey
+      JOIN nation n2 ON c.nationkey = n2.nationkey
+      WHERE l.shipdate >= date '1995-01-01' AND l.shipdate <= date '1996-12-31'
+        AND ((n1.name = 'FRANCE' AND n2.name = 'GERMANY')
+             OR (n1.name = 'GERMANY' AND n2.name = 'FRANCE'))
+      GROUP BY n1.name, n2.name ORDER BY supp_nation, cust_nation
+    """, max_groups=16, join_capacity=1 << 18),
+    TpchQuery(8, """
+      SELECT year(o.orderdate) AS o_year,
+             sum(CASE WHEN n.name = 'BRAZIL'
+                 THEN l.extendedprice * (1 - l.discount) ELSE 0 END) AS brazil,
+             sum(l.extendedprice * (1 - l.discount)) AS total
+      FROM lineitem l
+      JOIN orders o ON l.orderkey = o.orderkey
+      JOIN customer c ON o.custkey = c.custkey
+      JOIN nation n ON c.nationkey = n.nationkey
+      WHERE o.orderdate >= date '1995-01-01' AND o.orderdate <= date '1996-12-31'
+      GROUP BY year(o.orderdate) ORDER BY o_year
+    """, max_groups=16, join_capacity=1 << 18),
+    TpchQuery(9, """
+      SELECT n.name AS nation, sum(l.extendedprice * (1 - l.discount)) AS profit
+      FROM lineitem l
+      JOIN part p ON l.partkey = p.partkey
+      JOIN supplier s ON l.suppkey = s.suppkey
+      JOIN nation n ON s.nationkey = n.nationkey
+      WHERE p.name LIKE '%sleep%'
+      GROUP BY n.name ORDER BY profit DESC
+    """, max_groups=64, join_capacity=1 << 18),
+    TpchQuery(10, """
+      SELECT c.custkey, c.name, sum(l.extendedprice * (1 - l.discount)) AS rev,
+             c.acctbal, n.name AS nation
+      FROM customer c
+      JOIN orders o ON c.custkey = o.custkey
+      JOIN lineitem l ON l.orderkey = o.orderkey
+      JOIN nation n ON c.nationkey = n.nationkey
+      WHERE o.orderdate >= date '1993-10-01' AND o.orderdate < date '1994-01-01'
+        AND l.returnflag = 'R'
+      GROUP BY c.custkey, c.name, c.acctbal, n.name
+      ORDER BY rev DESC
+      LIMIT 20
+    """, max_groups=1 << 14, join_capacity=1 << 18),
+    TpchQuery(11, """
+      SELECT ps.partkey, sum(ps.supplycost * ps.availqty) AS value
+      FROM partsupp ps
+      GROUP BY ps.partkey
+      HAVING sum(ps.supplycost * ps.availqty) >
+             (SELECT sum(supplycost * availqty) * 0.001 FROM partsupp)
+      ORDER BY value DESC LIMIT 25
+    """, max_groups=1 << 13, join_capacity=1 << 15),
+    TpchQuery(12, """
+      SELECT shipmode,
+             sum(CASE WHEN orderpriority = '1-URGENT'
+                       OR orderpriority = '2-HIGH' THEN 1 ELSE 0 END) AS high,
+             sum(CASE WHEN orderpriority <> '1-URGENT'
+                      AND orderpriority <> '2-HIGH' THEN 1 ELSE 0 END) AS low
+      FROM orders o JOIN lineitem l ON o.orderkey = l.orderkey
+      WHERE l.shipmode IN ('MAIL', 'SHIP')
+        AND l.commitdate < l.receiptdate
+        AND l.shipdate < l.commitdate
+        AND l.receiptdate >= date '1994-01-01'
+        AND l.receiptdate < date '1995-01-01'
+      GROUP BY shipmode ORDER BY shipmode
+    """, max_groups=16, join_capacity=1 << 18),
+    TpchQuery(13, """
+      SELECT c_count, count(*) AS custdist
+      FROM (SELECT custkey, count(*) AS c_count FROM orders
+            GROUP BY custkey) c_orders
+      GROUP BY c_count ORDER BY custdist DESC, c_count DESC
+    """, max_groups=1 << 13),
+    TpchQuery(14, """
+      SELECT 100.00 * sum(CASE WHEN p.type LIKE 'PROMO%'
+                          THEN l.extendedprice * (1 - l.discount)
+                          ELSE 0 END)
+             / sum(l.extendedprice * (1 - l.discount)) AS promo_revenue
+      FROM lineitem l JOIN part p ON l.partkey = p.partkey
+      WHERE l.shipdate >= date '1995-09-01' AND l.shipdate < date '1995-10-01'
+    """, max_groups=4, join_capacity=1 << 18),
+    TpchQuery(15, """
+      WITH revenue AS (
+        SELECT suppkey AS supplier_no,
+               sum(extendedprice * (1 - discount)) AS total_revenue
+        FROM lineitem
+        WHERE shipdate >= date '1996-01-01' AND shipdate < date '1996-04-01'
+        GROUP BY suppkey)
+      SELECT s.suppkey, r.total_revenue
+      FROM supplier s JOIN revenue r ON s.suppkey = r.supplier_no
+      WHERE r.total_revenue >
+            (SELECT max(total_revenue) * 0.999 FROM revenue)
+      ORDER BY s.suppkey
+    """, max_groups=1 << 13, join_capacity=1 << 15),
+    TpchQuery(16, """
+      SELECT p.brand, p.type, p.size,
+             count(DISTINCT ps.suppkey) AS supplier_cnt
+      FROM partsupp ps JOIN part p ON p.partkey = ps.partkey
+      WHERE p.brand <> 'Brand#45'
+        AND p.size IN (9, 14, 23, 45, 19, 3, 36, 49)
+        AND ps.suppkey NOT IN (SELECT suppkey FROM supplier
+                               WHERE comment LIKE '%carefully%deposits%')
+      GROUP BY p.brand, p.type, p.size
+      ORDER BY supplier_cnt DESC, p.brand, p.type, p.size
+      LIMIT 20
+    """, max_groups=1 << 13, join_capacity=1 << 17),
+    TpchQuery(17, """
+      SELECT sum(l.extendedprice) AS total
+      FROM lineitem l JOIN part p ON p.partkey = l.partkey
+      WHERE p.brand = 'Brand#23' AND p.container = 'MED BOX'
+        AND l.quantity < (SELECT 0.2 * avg(l2.quantity) FROM lineitem l2
+                          WHERE l2.partkey = l.partkey)
+    """, max_groups=1 << 13, join_capacity=1 << 17),
+    TpchQuery(18, """
+      SELECT o.custkey, o.orderkey, o.totalprice
+      FROM orders o
+      WHERE o.orderkey IN (SELECT orderkey FROM lineitem
+                           GROUP BY orderkey HAVING sum(quantity) > 210.00)
+      ORDER BY o.totalprice DESC LIMIT 20
+    """, max_groups=1 << 14),
+    TpchQuery(19, """
+      SELECT sum(l.extendedprice * (1 - l.discount)) AS revenue
+      FROM lineitem l JOIN part p ON l.partkey = p.partkey
+      WHERE (p.brand = 'Brand#12' AND l.quantity BETWEEN 1 AND 11
+             AND p.size BETWEEN 1 AND 5)
+         OR (p.brand = 'Brand#23' AND l.quantity BETWEEN 10 AND 20
+             AND p.size BETWEEN 1 AND 10)
+         OR (p.brand = 'Brand#34' AND l.quantity BETWEEN 20 AND 30
+             AND p.size BETWEEN 1 AND 15)
+    """, max_groups=4, join_capacity=1 << 18),
+    TpchQuery(20, """
+      SELECT count(*) FROM supplier s
+      WHERE s.suppkey IN
+            (SELECT ps.suppkey FROM partsupp ps
+             WHERE ps.availqty > (SELECT 0.5 * sum(l.quantity)
+                                  FROM lineitem l
+                                  WHERE l.partkey = ps.partkey
+                                    AND l.suppkey = ps.suppkey))
+    """, max_groups=1 << 17, join_capacity=1 << 17),
+    TpchQuery(21, """
+      SELECT s.name, count(*) AS numwait
+      FROM supplier s
+      JOIN lineitem l1 ON s.suppkey = l1.suppkey
+      JOIN orders o ON o.orderkey = l1.orderkey
+      WHERE o.orderstatus = 'F'
+        AND l1.receiptdate > l1.commitdate
+        AND EXISTS (SELECT l2.orderkey FROM lineitem l2
+                    WHERE l2.orderkey = l1.orderkey
+                      AND l2.suppkey <> l1.suppkey)
+        AND NOT EXISTS (SELECT l3.orderkey FROM lineitem l3
+                        WHERE l3.orderkey = l1.orderkey
+                          AND l3.suppkey <> l1.suppkey
+                          AND l3.receiptdate > l3.commitdate)
+      GROUP BY s.name ORDER BY numwait DESC, s.name LIMIT 10
+    """, max_groups=1 << 13, join_capacity=1 << 18),
+    TpchQuery(22, """
+      SELECT substr(c.phone, 1, 2) AS cntrycode, count(*) AS numcust,
+             sum(c.acctbal) AS totacctbal
+      FROM customer c
+      WHERE substr(c.phone, 1, 2) IN ('13', '31', '23', '29', '30', '18', '17')
+        AND c.acctbal > (SELECT avg(acctbal) FROM customer
+                         WHERE acctbal > 0.00)
+        AND c.custkey NOT IN (SELECT custkey FROM orders)
+      GROUP BY substr(c.phone, 1, 2) ORDER BY cntrycode
+    """, max_groups=64, join_capacity=1 << 17),
+]}
+
+
+def tpch_query(number: int) -> TpchQuery:
+    q = TPCH_QUERIES.get(number)
+    if q is None:
+        raise KeyError(f"no TPC-H query q{number} in the corpus (1-22)")
+    return q
+
+
+@dataclasses.dataclass
+class StagedQuery:
+    """Everything the staging-time auditor sees for one query: the
+    fused function and the staged scan batches it will be dispatched
+    over (call ``fn(tuple(batches))`` -- or trace it)."""
+    label: str
+    fn: object
+    batches: Tuple
+    mesh: Optional[object]
+
+
+def stage_tpch(number: int, sf: float = 0.01,
+               mesh=None) -> StagedQuery:
+    """Plan + compile + stage one corpus query without dispatching:
+    the exact pre-execution state ``audit_staged_query`` audits."""
+    from ..exec.planner import compile_plan
+    from ..exec.runner import _scan_batch, prepare_plan
+    from ..sql import plan_sql
+
+    q = tpch_query(number)
+    root = plan_sql(q.text, max_groups=q.max_groups,
+                    join_capacity=q.join_capacity)
+    root = prepare_plan(root, sf=sf, mesh=mesh)
+    plan = compile_plan(root, mesh, q.join_capacity or 1 << 16)
+    pad = (mesh.devices.size if mesh is not None else 1) * 8
+    batches = tuple(_scan_batch(s, sf, None, pad)
+                    for s in plan.scan_nodes)
+    label = q.label if mesh is None else f"{q.label}.mesh"
+    return StagedQuery(label=label, fn=plan.fn, batches=batches, mesh=mesh)

@@ -24,7 +24,8 @@ __all__ = ["MetricFamily", "render_prometheus", "parse_prometheus",
            "plan_cache_families", "narrowing_families", "uptime_family",
            "record_suppressed", "suppressed_error_families",
            "suppressed_error_totals", "tracing_families",
-           "flight_recorder_families", "CONTENT_TYPE"]
+           "flight_recorder_families", "kernel_audit_families",
+           "CONTENT_TYPE"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -192,6 +193,30 @@ def flight_recorder_families() -> List[MetricFamily]:
                      "structured events appended to the flight-recorder "
                      "ring").add(t["events"]),
         fam_d,
+    ]
+
+
+def kernel_audit_families() -> List[MetricFamily]:
+    """Staging-time kernel-audit totals (audit/staged.py), exported by
+    BOTH tiers: findings per IR pass plus kernels audited. Every
+    registered pass code gets a sample (zeros included) so the scrape
+    shape is stable from the first request on."""
+    from ..audit.core import all_passes
+    from ..audit.staged import kernel_audit_totals
+    t = kernel_audit_totals()
+    findings = t["findings"]
+    fam = MetricFamily(
+        "presto_tpu_kernel_audit_findings_total", "counter",
+        "IR-audit findings surfaced to queries, by pass "
+        "(kernaudit; see DESIGN.md 'Kernel IR auditing')")
+    codes = {p.code for p in all_passes()} | set(findings)
+    for code in sorted(codes):
+        fam.add(findings.get(code, 0), {"pass": code})
+    return [
+        fam,
+        MetricFamily("presto_tpu_kernel_audit_kernels_total", "counter",
+                     "staged kernels traced and audited (memo hits "
+                     "excluded)").add(t["kernels"]),
     ]
 
 

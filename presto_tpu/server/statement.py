@@ -657,6 +657,7 @@ class StatementServer:
                    totals["peak_memory_bytes"]),
         ]
         from .metrics import (flight_recorder_families,
+                              kernel_audit_families,
                               narrowing_families, plan_cache_families,
                               suppressed_error_families,
                               tracing_families, uptime_family)
@@ -666,6 +667,7 @@ class StatementServer:
         fams.extend(suppressed_error_families())
         fams.extend(tracing_families())
         fams.extend(flight_recorder_families())
+        fams.extend(kernel_audit_families())
         return fams
 
 

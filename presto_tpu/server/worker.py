@@ -724,11 +724,13 @@ class _Handler(BaseHTTPRequestHandler):
         fams.extend(plan_cache_families())
         fams.extend(narrowing_families())
         from .metrics import (flight_recorder_families,
+                              kernel_audit_families,
                               suppressed_error_families,
                               tracing_families)
         fams.extend(suppressed_error_families())
         fams.extend(tracing_families())
         fams.extend(flight_recorder_families())
+        fams.extend(kernel_audit_families())
         return fams
 
     def do_GET(self):  # noqa: N802

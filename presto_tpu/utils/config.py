@@ -188,6 +188,17 @@ SESSION_PROPERTIES = (
          "FLOPs / bytes-accessed (costs one extra program trace per "
          "distinct plan+shape, memoized; EXPLAIN ANALYZE, the CLI "
          "--stats flag and bench.py's telemetry smoke turn it on)")
+    .add("kernel_audit", "bool", False,
+         "run the kernaudit IR passes (presto_tpu/audit/) over the "
+         "staged program at staging time: findings land in QueryStats "
+         "counters + presto_tpu_kernel_audit_findings_total{pass} on "
+         "/v1/metrics + a flight-recorder event (costs one extra trace "
+         "per distinct plan+shape, memoized; env default "
+         "PRESTO_TPU_KERNEL_AUDIT)")
+    .add("kernel_audit_budget_bytes", "int", 0,
+         "K005 intermediate-footprint budget for live-query audits: "
+         "kernels whose estimated peak live bytes exceed it are "
+         "findings (0 = report the estimate without gating)")
 )
 
 

@@ -1,11 +1,13 @@
 #!/usr/bin/env python
-"""Static check: hot-path kernel modules stay narrow-lane disciplined.
+"""DEPRECATED static check: hot-path kernel modules stay narrow-lane
+disciplined. Use ``python scripts/tpulint.py --select W001`` instead.
 
 THIN SHIM over tpulint's W001 pass (presto_tpu/lint/passes/
 wide_lanes.py) -- the check that started as this standalone script in
 PR 2 now lives in the pluggable framework, with coverage extended to
-join.py/sort.py/window.py. This entry point keeps the original
-contract for existing callers and tests/test_no_wide_lanes.py:
+join.py/sort.py/window.py. Importing it emits a DeprecationWarning;
+the entry point keeps the original contract for existing callers and
+tests/test_no_wide_lanes.py:
 
   * ``HOT_MODULES`` / ``WIDE_OK_FUNCS`` module globals (mutable -- the
     sensitivity test empties the whitelist);
@@ -21,7 +23,13 @@ from __future__ import annotations
 
 import os
 import sys
+import warnings
 from typing import List, Tuple
+
+warnings.warn("scripts/check_no_wide_lanes.py is deprecated: run "
+              "`python scripts/tpulint.py --select W001` (full module "
+              "coverage + baseline/suppression support)",
+              DeprecationWarning, stacklevel=2)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:

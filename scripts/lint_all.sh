@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# The whole static-analysis gate in one invocation: tpulint (AST tier)
+# then kernaudit (IR tier over the TPC-H q1-q22 corpus), preserving the
+# repo's shared exit contract:
+#
+#   0  both gates clean
+#   1  findings / stale baseline entries in either gate
+#   2  internal error in either gate (bad path, failed staging, ...)
+#
+# Extra arguments are forwarded to BOTH tools (e.g. --format github for
+# CI annotations, --json for machine output). Runs both even when the
+# first fails, so one CI run reports everything.
+set -u
+
+here="$(cd "$(dirname "$0")" && pwd)"
+
+rc=0
+python "$here/tpulint.py" "$@"
+t=$?
+[ "$t" -gt "$rc" ] && rc=$t
+
+python "$here/kernaudit.py" "$@"
+k=$?
+[ "$k" -gt "$rc" ] && rc=$k
+
+exit "$rc"

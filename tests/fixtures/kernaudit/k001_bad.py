@@ -1,0 +1,18 @@
+"""kernaudit K001 fixture: seeded wide-lane escapes in a would-be
+staged kernel. NOT part of the engine -- traced and audited by
+tests/test_kernaudit.py (and `scripts/kernaudit.py <this file>`)."""
+
+import jax.numpy as jnp
+
+
+def build():
+    def kernel(x):  # x: int32 lanes
+        a = x.astype(jnp.int64)                     # BAD: narrow->wide cast
+        b = jnp.arange(x.shape[0], dtype=jnp.int64)  # BAD: wide iota
+        c = jnp.sum(x, dtype=jnp.int64)             # BAD: wide accumulate
+        d = (x < 0).astype(jnp.float64)             # BAD: bool->f64
+        ok = x.astype(jnp.int16)                    # narrow stays narrow
+        sup = x.astype(jnp.int64)  # kernaudit: disable=K001
+        return a + b + c + sup, d, ok
+
+    return kernel, (jnp.zeros(16, dtype=jnp.int32),)

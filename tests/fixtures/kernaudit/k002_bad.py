@@ -1,0 +1,25 @@
+"""kernaudit K002 fixture: seeded host round-trips inside a would-be
+staged kernel. NOT part of the engine -- traced and audited by
+tests/test_kernaudit.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _host_fn(v):
+    return np.asarray(v)
+
+
+def build():
+    def kernel(x):
+        shape = jax.ShapeDtypeStruct(x.shape, x.dtype)
+        a = jax.pure_callback(_host_fn, shape, x)     # BAD: host callback
+        jax.debug.callback(lambda v: None, x)         # BAD: debug callback
+        b = jax.device_put(x)                         # BAD: mid-program put
+        from jax.experimental import io_callback
+        c = io_callback(_host_fn, shape, x, ordered=False)  # BAD: io cb
+        sup = jax.pure_callback(_host_fn, shape, x)  # kernaudit: disable=K002
+        return a + b + c + sup
+
+    return kernel, (jnp.zeros(8, dtype=jnp.int32),)

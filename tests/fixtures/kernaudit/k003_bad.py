@@ -1,0 +1,17 @@
+"""kernaudit K003 fixture: seeded up-cast-then-down-cast widening
+chains. NOT part of the engine -- traced and audited by
+tests/test_kernaudit.py."""
+
+import jax.numpy as jnp
+
+
+def build():
+    def kernel(x):  # x: int16 lanes
+        a = x.astype(jnp.int32).astype(jnp.int16)        # BAD: 2->4->2
+        b = x.astype(jnp.int64).astype(jnp.int8)         # BAD: 2->8->1
+        c = (x + 1).astype(jnp.float64).astype(jnp.int16)  # BAD: 2->8->2
+        keep = x.astype(jnp.int64)          # wide result actually used
+        sup = x.astype(jnp.int64).astype(jnp.int16)  # kernaudit: disable=K003
+        return a, b, c, keep + 1, sup
+
+    return kernel, (jnp.zeros(16, dtype=jnp.int16),)

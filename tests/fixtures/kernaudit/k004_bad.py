@@ -1,0 +1,22 @@
+"""kernaudit K004 fixture: seeded collective violations. Traced under
+two size-1 mesh axes; the DECLARED exchange spec (MESH_AXES) only
+sanctions "rows", so the "workers" psum is an axis-mismatch finding
+and the "rows" collectives -- legal axis, wrong module -- are
+outside-exchange-boundary findings. NOT part of the engine."""
+
+import jax
+import jax.numpy as jnp
+
+TRACE_AXES = ("workers", "rows")   # axes bound while tracing
+MESH_AXES = ("rows",)              # the declared stage spec under audit
+
+
+def build():
+    def kernel(x):
+        a = jax.lax.psum(x, "workers")       # BAD: axis not in the spec
+        b = jax.lax.psum(x, "rows")          # BAD: outside exchange boundary
+        c = jax.lax.all_gather(x, "rows")    # BAD: outside exchange boundary
+        sup = jax.lax.psum(x, "rows")  # kernaudit: disable=K004
+        return a + b + jnp.sum(c, axis=0, dtype=x.dtype) + sup
+
+    return kernel, (jnp.zeros(8, dtype=jnp.int32),)

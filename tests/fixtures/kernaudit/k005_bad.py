@@ -1,0 +1,15 @@
+"""kernaudit K005 fixture: a kernel whose intermediate footprint
+(an 8MB outer product from two 4KB inputs) blows a deliberately tiny
+1MB budget. NOT part of the engine."""
+
+import jax.numpy as jnp
+
+FOOTPRINT_BUDGET = 1 << 20  # 1 MiB -- the outer product is ~8 MiB
+
+
+def build():
+    def kernel(x):  # x: (1024,) float64
+        m = x[:, None] * x[None, :]   # (1024, 1024) f64 intermediate
+        return jnp.sum(m, axis=0)
+
+    return kernel, (jnp.zeros(1024, dtype=jnp.float64),)

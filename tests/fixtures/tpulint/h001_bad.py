@@ -13,7 +13,9 @@ def kernel(x):
     x.block_until_ready()           # BAD: pipeline stall
     jax.device_get(x)               # BAD: explicit device->host
     last = x.sum().item()           # BAD: .item() sync
-    return total, back, last
+    frac = float(x.mean())          # BAD: float() on a traced reduction
+    flag = bool(x.any())            # BAD: bool() on a traced reduction
+    return total, back, last, frac, flag
 
 
 def known_good(rows):

@@ -1,0 +1,397 @@
+"""Tier-1 gates + unit tests for kernaudit (presto_tpu/audit/).
+
+Three contracts ride tier-1:
+
+  1. the TPC-H q1-q22 corpus stages audit-clean on both tiers with the
+     committed EMPTY baseline (``python scripts/kernaudit.py`` exits
+     0) -- an int64 escape, a host callback, a widening chain, a
+     stray collective, or a footprint blowup in any staged corpus
+     kernel fails the suite;
+  2. the detectors are not vacuous: every IR pass fires on its seeded
+     bad-kernel fixture (tests/fixtures/kernaudit/*_bad.py) and the
+     CLI exits 1 on it;
+  3. the staging-time hook surfaces findings on a LIVE query's
+     QueryStats and both /v1/metrics totals when the ``kernel_audit``
+     session property is on.
+
+Plus framework mechanics: source-comment suppressions, the shared
+ratchet baseline, --json schema stability, --format github, and the
+registry/KERNEL_MODE_ENVS non-drift pins.
+"""
+
+import io
+import json
+import os
+import sys
+from contextlib import redirect_stdout
+
+import pytest
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join("tests", "fixtures", "kernaudit")
+
+from presto_tpu.audit import all_passes, run_audit  # noqa: E402
+from presto_tpu.audit.cli import main as kernaudit_main  # noqa: E402
+from presto_tpu.audit.core import KernelIR  # noqa: E402
+
+ALL_CODES = ("K001", "K002", "K003", "K004", "K005")
+
+# (expected minimum findings, expected suppressed sites) per fixture:
+# K005 reports whole-kernel (no source line to suppress on)
+_FIXTURE_PINS = {"K001": (4, 1), "K002": (4, 1), "K003": (3, 1),
+                 "K004": (3, 1), "K005": (1, 0)}
+
+
+def _cli(args):
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = kernaudit_main(list(args))
+    return rc, buf.getvalue()
+
+
+# -- tier-1 gates -------------------------------------------------------
+
+
+def test_registry_ships_all_five_passes():
+    codes = {p.code for p in all_passes()}
+    assert set(ALL_CODES) <= codes
+
+
+@pytest.mark.parametrize("code", ALL_CODES)
+def test_pass_detects_seeded_fixture(code):
+    """Sensitivity: each IR pass fires on its fixture and the CLI
+    exits 1 (the detectors are not vacuous)."""
+    fixture = os.path.join(FIXTURES, f"{code.lower()}_bad.py")
+    rc, out = _cli(["--select", code, "--no-baseline", "--json", fixture])
+    assert rc == 1, out
+    doc = json.loads(out)
+    found = {f["code"] for f in doc["findings"]}
+    assert found == {code}
+    want_min, want_sup = _FIXTURE_PINS[code]
+    assert len(doc["findings"]) >= want_min
+    assert doc["suppressed"] == want_sup
+
+
+def test_tpch_corpus_stages_audit_clean():
+    """The acceptance gate: the full q1-q22 corpus stages audit-clean
+    against the committed (empty) baseline. Tier-1 runs the local tier
+    for all 22 (staging+tracing dominates the cost; the mesh tier of a
+    representative exchange mix rides the next test) -- the standalone
+    `python scripts/kernaudit.py` gate covers both tiers end to end."""
+    rc, out = _cli(["--tier", "local"])
+    assert rc == 0, f"kernaudit found violations:\n{out}"
+
+
+def test_tpch_mesh_tier_exchange_mix_audits_clean():
+    """Mesh-tier slice of the corpus covering every exchange shape the
+    planner lowers (gather: q1, broadcast+partitioned joins: q3, range
+    merge: q13) -- K004's live hunting ground."""
+    rc, out = _cli(["--tier", "mesh", "--queries", "1,3,13"])
+    assert rc == 0, f"kernaudit found violations:\n{out}"
+
+
+def test_committed_baseline_is_empty():
+    """`fix, don't baseline`: the shipped corpus baseline carries no
+    grandfathered debt."""
+    with open(os.path.join(REPO, "kernaudit_baseline.json")) as f:
+        doc = json.load(f)
+    assert doc == {"version": 1, "entries": {}}
+
+
+def test_registry_and_kernel_mode_envs_do_not_drift():
+    """The audit env is registered in the plan cache's kernel-mode key
+    (R001's single source of truth) and the pass registry carries
+    exactly the documented codes -- a new pass or env must update both
+    sides deliberately."""
+    from presto_tpu.audit.staged import AUDIT_ENV
+    from presto_tpu.exec.plan_cache import KERNEL_MODE_ENVS
+    assert AUDIT_ENV == "PRESTO_TPU_KERNEL_AUDIT"
+    assert AUDIT_ENV in {n for n, _ in KERNEL_MODE_ENVS}
+    assert [p.code for p in all_passes()] == sorted(set(ALL_CODES))
+
+
+# -- framework mechanics ------------------------------------------------
+
+
+def _trace_fixture(code):
+    sys.path.insert(0, os.path.join(REPO, FIXTURES))
+    try:
+        mod = __import__(f"{code.lower()}_bad")
+    finally:
+        sys.path.pop(0)
+    fn, args = mod.build()
+    return fn, args
+
+
+def test_suppression_is_per_source_line(tmp_path):
+    """A `# kernaudit: disable=K001` comment on the source line an eqn
+    traces to drops the finding (and ONLY that code's)."""
+    import jax.numpy as jnp
+
+    def kernel(x):
+        return x.astype(jnp.int64)  # kernaudit: disable=K001
+
+    k = KernelIR.trace(kernel, (jnp.zeros(4, jnp.int32),), "sup-test")
+    r = run_audit([k], codes=["K001"])
+    assert r.findings == [] and r.suppressed == 1
+
+    def kernel2(x):
+        return x.astype(jnp.int64)  # kernaudit: disable=K003
+
+    k2 = KernelIR.trace(kernel2, (jnp.zeros(4, jnp.int32),), "sup-test2")
+    r2 = run_audit([k2], codes=["K001"])
+    assert len(r2.findings) == 1 and r2.suppressed == 0
+
+
+def test_finding_fingerprints_are_line_independent():
+    """The shared Finding law holds for IR findings: the fingerprint
+    hashes kernel|context|message, not the source line."""
+    fn, args = _trace_fixture("K001")
+    k = KernelIR.trace(fn, args, "fp-test")
+    r = run_audit([k], codes=["K001"])
+    fps = [f.fingerprint for f in r.findings]
+    assert len(set(fps)) >= 2
+    for f in r.findings:
+        assert f.fingerprint == type(f)(
+            code=f.code, path=f.path, line=f.line + 100, col=f.col,
+            context=f.context, message=f.message).fingerprint
+
+
+def test_json_schema_matches_tpulint():
+    """kernaudit --json emits the same schema-v1 document shape as
+    tpulint --json (downstream tooling parses both identically)."""
+    fixture = os.path.join(FIXTURES, "k002_bad.py")
+    rc, out = _cli(["--select", "K002", "--no-baseline", "--json",
+                    fixture])
+    assert rc == 1
+    doc = json.loads(out)
+    assert set(doc) == {"version", "passes", "filesScanned", "findings",
+                        "baselined", "suppressed", "staleBaseline"}
+    assert doc["version"] == 1
+    for f in doc["findings"]:
+        assert set(f) == {"code", "path", "line", "col", "context",
+                          "message", "fingerprint"}
+    _, out2 = _cli(["--select", "K002", "--no-baseline", "--json",
+                    fixture])
+    assert out == out2
+
+
+def test_format_github_annotations():
+    """--format github emits ::error annotations pointing at each
+    finding's SOURCE file (CI-consumable; schema pinned here)."""
+    import re
+    fixture = os.path.join(FIXTURES, "k001_bad.py")
+    rc, out = _cli(["--select", "K001", "--no-baseline",
+                    "--format", "github", fixture])
+    assert rc == 1
+    lines = [l for l in out.splitlines() if l]
+    assert len(lines) >= 3
+    pat = re.compile(r"^::error file=([^,]+),line=(\d+),"
+                     r"title=kernaudit K001 \[[^]]+\]::(.+)$")
+    for line in lines:
+        m = pat.match(line)
+        assert m, line
+        assert m.group(1).endswith("tests/fixtures/kernaudit/k001_bad.py")
+        assert int(m.group(2)) > 0
+
+
+def test_baseline_ratchet_add_then_expire(tmp_path):
+    """The shared ratchet applies to IR findings: grandfather a
+    fixture's debt, go green, 'pay' it via --select scoping rules, and
+    stale entries force an update -- tpulint's exact semantics."""
+    from presto_tpu.lint.baseline import load_baseline
+    bl = str(tmp_path / "baseline.json")
+    fixture = os.path.join(FIXTURES, "k003_bad.py")
+    rc, _ = _cli(["--select", "K003", "--baseline", bl, fixture])
+    assert rc == 1
+    rc, _ = _cli(["--select", "K003", "--baseline", bl,
+                  "--update-baseline", fixture])
+    assert rc == 0
+    entries = load_baseline(bl)
+    assert entries and all(e["code"] == "K003"
+                           for e in entries.values())
+    rc, out = _cli(["--select", "K003", "--baseline", bl, "--json",
+                    fixture])
+    assert rc == 0
+    assert json.loads(out)["baselined"] >= 3
+    # a partial run over a DIFFERENT fixture must not report the
+    # k003 entries stale (scoped staleness, like tpulint)
+    other = os.path.join(FIXTURES, "k005_bad.py")
+    rc, out = _cli(["--select", "K005", "--baseline", bl, "--json",
+                    other])
+    assert rc == 1  # k005's own finding
+    assert json.loads(out)["staleBaseline"] == []
+
+
+def test_corpus_subset_and_tier_selection():
+    """--queries/--tier subset runs stay green and audit the expected
+    kernel count (1 query x 1 tier)."""
+    rc, out = _cli(["--queries", "6", "--tier", "local", "--json"])
+    assert rc == 0, out
+    doc = json.loads(out)
+    assert doc["filesScanned"] == 1 and doc["findings"] == []
+
+
+def test_unknown_pass_code_is_an_error():
+    rc, _ = _cli(["--select", "K999"])
+    assert rc == 2
+
+
+def test_empty_queries_selection_is_an_error_not_green():
+    """A reversed range ('7-5') selects nothing; the gate must exit 2,
+    never 'ok across 0 kernels'."""
+    rc, _ = _cli(["--queries", "7-5"])
+    assert rc == 2
+
+
+def test_whole_kernel_findings_render_valid_github_annotations():
+    """K005 findings carry no source site; the github format must
+    still emit a real file and line >= 1 (GitHub drops invalid
+    anchors)."""
+    fixture = os.path.join(FIXTURES, "k005_bad.py")
+    rc, out = _cli(["--select", "K005", "--no-baseline",
+                    "--format", "github", fixture])
+    assert rc == 1
+    (line,) = [l for l in out.splitlines() if l]
+    assert line.startswith("::error file=scripts/kernaudit.py,line=1,")
+
+
+def test_memo_key_includes_footprint_budget():
+    """Re-auditing the same plan under a different
+    kernel_audit_budget_bytes must re-run the passes (a memo hit would
+    serve the other budget's K005 verdict)."""
+    from presto_tpu.audit.staged import clear_audit_memo, \
+        kernel_audit_totals
+    from presto_tpu.sql import sql
+
+    clear_audit_memo()
+    q = "SELECT count(*) FROM supplier"
+    r1 = sql(q, sf=0.01, max_groups=4, session={"kernel_audit": True})
+    n1 = kernel_audit_totals()["kernels"]
+    # one byte of budget: everything is over it -> K005 must fire,
+    # which requires a fresh audit, not the budget-0 memo entry
+    r2 = sql(q, sf=0.01, max_groups=4,
+             session={"kernel_audit": True,
+                      "kernel_audit_budget_bytes": 1})
+    assert kernel_audit_totals()["kernels"] == n1 + 1
+    assert r1.query_stats.counters.get("kernel_audit.K005", 0) == 0
+    assert r2.query_stats.counters.get("kernel_audit.K005", 0) == 1
+
+
+def test_unreadable_fixture_is_an_error_not_clean():
+    rc, _ = _cli(["--no-baseline", "no/such/fixture.py"])
+    assert rc == 2
+
+
+def test_footprint_estimate_is_recorded_in_kernel_notes():
+    """K005 always records its estimate (the pool-accounting feed),
+    budget or not."""
+    fn, args = _trace_fixture("K005")
+    k = KernelIR.trace(fn, args, "note-test", footprint_budget_bytes=0)
+    r = run_audit([k], codes=["K005"])
+    assert r.findings == []  # budget 0 = report-only
+    assert k.notes["peak_bytes_estimate"] > (1 << 20)
+
+
+# -- the staging-time hook on a live query ------------------------------
+
+
+def _install_firing_pass():
+    """Register a test-only pass that flags every kernel (live TPC-H
+    queries are audit-clean, so the acceptance check 'findings appear
+    in QueryStats + /v1/metrics' needs a pass that fires)."""
+    from presto_tpu.audit import core as acore
+
+    class _AlwaysFires(acore.AuditPass):
+        code = "T901"
+        name = "test-always-fires"
+        description = "test-only"
+
+        def run(self, kernel):
+            return [kernel.kernel_finding("T901", "seeded test finding")]
+
+    acore._REGISTRY["T901"] = _AlwaysFires()
+    return lambda: acore._REGISTRY.pop("T901", None)
+
+
+def test_live_query_audit_lands_in_querystats_and_metrics():
+    from presto_tpu.audit.staged import clear_audit_memo, \
+        kernel_audit_totals
+    from presto_tpu.exec.memory import MemoryPool
+    from presto_tpu.server.metrics import (kernel_audit_families,
+                                           parse_prometheus,
+                                           render_prometheus)
+    from presto_tpu.sql import sql
+
+    remove = _install_firing_pass()
+    clear_audit_memo()
+    pool = MemoryPool(1 << 30)
+    try:
+        before = kernel_audit_totals()
+        res = sql("SELECT sum(quantity) FROM lineitem", sf=0.01,
+                  max_groups=4, session={"kernel_audit": True},
+                  memory_pool=pool, query_id="audit_q1")
+        qs = res.query_stats
+        assert qs.counters.get("kernel_audit_kernels", 0) >= 1
+        assert qs.counters.get("kernel_audit.T901", 0) >= 1
+        assert qs.counters.get("kernel_audit_peak_bytes_estimate", 0) > 0
+        # the K005 estimate fed the pool's per-query peak accounting
+        # and rode into QueryStats.peak_memory_bytes
+        assert qs.peak_memory_bytes >= \
+            qs.counters["kernel_audit_peak_bytes_estimate"]
+        after = kernel_audit_totals()
+        assert after["kernels"] >= before["kernels"] + 1
+        assert after["findings"].get("T901", 0) >= \
+            before["findings"].get("T901", 0) + 1
+        # the shared family both tiers render
+        text = render_prometheus(kernel_audit_families()).decode()
+        parsed = parse_prometheus(text)
+        fam = parsed["presto_tpu_kernel_audit_findings_total"]
+        assert fam['{pass="T901"}'] >= 1
+        assert parsed["presto_tpu_kernel_audit_kernels_total"][""] >= 1
+        # flight recorder carries the kernel_audit event
+        from presto_tpu.server.flight_recorder import get_flight_recorder
+        evts = get_flight_recorder().events(kind="kernel_audit")
+        assert any(e.get("queryId") == "audit_q1" for e in evts)
+    finally:
+        remove()
+        clear_audit_memo()
+
+
+def test_audit_memo_hits_skip_retrace_but_still_note():
+    """Second submission of the same plan reuses the memoized audit
+    report (kernels total unchanged) while its QueryStats still carry
+    the counters."""
+    from presto_tpu.audit.staged import clear_audit_memo, \
+        kernel_audit_totals
+    from presto_tpu.sql import sql
+
+    clear_audit_memo()
+    q = "SELECT count(*) FROM region"
+    r1 = sql(q, sf=0.01, max_groups=4, session={"kernel_audit": True})
+    mid = kernel_audit_totals()["kernels"]
+    r2 = sql(q, sf=0.01, max_groups=4, session={"kernel_audit": True})
+    assert kernel_audit_totals()["kernels"] == mid  # memoized
+    for r in (r1, r2):
+        assert r.query_stats.counters.get("kernel_audit_kernels", 0) >= 1
+
+
+def test_audit_off_by_default_costs_nothing():
+    from presto_tpu.sql import sql
+    res = sql("SELECT count(*) FROM nation", sf=0.01, max_groups=4)
+    assert not any(k.startswith("kernel_audit")
+                   for k in res.query_stats.counters)
+
+
+def test_metric_family_exports_zeroes_for_all_passes():
+    """Scrape shape is stable before any audit ran: every registered
+    pass code has a sample."""
+    from presto_tpu.server.metrics import (kernel_audit_families,
+                                           parse_prometheus,
+                                           render_prometheus)
+    text = render_prometheus(kernel_audit_families()).decode()
+    fam = parse_prometheus(text)["presto_tpu_kernel_audit_findings_total"]
+    for code in ALL_CODES:
+        assert f'{{pass="{code}"}}' in fam

@@ -1,13 +1,29 @@
 """Tier-1 static check: hot-path kernel modules never construct
 implicit int64 arrays outside the whitelisted limb-widening sites
-(scripts/check_no_wide_lanes.py; narrow-width execution discipline)."""
+(scripts/check_no_wide_lanes.py; narrow-width execution discipline).
 
+The script is a DEPRECATED shim over tpulint's W001 pass -- these
+tests pin both halves of that contract: the original check_all()/
+WIDE_OK_FUNCS behavior still works, and importing it warns."""
+
+import importlib
 import os
 import sys
+import warnings
 
 _SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "scripts")
 sys.path.insert(0, _SCRIPTS)
+
+
+def test_shim_import_emits_deprecation_pointing_at_tpulint():
+    sys.modules.pop("check_no_wide_lanes", None)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        importlib.import_module("check_no_wide_lanes")
+    dep = [w for w in caught if issubclass(w.category, DeprecationWarning)]
+    assert dep, "importing the shim must emit a DeprecationWarning"
+    assert "tpulint.py --select W001" in str(dep[0].message)
 
 
 def test_hot_path_modules_have_no_wide_lane_violations():

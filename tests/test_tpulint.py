@@ -265,6 +265,33 @@ def test_fingerprint_is_line_independent():
     assert [f.line for f in a] != [f.line for f in b]
 
 
+def test_format_github_annotations():
+    """--format github emits ::error annotations with file/line/title
+    properties (CI-consumable; the exact line shape is pinned here and
+    shared with kernaudit via lint.cli.github_annotation)."""
+    import re
+    fixture = os.path.join(FIXTURES, "w001_bad.py")
+    rc, out = _cli(["--select", "W001", "--no-baseline",
+                    "--format", "github", fixture])
+    assert rc == 1
+    lines = [l for l in out.splitlines() if l]
+    assert len(lines) >= 3
+    pat = re.compile(r"^::error file=([^,]+),line=(\d+),"
+                     r"title=tpulint W001::(.+)$")
+    for line in lines:
+        m = pat.match(line)
+        assert m, line
+        assert m.group(1) == fixture.replace(os.sep, "/")
+        assert int(m.group(2)) > 0
+
+
+def test_github_annotation_escaping():
+    from presto_tpu.lint.cli import github_annotation
+    line = github_annotation("a,b.py", 3, "t: x", "50% done\nnext")
+    assert line == ("::error file=a%2Cb.py,line=3,title=t%3A x"
+                    "::50%25 done%0Anext")
+
+
 # -- pass-specific pins -------------------------------------------------
 
 
@@ -342,6 +369,20 @@ def test_select_only_run_preserves_out_of_target_baseline(tmp_path):
                   "--update-baseline"])
     assert rc == 0
     assert fp in load_baseline(bl)  # preserved, not deleted
+
+
+def test_h001_flags_float_and_bool_coercions_on_traced_values():
+    """Satellite pin: float()/bool() on traced reductions spelled
+    WITHOUT a literal `jnp` (float(x.mean()), bool(x.any())) are
+    caught, alongside the original jnp-rooted int()/float() forms."""
+    fixture = os.path.join(FIXTURES, "h001_bad.py")
+    findings = run_passes(codes=["H001"], paths=[fixture]).findings
+    msgs = [f.message for f in findings]
+    assert sum("float(...) on a traced expression" in m
+               for m in msgs) >= 2  # float(jnp.sum(x)) + float(x.mean())
+    assert any("bool(...) on a traced expression" in m for m in msgs)
+    # precision: host math on shapes (known_good) stays clean --
+    # checked globally by test_fixture_known_good_sections_stay_clean
 
 
 def test_w001_extended_coverage_includes_join_sort_window():
