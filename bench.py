@@ -299,6 +299,35 @@ WHERE l.shipdate >= date '1995-09-01' AND l.shipdate < date '1995-10-01'
 """
 
 
+def _latency_tail(run_once, runs=5):
+    """p50/p99 per-query wall over `runs` invocations of `run_once` --
+    the tail behavior the single-number BENCH headline has never
+    captured (round-9 observability work)."""
+    walls = []
+    for _ in range(runs):
+        t0 = time.time()
+        run_once()
+        walls.append(time.time() - t0)
+    return {"p50_s": round(float(np.percentile(walls, 50)), 5),
+            "p99_s": round(float(np.percentile(walls, 99)), 5),
+            "runs": runs}
+
+
+def _top_kernel_shares(top=3):
+    """Top device-time kernels from the continuous profiler
+    (exec/profiler.py), with each one's share of ALL profiled device
+    time this process -- which kernels the benchmark actually paid."""
+    from presto_tpu.exec.profiler import profile_snapshot
+    rows = profile_snapshot()
+    total = sum(k["device_us"] for k in rows) or 1
+    return [{"fingerprint": k["fingerprint"][:12],
+             "device_us": k["device_us"],
+             "share": round(k["device_us"] / total, 4),
+             "calls": k["calls"], "retraces": k["retraces"],
+             "plan": k["label"][:100]}
+            for k in rows[:top]]
+
+
 def _query_telemetry(res):
     """QueryStats -> the compile/execute split the BENCH json records
     (exec/stats.py structured telemetry; None when stats are absent)."""
@@ -332,6 +361,11 @@ def _bench_sql_join(name, sql_text, sf, platform, **hints):
     t0 = time.time()
     res = run_sql(sql_text, sf=sf, **hints)
     warm_s = time.time() - t0
+    # warm-path latency tail (p50/p99) + which kernels burned the
+    # device, from the continuous profiler -- the BENCH artifact now
+    # records tail behavior beside the compile/execute split
+    latency = _latency_tail(lambda: run_sql(sql_text, sf=sf, **hints),
+                            runs=3)
     print(json.dumps({
         "metric": f"tpch_sf{sf:g}_{name}_rows_per_sec",
         "value": round(n / warm_s), "unit": "rows/s", "vs_baseline": 0,
@@ -341,6 +375,8 @@ def _bench_sql_join(name, sql_text, sf, platform, **hints):
                    "rows": n, "row_count": res.row_count,
                    "telemetry_cold": _query_telemetry(res_cold),
                    "telemetry_warm": _query_telemetry(res),
+                   "latency_warm": latency,
+                   "top_kernels": _top_kernel_shares(),
                    "platform": platform,
                    "scoring": not platform.startswith("cpu")}}))
 
@@ -465,6 +501,12 @@ def main():
     from presto_tpu.sql import sql as run_sql
     telemetry_smoke = _query_telemetry(run_sql(
         TPCH_Q1, sf=0.01, session={"query_cost_analysis": True}))
+    # per-query latency tail through the full front door at smoke
+    # scale, plus the top-3 kernel device-time shares of this process
+    # (incl. the sf-scale runs above): the perf trajectory finally
+    # captures tail behavior and per-kernel attribution
+    latency_smoke = _latency_tail(lambda: run_sql(TPCH_Q1, sf=0.01),
+                                  runs=5)
 
     rows_per_sec = n / dt_sql
     baseline_rows_per_sec = n / numpy_s
@@ -487,6 +529,8 @@ def main():
             "hand_built_staged_mb": round(staged_bytes / 1e6, 1),
             "timing_fallback": sql_fallback or _TIMING_FALLBACK,
             "telemetry_smoke_sf001": telemetry_smoke,
+            "latency_smoke_sf001": latency_smoke,
+            "top_kernels": _top_kernel_shares(),
             "platform": platform,
             "scoring": scoring,
             "iters": iters,

@@ -93,6 +93,14 @@ SCHEMA = {
                "column_count": T.BIGINT},
     "plan_cache": {"entries": T.BIGINT, "hits": T.BIGINT,
                    "misses": T.BIGINT},
+    # continuous per-kernel profiler (exec/profiler.py): one row per
+    # compiled kernel this process executed, hottest first
+    "kernels": {"fingerprint": _V, "plan": _V, "tables": _V,
+                "calls": T.BIGINT, "device_time_us": T.BIGINT,
+                "max_device_time_us": T.BIGINT,
+                "rows_in": T.BIGINT, "bytes_in": T.BIGINT,
+                "rows_out": T.BIGINT, "bytes_out": T.BIGINT,
+                "retraces": T.BIGINT, "footprint_bytes": T.BIGINT},
     "session_properties": {"name": _V, "default_value": _V, "type": _V,
                            "description": _V},
     "functions": {"function_name": _V, "kind": _V},
@@ -191,6 +199,15 @@ def _rows_of(table: str) -> List[tuple]:
         from ..exec.plan_cache import cache_stats
         st = cache_stats()
         return [(st["entries"], st["hits"], st["misses"])]
+    if table == "kernels":
+        from ..exec.profiler import profile_snapshot
+        return [(p["fingerprint"], p["label"], p["tables"],
+                 int(p["calls"]), int(p["device_us"]),
+                 int(p["max_device_us"]),
+                 int(p["rows_in"]), int(p["bytes_in"]),
+                 int(p["rows_out"]), int(p["bytes_out"]),
+                 int(p["retraces"]), int(p["footprint_bytes"]))
+                for p in profile_snapshot()]
     raise KeyError(f"no system table {table!r}")
 
 

@@ -115,7 +115,12 @@ KERNEL_MODE_ENVS = (("PRESTO_TPU_SMALLG", "auto"),
                     # doesn't change the lowered program, but keying it
                     # keeps audit-memo and executable lifecycles aligned
                     # and satisfies R001's registered-env contract
-                    ("PRESTO_TPU_KERNEL_AUDIT", "0"))
+                    ("PRESTO_TPU_KERNEL_AUDIT", "0"),
+                    # continuous per-kernel profiling (exec/profiler.py):
+                    # like the audit knob, program-invariant but
+                    # registered so every ambient knob exec/ reads lives
+                    # in this one R001-checked list
+                    ("PRESTO_TPU_PROFILE", "1"))
 
 
 def _kernel_mode() -> str:

@@ -349,6 +349,16 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
         plan, jfn, call_lock = _compile_any(root, mesh,
                                             default_join_capacity, 1, False)
         fp = None
+    # continuous per-kernel profiling (exec/profiler.py): every executed
+    # program is attributed by its plan-cache fingerprint -- computed
+    # here even for the fragment tier's uncached compiles (scan ranges /
+    # remote sources change batches, not the program's identity)
+    from .profiler import profiling_enabled
+    prof_on = profiling_enabled(session)
+    fp_prof = fp
+    if prof_on and fp_prof is None:
+        from .plan_cache import plan_fingerprint
+        fp_prof = plan_fingerprint(root)
     adaptive_off = False
     if session is not None:
         try:
@@ -447,10 +457,19 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     from ..audit.staged import audit_staged_query, kernel_audit_enabled
     if kernel_audit_enabled(session):
         with stats.timed("kernel_audit_s"):
-            audit_staged_query(plan, batches, mesh=mesh,
-                               query_id=query_id, session=session,
-                               collector=collector, stats=stats,
-                               memory_pool=memory_pool, plan_fp=fp)
+            audit_report = audit_staged_query(
+                plan, batches, mesh=mesh, query_id=query_id,
+                session=session, collector=collector, stats=stats,
+                memory_pool=memory_pool, plan_fp=fp)
+        if prof_on and audit_report \
+                and audit_report.get("peak_bytes_estimate"):
+            # the K005 footprint estimate rides the kernel's profile
+            # row: /v1/profile shows device time AND planned HBM appetite
+            from .profiler import note_footprint
+            note_footprint(fp_prof, audit_report["peak_bytes_estimate"])
+    device_s = 0.0           # summed dispatch+sync wall (all reruns)
+    compile_us: Optional[int] = None
+    res = None
     try:
         with stats.timed("execute_s"), collecting(collector), \
                 collector.stage("execute"):
@@ -474,6 +493,7 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
                     1, use_cache)
                 stats.add("capacity_feedback_scale", cap_scale)
             while True:
+                t_disp0 = time.time()
                 if jfn is None:
                     fn = jax.jit(plan.fn)
                     dispatch_fn = fn
@@ -483,6 +503,10 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
                     with call_lock:  # serialize trace-time closure state
                         out, overflow = jfn(tuple(batches))
                 jax.block_until_ready(out)
+                # host-observed device occupancy of this dispatch: the
+                # block_until_ready delta around the existing sync point
+                # is the only per-kernel timing one fused program exposes
+                device_s += time.time() - t_disp0
                 flags = int(np.asarray(overflow))
                 if flags == 0:
                     if cap_scale > 1 and fp:
@@ -559,6 +583,27 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
         if memory_pool is not None:
             memory_pool.free(query_id, reserved)
             peak_reserved = memory_pool.query_peak_bytes(query_id, pop=True)
+        if prof_on:
+            # record on success AND failure -- a failed query's device
+            # time must stay attributed (its flight dump embeds these
+            # rows). The captured XLA-compile wall is SUBTRACTED so
+            # device_us is device occupancy, not trace+compile: a cold
+            # dispatch would otherwise outrank genuinely hot kernels on
+            # every ranking surface.
+            cu = compile_us if compile_us is not None \
+                else collector.take_compile_us()
+            from ..server.tracing import TraceContext as _TC
+            from .profiler import plan_label, plan_tables, record_call
+            record_call(
+                fp_prof, label=plan_label(root),
+                tables=plan_tables(root),
+                device_us=max(int(device_s * 1e6) - cu, 0),
+                rows_in=staged_rows, bytes_in=staged_bytes,
+                rows_out=res.row_count if res is not None else 0,
+                bytes_out=_result_bytes(res) if res is not None else 0,
+                retraced=cu > 0, query_id=query_id,
+                trace_id=trace_id.trace_id
+                if isinstance(trace_id, _TC) else (trace_id or query_id))
     stats.add("output_rows", res.row_count)
     res.stats = stats.snapshot()
     _finalize_query_stats(collector, res, t_query0, peak_reserved, root,
@@ -678,6 +723,17 @@ def _finalize_query_stats(collector: StatsCollector, res: "QueryResult",
                              parent_id=trace_id.span_id)
     else:
         collector.emit_spans(trace_id or collector.query_id)
+    # per-stage latency distributions (/v1/metrics histograms): each
+    # stage's wall feeds the process histogram, exemplar'd with this
+    # query's trace id so a p99 execute spike links to its waterfall
+    from ..server.metrics import observe_histogram
+    tid = trace_id.trace_id if isinstance(trace_id, TraceContext) \
+        else (trace_id or collector.query_id)
+    for name, st in qs.stages.items():
+        if st.wall_us:
+            observe_histogram("presto_tpu_stage_seconds",
+                              st.wall_us / 1e6, labels={"stage": name},
+                              trace_id=tid)
 
 
 def _compile_any(root: N.PlanNode, mesh, default_join_capacity: int,

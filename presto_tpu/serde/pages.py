@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import struct
+import time
 import zlib
 from typing import List, Optional, Sequence, Tuple
 
@@ -363,8 +364,23 @@ def serialize_batch(batch: Batch, codec: PageCodec = PageCodec()) -> bytes:
     return serialize_page(cols, codec)
 
 
+def _observe_serde(op: str, seconds: float) -> None:
+    """Page serde work feeds the shared /v1/metrics histogram registry
+    (per-page serialize/deserialize latency on both tiers). Import is
+    deferred and shielded: serde loads before the server package during
+    bootstrap, and timing must never fail a page."""
+    try:
+        from ..server.metrics import observe_histogram
+        observe_histogram("presto_tpu_page_serde_seconds", seconds,
+                          labels={"op": op})
+    except Exception:  # noqa: BLE001 - interpreter teardown / circular
+        # bootstrap import: drop the observation, never the page
+        pass
+
+
 def serialize_page(columns: Sequence[Tuple[T.Type, np.ndarray, np.ndarray]],
                    codec: PageCodec = PageCodec()) -> bytes:
+    t_page0 = time.time()
     rows = len(columns[0][1]) if columns else 0
     body = [struct.pack("<i", len(columns))]
     for ty, vals, nulls in columns:
@@ -399,6 +415,7 @@ def serialize_page(columns: Sequence[Tuple[T.Type, np.ndarray, np.ndarray]],
         checksum = _checksum(payload, flags, rows, uncompressed)
     header = struct.pack("<iBiiq", rows, flags, uncompressed, len(payload),
                          checksum)
+    _observe_serde("serialize", time.time() - t_page0)
     return header + payload
 
 
@@ -416,6 +433,7 @@ def deserialize_page(buf: bytes, types: Sequence[T.Type],
                      ) -> List[Tuple[np.ndarray, np.ndarray]]:
     """-> [(values, nulls)] per column. `types` guide dtype mapping
     (the wire encoding alone cannot distinguish e.g. BIGINT from DOUBLE)."""
+    t_page0 = time.time()
     rows, flags, uncompressed, size, checksum = struct.unpack_from("<iBiiq", buf)
     payload = bytes(memoryview(buf)[21:21 + size])
     if flags & _CHECKSUMMED:
@@ -434,6 +452,7 @@ def deserialize_page(buf: bytes, types: Sequence[T.Type],
         ty = types[ci] if ci < len(types) else None
         (vals, nulls), pos = _deserialize_block(mv, pos, ty)
         out.append((vals, nulls))
+    _observe_serde("deserialize", time.time() - t_page0)
     return out
 
 

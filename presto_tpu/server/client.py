@@ -121,6 +121,13 @@ class WorkerClient:
         data, _ = self._request("GET", "/v1/info")
         return json.loads(data)
 
+    def profile(self) -> dict:
+        """The worker's per-kernel profile slice (GET /v1/profile) --
+        authenticated/TLS'd like every other internal hop, so the
+        coordinator's cluster merge works on secured clusters too."""
+        data, _ = self._request("GET", "/v1/profile")
+        return json.loads(data)
+
     def submit(self, task_id: str, plan: N.PlanNode, sf: float = 0.01,
                session: Optional[dict] = None) -> dict:
         return self.submit_body(task_id, {"plan": N.to_json(plan), "sf": sf,

@@ -295,10 +295,19 @@ class Dispatcher:
         # queue wait (the caller's bound, not 2x it)
         deadline = None if queue_timeout is None \
             else time.time() + queue_timeout
-        self._await_cluster_slot(group_name, group, deadline)
-        remaining = None if deadline is None \
-            else max(deadline - time.time(), 0.001)
-        group.acquire(remaining, mem=mem)
+        t_queue0 = time.time()
+        try:
+            self._await_cluster_slot(group_name, group, deadline)
+            remaining = None if deadline is None \
+                else max(deadline - time.time(), 0.001)
+            group.acquire(remaining, mem=mem)
+        finally:
+            # queue-wait distribution (previously timed by NOBODY): the
+            # cluster gate + local slot wait, rejected waits included --
+            # a full queue's p99 is exactly the signal this exists for
+            from .metrics import observe_histogram
+            observe_histogram("presto_tpu_dispatch_queue_wait_seconds",
+                              time.time() - t_queue0)
         t0 = time.time()
         try:
             result = executor(query_id)

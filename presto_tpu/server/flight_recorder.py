@@ -134,12 +134,21 @@ class FlightRecorder:
         try:
             os.makedirs(self.dump_dir, exist_ok=True)
             events = self.events(query_id=key)
+            kernels = self._profile_of(key)
             with open(path, "w") as f:
                 f.write(json.dumps(
                     {"dump": {"key": key, "reason": reason,
                               "tsUs": int(time.time() * 1_000_000),
                               "events": len(events),
                               **(extra or {})}}) + "\n")
+                if kernels:
+                    # the continuous profiler's view of THIS query's
+                    # kernels (cross-linked by plan fingerprint): a
+                    # slow-query dump answers "which kernel" offline,
+                    # without a live /v1/profile to ask
+                    f.write(json.dumps(
+                        {"profile": {"queryId": key,
+                                     "kernels": kernels}}) + "\n")
                 for evt in events:
                     f.write(json.dumps(evt, default=str) + "\n")
         except Exception as e:  # noqa: BLE001 - a full disk must not
@@ -152,6 +161,20 @@ class FlightRecorder:
         with self._lock:
             self._dumped[key] = path
         return path
+
+    @staticmethod
+    def _profile_of(key: str) -> List[dict]:
+        """Top device-time kernel rows the profiler attributed to this
+        query/task id (best-effort: a dump with no profile beats no
+        dump)."""
+        try:
+            from ..exec.profiler import profile_for_query
+            return profile_for_query(key, top=8)
+        except Exception as e:  # noqa: BLE001 - the dump must land even
+            # when the profiler is broken; count the gap
+            from .metrics import record_suppressed
+            record_suppressed("flight_recorder", "profile_snapshot", e)
+            return []
 
 
 _recorder: Optional[FlightRecorder] = None

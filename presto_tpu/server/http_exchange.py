@@ -66,6 +66,11 @@ def fetch_remote_batch(sources: Sequence[str], task_ids: Sequence[str],
     feed for a fragment whose upstream ran on other workers/slices.
     With `merge_keys`, upstream streams are locally sorted and the
     concatenation is k-way merged by those keys (MergeOperator)."""
+    import time
+
+    from .metrics import observe_histogram
+    from .tracing import current_context
+    t_fetch0 = time.time()
     all_cols: List[List[np.ndarray]] = [[] for _ in types]
     all_nulls: List[List[np.ndarray]] = [[] for _ in types]
     total = 0
@@ -101,4 +106,11 @@ def fetch_remote_batch(sources: Sequence[str], task_ids: Sequence[str],
         nulls = [m[perm] for m in nulls]
     cap = capacity or max(-(-total // pad_multiple) * pad_multiple,
                           pad_multiple)
-    return batch_from_numpy(types, arrays, nulls, capacity=cap)
+    out = batch_from_numpy(types, arrays, nulls, capacity=cap)
+    # exchange pull+decode distribution (/v1/metrics histogram); the
+    # ambient trace context exemplar-links a slow fetch to its trace
+    ctx = current_context()
+    observe_histogram("presto_tpu_exchange_fetch_seconds",
+                      time.time() - t_fetch0,
+                      trace_id=ctx.trace_id if ctx else None)
+    return out

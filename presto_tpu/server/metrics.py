@@ -9,18 +9,38 @@ through this module, so scrape format and naming conventions cannot
 drift between tiers.
 
 Format is the Prometheus exposition text format v0.0.4: per family a
-``# HELP`` line, a ``# TYPE`` line (counter | gauge), then one sample
-per label set. Labels are rendered sorted for deterministic scrapes
-(scripts/scrape_metrics.py diffs two scrapes textually-parsed).
+``# HELP`` line, a ``# TYPE`` line (counter | gauge | histogram), then
+one sample per label set. Histogram families render the cumulative
+``_bucket{le=...}`` ladder (``+Inf`` == ``_count``) plus ``_sum`` /
+``_count``; buckets carrying an exemplar append the OpenMetrics-style
+``# {trace_id="..."} <value>`` suffix, which links a latency bucket
+straight to ``GET /v1/trace/{traceId}``. Labels are rendered sorted
+for deterministic scrapes (scripts/scrape_metrics.py diffs two
+scrapes textually-parsed).
+
+Latency distributions live in a process-wide histogram registry
+(:func:`observe_histogram`): the hot seams -- query end-to-end and
+per-state wall (statement), dispatcher queue-wait, per-stage micros
+(runner), exchange fetch (http_exchange), page serde (serde/pages),
+task lifetime (worker) -- observe into named histograms with FIXED
+log-spaced buckets, so per-process distributions merge associatively
+and a scrape shape is stable from the first request on (declared
+families render zeros before any observation).
 """
 
 from __future__ import annotations
 
+import bisect
 import logging
 import threading
+import time as _time
 from typing import Dict, List, Optional, Tuple, Union
 
-__all__ = ["MetricFamily", "render_prometheus", "parse_prometheus",
+__all__ = ["MetricFamily", "Histogram", "DEFAULT_BUCKETS",
+           "observe_histogram", "get_histogram", "histogram_families",
+           "reset_histograms",
+           "render_prometheus", "parse_prometheus",
+           "negotiate_exposition", "CONTENT_TYPE_OPENMETRICS",
            "plan_cache_families", "narrowing_families", "uptime_family",
            "record_suppressed", "suppressed_error_families",
            "suppressed_error_totals", "tracing_families",
@@ -28,37 +48,225 @@ __all__ = ["MetricFamily", "render_prometheus", "parse_prometheus",
            "CONTENT_TYPE"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+# exemplars are legal only in the OpenMetrics exposition (the classic
+# 0.0.4 text parser rejects a `# {...}` suffix after the value): the
+# /v1/metrics handlers negotiate via the Accept header and render
+# exemplars only under this content type
+CONTENT_TYPE_OPENMETRICS = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
 
 _LabelSample = Tuple[Dict[str, str], Union[int, float]]
+
+# The one bucket scheme every latency histogram shares (seconds,
+# log-spaced 1-2.5-5 ladder from 100us to 100s). FIXED buckets are what
+# make Histogram.merge associative+commutative across workers without
+# negotiation -- the same property QueryStats.merge relies on.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0)
+
+
+class Histogram:
+    """Mergeable latency distribution over fixed bucket bounds.
+
+    The merge law mirrors ``QueryStats.merge``: counts/sum add
+    elementwise, exemplars keep the larger observation -- associative,
+    commutative, with the empty histogram as identity -- so per-worker
+    histograms fold into a cluster view in any order. ``observe`` is
+    thread-safe (one lock per histogram; request-handler, task and
+    engine threads all observe concurrently).
+
+    Exemplars: per bucket, the (trace_id, value, tsUs) of the
+    MAX-latency observation that landed in that bucket (only kept when
+    the observer supplied a trace id), so the worst sample of every
+    latency band links to its distributed trace.
+    """
+
+    _GUARDED_BY = {"_lock": ("counts", "sum", "count", "exemplars")}
+
+    def __init__(self, buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
+        self.buckets = tuple(float(b) for b in buckets)
+        assert list(self.buckets) == sorted(set(self.buckets)), \
+            "bucket bounds must be strictly ascending"
+        # counts[i] = observations <= buckets[i]'s bound and > the
+        # previous bound (per-bucket, NOT cumulative; render cumulates);
+        # counts[-1] is the +Inf overflow bucket
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.sum = 0.0
+        self.count = 0
+        # per-bucket (trace_id, value, ts_us) of the max observation
+        self.exemplars: List[Optional[Tuple[str, float, int]]] = \
+            [None] * (len(self.buckets) + 1)
+        self._lock = threading.Lock()
+
+    def observe(self, value: float,
+                trace_id: Optional[str] = None) -> None:
+        v = float(value)
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self.counts[i] += 1
+            self.sum += v
+            self.count += 1
+            if trace_id:
+                ex = self.exemplars[i]
+                if ex is None or v >= ex[1]:
+                    self.exemplars[i] = (str(trace_id), v,
+                                         int(_time.time() * 1e6))
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        if self.buckets != other.buckets:
+            raise ValueError("cannot merge histograms with different "
+                             f"bucket schemes: {len(self.buckets)} vs "
+                             f"{len(other.buckets)} bounds")
+        out = Histogram(self.buckets)
+        a, b = self.snapshot(), other.snapshot()
+        with out._lock:  # fresh object, but the write barrier is uniform
+            out.counts = [x + y for x, y in zip(a["counts"], b["counts"])]
+            out.sum = a["sum"] + b["sum"]
+            out.count = a["count"] + b["count"]
+            out.exemplars = [
+                _max_exemplar(x, y)
+                for x, y in zip(a["exemplars"], b["exemplars"])]
+        return out
+
+    def snapshot(self) -> dict:
+        """Consistent copy (render/merge never see a torn update)."""
+        with self._lock:
+            return {"buckets": self.buckets,
+                    "counts": list(self.counts),
+                    "sum": self.sum, "count": self.count,
+                    "exemplars": list(self.exemplars)}
+
+    def quantile(self, q: float) -> float:
+        """Bucket-interpolated quantile estimate (the scrape-side p50/
+        p95/p99 arithmetic, shared with scripts/scrape_metrics.py)."""
+        return quantile_from_buckets(self.buckets,
+                                     self.snapshot()["counts"], q)
+
+    def to_json(self) -> dict:
+        snap = self.snapshot()
+        return {"buckets": list(snap["buckets"]),
+                "counts": snap["counts"],
+                "sum": snap["sum"], "count": snap["count"],
+                "exemplars": [list(e) if e else None
+                              for e in snap["exemplars"]]}
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "Histogram":
+        h = cls(tuple(doc["buckets"]))
+        ex = doc.get("exemplars") or [None] * (len(h.buckets) + 1)
+        with h._lock:  # fresh object, but the write barrier is uniform
+            h.counts = [int(c) for c in doc["counts"]]
+            h.sum = float(doc["sum"])
+            h.count = int(doc["count"])
+            h.exemplars = [tuple(e) if e else None for e in ex]
+        return h
+
+
+def _max_exemplar(a, b):
+    """Larger observation wins; ties break by timestamp then trace id,
+    so the merge stays commutative (order of folding cannot pick a
+    different exemplar)."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a if (a[1], a[2], a[0]) >= (b[1], b[2], b[0]) else b
+
+
+def quantile_from_buckets(bounds, counts, q: float) -> float:
+    """Estimate the q-quantile of a (non-cumulative) bucket-count
+    vector by linear interpolation within the bucket containing rank
+    q*count; the +Inf bucket reports the last finite bound."""
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    acc = 0.0
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        if acc + c >= rank:
+            hi = bounds[i] if i < len(bounds) else bounds[-1]
+            lo = bounds[i - 1] if 0 < i <= len(bounds) else 0.0
+            frac = (rank - acc) / c
+            return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        acc += c
+    return float(bounds[-1])
 
 
 class MetricFamily:
     """One metric family: name, type, help, and samples (optionally
-    labelled)."""
+    labelled). Histogram families carry Histogram snapshots instead of
+    scalar samples and render the full cumulative-bucket ladder."""
 
     def __init__(self, name: str, mtype: str, help_: str):
-        assert mtype in ("counter", "gauge"), mtype
+        assert mtype in ("counter", "gauge", "histogram"), mtype
         self.name = name
         self.mtype = mtype
         self.help = help_
         self.samples: List[_LabelSample] = []
+        self.histograms: List[Tuple[Dict[str, str], dict]] = []
 
     def add(self, value: Union[int, float],
             labels: Optional[Dict[str, str]] = None) -> "MetricFamily":
         self.samples.append((dict(labels or {}), value))
         return self
 
-    def render(self) -> List[str]:
+    def add_histogram(self, hist: "Histogram",
+                      labels: Optional[Dict[str, str]] = None
+                      ) -> "MetricFamily":
+        self.histograms.append((dict(labels or {}), hist.snapshot()))
+        return self
+
+    def _label_str(self, labels: Dict[str, str]) -> str:
+        return ",".join(f'{k}="{_escape(v)}"'
+                        for k, v in sorted(labels.items()))
+
+    def render(self, exemplars: bool = True) -> List[str]:
+        """`exemplars=False` renders strictly classic-0.0.4 text (the
+        default /v1/metrics scrape); True appends the OpenMetrics
+        exemplar suffix on histogram buckets that carry one."""
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} {self.mtype}"]
         for labels, value in self.samples:
             if labels:
-                lab = ",".join(
-                    f'{k}="{_escape(v)}"'
-                    for k, v in sorted(labels.items()))
-                lines.append(f"{self.name}{{{lab}}} {_num(value)}")
+                lines.append(
+                    f"{self.name}{{{self._label_str(labels)}}} "
+                    f"{_num(value)}")
             else:
                 lines.append(f"{self.name} {_num(value)}")
+        for labels, snap in self.histograms:
+            lines.extend(self._render_histogram(labels, snap,
+                                                exemplars))
+        return lines
+
+    def _render_histogram(self, labels: Dict[str, str], snap: dict,
+                          exemplars: bool) -> List[str]:
+        lines: List[str] = []
+        cum = 0
+        for i, bound in enumerate(snap["buckets"]):
+            cum += snap["counts"][i]
+            lab = self._label_str({**labels, "le": _num(float(bound))})
+            line = f"{self.name}_bucket{{{lab}}} {cum}"
+            ex = snap["exemplars"][i]
+            if exemplars and ex is not None:
+                # OpenMetrics exemplar: the max-latency observation of
+                # this bucket, linking to GET /v1/trace/{trace_id}
+                line += (f' # {{trace_id="{_escape(ex[0])}"}} '
+                         f"{_num(float(ex[1]))}")
+            lines.append(line)
+        cum += snap["counts"][-1]
+        lab = self._label_str({**labels, "le": "+Inf"})
+        line = f"{self.name}_bucket{{{lab}}} {cum}"
+        ex = snap["exemplars"][-1]
+        if exemplars and ex is not None:
+            line += (f' # {{trace_id="{_escape(ex[0])}"}} '
+                     f"{_num(float(ex[1]))}")
+        lines.append(line)
+        tail = f"{{{self._label_str(labels)}}}" if labels else ""
+        lines.append(f"{self.name}_sum{tail} {_num(snap['sum'])}")
+        lines.append(f"{self.name}_count{tail} {snap['count']}")
         return lines
 
 
@@ -73,6 +281,101 @@ def _num(v: Union[int, float]) -> str:
     if isinstance(v, int):
         return str(v)
     return repr(round(float(v), 6))
+
+
+# -- process histogram registry -----------------------------------------
+#
+# Named latency histograms observed from the hot seams. Declared
+# families render on EVERY scrape (zeros included) so both tiers'
+# /v1/metrics carry a stable histogram shape from the first request on;
+# undeclared names observed at runtime export too.
+
+_HIST_LOCK = threading.Lock()
+_HISTOGRAMS: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Histogram] = {}
+
+# name -> (help text, preset label sets rendered even before any
+# observation). The label values are the closed vocabularies of each
+# seam, so a dashboard's first scrape already shows every series.
+_DECLARED_HISTOGRAMS: Dict[str, Tuple[str, Tuple[Dict[str, str], ...]]] = {
+    "presto_tpu_query_latency_seconds": (
+        "end-to-end statement latency (queued -> terminal)", ({},)),
+    "presto_tpu_query_state_seconds": (
+        "per-state statement wall time (QueryStateMachine transitions)",
+        tuple({"state": s} for s in
+              ("QUEUED", "PLANNING", "RUNNING", "FINISHING"))),
+    "presto_tpu_dispatch_queue_wait_seconds": (
+        "admission wait in the dispatcher's resource-group queue "
+        "(cluster gate + local slot)", ({},)),
+    "presto_tpu_stage_seconds": (
+        "per-query host-visible stage wall (exec/stats.py stages)",
+        tuple({"stage": s} for s in
+              ("staging", "compile", "execute", "exchange", "fetch"))),
+    "presto_tpu_exchange_fetch_seconds": (
+        "cross-worker exchange pull+decode (http_exchange."
+        "fetch_remote_batch)", ({},)),
+    "presto_tpu_page_serde_seconds": (
+        "SerializedPage codec work per page", tuple(
+            {"op": s} for s in ("serialize", "deserialize"))),
+    "presto_tpu_task_seconds": (
+        "worker task lifetime (create -> terminal)", ({},)),
+}
+
+
+def _hist_key(name: str, labels: Optional[Dict[str, str]]
+              ) -> Tuple[str, Tuple[Tuple[str, str], ...]]:
+    return (name, tuple(sorted((labels or {}).items())))
+
+
+def get_histogram(name: str, labels: Optional[Dict[str, str]] = None
+                  ) -> Histogram:
+    """The named histogram (created on first use; fixed default
+    buckets so every instance merges with every other)."""
+    key = _hist_key(name, labels)
+    with _HIST_LOCK:
+        h = _HISTOGRAMS.get(key)
+        if h is None:
+            h = _HISTOGRAMS[key] = Histogram()
+        return h
+
+
+def observe_histogram(name: str, value: float,
+                      labels: Optional[Dict[str, str]] = None,
+                      trace_id: Optional[str] = None) -> None:
+    """Observe one latency sample into the process registry. Never
+    raises: this sits on request/task hot paths."""
+    try:
+        get_histogram(name, labels).observe(value, trace_id=trace_id)
+    except Exception as e:  # noqa: BLE001 - telemetry must never fail
+        # the request that carried it; a broken registry is counted
+        record_suppressed("metrics", "observe_histogram", e)
+
+
+def histogram_families() -> List[MetricFamily]:
+    """Every declared + observed histogram family (shared by both
+    tiers' /v1/metrics, like the counter builders above)."""
+    with _HIST_LOCK:
+        live = dict(_HISTOGRAMS)
+    fams: List[MetricFamily] = []
+    names = list(_DECLARED_HISTOGRAMS) + sorted(
+        {n for n, _ in live} - set(_DECLARED_HISTOGRAMS))
+    for name in names:
+        help_, presets = _DECLARED_HISTOGRAMS.get(
+            name, ("runtime-observed latency histogram", ({},)))
+        fam = MetricFamily(name, "histogram", help_)
+        keys = {_hist_key(name, p)[1] for p in presets}
+        keys |= {lk for n, lk in live if n == name}
+        for lk in sorted(keys):
+            labels = dict(lk)
+            fam.add_histogram(live.get((name, lk)) or Histogram(),
+                              labels)
+        fams.append(fam)
+    return fams
+
+
+def reset_histograms() -> None:
+    """Drop every observed histogram (tests isolate scrape state)."""
+    with _HIST_LOCK:
+        _HISTOGRAMS.clear()
 
 
 def plan_cache_families() -> List[MetricFamily]:
@@ -227,19 +530,52 @@ def uptime_family(started_at: float, role: str) -> MetricFamily:
                             round(time.time() - started_at, 1))
 
 
-def render_prometheus(families: List[MetricFamily]) -> bytes:
+def render_prometheus(families: List[MetricFamily],
+                      openmetrics: bool = False) -> bytes:
+    """Default: classic text format 0.0.4, exemplar-free (valid for a
+    stock Prometheus scraper). `openmetrics=True` (the handlers pass it
+    when the Accept header asks for application/openmetrics-text)
+    renders bucket exemplars and the terminating ``# EOF``."""
     lines: List[str] = []
     for f in families:
-        lines.extend(f.render())
+        lines.extend(f.render(exemplars=openmetrics))
+    if openmetrics:
+        lines.append("# EOF")
     return ("\n".join(lines) + "\n").encode()
+
+
+def negotiate_exposition(accept_header: Optional[str]
+                         ) -> Tuple[bool, str]:
+    """(openmetrics?, content type) from a scrape's Accept header --
+    the one negotiation both tiers' /v1/metrics handlers share."""
+    if accept_header and "openmetrics" in accept_header:
+        return True, CONTENT_TYPE_OPENMETRICS
+    return False, CONTENT_TYPE
+
+
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _histogram_base(name: str, typed: Dict[str, str]) -> Optional[str]:
+    """The histogram family a ``_bucket``/``_sum``/``_count`` sample
+    belongs to, when one is declared."""
+    for suf in _HIST_SUFFIXES:
+        if name.endswith(suf):
+            base = name[: -len(suf)]
+            if typed.get(base) == "histogram":
+                return base
+    return None
 
 
 def parse_prometheus(text: str) -> Dict[str, Dict[str, float]]:
     """Exposition text -> {family: {sample_key: value}} where
     sample_key is '' for unlabelled samples or the rendered label set.
-    Used by scripts/scrape_metrics.py and the test suite; raises
-    ValueError on lines that are neither comments nor samples (the
-    'valid Prometheus text' check)."""
+    Histogram sub-samples keep their full ``<base>_bucket``/``_sum``/
+    ``_count`` names as the family key (their ``# TYPE`` line is the
+    base name); OpenMetrics exemplar suffixes (`` # {...} v``) are
+    stripped before value parsing. Used by scripts/scrape_metrics.py
+    and the test suite; raises ValueError on lines that are neither
+    comments nor samples (the 'valid Prometheus text' check)."""
     out: Dict[str, Dict[str, float]] = {}
     typed: Dict[str, str] = {}
     for raw in text.splitlines():
@@ -255,6 +591,11 @@ def parse_prometheus(text: str) -> Dict[str, Dict[str, float]]:
                     raise ValueError(f"bad TYPE line: {raw!r}")
                 typed[parts[2]] = mtype
             continue
+        # exemplar suffix: everything from the last " # {" on is the
+        # OpenMetrics exemplar annotation, not part of the sample
+        ex_at = line.rfind(" # {")
+        if ex_at != -1:
+            line = line[:ex_at].rstrip()
         name, _, rest = line.partition("{")
         if rest:  # labelled sample
             labels, _, valpart = rest.rpartition("}")
@@ -271,7 +612,7 @@ def parse_prometheus(text: str) -> Dict[str, Dict[str, float]]:
             fval = float(value)
         except ValueError as e:
             raise ValueError(f"bad value in line: {raw!r}") from e
-        if fam not in typed:
+        if fam not in typed and _histogram_base(fam, typed) is None:
             raise ValueError(f"sample {fam!r} before its # TYPE line")
         out.setdefault(fam, {})[key] = fval
     return out

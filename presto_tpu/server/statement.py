@@ -146,8 +146,15 @@ class StatementServer:
                  executor=None, page_rows: int = 1024,
                  queue_poll_s: float = 1.0,
                  query_ttl_s: float = 600.0,
-                 tls: Optional[tuple] = None):
+                 tls: Optional[tuple] = None,
+                 profile_workers=None):
+        """`profile_workers`: worker base URLs (list, or zero-arg
+        callable returning one) whose GET /v1/profile slices the
+        cluster-merged GET /v1/profile on THIS server folds in --
+        wire the coordinator's worker view here on the distributed
+        tier; None serves this process's slice alone."""
         self.sf = sf
+        self._profile_workers = profile_workers
         from ..sql.statements import PreparedStatements
         # per-user registries (the reference scopes prepared statements
         # per session via X-Presto-Prepared-Statement headers)
@@ -361,7 +368,26 @@ class StatementServer:
 
     def _account_query(self, q: _Query) -> None:
         """Roll a terminal query into the /v1/metrics lifetime totals
-        (exactly once: _run's finally is the single terminal seam)."""
+        (exactly once: _run's finally is the single terminal seam) and
+        feed the latency distributions: end-to-end wall plus one
+        observation per traversed state, exemplar'd with the query's
+        trace id so a p99 bucket links straight to its waterfall."""
+        from .metrics import observe_histogram
+        tid = q.trace_ctx.trace_id
+        observe_histogram("presto_tpu_query_latency_seconds",
+                          q.machine.elapsed_ms() / 1e3, trace_id=tid)
+        timings = q.machine.timings()
+        entered = sorted(((s, t) for s, t in timings.items()),
+                         key=lambda x: x[1])
+        for i, (state, start) in enumerate(entered):
+            if state not in ("QUEUED", "PLANNING", "RUNNING",
+                             "FINISHING"):
+                continue
+            end = entered[i + 1][1] if i + 1 < len(entered) \
+                else time.time()
+            observe_histogram("presto_tpu_query_state_seconds",
+                              max(end - start, 0.0),
+                              labels={"state": state}, trace_id=tid)
         qs = q.result_stats
         with self._metrics_lock:
             st = q.machine.state
@@ -657,7 +683,7 @@ class StatementServer:
                    totals["peak_memory_bytes"]),
         ]
         from .metrics import (flight_recorder_families,
-                              kernel_audit_families,
+                              histogram_families, kernel_audit_families,
                               narrowing_families, plan_cache_families,
                               suppressed_error_families,
                               tracing_families, uptime_family)
@@ -668,7 +694,18 @@ class StatementServer:
         fams.extend(tracing_families())
         fams.extend(flight_recorder_families())
         fams.extend(kernel_audit_families())
+        fams.extend(histogram_families())
         return fams
+
+    def profile_doc(self) -> dict:
+        """Cluster-merged per-kernel profile for GET /v1/profile: this
+        process's slice plus every configured worker's, folded by
+        fingerprint (exec/profiler.py; process-id dedup keeps an
+        in-process worker from double-counting)."""
+        from ..exec.profiler import cluster_profile_doc
+        pw = self._profile_workers
+        urls = list(pw() if callable(pw) else (pw or ()))
+        return cluster_profile_doc(urls)
 
 
 def _render_ui(server: "StatementServer", parts: List[str]) -> str:
@@ -796,6 +833,11 @@ def _make_handler(server: StatementServer):
                             headers["X-Presto-Clear-Transaction-Id"] = "true"
                 self._send(doc, headers=headers)
                 return
+            if parts == ["v1", "profile"]:
+                # cluster-merged per-kernel device-time table (the
+                # continuous profiler's coordinator surface)
+                self._send(server.profile_doc())
+                return
             if len(parts) == 3 and parts[:2] == ["v1", "trace"]:
                 doc = server.trace_doc(parts[2])
                 self._send(doc if doc else
@@ -817,10 +859,14 @@ def _make_handler(server: StatementServer):
                             "uptime": "0m"})
                 return
             if parts == ["v1", "metrics"]:
-                from .metrics import CONTENT_TYPE, render_prometheus
-                body = render_prometheus(server.metric_families())
+                from .metrics import (negotiate_exposition,
+                                      render_prometheus)
+                om, ctype = negotiate_exposition(
+                    self.headers.get("Accept"))
+                body = render_prometheus(server.metric_families(),
+                                         openmetrics=om)
                 self.send_response(200)
-                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

@@ -361,6 +361,12 @@ class TaskManager:
                            "bytes": tstats.get("outputBytes", 0)},
                           span_id=task_ctx.span_id,
                           parent_id=ctx.span_id if ctx else None)
+                # task-lifetime distribution (/v1/metrics histogram),
+                # exemplar'd with the propagated trace id
+                from .metrics import observe_histogram
+                observe_histogram("presto_tpu_task_seconds",
+                                  time.time() - t_start,
+                                  trace_id=trace_id)
         with task.lock:
             task.spans = buf.spans
         if state == "FAILED":
@@ -724,13 +730,14 @@ class _Handler(BaseHTTPRequestHandler):
         fams.extend(plan_cache_families())
         fams.extend(narrowing_families())
         from .metrics import (flight_recorder_families,
-                              kernel_audit_families,
+                              histogram_families, kernel_audit_families,
                               suppressed_error_families,
                               tracing_families)
         fams.extend(suppressed_error_families())
         fams.extend(tracing_families())
         fams.extend(flight_recorder_families())
         fams.extend(kernel_audit_families())
+        fams.extend(histogram_families())
         return fams
 
     def do_GET(self):  # noqa: N802
@@ -746,15 +753,24 @@ class _Handler(BaseHTTPRequestHandler):
         if parts in (["v1", "metrics"], ["v1", "info", "metrics"]):
             # Prometheus text format (PrometheusStatsReporter.cpp /
             # PrestoServer.cpp:562 registerHttpEndpoints analog);
-            # /v1/info/metrics is the legacy alias
-            from .metrics import CONTENT_TYPE, render_prometheus
-            body = render_prometheus(self._metric_families())
+            # /v1/info/metrics is the legacy alias. Exemplars render
+            # only under negotiated OpenMetrics (classic 0.0.4 scrapers
+            # reject the suffix).
+            from .metrics import negotiate_exposition, render_prometheus
+            om, ctype = negotiate_exposition(self.headers.get("Accept"))
+            body = render_prometheus(self._metric_families(),
+                                     openmetrics=om)
             self.send_response(200)
-            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
             return
+        if parts == ["v1", "profile"]:
+            # this worker's per-kernel profile slice (the coordinator
+            # pulls + merges these cluster-wide; exec/profiler.py)
+            from ..exec.profiler import profile_doc
+            return self._send_json(profile_doc())
         if len(parts) == 3 and parts[:2] == ["v1", "trace"]:
             # worker-local slice of a distributed trace (the coordinator
             # serves the stitched whole; this answers "what did THIS

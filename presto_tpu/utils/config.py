@@ -199,6 +199,13 @@ SESSION_PROPERTIES = (
          "K005 intermediate-footprint budget for live-query audits: "
          "kernels whose estimated peak live bytes exceed it are "
          "findings (0 = report the estimate without gating)")
+    .add("continuous_profiling", "bool", True,
+         "accumulate per-kernel device-time profiles keyed by plan "
+         "fingerprint (exec/profiler.py): calls, block_until_ready "
+         "device wall, rows/bytes in-out, retraces; served at "
+         "GET /v1/profile and SELECT * FROM system.kernels (env "
+         "default PRESTO_TPU_PROFILE; on by default -- the overhead "
+         "is one clock pair and a dict update per query)")
 )
 
 

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Render a top-N per-kernel device-time report from /v1/profile.
+
+The operator loop the continuous profiler exists for: point it at a
+worker (local slice) or the statement tier (cluster-merged), get the
+table that answers "which kernel is burning the device" -- total and
+mean device time, share of the profiled total, calls, retraces,
+rows/bytes throughput, and the kernaudit K005 footprint estimate.
+
+  python scripts/profile_view.py http://127.0.0.1:8080        # either tier
+  python scripts/profile_view.py profile.json                 # curl'd doc
+  python scripts/profile_view.py URL --top 5 --json
+
+Exit codes: 0 on success, 1 when the document carries no kernels,
+2 when the endpoint/file is unreadable.
+"""
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+# repo root importable regardless of invocation directory
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_profile(target: str, timeout: float = 10.0) -> dict:
+    """`target` is a base URL (the /v1/profile path is appended; a full
+    /v1/profile URL also works) or a path to a saved JSON document."""
+    if target.startswith(("http://", "https://")):
+        url = target.rstrip("/")
+        if not url.endswith("/v1/profile"):
+            url = f"{url}/v1/profile"
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read().decode())
+    with open(target) as f:
+        return json.load(f)
+
+
+def _fmt_us(us: int) -> str:
+    if us >= 1_000_000:
+        return f"{us / 1e6:.2f}s"
+    if us >= 1000:
+        return f"{us / 1e3:.1f}ms"
+    return f"{us}us"
+
+
+def _fmt_bytes(n: int) -> str:
+    for bound, suffix in ((1 << 30, "GB"), (1 << 20, "MB"),
+                          (1 << 10, "KB")):
+        if n >= bound:
+            return f"{n / bound:.1f}{suffix}"
+    return f"{n}B"
+
+
+def render(doc: dict, top: int = 10) -> str:
+    kernels = doc.get("kernels") or []
+    total_us = sum(int(k.get("device_us", 0)) for k in kernels) or 1
+    scope = "cluster" if doc.get("cluster") else "process"
+    lines = [f"-- top {min(top, len(kernels))} of {len(kernels)} "
+             f"kernels by device time ({scope} scope"
+             + (f", {doc.get('workersPulled', 0)} workers pulled"
+                if doc.get("cluster") else "") + ") --"]
+    header = (f"{'fingerprint':14} {'device':>9} {'share':>6} "
+              f"{'calls':>6} {'mean':>9} {'retrace':>7} {'rows_out':>9} "
+              f"{'bytes_in':>9} {'footprint':>9}  plan")
+    lines.append(header)
+    for k in kernels[:top]:
+        device = int(k.get("device_us", 0))
+        calls = max(int(k.get("calls", 0)), 1)
+        lines.append(
+            f"{k.get('fingerprint', '')[:12]:14} "
+            f"{_fmt_us(device):>9} "
+            f"{100.0 * device / total_us:>5.1f}% "
+            f"{k.get('calls', 0):>6} "
+            f"{_fmt_us(device // calls):>9} "
+            f"{k.get('retraces', 0):>7} "
+            f"{k.get('rows_out', 0):>9} "
+            f"{_fmt_bytes(int(k.get('bytes_in', 0))):>9} "
+            f"{_fmt_bytes(int(k.get('footprint_bytes', 0))):>9}  "
+            f"{k.get('label', '')}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="profile_view")
+    ap.add_argument("target",
+                    help="worker/coordinator base URL, or a saved "
+                         "/v1/profile JSON file")
+    ap.add_argument("--top", type=int, default=10,
+                    help="kernels to show (default 10)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the (top-truncated) document as JSON")
+    args = ap.parse_args(argv)
+    try:
+        doc = load_profile(args.target)
+    except Exception as e:  # noqa: BLE001 - unreachable target is the
+        # signal this tool reports
+        print(f"error: cannot load profile from {args.target}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    kernels = doc.get("kernels") or []
+    if not kernels:
+        print("no kernels profiled yet (is PRESTO_TPU_PROFILE=0, or "
+              "has nothing executed?)", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps({**doc, "kernels": kernels[:args.top]},
+                         indent=1, sort_keys=True))
+    else:
+        print(render(doc, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -4,8 +4,13 @@
 The ops loop the metrics endpoint exists for, in script form: point it
 at a coordinator or worker, and it reports counter DELTAS over the
 interval (queries finished, rows/bytes produced, compile vs execute
-seconds, cache hits) plus current gauge values -- the numbers a
-before/after perf comparison cites.
+seconds, cache hits), current gauge values, and -- for every histogram
+family -- bucket-estimated p50/p95/p99 of the observations that landed
+WITHIN the window, the numbers a before/after perf comparison cites.
+
+Counter DECREASES between the two scrapes are monotonicity violations
+(a restarted process, or a counter bug) and are flagged in their own
+``violations`` section instead of silently diffing negative.
 
   python scripts/scrape_metrics.py http://127.0.0.1:8080 [--interval 5]
   python scripts/scrape_metrics.py URL --once          # one scrape, dump
@@ -17,6 +22,7 @@ Exit codes: 0 on success, 2 when the endpoint is unreachable.
 import argparse
 import json
 import os
+import re
 import sys
 import time
 import urllib.request
@@ -25,7 +31,8 @@ import urllib.request
 sys.path.insert(0, os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
 
-from presto_tpu.server.metrics import parse_prometheus  # noqa: E402
+from presto_tpu.server.metrics import (parse_prometheus,  # noqa: E402
+                                       quantile_from_buckets)
 
 
 def scrape(url: str, timeout: float = 10.0):
@@ -46,23 +53,81 @@ TRACING_FAMILIES = (
 )
 
 
+_LE_RE = re.compile(r'le="([^"]+)"')
+
+
+def _histogram_window(before: dict, after: dict, fam: str) -> dict:
+    """Per label-set window stats of one histogram family: delta
+    counts per bucket between the scrapes -> estimated p50/p95/p99 of
+    the interval's observations (quantile_from_buckets, the same
+    arithmetic the server-side Histogram uses)."""
+    out = {}
+    groups = {}
+    for key, val in after.get(fam + "_bucket", {}).items():
+        m = _LE_RE.search(key)
+        if not m:
+            continue
+        series = _LE_RE.sub("", key).replace(",,", ",").replace(
+            "{,", "{").replace(",}", "}")
+        le = m.group(1)
+        prev = before.get(fam + "_bucket", {}).get(key, 0.0)
+        groups.setdefault(series, []).append(
+            (float("inf") if le == "+Inf" else float(le), val - prev))
+    for series, buckets in groups.items():
+        buckets.sort(key=lambda x: x[0])
+        bounds = [b for b, _ in buckets if b != float("inf")]
+        # cumulative deltas -> per-bucket deltas (clamped: a restarted
+        # process yields negatives, reported as count_delta < 0)
+        cums = [c for _, c in buckets]
+        per = [cums[0]] + [cums[i] - cums[i - 1]
+                           for i in range(1, len(cums))]
+        count = cums[-1] if cums else 0.0
+        doc = {"count_delta": round(count, 6)}
+        if count > 0 and bounds:
+            clamped = [max(c, 0.0) for c in per]
+            for q, name in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+                doc[name] = round(
+                    quantile_from_buckets(bounds, clamped, q), 6)
+        out[series if series != "{}" else ""] = doc
+    return out
+
+
 def diff(before: dict, after: dict) -> dict:
     """Counter deltas + gauge currents between two parsed scrapes,
-    plus the always-present tracing/flight-recorder section."""
-    out = {"counters": {}, "gauges": {}, "tracing": {}}
+    histogram window quantiles, counter-monotonicity violations, plus
+    the always-present tracing/flight-recorder section."""
+    out = {"counters": {}, "gauges": {}, "tracing": {},
+           "histograms": {}, "violations": {}}
+    hist_bases = set()
     for fam, samples in after.items():
+        if fam.endswith("_bucket"):
+            hist_bases.add(fam[: -len("_bucket")])
+            continue
+        base = fam.rsplit("_", 1)[0]
+        if fam.endswith(("_sum", "_count")) and \
+                (base + "_bucket") in after:
+            continue  # folded into the histogram section
         is_counter = fam.endswith("_total")
         for key, val in samples.items():
             label = fam + key
             if is_counter:
                 prev = before.get(fam, {}).get(key, 0.0)
                 delta = val - prev
+                if delta < 0:
+                    # a counter went DOWN: that is a restart or a bug,
+                    # not a negative rate -- flag it, don't diff it
+                    out["violations"][label] = round(delta, 6)
+                    continue
                 if fam in TRACING_FAMILIES:
                     out["tracing"][label] = round(delta, 6)
                 elif delta:
                     out["counters"][label] = round(delta, 6)
             else:
                 out["gauges"][label] = round(val, 6)
+    for base in sorted(hist_bases):
+        win = _histogram_window(before, after, base)
+        if win:
+            out["histograms"][base] = win
     return out
 
 

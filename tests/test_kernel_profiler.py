@@ -1,0 +1,502 @@
+"""Continuous kernel profiler + histogram metrics: the round-10
+observability layer.
+
+Covers: the Histogram merge law (associative / commutative / identity,
+the same contract QueryStats.merge carries), exposition-format
+compliance (cumulative ``le`` ladder, ``+Inf`` == ``_count``,
+exemplars, parse_prometheus round-trip), concurrent ``observe()``
+under threads, profiler registry bounded-size eviction, the
+cluster-wide ``/v1/profile`` merge E2E with two workers,
+``system.kernels`` via SQL, exemplar -> trace linkage, the
+flight-dump profiler embed, and scrape-side histogram quantile /
+counter-monotonicity analysis."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from presto_tpu.server.metrics import (DEFAULT_BUCKETS, Histogram,
+                                       MetricFamily, histogram_families,
+                                       observe_histogram,
+                                       parse_prometheus,
+                                       quantile_from_buckets,
+                                       render_prometheus,
+                                       reset_histograms)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    yield
+    reset_histograms()
+    from presto_tpu.server.tracing import set_tracer
+    set_tracer(None)
+
+
+# ---------------------------------------------------------------------------
+# Histogram value type
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_merge_law():
+    a, b, c = Histogram(), Histogram(), Histogram()
+    a.observe(0.003, trace_id="ta")
+    a.observe(0.4)
+    b.observe(7.0, trace_id="tb")
+    c.observe(0.003, trace_id="tc")
+    # associative
+    assert a.merge(b).merge(c).to_json() == a.merge(b.merge(c)).to_json()
+    # commutative
+    assert a.merge(b).to_json() == b.merge(a).to_json()
+    # identity
+    ident = Histogram()
+    assert a.merge(ident).to_json() == a.to_json()
+    assert ident.merge(a).to_json() == a.to_json()
+    m = a.merge(b).merge(c)
+    assert m.count == 4
+    assert abs(m.sum - 7.406) < 1e-9
+    # exemplar law: per bucket, the max-latency observation survives
+    snap = m.snapshot()
+    kept = {e[0] for e in snap["exemplars"] if e}
+    assert "tb" in kept
+    # 0.003 landed twice (ta then tc at equal value): later >= wins
+    assert "tc" in kept
+    # different bucket schemes refuse to merge
+    with pytest.raises(ValueError):
+        Histogram((1.0, 2.0)).merge(Histogram((1.0, 3.0)))
+
+
+def test_histogram_json_round_trip():
+    h = Histogram()
+    h.observe(0.02, trace_id="x")
+    h.observe(50.0)
+    rt = Histogram.from_json(json.loads(json.dumps(h.to_json())))
+    assert rt.to_json() == h.to_json()
+
+
+def test_concurrent_observe_under_threads():
+    h = Histogram()
+    n_threads, per_thread = 8, 500
+
+    def worker(i):
+        for k in range(per_thread):
+            h.observe(0.001 * ((i + k) % 7 + 1), trace_id=f"t{i}")
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = h.snapshot()
+    assert snap["count"] == n_threads * per_thread
+    assert sum(snap["counts"]) == n_threads * per_thread
+    assert snap["sum"] > 0
+
+
+def test_quantile_estimation_from_buckets():
+    h = Histogram()
+    for _ in range(90):
+        h.observe(0.003)   # -> (0.0025, 0.005] bucket
+    for _ in range(10):
+        h.observe(30.0)    # -> (25, 50] bucket
+    p50 = h.quantile(0.5)
+    assert 0.0025 <= p50 <= 0.005
+    p99 = h.quantile(0.99)
+    assert 25.0 <= p99 <= 50.0
+    # empty histogram reports 0
+    assert Histogram().quantile(0.99) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# exposition format
+# ---------------------------------------------------------------------------
+
+
+def test_exposition_cumulative_le_and_inf_equals_count():
+    h = Histogram()
+    h.observe(0.0002, trace_id="small")
+    h.observe(3.0, trace_id="big")
+    h.observe(3.0)
+    fam = MetricFamily("t_hist_seconds", "histogram", "test").\
+        add_histogram(h)
+    text = "\n".join(fam.render()) + "\n"
+    parsed = parse_prometheus(text)
+    buckets = parsed["t_hist_seconds_bucket"]
+    # cumulative: monotone non-decreasing in le order
+    by_le = sorted(((float("inf") if 'le="+Inf"' in k
+                     else float(k.split('le="')[1].split('"')[0]), v)
+                    for k, v in buckets.items()), key=lambda x: x[0])
+    vals = [v for _, v in by_le]
+    assert vals == sorted(vals)
+    # +Inf bucket == _count; _sum matches
+    assert by_le[-1][1] == parsed["t_hist_seconds_count"][""] == 3
+    assert abs(parsed["t_hist_seconds_sum"][""] - 6.0002) < 1e-6
+    # one bucket line per bound plus +Inf
+    assert len(buckets) == len(DEFAULT_BUCKETS) + 1
+    # exemplars rendered and stripped cleanly by the parser
+    assert 'trace_id="big"' in text and 'trace_id="small"' in text
+
+
+def test_registry_families_on_both_tiers_and_declared_shape():
+    # declared families render zeros before any observation
+    fams = {f.name for f in histogram_families()}
+    assert {"presto_tpu_query_latency_seconds",
+            "presto_tpu_dispatch_queue_wait_seconds",
+            "presto_tpu_stage_seconds",
+            "presto_tpu_task_seconds"} <= fams
+    observe_histogram("presto_tpu_stage_seconds", 0.02,
+                      labels={"stage": "execute"}, trace_id="tt")
+    text = render_prometheus(histogram_families()).decode()
+    parsed = parse_prometheus(text)
+    key = '{le="+Inf",stage="execute"}'
+    assert parsed["presto_tpu_stage_seconds_bucket"][key] == 1
+
+
+def _hist_family_count(url):
+    with urllib.request.urlopen(f"{url}/v1/metrics") as r:
+        text = r.read().decode()
+    names = [line.split()[2] for line in text.splitlines()
+             if line.startswith("# TYPE")
+             and line.rstrip().endswith("histogram")]
+    parse_prometheus(text)  # must stay valid exposition text
+    return names
+
+
+def test_metrics_histograms_on_both_tiers():
+    from presto_tpu.client import execute
+    from presto_tpu.server import TpuWorkerServer
+    from presto_tpu.server.statement import StatementServer
+    with StatementServer(sf=0.01) as srv:
+        execute(srv.url, "SELECT count(*) AS n FROM region",
+                session={"sf": "0.01"})
+        coord_names = _hist_family_count(srv.url)
+        assert len(coord_names) >= 4
+        assert "presto_tpu_query_latency_seconds" in coord_names
+        assert "presto_tpu_dispatch_queue_wait_seconds" in coord_names
+        # the executed query landed observations, exemplar'd
+        with urllib.request.urlopen(f"{srv.url}/v1/metrics") as r:
+            text = r.read().decode()
+        parsed = parse_prometheus(text)
+        lat = parsed["presto_tpu_query_latency_seconds_count"][""]
+        assert lat >= 1
+    w = TpuWorkerServer(sf=0.01).start()
+    try:
+        worker_names = _hist_family_count(f"http://127.0.0.1:{w.port}")
+        assert len(worker_names) >= 4
+        assert "presto_tpu_query_latency_seconds" in worker_names
+        assert "presto_tpu_dispatch_queue_wait_seconds" in worker_names
+    finally:
+        w.stop()
+
+
+def test_exemplar_links_to_trace():
+    """A /v1/metrics exemplar's trace id resolves on GET /v1/trace.
+    Exemplars render only under negotiated OpenMetrics (a classic
+    0.0.4 scraper would reject the suffix); the default scrape stays
+    exemplar-free and strictly valid."""
+    from presto_tpu.client import execute
+    from presto_tpu.server.statement import StatementServer
+    from presto_tpu.server.tracing import RecordingTracer, set_tracer
+    set_tracer(RecordingTracer())
+    with StatementServer(sf=0.01) as srv:
+        execute(srv.url, "SELECT count(*) AS n FROM nation",
+                session={"sf": "0.01"})
+        # default Accept: classic text format, NO exemplar suffixes
+        with urllib.request.urlopen(f"{srv.url}/v1/metrics") as r:
+            assert "0.0.4" in r.headers["Content-Type"]
+            assert " # {" not in r.read().decode()
+        req = urllib.request.Request(
+            f"{srv.url}/v1/metrics",
+            headers={"Accept": "application/openmetrics-text"})
+        with urllib.request.urlopen(req) as r:
+            assert "openmetrics" in r.headers["Content-Type"]
+            text = r.read().decode()
+        assert text.rstrip().endswith("# EOF")
+        ex_lines = [l for l in text.splitlines()
+                    if l.startswith("presto_tpu_query_latency_seconds_"
+                                    "bucket") and " # {" in l]
+        assert ex_lines, "query latency carried no exemplar"
+        tid = ex_lines[0].split('trace_id="')[1].split('"')[0]
+        with urllib.request.urlopen(f"{srv.url}/v1/trace/{tid}") as r:
+            doc = json.loads(r.read().decode())
+        assert doc["spans"]
+        assert any(s["name"] == "query" for s in doc["spans"])
+
+
+# ---------------------------------------------------------------------------
+# profiler registry
+# ---------------------------------------------------------------------------
+
+
+def test_profiler_records_and_matches_query_stats():
+    from presto_tpu.exec.profiler import clear_profiler, profile_snapshot
+    from presto_tpu.queries.tpch_sql import tpch_query
+    from presto_tpu.sql import sql
+    clear_profiler()
+    q1 = tpch_query(1)
+    res = sql(q1.text, sf=0.01, max_groups=q1.max_groups)
+    assert res.row_count > 0
+    snap = profile_snapshot()
+    assert snap, "q1 execution did not land in the profiler"
+    top = snap[0]
+    qs = res.query_stats
+    exec_us = qs.stages["execute"].wall_us
+    comp = qs.stages.get("compile")
+    comp_us = comp.wall_us if comp else 0
+    # the acceptance bound: the hottest kernel's device time matches
+    # the QueryStats stage timings within measurement noise -- the
+    # execute stage wraps exactly the dispatch + block_until_ready this
+    # measures, minus the carved-out compile stage (cold dispatches
+    # must not book trace+XLA-compile as device occupancy)
+    expected = max(exec_us - comp_us, 0)
+    assert 0 <= top["device_us"] <= exec_us * 1.1 + 20_000
+    assert abs(top["device_us"] - expected) <= \
+        max(0.3 * max(expected, 1), 50_000)
+    assert top["calls"] >= 1
+    assert top["retraces"] >= 1          # first execution pays compile
+    assert top["rows_out"] == res.row_count
+    assert top["rows_in"] > 0 and top["bytes_in"] > 0
+    assert "lineitem" in top["tables"]
+    assert "TableScan[tpch.lineitem]" in top["label"]
+    # second run: cache hit -> calls grow, retraces do not
+    sql(q1.text, sf=0.01, max_groups=q1.max_groups)
+    again = [p for p in profile_snapshot()
+             if p["fingerprint"] == top["fingerprint"]][0]
+    assert again["calls"] == top["calls"] + 1
+    assert again["retraces"] == top["retraces"]
+
+
+def test_profiler_bounded_eviction():
+    from presto_tpu.exec import profiler
+    profiler.clear_profiler()
+    prev = profiler.set_capacity(4)
+    try:
+        for i in range(10):
+            profiler.record_call(f"fp{i:02d}", label=f"k{i}",
+                                 device_us=100 + i)
+        snap = profiler.profile_snapshot()
+        assert len(snap) == 4
+        fps = {p["fingerprint"] for p in snap}
+        assert fps == {"fp06", "fp07", "fp08", "fp09"}  # LRU out
+    finally:
+        profiler.set_capacity(prev)
+        profiler.clear_profiler()
+
+
+def test_merge_kernel_rows_dedups_process_slices():
+    from presto_tpu.exec.profiler import merge_kernel_rows
+    row = {"fingerprint": "abc", "calls": 2, "device_us": 100,
+           "max_device_us": 80, "rows_in": 10, "bytes_in": 100,
+           "rows_out": 1, "bytes_out": 8, "retraces": 1,
+           "footprint_bytes": 0, "label": "X", "tables": "t"}
+    other = dict(row, device_us=50, calls=1, max_device_us=50)
+    docs = [{"processId": "p1", "kernels": [row]},
+            {"processId": "p1", "kernels": [row]},   # same process twice
+            {"processId": "p2", "kernels": [other]}]
+    merged = merge_kernel_rows(docs)
+    assert len(merged) == 1
+    assert merged[0]["calls"] == 3            # p1 once + p2
+    assert merged[0]["device_us"] == 150
+    assert merged[0]["max_device_us"] == 80   # max law
+
+
+def test_cluster_profile_merge_two_workers_e2e():
+    from presto_tpu.exec.profiler import clear_profiler, profile_snapshot
+    from presto_tpu.plan.distribute import add_exchanges
+    from presto_tpu.server import Coordinator, TpuWorkerServer
+    from presto_tpu.server.statement import StatementServer
+    from presto_tpu.sql import plan_sql
+    clear_profiler()
+    ws = [TpuWorkerServer(sf=0.01).start() for _ in range(2)]
+    urls = [f"http://127.0.0.1:{w.port}" for w in ws]
+    try:
+        coord = Coordinator(urls)
+        dist = add_exchanges(plan_sql(
+            "SELECT regionkey, count(*) AS c FROM nation "
+            "GROUP BY regionkey", max_groups=64))
+        cols, _ = coord.execute(dist, sf=0.01)
+        # each worker serves its slice at GET /v1/profile
+        slices = []
+        for url in urls:
+            with urllib.request.urlopen(f"{url}/v1/profile") as r:
+                slices.append(json.loads(r.read().decode()))
+        assert all(doc["kernels"] for doc in slices)
+        assert all(doc["processId"] for doc in slices)
+        # the statement tier serves the cluster-merged table
+        with StatementServer(sf=0.01,
+                             profile_workers=lambda: urls) as srv:
+            with urllib.request.urlopen(f"{srv.url}/v1/profile") as r:
+                doc = json.loads(r.read().decode())
+        assert doc["cluster"] is True
+        assert doc["workersPulled"] == 2
+        assert doc["kernels"]
+        # in-process workers share one registry: processId dedup must
+        # fold the three identical slices into exactly the local view
+        local = {p["fingerprint"]: p for p in profile_snapshot()}
+        merged = {p["fingerprint"]: p for p in doc["kernels"]}
+        assert set(merged) == set(local)
+        for fp, p in merged.items():
+            assert p["calls"] == local[fp]["calls"]
+            assert p["device_us"] == local[fp]["device_us"]
+    finally:
+        for w in ws:
+            w.stop()
+
+
+def test_system_kernels_sql():
+    from presto_tpu.exec.profiler import clear_profiler
+    from presto_tpu.sql import sql
+    clear_profiler()
+    sql("SELECT count(*) AS n FROM region", sf=0.01)
+    res = sql("SELECT fingerprint, plan, calls, device_time_us, "
+              "retraces FROM system.kernels")
+    rows = res.rows()
+    assert rows, "system.kernels is empty after an executed query"
+    fp, plan, calls, device_us, retraces = rows[0]
+    assert len(fp) == 64 and int(calls) >= 1
+    assert "TableScan[tpch.region]" in plan
+    assert int(device_us) > 0
+
+
+def test_explain_analyze_kernel_section():
+    from presto_tpu.plan import explain_analyze
+    from presto_tpu.sql import plan_sql
+    text = explain_analyze(
+        plan_sql("SELECT nationkey FROM nation WHERE regionkey = 1"),
+        sf=0.01)
+    assert "-- kernels" in text
+    assert "<- this query" in text
+
+
+def test_failed_query_keeps_attribution():
+    """A query that fails mid-execute still lands in the registry (the
+    recording sits in run_query's finally), so its flight dump can
+    embed the kernels that burned device time before the failure."""
+    from presto_tpu.exec.profiler import (clear_profiler,
+                                          profile_for_query,
+                                          profile_snapshot)
+    from presto_tpu.sql import sql
+    clear_profiler()
+    with pytest.raises(RuntimeError, match="overflow"):
+        sql("SELECT custkey, count(*) AS c FROM orders GROUP BY custkey",
+            sf=0.01, max_groups=4,
+            session={"adaptive_capacity": False,
+                     "stats_capacity_refinement": False})
+    snap = profile_snapshot()
+    assert snap and snap[0]["calls"] == 1
+    assert snap[0]["rows_out"] == 0           # it never produced
+    assert profile_for_query("query")         # query-id cross-link
+
+
+def test_footprint_estimate_rides_profile_rows():
+    from presto_tpu.exec.profiler import clear_profiler, profile_snapshot
+    from presto_tpu.sql import sql
+    clear_profiler()
+    sql("SELECT sum(quantity) AS s FROM lineitem", sf=0.001,
+        session={"kernel_audit": True})
+    rows = [p for p in profile_snapshot() if "lineitem" in p["tables"]]
+    assert rows and rows[0]["footprint_bytes"] > 0
+
+
+def test_flight_dump_embeds_profile(tmp_path):
+    from presto_tpu.client import execute
+    from presto_tpu.server.flight_recorder import (FlightRecorder,
+                                                   set_flight_recorder)
+    from presto_tpu.server.statement import StatementServer
+    rec = FlightRecorder(dump_dir=str(tmp_path))
+    set_flight_recorder(rec)
+    try:
+        with StatementServer(sf=0.01) as srv:
+            r = execute(srv.url, "SELECT count(*) AS n FROM lineitem",
+                        session={"sf": "0.01",
+                                 "slow_query_threshold_ms": "1"})
+            qid = r.query_id
+            deadline = time.time() + 5
+            path = None
+            while path is None and time.time() < deadline:
+                path = rec.dump_path(qid)
+                time.sleep(0.05)
+        assert path is not None
+        lines = [json.loads(l) for l in open(path)]
+        assert lines[0]["dump"]["reason"] == "slow"
+        profs = [l for l in lines if "profile" in l]
+        assert profs, "dump carries no profiler snapshot"
+        kernels = profs[0]["profile"]["kernels"]
+        assert kernels and kernels[0]["fingerprint"]
+        assert kernels[0]["device_us"] >= 0
+        assert kernels[0]["calls"] >= 1
+    finally:
+        set_flight_recorder(None)
+
+
+# ---------------------------------------------------------------------------
+# scrape-side analysis (scripts/scrape_metrics.py)
+# ---------------------------------------------------------------------------
+
+
+def _scrape_diff():
+    import importlib
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    return importlib.import_module("scrape_metrics").diff
+
+
+def test_scrape_diff_histogram_quantiles_and_violations():
+    diff = _scrape_diff()
+    h = Histogram()
+    before_fams = histogram_families()
+    before = parse_prometheus(
+        render_prometheus(before_fams).decode())
+    before["presto_tpu_queries_total"] = {'{state="FINISHED"}': 10.0}
+    for _ in range(95):
+        observe_histogram("presto_tpu_query_latency_seconds", 0.003)
+    for _ in range(5):
+        observe_histogram("presto_tpu_query_latency_seconds", 30.0)
+    after = parse_prometheus(
+        render_prometheus(histogram_families()).decode())
+    # a counter that DECREASED between scrapes
+    after["presto_tpu_queries_total"] = {'{state="FINISHED"}': 4.0}
+    out = diff(before, after)
+    win = out["histograms"]["presto_tpu_query_latency_seconds"][""]
+    assert win["count_delta"] == 100
+    assert 0.0025 <= win["p50"] <= 0.005
+    assert 25.0 <= win["p99"] <= 50.0
+    # the decrease is flagged, not silently diffed negative
+    key = 'presto_tpu_queries_total{state="FINISHED"}'
+    assert out["violations"][key] == -6
+    assert key not in out["counters"]
+    del h
+
+
+def test_quantile_from_buckets_shared_helper():
+    bounds = [0.001, 0.01, 0.1]
+    # 10 obs in (0.001, 0.01], 10 in +Inf
+    assert quantile_from_buckets(bounds, [0, 10, 0, 10], 0.25) <= 0.01
+    assert quantile_from_buckets(bounds, [0, 10, 0, 10], 0.99) == 0.1
+    assert quantile_from_buckets(bounds, [0, 0, 0, 0], 0.5) == 0.0
+
+
+def test_profile_view_renders():
+    import importlib
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    pv = importlib.import_module("profile_view")
+    doc = {"processId": "p", "cluster": True, "workersPulled": 2,
+           "kernels": [
+               {"fingerprint": "a" * 64, "label": "Output > Scan",
+                "tables": "tpch.nation", "calls": 3,
+                "device_us": 900_000, "max_device_us": 500_000,
+                "rows_in": 75, "bytes_in": 4096, "rows_out": 5,
+                "bytes_out": 64, "retraces": 1,
+                "footprint_bytes": 1 << 20}]}
+    text = pv.render(doc, top=5)
+    assert "aaaaaaaaaaaa" in text
+    assert "100.0%" in text
+    assert "cluster scope, 2 workers pulled" in text

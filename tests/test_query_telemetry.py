@@ -182,9 +182,19 @@ def test_coordinator_metrics_endpoint_ten_families():
         assert any(k.startswith('{state="FINISHED"}')
                    for k in fams["presto_tpu_queries_total"])
         assert fams["presto_tpu_query_rows_total"][""] >= 1
-        # every family carries HELP/TYPE lines (exposition format)
-        assert text.count("# HELP") == len(fams)
-        assert text.count("# TYPE") == len(fams)
+
+        # every family carries HELP/TYPE lines (exposition format);
+        # histogram sub-samples (_bucket/_sum/_count) share their base
+        # family's HELP/TYPE lines
+        def base_of(name):
+            for suf in ("_bucket", "_sum", "_count"):
+                if name.endswith(suf) and \
+                        (name[: -len(suf)] + "_bucket") in fams:
+                    return name[: -len(suf)]
+            return name
+        bases = {base_of(k) for k in fams}
+        assert text.count("# HELP") == len(bases)
+        assert text.count("# TYPE") == len(bases)
 
 
 def test_explain_analyze_mesh_tpch_annotations(mesh8):
