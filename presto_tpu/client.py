@@ -14,11 +14,21 @@ shape) can drive the engine remotely.
 from __future__ import annotations
 
 import json
+import os
 import time
 import urllib.request
 from typing import Dict, Iterator, List, Optional, Tuple
 
-__all__ = ["StatementClient", "QueryError", "execute"]
+__all__ = ["StatementClient", "QueryError", "execute",
+           "DEFAULT_DEADLINE_S"]
+
+# overall statement deadline: the result-polling loop gives up (with a
+# clean CLIENT_POLL_TIMEOUT error and a best-effort cancel) once a
+# statement has been in flight this long. The statement tier answers
+# each poll promptly even when the ENGINE is wedged -- the per-request
+# timeout never fires -- so without this bound a hung server tier
+# blocks the CLI forever. Env override: PRESTO_TPU_CLIENT_DEADLINE_S.
+DEFAULT_DEADLINE_S = 3600.0
 
 
 class QueryError(RuntimeError):
@@ -41,9 +51,22 @@ class StatementClient:
                  session: Optional[Dict[str, str]] = None,
                  transaction_id: Optional[str] = None,
                  timeout: float = 120.0,
-                 extra_headers: Optional[Dict[str, str]] = None):
+                 extra_headers: Optional[Dict[str, str]] = None,
+                 deadline_s: Optional[float] = None):
+        """`timeout` bounds each HTTP request; `deadline_s` bounds the
+        WHOLE statement (POST through last page). None resolves through
+        env PRESTO_TPU_CLIENT_DEADLINE_S to DEFAULT_DEADLINE_S; pass 0
+        to disable the overall bound."""
         self.server_url = server_url.rstrip("/")
         self.timeout = timeout
+        if deadline_s is None:
+            try:
+                deadline_s = float(os.environ.get(
+                    "PRESTO_TPU_CLIENT_DEADLINE_S", DEFAULT_DEADLINE_S))
+            except ValueError:
+                deadline_s = DEFAULT_DEADLINE_S
+        self.deadline_s = deadline_s
+        self._deadline = (time.time() + deadline_s) if deadline_s else None
         self.columns: Optional[List[dict]] = None
         self.data: List[list] = []
         self.stats: Dict = {}
@@ -123,9 +146,19 @@ class StatementClient:
                 self.clear_transaction = True
 
     def advance(self) -> bool:
-        """Fetch the next results document; False when finished."""
+        """Fetch the next results document; False when finished. Past
+        the overall deadline, cancels (best-effort) and raises a clean
+        CLIENT_POLL_TIMEOUT instead of polling a wedged tier forever."""
         if self._next_uri is None:
             return False
+        if self._deadline is not None and time.time() > self._deadline:
+            self.cancel()
+            raise QueryError({
+                "message": f"statement {self.query_id or '<unknown>'} "
+                           f"did not complete within {self.deadline_s}s "
+                           f"(client poll deadline)",
+                "errorCode": 16, "errorName": "CLIENT_POLL_TIMEOUT",
+                "errorType": "EXTERNAL"})
         doc, headers = self._request(self._next_uri)
         self._absorb(doc, headers)
         self._next_uri = doc.get("nextUri")
@@ -151,9 +184,11 @@ def execute(server_url: str, text: str, user: str = "presto",
             session: Optional[Dict[str, str]] = None,
             transaction_id: Optional[str] = None,
             timeout: float = 120.0,
-            extra_headers: Optional[Dict[str, str]] = None
+            extra_headers: Optional[Dict[str, str]] = None,
+            deadline_s: Optional[float] = None
             ) -> StatementClient:
     """POST + drain: returns the finished client (columns/data/stats)."""
     return StatementClient(server_url, text, user=user, session=session,
                           transaction_id=transaction_id, timeout=timeout,
-                          extra_headers=extra_headers).drain()
+                          extra_headers=extra_headers,
+                          deadline_s=deadline_s).drain()

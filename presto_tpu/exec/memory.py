@@ -19,6 +19,7 @@ import dataclasses
 import threading
 from typing import Dict, Optional
 
+from .. import failpoints
 from ..block import Batch
 
 __all__ = ["MemoryPool", "MemoryContext", "MemoryReservationError",
@@ -135,6 +136,13 @@ class MemoryPool:
         queries to release; only then does it raise -- the caller then
         downsizes buckets or spills its own inputs."""
         import time as _time
+        if failpoints.ARMED:
+            try:
+                failpoints.hit("memory.reserve")
+            except failpoints.InjectedOOM as e:
+                # the injected fault speaks this pool's native refusal
+                # surface, so callers exercise their REAL degrade paths
+                raise MemoryReservationError(str(e)) from None
         deadline = _time.time() + self.admission_timeout_s
         revoke_tried = False
         while True:

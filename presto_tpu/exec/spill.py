@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import failpoints
 from .. import types as T
 from ..block import Batch, batch_from_numpy, to_numpy
 from ..connectors import catalog
@@ -128,6 +129,9 @@ class _HostRows:
         import uuid as _uuid
         if self.rows == 0 or not self._cols[0]:
             return
+        if failpoints.ARMED:
+            # a full/broken spill disk at run-flush time
+            failpoints.hit("spill.write")
         os.makedirs(self.disk_dir, exist_ok=True)
         path = os.path.join(self.disk_dir,
                             f"spill_{_uuid.uuid4().hex[:12]}.npz")
@@ -151,6 +155,9 @@ class _HostRows:
     def columns(self) -> Tuple[List[np.ndarray], List[np.ndarray]]:
         cols_runs: List[List[np.ndarray]] = [[] for _ in self.types]
         nulls_runs: List[List[np.ndarray]] = [[] for _ in self.types]
+        if failpoints.ARMED and self._runs:
+            # a run file that rotted/vanished between write and re-read
+            failpoints.hit("spill.read")
         for path in self._runs:
             with np.load(path, allow_pickle=True) as z:
                 for c in range(len(self.types)):

@@ -1,0 +1,96 @@
+"""The failpoint site catalog: every named injection point in the engine.
+
+One committed registry of ``<layer>.<verb>`` names so the admin API can
+list what exists, the chaos harness (scripts/chaos.py) can generate
+schedules over real sites, and DESIGN.md's naming convention has a
+single source of truth. Adding a site = instrument the code path with
+the two-line armed-check idiom (see the package docstring) AND add its
+row here; a site that fires but is absent from this catalog still
+works (the registry arms any name), it just won't be offered to
+schedule generators or described by ``GET /v1/failpoint``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+__all__ = ["SITES", "sites_by_layer"]
+
+# name -> (layer, description). Layers mirror the engine's seams; the
+# chaos soak's coverage invariant counts DISTINCT LAYERS fired.
+SITES: Dict[str, Tuple[str, str]] = {
+    "exchange.fetch": (
+        "exchange",
+        "cross-worker page pull entry (http_exchange.fetch_remote_batch): "
+        "a consumer task's view of a dead/slow upstream"),
+    "exchange.serve": (
+        "exchange",
+        "worker result-buffer serve (GET /v1/task/.../results/...): "
+        "drop_conn here exercises the client's stale-socket retry"),
+    "serde.serialize": (
+        "serde",
+        "SerializedPage encode epilogue (serde/pages.serialize_page): "
+        "corrupt_page flips payload bytes AFTER the checksum is stamped"),
+    "serde.deserialize": (
+        "serde",
+        "SerializedPage decode entry (serde/pages.deserialize_page): "
+        "corrupt_page feeds the checksum/bounds validation paths"),
+    "task.submit": (
+        "task",
+        "coordinator task-submission hop (Coordinator._submit): "
+        "errors exercise submission failover to the next worker"),
+    "task.status": (
+        "task",
+        "coordinator task-status poll (Coordinator._await_or_retry): "
+        "errors exercise abort + resubmit-elsewhere recovery"),
+    "task.result": (
+        "task",
+        "coordinator final result pull (fetch_results): errors exercise "
+        "the re-run-final-task recovery path"),
+    "worker.run_task": (
+        "task",
+        "worker task execution entry (TaskManager._run_task, after the "
+        "RUNNING transition): error = crash mid-task, hang/delay = "
+        "wedged or slow worker"),
+    "client.request": (
+        "task",
+        "WorkerClient HTTP request (one per hop): drop_conn exercises "
+        "the stale-keep-alive retry with backoff"),
+    "discovery.announce": (
+        "discovery",
+        "worker announcement PUT (Announcer.announce_once): a worker "
+        "that cannot reach discovery"),
+    "discovery.probe": (
+        "discovery",
+        "heartbeat probe (HeartbeatProber._probe): a probe failure "
+        "feeds the decayed failure rate that gates scheduling"),
+    "dispatcher.admit": (
+        "dispatcher",
+        "query admission entry (Dispatcher.submit, before the resource-"
+        "group queue): delay = admission stall, error = failed dispatch"),
+    "memory.reserve": (
+        "memory",
+        "HBM admission reservation (MemoryPool.reserve): the oom action "
+        "surfaces as MemoryReservationError, the real refusal path"),
+    "spill.write": (
+        "spill",
+        "spill run-file flush (exec/spill._HostRows._flush_run): a full "
+        "or broken spill disk"),
+    "spill.read": (
+        "spill",
+        "spill run-file re-read (exec/spill._HostRows.columns): a run "
+        "file that vanished or rotted between write and read"),
+    "statement.execute": (
+        "statement",
+        "statement-tier engine execution entry (StatementServer."
+        "_run_engine): hang here pins the client's poll deadline"),
+}
+
+
+def sites_by_layer() -> Dict[str, list]:
+    """{layer: [site, ...]} over the committed catalog (schedule
+    generators pick per-layer; deterministic order)."""
+    out: Dict[str, list] = {}
+    for name in sorted(SITES):
+        out.setdefault(SITES[name][0], []).append(name)
+    return out

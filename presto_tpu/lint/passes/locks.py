@@ -93,7 +93,7 @@ class LockDisciplinePass(LintPass):
     name = "lock-discipline"
     description = ("writes to _GUARDED_BY-declared attributes outside "
                    "their `with <lock>:` block")
-    TARGETS = ("presto_tpu/server/*.py",)
+    TARGETS = ("presto_tpu/server/*.py", "presto_tpu/failpoints/*.py")
 
     def run(self, ms: ModuleSource) -> List[Finding]:
         guarded = _guarded_map(ms.tree)

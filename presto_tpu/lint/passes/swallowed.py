@@ -73,7 +73,7 @@ class SwallowedErrorsPass(LintPass):
     name = "swallowed-errors"
     description = ("bare/overbroad excepts whose body neither logs, "
                    "counts, re-raises, nor returns a value")
-    TARGETS = ("presto_tpu/server/*.py",)
+    TARGETS = ("presto_tpu/server/*.py", "presto_tpu/failpoints/*.py")
 
     def run(self, ms: ModuleSource) -> List[Finding]:
         findings: List[Finding] = []

@@ -34,6 +34,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import failpoints
 from .. import types as T
 from ..block import Batch, Block, Column, DictionaryColumn, StringColumn, to_numpy
 from ..native import kernels as nk
@@ -415,8 +416,13 @@ def serialize_page(columns: Sequence[Tuple[T.Type, np.ndarray, np.ndarray]],
         checksum = _checksum(payload, flags, rows, uncompressed)
     header = struct.pack("<iBiiq", rows, flags, uncompressed, len(payload),
                          checksum)
+    page = header + payload
+    if failpoints.ARMED:
+        # corrupt_page flips payload bytes AFTER the checksum stamp, so
+        # the consumer's checksum validation is what catches it
+        page = failpoints.hit("serde.serialize", page)
     _observe_serde("serialize", time.time() - t_page0)
-    return header + payload
+    return page
 
 
 def _checksum(payload: bytes, codec_flags: int, rows: int,
@@ -434,6 +440,8 @@ def deserialize_page(buf: bytes, types: Sequence[T.Type],
     """-> [(values, nulls)] per column. `types` guide dtype mapping
     (the wire encoding alone cannot distinguish e.g. BIGINT from DOUBLE)."""
     t_page0 = time.time()
+    if failpoints.ARMED:
+        buf = failpoints.hit("serde.deserialize", buf)
     rows, flags, uncompressed, size, checksum = struct.unpack_from("<iBiiq", buf)
     payload = bytes(memoryview(buf)[21:21 + size])
     if flags & _CHECKSUMMED:

@@ -19,9 +19,11 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import failpoints
 from .. import types as T
 from ..plan import nodes as N
 from ..serde import PageCodec, deserialize_page
+from ..utils.backoff import Backoff
 
 __all__ = ["WorkerClient"]
 
@@ -84,6 +86,10 @@ class WorkerClient:
                 conn = self._connect()
                 self._local.conn = conn
             try:
+                if failpoints.ARMED:
+                    # drop_conn here is an injected stale keep-alive
+                    # socket: a ConnectionError the retry below handles
+                    failpoints.hit("client.request")
                 conn.request(method, self._prefix + path, body=body,
                              headers=headers)
                 resp = conn.getresponse()
@@ -111,6 +117,10 @@ class WorkerClient:
                 from .flight_recorder import record_event
                 record_event("http_retry", path=path,
                              error=f"{type(e).__name__}: {e}")
+                # brief seeded backoff before the fresh-connection
+                # retry: a reset usually means the peer is busy or
+                # mid-restart, and an instant retry piles on
+                Backoff(base_s=0.02, cap_s=0.25, seed=path).sleep()
         raise last_err  # unreachable
 
     @staticmethod

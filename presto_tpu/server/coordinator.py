@@ -28,8 +28,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .. import failpoints
 from ..connectors import catalog
 from ..plan import fragment_plan, nodes as N
+from ..utils.backoff import Backoff
 from .client import WorkerClient
 from .discovery import alive_nodes
 from .flight_recorder import record_event
@@ -110,12 +112,19 @@ class Coordinator:
     def _submit(self, urls: List[str], preferred: int, task_id: str,
                 body: dict, timeout: float) -> Tuple[str, str, int]:
         """Submit (without waiting), failing over on SUBMISSION errors.
-        Returns (url, tid, next_attempt)."""
+        Failover attempts back off (seeded by task id, so a failpoint
+        schedule replays the same delays) instead of hammering the next
+        candidate immediately. Returns (url, tid, next_attempt)."""
         last_err = None
+        backoff = Backoff(base_s=0.02, cap_s=0.5, seed=task_id)
         for attempt in range(len(urls)):
+            if attempt:
+                backoff.sleep()
             url = urls[(preferred + attempt) % len(urls)]
             tid = task_id if attempt == 0 else f"{task_id}.s{attempt}"
             try:
+                if failpoints.ARMED:
+                    failpoints.hit("task.submit")
                 WorkerClient(url, timeout).submit_body(tid, body)
                 return url, tid, attempt + 1
             except Exception as e:  # noqa: BLE001 - dead worker -> next
@@ -143,8 +152,14 @@ class Coordinator:
         for key, url, tid, preferred in pending:
             retries_left = len(urls)
             last_err = None
+            # retry pacing (RequestErrorTracker backoff analog): grows
+            # per resubmission of THIS task; seeded so chaos schedules
+            # replay identical delay sequences
+            backoff = Backoff(base_s=0.05, cap_s=1.0, seed=tid)
             while True:
                 try:
+                    if failpoints.ARMED:
+                        failpoints.hit("task.status")
                     info = WorkerClient(url, timeout).wait(tid, timeout)
                     if info["state"] == "FINISHED":
                         done[key] = (url, tid)
@@ -176,6 +191,10 @@ class Coordinator:
                         recover(body)
                     except Exception as e:  # noqa: BLE001
                         last_err = f"upstream recovery: "                                    f"{type(e).__name__}: {e}"
+                # back off before resubmitting: the failure often IS
+                # load (a struggling worker), and immediate resubmission
+                # feeds it
+                backoff.sleep()
                 # re-derive the candidate set: the prober/discovery view
                 # may have excluded the dead worker by now
                 retry_urls = self._retry_urls(urls)
@@ -611,6 +630,8 @@ class Coordinator:
         t_pull0 = time.time()
         for w, (url, tid) in enumerate(produced[fragments[-1].id]):
             try:
+                if failpoints.ARMED:
+                    failpoints.hit("task.result")
                 cols = WorkerClient(url, timeout).fetch_results(tid, types)
             except Exception:  # noqa: BLE001
                 # the producer died between finishing and the result

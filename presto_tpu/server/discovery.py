@@ -21,6 +21,8 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
+from .. import failpoints
+
 __all__ = ["DiscoveryServer", "Announcer", "alive_nodes",
            "HeartbeatProber"]
 
@@ -139,6 +141,11 @@ class Announcer:
                 **bearer_headers(self._auth)}
 
     def announce_once(self):
+        if failpoints.ARMED:
+            # an injected error makes THIS announcement fail the way a
+            # discovery outage would; the loop's suppressed-error
+            # accounting is the path under test
+            failpoints.hit("discovery.announce")
         req = urllib.request.Request(
             f"{self.discovery_url}/v1/announcement/{self.node_id}",
             data=self.body, method="PUT", headers=self._headers())
@@ -209,6 +216,10 @@ class HeartbeatProber:
     def _probe(self, url: str) -> bool:
         from .auth import bearer_headers
         try:
+            if failpoints.ARMED:
+                # inside the try: an injected failure counts into the
+                # decayed failure rate exactly like a real probe miss
+                failpoints.hit("discovery.probe")
             req = urllib.request.Request(
                 f"{url.rstrip('/')}/v1/info",
                 headers=bearer_headers(self._auth))

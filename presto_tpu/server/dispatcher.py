@@ -21,6 +21,7 @@ import time
 import uuid
 from typing import Callable, Dict, List, Optional
 
+from .. import failpoints
 from .events import event_listeners
 
 __all__ = ["ResourceGroup", "Dispatcher", "QueryRejected"]
@@ -287,6 +288,11 @@ class Dispatcher:
         events = event_listeners()
         events.query_created(query_id, query_text,
                              session.get("user", ""))
+        if failpoints.ARMED:
+            # delay = a stalled dispatch ahead of the resource-group
+            # queue, error = failed admission (the query fails cleanly
+            # before holding any slot)
+            failpoints.hit("dispatcher.admit")
         mem = 0
         if "query_max_memory" in session:
             from ..utils.config import parse_size

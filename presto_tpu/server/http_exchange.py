@@ -15,6 +15,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from .. import failpoints
 from .. import types as T
 from ..block import Batch, batch_from_numpy
 from ..serde import PageCodec
@@ -70,6 +71,10 @@ def fetch_remote_batch(sources: Sequence[str], task_ids: Sequence[str],
 
     from .metrics import observe_histogram
     from .tracing import current_context
+    if failpoints.ARMED:
+        # an injected error here is a consumer-side upstream failure:
+        # the task fails and the coordinator's resubmit path takes over
+        failpoints.hit("exchange.fetch")
     t_fetch0 = time.time()
     all_cols: List[List[np.ndarray]] = [[] for _ in types]
     all_nulls: List[List[np.ndarray]] = [[] for _ in types]

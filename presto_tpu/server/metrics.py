@@ -45,7 +45,7 @@ __all__ = ["MetricFamily", "Histogram", "DEFAULT_BUCKETS",
            "record_suppressed", "suppressed_error_families",
            "suppressed_error_totals", "tracing_families",
            "flight_recorder_families", "kernel_audit_families",
-           "CONTENT_TYPE"]
+           "failpoint_families", "CONTENT_TYPE"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 # exemplars are legal only in the OpenMetrics exposition (the classic
@@ -520,6 +520,29 @@ def kernel_audit_families() -> List[MetricFamily]:
         MetricFamily("presto_tpu_kernel_audit_kernels_total", "counter",
                      "staged kernels traced and audited (memo hits "
                      "excluded)").add(t["kernels"]),
+    ]
+
+
+def failpoint_families() -> List[MetricFamily]:
+    """Fault-injection accounting, exported by BOTH tiers: lifetime
+    fired-fault counts per (site, action) plus the currently-armed
+    gauge. The chaos harness's third invariant -- every injected fault
+    accounted for -- audits against exactly these samples."""
+    from ..failpoints import armed_count, failpoint_totals
+    fam = MetricFamily(
+        "presto_tpu_failpoint_hits_total", "counter",
+        "fault injections fired, by (site, action) "
+        "(failpoints subsystem; see DESIGN.md 'Fault injection')")
+    totals = failpoint_totals()
+    for (site, action), n in sorted(totals.items()):
+        fam.add(n, {"site": site, "action": action})
+    if not totals:  # stable scrape shape from the first request on
+        fam.add(0, {"site": "none", "action": "none"})
+    return [
+        fam,
+        MetricFamily("presto_tpu_failpoints_armed", "gauge",
+                     "failpoint sites currently armed").add(
+                         armed_count()),
     ]
 
 

@@ -32,6 +32,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import failpoints
 from .. import types as T
 from ..transaction import TransactionManager
 from .dispatcher import Dispatcher, QueryRejected
@@ -409,12 +410,17 @@ class StatementServer:
             if m:
                 self._run_session_statement(q, m.group(1).lower())
                 return
-            self.dispatcher.submit(
-                lambda qid: self._run_engine(q),
-                session={"user": q.user, **q.session_values},
-                query_text=q.text, query_id=q.id,
-                queue_timeout=float(q.session_values.get(
-                    "queue_timeout_s", 60.0)))
+            # per-query failpoint schedule (`failpoints` session
+            # property): armed for this query's dispatch + execution
+            # scope, restored afterwards
+            with failpoints.session_scope(
+                    q.session_values.get("failpoints")):
+                self.dispatcher.submit(
+                    lambda qid: self._run_engine(q),
+                    session={"user": q.user, **q.session_values},
+                    query_text=q.text, query_id=q.id,
+                    queue_timeout=float(q.session_values.get(
+                        "queue_timeout_s", 60.0)))
         except QueryRejected as e:
             q.machine.to_failed(_error_doc("QUERY_QUEUE_FULL", str(e)))
         except Exception as e:  # noqa: BLE001
@@ -423,6 +429,10 @@ class StatementServer:
             q.machine.to_failed(_error_doc(name, f"{type(e).__name__}: {e}"))
 
     def _run_engine(self, q: _Query):
+        if failpoints.ARMED:
+            # hang = a wedged statement tier (the client poll deadline's
+            # test surface); error = a query failed before planning
+            failpoints.hit("statement.execute")
         q.machine.to_planning()
         m = re.match(r"\s*explain(\s+analyze)?\b", q.text, re.IGNORECASE)
         if m:
@@ -682,7 +692,8 @@ class StatementServer:
                "largest per-query peak memory seen").add(
                    totals["peak_memory_bytes"]),
         ]
-        from .metrics import (flight_recorder_families,
+        from .metrics import (failpoint_families,
+                              flight_recorder_families,
                               histogram_families, kernel_audit_families,
                               narrowing_families, plan_cache_families,
                               suppressed_error_families,
@@ -694,6 +705,7 @@ class StatementServer:
         fams.extend(tracing_families())
         fams.extend(flight_recorder_families())
         fams.extend(kernel_audit_families())
+        fams.extend(failpoint_families())
         fams.extend(histogram_families())
         return fams
 
@@ -773,6 +785,13 @@ def _make_handler(server: StatementServer):
             self.wfile.write(body)
 
         def do_POST(self):  # noqa: N802
+            parts = [p for p in self.path.split("/") if p]
+            if parts == ["v1", "failpoint"]:
+                length = int(self.headers.get("Content-Length", "0") or 0)
+                body = json.loads(self.rfile.read(length) or b"{}")
+                doc, code = failpoints.admin_post(body)
+                self._send(doc, code)
+                return
             if self.path.rstrip("/") != "/v1/statement":
                 self._send({"error": "not found"}, 404)
                 return
@@ -838,6 +857,10 @@ def _make_handler(server: StatementServer):
                 # continuous profiler's coordinator surface)
                 self._send(server.profile_doc())
                 return
+            if parts == ["v1", "failpoint"]:
+                # fault-injection admin surface (mirrors the worker's)
+                self._send(failpoints.admin_get_doc())
+                return
             if len(parts) == 3 and parts[:2] == ["v1", "trace"]:
                 doc = server.trace_doc(parts[2])
                 self._send(doc if doc else
@@ -886,6 +909,10 @@ def _make_handler(server: StatementServer):
 
         def do_DELETE(self):  # noqa: N802
             parts = [p for p in self.path.split("/") if p]
+            if parts[:2] == ["v1", "failpoint"] and len(parts) in (2, 3):
+                self._send(failpoints.admin_delete(
+                    parts[2] if len(parts) == 3 else None))
+                return
             if len(parts) >= 5 and parts[:2] == ["v1", "statement"]:
                 q = server.get_query(parts[3], parts[4])
                 if q is None:

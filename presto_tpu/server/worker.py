@@ -40,6 +40,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from .. import failpoints
 from ..plan import nodes as N
 from ..serde import PageCodec, serialize_page
 from ..utils.config import Session
@@ -316,7 +317,13 @@ class TaskManager:
 
     def _run(self, task: _Task, body: dict):
         try:
-            self._run_inner(task, body)
+            # per-task failpoint schedule (the `failpoints` session
+            # property): armed for this task's whole scope -- remote
+            # fetch, serde, execution -- and restored afterwards
+            spec = (body.get("session") or {}).get("failpoints") \
+                if isinstance(body.get("session"), dict) else None
+            with failpoints.session_scope(spec):
+                self._run_inner(task, body)
         finally:
             # every exit path accounts the task exactly once; the
             # mid-execution ABORT early-returns land here uncounted
@@ -381,6 +388,10 @@ class TaskManager:
                 task.state = "RUNNING"
             record_event("task_state", query_id=task.task_id,
                          state="RUNNING")
+            if failpoints.ARMED:
+                # error = crash mid-task (-> FAILED -> coordinator
+                # resubmit); hang/delay = wedged or slow worker
+                failpoints.hit("worker.run_task")
             plan = N.from_json(body["plan"])
             session = Session(body.get("session", {}))
             if not session.get("tpu_execution_enabled"):
@@ -690,6 +701,27 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _failpoint_gate(self, site: str) -> bool:
+        """Evaluate a server-side failpoint; False when this request was
+        already answered (injected error -> 500) or deliberately severed
+        (drop_conn -> socket closed without a response, the shape a
+        crashed peer leaves behind)."""
+        from .metrics import record_suppressed
+        try:
+            failpoints.hit(site)
+        except failpoints.InjectedConnDrop:
+            self.close_connection = True
+            try:
+                self.connection.close()
+            except Exception as e:  # noqa: BLE001 - already severing
+                record_suppressed("worker", "failpoint_drop", e)
+            return False
+        except Exception as e:  # noqa: BLE001 - injected server error
+            self._send_json({"error": f"failpoint {site}: "
+                                      f"{type(e).__name__}: {e}"}, 500)
+            return False
+        return True
+
     def _metric_families(self):
         """Worker-side metric families (shared emitter: metrics.py)."""
         from .metrics import (MetricFamily as MF, narrowing_families,
@@ -729,7 +761,8 @@ class _Handler(BaseHTTPRequestHandler):
                            f"lifetime {k}").add(counters[k]))
         fams.extend(plan_cache_families())
         fams.extend(narrowing_families())
-        from .metrics import (flight_recorder_families,
+        from .metrics import (failpoint_families,
+                              flight_recorder_families,
                               histogram_families, kernel_audit_families,
                               suppressed_error_families,
                               tracing_families)
@@ -737,6 +770,7 @@ class _Handler(BaseHTTPRequestHandler):
         fams.extend(tracing_families())
         fams.extend(flight_recorder_families())
         fams.extend(kernel_audit_families())
+        fams.extend(failpoint_families())
         fams.extend(histogram_families())
         return fams
 
@@ -771,6 +805,10 @@ class _Handler(BaseHTTPRequestHandler):
             # pulls + merges these cluster-wide; exec/profiler.py)
             from ..exec.profiler import profile_doc
             return self._send_json(profile_doc())
+        if parts == ["v1", "failpoint"]:
+            # live fault-injection admin surface (failpoints/): armed
+            # table + lifetime hit counters + the site catalog
+            return self._send_json(failpoints.admin_get_doc())
         if len(parts) == 3 and parts[:2] == ["v1", "trace"]:
             # worker-local slice of a distributed trace (the coordinator
             # serves the stitched whole; this answers "what did THIS
@@ -830,6 +868,9 @@ class _Handler(BaseHTTPRequestHandler):
             self.manager.acknowledge(parts[2], int(parts[5]), int(parts[4]))
             return self._send_json({"acknowledged": True})
         if len(parts) == 6 and parts[:2] == ["v1", "task"] and parts[3] == "results":
+            if failpoints.ARMED and not self._failpoint_gate(
+                    "exchange.serve"):
+                return
             task_id, buffer_id, token = parts[2], int(parts[4]), int(parts[5])
             try:
                 page, next_token, complete = self.manager.results(
@@ -854,6 +895,13 @@ class _Handler(BaseHTTPRequestHandler):
         if not self._authorized():
             return
         parts = [p for p in self.path.split("/") if p]
+        if parts == ["v1", "failpoint"]:
+            # arm a site ({site, spec}) or a whole schedule ({config})
+            # on a RUNNING worker -- the chaos driver's live flip
+            length = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            doc, code = failpoints.admin_post(body)
+            return self._send_json(doc, code)
         if len(parts) == 3 and parts[:2] == ["v1", "task"]:
             length = int(self.headers.get("Content-Length", "0"))
             body = json.loads(self.rfile.read(length) or b"{}")
@@ -926,6 +974,9 @@ class _Handler(BaseHTTPRequestHandler):
         if not self._authorized():
             return
         parts = [p for p in self.path.split("/") if p]
+        if parts[:2] == ["v1", "failpoint"] and len(parts) in (2, 3):
+            return self._send_json(failpoints.admin_delete(
+                parts[2] if len(parts) == 3 else None))
         if len(parts) == 3 and parts[:2] == ["v1", "task"]:
             self.manager.abort(parts[2])
             task = self.manager.get(parts[2])

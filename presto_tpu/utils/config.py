@@ -199,6 +199,12 @@ SESSION_PROPERTIES = (
          "K005 intermediate-footprint budget for live-query audits: "
          "kernels whose estimated peak live bytes exceed it are "
          "findings (0 = report the estimate without gating)")
+    .add("failpoints", "str", "",
+         "fault-injection schedule applied for this query's execution "
+         "scope and restored afterwards: 'site=action:trigger,...' "
+         "(presto_tpu/failpoints grammar; same as the "
+         "PRESTO_TPU_FAILPOINTS env var and POST /v1/failpoint). "
+         "Empty = no injection; the subsystem is zero-cost disarmed")
     .add("continuous_profiling", "bool", True,
          "accumulate per-kernel device-time profiles keyed by plan "
          "fingerprint (exec/profiler.py): calls, block_until_ready "

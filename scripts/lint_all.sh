@@ -1,15 +1,18 @@
 #!/usr/bin/env bash
-# The whole static-analysis gate in one invocation: tpulint (AST tier)
-# then kernaudit (IR tier over the TPC-H q1-q22 corpus), preserving the
-# repo's shared exit contract:
+# The whole pre-PR gate in one invocation: tpulint (AST tier), then
+# kernaudit (IR tier over the TPC-H q1-q22 corpus), then a seeded
+# chaos smoke (scripts/chaos.py --smoke: a small deterministic fault
+# schedule over an in-process cluster, so every recovery path runs
+# before every PR), preserving the repo's shared exit contract:
 #
-#   0  both gates clean
-#   1  findings / stale baseline entries in either gate
-#   2  internal error in either gate (bad path, failed staging, ...)
+#   0  all gates clean
+#   1  findings / stale baseline entries / invariant violations
+#   2  internal error in any gate (bad path, failed staging, ...)
 #
-# Extra arguments are forwarded to BOTH tools (e.g. --format github for
-# CI annotations, --json for machine output). Runs both even when the
-# first fails, so one CI run reports everything.
+# Extra arguments are forwarded to the two LINT tools only (e.g.
+# --format github for CI annotations, --json for machine output); the
+# chaos smoke always runs its committed seed-42 schedule. Runs every
+# gate even when an earlier one fails, so one CI run reports all.
 set -u
 
 here="$(cd "$(dirname "$0")" && pwd)"
@@ -22,5 +25,9 @@ t=$?
 python "$here/kernaudit.py" "$@"
 k=$?
 [ "$k" -gt "$rc" ] && rc=$k
+
+python "$here/chaos.py" --seed 42 --smoke
+c=$?
+[ "$c" -gt "$rc" ] && rc=$c
 
 exit "$rc"

@@ -52,6 +52,11 @@ TRACING_FAMILIES = (
     "presto_tpu_flight_recorder_dumps_total",
 )
 
+# fault-injection accounting (presto_tpu/failpoints): its own section,
+# zeros included -- during a chaos run "which faults fired in this
+# window" is the first question, and "none" is an answer too
+FAULT_FAMILY_PREFIX = "presto_tpu_failpoint"
+
 
 _LE_RE = re.compile(r'le="([^"]+)"')
 
@@ -96,7 +101,7 @@ def diff(before: dict, after: dict) -> dict:
     """Counter deltas + gauge currents between two parsed scrapes,
     histogram window quantiles, counter-monotonicity violations, plus
     the always-present tracing/flight-recorder section."""
-    out = {"counters": {}, "gauges": {}, "tracing": {},
+    out = {"counters": {}, "gauges": {}, "tracing": {}, "faults": {},
            "histograms": {}, "violations": {}}
     hist_bases = set()
     for fam, samples in after.items():
@@ -108,6 +113,7 @@ def diff(before: dict, after: dict) -> dict:
                 (base + "_bucket") in after:
             continue  # folded into the histogram section
         is_counter = fam.endswith("_total")
+        is_fault = fam.startswith(FAULT_FAMILY_PREFIX)
         for key, val in samples.items():
             label = fam + key
             if is_counter:
@@ -118,10 +124,16 @@ def diff(before: dict, after: dict) -> dict:
                     # not a negative rate -- flag it, don't diff it
                     out["violations"][label] = round(delta, 6)
                     continue
-                if fam in TRACING_FAMILIES:
+                if is_fault:
+                    out["faults"][label] = round(delta, 6)
+                elif fam in TRACING_FAMILIES:
                     out["tracing"][label] = round(delta, 6)
                 elif delta:
                     out["counters"][label] = round(delta, 6)
+            elif is_fault:
+                # the armed gauge rides the faults section too: "3
+                # faults fired, 2 still armed" reads off one block
+                out["faults"][label] = round(val, 6)
             else:
                 out["gauges"][label] = round(val, 6)
     for base in sorted(hist_bases):

@@ -1,0 +1,464 @@
+"""Failpoint subsystem: registry semantics, trigger determinism, the
+admin API round-trip, metrics/flight-recorder accounting, disarmed
+zero-cost, and the satellites that ride on it (seeded retry backoff,
+client poll deadline)."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from presto_tpu import failpoints as fp
+from presto_tpu import types as T
+from presto_tpu.failpoints import (FailpointRegistry, FailpointSpecError,
+                                   InjectedConnDrop, InjectedOOM,
+                                   parse_config)
+from presto_tpu.utils.backoff import Backoff
+
+SF = 0.01
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    fp.disarm_all()
+    yield
+    fp.disarm_all()
+
+
+# -- registry + trigger semantics ---------------------------------------
+
+def test_armed_flag_tracks_registry():
+    assert fp.ARMED is False
+    fp.arm("x.site", "delay(0)")
+    assert fp.ARMED is True
+    assert fp.disarm("x.site") is True
+    assert fp.ARMED is False
+    assert fp.disarm("x.site") is False  # idempotent
+
+
+def test_disarmed_sites_never_reach_the_registry(monkeypatch):
+    """The zero-cost contract: instrumented code checks the module
+    bool BEFORE calling hit(), so a disarmed process pays one truthy
+    test per site -- proven by making hit() explode and running an
+    instrumented path anyway."""
+    from presto_tpu.serde.pages import deserialize_page, serialize_page
+
+    def boom(*a, **k):  # pragma: no cover - must not be called
+        raise AssertionError("hit() called while disarmed")
+    monkeypatch.setattr(fp, "hit", boom)
+    cols = [(T.BIGINT, np.arange(4), np.zeros(4, bool))]
+    page = serialize_page(cols)
+    out = deserialize_page(page, [T.BIGINT])
+    assert list(out[0][0]) == [0, 1, 2, 3]
+
+
+def test_trigger_once_every_after():
+    r = FailpointRegistry()
+    r.arm("s", "delay(0):once")
+    assert [r.evaluate("s") is not None for _ in range(4)] == \
+        [True, False, False, False]
+    r.arm("s", "delay(0):every(3)")
+    assert [r.evaluate("s") is not None for _ in range(7)] == \
+        [False, False, True, False, False, True, False]
+    r.arm("s", "delay(0):after(2)")
+    assert [r.evaluate("s") is not None for _ in range(5)] == \
+        [False, False, True, True, True]
+    r.arm("s", "delay(0):always")
+    assert all(r.evaluate("s") is not None for _ in range(3))
+
+
+def test_prob_trigger_replays_bit_identically():
+    def draw(seed):
+        r = FailpointRegistry()
+        r.arm("site.a", f"delay(0):prob(0.4,{seed})")
+        return [r.evaluate("site.a") is not None for _ in range(64)]
+    a, b = draw(42), draw(42)
+    assert a == b
+    assert any(a) and not all(a)  # a real mixture, not a constant
+    assert draw(43) != a  # a different seed draws differently
+
+
+def test_prob_seed_is_per_site():
+    r = FailpointRegistry()
+    r.arm("a", "delay(0):prob(0.5,7)")
+    r.arm("b", "delay(0):prob(0.5,7)")
+    sa = [r.evaluate("a") is not None for _ in range(64)]
+    sb = [r.evaluate("b") is not None for _ in range(64)]
+    assert sa != sb  # same seed, different site -> independent stream
+
+
+def test_fire_sequence_numbers_and_lifetime_totals():
+    r = FailpointRegistry()
+    r.arm("s", "delay(0):every(2)")
+    seqs = [r.evaluate("s") for _ in range(6)]
+    assert [x[1] for x in seqs if x is not None] == [1, 2, 3]
+    assert r.totals() == {("s", "delay"): 3}
+    r.disarm("s")
+    assert r.totals() == {("s", "delay"): 3}  # totals survive disarm
+    r.arm("s", "delay(0):always")
+    assert r.evaluate("s")[1] == 1  # per-arm sequence resets
+    assert r.totals() == {("s", "delay"): 4}  # lifetime keeps counting
+
+
+def test_spec_parse_errors():
+    for bad in ("nope", "error(NoSuchExc)", "delay", "delay(5,6)",
+                "corrupt_page(1)", "error(RuntimeError):sometimes",
+                "delay(5):every", "delay(5):prob(1.5)", ""):
+        with pytest.raises((FailpointSpecError, ValueError)):
+            fp.parse_spec("s", bad)
+    with pytest.raises(FailpointSpecError):
+        parse_config("site-without-equals")
+
+
+def test_config_string_nested_commas_and_whole_string_validation():
+    entries = parse_config(
+        " a=error(OSError):once , b=delay(5):prob(0.1,7) ,")
+    assert entries == [("a", "error(OSError):once"),
+                      ("b", "delay(5):prob(0.1,7)")]
+    # a bad tail must not half-apply the schedule
+    r = FailpointRegistry()
+    with pytest.raises(FailpointSpecError):
+        r.configure("a=delay(1),b=bogus")
+    assert r.armed_count() == 0
+
+
+def test_env_config_arms_at_import(monkeypatch):
+    """PRESTO_TPU_FAILPOINTS arms the registry at package import --
+    the import-time hook (_configure_from_env) driven directly on a
+    fresh registry, with unset meaning untouched."""
+    monkeypatch.setenv(
+        "PRESTO_TPU_FAILPOINTS",
+        "worker.run_task=delay(1):once,"
+        "serde.deserialize=corrupt_page:prob(0.5,9)")
+    r = FailpointRegistry()
+    armed = fp._configure_from_env(r)
+    assert sorted(armed) == ["serde.deserialize", "worker.run_task"]
+    assert r.armed_table()["serde.deserialize"].trigger.kind == "prob"
+    monkeypatch.delenv("PRESTO_TPU_FAILPOINTS")
+    r2 = FailpointRegistry()
+    assert fp._configure_from_env(r2) == [] and r2.armed_count() == 0
+
+
+def test_scratch_registry_never_touches_the_process_armed_flag():
+    """Only the process singleton drives the module-level fast gate:
+    a scratch registry (tests, tools) arming or disarming must not
+    flip ARMED while real sites are armed on the process registry."""
+    fp.arm("real.site", "delay(0):always")
+    scratch = FailpointRegistry()
+    scratch.arm("x", "delay(0)")
+    assert fp.ARMED is True
+    scratch.disarm_all()
+    assert fp.ARMED is True  # the process schedule must keep firing
+    assert "real.site" in fp.active()
+    fp.disarm_all()
+    scratch.arm("y", "delay(0)")
+    assert fp.ARMED is False  # and a scratch arm must not fake it on
+
+
+def test_session_scope_composes_with_concurrent_arms():
+    """A scope reverts exactly the sites IT configured: another
+    query's concurrent arm made while the scope is live survives its
+    exit (per-site undo, not a whole-table swap)."""
+    with fp.session_scope("scoped.site=delay(0):once"):
+        fp.arm("other.query", "delay(0):always")  # concurrent schedule
+    assert "other.query" in fp.active()
+    assert "scoped.site" not in fp.active()
+
+
+def test_overlapping_scopes_on_same_site_cannot_leak():
+    """Two scopes arming the SAME site unwind safely in either exit
+    order: the later-live schedule survives the earlier exit, and
+    nothing outlives both scopes (no resurrected stale schedule)."""
+    a = fp.session_scope("dup.site=error(RuntimeError):always")
+    b = fp.session_scope("dup.site=delay(1):always")
+    a.__enter__()
+    b.__enter__()
+    a.__exit__(None, None, None)  # A first: B's live schedule stands
+    assert fp.active()["dup.site"]["spec"] == "delay(1):always"
+    b.__exit__(None, None, None)
+    assert "dup.site" not in fp.active() and fp.ARMED is False
+    # reverse order: inner exits -> outer's schedule restored, then gone
+    a = fp.session_scope("dup.site=error(RuntimeError):always")
+    b = fp.session_scope("dup.site=delay(1):always")
+    a.__enter__()
+    b.__enter__()
+    b.__exit__(None, None, None)
+    assert fp.active()["dup.site"]["spec"] == "error(RuntimeError):always"
+    a.__exit__(None, None, None)
+    assert "dup.site" not in fp.active() and fp.ARMED is False
+    # a manual re-arm DURING a scope is someone else's decision: stands
+    with fp.session_scope("dup.site=delay(1):once"):
+        fp.arm("dup.site", "oom:always")
+    assert fp.active()["dup.site"]["spec"] == "oom:always"
+
+
+def test_session_scope_applies_and_restores():
+    fp.arm("keep.me", "delay(0):always")
+    with fp.session_scope("temp.site=error(RuntimeError):once"):
+        assert set(fp.active()) == {"keep.me", "temp.site"}
+        with fp.session_scope(""):  # falsy = no-op
+            assert set(fp.active()) == {"keep.me", "temp.site"}
+    assert set(fp.active()) == {"keep.me"}
+    with fp.session_scope("keep.me=delay(1):once"):
+        assert fp.active()["keep.me"]["spec"] == "delay(1):once"
+    assert fp.active()["keep.me"]["spec"] == "delay(0):always"
+
+
+# -- actions ------------------------------------------------------------
+
+def test_actions_raise_sleep_and_corrupt():
+    fp.arm("s", "error(ConnectionError):always")
+    with pytest.raises(ConnectionError):
+        fp.hit("s")
+    fp.arm("s", "oom:always")
+    with pytest.raises(InjectedOOM):
+        fp.hit("s")
+    fp.arm("s", "drop_conn:always")
+    with pytest.raises(InjectedConnDrop):
+        fp.hit("s")
+    fp.arm("s", "delay(30):always")
+    t0 = time.time()
+    assert fp.hit("s", b"payload") == b"payload"
+    assert time.time() - t0 >= 0.025
+    fp.arm("s", "corrupt_page:always")
+    blob = bytes(range(64))
+    corrupted = fp.hit("s", blob)
+    assert corrupted != blob and len(corrupted) == len(blob)
+    assert fp.hit("s", corrupted) == blob  # XOR: deterministic + involutive
+    assert fp.hit("s", None) is None  # non-bytes payloads pass through
+
+
+def test_corrupt_page_fails_checksum_and_clean_reread_recovers():
+    from presto_tpu.serde.pages import deserialize_page, serialize_page
+    cols = [(T.BIGINT, np.arange(16), np.zeros(16, bool))]
+    page = serialize_page(cols)
+    fp.arm("serde.deserialize", "corrupt_page:once")
+    with pytest.raises(ValueError, match="checksum"):
+        deserialize_page(page, [T.BIGINT])
+    # `once` spent: the retry path re-reads the SAME clean bytes
+    out = deserialize_page(page, [T.BIGINT])
+    assert list(out[0][0]) == list(range(16))
+
+
+def test_memory_reserve_oom_speaks_reservation_error():
+    from presto_tpu.exec.memory import MemoryPool, MemoryReservationError
+    pool = MemoryPool(1 << 20)
+    fp.arm("memory.reserve", "oom:once")
+    with pytest.raises(MemoryReservationError, match="failpoint"):
+        pool.reserve("q1", 128)
+    pool.reserve("q1", 128)  # recovered; pool state untouched by fault
+    assert pool.reserved_bytes == 128
+
+
+def test_spill_write_and_read_failpoints(tmp_path):
+    from presto_tpu.block import batch_from_numpy
+    from presto_tpu.exec.spill import _HostRows
+    rows = _HostRows([T.BIGINT], disk_dir=str(tmp_path),
+                     disk_threshold_bytes=1)
+    batch = batch_from_numpy([T.BIGINT], [np.arange(8)],
+                             [np.zeros(8, bool)])
+    fp.arm("spill.write", "error(OSError):once")
+    with pytest.raises(OSError, match="failpoint"):
+        rows.append(batch, None)  # flush (past threshold) is injected
+    rows.append(batch, None)  # retry flushes clean
+    fp.arm("spill.read", "error(OSError):once")
+    with pytest.raises(OSError, match="failpoint"):
+        rows.columns()
+    cols, _nulls = rows.columns()  # clean re-read
+    assert len(cols[0]) >= 8
+    rows.close()
+
+
+# -- accounting: flight recorder + metrics ------------------------------
+
+def test_fired_fault_lands_in_flight_ring_with_trace_link():
+    from presto_tpu.server.flight_recorder import get_flight_recorder
+    from presto_tpu.server.tracing import TraceContext, trace_context
+    fp.arm("s.traced", "delay(0):always")
+    with trace_context(TraceContext("trace-abc", "0123456789abcdef")):
+        fp.hit("s.traced")
+    evts = [e for e in get_flight_recorder().events(kind="failpoint")
+            if e.get("site") == "s.traced"]
+    assert evts and evts[-1]["action"] == "delay"
+    assert evts[-1]["seq"] == 1
+    assert evts[-1]["trace"] == "trace-abc"
+
+
+def test_metrics_family_shapes():
+    from presto_tpu.server.metrics import failpoint_families
+    # totals are process-lifetime; capture a baseline then fire
+    fp.arm("m.site", "delay(0):always")
+    before = fp.failpoint_totals().get(("m.site", "delay"), 0)
+    fp.hit("m.site")
+    fp.hit("m.site")
+    fams = {f.name: f for f in failpoint_families()}
+    hits = fams["presto_tpu_failpoint_hits_total"]
+    assert hits.mtype == "counter"
+    by_label = {tuple(sorted(lab.items())): v for lab, v in hits.samples}
+    key = (("action", "delay"), ("site", "m.site"))
+    assert by_label[key] == before + 2
+    armed = fams["presto_tpu_failpoints_armed"]
+    assert armed.samples[0][1] == 1
+
+
+# -- admin API + live tiers ---------------------------------------------
+
+def _http(method, url, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"} if data else {})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture(scope="module")
+def worker():
+    from presto_tpu.server import TpuWorkerServer
+    w = TpuWorkerServer(sf=SF).start()
+    yield w
+    w.stop()
+
+
+@pytest.fixture(scope="module")
+def statement_server():
+    from presto_tpu.server.statement import StatementServer
+    s = StatementServer(sf=SF).start()
+    yield s
+    s.stop()
+
+
+def test_admin_api_round_trip_both_tiers(worker, statement_server):
+    for base in (worker.url, statement_server.url):
+        code, doc = _http("POST", f"{base}/v1/failpoint",
+                          {"site": "adm.site",
+                           "spec": "error(RuntimeError):every(5)"})
+        assert code == 200 and "adm.site" in doc["active"]
+        code, doc = _http("GET", f"{base}/v1/failpoint")
+        assert code == 200
+        assert doc["armed"]["adm.site"]["trigger"] == "every(5)"
+        assert "exchange.fetch" in doc["sites"]  # catalog served
+        code, doc = _http("DELETE", f"{base}/v1/failpoint/adm.site")
+        assert code == 200 and doc["disarmed"] == ["adm.site"]
+        # config form + delete-all
+        code, doc = _http("POST", f"{base}/v1/failpoint",
+                          {"config": "a.b=delay(1):once,c.d=oom"})
+        assert code == 200 and sorted(doc["armed"]) == ["a.b", "c.d"]
+        code, doc = _http("DELETE", f"{base}/v1/failpoint")
+        assert code == 200 and sorted(doc["disarmed"]) == ["a.b", "c.d"]
+        assert fp.armed_count() == 0
+
+
+def test_admin_api_rejects_bad_spec(worker):
+    code, doc = _http("POST", f"{worker.url}/v1/failpoint",
+                      {"site": "s", "spec": "explode(9)"})
+    assert code == 400 and "unknown action" in doc["error"]
+    code, doc = _http("POST", f"{worker.url}/v1/failpoint", {"nope": 1})
+    assert code == 400
+
+
+def test_both_tiers_export_hit_counter(worker, statement_server):
+    from presto_tpu.server.metrics import parse_prometheus
+    for base in (worker.url, statement_server.url):
+        with urllib.request.urlopen(f"{base}/v1/metrics",
+                                    timeout=10) as r:
+            parsed = parse_prometheus(r.read().decode())
+        assert "presto_tpu_failpoint_hits_total" in parsed
+        assert "presto_tpu_failpoints_armed" in parsed
+
+
+def test_worker_task_session_property_schedule(worker):
+    """The `failpoints` session property arms a per-task schedule and
+    restores the registry afterwards."""
+    from presto_tpu.server import WorkerClient
+    from presto_tpu.sql import plan_sql
+    client = WorkerClient(worker.url)
+    client.submit("fp-sess-1", plan_sql("SELECT 1"), sf=SF,
+                  session={"failpoints":
+                           "worker.run_task=error(RuntimeError):always"})
+    info = client.wait("fp-sess-1", timeout=30)
+    assert info["state"] == "FAILED"
+    assert "failpoint worker.run_task" in info["error"]
+    # scope restored after the task (the task thread flips FAILED a
+    # beat before it exits the scope: poll briefly)
+    deadline = time.time() + 2.0
+    while fp.ARMED and time.time() < deadline:
+        time.sleep(0.02)
+    assert fp.ARMED is False
+    client.abort("fp-sess-1")
+
+
+def test_statement_session_property_schedule(statement_server):
+    from presto_tpu.client import QueryError, execute
+    with pytest.raises(QueryError, match="failpoint statement.execute"):
+        execute(statement_server.url, "SELECT 1",
+                session={"failpoints":
+                         "statement.execute=error(RuntimeError):once"},
+                deadline_s=60)
+    assert fp.ARMED is False
+    # and the tier recovers immediately
+    c = execute(statement_server.url, "SELECT 1", deadline_s=60)
+    assert c.data == [[1]]
+
+
+def test_client_poll_deadline_surfaces_clean_timeout(statement_server):
+    """Satellite pin: a hung statement tier (hang failpoint) surfaces
+    a clean CLIENT_POLL_TIMEOUT instead of blocking the client."""
+    from presto_tpu.client import QueryError, execute
+    fp.arm("statement.execute", "hang(1400):once")
+    t0 = time.time()
+    with pytest.raises(QueryError) as ei:
+        execute(statement_server.url, "SELECT 1", deadline_s=0.5)
+    assert ei.value.error_name == "CLIENT_POLL_TIMEOUT"
+    assert time.time() - t0 < 1.3  # gave up well before the hang ended
+    time.sleep(1.2)  # drain the hung engine thread past its stall
+
+
+def test_dispatcher_admit_failpoint_fails_query_cleanly(
+        statement_server):
+    from presto_tpu.client import QueryError, execute
+    fp.arm("dispatcher.admit", "error(RuntimeError):once")
+    with pytest.raises(QueryError, match="failpoint dispatcher.admit"):
+        execute(statement_server.url, "SELECT 1", deadline_s=60)
+    c = execute(statement_server.url, "SELECT 1", deadline_s=60)
+    assert c.data == [[1]]
+
+
+def test_client_request_drop_conn_retries_with_backoff(worker):
+    """drop_conn on the client hop = an injected stale keep-alive
+    socket: the request must succeed on the fresh-connection retry and
+    leave an http_retry event on the flight timeline."""
+    from presto_tpu.server import WorkerClient
+    from presto_tpu.server.flight_recorder import get_flight_recorder
+    n0 = len(get_flight_recorder().events(kind="http_retry"))
+    fp.arm("client.request", "drop_conn:once")
+    info = WorkerClient(worker.url).info()
+    assert info["state"] == "ACTIVE"
+    assert fp.active()["client.request"]["fires"] == 1
+    assert len(get_flight_recorder().events(kind="http_retry")) > n0
+
+
+# -- backoff satellite --------------------------------------------------
+
+def test_backoff_deterministic_bounded_and_growing():
+    a = Backoff(base_s=0.05, cap_s=1.0, factor=2.0, jitter=0.5, seed="t")
+    b = Backoff(base_s=0.05, cap_s=1.0, factor=2.0, jitter=0.5, seed="t")
+    da = [a.next_delay() for _ in range(10)]
+    db = [b.next_delay() for _ in range(10)]
+    assert da == db  # seeded: bit-identical sequences
+    assert all(0.0 <= d <= 1.0 * 1.5 for d in da)  # cap * (1+jitter)
+    # raw (pre-jitter) schedule grows geometrically to the cap
+    raw = [min(1.0, 0.05 * 2.0 ** k) for k in range(10)]
+    assert all(abs(d - r) <= 0.5 * r + 1e-9 for d, r in zip(da, raw))
+    assert Backoff(seed="other").next_delay() != da[0]
+
+
+def test_backoff_preview_does_not_consume():
+    b = Backoff(seed=1)
+    peek = b.preview(3)
+    assert [b.next_delay() for _ in range(3)] == peek

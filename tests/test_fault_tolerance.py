@@ -1,12 +1,15 @@
-"""Mid-query fault tolerance: heartbeat prober + task retry on
-surviving workers (HeartbeatFailureDetector.java:76 + recoverable
-deterministic splits)."""
+"""Mid-query fault tolerance, driven by DETERMINISTIC failpoint
+schedules (presto_tpu/failpoints): crash / slow / hung / submit-failure
+workers each exercise a specific recovery path on demand, plus one real
+thread-kill E2E kept as the non-simulated anchor (a killed server is
+the one failure mode no injected exception fully imitates)."""
 
 import threading
 import time
 
 import pytest
 
+from presto_tpu import failpoints as fp
 from presto_tpu.exec import run_query
 from presto_tpu.plan.fragment import distribute_simple_agg
 from presto_tpu.server import Coordinator, TpuWorkerServer
@@ -14,7 +17,44 @@ from presto_tpu.server.discovery import HeartbeatProber
 from presto_tpu.sql import plan_sql
 
 SF = 0.01
+SQL = ("SELECT custkey, sum(totalprice) AS s, count(*) AS c "
+       "FROM orders GROUP BY custkey")
 
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    fp.disarm_all()
+    yield
+    fp.disarm_all()
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    local = run_query(plan_sql(SQL, max_groups=1 << 14), sf=SF)
+    return {r[0]: (int(r[1]), int(r[2])) for r in local.rows()}
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    workers = [TpuWorkerServer(sf=SF).start() for _ in range(2)]
+    yield workers
+    for w in workers:
+        try:
+            w.stop()
+        except Exception:  # noqa: BLE001 - already stopped
+            pass
+
+
+def _run_distributed(cluster, timeout=60.0):
+    coord = Coordinator([f"http://127.0.0.1:{w.port}" for w in cluster])
+    dist = distribute_simple_agg(plan_sql(SQL, max_groups=1 << 14))
+    cols, _ = coord.execute(dist, sf=SF, timeout=timeout)
+    return {int(cols[0][0][i]): (int(cols[1][0][i]),
+                                 int(cols[2][0][i]))
+            for i in range(len(cols[0][0]))}
+
+
+# -- prober (active failure detection) ----------------------------------
 
 def test_prober_marks_dead_worker_and_recovers_live_one():
     w = TpuWorkerServer(sf=SF).start()
@@ -41,12 +81,80 @@ def test_coordinator_excludes_prober_failed_workers():
         w.stop()
 
 
+def test_prober_failpoint_schedule_is_deterministic():
+    """An injected probe failure feeds the decayed failure rate exactly
+    like a real one -- and `once` means exactly one probe cycle pays."""
+    w = TpuWorkerServer(sf=SF).start()
+    try:
+        urls = [f"http://127.0.0.1:{w.port}"]
+        p = HeartbeatProber(lambda: urls, decay=0.0)
+        fp.arm("discovery.probe", "error(OSError):once")
+        p.probe_all_once()
+        assert p.healthy() == []  # the injected miss failed the node
+        p.probe_all_once()        # fault spent: full recovery
+        assert p.healthy() == [urls[0]]
+        assert fp.active()["discovery.probe"]["fires"] == 1
+    finally:
+        w.stop()
+
+
+# -- deterministic crash / slow / hung schedules ------------------------
+
+def test_worker_crash_schedule_retries_to_completion(cluster, oracle):
+    """error(RuntimeError):once at worker.run_task = one task crashes
+    mid-query; the coordinator must resubmit it and the query must
+    match the oracle -- every run, no thread-timing roulette."""
+    fp.arm("worker.run_task", "error(RuntimeError):once")
+    assert _run_distributed(cluster) == oracle
+    assert fp.active()["worker.run_task"]["fires"] == 1
+    # the retry is on the flight-recorder timeline for post-mortems
+    from presto_tpu.server.flight_recorder import get_flight_recorder
+    kinds = {e["kind"] for e in get_flight_recorder().events()}
+    assert "failpoint" in kinds and "retry_task" in kinds
+
+
+def test_slow_worker_schedule_completes_without_retry(cluster, oracle):
+    """delay(300):once = a slow-but-healthy task. It must complete on
+    the FIRST attempt (no spurious retry storm against slowness)."""
+    from presto_tpu.server.flight_recorder import get_flight_recorder
+    t0_us = int(time.time() * 1e6)
+    fp.arm("worker.run_task", "delay(300):once")
+    assert _run_distributed(cluster) == oracle
+    assert fp.active()["worker.run_task"]["fires"] == 1
+    retries = [e for e in get_flight_recorder().events(kind="retry_task")
+               if e["tsUs"] >= t0_us]
+    assert retries == []
+
+
+def test_hung_worker_schedule_fails_cleanly_not_forever(cluster):
+    """hang(2000):always with a short coordinator timeout: every
+    attempt wedges, so the query must surface a clean error within
+    bounded time -- never a hang."""
+    fp.arm("worker.run_task", "hang(2000):always")
+    t0 = time.time()
+    with pytest.raises((RuntimeError, TimeoutError)):
+        _run_distributed(cluster, timeout=0.8)
+    # len(urls)+1 attempts, each bounded by the 1s timeout, plus
+    # seeded backoff between them: well under a wedged-forever wait
+    assert time.time() - t0 < 20.0
+    assert fp.active()["worker.run_task"]["fires"] >= 1
+
+
+def test_submit_failover_schedule(cluster, oracle):
+    """error(ConnectionError):once at task.submit = the first
+    submission hop dies (worker unreachable at submit time); the
+    coordinator fails over to the next worker and completes."""
+    fp.arm("task.submit", "error(ConnectionError):once")
+    assert _run_distributed(cluster) == oracle
+    assert fp.active()["task.submit"]["fires"] == 1
+
+
+# -- the real thing: one non-simulated kill E2E -------------------------
+
 def test_kill_worker_mid_query_completes():
     """kill a worker while its tasks run; the query must complete
     correctly on the survivor (the round-3 verdict's done-criterion)."""
-    sqltext = ("SELECT custkey, sum(totalprice) AS s, count(*) AS c "
-               "FROM orders GROUP BY custkey")
-    local = run_query(plan_sql(sqltext, max_groups=1 << 14), sf=SF)
+    local = run_query(plan_sql(SQL, max_groups=1 << 14), sf=SF)
     want = {r[0]: (int(r[1]), int(r[2])) for r in local.rows()}
 
     wa = TpuWorkerServer(sf=SF).start()
@@ -55,7 +163,7 @@ def test_kill_worker_mid_query_completes():
     killer = threading.Timer(0.15, wa.stop)
     try:
         coord = Coordinator(urls)
-        dist = distribute_simple_agg(plan_sql(sqltext, max_groups=1 << 14))
+        dist = distribute_simple_agg(plan_sql(SQL, max_groups=1 << 14))
         killer.start()
         cols, _ = coord.execute(dist, sf=SF, timeout=60.0)
         got = {int(cols[0][0][i]): (int(cols[1][0][i]),
