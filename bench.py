@@ -299,6 +299,21 @@ WHERE l.shipdate >= date '1995-09-01' AND l.shipdate < date '1995-10-01'
 """
 
 
+def _bench_meta(platform):
+    """Measurement-environment provenance recorded in every artifact:
+    jax version, platform, the BENCH_SEED that pins data generation,
+    and a run timestamp PASSED IN via BENCH_RUN_TS (the caller's clock
+    -- scripts/perfgate.py must stay a pure function of its inputs, so
+    nothing downstream reads one). The gate keys baselines on
+    (metric, platform); the rest is for a human triaging WHY a sample
+    moved (jax upgrade, reseeded data), not part of the key."""
+    import jax
+    return {"jax_version": getattr(jax, "__version__", "unknown"),
+            "platform": platform,
+            "seed": int(os.environ.get("BENCH_SEED", "0")),
+            "timestamp": os.environ.get("BENCH_RUN_TS", "")}
+
+
 def _latency_tail(run_once, runs=5):
     """p50/p99 per-query wall over `runs` invocations of `run_once` --
     the tail behavior the single-number BENCH headline has never
@@ -378,7 +393,8 @@ def _bench_sql_join(name, sql_text, sf, platform, **hints):
                    "latency_warm": latency,
                    "top_kernels": _top_kernel_shares(),
                    "platform": platform,
-                   "scoring": not platform.startswith("cpu")}}))
+                   "scoring": not platform.startswith("cpu"),
+                   "meta": _bench_meta(platform)}}))
 
 
 def _bench_large_g(platform, iters):
@@ -542,6 +558,7 @@ def main():
             # narrow-width execution A/B (PRESTO_TPU_NARROW): staged_mb
             # above reflects the narrowed lanes when on
             "narrow_width_execution": narrow_on,
+            "meta": _bench_meta(platform),
         },
     }
     print(json.dumps(result))
@@ -639,7 +656,8 @@ def _bench_q6(sf, iters, platform):
                    "timing_fallback": _TIMING_FALLBACK,
                    "platform": platform,
                    "scoring": not platform.startswith("cpu"),
-                   "iters": iters}}))
+                   "iters": iters,
+                   "meta": _bench_meta(platform)}}))
 
 
 if __name__ == "__main__":

@@ -104,6 +104,19 @@ SCHEMA = {
     "session_properties": {"name": _V, "default_value": _V, "type": _V,
                            "description": _V},
     "functions": {"function_name": _V, "kind": _V},
+    # completed-query archive (server/history.py): one row per retained
+    # record, newest first -- the perf sentinel's raw material as SQL
+    "query_history": {"query_id": _V, "state": _V, "user": _V,
+                      "query": _V, "fingerprint": _V, "trace_id": _V,
+                      "ts_us": T.BIGINT, "wall_us": T.BIGINT,
+                      "compile_us": T.BIGINT, "execute_us": T.BIGINT,
+                      "staged_bytes": T.BIGINT,
+                      "narrowed_bytes_saved": T.BIGINT,
+                      "retraces": T.BIGINT, "spill_bytes": T.BIGINT,
+                      "peak_memory_bytes": T.BIGINT,
+                      "output_rows": T.BIGINT,
+                      "failpoint_hits": T.BIGINT,
+                      "regressions": _V},
 }
 
 
@@ -199,6 +212,27 @@ def _rows_of(table: str) -> List[tuple]:
         from ..exec.plan_cache import cache_stats
         st = cache_stats()
         return [(st["entries"], st["hits"], st["misses"])]
+    if table == "query_history":
+        from ..server.history import get_history_archive
+        out = []
+        for r in get_history_archive().records():
+            st = r.get("stats") or {}
+            out.append((r.get("queryId", ""), r.get("state", ""),
+                        r.get("user", ""), r.get("query", ""),
+                        r.get("fingerprint", ""), r.get("traceId", ""),
+                        int(r.get("tsUs", 0)),
+                        int(st.get("wall_us", 0)),
+                        int(st.get("compile_us", 0)),
+                        int(st.get("execute_us", 0)),
+                        int(st.get("staged_bytes", 0)),
+                        int(st.get("narrowed_bytes_saved", 0)),
+                        int(st.get("retraces", 0)),
+                        int(st.get("spill_bytes", 0)),
+                        int(st.get("peak_memory_bytes", 0)),
+                        int(st.get("output_rows", 0)),
+                        int(r.get("failpointHits", 0)),
+                        ",".join(r.get("regressions") or ())))
+        return out
     if table == "kernels":
         from ..exec.profiler import profile_snapshot
         return [(p["fingerprint"], p["label"], p["tables"],

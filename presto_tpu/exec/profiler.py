@@ -51,7 +51,8 @@ from typing import Dict, List, Optional
 
 __all__ = ["profiling_enabled", "record_call", "note_footprint",
            "profile_snapshot", "profile_doc", "profile_for_query",
-           "merge_kernel_rows", "cluster_profile_doc",
+           "query_fingerprints", "merge_kernel_rows",
+           "cluster_profile_doc",
            "clear_profiler", "set_capacity", "plan_label", "plan_tables",
            "PROFILE_ENV"]
 
@@ -240,6 +241,14 @@ def profile_for_query(query_id: str, top: Optional[int] = None
     return [p.to_json() for p in rows]
 
 
+def query_fingerprints(query_id: str) -> List[str]:
+    """The plan-cache fingerprints a query id dispatched, in execution
+    order (the query-history archive's plan identity; a write query's
+    inner SELECT contributes its own fingerprint too)."""
+    with _LOCK:
+        return list(_QUERY_KERNELS.get(query_id, ()))
+
+
 def profile_doc() -> dict:
     """This process's /v1/profile slice."""
     return {"processId": _PROCESS_ID, "kernels": profile_snapshot()}
@@ -270,22 +279,15 @@ def merge_kernel_rows(docs: List[dict]) -> List[dict]:
 
 def cluster_profile_doc(worker_urls=(), timeout: float = 3.0) -> dict:
     """The coordinator-side merge: this process's slice plus every
-    reachable worker's ``GET /v1/profile``, folded by fingerprint
-    (same shape as the QueryStats/span stitch: best-effort, an
-    unreachable worker is skipped and counted, never an error).
-    Pulls ride WorkerClient so the internal bearer/TLS/trace headers
-    every other cross-node hop carries are attached here too."""
-    from ..server.client import WorkerClient
-    docs = [profile_doc()]
-    workers_seen = 0
-    for url in worker_urls or ():
-        try:
-            docs.append(WorkerClient(str(url), timeout).profile())
-            workers_seen += 1
-        except Exception as e:  # noqa: BLE001 - a dead worker must not
-            # fail the profile pull; the gap is counted on /v1/metrics
-            from ..server.metrics import record_suppressed
-            record_suppressed("profiler", "cluster_pull", e)
+    reachable worker's ``GET /v1/profile``, folded by fingerprint.
+    Pulls ride the shared best-effort helper
+    (server/client.pull_worker_docs) so the internal bearer/TLS/trace
+    headers -- and the skip-and-count-dead-workers contract -- stay
+    identical to the history merge's."""
+    from ..server.client import pull_worker_docs
+    pulled, workers_seen = pull_worker_docs(
+        worker_urls, timeout, lambda c: c.profile(), "profiler")
+    docs = [profile_doc(), *pulled]
     return {"processId": _PROCESS_ID, "cluster": True,
             "workersPulled": workers_seen,
             "kernels": merge_kernel_rows(docs)}

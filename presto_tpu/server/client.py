@@ -138,6 +138,13 @@ class WorkerClient:
         data, _ = self._request("GET", "/v1/profile")
         return json.loads(data)
 
+    def history(self) -> dict:
+        """The worker's completed-query history slice (GET /v1/history),
+        pulled over the same authenticated transport as profile() so
+        the statement tier's cluster merge works on secured clusters."""
+        data, _ = self._request("GET", "/v1/history")
+        return json.loads(data)
+
     def submit(self, task_id: str, plan: N.PlanNode, sf: float = 0.01,
                session: Optional[dict] = None) -> dict:
         return self.submit_body(task_id, {"plan": N.to_json(plan), "sf": sf,
@@ -207,3 +214,23 @@ class WorkerClient:
     def abort(self, task_id: str) -> dict:
         data, _ = self._request("DELETE", f"/v1/task/{task_id}")
         return json.loads(data)
+
+
+def pull_worker_docs(worker_urls, timeout: float, fetch,
+                     component: str, site: str = "cluster_pull"):
+    """The one best-effort cluster pull both merged surfaces
+    (/v1/profile, /v1/history) share: fetch one document per reachable
+    worker through an authenticated WorkerClient, skip-and-count the
+    unreachable ones (never an error). ``fetch(client) -> dict``;
+    returns (docs, workers_pulled)."""
+    docs = []
+    pulled = 0
+    for url in worker_urls or ():
+        try:
+            docs.append(fetch(WorkerClient(str(url), timeout)))
+            pulled += 1
+        except Exception as e:  # noqa: BLE001 - a dead worker must not
+            # fail the cluster view; the gap is counted on /v1/metrics
+            from .metrics import record_suppressed
+            record_suppressed(component, site, e)
+    return docs, pulled

@@ -36,6 +36,7 @@ __all__ = ["FlightRecorder", "get_flight_recorder", "set_flight_recorder",
 _COUNTERS_LOCK = threading.Lock()
 _EVENTS_TOTAL = {"count": 0}
 _DUMPS_TOTAL: Dict[str, int] = {}  # reason -> count
+_EVICTED_TOTAL = {"count": 0}      # dump files deleted by retention
 
 # _dumped marker while the JSONL write is in flight ('' = capped/failed)
 _PENDING = "<pending>"
@@ -44,7 +45,8 @@ _PENDING = "<pending>"
 def flight_recorder_totals() -> Dict[str, object]:
     with _COUNTERS_LOCK:
         return {"events": _EVENTS_TOTAL["count"],
-                "dumps": dict(_DUMPS_TOTAL)}
+                "dumps": dict(_DUMPS_TOTAL),
+                "evicted": _EVICTED_TOTAL["count"]}
 
 
 class FlightRecorder:
@@ -60,7 +62,8 @@ class FlightRecorder:
 
     def __init__(self, capacity: int = 4096,
                  dump_dir: Optional[str] = None,
-                 max_dump_files: int = 256):
+                 max_dump_files: int = 256,
+                 max_dump_dir_files: Optional[int] = None):
         import tempfile
         self.capacity = int(capacity)
         self._ring: "collections.deque[dict]" = \
@@ -69,6 +72,19 @@ class FlightRecorder:
             "PRESTO_TPU_FLIGHT_DIR") or os.path.join(
                 tempfile.gettempdir(), "presto_tpu_flight")
         self.max_dump_files = max_dump_files
+        # ON-DISK retention: the dump directory previously grew without
+        # bound across process restarts (the in-memory _dumped cap only
+        # limits one process's writes). Beyond this many *.jsonl files
+        # the OLDEST are deleted after each new dump lands, counted
+        # presto_tpu_flight_dumps_evicted_total. Env override
+        # PRESTO_TPU_FLIGHT_MAX_DUMPS; <= 0 disables eviction.
+        if max_dump_dir_files is None:
+            try:
+                max_dump_dir_files = int(os.environ.get(
+                    "PRESTO_TPU_FLIGHT_MAX_DUMPS", "256"))
+            except ValueError:
+                max_dump_dir_files = 256
+        self.max_dump_dir_files = int(max_dump_dir_files)
         self._dumped: Dict[str, str] = {}  # key -> dump path ('' = capped)
         self._lock = threading.Lock()
 
@@ -160,7 +176,40 @@ class FlightRecorder:
             return None
         with self._lock:
             self._dumped[key] = path
+        self._evict_dumps(keep=path)
         return path
+
+    def _evict_dumps(self, keep: Optional[str] = None) -> int:
+        """Enforce the on-disk retention cap: delete *.jsonl dump files
+        oldest-first (mtime, then name for determinism) beyond
+        ``max_dump_dir_files``, never the dump just written. Counted;
+        best-effort (a dir race is not an error). Returns the number
+        evicted."""
+        if self.max_dump_dir_files <= 0:
+            return 0
+        try:
+            names = [os.path.join(self.dump_dir, n)
+                     for n in os.listdir(self.dump_dir)
+                     if n.endswith(".jsonl")]
+            names.sort(key=lambda p: (os.path.getmtime(p), p))
+        except OSError:
+            return 0
+        excess = len(names) - self.max_dump_dir_files
+        evicted = 0
+        for path in names:
+            if evicted >= excess:
+                break
+            if keep is not None and path == keep:
+                continue
+            try:
+                os.remove(path)
+                evicted += 1
+            except OSError:
+                continue  # raced another evictor / already gone
+        if evicted:
+            with _COUNTERS_LOCK:
+                _EVICTED_TOTAL["count"] += evicted
+        return evicted
 
     @staticmethod
     def _profile_of(key: str) -> List[dict]:

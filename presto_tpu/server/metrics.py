@@ -45,7 +45,8 @@ __all__ = ["MetricFamily", "Histogram", "DEFAULT_BUCKETS",
            "record_suppressed", "suppressed_error_families",
            "suppressed_error_totals", "tracing_families",
            "flight_recorder_families", "kernel_audit_families",
-           "failpoint_families", "CONTENT_TYPE"]
+           "failpoint_families", "query_history_families",
+           "CONTENT_TYPE"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 # exemplars are legal only in the OpenMetrics exposition (the classic
@@ -481,21 +482,58 @@ def tracing_families() -> List[MetricFamily]:
 
 
 def flight_recorder_families() -> List[MetricFamily]:
-    """Flight-recorder health: events recorded and auto-dumps written,
-    labelled by trigger reason (failed | slow)."""
+    """Flight-recorder health: events recorded, auto-dumps written
+    (labelled by trigger reason: failed | slow | perf_regression), and
+    dump files evicted by the on-disk retention cap."""
     from .flight_recorder import flight_recorder_totals
     t = flight_recorder_totals()
     fam_d = MetricFamily(
         "presto_tpu_flight_recorder_dumps_total", "counter",
-        "automatic slow/failed-query JSONL dumps, by trigger reason")
+        "automatic slow/failed/perf-regression JSONL dumps, by trigger "
+        "reason")
     dumps = t["dumps"]
-    for reason in sorted(set(dumps) | {"failed", "slow"}):
+    for reason in sorted(set(dumps) | {"failed", "slow",
+                                       "perf_regression"}):
         fam_d.add(dumps.get(reason, 0), {"reason": reason})
     return [
         MetricFamily("presto_tpu_flight_recorder_events_total", "counter",
                      "structured events appended to the flight-recorder "
                      "ring").add(t["events"]),
         fam_d,
+        MetricFamily("presto_tpu_flight_dumps_evicted_total", "counter",
+                     "dump files deleted oldest-first by the "
+                     "PRESTO_TPU_FLIGHT_MAX_DUMPS retention cap").add(
+                         t.get("evicted", 0)),
+    ]
+
+
+def query_history_families() -> List[MetricFamily]:
+    """Query-history archive + perf-sentinel families, exported by BOTH
+    tiers: archive size, lifetime records archived, and regression
+    breaches per gated metric. Every sentinel metric gets a sample
+    (zeros included) so the scrape shape is stable from the first
+    request on and scripts/scrape_metrics.py's ``history`` section can
+    always report deltas."""
+    from ..exec.perfgate import SENTINEL_SPECS
+    from .history import (get_history_archive, history_totals,
+                          perf_regression_totals)
+    regressions = perf_regression_totals()
+    fam_r = MetricFamily(
+        "presto_tpu_perf_regressions_total", "counter",
+        "per-fingerprint baseline breaches caught by the in-engine "
+        "perf sentinel, by metric (server/history.py + exec/perfgate.py)")
+    metrics = {s.name for s in SENTINEL_SPECS} | set(regressions)
+    for m in sorted(metrics):
+        fam_r.add(regressions.get(m, 0), {"metric": m})
+    return [
+        MetricFamily("presto_tpu_query_history_entries", "gauge",
+                     "completed-query records currently retained by "
+                     "this process's history archive").add(
+                         get_history_archive().size()),
+        MetricFamily("presto_tpu_query_history_records_total", "counter",
+                     "completed-query records archived since process "
+                     "start").add(history_totals()["records"]),
+        fam_r,
     ]
 
 

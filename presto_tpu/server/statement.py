@@ -156,6 +156,10 @@ class StatementServer:
         tier; None serves this process's slice alone."""
         self.sf = sf
         self._profile_workers = profile_workers
+        # structured log correlation: every engine log record carries
+        # the ambient trace/query ids from here on (utils/log.py)
+        from ..utils.log import ensure_log_context
+        ensure_log_context()
         from ..sql.statements import PreparedStatements
         # per-user registries (the reference scopes prepared statements
         # per session via X-Presto-Prepared-Statement headers)
@@ -328,6 +332,7 @@ class StatementServer:
                 self._emit_trace(q)
                 self._account_query(q)
                 self._maybe_flight_dump(q)
+                self._record_history(q)
 
     def _slow_threshold_ms(self, q: _Query) -> float:
         """slow_query_threshold_ms session property, env fallback
@@ -366,6 +371,32 @@ class StatementServer:
             # telemetry loss, not a query failure; leave a counted trace
             from .metrics import record_suppressed
             record_suppressed("statement", "flight_dump", e)
+
+    def _record_history(self, q: _Query) -> None:
+        """Archive one terminal query into the process history archive
+        (server/history.py) -- the record the perf sentinel gates and
+        GET /v1/history / system.query_history serve. Runs AFTER the
+        flight-dump check so a failed/slow dump wins the per-query dump
+        slot over a perf-regression dump. Never fails the query."""
+        try:
+            from .history import QueryHistoryArchive, get_history_archive
+            # the EFFECTIVE scale factor salts the sentinel fingerprint:
+            # the server-constructor sf applies when the client set no
+            # session property, and cross-sf runs of the same SQL must
+            # not share a baseline (a workload change is not a
+            # regression)
+            session = dict(q.session_values)
+            session.setdefault("sf", self.sf)
+            record = QueryHistoryArchive.record_of(
+                q.id, q.machine.state, q.user, q.text,
+                q.machine.elapsed_ms(), q.trace_ctx.trace_id,
+                query_stats=q.result_stats, session=session)
+            get_history_archive().add(record)
+        except Exception as e:  # noqa: BLE001 - history is telemetry;
+            # a malformed executor result (query_stats of a foreign
+            # type) must not kill the query thread's terminal path
+            from .metrics import record_suppressed
+            record_suppressed("statement", "record_history", e)
 
     def _account_query(self, q: _Query) -> None:
         """Roll a terminal query into the /v1/metrics lifetime totals
@@ -696,6 +727,7 @@ class StatementServer:
                               flight_recorder_families,
                               histogram_families, kernel_audit_families,
                               narrowing_families, plan_cache_families,
+                              query_history_families,
                               suppressed_error_families,
                               tracing_families, uptime_family)
         fams.append(uptime_family(self._started_at, "coordinator"))
@@ -706,6 +738,7 @@ class StatementServer:
         fams.extend(flight_recorder_families())
         fams.extend(kernel_audit_families())
         fams.extend(failpoint_families())
+        fams.extend(query_history_families())
         fams.extend(histogram_families())
         return fams
 
@@ -715,9 +748,21 @@ class StatementServer:
         fingerprint (exec/profiler.py; process-id dedup keeps an
         in-process worker from double-counting)."""
         from ..exec.profiler import cluster_profile_doc
+        return cluster_profile_doc(self._worker_urls())
+
+    def history_doc(self) -> dict:
+        """Cluster-merged completed-query history for GET /v1/history
+        (server/history.py): this process's archive slice plus every
+        configured worker's, newest-first, deduplicated by processId
+        like the profile merge."""
+        from .history import cluster_history_doc
+        return cluster_history_doc(self._worker_urls())
+
+    def _worker_urls(self) -> list:
+        """The worker base URLs the cluster-merged surfaces
+        (/v1/profile, /v1/history) pull slices from."""
         pw = self._profile_workers
-        urls = list(pw() if callable(pw) else (pw or ()))
-        return cluster_profile_doc(urls)
+        return list(pw() if callable(pw) else (pw or ()))
 
 
 def _render_ui(server: "StatementServer", parts: List[str]) -> str:
@@ -856,6 +901,11 @@ def _make_handler(server: StatementServer):
                 # cluster-merged per-kernel device-time table (the
                 # continuous profiler's coordinator surface)
                 self._send(server.profile_doc())
+                return
+            if parts == ["v1", "history"]:
+                # cluster-merged completed-query archive (the perf
+                # sentinel's raw material; server/history.py)
+                self._send(server.history_doc())
                 return
             if parts == ["v1", "failpoint"]:
                 # fault-injection admin surface (mirrors the worker's)

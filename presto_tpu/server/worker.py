@@ -771,6 +771,8 @@ class _Handler(BaseHTTPRequestHandler):
         fams.extend(flight_recorder_families())
         fams.extend(kernel_audit_families())
         fams.extend(failpoint_families())
+        from .metrics import query_history_families
+        fams.extend(query_history_families())
         fams.extend(histogram_families())
         return fams
 
@@ -805,6 +807,12 @@ class _Handler(BaseHTTPRequestHandler):
             # pulls + merges these cluster-wide; exec/profiler.py)
             from ..exec.profiler import profile_doc
             return self._send_json(profile_doc())
+        if parts == ["v1", "history"]:
+            # this process's completed-query archive slice (the
+            # statement tier merges these cluster-wide like /v1/profile;
+            # server/history.py)
+            from .history import get_history_archive
+            return self._send_json(get_history_archive().history_doc())
         if parts == ["v1", "failpoint"]:
             # live fault-injection admin surface (failpoints/): armed
             # table + lifetime hit counters + the site catalog
@@ -996,6 +1004,10 @@ class TpuWorkerServer:
                  task_concurrency: int = 4,
                  tls: Optional[tuple] = None):
         from .auth import make_authenticator
+        # structured log correlation on the worker tier too: task
+        # threads log under the propagated trace context (utils/log.py)
+        from ..utils.log import ensure_log_context
+        ensure_log_context()
         self.manager = TaskManager(sf=sf, mesh=mesh,
                                    task_concurrency=task_concurrency)
         self.node_id = node_id or f"tpu-worker-{uuid.uuid4().hex[:8]}"

@@ -1,0 +1,133 @@
+"""Structured log correlation: every engine log record carries the
+ambient trace/query identity.
+
+The debugging loop this closes: a flight dump or a trace names a query,
+but the log line that explains WHY ("suppressed error in ...", a retry,
+a spill) carries neither -- correlating them is grep-by-timestamp. Both
+tiers' servers call :func:`ensure_log_context` at construction, which
+installs a process-wide ``logging`` record factory stamping
+``record.trace_id`` / ``record.query_id`` from the thread's ambient
+state (the tracing context installed per hop by
+``server.tracing.trace_context``, and the per-query StatsCollector the
+engine installs around execution). Formatters can then reference
+``%(trace_id)s`` unconditionally -- the fields always exist, empty when
+no query is ambient.
+
+Opt-in JSON logs (``PRESTO_TPU_LOG_JSON=1`` at server construction):
+one JSON object per line on stderr -- ``{ts, level, logger, message,
+trace_id, query_id}`` -- the shape a log pipeline joins against
+``GET /v1/trace/{traceId}`` without a parse rule per format.
+
+Everything here is idempotent and never raises: logging setup runs in
+server constructors, including test suites that build hundreds.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from typing import Optional, Tuple
+
+__all__ = ["ensure_log_context", "TraceContextFilter", "JsonFormatter",
+           "ambient_ids", "LOG_JSON_ENV"]
+
+LOG_JSON_ENV = "PRESTO_TPU_LOG_JSON"
+
+_ENGINE_LOGGER = "presto_tpu"
+
+_install_lock = threading.Lock()
+_factory_installed = False
+_json_handler: Optional[logging.Handler] = None
+_prev_propagate = True
+
+
+def ambient_ids() -> Tuple[str, str]:
+    """(trace_id, query_id) of the calling thread's ambient query, empty
+    strings when none: the tracing context covers coordinator/worker
+    hops, the stats collector covers the engine's execution scope."""
+    trace_id = query_id = ""
+    try:
+        from ..server.tracing import current_context
+        ctx = current_context()
+        if ctx is not None:
+            trace_id = ctx.trace_id
+    except Exception:  # noqa: BLE001 - log plumbing must never raise
+        pass
+    try:
+        from ..exec.stats import current_collector
+        c = current_collector()
+        if c is not None:
+            query_id = c.query_id
+    except Exception:  # noqa: BLE001 - as above
+        pass
+    return trace_id, query_id
+
+
+class TraceContextFilter(logging.Filter):
+    """Handler-attachable variant of the same injection (for foreign
+    handlers that want the fields without the process-wide factory)."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        if not getattr(record, "trace_id", None):
+            record.trace_id, record.query_id = ambient_ids()
+        return True
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line, correlation ids included."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        doc = {
+            "ts": round(record.created, 6),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+            "trace_id": getattr(record, "trace_id", "") or "",
+            "query_id": getattr(record, "query_id", "") or "",
+        }
+        if record.exc_info:
+            doc["exc"] = self.formatException(record.exc_info)
+        return json.dumps(doc, default=str)
+
+
+def ensure_log_context() -> None:
+    """Install the correlating record factory (once per process) and,
+    when ``PRESTO_TPU_LOG_JSON`` is set truthy, a JSON stderr handler on
+    the engine's root logger. Idempotent; never raises."""
+    global _factory_installed, _json_handler, _prev_propagate
+    try:
+        with _install_lock:
+            if not _factory_installed:
+                prev = logging.getLogRecordFactory()
+
+                def factory(*args, _prev=prev, **kwargs):
+                    record = _prev(*args, **kwargs)
+                    record.trace_id, record.query_id = ambient_ids()
+                    return record
+
+                logging.setLogRecordFactory(factory)
+                _factory_installed = True
+            want_json = os.environ.get(LOG_JSON_ENV, "") \
+                not in ("", "0", "false")
+            logger = logging.getLogger(_ENGINE_LOGGER)
+            if want_json and _json_handler is None:
+                h = logging.StreamHandler()
+                h.setFormatter(JsonFormatter())
+                h.addFilter(TraceContextFilter())
+                logger.addHandler(h)
+                # stop propagation while the JSON handler owns the
+                # stream: a configured root handler would otherwise
+                # re-emit every engine record as plain text, breaking
+                # the one-JSON-object-per-line contract
+                _prev_propagate = logger.propagate
+                logger.propagate = False
+                _json_handler = h
+            elif not want_json and _json_handler is not None:
+                logger.removeHandler(_json_handler)
+                logger.propagate = _prev_propagate
+                _json_handler = None
+    except Exception:  # noqa: BLE001 - logging setup must never take
+        # down a server constructor; worst case logs stay uncorrelated
+        pass

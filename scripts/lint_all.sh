@@ -3,7 +3,9 @@
 # kernaudit (IR tier over the TPC-H q1-q22 corpus), then a seeded
 # chaos smoke (scripts/chaos.py --smoke: a small deterministic fault
 # schedule over an in-process cluster, so every recovery path runs
-# before every PR), preserving the repo's shared exit contract:
+# before every PR), then perfgate (the committed BENCH trajectory vs
+# PERF_BASELINE.json noise bands), preserving the repo's shared exit
+# contract:
 #
 #   0  all gates clean
 #   1  findings / stale baseline entries / invariant violations
@@ -29,5 +31,9 @@ k=$?
 python "$here/chaos.py" --seed 42 --smoke
 c=$?
 [ "$c" -gt "$rc" ] && rc=$c
+
+python "$here/perfgate.py" --json
+g=$?
+[ "$g" -gt "$rc" ] && rc=$g
 
 exit "$rc"

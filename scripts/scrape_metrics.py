@@ -57,6 +57,15 @@ TRACING_FAMILIES = (
 # window" is the first question, and "none" is an answer too
 FAULT_FAMILY_PREFIX = "presto_tpu_failpoint"
 
+# query-history archive + perf sentinel (server/history.py): its own
+# always-present section, zeros included -- "no regressions this
+# window" is the answer a deploy watch wants stated, not implied
+HISTORY_FAMILIES = (
+    "presto_tpu_query_history_entries",
+    "presto_tpu_query_history_records_total",
+    "presto_tpu_perf_regressions_total",
+)
+
 
 _LE_RE = re.compile(r'le="([^"]+)"')
 
@@ -102,7 +111,7 @@ def diff(before: dict, after: dict) -> dict:
     histogram window quantiles, counter-monotonicity violations, plus
     the always-present tracing/flight-recorder section."""
     out = {"counters": {}, "gauges": {}, "tracing": {}, "faults": {},
-           "histograms": {}, "violations": {}}
+           "history": {}, "histograms": {}, "violations": {}}
     hist_bases = set()
     for fam, samples in after.items():
         if fam.endswith("_bucket"):
@@ -114,6 +123,7 @@ def diff(before: dict, after: dict) -> dict:
             continue  # folded into the histogram section
         is_counter = fam.endswith("_total")
         is_fault = fam.startswith(FAULT_FAMILY_PREFIX)
+        is_history = fam in HISTORY_FAMILIES
         for key, val in samples.items():
             label = fam + key
             if is_counter:
@@ -126,6 +136,8 @@ def diff(before: dict, after: dict) -> dict:
                     continue
                 if is_fault:
                     out["faults"][label] = round(delta, 6)
+                elif is_history:
+                    out["history"][label] = round(delta, 6)
                 elif fam in TRACING_FAMILIES:
                     out["tracing"][label] = round(delta, 6)
                 elif delta:
@@ -134,6 +146,10 @@ def diff(before: dict, after: dict) -> dict:
                 # the armed gauge rides the faults section too: "3
                 # faults fired, 2 still armed" reads off one block
                 out["faults"][label] = round(val, 6)
+            elif is_history:
+                # the archive-size gauge rides the history section:
+                # "N records retained, 0 regressions" reads off one block
+                out["history"][label] = round(val, 6)
             else:
                 out["gauges"][label] = round(val, 6)
     for base in sorted(hist_bases):
