@@ -99,12 +99,25 @@ def run_one(query: str, sf: float, explain_only: bool = False,
     return 0
 
 
+def _watch_line(stats: dict, elapsed: float) -> str:
+    """One live ticker line from a poll's enriched stats: state, stage,
+    rows, percent, elapsed (the _base_doc progress enrichment)."""
+    state = stats.get("state", "QUEUED")
+    stage = stats.get("stage", "-")
+    rows = int(stats.get("processedRows", 0))
+    pct = float(stats.get("progressPercent", 0.0))
+    return (f"{state:>9s} | {stage:<8s} | rows {rows:>12,} | "
+            f"{pct:5.1f}% | {elapsed:6.1f}s")
+
+
 def run_one_remote(query: str, server: str, user: str = "presto",
                    session=None, stats: bool = False,
-                   trace: bool = False) -> int:
+                   trace: bool = False, watch: bool = False) -> int:
     """Run one statement over the client statement protocol (the
-    presto-cli-to-coordinator path: POST /v1/statement + nextUri)."""
-    from presto_tpu.client import QueryError, execute
+    presto-cli-to-coordinator path: POST /v1/statement + nextUri).
+    `watch` renders a one-line live progress ticker from the poll
+    loop's enriched stats while the statement is in flight."""
+    from presto_tpu.client import QueryError, StatementClient, execute
 
     extra_headers = None
     if trace:
@@ -117,8 +130,24 @@ def run_one_remote(query: str, server: str, user: str = "presto",
         extra_headers = {TRACE_HEADER: ctx.header()}
     t0 = time.time()
     try:
-        client = execute(server, query, user=user, session=session or {},
-                         extra_headers=extra_headers)
+        if watch:
+            client = StatementClient(server, query, user=user,
+                                     session=session or {},
+                                     extra_headers=extra_headers)
+            try:
+                while True:
+                    print("\r" + _watch_line(client.stats or {},
+                                             time.time() - t0),
+                          end="", file=sys.stderr, flush=True)
+                    if not client.advance():
+                        break
+            finally:
+                print(file=sys.stderr)  # leave the ticker line behind
+            client.drain()  # no-op advance + the error-raising contract
+        else:
+            client = execute(server, query, user=user,
+                             session=session or {},
+                             extra_headers=extra_headers)
     except QueryError as e:
         print(f"error [{e.error_name}]: {e}", file=sys.stderr)
         return 1
@@ -176,6 +205,11 @@ def main(argv=None) -> int:
     ap.add_argument("--server", default=None,
                     help="coordinator URL; statements ride the client "
                          "protocol instead of the embedded engine")
+    ap.add_argument("--watch", action="store_true",
+                    help="with --server: render a one-line live "
+                         "progress ticker (state, stage, rows, "
+                         "percent, elapsed) from the poll loop's "
+                         "enriched stats while the statement runs")
     ap.add_argument("--user", default="presto")
     args = ap.parse_args(argv)
 
@@ -187,7 +221,7 @@ def main(argv=None) -> int:
                 query = f"EXPLAIN {query}"  # server-side EXPLAIN
             return run_one_remote(query, args.server, args.user,
                                   {"sf": str(args.sf)}, stats=args.stats,
-                                  trace=args.trace)
+                                  trace=args.trace, watch=args.watch)
         return run_one(args.query, args.sf, args.explain, args.stats,
                        trace=args.trace)
 
@@ -211,7 +245,8 @@ def main(argv=None) -> int:
                         stmt = f"EXPLAIN {stmt}"
                     run_one_remote(stmt, args.server, args.user,
                                    {"sf": str(args.sf)},
-                                   stats=args.stats, trace=args.trace)
+                                   stats=args.stats, trace=args.trace,
+                                   watch=args.watch)
                 else:
                     run_one(stmt, args.sf, args.explain, args.stats,
                             trace=args.trace)

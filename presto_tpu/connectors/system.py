@@ -81,7 +81,26 @@ SCHEMA = {
                 # bytes, high-water memory, XLA compile micros
                 "cumulative_bytes": T.BIGINT,
                 "peak_memory_bytes": T.BIGINT,
-                "compile_us": T.BIGINT},
+                "compile_us": T.BIGINT,
+                # live-progress columns (exec/progress.py): real
+                # movement for RUNNING queries, not just terminal stats
+                "processed_rows": T.BIGINT,
+                "processed_bytes": T.BIGINT,
+                "progress_percent": T.DOUBLE,
+                "stage": _V,
+                "last_advance_age_ms": T.BIGINT},
+    # in-flight query/task progress heartbeats (exec/progress.py):
+    # one row per live entry this process tracks -- local engine
+    # queries, this worker's tasks, and remote tasks the coordinator's
+    # status polls folded back in
+    "live_tasks": {"task_id": _V, "query_id": _V, "kind": _V,
+                   "worker": _V, "state": _V, "stage": _V,
+                   "splits_done": T.BIGINT, "splits_planned": T.BIGINT,
+                   "rows": T.BIGINT, "bytes": T.BIGINT,
+                   "peak_memory_bytes": T.BIGINT,
+                   "progress_percent": T.DOUBLE,
+                   "elapsed_ms": T.BIGINT,
+                   "last_advance_age_ms": T.BIGINT},
     "tasks": {"task_id": _V, "state": _V, "rows": T.BIGINT,
               "buffered_pages": T.BIGINT, "elapsed_s": T.DOUBLE,
               "output_bytes": T.BIGINT, "peak_memory_bytes": T.BIGINT,
@@ -134,13 +153,28 @@ def _rows_of(table: str) -> List[tuple]:
         for s in servers:
             for doc in s.queries_doc():
                 qs = doc.get("queryStats") or {}
+                prog = doc.get("progress") or {}
                 out.append((doc["queryId"], doc["state"], doc["user"],
                             doc["query"],
                             int(doc.get("elapsedTimeMillis", 0)),
                             int(qs.get("outputBytes", 0)),
                             int(qs.get("peakMemoryBytes", 0)),
-                            _compile_us_of(qs)))
+                            _compile_us_of(qs),
+                            int(prog.get("rows", 0)),
+                            int(prog.get("bytes", 0)),
+                            float(prog.get("progressPercent", 0.0)),
+                            str(prog.get("stage", "")),
+                            int(prog.get("lastAdvanceAgeMs", 0))))
         return out
+    if table == "live_tasks":
+        from ..exec.progress import live_snapshots
+        return [(e["key"], e["query"], e["kind"], e["worker"] or "",
+                 e["state"], e["stage"], int(e["splitsDone"]),
+                 int(e["splitsPlanned"]), int(e["rows"]),
+                 int(e["bytes"]), int(e["peakMemoryBytes"]),
+                 float(e["progressPercent"]), int(e["elapsedMs"]),
+                 int(e["lastAdvanceAgeMs"]))
+                for e in live_snapshots()]
     if table == "tasks":
         out = []
         with _lock:

@@ -252,7 +252,43 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     programmatic plans). With a mesh, scan batches are padded to a
     multiple of the mesh size and the plan runs SPMD. With `split_rows`,
     streamable aggregation plans execute split-by-split with bounded
-    HBM (exec/streaming.py)."""
+    HBM (exec/streaming.py).
+
+    Every invocation maintains a live-progress entry keyed by
+    ``query_id`` (exec/progress.py): monotonic stage/splits/rows/bytes
+    counters an in-flight status poll, ``GET /v1/cluster`` and the
+    stuck-progress watchdog read while the query is still RUNNING.
+    Nested invocations (write roots) share their outer scope's entry."""
+    from .progress import begin as _progress_begin
+    prog = _progress_begin(query_id)
+    try:
+        res = _run_query_inner(
+            root, sf=sf, mesh=mesh, capacity_hints=capacity_hints,
+            default_join_capacity=default_join_capacity,
+            split_rows=split_rows, scan_ranges=scan_ranges,
+            remote_sources=remote_sources, memory_pool=memory_pool,
+            query_id=query_id, session=session,
+            hbm_budget_bytes=hbm_budget_bytes, prepared=prepared,
+            trace_id=trace_id, prog=prog)
+    except BaseException:
+        prog.release(state="FAILED")
+        raise
+    prog.release(state="FINISHED")
+    return res
+
+
+def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
+                     capacity_hints: Optional[Dict[str, int]] = None,
+                     default_join_capacity: int = 1 << 16,
+                     split_rows: Optional[int] = None,
+                     scan_ranges: Optional[Dict[str,
+                                                Tuple[int, int]]] = None,
+                     remote_sources: Optional[Dict[str, Batch]] = None,
+                     memory_pool=None, query_id: str = "query",
+                     session=None,
+                     hbm_budget_bytes: Optional[int] = None,
+                     prepared: bool = False,
+                     trace_id=None, prog=None) -> QueryResult:
     # write/DDL roots execute their source on device, then write
     # host-side (TableWriterOperator.java:76 analog -- the sink is a
     # host effect, fed by one DMA-out of the computed rows)
@@ -272,6 +308,8 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
             hbm_budget_bytes=hbm_budget_bytes, trace_id=trace_id)
     if not prepared:
         root = prepare_plan(root, sf=sf, mesh=mesh, session=session)
+    if prog is not None:
+        prog.advance(stage="plan")
     from ..utils.config import session_flag, session_value
     refine = session_flag(session, "stats_capacity_refinement", True)
     # access control: the analysis-time boundary (AccessControlManager
@@ -292,6 +330,8 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
         shape = streamable_agg_shape(root)
         if shape is not None:
             agg_node, _ = shape
+            if prog is not None:
+                prog.advance(stage="execute")
             if hbm_budget:  # 0 / None = uncapped (the config default)
                 from .spill import plan_state_bytes, run_spilled_agg
                 spill_dir = session_value(session, "spill_path") or None
@@ -395,7 +435,12 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
             for s in plan.scan_nodes)
         memory_pool.reserve(query_id, reserved)
         stats.add("reserved_bytes", reserved)
+        if prog is not None:
+            prog.note_memory(reserved)
     try:
+        if prog is not None:
+            prog.set_planned(len(plan.scan_nodes))
+            prog.advance(stage="staging")
         with stats.timed("scan_stage_s"), collector.stage("staging"):
             batches = []
             for si, s in enumerate(plan.scan_nodes):
@@ -411,6 +456,8 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
                 collector.operator(
                     _scan_key(si, s), _scan_label(s),
                     wall_us=int((time.time() - t_scan0) * 1e6))
+                if prog is not None:  # one split staged = one heartbeat
+                    prog.advance(splits=1)
     except Exception:
         if memory_pool is not None:
             memory_pool.free(query_id, reserved)
@@ -428,6 +475,8 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
         stats.add("scan_rows", rows)
         collector.operator(_scan_key(si, s), output_rows=rows,
                            output_bytes=nbytes)
+        if prog is not None:  # processed-input counters (monotonic)
+            prog.advance(rows=rows, bytes=nbytes)
         if getattr(s, "physical_dtypes", None):
             nc, nb = batch_narrowed_bytes_saved(b)
             narrowed_cols += nc
@@ -470,6 +519,8 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     device_s = 0.0           # summed dispatch+sync wall (all reruns)
     compile_us: Optional[int] = None
     res = None
+    if prog is not None:
+        prog.advance(stage="execute")
     try:
         with stats.timed("execute_s"), collecting(collector), \
                 collector.stage("execute"):
@@ -507,6 +558,8 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
                 # block_until_ready delta around the existing sync point
                 # is the only per-kernel timing one fused program exposes
                 device_s += time.time() - t_disp0
+                if prog is not None:  # each landed dispatch advances
+                    prog.advance()
                 flags = int(np.asarray(overflow))
                 if flags == 0:
                     if cap_scale > 1 and fp:
@@ -574,6 +627,8 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
             if cost:
                 collector.bump_stage("compile", **cost)
                 stats.add("xla_flops", cost["flops"])
+        if prog is not None:
+            prog.advance(stage="fetch")
         with stats.timed("fetch_s"), collector.stage("fetch"):
             res = _batch_to_result(out, root)
     finally:

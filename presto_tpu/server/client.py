@@ -145,6 +145,13 @@ class WorkerClient:
         data, _ = self._request("GET", "/v1/history")
         return json.loads(data)
 
+    def status(self) -> dict:
+        """The worker's enriched NodeStatus (GET /v1/status): liveness,
+        uptime, version, running tasks, memory-pool occupancy -- the
+        per-worker row of the statement tier's /v1/cluster overview."""
+        data, _ = self._request("GET", "/v1/status")
+        return json.loads(data)
+
     def submit(self, task_id: str, plan: N.PlanNode, sf: float = 0.01,
                session: Optional[dict] = None) -> dict:
         return self.submit_body(task_id, {"plan": N.to_json(plan), "sf": sf,
@@ -166,11 +173,35 @@ class WorkerClient:
         info = None
         while time.time() < deadline:
             info = self.task_info(task_id)
+            self._note_progress(task_id, info)
             if info["state"] in ("FINISHED", "FAILED", "ABORTED"):
                 return info
             time.sleep(0.05)
         state = info["state"] if info else "<never polled>"
         raise TimeoutError(f"task {task_id} still {state}")
+
+    def _note_progress(self, task_id: str, info: dict) -> None:
+        """Fold the progress heartbeat riding a TaskInfo poll into the
+        local registry (exec/progress.py), tagged with the ambient
+        trace id -- how the coordinator/statement process learns what
+        every remote task is doing mid-flight. A terminal TaskInfo
+        state finishes the entry even when the shipped snapshot lags
+        behind it (the worker flips the task terminal a beat before
+        its own finish_task runs): wait() stops polling on the
+        terminal state, so this poll is the last chance to close the
+        entry. Never raises."""
+        from .tracing import current_context
+        if not isinstance(info, dict):
+            return
+        from ..exec.progress import finish_task, note_remote
+        doc = info.get("progress")
+        if doc:
+            ctx = current_context()
+            note_remote(task_id, doc, worker=self.base,
+                        query=ctx.trace_id if ctx is not None else None)
+        state = info.get("state")
+        if state in ("FINISHED", "FAILED", "ABORTED"):
+            finish_task(task_id, state)
 
     def fetch_results(self, task_id: str, types: Sequence[T.Type],
                       codec: PageCodec = PageCodec(), buffer_id: int = 0,
@@ -217,20 +248,31 @@ class WorkerClient:
 
 
 def pull_worker_docs(worker_urls, timeout: float, fetch,
-                     component: str, site: str = "cluster_pull"):
-    """The one best-effort cluster pull both merged surfaces
-    (/v1/profile, /v1/history) share: fetch one document per reachable
-    worker through an authenticated WorkerClient, skip-and-count the
-    unreachable ones (never an error). ``fetch(client) -> dict``;
-    returns (docs, workers_pulled)."""
-    docs = []
-    pulled = 0
-    for url in worker_urls or ():
+                     component: str, site: str = "cluster_pull",
+                     parallel: bool = False):
+    """The one best-effort cluster pull the merged surfaces
+    (/v1/profile, /v1/history, /v1/cluster) share: fetch one document
+    per reachable worker through an authenticated WorkerClient,
+    skip-and-count the unreachable ones (never an error).
+    ``fetch(client) -> dict``; returns (docs, workers_pulled) with
+    docs in input-URL order. ``parallel`` fans the pulls out on a
+    small thread pool -- the live /v1/cluster probe uses it so ONE
+    dead worker costs one timeout per frame, not one per dead worker."""
+    from .metrics import record_suppressed
+
+    def pull(url):
         try:
-            docs.append(fetch(WorkerClient(str(url), timeout)))
-            pulled += 1
+            return fetch(WorkerClient(str(url), timeout))
         except Exception as e:  # noqa: BLE001 - a dead worker must not
             # fail the cluster view; the gap is counted on /v1/metrics
-            from .metrics import record_suppressed
             record_suppressed(component, site, e)
-    return docs, pulled
+            return None
+    urls = list(worker_urls or ())
+    if parallel and len(urls) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=min(8, len(urls))) as pool:
+            results = list(pool.map(pull, urls))
+    else:
+        results = [pull(u) for u in urls]
+    docs = [d for d in results if d is not None]
+    return docs, len(docs)

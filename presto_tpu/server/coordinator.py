@@ -168,11 +168,15 @@ class Coordinator:
                 except Exception as e:  # noqa: BLE001
                     last_err = f"{type(e).__name__}: {e}"
                 # this attempt is abandoned: abort it so a possibly
-                # still-running task stops buffering pages
+                # still-running task stops buffering pages, and close
+                # its live-progress entry so it cannot linger RUNNING
+                # on /v1/cluster after the failover resubmits elsewhere
                 try:
                     WorkerClient(url, timeout).abort(tid)
                 except Exception as e:  # noqa: BLE001 - worker may be dead
                     record_suppressed("coordinator", "abort_attempt", e)
+                from ..exec.progress import finish_task
+                finish_task(tid, "ABORTED")
                 if retries_left <= 0:
                     raise RuntimeError(
                         f"task {tid} failed everywhere: {last_err}")
@@ -287,11 +291,16 @@ class Coordinator:
             # reference's destroy-buffers-after-consumption contract.
             # Short fixed timeout: cleanup is best-effort and must not
             # stall a failing query behind dead workers.
+            from ..exec.progress import finish_task
             for url, tid in submitted:
                 try:
                     WorkerClient(url, min(timeout, 5.0)).abort(tid)
                 except Exception as e:  # noqa: BLE001 - best-effort cleanup
                     record_suppressed("coordinator", "task_cleanup", e)
+                # close any still-live progress entry (a task whose
+                # worker died unreachable was never polled terminal);
+                # finish_task is a no-op on already-finished entries
+                finish_task(tid, "ABORTED")
 
     def _merge_task_stats(self, produced, timeout: float,
                           trace_id: Optional[str] = None):

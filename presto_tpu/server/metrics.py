@@ -46,7 +46,7 @@ __all__ = ["MetricFamily", "Histogram", "DEFAULT_BUCKETS",
            "suppressed_error_totals", "tracing_families",
            "flight_recorder_families", "kernel_audit_families",
            "failpoint_families", "query_history_families",
-           "CONTENT_TYPE"]
+           "live_introspection_families", "CONTENT_TYPE"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 # exemplars are legal only in the OpenMetrics exposition (the classic
@@ -493,7 +493,7 @@ def flight_recorder_families() -> List[MetricFamily]:
         "reason")
     dumps = t["dumps"]
     for reason in sorted(set(dumps) | {"failed", "slow",
-                                       "perf_regression"}):
+                                       "perf_regression", "stuck"}):
         fam_d.add(dumps.get(reason, 0), {"reason": reason})
     return [
         MetricFamily("presto_tpu_flight_recorder_events_total", "counter",
@@ -559,6 +559,35 @@ def kernel_audit_families() -> List[MetricFamily]:
                      "staged kernels traced and audited (memo hits "
                      "excluded)").add(t["kernels"]),
     ]
+
+
+def live_introspection_families(workers_alive: Optional[int] = None
+                                ) -> List[MetricFamily]:
+    """Live-cluster introspection gauges + the stuck-progress counter,
+    exported by BOTH tiers: in-flight tasks known to this process's
+    progress registry (exec/progress.py), the caller's view of alive
+    workers (the worker passes 1 -- itself; the statement tier passes
+    its cached /v1/status probe count), and lifetime stuck-progress
+    watchdog firings (server/watchdog.py)."""
+    from ..exec.progress import live_task_count
+    from .watchdog import stuck_totals
+    fams = [
+        MetricFamily("presto_tpu_running_tasks", "gauge",
+                     "in-flight query/task progress entries this "
+                     "process is tracking").add(live_task_count()),
+        MetricFamily("presto_tpu_stuck_queries_total", "counter",
+                     "queries/tasks whose progress last-advance age "
+                     "exceeded stuck_query_threshold_ms "
+                     "(stuck-progress watchdog firings)").add(
+                         stuck_totals()),
+    ]
+    if workers_alive is not None:
+        fams.insert(1, MetricFamily(
+            "presto_tpu_cluster_workers_alive", "gauge",
+            "workers this node currently believes alive (the worker "
+            "reports itself; the statement tier its last /v1/status "
+            "probe)").add(int(workers_alive)))
+    return fams
 
 
 def failpoint_families() -> List[MetricFamily]:

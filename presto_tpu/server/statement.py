@@ -120,6 +120,14 @@ class _Query:
         self.update_count: Optional[int] = None
         # structured execution stats (QueryStats) once the engine ran
         self.result_stats = None
+        # client-visible progress high-water marks: the live registry's
+        # per-task aggregate can transiently dip when the task set
+        # changes (a new task joins at 0%), but the PROTOCOL promises
+        # monotonically non-decreasing progress on every poll -- the
+        # max is taken here, per query (benign last-writer race: both
+        # writers only raise it)
+        self.progress_hwm = {"pct": 0.0, "rows": 0, "bytes": 0,
+                             "peak": 0}
         # response-header mutations for the client to apply
         self.set_session: Dict[str, str] = {}
         self.started_txn: Optional[str] = None
@@ -140,7 +148,8 @@ class StatementServer:
     # request-handler threads share the query registry and the metrics
     # roll-ups; writes go through these locks (tpulint C001)
     _GUARDED_BY = {"_qlock": ("_queries",),
-                   "_metrics_lock": ("_queries_by_state", "_totals")}
+                   "_metrics_lock": ("_queries_by_state", "_totals",
+                                     "_workers_alive")}
 
     def __init__(self, port: int = 0, sf: float = 0.01,
                  dispatcher: Optional[Dispatcher] = None,
@@ -180,6 +189,17 @@ class StatementServer:
         self._totals = {"rows": 0, "bytes": 0, "wall_us": 0,
                         "compile_us": 0, "execute_us": 0,
                         "peak_memory_bytes": 0}
+        # fleet liveness cache: refreshed by every /v1/cluster probe;
+        # None = never probed (the gauge then reports the configured
+        # count optimistically rather than paying an HTTP probe per
+        # metrics scrape)
+        self._workers_alive: Optional[int] = None
+        # stuck-progress watchdog (server/watchdog.py): scans live
+        # queries; per query disabled unless stuck_query_threshold_ms /
+        # PRESTO_TPU_STUCK_MS arms a threshold
+        from .watchdog import StuckProgressWatchdog
+        self._watchdog = StuckProgressWatchdog(
+            self._stuck_candidates, tier="statement")
         handler = _make_handler(self)
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         scheme = "http"
@@ -200,9 +220,11 @@ class StatementServer:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
+        self._watchdog.start()
         return self
 
     def stop(self):
+        self._watchdog.stop()
         self._httpd.shutdown()
         self._httpd.server_close()
 
@@ -617,6 +639,14 @@ class StatementServer:
                       parent_id=q.trace_ctx.span_id)
         return doc
 
+    def _progress_doc(self, q: _Query) -> Optional[dict]:
+        """The query's live progress aggregate: its own engine entry
+        plus every remote task entry tagged with its trace id
+        (exec/progress.py -- fed locally by run_query, cross-worker by
+        the coordinator's status polls)."""
+        from ..exec.progress import aggregate_query_progress
+        return aggregate_query_progress({q.id, q.trace_ctx.trace_id})
+
     def _base_doc(self, q: _Query, state: str) -> dict:
         queued = state == QueryState.QUEUED
         doc = {
@@ -633,16 +663,41 @@ class StatementServer:
                 "peakMemoryBytes": 0,
             },
         }
+        stats = doc["stats"]
+        prog = self._progress_doc(q)
+        hwm = q.progress_hwm
+        if prog is not None:
+            # live heartbeats: an IN-FLIGHT poll sees real movement
+            # (the round-1 protocol hardcoded zeros until FINISHED).
+            # Counters clamp to the per-query high-water mark so the
+            # client-visible sequence is monotonic even when the task
+            # set changes under the aggregate.
+            hwm["rows"] = max(hwm["rows"], prog["rows"])
+            hwm["bytes"] = max(hwm["bytes"], prog["bytes"])
+            hwm["peak"] = max(hwm["peak"], prog["peakMemoryBytes"])
+            hwm["pct"] = max(hwm["pct"], prog["progressPercent"])
+            stats["stage"] = prog["stage"]
+            stats["lastAdvanceAgeMs"] = prog["lastAdvanceAgeMs"]
+            stats["liveTasks"] = prog["runningTasks"]
+            stats["splitsDone"] = prog["splitsDone"]
+            stats["splitsPlanned"] = prog["splitsPlanned"]
+        stats["processedRows"] = max(len(q.rows), hwm["rows"])
+        stats["processedBytes"] = hwm["bytes"]
+        stats["peakMemoryBytes"] = hwm["peak"]
+        stats["progressPercent"] = 100.0 \
+            if state == QueryState.FINISHED else round(hwm["pct"], 1)
         qs = q.result_stats
         if qs is not None:
             # the engine's structured stats populate the client
             # protocol's stats field (StatementStats analog), with the
             # full stage/operator document alongside for rich clients
-            doc["stats"]["processedBytes"] = qs.output_bytes
-            doc["stats"]["peakMemoryBytes"] = qs.peak_memory_bytes
-            doc["stats"]["compileTimeMicros"] = qs.compile_us
-            doc["stats"]["executeTimeMicros"] = qs.stage_us("execute")
-            doc["stats"]["queryStats"] = qs.to_json()
+            stats["processedBytes"] = max(stats["processedBytes"],
+                                          qs.output_bytes)
+            stats["peakMemoryBytes"] = max(stats["peakMemoryBytes"],
+                                           qs.peak_memory_bytes)
+            stats["compileTimeMicros"] = qs.compile_us
+            stats["executeTimeMicros"] = qs.stage_us("execute")
+            stats["queryStats"] = qs.to_json()
         return doc
 
     def cancel(self, q: _Query) -> None:
@@ -659,6 +714,10 @@ class StatementServer:
                 "timings": q.machine.timings(),
                 "elapsedTimeMillis": q.machine.elapsed_ms(),
                 "errorInfo": q.machine.error,
+                # the live-progress aggregate (None before anything
+                # registered): system.queries' progress columns and the
+                # per-query admin page read it mid-flight
+                "progress": self._progress_doc(q),
                 "queryStats": q.result_stats.to_json()
                 if q.result_stats is not None else None}
 
@@ -680,6 +739,109 @@ class StatementServer:
             doc["queryId"] = q.id
             doc["state"] = q.machine.state
         return doc
+
+    def _stuck_candidates(self):
+        """Live queries offered to the stuck-progress watchdog: every
+        non-terminal query past QUEUED (queued waits are the
+        dispatcher's business), threshold from its session (env
+        fallback), last advance = the freshest of its state transitions
+        and its progress entries' heartbeats -- so a query wedged
+        before the engine registered anything still ages from the
+        moment it entered RUNNING."""
+        from ..exec.progress import aggregate_query_progress
+        from .watchdog import StuckCandidate, resolve_stuck_threshold_ms
+        with self._qlock:
+            queries = list(self._queries.values())
+        out = []
+        now = time.time()
+        for q in queries:
+            state = q.machine.state
+            if state == QueryState.QUEUED or state in TERMINAL_STATES:
+                continue
+            thr = resolve_stuck_threshold_ms(q.session_values)
+            if thr <= 0:
+                continue
+            last = max(q.machine.timings().values())
+            prog = aggregate_query_progress({q.id,
+                                             q.trace_ctx.trace_id})
+            if prog is not None:
+                last = max(last, now - prog["lastAdvanceAgeMs"] / 1000.0)
+            out.append(StuckCandidate(
+                q.id, thr, last, trace_id=q.trace_ctx.trace_id,
+                extra={"state": state, "user": q.user,
+                       "query": q.text[:200]}))
+        return out
+
+    def cluster_doc(self) -> dict:
+        """The fleet overview ``GET /v1/cluster`` serves (the reference
+        coordinator's ClusterStatsResource analog): live query counts +
+        per-query progress, per-worker liveness/occupancy rows probed
+        over ``GET /v1/status``, aggregate throughput, resource-group
+        queue depths, and the stuck-progress watchdog total. One
+        probe refreshes the workers-alive gauge cache."""
+        from ..exec.progress import live_snapshots, live_task_count
+        from .client import pull_worker_docs
+        from .watchdog import stuck_totals
+        now = time.time()
+        with self._qlock:
+            queries = list(self._queries.values())
+        queued = running = 0
+        running_docs = []
+        for q in queries:
+            state = q.machine.state
+            if state in TERMINAL_STATES:
+                continue
+            if state == QueryState.QUEUED:
+                queued += 1
+            else:
+                running += 1
+            running_docs.append({
+                "queryId": q.id, "user": q.user, "state": state,
+                "elapsedMs": q.machine.elapsed_ms(),
+                "query": q.text[:120],
+                "traceId": q.trace_ctx.trace_id,
+                "progress": self._progress_doc(q)})
+        groups = self.dispatcher.group_stats()
+        blocked = sum(int(g.get("queued", 0)) for g in groups.values())
+        urls = self._worker_urls()
+        workers, alive = pull_worker_docs(
+            urls, 2.0, lambda c: {**c.status(), "uri": c.base},
+            "statement", "cluster_status", parallel=True)
+        with self._metrics_lock:
+            self._workers_alive = alive
+            by_state = dict(self._queries_by_state)
+            totals = dict(self._totals)
+        live = live_snapshots()
+        rows_per_s = sum(e["rows"] / max(e["elapsedMs"] / 1000.0, 1e-3)
+                         for e in live)
+        return {
+            "tsUs": int(now * 1e6),
+            "nodeVersion": {"version": "presto-tpu-0.4"},
+            "uptimeSeconds": round(now - self._started_at, 1),
+            "queries": {"queued": queued, "running": running,
+                        "blocked": blocked,
+                        "finishedTotal": by_state.get("FINISHED", 0),
+                        "failedTotal": by_state.get("FAILED", 0),
+                        "canceledTotal": by_state.get("CANCELED", 0)},
+            "runningQueries": running_docs,
+            "liveTasks": live_task_count(),
+            "rowsPerSecond": round(rows_per_s, 1),
+            "totals": {"rows": totals["rows"], "bytes": totals["bytes"],
+                       "wallSeconds": round(totals["wall_us"] / 1e6, 3)},
+            "resourceGroups": groups,
+            "workers": workers,
+            "workersAlive": alive,
+            "workersConfigured": len(urls),
+            "stuckQueriesTotal": stuck_totals(),
+        }
+
+    def _workers_alive_view(self) -> int:
+        """The workers-alive gauge value: the last /v1/cluster probe's
+        count, or the configured count before any probe (metrics
+        scrapes never pay an HTTP probe themselves)."""
+        with self._metrics_lock:
+            alive = self._workers_alive
+        return len(self._worker_urls()) if alive is None else alive
 
     def metric_families(self):
         """Coordinator-side /v1/metrics families (shared emitter:
@@ -726,11 +888,14 @@ class StatementServer:
         from .metrics import (failpoint_families,
                               flight_recorder_families,
                               histogram_families, kernel_audit_families,
+                              live_introspection_families,
                               narrowing_families, plan_cache_families,
                               query_history_families,
                               suppressed_error_families,
                               tracing_families, uptime_family)
         fams.append(uptime_family(self._started_at, "coordinator"))
+        fams.extend(live_introspection_families(
+            workers_alive=self._workers_alive_view()))
         fams.extend(plan_cache_families())
         fams.extend(narrowing_families())
         fams.extend(suppressed_error_families())
@@ -896,6 +1061,12 @@ def _make_handler(server: StatementServer):
                         if q.clear_txn:
                             headers["X-Presto-Clear-Transaction-Id"] = "true"
                 self._send(doc, headers=headers)
+                return
+            if parts == ["v1", "cluster"]:
+                # fleet overview: live query/task progress + per-worker
+                # liveness/occupancy (ClusterStatsResource analog; the
+                # document scripts/ptop.py renders)
+                self._send(server.cluster_doc())
                 return
             if parts == ["v1", "profile"]:
                 # cluster-merged per-kernel device-time table (the

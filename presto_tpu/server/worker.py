@@ -172,12 +172,16 @@ class _Task:
                             "spans")}
 
     def __init__(self, task_id: str, spool_threshold: int = 64 << 20,
-                 spool_dir: Optional[str] = None):
+                 spool_dir: Optional[str] = None,
+                 session_stuck_ms=None):
         self.task_id = task_id
         self.state = "PLANNED"  # PLANNED -> RUNNING -> FINISHED/FAILED/ABORTED
         self.error: Optional[str] = None
         self._spool_threshold = spool_threshold
         self._spool_dir = spool_dir
+        # the task body session's stuck_query_threshold_ms (None =
+        # resolve the PRESTO_TPU_STUCK_MS env at watchdog scan time)
+        self.session_stuck_ms = session_stuck_ms
         # partition-addressed output buffers (OutputBufferId -> pages);
         # unpartitioned results live in buffer 0. Pages past the memory
         # budget spool to disk (SpoolingOutputBuffer.java analog)
@@ -198,6 +202,13 @@ class _Task:
         return SpoolingOutputBuffer(self._spool_threshold, self._spool_dir)
 
     def info(self) -> dict:
+        # live progress rides every TaskInfo poll: the coordinator's
+        # status loop folds it back into its own registry, so the
+        # statement tier sees cross-worker heartbeats without a second
+        # protocol (registry lock nests inside the task lock and never
+        # takes it back -- no cycle)
+        from ..exec.progress import get_progress
+        ent = get_progress(self.task_id)
         with self.lock:
             doc = {
                 "taskId": self.task_id,
@@ -210,6 +221,8 @@ class _Task:
                 "stats": dict(self.stats),
                 "elapsedSeconds": round(time.time() - self.created_at, 3),
             }
+            if ent is not None:
+                doc["progress"] = ent.snapshot()
             if self.spans:
                 # populated only at terminal state, so in-flight status
                 # polls stay small and the final poll carries the spans
@@ -301,8 +314,12 @@ class TaskManager:
                 if self.draining:
                     raise RuntimeError(
                         "worker is SHUTTING_DOWN: not accepting tasks")
+                sess = body.get("session") \
+                    if isinstance(body.get("session"), dict) else {}
                 task = _Task(task_id, self.output_spool_threshold_bytes,
-                             self.output_spool_dir)
+                             self.output_spool_dir,
+                             session_stuck_ms=(sess or {}).get(
+                                 "stuck_query_threshold_ms"))
                 self.tasks[task_id] = task
                 self._count("tasks_created")
                 threading.Thread(target=self._run, args=(task, body),
@@ -314,6 +331,38 @@ class TaskManager:
             self._prune_locked()
             return sum(1 for t in self.tasks.values()
                        if t.state in ("PLANNED", "RUNNING"))
+
+    def _stuck_candidates(self):
+        """RUNNING tasks offered to the stuck-progress watchdog
+        (server/watchdog.py): threshold from the task body's session
+        (env fallback resolved at scan time, so a live env flip takes
+        effect for already-running tasks), last advance from the live
+        progress entry (falling back to task creation -- a task wedged
+        before the runner registered anything is exactly the case the
+        detector exists for)."""
+        from ..exec.progress import get_progress
+        from .watchdog import StuckCandidate, resolve_stuck_threshold_ms
+        with self._tasks_lock:
+            tasks = list(self.tasks.values())
+        out = []
+        for t in tasks:
+            with t.lock:
+                state = t.state
+            if state != "RUNNING":
+                continue
+            sess = None if t.session_stuck_ms is None else \
+                {"stuck_query_threshold_ms": t.session_stuck_ms}
+            thr = resolve_stuck_threshold_ms(sess)
+            if thr <= 0:
+                continue
+            ent = get_progress(t.task_id)
+            snap = ent.snapshot() if ent is not None else None
+            out.append(StuckCandidate(
+                t.task_id, thr,
+                snap["lastAdvanceTsUs"] / 1e6 if snap else t.created_at,
+                trace_id=snap["query"] if snap else None,
+                extra={"stage": snap["stage"] if snap else "start"}))
+        return out
 
     def _run(self, task: _Task, body: dict):
         try:
@@ -358,9 +407,15 @@ class TaskManager:
             try:
                 self._run_task(task, body, task_ctx)
             finally:
+                # the task state machine (not the runner) owns task
+                # finality: force the progress entry terminal so a
+                # crashed/aborted task never lingers "RUNNING" on the
+                # live surfaces
+                from ..exec.progress import finish_task
                 with task.lock:
                     state = task.state
                     tstats = dict(task.stats)
+                finish_task(task.task_id, state)
                 emit_span(trace_id, f"task.{task.task_id}",
                           t_start, time.time(),
                           {"state": state,
@@ -388,6 +443,13 @@ class TaskManager:
                 task.state = "RUNNING"
             record_event("task_state", query_id=task.task_id,
                          state="RUNNING")
+            # progress heartbeat entry registered BEFORE any failpoint/
+            # staging work: a task wedged right here (the `hang` site
+            # below) is still visible -- with a stalling last-advance
+            # age -- to status polls and the stuck-progress watchdog
+            from ..exec.progress import begin as progress_begin
+            progress_begin(task.task_id, kind="task",
+                           query=task_ctx.trace_id)
             if failpoints.ARMED:
                 # error = crash mid-task (-> FAILED -> coordinator
                 # resubmit); hang/delay = wedged or slow worker
@@ -771,8 +833,12 @@ class _Handler(BaseHTTPRequestHandler):
         fams.extend(flight_recorder_families())
         fams.extend(kernel_audit_families())
         fams.extend(failpoint_families())
-        from .metrics import query_history_families
+        from .metrics import (live_introspection_families,
+                              query_history_families)
         fams.extend(query_history_families())
+        # a worker's "alive" view is itself (the statement tier reports
+        # its probed fleet count through the same builder)
+        fams.extend(live_introspection_families(workers_alive=1))
         fams.extend(histogram_families())
         return fams
 
@@ -827,13 +893,26 @@ class _Handler(BaseHTTPRequestHandler):
                 doc if doc else {"error": f"no trace {parts[2]}"},
                 200 if doc else 404)
         if parts == ["v1", "status"]:
+            # enriched NodeStatus (the /v1/cluster fleet overview's
+            # per-worker row): uptime, engine version, running tasks,
+            # memory-pool occupancy. The legacy flat memory keys stay
+            # for older pollers.
+            m = self.manager
+            pool = m.memory_pool
             return self._send_json({
                 "nodeId": self.node_id,
-                "activeTasks": self.manager.active_task_count(),
-                "state": ("SHUTTING_DOWN" if self.manager.draining
+                "nodeVersion": {"version": "presto-tpu-0.4"},
+                "activeTasks": m.active_task_count(),
+                "runningTasks": m.active_task_count(),
+                "uptimeSeconds": round(time.time() - self.started_at, 1),
+                "state": ("SHUTTING_DOWN" if m.draining
                           else "ACTIVE"),
-                "memoryReservedBytes": self.manager.memory_pool.reserved_bytes,
-                "memoryCapacityBytes": self.manager.memory_pool.capacity})
+                "memory": {"reservedBytes": pool.reserved_bytes,
+                           "capacityBytes": pool.capacity,
+                           "peakBytes": pool.peak_bytes,
+                           "revokedBytes": pool.revoked_bytes},
+                "memoryReservedBytes": pool.reserved_bytes,
+                "memoryCapacityBytes": pool.capacity})
         if len(parts) == 3 and parts[:2] == ["v1", "task"]:
             tid, _, query = parts[2].partition("?")
             task = self.manager.get(tid)
@@ -1027,6 +1106,12 @@ class TpuWorkerServer:
         self.port = self.httpd.server_address[1]
         self.url = f"{scheme}://127.0.0.1:{self.port}"
         self._thread: Optional[threading.Thread] = None
+        # stuck-progress watchdog (server/watchdog.py): scans this
+        # manager's RUNNING tasks; disabled per task unless the session
+        # property / PRESTO_TPU_STUCK_MS arms a threshold
+        from .watchdog import StuckProgressWatchdog
+        self._watchdog = StuckProgressWatchdog(
+            self.manager._stuck_candidates, tier="worker")
         self._announcer = None
         if discovery_url:
             from .discovery import Announcer
@@ -1039,6 +1124,7 @@ class TpuWorkerServer:
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
+        self._watchdog.start()
         if self._announcer:
             self._announcer.start()
         return self
@@ -1046,5 +1132,6 @@ class TpuWorkerServer:
     def stop(self):
         if self._announcer:
             self._announcer.stop(unannounce=True)
+        self._watchdog.stop()
         self.httpd.shutdown()
         self.httpd.server_close()

@@ -205,6 +205,14 @@ SESSION_PROPERTIES = (
          "(presto_tpu/failpoints grammar; same as the "
          "PRESTO_TPU_FAILPOINTS env var and POST /v1/failpoint). "
          "Empty = no injection; the subsystem is zero-cost disarmed")
+    .add("stuck_query_threshold_ms", "float", 0.0,
+         "stuck-progress watchdog threshold: a non-terminal query/task "
+         "whose live-progress last-advance age (exec/progress.py) "
+         "exceeds this fires presto_tpu_stuck_queries_total, a "
+         "flight-recorder stuck_progress event and a reason=stuck "
+         "flight dump -- orthogonal to slow_query_threshold_ms, which "
+         "fires on TOTAL wall time (env fallback PRESTO_TPU_STUCK_MS; "
+         "0 disables)")
     .add("continuous_profiling", "bool", True,
          "accumulate per-kernel device-time profiles keyed by plan "
          "fingerprint (exec/profiler.py): calls, block_until_ready "

@@ -95,6 +95,7 @@ OP_ROUNDS = [
     ("dispatcher", "admit"),
     ("statement", "fail_dump"),
     ("statement", "hang_deadline"),
+    ("task", "stuck"),
 ]
 
 
@@ -393,6 +394,45 @@ class ChaosRun:
                           f"failpoint event")
                 return "DUMP_MISSING_FAULT"
             return "clean_failure:dumped"
+        if op == "stuck":
+            # the hang failpoint's DETERMINISTIC detector (PR 10): a
+            # bounded worker hang well past the stuck threshold must
+            # fire the stuck-progress watchdog -- counter bump +
+            # flight-recorder stuck_progress event -- while the query
+            # still completes and matches its oracle afterwards
+            from presto_tpu.server.watchdog import stuck_totals
+            step["site"], step["spec"] = \
+                "worker.run_task", "hang(1200):once"
+            n = min(self.oracles)  # deterministic query choice
+            before = stuck_totals()
+            cluster.arm(step["site"], step["spec"])
+            os.environ["PRESTO_TPU_STUCK_MS"] = "300"
+            try:
+                def go():
+                    cols, _ = cluster.coordinator.execute(
+                        self.plans[n], sf=self.sf,
+                        timeout=self.args.timeout)
+                    return canon_rows(cols)
+                status, value = Watchdog(go, self.args.timeout + 30).run()
+            finally:
+                os.environ.pop("PRESTO_TPU_STUCK_MS", None)
+            if status == "hung":
+                self.fail(f"stuck round: q{n} HUNG past the deadline")
+                return "HUNG"
+            if status == "error":
+                return f"clean_failure:{type(value).__name__}"
+            if value != self.oracles[n]:
+                self.fail(f"stuck round: q{n} returned WRONG rows")
+                return "WRONG_RESULT"
+            if stuck_totals() <= before:
+                self.fail("stuck round: the hang fired but the "
+                          "stuck-progress watchdog never did")
+                return "UNDETECTED"
+            if not get_flight_recorder().events(kind="stuck_progress"):
+                self.fail("stuck round: watchdog fired without a "
+                          "stuck_progress flight event")
+                return "NO_FLIGHT_EVENT"
+            return "match+stuck_detected"
         if op == "hang_deadline":
             step["site"], step["spec"] = \
                 "statement.execute", "hang(1500):once"
@@ -514,7 +554,8 @@ class ChaosRun:
                "invariants": {
                    "correct_or_clean": not any(
                        "WRONG" in r["outcome"] or r["outcome"] in
-                       ("HUNG", "NOT_RECOVERED", "NO_TIMEOUT", "UNFIRED")
+                       ("HUNG", "NOT_RECOVERED", "NO_TIMEOUT", "UNFIRED",
+                        "UNDETECTED", "NO_FLIGHT_EVENT")
                        for r in self.rounds),
                    "no_counter_decrease": not any(
                        "counter decreased" in f for f in self.failures),

@@ -66,6 +66,16 @@ HISTORY_FAMILIES = (
     "presto_tpu_perf_regressions_total",
 )
 
+# live-cluster introspection (exec/progress.py + server/watchdog.py):
+# an always-present gauge snapshot -- in-flight tasks, alive workers,
+# stuck-progress firings -- so "is anything running / wedged RIGHT
+# NOW" reads off the same diff as the retrospective sections
+CLUSTER_FAMILIES = (
+    "presto_tpu_running_tasks",
+    "presto_tpu_cluster_workers_alive",
+    "presto_tpu_stuck_queries_total",
+)
+
 
 _LE_RE = re.compile(r'le="([^"]+)"')
 
@@ -111,7 +121,8 @@ def diff(before: dict, after: dict) -> dict:
     histogram window quantiles, counter-monotonicity violations, plus
     the always-present tracing/flight-recorder section."""
     out = {"counters": {}, "gauges": {}, "tracing": {}, "faults": {},
-           "history": {}, "histograms": {}, "violations": {}}
+           "history": {}, "cluster": {}, "histograms": {},
+           "violations": {}}
     hist_bases = set()
     for fam, samples in after.items():
         if fam.endswith("_bucket"):
@@ -124,6 +135,7 @@ def diff(before: dict, after: dict) -> dict:
         is_counter = fam.endswith("_total")
         is_fault = fam.startswith(FAULT_FAMILY_PREFIX)
         is_history = fam in HISTORY_FAMILIES
+        is_cluster = fam in CLUSTER_FAMILIES
         for key, val in samples.items():
             label = fam + key
             if is_counter:
@@ -138,6 +150,9 @@ def diff(before: dict, after: dict) -> dict:
                     out["faults"][label] = round(delta, 6)
                 elif is_history:
                     out["history"][label] = round(delta, 6)
+                elif is_cluster:
+                    # stuck-firing delta rides the cluster section
+                    out["cluster"][label] = round(delta, 6)
                 elif fam in TRACING_FAMILIES:
                     out["tracing"][label] = round(delta, 6)
                 elif delta:
@@ -150,6 +165,10 @@ def diff(before: dict, after: dict) -> dict:
                 # the archive-size gauge rides the history section:
                 # "N records retained, 0 regressions" reads off one block
                 out["history"][label] = round(val, 6)
+            elif is_cluster:
+                # current gauge values: "what is in flight NOW" reads
+                # off one block beside the stuck delta
+                out["cluster"][label] = round(val, 6)
             else:
                 out["gauges"][label] = round(val, 6)
     for base in sorted(hist_bases):

@@ -1,0 +1,517 @@
+"""Live cluster introspection (PR 10): progress heartbeats, the
+/v1/cluster fleet overview, system.live_tasks, the stuck-progress
+watchdog, and the ptop dashboard.
+
+Covers the acceptance criteria end to end:
+  * the monotonic progress law (unit + protocol-level: every poll of a
+    running statement sees non-decreasing rows/bytes/percent);
+  * /v1/cluster shape over a 2-worker in-process cluster;
+  * system.live_tasks rows under a running query;
+  * the watchdog firing deterministically under a ``worker.run_task``
+    ``hang(...)`` failpoint (counter + flight event + reason=stuck
+    dump cross-linking the trace) and staying silent on a healthy run;
+  * ``ptop --once --json`` golden shape.
+"""
+
+import io
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+from contextlib import redirect_stderr, redirect_stdout
+
+import pytest
+
+from presto_tpu import failpoints
+from presto_tpu.client import StatementClient, execute
+from presto_tpu.exec import progress
+from presto_tpu.server.flight_recorder import (FlightRecorder,
+                                               get_flight_recorder,
+                                               set_flight_recorder)
+from presto_tpu.server.watchdog import (StuckCandidate,
+                                        StuckProgressWatchdog,
+                                        resolve_stuck_threshold_ms,
+                                        stuck_totals)
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+
+@pytest.fixture(autouse=True)
+def _isolation(tmp_path):
+    """Fresh flight recorder (dump dir under tmp) + disarmed
+    failpoints around every test; the progress registry is cleared so
+    gauges/live tables start empty."""
+    failpoints.disarm_all()
+    progress.reset()
+    set_flight_recorder(FlightRecorder(dump_dir=str(tmp_path / "fl")))
+    yield
+    failpoints.disarm_all()
+    set_flight_recorder(None)
+
+
+def _wait_for(cond, timeout=20.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError("condition not met within timeout")
+
+
+# -- unit: the monotonic progress law -----------------------------------
+
+def test_progress_monotonic_law():
+    p = progress.TaskProgress("q1")
+    seen = []
+
+    def poll():
+        s = p.snapshot()
+        seen.append((s["rows"], s["bytes"], s["splitsDone"],
+                     s["progressPercent"], s["lastAdvanceTsUs"]))
+
+    poll()
+    p.set_planned(4)
+    p.advance(stage="plan")
+    poll()
+    p.advance(stage="staging", splits=2, rows=100, bytes=800)
+    poll()
+    p.advance(splits=-5, rows=-1, bytes=-1)  # negative deltas clamp
+    poll()
+    p.advance(stage="execute")
+    poll()
+    p.advance(stage="staging")  # stage regression: percent must hold
+    poll()
+    p.advance(stage="fetch", rows=50)
+    poll()
+    p.release(state="FINISHED")
+    poll()
+    for a, b in zip(seen, seen[1:]):
+        for i in range(5):
+            assert a[i] <= b[i], (a, b)
+    final = p.snapshot()
+    assert final["state"] == "FINISHED"
+    assert final["progressPercent"] == 100.0
+    assert final["rows"] == 150 and final["splitsDone"] == 2
+
+
+def test_progress_reentry_eviction_and_remote_merge():
+    # nested begin(): the outer scope owns finality
+    e = progress.begin("w1")
+    inner = progress.begin("w1")
+    assert inner is e
+    inner.release(state="FINISHED")
+    assert not e.done  # depth 1 remains
+    e.release(state="FINISHED")
+    assert e.done
+
+    # note_remote folds snapshots monotonically, out-of-order safe
+    progress.note_remote("t9", {"stage": "execute", "rows": 500,
+                                "bytes": 4000, "splitsDone": 2,
+                                "splitsPlanned": 2,
+                                "progressPercent": 60.0,
+                                "lastAdvanceAgeMs": 10,
+                                "state": "RUNNING"}, worker="http://w")
+    progress.note_remote("t9", {"stage": "staging", "rows": 100,
+                                "bytes": 100, "progressPercent": 10.0,
+                                "lastAdvanceAgeMs": 5000,
+                                "state": "RUNNING"})
+    s = progress.get_progress("t9").snapshot()
+    assert s["rows"] == 500 and s["bytes"] == 4000
+    assert s["progressPercent"] >= 60.0
+    assert s["lastAdvanceAgeMs"] < 2000  # stale age cannot move it back
+    progress.note_remote("t9", {"state": "FINISHED",
+                                "lastAdvanceAgeMs": 0})
+    assert progress.get_progress("t9").done
+
+    # bounded registry: done entries evict oldest-first
+    progress.set_capacity(4)
+    try:
+        for i in range(10):
+            progress.begin(f"ev{i}").release()
+        with progress._LOCK:
+            n = len(progress._ENTRIES)
+        assert n <= 4
+    finally:
+        progress.set_capacity(2048)
+
+
+def test_run_query_populates_progress():
+    from presto_tpu.sql import sql
+    res = sql("SELECT count(*) FROM region", query_id="prg1")
+    assert res.rows() == [(5,)]
+    ent = progress.get_progress("prg1")
+    assert ent is not None and ent.done
+    s = ent.snapshot()
+    assert s["state"] == "FINISHED"
+    assert s["splitsPlanned"] >= 1
+    assert s["splitsDone"] == s["splitsPlanned"]
+    assert s["rows"] >= 5 and s["bytes"] > 0
+    assert s["progressPercent"] == 100.0
+
+
+def test_threshold_resolution_session_over_env(monkeypatch):
+    monkeypatch.setenv("PRESTO_TPU_STUCK_MS", "700")
+    assert resolve_stuck_threshold_ms(None) == 700.0
+    assert resolve_stuck_threshold_ms(
+        {"stuck_query_threshold_ms": "250"}) == 250.0
+    assert resolve_stuck_threshold_ms(
+        {"stuck_query_threshold_ms": "0"}) == 0.0  # explicit disable
+    monkeypatch.delenv("PRESTO_TPU_STUCK_MS")
+    assert resolve_stuck_threshold_ms(None) == 0.0
+    assert resolve_stuck_threshold_ms(
+        {"stuck_query_threshold_ms": "garbage"}) == 0.0
+
+
+def test_watchdog_unit_fires_once_and_paces():
+    fired = []
+    now = time.time()
+    cands = [StuckCandidate("k1", 100.0, now - 1.0, trace_id="tr1"),
+             StuckCandidate("k2", 100.0, now, trace_id="tr2"),
+             StuckCandidate("k3", 0.0, now - 99.0)]  # disabled
+    wd = StuckProgressWatchdog(lambda: cands, tier="unit")
+    before = stuck_totals()
+    delay = wd.check_once()
+    assert stuck_totals() - before == 1  # only k1 is old enough
+    wd.check_once()
+    assert stuck_totals() - before == 1  # exactly-once per key
+    assert delay == pytest.approx(0.05, abs=0.01)  # 100ms/4 -> floor
+    evts = [e for e in get_flight_recorder().events(
+        kind="stuck_progress") if e.get("key") == "k1"]
+    assert evts and evts[0]["trace"] == "tr1"
+    assert get_flight_recorder().dump_path("k1").endswith(
+        ".stuck.jsonl")
+    # empty scan idles at the cap
+    assert StuckProgressWatchdog(lambda: [],
+                                 tier="unit2").check_once() == 1.0
+
+
+# -- worker tier --------------------------------------------------------
+
+def test_worker_hang_fires_watchdog_then_healthy_stays_silent(
+        monkeypatch):
+    from presto_tpu.server import TpuWorkerServer, WorkerClient
+    from presto_tpu.sql import plan_sql
+    monkeypatch.setenv("PRESTO_TPU_STUCK_MS", "250")
+    w = TpuWorkerServer(sf=0.01).start()
+    try:
+        c = WorkerClient(f"http://127.0.0.1:{w.port}", 30)
+        failpoints.configure("worker.run_task=hang(1200):once")
+        before = stuck_totals()
+        c.submit(task_id="t-hang",
+                 plan=plan_sql("SELECT count(*) FROM region"))
+        # mid-hang, the status poll already shows a stalling heartbeat
+        time.sleep(0.4)
+        info = c.task_info("t-hang")
+        if info["state"] == "RUNNING":
+            prog = info.get("progress") or {}
+            assert prog.get("lastAdvanceAgeMs", 0) >= 200
+        info = c.wait("t-hang", 30)
+        assert info["state"] == "FINISHED"  # hang is bounded
+        _wait_for(lambda: stuck_totals() > before)
+        evts = [e for e in get_flight_recorder().events(
+            kind="stuck_progress") if e.get("queryId") == "t-hang"]
+        assert evts and evts[0]["tier"] == "worker"
+        dump = get_flight_recorder().dump_path("t-hang")
+        assert dump is not None and dump.endswith(".stuck.jsonl")
+        head = json.loads(open(dump).readline())["dump"]
+        assert head["reason"] == "stuck"
+        # the counter is on the worker's /v1/metrics
+        from presto_tpu.server.metrics import parse_prometheus
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{w.port}/v1/metrics") as r:
+            fams = parse_prometheus(r.read().decode())
+        assert fams["presto_tpu_stuck_queries_total"][""] >= 1
+        assert fams["presto_tpu_cluster_workers_alive"][""] == 1
+        # ... and the reason=stuck dump label is declared
+        assert fams["presto_tpu_flight_recorder_dumps_total"][
+            '{reason="stuck"}'] >= 1
+
+        # healthy run under the same threshold: no new firing
+        failpoints.disarm_all()
+        after = stuck_totals()
+        c.submit(task_id="t-ok",
+                 plan=plan_sql("SELECT count(*) FROM nation"))
+        assert c.wait("t-ok", 30)["state"] == "FINISHED"
+        assert stuck_totals() == after
+        assert get_flight_recorder().dump_path("t-ok") is None
+    finally:
+        w.stop()
+
+
+def test_worker_status_enriched():
+    from presto_tpu.server import TpuWorkerServer
+    w = TpuWorkerServer(sf=0.01).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{w.port}/v1/status") as r:
+            st = json.loads(r.read())
+        assert st["nodeVersion"]["version"].startswith("presto-tpu")
+        assert st["uptimeSeconds"] >= 0
+        assert st["runningTasks"] == 0
+        mem = st["memory"]
+        assert {"reservedBytes", "capacityBytes", "peakBytes",
+                "revokedBytes"} <= set(mem)
+        # legacy flat keys stay for older pollers
+        assert "memoryReservedBytes" in st
+    finally:
+        w.stop()
+
+
+# -- statement tier: 2-worker cluster -----------------------------------
+
+@pytest.fixture
+def distributed(request):
+    """StatementServer fronting a 2-worker Coordinator (the
+    test_query_history topology), workers wired into profile_workers
+    so /v1/cluster probes them."""
+    from presto_tpu.exec.runner import QueryResult
+    from presto_tpu.plan.distribute import add_exchanges
+    from presto_tpu.server import Coordinator, TpuWorkerServer
+    from presto_tpu.server.statement import StatementServer
+    from presto_tpu.sql import plan_sql
+
+    workers = [TpuWorkerServer(sf=0.01).start() for _ in range(2)]
+    urls = [f"http://127.0.0.1:{w.port}" for w in workers]
+    coord = Coordinator(urls)
+    holder = {}
+
+    def executor(text, session_values, query_id, txn_id):
+        root = add_exchanges(plan_sql(text, max_groups=1 << 14))
+        cols, names = coord.execute(
+            root, sf=0.01,
+            trace_ctx=holder["srv"]._trace_ctx_of(query_id))
+        return QueryResult([v for v, _ in cols], [n for _, n in cols],
+                           names, len(cols[0][0]) if cols else 0,
+                           types=root.output_types())
+
+    srv = StatementServer(sf=0.01, executor=executor,
+                          queue_poll_s=0.05, profile_workers=urls)
+    holder["srv"] = srv
+    srv.start()
+    try:
+        yield srv
+    finally:
+        srv.stop()
+        for w in workers:
+            w.stop()
+
+
+GROUP_BY = "SELECT custkey, count(*) AS c FROM orders GROUP BY custkey"
+
+
+def test_cluster_doc_shape_two_workers(distributed):
+    srv = distributed
+    execute(srv.url, "SELECT count(*) FROM region")
+    with urllib.request.urlopen(f"{srv.url}/v1/cluster") as r:
+        doc = json.loads(r.read().decode())
+    assert {"tsUs", "uptimeSeconds", "queries", "runningQueries",
+            "liveTasks", "rowsPerSecond", "totals", "resourceGroups",
+            "workers", "workersAlive", "workersConfigured",
+            "stuckQueriesTotal"} <= set(doc)
+    q = doc["queries"]
+    assert {"queued", "running", "blocked", "finishedTotal",
+            "failedTotal", "canceledTotal"} <= set(q)
+    assert q["finishedTotal"] >= 1
+    assert doc["workersConfigured"] == 2 and doc["workersAlive"] == 2
+    for w in doc["workers"]:
+        assert {"nodeId", "uri", "state", "uptimeSeconds",
+                "runningTasks", "memory"} <= set(w)
+        assert w["memory"]["capacityBytes"] > 0
+    # the probe refreshed the workers-alive gauge on /v1/metrics
+    from presto_tpu.server.metrics import parse_prometheus
+    with urllib.request.urlopen(f"{srv.url}/v1/metrics") as r:
+        fams = parse_prometheus(r.read().decode())
+    assert fams["presto_tpu_cluster_workers_alive"][""] == 2
+    assert "" in fams["presto_tpu_running_tasks"]
+    assert "" in fams["presto_tpu_stuck_queries_total"]
+
+
+def test_remote_entries_close_after_query_completes(distributed):
+    """Review regression: a completed distributed query must leave NO
+    live progress entries behind -- the terminal TaskInfo state closes
+    coordinator-side entries even when the worker's own finish lags
+    the status poll, and the end-of-query cleanup closes entries whose
+    worker was never polled terminal."""
+    srv = distributed
+    execute(srv.url, GROUP_BY)
+    _wait_for(lambda: progress.live_task_count() == 0, timeout=10)
+    with urllib.request.urlopen(f"{srv.url}/v1/cluster") as r:
+        doc = json.loads(r.read().decode())
+    assert doc["liveTasks"] == 0 and doc["runningQueries"] == []
+
+
+def test_statement_polls_move_before_finished_and_stay_monotonic(
+        distributed):
+    """The _base_doc satellite fix: an in-flight poll sees real
+    processedRows/processedBytes movement (the consumer fragment is
+    stalled at the exchange while the finished leaf tasks' counters
+    are already folded in), and every poll is non-decreasing."""
+    srv = distributed
+    execute(srv.url, GROUP_BY)  # warm plan/fragment caches
+    failpoints.configure("exchange.fetch=delay(900):once")
+    c = StatementClient(srv.url, GROUP_BY)
+    seq = []
+    while True:
+        s = c.stats or {}
+        seq.append((s.get("state"), int(s.get("processedRows", 0)),
+                    int(s.get("processedBytes", 0)),
+                    float(s.get("progressPercent", 0.0))))
+        if not c.advance():
+            break
+    assert len(c.data) > 0
+    for a, b in zip(seq, seq[1:]):
+        assert a[1] <= b[1] and a[2] <= b[2] and a[3] <= b[3], (a, b)
+    moving = [s for s in seq if s[0] == "RUNNING" and s[1] > 0]
+    assert moving, f"no in-flight poll saw progress: {seq}"
+    assert seq[-1][3] == 100.0
+
+
+def test_live_tasks_sql_and_queries_progress_columns(distributed):
+    from presto_tpu.sql import sql
+    srv = distributed
+    execute(srv.url, GROUP_BY)  # warm
+    failpoints.configure("exchange.fetch=delay(1200):once")
+    done = {}
+
+    def run():
+        done["client"] = execute(srv.url, GROUP_BY)
+
+    t = threading.Thread(target=run)
+    t.start()
+    try:
+        def live_rows():
+            res = sql("SELECT task_id, query_id, kind, state, stage, "
+                      "rows, progress_percent, last_advance_age_ms "
+                      "FROM system.live_tasks", sf=0.01)
+            return [r for r in res.rows()
+                    if r[2] == "task" and r[3] == "RUNNING"]
+        rows = _wait_for(live_rows, timeout=15)
+        r0 = rows[0]
+        assert r0[0] and r0[1]           # task + query ids
+        assert 0.0 <= float(r0[6]) <= 100.0
+        assert int(r0[7]) >= 0
+        # system.queries live columns move for the RUNNING query
+        qres = sql("SELECT query_id, state, progress_percent, stage "
+                   "FROM system.queries", sf=0.01)
+        running = [r for r in qres.rows() if r[1] == "RUNNING"]
+        assert running, "the in-flight query shows in system.queries"
+    finally:
+        t.join(60)
+    assert len(done["client"].data) > 0
+
+
+def test_statement_watchdog_acceptance(distributed):
+    """The acceptance criterion: hang one worker task; /v1/cluster
+    shows the query RUNNING with a stalled last-advance age, the
+    watchdog bumps presto_tpu_stuck_queries_total and writes a
+    reason=stuck dump cross-linking the trace -- then a clean run with
+    the same threshold triggers nothing."""
+    srv = distributed
+    execute(srv.url, GROUP_BY)  # warm
+    failpoints.configure("worker.run_task=hang(2000):once")
+    before = stuck_totals()
+    done = {}
+
+    def run():
+        done["client"] = execute(
+            srv.url, GROUP_BY,
+            session={"stuck_query_threshold_ms": "300"})
+
+    t = threading.Thread(target=run)
+    t.start()
+    try:
+        def running_query():
+            with urllib.request.urlopen(f"{srv.url}/v1/cluster") as r:
+                doc = json.loads(r.read().decode())
+            for rq in doc["runningQueries"]:
+                if rq["state"] == "RUNNING":
+                    return rq
+            return None
+        rq = _wait_for(running_query, timeout=15)
+        assert rq["progress"] is None or \
+            rq["progress"]["lastAdvanceAgeMs"] >= 0
+        _wait_for(lambda: stuck_totals() > before, timeout=15)
+    finally:
+        t.join(60)
+    client = done["client"]
+    assert len(client.data) > 0  # the bounded hang still completed
+    qid = client.query_id
+    evts = [e for e in get_flight_recorder().events(
+        kind="stuck_progress") if e.get("queryId") == qid]
+    assert evts and evts[0]["tier"] == "statement"
+    dump = get_flight_recorder().dump_path(qid)
+    assert dump is not None and dump.endswith(".stuck.jsonl")
+    head = json.loads(open(dump).readline())["dump"]
+    assert head["reason"] == "stuck" and head["traceId"] == qid
+    # the firing shows on the statement tier's scrape
+    from presto_tpu.server.metrics import parse_prometheus
+    with urllib.request.urlopen(f"{srv.url}/v1/metrics") as r:
+        fams = parse_prometheus(r.read().decode())
+    assert fams["presto_tpu_stuck_queries_total"][""] >= 1
+
+    # clean replay under the same threshold: silent
+    after = stuck_totals()
+    clean = execute(srv.url, GROUP_BY,
+                    session={"stuck_query_threshold_ms": "1500"})
+    assert len(clean.data) > 0
+    assert stuck_totals() == after
+    assert get_flight_recorder().dump_path(clean.query_id) is None
+
+
+# -- dashboards + scripts ----------------------------------------------
+
+def test_ptop_once_json_golden_shape(distributed):
+    import ptop
+    srv = distributed
+    execute(srv.url, "SELECT count(*) FROM region")
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = ptop.main([srv.url, "--once", "--json"])
+    assert rc == 0
+    doc = json.loads(buf.getvalue())
+    assert {"fetchedAt", "queries", "runningQueries", "workers",
+            "workersAlive", "liveTasks", "rowsPerSecond",
+            "stuckQueriesTotal", "uptimeSeconds"} <= set(doc)
+    assert doc["workersAlive"] == 2
+    # the rendered frame mentions the fleet header
+    buf2 = io.StringIO()
+    with redirect_stdout(buf2):
+        assert ptop.main([srv.url, "--once"]) == 0
+    frame = buf2.getvalue()
+    assert "presto-tpu cluster" in frame and "workers 2/2" in frame
+    # unreachable endpoint -> exit 2
+    err = io.StringIO()
+    with redirect_stderr(err):
+        assert ptop.main(["http://127.0.0.1:9", "--once"]) == 2
+
+
+def test_cli_watch_ticker():
+    from presto_tpu import cli
+    from presto_tpu.server.statement import StatementServer
+    with StatementServer(sf=0.01, queue_poll_s=0.05) as srv:
+        out, err = io.StringIO(), io.StringIO()
+        with redirect_stdout(out), redirect_stderr(err):
+            rc = cli.main(["SELECT count(*) FROM nation",
+                           "--server", srv.url, "--watch"])
+        assert rc == 0
+        ticker = err.getvalue()
+        assert "rows" in ticker and "%" in ticker
+        assert "25" in out.getvalue()  # the result still renders
+
+
+def test_scrape_metrics_cluster_section(distributed):
+    import scrape_metrics
+    srv = distributed
+    before = scrape_metrics.scrape(srv.url)
+    execute(srv.url, "SELECT count(*) FROM region")
+    after = scrape_metrics.scrape(srv.url)
+    d = scrape_metrics.diff(before, after)
+    assert "cluster" in d
+    keys = set(d["cluster"])
+    assert "presto_tpu_running_tasks" in keys
+    assert "presto_tpu_cluster_workers_alive" in keys
+    assert "presto_tpu_stuck_queries_total" in keys
