@@ -311,7 +311,10 @@ def _bench_meta(platform):
     return {"jax_version": getattr(jax, "__version__", "unknown"),
             "platform": platform,
             "seed": int(os.environ.get("BENCH_SEED", "0")),
-            "timestamp": os.environ.get("BENCH_RUN_TS", "")}
+            "timestamp": os.environ.get("BENCH_RUN_TS", ""),
+            # pipeline-region fusion mode (exec/regions.py): =0 is the
+            # per-operator A/B; artifacts must say which form ran
+            "fusion": os.environ.get("PRESTO_TPU_FUSION", "1") != "0"}
 
 
 def _latency_tail(run_once, runs=5):

@@ -111,6 +111,11 @@ KERNEL_MODE_ENVS = (("PRESTO_TPU_SMALLG", "auto"),
                     ("PRESTO_TPU_NARROW", "1"),
                     ("PRESTO_TPU_BF16", "auto"),
                     ("PRESTO_TPU_GROUPBY", "sort"),
+                    # pipeline-region fusion (exec/regions.py): =0 runs
+                    # every operator as its own materialized program;
+                    # partitioning changes WHICH programs compile, so
+                    # the mode is part of every cached key
+                    ("PRESTO_TPU_FUSION", "1"),
                     # staging-time kernel auditing (audit/staged.py):
                     # doesn't change the lowered program, but keying it
                     # keeps audit-memo and executable lifecycles aligned
@@ -131,13 +136,38 @@ def _kernel_mode() -> str:
                     for name, default in KERNEL_MODE_ENVS)
 
 
+def _capacity_sensitive(root: N.PlanNode) -> bool:
+    """Whether `default_join_capacity` can change this plan's lowered
+    program. The ONLY lowering site that reads it is a JoinNode without
+    an explicit out_capacity (exec/planner.py), so join-free plans --
+    and plans whose joins all carry planned capacities -- compile
+    identically under every default. Keying those on the default would
+    fragment the cache across callers that merely configure different
+    join defaults (the fragment tier passes the session's
+    default_join_capacity on every submission)."""
+    seen: set = set()
+
+    def walk(n) -> bool:
+        if id(n) in seen:  # shared CTE subtrees visit once (a DAG
+            return False   # walked as a tree is exponential)
+        seen.add(id(n))
+        if isinstance(n, N.JoinNode) and n.out_capacity is None:
+            return True
+        return any(walk(s) for s in n.sources)
+    return walk(root)
+
+
 def cached_compile(root: N.PlanNode, mesh, default_join_capacity: int,
                    exchange_slot_scale: int = 1
                    ) -> Tuple[CompiledPlan, object, threading.Lock]:
     """(CompiledPlan, jitted fn, per-entry dispatch lock) for this plan,
-    compiling at most once per (structure, mesh, capacities, scale)."""
+    compiling at most once per (structure, mesh, capacities, scale).
+    Join-free plans are capacity-insensitive: their key ignores
+    `default_join_capacity`, so fused scan/agg regions never fragment
+    the cache across join-capacity configurations."""
     global _hits, _misses
-    key = (plan_fingerprint(root), _mesh_key(mesh), default_join_capacity,
+    cap_key = default_join_capacity if _capacity_sensitive(root) else None
+    key = (plan_fingerprint(root), _mesh_key(mesh), cap_key,
            exchange_slot_scale, _kernel_mode())
     with _lock:
         entry = _cache.get(key)

@@ -51,6 +51,21 @@ class QueryResult:
                              for c in range(len(self.columns))))
         return out
 
+    def canonical_rows(self, digits: int = 6) -> List[tuple]:
+        """Order-independent, stringified rows for oracle comparison
+        (floats rounded so summation order cannot flip a digit) -- the
+        ONE canonicalization the fusion A/B surfaces share."""
+        out = []
+        for i in range(self.row_count):
+            row = []
+            for c in range(len(self.columns)):
+                v = None if self.nulls[c][i] else self.columns[c][i]
+                if isinstance(v, (float, np.floating)):
+                    v = round(float(v), digits)
+                row.append(str(v))
+            out.append(tuple(row))
+        return sorted(out)
+
 
 def stage_scan_split(conn, node: "N.TableScanNode", sf: float, start: int,
                      count: int, capacity: int) -> Batch:
@@ -379,25 +394,65 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
     # sources) refer to THIS plan object's ids, which a cached plan does
     # not share -- those callers (the fragment tier) compile fresh.
     use_cache = not hints and not scan_ranges and not remote_sources
-    if use_cache:
-        from .plan_cache import plan_fingerprint
+    # Pipeline-region partition (exec/regions.py): the prepared plan
+    # becomes 1..N regions, each staged as ONE XLA program. With fusion
+    # on and nothing refused/demoted this is a single region -- the
+    # fused whole-fragment program, compiled and cached exactly as
+    # before. Materialized boundaries (fusion off, footprint refusal,
+    # profiler demotion) run the general region executor below.
+    from .plan_cache import plan_fingerprint
+    from .regions import fusion_memory, partition_regions
+    rplan = partition_regions(root, session=session, sf=sf, mesh=mesh)
+    from .. import failpoints
+    if failpoints.ARMED and rplan.fused and mesh is None \
+            and len(rplan.regions) == 1 and rplan.regions[0].ops > 1:
+        try:
+            failpoints.hit("fusion.demote")
+        except Exception as e:  # noqa: BLE001 - any injected error class
+            # forced demotion mid-query (chaos/bisection): the fused
+            # span demotes and THIS query already runs materialized
+            fusion_memory().demote(
+                plan_fingerprint(rplan.regions[0].root),
+                f"failpoint ({type(e).__name__})")
+            # the shared demotion counter (both paths) + the forced-
+            # path discriminator, correlated by the flight event reason
+            stats.add("fusion_demotions", 1)
+            stats.add("fusion_forced_demotions", 1)
+            collector.note("fusion_demotions")
+            from ..server.flight_recorder import record_event
+            record_event("fusion_demotion", query_id=query_id,
+                         reason="failpoint")
+            rplan = partition_regions(root, session=session, sf=sf,
+                                      mesh=mesh)
+    multi_region = len(rplan.regions) > 1
+    if multi_region:
+        stats.add("fusion_regions", len(rplan.regions))
+        collector.note("fusion_regions", len(rplan.regions))
+        plan = jfn = call_lock = None
+        fp = None
+        scan_leaves: List[N.PlanNode] = []
+        from .planner import _collect_scans
+        _collect_scans(root, scan_leaves)
+    elif use_cache:
         plan, jfn, call_lock = _compile_any(root, mesh,
                                             default_join_capacity, 1, True)
         root = plan.root  # canonical tree: node ids match plan.scan_nodes
         fp = plan_fingerprint(root)
+        scan_leaves = plan.scan_nodes
     else:
         plan, jfn, call_lock = _compile_any(root, mesh,
                                             default_join_capacity, 1, False)
         fp = None
+        scan_leaves = plan.scan_nodes
     # continuous per-kernel profiling (exec/profiler.py): every executed
     # program is attributed by its plan-cache fingerprint -- computed
     # here even for the fragment tier's uncached compiles (scan ranges /
-    # remote sources change batches, not the program's identity)
+    # remote sources change batches, not the program's identity). The
+    # region executor attributes per REGION fingerprint instead.
     from .profiler import profiling_enabled
     prof_on = profiling_enabled(session)
     fp_prof = fp
-    if prof_on and fp_prof is None:
-        from .plan_cache import plan_fingerprint
+    if prof_on and fp_prof is None and not multi_region:
         fp_prof = plan_fingerprint(root)
     adaptive_off = False
     if session is not None:
@@ -432,18 +487,18 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
         reserved = sum(
             _planned_scan_bytes(s, sf, hints.get(s.id), pad,
                                 scan_ranges.get(s.id), remote_sources)
-            for s in plan.scan_nodes)
+            for s in scan_leaves)
         memory_pool.reserve(query_id, reserved)
         stats.add("reserved_bytes", reserved)
         if prog is not None:
             prog.note_memory(reserved)
     try:
         if prog is not None:
-            prog.set_planned(len(plan.scan_nodes))
+            prog.set_planned(len(scan_leaves))
             prog.advance(stage="staging")
         with stats.timed("scan_stage_s"), collector.stage("staging"):
             batches = []
-            for si, s in enumerate(plan.scan_nodes):
+            for si, s in enumerate(scan_leaves):
                 t_scan0 = time.time()
                 if isinstance(s, N.RemoteSourceNode):
                     assert s.id in remote_sources, \
@@ -467,7 +522,7 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
     from ..plan.widths import batch_narrowed_bytes_saved, note_narrowed
     staged_rows = staged_bytes = 0
     narrowed_cols = narrowed_saved = 0
-    for si, (s, b) in enumerate(zip(plan.scan_nodes, batches)):
+    for si, (s, b) in enumerate(zip(scan_leaves, batches)):
         rows = int(np.asarray(b.active).sum())
         nbytes = batch_bytes(b)
         staged_rows += rows
@@ -504,18 +559,26 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
     # (plan fingerprint, mesh, kernel mode, shapes); never fails the
     # query.
     from ..audit.staged import audit_staged_query, kernel_audit_enabled
-    if kernel_audit_enabled(session):
+    if kernel_audit_enabled(session) and not multi_region:
         with stats.timed("kernel_audit_s"):
             audit_report = audit_staged_query(
                 plan, batches, mesh=mesh, query_id=query_id,
                 session=session, collector=collector, stats=stats,
                 memory_pool=memory_pool, plan_fp=fp)
-        if prof_on and audit_report \
-                and audit_report.get("peak_bytes_estimate"):
-            # the K005 footprint estimate rides the kernel's profile
-            # row: /v1/profile shows device time AND planned HBM appetite
-            from .profiler import note_footprint
-            note_footprint(fp_prof, audit_report["peak_bytes_estimate"])
+        if audit_report and audit_report.get("peak_bytes_estimate"):
+            # the K005 footprint estimate feeds the fusion cost model:
+            # a fused span whose measured peak exceeds
+            # kernel_audit_budget_bytes is REFUSED on its next
+            # submission (exec/regions.py footprint feedback)
+            if rplan.fused and mesh is None and rplan.regions[0].ops > 1:
+                fusion_memory().note_footprint(
+                    fp or plan_fingerprint(root),
+                    audit_report["peak_bytes_estimate"])
+            if prof_on:
+                # ... and rides the kernel's profile row: /v1/profile
+                # shows device time AND planned HBM appetite
+                from .profiler import note_footprint
+                note_footprint(fp_prof, audit_report["peak_bytes_estimate"])
     device_s = 0.0           # summed dispatch+sync wall (all reruns)
     compile_us: Optional[int] = None
     res = None
@@ -524,87 +587,31 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
     try:
         with stats.timed("execute_s"), collecting(collector), \
                 collector.stage("execute"):
-            # exchange-slot overflow (flag bit1) -> rerun with
-            # geometrically larger slots; slots clamp at the sender
-            # capacity, where overflow is impossible, so this converges.
-            # Join/group overflow (bit0) is not slot-scalable and errors
-            # out immediately. This is the memory-feedback loop the
-            # reference runs as reserve/revoke -- here it recompiles
-            # with bigger static buckets instead.
-            scale = 1
-            cap_scale = _CAPACITY_FEEDBACK.get(fp, 1) if fp else 1
-            exec_root = root if cap_scale == 1 else None  # set below
-            if cap_scale > 1:
-                # HBO-lite: a structurally identical plan overflowed
-                # before; start from the capacities that worked
-                from ..plan.stats import scale_capacities
-                exec_root = scale_capacities(root, cap_scale)
-                plan, jfn, call_lock = _compile_any(
-                    exec_root, mesh, default_join_capacity * cap_scale,
-                    1, use_cache)
-                stats.add("capacity_feedback_scale", cap_scale)
-            while True:
-                t_disp0 = time.time()
-                if jfn is None:
-                    fn = jax.jit(plan.fn)
-                    dispatch_fn = fn
-                    out, overflow = fn(tuple(batches))
-                else:
-                    dispatch_fn = jfn
-                    with call_lock:  # serialize trace-time closure state
-                        out, overflow = jfn(tuple(batches))
-                jax.block_until_ready(out)
-                # host-observed device occupancy of this dispatch: the
-                # block_until_ready delta around the existing sync point
-                # is the only per-kernel timing one fused program exposes
-                device_s += time.time() - t_disp0
-                if prog is not None:  # each landed dispatch advances
-                    prog.advance()
-                flags = int(np.asarray(overflow))
-                if flags == 0:
-                    if cap_scale > 1 and fp:
-                        _CAPACITY_FEEDBACK[fp] = cap_scale
-                    break
-                if flags & 1:
-                    # hard (join/group/unnest) overflow: adaptive rerun
-                    # with geometrically larger capacities (the
-                    # memory-feedback loop that replaces per-query hand
-                    # hints; reserve/revoke analog)
-                    if cap_scale >= _MAX_CAPACITY_SCALE or adaptive_off:
-                        hint = (" (note: connector NDV statistics shrank "
-                                "group capacities this run; set session "
-                                "stats_capacity_refinement=false if a "
-                                "hand-set max_groups must stand)"
-                                if refine else "")
-                        raise RuntimeError(
-                            "plan execution overflowed a static bucket "
-                            "(join/group capacity) beyond the adaptive "
-                            "rerun ceiling; rerun with larger capacity "
-                            "hints (max_groups / join_capacity)" + hint)
-                    from ..plan.stats import scale_capacities
-                    cap_scale *= 4
-                    stats.add("capacity_reruns", 1)
-                    exec_root = scale_capacities(root, cap_scale)
-                    scale = 1
-                    plan, jfn, call_lock = _compile_any(
-                        exec_root, mesh, default_join_capacity * cap_scale,
-                        1, use_cache)
-                    continue
-                if mesh is None or scale >= 1 << 20:  # unreachable: clamp
-                    raise RuntimeError(
-                        "exchange slot overflow did not converge")
-                scale *= 2
-                stats.add("exchange_slot_reruns", 1)
-                plan, jfn, call_lock = _compile_any(
-                    exec_root if exec_root is not None else root, mesh,
-                    default_join_capacity * cap_scale, scale, use_cache)
+            if multi_region:
+                # region executor: each pipeline region dispatches as
+                # its own program; boundaries are HBM-resident Batch
+                # handoffs (no host round trip), reruns re-dispatch
+                # only the overflowing region
+                out, device_s, compile_us = _execute_regions(
+                    rplan, scan_leaves, batches, default_join_capacity,
+                    use_cache, stats, session, adaptive_off, refine,
+                    prog, collector, query_id, trace_id, prof_on,
+                    memory_pool, plan_fp_root=plan_fingerprint(root))
+            else:
+                (out, device_s, dispatch_fn, call_lock, cap_scale,
+                 scale, plan) = _dispatch_ladder(
+                    root, plan, jfn, call_lock, batches, mesh,
+                    default_join_capacity, use_cache, fp, stats,
+                    adaptive_off, refine, prog)
         # XLA compile cost (compile-time captured via jax.monitoring; a
         # plan-cache hit naturally reports zero) + the program's
         # FLOPs / bytes-accessed from cost_analysis, memoized per plan.
         # Clamped to the execute wall that contains it (nested-jit
         # lowering events can overlap), anchored at execute start so
-        # trace timelines render the compile where it happened.
-        compile_us = collector.take_compile_us()
+        # trace timelines render the compile where it happened. The
+        # region executor drains compile incrementally per region; any
+        # remainder is folded in here.
+        compile_us = (compile_us or 0) + collector.take_compile_us()
         exec_stage = collector.stats.stages.get("execute")
         if exec_stage is not None and exec_stage.wall_us:
             compile_us = min(compile_us, exec_stage.wall_us)
@@ -614,12 +621,9 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
                 "compile", anchor, anchor + compile_us / 1e6,
                 compile_us=compile_us)
             stats.add("compile_s", compile_us / 1e6)
-        if session_flag(session, "query_cost_analysis", False):
-            if fp is None:
-                from .plan_cache import plan_fingerprint
-                fp_cost = plan_fingerprint(root)
-            else:
-                fp_cost = fp
+        if session_flag(session, "query_cost_analysis", False) \
+                and not multi_region:
+            fp_cost = fp if fp is not None else plan_fingerprint(root)
             # cap_scale distinguishes the scaled rerun's program from
             # the unscaled one (same fingerprint + shapes otherwise)
             cost = _stage_cost(dispatch_fn, batches,
@@ -627,6 +631,25 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
             if cost:
                 collector.bump_stage("compile", **cost)
                 stats.add("xla_flops", cost["flops"])
+        if rplan.fused and mesh is None and not multi_region \
+                and rplan.regions[0].ops > 1:
+            # fused-side sample for the demotion comparator: device
+            # occupancy of the fused span, compile excluded. When the
+            # profiler's samples show the fused form regressing beyond
+            # the perfgate band vs the materialized baseline, the span
+            # demotes and the NEXT submission runs materialized.
+            mem = fusion_memory()
+            span_fp = fp if fp is not None else plan_fingerprint(root)
+            mem.note_fused(span_fp,
+                           max(int(device_s * 1e6) - compile_us, 0))
+            verdict = mem.maybe_demote(span_fp)
+            if verdict is not None:
+                stats.add("fusion_demotions", 1)
+                collector.note("fusion_demotions")
+                from ..server.flight_recorder import record_event
+                record_event("fusion_demotion", query_id=query_id,
+                             reason="profiler",
+                             ratio=verdict.get("ratio"))
         if prog is not None:
             prog.advance(stage="fetch")
         with stats.timed("fetch_s"), collector.stage("fetch"):
@@ -638,13 +661,14 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
         if memory_pool is not None:
             memory_pool.free(query_id, reserved)
             peak_reserved = memory_pool.query_peak_bytes(query_id, pop=True)
-        if prof_on:
+        if prof_on and not multi_region:
             # record on success AND failure -- a failed query's device
             # time must stay attributed (its flight dump embeds these
             # rows). The captured XLA-compile wall is SUBTRACTED so
             # device_us is device occupancy, not trace+compile: a cold
             # dispatch would otherwise outrank genuinely hot kernels on
-            # every ranking surface.
+            # every ranking surface. (The region executor attributes
+            # per region fingerprint inside its loop instead.)
             cu = compile_us if compile_us is not None \
                 else collector.take_compile_us()
             from ..server.tracing import TraceContext as _TC
@@ -672,6 +696,198 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
 # submissions start at the known-good size instead of re-laddering.
 _CAPACITY_FEEDBACK: Dict[str, int] = {}
 _MAX_CAPACITY_SCALE = 1 << 10
+
+
+def _dispatch_ladder(root: N.PlanNode, plan, jfn, call_lock, batches,
+                     mesh, default_join_capacity: int, use_cache: bool,
+                     fp: Optional[str], stats, adaptive_off: bool,
+                     refine: bool, prog):
+    """The overflow->rerun dispatch loop for ONE compiled program (a
+    whole fused plan or a single pipeline region).
+
+    Exchange-slot overflow (flag bit1) -> rerun with geometrically
+    larger slots; slots clamp at the sender capacity, where overflow is
+    impossible, so this converges. Join/group overflow (bit0) reruns
+    with geometrically larger capacities up to the adaptive ceiling.
+    This is the memory-feedback loop the reference runs as
+    reserve/revoke -- here it recompiles with bigger static buckets
+    instead. Under the region executor only the overflowing REGION
+    re-dispatches; upstream regions' materialized outputs are reused.
+
+    Returns (out, device_s, dispatch_fn, call_lock, cap_scale, scale,
+    plan)."""
+    device_s = 0.0
+    scale = 1
+    cap_scale = _CAPACITY_FEEDBACK.get(fp, 1) if fp else 1
+    exec_root = root if cap_scale == 1 else None  # set below
+    if cap_scale > 1:
+        # HBO-lite: a structurally identical plan overflowed before;
+        # start from the capacities that worked
+        from ..plan.stats import scale_capacities
+        exec_root = scale_capacities(root, cap_scale)
+        plan, jfn, call_lock = _compile_any(
+            exec_root, mesh, default_join_capacity * cap_scale,
+            1, use_cache)
+        stats.add("capacity_feedback_scale", cap_scale)
+    while True:
+        t_disp0 = time.time()
+        if jfn is None:
+            fn = jax.jit(plan.fn)
+            dispatch_fn = fn
+            out, overflow = fn(tuple(batches))
+        else:
+            dispatch_fn = jfn
+            with call_lock:  # serialize trace-time closure state
+                out, overflow = jfn(tuple(batches))
+        jax.block_until_ready(out)
+        # host-observed device occupancy of this dispatch: the
+        # block_until_ready delta around the existing sync point is the
+        # only per-kernel timing one fused program exposes
+        device_s += time.time() - t_disp0
+        if prog is not None:  # each landed dispatch advances
+            prog.advance()
+        flags = int(np.asarray(overflow))
+        if flags == 0:
+            if cap_scale > 1 and fp:
+                _CAPACITY_FEEDBACK[fp] = cap_scale
+            break
+        if flags & 1:
+            # hard (join/group/unnest) overflow: adaptive rerun with
+            # geometrically larger capacities (the memory-feedback loop
+            # that replaces per-query hand hints; reserve/revoke analog)
+            if cap_scale >= _MAX_CAPACITY_SCALE or adaptive_off:
+                hint = (" (note: connector NDV statistics shrank "
+                        "group capacities this run; set session "
+                        "stats_capacity_refinement=false if a "
+                        "hand-set max_groups must stand)"
+                        if refine else "")
+                raise RuntimeError(
+                    "plan execution overflowed a static bucket "
+                    "(join/group capacity) beyond the adaptive "
+                    "rerun ceiling; rerun with larger capacity "
+                    "hints (max_groups / join_capacity)" + hint)
+            from ..plan.stats import scale_capacities
+            cap_scale *= 4
+            stats.add("capacity_reruns", 1)
+            exec_root = scale_capacities(root, cap_scale)
+            scale = 1
+            plan, jfn, call_lock = _compile_any(
+                exec_root, mesh, default_join_capacity * cap_scale,
+                1, use_cache)
+            continue
+        if mesh is None or scale >= 1 << 20:  # unreachable: clamp
+            raise RuntimeError(
+                "exchange slot overflow did not converge")
+        scale *= 2
+        stats.add("exchange_slot_reruns", 1)
+        plan, jfn, call_lock = _compile_any(
+            exec_root if exec_root is not None else root, mesh,
+            default_join_capacity * cap_scale, scale, use_cache)
+    return out, device_s, dispatch_fn, call_lock, cap_scale, scale, plan
+
+
+def _execute_regions(rplan, scan_leaves, batches, default_join_capacity,
+                     use_cache, stats, session, adaptive_off, refine,
+                     prog, collector, query_id, trace_id, prof_on,
+                     memory_pool, plan_fp_root: str):
+    """Materialized region executor (exec/regions.py partition): run
+    each pipeline region as its own compiled-and-cached program in
+    producer order. Region outputs stay DEVICE-resident Batches handed
+    to downstream regions' programs -- a materialized block boundary in
+    HBM, never a host round trip. Per-region: the plan cache keys on
+    the region fingerprint, the kernel auditor (when armed) audits the
+    region's program and feeds its K005 peak into the fusion cost
+    model, and the continuous profiler attributes device time to the
+    region with its plan-node chain + region tag as provenance.
+
+    Returns (final output Batch, total device seconds, total compile
+    micros drained so far)."""
+    from ..audit.staged import audit_staged_query, kernel_audit_enabled
+    from ..server.tracing import TraceContext as _TC
+    from ..utils.config import session_flag
+    from .plan_cache import plan_fingerprint
+    from .profiler import note_footprint, plan_label, plan_tables, \
+        record_call
+    from .regions import fusion_memory
+    staged_by_id = {id(n): b for n, b in zip(scan_leaves, batches)}
+    outputs: Dict[int, Batch] = {}
+    # consumer refcounts: a materialized intermediate is dropped after
+    # its LAST consumer dispatches, so peak HBM in per-op mode is the
+    # max live set, not the sum of every boundary in the chain
+    consumers: Dict[int, int] = {}
+    for reg in rplan.regions:
+        for i in reg.inputs:
+            if i.kind == "region":
+                consumers[i.region] = consumers.get(i.region, 0) + 1
+    total_device_s = 0.0
+    total_compile_us = 0
+    audit_on = kernel_audit_enabled(session)
+    cost_on = session_flag(session, "query_cost_analysis", False)
+    nreg = len(rplan.regions)
+    for reg in rplan.regions:
+        rbatches = [staged_by_id[id(i.node)] if i.kind == "scan"
+                    else outputs[i.region] for i in reg.inputs]
+        plan, jfn, call_lock = _compile_any(reg.root, None,
+                                            default_join_capacity, 1,
+                                            use_cache)
+        rfp = plan_fingerprint(reg.root)
+        if audit_on:
+            with stats.timed("kernel_audit_s"):
+                report = audit_staged_query(
+                    plan, rbatches, mesh=None, query_id=query_id,
+                    session=session, collector=collector, stats=stats,
+                    memory_pool=memory_pool, plan_fp=rfp)
+            if report and report.get("peak_bytes_estimate"):
+                fusion_memory().note_footprint(
+                    rfp, report["peak_bytes_estimate"])
+                if prof_on:
+                    note_footprint(rfp, report["peak_bytes_estimate"])
+        out, dev_s, dispatch_fn, dlock, cap_scale, scale, _ = \
+            _dispatch_ladder(
+                reg.root, plan, jfn, call_lock, rbatches, None,
+                default_join_capacity, use_cache, rfp, stats,
+                adaptive_off, refine, prog)
+        if cost_on and collector is not None:
+            # per-region XLA cost analysis: the fused path's FLOPs /
+            # bytes-accessed split, summed region by region so EXPLAIN
+            # ANALYZE keeps its compile-stage roofline inputs under
+            # fusion=0 / refusal / demotion
+            cost = _stage_cost(dispatch_fn, rbatches,
+                               (rfp, cap_scale, scale), dlock)
+            if cost:
+                collector.bump_stage("compile", **cost)
+                stats.add("xla_flops", cost["flops"])
+        outputs[reg.index] = out
+        for i in reg.inputs:  # drop intermediates past their last use
+            if i.kind == "region":
+                consumers[i.region] -= 1
+                if consumers[i.region] == 0:
+                    outputs.pop(i.region, None)
+        total_device_s += dev_s
+        # incremental compile drain: what accumulated since the last
+        # region dispatched is this region's trace+compile share
+        cu = collector.take_compile_us() if collector is not None else 0
+        total_compile_us += cu
+        dev_us = max(int(dev_s * 1e6) - cu, 0)
+        stats.add(f"fusion_region_{reg.tag}_device_us", dev_us)
+        if prof_on:
+            record_call(
+                rfp,
+                label=(f"{plan_label(reg.root, max_len=120)} "
+                       f"[region {reg.tag}/{nreg}]"),
+                tables=plan_tables(reg.root),
+                device_us=dev_us, retraced=cu > 0, query_id=query_id,
+                trace_id=trace_id.trace_id if isinstance(trace_id, _TC)
+                else (trace_id or query_id))
+    # materialized-baseline sample for the demotion comparator: the
+    # whole span just ran with materialized boundaries, so its total
+    # device time is the unfused side of the span's fused-vs-unfused
+    # comparison (keyed by the fingerprint the span fuses to)
+    fusion_memory().note_unfused(
+        plan_fp_root,
+        max(int(total_device_s * 1e6) - total_compile_us, 0))
+    return (outputs[rplan.regions[-1].index], total_device_s,
+            total_compile_us)
 
 
 def _scan_key(index: int, node: N.PlanNode) -> str:

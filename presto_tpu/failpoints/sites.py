@@ -84,6 +84,13 @@ SITES: Dict[str, Tuple[str, str]] = {
         "statement",
         "statement-tier engine execution entry (StatementServer."
         "_run_engine): hang here pins the client's poll deadline"),
+    "fusion.demote": (
+        "fusion",
+        "pipeline-region fusion gate (exec/runner.py, before dispatch "
+        "of a fused multi-op region): an error action forces the span "
+        "to DEMOTE mid-query -- the query re-partitions and runs with "
+        "materialized boundaries, and the demotion sticks for later "
+        "submissions (exec/regions.FusionMemory)"),
 }
 
 

@@ -427,6 +427,11 @@ def _seg_add(ids, contrib, max_groups: int,
     matmuls for integers (batched across aggregates through the ambient
     pool when one is installed), per-group masked reductions for
     floats."""
+    if max_groups == 1:
+        # global aggregation: ONE group -- a plain reduction beats any
+        # scatter/matmul on every backend (contrib is pre-masked, and
+        # integer sums here are exact by the callers' limb discipline)
+        return jnp.sum(contrib)[None]
     if max_groups <= _SMALL_G and _scatter_free():
         if contrib.dtype in (jnp.int64, jnp.int32):
             pool = _seg_pool()
@@ -446,6 +451,8 @@ def _seg_add(ids, contrib, max_groups: int,
 
 def _seg_count(ids, flags, max_groups: int) -> jnp.ndarray:
     """Per-group count of True flags (int64)."""
+    if max_groups == 1:
+        return jnp.sum(flags.astype(jnp.int64))[None]
     if max_groups <= _SMALL_G and _scatter_free():
         pool = _seg_pool()
         if pool is not None and ids is pool.ids:
@@ -459,6 +466,8 @@ def _seg_count(ids, flags, max_groups: int) -> jnp.ndarray:
 
 def _seg_min(ids, contrib, max_groups: int, ident) -> jnp.ndarray:
     """Per-group min of `contrib` (dead rows pre-masked to `ident`)."""
+    if max_groups == 1:
+        return jnp.min(contrib)[None]
     if max_groups <= _SMALL_G and _scatter_free():
         return jnp.stack([jnp.min(jnp.where(ids == g, contrib, ident))
                           for g in range(max_groups)])
@@ -466,6 +475,8 @@ def _seg_min(ids, contrib, max_groups: int, ident) -> jnp.ndarray:
 
 
 def _seg_max(ids, contrib, max_groups: int, ident) -> jnp.ndarray:
+    if max_groups == 1:
+        return jnp.max(contrib)[None]
     if max_groups <= _SMALL_G and _scatter_free():
         return jnp.stack([jnp.max(jnp.where(ids == g, contrib, ident))
                           for g in range(max_groups)])
@@ -484,11 +495,15 @@ def _sum128(ids, col, live, max_groups: int):
     if isinstance(col, Int128Column):
         limbs = limbs13_of_128(col.hi, col.lo)  # 10 x int64
     else:
-        limbs = limbs13_of_i64(col.values)  # 5 x int64
-    # each 13-bit limb (signed top) fits 14 bits -- the pooled/matmul
-    # forms split no wider than needed at accumulation time
+        # lane-width-proven limb count: narrowed int16/int32 lanes need
+        # 2/3 limbs, not int64's 5 (the fused-pool matmul width and the
+        # scatter count shrink with them)
+        limbs = limbs13_of_i64(col.values, _nlimbs13(col.values))
+    # every limb's magnitude is < 2^13 (signed top included), so one
+    # 13-bit request suffices: f32 chunk sums stay exact
+    # (2048 * 8191 < 2^24) and the bf16 form splits to its 8-bit limbs
     totals = [_seg_add(ids, jnp.where(live, l, 0), max_groups,
-                       value_bits=14)
+                       value_bits=13)
               for l in limbs]
     return combine_limb_totals_128(jnp.stack(totals, axis=-1))
 
@@ -738,7 +753,9 @@ def _sorted_states(spec: AggSpec, scol, live, start, end, new_seg,
         if isinstance(scol, Int128Column):
             limbs = limbs13_of_128(scol.hi, scol.lo)
         else:
-            limbs = limbs13_of_i64(scol.values)
+            # lane-width-proven limb count (see _nlimbs13): narrowed
+            # lanes pay 2-3 cumsums here instead of int64's 5
+            limbs = limbs13_of_i64(scol.values, _nlimbs13(scol.values))
         totals = [_seg_total(jnp.where(live, l, 0), start, end)
                   for l in limbs]
         hi, lo = combine_limb_totals_128(jnp.stack(totals, axis=-1))
@@ -751,9 +768,10 @@ def _sorted_states(spec: AggSpec, scol, live, start, end, new_seg,
     if name in ("sum", "avg"):
         sv = v.astype(_sum_dtype(scol.type))
         if sv.dtype == jnp.int64:
-            # 13-bit limb cumsums keep every intermediate exact
+            # 13-bit limb cumsums keep every intermediate exact; the
+            # limb count follows the lane's proven width (_nlimbs13)
             from ..int128 import limbs13_of_i64
-            limbs = limbs13_of_i64(sv)
+            limbs = limbs13_of_i64(sv, _nlimbs13(v))
             tot = jnp.zeros(g, dtype=jnp.int64)
             for li, l in enumerate(limbs):
                 tot = tot + (_seg_total(jnp.where(live, l, 0), start, end)
@@ -943,6 +961,28 @@ def _sum_dtype(ty: T.Type):
     return jnp.int64
 
 
+def _lane_bits(values) -> int:
+    """Proven bit width of a value lane: the PHYSICAL dtype's width.
+    Narrow-width execution stages range-proven columns at int8/16/32
+    lanes (plan/widths.py), so the staged dtype is itself a proof of
+    the value range -- the exact-sum limb decompositions need only
+    cover it (int16 lanes: 2 13-bit limbs, not int64's 5), shrinking
+    the one-hot matmul / scatter / cumsum work per aggregate."""
+    dt = jnp.dtype(values.dtype) if hasattr(values, "dtype") else None
+    if dt is not None and dt.kind in "iu":
+        return dt.itemsize * 8
+    if dt is not None and dt.kind == "b":
+        return 1
+    return 64
+
+
+def _nlimbs13(values) -> int:
+    """13-bit limbs covering a lane's proven width (signed top limb:
+    ceil(bits/13) limbs span bits+ (13-bits%13) with the sign riding
+    the arithmetic-shift remainder -- int64's historical 5)."""
+    return max(-(-_lane_bits(values) // 13), 1)
+
+
 def _acc_columns(spec: AggSpec, col: Optional[Block], ids, active, max_groups: int,
                  batch: Optional[Batch] = None,
                  overflow_out: Optional[list] = None) -> List[Tuple[str, Column]]:
@@ -1040,7 +1080,8 @@ def _acc_columns(spec: AggSpec, col: Optional[Block], ids, active, max_groups: i
     v = col.values
     if name == "sum" or name == "avg":
         sv = v.astype(_sum_dtype(col.type))
-        s = _seg_add(ids, jnp.where(live, sv, sv.dtype.type(0)), g)
+        s = _seg_add(ids, jnp.where(live, sv, sv.dtype.type(0)), g,
+                     value_bits=_lane_bits(v))
         out = [("sum", Column(s, no_input, spec.output_type if name == "sum"
                               else _sum_type(col.type)))]
         if name == "avg":
@@ -1245,6 +1286,12 @@ def group_by(batch: Batch, key_channels: Sequence[int], aggs: Sequence[AggSpec],
 
     Global aggregation (no keys) always yields exactly one group, even
     over zero input rows -- SQL's `SELECT count(*) ... -> 0` contract."""
+    if not key_channels:
+        # global aggregation: exactly one group, ever. A wider declared
+        # capacity (the planner's generic max_groups default) would pad
+        # EVERY accumulator table and scatter/einsum to it -- q6's
+        # whole aggregate state is one row, not 2^16
+        max_groups = 1
     if max_groups > _SMALL_G and _LARGE_G_MODE == "sort" \
             and _sorted_capable(batch, key_channels, aggs):
         return _group_by_sorted(batch, key_channels, aggs, max_groups)

@@ -76,17 +76,82 @@ def _keys(keys) -> str:
                      for c, d, nl in keys)
 
 
-def explain(root: N.PlanNode) -> str:
-    """Single-plan tree rendering (EXPLAIN (TYPE LOGICAL) analog)."""
+def explain(root: N.PlanNode, *, regions: bool = False, session=None,
+            sf: float = 0.01, mesh=None) -> str:
+    """Single-plan tree rendering (EXPLAIN (TYPE LOGICAL) analog).
+    With ``regions=True`` the plan is first SHAPED exactly as execution
+    shapes it (exec.runner.prepare_plan -- region fingerprints and
+    demotion/footprint state key on the executed tree, so partitioning
+    the raw logical tree would render decisions the engine never
+    makes), then each operator line carries the pipeline region it
+    fuses into plus the per-region summary tail -- the statement tier's
+    plain EXPLAIN opts in so fusion decisions are inspectable without
+    executing."""
+    node_region: dict = {}
+    rplan = None
+    if regions and not _is_write_root(root):
+        # write/DDL roots are never partitioned by execution (they run
+        # host-side and only their inner SELECT re-enters run_query) --
+        # annotating them would render regions the engine never forms
+        from ..exec.regions import partition_regions
+        from ..exec.runner import prepare_plan
+        root = prepare_plan(root, sf=sf, mesh=mesh, session=session)
+        rplan = partition_regions(root, session=session, sf=sf, mesh=mesh)
+        node_region = rplan.node_region
     lines: List[str] = []
 
     def walk(n: N.PlanNode, depth: int):
-        lines.append("    " * depth + "- " + _node_line(n))
+        tag = ""
+        if id(n) in node_region:
+            tag = f"  [region=R{node_region[id(n)]}]"
+        lines.append("    " * depth + "- " + _node_line(n) + tag)
         for s in n.sources:
             walk(s, depth + 1)
 
     walk(root, 0)
+    if rplan is not None:
+        lines.extend(_region_lines(rplan, None, sf))
     return "\n".join(lines)
+
+
+def _is_write_root(root: N.PlanNode) -> bool:
+    """Mirrors exec.runner._run_query_inner's write/DDL routing: these
+    roots execute host-side and never partition into regions."""
+    inner = root.source if isinstance(root, N.OutputNode) else root
+    return isinstance(inner, (N.DdlNode, N.TableFinishNode,
+                              N.TableWriterNode, N.TableRewriteNode))
+
+
+def _region_lines(rplan, runtime_counters, sf: float) -> List[str]:
+    """The '-- regions --' tail: one line per pipeline region with its
+    fused-op count, boundary reason, fingerprint, footprint estimates
+    (static + measured K005 when the auditor has seen it) and -- when
+    the query executed materialized -- the region's device wall."""
+    from ..exec.plan_cache import plan_fingerprint
+    from ..exec.regions import estimate_region_bytes, fusion_memory
+    lines = ["", f"-- regions ({len(rplan.regions)}, "
+                 f"fusion {'on' if rplan.fused else 'off'}) --"]
+    mem = fusion_memory()
+    for reg in rplan.regions:
+        fp = plan_fingerprint(reg.root)
+        extra = ""
+        measured = mem.footprint(fp)
+        if measured:
+            extra += f" k005Peak={_fmt_bytes(measured)}"
+        demoted = mem.demoted(fp)
+        if demoted:
+            extra += " demoted"
+        if runtime_counters:
+            dev = runtime_counters.get(
+                f"fusion_region_{reg.tag}_device_us")
+            if dev:
+                extra += f" device={int(dev['total'])}us"
+        lines.append(f"{reg.tag}: ops={reg.ops} reason={reg.reason} "
+                     f"fingerprint={fp[:12]} "
+                     f"estPeak={_fmt_bytes(estimate_region_bytes(reg, sf))}"
+                     f"{extra}")
+        lines.append(f"    {reg.span}")
+    return lines
 
 
 def _fmt_bytes(n: int) -> str:
@@ -109,11 +174,13 @@ def _collect_scan_leaves(root: N.PlanNode) -> List[N.PlanNode]:
     return out
 
 
-def _annotated_tree(root: N.PlanNode, qs, sf: float) -> str:
+def _annotated_tree(root: N.PlanNode, qs, sf: float,
+                    node_region=None) -> str:
     from .stats import estimate_rows
 
     scan_index = {id(n): i for i, n in enumerate(_collect_scan_leaves(root))}
     ops = qs.operators if qs is not None else {}
+    node_region = node_region or {}
     lines: List[str] = []
     seen = set()
 
@@ -147,7 +214,9 @@ def _annotated_tree(root: N.PlanNode, qs, sf: float) -> str:
             lines.append(line + "  [shared subtree]")
             return
         seen.add(id(n))
-        lines.append(line + annotate(n, is_root))
+        tag = f"  [region=R{node_region[id(n)]}]" \
+            if id(n) in node_region else ""
+        lines.append(line + annotate(n, is_root) + tag)
         for s in n.sources:
             walk(s, depth + 1, False)
 
@@ -173,7 +242,21 @@ def explain_analyze(root: N.PlanNode, sf: float = 0.01, **kwargs) -> str:
     res = run_query(executed, sf=sf, session=session, prepared=True,
                     **kwargs)
     qs = res.query_stats
-    lines = [_annotated_tree(executed, qs, sf)]
+    # region grouping (exec/regions.py): re-partition the executed tree
+    # under the same session/kernel mode -- deterministic, so the
+    # annotation matches what ran (modulo a demotion this very run
+    # recorded, which the NEXT run and this tail both reflect). Write
+    # roots never partition (they execute host-side).
+    rplan = None
+    if not _is_write_root(executed):
+        from ..exec.regions import partition_regions
+        rplan = partition_regions(executed, session=session, sf=sf,
+                                  mesh=mesh)
+    lines = [_annotated_tree(executed, qs, sf,
+                             node_region=rplan.node_region
+                             if rplan else None)]
+    if rplan is not None:
+        lines.extend(_region_lines(rplan, res.stats, sf))
     if qs is not None:
         lines += ["", "-- stages --"]
         for name in ("staging", "compile", "execute", "exchange", "fetch"):

@@ -497,8 +497,11 @@ class StatementServer:
             inner = q.text[m.end():].strip()
             sf = float(q.session_values.get("sf", self.sf))
             q.machine.to_running()
-            text = explain_analyze(plan_sql(inner), sf=sf) if m.group(1) \
-                else explain_plan(plan_sql(inner))
+            text = explain_analyze(plan_sql(inner), sf=sf,
+                                   session=q.session_values) \
+                if m.group(1) \
+                else explain_plan(plan_sql(inner), regions=True,
+                                  session=q.session_values, sf=sf)
             q.columns = [{"name": "Query Plan", "type": "varchar"}]
             q.rows = [[line] for line in text.splitlines()]
             q.machine.to_finishing()

@@ -183,6 +183,16 @@ SESSION_PROPERTIES = (
          "int32/int16/int8) -- bit-exact, every compute site widens "
          "before arithmetic; env PRESTO_TPU_NARROW=0 disables globally "
          "including the bf16/fused kernel forms")
+    .add("fusion", "bool", True,
+         "pipeline-region fusion (exec/regions.py): stage each plan "
+         "fragment's operator chain as ONE XLA program per pipeline "
+         "region, with fusion-plan choice (what to fuse vs materialize) "
+         "driven by K005 footprint estimates against "
+         "kernel_audit_budget_bytes and the continuous profiler's "
+         "per-fingerprint device time (regressing fused regions demote "
+         "back to materialized boundaries). false = one program per "
+         "operator, the A/B + bisection mode (env PRESTO_TPU_FUSION, "
+         "registered in KERNEL_MODE_ENVS)")
     .add("query_cost_analysis", "bool", False,
          "annotate QueryStats' compile stage with XLA cost_analysis "
          "FLOPs / bytes-accessed (costs one extra program trace per "

@@ -96,6 +96,7 @@ OP_ROUNDS = [
     ("statement", "fail_dump"),
     ("statement", "hang_deadline"),
     ("task", "stuck"),
+    ("fusion", "demote"),
 ]
 
 
@@ -433,6 +434,46 @@ class ChaosRun:
                           "stuck_progress flight event")
                 return "NO_FLIGHT_EVENT"
             return "match+stuck_detected"
+        if op == "demote":
+            # forced mid-query fusion demotion (PR 11): the
+            # fusion.demote failpoint demotes the first fused multi-op
+            # span a worker dispatches; that query must STILL match its
+            # oracle (the materialized region executor is bit-identical
+            # to the fused program), the demotion must land as a
+            # fusion_demotion flight event, and the round clears the
+            # sticky demotion afterwards so later rounds run fused
+            from presto_tpu.exec.regions import fusion_memory
+            step["site"], step["spec"] = "fusion.demote", "error:once"
+            n = min(self.oracles)  # deterministic query choice
+            cluster.arm(step["site"], step["spec"])
+            try:
+                def go():
+                    cols, _ = cluster.coordinator.execute(
+                        self.plans[n], sf=self.sf,
+                        timeout=self.args.timeout)
+                    return canon_rows(cols)
+                status, value = Watchdog(go, self.args.timeout + 30).run()
+            finally:
+                demoted = fusion_memory().snapshot()["demoted"]
+                fusion_memory().clear()
+            if status == "hung":
+                self.fail(f"fusion round: q{n} HUNG past the deadline")
+                return "HUNG"
+            if status == "error":
+                return f"clean_failure:{type(value).__name__}"
+            if value != self.oracles[n]:
+                self.fail(f"fusion round: q{n} under forced demotion "
+                          f"returned WRONG rows")
+                return "WRONG_RESULT"
+            if not demoted:
+                self.fail("fusion round: the demote failpoint fired "
+                          "but no span was demoted")
+                return "NOT_DEMOTED"
+            if not get_flight_recorder().events(kind="fusion_demotion"):
+                self.fail("fusion round: demotion without a "
+                          "fusion_demotion flight event")
+                return "NO_FLIGHT_EVENT"
+            return "match+demoted"
         if op == "hang_deadline":
             step["site"], step["spec"] = \
                 "statement.execute", "hang(1500):once"
@@ -555,7 +596,7 @@ class ChaosRun:
                    "correct_or_clean": not any(
                        "WRONG" in r["outcome"] or r["outcome"] in
                        ("HUNG", "NOT_RECOVERED", "NO_TIMEOUT", "UNFIRED",
-                        "UNDETECTED", "NO_FLIGHT_EVENT")
+                        "UNDETECTED", "NO_FLIGHT_EVENT", "NOT_DEMOTED")
                        for r in self.rounds),
                    "no_counter_decrease": not any(
                        "counter decreased" in f for f in self.failures),

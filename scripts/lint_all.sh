@@ -24,7 +24,9 @@ python "$here/tpulint.py" "$@"
 t=$?
 [ "$t" -gt "$rc" ] && rc=$t
 
-python "$here/kernaudit.py" "$@"
+# the corpus gate audits the IR the engine actually dispatches:
+# pipeline-region fusion ON, so fused jaxprs are what K001-K005 walk
+PRESTO_TPU_FUSION=1 python "$here/kernaudit.py" "$@"
 k=$?
 [ "$k" -gt "$rc" ] && rc=$k
 

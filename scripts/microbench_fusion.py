@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Micro-benchmark the pipeline-region fusion executor (exec/regions.py).
+
+The oracle-checked A/B grid the fusion PR gates on:
+
+    (fused | per-op materialized) x (narrow on | off) x (q1 | q6 chains)
+
+Each cell runs the REAL front door (SQL -> prepare_plan -> region
+partition -> region executor) at MB_SF, times end-to-end wall over
+MB_ITERS repeats (plan cache warm after the first), and reads the
+engine's own QueryStats for the execute-stage split and the region
+count -- so the grid measures exactly what ships, not a lab kernel.
+Every cell's rows are asserted equal to the fused-narrow baseline
+cell's (bit-exact fusion law, the same invariant
+tests/test_fusion_regions.py pins across TPC-H q1-q22).
+
+Env knobs: MB_SF (default 0.05), MB_ITERS (default 3).
+``--json`` emits one machine-readable line (PERF.md / BENCH artifact
+paste material).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import presto_tpu  # noqa: F401  (x64 on, before any array is created)
+
+SF = float(os.environ.get("MB_SF", "0.05"))
+ITERS = int(os.environ.get("MB_ITERS", "3"))
+
+# q1: the scan->filter->project->agg->sort fusion flagship; q6: the
+# pure filter->project->global-agg chain (no group table at all)
+QUERIES = (1, 6)
+
+
+def canon(res):
+    # QueryResult.canonical_rows: the shared oracle canonicalization
+    return res.canonical_rows(digits=3)
+
+
+def run_cell(qnum, narrow, fused):
+    """One grid cell: ITERS timed runs; returns (canon rows, metrics)."""
+    from presto_tpu.queries.tpch_sql import tpch_query
+    from presto_tpu.sql import sql as run_sql
+
+    q = tpch_query(qnum)
+    os.environ["PRESTO_TPU_NARROW"] = "1" if narrow else "0"
+    try:
+        session = {"fusion": bool(fused)}
+        walls, res = [], None
+        kw = dict(max_groups=q.max_groups)
+        if q.join_capacity:
+            kw["join_capacity"] = q.join_capacity
+        cold0 = time.time()
+        res = run_sql(q.text, sf=SF, session=session, **kw)
+        cold_s = time.time() - cold0
+        for _ in range(ITERS):
+            t0 = time.time()
+            res = run_sql(q.text, sf=SF, session=session, **kw)
+            walls.append(time.time() - t0)
+        qs = res.query_stats
+        regions = int((res.stats.get("fusion_regions") or {}).get("max", 1))
+        metrics = {
+            "query": f"q{qnum}",
+            "fusion": "fused" if fused else "per-op",
+            "narrow": bool(narrow),
+            "cold_wall_s": round(cold_s, 4),
+            "warm_wall_s": round(float(np.median(walls)), 4),
+            "execute_s": round(qs.stage_us("execute") / 1e6, 4),
+            "staging_s": round(qs.stage_us("staging") / 1e6, 4),
+            "regions": regions,
+        }
+        return canon(res), metrics
+    finally:
+        os.environ.pop("PRESTO_TPU_NARROW", None)
+
+
+def main() -> int:
+    import jax
+    platform = jax.devices()[0].platform
+    rows = []
+    oracles = {}
+    for qnum in QUERIES:
+        for narrow in (True, False):
+            for fused in (True, False):
+                got, metrics = run_cell(qnum, narrow, fused)
+                if qnum not in oracles:
+                    oracles[qnum] = got
+                elif got != oracles[qnum]:
+                    print(f"ORACLE MISMATCH: q{qnum} "
+                          f"fusion={metrics['fusion']} "
+                          f"narrow={narrow}", file=sys.stderr)
+                    return 1
+                rows.append(metrics)
+    doc = {"platform": platform, "sf": SF, "iters": ITERS,
+           "oracle": "all cells bit-equal per query", "cells": rows}
+    if "--json" in sys.argv:
+        print(json.dumps(doc, sort_keys=True))
+        return 0
+    print(f"platform={platform} sf={SF} iters={ITERS} "
+          f"(fused-vs-materialized A/B; oracle-checked)")
+    print(f"{'cell':34s} {'cold':>8s} {'warm':>8s} {'execute':>9s} "
+          f"{'staging':>9s} {'regions':>8s}")
+    for m in rows:
+        name = (f"{m['query']} {m['fusion']}"
+                f"{' narrow' if m['narrow'] else ' wide'}")
+        print(f"{name:34s} {m['cold_wall_s']:7.3f}s {m['warm_wall_s']:7.3f}s "
+              f"{m['execute_s']:8.4f}s {m['staging_s']:8.4f}s "
+              f"{m['regions']:8d}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
