@@ -100,7 +100,11 @@ SCHEMA = {
                    "peak_memory_bytes": T.BIGINT,
                    "progress_percent": T.DOUBLE,
                    "elapsed_ms": T.BIGINT,
-                   "last_advance_age_ms": T.BIGINT},
+                   "last_advance_age_ms": T.BIGINT,
+                   # straggler-mitigation provenance: TRUE when this
+                   # entry is a speculative re-execution racing its
+                   # original (coordinator `.spec` task ids)
+                   "speculative": T.BOOLEAN},
     "tasks": {"task_id": _V, "state": _V, "rows": T.BIGINT,
               "buffered_pages": T.BIGINT, "elapsed_s": T.DOUBLE,
               "output_bytes": T.BIGINT, "peak_memory_bytes": T.BIGINT,
@@ -173,7 +177,8 @@ def _rows_of(table: str) -> List[tuple]:
                  int(e["splitsPlanned"]), int(e["rows"]),
                  int(e["bytes"]), int(e["peakMemoryBytes"]),
                  float(e["progressPercent"]), int(e["elapsedMs"]),
-                 int(e["lastAdvanceAgeMs"]))
+                 int(e["lastAdvanceAgeMs"]),
+                 bool(e.get("speculative", False)))
                 for e in live_snapshots()]
     if table == "tasks":
         out = []

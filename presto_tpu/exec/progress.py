@@ -75,6 +75,11 @@ class TaskProgress:
         self.query = query        # owning query/trace id (cross-link)
         self.worker = worker      # origin node for remote-noted entries
         self.remote = remote
+        # speculative-attempt provenance: the coordinator names every
+        # straggler re-execution `<taskId>.spec[...]`, so the live
+        # surfaces (system.live_tasks, /v1/cluster) can render which
+        # in-flight work is a speculation racing its original
+        self.speculative = ".spec" in self.key
         self.started_at = time.time()
         self.stage = "start"
         self.splits_planned = 0
@@ -167,6 +172,7 @@ class TaskProgress:
                 "kind": self.kind,
                 "query": self.query or self.key,
                 "worker": self.worker,
+                "speculative": self.speculative,
                 "state": (self.final_state or "FINISHED") if self.done
                          else "RUNNING",
                 "stage": self.stage,
@@ -351,4 +357,6 @@ def aggregate_query_progress(keys: Iterable[str]) -> Optional[dict]:
         "lastAdvanceAgeMs": min(d["lastAdvanceAgeMs"] for d in docs),
         "tasks": len(docs),
         "runningTasks": sum(1 for d in docs if d["state"] == "RUNNING"),
+        "speculativeTasks": sum(1 for d in docs
+                                if d.get("speculative")),
     }

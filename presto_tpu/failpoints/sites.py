@@ -84,6 +84,26 @@ SITES: Dict[str, Tuple[str, str]] = {
         "statement",
         "statement-tier engine execution entry (StatementServer."
         "_run_engine): hang here pins the client's poll deadline"),
+    "discovery.unannounce_lost": (
+        "discovery",
+        "graceful-goodbye DELETE (Announcer.stop unannounce): an error "
+        "here loses the unannouncement, so the node lingers in "
+        "discovery until its announcement ages out -- the silent-"
+        "age-out path the elastic-fleet membership code must survive"),
+    "worker.drain_stall": (
+        "fleet",
+        "graceful-drain migration step (TpuWorkerServer.begin_drain, "
+        "after running tasks settle, before buffered pages migrate): "
+        "delay/hang = a drain stuck behind a slow peer, error = a "
+        "migration hop that dies mid-drain (pages stay local and are "
+        "served until consumed -- drain degrades, never loses pages)"),
+    "coordinator.heartbeat_lapse": (
+        "fleet",
+        "coordinator->resource-manager heartbeat send "
+        "(ClusterStateSender.send_once): error = a lost heartbeat; "
+        "enough consecutive losses age the primary out of the RM view "
+        "and the standby's failover monitor takes over statement "
+        "execution (server/resource_manager.StandbyCoordinator)"),
     "fusion.demote": (
         "fusion",
         "pipeline-region fusion gate (exec/runner.py, before dispatch "

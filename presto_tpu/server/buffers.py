@@ -91,6 +91,41 @@ class SpoolingOutputBuffer:
         """All pages as bytes (fragment-result-cache capture)."""
         return [self.get(i) for i in range(len(self._entries))]
 
+    def stream_checksum(self) -> str:
+        """Order-sensitive digest of the live page stream -- the
+        exactly-once witness graceful drain is audited against: a
+        migrated buffer must replay byte-identical pages in the same
+        order (tests checksum before drain and after the redirected
+        fetch)."""
+        import hashlib
+        h = hashlib.sha256()
+        for i in range(len(self._entries)):
+            page = self.get(i)
+            h.update(len(page).to_bytes(8, "little"))
+            h.update(page)
+        return h.hexdigest()
+
+    def export_pages(self) -> List[str]:
+        """Live (un-acked) pages as base64 strings -- the drain
+        migration wire format (spooled entries read back from the
+        spool file; the acked prefix was already dropped and is NOT
+        exported, so a consumer resuming mid-stream never re-reads)."""
+        import base64
+        return [base64.b64encode(self.get(i)).decode("ascii")
+                for i in range(len(self._entries))]
+
+    def restore_pages(self, encoded: List[str]) -> int:
+        """Adopt a migrated page stream (inverse of export_pages) into
+        this (empty) buffer; returns the byte total. Pages re-spool
+        locally past the memory threshold like any append."""
+        import base64
+        total = 0
+        for s in encoded:
+            page = base64.b64decode(s)
+            self.append(page)
+            total += len(page)
+        return total
+
     # -- lifecycle ---------------------------------------------------------
 
     def drop_prefix(self, n: int) -> None:

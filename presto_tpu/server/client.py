@@ -52,6 +52,7 @@ class WorkerClient:
         from .auth import make_authenticator
         self.base = base_url.rstrip("/")
         self.timeout = timeout
+        self._secret = shared_secret  # re-target (moved pages) clients
         self._auth = make_authenticator(shared_secret, "client")
         u = urllib.parse.urlsplit(self.base)
         self._scheme = u.scheme or "http"
@@ -164,6 +165,30 @@ class WorkerClient:
                                 json.dumps(body).encode())
         return json.loads(data)
 
+    def migrate(self, task_id: str, doc: dict) -> dict:
+        """Offer a finished task's buffered pages for adoption
+        (graceful-drain migration hop; POST /v1/task/{id}/migrate)."""
+        data, _ = self._request("POST", f"/v1/task/{task_id}/migrate",
+                                json.dumps(doc).encode())
+        return json.loads(data)
+
+    def drain(self, migrate_to: Optional[str] = None,
+              timeout_ms: Optional[float] = None) -> dict:
+        """Start the worker's graceful drain (POST /v1/worker/drain);
+        returns the drain-status document."""
+        body = {}
+        if migrate_to:
+            body["migrateTo"] = migrate_to
+        if timeout_ms is not None:
+            body["timeoutMs"] = float(timeout_ms)
+        data, _ = self._request("POST", "/v1/worker/drain",
+                                json.dumps(body).encode())
+        return json.loads(data)
+
+    def drain_status(self) -> dict:
+        data, _ = self._request("GET", "/v1/worker/drain")
+        return json.loads(data)
+
     def task_info(self, task_id: str) -> dict:
         data, _ = self._request("GET", f"/v1/task/{task_id}")
         return json.loads(data)
@@ -209,23 +234,65 @@ class WorkerClient:
                       ) -> List[Tuple[np.ndarray, np.ndarray]]:
         """Token/ack pull loop until the buffer reports complete; returns
         concatenated (values, nulls) per column. Raises on deadline or on
-        HTTP 410 (pages acked away by a prior consumer attempt)."""
+        HTTP 410 (pages acked away by a prior consumer attempt). A
+        drained-away task (``X-Presto-Task-Moved`` header) re-targets
+        the adopting peer and resumes the SAME absolute token, so the
+        page stream replays exactly once across the migration."""
         token = 0
         pages = []
+        target = self  # re-targeted when the task's pages migrated
+        moves = 0
+        last_move = None  # last followed move target (normalized url)
+        peer_misses = 0  # consecutive 404s after following a move
         deadline = time.time() + self.timeout
         while True:
             if time.time() > deadline:
                 raise TimeoutError(
                     f"results of {task_id}/{buffer_id} not complete after "
                     f"{self.timeout}s")
-            data, headers = self._request(
-                "GET", f"/v1/task/{task_id}/results/{buffer_id}/{token}")
+            try:
+                data, headers = target._request(
+                    "GET",
+                    f"/v1/task/{task_id}/results/{buffer_id}/{token}")
+            except urllib.error.HTTPError as e:
+                if e.code == 404 and target is not self:
+                    # the adopt POST may still be in flight on the
+                    # peer -- or it FAILED and the origin rolled its
+                    # moved_to flip back and still serves the pages:
+                    # retry the peer briefly, then fall back to the
+                    # origin (which either serves directly or re-issues
+                    # the move once the adopt finally landed)
+                    peer_misses += 1
+                    if peer_misses >= 10:
+                        peer_misses = 0
+                        target = self
+                        continue
+                    time.sleep(0.05)
+                    continue
+                raise
+            peer_misses = 0
+            moved = headers.get("X-Presto-Task-Moved")
+            if moved:
+                # count only moves to a NEW target toward the loop cap:
+                # re-following the SAME pending migration after an
+                # origin fallback is the slow-adopt wait (bounded by
+                # the deadline), not a redirect chain
+                if moved.rstrip("/") != last_move:
+                    moves += 1
+                    if moves >= 8:
+                        raise RuntimeError(
+                            f"task {task_id} pages moved too many "
+                            f"times (migration loop?)")
+                    last_move = moved.rstrip("/")
+                target = WorkerClient(moved, self.timeout,
+                                      shared_secret=self._secret)
+                continue
             complete = headers.get("X-Presto-Buffer-Complete") == "true"
             next_token = int(headers.get("X-Presto-Page-Next-Token", token))
             if data:
                 pages.append(deserialize_page(data, types, codec))
                 if ack:
-                    self._request(
+                    target._request(
                         "GET",
                         f"/v1/task/{task_id}/results/{buffer_id}/{next_token}/acknowledge")
                 token = next_token
@@ -249,15 +316,18 @@ class WorkerClient:
 
 def pull_worker_docs(worker_urls, timeout: float, fetch,
                      component: str, site: str = "cluster_pull",
-                     parallel: bool = False):
+                     parallel: bool = False, placeholder=None):
     """The one best-effort cluster pull the merged surfaces
     (/v1/profile, /v1/history, /v1/cluster) share: fetch one document
     per reachable worker through an authenticated WorkerClient,
     skip-and-count the unreachable ones (never an error).
     ``fetch(client) -> dict``; returns (docs, workers_pulled) with
-    docs in input-URL order. ``parallel`` fans the pulls out on a
-    small thread pool -- the live /v1/cluster probe uses it so ONE
-    dead worker costs one timeout per frame, not one per dead worker."""
+    docs in input-URL order; workers_pulled counts REACHABLE workers
+    only. ``parallel`` fans the pulls out on a small thread pool --
+    the live /v1/cluster probe uses it so ONE dead worker costs one
+    timeout per frame, not one per dead worker. ``placeholder(url) ->
+    dict`` keeps unreachable workers IN the doc list (the fleet view's
+    DEAD rows) instead of silently dropping them."""
     from .metrics import record_suppressed
 
     def pull(url):
@@ -274,5 +344,10 @@ def pull_worker_docs(worker_urls, timeout: float, fetch,
             results = list(pool.map(pull, urls))
     else:
         results = [pull(u) for u in urls]
-    docs = [d for d in results if d is not None]
-    return docs, len(docs)
+    alive = sum(1 for d in results if d is not None)
+    if placeholder is not None:
+        docs = [d if d is not None else placeholder(str(u))
+                for u, d in zip(urls, results)]
+    else:
+        docs = [d for d in results if d is not None]
+    return docs, alive

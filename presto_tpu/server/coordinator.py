@@ -38,7 +38,33 @@ from .flight_recorder import record_event
 from .metrics import record_suppressed
 from .tracing import TraceContext, emit_span, new_span_id, trace_context
 
-__all__ = ["Coordinator", "SchedulerGap"]
+__all__ = ["Coordinator", "SchedulerGap", "speculation_totals",
+           "reset_speculation_totals"]
+
+# -- speculative-execution accounting (process-wide, like the watchdog
+# totals): launched attempts, wins (the speculative copy finished
+# first) and losses (the original beat it) -- exported by
+# metrics.fleet_families on both tiers
+_SPEC_LOCK = threading.Lock()
+_SPEC = {"launched": 0, "wins": 0, "losses": 0}
+
+ENV_SPECULATION_MS = "PRESTO_TPU_SPECULATION_MS"
+
+
+def speculation_totals() -> dict:
+    with _SPEC_LOCK:
+        return dict(_SPEC)
+
+
+def reset_speculation_totals() -> None:
+    """Test isolation only; production counters are monotonic."""
+    with _SPEC_LOCK:
+        _SPEC.update({"launched": 0, "wins": 0, "losses": 0})
+
+
+def _count_spec(key: str) -> None:
+    with _SPEC_LOCK:
+        _SPEC[key] += 1
 
 
 class SchedulerGap(NotImplementedError):
@@ -53,7 +79,8 @@ class Coordinator:
                  discovery_url: Optional[str] = None,
                  prober=None,
                  writer_min_rows_per_task: int = 1 << 20,
-                 ttl_horizon_s: float = 60.0):
+                 ttl_horizon_s: float = 60.0,
+                 speculation_threshold_ms: Optional[float] = None):
         """`prober`: an optional discovery.HeartbeatProber; when set,
         workers the prober has marked failed are excluded from
         scheduling AND from retry targets (HeartbeatFailureDetector ->
@@ -79,6 +106,40 @@ class Coordinator:
         # excluded from NEW task placement (long queries would die with
         # the node); 0 disables the filter
         self.ttl_horizon_s = ttl_horizon_s
+        # straggler mitigation: a task whose live-progress last-advance
+        # age exceeds this is speculatively re-run on another worker
+        # (None = resolve the PRESTO_TPU_SPECULATION_MS env per query;
+        # the speculative_execution_threshold_ms session property
+        # overrides both when execute() is given a session)
+        self.speculation_threshold_ms = speculation_threshold_ms
+
+    def _speculation_ms(self, session=None) -> float:
+        """Effective speculation threshold: session property >
+        constructor > env; 0/unparseable disables."""
+        import os
+        raw = None
+        if session is not None:
+            try:
+                # only an EXPLICIT session value takes precedence: a
+                # Session object's get() would return the coerced spec
+                # default (0.0) for an unset key and silently shadow
+                # the constructor/env layers below
+                if hasattr(session, "get_explicit"):
+                    raw = session.get_explicit(
+                        "speculative_execution_threshold_ms")
+                else:
+                    raw = session.get(
+                        "speculative_execution_threshold_ms")
+            except (KeyError, TypeError):
+                raw = None
+        if raw in (None, ""):
+            raw = self.speculation_threshold_ms
+        if raw in (None, ""):
+            raw = os.environ.get(ENV_SPECULATION_MS, "0")
+        try:
+            return max(float(raw), 0.0)
+        except (TypeError, ValueError):
+            return 0.0
 
     @property
     def last_query_stats(self):
@@ -90,6 +151,14 @@ class Coordinator:
         else:
             nodes = alive_nodes(self.discovery_url)
             assert nodes, "no alive workers in discovery"
+            # DRAINING nodes stay announced (their buffered pages are
+            # still served/migrating) but take no NEW task placement;
+            # never filter down to an empty cluster
+            active = [n for n in nodes
+                      if str(n.get("state", "ACTIVE")).upper()
+                      != "DRAINING"]
+            if active:
+                nodes = active
             if self.ttl_horizon_s:
                 # TTL-aware placement: avoid nodes leaving within the
                 # horizon (they'd take running tasks down with them);
@@ -137,9 +206,146 @@ class Coordinator:
         raise RuntimeError(
             f"task {task_id} could not be submitted anywhere: {last_err}")
 
+    def _wait_speculative(self, urls: List[str], url: str, tid: str,
+                          body: dict, timeout: float, submitted,
+                          register, key, spec_ms: float):
+        """Poll one task to a terminal state, speculatively re-running
+        it elsewhere when it straggles: once the task's live-progress
+        last-advance age (exec/progress.py -- the same signal the
+        stuck-progress watchdog observes) exceeds `spec_ms`, ONE copy
+        is submitted to a different worker with a ``.spec`` task id.
+        First FINISHED attempt wins; every other attempt is aborted
+        and its progress entry closed, so exactly one attempt's buffers
+        feed consumers (exactly-once result dedup) and the loser stops
+        burning its worker. Returns (info, url, tid) of the winning --
+        or last surviving -- attempt; raises like WorkerClient.wait
+        when the only attempt is unreachable or the deadline passes so
+        the caller's retry ladder is unchanged."""
+        from ..exec.progress import finish_task, get_progress
+        deadline = time.time() + timeout
+        wait_started = time.time()
+        # with speculation ARMED, polls get a short timeout (like
+        # _merge_task_stats' pulls): a wedged-socket worker must not
+        # hold the poll loop -- and so the other attempt's win --
+        # hostage for the full task deadline. Speculation OFF keeps the
+        # old full-deadline socket timeout: an in-process worker
+        # GIL-bound in a heavy compile can legitimately stall a status
+        # GET past 2s, and aborting it for that would be a regression.
+        poll_to = min(timeout, 2.0) if spec_ms > 0 else timeout
+        # (url, tid, client) per live attempt; index 0 = the original
+        attempts = [(url, tid, WorkerClient(url, poll_to))]
+        spec_tried = spec_ms <= 0 or len(urls) < 2
+        launched_spec = False
+        last = None  # (info, url, tid) of the last terminal attempt
+        poll_fails: dict = {}  # tid -> consecutive poll failures
+
+        def close_attempt(u, t, client, aborted):
+            if aborted:
+                try:
+                    client.abort(t)
+                except Exception as e:  # noqa: BLE001 - loser's worker
+                    # may be the dead/wedged one
+                    record_suppressed("coordinator", "abort_loser", e)
+            finish_task(t, "ABORTED")
+
+        while time.time() < deadline:
+            for u, t, client in list(attempts):
+                try:
+                    info = client.task_info(t)
+                    client._note_progress(t, info)
+                    poll_fails[t] = 0
+                except Exception as e:  # noqa: BLE001 - attempt's
+                    # worker unreachable (or one poll stalled past the
+                    # short speculation-armed timeout)
+                    if len(attempts) == 1:
+                        raise  # sole attempt: the retry ladder's case
+                    # tolerate transient poll failures: with the 2s
+                    # speculation-armed timeout, ONE stalled status GET
+                    # (a GIL-bound compiling worker) must not discard a
+                    # healthy racing attempt. Three consecutive misses
+                    # = the worker is gone: drop the attempt and ABORT
+                    # it best-effort (the losers-are-aborted contract
+                    # holds even for attempts lost to unreachability).
+                    poll_fails[t] = poll_fails.get(t, 0) + 1
+                    if poll_fails[t] < 3:
+                        continue
+                    attempts.remove((u, t, client))
+                    close_attempt(u, t, client, aborted=True)
+                    record_event("retry_task", task=t, source=u,
+                                 error=f"{type(e).__name__}: {e}")
+                    continue
+                state = info.get("state")
+                if state == "FINISHED":
+                    # a win/loss is only a RACE outcome when both
+                    # attempts were still alive; a spec that finishes
+                    # after its original already failed is a rescue
+                    # (the retry ladder analog), not a won race
+                    race = len(attempts) > 1
+                    # first-result-wins: abort the losers so no second
+                    # buffer can ever be consumed
+                    for lu, lt, lc in attempts:
+                        if lt != t:
+                            close_attempt(lu, lt, lc, aborted=True)
+                    if t != tid and race:
+                        # identity, not a ".spec" substring test: a
+                        # plain retry of a speculative id (.spec.r)
+                        # re-enters this function as the ORIGINAL and
+                        # must not count as a race win
+                        _count_spec("wins")
+                        record_event("speculative_win", task=tid,
+                                     winner=t, target=u)
+                    elif t == tid and race and launched_spec:
+                        _count_spec("losses")
+                        record_event("speculative_loss", task=tid)
+                    return info, u, t
+                if state in ("FAILED", "ABORTED"):
+                    if len(attempts) == 1:
+                        return info, u, t  # retry ladder takes over
+                    attempts.remove((u, t, client))
+                    close_attempt(u, t, client, aborted=False)
+                    last = (info, u, t)
+                    continue
+            if not attempts:
+                return last if last is not None else (
+                    {"state": "FAILED", "error": "no attempt survived"},
+                    url, tid)
+            if not spec_tried and any(t == tid for _, t, _c in attempts):
+                # straggler detection: the original attempt's progress
+                # entry (fed by the very polls above) stopped advancing
+                ent = get_progress(tid)
+                age_ms = ent.snapshot()["lastAdvanceAgeMs"] \
+                    if ent is not None \
+                    else (time.time() - wait_started) * 1000.0
+                if age_ms >= spec_ms:
+                    spec_tried = True  # one speculative copy per task
+                    cand = [c for c in self._retry_urls(urls)
+                            if c.rstrip("/") != url.rstrip("/")]
+                    try:
+                        if cand:
+                            su, st, _ = self._submit(
+                                cand, 0, f"{tid}.spec", body, timeout)
+                            launched_spec = True
+                            _count_spec("launched")
+                            record_event("speculative_submit", task=tid,
+                                         target=su, ageMs=int(age_ms))
+                            if register is not None:
+                                register(st, key)
+                            if submitted is not None:
+                                submitted.append((su, st))
+                            attempts.append(
+                                (su, st, WorkerClient(su, poll_to)))
+                    except Exception as e:  # noqa: BLE001 - nowhere to
+                        # speculate: the original keeps running and the
+                        # stuck watchdog / retry ladder still cover it
+                        record_suppressed("coordinator",
+                                          "speculative_submit", e)
+            time.sleep(0.05)
+        raise TimeoutError(f"task {tid} still not terminal after "
+                           f"{timeout}s (speculative={not spec_tried})")
+
     def _await_or_retry(self, urls: List[str], pending, body_of,
                         timeout: float, submitted=None, recover=None,
-                        register=None):
+                        register=None, spec_ms: float = 0.0):
         """Wait for submitted tasks (all executing concurrently); on an
         execution failure, resubmit that task elsewhere (deterministic
         splits make any attempt re-runnable -- the recoverable-execution
@@ -160,7 +366,9 @@ class Coordinator:
                 try:
                     if failpoints.ARMED:
                         failpoints.hit("task.status")
-                    info = WorkerClient(url, timeout).wait(tid, timeout)
+                    info, url, tid = self._wait_speculative(
+                        urls, url, tid, body_of(key), timeout,
+                        submitted, register, key, spec_ms)
                     if info["state"] == "FINISHED":
                         done[key] = (url, tid)
                         break
@@ -222,7 +430,8 @@ class Coordinator:
 
     def execute(self, root: N.PlanNode, sf: float = 0.01,
                 timeout: float = 120.0, policy: str = "phased",
-                trace_ctx: Optional[TraceContext] = None):
+                trace_ctx: Optional[TraceContext] = None,
+                session=None):
         """Run a (possibly multi-fragment) plan. Returns (cols, names)
         where cols is a list of (values, nulls) numpy pairs per output
         column, pulled from the final task.
@@ -266,7 +475,8 @@ class Coordinator:
             with trace_context(exec_ctx):
                 result = self._execute_fragments(
                     workers, fragments, produced, submitted, qid, sf,
-                    timeout, policy, exec_ctx)
+                    timeout, policy, exec_ctx,
+                    spec_ms=self._speculation_ms(session))
             return result
         finally:
             # stitch BEFORE task cleanup destroys worker state, and on
@@ -368,7 +578,8 @@ class Coordinator:
 
     def _execute_fragments(self, workers, fragments, produced, submitted,
                            qid, sf, timeout, policy="phased",
-                           exec_ctx: Optional[TraceContext] = None):
+                           exec_ctx: Optional[TraceContext] = None,
+                           spec_ms: float = 0.0):
         if exec_ctx is None:
             exec_ctx = TraceContext(f"query.{qid}", new_span_id())
         trace_id = exec_ctx.trace_id
@@ -498,6 +709,16 @@ class Coordinator:
                     for w in range(ntasks_of[frag.id])]
 
         for frag in fragments:
+            # elastic placement: re-derive the healthy worker set per
+            # FRAGMENT (discovery + prober + DRAINING filter), so a
+            # worker that joined since the query started takes shards
+            # of later fragments and one that left/drained takes none
+            # -- the shard COUNT (ntasks_of, fixed in pass 1) is what
+            # consumers sized their buffers for; only placement moves.
+            # all_at_once keeps its predicted placement (consumers
+            # already hold those (url, taskId) pairs).
+            placement = workers if policy == "all_at_once" \
+                else self._retry_urls(workers)
             frag_plan = N.OutputNode(frag.root, [
                 f"c{i}" for i in range(len(frag.root.output_types()))]) \
                 if not isinstance(frag.root, N.OutputNode) else frag.root
@@ -592,7 +813,7 @@ class Coordinator:
                     submitted.append((url, tid))
                     all_pending.append((url, tid))
                     continue
-                url, tid, _ = self._submit(workers, w,
+                url, tid, _ = self._submit(placement, w,
                                            f"{qid}.f{frag.id}.w{w}",
                                            body, timeout)
                 origin[tid] = (frag.id, w)
@@ -602,10 +823,11 @@ class Coordinator:
             if policy == "all_at_once":
                 continue  # awaited together after every stage launched
             done = self._await_or_retry(
-                workers, pending, lambda k: bodies[k], timeout, submitted,
-                recover=recover_upstreams,
+                placement, pending, lambda k: bodies[k], timeout,
+                submitted, recover=recover_upstreams,
                 register=lambda tid, k, f=frag.id: origin.__setitem__(
-                    tid, (f, k)))
+                    tid, (f, k)),
+                spec_ms=spec_ms)
             produced[frag.id] = [done[w] for w in sorted(done)]
             sid, t_f0 = frag_spans[frag.id]
             emit_span(trace_id, f"fragment.f{frag.id}", t_f0, time.time(),
@@ -657,7 +879,7 @@ class Coordinator:
                 done = self._await_or_retry(
                     retry, [(w, url, tid, w + 1)],
                     lambda k: final_bodies[k], timeout, submitted,
-                    recover=recover_upstreams)
+                    recover=recover_upstreams, spec_ms=spec_ms)
                 url, tid = done[w]
                 cols = WorkerClient(url, timeout).fetch_results(tid, types)
             for c in range(len(types)):

@@ -22,9 +22,90 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
 from .. import failpoints
+from ..utils.backoff import Backoff
 
 __all__ = ["DiscoveryServer", "Announcer", "alive_nodes",
-           "HeartbeatProber"]
+           "HeartbeatProber", "fleet_membership_totals",
+           "announce_retry_totals", "note_unannounced",
+           "clear_unannounced", "recently_unannounced",
+           "reset_fleet_state"]
+
+# -- process-wide fleet membership accounting ---------------------------
+#
+# Like the failpoint registry and watchdog totals next door, membership
+# events are process-wide: every discovery server / announcer in this
+# process feeds one set of counters (exported by metrics.fleet_families
+# on both tiers) and one recently-unannounced registry the /v1/cluster
+# probe consults so a gracefully-departed worker drops out of the alive
+# gauge IMMEDIATELY instead of flapping dead-then-gone.
+
+_FLEET_LOCK = threading.Lock()
+_FLEET = {"joined": 0, "left": 0, "announce_retries": 0}
+# uri -> unannounce ts; cleared on re-announce, expired past the ttl.
+# The ttl is short on purpose: its job is bridging the window between
+# a graceful goodbye and the process actually exiting (so the alive
+# gauge never flaps); a NEW process reusing the port later must not
+# inherit the goodbye.
+_UNANNOUNCED: Dict[str, float] = {}
+_UNANNOUNCED_TTL_S = 60.0
+
+
+def fleet_membership_totals() -> Dict[str, int]:
+    with _FLEET_LOCK:
+        return {"joined": _FLEET["joined"], "left": _FLEET["left"]}
+
+
+def announce_retry_totals() -> int:
+    with _FLEET_LOCK:
+        return _FLEET["announce_retries"]
+
+
+def _count_fleet(key: str, delta: int = 1) -> None:
+    with _FLEET_LOCK:
+        _FLEET[key] += delta
+
+
+def note_unannounced(uri: Optional[str]) -> None:
+    """Record a graceful goodbye (discovery DELETE): the fleet surfaces
+    (/v1/cluster) stop probing/counting this worker at once."""
+    if not uri:
+        return
+    with _FLEET_LOCK:
+        _UNANNOUNCED[uri.rstrip("/")] = time.time()
+
+
+def clear_unannounced(uri: Optional[str]) -> None:
+    """Drop a goodbye mark: a (re)announcing node clears its own, and
+    a NEW worker server binding the same url clears any stale one a
+    drained predecessor left (explicit-url clusters never announce, so
+    without this a same-port replacement would stay hidden from
+    /v1/cluster until the ttl expired)."""
+    if not uri:
+        return
+    with _FLEET_LOCK:
+        _UNANNOUNCED.pop(uri.rstrip("/"), None)
+
+
+_clear_unannounced = clear_unannounced  # internal alias
+
+
+def recently_unannounced() -> Dict[str, float]:
+    """{uri: unannounce_ts} of workers that said goodbye and have not
+    re-announced (bounded by the ttl so test-churned urls don't pin
+    the registry forever)."""
+    now = time.time()
+    with _FLEET_LOCK:
+        for uri in [u for u, ts in _UNANNOUNCED.items()
+                    if now - ts > _UNANNOUNCED_TTL_S]:
+            del _UNANNOUNCED[uri]
+        return dict(_UNANNOUNCED)
+
+
+def reset_fleet_state() -> None:
+    """Test isolation only; production counters are monotonic."""
+    with _FLEET_LOCK:
+        _FLEET.update({"joined": 0, "left": 0, "announce_retries": 0})
+        _UNANNOUNCED.clear()
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -57,8 +138,14 @@ class _Handler(BaseHTTPRequestHandler):
             length = int(self.headers.get("Content-Length", "0"))
             body = json.loads(self.rfile.read(length) or b"{}")
             with self.lock:
+                joined = parts[2] not in self.nodes
                 self.nodes[parts[2]] = {**body, "nodeId": parts[2],
                                         "lastSeen": time.time()}
+            if joined:
+                _count_fleet("joined")
+            # an announcing node is (back) in the fleet: clear any
+            # goodbye mark so a rejoining worker counts alive again
+            _clear_unannounced(body.get("uri"))
             return self._json({"announced": True}, 202)
         return self._json({"error": "bad path"}, 404)
 
@@ -80,7 +167,12 @@ class _Handler(BaseHTTPRequestHandler):
         parts = [p for p in self.path.split("/") if p]
         if len(parts) == 3 and parts[:2] == ["v1", "announcement"]:
             with self.lock:
-                self.nodes.pop(parts[2], None)
+                gone = self.nodes.pop(parts[2], None)
+            if gone is not None:
+                _count_fleet("left")
+                # the alive-set drop is IMMEDIATE: fleet surfaces stop
+                # probing this uri now, not when a probe ttl expires
+                note_unannounced(gone.get("uri"))
             return self._json({"removed": True})
         return self._json({"error": "bad path"}, 404)
 
@@ -123,17 +215,28 @@ class Announcer:
         from .auth import make_authenticator
         self.discovery_url = discovery_url.rstrip("/")
         self.node_id = node_id
-        body = {"uri": worker_url, "environment": environment,
-                "coordinator": False}
+        self.worker_url = worker_url
+        self._body_doc = {"uri": worker_url, "environment": environment,
+                          "coordinator": False, "state": "ACTIVE"}
         if ttl_epoch_s is not None:
             # TTL-based scheduling hint (NodeTtlFetcher analog): the
             # instant this node expects to leave the cluster
-            body["ttlEpochSeconds"] = float(ttl_epoch_s)
-        self.body = json.dumps(body).encode()
+            self._body_doc["ttlEpochSeconds"] = float(ttl_epoch_s)
         self.interval = interval_s
         self._auth = make_authenticator(shared_secret, node_id)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    @property
+    def body(self) -> bytes:
+        return json.dumps(self._body_doc).encode()
+
+    def set_state(self, state: str) -> None:
+        """Announced fleet state (ACTIVE | DRAINING): a DRAINING
+        announcement keeps the node visible (its buffered pages are
+        still being served/migrated) while the coordinator's placement
+        filter stops assigning it NEW tasks."""
+        self._body_doc["state"] = str(state)
 
     def _headers(self) -> dict:
         from .auth import bearer_headers
@@ -154,18 +257,43 @@ class Announcer:
     def start(self):
         def loop():
             from .metrics import record_suppressed
+            # re-registration backoff (seeded by node id so retry
+            # timing replays under test): a failed announcement retries
+            # on the backoff schedule instead of waiting out a full
+            # interval -- after a discovery-server restart the node is
+            # back in alive_nodes within a few hundred ms, not after
+            # its announcement silently aged out of max_age
+            backoff = Backoff(base_s=0.05, cap_s=min(self.interval, 2.0),
+                              seed=self.node_id)
             while not self._stop.is_set():
                 try:
                     self.announce_once()
+                    backoff.attempt = 0  # healthy again: reset schedule
+                    self._stop.wait(self.interval)
                 except Exception as e:  # noqa: BLE001
                     # discovery outage: keep trying (airlift behavior),
                     # but leave a trace -- a worker that never manages
-                    # to announce is otherwise invisible
+                    # to announce is otherwise invisible -- and count
+                    # the recovery attempts (announce_retries_total)
                     record_suppressed("announcer", "announce", e)
-                self._stop.wait(self.interval)
+                    _count_fleet("announce_retries")
+                    self._stop.wait(backoff.next_delay())
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()
         return self
+
+    def unannounce_once(self):
+        """One goodbye DELETE (raises on failure -- stop() wraps it in
+        the best-effort/counted path)."""
+        if failpoints.ARMED:
+            # a lost unannouncement: the node lingers in discovery
+            # until its announcement ages out of max_age
+            failpoints.hit("discovery.unannounce_lost")
+        req = urllib.request.Request(
+            f"{self.discovery_url}/v1/announcement/{self.node_id}",
+            method="DELETE",
+            headers=dict(self._headers()))
+        urllib.request.urlopen(req, timeout=5).read()
 
     def stop(self, unannounce: bool = True):
         self._stop.set()
@@ -176,11 +304,7 @@ class Announcer:
             self._thread.join(timeout=6)
         if unannounce:
             try:
-                req = urllib.request.Request(
-                    f"{self.discovery_url}/v1/announcement/{self.node_id}",
-                    method="DELETE",
-                    headers=dict(self._headers()))
-                urllib.request.urlopen(req, timeout=5).read()
+                self.unannounce_once()
             except Exception as e:  # noqa: BLE001 - best-effort goodbye
                 from .metrics import record_suppressed
                 record_suppressed("announcer", "unannounce", e)

@@ -46,7 +46,8 @@ __all__ = ["MetricFamily", "Histogram", "DEFAULT_BUCKETS",
            "suppressed_error_totals", "tracing_families",
            "flight_recorder_families", "kernel_audit_families",
            "failpoint_families", "query_history_families",
-           "live_introspection_families", "CONTENT_TYPE"]
+           "live_introspection_families", "fleet_families",
+           "CONTENT_TYPE"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 # exemplars are legal only in the OpenMetrics exposition (the classic
@@ -587,6 +588,56 @@ def live_introspection_families(workers_alive: Optional[int] = None
             "workers this node currently believes alive (the worker "
             "reports itself; the statement tier its last /v1/status "
             "probe)").add(int(workers_alive)))
+    return fams
+
+
+def fleet_families(workers_draining: Optional[int] = None
+                   ) -> List[MetricFamily]:
+    """Elastic-fleet accounting, exported by BOTH tiers with a stable
+    zero shape: membership churn (workers joined/left through the
+    discovery service), announcer re-registration retries, speculative
+    re-execution outcomes (launched/wins/losses), coordinator
+    failovers, and -- when the caller knows it -- the draining-worker
+    gauge (the worker reports its own drain state; the statement tier
+    its last /v1/cluster probe's DRAINING count)."""
+    from .coordinator import speculation_totals
+    from .discovery import announce_retry_totals, fleet_membership_totals
+    from .resource_manager import failover_totals
+    member = fleet_membership_totals()
+    spec = speculation_totals()
+    fams = [
+        MetricFamily("presto_tpu_fleet_workers_joined_total", "counter",
+                     "distinct worker announcements accepted by this "
+                     "process's discovery service").add(member["joined"]),
+        MetricFamily("presto_tpu_fleet_workers_left_total", "counter",
+                     "worker unannouncements (graceful goodbyes) "
+                     "accepted by this process's discovery "
+                     "service").add(member["left"]),
+        MetricFamily("presto_tpu_announce_retries_total", "counter",
+                     "failed worker announcements retried on the "
+                     "backoff schedule (utils/backoff.py)").add(
+                         announce_retry_totals()),
+        MetricFamily("presto_tpu_speculation_launched_total", "counter",
+                     "speculative task attempts submitted for "
+                     "stragglers").add(spec["launched"]),
+        MetricFamily("presto_tpu_speculation_wins_total", "counter",
+                     "speculative attempts that finished before their "
+                     "straggling original").add(spec["wins"]),
+        MetricFamily("presto_tpu_speculation_losses_total", "counter",
+                     "speculative attempts beaten by their "
+                     "original").add(spec["losses"]),
+        MetricFamily("presto_tpu_coordinator_failovers_total", "counter",
+                     "standby-coordinator takeovers after a primary "
+                     "heartbeat lapse "
+                     "(resource_manager.StandbyCoordinator)").add(
+                         failover_totals()),
+    ]
+    if workers_draining is not None:
+        fams.append(MetricFamily(
+            "presto_tpu_fleet_workers_draining", "gauge",
+            "workers currently in the DRAINING state (the worker "
+            "reports itself; the statement tier its last probe)").add(
+                int(workers_draining)))
     return fams
 
 

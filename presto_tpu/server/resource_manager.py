@@ -14,9 +14,17 @@ Pieces:
   * ResourceManager        -- the HTTP service (heartbeats in,
                               aggregated cluster view out)
   * ClusterStateSender     -- coordinator-side periodic POST of its
-                              dispatcher's group stats
+                              dispatcher's group stats + its IN-FLIGHT
+                              statement snapshot (the failover manifest)
   * remote_group_load      -- admission-side helper: running count for
                               a group across OTHER coordinators
+  * StandbyCoordinator     -- the failover monitor: a standby statement
+                              tier that watches the primary's heartbeat
+                              through the RM view and, when it lapses,
+                              ADOPTS the primary's queued/running
+                              statements so they complete (and the
+                              router's health checks steer new traffic
+                              its way)
   * Dispatcher integration -- `cluster_limits` + a resource-manager
                               url gate queries on the CLUSTER-wide
                               running count before local admission
@@ -29,9 +37,29 @@ import threading
 import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
-__all__ = ["ResourceManager", "ClusterStateSender", "remote_group_load"]
+from .. import failpoints
+
+__all__ = ["ResourceManager", "ClusterStateSender", "remote_group_load",
+           "StandbyCoordinator", "failover_totals",
+           "reset_failover_totals"]
+
+# -- failover accounting (process-wide, exported by
+# metrics.fleet_families on both tiers) ---------------------------------
+_FAILOVER_LOCK = threading.Lock()
+_FAILOVER = {"count": 0}
+
+
+def failover_totals() -> int:
+    with _FAILOVER_LOCK:
+        return _FAILOVER["count"]
+
+
+def reset_failover_totals() -> None:
+    """Test isolation only; production counters are monotonic."""
+    with _FAILOVER_LOCK:
+        _FAILOVER["count"] = 0
 
 
 class _State:
@@ -135,20 +163,31 @@ class ResourceManager:
 
 class ClusterStateSender:
     """Coordinator-side periodic heartbeat of dispatcher group stats
-    (ClusterStatusSender analog)."""
+    (ClusterStatusSender analog). `inflight_fn` (zero-arg callable ->
+    list of in-flight statement docs, e.g. StatementServer.inflight_doc)
+    rides each heartbeat as the failover manifest: the statements a
+    standby re-dispatches when this coordinator's heartbeat lapses."""
 
     def __init__(self, rm_url: str, coordinator_id: str, dispatcher,
-                 interval_s: float = 0.5, timeout: float = 5.0):
+                 interval_s: float = 0.5, timeout: float = 5.0,
+                 inflight_fn=None):
         self.rm_url = rm_url.rstrip("/")
         self.coordinator_id = coordinator_id
         self.dispatcher = dispatcher
         self.interval = interval_s
         self.timeout = timeout
+        self.inflight_fn = inflight_fn
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def send_once(self) -> None:
+        if failpoints.ARMED:
+            # a lost heartbeat: enough consecutive losses age this
+            # coordinator out of the RM view and the standby takes over
+            failpoints.hit("coordinator.heartbeat_lapse")
         doc = {"groups": self.dispatcher.group_stats()}
+        if self.inflight_fn is not None:
+            doc["queries"] = self.inflight_fn()
         req = urllib.request.Request(
             f"{self.rm_url}/v1/resourcemanager/{self.coordinator_id}",
             data=json.dumps(doc).encode(), method="PUT",
@@ -165,6 +204,125 @@ class ClusterStateSender:
                     # keep trying; counted so a flapping RM is visible
                     record_suppressed("resource_manager", "heartbeat", e)
                 self._stop.wait(self.interval)
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(self.timeout + 1)
+
+
+class StandbyCoordinator:
+    """Multi-coordinator failover monitor (the promotion of router.py +
+    resource_manager.py the elastic fleet needs): a STANDBY statement
+    tier watches the PRIMARY's heartbeat through the resource manager's
+    aggregated view and, when the heartbeat lapses past `ttl_s`, takes
+    over statement execution for the queries the primary last reported
+    queued/running -- each one re-dispatched on the standby under its
+    ORIGINAL query id + slug (StatementServer.adopt_query), so a client
+    (or the router fronting both coordinators) re-resolves its polls
+    against the standby and drains the same statement to completion.
+
+    The handshake, in order:
+      1. while the primary heartbeats, the monitor only caches its
+         in-flight manifest (the last heartbeat's ``queries`` list);
+      2. heartbeat age > ttl  ->  exactly-once failover: the counter
+         (presto_tpu_coordinator_failovers_total) bumps, a
+         ``coordinator_failover`` flight event lands, and every
+         non-terminal manifest entry is adopted onto the standby;
+      3. the router's health checks drop the dead primary on their own
+         cadence, steering NEW statements at the standby (kind=
+         "standby" clusters serve only while no primary is healthy);
+      4. a primary that comes BACK (restart) simply resumes
+         heartbeating -- the monitor re-arms for the next lapse
+         (adoption is idempotent per query id: a re-fired failover
+         never double-runs an adopted statement).
+
+    Driven either by start() (background thread) or check_once() (the
+    deterministic test/chaos surface, like the watchdog's)."""
+
+    _GUARDED_BY = {"_lock": ("_manifest", "_seen_primary", "_fired",
+                             "is_primary")}
+
+    def __init__(self, rm_url: str, primary_id: str, statement_server,
+                 ttl_s: float = 3.0, poll_s: float = 0.5,
+                 timeout: float = 5.0):
+        self.rm_url = rm_url.rstrip("/")
+        self.primary_id = primary_id
+        self.statement_server = statement_server
+        self.ttl_s = ttl_s
+        self.poll_s = poll_s
+        self.timeout = timeout
+        self.is_primary = False     # True after takeover
+        self._manifest: List[dict] = []  # last-seen in-flight snapshot
+        self._seen_primary = False
+        self._fired = False
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def check_once(self) -> bool:
+        """One monitor pass; returns True iff failover fired THIS pass.
+        Public so tests and the chaos driver can step the handshake
+        deterministically."""
+        with urllib.request.urlopen(
+                f"{self.rm_url}/v1/resourcemanager",
+                timeout=self.timeout) as r:
+            view = json.loads(r.read())
+        live = view.get("coordinators", {})
+        primary = live.get(self.primary_id)
+        if primary is not None and \
+                float(primary.get("ageSeconds", 0.0)) <= self.ttl_s:
+            with self._lock:
+                self._seen_primary = True
+                self._fired = False  # primary is back: re-arm
+                queries = primary.get("queries")
+                if isinstance(queries, list):
+                    self._manifest = list(queries)
+            return False
+        with self._lock:
+            if self._fired or not self._seen_primary:
+                return False  # never saw it alive, or already took over
+            self._fired = True
+            self.is_primary = True
+            manifest = list(self._manifest)
+        self._take_over(manifest)
+        return True
+
+    def _take_over(self, manifest: List[dict]) -> None:
+        from .flight_recorder import record_event
+        from .metrics import record_suppressed
+        with _FAILOVER_LOCK:
+            _FAILOVER["count"] += 1
+        adoptable = [q for q in manifest
+                     if q.get("state") not in ("FINISHED", "FAILED",
+                                               "CANCELED")]
+        record_event("coordinator_failover", query_id=self.primary_id,
+                     standby=getattr(self.statement_server, "url", ""),
+                     adopted=len(adoptable))
+        for q in adoptable:
+            try:
+                self.statement_server.adopt_query(
+                    q["queryId"], q.get("slug", ""), q.get("query", ""),
+                    q.get("user", "failover"),
+                    q.get("sessionProperties") or {})
+            except Exception as e:  # noqa: BLE001 - one unadoptable
+                # statement must not strand the rest of the manifest
+                record_suppressed("standby", "adopt_query", e)
+
+    def start(self) -> "StandbyCoordinator":
+        def loop():
+            from .metrics import record_suppressed
+            while not self._stop.is_set():
+                try:
+                    self.check_once()
+                except Exception as e:  # noqa: BLE001 - RM outage: the
+                    # monitor keeps watching (counted so a blind
+                    # standby is visible on /v1/metrics)
+                    record_suppressed("standby", "monitor", e)
+                self._stop.wait(self.poll_s)
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()
         return self

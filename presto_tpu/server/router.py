@@ -104,7 +104,15 @@ class RouterServer:
                     return c
             return None
         primaries = [c for c in self.clusters
-                     if c.kind != "fallback" and self._healthy(c)]
+                     if c.kind not in ("fallback", "standby")
+                     and self._healthy(c)]
+        if not primaries:
+            # coordinator failover: standby clusters serve statement
+            # traffic only while NO primary answers -- the router half
+            # of the StandbyCoordinator handshake (the standby is
+            # meanwhile adopting the dead primary's in-flight queries)
+            primaries = [c for c in self.clusters
+                         if c.kind == "standby" and self._healthy(c)]
         if not primaries:
             # degraded: a healthy fallback beats failing the query
             primaries = [c for c in self.clusters if self._healthy(c)]

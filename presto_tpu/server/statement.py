@@ -149,7 +149,8 @@ class StatementServer:
     # roll-ups; writes go through these locks (tpulint C001)
     _GUARDED_BY = {"_qlock": ("_queries",),
                    "_metrics_lock": ("_queries_by_state", "_totals",
-                                     "_workers_alive")}
+                                     "_workers_alive",
+                                     "_workers_draining")}
 
     def __init__(self, port: int = 0, sf: float = 0.01,
                  dispatcher: Optional[Dispatcher] = None,
@@ -194,6 +195,7 @@ class StatementServer:
         # count optimistically rather than paying an HTTP probe per
         # metrics scrape)
         self._workers_alive: Optional[int] = None
+        self._workers_draining = 0  # DRAINING rows of the last probe
         # stuck-progress watchdog (server/watchdog.py): scans live
         # queries; per query disabled unless stuck_query_threshold_ms /
         # PRESTO_TPU_STUCK_MS arms a threshold
@@ -343,6 +345,45 @@ class StatementServer:
         with self._qlock:
             self._reap_locked()
             self._queries[q.id] = q
+        threading.Thread(target=self._run, args=(q,), daemon=True).start()
+        return q
+
+    def inflight_doc(self) -> List[dict]:
+        """In-flight statement manifest (one entry per non-terminal
+        query): what a ClusterStateSender heartbeats to the resource
+        manager so a StandbyCoordinator can adopt these statements
+        when this coordinator's heartbeat lapses."""
+        with self._qlock:
+            queries = list(self._queries.values())
+        out = []
+        for q in queries:
+            state = q.machine.state
+            if state in TERMINAL_STATES:
+                continue
+            out.append({"queryId": q.id, "slug": q.slug,
+                        "query": q.text, "user": q.user, "state": state,
+                        "sessionProperties": q.session_values})
+        return out
+
+    def adopt_query(self, query_id: str, slug: str, text: str,
+                    user: str, session_values: Dict) -> _Query:
+        """Failover adoption: run `text` on THIS server under the
+        ORIGINAL query id + slug, so a client re-resolving its polls
+        here (via the router, or the standby url) drains the same
+        statement. Idempotent per query id -- a re-fired failover
+        never double-runs an adopted statement."""
+        q = _Query(query_id, slug, text, dict(session_values or {}),
+                   user, None)
+        with self._qlock:
+            existing = self._queries.get(query_id)
+            if existing is not None:
+                return existing
+            self._reap_locked()
+            self._queries[query_id] = q
+        record_event("query_adopt", query_id=query_id, user=user)
+        q.machine.add_listener(
+            lambda old, new, qid=q.id: record_event(
+                "query_state", query_id=qid, frm=old, to=new))
         threading.Thread(target=self._run, args=(q,), daemon=True).start()
         return q
 
@@ -806,12 +847,36 @@ class StatementServer:
                 "progress": self._progress_doc(q)})
         groups = self.dispatcher.group_stats()
         blocked = sum(int(g.get("queued", 0)) for g in groups.values())
-        urls = self._worker_urls()
+        from .discovery import recently_unannounced
+        all_urls = self._worker_urls()
+        # a worker that UNANNOUNCED (graceful goodbye / completed
+        # drain) drops out of the probed set IMMEDIATELY -- probing it
+        # until some ttl expired made drained workers flap
+        # dead-then-alive in the workers-alive gauge. The goodbye
+        # registry is PROCESS-local (worker drains and the discovery
+        # DELETE handler feed it); statement tiers running in their
+        # own process should wire `profile_workers` to a discovery-
+        # backed callable instead -- alive_nodes drops unannounced
+        # nodes immediately by construction
+        gone = set(recently_unannounced())
+        urls = [u for u in all_urls if str(u).rstrip("/") not in gone]
         workers, alive = pull_worker_docs(
             urls, 2.0, lambda c: {**c.status(), "uri": c.base},
-            "statement", "cluster_status", parallel=True)
+            "statement", "cluster_status", parallel=True,
+            placeholder=lambda u: {"uri": u, "nodeId": u,
+                                   "state": "DEAD",
+                                   "fleetState": "DEAD", "memory": {}})
+        for w in workers:
+            # older workers without the elastic state machine map their
+            # legacy flat state onto it
+            w.setdefault("fleetState",
+                         "DRAINING" if w.get("state") == "SHUTTING_DOWN"
+                         else str(w.get("state", "ACTIVE")))
+        draining = sum(1 for w in workers
+                       if w.get("fleetState") == "DRAINING")
         with self._metrics_lock:
             self._workers_alive = alive
+            self._workers_draining = draining
             by_state = dict(self._queries_by_state)
             totals = dict(self._totals)
         live = live_snapshots()
@@ -834,7 +899,14 @@ class StatementServer:
             "resourceGroups": groups,
             "workers": workers,
             "workersAlive": alive,
-            "workersConfigured": len(urls),
+            # the CONFIGURED count keeps counting unannounced workers
+            # (they are configured, just gone): ptop's alive/configured
+            # ratio is where a missing worker shows
+            "workersConfigured": len(all_urls),
+            "workersDraining": draining,
+            "workersDead": sum(1 for w in workers
+                               if w.get("fleetState") == "DEAD"),
+            "workersUnannounced": len(all_urls) - len(urls),
             "stuckQueriesTotal": stuck_totals(),
         }
 
@@ -888,7 +960,7 @@ class StatementServer:
                "largest per-query peak memory seen").add(
                    totals["peak_memory_bytes"]),
         ]
-        from .metrics import (failpoint_families,
+        from .metrics import (failpoint_families, fleet_families,
                               flight_recorder_families,
                               histogram_families, kernel_audit_families,
                               live_introspection_families,
@@ -899,6 +971,9 @@ class StatementServer:
         fams.append(uptime_family(self._started_at, "coordinator"))
         fams.extend(live_introspection_families(
             workers_alive=self._workers_alive_view()))
+        with self._metrics_lock:
+            draining = self._workers_draining
+        fams.extend(fleet_families(workers_draining=draining))
         fams.extend(plan_cache_families())
         fams.extend(narrowing_families())
         fams.extend(suppressed_error_families())

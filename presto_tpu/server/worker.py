@@ -77,6 +77,15 @@ class _GoneError(Exception):
     """Requested pages were acked away by a prior consumer (HTTP 410)."""
 
 
+class _MovedError(Exception):
+    """The task's buffered pages migrated to a peer during graceful
+    drain; str(self) is the adopting worker's base url. The HTTP layer
+    answers with an ``X-Presto-Task-Moved`` header and the consumer
+    (WorkerClient.fetch_results) resumes its token stream against the
+    peer -- tokens are absolute and the acked prefix migrated with the
+    pages, so the replay is exactly-once by construction."""
+
+
 class FragmentResultCache:
     """Leaf-fragment output cache (FileFragmentResultCacheManager
     analog): serialized result pages keyed by (canonical plan
@@ -169,7 +178,7 @@ class _Task:
     # wide: TaskManager's writes through `task.` are checked too)
     _GUARDED_BY = {"lock": ("state", "error", "buffers", "first_token",
                             "no_more_pages", "stats", "finished_at",
-                            "spans")}
+                            "spans", "moved_to")}
 
     def __init__(self, task_id: str, spool_threshold: int = 64 << 20,
                  spool_dir: Optional[str] = None,
@@ -189,6 +198,10 @@ class _Task:
             0: self._new_buffer()}
         self.first_token: Dict[int, int] = {}  # per-buffer acked prefix
         self.no_more_pages = False
+        # base url of the peer this task's pages migrated to during a
+        # graceful drain (None = pages are local); once set, result
+        # pulls redirect and local acks are no-ops
+        self.moved_to: Optional[str] = None
         self.created_at = time.time()
         self.finished_at: Optional[float] = None
         self.stats: Dict[str, float] = {}
@@ -221,6 +234,8 @@ class _Task:
                 "stats": dict(self.stats),
                 "elapsedSeconds": round(time.time() - self.created_at, 3),
             }
+            if self.moved_to is not None:
+                doc["movedTo"] = self.moved_to
             if ent is not None:
                 doc["progress"] = ent.snapshot()
             if self.spans:
@@ -241,10 +256,10 @@ class TaskManager:
     their host-side staging, serde, and compile phases, which dominate
     short-task latency."""
 
-    # `draining` rides the tasks lock: create_or_update reads it under
-    # _tasks_lock to make the refuse-new-tasks decision atomic with
-    # task creation (write path: drain())
-    _GUARDED_BY = {"_tasks_lock": ("tasks", "draining"),
+    # `draining`/`drained` ride the tasks lock: create_or_update reads
+    # them under _tasks_lock to make the refuse-new-tasks decision
+    # atomic with task creation (write paths: drain(), mark_drained())
+    _GUARDED_BY = {"_tasks_lock": ("tasks", "draining", "drained"),
                    "_counters_lock": ("counters",)}
 
     def __init__(self, sf: float = 0.01, mesh=None,
@@ -262,6 +277,7 @@ class TaskManager:
         self.memory_pool = MemoryPool(memory_bytes,
                                       admission_timeout_s=60.0)
         self.draining = False  # GracefulShutdownHandler state
+        self.drained = False   # drain complete: pages replayed/migrated
         self.task_ttl_s = task_ttl_s
         self.task_concurrency = max(1, int(task_concurrency))
         self.output_spool_threshold_bytes = output_spool_threshold_bytes
@@ -276,6 +292,8 @@ class TaskManager:
                                          "tasks_finished": 0,
                                          "tasks_failed": 0,
                                          "tasks_aborted": 0,
+                                         "tasks_adopted": 0,
+                                         "pages_migrated": 0,
                                          "rows_produced": 0,
                                          "exchange_bytes": 0,
                                          "compile_us": 0,
@@ -292,6 +310,148 @@ class TaskManager:
         flag flip is atomic with in-flight create_or_update decisions."""
         with self._tasks_lock:
             self.draining = True
+
+    def mark_drained(self) -> None:
+        """Drain complete: every buffered page was replayed or migrated
+        (TpuWorkerServer._drain's terminal step)."""
+        with self._tasks_lock:
+            self.draining = True
+            self.drained = True
+
+    @property
+    def drain_state(self) -> str:
+        """ACTIVE | DRAINING | DRAINED -- the fleet state /v1/status,
+        /v1/cluster and ptop render (the legacy flat `state` key keeps
+        its SHUTTING_DOWN spelling for older pollers)."""
+        with self._tasks_lock:
+            if self.drained:
+                return "DRAINED"
+            return "DRAINING" if self.draining else "ACTIVE"
+
+    def unreplayed_pages(self) -> int:
+        """Buffered result pages still owned by THIS worker (migrated
+        tasks excluded): the quantity graceful drain must bring to zero
+        before the node unannounces."""
+        with self._tasks_lock:
+            tasks = list(self.tasks.values())
+        total = 0
+        for t in tasks:
+            with t.lock:
+                if t.moved_to is None:
+                    total += sum(len(b) for b in t.buffers.values())
+        return total
+
+    def migrate_buffers(self, peer_url: str, timeout: float = 30.0,
+                        secret: Optional[str] = None) -> int:
+        """Migrate every finished task's remaining buffered pages to
+        `peer_url` (SpoolingOutputBuffer tail included); returns pages
+        moved. The export + moved_to flip happen under the task lock in
+        ONE critical section, so no consumer can ack a local page after
+        its copy shipped (the duplicate-replay hazard); a failed POST
+        rolls the flip back and the pages stay served locally -- drain
+        degrades to waiting, never loses or doubles a page."""
+        from .client import WorkerClient
+        from .flight_recorder import record_event
+        from .metrics import record_suppressed
+        with self._tasks_lock:
+            tasks = list(self.tasks.values())
+        # the migration hop is an internal hop like any other: it must
+        # carry the cluster secret or secured peers 401 every adopt
+        client = WorkerClient(peer_url, timeout=timeout,
+                              shared_secret=secret)
+        moved = 0
+        for task in tasks:
+            with task.lock:
+                if task.moved_to is not None or task.state != "FINISHED" \
+                        or not task.no_more_pages:
+                    continue
+                npages = sum(len(b) for b in task.buffers.values())
+                if npages == 0:
+                    continue
+                doc = {
+                    "state": task.state,
+                    "noMorePages": True,
+                    "stats": dict(task.stats),
+                    "firstToken": {str(b): task.first_token.get(b, 0)
+                                   for b in task.buffers},
+                    "buffers": {str(b): buf.export_pages()
+                                for b, buf in task.buffers.items()},
+                }
+                # optimistic flip: consumers redirect from here on (the
+                # peer's adopt races them by at most one short retry)
+                task.moved_to = peer_url.rstrip("/")
+            try:
+                client.migrate(task.task_id, doc)
+            except Exception as e:  # noqa: BLE001 - peer refused/died
+                record_suppressed("worker", "migrate_task", e)
+                # a timed-out POST may still have LANDED: rolling back
+                # while the peer serves the adopted copy would let two
+                # nodes serve the same pages. Probe before deciding --
+                # only a confirmed-absent adopt rolls the flip back
+                # (keep serving locally); a confirmed/ambiguous adopt
+                # stays moved (consumers redirect, worst case they wait
+                # out the adopt exactly like the in-flight window).
+                adopted = False
+                try:
+                    adopted = client.task_info(task.task_id) is not None
+                except Exception as pe:  # noqa: BLE001 - 404 or dead
+                    # peer: no adopted copy is reachable -> roll back
+                    record_suppressed("worker", "migrate_probe", pe)
+                if not adopted:
+                    with task.lock:
+                        task.moved_to = None
+                    continue
+            with task.lock:
+                for b in task.buffers.values():
+                    b.clear()
+                task.buffers = {}
+            moved += npages
+            self._count("pages_migrated", npages)
+            record_event("buffer_migrate", query_id=task.task_id,
+                         pages=npages, to=peer_url)
+        return moved
+
+    def adopt_task(self, task_id: str, doc: dict) -> dict:
+        """Adopt a draining peer's finished task: restore its buffered
+        pages (at their original absolute token offsets) so redirected
+        consumers resume their pull streams here. Idempotent; refused
+        while this worker is itself draining (like new tasks)."""
+        from .flight_recorder import record_event
+        with self._tasks_lock:
+            self._prune_locked()
+            task = self.tasks.get(task_id)
+            if task is None:
+                if self.draining:
+                    raise RuntimeError(
+                        "worker is SHUTTING_DOWN: not adopting tasks")
+                task = _Task(task_id, self.output_spool_threshold_bytes,
+                             self.output_spool_dir)
+                self.tasks[task_id] = task
+                adopted = True
+            else:
+                adopted = False
+        if not adopted:
+            return task.info()
+        total = 0
+        with task.lock:
+            buffers: Dict[int, SpoolingOutputBuffer] = {}
+            for bid, pages in (doc.get("buffers") or {}).items():
+                buf = task._new_buffer()
+                total += buf.restore_pages(pages)
+                buffers[int(bid)] = buf
+            task.buffers = buffers or {0: task._new_buffer()}
+            task.first_token = {int(b): int(t) for b, t in
+                                (doc.get("firstToken") or {}).items()}
+            task.no_more_pages = bool(doc.get("noMorePages", True))
+            task.stats = dict(doc.get("stats") or {})
+            task.state = str(doc.get("state", "FINISHED"))
+            task.finished_at = time.time()
+        # already accounted (finished) by the origin worker: only the
+        # adoption itself counts
+        task._accounted = True
+        self._count("tasks_adopted")
+        record_event("task_adopt", query_id=task_id, bytes=total)
+        return task.info()
 
     def _prune_locked(self):
         """Drop terminal tasks (and their buffered pages) older than the
@@ -681,6 +841,10 @@ class TaskManager:
         if task is None:
             raise KeyError(task_id)
         with task.lock:
+            if task.moved_to is not None:
+                # pages migrated during graceful drain: point the
+                # consumer at the adopting peer (same absolute tokens)
+                raise _MovedError(task.moved_to)
             pages = task.buffers.get(buffer_id)
             npages = 0 if pages is None else len(pages)
             first = task.first_token.get(buffer_id, 0)
@@ -702,6 +866,8 @@ class TaskManager:
         if task is None:
             return
         with task.lock:
+            if task.moved_to is not None:
+                return  # pages live at the peer now; acks land there
             first = task.first_token.get(buffer_id, 0)
             drop = token - first
             pages = task.buffers.get(buffer_id)
@@ -735,6 +901,7 @@ class _Handler(BaseHTTPRequestHandler):
     node_id: str = ""
     started_at: float = 0.0
     authenticator = None  # InternalAuthenticator when a secret is set
+    worker_server = None  # the owning TpuWorkerServer (drain endpoints)
 
     def log_message(self, fmt, *args):  # quiet
         pass
@@ -833,12 +1000,18 @@ class _Handler(BaseHTTPRequestHandler):
         fams.extend(flight_recorder_families())
         fams.extend(kernel_audit_families())
         fams.extend(failpoint_families())
-        from .metrics import (live_introspection_families,
+        from .metrics import (fleet_families,
+                              live_introspection_families,
                               query_history_families)
         fams.extend(query_history_families())
         # a worker's "alive" view is itself (the statement tier reports
-        # its probed fleet count through the same builder)
+        # its probed fleet count through the same builder); its
+        # draining gauge is its own drain state
         fams.extend(live_introspection_families(workers_alive=1))
+        # DRAINED is not DRAINING: once the drain completes the gauge
+        # drops back to zero (matching the statement tier's count)
+        fams.extend(fleet_families(
+            workers_draining=1 if m.drain_state == "DRAINING" else 0))
         fams.extend(histogram_families())
         return fams
 
@@ -892,6 +1065,9 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_json(
                 doc if doc else {"error": f"no trace {parts[2]}"},
                 200 if doc else 404)
+        if parts == ["v1", "worker", "drain"]:
+            # live drain progress (state machine + unreplayed pages)
+            return self._send_json(self.worker_server.drain_status())
         if parts == ["v1", "status"]:
             # enriched NodeStatus (the /v1/cluster fleet overview's
             # per-worker row): uptime, engine version, running tasks,
@@ -907,6 +1083,11 @@ class _Handler(BaseHTTPRequestHandler):
                 "uptimeSeconds": round(time.time() - self.started_at, 1),
                 "state": ("SHUTTING_DOWN" if m.draining
                           else "ACTIVE"),
+                # the elastic-fleet state machine (/v1/cluster + ptop
+                # render this; the flat `state` keeps its legacy
+                # SHUTTING_DOWN spelling for older pollers)
+                "fleetState": m.drain_state,
+                "unreplayedPages": m.unreplayed_pages(),
                 "memory": {"reservedBytes": pool.reserved_bytes,
                            "capacityBytes": pool.capacity,
                            "peakBytes": pool.peak_bytes,
@@ -966,6 +1147,16 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send_json({"error": f"no such task {task_id}"}, 404)
             except _GoneError as e:
                 return self._send_json({"error": str(e)}, 410)
+            except _MovedError as e:
+                # drained-away pages: the consumer resumes its token
+                # stream against the adopting peer (client.fetch_results
+                # follows this header transparently)
+                return self._send_bytes(b"", {
+                    "X-Presto-Task-Instance-Id": task_id,
+                    "X-Presto-Task-Moved": str(e),
+                    "X-Presto-Page-Token": str(token),
+                    "X-Presto-Page-Next-Token": str(token),
+                    "X-Presto-Buffer-Complete": "false"})
             task = self.manager.get(task_id)
             if task is not None and task.state == "FAILED":
                 return self._send_json({"error": task.error}, 500)
@@ -989,6 +1180,28 @@ class _Handler(BaseHTTPRequestHandler):
             body = json.loads(self.rfile.read(length) or b"{}")
             doc, code = failpoints.admin_post(body)
             return self._send_json(doc, code)
+        if parts == ["v1", "worker", "drain"]:
+            # graceful drain: refuse new tasks, finish running ones,
+            # migrate remaining buffered pages ({"migrateTo": url}),
+            # unannounce when empty (GracefulShutdownHandler, grown up)
+            length = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            timeout_ms = body.get("timeoutMs")
+            return self._send_json(self.worker_server.begin_drain(
+                migrate_to=body.get("migrateTo"),
+                timeout_s=(float(timeout_ms) / 1000.0
+                           if timeout_ms is not None else None)))
+        if len(parts) == 4 and parts[:2] == ["v1", "task"] and \
+                parts[3] == "migrate":
+            # adopt a draining peer's finished task (buffered pages at
+            # their original token offsets)
+            length = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            try:
+                return self._send_json(
+                    self.manager.adopt_task(parts[2], body))
+            except RuntimeError as e:  # this worker is draining too
+                return self._send_json({"error": str(e)}, 503)
         if len(parts) == 3 and parts[:2] == ["v1", "task"]:
             length = int(self.headers.get("Content-Length", "0"))
             body = json.loads(self.rfile.read(length) or b"{}")
@@ -1075,6 +1288,10 @@ class TpuWorkerServer:
     """HTTP worker shell (PrestoServer.cpp:493 registerHttpEndpoints
     analog). start() binds a port and serves on background threads."""
 
+    # drain lifecycle state shared between the drain thread and the
+    # HTTP handlers (tpulint C001)
+    _GUARDED_BY = {"_drain_lock": ("_drain_thread", "_drain_migrated")}
+
     def __init__(self, port: int = 0, sf: float = 0.01, mesh=None,
                  node_id: Optional[str] = None,
                  discovery_url: Optional[str] = None,
@@ -1093,7 +1310,8 @@ class TpuWorkerServer:
         auth = make_authenticator(shared_secret, self.node_id)
         handler = type("BoundHandler", (_Handler,), {
             "manager": self.manager, "node_id": self.node_id,
-            "started_at": time.time(), "authenticator": auth})
+            "started_at": time.time(), "authenticator": auth,
+            "worker_server": self})
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         scheme = "http"
         if tls is not None:
@@ -1105,6 +1323,11 @@ class TpuWorkerServer:
             scheme = "https"
         self.port = self.httpd.server_address[1]
         self.url = f"{scheme}://127.0.0.1:{self.port}"
+        # a fresh worker on this url supersedes any drained
+        # predecessor's goodbye mark (explicit-url clusters never
+        # announce, so nothing else would clear it)
+        from .discovery import clear_unannounced
+        clear_unannounced(self.url)
         self._thread: Optional[threading.Thread] = None
         # stuck-progress watchdog (server/watchdog.py): scans this
         # manager's RUNNING tasks; disabled per task unless the session
@@ -1113,6 +1336,11 @@ class TpuWorkerServer:
         self._watchdog = StuckProgressWatchdog(
             self.manager._stuck_candidates, tier="worker")
         self._announcer = None
+        self._shared_secret = shared_secret  # drain-migration hops
+        self._drain_thread: Optional[threading.Thread] = None
+        self._drain_lock = threading.Lock()
+        self._drain_migrated = 0
+        self._stop_drain = threading.Event()  # server teardown signal
         if discovery_url:
             from .discovery import Announcer
             self._announcer = Announcer(
@@ -1129,9 +1357,137 @@ class TpuWorkerServer:
             self._announcer.start()
         return self
 
-    def stop(self):
+    def stop(self, unannounce: bool = True):
+        self._stop_drain.set()  # release a waiting drain thread
         if self._announcer:
-            self._announcer.stop(unannounce=True)
+            self._announcer.stop(unannounce=unannounce)
         self._watchdog.stop()
         self.httpd.shutdown()
         self.httpd.server_close()
+
+    def kill(self):
+        """Ungraceful stop (a crash, not a goodbye): the HTTP server
+        dies WITHOUT unannouncing, so discovery only notices when the
+        announcement ages out -- the failure-detection path the chaos
+        harness's kill rounds exercise, as opposed to stop()'s
+        graceful goodbye."""
+        self.stop(unannounce=False)
+
+    # -- graceful drain (POST /v1/worker/drain) -------------------------
+
+    def begin_drain(self, migrate_to: Optional[str] = None,
+                    timeout_s: Optional[float] = None) -> dict:
+        """Start the drain state machine (idempotent): refuse new
+        tasks, announce DRAINING, then -- on a background thread --
+        wait for running tasks, migrate remaining buffered pages to
+        `migrate_to` (when given), and unannounce only once no
+        unreplayed page remains (or the drain budget runs out: pages
+        then stay served locally until consumed)."""
+        with self._drain_lock:
+            already = self._drain_thread is not None
+            if not already:
+                self.manager.drain()
+                if self._announcer is not None:
+                    self._announcer.set_state("DRAINING")
+                t = threading.Thread(
+                    target=self._drain, args=(migrate_to, timeout_s),
+                    name=f"drain-{self.node_id}", daemon=True)
+                self._drain_thread = t
+        if already:
+            return self.drain_status()
+        from .metrics import record_suppressed
+        if self._announcer is not None:
+            try:
+                # a DRAINING announcement lands NOW, not at the next
+                # interval tick: placement filters react immediately.
+                # (A loop-thread announcement serialized just before
+                # set_state can land after this one and read ACTIVE for
+                # up to one interval -- harmless: the drain refusal +
+                # submit failover cover the window, and the next tick
+                # re-announces DRAINING.)
+                self._announcer.announce_once()
+            except Exception as e:  # noqa: BLE001 - discovery may be
+                # down; the drain itself must still proceed
+                record_suppressed("worker", "drain_announce", e)
+        t.start()
+        return self.drain_status()
+
+    def _drain(self, migrate_to: Optional[str],
+               timeout_s: Optional[float]) -> None:
+        from .flight_recorder import record_event
+        from .metrics import record_suppressed
+        if timeout_s is None:
+            # the drain_timeout_ms session-property SPEC is the single
+            # source of the default budget (callers override per
+            # request via the body's timeoutMs)
+            from ..utils.config import Session
+            timeout_s = float(Session({}).get("drain_timeout_ms")) / 1e3
+        budget = max(float(timeout_s), 0.0)
+        deadline = time.time() + budget
+        record_event("worker_drain", query_id=self.node_id,
+                     phase="start", migrateTo=migrate_to)
+        # 1. let running tasks finish (drain refuses only NEW ones)
+        while time.time() < deadline and \
+                self.manager.active_task_count() > 0:
+            time.sleep(0.05)
+        # 2. migrate the remaining buffered pages to the peer
+        moved = 0
+        try:
+            if failpoints.ARMED:
+                # delay/hang = a drain stuck behind a slow peer; error
+                # = the migration hop dies (pages stay local + served)
+                failpoints.hit("worker.drain_stall")
+            if migrate_to:
+                moved = self.manager.migrate_buffers(
+                    migrate_to, secret=self._shared_secret)
+        except Exception as e:  # noqa: BLE001 - a failed migration
+            # degrades drain to serve-until-consumed, never data loss
+            record_suppressed("worker", "drain_migrate", e)
+        with self._drain_lock:
+            self._drain_migrated = moved
+        # 3. unannounce only when empty (pages all migrated/consumed).
+        # The budget bounds how long we expect the fast path to take;
+        # past it the node logs budget_exhausted (operator-visible) but
+        # KEEPS waiting at a relaxed cadence -- a slow consumer must
+        # not wedge the worker in DRAINING forever after it finally
+        # drains the remainder
+        exhausted = False
+        while self.manager.unreplayed_pages() > 0 or \
+                self.manager.active_task_count() > 0:
+            if not exhausted and time.time() >= deadline:
+                exhausted = True
+                record_event("worker_drain", query_id=self.node_id,
+                             phase="budget_exhausted",
+                             migratedPages=moved,
+                             unreplayedPages=self.manager
+                             .unreplayed_pages())
+            if self._stop_drain.wait(0.25 if exhausted else 0.05):
+                return  # server stopping: leave the state as-is
+        self.manager.mark_drained()
+        if self._announcer is not None:
+            self._announcer.stop(unannounce=True)
+        # explicit-url clusters have no announcer: the process-wide
+        # goodbye registry still drops this node from /v1/cluster
+        # probes immediately (idempotent with the discovery DELETE)
+        from .discovery import note_unannounced
+        note_unannounced(self.url)
+        record_event("worker_drain", query_id=self.node_id,
+                     phase="complete", migratedPages=moved,
+                     unreplayedPages=0)
+
+    def drain_status(self) -> dict:
+        """The drain state machine's live document (POST/GET
+        /v1/worker/drain): ACTIVE | DRAINING | DRAINED plus the page
+        accounting the chaos gate audits (a DRAINED worker must report
+        zero unreplayed pages)."""
+        m = self.manager
+        with self._drain_lock:
+            migrated = self._drain_migrated
+        with m._counters_lock:
+            adopted = m.counters.get("tasks_adopted", 0)
+        return {"nodeId": self.node_id,
+                "state": m.drain_state,
+                "activeTasks": m.active_task_count(),
+                "unreplayedPages": m.unreplayed_pages(),
+                "migratedPages": migrated,
+                "adoptedTasks": adopted}

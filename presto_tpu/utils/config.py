@@ -88,6 +88,13 @@ class Config:
             return self._values[key]
         return _COERCE[prop.kind](prop.default)  # defaults coerce too ("12GB")
 
+    def get_explicit(self, key: str) -> Any:
+        """The EXPLICITLY-set value, or None when the key rides its
+        spec default -- for layered precedence chains (session value >
+        constructor > env) where the spec default must not shadow the
+        lower layers the way get()'s coerced default would."""
+        return self._values.get(key)
+
     @classmethod
     def from_properties_file(cls, spec: ConfigSpec, path: str) -> "Config":
         values = {}
@@ -223,6 +230,25 @@ SESSION_PROPERTIES = (
          "flight dump -- orthogonal to slow_query_threshold_ms, which "
          "fires on TOTAL wall time (env fallback PRESTO_TPU_STUCK_MS; "
          "0 disables)")
+    .add("speculative_execution_threshold_ms", "float", 0.0,
+         "straggler mitigation: a remote task whose live-progress "
+         "last-advance age (exec/progress.py -- the stuck-watchdog's "
+         "signal) exceeds this is speculatively re-submitted to "
+         "another worker; first FINISHED attempt wins, the loser is "
+         "aborted, and the winner alone feeds consumers (exactly-once "
+         "by construction). Orthogonal to stuck_query_threshold_ms, "
+         "which only OBSERVES the stall. Resolved by "
+         "Coordinator.execute(session=...) -- embeddings that drive a "
+         "Coordinator pass their session through; the constructor arg "
+         "and the PRESTO_TPU_SPECULATION_MS env cover the rest "
+         "(0 disables)")
+    .add("drain_timeout_ms", "float", 30000.0,
+         "graceful-drain budget (POST /v1/worker/drain): how long a "
+         "DRAINING worker waits for running tasks to finish and its "
+         "buffered result pages to migrate/be consumed before giving "
+         "up on unannouncing; this spec's default is what "
+         "begin_drain uses when the request body carries no "
+         "timeoutMs (server/worker.py)")
     .add("continuous_profiling", "bool", True,
          "accumulate per-kernel device-time profiles keyed by plan "
          "fingerprint (exec/profiler.py): calls, block_until_ready "

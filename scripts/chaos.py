@@ -97,6 +97,8 @@ OP_ROUNDS = [
     ("statement", "hang_deadline"),
     ("task", "stuck"),
     ("fusion", "demote"),
+    ("fleet", "elastic"),
+    ("fleet", "speculate"),
 ]
 
 
@@ -474,6 +476,124 @@ class ChaosRun:
                           "fusion_demotion flight event")
                 return "NO_FLIGHT_EVENT"
             return "match+demoted"
+        if op == "elastic":
+            # the elastic-fleet acceptance round: an 8-worker
+            # discovery-backed cluster changes shape MID-QUERY -- kill
+            # 2 workers, add 2, gracefully drain 1 (pages migrating to
+            # a peer) -- and the query must still match its fault-free
+            # oracle, the drained worker must end DRAINED with ZERO
+            # unreplayed buffered pages, and the armed drain_stall
+            # fault must be fully accounted like every other round
+            from presto_tpu.server.client import WorkerClient
+            step["site"], step["spec"] = \
+                "worker.drain_stall", "delay(100):once"
+            n = min(self.oracles)  # deterministic query choice
+            cluster.arm(step["site"], step["spec"])
+            disc = DiscoveryServer().start()
+            fleet = [TpuWorkerServer(sf=self.sf, discovery_url=disc.url,
+                                     announce_interval_s=0.2).start()
+                     for _ in range(8)]
+            try:
+                deadline = time.time() + 10
+                while time.time() < deadline and \
+                        len(alive_nodes(disc.url)) < 8:
+                    time.sleep(0.05)
+                coord = Coordinator(discovery_url=disc.url)
+                drained_w, peer_w = fleet[2], fleet[3]
+
+                def churn():
+                    time.sleep(0.15)
+                    fleet[0].kill()                       # kill 2
+                    fleet[1].kill()  # (ungraceful: no unannounce)
+                    for _ in range(2):                    # add 2
+                        fleet.append(TpuWorkerServer(
+                            sf=self.sf, discovery_url=disc.url,
+                            announce_interval_s=0.2).start())
+                    WorkerClient(                         # drain 1
+                        f"http://127.0.0.1:{drained_w.port}", 10).drain(
+                        migrate_to=f"http://127.0.0.1:{peer_w.port}",
+                        timeout_ms=20000)
+                churner = threading.Thread(target=churn, daemon=True)
+
+                def go():
+                    churner.start()
+                    cols, _ = coord.execute(self.plans[n], sf=self.sf,
+                                            timeout=self.args.timeout)
+                    return canon_rows(cols)
+                status, value = Watchdog(go, self.args.timeout + 30).run()
+                churner.join(30)
+                if status == "hung":
+                    self.fail(f"elastic round: q{n} HUNG past deadline")
+                    return "HUNG"
+                if status == "error":
+                    self.fail(f"elastic round: q{n} failed under fleet "
+                              f"churn: {type(value).__name__}: {value}")
+                    return f"clean_failure:{type(value).__name__}"
+                if value != self.oracles[n]:
+                    self.fail(f"elastic round: q{n} under kill/add/"
+                              f"drain returned WRONG rows")
+                    return "WRONG_RESULT"
+                # the drained worker must settle DRAINED with zero
+                # unreplayed pages (the graceful-exit acceptance bar)
+                deadline = time.time() + 25
+                st = drained_w.drain_status()
+                while time.time() < deadline and \
+                        st["state"] != "DRAINED":
+                    time.sleep(0.1)
+                    st = drained_w.drain_status()
+                if st["state"] != "DRAINED" or \
+                        st["unreplayedPages"] != 0:
+                    self.fail(f"elastic round: drained worker ended "
+                              f"{st}")
+                    return "UNREPLAYED_PAGES"
+                return "match+drained"
+            finally:
+                for w in fleet:
+                    try:
+                        w.stop()
+                    except Exception:  # noqa: BLE001 - already stopped
+                        pass
+                disc.stop()
+        if op == "speculate":
+            # straggler rescue: ONE task hangs well past the
+            # speculation threshold; the coordinator must re-run it
+            # elsewhere, the speculative copy must WIN (counter > 0),
+            # and the result must match the oracle -- speculation never
+            # duplicates or drops rows (first-result-wins dedup)
+            from presto_tpu.server.coordinator import speculation_totals
+            step["site"], step["spec"] = \
+                "worker.run_task", "hang(1800):once"
+            n = min(self.oracles)  # deterministic query choice
+            before = speculation_totals()["wins"]
+            cluster.arm(step["site"], step["spec"])
+            spec_coord = Coordinator(cluster.urls,
+                                     speculation_threshold_ms=300)
+
+            def go():
+                cols, _ = spec_coord.execute(self.plans[n], sf=self.sf,
+                                             timeout=self.args.timeout)
+                return canon_rows(cols)
+            status, value = Watchdog(go, self.args.timeout + 30).run()
+            if status == "hung":
+                self.fail(f"speculate round: q{n} HUNG past deadline")
+                return "HUNG"
+            if status == "error":
+                # this round's whole point is that speculation RESCUES
+                # the straggler -- a clean failure means it did not
+                self.fail(f"speculate round: q{n} failed instead of "
+                          f"being rescued: {type(value).__name__}: "
+                          f"{value}")
+                return "SPEC_FAILURE"
+            if value != self.oracles[n]:
+                self.fail(f"speculate round: q{n} returned WRONG rows "
+                          f"(duplicate/missing under speculation)")
+                return "WRONG_RESULT"
+            if speculation_totals()["wins"] <= before:
+                self.fail("speculate round: the straggler hung but no "
+                          "speculative attempt won")
+                return "NO_SPEC_WIN"
+            time.sleep(2.0)  # let the hung loser wake and self-abort
+            return "match+spec_win"
         if op == "hang_deadline":
             step["site"], step["spec"] = \
                 "statement.execute", "hang(1500):once"
@@ -596,7 +716,9 @@ class ChaosRun:
                    "correct_or_clean": not any(
                        "WRONG" in r["outcome"] or r["outcome"] in
                        ("HUNG", "NOT_RECOVERED", "NO_TIMEOUT", "UNFIRED",
-                        "UNDETECTED", "NO_FLIGHT_EVENT", "NOT_DEMOTED")
+                        "UNDETECTED", "NO_FLIGHT_EVENT", "NOT_DEMOTED",
+                        "NO_SPEC_WIN", "SPEC_FAILURE",
+                        "UNREPLAYED_PAGES")
                        for r in self.rounds),
                    "no_counter_decrease": not any(
                        "counter decreased" in f for f in self.failures),

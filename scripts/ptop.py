@@ -58,10 +58,15 @@ def render(doc: dict) -> str:
     tests can golden it without a terminal)."""
     lines = []
     q = doc.get("queries", {})
+    fleet = ""
+    if doc.get("workersDraining"):
+        fleet += f" ({doc['workersDraining']} draining)"
+    if doc.get("workersDead"):
+        fleet += f" ({doc['workersDead']} DEAD)"
     lines.append(
         f"presto-tpu cluster  up {doc.get('uptimeSeconds', 0):.0f}s  "
         f"workers {doc.get('workersAlive', 0)}/"
-        f"{doc.get('workersConfigured', 0)}  "
+        f"{doc.get('workersConfigured', 0)}{fleet}  "
         f"queries q:{q.get('queued', 0)} r:{q.get('running', 0)} "
         f"b:{q.get('blocked', 0)}  "
         f"done {q.get('finishedTotal', 0)}+{q.get('failedTotal', 0)}f  "
@@ -78,11 +83,15 @@ def render(doc: dict) -> str:
         age = prog.get("lastAdvanceAgeMs")
         age_s = f" adv {age / 1000.0:.1f}s ago" if age is not None \
             else ""
+        # straggler-mitigation provenance: speculative copies racing
+        # their originals show beside the bar
+        spec = prog.get("speculativeTasks", 0)
+        spec_s = f" spec:{spec}" if spec else ""
         lines.append(
             f"{rq.get('queryId', '?'):<26} {rq.get('state', '?'):<9} "
             f"{_bar(pct)} {pct:5.1f}%  "
             f"{prog.get('stage', '-'):<8} "
-            f"rows {int(prog.get('rows', 0)):>10,}{age_s}")
+            f"rows {int(prog.get('rows', 0)):>10,}{age_s}{spec_s}")
         lines.append(f"  {rq.get('query', '')[:74]}")
     lines.append("-" * 78)
     workers = doc.get("workers", [])
@@ -90,9 +99,12 @@ def render(doc: dict) -> str:
         lines.append("(no workers configured: embedded engine)")
     for w in workers:
         mem = w.get("memory", {})
+        # the elastic fleet state machine (ACTIVE | DRAINING | DRAINED
+        # | DEAD), falling back to the legacy flat state for old nodes
+        state = w.get("fleetState", w.get("state", "?"))
         lines.append(
             f"{w.get('nodeId', w.get('uri', '?')):<26} "
-            f"{w.get('state', '?'):<13} "
+            f"{state:<13} "
             f"tasks {w.get('runningTasks', w.get('activeTasks', 0)):>3} "
             f" mem {_fmt_bytes(mem.get('reservedBytes', 0))}/"
             f"{_fmt_bytes(mem.get('capacityBytes', 0))} "

@@ -76,6 +76,22 @@ CLUSTER_FAMILIES = (
     "presto_tpu_stuck_queries_total",
 )
 
+# elastic fleet (server/discovery.py + coordinator speculation +
+# resource_manager failover): its own always-present section, zeros
+# included -- during a deploy/drain "how many workers joined/left/are
+# draining, did speculation fire, did a coordinator fail over" is the
+# first question, and "nothing moved" is an answer too
+FLEET_FAMILIES = (
+    "presto_tpu_fleet_workers_joined_total",
+    "presto_tpu_fleet_workers_left_total",
+    "presto_tpu_fleet_workers_draining",
+    "presto_tpu_announce_retries_total",
+    "presto_tpu_speculation_launched_total",
+    "presto_tpu_speculation_wins_total",
+    "presto_tpu_speculation_losses_total",
+    "presto_tpu_coordinator_failovers_total",
+)
+
 
 _LE_RE = re.compile(r'le="([^"]+)"')
 
@@ -121,7 +137,7 @@ def diff(before: dict, after: dict) -> dict:
     histogram window quantiles, counter-monotonicity violations, plus
     the always-present tracing/flight-recorder section."""
     out = {"counters": {}, "gauges": {}, "tracing": {}, "faults": {},
-           "history": {}, "cluster": {}, "histograms": {},
+           "history": {}, "cluster": {}, "fleet": {}, "histograms": {},
            "violations": {}}
     hist_bases = set()
     for fam, samples in after.items():
@@ -136,6 +152,7 @@ def diff(before: dict, after: dict) -> dict:
         is_fault = fam.startswith(FAULT_FAMILY_PREFIX)
         is_history = fam in HISTORY_FAMILIES
         is_cluster = fam in CLUSTER_FAMILIES
+        is_fleet = fam in FLEET_FAMILIES
         for key, val in samples.items():
             label = fam + key
             if is_counter:
@@ -150,6 +167,10 @@ def diff(before: dict, after: dict) -> dict:
                     out["faults"][label] = round(delta, 6)
                 elif is_history:
                     out["history"][label] = round(delta, 6)
+                elif is_fleet:
+                    # membership churn / speculation / failover deltas,
+                    # zeros included
+                    out["fleet"][label] = round(delta, 6)
                 elif is_cluster:
                     # stuck-firing delta rides the cluster section
                     out["cluster"][label] = round(delta, 6)
@@ -165,6 +186,10 @@ def diff(before: dict, after: dict) -> dict:
                 # the archive-size gauge rides the history section:
                 # "N records retained, 0 regressions" reads off one block
                 out["history"][label] = round(val, 6)
+            elif is_fleet:
+                # the draining gauge rides the fleet section: "2 left,
+                # 1 still draining" reads off one block
+                out["fleet"][label] = round(val, 6)
             elif is_cluster:
                 # current gauge values: "what is in flight NOW" reads
                 # off one block beside the stuck delta

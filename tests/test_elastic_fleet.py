@@ -1,0 +1,520 @@
+"""Elastic fleet: worker join/leave with shard rebalancing, graceful
+drain with exactly-once buffer migration, speculative re-execution of
+stragglers, and coordinator failover.
+
+The chaos harness's `elastic`/`speculate` rounds exercise these paths
+under a full 8-worker cluster; this file pins each mechanism in
+isolation -- the migration byte stream (checksummed before drain and
+after the redirected fetch), the first-result-wins dedup, the
+announcer's re-registration backoff, the failover handshake's
+exactly-once adoption, and the fleet observability surfaces.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from presto_tpu import failpoints as fp
+from presto_tpu.exec import progress, run_query
+from presto_tpu.plan.fragment import distribute_simple_agg
+from presto_tpu.server import Coordinator, TpuWorkerServer
+from presto_tpu.server.buffers import SpoolingOutputBuffer
+from presto_tpu.server.client import WorkerClient
+from presto_tpu.server.coordinator import (reset_speculation_totals,
+                                           speculation_totals)
+from presto_tpu.server.discovery import (Announcer, DiscoveryServer,
+                                         alive_nodes,
+                                         announce_retry_totals,
+                                         fleet_membership_totals,
+                                         recently_unannounced,
+                                         reset_fleet_state)
+from presto_tpu.server.resource_manager import (ClusterStateSender,
+                                                ResourceManager,
+                                                StandbyCoordinator,
+                                                failover_totals,
+                                                reset_failover_totals)
+from presto_tpu.server.router import RouterServer
+from presto_tpu.server.statement import StatementServer
+from presto_tpu.sql import plan_sql
+
+SF = 0.01
+SQL = ("SELECT custkey, sum(totalprice) AS s, count(*) AS c "
+       "FROM orders GROUP BY custkey")
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    fp.disarm_all()
+    yield
+    fp.disarm_all()
+    # the goodbye registry is process-wide; a lingering mark could
+    # shadow a later test's worker that reuses the ephemeral port
+    reset_fleet_state()
+
+
+def _wait_for(cond, timeout=10.0, step=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(step)
+    raise AssertionError("condition not reached in time")
+
+
+def _stop_all(*servers):
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:  # noqa: BLE001 - already stopped
+            pass
+
+
+# -- buffer migration: the wire format and the exactly-once law ---------
+
+def test_buffer_export_restore_checksum_roundtrip(tmp_path):
+    src = SpoolingOutputBuffer(memory_threshold_bytes=32,
+                               spool_dir=str(tmp_path))
+    pages = [bytes([i]) * (20 + i) for i in range(5)]  # tail spools
+    src.extend(pages)
+    assert src.spooled_bytes > 0  # the spool tier is in the stream
+    src.drop_prefix(1)            # acked prefix must NOT migrate
+    want = src.stream_checksum()
+    dst = SpoolingOutputBuffer(memory_threshold_bytes=32,
+                               spool_dir=str(tmp_path))
+    total = dst.restore_pages(src.export_pages())
+    assert total == sum(len(p) for p in pages[1:])
+    assert dst.stream_checksum() == want
+    assert [dst.get(i) for i in range(len(dst))] == pages[1:]
+    src.clear()
+    dst.clear()
+
+
+def test_drain_migrates_pages_exactly_once():
+    """The acceptance pin: a drained worker's result pages replay to
+    the consumer byte-identically (checksum before drain == checksum
+    after the redirected fetch) and exactly once (row counts match the
+    direct pull), and the drained worker exits with ZERO unreplayed
+    buffered pages."""
+    w1 = TpuWorkerServer(sf=SF).start()
+    w2 = TpuWorkerServer(sf=SF).start()
+    try:
+        plan = plan_sql("SELECT regionkey, name FROM region")
+        c1 = WorkerClient(f"http://127.0.0.1:{w1.port}", 30.0)
+        c1.submit("mig1", plan, sf=SF)
+        assert c1.wait("mig1", 30)["state"] == "FINISHED"
+        task = w1.manager.get("mig1")
+        with task.lock:
+            pre = {b: buf.stream_checksum()
+                   for b, buf in task.buffers.items()}
+        st = c1.drain(migrate_to=f"http://127.0.0.1:{w2.port}",
+                      timeout_ms=15000)
+        assert st["state"] in ("DRAINING", "DRAINED")
+        st = _wait_for(lambda: (c1.drain_status()
+                                if c1.drain_status()["state"] == "DRAINED"
+                                else None), timeout=15)
+        assert st["unreplayedPages"] == 0
+        assert st["migratedPages"] >= 1
+        # adopted byte-identically at the peer
+        atask = w2.manager.get("mig1")
+        with atask.lock:
+            post = {b: buf.stream_checksum()
+                    for b, buf in atask.buffers.items()}
+        assert post == pre
+        # the consumer's pull through the DRAINED worker's url follows
+        # the moved header and replays the stream exactly once
+        types = plan.output_types()
+        cols = c1.fetch_results("mig1", types)
+        assert len(cols[0][0]) == 5
+        assert sorted(str(v) for v in cols[1][0]) == sorted(
+            ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"])
+        # pages were acked at the peer by the pull above: a re-pull
+        # finds the acked prefix gone (410), NOT a duplicate stream
+        with pytest.raises(urllib.request.HTTPError) as ei:
+            WorkerClient(f"http://127.0.0.1:{w2.port}", 5.0) \
+                .fetch_results("mig1", types)
+        assert ei.value.code == 410
+    finally:
+        _stop_all(w1, w2)
+
+
+def test_drain_migration_carries_the_cluster_secret():
+    """On a secured cluster the migration hop must authenticate like
+    every other internal hop -- otherwise every adopt 401s and drain
+    silently degrades to serve-until-consumed."""
+    secret = "fleet-secret"
+    w1 = TpuWorkerServer(sf=SF, shared_secret=secret).start()
+    w2 = TpuWorkerServer(sf=SF, shared_secret=secret).start()
+    try:
+        plan = plan_sql("SELECT regionkey FROM region")
+        c1 = WorkerClient(f"http://127.0.0.1:{w1.port}", 30.0,
+                          shared_secret=secret)
+        c1.submit("sec1", plan, sf=SF)
+        assert c1.wait("sec1", 30)["state"] == "FINISHED"
+        c1.drain(migrate_to=f"http://127.0.0.1:{w2.port}",
+                 timeout_ms=15000)
+        st = _wait_for(lambda: (c1.drain_status()
+                                if c1.drain_status()["state"] == "DRAINED"
+                                else None), timeout=15)
+        assert st["migratedPages"] >= 1 and st["unreplayedPages"] == 0
+        assert w2.manager.get("sec1") is not None
+        cols = c1.fetch_results("sec1", plan.output_types())
+        assert len(cols[0][0]) == 5  # redirected pull authenticates too
+    finally:
+        _stop_all(w1, w2)
+
+
+def test_drain_refuses_new_tasks_and_reports_fleet_state():
+    w = TpuWorkerServer(sf=SF).start()
+    try:
+        c = WorkerClient(f"http://127.0.0.1:{w.port}", 10.0)
+        assert c.status()["fleetState"] == "ACTIVE"
+        c.drain(timeout_ms=5000)
+        st = c.status()
+        assert st["fleetState"] in ("DRAINING", "DRAINED")
+        assert st["state"] == "SHUTTING_DOWN"  # legacy spelling kept
+        plan = plan_sql("SELECT 1")
+        with pytest.raises(urllib.request.HTTPError) as ei:
+            c.submit("refused", plan, sf=SF)
+        assert ei.value.code == 503
+        # idle worker settles DRAINED with nothing left to replay
+        st = _wait_for(lambda: (c.drain_status()
+                                if c.drain_status()["state"] == "DRAINED"
+                                else None), timeout=10)
+        assert st["unreplayedPages"] == 0 and st["activeTasks"] == 0
+    finally:
+        _stop_all(w)
+
+
+def test_drain_migration_failure_keeps_pages_served_locally():
+    """worker.drain_stall=error: the migration hop dies; pages stay
+    local and correct (drain degrades to serve-until-consumed, never
+    data loss), and the worker does NOT claim DRAINED."""
+    w1 = TpuWorkerServer(sf=SF).start()
+    w2 = TpuWorkerServer(sf=SF).start()
+    try:
+        plan = plan_sql("SELECT regionkey FROM region")
+        c1 = WorkerClient(f"http://127.0.0.1:{w1.port}", 30.0)
+        c1.submit("stall1", plan, sf=SF)
+        assert c1.wait("stall1", 30)["state"] == "FINISHED"
+        fp.arm("worker.drain_stall", "error(OSError):once")
+        c1.drain(migrate_to=f"http://127.0.0.1:{w2.port}",
+                 timeout_ms=600)
+        time.sleep(1.2)  # budget exhausted
+        st = c1.drain_status()
+        assert st["state"] == "DRAINING"       # never lied about DRAINED
+        assert st["unreplayedPages"] >= 1      # pages still local
+        assert w2.manager.get("stall1") is None
+        cols = c1.fetch_results("stall1", plan.output_types())
+        assert len(cols[0][0]) == 5            # served until consumed
+        assert fp.active()["worker.drain_stall"]["fires"] == 1
+    finally:
+        _stop_all(w1, w2)
+
+
+# -- speculative re-execution -------------------------------------------
+
+@pytest.fixture(scope="module")
+def oracle():
+    local = run_query(plan_sql(SQL, max_groups=1 << 14), sf=SF)
+    return {r[0]: (int(r[1]), int(r[2])) for r in local.rows()}
+
+
+def test_speculation_rescues_straggler_no_duplicate_rows(oracle):
+    """every(2) hangs make alternating task executions straggle; the
+    speculative copies must win (counter > 0) and the oracle-matched
+    result proves no duplicate or missing rows (first-result-wins
+    dedup + loser cancellation)."""
+    ws = [TpuWorkerServer(sf=SF).start() for _ in range(2)]
+    urls = [f"http://127.0.0.1:{w.port}" for w in ws]
+    try:
+        coord = Coordinator(urls, speculation_threshold_ms=250)
+        dist = distribute_simple_agg(plan_sql(SQL, max_groups=1 << 14))
+        coord.execute(dist, sf=SF, timeout=60.0)  # warm compile caches
+        reset_speculation_totals()
+        fp.arm("worker.run_task", "hang(1500):every(2)")
+        cols, _ = coord.execute(dist, sf=SF, timeout=60.0)
+        got = {int(cols[0][0][i]): (int(cols[1][0][i]),
+                                    int(cols[2][0][i]))
+               for i in range(len(cols[0][0]))}
+        assert got == oracle
+        st = speculation_totals()
+        assert st["launched"] >= 1 and st["wins"] >= 1, st
+        fp.disarm_all()
+        time.sleep(1.6)  # let hung losers wake and self-abort
+    finally:
+        _stop_all(*ws)
+
+
+def test_speculation_threshold_resolution(monkeypatch):
+    coord = Coordinator(["http://127.0.0.1:1"])
+    assert coord._speculation_ms() == 0.0          # off by default
+    monkeypatch.setenv("PRESTO_TPU_SPECULATION_MS", "750")
+    assert coord._speculation_ms() == 750.0        # env fallback
+    coord.speculation_threshold_ms = 300
+    assert coord._speculation_ms() == 300.0        # constructor wins
+    assert coord._speculation_ms(
+        {"speculative_execution_threshold_ms": 120}) == 120.0
+    assert coord._speculation_ms(
+        {"speculative_execution_threshold_ms": "bogus"}) == 0.0
+    # a Session OBJECT's unset property (coerced spec default 0.0)
+    # must not shadow the constructor/env layers below it
+    from presto_tpu.utils.config import Session
+    assert coord._speculation_ms(Session({})) == 300.0
+    assert coord._speculation_ms(Session(
+        {"speculative_execution_threshold_ms": 120})) == 120.0
+
+
+# -- dynamic membership / rebalancing -----------------------------------
+
+def test_workers_follow_discovery_join_leave_and_draining():
+    reset_fleet_state()
+    disc = DiscoveryServer().start()
+    w1 = TpuWorkerServer(sf=SF, discovery_url=disc.url,
+                         announce_interval_s=30.0).start()
+    w2 = TpuWorkerServer(sf=SF, discovery_url=disc.url,
+                         announce_interval_s=30.0).start()
+    try:
+        _wait_for(lambda: len(alive_nodes(disc.url)) == 2)
+        coord = Coordinator(discovery_url=disc.url)
+        assert sorted(coord.workers()) == sorted([w1.url, w2.url])
+        assert fleet_membership_totals()["joined"] == 2
+        # a DRAINING announcement takes the node out of NEW placement
+        w2._announcer.set_state("DRAINING")
+        w2._announcer.announce_once()
+        assert coord.workers() == [w1.url]
+        # ...but never filters down to an empty cluster
+        w1._announcer.set_state("DRAINING")
+        w1._announcer.announce_once()
+        assert sorted(coord.workers()) == sorted([w1.url, w2.url])
+        # a graceful goodbye leaves the alive set immediately
+        w2._announcer.set_state("ACTIVE")
+        w2._announcer.announce_once()
+        w1._announcer.unannounce_once()
+        assert coord.workers() == [w2.url]
+        assert fleet_membership_totals()["left"] == 1
+        assert w1.url.rstrip("/") in recently_unannounced()
+    finally:
+        _stop_all(w1, w2, disc)
+
+
+def test_unannounce_lost_failpoint_leaves_node_to_age_out():
+    disc = DiscoveryServer().start()
+    try:
+        a = Announcer(disc.url, "ghost", "http://127.0.0.1:9", 30.0)
+        a.announce_once()
+        fp.arm("discovery.unannounce_lost", "error(OSError):once")
+        a.stop(unannounce=True)  # the goodbye DELETE is lost...
+        assert fp.active()["discovery.unannounce_lost"]["fires"] == 1
+        # ...so the node lingers (silent age-out, the path the
+        # announce-retry backoff exists to shorten)
+        assert any(n["nodeId"] == "ghost"
+                   for n in alive_nodes(disc.url, max_age_s=1e9))
+    finally:
+        _stop_all(disc)
+
+
+def test_announcer_backoff_retries_then_recovers():
+    """A worker that cannot reach discovery retries on the backoff
+    schedule (counted) instead of waiting out its full interval, so a
+    restarted discovery server sees it re-register promptly."""
+    reset_fleet_state()
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    a = Announcer(f"http://127.0.0.1:{port}", "late-node",
+                  "http://127.0.0.1:9", interval_s=60.0)
+    a.start()
+    disc = None
+    try:
+        _wait_for(lambda: announce_retry_totals() >= 2, timeout=10)
+        disc = DiscoveryServer(port=port).start()
+        _wait_for(lambda: any(
+            n["nodeId"] == "late-node"
+            for n in alive_nodes(disc.url, max_age_s=1e9)), timeout=10)
+    finally:
+        a.stop(unannounce=False)
+        if disc is not None:
+            _stop_all(disc)
+
+
+# -- coordinator failover -----------------------------------------------
+
+def test_standby_adopts_inflight_queries_exactly_once():
+    reset_failover_totals()
+    rm = ResourceManager(heartbeat_ttl_s=1.0).start()
+    gate = threading.Event()
+
+    def wedged_executor(text, session_values, query_id, txn_id):
+        from presto_tpu.sql import sql as run_sql
+        gate.wait(20)
+        return run_sql(text, sf=SF)
+
+    primary = StatementServer(sf=SF, executor=wedged_executor,
+                              queue_poll_s=0.05).start()
+    standby = StatementServer(sf=SF, queue_poll_s=0.05).start()
+    try:
+        sender = ClusterStateSender(rm.url, "primary",
+                                    primary.dispatcher,
+                                    inflight_fn=primary.inflight_doc)
+        monitor = StandbyCoordinator(rm.url, "primary", standby,
+                                     ttl_s=0.4)
+        from presto_tpu.client import StatementClient
+        c = StatementClient(primary.url, "SELECT count(*) FROM region")
+        qid = c.query_id
+        with primary._qlock:
+            slug = primary._queries[qid].slug
+        _wait_for(lambda: primary.inflight_doc())
+        sender.send_once()              # manifest rides the heartbeat
+        assert monitor.check_once() is False  # primary alive
+        time.sleep(1.1)                 # heartbeat lapses
+        assert monitor.check_once() is True   # failover fires
+        assert failover_totals() == 1
+        assert monitor.check_once() is False  # exactly-once
+        assert monitor.is_primary
+        q = standby.get_query(qid, slug)      # SAME id + slug
+        assert q is not None
+        q.machine.wait_done(20)
+        assert q.machine.state == "FINISHED" and q.rows == [[5]]
+        # idempotent adoption: a second manifest replay is a no-op
+        assert standby.adopt_query(qid, slug, "SELECT 1", "x", {}) is q
+    finally:
+        gate.set()
+        _stop_all(primary, standby, rm)
+
+
+def test_heartbeat_lapse_failpoint_and_router_standby_promotion():
+    rm = ResourceManager(heartbeat_ttl_s=5.0).start()
+    primary = StatementServer(sf=SF, queue_poll_s=0.05).start()
+    standby = StatementServer(sf=SF, queue_poll_s=0.05).start()
+    router = RouterServer([{"url": primary.url, "kind": "tpu"},
+                           {"url": standby.url, "kind": "standby"}],
+                          health_ttl_s=0.0).start()
+    try:
+        sender = ClusterStateSender(rm.url, "p1", primary.dispatcher)
+        fp.arm("coordinator.heartbeat_lapse", "error(OSError):once")
+        with pytest.raises(OSError):
+            sender.send_once()          # the heartbeat is lost
+        assert fp.active()["coordinator.heartbeat_lapse"]["fires"] == 1
+        sender.send_once()              # next one lands
+        with urllib.request.urlopen(
+                f"{rm.url}/v1/resourcemanager", timeout=5) as r:
+            view = json.loads(r.read())
+        assert "p1" in view["coordinators"]
+        # the router half: standby serves only while no primary answers
+        assert router.pick("SELECT 1").url == primary.url.rstrip("/")
+        primary.stop()
+        assert router.pick("SELECT 1").url == standby.url.rstrip("/")
+    finally:
+        _stop_all(router, primary, standby, rm)
+
+
+# -- fleet observability surfaces ---------------------------------------
+
+def test_cluster_doc_renders_draining_dead_and_unannounced():
+    reset_fleet_state()
+    w1 = TpuWorkerServer(sf=SF).start()
+    w2 = TpuWorkerServer(sf=SF).start()
+    dead_url = "http://127.0.0.1:1"
+    srv = StatementServer(sf=SF, profile_workers=[
+        w1.url, w2.url, dead_url]).start()
+    try:
+        w1.manager.drain()  # DRAINING, still probe-able
+        doc = srv.cluster_doc()
+        states = {w.get("uri", "").rstrip("/"): w["fleetState"]
+                  for w in doc["workers"]}
+        assert states[w1.url.rstrip("/")] == "DRAINING"
+        assert states[w2.url.rstrip("/")] == "ACTIVE"
+        assert states[dead_url] == "DEAD"
+        assert doc["workersAlive"] == 2
+        assert doc["workersDraining"] == 1 and doc["workersDead"] == 1
+        # ptop renders the fleet states off the same document
+        import sys
+        sys.path.insert(0, "scripts")
+        import ptop
+        frame = ptop.render(doc)
+        assert "DRAINING" in frame and "DEAD" in frame
+        assert "(1 draining)" in frame and "(1 DEAD)" in frame
+        # an unannounced (drained-away) worker drops out IMMEDIATELY:
+        # no probe, no DEAD flapping, gauge down by one
+        from presto_tpu.server.discovery import note_unannounced
+        note_unannounced(w2.url)
+        doc = srv.cluster_doc()
+        uris = {w.get("uri", "").rstrip("/") for w in doc["workers"]}
+        assert w2.url.rstrip("/") not in uris
+        assert doc["workersAlive"] == 1
+        assert doc["workersUnannounced"] == 1
+    finally:
+        _stop_all(srv, w1, w2)
+        reset_fleet_state()
+
+
+def test_fleet_metric_families_on_both_tiers():
+    from presto_tpu.server.metrics import parse_prometheus
+    w = TpuWorkerServer(sf=SF).start()
+    srv = StatementServer(sf=SF).start()
+    try:
+        want = {"presto_tpu_fleet_workers_joined_total",
+                "presto_tpu_fleet_workers_left_total",
+                "presto_tpu_announce_retries_total",
+                "presto_tpu_speculation_launched_total",
+                "presto_tpu_speculation_wins_total",
+                "presto_tpu_speculation_losses_total",
+                "presto_tpu_coordinator_failovers_total",
+                "presto_tpu_fleet_workers_draining"}
+        for base in (w.url, srv.url):
+            with urllib.request.urlopen(f"{base}/v1/metrics",
+                                        timeout=5) as r:
+                fams = parse_prometheus(r.read().decode())
+            assert want <= set(fams), base
+    finally:
+        _stop_all(srv, w)
+
+
+def test_scrape_metrics_fleet_section():
+    import sys
+    sys.path.insert(0, "scripts")
+    import scrape_metrics
+    w = TpuWorkerServer(sf=SF).start()
+    try:
+        before = scrape_metrics.scrape(w.url)
+        after = scrape_metrics.scrape(w.url)
+        d = scrape_metrics.diff(before, after)
+        assert "fleet" in d
+        keys = " ".join(d["fleet"])
+        assert "presto_tpu_speculation_wins_total" in keys
+        assert "presto_tpu_fleet_workers_draining" in keys
+        assert "presto_tpu_coordinator_failovers_total" in keys
+    finally:
+        _stop_all(w)
+
+
+def test_live_tasks_speculative_provenance():
+    from presto_tpu.sql import sql
+    progress.begin("fleetq.f0.w0.spec", kind="task", query="fleetq")
+    progress.begin("fleetq.f0.w1", kind="task", query="fleetq")
+    try:
+        res = sql("SELECT task_id, speculative FROM system.live_tasks",
+                  sf=SF)
+        rows = {r[0]: bool(r[1]) for r in res.rows()}
+        assert rows["fleetq.f0.w0.spec"] is True
+        assert rows["fleetq.f0.w1"] is False
+    finally:
+        progress.finish_task("fleetq.f0.w0.spec", "ABORTED")
+        progress.finish_task("fleetq.f0.w1", "ABORTED")
+
+
+def test_new_failpoint_sites_cataloged():
+    from presto_tpu.failpoints import SITES, sites_by_layer
+    for site in ("discovery.unannounce_lost", "worker.drain_stall",
+                 "coordinator.heartbeat_lapse"):
+        assert site in SITES
+    by_layer = sites_by_layer()
+    assert "worker.drain_stall" in by_layer["fleet"]
+    assert "coordinator.heartbeat_lapse" in by_layer["fleet"]
+    assert "discovery.unannounce_lost" in by_layer["discovery"]
