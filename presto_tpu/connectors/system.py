@@ -88,7 +88,13 @@ SCHEMA = {
                 "processed_bytes": T.BIGINT,
                 "progress_percent": T.DOUBLE,
                 "stage": _V,
-                "last_advance_age_ms": T.BIGINT},
+                "last_advance_age_ms": T.BIGINT,
+                # admission + batching attribution (PR 13): the
+                # resource group the dispatcher routed the query to
+                # and the batched-dispatch occupancy that served it
+                # (0 = serial dispatch)
+                "resource_group": _V,
+                "batch_size": T.BIGINT},
     # in-flight query/task progress heartbeats (exec/progress.py):
     # one row per live entry this process tracks -- local engine
     # queries, this worker's tasks, and remote tasks the coordinator's
@@ -168,7 +174,9 @@ def _rows_of(table: str) -> List[tuple]:
                             int(prog.get("bytes", 0)),
                             float(prog.get("progressPercent", 0.0)),
                             str(prog.get("stage", "")),
-                            int(prog.get("lastAdvanceAgeMs", 0))))
+                            int(prog.get("lastAdvanceAgeMs", 0)),
+                            str(doc.get("resourceGroup", "")),
+                            int(doc.get("batchSize", 0))))
         return out
     if table == "live_tasks":
         from ..exec.progress import live_snapshots

@@ -90,6 +90,12 @@ BENCH_SPECS: Sequence[MetricSpec] = (
     MetricSpec("query_wall_s", rel_threshold=0.6, abs_floor=0.5),
     MetricSpec("staged_mb", rel_threshold=0.10, abs_floor=8.0,
                mad_k=3.0),
+    # the concurrent-query throughput tier (scripts/loadgen.py
+    # LOADGEN_r* artifacts): queries/sec regresses DOWN, tail latency
+    # UP -- both on shared-CI noise, so the bands stay proportional
+    MetricSpec("qps", higher_is_worse=False,
+               rel_threshold=0.6, abs_floor=0.0),
+    MetricSpec("p99_ms", rel_threshold=0.75, abs_floor=25.0),
 )
 
 # MAD -> sigma consistency constant for normally distributed noise

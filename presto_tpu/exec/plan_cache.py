@@ -125,7 +125,12 @@ KERNEL_MODE_ENVS = (("PRESTO_TPU_SMALLG", "auto"),
                     # like the audit knob, program-invariant but
                     # registered so every ambient knob exec/ reads lives
                     # in this one R001-checked list
-                    ("PRESTO_TPU_PROFILE", "1"))
+                    ("PRESTO_TPU_PROFILE", "1"),
+                    # concurrent-query batching (exec/batching.py): the
+                    # batched dispatch traces a vmapped program over the
+                    # parameter axis, so the mode is part of every batch
+                    # key (and rides the one R001-checked env list)
+                    ("PRESTO_TPU_BATCHING", "1"))
 
 
 def _kernel_mode() -> str:

@@ -50,6 +50,7 @@ import uuid
 from typing import Dict, List, Optional
 
 __all__ = ["profiling_enabled", "record_call", "note_footprint",
+           "note_query_kernel",
            "profile_snapshot", "profile_doc", "profile_for_query",
            "query_fingerprints", "merge_kernel_rows",
            "cluster_profile_doc",
@@ -201,6 +202,24 @@ def record_call(fingerprint: str, label: str = "", tables: str = "",
         # the query it observes; leave the counted trace
         from ..server.metrics import record_suppressed
         record_suppressed("profiler", "record_call", e)
+
+
+def note_query_kernel(fingerprint: str, query_ids: List[str]) -> None:
+    """Cross-link several query ids to one executed fingerprint in a
+    single registry pass -- the batched-dispatch path's attribution
+    (record_call folds the dispatch once for the leader; followers
+    only need the query->fingerprint edge history/flight dumps read)."""
+    with _LOCK:
+        for query_id in query_ids:
+            fps = _QUERY_KERNELS.get(query_id)
+            if fps is None:
+                fps = _QUERY_KERNELS[query_id] = []
+                while len(_QUERY_KERNELS) > _QUERY_KERNELS_MAX:
+                    _QUERY_KERNELS.popitem(last=False)
+            else:
+                _QUERY_KERNELS.move_to_end(query_id)
+            if fingerprint not in fps:
+                fps.append(fingerprint)
 
 
 def note_footprint(fingerprint: str, peak_bytes: int) -> None:

@@ -23,7 +23,9 @@ constant-folding these in LocalExecutionPlanner/bytecode gen.
 
 from __future__ import annotations
 
+import contextlib
 import functools
+import threading
 from typing import Callable, Sequence, Union
 
 import jax
@@ -33,13 +35,52 @@ import numpy as np
 from .. import types as T
 from ..block import Batch, Column, DictionaryColumn, StringColumn
 from . import functions as F
-from .ir import (Call, Constant, InputReference, Lambda, LambdaVariable,
-                 RowExpression, SpecialForm)
+from .ir import (BatchParam, Call, Constant, InputReference, Lambda,
+                 LambdaVariable, RowExpression, SpecialForm)
 
 Block = Union[Column, StringColumn]
 
 __all__ = ["compile_expression", "compile_filter", "compile_projections",
-           "evaluate"]
+           "evaluate", "bound_params"]
+
+
+# ---------------------------------------------------------------------------
+# batch-parameter scope (exec/batching.py)
+# ---------------------------------------------------------------------------
+#
+# A parameterized template plan contains BatchParam leaves instead of
+# Constants; evaluation reads slot `index` of the params bound on THIS
+# thread while the program traces. The batching executor binds traced
+# (value, null) scalar pairs inside its vmapped wrapper, so one traced
+# program serves every member of a query batch with per-member values.
+
+_PARAM_SCOPE = threading.local()
+
+
+@contextlib.contextmanager
+def bound_params(values: Sequence):
+    """Bind the ambient parameter vector (sequence of (value, is_null)
+    scalars -- concrete or traced) for BatchParam evaluation on this
+    thread for the duration of a trace."""
+    prev = getattr(_PARAM_SCOPE, "values", None)
+    _PARAM_SCOPE.values = values
+    try:
+        yield
+    finally:
+        _PARAM_SCOPE.values = prev
+
+
+def _param_block(p: BatchParam, capacity: int) -> Block:
+    values = getattr(_PARAM_SCOPE, "values", None)
+    if values is None:
+        raise RuntimeError(
+            "BatchParam evaluated outside a bound_params scope -- "
+            "template plans only execute through exec/batching.py")
+    v, null = values[p.index]
+    dt = p.type.to_dtype()
+    vals = jnp.broadcast_to(jnp.asarray(v, dtype=dt), (capacity,))
+    nulls = jnp.broadcast_to(jnp.asarray(null, dtype=bool), (capacity,))
+    return Column(vals, nulls, p.type)
 
 
 # ---------------------------------------------------------------------------
@@ -162,6 +203,9 @@ def evaluate(expr: RowExpression, batch: Batch) -> Block:
 
     if isinstance(expr, Constant):
         return _constant_block(expr, cap)
+
+    if isinstance(expr, BatchParam):
+        return _param_block(expr, cap)
 
     if isinstance(expr, SpecialForm):
         return _eval_special(expr, batch)

@@ -25,8 +25,9 @@ from typing import Any, Tuple
 
 from .. import types as T
 
-__all__ = ["RowExpression", "InputReference", "Constant", "Call", "SpecialForm",
-           "input_ref", "const", "call", "special", "from_json", "to_json"]
+__all__ = ["RowExpression", "InputReference", "Constant", "BatchParam",
+           "Call", "SpecialForm", "input_ref", "const", "call", "special",
+           "from_json", "to_json"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -61,6 +62,22 @@ class Constant(RowExpression):
 
     def __str__(self):
         return f"{self.value!r}:{self.type}"
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchParam(RowExpression):
+    """A literal lifted out of a co-batchable plan (exec/batching.py):
+    slot ``index`` of the ambient per-query parameter vector. Two plans
+    that differ only in parameterizable Constants rewrite to the SAME
+    template (BatchParam carries type + index, never the value), which
+    is what makes their plan fingerprints -- and therefore their batch
+    keys -- collide. Evaluation reads the value from the compiler's
+    bound-params scope, so ONE traced program serves every member of a
+    query batch (vmap maps the parameter axis)."""
+    index: int = 0
+
+    def __str__(self):
+        return f"$param{self.index}:{self.type}"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -151,6 +168,8 @@ def special(form: str, ty: T.Type, *args: RowExpression) -> SpecialForm:
 def to_json(e: RowExpression) -> dict:
     if isinstance(e, InputReference):
         return {"@type": "input", "channel": e.channel, "type": str(e.type)}
+    if isinstance(e, BatchParam):
+        return {"@type": "param", "index": e.index, "type": str(e.type)}
     if isinstance(e, Constant):
         return {"@type": "constant", "value": e.value, "type": str(e.type)}
     if isinstance(e, Call):
@@ -171,6 +190,8 @@ def from_json(j: dict) -> RowExpression:
     t = j["@type"]
     if t == "input":
         return InputReference(T.parse_type(j["type"]), j["channel"])
+    if t == "param":
+        return BatchParam(T.parse_type(j["type"]), j["index"])
     if t == "constant":
         return Constant(T.parse_type(j["type"]), j["value"])
     if t == "call":

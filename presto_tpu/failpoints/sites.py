@@ -104,6 +104,15 @@ SITES: Dict[str, Tuple[str, str]] = {
         "enough consecutive losses age the primary out of the RM view "
         "and the standby's failover monitor takes over statement "
         "execution (server/resource_manager.StandbyCoordinator)"),
+    "dispatcher.batch_collapse": (
+        "dispatcher",
+        "formed-batch dispatch gate (exec/batching.py, after the "
+        "formation window seals, before the vmapped dispatch): an "
+        "error action COLLAPSES the batch back to serial per-query "
+        "dispatch mid-flight -- every member must still match its "
+        "serial oracle, the fallback is counted "
+        "presto_tpu_batch_collapses_total{reason=failpoint} and "
+        "recorded as a batch_collapse flight event"),
     "fusion.demote": (
         "fusion",
         "pipeline-region fusion gate (exec/runner.py, before dispatch "

@@ -24,7 +24,9 @@ from typing import Callable, Dict, List, Optional
 from .. import failpoints
 from .events import event_listeners
 
-__all__ = ["ResourceGroup", "Dispatcher", "QueryRejected"]
+__all__ = ["ResourceGroup", "Dispatcher", "QueryRejected",
+           "LATENCY_CLASSES", "latency_class_groups",
+           "latency_class_selector"]
 
 
 class QueryRejected(RuntimeError):
@@ -46,6 +48,11 @@ class ResourceGroup:
     max_queued: int = 16
     soft_memory_limit_bytes: Optional[int] = None
     scheduling_weight: int = 1
+    # admission preemption (latency classes): among capacity-eligible
+    # waiters a HIGHER-priority leaf always admits first -- interactive
+    # traffic preempts queued scans at the slot boundary (the
+    # cooperative analog of the reference's query preemption)
+    priority: int = 0
 
     def __post_init__(self):
         self._running = 0
@@ -104,6 +111,7 @@ class ResourceGroup:
                    "hardConcurrencyLimit": self.hard_concurrency_limit,
                    "maxQueued": self.max_queued,
                    "schedulingWeight": self.scheduling_weight,
+                   "priority": self.priority,
                    "memoryUsedBytes": self._mem_used}
             if self.soft_memory_limit_bytes is not None:
                 out["softMemoryLimitBytes"] = self.soft_memory_limit_bytes
@@ -143,13 +151,16 @@ class ResourceGroup:
             def my_turn() -> bool:
                 if not self._capacity_now(mem):
                     return False
-                # weighted-fair: among capacity-eligible waiters, the
-                # best (lowest running/weight, then FIFO ticket) goes
+                # priority-then-weighted-fair: among capacity-eligible
+                # waiters the highest-priority leaf admits first
+                # (latency-class preemption), ties by lowest
+                # running/weight, then FIFO ticket
                 best = None
                 for tkt, leaf, wmem in root._waiters:
                     if not leaf._capacity_now(wmem):
                         continue
-                    key = (leaf._running / max(leaf.scheduling_weight, 1),
+                    key = (-leaf.priority,
+                           leaf._running / max(leaf.scheduling_weight, 1),
                            tkt)
                     if best is None or key < best[0]:
                         best = (key, tkt, leaf)
@@ -186,6 +197,46 @@ class ResourceGroup:
             self._cv.notify_all()
 
 
+# the latency-class taxonomy (admission-to-SLO): interactive point
+# lookups preempt dashboard refreshes preempt batch scans. Limits are
+# per-class concurrency + queue depth; the shared root caps the tree.
+LATENCY_CLASSES = ("interactive", "dashboard", "batch")
+
+
+def latency_class_groups(root_concurrency: int = 64,
+                         root_queued: int = 1024) -> ResourceGroup:
+    """The default latency-class resource-group tree: a ``global``
+    root bounding total admission, with interactive/dashboard/batch
+    leaves whose priority + weight implement admission preemption
+    (interactive first) and whose per-class limits keep one class from
+    starving the others' queues."""
+    root = ResourceGroup("global",
+                         hard_concurrency_limit=root_concurrency,
+                         max_queued=root_queued)
+    root.add_child(ResourceGroup(
+        "interactive", hard_concurrency_limit=root_concurrency,
+        max_queued=root_queued, scheduling_weight=8, priority=2))
+    root.add_child(ResourceGroup(
+        "dashboard", hard_concurrency_limit=max(root_concurrency // 2, 1),
+        max_queued=max(root_queued // 2, 1), scheduling_weight=4,
+        priority=1))
+    root.add_child(ResourceGroup(
+        "batch", hard_concurrency_limit=max(root_concurrency // 16, 1),
+        max_queued=max(root_queued // 16, 1), scheduling_weight=1,
+        priority=0))
+    return root
+
+
+def latency_class_selector(session: Dict) -> str:
+    """Route on the ``latency_class`` session property: a class name
+    maps under the global tree, an explicit dotted path passes
+    through, absent/empty lands on the root group."""
+    lc = str((session or {}).get("latency_class", "") or "")
+    if lc in LATENCY_CLASSES:
+        return f"global.{lc}"
+    return lc or "global"
+
+
 class Dispatcher:
     """DispatchManager analog: select a group, admit, execute, account.
 
@@ -214,11 +265,28 @@ class Dispatcher:
         self.coordinator_id = coordinator_id or f"coord-{id(self):x}"
         self.cluster_limits = dict(cluster_limits or {})
 
+    @classmethod
+    def with_latency_classes(cls, root_concurrency: int = 64,
+                             root_queued: int = 1024,
+                             **kwargs) -> "Dispatcher":
+        """A dispatcher admitting through the latency-class tree
+        (interactive/dashboard/batch under one global root), routed by
+        the ``latency_class`` session property -- the admission-to-SLO
+        configuration scripts/loadgen.py drives."""
+        return cls(groups=[latency_class_groups(root_concurrency,
+                                                root_queued)],
+                   selector=latency_class_selector, **kwargs)
+
     def _register(self, g: ResourceGroup, path: str):
         self.groups[path] = g
         self.groups.setdefault(g.name, g)
         for c in g.children.values():
             self._register(c, f"{path}.{c.name}")
+
+    def select_group(self, session: Optional[Dict] = None) -> str:
+        """The group path the selector routes this session to (public:
+        the statement tier records it per query for system.queries)."""
+        return self._selector(session or {})
 
     def _await_cluster_slot(self, group_name: str, group: ResourceGroup,
                             deadline: Optional[float]) -> None:
@@ -310,10 +378,13 @@ class Dispatcher:
         finally:
             # queue-wait distribution (previously timed by NOBODY): the
             # cluster gate + local slot wait, rejected waits included --
-            # a full queue's p99 is exactly the signal this exists for
+            # a full queue's p99 is exactly the signal this exists for.
+            # Labeled by resource group so loadgen p99s are
+            # attributable per latency class.
             from .metrics import observe_histogram
             observe_histogram("presto_tpu_dispatch_queue_wait_seconds",
-                              time.time() - t_queue0)
+                              time.time() - t_queue0,
+                              labels={"group": group_name})
         t0 = time.time()
         try:
             result = executor(query_id)

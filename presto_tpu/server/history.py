@@ -142,7 +142,7 @@ class QueryHistoryArchive:
     # ring's rotation state rides its OWN lock so file I/O (a slow or
     # full disk) never stalls /v1/metrics and /v1/history readers of
     # the in-memory archive.
-    _GUARDED_BY = {"_lock": ("_records",),
+    _GUARDED_BY = {"_lock": ("_records", "_batch_fp_counts"),
                    "_plock": ("_file_index", "_file_lines")}
 
     def __init__(self, capacity: int = 512,
@@ -158,6 +158,10 @@ class QueryHistoryArchive:
         self.sentinel = bool(sentinel)
         self.baseline = baseline or RollingBaseline()
         self._records: List[dict] = []
+        # batchFingerprint -> archived-record count, maintained on
+        # append/evict so the batching executor's per-submission
+        # hotness seed is O(1) instead of an O(n) scan under _lock
+        self._batch_fp_counts: Dict[str, int] = {}
         self._file_index = 0
         self._file_lines = 0
         self._lock = threading.Lock()
@@ -277,8 +281,8 @@ class QueryHistoryArchive:
             self._raise_alarms(record, breaches)
         with self._lock:
             self._records.append(record)
-            if len(self._records) > self.capacity:
-                del self._records[: len(self._records) - self.capacity]
+            self._count_batch_fp(record, +1)
+            self._evict_over_capacity()
         self._persist(record)
         _count_record()
         return breaches
@@ -380,14 +384,14 @@ class QueryHistoryArchive:
         with self._lock:
             for doc in loaded:
                 self._records.append(doc)
+                self._count_batch_fp(doc, +1)
                 if doc.get("state") == "FINISHED" and \
                         isinstance(doc.get("stats"), dict):
                     self.baseline.warm(str(doc.get("fingerprint", "")),
                                        {k: float(v) for k, v in
                                         doc["stats"].items()
                                         if isinstance(v, (int, float))})
-            if len(self._records) > self.capacity:
-                del self._records[: len(self._records) - self.capacity]
+            self._evict_over_capacity()
         if files:
             with self._plock:
                 # resume appends on the newest ring file
@@ -424,6 +428,36 @@ class QueryHistoryArchive:
         if limit is not None:
             snap = snap[: max(0, int(limit))]
         return snap
+
+    def _count_batch_fp(self, record: dict, delta: int) -> None:
+        """Maintain the batchFingerprint counter (caller holds _lock)."""
+        fp = record.get("batchFingerprint")
+        if not fp:
+            return
+        n = self._batch_fp_counts.get(fp, 0) + delta
+        if n > 0:
+            self._batch_fp_counts[fp] = n
+        else:
+            self._batch_fp_counts.pop(fp, None)
+
+    def _evict_over_capacity(self) -> None:
+        """Drop the oldest records past capacity (caller holds _lock),
+        keeping the batchFingerprint counter exact."""
+        over = len(self._records) - self.capacity
+        if over > 0:
+            for r in self._records[:over]:
+                self._count_batch_fp(r, -1)
+            del self._records[:over]
+
+    def batch_fingerprint_count(self, fingerprint: str) -> int:
+        """How many archived records carry this batch-template
+        fingerprint (exec/batching.py seeds its formation-window
+        hotness from here, so a dashboard fingerprint is hot from the
+        first poll after a restart -- the archive reloads from its
+        JSONL ring). O(1): the counter is maintained on append/evict,
+        this runs per batchable submission."""
+        with self._lock:
+            return self._batch_fp_counts.get(fingerprint, 0)
 
     def size(self) -> int:
         with self._lock:

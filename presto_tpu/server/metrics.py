@@ -41,7 +41,8 @@ __all__ = ["MetricFamily", "Histogram", "DEFAULT_BUCKETS",
            "reset_histograms",
            "render_prometheus", "parse_prometheus",
            "negotiate_exposition", "CONTENT_TYPE_OPENMETRICS",
-           "plan_cache_families", "narrowing_families", "uptime_family",
+           "plan_cache_families", "narrowing_families",
+           "batching_families", "uptime_family",
            "record_suppressed", "suppressed_error_families",
            "suppressed_error_totals", "tracing_families",
            "flight_recorder_families", "kernel_audit_families",
@@ -307,7 +308,15 @@ _DECLARED_HISTOGRAMS: Dict[str, Tuple[str, Tuple[Dict[str, str], ...]]] = {
               ("QUEUED", "PLANNING", "RUNNING", "FINISHING"))),
     "presto_tpu_dispatch_queue_wait_seconds": (
         "admission wait in the dispatcher's resource-group queue "
-        "(cluster gate + local slot)", ({},)),
+        "(cluster gate + local slot), labeled by resource group so "
+        "per-latency-class p99s are attributable",
+        tuple({"group": g} for g in
+              ("global", "global.interactive", "global.dashboard",
+               "global.batch"))),
+    "presto_tpu_batch_occupancy_queries": (
+        "queries served per batched dispatch (exec/batching.py "
+        "formation outcomes; solo serial dispatches do not observe)",
+        ({},)),
     "presto_tpu_stage_seconds": (
         "per-query host-visible stage wall (exec/stats.py stages)",
         tuple({"stage": s} for s in
@@ -392,6 +401,36 @@ def plan_cache_families() -> List[MetricFamily]:
                      "compiled-plan cache hits").add(st["hits"]),
         MetricFamily("presto_tpu_plan_cache_misses_total", "counter",
                      "compiled-plan cache misses").add(st["misses"]),
+    ]
+
+
+def batching_families() -> List[MetricFamily]:
+    """Concurrent-query batching totals (exec/batching.py), exported
+    by BOTH tiers with a stable zero shape: dispatch amortization
+    (batches vs queries served), collapse reasons, and the live
+    occupancy gauge /v1/cluster mirrors."""
+    from ..exec.batching import COLLAPSE_REASONS, batching_totals
+    t = batching_totals()
+    fam_c = MetricFamily("presto_tpu_batch_collapses_total", "counter",
+                         "formed batches collapsed back to serial "
+                         "dispatch, by reason")
+    for r in COLLAPSE_REASONS:
+        fam_c.add(t["collapses"].get(r, 0), {"reason": r})
+    return [
+        MetricFamily("presto_tpu_batch_dispatches_total", "counter",
+                     "batched dispatches executed (one vmapped program "
+                     "per batch)").add(t["batches"]),
+        MetricFamily("presto_tpu_batched_queries_total", "counter",
+                     "queries served by a batched dispatch").add(
+                         t["batched_queries"]),
+        MetricFamily("presto_tpu_batch_solo_dispatches_total", "counter",
+                     "batch-of-1 dispatches riding an already-warm "
+                     "template program (no co-batching, no fresh "
+                     "compile)").add(t.get("solo_dispatches", 0)),
+        fam_c,
+        MetricFamily("presto_tpu_batch_occupancy", "gauge",
+                     "queries per dispatch of the last formed "
+                     "batch").add(t["last_batch_size"]),
     ]
 
 

@@ -132,6 +132,11 @@ class _Query:
         self.set_session: Dict[str, str] = {}
         self.started_txn: Optional[str] = None
         self.clear_txn: bool = False
+        # admission attribution: the resource group the dispatcher
+        # routed this query to, and (after execution) the size of the
+        # batched dispatch that served it (0 = serial)
+        self.resource_group: str = ""
+        self.batch_size: int = 0
 
 
 _SESSION_STMT = re.compile(
@@ -268,6 +273,19 @@ class StatementServer:
         if ctx is not None:
             # stage spans become children of the query root span
             kwargs["trace_id"] = ctx
+        # concurrent-query batching (exec/batching.py): co-batchable
+        # statements that form a batch are served by ONE vmapped
+        # dispatch and return here; everything else (not batchable,
+        # batching off, no batch formed) runs the serial path below
+        from ..exec.batching import get_batching_executor
+        res = get_batching_executor().try_execute(
+            pre.text, sf=sf, session=kwargs["session"],
+            query_id=query_id, trace_id=kwargs.get("trace_id"),
+            max_groups=kwargs.get("max_groups"),
+            join_capacity=kwargs.get("join_capacity"),
+            catalog=session_values.get("catalog", "tpch"))
+        if res is not None:
+            return res
         return run_sql(pre.text, sf=sf, **kwargs)
 
     def _user_of(self, query_id: str) -> str:
@@ -454,6 +472,14 @@ class StatementServer:
                 q.id, q.machine.state, q.user, q.text,
                 q.machine.elapsed_ms(), q.trace_ctx.trace_id,
                 query_stats=q.result_stats, session=session)
+            # the batch-template fingerprint (exec/batching.py) rides
+            # the record so the archive's per-fingerprint frequency
+            # can drive batch-formation windows across restarts
+            from ..exec.batching import template_fp_of
+            bfp = template_fp_of(q.id)
+            if bfp:
+                record["batchFingerprint"] = bfp
+                record["batchSize"] = q.batch_size
             get_history_archive().add(record)
         except Exception as e:  # noqa: BLE001 - history is telemetry;
             # a malformed executor result (query_stats of a foreign
@@ -507,6 +533,8 @@ class StatementServer:
             # per-query failpoint schedule (`failpoints` session
             # property): armed for this query's dispatch + execution
             # scope, restored afterwards
+            q.resource_group = self.dispatcher.select_group(
+                {"user": q.user, **q.session_values})
             with failpoints.session_scope(
                     q.session_values.get("failpoints")):
                 self.dispatcher.submit(
@@ -574,6 +602,8 @@ class StatementServer:
                     res.row_count == 1:
                 q.update_count = int(res.columns[0][0])
         q.result_stats = getattr(res, "query_stats", None)
+        from ..exec.batching import batch_size_of
+        q.batch_size = batch_size_of(q.id)
         q.columns = [{"name": n, "type": str(t)}
                      for n, t in zip(res.names, res.types)]
         rendered = []
@@ -758,6 +788,8 @@ class StatementServer:
                 "timings": q.machine.timings(),
                 "elapsedTimeMillis": q.machine.elapsed_ms(),
                 "errorInfo": q.machine.error,
+                "resourceGroup": q.resource_group,
+                "batchSize": q.batch_size,
                 # the live-progress aggregate (None before anything
                 # registered): system.queries' progress columns and the
                 # per-query admin page read it mid-flight
@@ -897,6 +929,10 @@ class StatementServer:
             "totals": {"rows": totals["rows"], "bytes": totals["bytes"],
                        "wallSeconds": round(totals["wall_us"] / 1e6, 3)},
             "resourceGroups": groups,
+            # live batching view: per-group queue depth rides
+            # resourceGroups above; this is the dispatch-amortization
+            # side (current occupancy, forming queues, collapses)
+            "batching": self._batching_doc(),
             "workers": workers,
             "workersAlive": alive,
             # the CONFIGURED count keeps counting unannounced workers
@@ -909,6 +945,18 @@ class StatementServer:
             "workersUnannounced": len(all_urls) - len(urls),
             "stuckQueriesTotal": stuck_totals(),
         }
+
+    def _batching_doc(self) -> dict:
+        """The batching executor's live snapshot for /v1/cluster
+        (never fails the cluster doc)."""
+        try:
+            from ..exec.batching import batching_snapshot
+            return batching_snapshot()
+        except Exception as e:  # noqa: BLE001 - introspection must not
+            # take down the fleet overview
+            from .metrics import record_suppressed
+            record_suppressed("statement", "batching_doc", e)
+            return {}
 
     def _workers_alive_view(self) -> int:
         """The workers-alive gauge value: the last /v1/cluster probe's
@@ -960,8 +1008,8 @@ class StatementServer:
                "largest per-query peak memory seen").add(
                    totals["peak_memory_bytes"]),
         ]
-        from .metrics import (failpoint_families, fleet_families,
-                              flight_recorder_families,
+        from .metrics import (batching_families, failpoint_families,
+                              fleet_families, flight_recorder_families,
                               histogram_families, kernel_audit_families,
                               live_introspection_families,
                               narrowing_families, plan_cache_families,
@@ -976,6 +1024,7 @@ class StatementServer:
         fams.extend(fleet_families(workers_draining=draining))
         fams.extend(plan_cache_families())
         fams.extend(narrowing_families())
+        fams.extend(batching_families())
         fams.extend(suppressed_error_families())
         fams.extend(tracing_families())
         fams.extend(flight_recorder_families())

@@ -990,6 +990,8 @@ class _Handler(BaseHTTPRequestHandler):
                            f"lifetime {k}").add(counters[k]))
         fams.extend(plan_cache_families())
         fams.extend(narrowing_families())
+        from .metrics import batching_families
+        fams.extend(batching_families())
         from .metrics import (failpoint_families,
                               flight_recorder_families,
                               histogram_families, kernel_audit_families,

@@ -249,6 +249,36 @@ SESSION_PROPERTIES = (
          "up on unannouncing; this spec's default is what "
          "begin_drain uses when the request body carries no "
          "timeoutMs (server/worker.py)")
+    .add("query_batching", "bool", True,
+         "concurrent-query batching (exec/batching.py): queries whose "
+         "plans differ only in parameterizable literals share ONE "
+         "vmapped dispatch -- grouped by (template plan fingerprint, "
+         "kernel-mode envs), literals lifted into a parameter vector, "
+         "results fanned back bit-identically to serial execution. "
+         "false = the serial A/B control scripts/loadgen.py measures "
+         "against (env PRESTO_TPU_BATCHING, registered in "
+         "KERNEL_MODE_ENVS)")
+    .add("batch_window_ms", "float", 5.0,
+         "batch formation window: how long the FIRST arrival of a hot "
+         "plan fingerprint waits for co-batchable followers before "
+         "dispatching (cold fingerprints never wait; hotness is the "
+         "fingerprint's recent frequency, seeded from the query-history "
+         "archive)")
+    .add("batch_max_size", "int", 64,
+         "queries per batched dispatch cap; a forming batch seals "
+         "early when it fills")
+    .add("batch_hot_min", "int", 2,
+         "submissions of a plan fingerprint (recent in-process + "
+         "history-archive counts) before it is HOT enough to pay the "
+         "formation window; <=1 = every batchable query windows")
+    .add("latency_class", "str", "",
+         "resource-group latency class for admission-to-SLO "
+         "(interactive | dashboard | batch, or an explicit dotted "
+         "group path) -- dispatchers built with "
+         "Dispatcher.with_latency_classes route on it: interactive "
+         "preempts scans at admission (higher priority + weight), "
+         "per-class concurrency and queue-depth limits apply "
+         "(empty = the dispatcher's default group)")
     .add("continuous_profiling", "bool", True,
          "accumulate per-kernel device-time profiles keyed by plan "
          "fingerprint (exec/profiler.py): calls, block_until_ready "
@@ -281,14 +311,20 @@ def parse_size(v) -> int:
 def session_flag(session, name: str, default: bool = True) -> bool:
     """Default-on boolean session property over Session objects OR plain
     dicts: missing/None = `default`; only an explicit value overrides.
-    The one shared parser -- hand-rolled copies drifted."""
+    The one shared parser -- hand-rolled copies drifted. Values are
+    parsed with the registry's bool coercion, NOT truthiness: the
+    statement tier hands the engine raw header/SET SESSION strings, and
+    ``bool("false")`` silently leaving a flag ON is exactly the bug
+    that once broke loadgen's serial A/B control."""
     if session is None:
         return default
     try:
         v = session.get(name)
     except (KeyError, TypeError):
         return default
-    return default if v is None else bool(v)
+    if v is None:
+        return default
+    return v if isinstance(v, bool) else _parse_bool(v)
 
 
 def session_value(session, name: str, default=None):

@@ -93,6 +93,7 @@ OP_ROUNDS = [
     ("discovery", "announce"),
     ("discovery", "probe"),
     ("dispatcher", "admit"),
+    ("dispatcher", "batch"),
     ("statement", "fail_dump"),
     ("statement", "hang_deadline"),
     ("task", "stuck"),
@@ -363,6 +364,85 @@ class ChaosRun:
             c = StatementClient(cluster.statement.url,
                                 "SELECT 1", deadline_s=60).drain()
             return "match" if c.data == [[1]] else "WRONG_RESULT"
+        if op == "batch":
+            # a FORMED query batch forced to collapse back to serial
+            # dispatch mid-flight (PR 13): co-batchable point lookups
+            # form one batch under a long window, the
+            # dispatcher.batch_collapse failpoint fires before the
+            # vmapped dispatch, and every member must still match its
+            # serial oracle while the collapse is fully accounted
+            # (reason counter + flight event + the generic fires/ring
+            # legs the driver audits for every round)
+            from presto_tpu.exec.batching import (batching_totals,
+                                                  get_batching_executor)
+            from presto_tpu.sql import sql as engine_sql
+            step["site"], step["spec"] = \
+                "dispatcher.batch_collapse", "error(RuntimeError):once"
+            texts = ["SELECT custkey, name, acctbal FROM customer "
+                     f"WHERE custkey = {k}" for k in (7, 11, 23, 42)]
+            oracles = []
+            for t in texts:
+                r = engine_sql(t, sf=self.sf,
+                               session={"query_batching": "false"})
+                oracles.append(canon_rows(
+                    [(np.asarray(r.columns[c]), np.asarray(r.nulls[c]))
+                     for c in range(len(r.columns))]))
+            before = batching_totals()["collapses"].get("failpoint", 0)
+            cluster.arm(step["site"], step["spec"])
+            sess = {"query_batching": "true", "batch_window_ms": "500",
+                    "batch_hot_min": "1"}
+            executor = get_batching_executor()
+            results = [None] * len(texts)
+            errors = [None] * len(texts)
+
+            def member(i, t):
+                try:
+                    res = executor.try_execute(
+                        t, sf=self.sf, session=sess,
+                        query_id=f"chaos-batch-{i}")
+                    if res is None:  # no batch formed for this member
+                        res = engine_sql(t, sf=self.sf, session=sess)
+                    results[i] = res
+                except BaseException as e:  # noqa: BLE001 - verdict
+                    errors[i] = e
+
+            threads = [threading.Thread(target=member, args=(i, t),
+                                        daemon=True)
+                       for i, t in enumerate(texts)]
+            threads[0].start()      # the leader opens the window ...
+            time.sleep(0.1)
+            for t in threads[1:]:   # ... followers join inside it
+                t.start()
+            for t in threads:
+                t.join(60)
+            if any(not r and e is None
+                   for r, e in zip(results, errors)):
+                self.fail("batch round: a member HUNG past 60s")
+                return "HUNG"
+            for i, e in enumerate(errors):
+                if e is not None:
+                    self.fail(f"batch round: member {i} failed under "
+                              f"collapse: {type(e).__name__}: {e}")
+                    return f"clean_failure:{type(e).__name__}"
+            for i, r in enumerate(results):
+                got = canon_rows(
+                    [(np.asarray(r.columns[c]), np.asarray(r.nulls[c]))
+                     for c in range(len(r.columns))])
+                if got != oracles[i]:
+                    self.fail(f"batch round: member {i} under forced "
+                              f"collapse returned WRONG rows")
+                    return "WRONG_RESULT"
+            delta = batching_totals()["collapses"].get("failpoint", 0) \
+                - before
+            if delta != 1:
+                self.fail(f"batch round: collapse counter moved {delta} "
+                          f"(expected exactly 1 collapsed batch)")
+                return "UNACCOUNTED_COLLAPSE"
+            if not get_flight_recorder().events(kind="batch_collapse"):
+                self.fail("batch round: collapse without a "
+                          "batch_collapse flight event")
+                return "NO_FLIGHT_EVENT"
+            return "match+collapsed"
         if op == "fail_dump":
             step["site"], step["spec"] = \
                 "statement.execute", "error(RuntimeError):once"
@@ -718,7 +798,7 @@ class ChaosRun:
                        ("HUNG", "NOT_RECOVERED", "NO_TIMEOUT", "UNFIRED",
                         "UNDETECTED", "NO_FLIGHT_EVENT", "NOT_DEMOTED",
                         "NO_SPEC_WIN", "SPEC_FAILURE",
-                        "UNREPLAYED_PAGES")
+                        "UNREPLAYED_PAGES", "UNACCOUNTED_COLLAPSE")
                        for r in self.rounds),
                    "no_counter_decrease": not any(
                        "counter decreased" in f for f in self.failures),

@@ -3,9 +3,10 @@
 # kernaudit (IR tier over the TPC-H q1-q22 corpus), then a seeded
 # chaos smoke (scripts/chaos.py --smoke: a small deterministic fault
 # schedule over an in-process cluster, so every recovery path runs
-# before every PR), then perfgate (the committed BENCH trajectory vs
-# PERF_BASELINE.json noise bands), preserving the repo's shared exit
-# contract:
+# before every PR), then the loadgen smoke (batching must form batches
+# and beat serial dispatch), then perfgate (the committed BENCH +
+# LOADGEN trajectories vs PERF_BASELINE.json noise bands), preserving
+# the repo's shared exit contract:
 #
 #   0  all gates clean
 #   1  findings / stale baseline entries / invariant violations
@@ -33,6 +34,14 @@ k=$?
 python "$here/chaos.py" --seed 42 --smoke
 c=$?
 [ "$c" -gt "$rc" ] && rc=$c
+
+# the throughput-tier tripwire: batches must still form and batched
+# dispatch must still beat the serial A/B control on a small fixed
+# zipfian workload (the committed LOADGEN_r*.json artifacts gate the
+# real numbers through perfgate below)
+python "$here/loadgen.py" --smoke
+l=$?
+[ "$l" -gt "$rc" ] && rc=$l
 
 python "$here/perfgate.py" --json
 g=$?

@@ -50,9 +50,13 @@ DEFAULT_BASELINE = os.path.join(REPO_ROOT, "PERF_BASELINE.json")
 
 
 def default_artifacts() -> List[str]:
-    """The committed BENCH trajectory, round order (lexical == round
-    order for the zero-padded BENCH_r0N names)."""
-    return sorted(glob.glob(os.path.join(REPO_ROOT, "BENCH_r*.json")))
+    """The committed BENCH + LOADGEN trajectories, round order (lexical
+    == round order for the zero-padded *_r0N names; loadgen artifacts
+    carry the throughput-tier qps/p99_ms metrics under their own
+    key)."""
+    return (sorted(glob.glob(os.path.join(REPO_ROOT, "BENCH_r*.json")))
+            + sorted(glob.glob(os.path.join(REPO_ROOT,
+                                            "LOADGEN_r*.json"))))
 
 
 def _platform(detail: dict) -> str:
@@ -79,7 +83,7 @@ def load_artifact(path: str) -> Tuple[str, Dict[str, float], dict]:
     metrics: Dict[str, float] = {}
     if isinstance(parsed.get("value"), (int, float)):
         metrics["rows_per_sec"] = float(parsed["value"])
-    for name in ("query_wall_s", "staged_mb"):
+    for name in ("query_wall_s", "staged_mb", "qps", "p99_ms"):
         v = detail.get(name)
         if isinstance(v, (int, float)):
             metrics[name] = float(v)
@@ -207,7 +211,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
 
     entries = baseline["entries"]
-    candidates = loaded if args.all else loaded[-1:]
+    if args.all:
+        candidates = loaded
+    elif args.artifacts:
+        # explicit paths: the caller's LAST argument is the candidate
+        candidates = loaded[-1:]
+    else:
+        # default glob: the newest artifact of EACH key gates, so the
+        # BENCH trajectory and the LOADGEN throughput tier are both
+        # checked in one run (one family cannot shadow the other)
+        newest: Dict[str, Tuple[str, str, Dict[str, float]]] = {}
+        for item in loaded:
+            newest[item[1]] = item
+        candidates = [item for item in loaded
+                      if newest[item[1]] is item]
     findings: List[dict] = []
     unbaselined: List[str] = []
     checked = 0

@@ -94,6 +94,28 @@ def render(doc: dict) -> str:
             f"rows {int(prog.get('rows', 0)):>10,}{age_s}{spec_s}")
         lines.append(f"  {rq.get('query', '')[:74]}")
     lines.append("-" * 78)
+    # resource-group rows (latency-class admission): per-group queue
+    # depth beside the batching executor's dispatch amortization
+    groups = doc.get("resourceGroups") or {}
+    for name in sorted(groups):
+        g = groups[name]
+        lines.append(
+            f"group {name:<20} r:{g.get('running', 0):>3}"
+            f"/{g.get('hardConcurrencyLimit', 0):<3} "
+            f"q:{g.get('queued', 0):>4}/{g.get('maxQueued', 0):<4} "
+            f"w:{g.get('schedulingWeight', 1):<2} "
+            f"prio:{g.get('priority', 0)}")
+    batching = doc.get("batching") or {}
+    if batching:
+        lines.append(
+            f"batching: {batching.get('queriesBatched', 0)} queries / "
+            f"{batching.get('batchesDispatched', 0)} dispatches "
+            f"(occ last {batching.get('lastBatchSize', 0)} "
+            f"avg {batching.get('avgOccupancy', 0.0):.1f} "
+            f"max {batching.get('maxBatchSize', 0)})  "
+            f"solo {batching.get('soloDispatches', 0)}  "
+            f"collapses {sum((batching.get('collapses') or {}).values())}")
+        lines.append("-" * 78)
     workers = doc.get("workers", [])
     if not workers:
         lines.append("(no workers configured: embedded engine)")

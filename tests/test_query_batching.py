@@ -1,0 +1,272 @@
+"""Concurrent-query batching (exec/batching.py): N queries, ONE
+vmapped dispatch, results bit-identical to serial execution.
+
+Covers the PR-13 acceptance surface: batched-vs-serial bit-exactness
+across differing literals / NULL parameters / fan-out ordering,
+negative co-batchability (kernel-mode envs, string literals, LIKE
+structure), the collapse fallback, and plan-cache hit accounting under
+batching.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from presto_tpu import failpoints
+from presto_tpu.exec.batching import (BatchingExecutor, batching_totals,
+                                      clear_batching,
+                                      get_batching_executor,
+                                      parameterize_plan)
+from presto_tpu.exec.plan_cache import (cache_stats, clear_plan_cache,
+                                        plan_fingerprint)
+from presto_tpu.sql import sql
+
+SF = 0.01
+LOOKUP = "SELECT custkey, name, acctbal FROM customer WHERE custkey = {}"
+DASH = ("SELECT orderpriority, count(*) AS c, sum(totalprice) AS s "
+        "FROM orders WHERE custkey = {} "
+        "GROUP BY orderpriority ORDER BY orderpriority")
+
+# a long window + hot_min=1 makes formation deterministic under a
+# staggered leader/follower start (the leader's window absorbs thread
+# scheduling noise)
+BSESS = {"query_batching": "true", "batch_window_ms": "400",
+         "batch_hot_min": "1"}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_executor():
+    clear_batching()
+    yield
+    clear_batching()
+
+
+def form_batch(texts, session=None, sf=SF):
+    """Drive one batch through the executor: the first text leads and
+    opens the window, the rest join inside it. Returns per-text
+    QueryResults (serial fallback when no batch formed -- asserted
+    against by callers that require formation)."""
+    ex = get_batching_executor()
+    sess = dict(BSESS)
+    sess.update(session or {})
+    results = [None] * len(texts)
+    errors = [None] * len(texts)
+
+    def member(i, t):
+        try:
+            r = ex.try_execute(t, sf=sf, session=sess,
+                               query_id=f"tb-{i}")
+            if r is None:
+                r = sql(t, sf=sf, session=sess)
+            results[i] = r
+        except BaseException as e:  # noqa: BLE001 - assert in caller
+            errors[i] = e
+
+    threads = [threading.Thread(target=member, args=(i, t), daemon=True)
+               for i, t in enumerate(texts)]
+    threads[0].start()
+    time.sleep(0.1)
+    for t in threads[1:]:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert errors == [None] * len(texts), errors
+    assert all(r is not None for r in results), "a member hung"
+    return results
+
+
+def serial_of(text, sf=SF):
+    return sql(text, sf=sf, session={"query_batching": "false"})
+
+
+def assert_bit_identical(batched, serial):
+    """Full result equality: names, types, row count, null masks, and
+    value arrays (dtype included) at every non-null position."""
+    assert batched.names == serial.names
+    assert [str(t) for t in batched.types] == \
+        [str(t) for t in serial.types]
+    assert batched.row_count == serial.row_count
+    for c in range(len(serial.columns)):
+        bn = np.asarray(batched.nulls[c])
+        sn = np.asarray(serial.nulls[c])
+        assert np.array_equal(bn, sn)
+        bv = np.asarray(batched.columns[c])
+        sv = np.asarray(serial.columns[c])
+        if bv.dtype.kind in "OU" or sv.dtype.kind in "OU":
+            assert [x for x, n in zip(bv, sn) if not n] == \
+                [x for x, n in zip(sv, sn) if not n]
+        else:
+            assert bv.dtype == sv.dtype
+            assert np.array_equal(bv[~sn], sv[~sn])
+
+
+# ---------------------------------------------------------------------------
+# bit-exactness + fan-out
+# ---------------------------------------------------------------------------
+
+
+def test_batched_matches_serial_across_literals():
+    texts = [LOOKUP.format(k) for k in (42, 7, 23, 11)]
+    results = form_batch(texts)
+    assert batching_totals()["batches"] >= 1
+    assert batching_totals()["batched_queries"] >= len(texts)
+    for text, res in zip(texts, results):
+        assert_bit_identical(res, serial_of(text))
+
+
+def test_null_parameter_cobatches_and_matches_serial():
+    # `custkey = NULL` lifts the untyped NULL at its sibling's type, so
+    # it shares a template (and a batch) with `custkey = 42` -- and its
+    # batched result is the same empty set serial execution produces
+    ex = get_batching_executor()
+    pnull = ex._prepare(LOOKUP.format("NULL"), sf=SF, session={},
+                        max_groups=None, join_capacity=None,
+                        catalog="tpch")
+    plit = ex._prepare(LOOKUP.format(42), sf=SF, session={},
+                       max_groups=None, join_capacity=None,
+                       catalog="tpch")
+    assert pnull[3] == plit[3]          # same batch key
+    assert pnull[2] == [(0, True)]      # the NULL parameter vector
+    texts = [LOOKUP.format(k) for k in (42, "NULL", 7)]
+    results = form_batch(texts)
+    assert batching_totals()["batches"] >= 1
+    assert results[1].row_count == 0
+    for text, res in zip(texts, results):
+        assert_bit_identical(res, serial_of(text))
+
+
+def test_fan_out_ordering_member_owns_its_literal():
+    # member i must receive the rows for ITS literal, not a neighbor's
+    keys = (99, 3, 57, 12)
+    results = form_batch([LOOKUP.format(k) for k in keys])
+    assert batching_totals()["batched_queries"] >= len(keys)
+    for k, res in zip(keys, results):
+        assert res.row_count == 1
+        assert int(res.columns[0][0]) == k
+
+
+def test_aggregate_template_matches_serial():
+    texts = [DASH.format(k) for k in (1, 4, 10)]
+    results = form_batch(texts)
+    assert batching_totals()["batches"] >= 1
+    for text, res in zip(texts, results):
+        assert_bit_identical(res, serial_of(text))
+
+
+# ---------------------------------------------------------------------------
+# negative co-batchability
+# ---------------------------------------------------------------------------
+
+
+def _key_of(text):
+    ex = get_batching_executor()
+    return ex._prepare(text, sf=SF, session={}, max_groups=None,
+                       join_capacity=None, catalog="tpch")[3]
+
+
+def test_differing_kernel_mode_envs_never_cobatch(monkeypatch):
+    k1 = BatchingExecutor._batch_key("fp", SF, 1 << 16)
+    monkeypatch.setenv("PRESTO_TPU_SMALLG", "never")
+    k2 = BatchingExecutor._batch_key("fp", SF, 1 << 16)
+    assert k1 != k2
+    # the end-to-end form: the same text prepares to different keys
+    # under different kernel-mode envs (memo keyed by mode too)
+    monkeypatch.delenv("PRESTO_TPU_SMALLG", raising=False)
+    ka = _key_of(LOOKUP.format(5))
+    monkeypatch.setenv("PRESTO_TPU_SMALLG", "never")
+    kb = _key_of(LOOKUP.format(5))
+    assert ka != kb
+
+
+def test_string_literals_stay_structural():
+    # strings are shape-bearing: never lifted, so differing string
+    # literals produce different templates (no co-batching)
+    a = _key_of("SELECT custkey FROM customer "
+                "WHERE mktsegment = 'BUILDING'")
+    b = _key_of("SELECT custkey FROM customer "
+                "WHERE mktsegment = 'AUTOMOBILE'")
+    assert a != b
+
+
+def test_like_patterns_stay_structural():
+    a = _key_of("SELECT custkey FROM customer WHERE name LIKE '%11%'")
+    b = _key_of("SELECT custkey FROM customer WHERE name LIKE '%22%'")
+    assert a != b
+
+
+def test_differing_plan_shapes_never_cobatch():
+    assert _key_of(LOOKUP.format(1)) != _key_of(DASH.format(1))
+
+
+def test_parameterize_lifts_only_value_positions():
+    from presto_tpu.exec.runner import prepare_plan
+    from presto_tpu.sql.planner import plan_sql
+    root = plan_sql(LOOKUP.format(42))
+    template, params = parameterize_plan(prepare_plan(root, sf=SF))
+    assert [v for v, _ty in params] == [(42, False)]
+    # same template for a different literal -> fingerprints collide
+    root2 = plan_sql(LOOKUP.format(7))
+    template2, params2 = parameterize_plan(prepare_plan(root2, sf=SF))
+    assert plan_fingerprint(template) == plan_fingerprint(template2)
+    assert [v for v, _ty in params2] == [(7, False)]
+
+
+def test_cold_fingerprint_never_pays_the_window():
+    # hot_min=2 and a fresh executor: the first submission of a
+    # fingerprint must return None immediately (serial path), not
+    # open a formation window
+    ex = get_batching_executor()
+    t0 = time.time()
+    r = ex.try_execute(LOOKUP.format(5), sf=SF,
+                       session={"query_batching": "true",
+                                "batch_window_ms": "5000",
+                                "batch_hot_min": "2"},
+                       query_id="cold-1")
+    assert r is None
+    assert time.time() - t0 < 2.0
+
+
+# ---------------------------------------------------------------------------
+# collapse fallback
+# ---------------------------------------------------------------------------
+
+
+def test_failpoint_collapse_falls_back_bit_identically():
+    failpoints.disarm_all()
+    failpoints.arm("dispatcher.batch_collapse", "error(RuntimeError):once")
+    try:
+        texts = [LOOKUP.format(k) for k in (42, 7, 23)]
+        results = form_batch(texts)
+        t = batching_totals()
+        assert t["collapses"]["failpoint"] == 1
+        assert t["batches"] == 0  # the collapsed batch never dispatched
+        for text, res in zip(texts, results):
+            assert_bit_identical(res, serial_of(text))
+    finally:
+        failpoints.disarm_all()
+
+
+# ---------------------------------------------------------------------------
+# plan-cache accounting
+# ---------------------------------------------------------------------------
+
+
+def test_plan_cache_hit_accounting_under_batching():
+    # the template program rides the SHARED plan cache: the first
+    # batched dispatch of a template misses (compile), a later executor
+    # hitting the same template (same fingerprint + kernel mode) HITS
+    # instead of recompiling -- exactly serial-repeat accounting
+    clear_plan_cache()
+    texts = [LOOKUP.format(k) for k in (42, 7, 23)]
+    form_batch(texts)
+    assert batching_totals()["batches"] >= 1
+    st1 = cache_stats()
+    assert st1["misses"] >= 1
+    clear_batching()  # fresh executor, same process-wide plan cache
+    form_batch(texts)
+    assert batching_totals()["batches"] >= 1
+    st2 = cache_stats()
+    assert st2["hits"] > st1["hits"]
+    assert st2["misses"] == st1["misses"]
