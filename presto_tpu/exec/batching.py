@@ -70,6 +70,7 @@ from .. import types as T
 from ..expr import ir as E
 from ..expr.compile import bound_params
 from ..plan import nodes as N
+from ..utils.locks import OrderedLock
 
 __all__ = ["BATCHING_ENV", "batching_enabled", "parameterize_plan",
            "BatchingExecutor", "get_batching_executor",
@@ -249,7 +250,7 @@ def parameterize_plan(root: N.PlanNode
 # process totals (server/metrics.py batching_families reads these)
 # ---------------------------------------------------------------------------
 
-_TOTALS_LOCK = threading.Lock()
+_TOTALS_LOCK = OrderedLock("batching._TOTALS_LOCK")
 _TOTALS = {"batches": 0, "batched_queries": 0, "last_batch_size": 0,
            "max_batch_size": 0, "solo_dispatches": 0}
 _COLLAPSES = {r: 0 for r in COLLAPSE_REASONS}
@@ -264,6 +265,12 @@ _QUERY_BATCH: "collections.OrderedDict[str, int]" = \
 _QUERY_TEMPLATE: "collections.OrderedDict[str, str]" = \
     collections.OrderedDict()
 _QUERY_MAP_MAX = 1024
+
+# tpulint C001: module-global write barrier (the process-counter
+# idiom; _EXECUTOR is the singleton swap under its own lock)
+_GUARDED_BY = {"_TOTALS_LOCK": ("_TOTALS", "_COLLAPSES",
+                                "_QUERY_BATCH", "_QUERY_TEMPLATE"),
+               "_EXEC_LOCK": ("_EXECUTOR",)}
 
 
 def _note_query(table: "collections.OrderedDict", query_id: str,
@@ -354,6 +361,11 @@ class BatchingExecutor:
     batch formed). Thread-safe; statement _run threads are the
     callers."""
 
+    # tpulint C001: formation/inflight/template registries are shared
+    # across every statement _run thread
+    _GUARDED_BY = {"_lock": ("_forming", "_inflight", "_recent",
+                             "_shape_recent", "_vmapped", "_staged")}
+
     def __init__(self, window_ms: float = 5.0, max_batch: int = 64,
                  hot_min: int = 2, hot_window_s: float = 30.0,
                  follower_timeout_s: float = 300.0,
@@ -372,7 +384,7 @@ class BatchingExecutor:
         # batch forms, and the cap keeps occupancy adaptive (a full
         # pipeline makes the next leader keep collecting)
         self.max_inflight = max_inflight
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("batching.BatchingExecutor._lock")
         self._forming: Dict[tuple, _Forming] = {}
         # key -> count of batched dispatches currently executing: a
         # forming batch keeps COLLECTING while its key's dispatch
@@ -1005,7 +1017,7 @@ def _trace_str(trace_id, query_id: str) -> str:
     return str(trace_id or query_id)
 
 
-_EXEC_LOCK = threading.Lock()
+_EXEC_LOCK = OrderedLock("batching._EXEC_LOCK")
 _EXECUTOR: Optional[BatchingExecutor] = None
 
 

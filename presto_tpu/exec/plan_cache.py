@@ -36,6 +36,7 @@ import jax
 import numpy as np
 
 from ..plan import nodes as N
+from ..utils.locks import OrderedLock
 from .planner import CompiledPlan, compile_plan
 
 __all__ = ["plan_fingerprint", "cached_compile", "cache_stats",
@@ -43,7 +44,7 @@ __all__ = ["plan_fingerprint", "cached_compile", "cache_stats",
 
 _MAX_ENTRIES = 64
 
-_lock = threading.Lock()
+_lock = OrderedLock("plan_cache._lock")
 _cache: "OrderedDict[tuple, _Entry]" = OrderedDict()
 _hits = 0
 _misses = 0
@@ -185,7 +186,7 @@ def cached_compile(root: N.PlanNode, mesh, default_join_capacity: int,
     # the expensive XLA work happens lazily at first dispatch)
     plan = compile_plan(root, mesh, default_join_capacity,
                         exchange_slot_scale=exchange_slot_scale)
-    entry = _Entry(plan, jax.jit(plan.fn), threading.Lock())
+    entry = _Entry(plan, jax.jit(plan.fn), OrderedLock("plan_cache._Entry.call_lock"))
     with _lock:
         have = _cache.get(key)
         if have is not None:     # lost a race: keep the first

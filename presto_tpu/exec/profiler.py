@@ -49,6 +49,8 @@ import threading
 import uuid
 from typing import Dict, List, Optional
 
+from ..utils.locks import OrderedLock
+
 __all__ = ["profiling_enabled", "record_call", "note_footprint",
            "note_query_kernel",
            "profile_snapshot", "profile_doc", "profile_for_query",
@@ -127,7 +129,7 @@ class KernelProfile:
 
 # engine threads (run_query), request handlers (/v1/profile, system
 # tables) and the flight recorder all touch the registry
-_LOCK = threading.Lock()
+_LOCK = OrderedLock("profiler._LOCK")
 _REGISTRY: "collections.OrderedDict[str, KernelProfile]" = \
     collections.OrderedDict()
 _MAX_ENTRIES = 512

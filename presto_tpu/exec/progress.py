@@ -44,6 +44,8 @@ import threading
 import time
 from typing import Dict, Iterable, List, Optional
 
+from ..utils.locks import OrderedLock
+
 __all__ = ["TaskProgress", "begin", "get_progress", "note_remote",
            "finish_task", "live_snapshots", "snapshots_for_query",
            "live_task_count", "set_capacity", "reset",
@@ -92,7 +94,7 @@ class TaskProgress:
         self.final_state: Optional[str] = None
         self._depth = 1           # re-entrant begin() nesting (writes)
         self._pct = 0.0           # high-water percent (monotonic)
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("progress.TaskProgress._lock")
 
     # -- producer side --------------------------------------------------
 
@@ -223,7 +225,7 @@ class TaskProgress:
 # entries keyed by query/task id, bounded; finished entries linger so a
 # final poll still resolves, evicted oldest-first past capacity (done
 # entries first -- a live entry is only evicted when everything is live)
-_LOCK = threading.Lock()
+_LOCK = OrderedLock("progress._LOCK")
 _ENTRIES: "collections.OrderedDict[str, TaskProgress]" = \
     collections.OrderedDict()
 _CAPACITY = 2048

@@ -65,6 +65,7 @@ import threading
 from typing import Dict, List, Optional
 
 from ..plan import nodes as N
+from ..utils.locks import OrderedLock
 from .perfgate import MetricSpec, compare
 
 __all__ = ["FUSION_ENV", "fusion_enabled", "RegionInput", "PipelineRegion",
@@ -206,6 +207,10 @@ class FusionMemory:
 
     _WINDOW = 16
     _MAX_KEYS = 512
+    # tpulint C001: the runner's hot path appends samples from every
+    # dispatch thread; the partitioner reads across them
+    _GUARDED_BY = {"_lock": ("_footprint", "_fused", "_unfused",
+                             "_demoted")}
     # device time regresses upward; a fused region must beat its
     # materialized form by more than noise + 10% before demotion is
     # even considered, and micro-kernels under 200us never demote
@@ -215,7 +220,7 @@ class FusionMemory:
     MIN_SAMPLES = 3
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("regions.FusionMemory._lock")
         self._footprint: "collections.OrderedDict[str, int]" = \
             collections.OrderedDict()
         self._fused: "collections.OrderedDict[str, collections.deque]" = \

@@ -56,6 +56,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..utils.locks import OrderedLock
 from .sites import SITES, sites_by_layer
 
 __all__ = ["ARMED", "hit", "arm", "disarm", "disarm_all", "configure",
@@ -298,7 +299,7 @@ class FailpointRegistry:
         self._armed: Dict[str, _Armed] = {}
         # lifetime (site, action-kind) -> fired count
         self._totals: Dict[Tuple[str, str], int] = {}
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("failpoints.FailpointRegistry._lock")
 
     def arm(self, site: str, spec: str) -> None:
         action, trigger = parse_spec(site, spec)

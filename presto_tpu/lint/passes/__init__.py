@@ -2,4 +2,5 @@
 pass with the core registry (core.register decorator side effect); add
 a new pass by dropping a module here and importing it below."""
 
-from . import host_sync, locks, retrace, swallowed, wide_lanes  # noqa: F401
+from . import (blocking, host_sync, lock_order, locks, retrace,  # noqa: F401
+               swallowed, threads, wide_lanes)

@@ -29,6 +29,8 @@ import re
 import threading
 from typing import Dict, List, Optional, Sequence
 
+from ..utils.locks import OrderedLock
+
 __all__ = ["AccessDeniedException", "AccessControlManager",
            "set_access_control", "get_access_control"]
 
@@ -141,7 +143,7 @@ class AccessControlManager:
         walk(root)
 
 
-_lock = threading.Lock()
+_lock = OrderedLock("access._lock")
 _manager: Optional[AccessControlManager] = None
 
 

@@ -23,6 +23,8 @@ import threading
 import time
 from typing import Optional
 
+from ..utils.locks import OrderedLock
+
 __all__ = ["AuthError", "InternalAuthenticator", "INTERNAL_BEARER_HEADER",
            "sign_jwt", "verify_jwt", "set_shared_secret",
            "get_shared_secret", "make_authenticator", "bearer_headers",
@@ -30,7 +32,7 @@ __all__ = ["AuthError", "InternalAuthenticator", "INTERNAL_BEARER_HEADER",
 
 INTERNAL_BEARER_HEADER = "X-Presto-Internal-Bearer"
 
-_shared_secret_lock = threading.Lock()
+_shared_secret_lock = OrderedLock("auth._shared_secret_lock")
 _shared_secret: Optional[str] = None
 
 
@@ -115,7 +117,7 @@ class InternalAuthenticator:
         self.secret = secret
         self.node_id = node_id
         self.ttl_s = ttl_s
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("auth.InternalAuthenticator._lock")
         self._token: Optional[str] = None
         self._token_exp = 0.0
 

@@ -26,6 +26,13 @@ class SpoolingOutputBuffer:
     the spool file. NOT thread-safe by itself -- callers hold the task
     lock, as they did for the plain list."""
 
+    # tpulint C001: the caller-holds-the-task-lock contract, declared
+    # (writes through self in here are the contract body; any OTHER
+    # receiver mutating these fields must hold SOME lock)
+    _GUARDED_BY = {"<caller>": ("_entries", "_mem_bytes",
+                                "_spooled_bytes", "_file",
+                                "_file_path")}
+
     def __init__(self, memory_threshold_bytes: int = 64 << 20,
                  spool_dir: Optional[str] = None):
         self.memory_threshold = memory_threshold_bytes

@@ -32,6 +32,7 @@ from .. import failpoints
 from ..connectors import catalog
 from ..plan import fragment_plan, nodes as N
 from ..utils.backoff import Backoff
+from ..utils.locks import OrderedLock
 from .client import WorkerClient
 from .discovery import alive_nodes
 from .flight_recorder import record_event
@@ -45,8 +46,11 @@ __all__ = ["Coordinator", "SchedulerGap", "speculation_totals",
 # totals): launched attempts, wins (the speculative copy finished
 # first) and losses (the original beat it) -- exported by
 # metrics.fleet_families on both tiers
-_SPEC_LOCK = threading.Lock()
+_SPEC_LOCK = OrderedLock("coordinator._SPEC_LOCK")
 _SPEC = {"launched": 0, "wins": 0, "losses": 0}
+
+# tpulint C001: module-global write barrier
+_GUARDED_BY = {"_SPEC_LOCK": ("_SPEC",)}
 
 ENV_SPECULATION_MS = "PRESTO_TPU_SPECULATION_MS"
 

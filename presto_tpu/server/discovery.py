@@ -23,6 +23,7 @@ from typing import Dict, List, Optional
 
 from .. import failpoints
 from ..utils.backoff import Backoff
+from ..utils.locks import OrderedLock
 
 __all__ = ["DiscoveryServer", "Announcer", "alive_nodes",
            "HeartbeatProber", "fleet_membership_totals",
@@ -39,7 +40,7 @@ __all__ = ["DiscoveryServer", "Announcer", "alive_nodes",
 # probe consults so a gracefully-departed worker drops out of the alive
 # gauge IMMEDIATELY instead of flapping dead-then-gone.
 
-_FLEET_LOCK = threading.Lock()
+_FLEET_LOCK = OrderedLock("discovery._FLEET_LOCK")
 _FLEET = {"joined": 0, "left": 0, "announce_retries": 0}
 # uri -> unannounce ts; cleared on re-announce, expired past the ttl.
 # The ttl is short on purpose: its job is bridging the window between
@@ -112,7 +113,7 @@ class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     _GUARDED_BY = {"lock": ("nodes",)}  # tpulint C001
     nodes: Dict[str, dict] = {}
-    lock = threading.Lock()
+    lock = OrderedLock("discovery._Handler.lock")
     authenticator = None  # InternalAuthenticator when a secret is set
 
     def log_message(self, fmt, *args):
@@ -183,7 +184,7 @@ class DiscoveryServer:
                  tls: Optional[tuple] = None):
         from .auth import make_authenticator
         handler = type("BoundDiscovery", (_Handler,),
-                       {"nodes": {}, "lock": threading.Lock(),
+                       {"nodes": {}, "lock": OrderedLock("discovery._Handler.lock"),
                         "authenticator": make_authenticator(
                             shared_secret, "discovery")})
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
@@ -330,7 +331,7 @@ class HeartbeatProber:
         self.threshold = threshold  # above this = failed
         self.probe_timeout = probe_timeout_s
         self._rates: Dict[str, float] = {}
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("discovery.HeartbeatProber._lock")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         from .auth import make_authenticator

@@ -22,6 +22,7 @@ import uuid
 from typing import Callable, Dict, List, Optional
 
 from .. import failpoints
+from ..utils.locks import OrderedLock
 from .events import event_listeners
 
 __all__ = ["ResourceGroup", "Dispatcher", "QueryRejected",
@@ -54,6 +55,13 @@ class ResourceGroup:
     # cooperative analog of the reference's query preemption)
     priority: int = 0
 
+    # tpulint C001: admission state is written through WHATEVER
+    # receiver walks the tree (g/root/leaf) while holding the ONE
+    # per-tree condition -- _cv is a shared lock, any receiver counts
+    _GUARDED_BY = {"_cv": ("_running", "_queued", "_mem_used",
+                           "_ticket", "_waiters")}
+    _GUARDED_BY_SHARED = ("_cv",)
+
     def __post_init__(self):
         self._running = 0
         self._queued = 0
@@ -61,7 +69,11 @@ class ResourceGroup:
         self.parent: Optional["ResourceGroup"] = None
         self.children: Dict[str, "ResourceGroup"] = {}
         # one condition per TREE (the root's); shared by add_child
-        self._cv = threading.Condition()
+        # the tree's condition wraps an OrderedLock so admission waits
+        # ride the runtime lock-order witness like every other lock
+        # (Condition probes ownership via OrderedLock._is_owned)
+        self._cv = threading.Condition(
+            OrderedLock("dispatcher.ResourceGroup._cv"))
         self._waiters: List[tuple] = []  # (ticket, leaf) FIFO registry
         self._ticket = 0
 

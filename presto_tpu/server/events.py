@@ -18,13 +18,15 @@ import threading
 import time
 from typing import Callable, Dict, List
 
+from ..utils.locks import OrderedLock
+
 __all__ = ["EventListenerManager", "event_listeners"]
 
 
 class EventListenerManager:
     def __init__(self):
         self._listeners: List[Callable[[str, Dict], None]] = []
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("events.EventListenerManager._lock")
 
     def register(self, listener: Callable[[str, Dict], None]):
         """listener(event_name, payload). Returns an unregister handle."""

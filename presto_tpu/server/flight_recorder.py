@@ -28,12 +28,14 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ..utils.locks import OrderedLock
+
 __all__ = ["FlightRecorder", "get_flight_recorder", "set_flight_recorder",
            "record_event", "flight_recorder_totals"]
 
 # -- process-lifetime counters (survive recorder swaps; /v1/metrics) ----
 
-_COUNTERS_LOCK = threading.Lock()
+_COUNTERS_LOCK = OrderedLock("flight_recorder._COUNTERS_LOCK")
 _EVENTS_TOTAL = {"count": 0}
 _DUMPS_TOTAL: Dict[str, int] = {}  # reason -> count
 _EVICTED_TOTAL = {"count": 0}      # dump files deleted by retention
@@ -86,7 +88,7 @@ class FlightRecorder:
                 max_dump_dir_files = 256
         self.max_dump_dir_files = int(max_dump_dir_files)
         self._dumped: Dict[str, str] = {}  # key -> dump path ('' = capped)
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("flight_recorder.FlightRecorder._lock")
 
     # -- recording ------------------------------------------------------
 
@@ -227,7 +229,7 @@ class FlightRecorder:
 
 
 _recorder: Optional[FlightRecorder] = None
-_recorder_lock = threading.Lock()
+_recorder_lock = OrderedLock("flight_recorder._recorder_lock")
 
 
 def get_flight_recorder() -> FlightRecorder:

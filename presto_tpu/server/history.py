@@ -45,6 +45,7 @@ import time
 from typing import Dict, List, Optional
 
 from ..exec.perfgate import SENTINEL_SPECS, RollingBaseline
+from ..utils.locks import OrderedLock
 
 __all__ = ["QueryHistoryArchive", "get_history_archive",
            "set_history_archive", "history_totals",
@@ -69,7 +70,7 @@ def _process_id() -> str:
 
 # -- process-lifetime counters (survive archive swaps; /v1/metrics) -----
 
-_COUNTERS_LOCK = threading.Lock()
+_COUNTERS_LOCK = OrderedLock("history._COUNTERS_LOCK")
 _RECORDS_TOTAL = {"count": 0}
 _REGRESSIONS_TOTAL: Dict[str, int] = {}  # metric -> breaches
 
@@ -164,8 +165,8 @@ class QueryHistoryArchive:
         self._batch_fp_counts: Dict[str, int] = {}
         self._file_index = 0
         self._file_lines = 0
-        self._lock = threading.Lock()
-        self._plock = threading.Lock()
+        self._lock = OrderedLock("history.QueryHistoryArchive._lock")
+        self._plock = OrderedLock("history.QueryHistoryArchive._plock")
         if self.history_dir:
             self.load()
 
@@ -281,8 +282,8 @@ class QueryHistoryArchive:
             self._raise_alarms(record, breaches)
         with self._lock:
             self._records.append(record)
-            self._count_batch_fp(record, +1)
-            self._evict_over_capacity()
+            self._count_batch_fp_locked(record, +1)
+            self._evict_over_capacity_locked()
         self._persist(record)
         _count_record()
         return breaches
@@ -384,14 +385,14 @@ class QueryHistoryArchive:
         with self._lock:
             for doc in loaded:
                 self._records.append(doc)
-                self._count_batch_fp(doc, +1)
+                self._count_batch_fp_locked(doc, +1)
                 if doc.get("state") == "FINISHED" and \
                         isinstance(doc.get("stats"), dict):
                     self.baseline.warm(str(doc.get("fingerprint", "")),
                                        {k: float(v) for k, v in
                                         doc["stats"].items()
                                         if isinstance(v, (int, float))})
-            self._evict_over_capacity()
+            self._evict_over_capacity_locked()
         if files:
             with self._plock:
                 # resume appends on the newest ring file
@@ -429,7 +430,7 @@ class QueryHistoryArchive:
             snap = snap[: max(0, int(limit))]
         return snap
 
-    def _count_batch_fp(self, record: dict, delta: int) -> None:
+    def _count_batch_fp_locked(self, record: dict, delta: int) -> None:
         """Maintain the batchFingerprint counter (caller holds _lock)."""
         fp = record.get("batchFingerprint")
         if not fp:
@@ -440,13 +441,13 @@ class QueryHistoryArchive:
         else:
             self._batch_fp_counts.pop(fp, None)
 
-    def _evict_over_capacity(self) -> None:
+    def _evict_over_capacity_locked(self) -> None:
         """Drop the oldest records past capacity (caller holds _lock),
         keeping the batchFingerprint counter exact."""
         over = len(self._records) - self.capacity
         if over > 0:
             for r in self._records[:over]:
-                self._count_batch_fp(r, -1)
+                self._count_batch_fp_locked(r, -1)
             del self._records[:over]
 
     def batch_fingerprint_count(self, fingerprint: str) -> int:
@@ -512,7 +513,7 @@ def cluster_history_doc(worker_urls=(), timeout: float = 3.0) -> dict:
 
 
 _archive: Optional[QueryHistoryArchive] = None
-_archive_lock = threading.Lock()
+_archive_lock = OrderedLock("history._archive_lock")
 
 
 def get_history_archive() -> QueryHistoryArchive:

@@ -36,6 +36,8 @@ import threading
 import time as _time
 from typing import Dict, List, Optional, Tuple, Union
 
+from ..utils.locks import OrderedLock
+
 __all__ = ["MetricFamily", "Histogram", "DEFAULT_BUCKETS",
            "observe_histogram", "get_histogram", "histogram_families",
            "reset_histograms",
@@ -48,7 +50,7 @@ __all__ = ["MetricFamily", "Histogram", "DEFAULT_BUCKETS",
            "flight_recorder_families", "kernel_audit_families",
            "failpoint_families", "query_history_families",
            "live_introspection_families", "fleet_families",
-           "CONTENT_TYPE"]
+           "lock_families", "CONTENT_TYPE"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 # exemplars are legal only in the OpenMetrics exposition (the classic
@@ -100,7 +102,7 @@ class Histogram:
         # per-bucket (trace_id, value, ts_us) of the max observation
         self.exemplars: List[Optional[Tuple[str, float, int]]] = \
             [None] * (len(self.buckets) + 1)
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("metrics.Histogram._lock")
 
     def observe(self, value: float,
                 trace_id: Optional[str] = None) -> None:
@@ -293,7 +295,7 @@ def _num(v: Union[int, float]) -> str:
 # /v1/metrics carry a stable histogram shape from the first request on;
 # undeclared names observed at runtime export too.
 
-_HIST_LOCK = threading.Lock()
+_HIST_LOCK = OrderedLock("metrics._HIST_LOCK")
 _HISTOGRAMS: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Histogram] = {}
 
 # name -> (help text, preset label sets rendered even before any
@@ -459,7 +461,7 @@ def narrowing_families() -> List[MetricFamily]:
 # /v1/metrics by both tiers. "Swallowed but counted" is observable;
 # "swallowed" is a silent outage.
 
-_SUPPRESSED_LOCK = threading.Lock()
+_SUPPRESSED_LOCK = OrderedLock("metrics._SUPPRESSED_LOCK")
 _SUPPRESSED: Dict[Tuple[str, str], int] = {}
 _log = logging.getLogger("presto_tpu.server")
 
@@ -700,6 +702,27 @@ def failpoint_families() -> List[MetricFamily]:
         MetricFamily("presto_tpu_failpoints_armed", "gauge",
                      "failpoint sites currently armed").add(
                          armed_count()),
+    ]
+
+
+def lock_families() -> List[MetricFamily]:
+    """Lock-order witness accounting, exported by BOTH tiers: the
+    process-lifetime inversion counter (a stable zero on a healthy
+    tier -- the chaos soak and the armed tier-1 cluster test fail on
+    anything else) plus the armed gauge, so a scrape shows whether
+    zero means "clean under watch" or "witness off"."""
+    from ..utils import locks as _locks
+    return [
+        MetricFamily(
+            "presto_tpu_lock_order_violations_total", "counter",
+            "lock-order inversions detected at acquire time by the "
+            "runtime witness (utils/locks.py; see DESIGN.md "
+            "'Concurrency auditing')").add(
+                _locks.witness_violations_total()),
+        MetricFamily(
+            "presto_tpu_lock_witness_armed", "gauge",
+            "1 while the lock-order witness is armed").add(
+                1 if _locks.ARMED else 0),
     ]
 
 

@@ -16,6 +16,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..utils.locks import OrderedLock
+
 __all__ = ["QueryState", "QueryStateMachine", "TERMINAL_STATES"]
 
 
@@ -45,7 +47,7 @@ class QueryStateMachine:
 
     def __init__(self, query_id: str):
         self.query_id = query_id
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("query_state.QueryStateMachine._lock")
         self._state = QueryState.QUEUED
         self._entered: Dict[str, float] = {QueryState.QUEUED: time.time()}
         self._listeners: List[Callable[[str, str], None]] = []

@@ -40,6 +40,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
 from .. import failpoints
+from ..utils.locks import OrderedLock
 
 __all__ = ["ResourceManager", "ClusterStateSender", "remote_group_load",
            "StandbyCoordinator", "failover_totals",
@@ -47,7 +48,7 @@ __all__ = ["ResourceManager", "ClusterStateSender", "remote_group_load",
 
 # -- failover accounting (process-wide, exported by
 # metrics.fleet_families on both tiers) ---------------------------------
-_FAILOVER_LOCK = threading.Lock()
+_FAILOVER_LOCK = OrderedLock("resource_manager._FAILOVER_LOCK")
 _FAILOVER = {"count": 0}
 
 
@@ -66,7 +67,7 @@ class _State:
     _GUARDED_BY = {"lock": ("coordinators",)}  # tpulint C001
 
     def __init__(self, heartbeat_ttl_s: float):
-        self.lock = threading.Lock()
+        self.lock = OrderedLock("resource_manager._State.lock")
         self.ttl = heartbeat_ttl_s
         # coordinator_id -> {"at": ts, "groups": {name: stats}}
         self.coordinators: Dict[str, dict] = {}
@@ -259,7 +260,7 @@ class StandbyCoordinator:
         self._manifest: List[dict] = []  # last-seen in-flight snapshot
         self._seen_primary = False
         self._fired = False
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("resource_manager.StandbyCoordinator._lock")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 

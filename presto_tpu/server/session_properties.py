@@ -20,6 +20,8 @@ import re
 import threading
 from typing import Dict, List, Optional
 
+from ..utils.locks import OrderedLock
+
 __all__ = ["SessionPropertyManager", "set_session_property_manager",
            "get_session_property_manager"]
 
@@ -50,7 +52,7 @@ class SessionPropertyManager:
         return out
 
 
-_lock = threading.Lock()
+_lock = OrderedLock("session_properties._lock")
 _manager: Optional[SessionPropertyManager] = None
 
 

@@ -35,6 +35,7 @@ import numpy as np
 from .. import failpoints
 from .. import types as T
 from ..transaction import TransactionManager
+from ..utils.locks import OrderedLock
 from .dispatcher import Dispatcher, QueryRejected
 from .flight_recorder import get_flight_recorder, record_event
 from .query_state import QueryState, QueryStateMachine, TERMINAL_STATES
@@ -186,11 +187,11 @@ class StatementServer:
         self.transactions = TransactionManager()
         self._executor = executor or self._default_executor
         self._queries: Dict[str, _Query] = {}
-        self._qlock = threading.Lock()
+        self._qlock = OrderedLock("statement.StatementServer._qlock")
         self._started_at = time.time()
         # lifetime roll-ups for /v1/metrics (terminal queries only;
         # accounted exactly once per query in _run's finally)
-        self._metrics_lock = threading.Lock()
+        self._metrics_lock = OrderedLock("statement.StatementServer._metrics_lock")
         self._queries_by_state: Dict[str, int] = {}
         self._totals = {"rows": 0, "bytes": 0, "wall_us": 0,
                         "compile_us": 0, "execute_us": 0,
@@ -1030,6 +1031,8 @@ class StatementServer:
         fams.extend(flight_recorder_families())
         fams.extend(kernel_audit_families())
         fams.extend(failpoint_families())
+        from .metrics import lock_families
+        fams.extend(lock_families())
         fams.extend(query_history_families())
         fams.extend(histogram_families())
         return fams

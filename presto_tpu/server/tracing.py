@@ -33,6 +33,8 @@ import time
 import uuid
 from typing import Dict, List, Optional
 
+from ..utils.locks import OrderedLock
+
 __all__ = ["RecordingTracer", "set_tracer", "get_tracer",
            "spans_from_state_timings", "TraceContext", "TRACE_HEADER",
            "new_trace_id", "new_span_id", "parse_traceparent",
@@ -88,7 +90,7 @@ def parse_traceparent(value: Optional[str]) -> Optional[TraceContext]:
 
 # -- process-lifetime counters (exported on /v1/metrics, both tiers) ----
 
-_COUNTERS_LOCK = threading.Lock()
+_COUNTERS_LOCK = OrderedLock("tracing._COUNTERS_LOCK")
 _COUNTERS = {"spans": 0, "evicted": 0, "dropped": 0}
 
 
@@ -125,7 +127,7 @@ class RecordingTracer:
         # hot (never the LRU victim), so per-trace growth needs its own
         # bound; overflow is counted as dropped
         self.max_spans_per_trace = max_spans_per_trace
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("tracing.RecordingTracer._lock")
 
     def span(self, trace_id: str, name: str, start_s: float, end_s: float,
              attributes: Optional[dict] = None,

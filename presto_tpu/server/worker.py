@@ -44,6 +44,7 @@ from .. import failpoints
 from ..plan import nodes as N
 from ..serde import PageCodec, serialize_page
 from ..utils.config import Session
+from ..utils.locks import OrderedLock
 from .buffers import SpoolingOutputBuffer
 
 __all__ = ["TpuWorkerServer", "TaskManager"]
@@ -102,7 +103,7 @@ class FragmentResultCache:
         self.max_bytes = max_bytes
         self._entries = collections.OrderedDict()
         self._bytes = 0
-        self._lock = threading.Lock()
+        self._lock = OrderedLock("worker.FragmentResultCache._lock")
         self.hits = 0
         self.misses = 0
 
@@ -209,7 +210,7 @@ class _Task:
         # ship to the coordinator piggybacked on the final task status
         # (the distributed-trace stitch transport)
         self.spans: List[dict] = []
-        self.lock = threading.Lock()
+        self.lock = OrderedLock("worker._Task.lock")
 
     def _new_buffer(self) -> SpoolingOutputBuffer:
         return SpoolingOutputBuffer(self._spool_threshold, self._spool_dir)
@@ -283,7 +284,7 @@ class TaskManager:
         self.output_spool_threshold_bytes = output_spool_threshold_bytes
         self.output_spool_dir = output_spool_dir
         self._exec_slots = threading.BoundedSemaphore(self.task_concurrency)
-        self._tasks_lock = threading.Lock()
+        self._tasks_lock = OrderedLock("worker.TaskManager._tasks_lock")
         self.fragment_cache = FragmentResultCache()
         from ..connectors.system import register_task_manager
         register_task_manager(self)  # system.tasks introspection
@@ -298,7 +299,7 @@ class TaskManager:
                                          "exchange_bytes": 0,
                                          "compile_us": 0,
                                          "execute_us": 0}
-        self._counters_lock = threading.Lock()
+        self._counters_lock = OrderedLock("worker.TaskManager._counters_lock")
 
     def _count(self, name: str, delta: int = 1):
         with self._counters_lock:
@@ -432,13 +433,20 @@ class TaskManager:
                 adopted = False
         if not adopted:
             return task.info()
+        # restore (and possibly re-spool to disk) OUTSIDE the task
+        # lock: only this thread adopts (the `adopted` flag is flipped
+        # under _tasks_lock), and a consumer that races the attach sees
+        # the same fresh-empty state it could already see between task
+        # creation and the old in-lock restore -- its 404-retry covers
+        # the window. Holding task.lock across file I/O stalled every
+        # /v1/task status poll behind a slow disk (tpulint C003).
         total = 0
+        buffers: Dict[int, SpoolingOutputBuffer] = {}
+        for bid, pages in (doc.get("buffers") or {}).items():
+            buf = task._new_buffer()
+            total += buf.restore_pages(pages)
+            buffers[int(bid)] = buf
         with task.lock:
-            buffers: Dict[int, SpoolingOutputBuffer] = {}
-            for bid, pages in (doc.get("buffers") or {}).items():
-                buf = task._new_buffer()
-                total += buf.restore_pages(pages)
-                buffers[int(bid)] = buf
             task.buffers = buffers or {0: task._new_buffer()}
             task.first_token = {int(b): int(t) for b, t in
                                 (doc.get("firstToken") or {}).items()}
@@ -1002,6 +1010,8 @@ class _Handler(BaseHTTPRequestHandler):
         fams.extend(flight_recorder_families())
         fams.extend(kernel_audit_families())
         fams.extend(failpoint_families())
+        from .metrics import lock_families
+        fams.extend(lock_families())
         from .metrics import (fleet_families,
                               live_introspection_families,
                               query_history_families)
@@ -1340,7 +1350,7 @@ class TpuWorkerServer:
         self._announcer = None
         self._shared_secret = shared_secret  # drain-migration hops
         self._drain_thread: Optional[threading.Thread] = None
-        self._drain_lock = threading.Lock()
+        self._drain_lock = OrderedLock("worker.TpuWorkerServer._drain_lock")
         self._drain_migrated = 0
         self._stop_drain = threading.Event()  # server teardown signal
         if discovery_url:

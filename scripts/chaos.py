@@ -5,7 +5,7 @@ Drives an in-process multi-worker cluster (workers + coordinator +
 statement tier + discovery + prober -- the DistributedQueryRunner
 harness pattern) through a DETERMINISTIC schedule of fault injections
 (presto_tpu/failpoints), armed round by round over the live admin API
-(``POST /v1/failpoint``), and asserts the three soak invariants:
+(``POST /v1/failpoint``), and asserts the four soak invariants:
 
   1. correct-or-clean-failure: every chaos query either matches its
      fault-free oracle result or raises a clean error within its
@@ -16,7 +16,11 @@ harness pattern) through a DETERMINISTIC schedule of fault injections
   3. full fault accounting: every fired injection shows up in the
      ``presto_tpu_failpoint_hits_total{site,action}`` counters AND as
      a flight-recorder ``failpoint`` event (and a statement-tier
-     failure round checks its auto flight DUMP carries them).
+     failure round checks its auto flight DUMP carries them);
+  4. lock-order consistency: the runtime witness (utils/locks.py) is
+     ARMED for the whole soak -- every OrderedLock acquire on every
+     tier is checked against the process's established acquisition
+     order, and a single inversion anywhere fails its round.
 
 Determinism contract: with a fixed ``--seed``, two runs produce an
 identical fault sequence and identical per-query outcomes -- the
@@ -50,6 +54,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import _cpu  # noqa: E402,F401
 
 from presto_tpu import failpoints  # noqa: E402
+from presto_tpu.utils import locks as wlocks  # noqa: E402
 from presto_tpu.client import StatementClient, QueryError  # noqa: E402
 from presto_tpu.exec import run_query  # noqa: E402
 from presto_tpu.plan.distribute import add_exchanges  # noqa: E402
@@ -697,6 +702,13 @@ class ChaosRun:
         totals0 = dict(failpoints.failpoint_totals())
         set_flight_recorder(FlightRecorder(
             dump_dir=tempfile.mkdtemp(prefix="presto_tpu_chaos_")))
+        # invariant 4: the lock-order witness rides the whole soak --
+        # every OrderedLock acquire on every tier is order-checked, and
+        # ONE inversion anywhere fails the round that provoked it
+        wlocks.reset_witness()
+        wlocks.arm_witness()
+        witness0 = wlocks.witness_violations_total()
+        witness_seen = 0  # records consumed by per-round reporting
         cluster = ChaosCluster(self.sf, workers=args.workers)
         t_run0 = time.time()
         try:
@@ -734,6 +746,24 @@ class ChaosRun:
                         self.fail(f"round {i}: counter decreased on "
                                   f"{ep}: {v}")
                 prev_scrapes = scrapes
+                # invariant 4: no lock-order inversion this round (the
+                # witness catches the FIRST inconsistent acquisition
+                # deterministically; which round provoked it is part
+                # of the failure report)
+                wnow = wlocks.witness_violations_total()
+                if wnow != witness0:
+                    # only the records NEW since the last round: each
+                    # inversion is attributed to (and fails) exactly
+                    # the round that provoked it
+                    for v in wlocks.witness_violations()[witness_seen:]:
+                        self.fail(
+                            f"round {i}: lock-order inversion: "
+                            f"acquired {v['acquiring']} while holding "
+                            f"{v['held']} at {v['site']} (established "
+                            f"order {' -> '.join(v['reversePath'])} "
+                            f"from {v['reverseSite']})")
+                    witness_seen = len(wlocks.witness_violations())
+                    witness0 = wnow
                 row = {"round": i, "kind": step["kind"],
                        "layer": step["layer"],
                        "site": step["site"], "spec": step["spec"],
@@ -783,6 +813,7 @@ class ChaosRun:
                           f"sites over layers {sorted(fired_layers)}")
         finally:
             failpoints.disarm_all()
+            wlocks.disarm_witness()
             cluster.stop()
         return self.report(time.time() - t_run0, queries)
 
@@ -804,7 +835,10 @@ class ChaosRun:
                        "counter decreased" in f for f in self.failures),
                    "fault_accounting": not any(
                        "accounting" in f or "hit counter" in f
-                       or "flight" in f for f in self.failures)},
+                       or "flight" in f for f in self.failures),
+                   "lock_order": not any(
+                       "lock-order inversion" in f
+                       for f in self.failures)},
                "violations": self.failures,
                "wallSeconds": round(wall_s, 2)}
         path = self.args.report or os.path.join(

@@ -25,6 +25,16 @@ python "$here/tpulint.py" "$@"
 t=$?
 [ "$t" -gt "$rc" ] && rc=$t
 
+# the concurrency artifact gate: the CURRENT lock-acquisition-order
+# graph must be cycle-free (exit 2 -- a potential deadlock is never
+# committable) and structurally identical to the committed
+# LOCK_ORDER.json (exit 1 -- run scripts/lockgraph.py --update and
+# review the diff). tpulint above already ran C001-C004 over the same
+# surface; this gate pins the REVIEWED artifact.
+python "$here/lockgraph.py" --check
+o=$?
+[ "$o" -gt "$rc" ] && rc=$o
+
 # the corpus gate audits the IR the engine actually dispatches:
 # pipeline-region fusion ON, so fused jaxprs are what K001-K005 walk
 PRESTO_TPU_FUSION=1 python "$here/kernaudit.py" "$@"

@@ -93,6 +93,16 @@ FLEET_FAMILIES = (
 )
 
 
+# lock-order witness (utils/locks.py): its own always-present section,
+# zeros included -- "0 inversions while ARMED" is the health statement
+# the concurrency audit exists to make, and "0 while disarmed" must
+# read differently (nobody was watching)
+LOCK_FAMILIES = (
+    "presto_tpu_lock_order_violations_total",
+    "presto_tpu_lock_witness_armed",
+)
+
+
 _LE_RE = re.compile(r'le="([^"]+)"')
 
 
@@ -137,8 +147,8 @@ def diff(before: dict, after: dict) -> dict:
     histogram window quantiles, counter-monotonicity violations, plus
     the always-present tracing/flight-recorder section."""
     out = {"counters": {}, "gauges": {}, "tracing": {}, "faults": {},
-           "history": {}, "cluster": {}, "fleet": {}, "histograms": {},
-           "violations": {}}
+           "history": {}, "cluster": {}, "fleet": {}, "locks": {},
+           "histograms": {}, "violations": {}}
     hist_bases = set()
     for fam, samples in after.items():
         if fam.endswith("_bucket"):
@@ -153,6 +163,7 @@ def diff(before: dict, after: dict) -> dict:
         is_history = fam in HISTORY_FAMILIES
         is_cluster = fam in CLUSTER_FAMILIES
         is_fleet = fam in FLEET_FAMILIES
+        is_locks = fam in LOCK_FAMILIES
         for key, val in samples.items():
             label = fam + key
             if is_counter:
@@ -174,6 +185,10 @@ def diff(before: dict, after: dict) -> dict:
                 elif is_cluster:
                     # stuck-firing delta rides the cluster section
                     out["cluster"][label] = round(delta, 6)
+                elif is_locks:
+                    # inversion delta, zero included: "0 new
+                    # inversions" is the statement, not silence
+                    out["locks"][label] = round(delta, 6)
                 elif fam in TRACING_FAMILIES:
                     out["tracing"][label] = round(delta, 6)
                 elif delta:
@@ -194,6 +209,10 @@ def diff(before: dict, after: dict) -> dict:
                 # current gauge values: "what is in flight NOW" reads
                 # off one block beside the stuck delta
                 out["cluster"][label] = round(val, 6)
+            elif is_locks:
+                # the armed gauge rides beside the inversion delta so
+                # the zero is qualified: watched, or unwatched
+                out["locks"][label] = round(val, 6)
             else:
                 out["gauges"][label] = round(val, 6)
     for base in sorted(hist_bases):

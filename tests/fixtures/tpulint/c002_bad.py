@@ -1,0 +1,81 @@
+"""tpulint C002 fixture: seeded lock-order cycles. NOT part of the
+engine -- linted standalone by tests/test_tpulint.py (the pass builds
+a self-contained graph for files outside its target set)."""
+
+import threading
+
+_reg = threading.Lock()
+_stats = threading.Lock()
+_pool = threading.Lock()
+_queue = threading.Lock()
+_spool = threading.Lock()
+_tail = threading.Lock()
+_sup_a = threading.Lock()
+_sup_b = threading.Lock()
+_outer = threading.Lock()
+_inner = threading.Lock()
+
+
+def reg_then_stats():
+    with _reg:
+        with _stats:          # half an inversion: reg -> stats
+            pass
+
+
+def stats_then_reg():
+    with _stats:
+        with _reg:            # BAD: closes the reg/stats cycle
+            pass
+
+
+def pool_then_queue():
+    with _pool:
+        with _queue:          # half an inversion: pool -> queue
+            pass
+
+
+def queue_then_pool():
+    with _queue:
+        with _pool:           # BAD: closes the pool/queue cycle
+            pass
+
+
+def spool_then_tail():
+    with _spool:
+        with _tail:           # half an inversion: spool -> tail
+            pass
+
+
+def tail_then_spool():
+    with _tail:
+        with _spool:          # BAD: closes the spool/tail cycle
+            pass
+
+
+def sup_forward():
+    with _sup_a:
+        with _sup_b:  # tpulint: disable=C002
+            pass
+
+
+def sup_reverse():
+    with _sup_b:
+        with _sup_a:
+            pass
+
+
+def ok_nested_consistent():
+    with _outer:
+        with _inner:          # outer -> inner, and only ever that way
+            pass
+
+
+def ok_nested_consistent_again():
+    with _outer:
+        with _inner:          # same order elsewhere: no cycle
+            pass
+
+
+def ok_disjoint():
+    with _inner:              # no other lock held: no edge at all
+        pass

@@ -1,0 +1,54 @@
+"""tpulint C003 fixture: seeded blocking-under-lock stalls. NOT part
+of the engine -- linted standalone by tests/test_tpulint.py."""
+
+import threading
+import time
+import urllib.request
+
+_lock = threading.Lock()
+_cv = threading.Condition()
+
+
+def bad_sleep_under_lock():
+    with _lock:
+        time.sleep(0.05)                 # BAD: every waiter sleeps too
+
+
+def bad_http_under_lock(url):
+    with _lock:
+        return urllib.request.urlopen(url)   # BAD: network under lock
+
+
+def bad_join_under_lock(t):
+    with _lock:
+        t.join()                         # BAD: holder blocks on a thread
+
+
+def bad_foreign_wait(other):
+    with _lock:
+        other.acquire()                  # BAD: waiting on a DIFFERENT lock
+
+
+def suppressed_io(path):
+    with _lock:
+        return open(path)  # tpulint: disable=C003
+
+
+def ok_sleep_unlocked():
+    time.sleep(0.05)                     # no lock held: fine
+
+
+def ok_wait_own_condition():
+    with _cv:
+        _cv.wait(0.1)                    # the normal cv idiom: exempt
+
+
+def ok_io_unlocked(path):
+    with open(path) as f:
+        return f.read()
+
+
+def _flush_locked(sink):
+    # *_locked convention: the CALLER holds the lock, so blocking here
+    # is still blocking under it -- but this helper only formats
+    return repr(sink)

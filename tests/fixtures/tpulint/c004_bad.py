@@ -1,0 +1,55 @@
+"""tpulint C004 fixture: seeded thread-lifecycle leaks. NOT part of
+the engine -- linted standalone by tests/test_tpulint.py."""
+
+import threading
+
+
+def _work():
+    pass
+
+
+class LeakyService:
+    def __init__(self):
+        self._stop = threading.Event()
+
+    def start_bad_attr(self):
+        # BAD: bound to self but no .join() anywhere in the module
+        self._pump = threading.Thread(target=self._spin)
+        self._pump.start()
+
+    def start_bad_local(self):
+        # BAD: local thread neither joined nor daemon-flagged here
+        t = threading.Thread(target=self._spin)
+        t.start()
+
+    def start_bad_anonymous(self):
+        # BAD: anonymous -- nothing can ever join it
+        threading.Thread(target=self._spin).start()
+
+    def _spin(self):
+        while True:                      # BAD: no stop-flag check
+            _work()
+
+    def start_suppressed(self):
+        self._aux = threading.Thread(target=self._serve)  # tpulint: disable=C004
+        self._aux.start()
+
+    def _serve(self):
+        while not self._stop.is_set():   # the sanctioned loop shape
+            _work()
+
+    def start_ok_daemon(self):
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def start_ok_joined(self):
+        self._worker = threading.Thread(target=self._serve)
+        self._worker.start()
+
+    def start_ok_local_daemon(self):
+        t = threading.Thread(target=self._serve)
+        t.daemon = True
+        t.start()
+
+    def stop(self):
+        self._stop.set()
+        self._worker.join(timeout=5)

@@ -133,6 +133,11 @@ def test_tpcds_query(name, sf, kw):
 
 
 @pytest.mark.tpcds_slow
+# ALSO `slow`: a bare `-m "not slow"` invocation (the tier-1 wall-
+# budget driver) overrides the ini's combined default expression, and
+# this corpus's sqlite oracle construction blows the 870s budget --
+# the stragglers must fall out of EITHER spelling of the fast tier
+@pytest.mark.slow
 @pytest.mark.parametrize("name,sf,kw", SLOW_CASES,
                          ids=[c[0] for c in SLOW_CASES])
 def test_tpcds_query_slow(name, sf, kw):

@@ -33,7 +33,8 @@ from presto_tpu.lint.baseline import load_baseline, save_baseline  # noqa: E402
 from presto_tpu.lint.cli import main as tpulint_main  # noqa: E402
 from presto_tpu.lint.core import ModuleSource  # noqa: E402
 
-ALL_CODES = ("W001", "H001", "R001", "C001", "S001")
+ALL_CODES = ("W001", "H001", "R001", "C001", "C002", "C003", "C004",
+             "S001")
 
 
 def _cli(args):
@@ -53,7 +54,7 @@ def test_repo_is_clean_modulo_baseline():
     assert rc == 0, f"tpulint found violations:\n{out}"
 
 
-def test_registry_ships_all_five_passes():
+def test_registry_ships_every_pass():
     codes = {p.code for p in all_passes()}
     assert set(ALL_CODES) <= codes
 
@@ -438,3 +439,186 @@ def test_shim_check_no_wide_lanes_contract():
         assert len(c.check_all()) >= 10
     finally:
         c.WIDE_OK_FUNCS = orig
+
+
+# -- the concurrency-audit suite (C001 extensions, C002/C003/C004) -----
+
+
+def test_c001_module_level_guards(tmp_path):
+    """Module-level _GUARDED_BY: writes to declared globals (assign,
+    augassign, subscript) outside `with <LOCK>:` are flagged; locked
+    and module-scope (initialization) writes are not."""
+    p = tmp_path / "modguard.py"
+    p.write_text(
+        "import threading\n"
+        "_L = threading.Lock()\n"
+        "_T = {'n': 0}\n"
+        "_GUARDED_BY = {'_L': ('_T',)}\n"
+        "def bad():\n"
+        "    _T['n'] += 1\n"
+        "def bad_rebind():\n"
+        "    global _T\n"
+        "    _T = {}\n"
+        "def good():\n"
+        "    with _L:\n"
+        "        _T['n'] += 1\n")
+    findings = run_passes(codes=["C001"], paths=[str(p)]).findings
+    assert {f.context for f in findings} == {"bad", "bad_rebind"}
+    assert all("module global '_T'" in f.message for f in findings)
+
+
+def test_c001_shared_lock_accepts_any_receiver(tmp_path):
+    """_GUARDED_BY_SHARED: one lock object per tree -- holding it
+    through ANY receiver satisfies the barrier (the dispatcher's
+    resource-group condition idiom)."""
+    p = tmp_path / "shared.py"
+    p.write_text(
+        "class Tree:\n"
+        "    _GUARDED_BY = {'_cv': ('_ticket',)}\n"
+        "    _GUARDED_BY_SHARED = ('_cv',)\n"
+        "    def good_via_self(self, root):\n"
+        "        with self._cv:\n"
+        "            root._ticket += 1\n"
+        "    def bad_unlocked(self, root):\n"
+        "        root._ticket += 1\n")
+    findings = run_passes(codes=["C001"], paths=[str(p)]).findings
+    assert [f.context for f in findings] == ["Tree.bad_unlocked"]
+
+
+def test_c001_caller_lock_pseudo_declaration(tmp_path):
+    """"<caller>": writes through self inside the declaring class are
+    the contract; a foreign receiver mutating the fields with NO lock
+    held is flagged, with any held lock accepted."""
+    p = tmp_path / "callerlock.py"
+    p.write_text(
+        "import threading\n"
+        "class Buf:\n"
+        "    _GUARDED_BY = {'<caller>': ('_pages',)}\n"
+        "    def ok_push(self, x):\n"
+        "        self._pages = [x]\n"
+        "def bad_helper(buf):\n"
+        "    buf._pages = []\n"
+        "def good_helper(buf, task):\n"
+        "    with task.lock:\n"
+        "        buf._pages = []\n")
+    findings = run_passes(codes=["C001"], paths=[str(p)]).findings
+    assert [f.context for f in findings] == ["bad_helper"]
+    assert "caller-locked" in findings[0].message
+
+
+def test_c001_targets_cover_threaded_exec_modules():
+    from presto_tpu.lint.core import get_pass
+    files = {p.replace(os.sep, "/") for p in
+             get_pass("C001").target_files()}
+    assert {"presto_tpu/exec/batching.py", "presto_tpu/exec/regions.py",
+            "presto_tpu/exec/progress.py",
+            "presto_tpu/server/dispatcher.py",
+            "presto_tpu/server/buffers.py"} <= files
+
+
+def test_c002_reports_both_acquisition_paths():
+    """Sensitivity pin: every cycle report names the two locks AND
+    carries both sides' evidence (context of each edge)."""
+    fixture = os.path.join(FIXTURES, "c002_bad.py")
+    findings = run_passes(codes=["C002"], paths=[fixture]).findings
+    assert len(findings) == 3
+    by_msg = {f.message for f in findings}
+    assert any("_reg" in m and "_stats" in m and
+               "reg_then_stats" in m and "stats_then_reg" in m
+               for m in by_msg), by_msg
+
+
+def test_c002_consistent_order_is_silent(tmp_path):
+    p = tmp_path / "consistent.py"
+    p.write_text(
+        "import threading\n"
+        "_a = threading.Lock()\n"
+        "_b = threading.Lock()\n"
+        "def one():\n"
+        "    with _a:\n"
+        "        with _b:\n"
+        "            pass\n"
+        "def two():\n"
+        "    with _a:\n"
+        "        with _b:\n"
+        "            pass\n")
+    assert run_passes(codes=["C002"], paths=[str(p)]).findings == []
+
+
+def test_c002_cross_function_cycle_through_call_edge(tmp_path):
+    """The graph resolves call edges: acquiring under a held lock TWO
+    frames down still closes the cycle."""
+    p = tmp_path / "viacall.py"
+    p.write_text(
+        "import threading\n"
+        "_a = threading.Lock()\n"
+        "_b = threading.Lock()\n"
+        "def helper_takes_b():\n"
+        "    with _b:\n"
+        "        pass\n"
+        "def forward():\n"
+        "    with _a:\n"
+        "        helper_takes_b()\n"
+        "def reverse():\n"
+        "    with _b:\n"
+        "        with _a:\n"
+        "            pass\n")
+    findings = run_passes(codes=["C002"], paths=[str(p)]).findings
+    assert len(findings) == 1
+    assert "viacall._a -> viacall._b -> viacall._a" in \
+        findings[0].message
+
+
+def test_c003_transitive_blocking_through_helper(tmp_path):
+    """A helper that sleeps, called under a lock, is flagged at the
+    call site (the indirection of one function can't hide the stall)."""
+    p = tmp_path / "indirect.py"
+    p.write_text(
+        "import threading\n"
+        "import time\n"
+        "_l = threading.Lock()\n"
+        "def slow_flush():\n"
+        "    time.sleep(0.1)\n"
+        "def bad_caller():\n"
+        "    with _l:\n"
+        "        slow_flush()\n")
+    findings = run_passes(codes=["C003"], paths=[str(p)]).findings
+    contexts = {f.context for f in findings}
+    assert "bad_caller" in contexts
+    assert any("slow_flush" in f.message for f in findings)
+
+
+def test_c003_allowlist_is_honored():
+    """The history-archive persistence lock's deliberate I/O is in the
+    visible allowlist -- and the allowlisted entries actually match
+    real (path, context) pairs so they can't silently go stale."""
+    from presto_tpu.lint.passes.blocking import ALLOWED
+    result = run_passes(codes=["C003"])
+    assert result.findings == [], "\n".join(
+        f.render() for f in result.findings)
+    for (rel, context, _detail) in ALLOWED:
+        assert os.path.exists(os.path.join(REPO, rel)), rel
+        cls, method = context.split(".", 1)
+        src = open(os.path.join(REPO, rel)).read()
+        assert f"class {cls}" in src and f"def {method}" in src, context
+
+
+def test_c004_stop_flag_loop_and_daemon_are_silent():
+    fixture = os.path.join(FIXTURES, "c004_bad.py")
+    findings = run_passes(codes=["C004"], paths=[fixture]).findings
+    contexts = {f.context for f in findings}
+    assert contexts == {"LeakyService.start_bad_attr",
+                        "LeakyService.start_bad_local",
+                        "LeakyService.start_bad_anonymous",
+                        "LeakyService._spin"}
+
+
+def test_concurrency_passes_repo_clean_with_empty_baseline():
+    """The acceptance pin: C001-C004 over the real tree with NO
+    baseline entries -- findings were fixed in code, not grandfathered."""
+    result = run_passes(codes=["C001", "C002", "C003", "C004"])
+    assert result.findings == [], "\n".join(
+        f.render() for f in result.findings)
+    bl = load_baseline(os.path.join(REPO, "tpulint_baseline.json"))
+    assert not any(k.startswith(("C001", "C002", "C003", "C004"))
+                   for k in bl), "concurrency findings must be fixed"
