@@ -496,9 +496,9 @@ def main():
     scan_cols = cp.scan_nodes[0].columns
     sql_phys = cp.scan_nodes[0].physical_dtypes
     sql_host = tpch.generate_columns("lineitem", sf, scan_cols)
-    dt_sql, sql_staged_bytes = _stage_and_time(sql_host, scan_cols, capacity,
-                                               cp.fn, iters, wrap_seq=True,
-                                               physical_dtypes=sql_phys)
+    dt_sql, sql_staged_bytes, sql_stage_s = _stage_and_time(
+        sql_host, scan_cols, capacity, cp.fn, iters, wrap_seq=True,
+        physical_dtypes=sql_phys)
     sql_fallback = _TIMING_FALLBACK
 
     # --- hand-built plan (HandTpchQuery1 analog), for engine-overhead
@@ -509,9 +509,9 @@ def main():
         hand_phys = infer_table_widths(
             "tpch", "lineitem", Q1_COLUMNS,
             [tpch.column_type("lineitem", c) for c in Q1_COLUMNS], sf)
-    dt_hand, staged_bytes = _stage_and_time(host_cols, Q1_COLUMNS, capacity,
-                                            q1_local(), iters,
-                                            physical_dtypes=hand_phys)
+    dt_hand, staged_bytes, _hand_stage_s = _stage_and_time(
+        host_cols, Q1_COLUMNS, capacity, q1_local(), iters,
+        physical_dtypes=hand_phys)
 
     # fast telemetry smoke: one run_sql at sf=0.01 through the full
     # engine so every BENCH artifact carries the compile/execute split
@@ -545,6 +545,15 @@ def main():
             "rows": n,
             "staged_mb": round(sql_staged_bytes / 1e6, 1),
             "achieved_gb_per_s": round(sql_staged_bytes / dt_sql / 1e9, 1),
+            # the MEASURED host->HBM staging rate (one device_put of
+            # the q1 scan, synced): the perfgate-gated
+            # `staging_gb_per_s` sample, the exact number ROADMAP
+            # item 3's async split pipeline must raise past 1.0
+            "staging_gb_per_s": round(
+                sql_staged_bytes / max(sql_stage_s, 1e-9) / 1e9, 3),
+            # per-hop achieved rates from the data-path waterfall
+            # (exec/datapath.py; populated by the run_sql smoke runs)
+            "datapath": _datapath_detail(),
             "hand_built_staged_mb": round(staged_bytes / 1e6, 1),
             "timing_fallback": sql_fallback or _TIMING_FALLBACK,
             "telemetry_smoke_sf001": telemetry_smoke,
@@ -565,6 +574,22 @@ def main():
         },
     }
     print(json.dumps(result))
+
+
+def _datapath_detail():
+    """Per-hop byte totals + achieved GB/s from the process data-path
+    ledger (exec/datapath.py) -- only hops the run exercised. The
+    BENCH artifact records where the bytes went and how fast each hop
+    moved them, beside the headline staging_gb_per_s."""
+    from presto_tpu.exec.datapath import process_totals
+    out = {}
+    for hop, h in process_totals().items():
+        if not h.invocations:
+            continue
+        rate = h.bytes / (h.wall_us / 1e6) if h.wall_us else 0.0
+        out[hop] = {"bytes": h.bytes,
+                    "achieved_gb_per_s": round(rate / 1e9, 3)}
+    return out
 
 
 def _executed_smallg_form():
@@ -590,6 +615,10 @@ def _stage_and_time(host_cols, columns, capacity, pipeline_fn, iters,
 
     ``wrap_seq``: pipeline_fn is a CompiledPlan.fn taking a SEQUENCE of
     scan batches (vs a single batch).
+
+    Returns (per-iteration wall, staged bytes, staging wall): the
+    third value is the measured host->HBM put of the scan batch
+    (synced), the denominator of the gated ``staging_gb_per_s``.
     """
     import jax
 
@@ -597,10 +626,12 @@ def _stage_and_time(host_cols, columns, capacity, pipeline_fn, iters,
     from presto_tpu.connectors import tpch
 
     types = [tpch.column_type("lineitem", c) for c in columns]
+    t_stage0 = time.time()
     batch = jax.block_until_ready(jax.device_put(
         batch_from_numpy(types, [host_cols[c] for c in columns],
                          capacity=capacity,
                          physical_dtypes=physical_dtypes)))
+    stage_s = time.time() - t_stage0
     fn = (lambda b: pipeline_fn([b])) if wrap_seq else pipeline_fn
     run = jax.jit(fn)
     warm = jax.device_get(run(batch))  # warm-up / compile + round trip
@@ -611,7 +642,7 @@ def _stage_and_time(host_cols, columns, capacity, pipeline_fn, iters,
     global _TIMING_FALLBACK
     dt, _TIMING_FALLBACK = _diff_windows(run, batch, iters)
     staged_bytes = sum(x.nbytes for x in jax.tree_util.tree_leaves(batch))
-    return dt, staged_bytes
+    return dt, staged_bytes, stage_s
 
 
 def _diff_windows(run, batch, iters):
@@ -648,14 +679,17 @@ def _bench_q6(sf, iters, platform):
     n = tpch.table_row_count("lineitem", sf)
     capacity = -(-n // 1024) * 1024
     host = tpch.generate_columns("lineitem", sf, Q6_COLUMNS)
-    dt, staged_bytes = _stage_and_time(host, Q6_COLUMNS, capacity,
-                                       q6_local(), iters)
+    dt, staged_bytes, stage_s = _stage_and_time(host, Q6_COLUMNS,
+                                                capacity, q6_local(),
+                                                iters)
     print(json.dumps({
         "metric": f"tpch_sf{sf:g}_q6_rows_per_sec",
         "value": round(n / dt), "unit": "rows/s", "vs_baseline": 0,
         "detail": {"query_wall_s": round(dt, 5), "rows": n,
                    "staged_mb": round(staged_bytes / 1e6, 1),
                    "achieved_gb_per_s": round(staged_bytes / dt / 1e9, 1),
+                   "staging_gb_per_s": round(
+                       staged_bytes / max(stage_s, 1e-9) / 1e9, 3),
                    "timing_fallback": _TIMING_FALLBACK,
                    "platform": platform,
                    "scoring": not platform.startswith("cpu"),

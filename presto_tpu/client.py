@@ -31,6 +31,18 @@ __all__ = ["StatementClient", "QueryError", "execute",
 DEFAULT_DEADLINE_S = 3600.0
 
 
+def _note_drain(nbytes: int, seconds: float) -> None:
+    """Data-path attribution of the statement-protocol result drain
+    (exec/datapath.py `client_drain` hop). Shielded lazy import: this
+    client stays stdlib-operable -- when the engine package is absent
+    or half-imported, the observation drops, never the poll."""
+    try:
+        from .exec.datapath import record_hop
+        record_hop("client_drain", nbytes, seconds)
+    except Exception:  # noqa: BLE001 - stdlib-only deployments
+        pass
+
+
 class QueryError(RuntimeError):
     def __init__(self, error: dict):
         super().__init__(error.get("message", "query failed"))
@@ -105,7 +117,15 @@ class StatementClient:
                                      headers=headers or {})
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                doc = json.loads(resp.read().decode())
+                # time the BODY read only: urlopen returns once headers
+                # land, so everything before (connect + the server-side
+                # queue/execute wait inside a poll) stays out of the
+                # drain hop -- this measures moving result bytes, not
+                # waiting for them to exist
+                t0 = time.time()
+                raw = resp.read()
+                _note_drain(len(raw), time.time() - t0)
+                doc = json.loads(raw.decode())
                 return doc, dict(resp.headers)
         except urllib.error.HTTPError as e:
             if e.code == 307 and follow_307 and e.headers.get("Location"):

@@ -25,7 +25,8 @@ import numpy as np
 
 from .. import types as T
 from ..block import batch_from_numpy
-from .parquet import _column_to_engine, _engine_type, engine_to_arrow
+from .parquet import (_column_to_engine, _engine_type, _record_decode,
+                      engine_to_arrow)
 
 __all__ = ["SCHEMA", "register_table", "unregister_table", "reset",
            "table_row_count", "generate_columns", "generate_nulls",
@@ -107,6 +108,8 @@ def data_version(table: str) -> float:
 def _read(table: str, columns: Sequence[str], start: int, count: int):
     """Read [start, start+count) of the requested columns, decoding only
     the stripes the range touches (stripe = the ORC row-group analog)."""
+    import time as _time
+    t_read0 = _time.time()
     with _lock:
         f = _tables[table]["f"]
         schema = _tables[table]["schema"]
@@ -134,6 +137,7 @@ def _read(table: str, columns: Sequence[str], start: int, count: int):
     for c in columns:
         out[c] = _column_to_engine(whole.column(c).combine_chunks(),
                                    schema[c])
+    _record_decode(out, _time.time() - t_read0)
     return out, schema
 
 

@@ -223,10 +223,27 @@ def _column_to_engine(arr, ty: T.Type) -> Tuple[np.ndarray, np.ndarray]:
     return np.where(nulls, fill, np_vals).astype(ty.to_dtype()), nulls
 
 
+def _record_decode(cols: Dict[str, Tuple[np.ndarray, np.ndarray]],
+                   seconds: float) -> None:
+    """File decode feeds the data-path waterfall's ``decode`` hop
+    (exec/datapath.py) with the decoded engine-array bytes. Shielded:
+    connectors must stay importable in stripped tooling, and
+    attribution must never fail a scan. Shared with the ORC reader."""
+    try:
+        from ..exec.datapath import record_hop
+        record_hop("decode",
+                   sum(v.nbytes + n.nbytes for v, n in cols.values()),
+                   seconds)
+    except Exception:  # noqa: BLE001 - attribution is garnish here
+        pass
+
+
 def _read(table: str, columns: Sequence[str], start: int, count: int,
           predicate=None):
     """Read [start, start+count) of the requested columns, decoding only
     the row groups the range (and the optional predicate) touches."""
+    import time as _time
+    t_read0 = _time.time()
     with _lock:
         pf = _tables[table]["pf"]
         schema = _tables[table]["schema"]
@@ -256,6 +273,7 @@ def _read(table: str, columns: Sequence[str], start: int, count: int,
     for c in columns:
         out[c] = _column_to_engine(whole.column(c).combine_chunks(),
                                    schema[c])
+    _record_decode(out, _time.time() - t_read0)
     return out, schema
 
 

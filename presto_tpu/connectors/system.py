@@ -130,6 +130,14 @@ SCHEMA = {
                 "rows_in": T.BIGINT, "bytes_in": T.BIGINT,
                 "rows_out": T.BIGINT, "bytes_out": T.BIGINT,
                 "retraces": T.BIGINT, "footprint_bytes": T.BIGINT},
+    # data-path waterfall (exec/datapath.py): one row per catalog hop,
+    # data-path order -- lifetime bytes/wall, achieved B/s, the
+    # measured ceiling it rooflines against, and the utilization ratio
+    "datapath": {"hop": _V, "bytes": T.BIGINT, "wall_us": T.BIGINT,
+                 "invocations": T.BIGINT,
+                 "achieved_b_per_s": T.DOUBLE,
+                 "ceiling_b_per_s": T.DOUBLE,
+                 "utilization": T.DOUBLE},
     "session_properties": {"name": _V, "default_value": _V, "type": _V,
                            "description": _V},
     "functions": {"function_name": _V, "kind": _V},
@@ -280,6 +288,12 @@ def _rows_of(table: str) -> List[tuple]:
                         int(r.get("failpointHits", 0)),
                         ",".join(r.get("regressions") or ())))
         return out
+    if table == "datapath":
+        from ..exec.datapath import snapshot as datapath_snapshot
+        return [(r["hop"], int(r["bytes"]), int(r["wall_us"]),
+                 int(r["invocations"]), float(r["achievedBPerS"]),
+                 float(r["ceilingBPerS"]), float(r["utilization"]))
+                for r in datapath_snapshot()]
     if table == "kernels":
         from ..exec.profiler import profile_snapshot
         return [(p["fingerprint"], p["label"], p["tables"],

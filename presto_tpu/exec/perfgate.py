@@ -96,6 +96,14 @@ BENCH_SPECS: Sequence[MetricSpec] = (
     MetricSpec("qps", higher_is_worse=False,
                rel_threshold=0.6, abs_floor=0.0),
     MetricSpec("p99_ms", rel_threshold=0.75, abs_floor=25.0),
+    # the q1 staging rate (exec/datapath.py data-path waterfall; the
+    # ROADMAP item-3 headline): host->HBM GB/s regresses DOWN. Keyed
+    # (metric|platform) like every BENCH entry -- the CPU fallback and
+    # a chip run never share a baseline. Its history starts EMPTY
+    # (unbaselined is reported, not failed) and gates from the first
+    # --update-baseline on.
+    MetricSpec("staging_gb_per_s", higher_is_worse=False,
+               rel_threshold=0.5, abs_floor=0.0),
 )
 
 # MAD -> sigma consistency constant for normally distributed noise

@@ -67,6 +67,15 @@ class QueryResult:
         return sorted(out)
 
 
+def _host_bytes(arrays, nulls=None) -> int:
+    """Host-side byte count of generated columns (object lanes count
+    pointer bytes -- consistent, if conservative, for strings)."""
+    total = sum(getattr(a, "nbytes", 0) for a in arrays)
+    if nulls:
+        total += sum(getattr(n, "nbytes", 0) for n in nulls)
+    return total
+
+
 def stage_scan_split(conn, node: "N.TableScanNode", sf: float, start: int,
                      count: int, capacity: int) -> Batch:
     """Stage one scan split honoring the node's narrow-width annotation
@@ -75,23 +84,52 @@ def stage_scan_split(conn, node: "N.TableScanNode", sf: float, start: int,
     the batch stages at the narrowed physical dtypes -- the shared
     staging path of the runner and the streaming executor. Falls back
     to the connector's own generate_batch when the node carries no
-    width annotation (or the connector can't produce host columns)."""
+    width annotation (or the connector can't produce host columns).
+
+    Every path records its data-path hops (exec/datapath.py):
+    connector_read (host column materialization), narrow_cast (the
+    staging-time range re-proof), device_put (host -> HBM staging,
+    the bytes QueryStats' staging stage counts)."""
+    from .datapath import record_hop, timed_hop
+    from .memory import batch_bytes
     phys = getattr(node, "physical_dtypes", None)
     if not phys or not any(phys) or not hasattr(conn, "generate_columns"):
-        return conn.generate_batch(node.table, sf, node.columns,
-                                   start=start, count=count,
-                                   capacity=capacity)
+        # the connector stages straight to a device batch: the whole
+        # read+put attributes to connector_read (coarse by design --
+        # connectors wanting finer hops expose generate_columns)
+        t0 = time.time()
+        b = conn.generate_batch(node.table, sf, node.columns,
+                                start=start, count=count,
+                                capacity=capacity)
+        record_hop("connector_read", batch_bytes(b), time.time() - t0)
+        return b
     from ..plan.widths import checked_physical_dtypes
-    data = conn.generate_columns(node.table, sf, node.columns, start, count)
-    arrays = [data[c] for c in node.columns]
-    nulls = None
-    if hasattr(conn, "generate_nulls"):
-        nmap = conn.generate_nulls(node.table, node.columns, start, count)
-        nulls = [nmap[c] for c in node.columns]
-    checked = checked_physical_dtypes(phys, node.column_types, arrays,
-                                      nulls=nulls)
-    return batch_from_numpy(node.column_types, arrays, nulls=nulls,
-                            capacity=capacity, physical_dtypes=checked)
+    with timed_hop("connector_read") as t_read:
+        data = conn.generate_columns(node.table, sf, node.columns,
+                                     start, count)
+        arrays = [data[c] for c in node.columns]
+        nulls = None
+        if hasattr(conn, "generate_nulls"):
+            nmap = conn.generate_nulls(node.table, node.columns, start,
+                                       count)
+            nulls = [nmap[c] for c in node.columns]
+        t_read.bytes = _host_bytes(arrays, nulls)
+    with timed_hop("narrow_cast", t_read.bytes):
+        checked = checked_physical_dtypes(phys, node.column_types, arrays,
+                                          nulls=nulls)
+    with timed_hop("device_put") as t_put:
+        b = batch_from_numpy(node.column_types, arrays, nulls=nulls,
+                             capacity=capacity, physical_dtypes=checked)
+        # sync so the measured wall is the transfer, not the async
+        # dispatch returning early (bench.py learned this on the
+        # chip). The staging loop is synchronous today (stage ->
+        # execute, ROADMAP item 3) and the caller host-reads
+        # b.active immediately after, so this adds no real
+        # serialization; item 3's producer/consumer pipeline will
+        # record this hop from its prefetch threads instead.
+        jax.block_until_ready(b)
+        t_put.bytes = batch_bytes(b)
+    return b
 
 
 def _scan_batch(node: N.PlanNode, sf: float, capacity_hint: Optional[int],
@@ -134,9 +172,12 @@ def _scan_batch(node: N.PlanNode, sf: float, capacity_hint: Optional[int],
         # dynamic filtering: prune fact rows host-side BEFORE they are
         # staged into HBM (DynamicFilterSourceOperator pushdown; the
         # win here is smaller staged shapes)
+        from .datapath import timed_hop
         from .dynfilter import apply_dynamic_filters
-        data = conn.generate_columns(node.table, sf, node.columns,
-                                     start, count)
+        with timed_hop("connector_read") as t_read:
+            data = conn.generate_columns(node.table, sf, node.columns,
+                                         start, count)
+            t_read.bytes = _host_bytes(list(data.values()))
         keep, pruned = apply_dynamic_filters(data, node.columns,
                                              dyn_filters)
         if stats is not None:
@@ -154,18 +195,34 @@ def _scan_batch(node: N.PlanNode, sf: float, capacity_hint: Optional[int],
         phys = getattr(node, "physical_dtypes", None)
         if phys and any(phys):
             from ..plan.widths import checked_physical_dtypes
-            phys = checked_physical_dtypes(phys, tys, arrays, nulls=nulls)
-        return batch_from_numpy(tys, arrays, capacity=cap, nulls=nulls,
-                                physical_dtypes=phys or None)
+            with timed_hop("narrow_cast", _host_bytes(arrays, nulls)):
+                phys = checked_physical_dtypes(phys, tys, arrays,
+                                               nulls=nulls)
+        from .memory import batch_bytes
+        with timed_hop("device_put") as t_put:
+            b = batch_from_numpy(tys, arrays, capacity=cap, nulls=nulls,
+                                 physical_dtypes=phys or None)
+            jax.block_until_ready(b)
+            t_put.bytes = batch_bytes(b)
+        return b
     cap = capacity_hint or max(-(-count // pad_multiple) * pad_multiple,
                                pad_multiple)
     if node.pushdown is not None and scan_range is None \
             and hasattr(conn, "row_groups_matching"):
         # connector statistics pruning: skip row groups the pushed-down
-        # range provably excludes (the exact Filter still runs above)
-        return conn.generate_batch(node.table, sf, node.columns,
-                                   start=start, count=count, capacity=cap,
-                                   predicate=tuple(node.pushdown))
+        # range provably excludes (the exact Filter still runs above).
+        # Coarse datapath attribution like stage_scan_split's fallback:
+        # the connector stages straight to device, so the whole
+        # read+put attributes to connector_read (the ledger must never
+        # show zero bytes for a staged scan)
+        from .datapath import record_hop
+        from .memory import batch_bytes
+        t0 = time.time()
+        b = conn.generate_batch(node.table, sf, node.columns,
+                                start=start, count=count, capacity=cap,
+                                predicate=tuple(node.pushdown))
+        record_hop("connector_read", batch_bytes(b), time.time() - t0)
+        return b
     return stage_scan_split(conn, node, sf, start, count, cap)
 
 
@@ -273,18 +330,28 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     ``query_id`` (exec/progress.py): monotonic stage/splits/rows/bytes
     counters an in-flight status poll, ``GET /v1/cluster`` and the
     stuck-progress watchdog read while the query is still RUNNING.
-    Nested invocations (write roots) share their outer scope's entry."""
+    Nested invocations (write roots) share their outer scope's entry.
+
+    A per-query datapath ledger (exec/datapath.py) is ambient for the
+    whole invocation: every instrumented hop on this thread
+    (connector read, decode, narrow cast, device put, kernel, serde)
+    attributes to THIS query; nested invocations (write roots' inner
+    SELECTs) shadow-and-restore like the progress entry."""
+    from .datapath import DatapathLedger
+    from .datapath import recording as _dp_recording
     from .progress import begin as _progress_begin
     prog = _progress_begin(query_id)
+    dp = DatapathLedger()
     try:
-        res = _run_query_inner(
-            root, sf=sf, mesh=mesh, capacity_hints=capacity_hints,
-            default_join_capacity=default_join_capacity,
-            split_rows=split_rows, scan_ranges=scan_ranges,
-            remote_sources=remote_sources, memory_pool=memory_pool,
-            query_id=query_id, session=session,
-            hbm_budget_bytes=hbm_budget_bytes, prepared=prepared,
-            trace_id=trace_id, prog=prog)
+        with _dp_recording(dp):
+            res = _run_query_inner(
+                root, sf=sf, mesh=mesh, capacity_hints=capacity_hints,
+                default_join_capacity=default_join_capacity,
+                split_rows=split_rows, scan_ranges=scan_ranges,
+                remote_sources=remote_sources, memory_pool=memory_pool,
+                query_id=query_id, session=session,
+                hbm_budget_bytes=hbm_budget_bytes, prepared=prepared,
+                trace_id=trace_id, prog=prog, dp=dp)
     except BaseException:
         prog.release(state="FAILED")
         raise
@@ -303,7 +370,7 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
                      session=None,
                      hbm_budget_bytes: Optional[int] = None,
                      prepared: bool = False,
-                     trace_id=None, prog=None) -> QueryResult:
+                     trace_id=None, prog=None, dp=None) -> QueryResult:
     # write/DDL roots execute their source on device, then write
     # host-side (TableWriterOperator.java:76 analog -- the sink is a
     # host effect, fed by one DMA-out of the computed rows)
@@ -366,7 +433,7 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
                     res = _batch_to_result(out_b, root)
                     res.stats = stats.snapshot()
                     _finalize_query_stats(collector, res, t_query0, 0,
-                                          root, trace_id)
+                                          root, trace_id, dp=dp)
                     return res
             with stats.timed("streaming_exec_s"), collecting(collector), \
                     collector.stage("execute"):
@@ -382,7 +449,7 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
             res = _batch_to_result(out_b, root)
             res.stats = stats.snapshot()
             _finalize_query_stats(collector, res, t_query0, 0, root,
-                                  trace_id)
+                                  trace_id, dp=dp)
             return res
     pad = (mesh.devices.size if mesh is not None else 1) * 8
     hints = capacity_hints or {}
@@ -650,6 +717,23 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
                 record_event("fusion_demotion", query_id=query_id,
                              reason="profiler",
                              ratio=verdict.get("ratio"))
+        # kernel hop (exec/datapath.py): the compiled program's dispatch
+        # wall over the bytes it read -- the data-path waterfall's
+        # device-side rung, bounded by the device_put ceiling proxy.
+        # XLA compile is SUBTRACTED (same correction the profiler and
+        # the fusion comparator apply above): a cold dispatch's 1-2s
+        # compile would otherwise read as <1% utilization and misname
+        # 'kernel' as the bottleneck on every fresh query. Bytes scale
+        # with the DISPATCH count (device_s sums every overflow
+        # rerun's wall, and each rerun re-reads the staged inputs) so
+        # a capacity-rescaled query's achieved rate stays honest.
+        from .datapath import record_hop as _dp_record
+        _snap = stats.snapshot()
+        _dispatches = 1 + \
+            int(_snap.get("capacity_reruns", {}).get("total", 0)) + \
+            int(_snap.get("exchange_slot_reruns", {}).get("total", 0))
+        _dp_record("kernel", staged_bytes * _dispatches,
+                   max(device_s - (compile_us or 0) / 1e6, 0.0))
         if prog is not None:
             prog.advance(stage="fetch")
         with stats.timed("fetch_s"), collector.stage("fetch"):
@@ -686,7 +770,7 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
     stats.add("output_rows", res.row_count)
     res.stats = stats.snapshot()
     _finalize_query_stats(collector, res, t_query0, peak_reserved, root,
-                          trace_id)
+                          trace_id, dp=dp)
     return res
 
 
@@ -955,11 +1039,20 @@ def _result_bytes(res: "QueryResult") -> int:
 def _finalize_query_stats(collector: StatsCollector, res: "QueryResult",
                           t0: float, peak_reserved_bytes: int,
                           root: Optional[N.PlanNode],
-                          trace_id=None) -> None:
+                          trace_id=None, dp=None) -> None:
     """Close out the structured stats for one run_query invocation and
     emit one tracer span per collected stage. `peak_reserved_bytes` is
-    the pool high-water mark the caller already drained."""
+    the pool high-water mark the caller already drained. `dp` is the
+    invocation's datapath ledger: its hop map rides QueryStats.datapath
+    (stitching worker slices through the task-status path) and the
+    bounded per-query registry flight dumps embed from."""
     qs = collector.stats
+    if dp is not None:
+        from .datapath import merge_hop_maps, note_query
+        hops = dp.snapshot_hops()
+        if hops:
+            qs.datapath = merge_hop_maps(qs.datapath, hops)
+            note_query(collector.query_id, hops)
     # drain any compile time not yet attributed (the streaming/spill
     # early-return paths compile inside their execute stage and never
     # reach the main path's drain); same clamp + anchor as there

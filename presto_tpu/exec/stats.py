@@ -43,6 +43,9 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from .datapath import HopStats, hop_map_from_json, hop_map_to_json, \
+    merge_hop_maps
+
 __all__ = ["RuntimeStats", "timed", "OperatorStats", "StageStats",
            "QueryStats", "StatsCollector", "current_collector",
            "collecting"]
@@ -200,6 +203,11 @@ class QueryStats:
     # free-form summed counters (exchange collective counts noted at
     # trace time, cache hits, ...); merged by addition
     counters: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # per-hop data-path ledger (exec/datapath.py): bytes/wall per hop,
+    # merged by HopStats' own sums-add/maxes-max law -- this is how a
+    # worker's hop slice stitches to the coordinator's through the
+    # existing task-status path
+    datapath: Dict[str, HopStats] = dataclasses.field(default_factory=dict)
 
     # -- convenience accessors (the EXPLAIN ANALYZE / CLI summary view) --
 
@@ -232,7 +240,8 @@ class QueryStats:
             peak_memory_bytes=max(self.peak_memory_bytes,
                                   other.peak_memory_bytes),
             task_count=self.task_count + other.task_count,
-            stages=stages, operators=operators, counters=counters)
+            stages=stages, operators=operators, counters=counters,
+            datapath=merge_hop_maps(self.datapath, other.datapath))
 
     def to_json(self) -> dict:
         return {"wallUs": self.wall_us,
@@ -243,7 +252,8 @@ class QueryStats:
                 "stages": {k: s.to_json() for k, s in self.stages.items()},
                 "operators": {k: o.to_json()
                               for k, o in self.operators.items()},
-                "counters": dict(self.counters)}
+                "counters": dict(self.counters),
+                "datapath": hop_map_to_json(self.datapath)}
 
     @classmethod
     def from_json(cls, doc: dict) -> "QueryStats":
@@ -258,7 +268,8 @@ class QueryStats:
             operators={k: OperatorStats.from_json(o)
                        for k, o in doc.get("operators", {}).items()},
             counters={k: int(v)
-                      for k, v in doc.get("counters", {}).items()})
+                      for k, v in doc.get("counters", {}).items()},
+            datapath=hop_map_from_json(doc.get("datapath", {})))
 
     def summary(self) -> str:
         """One-paragraph human summary (the CLI --stats shape)."""

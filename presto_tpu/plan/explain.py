@@ -286,6 +286,7 @@ def explain_analyze(root: N.PlanNode, sf: float = 0.01, **kwargs) -> str:
     else:
         lines += ["", f"output rows: {res.row_count}"]
     lines.extend(_kernel_lines(executed, session))
+    lines.extend(_datapath_lines(qs))
     # the flat named counters keep their historical tail section
     if res.stats:
         lines += ["", "-- runtime counters --"]
@@ -329,6 +330,47 @@ def _kernel_lines(executed: N.PlanNode, session,
             f"retraces={r['retraces']} rows_out={r['rows_out']} "
             f"{r['label']}{marker}")
     return lines
+
+
+def _datapath_lines(qs) -> List[str]:
+    """EXPLAIN ANALYZE's data-path waterfall tail (exec/datapath.py):
+    one line per hop THIS query exercised -- bytes, wall, achieved
+    rate, utilization of the hop's measured ceiling -- closed by the
+    named bottleneck verdict (the hop with max wall share below band).
+    The first call in a process pays the one-shot ceilings probe."""
+    try:
+        from ..exec.datapath import (HOP_CEILING, HOPS, achieved_b_per_s,
+                                     bottleneck_verdict, probe_ceilings)
+        if qs is None or not qs.datapath:
+            return []
+        ceilings = probe_ceilings()
+        lines = ["", "-- datapath --"]
+        total_wall = sum(h.wall_us for h in qs.datapath.values())
+        for hop in HOPS:
+            h = qs.datapath.get(hop)
+            if h is None:
+                continue
+            achieved = achieved_b_per_s(h.bytes, h.wall_us)
+            ceiling = ceilings.get(HOP_CEILING.get(hop, ""), 0.0)
+            util = achieved / ceiling if ceiling > 0 else 0.0
+            share = h.wall_us / total_wall if total_wall else 0.0
+            lines.append(
+                f"{hop}: bytes={_fmt_bytes(h.bytes)} "
+                f"wall={h.wall_us}us ({share:.0%}) "
+                f"rate={achieved / 1e9:.3f}GB/s "
+                f"util={util:.0%} of {HOP_CEILING.get(hop, '?')}")
+        verdict = bottleneck_verdict(qs.datapath, ceilings)
+        if verdict is not None:
+            qual = "below band" if verdict["belowBand"] else \
+                "at ceiling; largest wall share"
+            lines.append(
+                f"bottleneck: {verdict['hop']} "
+                f"(wall share {verdict['wallShare']:.0%}, "
+                f"util {verdict['utilization']:.0%}, {qual})")
+        return lines
+    except Exception:  # noqa: BLE001 - the waterfall is garnish here;
+        # EXPLAIN ANALYZE output must never fail on it
+        return []
 
 
 def explain_distributed(root: N.PlanNode) -> str:

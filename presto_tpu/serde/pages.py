@@ -365,15 +365,21 @@ def serialize_batch(batch: Batch, codec: PageCodec = PageCodec()) -> bytes:
     return serialize_page(cols, codec)
 
 
-def _observe_serde(op: str, seconds: float) -> None:
+def _observe_serde(op: str, seconds: float, nbytes: int = 0) -> None:
     """Page serde work feeds the shared /v1/metrics histogram registry
-    (per-page serialize/deserialize latency on both tiers). Import is
-    deferred and shielded: serde loads before the server package during
-    bootstrap, and timing must never fail a page."""
+    (per-page serialize/deserialize latency on both tiers) AND the
+    data-path waterfall (exec/datapath.py): serialization is the
+    ``exchange_serialize`` hop, deserialization the ``decode`` hop,
+    each carrying the page's wire bytes. Imports are deferred and
+    shielded: serde loads before the server package during bootstrap,
+    and attribution must never fail a page."""
     try:
         from ..server.metrics import observe_histogram
         observe_histogram("presto_tpu_page_serde_seconds", seconds,
                           labels={"op": op})
+        from ..exec.datapath import record_hop
+        record_hop("exchange_serialize" if op == "serialize"
+                   else "decode", nbytes, seconds)
     except Exception:  # noqa: BLE001 - interpreter teardown / circular
         # bootstrap import: drop the observation, never the page
         pass
@@ -421,7 +427,7 @@ def serialize_page(columns: Sequence[Tuple[T.Type, np.ndarray, np.ndarray]],
         # corrupt_page flips payload bytes AFTER the checksum stamp, so
         # the consumer's checksum validation is what catches it
         page = failpoints.hit("serde.serialize", page)
-    _observe_serde("serialize", time.time() - t_page0)
+    _observe_serde("serialize", time.time() - t_page0, len(page))
     return page
 
 
@@ -460,7 +466,12 @@ def deserialize_page(buf: bytes, types: Sequence[T.Type],
         ty = types[ci] if ci < len(types) else None
         (vals, nulls), pos = _deserialize_block(mv, pos, ty)
         out.append((vals, nulls))
-    _observe_serde("deserialize", time.time() - t_page0)
+    # decode-hop bytes are the DECODED engine arrays (same unit the
+    # parquet/ORC readers record): wire bytes may be zstd-compressed,
+    # and mixing encoded and decoded bytes in one hop would make its
+    # achieved B/s a meaningless blend
+    _observe_serde("deserialize", time.time() - t_page0,
+                   sum(v.nbytes + n.nbytes for v, n in out))
     return out
 
 

@@ -146,6 +146,13 @@ class WorkerClient:
         data, _ = self._request("GET", "/v1/history")
         return json.loads(data)
 
+    def datapath(self) -> dict:
+        """The worker's per-hop data-path slice (GET /v1/datapath),
+        pulled over the same authenticated transport as profile() so
+        the statement tier's cluster merge works on secured clusters."""
+        data, _ = self._request("GET", "/v1/datapath")
+        return json.loads(data)
+
     def status(self) -> dict:
         """The worker's enriched NodeStatus (GET /v1/status): liveness,
         uptime, version, running tasks, memory-pool occupancy -- the

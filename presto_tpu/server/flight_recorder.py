@@ -153,6 +153,7 @@ class FlightRecorder:
             os.makedirs(self.dump_dir, exist_ok=True)
             events = self.events(query_id=key)
             kernels = self._profile_of(key)
+            datapath = self._datapath_of(key)
             with open(path, "w") as f:
                 f.write(json.dumps(
                     {"dump": {"key": key, "reason": reason,
@@ -167,6 +168,13 @@ class FlightRecorder:
                     f.write(json.dumps(
                         {"profile": {"queryId": key,
                                      "kernels": kernels}}) + "\n")
+                if datapath:
+                    # the data-path waterfall of THIS query (per-hop
+                    # bytes/wall): a slow-query dump answers "which
+                    # hop" offline, without a live /v1/datapath to ask
+                    f.write(json.dumps(
+                        {"datapath": {"queryId": key,
+                                      "hops": datapath}}) + "\n")
                 for evt in events:
                     f.write(json.dumps(evt, default=str) + "\n")
         except Exception as e:  # noqa: BLE001 - a full disk must not
@@ -212,6 +220,19 @@ class FlightRecorder:
             with _COUNTERS_LOCK:
                 _EVICTED_TOTAL["count"] += evicted
         return evicted
+
+    @staticmethod
+    def _datapath_of(key: str) -> dict:
+        """This query's per-hop ledger (best-effort, like the profile
+        embed)."""
+        try:
+            from ..exec.datapath import datapath_for_query
+            return datapath_for_query(key)
+        except Exception as e:  # noqa: BLE001 - the dump must land
+            # even when the ledger is broken; count the gap
+            from .metrics import record_suppressed
+            record_suppressed("flight_recorder", "datapath_snapshot", e)
+            return {}
 
     @staticmethod
     def _profile_of(key: str) -> List[dict]:

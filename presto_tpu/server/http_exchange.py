@@ -79,6 +79,11 @@ def fetch_remote_batch(sources: Sequence[str], task_ids: Sequence[str],
     all_cols: List[List[np.ndarray]] = [[] for _ in types]
     all_nulls: List[List[np.ndarray]] = [[] for _ in types]
     total = 0
+    # wall spent actually MOVING pages (fetch + decode + restage below)
+    # vs waiting for upstreams to finish computing: the datapath hop
+    # records only the former -- attributing an upstream's 5s kernel
+    # to the network rung would misname every distributed verdict
+    move_s = 0.0
     for base, tid in zip(sources, task_ids):
         client = WorkerClient(base, timeout=timeout)
         info = client.wait(tid, timeout=timeout)
@@ -87,14 +92,17 @@ def fetch_remote_batch(sources: Sequence[str], task_ids: Sequence[str],
             # silently partial result (RemoteTask error propagation)
             raise RuntimeError(f"upstream task {tid} at {base} is "
                               f"{info['state']}: {info.get('error')}")
+        t_pull0 = time.time()
         cols = client.fetch_results(tid, types, codec, buffer_id=buffer_id,
                                     ack=ack)
+        move_s += time.time() - t_pull0
         n = len(cols[0][0]) if cols else 0
         total += n
         for c, (v, m) in enumerate(cols):
             if len(v):  # skip empty pages: their default dtype would
                 all_cols[c].append(v)  # poison the concatenated dtype
                 all_nulls[c].append(m)
+    t_stage0 = time.time()
     arrays = []
     nulls = []
     for c, ty in enumerate(types):
@@ -118,4 +126,13 @@ def fetch_remote_batch(sources: Sequence[str], task_ids: Sequence[str],
     observe_histogram("presto_tpu_exchange_fetch_seconds",
                       time.time() - t_fetch0,
                       trace_id=ctx.trace_id if ctx else None)
+    # data-path waterfall: pull+decode+restage wall ONLY -- the
+    # upstream-completion wait above is excluded (page decode inside
+    # this window records its own `decode` hop too; hops overlap by
+    # design, they are independent attributions, not a partition)
+    from ..exec.datapath import record_hop
+    record_hop("exchange_fetch",
+               sum(a.nbytes for a in arrays) +
+               sum(m.nbytes for m in nulls),
+               move_s + (time.time() - t_stage0))
     return out

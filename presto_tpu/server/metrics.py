@@ -39,6 +39,7 @@ from typing import Dict, List, Optional, Tuple, Union
 from ..utils.locks import OrderedLock
 
 __all__ = ["MetricFamily", "Histogram", "DEFAULT_BUCKETS",
+           "SIZE_BUCKETS", "datapath_families",
            "observe_histogram", "get_histogram", "histogram_families",
            "reset_histograms",
            "render_prometheus", "parse_prometheus",
@@ -69,6 +70,15 @@ _LabelSample = Tuple[Dict[str, str], Union[int, float]]
 DEFAULT_BUCKETS: Tuple[float, ...] = (
     0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0)
+
+# The bytes-oriented ladder beside the time ladder: 1 KiB -> 4 GiB,
+# log-spaced (powers of 4), so page/batch/payload SIZE distributions
+# have somewhere to land -- a page-size histogram forced onto the
+# seconds ladder would put every sample in +Inf. Fixed bounds keep
+# Histogram.merge elementwise-add associative+commutative across
+# workers, same law, same exemplar contract as the time ladder.
+SIZE_BUCKETS: Tuple[float, ...] = tuple(
+    float(1024 * 4 ** i) for i in range(12))  # 1KiB .. 4GiB
 
 
 class Histogram:
@@ -331,6 +341,24 @@ _DECLARED_HISTOGRAMS: Dict[str, Tuple[str, Tuple[Dict[str, str], ...]]] = {
             {"op": s} for s in ("serialize", "deserialize"))),
     "presto_tpu_task_seconds": (
         "worker task lifetime (create -> terminal)", ({},)),
+    # the data-path waterfall's per-hop payload-size distribution
+    # (exec/datapath.py record_hop): SIZE_BUCKETS ladder, one series
+    # per catalog hop. The label values are spelled literally (like
+    # every closed vocabulary above); tests pin them to datapath.HOPS.
+    "presto_tpu_datapath_bytes": (
+        "per-hop data-path payload size (bytes ladder; "
+        "exec/datapath.py hop catalog)",
+        tuple({"hop": h} for h in
+              ("connector_read", "decode", "narrow_cast", "device_put",
+               "kernel", "exchange_serialize", "exchange_fetch",
+               "client_drain"))),
+}
+
+# histogram families whose observations are NOT seconds use their own
+# fixed ladder (one scheme per family name: merge stays lawful because
+# every instance of a name shares the same bounds)
+_BUCKET_SCHEMES: Dict[str, Tuple[float, ...]] = {
+    "presto_tpu_datapath_bytes": SIZE_BUCKETS,
 }
 
 
@@ -341,13 +369,15 @@ def _hist_key(name: str, labels: Optional[Dict[str, str]]
 
 def get_histogram(name: str, labels: Optional[Dict[str, str]] = None
                   ) -> Histogram:
-    """The named histogram (created on first use; fixed default
-    buckets so every instance merges with every other)."""
+    """The named histogram (created on first use; fixed buckets per
+    family name -- the time ladder unless _BUCKET_SCHEMES declares a
+    size ladder -- so every instance merges with every other)."""
     key = _hist_key(name, labels)
     with _HIST_LOCK:
         h = _HISTOGRAMS.get(key)
         if h is None:
-            h = _HISTOGRAMS[key] = Histogram()
+            h = _HISTOGRAMS[key] = Histogram(
+                _BUCKET_SCHEMES.get(name, DEFAULT_BUCKETS))
         return h
 
 
@@ -379,8 +409,10 @@ def histogram_families() -> List[MetricFamily]:
         keys |= {lk for n, lk in live if n == name}
         for lk in sorted(keys):
             labels = dict(lk)
-            fam.add_histogram(live.get((name, lk)) or Histogram(),
-                              labels)
+            fam.add_histogram(
+                live.get((name, lk)) or
+                Histogram(_BUCKET_SCHEMES.get(name, DEFAULT_BUCKETS)),
+                labels)
         fams.append(fam)
     return fams
 
@@ -434,6 +466,34 @@ def batching_families() -> List[MetricFamily]:
                      "queries per dispatch of the last formed "
                      "batch").add(t["last_batch_size"]),
     ]
+
+
+def datapath_families() -> List[MetricFamily]:
+    """Data-path waterfall lifetime totals (exec/datapath.py), exported
+    by BOTH tiers with a stable zero shape: per-hop bytes moved and
+    wall burned -- the counters whose scrape-window ratio IS the hop's
+    achieved B/s, beside the SIZE_BUCKETS distribution the histogram
+    registry already renders."""
+    from ..exec.datapath import HOPS, process_totals
+    totals = process_totals()
+    fam_b = MetricFamily(
+        "presto_tpu_datapath_bytes_total", "counter",
+        "bytes attributed per data-path hop "
+        "(exec/datapath.py; see DESIGN.md 'Data-path attribution')")
+    fam_s = MetricFamily(
+        "presto_tpu_datapath_seconds_total", "counter",
+        "wall attributed per data-path hop (bytes/seconds ratio over "
+        "a scrape window = the hop's achieved throughput)")
+    fam_i = MetricFamily(
+        "presto_tpu_datapath_observations_total", "counter",
+        "hop observations recorded (splits staged, pages coded, "
+        "fetches, drains)")
+    for hop in HOPS:
+        h = totals[hop]
+        fam_b.add(h.bytes, {"hop": hop})
+        fam_s.add(round(h.wall_us / 1e6, 6), {"hop": hop})
+        fam_i.add(h.invocations, {"hop": hop})
+    return [fam_b, fam_s, fam_i]
 
 
 def narrowing_families() -> List[MetricFamily]:

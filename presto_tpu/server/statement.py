@@ -945,7 +945,22 @@ class StatementServer:
                                if w.get("fleetState") == "DEAD"),
             "workersUnannounced": len(all_urls) - len(urls),
             "stuckQueriesTotal": stuck_totals(),
+            # data-path staging rate + cached bottleneck hop (the ptop
+            # header; a cluster frame never pays the ceilings probe)
+            "datapath": self._datapath_summary(),
         }
+
+    def _datapath_summary(self) -> dict:
+        """The cheap per-frame datapath embed (never fails the fleet
+        overview)."""
+        try:
+            from ..exec.datapath import staging_summary
+            return staging_summary()
+        except Exception as e:  # noqa: BLE001 - introspection must not
+            # take down the fleet overview
+            from .metrics import record_suppressed
+            record_suppressed("statement", "datapath_summary", e)
+            return {}
 
     def _batching_doc(self) -> dict:
         """The batching executor's live snapshot for /v1/cluster
@@ -1009,7 +1024,8 @@ class StatementServer:
                "largest per-query peak memory seen").add(
                    totals["peak_memory_bytes"]),
         ]
-        from .metrics import (batching_families, failpoint_families,
+        from .metrics import (batching_families, datapath_families,
+                              failpoint_families,
                               fleet_families, flight_recorder_families,
                               histogram_families, kernel_audit_families,
                               live_introspection_families,
@@ -1025,6 +1041,7 @@ class StatementServer:
         fams.extend(fleet_families(workers_draining=draining))
         fams.extend(plan_cache_families())
         fams.extend(narrowing_families())
+        fams.extend(datapath_families())
         fams.extend(batching_families())
         fams.extend(suppressed_error_families())
         fams.extend(tracing_families())
@@ -1052,6 +1069,15 @@ class StatementServer:
         like the profile merge."""
         from .history import cluster_history_doc
         return cluster_history_doc(self._worker_urls())
+
+    def datapath_doc(self) -> dict:
+        """Cluster-merged per-hop data-path ledger for GET
+        /v1/datapath: this process's slice plus every configured
+        worker's, folded by hop (exec/datapath.py; processId dedup
+        keeps an in-process worker from double-counting, exactly like
+        the profile merge)."""
+        from ..exec.datapath import cluster_datapath_doc
+        return cluster_datapath_doc(self._worker_urls())
 
     def _worker_urls(self) -> list:
         """The worker base URLs the cluster-merged surfaces
@@ -1202,6 +1228,11 @@ def _make_handler(server: StatementServer):
                 # cluster-merged per-kernel device-time table (the
                 # continuous profiler's coordinator surface)
                 self._send(server.profile_doc())
+                return
+            if parts == ["v1", "datapath"]:
+                # cluster-merged per-hop byte/throughput ledger with
+                # roofline bottleneck verdicts (exec/datapath.py)
+                self._send(server.datapath_doc())
                 return
             if parts == ["v1", "history"]:
                 # cluster-merged completed-query archive (the perf

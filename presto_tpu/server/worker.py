@@ -998,8 +998,9 @@ class _Handler(BaseHTTPRequestHandler):
                            f"lifetime {k}").add(counters[k]))
         fams.extend(plan_cache_families())
         fams.extend(narrowing_families())
-        from .metrics import batching_families
+        from .metrics import batching_families, datapath_families
         fams.extend(batching_families())
+        fams.extend(datapath_families())
         from .metrics import (failpoint_families,
                               flight_recorder_families,
                               histogram_families, kernel_audit_families,
@@ -1058,6 +1059,12 @@ class _Handler(BaseHTTPRequestHandler):
             # pulls + merges these cluster-wide; exec/profiler.py)
             from ..exec.profiler import profile_doc
             return self._send_json(profile_doc())
+        if parts == ["v1", "datapath"]:
+            # this worker's per-hop data-path slice (the statement
+            # tier pulls + merges these cluster-wide, same path shape;
+            # exec/datapath.py)
+            from ..exec.datapath import datapath_doc
+            return self._send_json(datapath_doc())
         if parts == ["v1", "history"]:
             # this process's completed-query archive slice (the
             # statement tier merges these cluster-wide like /v1/profile;

@@ -83,7 +83,8 @@ def load_artifact(path: str) -> Tuple[str, Dict[str, float], dict]:
     metrics: Dict[str, float] = {}
     if isinstance(parsed.get("value"), (int, float)):
         metrics["rows_per_sec"] = float(parsed["value"])
-    for name in ("query_wall_s", "staged_mb", "qps", "p99_ms"):
+    for name in ("query_wall_s", "staged_mb", "qps", "p99_ms",
+                 "staging_gb_per_s"):
         v = detail.get(name)
         if isinstance(v, (int, float)):
             metrics[name] = float(v)

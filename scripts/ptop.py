@@ -73,6 +73,14 @@ def render(doc: dict) -> str:
         f"tasks {doc.get('liveTasks', 0)}  "
         f"{doc.get('rowsPerSecond', 0):.0f} rows/s  "
         f"stuck {doc.get('stuckQueriesTotal', 0)}")
+    # cluster staging rate (the data-path waterfall's device_put hop:
+    # host->HBM GB/s) + the bottleneck hop when ceilings were probed
+    dp = doc.get("datapath") or {}
+    if dp:
+        bn = dp.get("bottleneck")
+        lines.append(
+            f"staging {dp.get('stagingGbPerS', 0.0):.3f} GB/s"
+            + (f"  bottleneck {bn}" if bn else ""))
     lines.append("-" * 78)
     running = doc.get("runningQueries", [])
     if not running:
@@ -87,11 +95,18 @@ def render(doc: dict) -> str:
         # their originals show beside the bar
         spec = prog.get("speculativeTasks", 0)
         spec_s = f" spec:{spec}" if spec else ""
+        # achieved GB/s: the query's cumulative processed bytes over
+        # its TOTAL elapsed wall (queue + compile included) -- a
+        # processed-bytes throughput, coarser than the per-hop rates
+        # /v1/datapath serves, but live per query
+        gbps = float(prog.get("bytes", 0)) / \
+            max(float(rq.get("elapsedMs", 0)) / 1000.0, 1e-3) / 1e9
         lines.append(
             f"{rq.get('queryId', '?'):<26} {rq.get('state', '?'):<9} "
             f"{_bar(pct)} {pct:5.1f}%  "
             f"{prog.get('stage', '-'):<8} "
-            f"rows {int(prog.get('rows', 0)):>10,}{age_s}{spec_s}")
+            f"rows {int(prog.get('rows', 0)):>10,} "
+            f"{gbps:6.3f}GB/s{age_s}{spec_s}")
         lines.append(f"  {rq.get('query', '')[:74]}")
     lines.append("-" * 78)
     # resource-group rows (latency-class admission): per-group queue

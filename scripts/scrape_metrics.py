@@ -102,6 +102,13 @@ LOCK_FAMILIES = (
     "presto_tpu_lock_witness_armed",
 )
 
+# data-path waterfall (exec/datapath.py): its own always-present
+# section, zeros included -- per-hop byte/second deltas (their ratio
+# is the window's achieved B/s per hop) plus the size histogram's
+# bucket-delta p50/p99. "No bytes moved on a hop this window" is an
+# answer a staging-rate investigation needs stated, not implied.
+DATAPATH_FAMILY_PREFIX = "presto_tpu_datapath"
+
 
 _LE_RE = re.compile(r'le="([^"]+)"')
 
@@ -148,7 +155,7 @@ def diff(before: dict, after: dict) -> dict:
     the always-present tracing/flight-recorder section."""
     out = {"counters": {}, "gauges": {}, "tracing": {}, "faults": {},
            "history": {}, "cluster": {}, "fleet": {}, "locks": {},
-           "histograms": {}, "violations": {}}
+           "datapath": {}, "histograms": {}, "violations": {}}
     hist_bases = set()
     for fam, samples in after.items():
         if fam.endswith("_bucket"):
@@ -160,6 +167,7 @@ def diff(before: dict, after: dict) -> dict:
             continue  # folded into the histogram section
         is_counter = fam.endswith("_total")
         is_fault = fam.startswith(FAULT_FAMILY_PREFIX)
+        is_datapath = fam.startswith(DATAPATH_FAMILY_PREFIX)
         is_history = fam in HISTORY_FAMILIES
         is_cluster = fam in CLUSTER_FAMILIES
         is_fleet = fam in FLEET_FAMILIES
@@ -176,6 +184,10 @@ def diff(before: dict, after: dict) -> dict:
                     continue
                 if is_fault:
                     out["faults"][label] = round(delta, 6)
+                elif is_datapath:
+                    # per-hop byte/second deltas, zeros included: the
+                    # window's bytes/seconds ratio is the achieved B/s
+                    out["datapath"][label] = round(delta, 6)
                 elif is_history:
                     out["history"][label] = round(delta, 6)
                 elif is_fleet:
@@ -217,7 +229,13 @@ def diff(before: dict, after: dict) -> dict:
                 out["gauges"][label] = round(val, 6)
     for base in sorted(hist_bases):
         win = _histogram_window(before, after, base)
-        if win:
+        if not win:
+            continue
+        if base.startswith(DATAPATH_FAMILY_PREFIX):
+            # the size histogram's bucket-delta quantiles ride the
+            # datapath section beside the byte deltas (zeros included)
+            out["datapath"][base] = win
+        else:
             out["histograms"][base] = win
     return out
 
