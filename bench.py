@@ -554,6 +554,10 @@ def main():
             # per-hop achieved rates from the data-path waterfall
             # (exec/datapath.py; populated by the run_sql smoke runs)
             "datapath": _datapath_detail(),
+            # per-query estimate-accuracy summary (exec/accuracy.py;
+            # populated by the run_sql smoke runs): worst q-error and
+            # the node that earned it ride every BENCH artifact
+            "accuracy": _accuracy_detail(),
             "hand_built_staged_mb": round(staged_bytes / 1e6, 1),
             "timing_fallback": sql_fallback or _TIMING_FALLBACK,
             "telemetry_smoke_sf001": telemetry_smoke,
@@ -589,6 +593,23 @@ def _datapath_detail():
         rate = h.bytes / (h.wall_us / 1e6) if h.wall_us else 0.0
         out[hop] = {"bytes": h.bytes,
                     "achieved_gb_per_s": round(rate / 1e9, 3)}
+    return out
+
+
+def _accuracy_detail():
+    """Per-unit estimate-accuracy roll-up from the process ledger
+    (exec/accuracy.py) -- record/misestimate counts and the worst
+    q-error per unit, so the BENCH artifact records whether the
+    planner's cardinality/footprint estimates held for this run."""
+    from presto_tpu.exec.accuracy import process_totals
+    out = {}
+    for unit, t in process_totals().items():
+        if not t.get("records"):
+            continue
+        out[unit] = {"records": t["records"],
+                     "under": t["under"], "over": t["over"],
+                     "worst_q_error": round(t["worstQError"], 2),
+                     "worst_node": t["worstNode"]}
     return out
 
 

@@ -138,6 +138,13 @@ SCHEMA = {
                  "achieved_b_per_s": T.DOUBLE,
                  "ceiling_b_per_s": T.DOUBLE,
                  "utilization": T.DOUBLE},
+    # estimate-accuracy observatory (exec/accuracy.py): one row per
+    # (retained query, plan node) -- the planner's estimate beside what
+    # the runtime measured, folded into a q-error with direction
+    "cardinality": {"query_id": _V, "node": _V, "node_type": _V,
+                    "unit": _V, "est": T.DOUBLE, "actual": T.DOUBLE,
+                    "q_error": T.DOUBLE, "direction": _V,
+                    "tasks": T.BIGINT},
     "session_properties": {"name": _V, "default_value": _V, "type": _V,
                            "description": _V},
     "functions": {"function_name": _V, "kind": _V},
@@ -153,7 +160,12 @@ SCHEMA = {
                       "peak_memory_bytes": T.BIGINT,
                       "output_rows": T.BIGINT,
                       "failpoint_hits": T.BIGINT,
-                      "regressions": _V},
+                      "regressions": _V,
+                      # estimate-accuracy columns appended at the END
+                      # (generate_columns indexes SCHEMA order, so new
+                      # columns must not shift existing ones)
+                      "max_q_error": T.DOUBLE,
+                      "misestimated_node": _V},
 }
 
 
@@ -286,7 +298,9 @@ def _rows_of(table: str) -> List[tuple]:
                         int(st.get("peak_memory_bytes", 0)),
                         int(st.get("output_rows", 0)),
                         int(r.get("failpointHits", 0)),
-                        ",".join(r.get("regressions") or ())))
+                        ",".join(r.get("regressions") or ()),
+                        float(st.get("max_q_error", 0.0)),
+                        r.get("misestimatedNode", "")))
         return out
     if table == "datapath":
         from ..exec.datapath import snapshot as datapath_snapshot
@@ -294,6 +308,14 @@ def _rows_of(table: str) -> List[tuple]:
                  int(r["invocations"]), float(r["achievedBPerS"]),
                  float(r["ceilingBPerS"]), float(r["utilization"]))
                 for r in datapath_snapshot()]
+    if table == "cardinality":
+        from ..exec.accuracy import snapshot as accuracy_snapshot
+        return [(r["queryId"], r["node"], r["node_type"], r["unit"],
+                 float(r["est"]) if r["est"] is not None else 0.0,
+                 float(r["actual"]) if r["actual"] is not None else 0.0,
+                 float(r["qError"]) if r["qError"] is not None else 0.0,
+                 r["direction"], int(r["tasks"]))
+                for r in accuracy_snapshot()]
     if table == "kernels":
         from ..exec.profiler import profile_snapshot
         return [(p["fingerprint"], p["label"], p["tables"],

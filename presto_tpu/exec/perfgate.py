@@ -74,6 +74,13 @@ SENTINEL_SPECS: Sequence[MetricSpec] = (
     MetricSpec("staged_bytes", rel_threshold=0.25, abs_floor=1_000_000.0),
     MetricSpec("peak_memory_bytes", rel_threshold=0.5,
                abs_floor=16_000_000.0),
+    # estimate-accuracy drift (exec/accuracy.py worst q-error per
+    # query): a fingerprint whose estimates DEGRADE across runs --
+    # stale connector stats, a data-dependent filter shifting -- fires
+    # here before the misestimate is big enough to move latency. The
+    # abs_floor is in q-error units: drift inside [1x, 3x] never gates
+    # (the planner's UNKNOWN_FILTER_COEFFICIENT guesses live there).
+    MetricSpec("max_q_error", rel_threshold=1.0, abs_floor=3.0),
 )
 
 # What the OFFLINE gate (scripts/perfgate.py) checks per BENCH

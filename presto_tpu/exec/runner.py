@@ -306,6 +306,11 @@ def prepare_plan(root: N.PlanNode, sf: float = 0.01, mesh=None,
     if violations:
         raise ValueError("plan not executable by the TPU engine "
                          f"(PlanChecker): {violations}")
+    # estimate stamping (exec/accuracy.py): every prepared node carries
+    # its planner row estimate, so EXPLAIN and the runtime's
+    # estimate-vs-actual ledger read ONE provenance
+    from .accuracy import stamp_estimates
+    stamp_estimates(root, sf)
     return root
 
 
@@ -337,13 +342,19 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     (connector read, decode, narrow cast, device put, kernel, serde)
     attributes to THIS query; nested invocations (write roots' inner
     SELECTs) shadow-and-restore like the progress entry."""
+    from .accuracy import AccuracyLedger
+    from .accuracy import recording as _acc_recording
     from .datapath import DatapathLedger
     from .datapath import recording as _dp_recording
     from .progress import begin as _progress_begin
     prog = _progress_begin(query_id)
     dp = DatapathLedger()
+    # the per-query estimate-vs-actual ledger (exec/accuracy.py) is
+    # ambient too: measured boundaries (scan outputs, region outputs,
+    # K005 footprint audits) attribute to THIS query's plan nodes
+    acc = AccuracyLedger()
     try:
-        with _dp_recording(dp):
+        with _dp_recording(dp), _acc_recording(acc):
             res = _run_query_inner(
                 root, sf=sf, mesh=mesh, capacity_hints=capacity_hints,
                 default_join_capacity=default_join_capacity,
@@ -351,7 +362,7 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
                 remote_sources=remote_sources, memory_pool=memory_pool,
                 query_id=query_id, session=session,
                 hbm_budget_bytes=hbm_budget_bytes, prepared=prepared,
-                trace_id=trace_id, prog=prog, dp=dp)
+                trace_id=trace_id, prog=prog, dp=dp, acc=acc)
     except BaseException:
         prog.release(state="FAILED")
         raise
@@ -370,7 +381,8 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
                      session=None,
                      hbm_budget_bytes: Optional[int] = None,
                      prepared: bool = False,
-                     trace_id=None, prog=None, dp=None) -> QueryResult:
+                     trace_id=None, prog=None, dp=None,
+                     acc=None) -> QueryResult:
     # write/DDL roots execute their source on device, then write
     # host-side (TableWriterOperator.java:76 analog -- the sink is a
     # host effect, fed by one DMA-out of the computed rows)
@@ -433,7 +445,8 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
                     res = _batch_to_result(out_b, root)
                     res.stats = stats.snapshot()
                     _finalize_query_stats(collector, res, t_query0, 0,
-                                          root, trace_id, dp=dp)
+                                          root, trace_id, dp=dp,
+                                          acc=acc, sf=sf)
                     return res
             with stats.timed("streaming_exec_s"), collecting(collector), \
                     collector.stage("execute"):
@@ -449,7 +462,7 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
             res = _batch_to_result(out_b, root)
             res.stats = stats.snapshot()
             _finalize_query_stats(collector, res, t_query0, 0, root,
-                                  trace_id, dp=dp)
+                                  trace_id, dp=dp, acc=acc, sf=sf)
             return res
     pad = (mesh.devices.size if mesh is not None else 1) * 8
     hints = capacity_hints or {}
@@ -587,6 +600,8 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
         raise
     from .memory import batch_bytes
     from ..plan.widths import batch_narrowed_bytes_saved, note_narrowed
+    from .accuracy import est_rows_of as _acc_est
+    from .accuracy import record_node as _acc_record
     staged_rows = staged_bytes = 0
     narrowed_cols = narrowed_saved = 0
     for si, (s, b) in enumerate(zip(scan_leaves, batches)):
@@ -597,6 +612,12 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
         stats.add("scan_rows", rows)
         collector.operator(_scan_key(si, s), output_rows=rows,
                            output_bytes=nbytes)
+        # estimate-vs-actual (exec/accuracy.py): the scan leaf's
+        # planner estimate against the rows it actually staged --
+        # structural keys line up with the operator rows and across
+        # workers running the same fragment
+        _acc_record(_scan_key(si, s), _scan_label(s), unit="rows",
+                    est=_acc_est(s, sf), actual=rows)
         if prog is not None:  # processed-input counters (monotonic)
             prog.advance(rows=rows, bytes=nbytes)
         if getattr(s, "physical_dtypes", None):
@@ -633,6 +654,11 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
                 session=session, collector=collector, stats=stats,
                 memory_pool=memory_pool, plan_fp=fp)
         if audit_report and audit_report.get("peak_bytes_estimate"):
+            # ... and the estimate side of the footprint accuracy
+            # record (actual fills in at finalize from the pool's
+            # measured per-query peak)
+            _acc_record("footprint", "MemoryPool", unit="bytes",
+                        est=float(audit_report["peak_bytes_estimate"]))
             # the K005 footprint estimate feeds the fusion cost model:
             # a fused span whose measured peak exceeds
             # kernel_audit_budget_bytes is REFUSED on its next
@@ -663,7 +689,8 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
                     rplan, scan_leaves, batches, default_join_capacity,
                     use_cache, stats, session, adaptive_off, refine,
                     prog, collector, query_id, trace_id, prof_on,
-                    memory_pool, plan_fp_root=plan_fingerprint(root))
+                    memory_pool, plan_fp_root=plan_fingerprint(root),
+                    sf=sf)
             else:
                 (out, device_s, dispatch_fn, call_lock, cap_scale,
                  scale, plan) = _dispatch_ladder(
@@ -770,7 +797,7 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
     stats.add("output_rows", res.row_count)
     res.stats = stats.snapshot()
     _finalize_query_stats(collector, res, t_query0, peak_reserved, root,
-                          trace_id, dp=dp)
+                          trace_id, dp=dp, acc=acc, sf=sf)
     return res
 
 
@@ -873,7 +900,7 @@ def _dispatch_ladder(root: N.PlanNode, plan, jfn, call_lock, batches,
 def _execute_regions(rplan, scan_leaves, batches, default_join_capacity,
                      use_cache, stats, session, adaptive_off, refine,
                      prog, collector, query_id, trace_id, prof_on,
-                     memory_pool, plan_fp_root: str):
+                     memory_pool, plan_fp_root: str, sf: float = 0.01):
     """Materialized region executor (exec/regions.py partition): run
     each pipeline region as its own compiled-and-cached program in
     producer order. Region outputs stay DEVICE-resident Batches handed
@@ -889,6 +916,8 @@ def _execute_regions(rplan, scan_leaves, batches, default_join_capacity,
     from ..audit.staged import audit_staged_query, kernel_audit_enabled
     from ..server.tracing import TraceContext as _TC
     from ..utils.config import session_flag
+    from .accuracy import est_rows_of as _acc_est
+    from .accuracy import record_node as _acc_record
     from .plan_cache import plan_fingerprint
     from .profiler import note_footprint, plan_label, plan_tables, \
         record_call
@@ -926,6 +955,13 @@ def _execute_regions(rplan, scan_leaves, batches, default_join_capacity,
                     rfp, report["peak_bytes_estimate"])
                 if prof_on:
                     note_footprint(rfp, report["peak_bytes_estimate"])
+                # per-region K005 estimate: region estimates fold by
+                # max into ONE query-level footprint record (the pool
+                # measures one per-query peak, and intermediates drop
+                # past their last consumer, so max is the honest
+                # planned-peak bound)
+                _acc_record("footprint", "MemoryPool", unit="bytes",
+                            est=float(report["peak_bytes_estimate"]))
         out, dev_s, dispatch_fn, dlock, cap_scale, scale, _ = \
             _dispatch_ladder(
                 reg.root, plan, jfn, call_lock, rbatches, None,
@@ -942,6 +978,15 @@ def _execute_regions(rplan, scan_leaves, batches, default_join_capacity,
                 collector.bump_stage("compile", **cost)
                 stats.add("xla_flops", cost["flops"])
         outputs[reg.index] = out
+        # region-boundary estimate-vs-actual: the region root's planner
+        # estimate against the rows its program actually emitted (join
+        # build sides that partition into their own region are
+        # attributed here; the dispatch already synced, so reading the
+        # active mask costs one small host transfer, not a block)
+        _acc_record(f"region[{reg.tag}]:{type(reg.root).__name__}",
+                    type(reg.root).__name__, unit="rows",
+                    est=_acc_est(reg.root, sf),
+                    actual=int(np.asarray(out.active).sum()))
         for i in reg.inputs:  # drop intermediates past their last use
             if i.kind == "region":
                 consumers[i.region] -= 1
@@ -1039,7 +1084,8 @@ def _result_bytes(res: "QueryResult") -> int:
 def _finalize_query_stats(collector: StatsCollector, res: "QueryResult",
                           t0: float, peak_reserved_bytes: int,
                           root: Optional[N.PlanNode],
-                          trace_id=None, dp=None) -> None:
+                          trace_id=None, dp=None, acc=None,
+                          sf: float = 0.01) -> None:
     """Close out the structured stats for one run_query invocation and
     emit one tracer span per collected stage. `peak_reserved_bytes` is
     the pool high-water mark the caller already drained. `dp` is the
@@ -1076,6 +1122,29 @@ def _finalize_query_stats(collector: StatsCollector, res: "QueryResult",
                            output_rows=res.row_count,
                            output_bytes=qs.output_bytes,
                            wall_us=qs.stage_us("fetch"))
+    # estimate-vs-actual close-out (exec/accuracy.py): the root's
+    # cardinality record, the footprint record's measured side (the
+    # pool peak the caller drained), then the whole ledger rides
+    # QueryStats.accuracy (stitching worker slices through the
+    # task-status path) and folds into the process registry +
+    # q-error histogram -- complete records only, at this one seam
+    if acc is not None:
+        from .accuracy import est_rows_of as _est_of
+        from .accuracy import finalize_query as _acc_finalize
+        from .accuracy import merge_record_maps as _acc_merge
+        if root is not None:
+            acc.record("output", node_type=type(root).__name__,
+                       unit="rows", est=_est_of(root, sf),
+                       actual=float(res.row_count))
+        recs = acc.snapshot_records()
+        if "footprint" in recs and qs.peak_memory_bytes:
+            acc.record("footprint", node_type="MemoryPool",
+                       unit="bytes",
+                       actual=float(qs.peak_memory_bytes))
+            recs = acc.snapshot_records()
+        if recs:
+            qs.accuracy = _acc_merge(qs.accuracy, recs)
+            _acc_finalize(collector.query_id, recs)
     res.query_stats = qs
     # trace_id is either a plain grouping string (legacy) or a
     # TraceContext carrying (trace id, parent span id): with a context,

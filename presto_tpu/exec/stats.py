@@ -43,6 +43,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from .accuracy import NodeAccuracy, merge_record_maps, \
+    record_map_from_json, record_map_to_json
 from .datapath import HopStats, hop_map_from_json, hop_map_to_json, \
     merge_hop_maps
 
@@ -208,6 +210,12 @@ class QueryStats:
     # worker's hop slice stitches to the coordinator's through the
     # existing task-status path
     datapath: Dict[str, HopStats] = dataclasses.field(default_factory=dict)
+    # per-plan-node estimate-vs-actual ledger (exec/accuracy.py):
+    # est/actual rows or bytes per node, merged by NodeAccuracy's own
+    # estimates-max/rows-add/peaks-max law -- worker slices of one
+    # query stitch to the coordinator's through the same path
+    accuracy: Dict[str, NodeAccuracy] = \
+        dataclasses.field(default_factory=dict)
 
     # -- convenience accessors (the EXPLAIN ANALYZE / CLI summary view) --
 
@@ -241,7 +249,8 @@ class QueryStats:
                                   other.peak_memory_bytes),
             task_count=self.task_count + other.task_count,
             stages=stages, operators=operators, counters=counters,
-            datapath=merge_hop_maps(self.datapath, other.datapath))
+            datapath=merge_hop_maps(self.datapath, other.datapath),
+            accuracy=merge_record_maps(self.accuracy, other.accuracy))
 
     def to_json(self) -> dict:
         return {"wallUs": self.wall_us,
@@ -253,7 +262,8 @@ class QueryStats:
                 "operators": {k: o.to_json()
                               for k, o in self.operators.items()},
                 "counters": dict(self.counters),
-                "datapath": hop_map_to_json(self.datapath)}
+                "datapath": hop_map_to_json(self.datapath),
+                "accuracy": record_map_to_json(self.accuracy)}
 
     @classmethod
     def from_json(cls, doc: dict) -> "QueryStats":
@@ -269,7 +279,10 @@ class QueryStats:
                        for k, o in doc.get("operators", {}).items()},
             counters={k: int(v)
                       for k, v in doc.get("counters", {}).items()},
-            datapath=hop_map_from_json(doc.get("datapath", {})))
+            datapath=hop_map_from_json(doc.get("datapath", {})),
+            # old-doc tolerance: records shipped before this field
+            # existed deserialize to the empty map (merge identity)
+            accuracy=record_map_from_json(doc.get("accuracy", {})))
 
     def summary(self) -> str:
         """One-paragraph human summary (the CLI --stats shape)."""

@@ -100,10 +100,20 @@ def explain(root: N.PlanNode, *, regions: bool = False, session=None,
         node_region = rplan.node_region
     lines: List[str] = []
 
+    from ..exec.accuracy import est_rows_of
+
     def walk(n: N.PlanNode, depth: int):
         tag = ""
+        # per-node planner estimate (stamped at prepare_plan when the
+        # tree was prepared, computed fresh otherwise -- same pure
+        # function either way), so estimate provenance is visible
+        # BEFORE a query runs and stale connector stats are
+        # diagnosable offline
+        est = est_rows_of(n, sf)
+        if est is not None:
+            tag += f"  estRows={est:.0f}"
         if id(n) in node_region:
-            tag = f"  [region=R{node_region[id(n)]}]"
+            tag += f"  [region=R{node_region[id(n)]}]"
         lines.append("    " * depth + "- " + _node_line(n) + tag)
         for s in n.sources:
             walk(s, depth + 1)
@@ -287,6 +297,7 @@ def explain_analyze(root: N.PlanNode, sf: float = 0.01, **kwargs) -> str:
         lines += ["", f"output rows: {res.row_count}"]
     lines.extend(_kernel_lines(executed, session))
     lines.extend(_datapath_lines(qs))
+    lines.extend(_accuracy_lines(qs))
     # the flat named counters keep their historical tail section
     if res.stats:
         lines += ["", "-- runtime counters --"]
@@ -369,6 +380,37 @@ def _datapath_lines(qs) -> List[str]:
                 f"util {verdict['utilization']:.0%}, {qual})")
         return lines
     except Exception:  # noqa: BLE001 - the waterfall is garnish here;
+        # EXPLAIN ANALYZE output must never fail on it
+        return []
+
+
+def _accuracy_lines(qs) -> List[str]:
+    """EXPLAIN ANALYZE's estimate-accuracy tail (exec/accuracy.py):
+    one line per recorded plan node -- the planner's estimate beside
+    what the runtime measured, folded into a q-error with direction --
+    closed by the named misestimate verdict."""
+    try:
+        from ..exec.accuracy import (direction_of, misestimate_verdict,
+                                     q_error)
+        if qs is None or not qs.accuracy:
+            return []
+        lines = ["", "-- accuracy --"]
+        for node in sorted(qs.accuracy):
+            r = qs.accuracy[node]
+            q = q_error(r.est, r.actual)
+            est_s = f"{r.est:.0f}" if r.est is not None else "?"
+            act_s = f"{r.actual:.0f}" if r.actual is not None else "?"
+            q_s = (f"{q:.2f}x {direction_of(r.est, r.actual)}"
+                   if q is not None else "-")
+            lines.append(f"{node}: est={est_s} actual={act_s} "
+                         f"q={q_s} [{r.unit}]")
+        verdict = misestimate_verdict(qs.accuracy)
+        if verdict is not None:
+            qual = "within band" if verdict["withinBand"] \
+                else "MISESTIMATE"
+            lines.append(f"verdict: {verdict['message']} ({qual})")
+        return lines
+    except Exception:  # noqa: BLE001 - the ledger is garnish here;
         # EXPLAIN ANALYZE output must never fail on it
         return []
 

@@ -154,6 +154,7 @@ class FlightRecorder:
             events = self.events(query_id=key)
             kernels = self._profile_of(key)
             datapath = self._datapath_of(key)
+            accuracy = self._accuracy_of(key)
             with open(path, "w") as f:
                 f.write(json.dumps(
                     {"dump": {"key": key, "reason": reason,
@@ -175,6 +176,14 @@ class FlightRecorder:
                     f.write(json.dumps(
                         {"datapath": {"queryId": key,
                                       "hops": datapath}}) + "\n")
+                if accuracy:
+                    # the estimate-vs-actual ledger of THIS query
+                    # (per-node est/act): a misestimate dump answers
+                    # "which node lied" offline, without a live
+                    # /v1/accuracy to ask
+                    f.write(json.dumps(
+                        {"accuracy": {"queryId": key,
+                                      "nodes": accuracy}}) + "\n")
                 for evt in events:
                     f.write(json.dumps(evt, default=str) + "\n")
         except Exception as e:  # noqa: BLE001 - a full disk must not
@@ -232,6 +241,19 @@ class FlightRecorder:
             # even when the ledger is broken; count the gap
             from .metrics import record_suppressed
             record_suppressed("flight_recorder", "datapath_snapshot", e)
+            return {}
+
+    @staticmethod
+    def _accuracy_of(key: str) -> dict:
+        """This query's per-node estimate-vs-actual records
+        (best-effort, like the profile embed)."""
+        try:
+            from ..exec.accuracy import accuracy_for_query
+            return accuracy_for_query(key)
+        except Exception as e:  # noqa: BLE001 - the dump must land
+            # even when the ledger is broken; count the gap
+            from .metrics import record_suppressed
+            record_suppressed("flight_recorder", "accuracy_snapshot", e)
             return {}
 
     @staticmethod

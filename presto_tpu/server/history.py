@@ -208,6 +208,37 @@ class QueryHistoryArchive:
             "output_rows": int(qs.output_rows) if qs is not None else 0,
             "output_bytes": int(qs.output_bytes) if qs is not None else 0,
         }
+        # estimate-accuracy aggregates (exec/accuracy.py): the numeric
+        # worst q-error joins the sentinel's stats dict (so the perf
+        # gate's max_q_error band fires on estimate DRIFT per
+        # fingerprint before latency moves), and the per-node rows +
+        # named verdict ride the record -- this archive is the
+        # per-(fingerprint, plan-node) feedback store ROADMAP item
+        # 2(c)'s estimate seeding reads
+        accuracy_rows: List[dict] = []
+        misestimated = ""
+        max_q = 0.0
+        try:
+            from ..exec.accuracy import (direction_of,
+                                         misestimate_verdict, q_error)
+            acc = qs.accuracy if qs is not None else {}
+            for node in sorted(acc):
+                r = acc[node]
+                q = q_error(r.est, r.actual)
+                row = r.to_json()
+                row["qError"] = round(q, 4) if q is not None else None
+                row["direction"] = direction_of(r.est, r.actual)
+                accuracy_rows.append(row)
+                if q is not None and q > max_q:
+                    max_q = q
+            v = misestimate_verdict(acc) if acc else None
+            if v is not None and not v["withinBand"]:
+                misestimated = v["node"]
+        except Exception as e:  # noqa: BLE001 - a record without
+            # accuracy attribution still archives; count the gap
+            from .metrics import record_suppressed
+            record_suppressed("history", "accuracy_snapshot", e)
+        stats["max_q_error"] = round(max_q, 4)
         kernels: List[str] = []
         top: List[dict] = []
         try:
@@ -248,6 +279,8 @@ class QueryHistoryArchive:
             "stats": stats,
             "failpointHits": failpoint_hits,
             "topKernels": top,
+            "accuracy": accuracy_rows,
+            "misestimatedNode": misestimated,
             "session": {k: str(v) for k, v in (session or {}).items()
                         if k in ("sf", "failpoints")},
             "regressions": [],

@@ -39,7 +39,8 @@ from typing import Dict, List, Optional, Tuple, Union
 from ..utils.locks import OrderedLock
 
 __all__ = ["MetricFamily", "Histogram", "DEFAULT_BUCKETS",
-           "SIZE_BUCKETS", "datapath_families",
+           "SIZE_BUCKETS", "Q_ERROR_BUCKETS", "datapath_families",
+           "accuracy_families",
            "observe_histogram", "get_histogram", "histogram_families",
            "reset_histograms",
            "render_prometheus", "parse_prometheus",
@@ -79,6 +80,14 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
 # workers, same law, same exemplar contract as the time ladder.
 SIZE_BUCKETS: Tuple[float, ...] = tuple(
     float(1024 * 4 ** i) for i in range(12))  # 1KiB .. 4GiB
+
+# The q-error ladder beside the two above: estimate accuracy is a
+# RATIO >= 1.0 (exec/accuracy.py, max(est/act, act/est)), log-spaced in
+# powers of 2 from "exact" to "off by ~1000x" -- a misestimate
+# distribution forced onto the seconds ladder would crowd everything
+# under 2.5. Fixed bounds keep Histogram.merge lawful across processes.
+Q_ERROR_BUCKETS: Tuple[float, ...] = tuple(
+    float(2 ** i) for i in range(11))  # 1x .. 1024x
 
 
 class Histogram:
@@ -352,6 +361,15 @@ _DECLARED_HISTOGRAMS: Dict[str, Tuple[str, Tuple[Dict[str, str], ...]]] = {
               ("connector_read", "decode", "narrow_cast", "device_put",
                "kernel", "exchange_serialize", "exchange_fetch",
                "client_drain"))),
+    # the estimate-accuracy observatory's q-error distribution
+    # (exec/accuracy.py finalize_query): Q_ERROR_BUCKETS ladder, one
+    # series per unit of the closed catalog. Label values spelled
+    # literally (like every closed vocabulary above); tests pin them
+    # to accuracy.UNITS.
+    "presto_tpu_q_error": (
+        "per-plan-node estimate q-error max(est/act, act/est) "
+        "(ratio ladder; exec/accuracy.py unit catalog)",
+        tuple({"unit": u} for u in ("rows", "bytes"))),
 }
 
 # histogram families whose observations are NOT seconds use their own
@@ -359,6 +377,7 @@ _DECLARED_HISTOGRAMS: Dict[str, Tuple[str, Tuple[Dict[str, str], ...]]] = {
 # every instance of a name shares the same bounds)
 _BUCKET_SCHEMES: Dict[str, Tuple[float, ...]] = {
     "presto_tpu_datapath_bytes": SIZE_BUCKETS,
+    "presto_tpu_q_error": Q_ERROR_BUCKETS,
 }
 
 
@@ -494,6 +513,35 @@ def datapath_families() -> List[MetricFamily]:
         fam_s.add(round(h.wall_us / 1e6, 6), {"hop": hop})
         fam_i.add(h.invocations, {"hop": hop})
     return [fam_b, fam_s, fam_i]
+
+
+def accuracy_families() -> List[MetricFamily]:
+    """Estimate-accuracy lifetime totals (exec/accuracy.py), exported
+    by BOTH tiers with a stable zero shape: complete records folded,
+    misestimates beyond the band by direction, and the worst q-error
+    seen -- beside the Q_ERROR_BUCKETS distribution the histogram
+    registry already renders."""
+    from ..exec.accuracy import UNITS, process_totals
+    totals = process_totals()
+    fam_r = MetricFamily(
+        "presto_tpu_accuracy_records_total", "counter",
+        "complete estimate-vs-actual records folded per unit "
+        "(exec/accuracy.py; see DESIGN.md 'Estimate accuracy')")
+    fam_m = MetricFamily(
+        "presto_tpu_misestimates_total", "counter",
+        "records whose q-error exceeded the band, by unit and "
+        "direction (under = planner guessed low)")
+    fam_w = MetricFamily(
+        "presto_tpu_worst_q_error", "gauge",
+        "lifetime worst q-error observed per unit (monotonic; 0 "
+        "until the first complete record)")
+    for unit in UNITS:
+        t = totals[unit]
+        fam_r.add(t["records"], {"unit": unit})
+        for d in ("under", "over"):
+            fam_m.add(t[d], {"unit": unit, "direction": d})
+        fam_w.add(round(t["worstQError"], 4), {"unit": unit})
+    return [fam_r, fam_m, fam_w]
 
 
 def narrowing_families() -> List[MetricFamily]:

@@ -82,6 +82,18 @@ _ERROR_CODES = {
 }
 
 
+def _max_q_error_of(query_id: str):
+    """Worst finalized q-error for one query id, or None (pre-close
+    and on any registry hiccup -- a cluster frame must never fail on
+    its garnish)."""
+    try:
+        from ..exec.accuracy import query_max_q_error
+        q = query_max_q_error(query_id)
+        return round(q, 2) if q is not None else None
+    except Exception:  # noqa: BLE001
+        return None
+
+
 def _error_doc(name: str, message: str) -> dict:
     code, etype = _ERROR_CODES.get(name, _ERROR_CODES["GENERIC_INTERNAL_ERROR"])
     return {"message": message, "errorCode": code, "errorName": name,
@@ -877,7 +889,11 @@ class StatementServer:
                 "elapsedMs": q.machine.elapsed_ms(),
                 "query": q.text[:120],
                 "traceId": q.trace_ctx.trace_id,
-                "progress": self._progress_doc(q)})
+                "progress": self._progress_doc(q),
+                # worst finalized q-error (None until the estimate
+                # ledger closed out -- FINISHING queries show it while
+                # the client still drains); the ptop per-query column
+                "maxQError": _max_q_error_of(q.id)})
         groups = self.dispatcher.group_stats()
         blocked = sum(int(g.get("queued", 0)) for g in groups.values())
         from .discovery import recently_unannounced
@@ -948,7 +964,22 @@ class StatementServer:
             # data-path staging rate + cached bottleneck hop (the ptop
             # header; a cluster frame never pays the ceilings probe)
             "datapath": self._datapath_summary(),
+            # estimate-accuracy lifetime summary (worst q-error + its
+            # node): the ptop header's accuracy line
+            "accuracy": self._accuracy_summary(),
         }
+
+    def _accuracy_summary(self) -> dict:
+        """The cheap per-frame accuracy embed (never fails the fleet
+        overview)."""
+        try:
+            from ..exec.accuracy import accuracy_summary
+            return accuracy_summary()
+        except Exception as e:  # noqa: BLE001 - introspection must not
+            # take down the fleet overview
+            from .metrics import record_suppressed
+            record_suppressed("statement", "accuracy_summary", e)
+            return {}
 
     def _datapath_summary(self) -> dict:
         """The cheap per-frame datapath embed (never fails the fleet
@@ -1042,6 +1073,8 @@ class StatementServer:
         fams.extend(plan_cache_families())
         fams.extend(narrowing_families())
         fams.extend(datapath_families())
+        from .metrics import accuracy_families
+        fams.extend(accuracy_families())
         fams.extend(batching_families())
         fams.extend(suppressed_error_families())
         fams.extend(tracing_families())
@@ -1078,6 +1111,15 @@ class StatementServer:
         the profile merge)."""
         from ..exec.datapath import cluster_datapath_doc
         return cluster_datapath_doc(self._worker_urls())
+
+    def accuracy_doc(self) -> dict:
+        """Cluster-merged estimate-accuracy ledger for GET
+        /v1/accuracy: this process's slice plus every configured
+        worker's, per-query records stitched by the NodeAccuracy merge
+        law (exec/accuracy.py; processId dedup keeps an in-process
+        worker from double-counting, exactly like the profile merge)."""
+        from ..exec.accuracy import cluster_accuracy_doc
+        return cluster_accuracy_doc(self._worker_urls())
 
     def _worker_urls(self) -> list:
         """The worker base URLs the cluster-merged surfaces
@@ -1233,6 +1275,11 @@ def _make_handler(server: StatementServer):
                 # cluster-merged per-hop byte/throughput ledger with
                 # roofline bottleneck verdicts (exec/datapath.py)
                 self._send(server.datapath_doc())
+                return
+            if parts == ["v1", "accuracy"]:
+                # cluster-merged per-plan-node estimate-vs-actual
+                # ledger with misestimate verdicts (exec/accuracy.py)
+                self._send(server.accuracy_doc())
                 return
             if parts == ["v1", "history"]:
                 # cluster-merged completed-query archive (the perf

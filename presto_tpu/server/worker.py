@@ -998,9 +998,11 @@ class _Handler(BaseHTTPRequestHandler):
                            f"lifetime {k}").add(counters[k]))
         fams.extend(plan_cache_families())
         fams.extend(narrowing_families())
-        from .metrics import batching_families, datapath_families
+        from .metrics import (accuracy_families, batching_families,
+                              datapath_families)
         fams.extend(batching_families())
         fams.extend(datapath_families())
+        fams.extend(accuracy_families())
         from .metrics import (failpoint_families,
                               flight_recorder_families,
                               histogram_families, kernel_audit_families,
@@ -1065,6 +1067,12 @@ class _Handler(BaseHTTPRequestHandler):
             # exec/datapath.py)
             from ..exec.datapath import datapath_doc
             return self._send_json(datapath_doc())
+        if parts == ["v1", "accuracy"]:
+            # this worker's estimate-accuracy slice (the statement
+            # tier pulls + stitches per-query records cluster-wide;
+            # exec/accuracy.py)
+            from ..exec.accuracy import accuracy_doc
+            return self._send_json(accuracy_doc())
         if parts == ["v1", "history"]:
             # this process's completed-query archive slice (the
             # statement tier merges these cluster-wide like /v1/profile;

@@ -81,6 +81,16 @@ def render(doc: dict) -> str:
         lines.append(
             f"staging {dp.get('stagingGbPerS', 0.0):.3f} GB/s"
             + (f"  bottleneck {bn}" if bn else ""))
+    # estimate-accuracy roll-up (exec/accuracy.py): how many nodes were
+    # scored, how many missed the band, and the worst offender so far
+    acc = doc.get("accuracy") or {}
+    if acc:
+        worst = acc.get("worstNode")
+        lines.append(
+            f"accuracy {acc.get('records', 0)} records  "
+            f"misest {acc.get('misestimates', 0)}  "
+            f"worst q {acc.get('worstQError', 0.0):.2f}x"
+            + (f" ({worst})" if worst else ""))
     lines.append("-" * 78)
     running = doc.get("runningQueries", [])
     if not running:
@@ -101,12 +111,16 @@ def render(doc: dict) -> str:
         # /v1/datapath serves, but live per query
         gbps = float(prog.get("bytes", 0)) / \
             max(float(rq.get("elapsedMs", 0)) / 1000.0, 1e-3) / 1e9
+        # worst q-error of THIS query (filled at finalize, so running
+        # queries show "-" until their accuracy ledger lands)
+        mq = rq.get("maxQError")
+        mq_s = f"{float(mq):5.1f}x" if mq is not None else "     -"
         lines.append(
             f"{rq.get('queryId', '?'):<26} {rq.get('state', '?'):<9} "
             f"{_bar(pct)} {pct:5.1f}%  "
             f"{prog.get('stage', '-'):<8} "
             f"rows {int(prog.get('rows', 0)):>10,} "
-            f"{gbps:6.3f}GB/s{age_s}{spec_s}")
+            f"{gbps:6.3f}GB/s q{mq_s}{age_s}{spec_s}")
         lines.append(f"  {rq.get('query', '')[:74]}")
     lines.append("-" * 78)
     # resource-group rows (latency-class admission): per-group queue

@@ -109,6 +109,18 @@ LOCK_FAMILIES = (
 # answer a staging-rate investigation needs stated, not implied.
 DATAPATH_FAMILY_PREFIX = "presto_tpu_datapath"
 
+# estimate-accuracy observatory (exec/accuracy.py): its own
+# always-present section, zeros included -- record/misestimate counter
+# deltas, the worst-q-error gauge, and the q-error histogram's
+# bucket-delta p50/p95/p99. "No misestimates this window" is an answer
+# an estimate-drift investigation needs stated, not implied.
+ACCURACY_FAMILY_PREFIX = "presto_tpu_accuracy"
+ACCURACY_FAMILIES = (
+    "presto_tpu_misestimates_total",
+    "presto_tpu_worst_q_error",
+)
+Q_ERROR_HISTOGRAM = "presto_tpu_q_error"
+
 
 _LE_RE = re.compile(r'le="([^"]+)"')
 
@@ -155,7 +167,8 @@ def diff(before: dict, after: dict) -> dict:
     the always-present tracing/flight-recorder section."""
     out = {"counters": {}, "gauges": {}, "tracing": {}, "faults": {},
            "history": {}, "cluster": {}, "fleet": {}, "locks": {},
-           "datapath": {}, "histograms": {}, "violations": {}}
+           "datapath": {}, "accuracy": {}, "histograms": {},
+           "violations": {}}
     hist_bases = set()
     for fam, samples in after.items():
         if fam.endswith("_bucket"):
@@ -168,6 +181,8 @@ def diff(before: dict, after: dict) -> dict:
         is_counter = fam.endswith("_total")
         is_fault = fam.startswith(FAULT_FAMILY_PREFIX)
         is_datapath = fam.startswith(DATAPATH_FAMILY_PREFIX)
+        is_accuracy = fam.startswith(ACCURACY_FAMILY_PREFIX) \
+            or fam in ACCURACY_FAMILIES
         is_history = fam in HISTORY_FAMILIES
         is_cluster = fam in CLUSTER_FAMILIES
         is_fleet = fam in FLEET_FAMILIES
@@ -188,6 +203,9 @@ def diff(before: dict, after: dict) -> dict:
                     # per-hop byte/second deltas, zeros included: the
                     # window's bytes/seconds ratio is the achieved B/s
                     out["datapath"][label] = round(delta, 6)
+                elif is_accuracy:
+                    # record + misestimate deltas, zeros included
+                    out["accuracy"][label] = round(delta, 6)
                 elif is_history:
                     out["history"][label] = round(delta, 6)
                 elif is_fleet:
@@ -209,6 +227,11 @@ def diff(before: dict, after: dict) -> dict:
                 # the armed gauge rides the faults section too: "3
                 # faults fired, 2 still armed" reads off one block
                 out["faults"][label] = round(val, 6)
+            elif is_accuracy:
+                # the worst-q-error gauge rides beside the misestimate
+                # deltas: "0 new misestimates, worst ever 47x" reads
+                # off one block
+                out["accuracy"][label] = round(val, 6)
             elif is_history:
                 # the archive-size gauge rides the history section:
                 # "N records retained, 0 regressions" reads off one block
@@ -235,6 +258,10 @@ def diff(before: dict, after: dict) -> dict:
             # the size histogram's bucket-delta quantiles ride the
             # datapath section beside the byte deltas (zeros included)
             out["datapath"][base] = win
+        elif base == Q_ERROR_HISTOGRAM:
+            # the q-error ladder's bucket-delta quantiles ride the
+            # accuracy section beside the misestimate deltas
+            out["accuracy"][base] = win
         else:
             out["histograms"][base] = win
     return out

@@ -1,0 +1,654 @@
+"""Estimate-accuracy observatory (exec/accuracy.py): the NodeAccuracy
+merge law, QueryStats carry-through, q-error/verdict semantics, both
+tiers' /v1/accuracy shape, the EXPLAIN surfaces, system.cardinality,
+the metrics/scrape/ptop/bench/perfgate/history surfaces, the TPC-H
+corpus sweep, and the 2-worker distributed stitch plus the forced
+misestimate (stats-free memory-connector table) named by the verdict
+and archived in history -- with the clean replay staying silent."""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from presto_tpu.exec.accuracy import (AccuracyLedger, NodeAccuracy,
+                                      UNITS, accuracy_doc,
+                                      accuracy_for_query,
+                                      accuracy_summary, clear_accuracy,
+                                      direction_of, est_rows_of,
+                                      finalize_query,
+                                      merge_accuracy_docs,
+                                      merge_record_maps,
+                                      misestimate_verdict, note_query,
+                                      process_totals, q_error,
+                                      query_max_q_error,
+                                      record_map_from_json,
+                                      record_map_to_json, record_node,
+                                      recording, snapshot,
+                                      stamp_estimates)
+
+SF = 0.01
+
+# the official TPC-H q1 text (dialect-adapted exactly like bench.py)
+TPCH_Q1 = """
+SELECT returnflag, linestatus,
+       sum(quantity) AS sum_qty,
+       sum(extendedprice) AS sum_base_price,
+       sum(extendedprice * (1 - discount)) AS sum_disc_price,
+       sum(extendedprice * (1 - discount) * (1 + tax)) AS sum_charge,
+       avg(quantity) AS avg_qty,
+       avg(extendedprice) AS avg_price,
+       avg(discount) AS avg_disc,
+       count(*) AS count_order
+FROM lineitem
+WHERE shipdate <= date '1998-09-02'
+GROUP BY returnflag, linestatus
+ORDER BY returnflag, linestatus
+"""
+
+
+def _r(node, est=None, actual=None, unit="rows", nt="T", tasks=1):
+    return NodeAccuracy(node, node_type=nt, unit=unit, est=est,
+                        actual=actual, tasks=tasks)
+
+
+def _wait_for(fn, timeout=8.0):
+    """Terminal-path hooks (archive append) run on the query's
+    execution thread AFTER the client sees the terminal state; poll."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(0.02)
+    return fn()
+
+
+# -- merge law -----------------------------------------------------------
+
+
+def test_record_merge_identity():
+    a = _r("output", est=10.0, actual=8.0, tasks=2)
+    z = NodeAccuracy("output")
+    assert a.merge(z) == a
+    assert z.merge(a) == a
+
+
+def test_record_merge_commutative_associative_rows():
+    a = _r("scan", est=100.0, actual=60.0, tasks=1)
+    b = _r("scan", est=100.0, actual=40.0, tasks=1)
+    c = _r("scan", est=90.0, actual=5.0, tasks=2)
+    assert a.merge(b) == b.merge(a)
+    assert a.merge(b).merge(c) == a.merge(b.merge(c))
+    m = a.merge(b).merge(c)
+    # estimates max (workers stamp the same fragment estimate), row
+    # actuals ADD (slices partition the stream), tasks add
+    assert (m.est, m.actual, m.tasks) == (100.0, 105.0, 4)
+
+
+def test_record_merge_bytes_actual_maxes():
+    a = _r("footprint", est=1000.0, actual=700.0, unit="bytes")
+    b = _r("footprint", est=800.0, actual=900.0, unit="bytes")
+    m = a.merge(b)
+    # byte actuals MAX (peaks max, like QueryStats.peak_memory_bytes)
+    assert (m.est, m.actual) == (1000.0, 900.0)
+    assert a.merge(b) == b.merge(a)
+
+
+def test_record_merge_half_open_sides():
+    est_only = _r("footprint", est=512.0, unit="bytes")
+    act_only = _r("footprint", actual=300.0, unit="bytes")
+    m = est_only.merge(act_only)
+    assert (m.est, m.actual) == (512.0, 300.0)
+    # a half-open record never produces a q-error
+    assert q_error(est_only.est, est_only.actual) is None
+
+
+def test_record_map_merge_and_json_round_trip():
+    x = {"a": _r("a", 10.0, 5.0), "b": _r("b", 1.0, 1.0)}
+    y = {"b": _r("b", 2.0, 3.0), "c": _r("c", 7.0, 7.0)}
+    m = merge_record_maps(x, y)
+    assert merge_record_maps(y, x) == m
+    assert merge_record_maps(x, {}) == x       # empty map is identity
+    back = record_map_from_json(record_map_to_json(m))
+    assert back == m
+
+
+def test_query_stats_carries_accuracy_through_json_and_merge():
+    """The worker-slice stitching contract: QueryStats serializes the
+    record map through the task-status wire shape and folds it in
+    merge() (so slices from any number of workers stitch in any
+    order)."""
+    from presto_tpu.exec.stats import QueryStats
+    a = QueryStats(accuracy={"scan": _r("scan", 100.0, 60.0)})
+    b = QueryStats(accuracy={"scan": _r("scan", 100.0, 40.0),
+                             "output": _r("output", 4.0, 3.0)})
+    m = a.merge(b)
+    assert m.accuracy["scan"].actual == 100.0
+    assert m.accuracy["scan"].est == 100.0
+    assert m.accuracy["output"].actual == 3.0
+    rt = QueryStats.from_json(m.to_json())
+    assert rt.accuracy == m.accuracy
+    # old documents without the key parse to an empty map
+    doc = m.to_json()
+    doc.pop("accuracy")
+    assert QueryStats.from_json(doc).accuracy == {}
+
+
+# -- q-error + direction -------------------------------------------------
+
+
+def test_q_error_semantics():
+    assert q_error(10.0, 10.0) == 1.0
+    assert q_error(100.0, 10.0) == 10.0
+    assert q_error(10.0, 100.0) == 10.0           # symmetric
+    assert q_error(0.0, 0.0) == 1.0               # clamped, not a div-0
+    assert q_error(0.0, 5.0) == 5.0
+    assert q_error(None, 5.0) is None
+    assert q_error(5.0, None) is None
+
+
+def test_direction_semantics():
+    assert direction_of(1.0, 10.0) == "under"
+    assert direction_of(10.0, 1.0) == "over"
+    assert direction_of(3.0, 3.0) == "exact"
+    assert direction_of(None, 3.0) == "exact"
+
+
+# -- ambient recording + process registry --------------------------------
+
+
+def test_record_node_folds_ambient_only():
+    clear_accuracy()
+    ledger = AccuracyLedger()
+    with recording(ledger):
+        record_node("scan", "TableScan", est=100.0, actual=60.0)
+        record_node("scan", "TableScan", actual=40.0)
+    record_node("outside", "X", est=1.0, actual=1.0)  # no ambient target
+    recs = ledger.snapshot_records()
+    assert recs["scan"].actual == 100.0
+    assert recs["scan"].est == 100.0
+    assert "outside" not in recs
+    # nothing folded yet: process totals fold at finalize, not record
+    assert process_totals()["rows"]["records"] == 0
+
+
+def test_finalize_folds_complete_records_and_totals():
+    clear_accuracy()
+    recs = {"output": _r("output", est=50.0, actual=10.0, nt="OutputNode"),
+            "half": _r("half", est=9.0),          # incomplete: skipped
+            "footprint": _r("footprint", est=100.0, actual=80.0,
+                            unit="bytes", nt="MemoryPool")}
+    finalize_query("qa", recs)
+    totals = process_totals()
+    assert set(totals) == set(UNITS)              # stable zero shape
+    assert totals["rows"]["records"] == 1
+    assert totals["rows"]["over"] == 1            # q=5 > band, over
+    assert totals["rows"]["worstQError"] == 5.0
+    assert totals["rows"]["worstNode"] == "output"
+    assert totals["bytes"]["records"] == 1
+    assert totals["bytes"]["over"] == 0           # q=1.25 within band
+    assert query_max_q_error("qa") == 5.0
+    assert query_max_q_error("missing") is None
+    assert accuracy_for_query("qa")["output"]["est"] == 50.0
+    s = accuracy_summary()
+    assert s["records"] == 2 and s["misestimates"] == 1
+    assert s["worstNode"] == "output"
+
+
+def test_note_query_stitches_renotes():
+    clear_accuracy()
+    note_query("qx", {"scan": _r("scan", 100.0, 60.0)})
+    note_query("qx", {"scan": _r("scan", 100.0, 40.0)})
+    doc = accuracy_for_query("qx")
+    assert doc["scan"]["actual"] == 100.0
+    assert doc["scan"]["tasks"] == 2
+
+
+def test_finalize_observes_q_error_histogram():
+    from presto_tpu.server.metrics import get_histogram
+    clear_accuracy()
+    finalize_query("qh", {"n": _r("n", est=8.0, actual=1.0)})
+    h = get_histogram("presto_tpu_q_error", {"unit": "rows"})
+    assert h.buckets[0] == 1.0                    # q-error ladder
+    assert h.snapshot()["count"] >= 1
+
+
+# -- verdict (pure function) ---------------------------------------------
+
+
+def test_misestimate_verdict_named_and_pure():
+    recs = {"scan": _r("scan", 100.0, 100.0, nt="TableScan"),
+            "J3": _r("J3", 10.0, 470.0, nt="JoinNode"),
+            "half": _r("half", est=2.0)}
+    v = misestimate_verdict(recs)
+    assert v["node"] == "J3" and v["direction"] == "under"
+    assert v["qError"] == 47.0 and v["withinBand"] is False
+    assert v["message"] == "JoinNode J3 underestimated 47.0x"
+    # pure: identical inputs, identical verdict (objects or JSON rows)
+    assert misestimate_verdict(recs) == v
+    rows = {k: r.to_json() for k, r in recs.items()}
+    assert misestimate_verdict(rows) == v
+    # deterministic tiebreak at equal q-error: node key ascending
+    tie = {"b": _r("b", 10.0, 40.0), "a": _r("a", 40.0, 10.0)}
+    assert misestimate_verdict(tie)["node"] == "a"
+    # within band stays labeled so a clean replay reads as clean
+    ok = misestimate_verdict({"n": _r("n", 3.0, 4.0)})
+    assert ok["withinBand"] is True
+    assert misestimate_verdict({"h": _r("h", est=1.0)}) is None
+    assert misestimate_verdict({}) is None
+
+
+def test_merge_accuracy_docs_dedups_process_slices():
+    entry = {"nodes": {"scan": _r("scan", 100.0, 60.0).to_json()},
+             "verdict": None}
+    tot = {"rows": {"records": 1, "under": 1, "over": 0,
+                    "worstQError": 4.0, "worstNode": "scan"}}
+    docs = [{"processId": "p1", "queries": {"q": entry}, "totals": tot},
+            {"processId": "p1", "queries": {"q": entry}, "totals": tot},
+            {"processId": "p2", "queries": {"q": entry}, "totals": tot}]
+    merged = merge_accuracy_docs(docs)
+    # p1 counted once + p2: the same query's slices stitch by the law
+    assert merged["queries"]["q"]["nodes"]["scan"]["actual"] == 120.0
+    assert merged["totals"]["rows"]["records"] == 2
+    assert set(merged["totals"]) == set(UNITS)    # zero shape
+    assert merged["verdict"]["node"] == "scan"
+
+
+# -- estimate stamping (one provenance) ----------------------------------
+
+
+def test_stamp_estimates_and_est_rows_of():
+    from presto_tpu.sql import plan_sql
+    root = plan_sql("SELECT count(*) AS n FROM region")
+    stamp_estimates(root, SF)
+    assert root.est_rows == 1.0                   # ungrouped aggregate
+    scan = root
+    while getattr(scan, "sources", None):
+        scan = scan.sources[0]
+    assert scan.est_rows == 5.0                   # region row count
+    # stamped value wins; unstamped trees fall back to the same pure
+    # function of (node, sf) -- single provenance either way
+    fresh = plan_sql("SELECT count(*) AS n FROM region")
+    assert est_rows_of(fresh, SF) == 1.0
+    assert est_rows_of(root, SF) == 1.0
+
+
+# -- metrics vocabulary --------------------------------------------------
+
+
+def test_q_error_histogram_declared_with_unit_vocabulary():
+    from presto_tpu.server.metrics import (_BUCKET_SCHEMES,
+                                           _DECLARED_HISTOGRAMS,
+                                           Q_ERROR_BUCKETS)
+    help_, presets = _DECLARED_HISTOGRAMS["presto_tpu_q_error"]
+    assert {p["unit"] for p in presets} == set(UNITS)
+    assert _BUCKET_SCHEMES["presto_tpu_q_error"] == Q_ERROR_BUCKETS
+    # the log ladder: 1x .. 1024x in powers of two
+    assert Q_ERROR_BUCKETS[0] == 1.0
+    assert Q_ERROR_BUCKETS[-1] == 1024.0
+    assert list(Q_ERROR_BUCKETS) == sorted(Q_ERROR_BUCKETS)
+
+
+def test_accuracy_families_zero_shape():
+    from presto_tpu.server.metrics import (accuracy_families,
+                                           parse_prometheus,
+                                           render_prometheus)
+    clear_accuracy()
+    snap = parse_prometheus(
+        render_prometheus(accuracy_families()).decode())
+    for unit in UNITS:
+        assert snap["presto_tpu_accuracy_records_total"][
+            f'{{unit="{unit}"}}'] == 0.0
+        assert snap["presto_tpu_worst_q_error"][
+            f'{{unit="{unit}"}}'] == 0.0
+        for d in ("under", "over"):
+            key = f'{{direction="{d}",unit="{unit}"}}'
+            assert snap["presto_tpu_misestimates_total"][key] == 0.0
+
+
+# -- both tiers' /v1/accuracy --------------------------------------------
+
+
+def test_v1_accuracy_worker_slice_and_cluster_merge():
+    from presto_tpu.server import TpuWorkerServer
+    from presto_tpu.server.statement import StatementServer
+    clear_accuracy()
+    finalize_query("qe", {"output": _r("output", 8.0, 2.0,
+                                       nt="OutputNode")})
+    w = TpuWorkerServer(sf=SF).start()
+    url = f"http://127.0.0.1:{w.port}"
+    try:
+        with urllib.request.urlopen(f"{url}/v1/accuracy") as r:
+            doc = json.loads(r.read().decode())
+        assert doc["processId"]
+        assert set(doc["totals"]) == set(UNITS)   # stable zero shape
+        assert doc["queries"]["qe"]["verdict"]["node"] == "output"
+        with StatementServer(sf=SF,
+                             profile_workers=lambda: [url]) as srv:
+            with urllib.request.urlopen(f"{srv.url}/v1/accuracy") as r:
+                cdoc = json.loads(r.read().decode())
+            cluster = srv.cluster_doc()
+    finally:
+        w.stop()
+    assert cdoc["cluster"] is True
+    assert cdoc["workersPulled"] == 1
+    # worker + statement shells share one process: deduped, not doubled
+    assert cdoc["totals"]["rows"]["records"] == \
+        doc["totals"]["rows"]["records"]
+    # the cheap /v1/cluster embed agrees on the headline numbers
+    assert cluster["accuracy"]["worstQError"] == \
+        pytest.approx(cdoc["totals"]["rows"]["worstQError"], rel=0.01)
+
+
+# -- EXPLAIN surfaces ----------------------------------------------------
+
+
+def test_plain_explain_renders_est_rows():
+    from presto_tpu.plan import explain
+    from presto_tpu.sql import plan_sql
+    text = explain(plan_sql(TPCH_Q1), sf=SF)
+    assert "estRows=" in text
+    scan_line = next(ln for ln in text.splitlines()
+                     if "TableScan" in ln and "lineitem" in ln)
+    from presto_tpu.connectors import tpch
+    n = tpch.table_row_count("lineitem", SF)
+    assert f"estRows={n}" in scan_line
+
+
+def test_explain_analyze_accuracy_tail_names_a_verdict():
+    from presto_tpu.plan import explain_analyze
+    from presto_tpu.sql import plan_sql
+    text = explain_analyze(plan_sql(TPCH_Q1), sf=SF)
+    assert "-- accuracy --" in text
+    tail = text[text.index("-- accuracy --"):]
+    assert "output: est=" in tail
+    assert "q=" in tail and "[rows]" in tail
+    assert "verdict: " in tail
+    assert ("within band" in tail) or ("MISESTIMATE" in tail)
+
+
+# -- SQL front door: system tables + flight embed ------------------------
+
+
+def test_system_cardinality_sql():
+    from presto_tpu.sql import sql
+    clear_accuracy()
+    sql("SELECT count(*) AS n FROM region", sf=SF)
+    res = sql("SELECT query_id, node, node_type, unit, est, actual, "
+              "q_error, direction, tasks FROM system.cardinality")
+    rows = res.rows()
+    assert rows
+    by_node = {r[1]: r for r in rows if r[0] == "query"}
+    assert "output" in by_node
+    out = by_node["output"]
+    assert out[3] == "rows" and out[8] >= 1
+    assert out[6] >= 1.0                          # q-error >= 1 always
+    # a scan row attributes the connector table
+    assert any(n.startswith("scan[") for n in by_node)
+
+
+def test_query_history_sql_carries_accuracy_columns():
+    from presto_tpu.sql import sql
+    res = sql("SELECT query_id, max_q_error, misestimated_node "
+              "FROM system.query_history")
+    assert res.names == ["query_id", "max_q_error",
+                         "misestimated_node"]
+
+
+def test_flight_dump_embed_shape():
+    from presto_tpu.sql import sql
+    clear_accuracy()
+    sql("SELECT count(*) AS n FROM region", sf=SF)
+    doc = accuracy_for_query("query")
+    assert doc and "output" in doc
+    rows = snapshot()
+    assert any(r["queryId"] == "query" and r["node"] == "output"
+               for r in rows)
+
+
+# -- TPC-H corpus sweep --------------------------------------------------
+
+
+@pytest.mark.parametrize("qnum", [1, 3, 6, 12, 19])
+def test_tpch_queries_yield_records_and_verdicts(qnum):
+    """Every corpus query through the SQL front door produces at least
+    one COMPLETE per-node record and a named verdict."""
+    from presto_tpu.queries.tpch_sql import tpch_query
+    from presto_tpu.sql import sql
+    q = tpch_query(qnum)
+    kw = dict(max_groups=q.max_groups)
+    if q.join_capacity:
+        kw["join_capacity"] = q.join_capacity
+    res = sql(q.text, sf=SF, **kw)
+    acc = res.query_stats.accuracy
+    assert acc, f"q{qnum}: no accuracy records"
+    complete = [r for r in acc.values()
+                if q_error(r.est, r.actual) is not None]
+    assert complete, f"q{qnum}: no complete record"
+    v = misestimate_verdict(acc)
+    assert v is not None and v["message"]
+    assert v["qError"] >= 1.0
+    # every record is attributed: a node key, a unit from the catalog
+    for k, r in acc.items():
+        assert k and r.unit in UNITS
+
+
+# -- scripts + gate surfaces ---------------------------------------------
+
+
+def test_scrape_metrics_accuracy_section():
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts"))
+    import scrape_metrics
+    from presto_tpu.server.metrics import (accuracy_families,
+                                           histogram_families,
+                                           parse_prometheus,
+                                           render_prometheus)
+    clear_accuracy()
+    finalize_query("qs1", {"n": _r("n", est=8.0, actual=1.0)})
+    text = render_prometheus(accuracy_families()
+                             + histogram_families()).decode()
+    snap = parse_prometheus(text)
+    d = scrape_metrics.diff(snap, snap)
+    assert "accuracy" in d
+    # record/misestimate deltas, zeros included
+    for unit in UNITS:
+        assert f'presto_tpu_accuracy_records_total{{unit="{unit}"}}' \
+            in d["accuracy"]
+        for direction in ("under", "over"):
+            key = ('presto_tpu_misestimates_total'
+                   f'{{direction="{direction}",unit="{unit}"}}')
+            assert key in d["accuracy"]
+        # the worst-q-error gauge rides the same section (current value)
+        assert f'presto_tpu_worst_q_error{{unit="{unit}"}}' \
+            in d["accuracy"]
+    # the q-error histogram's bucket-delta quantiles ride the section
+    assert "presto_tpu_q_error" in d["accuracy"]
+    assert "presto_tpu_q_error" not in d["histograms"]
+
+
+def test_ptop_renders_accuracy_header_and_per_query_column():
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts"))
+    import ptop
+    doc = {"uptimeSeconds": 1.0, "queries": {},
+           "accuracy": {"records": 7, "misestimates": 2,
+                        "worstQError": 47.0,
+                        "worstNode": "region[r0]:JoinNode"},
+           "runningQueries": [
+               {"queryId": "q1", "state": "FINISHING",
+                "elapsedMs": 1000, "query": "SELECT 1",
+                "maxQError": 47.0,
+                "progress": {"progressPercent": 90.0, "rows": 5,
+                             "bytes": 0, "stage": "execute"}},
+               {"queryId": "q2", "state": "RUNNING", "elapsedMs": 10,
+                "query": "SELECT 2",
+                "progress": {"progressPercent": 1.0, "rows": 0,
+                             "bytes": 0, "stage": "staging"}}],
+           "workers": []}
+    out = ptop.render(doc)
+    assert "accuracy 7 records" in out
+    assert "misest 2" in out
+    assert "worst q 47.00x (region[r0]:JoinNode)" in out
+    assert "q 47.0x" in out                      # per-query column
+    assert "q     -" in out                      # pre-finalize: dash
+
+
+def test_bench_accuracy_detail():
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    import bench
+    clear_accuracy()
+    finalize_query("qb", {"output": _r("output", 50.0, 10.0,
+                                       nt="OutputNode")})
+    d = bench._accuracy_detail()
+    assert d["rows"]["records"] == 1
+    assert d["rows"]["worst_q_error"] == 5.0
+    assert d["rows"]["worst_node"] == "output"
+    assert "bytes" not in d                       # unexercised: omitted
+
+
+def test_perfgate_sentinel_gates_q_error_drift():
+    from presto_tpu.exec.perfgate import SENTINEL_SPECS, compare
+    spec = {s.name: s for s in SENTINEL_SPECS}["max_q_error"]
+    assert spec.higher_is_worse is True
+    assert spec.abs_floor == 3.0                  # q-error units
+    # stable small q-errors never gate (inside the floor) ...
+    assert compare(2.0, [1.2, 1.3, 1.2, 1.3, 1.2], spec) is None
+    # ... but a fingerprint whose estimates DEGRADE fires the sentinel
+    v = compare(40.0, [1.2, 1.3, 1.2, 1.3, 1.2], spec)
+    assert v is not None and v["metric"] == "max_q_error"
+
+
+def test_history_record_carries_accuracy_feedback():
+    """The archive record is the per-(fingerprint, plan-node) feedback
+    store: per-node rows with q-errors, the numeric max_q_error in the
+    gated stats, and the misestimated node named when out of band."""
+    from presto_tpu.exec.stats import QueryStats
+    from presto_tpu.server.history import QueryHistoryArchive
+    qs = QueryStats(accuracy={
+        "output": _r("output", 4.0, 3.0, nt="OutputNode"),
+        "J3": _r("J3", 10.0, 470.0, nt="JoinNode")})
+    rec = QueryHistoryArchive.record_of(
+        "qh1", "FINISHED", "u", "SELECT 1", 10.0, "t", query_stats=qs)
+    assert rec["stats"]["max_q_error"] == 47.0
+    assert rec["misestimatedNode"] == "J3"
+    rows = {r["node"]: r for r in rec["accuracy"]}
+    assert rows["J3"]["qError"] == 47.0
+    assert rows["output"]["qError"] == pytest.approx(1.3333, rel=1e-3)
+    # in-band estimates leave the misestimate field empty (silent)
+    clean = QueryHistoryArchive.record_of(
+        "qh2", "FINISHED", "u", "SELECT 1", 10.0, "t",
+        query_stats=QueryStats(accuracy={
+            "output": _r("output", 4.0, 3.0, nt="OutputNode")}))
+    assert clean["misestimatedNode"] == ""
+    assert clean["stats"]["max_q_error"] == pytest.approx(4 / 3,
+                                                          rel=1e-3)
+
+
+# -- distributed: 2-worker stitch ----------------------------------------
+
+
+def test_two_worker_accuracy_records_stitch():
+    """The distributed path: two real workers each run fragment slices;
+    their per-node records ship home on task status (QueryStats) and
+    stitch by the merge law -- the leaf scan's actual adds up to the
+    WHOLE table across both workers' disjoint splits."""
+    from presto_tpu.connectors import tpch
+    from presto_tpu.plan.distribute import add_exchanges
+    from presto_tpu.server import Coordinator, TpuWorkerServer
+    from presto_tpu.sql import plan_sql
+    workers = [TpuWorkerServer(sf=SF).start() for _ in range(2)]
+    coord = Coordinator([f"http://127.0.0.1:{w.port}" for w in workers])
+    try:
+        root = add_exchanges(plan_sql(
+            "SELECT custkey, count(*) AS c FROM orders "
+            "GROUP BY custkey", max_groups=1 << 14))
+        cols, names = coord.execute(root, sf=SF)
+        assert cols
+        qs = coord.last_query_stats
+        assert qs is not None and qs.accuracy
+        scans = [r for k, r in qs.accuracy.items()
+                 if "TableScan[tpch.orders]" in k]
+        assert scans, f"no orders scan record in {sorted(qs.accuracy)}"
+        scan = scans[0]
+        # both workers' slices stitched: actuals ADD to the full table
+        assert scan.actual == tpch.table_row_count("orders", SF)
+        assert scan.tasks >= 2
+        assert scan.est == float(tpch.table_row_count("orders", SF))
+        # every stitched record is attributed (node key + type + unit)
+        for k, r in qs.accuracy.items():
+            assert k and r.node_type and r.unit in UNITS
+        v = misestimate_verdict(qs.accuracy)
+        assert v is not None
+    finally:
+        for w in workers:
+            w.stop()
+
+
+# -- the forced misestimate, end to end ----------------------------------
+
+
+def test_forced_misestimate_named_and_archived_clean_replay_silent():
+    """A stats-free memory-connector table (no NDV statistics) makes
+    the planner's GROUP BY estimate deterministically wrong: 64 rows
+    share ONE key, the planner guesses 64 groups, one comes out -- a
+    64x overestimate the verdict must name, the history archive must
+    record per fingerprint, and /v1/metrics must count. The clean
+    replay (well-estimated tpch query) stays silent."""
+    from presto_tpu import types as T
+    from presto_tpu.client import execute
+    from presto_tpu.connectors import memory
+    from presto_tpu.exec.perfgate import RollingBaseline
+    from presto_tpu.server.history import (QueryHistoryArchive,
+                                           set_history_archive)
+    from presto_tpu.server.statement import StatementServer
+    clear_accuracy()
+    memory.reset()
+    memory.create_table("skew", ["k", "v"], [T.BIGINT, T.BIGINT])
+    archive = QueryHistoryArchive(capacity=32,
+                                  baseline=RollingBaseline(
+                                      min_samples=3))
+    set_history_archive(archive)
+    try:
+        with StatementServer(sf=SF) as srv:
+            execute(srv.url, "INSERT INTO memory.skew VALUES " +
+                    ", ".join(f"(1, {i})" for i in range(64)))
+            r = execute(srv.url, "SELECT k, count(*) AS c "
+                                 "FROM memory.skew GROUP BY k")
+            assert r.data == [[1, 64]]
+            rec = _wait_for(lambda: next(
+                (x for x in archive.records()
+                 if "GROUP BY" in x["query"]), None))
+            assert rec is not None
+            # the verdict names the misestimated node, out of band
+            assert rec["misestimatedNode"] == "output"
+            assert rec["stats"]["max_q_error"] == 64.0
+            rows = {x["node"]: x for x in rec["accuracy"]}
+            assert rows["output"]["direction"] == "over"
+            assert rows["output"]["qError"] == 64.0
+            # per-fingerprint feedback: the baseline absorbed the
+            # q-error sample under the plan fingerprint (ROADMAP 2(c))
+            assert rec["fingerprint"]
+            assert archive.baseline.samples_of(
+                rec["fingerprint"])["max_q_error"] == [64.0]
+            # the misestimate counted on /v1/metrics
+            assert process_totals()["rows"]["over"] >= 1
+            # clean replay: a well-estimated query archives silent
+            r2 = execute(srv.url, "SELECT count(*) FROM region")
+            assert r2.data == [[5]]
+            rec2 = _wait_for(lambda: next(
+                (x for x in archive.records()
+                 if "region" in x["query"]), None))
+            assert rec2 is not None
+            assert rec2["misestimatedNode"] == ""
+            assert rec2["stats"]["max_q_error"] <= 2.0
+    finally:
+        set_history_archive(None)
+        memory.reset()
