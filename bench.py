@@ -526,6 +526,11 @@ def main():
     # captures tail behavior and per-kernel attribution
     latency_smoke = _latency_tail(lambda: run_sql(TPCH_Q1, sf=0.01),
                                   runs=5)
+    # donation A/B at smoke scale: per-query pool peak with the
+    # materialized executor, donation ON -- the perfgate-gated
+    # `peak_memory_mb` sample -- beside the donation-off peak and the
+    # bytes the K006-proven donating dispatches aliased in place
+    donation_smoke = _donation_smoke()
 
     rows_per_sec = n / dt_sql
     baseline_rows_per_sec = n / numpy_s
@@ -562,6 +567,11 @@ def main():
             "timing_fallback": sql_fallback or _TIMING_FALLBACK,
             "telemetry_smoke_sf001": telemetry_smoke,
             "latency_smoke_sf001": latency_smoke,
+            # proven-safe buffer donation (exec/donation.py): the gated
+            # per-query peak rides top-level; the off-peak and donated
+            # bytes ride the subsection for the A/B readout
+            "peak_memory_mb": donation_smoke["peak_memory_mb"],
+            "donation": donation_smoke,
             "top_kernels": _top_kernel_shares(),
             "platform": platform,
             "scoring": scoring,
@@ -578,6 +588,31 @@ def main():
         },
     }
     print(json.dumps(result))
+
+
+def _donation_smoke():
+    """Donation A/B of q1 at smoke scale under the materialized region
+    executor: per-query MemoryPool peak with buffer donation off vs on
+    (strictly lower when a K006-proven donation landed), plus the HBM
+    bytes the donating dispatches aliased in place of fresh outputs."""
+    from presto_tpu.exec.donation import donation_totals
+    from presto_tpu.exec.memory import MemoryPool
+    from presto_tpu.sql import sql as run_sql
+    peaks = {}
+    donated = 0
+    for name, sess in (("off", {"fusion": False}),
+                       ("on", {"fusion": False,
+                               "buffer_donation": True})):
+        pool = MemoryPool(1 << 34)
+        before = donation_totals()["donated_bytes"]
+        run_sql(TPCH_Q1, sf=0.01, session=sess, memory_pool=pool,
+                query_id=f"bench-donation-{name}")
+        peaks[name] = pool.peak_bytes
+        if name == "on":
+            donated = donation_totals()["donated_bytes"] - before
+    return {"peak_memory_mb": round(peaks["on"] / 1e6, 3),
+            "peak_memory_mb_donation_off": round(peaks["off"] / 1e6, 3),
+            "donated_bytes": donated}
 
 
 def _datapath_detail():

@@ -113,7 +113,8 @@ def _fixture_kernel(path: str, budget: int) -> KernelIR:
     ``build() -> (fn, args)``; optional ``TRACE_AXES`` binds mesh axes
     (size-1 each) around the trace, ``MESH_AXES`` is the DECLARED
     exchange spec (defaults to TRACE_AXES), ``FOOTPRINT_BUDGET``
-    overrides the K005 budget."""
+    overrides the K005 budget, ``DONATE_ARGNUMS`` requests buffer
+    donation of those flat arg indices (K006 audits the request)."""
     import importlib.util
 
     abs_path = os.path.abspath(path)
@@ -150,8 +151,13 @@ def _fixture_kernel(path: str, budget: int) -> KernelIR:
             out_specs=P(), check_vma=False)
     else:
         traced = fn
-    return KernelIR.trace(traced, args, label, exchange_axes=declared,
-                          footprint_budget_bytes=budget)
+    kernel = KernelIR.trace(traced, args, label, exchange_axes=declared,
+                            footprint_budget_bytes=budget)
+    donate = getattr(mod, "DONATE_ARGNUMS", None)
+    if donate is not None:
+        kernel.notes["donation_requested"] = tuple(
+            int(i) for i in donate)
+    return kernel
 
 
 def _corpus_kernels(qnums: List[int], sf: float, tier: str,

@@ -2,5 +2,5 @@
 every pass with the audit registry (core.register side effect); add a
 new pass by dropping a module here and importing it below."""
 
-from . import (collectives, footprint, host_callback,  # noqa: F401
-               wide_lanes, widening)
+from . import (collectives, donation, footprint,  # noqa: F401
+               host_callback, wide_lanes, widening)

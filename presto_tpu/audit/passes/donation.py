@@ -1,0 +1,175 @@
+"""K006 donation safety + K007 baked-constant bloat.
+
+Buffer donation (``jax.jit(..., donate_argnums=...)``) lets XLA reuse
+an input's HBM for an output, cutting a fused region's peak residency
+by up to the donated bytes -- but a donation that XLA cannot honor is
+silently copied (the saving evaporates) and a donation the ENGINE
+cannot honor (the host still holds a live reference to the batch) is
+a use-after-free. The proof obligation splits in two:
+
+  * IR side (K006, here): the donated input must be aliasable AT ALL
+    -- it must not be returned unchanged (a passthrough output IS the
+    input buffer; nothing can be aliased into it), and some output
+    must carry the identical shape+dtype so XLA has a slot to alias it
+    into. Greedy first-fit matching over the top-level jaxpr's
+    flattened invars/outvars (the order ``jax.tree_util.tree_leaves``
+    produces, which is what ``exec/donation.py`` flattens at dispatch
+    time).
+  * engine side (exec/donation.py + exec/runner.py): the staged batch
+    must be dead after dispatch -- reference counting over the plan's
+    region wiring, NOT an IR property.
+
+K006 ALWAYS writes the machine-readable plan to
+``kernel.notes["donation_plan"]`` (the exec tier's feed) and only
+REPORTS when a donation was requested (``kernel.notes
+["donation_requested"]``, e.g. a fixture's ``DONATE_ARGNUMS``) that
+the proof cannot back -- a requested-but-unprovable donation is the
+bug class; an undonated kernel is merely unoptimized.
+
+K007 flags large arrays captured as jaxpr CONSTANTS instead of
+arguments: every compiled variant (and the plan cache keeps one per
+batch shape / kernel-mode key) bakes its own HBM copy, invisible to
+the memory pool's accounting. Constants are how weights leak into
+query kernels -- TPC-H lowering passes every relation as an argument,
+so any large const in the corpus is a planner bug.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..core import AuditPass, KernelIR, register
+from .footprint import _aval_bytes
+
+__all__ = ["DonationSafetyPass", "BakedConstPass", "donation_plan",
+           "K007_CONST_BYTES"]
+
+# a baked constant smaller than this is a literal table (format
+# strings, month lengths, ...) -- flagging those would be noise
+K007_CONST_BYTES = 1 << 20
+
+
+def _shape_dtype(v) -> Tuple[Optional[tuple], Optional[str]]:
+    a = getattr(v, "aval", None)
+    shape = getattr(a, "shape", None)
+    dt = getattr(a, "dtype", None)
+    return (tuple(shape) if shape is not None else None,
+            str(dt) if dt is not None else None)
+
+
+def donation_plan(jaxpr) -> Dict[str, list]:
+    """Prove which top-level invars are safely donatable: not a
+    passthrough output, and shape+dtype-identical to some output that
+    is not itself a passthrough. Greedy first-fit; indices are FLAT
+    leaf positions (``jax.tree_util.tree_leaves`` order)."""
+    invar_ids = {id(v) for v in jaxpr.invars}
+    consumed = {id(v) for _e in jaxpr.eqns for v in _e.invars}
+    # eligible alias targets: outputs that are NOT passthrough inputs
+    # (a passthrough output's buffer IS its input's -- nothing else
+    # can be aliased into it)
+    targets: List[Tuple[int, tuple, str]] = []
+    for j, ov in enumerate(jaxpr.outvars):
+        if id(ov) in invar_ids:
+            continue
+        shape, dt = _shape_dtype(ov)
+        if shape is None or dt is None:
+            continue
+        targets.append((j, shape, dt))
+    out_ids = {id(v) for v in jaxpr.outvars}
+    donatable: List[dict] = []
+    rejected: List[dict] = []
+    claimed: set = set()
+    for i, iv in enumerate(jaxpr.invars):
+        shape, dt = _shape_dtype(iv)
+        if shape is None or dt is None:
+            rejected.append({"arg": i, "reason": "abstract input"})
+            continue
+        if id(iv) in out_ids:
+            rejected.append({"arg": i,
+                             "reason": "returned unchanged (passthrough "
+                                       "output is the input buffer)"})
+            continue
+        if id(iv) not in consumed:
+            rejected.append({"arg": i,
+                             "reason": "never consumed (nothing to "
+                                       "alias it into)"})
+            continue
+        match = next((j for j, s, d in targets
+                      if j not in claimed and s == shape and d == dt),
+                     None)
+        if match is None:
+            rejected.append({"arg": i,
+                             "reason": f"no unclaimed output with shape "
+                                       f"{shape} dtype {dt}"})
+            continue
+        claimed.add(match)
+        donatable.append({"arg": i, "out": match,
+                          "bytes": _aval_bytes(iv),
+                          "shape": list(shape), "dtype": dt})
+    return {"version": 1, "donatable": donatable, "rejected": rejected}
+
+
+@register
+class DonationSafetyPass(AuditPass):
+    code = "K006"
+    name = "donation-safety"
+    description = ("prove which jit inputs are aliasable into an "
+                   "output (donation plan in kernel notes); requested "
+                   "donations the proof cannot back are findings")
+
+    def run(self, kernel: KernelIR) -> List:
+        plan = donation_plan(kernel.jaxpr)
+        kernel.notes["donation_plan"] = plan
+        requested = kernel.notes.get("donation_requested")
+        if not requested:
+            return []
+        proven = {d["arg"] for d in plan["donatable"]}
+        reasons = {r["arg"]: r["reason"] for r in plan["rejected"]}
+        findings = []
+        for i in requested:
+            i = int(i)
+            if i in proven:
+                continue
+            if 0 <= i < len(kernel.jaxpr.invars):
+                shape, dt = _shape_dtype(kernel.jaxpr.invars[i])
+                what = f"arg {i} ({dt}{list(shape or ())})"
+                why = reasons.get(i, "not a provable alias")
+            else:
+                what = f"arg {i}"
+                why = (f"index out of range (kernel takes "
+                       f"{len(kernel.jaxpr.invars)} flat inputs)")
+            findings.append(kernel.kernel_finding(
+                "K006",
+                f"requested donation of {what} is not provably safe: "
+                f"{why} -- XLA would silently copy (or worse, the "
+                f"engine would free a live buffer); drop it from "
+                f"donate_argnums or restructure the kernel"))
+        return findings
+
+
+@register
+class BakedConstPass(AuditPass):
+    code = "K007"
+    name = "baked-constant-bloat"
+    description = ("large arrays captured as jaxpr constants instead "
+                   "of arguments (silent HBM duplication per compiled "
+                   "variant, invisible to pool accounting)")
+
+    def run(self, kernel: KernelIR) -> List:
+        findings = []
+        total = 0
+        for cv in kernel.jaxpr.constvars:
+            nbytes = _aval_bytes(cv)
+            total += nbytes
+            if nbytes < K007_CONST_BYTES:
+                continue
+            shape, dt = _shape_dtype(cv)
+            findings.append(kernel.kernel_finding(
+                "K007",
+                f"kernel bakes a {dt}{list(shape or ())} constant "
+                f"({nbytes} bytes) into the compiled program -- every "
+                f"compiled variant duplicates it in HBM outside pool "
+                f"accounting; pass it as an argument (or shrink it "
+                f"below {K007_CONST_BYTES} bytes)"))
+        kernel.notes["baked_const_bytes"] = total
+        return findings

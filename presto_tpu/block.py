@@ -287,6 +287,20 @@ def _pad(arr: np.ndarray, capacity: int, fill=0) -> np.ndarray:
     return np.pad(arr, pad_width, constant_values=fill)
 
 
+def _pad_cast(arr: np.ndarray, capacity: int, dt, fill=0) -> np.ndarray:
+    """Fused cast+pad: allocate the (capacity, ...) staging buffer at
+    the target dtype once and slice-assign into it, instead of the
+    cast-then-pad chain that materializes two host copies of the same
+    column (M003 copy amplification)."""
+    dt = np.dtype(dt)
+    n = arr.shape[0]
+    if n == capacity and arr.dtype == dt:
+        return arr
+    out = np.full((capacity,) + arr.shape[1:], fill, dtype=dt)
+    out[:n] = arr
+    return out
+
+
 def from_numpy(ty: T.Type, values: np.ndarray, nulls: Optional[np.ndarray] = None,
                capacity: Optional[int] = None,
                physical_dtype=None) -> Block:
@@ -386,7 +400,7 @@ def from_numpy(ty: T.Type, values: np.ndarray, nulls: Optional[np.ndarray] = Non
             nulls = np.array([v is None for v in values], dtype=bool)
         else:
             nulls = np.zeros(n, dtype=bool)
-    nulls = _pad(nulls.astype(bool), capacity, fill=True)
+    nulls = _pad_cast(nulls, capacity, bool, fill=True)
     if ty.is_string and values.dtype != np.uint8:
         encoded = [str(v).encode("utf-8") if v is not None else b"" for v in values]
         max_len = max((len(b) for b in encoded), default=1) or 1
@@ -423,7 +437,7 @@ def from_numpy(ty: T.Type, values: np.ndarray, nulls: Optional[np.ndarray] = Non
                             jnp.asarray(nulls), ty)
     dt = np.dtype(physical_dtype) if physical_dtype is not None \
         else ty.to_dtype()
-    values = _pad(np.asarray(values, dtype=dt), capacity)
+    values = _pad_cast(values, capacity, dt)
     return Column(jnp.asarray(values), jnp.asarray(nulls), ty)
 
 

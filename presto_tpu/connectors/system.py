@@ -176,6 +176,11 @@ def _compile_us_of(query_stats_doc: dict) -> int:
 
 
 def _rows_of(table: str) -> List[tuple]:
+    # M001: system tables surface CAPPED registries -- the history
+    # archive is retention-capped, profiler/cache registries are
+    # entry-capped -- so one snapshot list per request is bounded
+    _BOUNDED_BY = {"out": "capped registry snapshot (history "
+                          "retention / profiler entry caps)"}
     if table == "queries":
         out = []
         with _lock:

@@ -370,6 +370,8 @@ def finalize_query(query_id: str,
     into the process totals, the ``presto_tpu_q_error`` histogram,
     and the bounded per-query registry. Never raises -- the runner
     calls this on every exit path."""
+    # M001: one record per PLAN NODE of one query, not per row
+    _BOUNDED_BY = {"observed": "one q-error sample per plan node"}
     try:
         note_query(query_id, records)
         observed = []
@@ -468,6 +470,8 @@ def misestimate_verdict(records,
     identical records always name the same node. None when no record
     has both sides. Deterministic tiebreak: q-error desc, node key
     asc."""
+    # M001: one candidate per PLAN NODE of one query
+    _BOUNDED_BY = {"rows": "one verdict candidate per plan node"}
     rows = []
     for node, r in dict(records).items():
         f = _as_fields(node, r)

@@ -1,0 +1,213 @@
+"""Proven-safe buffer donation for region dispatches.
+
+Closing the allocguard loop: kernaudit K006 (audit/passes/donation.py)
+proves, per region program, which jit inputs are aliasable into an
+output (shape+dtype-identical, not a passthrough); THIS module carries
+the engine-side half of the proof obligation and applies the plan:
+
+  * **engine deadness** -- only region-boundary intermediates whose
+    LAST consumer is the dispatching region are candidates (the
+    executor's refcounts, exec/runner._execute_regions). Scan-leaf
+    batches are never donated: the host tier may still hold references
+    (staging stats, fragment caches, test harnesses).
+  * **overflow-incapable regions only** -- the rerun ladder re-reads
+    the SAME input batches after a capacity overflow, which would be a
+    use-after-free on donated buffers; a region whose operators cannot
+    set overflow flags (filter/project/output/limit chains) is the
+    donation surface.
+  * **fallback, never failure** -- any error on the donation path
+    (including the ``donation.apply`` failpoint) collapses to the
+    normal undonated dispatch BEFORE any buffer is consumed, counted
+    in ``presto_tpu_donation_fallbacks_total``.
+
+The donating form compiles a separate wrapper program
+(``donate_argnums=0`` over the dead-leaf tuple), memoized per (region
+fingerprint, input signature, dead-leaf set); ``PRESTO_TPU_DONATION``
+is registered in KERNEL_MODE_ENVS so the mode keys every cached
+executable. HBM savings surface in the memory pool's per-query peak
+(the intermediate's reservation shrinks by the donated bytes) and the
+``presto_tpu_donated_bytes_total`` counter, gated by perfgate's
+``peak_memory_bytes`` band.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .. import failpoints
+from ..plan import nodes as N
+from ..utils.locks import OrderedLock
+
+__all__ = ["DONATION_ENV", "donation_enabled", "overflow_incapable",
+           "prepare_donation", "PreparedDonation", "donation_totals",
+           "note_donation", "note_fallback", "clear_donation_state"]
+
+DONATION_ENV = "PRESTO_TPU_DONATION"
+
+_LEAF_TYPES = (N.TableScanNode, N.ValuesNode, N.RemoteSourceNode)
+
+# operators that can NEVER set an overflow flag: pure mask/compute
+# chains with no capacity-bounded state (joins, group tables, unnest
+# and exchanges are the overflow producers -- see the dispatch
+# ladder). Conservative by construction: an absent node type means
+# "no donation", never a use-after-free.
+_OVERFLOW_FREE = (N.FilterNode, N.ProjectNode, N.OutputNode,
+                  N.LimitNode)
+
+
+def donation_enabled(session) -> bool:
+    """Session property ``buffer_donation``; process default from
+    PRESTO_TPU_DONATION (default OFF). Spelled literally so tpulint
+    R001 proves the knob is registered in KERNEL_MODE_ENVS."""
+    import os
+    env_on = os.environ.get("PRESTO_TPU_DONATION", "0") \
+        not in ("0", "", "false")
+    from ..utils.config import session_flag
+    return session_flag(session, "buffer_donation", env_on)
+
+
+def overflow_incapable(root: N.PlanNode) -> bool:
+    """True when every operator in the region subtree is on the
+    overflow-free whitelist (leaves excepted) -- the static half of
+    the donation-safety proof the rerun ladder demands."""
+    if isinstance(root, _LEAF_TYPES):
+        return True
+    if not isinstance(root, _OVERFLOW_FREE):
+        return False
+    return all(overflow_incapable(s) for s in root.sources)
+
+
+# -- process totals (/v1/metrics presto_tpu_donation* families) ---------
+
+# tpulint C001: dispatch threads bump, scrape threads read
+_TOTALS_GUARDED_BY = {"_TOTALS_LOCK": ("_TOTALS",)}
+_TOTALS_LOCK = OrderedLock("donation._TOTALS_LOCK")
+_TOTALS = {"donations": 0, "donated_bytes": 0, "fallbacks": 0}
+
+
+def note_donation(nbytes: int, leaves: int = 0) -> None:
+    with _TOTALS_LOCK:
+        _TOTALS["donations"] += 1
+        _TOTALS["donated_bytes"] += int(nbytes)
+
+
+def note_fallback() -> None:
+    with _TOTALS_LOCK:
+        _TOTALS["fallbacks"] += 1
+
+
+def donation_totals() -> Dict[str, int]:
+    with _TOTALS_LOCK:
+        return dict(_TOTALS)
+
+
+# -- donation-plan memo + donating-wrapper cache ------------------------
+
+_MEMO_LOCK = OrderedLock("donation._MEMO_LOCK")
+_MEMO_GUARDED_BY = {"_MEMO_LOCK": ("_MEMO",)}
+_MEMO: "collections.OrderedDict[tuple, Optional[PreparedDonation]]" = \
+    collections.OrderedDict()
+_MEMO_CAP = 256
+
+
+def clear_donation_state() -> None:
+    """Tests: drop the wrapper memo and zero the process totals."""
+    with _MEMO_LOCK:
+        _MEMO.clear()
+    with _TOTALS_LOCK:
+        for k in _TOTALS:
+            _TOTALS[k] = 0
+
+
+class PreparedDonation:
+    """A memoized donating dispatch: the jitted wrapper (its leading
+    tuple argument is donated), the flat leaf indices it donates, and
+    the bytes donation saves. One instance per (fingerprint,
+    signature, dead-leaf set) -- reusing the same callable keeps the
+    jit executable cache warm across queries."""
+
+    __slots__ = ("wrapper", "donate_idx", "donated_bytes", "_treedef")
+
+    def __init__(self, fn, treedef, nleaves: int,
+                 donate_idx: Tuple[int, ...], donated_bytes: int):
+        import jax
+        self.donate_idx = donate_idx
+        self.donated_bytes = int(donated_bytes)
+        self._treedef = treedef
+        donate_set = frozenset(donate_idx)
+        kept_idx = tuple(i for i in range(nleaves)
+                         if i not in donate_set)
+
+        def _call(donated, kept):
+            leaves: List = [None] * nleaves
+            for i, leaf in zip(donate_idx, donated):
+                leaves[i] = leaf
+            for i, leaf in zip(kept_idx, kept):
+                leaves[i] = leaf
+            return fn(jax.tree_util.tree_unflatten(treedef, leaves))
+
+        self.wrapper = jax.jit(_call, donate_argnums=0)
+
+    def dispatch(self, batches: Sequence):
+        """Run the donating form over `batches` (same structure the
+        plan memoized on). The donated leaves are DEAD to the caller
+        after this returns."""
+        import warnings
+
+        import jax
+        leaves = jax.tree_util.tree_leaves(tuple(batches))
+        donate_set = frozenset(self.donate_idx)
+        donated = tuple(leaves[i] for i in self.donate_idx)
+        kept = tuple(leaf for i, leaf in enumerate(leaves)
+                     if i not in donate_set)
+        with warnings.catch_warnings():
+            # CPU backends ignore donation ("Some donated buffers were
+            # not usable") -- the aliasing only lands on TPU; the
+            # ledger model is the TPU behavior either way
+            warnings.filterwarnings("ignore", message=".*[Dd]onat.*")
+            return self.wrapper(donated, kept)
+
+
+def _signature(leaves) -> tuple:
+    return tuple((tuple(leaf.shape), str(leaf.dtype)) for leaf in leaves)
+
+
+def prepare_donation(rfp: str, fn, batches: Sequence,
+                     dead_leaf_idx: Sequence[int]
+                     ) -> Optional[PreparedDonation]:
+    """Build (or recall) the donating dispatch for one region program:
+    intersect the K006 aliasing proof over ``fn``'s jaxpr with the
+    engine's dead-leaf set and wrap the provable subset in a
+    ``donate_argnums`` jit. Returns None when nothing is provably
+    donatable. Errors (including the ``donation.apply`` failpoint)
+    propagate -- the caller falls back to the undonated dispatch;
+    no buffer has been consumed yet."""
+    if failpoints.ARMED:
+        failpoints.hit("donation.apply")
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tuple(batches))
+    dead = frozenset(int(i) for i in dead_leaf_idx)
+    key = (rfp, _signature(leaves), tuple(sorted(dead)))
+    with _MEMO_LOCK:
+        if key in _MEMO:
+            _MEMO.move_to_end(key)
+            return _MEMO[key]
+
+    from ..audit.passes.donation import donation_plan
+    closed = jax.make_jaxpr(fn)(tuple(batches))
+    plan = donation_plan(closed.jaxpr)
+    chosen = [d for d in plan["donatable"] if d["arg"] in dead]
+    prepared: Optional[PreparedDonation] = None
+    if chosen:
+        prepared = PreparedDonation(
+            fn, treedef, len(leaves),
+            donate_idx=tuple(sorted(d["arg"] for d in chosen)),
+            donated_bytes=sum(d["bytes"] for d in chosen))
+    with _MEMO_LOCK:
+        _MEMO[key] = prepared
+        _MEMO.move_to_end(key)
+        while len(_MEMO) > _MEMO_CAP:
+            _MEMO.popitem(last=False)
+    return prepared

@@ -187,6 +187,23 @@ class MemoryPool:
                 self._reserved[query_id] = cur - bytes_
             self._cv.notify_all()  # admission waiters re-check
 
+    def note_usage(self, query_id: str, bytes_: int):
+        """Unconditional observed-usage accounting (NO admission
+        control): record bytes XLA has already materialized -- region
+        -boundary intermediates in the per-op executor -- against the
+        query's ledger and both high-water marks. Admission happens
+        up-front on planned scan footprints; refusing a query over an
+        intermediate that already exists on device would abort work
+        the pool cannot reclaim anyway. Never blocks, never raises;
+        pair every call with free()."""
+        with self._cv:
+            mine = self._reserved.get(query_id, 0) + int(bytes_)
+            self._reserved[query_id] = mine
+            total = sum(self._reserved.values())
+            self.peak_bytes = max(self.peak_bytes, total)
+            self._query_peak[query_id] = max(
+                self._query_peak.get(query_id, 0), mine)
+
     def query_bytes(self, query_id: str) -> int:
         with self._lock:
             return self._reserved.get(query_id, 0)

@@ -111,6 +111,14 @@ BENCH_SPECS: Sequence[MetricSpec] = (
     # --update-baseline on.
     MetricSpec("staging_gb_per_s", higher_is_worse=False,
                rel_threshold=0.5, abs_floor=0.0),
+    # per-query pool peak under the materialized executor with buffer
+    # donation ON (bench.py donation smoke): the HBM-headroom number
+    # proven-safe donation exists to shrink. Deterministic per (query,
+    # kernel mode) like staged_mb, so the band is tight -- losing a
+    # donation (a K006 proof that stops holding, an eligibility
+    # regression) shows up as a step UP in this metric.
+    MetricSpec("peak_memory_mb", rel_threshold=0.10, abs_floor=4.0,
+               mad_k=3.0),
 )
 
 # MAD -> sigma consistency constant for normally distributed noise

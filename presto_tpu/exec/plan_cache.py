@@ -131,7 +131,13 @@ KERNEL_MODE_ENVS = (("PRESTO_TPU_SMALLG", "auto"),
                     # batched dispatch traces a vmapped program over the
                     # parameter axis, so the mode is part of every batch
                     # key (and rides the one R001-checked env list)
-                    ("PRESTO_TPU_BATCHING", "1"))
+                    ("PRESTO_TPU_BATCHING", "1"),
+                    # proven-safe buffer donation (exec/donation.py):
+                    # the donating dispatch compiles a separate wrapper
+                    # program (donate_argnums over the dead leaves), so
+                    # the mode is part of every cached key (and the env
+                    # read rides the one R001-checked list)
+                    ("PRESTO_TPU_DONATION", "0"))
 
 
 def _kernel_mode() -> str:

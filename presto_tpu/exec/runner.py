@@ -45,6 +45,9 @@ class QueryResult:
     query_stats: Optional[QueryStats] = None
 
     def rows(self) -> List[tuple]:
+        # M001: the caller asked for the FINAL RESULT as Python
+        # rows -- output cardinality, already materialized above
+        _BOUNDED_BY = {"out": "final result rows (caller-requested materialization)"}
         out = []
         for i in range(self.row_count):
             out.append(tuple(None if self.nulls[c][i] else self.columns[c][i]
@@ -55,6 +58,8 @@ class QueryResult:
         """Order-independent, stringified rows for oracle comparison
         (floats rounded so summation order cannot flip a digit) -- the
         ONE canonicalization the fusion A/B surfaces share."""
+        # M001: same output surface as rows() above
+        _BOUNDED_BY = {"out": "final result rows (oracle canonicalization)"}
         out = []
         for i in range(self.row_count):
             row = []
@@ -913,11 +918,18 @@ def _execute_regions(rplan, scan_leaves, batches, default_join_capacity,
 
     Returns (final output Batch, total device seconds, total compile
     micros drained so far)."""
+    import contextlib
+
     from ..audit.staged import audit_staged_query, kernel_audit_enabled
+    from ..server.flight_recorder import record_event
     from ..server.tracing import TraceContext as _TC
     from ..utils.config import session_flag
     from .accuracy import est_rows_of as _acc_est
     from .accuracy import record_node as _acc_record
+    from .donation import (donation_enabled, note_donation,
+                           note_fallback, overflow_incapable,
+                           prepare_donation)
+    from .memory import batch_bytes
     from .plan_cache import plan_fingerprint
     from .profiler import note_footprint, plan_label, plan_tables, \
         record_call
@@ -936,78 +948,170 @@ def _execute_regions(rplan, scan_leaves, batches, default_join_capacity,
     total_compile_us = 0
     audit_on = kernel_audit_enabled(session)
     cost_on = session_flag(session, "query_cost_analysis", False)
+    donate_on = donation_enabled(session)
+    # region-boundary intermediates are real HBM the fused path never
+    # materializes: account them against the pool as OBSERVED usage
+    # (note_usage, not admission) so the per-query peak reflects the
+    # live set -- and shrinks by the donated bytes when donation
+    # aliases a dead input into the region's output. The finally
+    # balances whatever is still accounted (the caller's bulk free
+    # only covers staged scans).
+    inter_bytes: Dict[int, int] = {}
     nreg = len(rplan.regions)
-    for reg in rplan.regions:
-        rbatches = [staged_by_id[id(i.node)] if i.kind == "scan"
-                    else outputs[i.region] for i in reg.inputs]
-        plan, jfn, call_lock = _compile_any(reg.root, None,
-                                            default_join_capacity, 1,
-                                            use_cache)
-        rfp = plan_fingerprint(reg.root)
-        if audit_on:
-            with stats.timed("kernel_audit_s"):
-                report = audit_staged_query(
-                    plan, rbatches, mesh=None, query_id=query_id,
-                    session=session, collector=collector, stats=stats,
-                    memory_pool=memory_pool, plan_fp=rfp)
-            if report and report.get("peak_bytes_estimate"):
-                fusion_memory().note_footprint(
-                    rfp, report["peak_bytes_estimate"])
-                if prof_on:
-                    note_footprint(rfp, report["peak_bytes_estimate"])
-                # per-region K005 estimate: region estimates fold by
-                # max into ONE query-level footprint record (the pool
-                # measures one per-query peak, and intermediates drop
-                # past their last consumer, so max is the honest
-                # planned-peak bound)
-                _acc_record("footprint", "MemoryPool", unit="bytes",
-                            est=float(report["peak_bytes_estimate"]))
-        out, dev_s, dispatch_fn, dlock, cap_scale, scale, _ = \
-            _dispatch_ladder(
-                reg.root, plan, jfn, call_lock, rbatches, None,
-                default_join_capacity, use_cache, rfp, stats,
-                adaptive_off, refine, prog)
-        if cost_on and collector is not None:
-            # per-region XLA cost analysis: the fused path's FLOPs /
-            # bytes-accessed split, summed region by region so EXPLAIN
-            # ANALYZE keeps its compile-stage roofline inputs under
-            # fusion=0 / refusal / demotion
-            cost = _stage_cost(dispatch_fn, rbatches,
-                               (rfp, cap_scale, scale), dlock)
-            if cost:
-                collector.bump_stage("compile", **cost)
-                stats.add("xla_flops", cost["flops"])
-        outputs[reg.index] = out
-        # region-boundary estimate-vs-actual: the region root's planner
-        # estimate against the rows its program actually emitted (join
-        # build sides that partition into their own region are
-        # attributed here; the dispatch already synced, so reading the
-        # active mask costs one small host transfer, not a block)
-        _acc_record(f"region[{reg.tag}]:{type(reg.root).__name__}",
-                    type(reg.root).__name__, unit="rows",
-                    est=_acc_est(reg.root, sf),
-                    actual=int(np.asarray(out.active).sum()))
-        for i in reg.inputs:  # drop intermediates past their last use
-            if i.kind == "region":
-                consumers[i.region] -= 1
-                if consumers[i.region] == 0:
-                    outputs.pop(i.region, None)
-        total_device_s += dev_s
-        # incremental compile drain: what accumulated since the last
-        # region dispatched is this region's trace+compile share
-        cu = collector.take_compile_us() if collector is not None else 0
-        total_compile_us += cu
-        dev_us = max(int(dev_s * 1e6) - cu, 0)
-        stats.add(f"fusion_region_{reg.tag}_device_us", dev_us)
-        if prof_on:
-            record_call(
-                rfp,
-                label=(f"{plan_label(reg.root, max_len=120)} "
-                       f"[region {reg.tag}/{nreg}]"),
-                tables=plan_tables(reg.root),
-                device_us=dev_us, retraced=cu > 0, query_id=query_id,
-                trace_id=trace_id.trace_id if isinstance(trace_id, _TC)
-                else (trace_id or query_id))
+    try:
+        for reg in rplan.regions:
+            rbatches = [staged_by_id[id(i.node)] if i.kind == "scan"
+                        else outputs[i.region] for i in reg.inputs]
+            plan, jfn, call_lock = _compile_any(reg.root, None,
+                                                default_join_capacity, 1,
+                                                use_cache)
+            rfp = plan_fingerprint(reg.root)
+            if audit_on:
+                with stats.timed("kernel_audit_s"):
+                    report = audit_staged_query(
+                        plan, rbatches, mesh=None, query_id=query_id,
+                        session=session, collector=collector, stats=stats,
+                        memory_pool=memory_pool, plan_fp=rfp)
+                if report and report.get("peak_bytes_estimate"):
+                    fusion_memory().note_footprint(
+                        rfp, report["peak_bytes_estimate"])
+                    if prof_on:
+                        note_footprint(rfp, report["peak_bytes_estimate"])
+                    # per-region K005 estimate: region estimates fold by
+                    # max into ONE query-level footprint record (the pool
+                    # measures one per-query peak, and intermediates drop
+                    # past their last consumer, so max is the honest
+                    # planned-peak bound)
+                    _acc_record("footprint", "MemoryPool", unit="bytes",
+                                est=float(report["peak_bytes_estimate"]))
+            # -- proven-safe buffer donation (exec/donation.py) ----------
+            # engine half of the K006 proof: candidates are region-kind
+            # inputs whose LAST consumer is this region, fed exactly once,
+            # under an overflow-incapable root (the rerun ladder re-reads
+            # inputs after overflow -- donated buffers would be freed)
+            prep = None
+            donated_nbytes = 0
+            if donate_on and overflow_incapable(reg.root):
+                region_uses: Dict[int, int] = {}
+                for i in reg.inputs:
+                    if i.kind == "region":
+                        region_uses[i.region] = \
+                            region_uses.get(i.region, 0) + 1
+                dead_idx: list = []
+                pos = 0
+                for i, b in zip(reg.inputs, rbatches):
+                    nleaves = len(jax.tree_util.tree_leaves(b))
+                    if (i.kind == "region" and consumers[i.region] == 1
+                            and region_uses[i.region] == 1):
+                        dead_idx.extend(range(pos, pos + nleaves))
+                    pos += nleaves
+                if dead_idx:
+                    try:
+                        with (call_lock if call_lock is not None
+                              else contextlib.nullcontext()):
+                            prep = prepare_donation(rfp, plan.fn,
+                                                    rbatches, dead_idx)
+                    except Exception as e:
+                        # fallback, never failure: nothing was consumed
+                        # yet, the undonated dispatch below is untouched
+                        prep = None
+                        note_fallback()
+                        stats.add("donation_fallbacks", 1)
+                        if collector is not None:
+                            collector.note("donation_fallbacks", 1)
+                        record_event("donation_fallback",
+                                     query_id=query_id, region=reg.tag,
+                                     reason=str(e)[:200])
+            if prep is not None:
+                t_don0 = time.time()
+                with (call_lock if call_lock is not None
+                      else contextlib.nullcontext()):
+                    out, overflow = prep.dispatch(rbatches)
+                jax.block_until_ready(out)
+                dev_s = time.time() - t_don0
+                if prog is not None:
+                    prog.advance()
+                oflags = int(np.asarray(overflow))
+                if oflags:  # unreachable: whitelist admits no overflow op
+                    raise RuntimeError(
+                        f"donated region {reg.tag} set overflow flags "
+                        f"{oflags}; the overflow-incapable whitelist is "
+                        f"wrong -- this is a bug, not a capacity problem")
+                donated_nbytes = prep.donated_bytes
+                note_donation(donated_nbytes, len(prep.donate_idx))
+                stats.add("donations", 1)
+                stats.add("donated_bytes", donated_nbytes)
+                if collector is not None:
+                    collector.note("donations", 1)
+                    collector.note("donated_bytes", donated_nbytes)
+                record_event("buffer_donation", query_id=query_id,
+                             region=reg.tag, bytes=donated_nbytes,
+                             leaves=len(prep.donate_idx))
+                dispatch_fn = None
+            else:
+                out, dev_s, dispatch_fn, dlock, cap_scale, scale, _ = \
+                    _dispatch_ladder(
+                        reg.root, plan, jfn, call_lock, rbatches, None,
+                        default_join_capacity, use_cache, rfp, stats,
+                        adaptive_off, refine, prog)
+            if cost_on and collector is not None and dispatch_fn is not None:
+                # per-region XLA cost analysis: the fused path's FLOPs /
+                # bytes-accessed split, summed region by region so EXPLAIN
+                # ANALYZE keeps its compile-stage roofline inputs under
+                # fusion=0 / refusal / demotion
+                cost = _stage_cost(dispatch_fn, rbatches,
+                                   (rfp, cap_scale, scale), dlock)
+                if cost:
+                    collector.bump_stage("compile", **cost)
+                    stats.add("xla_flops", cost["flops"])
+            outputs[reg.index] = out
+            if memory_pool is not None and consumers.get(reg.index, 0) > 0:
+                # intermediate output: new HBM is its footprint minus the
+                # donated bytes its program aliased in place
+                held = max(batch_bytes(out) - donated_nbytes, 0)
+                if held:
+                    memory_pool.note_usage(query_id, held)
+                    inter_bytes[reg.index] = held
+            # region-boundary estimate-vs-actual: the region root's planner
+            # estimate against the rows its program actually emitted (join
+            # build sides that partition into their own region are
+            # attributed here; the dispatch already synced, so reading the
+            # active mask costs one small host transfer, not a block)
+            _acc_record(f"region[{reg.tag}]:{type(reg.root).__name__}",
+                        type(reg.root).__name__, unit="rows",
+                        est=_acc_est(reg.root, sf),
+                        actual=int(np.asarray(out.active).sum()))
+            for i in reg.inputs:  # drop intermediates past their last use
+                if i.kind == "region":
+                    consumers[i.region] -= 1
+                    if consumers[i.region] == 0:
+                        outputs.pop(i.region, None)
+                        freed = inter_bytes.pop(i.region, 0)
+                        if memory_pool is not None and freed:
+                            memory_pool.free(query_id, freed)
+            total_device_s += dev_s
+            # incremental compile drain: what accumulated since the last
+            # region dispatched is this region's trace+compile share
+            cu = collector.take_compile_us() if collector is not None else 0
+            total_compile_us += cu
+            dev_us = max(int(dev_s * 1e6) - cu, 0)
+            stats.add(f"fusion_region_{reg.tag}_device_us", dev_us)
+            if prof_on:
+                record_call(
+                    rfp,
+                    label=(f"{plan_label(reg.root, max_len=120)} "
+                           f"[region {reg.tag}/{nreg}]"),
+                    tables=plan_tables(reg.root),
+                    device_us=dev_us, retraced=cu > 0, query_id=query_id,
+                    trace_id=trace_id.trace_id if isinstance(trace_id, _TC)
+                    else (trace_id or query_id))
+    finally:
+        # no residue may leak into the pool's per-query ledger: the
+        # caller's finally frees exactly the staged-scan reservation
+        if memory_pool is not None:
+            leftover = sum(inter_bytes.values())
+            if leftover:
+                memory_pool.free(query_id, leftover)
     # materialized-baseline sample for the demotion comparator: the
     # whole span just ran with materialized boundaries, so its total
     # device time is the unfused side of the span's fused-vs-unfused

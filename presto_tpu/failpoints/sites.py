@@ -120,6 +120,14 @@ SITES: Dict[str, Tuple[str, str]] = {
         "to DEMOTE mid-query -- the query re-partitions and runs with "
         "materialized boundaries, and the demotion sticks for later "
         "submissions (exec/regions.FusionMemory)"),
+    "donation.apply": (
+        "fusion",
+        "buffer-donation prepare step (exec/donation.prepare_donation, "
+        "before any buffer is consumed): an error action collapses the "
+        "region to the normal undonated dispatch -- results must still "
+        "match the donation-off oracle, the fallback is counted "
+        "presto_tpu_donation_fallbacks_total and recorded as a "
+        "donation_fallback flight event"),
 }
 
 

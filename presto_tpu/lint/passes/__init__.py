@@ -2,5 +2,5 @@
 pass with the core registry (core.register decorator side effect); add
 a new pass by dropping a module here and importing it below."""
 
-from . import (blocking, host_sync, lock_order, locks, retrace,  # noqa: F401
-               swallowed, threads, wide_lanes)
+from . import (alloc, blocking, host_sync, lock_order, locks,  # noqa: F401
+               retrace, swallowed, threads, wide_lanes)

@@ -139,10 +139,12 @@ class PageCodec:
 
 def _bitpack_nulls(nulls: np.ndarray) -> bytes:
     """has-nulls byte + big-endian-bit packed null flags (spec: first
-    flag of each byte is the high bit)."""
+    flag of each byte is the high bit). Accepts any 0/1 mask dtype;
+    the one host conversion happens here, so callers must not
+    pre-convert (M003 copy amplification)."""
     if not nulls.any():
         return b"\x00"
-    return b"\x01" + np.packbits(nulls.astype(np.uint8)).tobytes()
+    return b"\x01" + np.packbits(np.asarray(nulls, dtype=np.uint8)).tobytes()
 
 
 def _bitunpack_nulls(buf: memoryview, pos: int, rows: int
@@ -191,7 +193,7 @@ def _serialize_int128(vals: np.ndarray, nulls: np.ndarray) -> bytes:
         pairs[i, 1] = np.uint64((v >> 64) & ((1 << 64) - 1))
     return b"".join([struct.pack("<i", len(enc)), enc,
                      struct.pack("<i", rows),
-                     _bitpack_nulls(np.asarray(nulls, dtype=bool)),
+                     _bitpack_nulls(nulls),
                      pairs.tobytes()])
 
 
@@ -210,7 +212,7 @@ def _serialize_varwidth(vals: np.ndarray, nulls: np.ndarray) -> bytes:
         struct.pack("<i", len(enc)), enc,
         struct.pack("<i", rows),
         offsets.tobytes(),
-        _bitpack_nulls(np.asarray(nulls, dtype=bool)),
+        _bitpack_nulls(nulls),
         struct.pack("<i", len(blob)),
         blob])
 
@@ -246,7 +248,7 @@ def _serialize_array(vals: np.ndarray, nulls: np.ndarray,
     return b"".join([struct.pack("<i", len(enc)), enc, child,
                      struct.pack("<i", rows),
                      np.asarray(offsets, dtype=np.int32).tobytes(),
-                     _bitpack_nulls(np.asarray(nulls, dtype=bool))])
+                     _bitpack_nulls(nulls)])
 
 
 def _serialize_child(vals, nulls, ty: T.Type) -> bytes:
@@ -297,7 +299,7 @@ def _serialize_map(vals: np.ndarray, nulls: np.ndarray,
         struct.pack("<i", -1),  # no precomputed hash table
         struct.pack("<i", rows),
         np.asarray(offsets, dtype=np.int32).tobytes(),
-        _bitpack_nulls(np.asarray(nulls, dtype=bool))])
+        _bitpack_nulls(nulls)])
 
 
 def _serialize_row(vals: np.ndarray, nulls: np.ndarray,
@@ -323,7 +325,7 @@ def _serialize_row(vals: np.ndarray, nulls: np.ndarray,
         parts.append(_serialize_child(fvals, fnulls, fty))
     parts.append(struct.pack("<i", rows))
     parts.append(np.asarray(offsets, dtype=np.int32).tobytes())
-    parts.append(_bitpack_nulls(np.asarray(nulls, dtype=bool)))
+    parts.append(_bitpack_nulls(nulls))
     return b"".join(parts)
 
 

@@ -510,6 +510,11 @@ def merge_history_docs(docs: List[dict], capacity: int = 512
     one process serve the same archive -- the in-process test
     topology), and records dedup by queryId (a query the coordinator
     archived is not re-counted from a worker that also saw it)."""
+    # M001: every input slice is itself a retention-capped archive
+    # dump, and the merged list truncates to `capacity` below
+    _BOUNDED_BY = {"seen_queries": "union of retention-capped "
+                                   "archive slices",
+                   "out": "truncated to capacity on return"}
     seen_processes = set()
     seen_queries = set()
     out: List[dict] = []

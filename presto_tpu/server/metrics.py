@@ -50,6 +50,7 @@ __all__ = ["MetricFamily", "Histogram", "DEFAULT_BUCKETS",
            "record_suppressed", "suppressed_error_families",
            "suppressed_error_totals", "tracing_families",
            "flight_recorder_families", "kernel_audit_families",
+           "donation_families",
            "failpoint_families", "query_history_families",
            "live_introspection_families", "fleet_families",
            "lock_families", "CONTENT_TYPE"]
@@ -708,6 +709,30 @@ def kernel_audit_families() -> List[MetricFamily]:
         MetricFamily("presto_tpu_kernel_audit_kernels_total", "counter",
                      "staged kernels traced and audited (memo hits "
                      "excluded)").add(t["kernels"]),
+    ]
+
+
+def donation_families() -> List[MetricFamily]:
+    """Proven-safe buffer-donation totals (exec/donation.py), exported
+    by BOTH tiers with a stable zero shape: donated dispatches, HBM
+    bytes aliased in place of fresh output allocations, and donation
+    -path errors that collapsed to the undonated dispatch."""
+    from ..exec.donation import donation_totals
+    t = donation_totals()
+    return [
+        MetricFamily("presto_tpu_donations_total", "counter",
+                     "region dispatches that ran the donating form "
+                     "(K006-proven donate_argnums wrapper)").add(
+                         t["donations"]),
+        MetricFamily("presto_tpu_donated_bytes_total", "counter",
+                     "HBM bytes aliased input-to-output by proven-safe "
+                     "buffer donation instead of freshly allocated "
+                     "(see DESIGN.md 'Buffer donation')").add(
+                         t["donated_bytes"]),
+        MetricFamily("presto_tpu_donation_fallbacks_total", "counter",
+                     "donation-path errors that fell back to the "
+                     "normal undonated dispatch (fallback, never "
+                     "failure)").add(t["fallbacks"]),
     ]
 
 

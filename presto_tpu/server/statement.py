@@ -619,6 +619,10 @@ class StatementServer:
         q.batch_size = batch_size_of(q.id)
         q.columns = [{"name": n, "type": str(t)}
                      for n, t in zip(res.names, res.types)]
+        # M001: protocol rendering of the FINAL RESULT the client
+        # asked for -- output cardinality, already materialized
+        _BOUNDED_BY = {"rendered": "final result rows (protocol "
+                                   "rendering)"}
         rendered = []
         for i in range(res.row_count):
             rendered.append([
@@ -1056,7 +1060,7 @@ class StatementServer:
                    totals["peak_memory_bytes"]),
         ]
         from .metrics import (batching_families, datapath_families,
-                              failpoint_families,
+                              donation_families, failpoint_families,
                               fleet_families, flight_recorder_families,
                               histogram_families, kernel_audit_families,
                               live_introspection_families,
@@ -1080,6 +1084,7 @@ class StatementServer:
         fams.extend(tracing_families())
         fams.extend(flight_recorder_families())
         fams.extend(kernel_audit_families())
+        fams.extend(donation_families())
         fams.extend(failpoint_families())
         from .metrics import lock_families
         fams.extend(lock_families())

@@ -1003,7 +1003,7 @@ class _Handler(BaseHTTPRequestHandler):
         fams.extend(batching_families())
         fams.extend(datapath_families())
         fams.extend(accuracy_families())
-        from .metrics import (failpoint_families,
+        from .metrics import (donation_families, failpoint_families,
                               flight_recorder_families,
                               histogram_families, kernel_audit_families,
                               suppressed_error_families,
@@ -1012,6 +1012,7 @@ class _Handler(BaseHTTPRequestHandler):
         fams.extend(tracing_families())
         fams.extend(flight_recorder_families())
         fams.extend(kernel_audit_families())
+        fams.extend(donation_families())
         fams.extend(failpoint_families())
         from .metrics import lock_families
         fams.extend(lock_families())

@@ -200,6 +200,17 @@ SESSION_PROPERTIES = (
          "back to materialized boundaries). false = one program per "
          "operator, the A/B + bisection mode (env PRESTO_TPU_FUSION, "
          "registered in KERNEL_MODE_ENVS)")
+    .add("buffer_donation", "bool", False,
+         "donate dead region-boundary buffers to XLA on proven-safe "
+         "dispatches (exec/donation.py): inputs the kernaudit K006 "
+         "proof shows aliasable into an output AND whose last consumer "
+         "is this dispatch are passed with donate_argnums, so XLA "
+         "reuses their HBM for the region's output -- peak residency "
+         "drops by the donated bytes (QueryStats.peak_memory_bytes, "
+         "presto_tpu_donated_bytes_total). Only overflow-incapable "
+         "regions donate (a rerun would re-read freed buffers); any "
+         "donation-path error falls back to the undonated dispatch "
+         "(env PRESTO_TPU_DONATION, registered in KERNEL_MODE_ENVS)")
     .add("query_cost_analysis", "bool", False,
          "annotate QueryStats' compile stage with XLA cost_analysis "
          "FLOPs / bytes-accessed (costs one extra program trace per "
@@ -230,6 +241,18 @@ SESSION_PROPERTIES = (
          "flight dump -- orthogonal to slow_query_threshold_ms, which "
          "fires on TOTAL wall time (env fallback PRESTO_TPU_STUCK_MS; "
          "0 disables)")
+    .add("slow_query_threshold_ms", "float", 0.0,
+         "slow-query flight-dump threshold: a query whose TOTAL wall "
+         "time exceeds this auto-dumps the flight-recorder ring once "
+         "on completion (server/statement.py _slow_threshold_ms; env "
+         "fallback PRESTO_TPU_SLOW_QUERY_MS; 0 disables) -- orthogonal "
+         "to stuck_query_threshold_ms, which fires on live-progress "
+         "stall age")
+    .add("queue_timeout_s", "float", 60.0,
+         "admission-queue patience (server/dispatcher.py submit): how "
+         "long a statement waits in the resource-group queue before "
+         "QUERY_QUEUE_FULL; the registry default is what statement "
+         "submission uses when the session carries no override")
     .add("speculative_execution_threshold_ms", "float", 0.0,
          "straggler mitigation: a remote task whose live-progress "
          "last-advance age (exec/progress.py -- the stuck-watchdog's "

@@ -103,6 +103,7 @@ OP_ROUNDS = [
     ("statement", "hang_deadline"),
     ("task", "stuck"),
     ("fusion", "demote"),
+    ("fusion", "donation"),
     ("fleet", "elastic"),
     ("fleet", "speculate"),
 ]
@@ -561,6 +562,47 @@ class ChaosRun:
                           "fusion_demotion flight event")
                 return "NO_FLIGHT_EVENT"
             return "match+demoted"
+        if op == "donation":
+            # forced donation-path failure (this PR): with buffer
+            # donation on under the materialized region executor, the
+            # donation.apply failpoint kills the prepare step for the
+            # first donation-eligible region BEFORE any buffer is
+            # consumed -- the dispatch must collapse to the undonated
+            # form with rows still matching the donation-off oracle,
+            # the fallback counted presto_tpu_donation_fallbacks_total,
+            # and a donation_fallback flight event on the timeline
+            from presto_tpu.exec.donation import donation_totals
+            from presto_tpu.queries.tpch_sql import tpch_query
+            from presto_tpu.sql import sql as engine_sql
+            step["site"], step["spec"] = "donation.apply", "error:once"
+            q = tpch_query(6)
+            oracle = engine_sql(q.text, sf=self.sf,
+                                session={"fusion": False},
+                                max_groups=q.max_groups)
+            before = donation_totals()["fallbacks"]
+            cluster.arm(step["site"], step["spec"])
+            sess = {"fusion": False, "buffer_donation": True}
+            try:
+                res = engine_sql(q.text, sf=self.sf, session=sess,
+                                 max_groups=q.max_groups)
+            except BaseException as e:  # noqa: BLE001 - verdict
+                self.fail(f"donation round: query FAILED under forced "
+                          f"fallback: {type(e).__name__}: {e}")
+                return f"clean_failure:{type(e).__name__}"
+            if res.canonical_rows() != oracle.canonical_rows():
+                self.fail("donation round: forced fallback returned "
+                          "WRONG rows")
+                return "WRONG_RESULT"
+            if donation_totals()["fallbacks"] - before < 1:
+                self.fail("donation round: the failpoint fired but no "
+                          "fallback was counted")
+                return "UNACCOUNTED_FALLBACK"
+            if not get_flight_recorder().events(
+                    kind="donation_fallback"):
+                self.fail("donation round: fallback without a "
+                          "donation_fallback flight event")
+                return "NO_FLIGHT_EVENT"
+            return "match+fallback"
         if op == "elastic":
             # the elastic-fleet acceptance round: an 8-worker
             # discovery-backed cluster changes shape MID-QUERY -- kill

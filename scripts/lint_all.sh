@@ -21,6 +21,11 @@ set -u
 here="$(cd "$(dirname "$0")" && pwd)"
 
 rc=0
+# the default (no --select) run is EVERY registered pass: wide lanes /
+# host syncs / retrace keys / concurrency C001-C004 / swallowed errors
+# AND the allocation tier M001-M003 (unbounded accumulation, unreserved
+# materialization, copy amplification) -- all against the one committed
+# baseline, which stays EMPTY for the M/C families (fix, don't baseline)
 python "$here/tpulint.py" "$@"
 t=$?
 [ "$t" -gt "$rc" ] && rc=$t
@@ -36,7 +41,8 @@ o=$?
 [ "$o" -gt "$rc" ] && rc=$o
 
 # the corpus gate audits the IR the engine actually dispatches:
-# pipeline-region fusion ON, so fused jaxprs are what K001-K005 walk
+# pipeline-region fusion ON, so fused jaxprs are what K001-K007 walk
+# (K006 donation-safety proofs + K007 baked-constant bloat included)
 PRESTO_TPU_FUSION=1 python "$here/kernaudit.py" "$@"
 k=$?
 [ "$k" -gt "$rc" ] && rc=$k

@@ -84,7 +84,7 @@ def load_artifact(path: str) -> Tuple[str, Dict[str, float], dict]:
     if isinstance(parsed.get("value"), (int, float)):
         metrics["rows_per_sec"] = float(parsed["value"])
     for name in ("query_wall_s", "staged_mb", "qps", "p99_ms",
-                 "staging_gb_per_s"):
+                 "staging_gb_per_s", "peak_memory_mb"):
         v = detail.get(name)
         if isinstance(v, (int, float)):
             metrics[name] = float(v)

@@ -122,6 +122,18 @@ ACCURACY_FAMILIES = (
 Q_ERROR_HISTOGRAM = "presto_tpu_q_error"
 
 
+# proven-safe buffer donation (exec/donation.py): its own
+# always-present section, zeros included -- donated dispatches, HBM
+# bytes aliased in place, and donation-path fallbacks. "Donation never
+# fired this window" is an answer an HBM-headroom investigation needs
+# stated, not implied.
+DONATION_FAMILIES = (
+    "presto_tpu_donations_total",
+    "presto_tpu_donated_bytes_total",
+    "presto_tpu_donation_fallbacks_total",
+)
+
+
 _LE_RE = re.compile(r'le="([^"]+)"')
 
 
@@ -167,8 +179,8 @@ def diff(before: dict, after: dict) -> dict:
     the always-present tracing/flight-recorder section."""
     out = {"counters": {}, "gauges": {}, "tracing": {}, "faults": {},
            "history": {}, "cluster": {}, "fleet": {}, "locks": {},
-           "datapath": {}, "accuracy": {}, "histograms": {},
-           "violations": {}}
+           "datapath": {}, "accuracy": {}, "donation": {},
+           "histograms": {}, "violations": {}}
     hist_bases = set()
     for fam, samples in after.items():
         if fam.endswith("_bucket"):
@@ -187,6 +199,7 @@ def diff(before: dict, after: dict) -> dict:
         is_cluster = fam in CLUSTER_FAMILIES
         is_fleet = fam in FLEET_FAMILIES
         is_locks = fam in LOCK_FAMILIES
+        is_donation = fam in DONATION_FAMILIES
         for key, val in samples.items():
             label = fam + key
             if is_counter:
@@ -219,6 +232,10 @@ def diff(before: dict, after: dict) -> dict:
                     # inversion delta, zero included: "0 new
                     # inversions" is the statement, not silence
                     out["locks"][label] = round(delta, 6)
+                elif is_donation:
+                    # donated dispatches / bytes / fallback deltas,
+                    # zeros included
+                    out["donation"][label] = round(delta, 6)
                 elif fam in TRACING_FAMILIES:
                     out["tracing"][label] = round(delta, 6)
                 elif delta:

@@ -1,0 +1,18 @@
+"""kernaudit K006 fixture: a kernel requesting donation of three
+inputs none of which is provably aliasable -- arg 0 is returned
+unchanged (its buffer IS output 0), arg 1 only feeds a scalar
+reduction, arg 2 shrinks before it is returned (no output carries its
+shape+dtype). NOT part of the engine."""
+
+import jax.numpy as jnp
+
+DONATE_ARGNUMS = (0, 1, 2)
+
+
+def build():
+    def kernel(x, y, z):
+        return x, y.sum(), z[:2] * 2.0
+
+    return kernel, (jnp.zeros(8, dtype=jnp.float32),
+                    jnp.zeros(8, dtype=jnp.float32),
+                    jnp.zeros(8, dtype=jnp.float32))

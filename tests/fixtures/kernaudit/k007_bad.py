@@ -1,0 +1,22 @@
+"""kernaudit K007 fixture: a kernel that closes over three host
+arrays past the 1 MiB const threshold -- each becomes a jaxpr
+constant baked into every compiled variant instead of an argument.
+NOT part of the engine."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+
+def build():
+    lut_a = np.ones(300_000, dtype=np.float32)       # 1.2 MB baked
+    lut_b = np.arange(160_000, dtype=np.float64)     # 1.3 MB baked
+    lut_c = np.zeros(400_000, dtype=np.int32)        # 1.6 MB baked
+
+    def kernel(x):
+        a = jnp.asarray(lut_a).sum()
+        b = jnp.asarray(lut_b).mean().astype(jnp.float32)
+        c = jnp.asarray(lut_c).sum().astype(jnp.float32)
+        return x + a + b + c
+
+    return kernel, (jnp.zeros(8, dtype=jnp.float32),)

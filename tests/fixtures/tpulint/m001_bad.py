@@ -1,0 +1,73 @@
+"""tpulint M001 fixture: seeded unbounded-accumulation violations.
+NOT part of the engine -- linted by tests/test_tpulint.py."""
+
+
+def collect_bad(splits):
+    acc = []
+    for s in splits:
+        acc.append(s.payload)       # BAD: grows per split, no bound
+    return acc
+
+
+def index_bad(pages):
+    seen = {}
+    blob = b""
+    for page in pages:
+        seen[page.key] = page       # BAD: dict grows per page
+        blob += page.payload        # BAD: bytes grow per page
+    return seen, blob
+
+
+def suppressed_site(rows):
+    out = []
+    for r in rows:
+        out.append(r)  # tpulint: disable=M001
+    return out
+
+
+def chunked_good(rows):
+    # generator: yielding per window IS the streaming seam
+    buf = []
+    for r in rows:
+        buf.append(r)
+        if len(buf) >= 1024:
+            yield buf
+            buf = []
+    if buf:
+        yield buf
+
+
+def reserved_good(pool, query_id, batches):
+    # accounted: the reservation seals this function
+    acc = []
+    pool.reserve(query_id, sum(b.nbytes for b in batches))
+    for b in batches:
+        acc.append(b)
+    return acc
+
+
+def declared_ok(pages):
+    _BOUNDED_BY = {"heads": "one fixed-size header per page wave "
+                            "(the caller chunks waves to 16 pages)"}
+    heads = []
+    for page in pages:
+        heads.append(page.header)
+    return heads
+
+
+def capped_ok(records):
+    # visible len() cap: a sliding window, not an accumulator
+    window = []
+    for rec in records:
+        if len(window) >= 64:
+            window.pop(0)
+        window.append(rec)
+    return window
+
+
+def schema_good(batch, names):
+    # plan-shaped loop (columns, not rows): bounded by the schema
+    cols = []
+    for name in names.column_names:
+        cols.append(batch.column(name))
+    return cols

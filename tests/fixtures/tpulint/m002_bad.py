@@ -1,0 +1,50 @@
+"""tpulint M002 fixture: seeded unreserved-materialization violations
+on a self-contained run_query call graph. NOT part of the engine --
+linted by tests/test_tpulint.py."""
+
+import numpy as np
+
+
+def run_query(plan, splits):
+    batches = gather_unreserved(splits)
+    rows = flatten_rows(batches)
+    footer = read_footer(plan)
+    stitched = stitch_suppressed(batches)
+    spooled = spill_partition(batches)
+    safe = reserved_merge(plan.pool, batches)
+    return batches, rows, footer, stitched, spooled, safe
+
+
+def gather_unreserved(splits):
+    # BAD: O(relation) glue on the hot path, nothing accounted
+    return np.concatenate([s.values for s in splits])
+
+
+def flatten_rows(batches):
+    out = np.vstack([b.rows for b in batches])   # BAD: full-relation stack
+    return out.tolist()                          # BAD: host list blowup
+
+
+def read_footer(plan):
+    with open(plan.path, "rb") as f:
+        return f.read()                          # BAD: whole-file read
+
+
+def stitch_suppressed(batches):
+    return np.hstack([b.cols for b in batches])  # tpulint: disable=M002
+
+
+def reserved_merge(pool, batches):
+    # ok: the reservation seals this subtree
+    pool.reserve("q", sum(b.nbytes for b in batches))
+    return np.concatenate([b.values for b in batches])
+
+
+def spill_partition(batches):
+    # ok: the spill seam hands accounting to the host-offload tier
+    return np.stack([b.values for b in batches])
+
+
+def offline_tool(batches):
+    # ok: not reachable from run_query (tooling path)
+    return np.vstack([b.rows for b in batches])

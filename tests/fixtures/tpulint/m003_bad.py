@@ -1,0 +1,52 @@
+"""tpulint M003 fixture: seeded copy-amplification chains. NOT part
+of the engine -- linted by tests/test_tpulint.py."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _pad(arr, capacity, fill=0):
+    # module-local copy WRAPPER: returns a copy-op of its first param,
+    # so calling it counts as one copy in a chain
+    return np.pad(arr, (0, capacity - arr.shape[0]),
+                  constant_values=fill)
+
+
+def stage_bad(values, capacity):
+    # BAD: cast then pad -- two host copies of the same column
+    return _pad(np.asarray(values, dtype=np.int64), capacity)
+
+
+def cast_then_pad_bad(col, capacity):
+    arr = np.asarray(col, dtype=np.float64)
+    return _pad(arr, capacity)      # BAD: chain through single-use local
+
+
+def double_cast_bad(mask):
+    return mask.astype(np.uint8).astype(bool)   # BAD: two casts, one needed
+
+
+def suppressed_site(vals, capacity):
+    return _pad(vals.astype(np.int32), capacity)  # tpulint: disable=M003
+
+
+def fused_good(col, capacity, dt):
+    # one allocation at the target dtype/shape, slice-assign into it
+    out = np.full((capacity,), 0, dtype=dt)
+    out[: len(col)] = col
+    return out
+
+
+def shared_intermediate_ok(values):
+    # v is read twice: a legitimate shared intermediate, not a re-copy
+    v = np.asarray(values, dtype=np.int64)
+    hi = (v >> 32).astype(np.int32)
+    lo = v.astype(np.int32)
+    return hi, lo
+
+
+def transfer_ok(arr):
+    # one host copy then the device transfer: the terminal does not
+    # count toward the chain
+    x = np.asarray(arr, dtype=np.float32)
+    return jnp.asarray(x)

@@ -1,0 +1,270 @@
+"""Buffer donation (exec/donation.py): the K006 proof consumed by the
+region executor.
+
+Contracts under test:
+
+  1. eligibility: only overflow-incapable region roots qualify (the
+     dispatch ladder reruns the SAME batches on overflow -- donating
+     into a rerun-capable region would hand XLA a buffer the retry
+     still needs);
+  2. prepare_donation proves per-arg safety on the jaxpr (passthrough
+     and shape/dtype-mismatched args are refused) and memoizes per
+     (fingerprint, signature, deadset);
+  3. live E2E: q1/q6 with donation ON are bit-exact vs OFF with a
+     strictly lower MemoryPool peak, and the donated bytes land on
+     QueryStats counters + the process totals /v1/metrics renders;
+  4. the donation.apply failpoint collapses to the undonated dispatch
+     with identical results (counted as a fallback);
+  5. MemoryPool.note_usage is unconditional accounting -- it never
+     blocks on admission and pairs with free().
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from presto_tpu import failpoints
+from presto_tpu.exec.donation import (clear_donation_state,
+                                      donation_enabled, donation_totals,
+                                      overflow_incapable,
+                                      prepare_donation)
+from presto_tpu.exec.memory import MemoryPool
+from presto_tpu.queries.tpch_sql import tpch_query
+from presto_tpu.sql import plan_sql
+from presto_tpu.sql import sql as run_sql
+
+SF = 0.01
+
+
+@pytest.fixture(autouse=True)
+def _clean_donation_state():
+    clear_donation_state()
+    yield
+    clear_donation_state()
+    failpoints.disarm_all()
+
+
+def _kw(q):
+    kw = dict(max_groups=q.max_groups)
+    if q.join_capacity:
+        kw["join_capacity"] = q.join_capacity
+    return kw
+
+
+# -- eligibility --------------------------------------------------------
+
+
+def test_overflow_incapable_whitelist():
+    """Scan->filter->project chains qualify; anything containing an
+    overflow-capable operator (aggregation/join/sort) does not."""
+    safe = plan_sql("SELECT extendedprice FROM lineitem "
+                    "WHERE quantity < 5")
+    assert overflow_incapable(safe)
+    agg = plan_sql("SELECT sum(quantity) FROM lineitem")
+    assert not overflow_incapable(agg)
+
+
+def test_donation_enabled_resolution(monkeypatch):
+    """Session property wins; the env is the ambient fallback."""
+    monkeypatch.delenv("PRESTO_TPU_DONATION", raising=False)
+    assert not donation_enabled(None)
+    assert donation_enabled({"buffer_donation": True})
+    monkeypatch.setenv("PRESTO_TPU_DONATION", "1")
+    assert donation_enabled(None)
+    assert not donation_enabled({"buffer_donation": False})
+
+
+# -- the proof + memo ---------------------------------------------------
+
+
+def test_prepare_donation_proves_and_dispatches_bit_exact():
+    def fn(batches):
+        return (batches[0] + 1.0, batches[1] * 2.0)
+
+    x = jnp.arange(8, dtype=jnp.float32)
+    y = jnp.ones(4, dtype=jnp.float32)
+    prep = prepare_donation("rfp-unit", fn, (x, y), [0, 1])
+    assert prep is not None
+    assert set(prep.donate_idx) == {0, 1}
+    assert prep.donated_bytes == x.nbytes + y.nbytes
+    out = prep.dispatch((x, y))
+    ref = fn((jnp.arange(8, dtype=jnp.float32),
+              jnp.ones(4, dtype=jnp.float32)))
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(ref[0]))
+    np.testing.assert_array_equal(np.asarray(out[1]), np.asarray(ref[1]))
+    assert donation_totals()["donations"] == 0  # runner counts, not prep
+
+
+def test_prepare_donation_refuses_unsafe_args():
+    """Passthrough outputs and shape/dtype mismatches fail the K006
+    proof; with no provable arg there is no plan at all."""
+    def passthrough(batches):
+        return (batches[0],)
+
+    x = jnp.arange(8, dtype=jnp.float32)
+    assert prepare_donation("rfp-pass", passthrough, (x,), [0]) is None
+
+    def widens(batches):
+        return (batches[0].astype(jnp.float64),)
+
+    assert prepare_donation("rfp-widen", widens, (x,), [0]) is None
+
+
+def test_prepare_donation_only_donates_dead_leaves():
+    """A leaf outside the dead set stays undonated even when the jaxpr
+    proof would allow it (the engine's liveness is the second half of
+    the proof)."""
+    def fn(batches):
+        return (batches[0] + 1.0, batches[1] * 2.0)
+
+    x = jnp.arange(8, dtype=jnp.float32)
+    y = jnp.ones(4, dtype=jnp.float32)
+    prep = prepare_donation("rfp-live", fn, (x, y), [1])
+    assert prep is not None and tuple(prep.donate_idx) == (1,)
+    assert prep.donated_bytes == y.nbytes
+
+
+def test_prepare_donation_memoizes_per_signature():
+    def fn(batches):
+        return (batches[0] + 1.0,)
+
+    x = jnp.arange(8, dtype=jnp.float32)
+    a = prepare_donation("rfp-memo", fn, (x,), [0])
+    b = prepare_donation("rfp-memo", fn, (x,), [0])
+    assert a is b  # memo hit: no retrace
+    z = jnp.arange(16, dtype=jnp.float32)
+    c = prepare_donation("rfp-memo", fn, (z,), [0])
+    assert c is not a  # new shape = new proof
+
+
+# -- live E2E -----------------------------------------------------------
+
+
+@pytest.mark.parametrize("qnum", [1, 6])
+def test_donated_run_is_bit_exact_with_lower_peak(qnum):
+    """The acceptance pin: donation ON returns exactly the OFF rows
+    with a strictly lower pool peak, and the donated bytes are counted
+    on QueryStats + the process totals."""
+    q = tpch_query(qnum)
+    kw = _kw(q)
+    pool_off = MemoryPool(1 << 34)
+    off = run_sql(q.text, sf=SF, session={"fusion": False},
+                  memory_pool=pool_off, query_id=f"don-off-q{qnum}", **kw)
+    before = donation_totals()
+    pool_on = MemoryPool(1 << 34)
+    on = run_sql(q.text, sf=SF,
+                 session={"fusion": False, "buffer_donation": True},
+                 memory_pool=pool_on, query_id=f"don-on-q{qnum}", **kw)
+    assert off.canonical_rows() == on.canonical_rows()
+    assert pool_on.peak_bytes < pool_off.peak_bytes
+    counters = on.query_stats.counters
+    assert counters.get("donations", 0) >= 1
+    assert counters.get("donated_bytes", 0) > 0
+    after = donation_totals()
+    assert after["donations"] - before["donations"] == \
+        counters["donations"]
+    assert after["donated_bytes"] - before["donated_bytes"] == \
+        counters["donated_bytes"]
+
+
+def test_donation_off_by_default():
+    q = tpch_query(6)
+    res = run_sql(q.text, sf=SF, session={"fusion": False},
+                  query_id="don-default-q6", **_kw(q))
+    assert res.query_stats.counters.get("donations", 0) == 0
+    assert donation_totals()["donations"] == 0
+
+
+def test_donation_families_render_on_metrics():
+    from presto_tpu.server.metrics import (donation_families,
+                                           parse_prometheus,
+                                           render_prometheus)
+    q = tpch_query(6)
+    run_sql(q.text, sf=SF,
+            session={"fusion": False, "buffer_donation": True},
+            query_id="don-metrics-q6", **_kw(q))
+    parsed = parse_prometheus(
+        render_prometheus(donation_families()).decode())
+    assert parsed["presto_tpu_donations_total"][""] >= 1
+    assert parsed["presto_tpu_donated_bytes_total"][""] > 0
+    assert "presto_tpu_donation_fallbacks_total" in parsed
+
+
+def test_scrape_metrics_donation_section():
+    """scripts/scrape_metrics.py carries an always-present `donation`
+    section: the three counters appear with zero deltas even when
+    nothing donated between snapshots."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts"))
+    import scrape_metrics
+    from presto_tpu.server.metrics import (donation_families,
+                                           parse_prometheus,
+                                           render_prometheus)
+    snap = parse_prometheus(
+        render_prometheus(donation_families()).decode())
+    d = scrape_metrics.diff(snap, snap)
+    assert "donation" in d
+    for fam in scrape_metrics.DONATION_FAMILIES:
+        assert d["donation"].get(fam) == 0
+
+
+# -- the failpoint fallback --------------------------------------------
+
+
+def test_donation_apply_failpoint_falls_back_bit_exact():
+    """An injected error in prepare_donation (before any buffer is
+    consumed) collapses the region to the normal undonated dispatch:
+    identical rows, fallback counted, flight event recorded."""
+    from presto_tpu.server.flight_recorder import get_flight_recorder
+    q = tpch_query(6)
+    kw = _kw(q)
+    oracle = run_sql(q.text, sf=SF, session={"fusion": False},
+                     query_id="don-fp-oracle", **kw)
+    failpoints.arm("donation.apply", "error:once")
+    try:
+        res = run_sql(q.text, sf=SF,
+                      session={"fusion": False, "buffer_donation": True},
+                      query_id="don-fp-q6", **kw)
+    finally:
+        failpoints.disarm_all()
+    assert res.canonical_rows() == oracle.canonical_rows()
+    assert donation_totals()["fallbacks"] >= 1
+    assert res.query_stats.counters.get("donation_fallbacks", 0) >= 1
+    assert any(e.get("kind") == "donation_fallback"
+               for e in get_flight_recorder().events())
+
+
+def test_perfgate_peak_memory_band_catches_lost_donation():
+    """The bench trajectory gates peak_memory_mb with the same tight
+    band as staged_mb: a peak stepping back UP (a lost donation) is a
+    finding; holding the donated peak is not."""
+    from presto_tpu.exec.perfgate import BENCH_SPECS, compare_metrics
+    spec = {s.name: s for s in BENCH_SPECS}["peak_memory_mb"]
+    assert spec.higher_is_worse and spec.rel_threshold <= 0.10
+    samples = {"peak_memory_mb": [8.76, 8.76, 8.77]}
+    bad = compare_metrics({"peak_memory_mb": 14.0}, samples, BENCH_SPECS)
+    assert any(v["metric"] == "peak_memory_mb" for v in bad)
+    ok = compare_metrics({"peak_memory_mb": 8.76}, samples, BENCH_SPECS)
+    assert not ok
+
+
+# -- note_usage accounting ---------------------------------------------
+
+
+def test_note_usage_is_unconditional_and_pairs_with_free():
+    """note_usage records observed usage without admission control: it
+    never blocks even past capacity, raises both peaks, and free()
+    unwinds the ledger."""
+    pool = MemoryPool(100)
+    pool.note_usage("q", 400)  # over capacity: must not block or raise
+    assert pool.peak_bytes == 400
+    pool.note_usage("q", 100)
+    assert pool.peak_bytes == 500
+    pool.free("q", 500)
+    assert pool.query_bytes("q") == 0
+    assert pool.peak_bytes == 500  # peak is a high-water mark
+    assert pool.query_peak_bytes("q", pop=True) == 500

@@ -36,12 +36,14 @@ from presto_tpu.audit import all_passes, run_audit  # noqa: E402
 from presto_tpu.audit.cli import main as kernaudit_main  # noqa: E402
 from presto_tpu.audit.core import KernelIR  # noqa: E402
 
-ALL_CODES = ("K001", "K002", "K003", "K004", "K005")
+ALL_CODES = ("K001", "K002", "K003", "K004", "K005", "K006", "K007")
 
 # (expected minimum findings, expected suppressed sites) per fixture:
-# K005 reports whole-kernel (no source line to suppress on)
+# K005/K006/K007 report whole-kernel / per-arg / per-constant (no
+# source line to suppress on)
 _FIXTURE_PINS = {"K001": (4, 1), "K002": (4, 1), "K003": (3, 1),
-                 "K004": (3, 1), "K005": (1, 0)}
+                 "K004": (3, 1), "K005": (1, 0), "K006": (3, 0),
+                 "K007": (3, 0)}
 
 
 def _cli(args):
@@ -54,7 +56,7 @@ def _cli(args):
 # -- tier-1 gates -------------------------------------------------------
 
 
-def test_registry_ships_all_five_passes():
+def test_registry_ships_every_pass():
     codes = {p.code for p in all_passes()}
     assert set(ALL_CODES) <= codes
 

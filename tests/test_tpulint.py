@@ -34,7 +34,7 @@ from presto_tpu.lint.cli import main as tpulint_main  # noqa: E402
 from presto_tpu.lint.core import ModuleSource  # noqa: E402
 
 ALL_CODES = ("W001", "H001", "R001", "C001", "C002", "C003", "C004",
-             "S001")
+             "S001", "M001", "M002", "M003")
 
 
 def _cli(args):
@@ -611,6 +611,58 @@ def test_c004_stop_flag_loop_and_daemon_are_silent():
                         "LeakyService.start_bad_local",
                         "LeakyService.start_bad_anonymous",
                         "LeakyService._spin"}
+
+
+# -- the allocation-audit suite (M001/M002/M003) ------------------------
+
+
+def test_m001_exemption_forms_are_silent():
+    """Sensitivity pin: data-bounded growth fires per accumulator; the
+    sanctioned forms (generator seam, reserve() call, _BOUNDED_BY
+    declaration, visible len() cap, plan-shaped loop) stay silent."""
+    fixture = os.path.join(FIXTURES, "m001_bad.py")
+    findings = run_passes(codes=["M001"], paths=[fixture]).findings
+    contexts = {f.context for f in findings}
+    assert contexts == {"collect_bad", "index_bad"}
+    # dict subscript-store AND bytes augassign both count as growth
+    assert sum(f.context == "index_bad" for f in findings) == 2
+
+
+def test_m002_reachability_and_sealed_subtrees():
+    """Materializers fire only on the run_query-reachable path; a
+    reserve() call or a spill/stream seam seals the subtree, and
+    tooling functions off the query path never fire."""
+    fixture = os.path.join(FIXTURES, "m002_bad.py")
+    findings = run_passes(codes=["M002"], paths=[fixture]).findings
+    contexts = {f.context for f in findings}
+    assert contexts == {"gather_unreserved", "flatten_rows",
+                        "read_footer"}
+    assert all("run_query" in f.message for f in findings)
+
+
+def test_m003_chains_flow_through_single_use_locals_and_wrappers():
+    """Copy chains thread nested calls, single-use locals, and
+    module-local copy wrappers; a shared (multi-read) intermediate
+    breaks the chain."""
+    fixture = os.path.join(FIXTURES, "m003_bad.py")
+    findings = run_passes(codes=["M003"], paths=[fixture]).findings
+    contexts = {f.context for f in findings}
+    assert contexts == {"stage_bad", "cast_then_pad_bad",
+                        "double_cast_bad"}
+    # the module-local _pad wrapper is recognized as a copy op
+    assert any("_pad()" in f.message for f in findings)
+
+
+def test_alloc_passes_repo_clean_with_empty_baseline():
+    """The acceptance pin: M001-M003 over the real tree with NO
+    baseline entries -- findings were fixed in code, not grandfathered."""
+    result = run_passes(codes=["M001", "M002", "M003"])
+    assert result.findings == [], "\n".join(
+        f.render() for f in result.findings)
+    bl = load_baseline(os.path.join(REPO, "tpulint_baseline.json"))
+    assert not any(e.get("code", "").startswith("M0")
+                   for e in bl.values()), \
+        "allocation findings must be fixed, not baselined"
 
 
 def test_concurrency_passes_repo_clean_with_empty_baseline():
