@@ -531,6 +531,11 @@ def main():
     # `peak_memory_mb` sample -- beside the donation-off peak and the
     # bytes the K006-proven donating dispatches aliased in place
     donation_smoke = _donation_smoke()
+    # occupancy smoke at smoke scale: the q1 overlap fraction and
+    # device-idle wall from the interval ledger (exec/timeline.py) --
+    # the perfgate-gated `overlap_fraction` sample plus the bubble
+    # verdict naming the hop the device waited on
+    timeline_smoke = _timeline_smoke()
 
     rows_per_sec = n / dt_sql
     baseline_rows_per_sec = n / numpy_s
@@ -572,6 +577,13 @@ def main():
             # bytes ride the subsection for the A/B readout
             "peak_memory_mb": donation_smoke["peak_memory_mb"],
             "donation": donation_smoke,
+            # execution-timeline occupancy (exec/timeline.py): the
+            # gated overlap_fraction rides top-level (today's ~0 serial
+            # baseline the async-ingest PR must raise) beside the
+            # device-idle wall; the bubble verdict rides the subsection
+            "overlap_fraction": timeline_smoke["overlap_fraction"],
+            "device_idle_us": timeline_smoke["device_idle_us"],
+            "timeline": timeline_smoke,
             "top_kernels": _top_kernel_shares(),
             "platform": platform,
             "scoring": scoring,
@@ -613,6 +625,27 @@ def _donation_smoke():
     return {"peak_memory_mb": round(peaks["on"] / 1e6, 3),
             "peak_memory_mb_donation_off": round(peaks["off"] / 1e6, 3),
             "donated_bytes": donated}
+
+
+def _timeline_smoke():
+    """Occupancy readout of q1 at smoke scale from the execution
+    -timeline ledger (exec/timeline.py): overlap fraction (the gated
+    sample), device-idle wall, and the bubble verdict naming the hop
+    the device spent that idle wall waiting on."""
+    from presto_tpu.exec.timeline import bubble_verdict, occupancy
+    from presto_tpu.sql import sql as run_sql
+    res = run_sql(TPCH_Q1, sf=0.01, query_id="bench-timeline")
+    intervals = res.query_stats.timeline.intervals
+    occ = occupancy(intervals)
+    if occ is None:
+        return {"overlap_fraction": 0.0, "device_idle_us": 0,
+                "bubble_verdict": ""}
+    verdict = bubble_verdict(intervals, occ)
+    return {"overlap_fraction": occ["overlapFraction"],
+            "device_idle_us": occ["deviceIdleUs"],
+            "device_idle_fraction": occ["deviceIdleFraction"],
+            "bubble_hop": verdict["hop"] if verdict else "",
+            "bubble_verdict": verdict["message"] if verdict else ""}
 
 
 def _datapath_detail():

@@ -145,6 +145,13 @@ SCHEMA = {
                     "unit": _V, "est": T.DOUBLE, "actual": T.DOUBLE,
                     "q_error": T.DOUBLE, "direction": _V,
                     "tasks": T.BIGINT},
+    # execution-timeline occupancy (exec/timeline.py): one row per
+    # (retained query, lane) -- lane busy wall/fraction beside the
+    # query's overlap fraction, device-idle wall and bubble hop
+    "occupancy": {"query_id": _V, "lane": _V, "busy_us": T.BIGINT,
+                  "busy_fraction": T.DOUBLE, "wall_us": T.BIGINT,
+                  "overlap_fraction": T.DOUBLE,
+                  "device_idle_us": T.BIGINT, "bubble_hop": _V},
     "session_properties": {"name": _V, "default_value": _V, "type": _V,
                            "description": _V},
     "functions": {"function_name": _V, "kind": _V},
@@ -321,6 +328,13 @@ def _rows_of(table: str) -> List[tuple]:
                  float(r["qError"]) if r["qError"] is not None else 0.0,
                  r["direction"], int(r["tasks"]))
                 for r in accuracy_snapshot()]
+    if table == "occupancy":
+        from ..exec.timeline import snapshot as timeline_snapshot
+        return [(r["queryId"], r["lane"], int(r["busyUs"]),
+                 float(r["busyFraction"]), int(r["wallUs"]),
+                 float(r["overlapFraction"]), int(r["deviceIdleUs"]),
+                 r["bubbleHop"])
+                for r in timeline_snapshot()]
     if table == "kernels":
         from ..exec.profiler import profile_snapshot
         return [(p["fingerprint"], p["label"], p["tables"],

@@ -75,6 +75,7 @@ from ..utils.locks import OrderedLock
 
 __all__ = ["HOPS", "CEILING_KEYS", "HOP_CEILING", "HopStats",
            "DatapathLedger", "recording", "record_hop", "timed_hop",
+           "now_us",
            "merge_hop_maps", "hop_map_to_json", "hop_map_from_json",
            "probe_ceilings", "ceilings_cached", "achieved_b_per_s",
            "bottleneck_verdict", "datapath_doc", "merge_datapath_docs",
@@ -112,6 +113,16 @@ _PROCESS_ID = uuid.uuid4().hex
 # utilization below this fraction of the hop's ceiling marks the hop
 # as under-performing (verdict-eligible); callers can widen/narrow
 _DEFAULT_BAND = 0.5
+
+
+def now_us() -> int:
+    """The per-process monotonic microsecond clock -- the ONE clock
+    the hop walls and the timeline interval ledger (exec/timeline.py)
+    share, so a hop's wall_us sum and its intervals' duration sum
+    reconcile by construction (pinned within 1% on q1). Monotonic:
+    never steps backward under NTP slew, so intervals cannot go
+    negative on the recording process."""
+    return int(time.monotonic() * 1e6)
 
 
 @dataclasses.dataclass
@@ -250,12 +261,19 @@ _GUARDED_BY = {"_LOCK": ("_PROCESS", "_QUERY_LEDGERS", "_CEILINGS",
                          "_PROBING")}
 
 
-def record_hop(hop: str, nbytes: int, seconds: float) -> None:
+def record_hop(hop: str, nbytes: int, seconds: float,
+               end_us: Optional[int] = None,
+               split_id: int = -1) -> None:
     """Fold one hop observation into the ambient ledger (when one is
-    installed), the process-lifetime registry, and the per-hop size
-    histogram. Never raises: this sits on the staging/serde hot
-    paths. Suppressed while the ceilings probe runs (the probe calls
-    the very seams it measures)."""
+    installed), the process-lifetime registry, the per-hop size
+    histogram, and the timeline interval ledger (exec/timeline.py --
+    the interval's duration IS this record's wall_us, so hop sums and
+    interval durations reconcile exactly). ``end_us`` is the window's
+    end on the :func:`now_us` clock; callers recording right after
+    the window (the coarse paths) may omit it. Never raises: this
+    sits on the staging/serde hot paths. Suppressed while the
+    ceilings probe runs (the probe calls the very seams it
+    measures)."""
     if getattr(_tls, "suppress", False):
         return
     try:
@@ -271,6 +289,10 @@ def record_hop(hop: str, nbytes: int, seconds: float) -> None:
             h.wall_us += wall_us
             h.invocations += 1
             h.max_wall_us = max(h.max_wall_us, wall_us)
+        t1 = now_us() if end_us is None else int(end_us)
+        from .timeline import record_interval
+        record_interval(hop, int(nbytes), t1 - wall_us, t1,
+                        split_id=split_id)
         from ..server.metrics import observe_histogram
         observe_histogram("presto_tpu_datapath_bytes", float(nbytes),
                           labels={"hop": hop})
@@ -285,18 +307,22 @@ def record_hop(hop: str, nbytes: int, seconds: float) -> None:
 
 class timed_hop:
     """``with timed_hop("connector_read") as t: ...; t.bytes = n`` --
-    records the hop on exit with the measured wall."""
+    records the hop on exit with the measured wall, on the monotonic
+    :func:`now_us` clock the interval ledger shares."""
 
-    def __init__(self, hop: str, nbytes: int = 0):
+    def __init__(self, hop: str, nbytes: int = 0, split_id: int = -1):
         self.hop = hop
         self.bytes = nbytes
+        self.split_id = split_id
 
     def __enter__(self):
-        self.t0 = time.time()
+        self.t0_us = now_us()
         return self
 
     def __exit__(self, *exc):
-        record_hop(self.hop, self.bytes, time.time() - self.t0)
+        end = now_us()
+        record_hop(self.hop, self.bytes, (end - self.t0_us) / 1e6,
+                   end_us=end, split_id=self.split_id)
         return False
 
 

@@ -137,7 +137,12 @@ KERNEL_MODE_ENVS = (("PRESTO_TPU_SMALLG", "auto"),
                     # program (donate_argnums over the dead leaves), so
                     # the mode is part of every cached key (and the env
                     # read rides the one R001-checked list)
-                    ("PRESTO_TPU_DONATION", "0"))
+                    ("PRESTO_TPU_DONATION", "0"),
+                    # execution-timeline interval tracing (exec/
+                    # timeline.py): program-invariant observability, but
+                    # registered so every ambient knob exec/ reads lives
+                    # in this one R001-checked list
+                    ("PRESTO_TPU_TIMELINE", "1"))
 
 
 def _kernel_mode() -> str:

@@ -95,18 +95,20 @@ def stage_scan_split(conn, node: "N.TableScanNode", sf: float, start: int,
     connector_read (host column materialization), narrow_cast (the
     staging-time range re-proof), device_put (host -> HBM staging,
     the bytes QueryStats' staging stage counts)."""
-    from .datapath import record_hop, timed_hop
+    from .datapath import now_us, record_hop, timed_hop
     from .memory import batch_bytes
     phys = getattr(node, "physical_dtypes", None)
     if not phys or not any(phys) or not hasattr(conn, "generate_columns"):
         # the connector stages straight to a device batch: the whole
         # read+put attributes to connector_read (coarse by design --
         # connectors wanting finer hops expose generate_columns)
-        t0 = time.time()
+        t0 = now_us()
         b = conn.generate_batch(node.table, sf, node.columns,
                                 start=start, count=count,
                                 capacity=capacity)
-        record_hop("connector_read", batch_bytes(b), time.time() - t0)
+        end = now_us()
+        record_hop("connector_read", batch_bytes(b), (end - t0) / 1e6,
+                   end_us=end)
         return b
     from ..plan.widths import checked_physical_dtypes
     with timed_hop("connector_read") as t_read:
@@ -220,13 +222,15 @@ def _scan_batch(node: N.PlanNode, sf: float, capacity_hint: Optional[int],
         # the connector stages straight to device, so the whole
         # read+put attributes to connector_read (the ledger must never
         # show zero bytes for a staged scan)
-        from .datapath import record_hop
+        from .datapath import now_us, record_hop
         from .memory import batch_bytes
-        t0 = time.time()
+        t0 = now_us()
         b = conn.generate_batch(node.table, sf, node.columns,
                                 start=start, count=count, capacity=cap,
                                 predicate=tuple(node.pushdown))
-        record_hop("connector_read", batch_bytes(b), time.time() - t0)
+        end = now_us()
+        record_hop("connector_read", batch_bytes(b), (end - t0) / 1e6,
+                   end_us=end)
         return b
     return stage_scan_split(conn, node, sf, start, count, cap)
 
@@ -352,14 +356,22 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
     from .datapath import DatapathLedger
     from .datapath import recording as _dp_recording
     from .progress import begin as _progress_begin
+    from .timeline import TimelineLedger, timeline_enabled
+    from .timeline import recording as _tl_recording
     prog = _progress_begin(query_id)
     dp = DatapathLedger()
     # the per-query estimate-vs-actual ledger (exec/accuracy.py) is
     # ambient too: measured boundaries (scan outputs, region outputs,
     # K005 footprint audits) attribute to THIS query's plan nodes
     acc = AccuracyLedger()
+    # ... and the interval-timeline ledger (exec/timeline.py): every
+    # hop the datapath records also lands as a (lane, hop, split,
+    # t0, t1) interval, the occupancy/bubble instrument. A disabled
+    # ledger (session `timeline` off) makes every record a no-op.
+    tl = TimelineLedger(query_id=query_id,
+                        enabled=timeline_enabled(session))
     try:
-        with _dp_recording(dp), _acc_recording(acc):
+        with _dp_recording(dp), _acc_recording(acc), _tl_recording(tl):
             res = _run_query_inner(
                 root, sf=sf, mesh=mesh, capacity_hints=capacity_hints,
                 default_join_capacity=default_join_capacity,
@@ -367,7 +379,7 @@ def run_query(root: N.PlanNode, sf: float = 0.01, mesh=None,
                 remote_sources=remote_sources, memory_pool=memory_pool,
                 query_id=query_id, session=session,
                 hbm_budget_bytes=hbm_budget_bytes, prepared=prepared,
-                trace_id=trace_id, prog=prog, dp=dp, acc=acc)
+                trace_id=trace_id, prog=prog, dp=dp, acc=acc, tl=tl)
     except BaseException:
         prog.release(state="FAILED")
         raise
@@ -387,7 +399,7 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
                      hbm_budget_bytes: Optional[int] = None,
                      prepared: bool = False,
                      trace_id=None, prog=None, dp=None,
-                     acc=None) -> QueryResult:
+                     acc=None, tl=None) -> QueryResult:
     # write/DDL roots execute their source on device, then write
     # host-side (TableWriterOperator.java:76 analog -- the sink is a
     # host effect, fed by one DMA-out of the computed rows)
@@ -451,7 +463,7 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
                     res.stats = stats.snapshot()
                     _finalize_query_stats(collector, res, t_query0, 0,
                                           root, trace_id, dp=dp,
-                                          acc=acc, sf=sf)
+                                          acc=acc, tl=tl, sf=sf)
                     return res
             with stats.timed("streaming_exec_s"), collecting(collector), \
                     collector.stage("execute"):
@@ -467,7 +479,8 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
             res = _batch_to_result(out_b, root)
             res.stats = stats.snapshot()
             _finalize_query_stats(collector, res, t_query0, 0, root,
-                                  trace_id, dp=dp, acc=acc, sf=sf)
+                                  trace_id, dp=dp, acc=acc, tl=tl,
+                                  sf=sf)
             return res
     pad = (mesh.devices.size if mesh is not None else 1) * 8
     hints = capacity_hints or {}
@@ -581,6 +594,7 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
         if prog is not None:
             prog.set_planned(len(scan_leaves))
             prog.advance(stage="staging")
+        from .timeline import split_scope
         with stats.timed("scan_stage_s"), collector.stage("staging"):
             batches = []
             for si, s in enumerate(scan_leaves):
@@ -590,9 +604,16 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
                         f"no remote source batch supplied for node {s.id}"
                     batches.append(remote_sources[s.id])
                 else:
-                    batches.append(_scan_batch(
-                        s, sf, hints.get(s.id), pad, scan_ranges.get(s.id),
-                        dyn_filters=dyn_filters.get(s.id), stats=stats))
+                    # split_scope: the hop seams inside this staging
+                    # call attribute their timeline intervals to the
+                    # si-th split without threading an index through
+                    # every connector signature
+                    with split_scope(si):
+                        batches.append(_scan_batch(
+                            s, sf, hints.get(s.id), pad,
+                            scan_ranges.get(s.id),
+                            dyn_filters=dyn_filters.get(s.id),
+                            stats=stats))
                 collector.operator(
                     _scan_key(si, s), _scan_label(s),
                     wall_us=int((time.time() - t_scan0) * 1e6))
@@ -802,7 +823,7 @@ def _run_query_inner(root: N.PlanNode, sf: float = 0.01, mesh=None,
     stats.add("output_rows", res.row_count)
     res.stats = stats.snapshot()
     _finalize_query_stats(collector, res, t_query0, peak_reserved, root,
-                          trace_id, dp=dp, acc=acc, sf=sf)
+                          trace_id, dp=dp, acc=acc, tl=tl, sf=sf)
     return res
 
 
@@ -845,8 +866,9 @@ def _dispatch_ladder(root: N.PlanNode, plan, jfn, call_lock, batches,
             exec_root, mesh, default_join_capacity * cap_scale,
             1, use_cache)
         stats.add("capacity_feedback_scale", cap_scale)
+    from .datapath import now_us as _now_us
     while True:
-        t_disp0 = time.time()
+        t_disp0 = _now_us()
         if jfn is None:
             fn = jax.jit(plan.fn)
             dispatch_fn = fn
@@ -858,8 +880,9 @@ def _dispatch_ladder(root: N.PlanNode, plan, jfn, call_lock, batches,
         jax.block_until_ready(out)
         # host-observed device occupancy of this dispatch: the
         # block_until_ready delta around the existing sync point is the
-        # only per-kernel timing one fused program exposes
-        device_s += time.time() - t_disp0
+        # only per-kernel timing one fused program exposes -- on the
+        # monotonic now_us clock the timeline intervals share
+        device_s += (_now_us() - t_disp0) / 1e6
         if prog is not None:  # each landed dispatch advances
             prog.advance()
         flags = int(np.asarray(overflow))
@@ -1023,12 +1046,13 @@ def _execute_regions(rplan, scan_leaves, batches, default_join_capacity,
                                      query_id=query_id, region=reg.tag,
                                      reason=str(e)[:200])
             if prep is not None:
-                t_don0 = time.time()
+                from .datapath import now_us as _now_us
+                t_don0 = _now_us()
                 with (call_lock if call_lock is not None
                       else contextlib.nullcontext()):
                     out, overflow = prep.dispatch(rbatches)
                 jax.block_until_ready(out)
-                dev_s = time.time() - t_don0
+                dev_s = (_now_us() - t_don0) / 1e6
                 if prog is not None:
                     prog.advance()
                 oflags = int(np.asarray(overflow))
@@ -1188,14 +1212,17 @@ def _result_bytes(res: "QueryResult") -> int:
 def _finalize_query_stats(collector: StatsCollector, res: "QueryResult",
                           t0: float, peak_reserved_bytes: int,
                           root: Optional[N.PlanNode],
-                          trace_id=None, dp=None, acc=None,
+                          trace_id=None, dp=None, acc=None, tl=None,
                           sf: float = 0.01) -> None:
     """Close out the structured stats for one run_query invocation and
     emit one tracer span per collected stage. `peak_reserved_bytes` is
     the pool high-water mark the caller already drained. `dp` is the
     invocation's datapath ledger: its hop map rides QueryStats.datapath
     (stitching worker slices through the task-status path) and the
-    bounded per-query registry flight dumps embed from."""
+    bounded per-query registry flight dumps embed from. `tl` is the
+    interval-timeline ledger (exec/timeline.py): its slice rides
+    QueryStats.timeline the same way, and the per-query registry keeps
+    it cross-linked to the query's trace id (the Chrome export)."""
     qs = collector.stats
     if dp is not None:
         from .datapath import merge_hop_maps, note_query
@@ -1203,6 +1230,16 @@ def _finalize_query_stats(collector: StatsCollector, res: "QueryResult",
         if hops:
             qs.datapath = merge_hop_maps(qs.datapath, hops)
             note_query(collector.query_id, hops)
+    if tl is not None:
+        from ..server.tracing import TraceContext as _TC
+        from .timeline import note_query as _tl_note
+        sl = tl.snapshot_slice()
+        if not sl.is_empty():
+            qs.timeline = qs.timeline.merge(sl)
+            _tl_note(collector.query_id, sl,
+                     trace_id=trace_id.trace_id
+                     if isinstance(trace_id, _TC)
+                     else (trace_id or collector.query_id))
     # drain any compile time not yet attributed (the streaming/spill
     # early-return paths compile inside their execute stage and never
     # reach the main path's drain); same clamp + anchor as there

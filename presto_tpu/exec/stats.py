@@ -47,6 +47,7 @@ from .accuracy import NodeAccuracy, merge_record_maps, \
     record_map_from_json, record_map_to_json
 from .datapath import HopStats, hop_map_from_json, hop_map_to_json, \
     merge_hop_maps
+from .timeline import TimelineSlice
 
 __all__ = ["RuntimeStats", "timed", "OperatorStats", "StageStats",
            "QueryStats", "StatsCollector", "current_collector",
@@ -216,6 +217,13 @@ class QueryStats:
     # query stitch to the coordinator's through the same path
     accuracy: Dict[str, NodeAccuracy] = \
         dataclasses.field(default_factory=dict)
+    # per-query interval-ledger slice (exec/timeline.py): bounded
+    # (lane, hop, split, t0, t1, bytes) records merged by the slice's
+    # own union-and-truncate law; shipped cross-process as skew-free
+    # ages, so a worker's slice stitches to the coordinator's without
+    # clock-skew-negative intervals
+    timeline: TimelineSlice = \
+        dataclasses.field(default_factory=TimelineSlice)
 
     # -- convenience accessors (the EXPLAIN ANALYZE / CLI summary view) --
 
@@ -250,7 +258,8 @@ class QueryStats:
             task_count=self.task_count + other.task_count,
             stages=stages, operators=operators, counters=counters,
             datapath=merge_hop_maps(self.datapath, other.datapath),
-            accuracy=merge_record_maps(self.accuracy, other.accuracy))
+            accuracy=merge_record_maps(self.accuracy, other.accuracy),
+            timeline=self.timeline.merge(other.timeline))
 
     def to_json(self) -> dict:
         return {"wallUs": self.wall_us,
@@ -263,7 +272,8 @@ class QueryStats:
                               for k, o in self.operators.items()},
                 "counters": dict(self.counters),
                 "datapath": hop_map_to_json(self.datapath),
-                "accuracy": record_map_to_json(self.accuracy)}
+                "accuracy": record_map_to_json(self.accuracy),
+                "timeline": self.timeline.to_json()}
 
     @classmethod
     def from_json(cls, doc: dict) -> "QueryStats":
@@ -282,7 +292,10 @@ class QueryStats:
             datapath=hop_map_from_json(doc.get("datapath", {})),
             # old-doc tolerance: records shipped before this field
             # existed deserialize to the empty map (merge identity)
-            accuracy=record_map_from_json(doc.get("accuracy", {})))
+            accuracy=record_map_from_json(doc.get("accuracy", {})),
+            # same tolerance: a missing timeline key is the empty
+            # slice (merge identity), never an error
+            timeline=TimelineSlice.from_json(doc.get("timeline", {})))
 
     def summary(self) -> str:
         """One-paragraph human summary (the CLI --stats shape)."""

@@ -298,6 +298,7 @@ def explain_analyze(root: N.PlanNode, sf: float = 0.01, **kwargs) -> str:
     lines.extend(_kernel_lines(executed, session))
     lines.extend(_datapath_lines(qs))
     lines.extend(_accuracy_lines(qs))
+    lines.extend(_timeline_lines(qs))
     # the flat named counters keep their historical tail section
     if res.stats:
         lines += ["", "-- runtime counters --"]
@@ -411,6 +412,37 @@ def _accuracy_lines(qs) -> List[str]:
             lines.append(f"verdict: {verdict['message']} ({qual})")
         return lines
     except Exception:  # noqa: BLE001 - the ledger is garnish here;
+        # EXPLAIN ANALYZE output must never fail on it
+        return []
+
+
+def _timeline_lines(qs) -> List[str]:
+    """EXPLAIN ANALYZE's execution-timeline tail (exec/timeline.py):
+    an ASCII Gantt per lane over THIS query's recorded intervals,
+    closed by the occupancy summary and the bubble verdict naming the
+    hop the device spent its idle wall waiting on."""
+    try:
+        from ..exec.timeline import ascii_gantt, bubble_verdict, occupancy
+        if qs is None or qs.timeline.is_empty():
+            return []
+        intervals = qs.timeline.intervals
+        occ = occupancy(intervals)
+        if occ is None:
+            return []
+        lines = ["", "-- timeline --"]
+        lines.extend(ascii_gantt(intervals))
+        lines.append(
+            f"wall={occ['wallUs']}us "
+            f"overlap={occ['overlapFraction']:.0%} "
+            f"device_idle={occ['deviceIdleUs']}us "
+            f"({occ['deviceIdleFraction']:.0%})"
+            + (f" dropped={qs.timeline.dropped}"
+               if qs.timeline.dropped else ""))
+        verdict = bubble_verdict(intervals, occ)
+        if verdict is not None:
+            lines.append(f"verdict: {verdict['message']}")
+        return lines
+    except Exception:  # noqa: BLE001 - the Gantt is garnish here;
         # EXPLAIN ANALYZE output must never fail on it
         return []
 

@@ -160,6 +160,13 @@ class WorkerClient:
         data, _ = self._request("GET", "/v1/accuracy")
         return json.loads(data)
 
+    def timeline(self) -> dict:
+        """The worker's execution-timeline slice (GET /v1/timeline),
+        pulled over the same authenticated transport as profile() so
+        the statement tier's cluster merge works on secured clusters."""
+        data, _ = self._request("GET", "/v1/timeline")
+        return json.loads(data)
+
     def status(self) -> dict:
         """The worker's enriched NodeStatus (GET /v1/status): liveness,
         uptime, version, running tasks, memory-pool occupancy -- the

@@ -155,6 +155,7 @@ class FlightRecorder:
             kernels = self._profile_of(key)
             datapath = self._datapath_of(key)
             accuracy = self._accuracy_of(key)
+            timeline = self._timeline_of(key)
             with open(path, "w") as f:
                 f.write(json.dumps(
                     {"dump": {"key": key, "reason": reason,
@@ -184,6 +185,14 @@ class FlightRecorder:
                     f.write(json.dumps(
                         {"accuracy": {"queryId": key,
                                       "nodes": accuracy}}) + "\n")
+                if timeline:
+                    # the execution timeline of THIS query (lane/hop
+                    # intervals + occupancy verdict): a slow-query dump
+                    # answers "what was the device waiting on" offline,
+                    # without a live /v1/timeline to ask
+                    f.write(json.dumps(
+                        {"timeline": {"queryId": key,
+                                      **timeline}}) + "\n")
                 for evt in events:
                     f.write(json.dumps(evt, default=str) + "\n")
         except Exception as e:  # noqa: BLE001 - a full disk must not
@@ -254,6 +263,19 @@ class FlightRecorder:
             # even when the ledger is broken; count the gap
             from .metrics import record_suppressed
             record_suppressed("flight_recorder", "accuracy_snapshot", e)
+            return {}
+
+    @staticmethod
+    def _timeline_of(key: str) -> dict:
+        """This query's lane/hop interval ledger + occupancy verdict
+        (best-effort, like the profile embed)."""
+        try:
+            from ..exec.timeline import timeline_for_query
+            return timeline_for_query(key)
+        except Exception as e:  # noqa: BLE001 - the dump must land
+            # even when the ledger is broken; count the gap
+            from .metrics import record_suppressed
+            record_suppressed("flight_recorder", "timeline_snapshot", e)
             return {}
 
     @staticmethod

@@ -971,6 +971,9 @@ class StatementServer:
             # estimate-accuracy lifetime summary (worst q-error + its
             # node): the ptop header's accuracy line
             "accuracy": self._accuracy_summary(),
+            # execution-timeline occupancy headline (overlap fraction,
+            # device-idle wall): the ptop occupancy line
+            "timeline": self._timeline_summary(),
         }
 
     def _accuracy_summary(self) -> dict:
@@ -995,6 +998,18 @@ class StatementServer:
             # take down the fleet overview
             from .metrics import record_suppressed
             record_suppressed("statement", "datapath_summary", e)
+            return {}
+
+    def _timeline_summary(self) -> dict:
+        """The cheap per-frame occupancy embed (never fails the fleet
+        overview)."""
+        try:
+            from ..exec.timeline import timeline_summary
+            return timeline_summary()
+        except Exception as e:  # noqa: BLE001 - introspection must not
+            # take down the fleet overview
+            from .metrics import record_suppressed
+            record_suppressed("statement", "timeline_summary", e)
             return {}
 
     def _batching_doc(self) -> dict:
@@ -1086,6 +1101,8 @@ class StatementServer:
         fams.extend(kernel_audit_families())
         fams.extend(donation_families())
         fams.extend(failpoint_families())
+        from .metrics import timeline_families
+        fams.extend(timeline_families())
         from .metrics import lock_families
         fams.extend(lock_families())
         fams.extend(query_history_families())
@@ -1125,6 +1142,16 @@ class StatementServer:
         worker from double-counting, exactly like the profile merge)."""
         from ..exec.accuracy import cluster_accuracy_doc
         return cluster_accuracy_doc(self._worker_urls())
+
+    def timeline_doc(self) -> dict:
+        """Cluster-merged execution-timeline ledger for GET
+        /v1/timeline: this process's slice plus every configured
+        worker's, per-query interval slices stitched on a shared
+        reference clock (exec/timeline.py; processId dedup keeps an
+        in-process worker from double-counting, exactly like the
+        profile merge)."""
+        from ..exec.timeline import cluster_timeline_doc
+        return cluster_timeline_doc(self._worker_urls())
 
     def _worker_urls(self) -> list:
         """The worker base URLs the cluster-merged surfaces
@@ -1285,6 +1312,11 @@ def _make_handler(server: StatementServer):
                 # cluster-merged per-plan-node estimate-vs-actual
                 # ledger with misestimate verdicts (exec/accuracy.py)
                 self._send(server.accuracy_doc())
+                return
+            if parts == ["v1", "timeline"]:
+                # cluster-merged execution-timeline ledger with
+                # occupancy/bubble verdicts (exec/timeline.py)
+                self._send(server.timeline_doc())
                 return
             if parts == ["v1", "history"]:
                 # cluster-merged completed-query archive (the perf

@@ -1014,6 +1014,8 @@ class _Handler(BaseHTTPRequestHandler):
         fams.extend(kernel_audit_families())
         fams.extend(donation_families())
         fams.extend(failpoint_families())
+        from .metrics import timeline_families
+        fams.extend(timeline_families())
         from .metrics import lock_families
         fams.extend(lock_families())
         from .metrics import (fleet_families,
@@ -1074,6 +1076,12 @@ class _Handler(BaseHTTPRequestHandler):
             # exec/accuracy.py)
             from ..exec.accuracy import accuracy_doc
             return self._send_json(accuracy_doc())
+        if parts == ["v1", "timeline"]:
+            # this worker's execution-timeline slice (the statement
+            # tier pulls + merges these cluster-wide with processId
+            # dedup; exec/timeline.py)
+            from ..exec.timeline import timeline_doc
+            return self._send_json(timeline_doc())
         if parts == ["v1", "history"]:
             # this process's completed-query archive slice (the
             # statement tier merges these cluster-wide like /v1/profile;

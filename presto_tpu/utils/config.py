@@ -309,6 +309,13 @@ SESSION_PROPERTIES = (
          "GET /v1/profile and SELECT * FROM system.kernels (env "
          "default PRESTO_TPU_PROFILE; on by default -- the overhead "
          "is one clock pair and a dict update per query)")
+    .add("timeline", "bool", True,
+         "record per-query execution-timeline intervals (exec/"
+         "timeline.py): (lane, hop, split, t0, t1, bytes) spans at the "
+         "datapath seams, powering occupancy/bubble verdicts, "
+         "GET /v1/timeline, system.occupancy and the Chrome trace "
+         "export (env default PRESTO_TPU_TIMELINE; on by default -- "
+         "bounded to 4096 intervals per query, totals-only beyond)")
 )
 
 

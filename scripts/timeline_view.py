@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Render execution timelines, or export them as a Chrome trace.
+
+The reading end of the interval-ledger contract (exec/timeline.py):
+point it at a tier's ``GET /v1/timeline`` (or a saved copy of that
+document) and it prints each retained query's per-lane ASCII Gantt with
+its occupancy summary and bubble verdict -- or, with ``--chrome``,
+writes Chrome trace-event JSON loadable in Perfetto / chrome://tracing,
+every span carrying the query's ``/v1/trace`` traceId in its args.
+
+  python scripts/timeline_view.py http://127.0.0.1:8080
+  python scripts/timeline_view.py http://127.0.0.1:8080 --chrome out.json
+  python scripts/timeline_view.py timeline.json --query q-42
+
+Exit codes: 0 rendered/exported, 1 no timelines, 2 source unreadable.
+"""
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+# repo root importable regardless of invocation directory
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+from presto_tpu.exec.timeline import (TimelineSlice, ascii_gantt,  # noqa: E402
+                                      bubble_verdict, occupancy,
+                                      to_chrome_trace)
+
+
+def load_doc(source: str, timeout: float = 5.0) -> dict:
+    """A ``/v1/timeline`` document from a base URL or a saved file."""
+    if source.startswith(("http://", "https://")):
+        url = f"{source.rstrip('/')}/v1/timeline"
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read())
+    with open(source) as f:
+        return json.load(f)
+
+
+def render(doc: dict, width: int = 48) -> str:
+    """The per-query Gantt + occupancy readout (pure; tested)."""
+    out = []
+    queries = doc.get("queries") or {}
+    for qid in sorted(queries):
+        entry = queries[qid] or {}
+        sl = TimelineSlice.from_json(entry.get("slice") or {}, now=0)
+        out.append(f"== {qid}" + (f"  trace={entry['traceId']}"
+                                  if entry.get("traceId") else ""))
+        if sl.is_empty():
+            out.append("  (no intervals retained)")
+            continue
+        out.extend(f"  {line}" for line in ascii_gantt(sl.intervals,
+                                                       width=width))
+        occ = occupancy(sl.intervals)
+        if occ is not None:
+            out.append(f"  wall={occ['wallUs']}us "
+                       f"overlap={occ['overlapFraction']:.0%} "
+                       f"device_idle={occ['deviceIdleUs']}us "
+                       f"({occ['deviceIdleFraction']:.0%})")
+            verdict = bubble_verdict(sl.intervals, occ)
+            if verdict is not None:
+                out.append(f"  verdict: {verdict['message']}")
+    t = doc.get("totals") or {}
+    out.append(f"queries={t.get('queries', 0)} "
+               f"intervals={t.get('intervals', 0)} "
+               f"dropped={t.get('dropped', 0)} "
+               f"degraded={t.get('degraded', 0)}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="timeline_view")
+    ap.add_argument("source", help="tier base URL (fetches /v1/timeline) "
+                                   "or a saved timeline JSON file")
+    ap.add_argument("--chrome", default=None, metavar="OUT",
+                    help="write Chrome trace-event JSON (Perfetto / "
+                         "chrome://tracing) instead of the ASCII Gantt")
+    ap.add_argument("--query", default=None,
+                    help="render only this query id")
+    ap.add_argument("--width", type=int, default=48)
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args(argv)
+
+    try:
+        doc = load_doc(args.source, timeout=args.timeout)
+    except Exception as e:  # noqa: BLE001 - source unreadable is the signal
+        print(f"error: cannot load {args.source}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    queries = doc.get("queries") or {}
+    if args.query is not None:
+        if args.query not in queries:
+            print(f"error: no timeline for {args.query!r}; have: "
+                  f"{sorted(queries) or 'none'}", file=sys.stderr)
+            return 1
+        doc = dict(doc, queries={args.query: queries[args.query]})
+    if args.chrome is not None:
+        trace = to_chrome_trace(doc)
+        with open(args.chrome, "w") as f:
+            json.dump(trace, f)
+        spans = sum(1 for e in trace["traceEvents"]
+                    if e.get("ph") == "X")
+        print(f"wrote {args.chrome}: {spans} spans across "
+              f"{len(queries)} queries")
+        return 0 if spans else 1
+    if not queries:
+        print("no timelines retained", file=sys.stderr)
+        return 1
+    print(render(doc, width=args.width))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -160,6 +160,15 @@ def test_buffer_donation_property_is_registered_and_keyed():
     assert ("PRESTO_TPU_DONATION", "0") in KERNEL_MODE_ENVS
 
 
+def test_timeline_property_is_registered_and_keyed():
+    """The timeline knob rides both registries: session property (on
+    by default -- the occupancy baseline must exist before the async
+    -pipeline PR) and kernel-mode env."""
+    prop = SESSION_PROPERTIES.properties["timeline"]
+    assert prop.default is True
+    assert ("PRESTO_TPU_TIMELINE", "1") in KERNEL_MODE_ENVS
+
+
 @pytest.mark.parametrize("name", sorted(_UNKEYED_ENVS))
 def test_unkeyed_allowlist_entries_are_still_read(name):
     """Allowlist hygiene: each unkeyed env is still read somewhere;

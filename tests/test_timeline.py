@@ -1,0 +1,611 @@
+"""Execution timeline & occupancy profiler (exec/timeline.py): the
+interval-slice merge law, QueryStats carry-through (incl. old-doc
+tolerance), occupancy/bubble-verdict purity + tiebreak, the q1
+serial-baseline overlap pin and the datapath-wall reconciliation, the
+Chrome trace-event export schema, both tiers' /v1/timeline zero shape,
+the 2-worker distributed stitch with skew-free ages, and the failpoint
+degradation round (broken ledger -> counted totals, oracle match)."""
+
+import json
+import os
+import sys
+import urllib.request
+
+import pytest
+
+from presto_tpu.exec.timeline import (LANES, MAX_INTERVALS, Interval,
+                                      TimelineLedger, TimelineSlice,
+                                      ascii_gantt, bubble_verdict,
+                                      clear_timeline, last_occupancy,
+                                      note_query, occupancy, recording,
+                                      record_interval, snapshot,
+                                      split_scope, timeline_doc,
+                                      timeline_for_query,
+                                      timeline_summary, timeline_totals,
+                                      to_chrome_trace)
+
+SF = 0.01
+
+# the official TPC-H q1 text (dialect-adapted exactly like bench.py)
+TPCH_Q1 = """
+SELECT returnflag, linestatus,
+       sum(quantity) AS sum_qty,
+       sum(extendedprice) AS sum_base_price,
+       sum(extendedprice * (1 - discount)) AS sum_disc_price,
+       sum(extendedprice * (1 - discount) * (1 + tax)) AS sum_charge,
+       avg(quantity) AS avg_qty,
+       avg(extendedprice) AS avg_price,
+       avg(discount) AS avg_disc,
+       count(*) AS count_order
+FROM lineitem
+WHERE shipdate <= date '1998-09-02'
+GROUP BY returnflag, linestatus
+ORDER BY returnflag, linestatus
+"""
+
+
+def _iv(hop, t0, t1, lane=None, split=-1, nbytes=0):
+    from presto_tpu.exec.timeline import LANE_OF
+    return Interval(lane or LANE_OF.get(hop, "host"), hop, split,
+                    t0, t1, nbytes)
+
+
+def _sl(*ivs, dropped=0):
+    s = TimelineSlice()
+    for iv in ivs:
+        s = s.merge(TimelineSlice([iv], 0, {
+            iv.hop: {"busyUs": iv.t1_us - iv.t0_us, "bytes": iv.bytes,
+                     "count": 1}}))
+    return TimelineSlice(s.intervals, s.dropped + dropped, s.totals)
+
+
+def _same(a: TimelineSlice, b: TimelineSlice):
+    assert a.intervals == b.intervals
+    assert a.dropped == b.dropped
+    assert a.totals == b.totals
+
+
+# -- the slice merge law -------------------------------------------------
+
+
+def test_slice_merge_identity():
+    a = _sl(_iv("connector_read", 0, 10, nbytes=5), dropped=2)
+    _same(a.merge(TimelineSlice()), a)
+    _same(TimelineSlice().merge(a), a)
+    assert TimelineSlice().is_empty()
+    assert not a.is_empty()
+
+
+def test_slice_merge_commutative_associative():
+    a = _sl(_iv("connector_read", 0, 10, nbytes=5))
+    b = _sl(_iv("kernel", 5, 25, nbytes=3), dropped=1)
+    c = _sl(_iv("device_put", 2, 8, nbytes=7),
+            _iv("connector_read", 9, 12))
+    _same(a.merge(b), b.merge(a))
+    _same(a.merge(b).merge(c), a.merge(b.merge(c)))
+    m = a.merge(b).merge(c)
+    assert m.totals["connector_read"]["count"] == 2
+    assert m.totals["kernel"]["busyUs"] == 20
+    assert m.dropped == 1
+    # intervals come out in the total sort order
+    assert m.intervals == sorted(m.intervals, key=Interval.sort_key)
+
+
+def test_slice_merge_truncates_and_counts_overflow():
+    a = TimelineSlice([_iv("serde_serialize", i, i + 1)
+                       for i in range(MAX_INTERVALS)], 0, {})
+    b = TimelineSlice([_iv("serde_serialize", MAX_INTERVALS + 1,
+                           MAX_INTERVALS + 2)], 0, {})
+    m = a.merge(b)
+    assert len(m.intervals) == MAX_INTERVALS
+    assert m.dropped == 1
+    # keep-k-smallest under a TOTAL order: the latest interval dropped
+    assert m.intervals[-1].t1_us == MAX_INTERVALS
+
+
+def test_slice_json_round_trip_is_skew_free():
+    a = _sl(_iv("connector_read", 100, 250, split=3, nbytes=64),
+            _iv("kernel", 200, 400, nbytes=8), dropped=1)
+    doc = a.to_json(now=1000)
+    b = TimelineSlice.from_json(json.loads(json.dumps(doc)), now=1000)
+    _same(b, a)
+    # a receiver 10ms "ahead" shifts the slice, never inverts it
+    c = TimelineSlice.from_json(doc, now=11_000)
+    assert [iv.t1_us - iv.t0_us for iv in c.intervals] == \
+        [iv.t1_us - iv.t0_us for iv in b.intervals]
+    assert all(iv.t0_us >= 0 and iv.t1_us >= iv.t0_us
+               for iv in c.intervals)
+    # a receiver whose clock reads 0 clamps, never goes negative
+    d = TimelineSlice.from_json(doc, now=0)
+    assert all(iv.t1_us >= iv.t0_us for iv in d.intervals)
+
+
+def test_query_stats_carries_timeline_and_tolerates_old_docs():
+    from presto_tpu.exec.stats import QueryStats
+    qs = QueryStats()
+    qs.timeline = _sl(_iv("device_put", 10, 30, nbytes=4))
+    doc = json.loads(json.dumps(qs.to_json()))
+    back = QueryStats.from_json(doc)
+    assert [iv.hop for iv in back.timeline.intervals] == ["device_put"]
+    assert [iv.t1_us - iv.t0_us for iv in back.timeline.intervals] \
+        == [20]
+    assert back.timeline.totals["device_put"]["bytes"] == 4
+    # merge folds slices like every other QueryStats field
+    other = QueryStats()
+    other.timeline = _sl(_iv("kernel", 0, 5), dropped=2)
+    m = qs.merge(other)
+    assert {iv.hop for iv in m.timeline.intervals} == \
+        {"device_put", "kernel"}
+    assert m.timeline.dropped == 2
+    # an OLD doc (no "timeline" key) deserializes to the identity
+    del doc["timeline"]
+    old = QueryStats.from_json(doc)
+    assert old.timeline.is_empty()
+
+
+# -- occupancy engine (pure) ---------------------------------------------
+
+
+def test_occupancy_pure_and_exact():
+    ivs = [_iv("connector_read", 0, 100, nbytes=10),
+           _iv("kernel", 50, 150)]
+    occ = occupancy(ivs)
+    assert occ == occupancy(list(ivs))            # pure: same doc twice
+    assert occ["wallUs"] == 150
+    assert occ["lanes"]["host"]["busyUs"] == 100
+    assert occ["lanes"]["device"]["busyUs"] == 100
+    assert occ["overlapUs"] == 50
+    assert occ["overlapFraction"] == 0.5
+    assert occ["deviceIdleUs"] == 50
+    # the idle window [0,50) is fully under connector_read
+    assert occ["bubbles"][0]["hop"] == "connector_read"
+    assert occ["bubbles"][0]["idleUs"] == 50
+    assert occupancy([]) is None
+
+
+def test_occupancy_accepts_raw_rows():
+    rows = _sl(_iv("connector_read", 0, 10)).rows()
+    assert occupancy(rows)["wallUs"] == 10
+
+
+def test_bubble_verdict_names_hop_and_tiebreaks():
+    # two host hops each own 10us of device idle: tie -> hop name asc
+    ivs = [_iv("device_put", 0, 10), _iv("connector_read", 10, 20),
+           _iv("kernel", 20, 40)]
+    v = bubble_verdict(ivs)
+    assert v["hop"] == "connector_read"
+    assert v["idleUs"] == 10
+    assert "device idle 50% of execute wall" in v["message"]
+    assert "connector_read (25%), device_put (25%)" in v["message"]
+    # no host activity during idle -> attributed to nothing, said so
+    v2 = bubble_verdict([_iv("kernel", 10, 20)])
+    assert v2["hop"] == "" and "no bubbles attributed" in v2["message"]
+    assert bubble_verdict([]) is None
+
+
+def test_ascii_gantt_shape():
+    lines = ascii_gantt([_iv("connector_read", 0, 50),
+                         _iv("kernel", 50, 100)], width=10)
+    assert lines == ["host   [#####.....]", "device [.....#####]"]
+    assert ascii_gantt([]) == []
+
+
+# -- ledger + ambient recording ------------------------------------------
+
+
+def test_ledger_records_split_scope_and_caps():
+    led = TimelineLedger(query_id="ql", max_intervals=2)
+    with recording(led):
+        with split_scope(7):
+            record_interval("connector_read", 10, 0, 5)
+        record_interval("device_put", 20, 5, 9)
+        record_interval("serde_serialize", 1, 9, 11)   # over the cap
+    sl = led.snapshot_slice()
+    assert [iv.split_id for iv in sl.intervals] == [7, -1]
+    assert sl.dropped == 1
+    # totals keep counting past the cap
+    assert sl.totals["serde_serialize"]["count"] == 1
+    # no ambient ledger: silently nothing
+    record_interval("connector_read", 1, 0, 1)
+    assert led.snapshot_slice().totals == sl.totals
+
+
+def test_disabled_ledger_records_nothing():
+    led = TimelineLedger(query_id="qd", enabled=False)
+    with recording(led):
+        record_interval("connector_read", 10, 0, 5)
+    assert led.snapshot_slice().is_empty()
+
+
+def test_session_property_gates_recording():
+    from presto_tpu.sql import sql
+    clear_timeline()
+    res = sql("SELECT count(*) AS n FROM region", sf=SF,
+              session={"timeline": False}, query_id="q-tl-off")
+    assert res.query_stats.timeline.is_empty()
+    assert timeline_for_query("q-tl-off") == {}
+
+
+# -- process registry + single-process surfaces --------------------------
+
+
+def test_note_query_registry_and_summary():
+    clear_timeline()
+    note_query("qa", _sl(_iv("connector_read", 0, 100, nbytes=10),
+                         _iv("kernel", 100, 150)), trace_id="tr-a")
+    note_query("qa", _sl(_iv("device_put", 40, 60)))   # re-note merges
+    t = timeline_totals()
+    assert t["queries"] == 1 and t["intervals"] == 3
+    doc = timeline_for_query("qa")
+    assert len(doc["intervals"]) == 3
+    assert doc["traceId"] == "tr-a"
+    assert doc["verdict"]["hop"] in ("connector_read", "device_put")
+    assert last_occupancy()["queryId"] == "qa"
+    s = timeline_summary()
+    assert s["queries"] == 1 and s["intervals"] == 3
+    assert s["deviceIdleUs"] == occupancy(
+        timeline_for_query("qa")["intervals"])["deviceIdleUs"]
+    rows = snapshot()
+    assert [r["lane"] for r in rows] == list(LANES)
+    assert all(r["queryId"] == "qa" for r in rows)
+
+
+def test_q1_records_intervals_and_explain_renders_gantt():
+    from presto_tpu.plan import explain_analyze
+    from presto_tpu.sql import plan_sql
+    clear_timeline()
+    text = explain_analyze(plan_sql(TPCH_Q1), sf=SF)
+    assert "-- timeline --" in text
+    tail = text[text.index("-- timeline --"):]
+    assert "host   [" in tail and "device [" in tail
+    assert "overlap=" in tail and "device_idle=" in tail
+    assert "verdict: device idle" in tail
+
+
+# -- the q1 serial-baseline pin + datapath reconciliation ----------------
+
+
+def test_q1_serial_baseline_overlap_near_zero_and_staging_bubble():
+    """Acceptance criterion: today's strictly serial staging measures
+    ~0 overlap on q1, and the bubble verdict deterministically names a
+    staging hop (connector_read or device_put) as the dominant
+    device-idle cause -- the committed baseline the async-ingest PR
+    must visibly move."""
+    from presto_tpu.sql import sql
+    clear_timeline()
+    res = sql(TPCH_Q1, sf=SF, query_id="q1-pin")
+    ivs = res.query_stats.timeline.intervals
+    assert ivs, "q1 recorded no intervals"
+    occ = occupancy(ivs)
+    assert occ["overlapFraction"] < 0.2           # serial pipeline
+    v = bubble_verdict(ivs, occ)
+    assert v["hop"] in ("connector_read", "device_put")
+    assert occ["deviceIdleUs"] > 0
+
+
+def test_q1_interval_durations_reconcile_with_hop_walls():
+    """Satellite: hop sums and interval durations share ONE monotonic
+    clock (datapath.now_us), so per-hop interval-duration sums
+    reconcile with the datapath hop walls within 1% on q1."""
+    from presto_tpu.sql import sql
+    res = sql(TPCH_Q1, sf=SF)
+    qs = res.query_stats
+    assert qs.timeline.intervals and not qs.timeline.dropped
+    by_hop = {}
+    for iv in qs.timeline.intervals:
+        by_hop[iv.hop] = by_hop.get(iv.hop, 0) + (iv.t1_us - iv.t0_us)
+    checked = 0
+    for hop, dur in by_hop.items():
+        wall = qs.datapath[hop].wall_us
+        assert abs(dur - wall) <= max(wall * 0.01, 1), \
+            f"{hop}: intervals {dur}us vs hop wall {wall}us"
+        checked += 1
+    assert checked >= 3                           # read/put/kernel
+
+
+# -- failpoint degradation -----------------------------------------------
+
+
+def test_failpoint_degrades_to_counted_totals_with_oracle_match():
+    from presto_tpu import failpoints
+    from presto_tpu.sql import sql
+    clear_timeline()
+    oracle = sql("SELECT count(*) AS n FROM region", sf=SF,
+                 session={"timeline": False})
+    before = timeline_totals()["degraded"]
+    failpoints.arm("timeline.record", "error:once")
+    try:
+        res = sql("SELECT count(*) AS n FROM region", sf=SF,
+                  query_id="q-fp-tl")
+    finally:
+        failpoints.disarm_all()
+    assert res.canonical_rows() == oracle.canonical_rows()
+    sl = res.query_stats.timeline
+    # STICKY: intervals dropped from the first failure on, totals kept
+    assert not sl.intervals and sl.dropped >= 1 and sl.totals
+    assert timeline_totals()["degraded"] - before == 1
+    from presto_tpu.server.flight_recorder import get_flight_recorder
+    evts = get_flight_recorder().events(kind="timeline_degraded")
+    assert any(e.get("queryId") == "q-fp-tl" for e in evts)
+
+
+# -- Chrome trace export -------------------------------------------------
+
+
+def test_chrome_trace_schema_and_trace_id_cross_link():
+    clear_timeline()
+    note_query("qc", _sl(_iv("connector_read", 0, 100, split=2,
+                             nbytes=10),
+                         _iv("kernel", 100, 150)), trace_id="tr-c")
+    trace = to_chrome_trace(timeline_doc())
+    assert set(trace) == {"traceEvents", "displayTimeUnit"}
+    assert trace["displayTimeUnit"] == "ms"
+    spans = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    metas = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+    assert len(spans) == 2
+    assert {m["name"] for m in metas} == {"process_name", "thread_name"}
+    for e in spans:
+        # the schema pin: every complete event carries the full shape
+        assert set(e) == {"name", "cat", "ph", "ts", "dur", "pid",
+                          "tid", "args"}
+        assert e["ts"] >= 0 and e["dur"] >= 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert e["cat"] in LANES
+        assert e["tid"] == LANES.index(e["cat"]) + 1
+        # acceptance criterion: spans carry the /v1/trace traceId
+        assert e["args"]["traceId"] == "tr-c"
+        assert e["args"]["queryId"] == "qc"
+    k = next(e for e in spans if e["name"] == "kernel")
+    r = next(e for e in spans if e["name"] == "connector_read")
+    assert k["ts"] == r["ts"] + 100 and k["dur"] == 50
+    assert r["args"]["splitId"] == 2 and r["args"]["bytes"] == 10
+    assert json.loads(json.dumps(trace)) == trace  # JSON-clean
+
+
+def test_timeline_view_script_renders_and_exports(tmp_path):
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts"))
+    import timeline_view
+    clear_timeline()
+    note_query("qv", _sl(_iv("connector_read", 0, 80, nbytes=10),
+                         _iv("kernel", 80, 100)), trace_id="tr-v")
+    src = tmp_path / "tl.json"
+    src.write_text(json.dumps(timeline_doc()))
+    out = timeline_view.render(json.loads(src.read_text()))
+    assert "== qv" in out and "trace=tr-v" in out
+    assert "host   [" in out and "verdict: device idle" in out
+    chrome = tmp_path / "chrome.json"
+    assert timeline_view.main([str(src), "--chrome", str(chrome)]) == 0
+    doc = json.loads(chrome.read_text())
+    assert sum(1 for e in doc["traceEvents"] if e["ph"] == "X") == 2
+
+
+# -- metrics / scrape / ptop / bench / perfgate surfaces -----------------
+
+
+def test_timeline_families_zero_shape():
+    from presto_tpu.server.metrics import (parse_prometheus,
+                                           render_prometheus,
+                                           timeline_families)
+    clear_timeline()
+    snap = parse_prometheus(
+        render_prometheus(timeline_families()).decode())
+    for fam in ("presto_tpu_timeline_intervals_total",
+                "presto_tpu_timeline_dropped_total",
+                "presto_tpu_timeline_queries_total",
+                "presto_tpu_overlap_fraction",
+                "presto_tpu_device_idle_us"):
+        assert snap[fam][""] == 0.0
+    note_query("qm", _sl(_iv("connector_read", 0, 60),
+                         _iv("kernel", 60, 100)))
+    snap = parse_prometheus(
+        render_prometheus(timeline_families()).decode())
+    assert snap["presto_tpu_timeline_intervals_total"][""] == 2.0
+    assert snap["presto_tpu_device_idle_us"][""] == 60.0
+
+
+def test_scrape_metrics_timeline_section():
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts"))
+    import scrape_metrics
+    from presto_tpu.server.metrics import (parse_prometheus,
+                                           render_prometheus,
+                                           timeline_families)
+    clear_timeline()
+    snap = parse_prometheus(
+        render_prometheus(timeline_families()).decode())
+    d = scrape_metrics.diff(snap, snap)
+    # always present, zeros included
+    assert d["timeline"] == {
+        "presto_tpu_timeline_intervals_total": 0.0,
+        "presto_tpu_timeline_dropped_total": 0.0,
+        "presto_tpu_timeline_queries_total": 0.0,
+        "presto_tpu_overlap_fraction": 0.0,
+        "presto_tpu_device_idle_us": 0.0,
+    }
+
+
+def test_ptop_renders_occupancy_line():
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts"))
+    import ptop
+    doc = {"uptimeSeconds": 1.0, "queries": {},
+           "timeline": {"queries": 3, "intervals": 12, "dropped": 1,
+                        "overlapFraction": 0.25,
+                        "deviceIdleUs": 31_000},
+           "runningQueries": [], "workers": []}
+    out = ptop.render(doc)
+    assert "occupancy overlap 25%" in out
+    assert "device idle 31.0ms" in out
+    assert "intervals 12 (1 dropped)" in out
+
+
+def test_system_occupancy_sql():
+    from presto_tpu.sql import sql
+    clear_timeline()
+    sql("SELECT count(*) AS n FROM region", sf=SF, query_id="q-occ")
+    res = sql("SELECT query_id, lane, busy_us, busy_fraction, wall_us, "
+              "overlap_fraction, device_idle_us, bubble_hop "
+              "FROM system.occupancy")
+    rows = [r for r in res.rows() if r[0] == "q-occ"]
+    assert {r[1] for r in rows} == set(LANES)
+    dev = next(r for r in rows if r[1] == "device")
+    assert dev[2] > 0 and dev[4] > 0              # busy_us, wall_us
+
+
+def test_bench_timeline_smoke_and_perfgate_spec():
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    import bench
+    from presto_tpu.exec.perfgate import BENCH_SPECS, compare
+    d = bench._timeline_smoke()
+    assert 0.0 <= d["overlap_fraction"] <= 1.0
+    assert d["device_idle_us"] >= 0
+    assert d["bubble_hop"] in ("connector_read", "device_put")
+    assert "bubbles attributed" in d["bubble_verdict"]
+    spec = {s.name: s for s in BENCH_SPECS}["overlap_fraction"]
+    assert spec.higher_is_worse is False
+    assert spec.abs_floor == 0.05
+    # overlap REGRESSES DOWN: losing achieved pipelining fires ...
+    v = compare(0.05, [0.5, 0.55, 0.5, 0.52, 0.5], spec)
+    assert v is not None and v["metric"] == "overlap_fraction"
+    # ... while jitter around today's serial ~0 stays inside the floor
+    assert compare(0.0, [0.01, 0.02, 0.01, 0.0, 0.01], spec) is None
+
+
+def test_flight_dump_embeds_timeline():
+    clear_timeline()
+    from presto_tpu.server.flight_recorder import FlightRecorder
+    note_query("qf", _sl(_iv("connector_read", 0, 40),
+                         _iv("kernel", 40, 90)), trace_id="tr-f")
+    doc = FlightRecorder._timeline_of("qf")
+    assert len(doc["intervals"]) == 2
+    assert doc["verdict"]["hop"] == "connector_read"
+    assert doc["traceId"] == "tr-f"
+    assert FlightRecorder._timeline_of("nope") == {}
+
+
+# -- both tiers' /v1/timeline --------------------------------------------
+
+
+def test_v1_timeline_worker_slice_and_cluster_merge():
+    from presto_tpu.server import TpuWorkerServer
+    from presto_tpu.server.statement import StatementServer
+    clear_timeline()
+    note_query("qt", _sl(_iv("connector_read", 0, 100, nbytes=10),
+                         _iv("kernel", 100, 160)), trace_id="tr-t")
+    w = TpuWorkerServer(sf=SF).start()
+    url = f"http://127.0.0.1:{w.port}"
+    try:
+        with urllib.request.urlopen(f"{url}/v1/timeline") as r:
+            doc = json.loads(r.read().decode())
+        assert doc["processId"]
+        # stable zero shape: every lifetime counter present
+        assert set(doc["totals"]) == {"intervals", "dropped",
+                                      "queries", "degraded"}
+        entry = doc["queries"]["qt"]
+        assert len(entry["slice"]["intervals"]) == 2
+        assert entry["traceId"] == "tr-t"
+        assert entry["verdict"]["hop"] == "connector_read"
+        assert doc["verdict"] is not None
+        with StatementServer(sf=SF,
+                             profile_workers=lambda: [url]) as srv:
+            with urllib.request.urlopen(f"{srv.url}/v1/timeline") as r:
+                cdoc = json.loads(r.read().decode())
+            cluster = srv.cluster_doc()
+    finally:
+        w.stop()
+    assert cdoc["cluster"] is True
+    assert cdoc["workersPulled"] == 1
+    # worker + statement shells share one process: deduped, not doubled
+    assert cdoc["totals"]["intervals"] == doc["totals"]["intervals"]
+    assert len(cdoc["queries"]["qt"]["slice"]["intervals"]) == 2
+    # no clock-skew-negative intervals survive the merge
+    for row in cdoc["queries"]["qt"]["slice"]["intervals"]:
+        assert row[3] >= 0 and row[4] >= 0        # endAgeUs, durUs
+    # the cheap /v1/cluster embed agrees on the headline numbers
+    assert cluster["timeline"]["intervals"] == \
+        doc["totals"]["intervals"]
+
+
+def test_v1_timeline_empty_zero_shape_both_tiers():
+    from presto_tpu.server import TpuWorkerServer
+    from presto_tpu.server.statement import StatementServer
+    clear_timeline()
+    w = TpuWorkerServer(sf=SF).start()
+    url = f"http://127.0.0.1:{w.port}"
+    try:
+        with urllib.request.urlopen(f"{url}/v1/timeline") as r:
+            doc = json.loads(r.read().decode())
+        with StatementServer(sf=SF,
+                             profile_workers=lambda: [url]) as srv:
+            with urllib.request.urlopen(f"{srv.url}/v1/timeline") as r:
+                cdoc = json.loads(r.read().decode())
+    finally:
+        w.stop()
+    for d in (doc, cdoc):
+        assert d["queries"] == {}
+        assert d["verdict"] is None
+        assert all(d["totals"][k] == 0 for k in
+                   ("intervals", "dropped", "queries", "degraded"))
+    assert cdoc["cluster"] is True and cdoc["workersPulled"] == 1
+
+
+def test_merge_timeline_docs_dedups_process_slices():
+    from presto_tpu.exec.timeline import merge_timeline_docs
+    sl = _sl(_iv("connector_read", 0, 50), _iv("kernel", 50, 80))
+    entry = {"slice": sl.to_json(now=100), "traceId": "tr-m"}
+    d1 = {"processId": "p1", "totals": {"intervals": 2, "dropped": 0,
+                                        "queries": 1, "degraded": 0},
+          "queries": {"qm": entry}}
+    merged = merge_timeline_docs([d1, dict(d1)], now=100)
+    # the same process pulled twice counts ONCE
+    assert merged["totals"]["intervals"] == 2
+    assert len(merged["queries"]["qm"]["slice"]["intervals"]) == 2
+    assert merged["queries"]["qm"]["traceId"] == "tr-m"
+    # distinct processes stitch by the slice law
+    d2 = {"processId": "p2", "totals": {"intervals": 1, "dropped": 0,
+                                        "queries": 1, "degraded": 0},
+          "queries": {"qm": {"slice": _sl(
+              _iv("device_put", 10, 30)).to_json(now=100)}}}
+    both = merge_timeline_docs([d1, d2], now=100)
+    assert both["totals"]["intervals"] == 3
+    assert len(both["queries"]["qm"]["slice"]["intervals"]) == 3
+
+
+# -- the 2-worker distributed stitch -------------------------------------
+
+
+def test_two_worker_timeline_slices_stitch_skew_free():
+    """The distributed path: two real workers each run fragment
+    slices; their interval ledgers ship home as (endAge, dur) rows on
+    task status (QueryStats) and stitch on the coordinator clock --
+    both lanes present, no clock-skew-negative intervals, and the hop
+    totals cover the staging path AND the kernel."""
+    from presto_tpu.plan.distribute import add_exchanges
+    from presto_tpu.server import Coordinator, TpuWorkerServer
+    from presto_tpu.sql import plan_sql
+    workers = [TpuWorkerServer(sf=SF).start() for _ in range(2)]
+    coord = Coordinator([f"http://127.0.0.1:{w.port}" for w in workers])
+    try:
+        root = add_exchanges(plan_sql(
+            "SELECT custkey, count(*) AS c FROM orders "
+            "GROUP BY custkey", max_groups=1 << 14))
+        cols, names = coord.execute(root, sf=SF)
+        assert cols
+        qs = coord.last_query_stats
+        tl = qs.timeline
+        assert tl.intervals
+        assert {iv.lane for iv in tl.intervals} == set(LANES)
+        for iv in tl.intervals:
+            assert iv.t0_us >= 0 and iv.t1_us >= iv.t0_us
+        for hop in ("connector_read", "device_put", "kernel"):
+            assert tl.totals[hop]["count"] >= 2, \
+                f"{hop} not stitched from both workers"
+        assert occupancy(tl.intervals) is not None
+    finally:
+        for w in workers:
+            w.stop()
